@@ -1,0 +1,25 @@
+"""hivedscheduler_tpu: a TPU-native HiveD.
+
+A from-scratch Kubernetes scheduler extender that gang-schedules multi-host
+Cloud TPU workloads with topology-guaranteed virtual-cluster quotas, in the
+spirit of HiveD (OSDI '20; reference: Global19/hivedscheduler).
+
+Where the reference's cell hierarchy models GPU/PCIe/NVLink/IB topology, ours
+models the Cloud TPU ICI torus (chip -> 4-chip TPU-VM host -> cube -> full
+slice); its buddy allocator hands out contiguous ICI sub-slices; and at bind
+time it injects the ``jax.distributed`` environment (coordinator address,
+worker ids, visible chips) into scheduled pods.
+
+Layer map (mirrors reference SURVEY.md section 1):
+  - ``common``:    generic utilities (codecs, logging)
+  - ``api``:       public config/annotation schema, constants, status DTOs
+  - ``algorithm``: the scheduling core (cells, placement, buddy alloc,
+                   preemption state machine, VC safety)
+  - ``scheduler``: the K8s bridge (pod state machine, assume/force bind)
+  - ``webserver``: HTTP extender + inspect API
+  - ``tpu``:       TPU topology presets and the JAX distributed env contract
+  - ``models``/``ops``/``parallel``: TPU-first JAX workloads scheduled by the
+                   framework (the five BASELINE.json configs)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,269 @@
+"""The cell tree: the resource model of the scheduler.
+
+A *cell* is a set of TPU chips affinitized by the ICI interconnect topology:
+level 1 is one chip, higher levels are progressively larger contiguous
+sub-slices (4-chip TPU-VM host, 4x4x4 cube, full slice). Cells form trees via
+parent/child pointers; a *chain* is a tree shape named by its top cell type.
+
+Python equivalent of the reference's ``pkg/algorithm/cell.go`` (Cell interface
+L34-48, GenericCell L58-128, PhysicalCell L130-313, VirtualCell L315-423) and
+the container types in ``pkg/algorithm/types.go`` (CellList L55, ChainCellList
+L97). Unlike the reference, inspect-API statuses are generated on demand by
+walking the trees (see core.py) instead of being incrementally mirrored.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional, TYPE_CHECKING
+
+from ..api import types as api
+
+if TYPE_CHECKING:
+    from .group import AffinityGroup
+
+CellChain = str
+CellLevel = int
+CellPriority = int
+
+# Internal priority space (reference: algorithm/constants.go:30-36).
+MAX_GUARANTEED_PRIORITY: CellPriority = api.constants.MAX_GUARANTEED_PRIORITY
+MIN_GUARANTEED_PRIORITY: CellPriority = api.constants.MIN_GUARANTEED_PRIORITY
+OPPORTUNISTIC_PRIORITY: CellPriority = api.constants.OPPORTUNISTIC_PRIORITY
+FREE_PRIORITY: CellPriority = OPPORTUNISTIC_PRIORITY - 1
+
+LOWEST_LEVEL: CellLevel = 1
+HIGHEST_LEVEL: CellLevel = 2**31 - 1
+
+
+class CellState(str, enum.Enum):
+    """Cell states (reference: algorithm/constants.go:40-58 and
+    doc/design/state-machine.md "Cell State Machine"):
+
+    - FREE:      no group is associated; priority must be FREE_PRIORITY.
+    - USED:      a group is using it; nobody is reserving it.
+    - RESERVING: a group is using it AND a preempting group is reserving it.
+    - RESERVED:  nobody is using it and a preempting group has reserved it.
+    """
+
+    FREE = "Free"
+    USED = "Used"
+    RESERVING = "Reserving"
+    RESERVED = "Reserved"
+
+
+class Cell:
+    """Common cell behavior (reference: GenericCell, cell.go:58-128)."""
+
+    __slots__ = (
+        "chain",
+        "level",
+        "address",
+        "cell_type",
+        "is_node_level",
+        "parent",
+        "children",
+        "at_or_higher_than_node",
+        "priority",
+        "state",
+        "healthy",
+        "total_leaf_cell_num",
+        "used_leaf_cells_at_priority",
+    )
+
+    def __init__(
+        self,
+        chain: CellChain,
+        level: CellLevel,
+        address: api.CellAddress,
+        at_or_higher_than_node: bool,
+        total_leaf_cell_num: int,
+        cell_type: api.CellType = "",
+        is_node_level: bool = False,
+    ):
+        self.chain = chain
+        self.level = level
+        self.address = address
+        self.cell_type = cell_type
+        self.is_node_level = is_node_level
+        self.parent: Optional[Cell] = None
+        self.children: List[Cell] = []
+        self.at_or_higher_than_node = at_or_higher_than_node
+        self.priority: CellPriority = FREE_PRIORITY
+        self.state: CellState = CellState.FREE
+        # Healthy if all children are healthy; orthogonal to priority/state
+        # (reference: cell.go:100-103). Cells start healthy; HivedCore's
+        # init marks every node bad until the informer reports it
+        # (reference: hived_algorithm.go:453-465).
+        self.healthy = True
+        self.total_leaf_cell_num = total_leaf_cell_num
+        #
+
+        # Leaf-cell usage per priority, for VC-safety and preemption decisions
+        # (reference: cell.go:104-106, 122-127).
+        self.used_leaf_cells_at_priority: Dict[CellPriority, int] = {}
+
+    def set_children(self, children: List["Cell"]) -> None:
+        self.children = children
+
+    def increase_used_leaf_cells_at_priority(
+        self, priority: CellPriority, delta: int
+    ) -> None:
+        """(reference: cell.go:122-127)"""
+        n = self.used_leaf_cells_at_priority.get(priority, 0) + delta
+        if n == 0:
+            self.used_leaf_cells_at_priority.pop(priority, None)
+        else:
+            self.used_leaf_cells_at_priority[priority] = n
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}({self.address}, p={self.priority})"
+
+
+def cell_equal(c1: Optional[Cell], c2: Optional[Cell]) -> bool:
+    """(reference: cell.go:50-56)"""
+    if c1 is None or c2 is None:
+        return c1 is None and c2 is None
+    return c1.address == c2.address
+
+
+class PhysicalCell(Cell):
+    """A cell in the physical cluster (reference: cell.go:130-313)."""
+
+    __slots__ = (
+        "nodes",
+        "leaf_cell_indices",
+        "using_group",
+        "reserving_or_reserved_group",
+        "virtual_cell",
+        "split",
+        "pinned",
+    )
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # Physical placement: K8s node names inside the cell and (for cells at
+        # or below node level) per-node chip indices; [-1] above node level
+        # (reference: cell.go:132-134, config.go:176).
+        self.nodes: List[str] = []
+        self.leaf_cell_indices: List[int] = []
+        self.using_group: Optional["AffinityGroup"] = None
+        self.reserving_or_reserved_group: Optional["AffinityGroup"] = None
+        self.virtual_cell: Optional["VirtualCell"] = None
+        self.split = False
+        self.pinned = False
+
+    def set_physical_resources(
+        self, nodes: List[str], leaf_cell_indices: List[int]
+    ) -> None:
+        self.nodes = nodes
+        self.leaf_cell_indices = leaf_cell_indices
+
+    def placement_string(self) -> str:
+        return f"{self.nodes}:{self.leaf_cell_indices}"
+
+    def set_state(self, s: CellState) -> None:
+        """State changes mirror into the bound virtual cell
+        (reference: cell.go:195-205)."""
+        self.state = s
+        if self.virtual_cell is not None:
+            self.virtual_cell.state = s
+
+    def set_priority(self, p: CellPriority) -> None:
+        self.priority = p
+
+    def set_healthiness(self, healthy: bool) -> None:
+        """Healthiness mirrors into the bound virtual cell
+        (reference: cell.go:302-313)."""
+        self.healthy = healthy
+        if self.virtual_cell is not None:
+            self.virtual_cell.healthy = healthy
+
+    def add_using_group(self, g: "AffinityGroup") -> None:
+        """(reference: cell.go:225-232; conflicting adds are logged, last
+        writer wins, matching the reference's non-fatal error log)"""
+        self.using_group = g
+
+    def delete_using_group(self, g: "AffinityGroup") -> None:
+        self.using_group = None
+
+    def add_reserving_or_reserved_group(self, g: "AffinityGroup") -> None:
+        self.reserving_or_reserved_group = g
+
+    def delete_reserving_or_reserved_group(self, g: "AffinityGroup") -> None:
+        self.reserving_or_reserved_group = None
+
+    def set_virtual_cell(self, cell: Optional["VirtualCell"]) -> None:
+        self.virtual_cell = cell
+
+
+class VirtualCell(Cell):
+    """A cell in a virtual cluster (reference: cell.go:315-423)."""
+
+    __slots__ = ("vc", "pinned_cell_id", "preassigned_cell", "physical_cell")
+
+    def __init__(self, vc: api.VirtualClusterName, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.vc = vc
+        self.pinned_cell_id: api.PinnedCellId = ""
+        # Top-level ancestor: the cell the VC's quota is actually counted in
+        # (reference: cell.go:319).
+        self.preassigned_cell: Optional["VirtualCell"] = None
+        self.physical_cell: Optional[PhysicalCell] = None
+
+    def set_priority(self, p: CellPriority) -> None:
+        self.priority = p
+
+    def set_physical_cell(self, cell: Optional[PhysicalCell]) -> None:
+        """Unbinding resets state/health since a dangling virtual cell has no
+        hardware underneath (reference: cell.go:401-420)."""
+        self.physical_cell = cell
+        if cell is None:
+            self.state = CellState.FREE
+            self.healthy = True
+        else:
+            self.healthy = cell.healthy
+
+
+class ChainCellList:
+    """Per-level cell lists for one chain
+    (reference: algorithm/types.go:97-131 ``ChainCellList``)."""
+
+    def __init__(self, top_level: CellLevel = 0):
+        self.levels: Dict[CellLevel, List[Cell]] = {
+            l: [] for l in range(LOWEST_LEVEL, top_level + 1)
+        }
+
+    def __getitem__(self, level: CellLevel) -> List[Cell]:
+        return self.levels.setdefault(level, [])
+
+    def __contains__(self, level: CellLevel) -> bool:
+        return level in self.levels
+
+    @property
+    def top_level(self) -> CellLevel:
+        return max(self.levels) if self.levels else 0
+
+    def contains(self, c: Cell, level: CellLevel) -> bool:
+        return any(cell_equal(c, cc) for cc in self.levels.get(level, []))
+
+    def remove(self, c: Cell, level: CellLevel) -> None:
+        lst = self.levels[level]
+        for i, cc in enumerate(lst):
+            if cell_equal(c, cc):
+                lst.pop(i)
+                return
+        raise api.internal_error(
+            f"Cell not found in list when removing: {c.address}"
+        )
+
+    def shallow_copy(self) -> "ChainCellList":
+        copied = ChainCellList()
+        copied.levels = {l: list(cl) for l, cl in self.levels.items()}
+        return copied
+
+    def __repr__(self) -> str:
+        return "\n".join(
+            f"level {l}: {[c.address for c in cl]}"
+            for l, cl in sorted(self.levels.items())
+        )

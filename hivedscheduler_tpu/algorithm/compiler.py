@@ -1,0 +1,400 @@
+"""Config compiler: YAML spec -> cell trees.
+
+Python equivalent of the reference's ``pkg/algorithm/config.go``: cell-type
+chain compilation (cellTypeConstructor L45-108), physical cell instantiation
+(physicalCellConstructor L110-235), per-VC virtual cell instantiation
+(virtualCellConstructor L237-413), and the chain metadata maps
+(parseCellChainInfo L415-440, ParseConfig L442-477).
+
+For TPU clusters the chains encode the ICI torus decomposition, e.g.::
+
+    v5p-chip -> v5p-host(4 chips) -> v5p-cube(16 hosts) -> v5p-slice
+
+with node level = the TPU-VM host (the K8s node). See tpu/topology.py for
+preset chain generators.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api import types as api
+from ..api.config import Config
+from .cell import (
+    Cell,
+    CellChain,
+    CellLevel,
+    ChainCellList,
+    LOWEST_LEVEL,
+    PhysicalCell,
+    VirtualCell,
+)
+
+
+@dataclass
+class ChainElement:
+    """Compiled metadata for one cell type in a chain
+    (reference: config.go:34-43 ``cellChainElement``)."""
+
+    cell_type: api.CellType
+    level: CellLevel
+    child_cell_type: api.CellType
+    child_number: int
+    has_node: bool       # at or above node (TPU-VM host) level
+    is_multi_nodes: bool  # strictly above node level (multi-host slice)
+    leaf_cell_type: str
+    leaf_cell_number: int
+
+
+def build_cell_chains(
+    cell_types: Dict[api.CellType, api.CellTypeSpec]
+) -> Dict[api.CellType, ChainElement]:
+    """Compile the cell-type forest into per-type chain elements. A type not
+    present in the map is a leaf cell (one TPU chip)
+    (reference: config.go:59-108)."""
+    elements: Dict[api.CellType, ChainElement] = {}
+
+    def add(ct: api.CellType) -> None:
+        if ct in elements:
+            return
+        spec = cell_types.get(ct)
+        if spec is None:
+            elements[ct] = ChainElement(
+                cell_type=ct,
+                level=LOWEST_LEVEL,
+                child_cell_type="",
+                child_number=0,
+                has_node=False,
+                is_multi_nodes=False,
+                leaf_cell_type=str(ct),
+                leaf_cell_number=1,
+            )
+            return
+        add(spec.child_cell_type)
+        child = elements[spec.child_cell_type]
+        elements[ct] = ChainElement(
+            cell_type=ct,
+            level=child.level + 1,
+            child_cell_type=child.cell_type,
+            child_number=spec.child_cell_number,
+            has_node=child.has_node or spec.is_node_level,
+            is_multi_nodes=child.has_node,
+            leaf_cell_type=child.leaf_cell_type,
+            leaf_cell_number=child.leaf_cell_number * spec.child_cell_number,
+        )
+
+    for ct in cell_types:
+        add(ct)
+    return elements
+
+
+class _PhysicalBuilder:
+    """Instantiate physical cell trees from specs
+    (reference: config.go:110-235)."""
+
+    def __init__(
+        self,
+        elements: Dict[api.CellType, ChainElement],
+        specs: List[api.PhysicalCellSpec],
+    ):
+        self.elements = elements
+        self.specs = specs
+        self.full_list: Dict[CellChain, ChainCellList] = {}
+        self.free_list: Dict[CellChain, ChainCellList] = {}
+        self.pinned_cells: Dict[api.PinnedCellId, PhysicalCell] = {}
+        self._chain: CellChain = ""
+
+    def build(
+        self,
+    ) -> Tuple[
+        Dict[CellChain, ChainCellList],
+        Dict[CellChain, ChainCellList],
+        Dict[api.PinnedCellId, PhysicalCell],
+    ]:
+        for spec in self.specs:
+            self._chain = spec.cell_type
+            element = self.elements.get(spec.cell_type)
+            if element is None:
+                raise api.bad_request(
+                    f"cellType {spec.cell_type} in physicalCells is not found "
+                    "in cell types definition"
+                )
+            if not element.has_node:
+                raise api.bad_request(
+                    f"top cell must be node-level or above: {spec.cell_type}"
+                )
+            root = self._build_cell(spec, spec.cell_type, "")
+            self.free_list.setdefault(root.chain, ChainCellList(root.level))
+            self.free_list[root.chain][root.level].append(root)
+        return self.full_list, self.free_list, self.pinned_cells
+
+    def _build_cell(
+        self, spec: api.PhysicalCellSpec, ct: api.CellType, current_node: str
+    ) -> PhysicalCell:
+        """(reference: config.go:141-183 ``buildChildCell``)"""
+        ce = self.elements[ct]
+        last_segment = spec.cell_address.rsplit("/", 1)[-1]
+        if ce.has_node and not ce.is_multi_nodes:
+            # Node-level cell: its address segment is the K8s node name,
+            # passed down so leaf cells know their host.
+            current_node = last_segment
+
+        cell = PhysicalCell(
+            self._chain,
+            ce.level,
+            spec.cell_address,
+            ce.has_node,
+            ce.leaf_cell_number,
+            cell_type=ce.cell_type,
+            is_node_level=ce.has_node and not ce.is_multi_nodes,
+        )
+        self.full_list.setdefault(self._chain, ChainCellList())
+        self.full_list[self._chain][ce.level].append(cell)
+        if spec.pinned_cell_id:
+            self.pinned_cells[spec.pinned_cell_id] = cell
+            cell.pinned = True
+
+        if ce.level == LOWEST_LEVEL:
+            # Leaf: one chip; address segment is the chip index on its host.
+            cell.set_physical_resources([current_node], [int(last_segment)])
+            return cell
+
+        nodes: List[str] = []
+        indices: List[int] = []
+        children: List[Cell] = []
+        for child_spec in spec.cell_children:
+            child = self._build_cell(child_spec, ce.child_cell_type, current_node)
+            child.parent = cell
+            children.append(child)
+            if ce.is_multi_nodes:
+                nodes.extend(child.nodes)
+            else:
+                indices.extend(child.leaf_cell_indices)
+        cell.set_children(children)
+        if ce.is_multi_nodes:
+            # Multi-host slice cell: chip indices are meaningless above the
+            # host (reference: config.go:176 sets [-1]).
+            indices = [-1]
+        else:
+            nodes = [current_node]
+        cell.set_physical_resources(nodes, indices)
+        return cell
+
+
+class _VirtualBuilder:
+    """Instantiate per-VC virtual cell trees
+    (reference: config.go:237-413)."""
+
+    def __init__(
+        self,
+        elements: Dict[api.CellType, ChainElement],
+        specs: Dict[api.VirtualClusterName, api.VirtualClusterSpec],
+        raw_pinned: Dict[api.PinnedCellId, PhysicalCell],
+    ):
+        self.elements = elements
+        self.specs = specs
+        self.raw_pinned = raw_pinned
+        self.vc_free_cell_num: Dict[
+            api.VirtualClusterName, Dict[CellChain, Dict[CellLevel, int]]
+        ] = {}
+        self.non_pinned_full: Dict[
+            api.VirtualClusterName, Dict[CellChain, ChainCellList]
+        ] = {}
+        self.non_pinned_free: Dict[
+            api.VirtualClusterName, Dict[CellChain, ChainCellList]
+        ] = {}
+        self.pinned: Dict[
+            api.VirtualClusterName, Dict[api.PinnedCellId, ChainCellList]
+        ] = {}
+        self.pinned_physical: Dict[
+            api.VirtualClusterName, Dict[api.PinnedCellId, PhysicalCell]
+        ] = {}
+        # building state
+        self._vc: api.VirtualClusterName = ""
+        self._chain: CellChain = ""
+        self._root: Optional[VirtualCell] = None
+        self._pid: api.PinnedCellId = ""
+
+    def build(self):
+        for vc, spec in self.specs.items():
+            self.vc_free_cell_num[vc] = {}
+            self.non_pinned_full[vc] = {}
+            self.non_pinned_free[vc] = {}
+            self.pinned[vc] = {}
+            self.pinned_physical[vc] = {}
+
+            num_cells = 0
+            for vcell in spec.virtual_cells:
+                # Fully-qualified dotted type: chain.segment...segment; the
+                # first segment is the chain, the last is the preassigned
+                # cell's own type (reference: config.go:367-373).
+                parts = vcell.cell_type.split(".")
+                chain: CellChain = parts[0]
+                root_type: api.CellType = parts[-1]
+                if root_type not in self.elements:
+                    raise api.bad_request(
+                        f"cellType {root_type} in virtualCells is not found in "
+                        "cell types definition"
+                    )
+                root_level = self.elements[root_type].level
+                self.vc_free_cell_num[vc].setdefault(chain, {})
+                self.vc_free_cell_num[vc][chain][root_level] = (
+                    self.vc_free_cell_num[vc][chain].get(root_level, 0)
+                    + vcell.cell_number
+                )
+                for _ in range(vcell.cell_number):
+                    self._vc, self._chain, self._root, self._pid = vc, chain, None, ""
+                    root = self._build_cell(root_type, f"{vc}/{num_cells}")
+                    self.non_pinned_free[vc].setdefault(chain, ChainCellList())
+                    self.non_pinned_free[vc][chain][root.level].append(root)
+                    num_cells += 1
+
+            for pcell in spec.pinned_cells:
+                pid = pcell.pinned_cell_id
+                pc = self.raw_pinned.get(pid)
+                if pc is None:
+                    raise api.bad_request(
+                        f"pinned cell not found in physicalCells: VC: {vc}, ID: {pid}"
+                    )
+                self.pinned_physical[vc][pid] = pc
+                # Find the cell type at the pinned cell's level by walking
+                # down the chain (reference: config.go:394-398).
+                child_type = api.CellType(pc.chain)
+                while self.elements[child_type].level > pc.level:
+                    child_type = self.elements[child_type].child_cell_type
+                self.vc_free_cell_num[vc].setdefault(pc.chain, {})
+                self.vc_free_cell_num[vc][pc.chain][pc.level] = (
+                    self.vc_free_cell_num[vc][pc.chain].get(pc.level, 0) + 1
+                )
+                self._vc, self._chain, self._root, self._pid = vc, pc.chain, None, pid
+                self._build_cell(child_type, f"{vc}/{num_cells}")
+                num_cells += 1
+
+        return (
+            self.vc_free_cell_num,
+            self.non_pinned_full,
+            self.non_pinned_free,
+            self.pinned,
+            self.pinned_physical,
+        )
+
+    def _build_cell(self, ct: api.CellType, address: api.CellAddress) -> VirtualCell:
+        """(reference: config.go:316-340 ``buildChildCell``)"""
+        ce = self.elements[ct]
+        cell = VirtualCell(
+            self._vc,
+            self._chain,
+            ce.level,
+            address,
+            ce.has_node,
+            ce.leaf_cell_number,
+            cell_type=ce.cell_type,
+            is_node_level=ce.has_node and not ce.is_multi_nodes,
+        )
+        if not self._pid:
+            vc_lists = self.non_pinned_full[self._vc]
+            vc_lists.setdefault(self._chain, ChainCellList())
+            vc_lists[self._chain][ce.level].append(cell)
+        else:
+            pid_lists = self.pinned[self._vc]
+            pid_lists.setdefault(self._pid, ChainCellList())
+            pid_lists[self._pid][ce.level].append(cell)
+        if self._root is None:
+            self._root = cell
+        cell.preassigned_cell = self._root
+
+        if ce.level > LOWEST_LEVEL:
+            # Child addresses restart at 0 under each preassigned cell and are
+            # globally positional below (reference: config.go:322-330).
+            parts = address.split("/")
+            offset = 0 if len(parts) == 2 else int(parts[-1]) * ce.child_number
+            children: List[Cell] = []
+            for i in range(ce.child_number):
+                child = self._build_cell(
+                    ce.child_cell_type, f"{address}/{offset + i}"
+                )
+                child.parent = cell
+                children.append(child)
+            cell.set_children(children)
+        return cell
+
+
+@dataclass
+class CompiledConfig:
+    """Everything the core algorithm needs, compiled from the YAML config
+    (reference: config.go:442-477 ``ParseConfig`` return values)."""
+
+    # chain -> level -> all physical cells (including non-top levels)
+    physical_full_list: Dict[CellChain, ChainCellList] = field(default_factory=dict)
+    # chain -> level -> free physical cells (initially only top-level roots)
+    physical_free_list: Dict[CellChain, ChainCellList] = field(default_factory=dict)
+    # vc -> chain -> level -> quota cell count
+    vc_free_cell_num: Dict[
+        api.VirtualClusterName, Dict[CellChain, Dict[CellLevel, int]]
+    ] = field(default_factory=dict)
+    # vc -> chain -> level -> all / free virtual cells (non-pinned)
+    virtual_non_pinned_full: Dict[
+        api.VirtualClusterName, Dict[CellChain, ChainCellList]
+    ] = field(default_factory=dict)
+    virtual_non_pinned_free: Dict[
+        api.VirtualClusterName, Dict[CellChain, ChainCellList]
+    ] = field(default_factory=dict)
+    # vc -> pinnedCellId -> level -> virtual cells
+    virtual_pinned: Dict[
+        api.VirtualClusterName, Dict[api.PinnedCellId, ChainCellList]
+    ] = field(default_factory=dict)
+    # vc -> pinnedCellId -> the pinned physical cell
+    physical_pinned: Dict[
+        api.VirtualClusterName, Dict[api.PinnedCellId, PhysicalCell]
+    ] = field(default_factory=dict)
+    # chain -> level -> leaf cells per cell of that level
+    cell_level_to_leaf_num: Dict[CellChain, Dict[CellLevel, int]] = field(
+        default_factory=dict
+    )
+    # chain -> level -> cell type name
+    cell_level_to_type: Dict[CellChain, Dict[CellLevel, api.CellType]] = field(
+        default_factory=dict
+    )
+    # leaf cell type (chip SKU, e.g. "v5p-chip") -> chains containing it
+    leaf_cell_type_to_chain: Dict[str, List[CellChain]] = field(default_factory=dict)
+    # chain -> leaf cell type
+    chain_to_leaf_type: Dict[CellChain, str] = field(default_factory=dict)
+
+
+def parse_config(config: Config) -> CompiledConfig:
+    """(reference: config.go:442-477 ``ParseConfig``)"""
+    elements = build_cell_chains(config.physical_cluster.cell_types)
+    full, free, raw_pinned = _PhysicalBuilder(
+        elements, config.physical_cluster.physical_cells
+    ).build()
+    (
+        vc_free_cell_num,
+        non_pinned_full,
+        non_pinned_free,
+        pinned,
+        pinned_physical,
+    ) = _VirtualBuilder(elements, config.virtual_clusters, raw_pinned).build()
+
+    cc = CompiledConfig(
+        physical_full_list=full,
+        physical_free_list=free,
+        vc_free_cell_num=vc_free_cell_num,
+        virtual_non_pinned_full=non_pinned_full,
+        virtual_non_pinned_free=non_pinned_free,
+        virtual_pinned=pinned,
+        physical_pinned=pinned_physical,
+    )
+    # Chain metadata (reference: config.go:415-440 ``parseCellChainInfo``).
+    for chain in full:
+        ce = elements[api.CellType(chain)]
+        cc.leaf_cell_type_to_chain.setdefault(ce.leaf_cell_type, []).append(chain)
+        cc.chain_to_leaf_type[chain] = ce.leaf_cell_type
+        cc.cell_level_to_leaf_num[chain] = {}
+        cc.cell_level_to_type[chain] = {}
+        cur: Optional[ChainElement] = ce
+        while cur is not None:
+            cc.cell_level_to_leaf_num[chain][cur.level] = cur.leaf_cell_number
+            cc.cell_level_to_type[chain][cur.level] = cur.cell_type
+            cur = elements.get(cur.child_cell_type)
+    return cc

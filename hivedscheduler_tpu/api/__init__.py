@@ -1,0 +1,8 @@
+"""Public API surface: config schema, annotations, constants, status DTOs.
+
+Equivalent of the reference's ``pkg/api`` package.
+"""
+
+from . import constants  # noqa: F401
+from .config import Config, config_fingerprint, load_config  # noqa: F401
+from .types import *  # noqa: F401,F403

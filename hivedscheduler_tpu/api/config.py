@@ -1,0 +1,133 @@
+"""Scheduler config: load, default, and infer full physical cell addresses.
+
+Python equivalent of the reference's ``pkg/api/config.go``: the Config schema
+(L39-85), pointer-based defaulting (L87-118), and the recursive physical-cell
+address inference (L120-167). Reconfiguration follows the reference's
+restart-based model (``WatchConfig`` exits the process on change,
+api/config.go:202-217): we expose :func:`config_fingerprint` so a supervisor
+(or our webserver loop) can detect change and exit for the work-preserving
+restart path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from .. import common
+from . import types as api
+
+
+@dataclass
+class Config:
+    """(reference: api/config.go:39-85)"""
+
+    kube_apiserver_address: Optional[str] = None
+    kube_config_file_path: Optional[str] = None
+    # Default ":9096" (reference: api/config.go:100-101).
+    webserver_address: str = ":9096"
+    # After this many failed bind attempts, force-bind directly
+    # (reference: api/config.go:100-102, default 3).
+    force_pod_bind_threshold: int = 3
+    # FIFO-vs-throughput knob (reference: api/config.go:71-77, default 0).
+    waiting_pod_scheduling_block_ms: int = 0
+    physical_cluster: api.PhysicalClusterSpec = field(
+        default_factory=api.PhysicalClusterSpec
+    )
+    virtual_clusters: Dict[api.VirtualClusterName, api.VirtualClusterSpec] = field(
+        default_factory=dict
+    )
+
+    @staticmethod
+    def from_dict(d: dict) -> "Config":
+        c = Config(
+            kube_apiserver_address=d.get("kubeApiServerAddress"),
+            kube_config_file_path=d.get("kubeConfigFilePath"),
+            webserver_address=d.get("webServerAddress") or ":9096",
+            force_pod_bind_threshold=int(d.get("forcePodBindThreshold", 3) or 3),
+            waiting_pod_scheduling_block_ms=int(
+                d.get("waitingPodSchedulingBlockMilliSec", 0) or 0
+            ),
+            physical_cluster=api.PhysicalClusterSpec.from_dict(
+                d.get("physicalCluster")
+            ),
+            virtual_clusters={
+                str(name): api.VirtualClusterSpec.from_dict(spec)
+                for name, spec in (d.get("virtualClusters") or {}).items()
+            },
+        )
+        default_physical_cells(c.physical_cluster)
+        return c
+
+
+def load_config(path: Optional[str] = None) -> Config:
+    """Read the YAML config file; path defaults to ``$CONFIG`` then
+    ``./hivedscheduler.yaml`` (reference: api/constants.go:65,
+    api/config.go:188-200)."""
+    path = path or os.environ.get("CONFIG", "./hivedscheduler.yaml")
+    with open(path) as f:
+        raw = common.from_yaml(f.read()) or {}
+    return Config.from_dict(raw)
+
+
+def config_fingerprint(path: str) -> str:
+    """Content hash used by the restart-based reconfiguration loop
+    (reference semantics: api/config.go:202-217 exits on content change)."""
+    with open(path, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def default_physical_cells(pc: api.PhysicalClusterSpec) -> None:
+    """Fill in every omitted cellType/cellAddress in the physical cell specs
+    (reference: api/config.go:120-133 ``defaultingPhysicalCells``)."""
+    for idx, spec in enumerate(pc.physical_cells):
+        if spec.cell_type not in pc.cell_types:
+            raise api.bad_request(
+                f"physicalCells contains unknown cellType: {spec.cell_type}"
+            )
+        _infer_cell_spec(spec, pc.cell_types, spec.cell_type, idx, "")
+
+
+def _infer_cell_spec(
+    spec: api.PhysicalCellSpec,
+    cell_types: Dict[api.CellType, api.CellTypeSpec],
+    cell_type: api.CellType,
+    default_address: int,
+    address_prefix: str,
+) -> None:
+    """Recursive address inference (reference: api/config.go:134-167):
+
+    - omitted ``cellType`` inherits from the parent's child type;
+    - omitted ``cellAddress`` defaults to the cell's index-derived position;
+    - node-level types reset the running index so leaf addresses restart at 0
+      within each node (chip indices are per-host on TPU VMs);
+    - provided addresses are still prefixed with the parent path so every cell
+      gets a full, unique address.
+    """
+    if not spec.cell_type:
+        spec.cell_type = cell_type
+    if not spec.cell_address:
+        spec.cell_address = address_prefix + str(default_address)
+    else:
+        spec.cell_address = address_prefix + spec.cell_address
+
+    ct = cell_types.get(cell_type)
+    if ct is None:
+        # Leaf cell type: a single TPU chip, no children to infer.
+        return
+    if ct.is_node_level:
+        default_address = 0
+    if ct.child_cell_number > 0 and not spec.cell_children:
+        spec.cell_children = [
+            api.PhysicalCellSpec() for _ in range(ct.child_cell_number)
+        ]
+    for i, child in enumerate(spec.cell_children):
+        _infer_cell_spec(
+            child,
+            cell_types,
+            ct.child_cell_type,
+            default_address * ct.child_cell_number + i,
+            spec.cell_address + "/",
+        )

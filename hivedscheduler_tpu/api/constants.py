@@ -1,0 +1,56 @@
+"""Public constants: annotation keys, resource gate, priorities, REST paths.
+
+Python equivalent of the reference's ``pkg/api/constants.go:34-94``, with the
+GPU-era names replaced by TPU-era ones.
+"""
+
+COMPONENT_NAME = "hivedscheduler-tpu"
+GROUP_NAME = "hivedscheduler.tpu.io"
+
+UNLIMITED_VALUE = -1
+
+# To leverage this scheduler, at least one container in the Pod must set this
+# extended-resource limit to a positive value
+# (reference: api/constants.go:42-43 ``ResourceNamePodSchedulingEnable``).
+RESOURCE_NAME_POD_SCHEDULING_ENABLE = GROUP_NAME + "/pod-scheduling-enable"
+
+# The Pod declares what it wants via this annotation, in PodSchedulingSpec
+# YAML format (reference: api/constants.go:46).
+ANNOTATION_POD_SCHEDULING_SPEC = GROUP_NAME + "/pod-scheduling-spec"
+
+# Written at bind: the chips of the node granted to this pod, as a
+# comma-separated index list. The container maps it to TPU chip isolation
+# (e.g. TPU_VISIBLE_CHIPS / TPU_CHIPS_PER_HOST_BOUNDS) the way the reference
+# maps its analog to NVIDIA_VISIBLE_DEVICES
+# (reference: api/constants.go:50, doc/user-manual.md:159-192).
+ANNOTATION_POD_LEAF_CELL_ISOLATION = GROUP_NAME + "/pod-leaf-cell-isolation"
+
+# Written at bind: full placement record used for crash recovery, in
+# PodBindInfo YAML format (reference: api/constants.go:53-55).
+ANNOTATION_POD_BIND_INFO = GROUP_NAME + "/pod-bind-info"
+
+# Written at bind (TPU-specific, no reference analog): the jax.distributed
+# environment block for this pod, in YAML map format. Containers lift it into
+# env vars via an init container or fieldRef so jax.distributed.initialize()
+# works out of the box. See tpu/env.py.
+ANNOTATION_POD_TPU_ENV = GROUP_NAME + "/pod-tpu-env"
+
+# Priority space (reference: api/constants.go:58-62).
+MAX_GUARANTEED_PRIORITY = 1000
+MIN_GUARANTEED_PRIORITY = 0
+OPPORTUNISTIC_PRIORITY = -1
+
+# REST paths (reference: api/constants.go:72-94).
+ROOT_PATH = "/"
+VERSION_PATH = ROOT_PATH + "v1"
+
+EXTENDER_PATH = VERSION_PATH + "/extender"
+FILTER_PATH = EXTENDER_PATH + "/filter"
+BIND_PATH = EXTENDER_PATH + "/bind"
+PREEMPT_PATH = EXTENDER_PATH + "/preempt"
+
+INSPECT_PATH = VERSION_PATH + "/inspect"
+AFFINITY_GROUPS_PATH = INSPECT_PATH + "/affinitygroups/"
+CLUSTER_STATUS_PATH = INSPECT_PATH + "/clusterstatus"
+PHYSICAL_CLUSTER_PATH = CLUSTER_STATUS_PATH + "/physicalcluster"
+VIRTUAL_CLUSTERS_PATH = CLUSTER_STATUS_PATH + "/virtualclusters/"

@@ -1,0 +1,419 @@
+"""Public API types: config schema, pod annotation schema, status DTOs.
+
+Python equivalent of the reference's ``pkg/api/types.go`` (config spec at
+L42-76, pod spec at L78-99, bind info at L101-118, inspect DTOs at L121-224),
+re-expressed as dataclasses with explicit YAML (de)serialization instead of
+struct tags. Cell types here name TPU slices (e.g. ``v5p-chip``,
+``v5e-host``) rather than GPUs, but the schema is deliberately kept
+wire-compatible so existing HiveD configs port mechanically.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from . import constants
+
+# Type aliases for readability (reference: api/types.go:35-39).
+CellType = str
+CellAddress = str
+PinnedCellId = str
+VirtualClusterName = str
+
+
+class WebServerError(Exception):
+    """An error carrying an HTTP status code
+    (reference: api/types.go:124-137)."""
+
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+    def __repr__(self) -> str:
+        return f"WebServerError(code={self.code}, message={self.message!r})"
+
+
+def bad_request(message: str) -> WebServerError:
+    return WebServerError(400, message)
+
+
+def not_found(message: str) -> WebServerError:
+    return WebServerError(404, message)
+
+
+def internal_error(message: str) -> WebServerError:
+    return WebServerError(500, message)
+
+
+###############################################################################
+# Physical cluster definition (reference: api/types.go:42-62)
+###############################################################################
+
+@dataclass
+class CellTypeSpec:
+    """One node of the cell-type forest. A type absent from the cellTypes map
+    is a leaf cell type: a single TPU chip
+    (reference: api/types.go:47-51)."""
+
+    child_cell_type: CellType = ""
+    child_cell_number: int = 0
+    is_node_level: bool = False
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "CellTypeSpec":
+        return CellTypeSpec(
+            child_cell_type=d.get("childCellType", "") or "",
+            child_cell_number=int(d.get("childCellNumber", 0) or 0),
+            is_node_level=bool(d.get("isNodeLevel", False)),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "childCellType": self.child_cell_type,
+            "childCellNumber": self.child_cell_number,
+            "isNodeLevel": self.is_node_level,
+        }
+
+
+@dataclass
+class PhysicalCellSpec:
+    """A physical cell instance; node-level cells carry K8s node names as
+    their address, leaf cells carry chip indices
+    (reference: api/types.go:54-60)."""
+
+    cell_type: CellType = ""
+    cell_address: CellAddress = ""
+    pinned_cell_id: PinnedCellId = ""
+    cell_children: List["PhysicalCellSpec"] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "PhysicalCellSpec":
+        d = d or {}
+        return PhysicalCellSpec(
+            cell_type=str(d.get("cellType", "") or ""),
+            cell_address=str(d.get("cellAddress", "") or ""),
+            pinned_cell_id=str(d.get("pinnedCellId", "") or ""),
+            cell_children=[
+                PhysicalCellSpec.from_dict(c) for c in (d.get("cellChildren") or [])
+            ],
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "cellType": self.cell_type,
+            "cellAddress": self.cell_address,
+        }
+        if self.pinned_cell_id:
+            d["pinnedCellId"] = self.pinned_cell_id
+        if self.cell_children:
+            d["cellChildren"] = [c.to_dict() for c in self.cell_children]
+        return d
+
+
+@dataclass
+class PhysicalClusterSpec:
+    """(reference: api/types.go:42-45)"""
+
+    cell_types: Dict[CellType, CellTypeSpec] = field(default_factory=dict)
+    physical_cells: List[PhysicalCellSpec] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "PhysicalClusterSpec":
+        d = d or {}
+        return PhysicalClusterSpec(
+            cell_types={
+                str(k): CellTypeSpec.from_dict(v or {})
+                for k, v in (d.get("cellTypes") or {}).items()
+            },
+            physical_cells=[
+                PhysicalCellSpec.from_dict(c) for c in (d.get("physicalCells") or [])
+            ],
+        )
+
+
+###############################################################################
+# Virtual cluster definition (reference: api/types.go:64-76)
+###############################################################################
+
+@dataclass
+class VirtualCellSpec:
+    """A VC quota entry: N cells of a (fully-qualified, dot-separated) type
+    within a chain (reference: api/types.go:69-72; the dotted path is split in
+    algorithm/config.go:370-373)."""
+
+    cell_number: int = 0
+    cell_type: CellType = ""
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "VirtualCellSpec":
+        return VirtualCellSpec(
+            cell_number=int(d.get("cellNumber", 0) or 0),
+            cell_type=str(d.get("cellType", "") or ""),
+        )
+
+
+@dataclass
+class PinnedCellSpec:
+    """(reference: api/types.go:74-76)"""
+
+    pinned_cell_id: PinnedCellId = ""
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PinnedCellSpec":
+        return PinnedCellSpec(pinned_cell_id=str(d.get("pinnedCellId", "") or ""))
+
+
+@dataclass
+class VirtualClusterSpec:
+    """(reference: api/types.go:64-67)"""
+
+    virtual_cells: List[VirtualCellSpec] = field(default_factory=list)
+    pinned_cells: List[PinnedCellSpec] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Optional[Dict[str, Any]]) -> "VirtualClusterSpec":
+        d = d or {}
+        return VirtualClusterSpec(
+            virtual_cells=[
+                VirtualCellSpec.from_dict(c) for c in (d.get("virtualCells") or [])
+            ],
+            pinned_cells=[
+                PinnedCellSpec.from_dict(c) for c in (d.get("pinnedCells") or [])
+            ],
+        )
+
+
+###############################################################################
+# Pod scheduling spec (the request annotation)
+# (reference: api/types.go:78-99)
+###############################################################################
+
+@dataclass
+class AffinityGroupMemberSpec:
+    """(reference: api/types.go:96-99)"""
+
+    pod_number: int = 0
+    leaf_cell_number: int = 0
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "AffinityGroupMemberSpec":
+        return AffinityGroupMemberSpec(
+            pod_number=int(d.get("podNumber", 0) or 0),
+            leaf_cell_number=int(d.get("leafCellNumber", 0) or 0),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"podNumber": self.pod_number, "leafCellNumber": self.leaf_cell_number}
+
+
+@dataclass
+class AffinityGroupSpec:
+    """The gang: a named set of members, each ``pod_number`` pods wanting
+    ``leaf_cell_number`` chips (reference: api/types.go:90-94)."""
+
+    name: str = ""
+    members: List[AffinityGroupMemberSpec] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "AffinityGroupSpec":
+        return AffinityGroupSpec(
+            name=str(d.get("name", "") or ""),
+            members=[
+                AffinityGroupMemberSpec.from_dict(m) for m in (d.get("members") or [])
+            ],
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "members": [m.to_dict() for m in self.members]}
+
+
+@dataclass
+class PodSchedulingSpec:
+    """What a pod asks for via the pod-scheduling-spec annotation
+    (reference: api/types.go:78-88). ``leaf_cell_type`` names a TPU chip
+    generation (e.g. ``v5p-chip``); ``leaf_cell_number`` is chips per pod
+    (on multi-host slices: chips on this pod's host, normally 4)."""
+
+    virtual_cluster: VirtualClusterName = ""
+    priority: int = 0
+    pinned_cell_id: PinnedCellId = ""
+    leaf_cell_type: str = ""
+    leaf_cell_number: int = 0
+    gang_release_enable: bool = False
+    lazy_preemption_enable: bool = False
+    ignore_k8s_suggested_nodes: bool = True
+    affinity_group: Optional[AffinityGroupSpec] = None
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PodSchedulingSpec":
+        ag = d.get("affinityGroup")
+        return PodSchedulingSpec(
+            virtual_cluster=str(d.get("virtualCluster", "") or ""),
+            priority=int(d.get("priority", 0) or 0),
+            pinned_cell_id=str(d.get("pinnedCellId", "") or ""),
+            leaf_cell_type=str(d.get("leafCellType", "") or ""),
+            leaf_cell_number=int(d.get("leafCellNumber", 0) or 0),
+            gang_release_enable=bool(d.get("gangReleaseEnable", False)),
+            lazy_preemption_enable=bool(d.get("lazyPreemptionEnable", False)),
+            ignore_k8s_suggested_nodes=bool(d.get("ignoreK8sSuggestedNodes", True)),
+            affinity_group=AffinityGroupSpec.from_dict(ag) if ag else None,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "virtualCluster": self.virtual_cluster,
+            "priority": self.priority,
+            "leafCellType": self.leaf_cell_type,
+            "leafCellNumber": self.leaf_cell_number,
+            "gangReleaseEnable": self.gang_release_enable,
+            "lazyPreemptionEnable": self.lazy_preemption_enable,
+            "ignoreK8sSuggestedNodes": self.ignore_k8s_suggested_nodes,
+        }
+        if self.pinned_cell_id:
+            d["pinnedCellId"] = self.pinned_cell_id
+        if self.affinity_group is not None:
+            d["affinityGroup"] = self.affinity_group.to_dict()
+        return d
+
+
+###############################################################################
+# Pod bind info (the recovery annotation)
+# (reference: api/types.go:101-118)
+###############################################################################
+
+@dataclass
+class PodPlacementInfo:
+    """(reference: api/types.go:112-118)"""
+
+    physical_node: str = ""
+    physical_leaf_cell_indices: List[int] = field(default_factory=list)
+    # Preassigned cell type per leaf cell; used to re-locate virtual cells when
+    # replaying an allocated pod after restart (reference: api/types.go:115-117).
+    preassigned_cell_types: List[CellType] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PodPlacementInfo":
+        return PodPlacementInfo(
+            physical_node=str(d.get("physicalNode", "") or ""),
+            physical_leaf_cell_indices=[
+                int(i) for i in (d.get("physicalLeafCellIndices") or [])
+            ],
+            preassigned_cell_types=[
+                str(t) for t in (d.get("preassignedCellTypes") or [])
+            ],
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "physicalNode": self.physical_node,
+            "physicalLeafCellIndices": list(self.physical_leaf_cell_indices),
+            "preassignedCellTypes": list(self.preassigned_cell_types),
+        }
+
+
+@dataclass
+class AffinityGroupMemberBindInfo:
+    """(reference: api/types.go:108-110)"""
+
+    pod_placements: List[PodPlacementInfo] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "AffinityGroupMemberBindInfo":
+        return AffinityGroupMemberBindInfo(
+            pod_placements=[
+                PodPlacementInfo.from_dict(p) for p in (d.get("podPlacements") or [])
+            ]
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"podPlacements": [p.to_dict() for p in self.pod_placements]}
+
+
+@dataclass
+class PodBindInfo:
+    """Written into the pod-bind-info annotation at bind; the scheduler's only
+    persistent state (reference: api/types.go:101-106)."""
+
+    node: str = ""
+    leaf_cell_isolation: List[int] = field(default_factory=list)
+    cell_chain: str = ""
+    affinity_group_bind_info: List[AffinityGroupMemberBindInfo] = field(
+        default_factory=list
+    )
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "PodBindInfo":
+        return PodBindInfo(
+            node=str(d.get("node", "") or ""),
+            leaf_cell_isolation=[int(i) for i in (d.get("leafCellIsolation") or [])],
+            cell_chain=str(d.get("cellChain", "") or ""),
+            affinity_group_bind_info=[
+                AffinityGroupMemberBindInfo.from_dict(m)
+                for m in (d.get("affinityGroupBindInfo") or [])
+            ],
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "node": self.node,
+            "leafCellIsolation": list(self.leaf_cell_isolation),
+            "cellChain": self.cell_chain,
+            "affinityGroupBindInfo": [
+                m.to_dict() for m in self.affinity_group_bind_info
+            ],
+        }
+
+
+###############################################################################
+# Inspect API DTOs (reference: api/types.go:140-224). Plain dicts are used on
+# the wire; these helpers build them.
+###############################################################################
+
+# Affinity group states surfaced by the inspect API
+# (reference: algorithm/constants.go group states).
+GROUP_STATE_ALLOCATED = "Allocated"
+GROUP_STATE_PREEMPTING = "Preempting"
+GROUP_STATE_BEING_PREEMPTED = "BeingPreempted"
+
+CELL_HEALTHY = "Healthy"
+CELL_BAD = "Bad"
+
+
+def deep_copy_status(obj: Any) -> Any:
+    """Inspect handlers must never leak internal mutable state
+    (reference: api/types.go:227-273 deepCopy methods)."""
+    return copy.deepcopy(obj)
+
+
+__all__ = [
+    "CellType",
+    "CellAddress",
+    "PinnedCellId",
+    "VirtualClusterName",
+    "WebServerError",
+    "bad_request",
+    "not_found",
+    "internal_error",
+    "CellTypeSpec",
+    "PhysicalCellSpec",
+    "PhysicalClusterSpec",
+    "VirtualCellSpec",
+    "PinnedCellSpec",
+    "VirtualClusterSpec",
+    "AffinityGroupMemberSpec",
+    "AffinityGroupSpec",
+    "PodSchedulingSpec",
+    "PodPlacementInfo",
+    "AffinityGroupMemberBindInfo",
+    "PodBindInfo",
+    "GROUP_STATE_ALLOCATED",
+    "GROUP_STATE_PREEMPTING",
+    "GROUP_STATE_BEING_PREEMPTED",
+    "CELL_HEALTHY",
+    "CELL_BAD",
+    "deep_copy_status",
+    "constants",
+]

@@ -1,0 +1,63 @@
+"""Generic utilities shared by every layer.
+
+Python equivalent of the reference's ``pkg/common`` (common/types.go:33,
+common/utils.go:119-212): YAML/JSON codecs, logging init, small helpers.
+Python sets/dicts replace the reference's hand-rolled ``Set``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from typing import Any, Iterable, List
+
+import yaml
+
+log = logging.getLogger("hivedscheduler_tpu")
+
+
+def init_logging(level: int = logging.INFO) -> None:
+    """Configure structured stderr logging (reference: common/utils.go:124-149
+    routes klog to stderr)."""
+    if log.handlers:
+        return
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(
+        logging.Formatter("%(asctime)s %(levelname).1s %(name)s: %(message)s")
+    )
+    log.addHandler(handler)
+    log.setLevel(level)
+
+
+def to_yaml(obj: Any) -> str:
+    """Serialize to YAML (reference: common/utils.go:176-181 ``ToYaml``)."""
+    return yaml.safe_dump(obj, default_flow_style=False, sort_keys=False)
+
+
+def from_yaml(text: str) -> Any:
+    """Deserialize YAML; raises on malformed input
+    (reference: common/utils.go:183-189 ``FromYaml`` panics on error)."""
+    return yaml.safe_load(text)
+
+
+def to_json(obj: Any) -> str:
+    """Serialize to JSON (reference: common/utils.go:191-199)."""
+    return json.dumps(obj, separators=(",", ":"))
+
+
+def from_json(text: str) -> Any:
+    return json.loads(text)
+
+
+def to_indices_string(indices: Iterable[int]) -> str:
+    """Render leaf-cell indices as the isolation annotation value, e.g.
+    ``0,1,2,3`` (reference: common/utils.go ``ToIndicesString`` used by
+    internal/utils.go:180-181)."""
+    return ",".join(str(i) for i in indices)
+
+
+def from_indices_string(text: str) -> List[int]:
+    if not text:
+        return []
+    return [int(x) for x in text.split(",")]

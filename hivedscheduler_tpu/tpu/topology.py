@@ -1,0 +1,143 @@
+"""TPU topology presets: cell-type chains modeling the ICI torus.
+
+The reference encodes communication domains as cell levels (PCIe switch, CPU
+socket, node, IB domain — example/config/design/hivedscheduler.yaml:46-135).
+Here the levels are the ICI torus decomposition of a Cloud TPU slice:
+
+    chip (1) -> [forged sub-host levels] -> host (TPU VM, the K8s node)
+             -> host groups (ICI-contiguous sub-slices) -> full slice
+
+Cross-slice traffic rides DCN, which is exactly "different top-level cells".
+The "forged hierarchy" trick (reference design config comment at
+example/config/design/hivedscheduler.yaml:78-84) lets VCs request sub-host
+chip fractions (1 or 2 chips of a 4-chip host).
+
+Conventions used throughout this repo:
+  - ``v5e`` hosts have 4 chips (2x2); ``v5p`` hosts have 4 chips (2x2x1).
+  - Slice names count chips: ``v5p-64`` = 64 chips = 16 hosts (one 4x4x4
+    cube); ``v5e-16`` = 16 chips = 4 hosts.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..api import types as api
+
+
+def chip_type(generation: str) -> str:
+    return f"{generation}-chip"
+
+
+def host_type(generation: str) -> str:
+    return f"{generation}-host"
+
+
+def slice_type(generation: str, num_chips: int) -> str:
+    return f"{generation}-{num_chips}"
+
+
+def make_cell_types(
+    generation: str,
+    chips_per_host: int = 4,
+    slice_host_counts: Sequence[int] = (),
+    forge_sub_host: bool = True,
+) -> Dict[str, api.CellTypeSpec]:
+    """Build the cellTypes map for one TPU generation.
+
+    ``slice_host_counts`` lists the host-group sizes to expose as cells, in
+    increasing powers of the previous size (each level must divide the next);
+    e.g. ``(4, 16)`` for v5p yields ``v5p-16`` (4 hosts, one ICI plane) and
+    ``v5p-64`` (16 hosts, the 4x4x4 cube).
+    """
+    types: Dict[str, api.CellTypeSpec] = {}
+    child = chip_type(generation)
+    # Forged sub-host hierarchy: chip -> 2-chip -> ... -> host, so VCs can own
+    # chip fractions of a host (ICI-adjacent pairs on the 2x2 host mesh).
+    n = 1
+    if forge_sub_host:
+        while n * 2 < chips_per_host:
+            n *= 2
+            name = f"{generation}-{n}-chip"
+            types[name] = api.CellTypeSpec(
+                child_cell_type=child, child_cell_number=2, is_node_level=False
+            )
+            child = name
+        types[host_type(generation)] = api.CellTypeSpec(
+            child_cell_type=child,
+            child_cell_number=chips_per_host // max(n, 1),
+            is_node_level=True,
+        )
+    else:
+        types[host_type(generation)] = api.CellTypeSpec(
+            child_cell_type=child,
+            child_cell_number=chips_per_host,
+            is_node_level=True,
+        )
+    prev_type = host_type(generation)
+    prev_hosts = 1
+    for hosts in slice_host_counts:
+        if hosts % prev_hosts != 0:
+            raise api.bad_request(
+                f"slice host counts must nest: {hosts} not a multiple of {prev_hosts}"
+            )
+        name = slice_type(generation, hosts * chips_per_host)
+        types[name] = api.CellTypeSpec(
+            child_cell_type=prev_type,
+            child_cell_number=hosts // prev_hosts,
+            is_node_level=False,
+        )
+        prev_type, prev_hosts = name, hosts
+    return types
+
+
+def make_physical_cell(
+    cell_type: str,
+    node_names: Sequence[str],
+    pinned_cell_id: str = "",
+) -> api.PhysicalCellSpec:
+    """Build a physicalCells entry for one slice: the node-level descendants
+    get the given K8s node names as addresses (in ICI order: worker 0..N-1 of
+    the slice), everything else is inferred by api.config defaulting."""
+
+    def build(levels_of_nodes: List[List[str]]) -> api.PhysicalCellSpec:
+        raise NotImplementedError
+
+    spec = api.PhysicalCellSpec(cell_type=cell_type, pinned_cell_id=pinned_cell_id)
+    # We only need to pre-populate down to node level; address inference fills
+    # the rest. Walk the type name structure lazily: callers pass exactly the
+    # node names of the slice in worker order, and we build a skeleton of
+    # nested children whose fan-out is resolved later by defaulting. To keep
+    # this simple and explicit we require the caller to nest via
+    # make_slice_children below when the slice is multi-host.
+    if len(node_names) == 1:
+        spec.cell_address = node_names[0]
+    else:
+        spec.cell_children = _nest_hosts(list(node_names))
+    return spec
+
+
+def _nest_hosts(node_names: List[str]) -> List[api.PhysicalCellSpec]:
+    """Nest host names under 4-way groups, mirroring make_cell_types'
+    host-group fan-out (each slice level groups 4 of the previous)."""
+    if len(node_names) <= 4:
+        return [api.PhysicalCellSpec(cell_address=n) for n in node_names]
+    assert len(node_names) % 4 == 0
+    group = len(node_names) // 4
+    return [
+        api.PhysicalCellSpec(cell_children=_nest_hosts(node_names[i * group:(i + 1) * group]))
+        for i in range(4)
+    ]
+
+
+def v5e_cell_types(max_hosts: int = 4) -> Dict[str, api.CellTypeSpec]:
+    """v5e chains: chip -> 2-chip -> host(4) -> v5e-16 (4 hosts) [-> v5e-64]."""
+    counts = [c for c in (4, 16) if c <= max_hosts]
+    return make_cell_types("v5e", chips_per_host=4, slice_host_counts=counts)
+
+
+def v5p_cell_types(max_hosts: int = 16) -> Dict[str, api.CellTypeSpec]:
+    """v5p chains: chip -> 2-chip -> host(4) -> v5p-16 (4 hosts, ICI plane)
+    -> v5p-64 (16 hosts, the 4x4x4 cube)."""
+    counts = [c for c in (4, 16) if c <= max_hosts]
+    return make_cell_types("v5p", chips_per_host=4, slice_host_counts=counts)

@@ -1,0 +1,11 @@
+"""Test env: force an 8-device virtual CPU mesh before jax is imported, so
+multi-chip sharding tests run hermetically without TPU hardware."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

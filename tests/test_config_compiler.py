@@ -1,0 +1,282 @@
+"""Tests for api config defaulting/address inference and the cell compiler.
+
+Mirrors the reference's fixture style (example/config/design/hivedscheduler.yaml:
+mixed chains, forged hierarchies, non-standard indices, pinned cells) on TPU
+SKUs, and checks the semantics documented in algorithm/config.go.
+"""
+
+import pytest
+
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.api.config import Config
+from hivedscheduler_tpu.algorithm import compiler
+from hivedscheduler_tpu.algorithm.cell import CellState, FREE_PRIORITY
+from hivedscheduler_tpu.tpu import topology
+
+
+def tpu_design_config() -> Config:
+    """A deliberately devious TPU cluster: v5p + v5e + cpu chains, forged
+    sub-host hierarchy, a pinned sub-slice, explicit non-standard chip
+    indices on one host."""
+    cell_types = {}
+    cell_types.update(topology.v5p_cell_types(max_hosts=16))
+    cell_types.update(topology.v5e_cell_types(max_hosts=4))
+    cell_types["cpu-host"] = api.CellTypeSpec(
+        child_cell_type="cpu-socket", child_cell_number=2, is_node_level=True
+    )
+
+    return Config.from_dict(
+        {
+            "physicalCluster": {
+                "cellTypes": {
+                    name: {
+                        "childCellType": s.child_cell_type,
+                        "childCellNumber": s.child_cell_number,
+                        "isNodeLevel": s.is_node_level,
+                    }
+                    for name, s in cell_types.items()
+                },
+                "physicalCells": [
+                    # One v5p-64 cube: 16 hosts, 4 groups of 4; first v5p-16
+                    # pinned to VC1.
+                    {
+                        "cellType": "v5p-64",
+                        "cellChildren": [
+                            {
+                                "pinnedCellId": "VC1-PIN-V5P16",
+                                "cellChildren": [
+                                    {"cellAddress": f"v5p64-w{i}"} for i in range(4)
+                                ],
+                            },
+                            *[
+                                {
+                                    "cellChildren": [
+                                        {"cellAddress": f"v5p64-w{g * 4 + i}"}
+                                        for i in range(4)
+                                    ]
+                                }
+                                for g in range(1, 4)
+                            ],
+                        ],
+                    },
+                    # Two v5e-16 slices (4 hosts each).
+                    {
+                        "cellType": "v5e-16",
+                        "cellChildren": [
+                            {"cellAddress": f"v5e16a-w{i}"} for i in range(4)
+                        ],
+                    },
+                    {
+                        "cellType": "v5e-16",
+                        "cellChildren": [
+                            {"cellAddress": f"v5e16b-w{i}"} for i in range(4)
+                        ],
+                    },
+                    # A standalone v5e host with explicit non-standard chip
+                    # indices (reference design config has a node with
+                    # explicit GPU indices 8,9).
+                    {
+                        "cellType": "v5e-host",
+                        "cellAddress": "v5e-solo",
+                        "cellChildren": [
+                            {
+                                "cellChildren": [
+                                    {"cellAddress": "6"},
+                                    {"cellAddress": "7"},
+                                ]
+                            },
+                            {
+                                "cellChildren": [
+                                    {"cellAddress": "4"},
+                                    {"cellAddress": "5"},
+                                ]
+                            },
+                        ],
+                    },
+                    # CPU hosts for driver/eval pods (BASELINE config 1).
+                    {"cellType": "cpu-host", "cellAddress": "cpu-0"},
+                    {"cellType": "cpu-host", "cellAddress": "cpu-1"},
+                ],
+            },
+            "virtualClusters": {
+                "VC1": {
+                    "virtualCells": [
+                        {"cellType": "v5p-64.v5p-16", "cellNumber": 2},
+                        {"cellType": "v5e-16", "cellNumber": 1},
+                    ],
+                    "pinnedCells": [{"pinnedCellId": "VC1-PIN-V5P16"}],
+                },
+                "VC2": {
+                    "virtualCells": [
+                        {"cellType": "v5p-64.v5p-16", "cellNumber": 1},
+                        {"cellType": "v5e-16", "cellNumber": 1},
+                        {"cellType": "v5e-host", "cellNumber": 1},
+                        {"cellType": "cpu-host.cpu-socket", "cellNumber": 2},
+                    ]
+                },
+            },
+        }
+    )
+
+
+def test_cell_type_chain_compilation():
+    elements = compiler.build_cell_chains(topology.v5p_cell_types(max_hosts=16))
+    chip = elements["v5p-chip"]
+    assert chip.level == 1 and chip.leaf_cell_number == 1 and not chip.has_node
+
+    host = elements["v5p-host"]
+    assert host.leaf_cell_number == 4
+    assert host.has_node and not host.is_multi_nodes
+
+    cube = elements["v5p-64"]
+    assert cube.leaf_cell_number == 64
+    assert cube.has_node and cube.is_multi_nodes
+    # chip(1) -> 2-chip(2) -> host(3) -> v5p-16(4) -> v5p-64(5)
+    assert cube.level == 5
+    assert elements["v5p-16"].leaf_cell_number == 16
+
+
+def test_address_inference_defaults_and_node_reset():
+    cfg = tpu_design_config()
+    # v5e-16 slice: top cell address defaults to its index in physicalCells.
+    spec = cfg.physical_cluster.physical_cells[1]
+    assert spec.cell_type == "v5e-16"
+    assert spec.cell_address == "1"
+    # Node-level children keep their given names, prefixed.
+    host0 = spec.cell_children[0]
+    assert host0.cell_address == "1/v5e16a-w0"
+    # Below node level the index resets to 0 per node: chips 0..3.
+    leaf_addrs = [
+        leaf.cell_address
+        for half in host0.cell_children
+        for leaf in half.cell_children
+    ]
+    assert leaf_addrs == [
+        "1/v5e16a-w0/0/0",
+        "1/v5e16a-w0/0/1",
+        "1/v5e16a-w0/1/2",
+        "1/v5e16a-w0/1/3",
+    ]
+
+
+def test_unknown_cell_type_rejected():
+    with pytest.raises(api.WebServerError) as e:
+        Config.from_dict(
+            {
+                "physicalCluster": {
+                    "cellTypes": {},
+                    "physicalCells": [{"cellType": "nope"}],
+                }
+            }
+        )
+    assert e.value.code == 400
+
+
+def test_physical_compilation_placements():
+    cc = compiler.parse_config(tpu_design_config())
+    assert set(cc.physical_full_list) == {"v5p-64", "v5e-16", "v5e-host", "cpu-host"}
+
+    # The v5p-64 root: a multi-node cell over 16 hosts, indices [-1].
+    root = cc.physical_free_list["v5p-64"][5][0]
+    assert root.nodes == [f"v5p64-w{i}" for i in range(16)]
+    assert root.leaf_cell_indices == [-1]
+    assert root.total_leaf_cell_num == 64
+    assert root.state == CellState.FREE and root.priority == FREE_PRIORITY
+
+    # Pinned sub-slice recorded and marked.
+    pinned = cc.physical_pinned["VC1"]["VC1-PIN-V5P16"]
+    assert pinned.pinned and pinned.level == 4
+    assert pinned.nodes == ["v5p64-w0", "v5p64-w1", "v5p64-w2", "v5p64-w3"]
+
+    # Host-level cells: node-level flag, 4 chips each, chip indices 0..3.
+    hosts = cc.physical_full_list["v5p-64"][3]
+    assert len(hosts) == 16
+    assert all(h.is_node_level for h in hosts)
+    assert hosts[0].leaf_cell_indices == [0, 1, 2, 3]
+    assert hosts[0].nodes == ["v5p64-w0"]
+
+    # Non-standard explicit chip indices survive compilation.
+    solo = cc.physical_free_list["v5e-host"][3][0]
+    assert solo.nodes == ["v5e-solo"]
+    assert solo.leaf_cell_indices == [6, 7, 4, 5]
+
+    # Leaf cells carry (node, chip index).
+    leaf = cc.physical_full_list["v5e-host"][1][0]
+    assert leaf.nodes == ["v5e-solo"] and leaf.leaf_cell_indices == [6]
+
+    # Chain metadata.
+    assert cc.cell_level_to_leaf_num["v5p-64"] == {1: 1, 2: 2, 3: 4, 4: 16, 5: 64}
+    assert cc.chain_to_leaf_type["v5p-64"] == "v5p-chip"
+    assert set(cc.leaf_cell_type_to_chain["v5e-chip"]) == {"v5e-16", "v5e-host"}
+
+
+def test_virtual_compilation():
+    cc = compiler.parse_config(tpu_design_config())
+    # Quotas: VC1 has 2x level-4 v5p-16 cells plus the pinned one.
+    assert cc.vc_free_cell_num["VC1"]["v5p-64"][4] == 3
+    assert cc.vc_free_cell_num["VC1"]["v5e-16"][4] == 1
+    assert cc.vc_free_cell_num["VC2"]["cpu-host"][1] == 2
+
+    # Non-pinned free list holds only preassigned (top) cells.
+    free_v5p = cc.virtual_non_pinned_free["VC1"]["v5p-64"]
+    assert len(free_v5p[4]) == 2
+    preassigned = free_v5p[4][0]
+    assert preassigned.preassigned_cell is preassigned
+    assert preassigned.address.startswith("VC1/")
+
+    # Full list includes descendants, preassigned pointers set.
+    full_v5p = cc.virtual_non_pinned_full["VC1"]["v5p-64"]
+    assert len(full_v5p[1]) == 2 * 16
+    leaf = full_v5p[1][0]
+    assert leaf.preassigned_cell is preassigned
+    assert leaf.vc == "VC1"
+    # Address scheme: VC/<idx>/...
+    assert leaf.address.split("/")[0] == "VC1"
+
+    # Pinned virtual tree exists with the pinned cell's level as its top.
+    pinned_list = cc.virtual_pinned["VC1"]["VC1-PIN-V5P16"]
+    assert len(pinned_list[4]) == 1 and len(pinned_list[1]) == 16
+
+    # Unknown pinned id rejected.
+    bad = tpu_design_config()
+    bad.virtual_clusters["VC1"].pinned_cells[0].pinned_cell_id = "missing"
+    with pytest.raises(api.WebServerError):
+        compiler.parse_config(bad)
+
+
+def test_pod_spec_roundtrip():
+    spec = api.PodSchedulingSpec.from_dict(
+        {
+            "virtualCluster": "VC1",
+            "priority": 5,
+            "leafCellType": "v5p-chip",
+            "leafCellNumber": 4,
+            "affinityGroup": {
+                "name": "default/llama",
+                "members": [{"podNumber": 16, "leafCellNumber": 4}],
+            },
+        }
+    )
+    assert spec.ignore_k8s_suggested_nodes is True
+    rt = api.PodSchedulingSpec.from_dict(spec.to_dict())
+    assert rt == spec
+
+    bi = api.PodBindInfo.from_dict(
+        {
+            "node": "v5p64-w0",
+            "leafCellIsolation": [0, 1, 2, 3],
+            "cellChain": "v5p-64",
+            "affinityGroupBindInfo": [
+                {
+                    "podPlacements": [
+                        {
+                            "physicalNode": "v5p64-w0",
+                            "physicalLeafCellIndices": [0, 1, 2, 3],
+                            "preassignedCellTypes": ["v5p-16"] * 4,
+                        }
+                    ]
+                }
+            ],
+        }
+    )
+    assert api.PodBindInfo.from_dict(bi.to_dict()) == bi
