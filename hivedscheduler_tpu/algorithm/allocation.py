@@ -1,0 +1,439 @@
+"""Cell allocation: buddy allocation and virtual->physical binding.
+
+Python equivalent of the reference's ``pkg/algorithm/cell_allocation.go``:
+backtracking buddy allocation (L42-80), VC-safe relaxed split
+(L84-150), virtual placement mapping (L166-198), candidate filtering (L200-249),
+backtracking virtual->physical cell mapping (L252-318), the inverse
+physical->virtual mapping used by recovery (L320-383), bind/unbind chains
+(L386-420), and priority/usage propagation (L425-454).
+
+On TPU, "buddies" are ICI-adjacent sub-slices of a common enclosing slice, so
+splitting a free v5p-64 yields four v5p-16 cells that remain contiguous on
+the torus; the dynamic (lazy) binding of virtual to physical cells is what
+makes a VC's quota a guarantee over slice *shapes* rather than a static
+partition of the torus.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..api import types as api
+from .. import common
+from .cell import (
+    Cell,
+    CellLevel,
+    CellPriority,
+    ChainCellList,
+    FREE_PRIORITY,
+    LOWEST_LEVEL,
+    MAX_GUARANTEED_PRIORITY,
+    OPPORTUNISTIC_PRIORITY,
+    PhysicalCell,
+    VirtualCell,
+)
+from .group import BindingPathVertex
+
+
+def buddy_alloc(
+    vertex: BindingPathVertex,
+    free_list: ChainCellList,
+    current_level: CellLevel,
+    suggested_nodes: Optional[Set[str]],
+    ignore_suggested: bool,
+    bindings: Dict[api.CellAddress, PhysicalCell],
+) -> bool:
+    """Allocate a free physical cell to a preassigned virtual cell, splitting
+    a higher-level free cell when the current level is empty. Backtracking
+    version: the buddy invariant guarantees a cell exists, but it may be bad
+    or outside K8s-suggested nodes, so we search the free list
+    (reference: cell_allocation.go:42-80)."""
+    if current_level == vertex.cell.level:
+        ok, picked = map_virtual_cells_to_physical(
+            [vertex],
+            free_list[current_level],
+            suggested_nodes,
+            ignore_suggested,
+            bindings,
+            return_picked=True,
+        )
+        if ok:
+            for c in picked:
+                free_list.remove(c, current_level)
+            return True
+        return False
+
+    free_cells = get_usable_physical_cells(
+        free_list[current_level], 1, suggested_nodes, ignore_suggested
+    )
+    if free_cells is None:
+        return False
+    for c in free_cells:
+        free_list[current_level - 1].extend(c.children)
+        if buddy_alloc(
+            vertex, free_list, current_level - 1, suggested_nodes, ignore_suggested,
+            bindings,
+        ):
+            free_list.remove(c, current_level)
+            return True
+        free_list.levels[current_level - 1] = []
+    return False
+
+
+def safe_relaxed_buddy_alloc(
+    vertex: BindingPathVertex,
+    free_list: ChainCellList,
+    free_cell_num: Dict[CellLevel, int],
+    current_level: CellLevel,
+    suggested_nodes: Optional[Set[str]],
+    ignore_suggested: bool,
+    bindings: Dict[api.CellAddress, PhysicalCell],
+) -> bool:
+    """When buddy_alloc fails because the candidate cells are bad or not
+    suggested, split *higher*-level free cells — but only as many as VC
+    safety allows: ``splittable = free - reserved-for-VC-quota`` at each
+    level, cascading down (reference: cell_allocation.go:84-150). A negative
+    splittable count means the VC safety invariant is already broken, which
+    is an internal assertion failure."""
+    top = free_list.top_level
+    splittable_num: Dict[CellLevel, int] = {}
+    splittable_cell: Optional[Cell] = None
+    for l in range(top, current_level, -1):
+        splittable_num[l] = len(free_list[l]) - free_cell_num.get(l, 0)
+        if l < top and splittable_cell is not None:
+            splittable_num[l] += splittable_num[l + 1] * len(
+                splittable_cell.children
+            )
+        if splittable_cell is None and free_list[l]:
+            splittable_cell = free_list[l][0]
+        elif splittable_cell is not None:
+            splittable_cell = splittable_cell.children[0]
+        if splittable_num[l] < 0:
+            raise api.internal_error(
+                f"VC Safety Broken: level {l} cell with free list "
+                f"{[c.address for c in free_list[l]]} is unsplittable, "
+                f"splittableNum={splittable_num[l]}"
+            )
+
+    for l in range(current_level + 1, top + 1):
+        cell_num = min(len(free_list[l]), splittable_num.get(l, 0))
+        if cell_num <= 0:
+            continue
+        split_list: List[Cell] = []
+        for _ in range(cell_num):
+            split_list.append(free_list[l][0])
+            free_list.remove(free_list[l][0], l)
+        splittable_num[l] -= cell_num
+        for _ in range(l, current_level, -1):
+            split_list = [child for sc in split_list for child in sc.children]
+        free_list.levels[current_level] = split_list + free_list[current_level]
+        ok, picked = map_virtual_cells_to_physical(
+            [vertex],
+            free_list[current_level],
+            suggested_nodes,
+            ignore_suggested,
+            bindings,
+            return_picked=True,
+        )
+        if ok:
+            for c in picked:
+                free_list.remove(c, current_level)
+            return True
+    return False
+
+
+def get_lowest_free_cell_level(
+    free_list: ChainCellList, level: CellLevel
+) -> CellLevel:
+    """(reference: cell_allocation.go:153-162)"""
+    for l in range(level, free_list.top_level + 1):
+        if free_list[l]:
+            return l
+    raise api.internal_error(
+        f"VC Safety Broken: free cell not found even split to the highest "
+        f"level {free_list.top_level}"
+    )
+
+
+def map_virtual_placement_to_physical(
+    preassigned: List[BindingPathVertex],
+    non_preassigned: List[List[BindingPathVertex]],
+    free_list: ChainCellList,
+    free_cell_num: Dict[CellLevel, int],
+    suggested_nodes: Optional[Set[str]],
+    ignore_suggested: bool,
+    bindings: Dict[api.CellAddress, PhysicalCell],
+) -> bool:
+    """Map a VC placement's unbound cells to physical cells: buddy-alloc the
+    preassigned roots, then map the non-preassigned subtrees inside their
+    parents' physical cells (reference: cell_allocation.go:166-198)."""
+    for vertex in preassigned:
+        if buddy_alloc(
+            vertex,
+            free_list,
+            get_lowest_free_cell_level(free_list, vertex.cell.level),
+            suggested_nodes,
+            ignore_suggested,
+            bindings,
+        ):
+            free_cell_num[vertex.cell.level] = (
+                free_cell_num.get(vertex.cell.level, 0) - 1
+            )
+        else:
+            common.log.info(
+                "Buddy allocation failed due to bad cells, trying to split "
+                "higher level cells"
+            )
+            if not safe_relaxed_buddy_alloc(
+                vertex,
+                free_list,
+                free_cell_num,
+                vertex.cell.level,
+                suggested_nodes,
+                ignore_suggested,
+                bindings,
+            ):
+                common.log.info("Cannot split higher level cells")
+                return False
+    for vertices in non_preassigned:
+        parent_vc = vertices[0].cell.parent
+        assert isinstance(parent_vc, VirtualCell)
+        ok, _ = map_virtual_cells_to_physical(
+            vertices,
+            parent_vc.physical_cell.children,
+            suggested_nodes,
+            ignore_suggested,
+            bindings,
+            return_picked=False,
+        )
+        if not ok:
+            return False
+    return True
+
+
+def get_usable_physical_cells(
+    candidates: List[Cell],
+    num_needed: int,
+    suggested_nodes: Optional[Set[str]],
+    ignore_suggested: bool,
+) -> Optional[List[PhysicalCell]]:
+    """Filter candidates for binding: unbound, not a bad single-node cell,
+    and (unless ignored) having at least one suggested node; prefer cells with
+    fewer opportunistic pods to reduce preemption
+    (reference: cell_allocation.go:200-249)."""
+    usable: List[PhysicalCell] = []
+    for c in candidates:
+        assert isinstance(c, PhysicalCell)
+        if c.virtual_cell is not None:
+            continue
+        if len(c.nodes) == 1 and not c.healthy:
+            continue
+        if not ignore_suggested and suggested_nodes is not None:
+            if all(n not in suggested_nodes for n in c.nodes):
+                continue
+        usable.append(c)
+    if len(usable) < num_needed:
+        return None
+    usable.sort(
+        key=lambda c: c.used_leaf_cells_at_priority.get(OPPORTUNISTIC_PRIORITY, 0)
+    )
+    return usable
+
+
+def map_virtual_cells_to_physical(
+    vertices: List[BindingPathVertex],
+    candidates: List[Cell],
+    suggested_nodes: Optional[Set[str]],
+    ignore_suggested: bool,
+    bindings: Dict[api.CellAddress, PhysicalCell],
+    return_picked: bool,
+) -> Tuple[bool, List[PhysicalCell]]:
+    """Backtracking assignment of sibling virtual cells to candidate physical
+    cells, recursing into children so the topology inside a preassigned cell
+    matches its physical counterpart exactly
+    (reference: cell_allocation.go:252-318)."""
+    if not vertices:
+        return True, []
+    usable = get_usable_physical_cells(
+        candidates, len(vertices), suggested_nodes, ignore_suggested
+    )
+    if usable is None:
+        return False, []
+
+    picked_for: List[int] = [0] * len(vertices)
+    picked_set: Set[int] = set()
+    cell_index = 0
+    while cell_index >= 0:
+        candidate_index = picked_for[cell_index]
+        advanced = False
+        while candidate_index < len(usable):
+            if candidate_index in picked_set:
+                candidate_index += 1
+                continue
+            candidate = usable[candidate_index]
+            if candidate.level == LOWEST_LEVEL:
+                picked = True
+                bindings[vertices[cell_index].cell.address] = candidate
+            else:
+                picked, _ = map_virtual_cells_to_physical(
+                    vertices[cell_index].children_to_bind,
+                    candidate.children,
+                    suggested_nodes,
+                    ignore_suggested,
+                    bindings,
+                    return_picked=False,
+                )
+            if picked:
+                picked_for[cell_index] = candidate_index
+                picked_set.add(candidate_index)
+                if cell_index == len(vertices) - 1:
+                    if not return_picked:
+                        return True, []
+                    return True, [usable[i] for i in picked_for]
+                advanced = True
+                break
+            candidate_index += 1
+        if advanced:
+            cell_index += 1
+            picked_for[cell_index] = 0
+        else:
+            cell_index -= 1
+            if cell_index >= 0:
+                picked_set.discard(picked_for[cell_index])
+                picked_for[cell_index] += 1
+    return False, []
+
+
+def map_physical_cell_to_virtual(
+    c: PhysicalCell,
+    vccl: ChainCellList,
+    preassigned_level: CellLevel,
+    p: CellPriority,
+) -> Tuple[Optional[VirtualCell], str]:
+    """Inverse mapping used when replaying an allocated pod after restart:
+    find the virtual cell a physical cell should bind to
+    (reference: cell_allocation.go:320-350)."""
+    if c.virtual_cell is not None:
+        return c.virtual_cell, ""
+    if c.level == preassigned_level:
+        preassigned = get_lowest_priority_virtual_cell(
+            vccl[preassigned_level], p
+        )
+        if preassigned is None:
+            return None, (
+                "insufficient free cell in the VC at the preassigned level "
+                f"({preassigned_level})"
+            )
+        return preassigned, ""
+    if c.parent is None:
+        return None, (
+            "physical and virtual cell hierarchies not match (cannot reach "
+            f"the preassigned level {preassigned_level} in physical)"
+        )
+    parent_virtual, message = map_physical_cell_to_virtual(
+        c.parent, vccl, preassigned_level, p
+    )
+    if parent_virtual is None:
+        return None, message
+    return get_lowest_priority_virtual_cell(parent_virtual.children, p), ""
+
+
+def get_lowest_priority_virtual_cell(
+    cl: List[Cell], p: CellPriority
+) -> Optional[VirtualCell]:
+    """A free unbound cell if one exists, else the lowest-priority cell below
+    p (it will be lazy-preempted) — needed after reconfiguration when no free
+    cell may be left (reference: cell_allocation.go:352-377)."""
+    lowest_priority = MAX_GUARANTEED_PRIORITY
+    lowest_cell: Optional[VirtualCell] = None
+    for c in cl:
+        assert isinstance(c, VirtualCell)
+        if c.priority == FREE_PRIORITY:
+            if c.physical_cell is None:
+                return c
+            # A free cell with a binding is a doomed bad cell; skip it.
+            continue
+        if c.priority < p and c.priority < lowest_priority:
+            lowest_priority = c.priority
+            lowest_cell = c
+    return lowest_cell
+
+
+def get_unbound_virtual_cell(cl: List[Cell]) -> Optional[VirtualCell]:
+    """(reference: cell_allocation.go:379-383)"""
+    for c in cl:
+        assert isinstance(c, VirtualCell)
+        if c.physical_cell is None:
+            return c
+    return None
+
+
+def bind_cell(pc: PhysicalCell, vc: VirtualCell) -> None:
+    """Bind a virtual cell chain to a physical cell chain bottom-up, stopping
+    at the first already-bound ancestor (reference: cell_allocation.go:386-397)."""
+    cur_vc: Optional[VirtualCell] = vc
+    cur_pc: Optional[PhysicalCell] = pc
+    while cur_vc is not None and cur_vc.physical_cell is None:
+        cur_pc.set_virtual_cell(cur_vc)
+        cur_vc.set_physical_cell(cur_pc)
+        common.log.debug(
+            "Virtual cell %s is bound to physical cell %s",
+            cur_vc.address,
+            cur_pc.address,
+        )
+        cur_vc = cur_vc.parent  # type: ignore[assignment]
+        cur_pc = cur_pc.parent  # type: ignore[assignment]
+
+
+def unbind_cell(c: PhysicalCell) -> None:
+    """Unbind bottom-up, stopping at pinned cells (statically bound) or at an
+    ancestor that still has bound children (reference: cell_allocation.go:401-420)."""
+    bound_virtual = c.virtual_cell
+    while bound_virtual is not None and not bound_virtual.physical_cell.pinned:
+        bound_physical = bound_virtual.physical_cell
+        common.log.debug(
+            "Virtual cell %s is unbound from physical cell %s",
+            bound_virtual.address,
+            bound_physical.address,
+        )
+        bound_virtual.set_physical_cell(None)
+        bound_physical.set_virtual_cell(None)
+        parent = bound_virtual.parent
+        if parent is None:
+            return
+        for child in parent.children:
+            assert isinstance(child, VirtualCell)
+            if child.physical_cell is not None:
+                return
+        assert isinstance(parent, VirtualCell)
+        bound_virtual = parent
+
+
+def set_cell_priority(c: Cell, p: CellPriority) -> None:
+    """Set priority bottom-up, maintaining parent = max(children)
+    (reference: cell_allocation.go:425-443)."""
+    original = c.priority
+    if isinstance(c, (PhysicalCell, VirtualCell)):
+        c.set_priority(p)
+    else:
+        c.priority = p
+    parent = c.parent
+    if parent is not None:
+        if p > parent.priority:
+            set_cell_priority(parent, p)
+        elif original == parent.priority and p < original:
+            max_buddy = FREE_PRIORITY
+            for buddy in parent.children:
+                if buddy.priority > max_buddy:
+                    max_buddy = buddy.priority
+            set_cell_priority(parent, max_buddy)
+
+
+def update_used_leaf_cell_numbers(c: Cell, p: CellPriority, increase: bool) -> None:
+    """Propagate used-chip counters up the tree
+    (reference: cell_allocation.go:447-454)."""
+    delta = 1 if increase else -1
+    cur: Optional[Cell] = c
+    while cur is not None:
+        cur.increase_used_leaf_cells_at_priority(p, delta)
+        cur = cur.parent

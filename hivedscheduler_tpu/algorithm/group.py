@@ -1,0 +1,214 @@
+"""Affinity groups (gangs) and their placements.
+
+Python equivalent of the reference's ``pkg/algorithm/types.go``:
+AlgoAffinityGroup (L133-214), groupPhysicalPlacement/groupVirtualPlacement
+(L216-283), and the binding-path tree builder (L285-350).
+
+An affinity group is the gang-scheduling unit: all pods of a group are
+scheduled transactionally onto one cell chain, e.g. the 16 workers of a
+v5p-64 Llama pretraining job.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..api import types as api
+from .cell import Cell, CellPriority, PhysicalCell, VirtualCell, cell_equal
+
+
+class GroupState(str, enum.Enum):
+    """(reference: algorithm/constants.go:60-71 and
+    doc/design/state-machine.md "AG State Machine")"""
+
+    # Allocated cells; all its cells are Used.
+    ALLOCATED = "Allocated"
+    # Preempting other groups; its cells are Reserving or Reserved.
+    PREEMPTING = "Preempting"
+    # Being preempted by other group(s); its cells are Used or Reserving.
+    BEING_PREEMPTED = "BeingPreempted"
+
+
+# placement: leaf_cell_num -> list over pods -> list of leaf cells per pod
+Placement = Dict[int, List[List[Optional[Cell]]]]
+
+
+class AffinityGroup:
+    """Algorithm-internal gang state
+    (reference: algorithm/types.go:133-214 ``AlgoAffinityGroup``)."""
+
+    def __init__(
+        self,
+        spec: api.AffinityGroupSpec,
+        vc: api.VirtualClusterName,
+        lazy_preemption_enable: bool,
+        priority: int,
+        state: GroupState,
+    ):
+        self.name = spec.name
+        self.vc = vc
+        self.lazy_preemption_enable = lazy_preemption_enable
+        # Whether binding to non-suggested nodes is acceptable (bad nodes
+        # never are) (reference: types.go:139-141).
+        self.ignore_k8s_suggested_nodes = True
+        self.priority = priority
+        # leaf_cell_num -> pod count
+        self.total_pod_nums: Dict[int, int] = {}
+        for m in spec.members:
+            self.total_pod_nums[m.leaf_cell_number] = (
+                self.total_pod_nums.get(m.leaf_cell_number, 0) + m.pod_number
+            )
+        # leaf_cell_num -> fixed-size slot list of allocated pods (pod objects)
+        self.allocated_pods: Dict[int, List[Optional[Any]]] = {
+            n: [None] * p for n, p in self.total_pod_nums.items()
+        }
+        self.preempting_pods: Dict[str, Any] = {}
+        self.physical_placement: Placement = {
+            n: [[None] * n for _ in range(p)] for n, p in self.total_pod_nums.items()
+        }
+        self.virtual_placement: Placement = {
+            n: [[None] * n for _ in range(p)] for n, p in self.total_pod_nums.items()
+        }
+        self.state = state
+        self.lazy_preemption_status: Optional[Dict[str, Any]] = None
+
+    def to_status(self) -> Dict[str, Any]:
+        """Inspect DTO (reference: types.go:189-214 ``ToAffinityGroup``)."""
+        status: Dict[str, Any] = {
+            "metadata": {"name": self.name},
+            "status": {
+                "vc": self.vc,
+                "priority": self.priority,
+                "state": self.state.value,
+                "lazyPreemptionStatus": self.lazy_preemption_status,
+                "physicalPlacement": physical_placement_to_node_indices(
+                    self.physical_placement
+                ),
+                "virtualPlacement": virtual_placement_to_preassigned_map(
+                    self.virtual_placement
+                ),
+                "allocatedPods": [
+                    getattr(p, "uid", None)
+                    for pods in self.allocated_pods.values()
+                    for p in pods
+                    if p is not None
+                ],
+                "preemptingPods": list(self.preempting_pods),
+            },
+        }
+        return status
+
+
+def physical_placement_to_node_indices(p: Placement) -> Dict[str, List[int]]:
+    """node -> leaf cell (chip) indices (reference: types.go:222-238)."""
+    out: Dict[str, List[int]] = {}
+    for pod_placements in p.values():
+        for pod_placement in pod_placements:
+            for leaf in pod_placement:
+                if leaf is None:
+                    continue
+                assert isinstance(leaf, PhysicalCell)
+                out.setdefault(leaf.nodes[0], []).append(leaf.leaf_cell_indices[0])
+    return out
+
+
+def virtual_placement_to_preassigned_map(p: Placement) -> Dict[str, List[str]]:
+    """preassigned cell address -> leaf cell addresses
+    (reference: types.go:240-260)."""
+    out: Dict[str, List[str]] = {}
+    for pod_placements in p.values():
+        for pod_placement in pod_placements:
+            for leaf in pod_placement:
+                if leaf is None:
+                    continue
+                assert isinstance(leaf, VirtualCell)
+                out.setdefault(leaf.preassigned_cell.address, []).append(leaf.address)
+    return out
+
+
+def virtual_to_physical_placement(
+    virtual: Placement,
+    bindings: Dict[api.CellAddress, PhysicalCell],
+    leaf_cell_nums: List[int],
+) -> Placement:
+    """Translate a virtual placement into the physical placement using the
+    leaf bindings picked by allocation (reference: types.go:262-283)."""
+    physical: Placement = {}
+    for n in leaf_cell_nums:
+        physical[n] = [
+            [bindings[leaf.address] for leaf in pod_placement]
+            for pod_placement in virtual[n]
+        ]
+    return physical
+
+
+class BindingPathVertex:
+    """One vertex in the tree of virtual cells that still need physical
+    bindings (reference: types.go:344-350)."""
+
+    __slots__ = ("cell", "children_to_bind")
+
+    def __init__(self, cell: VirtualCell):
+        self.cell = cell
+        self.children_to_bind: List["BindingPathVertex"] = []
+
+
+def build_binding_paths(
+    virtual: Placement,
+    leaf_cell_nums: List[int],
+    bindings: Dict[api.CellAddress, PhysicalCell],
+) -> Tuple[List[BindingPathVertex], List[List[BindingPathVertex]]]:
+    """Collect all unbound ancestors of the placement's leaf cells into
+    binding-path trees (reference: types.go:285-342 ``toBindingPaths``).
+
+    Returns (preassigned roots to buddy-alloc, groups of non-preassigned
+    subtree roots whose parents are already bound).
+    """
+    preassigned: List[BindingPathVertex] = []
+    non_preassigned: List[List[BindingPathVertex]] = []
+    all_vertices: Dict[api.CellAddress, BindingPathVertex] = {}
+
+    for n in leaf_cell_nums:
+        for pod_placement in virtual[n]:
+            for leaf in pod_placement:
+                assert isinstance(leaf, VirtualCell)
+                if leaf.physical_cell is not None:
+                    # Already bound (e.g. pinned cells): just record it.
+                    bindings[leaf.address] = leaf.physical_cell
+                    continue
+                # Walk up collecting unbound, unvisited ancestors.
+                path: List[VirtualCell] = []
+                c: Optional[Cell] = leaf
+                while c is not None:
+                    vc = c
+                    assert isinstance(vc, VirtualCell)
+                    if vc.physical_cell is not None or vc.address in all_vertices:
+                        break
+                    path.append(vc)
+                    c = c.parent
+                if not path:
+                    continue
+                root = path[-1]
+                root_vertex = BindingPathVertex(root)
+                all_vertices[root.address] = root_vertex
+                parent = root.parent
+                if parent is None:
+                    preassigned.append(root_vertex)
+                elif parent.physical_cell is not None:  # type: ignore[union-attr]
+                    # Parent bound: group with buddies sharing that parent so
+                    # they are mapped together under it.
+                    for group in non_preassigned:
+                        if cell_equal(parent, group[0].cell.parent):
+                            group.append(root_vertex)
+                            break
+                    else:
+                        non_preassigned.append([root_vertex])
+                else:
+                    all_vertices[parent.address].children_to_bind.append(root_vertex)
+                # Wire the rest of the path under the root (top-down).
+                for vc in reversed(path[:-1]):
+                    vertex = BindingPathVertex(vc)
+                    all_vertices[vc.parent.address].children_to_bind.append(vertex)
+                    all_vertices[vc.address] = vertex
+    return preassigned, non_preassigned

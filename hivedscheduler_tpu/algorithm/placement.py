@@ -1,0 +1,374 @@
+"""Topology-aware intra-chain placement: pick hosts, then pick chips.
+
+Python equivalent of the reference's
+``pkg/algorithm/topology_aware_scheduler.go``: cluster view + packing sort
+(L118-266), greedy node selection (L268-307), and the backtracking
+LCA-affinity chip search inside a host (L309-463).
+
+On TPU, "best affinity" = lowest common ancestor in the cell tree = smallest
+enclosing ICI sub-slice, so minimizing the LCA level is exactly minimizing
+ICI hop distance between the chips granted to one pod.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..api import types as api
+from .cell import (
+    Cell,
+    CellLevel,
+    CellPriority,
+    ChainCellList,
+    FREE_PRIORITY,
+    HIGHEST_LEVEL,
+    LOWEST_LEVEL,
+    OPPORTUNISTIC_PRIORITY,
+    PhysicalCell,
+    VirtualCell,
+)
+
+
+class _NodeView:
+    """Sortable per-node scheduling stats
+    (reference: topology_aware_scheduler.go:118-156 ``node``)."""
+
+    __slots__ = (
+        "cell",
+        "free_at_priority",
+        "used_same_priority",
+        "used_higher_priority",
+        "healthy",
+        "suggested",
+        "node_address",
+    )
+
+    def __init__(self, cell: Cell):
+        self.cell = cell
+        self.free_at_priority = 0
+        self.used_same_priority = 0
+        self.used_higher_priority = 0
+        self.healthy = True
+        self.suggested = True
+        self.node_address: api.CellAddress = ""
+
+    def update_for_priority(self, p: CellPriority, cross_priority_pack: bool) -> None:
+        """(reference: topology_aware_scheduler.go:147-156; see the comment
+        above it for why cross-priority packing applies to intra-VC scheduling
+        but not to opportunistic scheduling)"""
+        used = self.cell.used_leaf_cells_at_priority
+        self.used_same_priority = used.get(p, 0)
+        self.used_higher_priority = 0
+        self.free_at_priority = self.cell.total_leaf_cell_num
+        for priority, num in used.items():
+            if cross_priority_pack:
+                if priority != p:
+                    self.used_same_priority += num
+            elif priority > p:
+                self.used_higher_priority += num
+            if priority >= p:
+                self.free_at_priority -= num
+
+    def sort_key(self) -> Tuple:
+        """Packing sort: healthy first, suggested first, more same-priority
+        usage first, less higher-priority usage first
+        (reference: topology_aware_scheduler.go:232-253)."""
+        return (
+            not self.healthy,
+            not self.suggested,
+            -self.used_same_priority,
+            self.used_higher_priority,
+        )
+
+
+def _ancestor_no_higher_than_node(c: Cell) -> Cell:
+    """(reference: topology_aware_scheduler.go:184-191)"""
+    while not c.at_or_higher_than_node and c.parent is not None:
+        c = c.parent
+    return c
+
+
+class TopologyAwareScheduler:
+    """Schedules a gang's pods onto the "nodes" of one chain, packing onto
+    busier nodes first, then picking chips with minimal ICI spread per pod
+    (reference: topology_aware_scheduler.go:36-115).
+
+    The view is built once from a chain cell list (physical for opportunistic
+    scheduling, virtual for intra-VC scheduling) and re-scored per request.
+    """
+
+    def __init__(
+        self,
+        ccl: ChainCellList,
+        level_leaf_cell_num: Dict[CellLevel, int],
+        cross_priority_pack: bool,
+    ):
+        self.level_leaf_cell_num = level_leaf_cell_num
+        self.cross_priority_pack = cross_priority_pack
+        self.cluster_view = self._build_cluster_view(ccl)
+
+    @staticmethod
+    def _build_cluster_view(ccl: ChainCellList) -> List[_NodeView]:
+        """Extract node-level cells (or top-level cells below node level)
+        (reference: topology_aware_scheduler.go:160-182)."""
+        top = ccl.top_level
+        node_level = LOWEST_LEVEL
+        for l in range(LOWEST_LEVEL, top + 1):
+            if ccl[l] and ccl[l][0].at_or_higher_than_node:
+                node_level = l
+                break
+        else:
+            node_level = top
+        view: List[_NodeView] = []
+        seen: Set[api.CellAddress] = set()
+        for l in range(node_level, LOWEST_LEVEL - 1, -1):
+            for c in ccl[l]:
+                anchor = _ancestor_no_higher_than_node(c)
+                if anchor.address not in seen:
+                    seen.add(anchor.address)
+                    view.append(_NodeView(anchor))
+        return view
+
+    def _update_cluster_view(
+        self,
+        p: CellPriority,
+        suggested_nodes: Optional[Set[str]],
+        ignore_suggested: bool,
+    ) -> None:
+        """(reference: topology_aware_scheduler.go:256-266 and the
+        health/suggested probing at L268-289)"""
+        for n in self.cluster_view:
+            n.update_for_priority(p, self.cross_priority_pack)
+            n.healthy, n.suggested, n.node_address = _node_health_and_suggested(
+                n.cell, suggested_nodes, ignore_suggested
+            )
+
+    def schedule(
+        self,
+        pod_leaf_cell_numbers: Dict[int, int],
+        priority: CellPriority,
+        suggested_nodes: Optional[Set[str]] = None,
+        ignore_suggested_nodes: bool = True,
+    ) -> Tuple[Optional[Dict[int, List[List[Cell]]]], str]:
+        """Place all pods of a gang; returns (placement, "") or
+        (None, failure reason) (reference: topology_aware_scheduler.go:65-115).
+
+        First tries at opportunistic priority (no preemption); if that fails
+        and the request is guaranteed, retries at the real priority, allowing
+        lower-priority cells to be treated as free (preemption).
+        """
+        sorted_leaf_nums: List[int] = []
+        for leaf_num, pod_num in pod_leaf_cell_numbers.items():
+            sorted_leaf_nums.extend([leaf_num] * pod_num)
+        sorted_leaf_nums.sort()
+
+        trial_priority = OPPORTUNISTIC_PRIORITY
+        self._update_cluster_view(
+            trial_priority, suggested_nodes, ignore_suggested_nodes
+        )
+        picked, failed_reason = _find_nodes_for_pods(
+            self.cluster_view, sorted_leaf_nums
+        )
+        if picked is None and priority > OPPORTUNISTIC_PRIORITY:
+            trial_priority = priority
+            self._update_cluster_view(
+                trial_priority, suggested_nodes, ignore_suggested_nodes
+            )
+            picked, failed_reason = _find_nodes_for_pods(
+                self.cluster_view, sorted_leaf_nums
+            )
+        if picked is None:
+            return None, failed_reason
+
+        placements: Dict[int, List[List[Cell]]] = {}
+        node_available: Dict[api.CellAddress, List[Cell]] = {}
+        for pod_index, leaf_num in enumerate(sorted_leaf_nums):
+            node_cell = self.cluster_view[picked[pod_index]].cell
+            chips, node_available[node_cell.address] = _find_leaf_cells_in_node(
+                node_cell,
+                leaf_num,
+                trial_priority,
+                node_available.get(node_cell.address),
+                self.level_leaf_cell_num,
+            )
+            placements.setdefault(leaf_num, []).append(chips)
+        return placements, ""
+
+
+def _node_health_and_suggested(
+    c: Cell,
+    suggested_nodes: Optional[Set[str]],
+    ignore_suggested: bool,
+) -> Tuple[bool, bool, api.CellAddress]:
+    """(reference: topology_aware_scheduler.go:268-289)"""
+    if isinstance(c, PhysicalCell):
+        return (
+            c.healthy,
+            ignore_suggested
+            or suggested_nodes is None
+            or c.nodes[0] in suggested_nodes,
+            c.address,
+        )
+    if isinstance(c, VirtualCell) and c.physical_cell is not None:
+        pc = c.physical_cell
+        return (
+            pc.healthy,
+            ignore_suggested
+            or suggested_nodes is None
+            or pc.nodes[0] in suggested_nodes,
+            pc.address,
+        )
+    return True, True, ""
+
+
+def _find_nodes_for_pods(
+    view: List[_NodeView], leaf_cell_nums: List[int]
+) -> Tuple[Optional[List[int]], str]:
+    """Greedy assignment of pods (sorted by chip count) to the packed-sorted
+    node list (reference: topology_aware_scheduler.go:291-337). A node that
+    fits but is bad / non-suggested fails the whole attempt so the caller can
+    fall back (relaxed split or K8s retry)."""
+    view.sort(key=_NodeView.sort_key)
+    picked = [0] * len(leaf_cell_nums)
+    pod_index = 0
+    picked_leaf_num = 0
+    node_index = 0
+    while node_index < len(view):
+        n = view[node_index]
+        if n.free_at_priority - picked_leaf_num >= leaf_cell_nums[pod_index]:
+            if not n.healthy:
+                return None, f"have to use at least one bad node {n.node_address}"
+            if not n.suggested:
+                return (
+                    None,
+                    f"have to use at least one non-suggested node {n.node_address}",
+                )
+            picked[pod_index] = node_index
+            picked_leaf_num += leaf_cell_nums[pod_index]
+            pod_index += 1
+            if pod_index == len(leaf_cell_nums):
+                return picked, ""
+        else:
+            picked_leaf_num = 0
+            node_index += 1
+    return None, "insufficient capacity"
+
+
+def _optimal_affinity(
+    leaf_cell_num: int, level_leaf_cell_num: Dict[CellLevel, int]
+) -> CellLevel:
+    """Lowest level whose cells can hold leaf_cell_num chips: the best
+    possible LCA (smallest enclosing ICI sub-slice)
+    (reference: topology_aware_scheduler.go:390-400)."""
+    for l in sorted(level_leaf_cell_num):
+        if level_leaf_cell_num[l] >= leaf_cell_num:
+            return l
+    raise api.internal_error(
+        "Assert Failure: pod allocated a node but exceeds the capacity of the "
+        "current chain"
+    )
+
+
+def _find_lca(lower: Cell, higher: Cell) -> Optional[Cell]:
+    """Lowest common ancestor of two cells, None if disjoint
+    (reference: topology_aware_scheduler.go:444-463)."""
+    while lower.level < higher.level:
+        if lower.parent is None:
+            return None
+        lower = lower.parent
+    if lower.address == higher.address:
+        return lower
+    while True:
+        lp, hp = lower.parent, higher.parent
+        if lp is None or hp is None:
+            return None
+        if lp.address == hp.address:
+            return lp
+        lower, higher = lp, hp
+
+
+def _collect_leaf_cells(
+    c: Cell, p: CellPriority, free: List[Cell], preemptible: List[Cell]
+) -> None:
+    """Collect free then preemptible (strictly lower priority) chips in a
+    node (reference: topology_aware_scheduler.go:465-476)."""
+    if c.level > LOWEST_LEVEL:
+        for cc in c.children:
+            _collect_leaf_cells(cc, p, free, preemptible)
+    elif c.priority == FREE_PRIORITY:
+        free.append(c)
+    elif c.priority < p:
+        preemptible.append(c)
+
+
+def _find_leaf_cells_in_node(
+    node_cell: Cell,
+    leaf_cell_num: int,
+    p: CellPriority,
+    available: Optional[List[Cell]],
+    level_leaf_cell_num: Dict[CellLevel, int],
+) -> Tuple[List[Cell], List[Cell]]:
+    """Backtracking search for the chip set with the lowest LCA inside one
+    node (reference: topology_aware_scheduler.go:309-387
+    ``findLeafCellsInNode``), with the same pruning (abandon a branch once
+    its LCA exceeds the best seen) and early exit on an optimal (all-buddy)
+    solution. Returns (picked chips, remaining available chips)."""
+    if available is None:
+        free: List[Cell] = []
+        preemptible: List[Cell] = []
+        _collect_leaf_cells(node_cell, p, free, preemptible)
+        available = free + preemptible  # free chips are preferred
+
+    optimal = _optimal_affinity(leaf_cell_num, level_leaf_cell_num)
+    best_affinity = HIGHEST_LEVEL
+    best_cells: List[Optional[Cell]] = [None] * leaf_cell_num
+    best_indices: List[int] = [0] * leaf_cell_num
+
+    current_indices = [0] * leaf_cell_num
+    current_affinity: List[Optional[Cell]] = [None] * leaf_cell_num
+
+    search_index = 0
+    avail_index = 0
+    while True:
+        while avail_index < len(available):
+            leaf = available[avail_index]
+            current_indices[search_index] = avail_index
+            if search_index == 0:
+                current_affinity[0] = leaf
+            else:
+                lca = _find_lca(leaf, current_affinity[search_index - 1])
+                current_affinity[search_index] = lca
+                # Pruning (reference: L344-352).
+                if (lca is None and best_affinity < HIGHEST_LEVEL) or (
+                    lca is not None and lca.level > best_affinity
+                ):
+                    avail_index += 1
+                    continue
+            if search_index == leaf_cell_num - 1:
+                affinity = current_affinity[-1].level if current_affinity[-1] else HIGHEST_LEVEL
+                if affinity < best_affinity:
+                    best_affinity = affinity
+                    best_indices = list(current_indices)
+                    best_cells = [available[i] for i in current_indices]
+                    if affinity == optimal:
+                        return _finish(available, best_indices, best_cells)
+            else:
+                search_index += 1
+            avail_index += 1
+        search_index -= 1
+        if search_index < 0:
+            if best_affinity == HIGHEST_LEVEL:
+                raise api.internal_error(
+                    f"Assert Failure: failed to allocate {leaf_cell_num} leaf "
+                    f"cells in picked node {node_cell.address}"
+                )
+            return _finish(available, best_indices, best_cells)
+        avail_index = current_indices[search_index] + 1
+
+
+def _finish(
+    available: List[Cell], picked_indices: List[int], picked: List[Optional[Cell]]
+) -> Tuple[List[Cell], List[Cell]]:
+    picked_set = set(picked_indices)
+    remaining = [c for i, c in enumerate(available) if i not in picked_set]
+    return [c for c in picked if c is not None], remaining

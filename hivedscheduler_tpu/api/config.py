@@ -42,14 +42,16 @@ class Config:
 
     @staticmethod
     def from_dict(d: dict) -> "Config":
+        fpbt = d.get("forcePodBindThreshold")
+        wait_ms = d.get("waitingPodSchedulingBlockMilliSec")
         c = Config(
             kube_apiserver_address=d.get("kubeApiServerAddress"),
             kube_config_file_path=d.get("kubeConfigFilePath"),
             webserver_address=d.get("webServerAddress") or ":9096",
-            force_pod_bind_threshold=int(d.get("forcePodBindThreshold", 3) or 3),
-            waiting_pod_scheduling_block_ms=int(
-                d.get("waitingPodSchedulingBlockMilliSec", 0) or 0
-            ),
+            # Explicit 0 must survive defaulting (reference preserves it via
+            # pointer-nil defaulting, api/config.go:100-102).
+            force_pod_bind_threshold=3 if fpbt is None else int(fpbt),
+            waiting_pod_scheduling_block_ms=0 if wait_ms is None else int(wait_ms),
             physical_cluster=api.PhysicalClusterSpec.from_dict(
                 d.get("physicalCluster")
             ),

@@ -55,6 +55,10 @@ def make_cell_types(
     # Forged sub-host hierarchy: chip -> 2-chip -> ... -> host, so VCs can own
     # chip fractions of a host (ICI-adjacent pairs on the 2x2 host mesh).
     n = 1
+    if forge_sub_host and chips_per_host & (chips_per_host - 1) != 0:
+        # Forging halves repeatedly; a non-power-of-2 host would silently
+        # lose chips, so fall back to a flat host cell.
+        forge_sub_host = False
     if forge_sub_host:
         while n * 2 < chips_per_host:
             n *= 2
@@ -94,39 +98,54 @@ def make_cell_types(
 def make_physical_cell(
     cell_type: str,
     node_names: Sequence[str],
+    cell_types: Dict[str, api.CellTypeSpec],
     pinned_cell_id: str = "",
 ) -> api.PhysicalCellSpec:
     """Build a physicalCells entry for one slice: the node-level descendants
     get the given K8s node names as addresses (in ICI order: worker 0..N-1 of
-    the slice), everything else is inferred by api.config defaulting."""
+    the slice), everything else is inferred by api.config defaulting.
 
-    def build(levels_of_nodes: List[List[str]]) -> api.PhysicalCellSpec:
-        raise NotImplementedError
-
+    ``cell_types`` is the map the cluster is declared with; the host nesting
+    follows its fan-outs exactly (a mismatch between node_names and the
+    declared host count is an error, never silently truncated)."""
     spec = api.PhysicalCellSpec(cell_type=cell_type, pinned_cell_id=pinned_cell_id)
-    # We only need to pre-populate down to node level; address inference fills
-    # the rest. Walk the type name structure lazily: callers pass exactly the
-    # node names of the slice in worker order, and we build a skeleton of
-    # nested children whose fan-out is resolved later by defaulting. To keep
-    # this simple and explicit we require the caller to nest via
-    # make_slice_children below when the slice is multi-host.
-    if len(node_names) == 1:
+    # Collect the multi-node fan-outs from cell_type down to the node level.
+    fan_outs: List[int] = []
+    ct = cell_type
+    while ct in cell_types and not cell_types[ct].is_node_level:
+        fan_outs.append(cell_types[ct].child_cell_number)
+        ct = cell_types[ct].child_cell_type
+    expected_hosts = 1
+    for f in fan_outs:
+        expected_hosts *= f
+    if expected_hosts != len(node_names):
+        raise api.bad_request(
+            f"{cell_type} contains {expected_hosts} hosts but "
+            f"{len(node_names)} node names were given"
+        )
+    if not fan_outs:
         spec.cell_address = node_names[0]
     else:
-        spec.cell_children = _nest_hosts(list(node_names))
+        spec.cell_children = _nest_hosts(list(node_names), fan_outs)
     return spec
 
 
-def _nest_hosts(node_names: List[str]) -> List[api.PhysicalCellSpec]:
-    """Nest host names under 4-way groups, mirroring make_cell_types'
-    host-group fan-out (each slice level groups 4 of the previous)."""
-    if len(node_names) <= 4:
+def _nest_hosts(
+    node_names: List[str], fan_outs: Sequence[int]
+) -> List[api.PhysicalCellSpec]:
+    """Nest host names following the declared per-level fan-outs."""
+    fan = fan_outs[0]
+    if len(fan_outs) == 1:
+        assert fan == len(node_names)
         return [api.PhysicalCellSpec(cell_address=n) for n in node_names]
-    assert len(node_names) % 4 == 0
-    group = len(node_names) // 4
+    group = len(node_names) // fan
     return [
-        api.PhysicalCellSpec(cell_children=_nest_hosts(node_names[i * group:(i + 1) * group]))
-        for i in range(4)
+        api.PhysicalCellSpec(
+            cell_children=_nest_hosts(
+                node_names[i * group:(i + 1) * group], fan_outs[1:]
+            )
+        )
+        for i in range(fan)
     ]
 
 

@@ -1,0 +1,226 @@
+"""Unit tests for the topology-aware scheduler and the buddy allocator."""
+
+import pytest
+
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.algorithm import allocation, compiler, placement
+from hivedscheduler_tpu.algorithm.cell import (
+    CellState,
+    FREE_PRIORITY,
+    OPPORTUNISTIC_PRIORITY,
+)
+from hivedscheduler_tpu.algorithm.group import BindingPathVertex
+
+from .test_config_compiler import tpu_design_config
+
+
+@pytest.fixture()
+def compiled():
+    return compiler.parse_config(tpu_design_config())
+
+
+def mark_used(leaf, priority):
+    """Simulate a chip in use at a priority (usage propagation only)."""
+    allocation.set_cell_priority(leaf, priority)
+    allocation.update_used_leaf_cell_numbers(leaf, priority, True)
+
+
+def test_pack_single_host_optimal_affinity(compiled):
+    # One v5e-16 chain: 4 hosts x 4 chips. A 2-chip pod must land on one
+    # 2-chip (ICI-pair) cell: LCA level 2, not two stray chips.
+    ccl = compiled.physical_full_list["v5e-16"]
+    tas = placement.TopologyAwareScheduler(
+        ccl, compiled.cell_level_to_leaf_num["v5e-16"], cross_priority_pack=False
+    )
+    placements, reason = tas.schedule({2: 1}, OPPORTUNISTIC_PRIORITY)
+    assert reason == "" and placements is not None
+    chips = placements[2][0]
+    assert len(chips) == 2
+    assert chips[0].parent.address == chips[1].parent.address  # same ICI pair
+
+
+def test_packing_prefers_busier_host(compiled):
+    ccl = compiled.physical_full_list["v5e-16"]
+    # Occupy 2 chips of host v5e16a-w2 at opportunistic priority.
+    host = next(
+        h for h in ccl[3] if h.nodes == ["v5e16a-w2"]
+    )
+    for leaf in host.children[0].children:
+        mark_used(leaf, OPPORTUNISTIC_PRIORITY)
+
+    tas = placement.TopologyAwareScheduler(
+        ccl, compiled.cell_level_to_leaf_num["v5e-16"], cross_priority_pack=True
+    )
+    placements, _ = tas.schedule({2: 1}, OPPORTUNISTIC_PRIORITY)
+    chips = placements[2][0]
+    # Packing: the half-used host is preferred over empty hosts.
+    assert chips[0].nodes == ["v5e16a-w2"]
+
+
+def test_gang_across_hosts(compiled):
+    # 4 pods x 4 chips on a v5e-16 slice: exactly its 4 hosts.
+    ccl = compiled.physical_full_list["v5e-16"]
+    tas = placement.TopologyAwareScheduler(
+        ccl, compiled.cell_level_to_leaf_num["v5e-16"], cross_priority_pack=True
+    )
+    placements, reason = tas.schedule({4: 4}, 0)
+    assert reason == ""
+    nodes = sorted(p[0].nodes[0] for p in placements[4])
+    a_nodes = [f"v5e16a-w{i}" for i in range(4)]
+    b_nodes = [f"v5e16b-w{i}" for i in range(4)]
+    assert nodes == a_nodes or nodes == b_nodes
+    # Each pod owns a full host (all 4 chips, LCA = host level).
+    for pod in placements[4]:
+        assert len({c.parent.parent.address for c in pod}) == 1
+
+
+def test_insufficient_capacity(compiled):
+    ccl = compiled.physical_full_list["v5e-host"]
+    tas = placement.TopologyAwareScheduler(
+        ccl, compiled.cell_level_to_leaf_num["v5e-host"], cross_priority_pack=True
+    )
+    placements, reason = tas.schedule({4: 2}, 0)
+    assert placements is None and "insufficient capacity" in reason
+
+
+def test_bad_node_fails_placement(compiled):
+    ccl = compiled.physical_full_list["v5e-host"]
+    for c in ccl[3][0].children:
+        for leaf in c.children:
+            leaf.healthy = False
+    ccl[3][0].healthy = False
+    tas = placement.TopologyAwareScheduler(
+        ccl, compiled.cell_level_to_leaf_num["v5e-host"], cross_priority_pack=True
+    )
+    placements, reason = tas.schedule({4: 1}, 0)
+    assert placements is None and "bad node" in reason
+
+
+def test_suggested_nodes_respected(compiled):
+    ccl = compiled.physical_full_list["v5e-16"]
+    tas = placement.TopologyAwareScheduler(
+        ccl, compiled.cell_level_to_leaf_num["v5e-16"], cross_priority_pack=True
+    )
+    suggested = {"v5e16a-w1"}
+    placements, reason = tas.schedule(
+        {4: 1}, 0, suggested_nodes=suggested, ignore_suggested_nodes=False
+    )
+    assert reason == ""
+    assert placements[4][0][0].nodes == ["v5e16a-w1"]
+
+
+def test_preemption_fallback_uses_lower_priority_chips(compiled):
+    # Fill every chip of both v5e-16 slices at opportunistic priority; a
+    # guaranteed pod should then place by treating them as preemptible.
+    ccl = compiled.physical_full_list["v5e-16"]
+    for leaf in ccl[1]:
+        mark_used(leaf, OPPORTUNISTIC_PRIORITY)
+    tas = placement.TopologyAwareScheduler(
+        ccl, compiled.cell_level_to_leaf_num["v5e-16"], cross_priority_pack=True
+    )
+    placements, reason = tas.schedule({4: 1}, 5)
+    assert reason == "" and placements is not None
+    # An opportunistic pod, however, cannot.
+    placements2, reason2 = tas.schedule({4: 1}, OPPORTUNISTIC_PRIORITY)
+    assert placements2 is None
+
+
+def test_buddy_alloc_splits_cube(compiled):
+    # Allocate one host (level 3) out of the free v5p-64 cube (level 5):
+    # buddy alloc splits 5 -> 4 -> 3 and leaves the free list with
+    # 3 x v5p-16 and 3 x host.
+    free = compiled.physical_free_list["v5p-64"]
+    vccl = compiled.virtual_non_pinned_full["VC1"]["v5p-64"]
+    # A host-level virtual cell from VC1's first preassigned v5p-16.
+    v_host = compiled.virtual_non_pinned_free["VC1"]["v5p-64"][4][0].children[0]
+    vertex = BindingPathVertex(v_host)
+    bindings = {}
+    ok = allocation.buddy_alloc(
+        vertex, free, allocation.get_lowest_free_cell_level(free, 3), None, True,
+        bindings,
+    )
+    assert ok
+    assert len(free[5]) == 0
+    assert len(free[4]) == 3
+    assert len(free[3]) == 3
+    # The vertex itself is not auto-bound (binding happens at leaf level via
+    # bindings map in the real flow); here the mapping picked a host cell.
+
+
+def test_map_virtual_placement_and_bind(compiled):
+    # Map a full preassigned v5p-16 (level 4) with its 16 leaves.
+    free = compiled.physical_free_list["v5p-64"]
+    preassigned = compiled.virtual_non_pinned_free["VC1"]["v5p-64"][4][0]
+
+    # Build a virtual placement of 4 pods x 4 chips inside the preassigned.
+    vccl = compiled.virtual_non_pinned_full["VC1"]["v5p-64"]
+    tas = placement.TopologyAwareScheduler(
+        _subtree_ccl(preassigned),
+        compiled.cell_level_to_leaf_num["v5p-64"],
+        cross_priority_pack=True,
+    )
+    virtual_placement, reason = tas.schedule({4: 4}, 0)
+    assert reason == ""
+
+    from hivedscheduler_tpu.algorithm.group import build_binding_paths
+
+    bindings = {}
+    pre, non_pre = build_binding_paths({4: virtual_placement[4]}, [4], bindings)
+    assert len(pre) == 1 and pre[0].cell is preassigned
+    ok = allocation.map_virtual_placement_to_physical(
+        pre, non_pre, free, {4: 3, 5: 0}, None, True, bindings
+    )
+    assert ok
+    assert len(bindings) == 16
+    # Bind the chains and verify physical/virtual mirror state.
+    for v_leaf_addr, p_leaf in bindings.items():
+        v_leaf = next(c for c in vccl[1] if c.address == v_leaf_addr)
+        allocation.bind_cell(p_leaf, v_leaf)
+    assert preassigned.physical_cell is not None
+    assert preassigned.physical_cell.level == 4
+    # All 16 physical leaves under one v5p-16 (ICI contiguity).
+    roots = {b.parent.parent.parent.address for b in bindings.values()}
+    assert len(roots) == 1
+
+    # Unbind one leaf chain: ancestors with other bound children survive.
+    some_leaf = next(iter(bindings.values()))
+    allocation.unbind_cell(some_leaf)
+    assert preassigned.physical_cell is not None  # still has bound children
+
+
+def _subtree_ccl(root):
+    """Build a ChainCellList for a single preassigned cell subtree."""
+    from hivedscheduler_tpu.algorithm.cell import ChainCellList
+
+    ccl = ChainCellList(root.level)
+
+    def walk(c):
+        ccl[c.level].append(c)
+        for ch in c.children:
+            walk(ch)
+
+    walk(root)
+    return ccl
+
+
+def test_set_cell_priority_propagation(compiled):
+    host = compiled.physical_full_list["v5e-16"][3][0]
+    leaf0, leaf1 = host.children[0].children
+    allocation.set_cell_priority(leaf0, 5)
+    assert host.priority == 5 and host.parent.priority == 5
+    allocation.set_cell_priority(leaf1, 7)
+    assert host.priority == 7
+    allocation.set_cell_priority(leaf1, FREE_PRIORITY)
+    assert host.priority == 5  # falls back to max of remaining children
+    allocation.set_cell_priority(leaf0, FREE_PRIORITY)
+    assert host.priority == FREE_PRIORITY
+
+
+def test_usage_counter_propagation(compiled):
+    host = compiled.physical_full_list["v5e-16"][3][0]
+    leaf = host.children[0].children[0]
+    allocation.update_used_leaf_cell_numbers(leaf, 5, True)
+    root = host.parent
+    assert root.used_leaf_cells_at_priority == {5: 1}
+    allocation.update_used_leaf_cell_numbers(leaf, 5, False)
+    assert root.used_leaf_cells_at_priority == {}
