@@ -1,0 +1,1842 @@
+"""The HiveD core algorithm: VC-safe, topology-guaranteed gang scheduling.
+
+Python equivalent of the reference's ``pkg/algorithm/hived_algorithm.go``
+(HivedAlgorithm, L40-1565) plus the helpers in ``pkg/algorithm/utils.go``
+(result generation L38-200, victim collection L202-248, recovery helpers
+L250-396, cell-state propagation L397-417, opportunistic status L419-452).
+
+Responsibilities:
+  - guaranteed scheduling: intra-VC placement then virtual->physical mapping
+    via buddy allocation (scheduleGuaranteedAffinityGroup, ref L900-942)
+  - opportunistic scheduling straight on the physical chains (ref L968-980)
+  - the cell state machine Free/Used/Reserving/Reserved x group state machine
+    Allocated/Preempting/BeingPreempted (doc/design/state-machine.md)
+  - lazy preemption and its revert (ref L1166-1230)
+  - VC-safety bookkeeping (vcFreeCellNum / allVCFreeCellNum / totalLeftCellNum)
+  - bad-node tracking with doomed-bad-cell bind/unbind (ref L453-653)
+  - crash recovery by replaying pod-bind-info annotations
+    (createAllocatedAffinityGroup, ref L982-1041)
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import common
+from ..api import types as api
+from ..api.config import Config
+from ..scheduler.types import (
+    Node,
+    Pod,
+    PodPreemptInfo,
+    PodScheduleResult,
+    PodWaitInfo,
+    SchedulingPhase,
+    extract_pod_bind_info,
+    extract_pod_scheduling_spec,
+    is_node_healthy,
+)
+from . import allocation, compiler
+from .cell import (
+    Cell,
+    CellChain,
+    CellLevel,
+    CellPriority,
+    CellState,
+    ChainCellList,
+    FREE_PRIORITY,
+    LOWEST_LEVEL,
+    MIN_GUARANTEED_PRIORITY,
+    OPPORTUNISTIC_PRIORITY,
+    PhysicalCell,
+    VirtualCell,
+    cell_equal,
+)
+from .group import (
+    AffinityGroup,
+    GroupState,
+    Placement,
+    build_binding_paths,
+    virtual_to_physical_placement,
+)
+from .intra_vc import IntraVCScheduler, SchedulingRequest
+from .placement import TopologyAwareScheduler
+
+###############################################################################
+# Free-standing helpers (reference: pkg/algorithm/utils.go)
+###############################################################################
+
+
+def in_free_cell_list(c: PhysicalCell) -> bool:
+    """True if the cell or an ancestor is in the global free list
+    (reference: utils.go:381-392)."""
+    while True:
+        if c.virtual_cell is not None or c.split:
+            return False
+        if c.parent is None or c.parent.split:
+            return True
+        c = c.parent
+
+
+def all_children_same_state(c: PhysicalCell, s: CellState) -> bool:
+    """(reference: utils.go:410-417)"""
+    return all(child.state == s for child in c.children)
+
+
+def set_cell_state(c: PhysicalCell, s: CellState) -> None:
+    """Propagate state up: a parent is Used if ANY child is Used; it takes
+    the other states only when ALL children share them
+    (reference: utils.go:397-407)."""
+    c.set_state(s)
+    if c.parent is not None:
+        parent = c.parent
+        if s == CellState.USED or all_children_same_state(parent, s):
+            set_cell_state(parent, s)
+
+
+def get_new_pod_index(pods: List[Optional[Pod]]) -> int:
+    """First free slot for a new pod in its group (reference: utils.go:300-309)."""
+    for i, p in enumerate(pods):
+        if p is None:
+            return i
+    return -1
+
+
+def get_allocated_pod_index(info: api.PodBindInfo, leaf_cell_num: int) -> int:
+    """Locate an allocated pod inside its group bind info by node + first
+    chip index (reference: utils.go:312-325)."""
+    for gms in info.affinity_group_bind_info:
+        if not gms.pod_placements:
+            continue
+        if len(gms.pod_placements[0].physical_leaf_cell_indices) == leaf_cell_num:
+            for pod_index, placement in enumerate(gms.pod_placements):
+                if (
+                    placement.physical_node == info.node
+                    and info.leaf_cell_isolation
+                    and info.leaf_cell_isolation[0]
+                    in placement.physical_leaf_cell_indices
+                ):
+                    return pod_index
+    return -1
+
+
+def all_pods_released(allocated_pods: Dict[int, List[Optional[Pod]]]) -> bool:
+    """(reference: utils.go:328-337)"""
+    return all(p is None for pods in allocated_pods.values() for p in pods)
+
+
+def find_physical_leaf_cell(
+    full_cell_list: Dict[CellChain, ChainCellList],
+    chain: CellChain,
+    node: str,
+    leaf_cell_index: int,
+) -> Optional[PhysicalCell]:
+    """Find a leaf cell by (node, chip index); searches other chains if not
+    found in the recorded one (the cell may have moved due to
+    reconfiguration) (reference: utils.go:340-378)."""
+    found = _find_leaf_in_chain(full_cell_list, chain, node, leaf_cell_index)
+    if found is None:
+        for c in full_cell_list:
+            if c != chain:
+                found = _find_leaf_in_chain(full_cell_list, c, node, leaf_cell_index)
+                if found is not None:
+                    common.log.warning(
+                        "Leaf cell %s on node %s has been moved to chain %s",
+                        leaf_cell_index, node, c,
+                    )
+                    return found
+    return found
+
+
+def _find_leaf_in_chain(
+    full_cell_list: Dict[CellChain, ChainCellList],
+    chain: CellChain,
+    node: str,
+    leaf_cell_index: int,
+) -> Optional[PhysicalCell]:
+    if chain not in full_cell_list:
+        return None
+    for c in full_cell_list[chain][LOWEST_LEVEL]:
+        assert isinstance(c, PhysicalCell)
+        if node in c.nodes:
+            if leaf_cell_index < 0 or leaf_cell_index in c.leaf_cell_indices:
+                return c
+    return None
+
+
+def collect_bad_or_non_suggested_nodes(
+    placement: Placement,
+    suggested_nodes: Optional[Set[str]],
+    ignore_suggested: bool,
+) -> Set[str]:
+    """(reference: utils.go:177-200)"""
+    bad: Set[str] = set()
+    for pod_placements in placement.values():
+        for pod_placement in pod_placements:
+            for leaf in pod_placement:
+                if leaf is None:
+                    continue
+                assert isinstance(leaf, PhysicalCell)
+                if not leaf.healthy or (
+                    not ignore_suggested
+                    and suggested_nodes is not None
+                    and leaf.nodes[0] not in suggested_nodes
+                ):
+                    bad.add(leaf.nodes[0])
+    return bad
+
+
+def collect_preemption_victims(
+    placement: Placement,
+) -> Tuple[Dict[str, Dict[str, Pod]], List[AffinityGroup]]:
+    """Victim pods (gang-preempted: all pods of any overlapping group) and
+    the preempting groups whose reservations overlap this placement
+    (reference: utils.go:202-248)."""
+    victims: Dict[str, Dict[str, Pod]] = {}  # node -> uid -> pod
+    overlapping_preemptors: List[AffinityGroup] = []
+    for pod_placements in placement.values():
+        for pod_placement in pod_placements:
+            for leaf in pod_placement:
+                if leaf is None:
+                    continue
+                assert isinstance(leaf, PhysicalCell)
+                state = leaf.state
+                if state in (CellState.USED, CellState.RESERVING):
+                    for pods in leaf.using_group.allocated_pods.values():
+                        for v in pods:
+                            if v is not None:
+                                victims.setdefault(v.node_name, {})[v.uid] = v
+                if state in (CellState.RESERVING, CellState.RESERVED):
+                    g = leaf.reserving_or_reserved_group
+                    if g is not None and all(
+                        g is not og for og in overlapping_preemptors
+                    ):
+                        overlapping_preemptors.append(g)
+    return victims, overlapping_preemptors
+
+
+def retrieve_missing_pod_placement(
+    g: AffinityGroup, leaf_cell_num: int, pod_index: int
+) -> Tuple[api.PodPlacementInfo, str]:
+    """Recover a pod's placement from the bind-info annotation of any other
+    allocated pod of the same group (reference: utils.go:250-268)."""
+    for pods in g.allocated_pods.values():
+        for p in pods:
+            if p is not None:
+                info = extract_pod_bind_info(p)
+                for mbi in info.affinity_group_bind_info:
+                    if mbi.pod_placements and len(
+                        mbi.pod_placements[0].physical_leaf_cell_indices
+                    ) == leaf_cell_num:
+                        return mbi.pod_placements[pod_index], info.cell_chain
+    raise api.internal_error(
+        f"No allocated pod found in an allocated group {g.name} when "
+        f"retrieving placement for pod {pod_index} with leaf cell number "
+        f"{leaf_cell_num}"
+    )
+
+
+def retrieve_virtual_cell(
+    physical: Placement, virtual: Placement, p_leaf: PhysicalCell
+) -> Optional[VirtualCell]:
+    """(reference: utils.go:271-287)"""
+    for leaf_num, pod_placements in physical.items():
+        for pod_index, pod_placement in enumerate(pod_placements):
+            for leaf_index, leaf in enumerate(pod_placement):
+                if leaf is not None and cell_equal(leaf, p_leaf):
+                    v = virtual[leaf_num][pod_index][leaf_index]
+                    assert v is None or isinstance(v, VirtualCell)
+                    return v
+    return None
+
+
+def generate_pod_preempt_info(
+    victims: Dict[str, Dict[str, Pod]], pod: Pod
+) -> PodPreemptInfo:
+    """Pick one node's victims (K8s preempts one node at a time; random node
+    to spread preemptors) (reference: utils.go:82-105)."""
+    nodes = sorted(victims)
+    node_to_preempt = nodes[random.randrange(len(nodes))]
+    victim_pods = list(victims[node_to_preempt].values())
+    common.log.info(
+        "[%s]: need to preempt pods %s",
+        pod.key, [v.key for v in victim_pods],
+    )
+    return PodPreemptInfo(victim_pods=victim_pods)
+
+
+def generate_affinity_group_bind_info(
+    group_physical: Placement,
+    group_virtual: Optional[Placement],
+    cell_level_to_type: Dict[CellChain, Dict[CellLevel, api.CellType]],
+    current_leaf_cell_num: int,
+    current_pod_index: int,
+    group: Optional[AffinityGroup],
+    group_name: str,
+) -> Tuple[List[api.AffinityGroupMemberBindInfo], str, List[int], str]:
+    """Translate placements into the durable bind-info record; also returns
+    the current pod's (node, chip indices, chain)
+    (reference: utils.go:108-174)."""
+    bind_info: List[api.AffinityGroupMemberBindInfo] = []
+    selected_node = ""
+    selected_indices: List[int] = []
+    chain = ""
+    for pod_leaf_num in sorted(group_physical):
+        pod_placements = group_physical[pod_leaf_num]
+        mbi = api.AffinityGroupMemberBindInfo(
+            pod_placements=[
+                api.PodPlacementInfo(
+                    physical_leaf_cell_indices=[0] * pod_leaf_num,
+                    preassigned_cell_types=[""] * pod_leaf_num,
+                )
+                for _ in pod_placements
+            ]
+        )
+        for pod_index, pod_placement in enumerate(pod_placements):
+            for leaf_index, p_leaf in enumerate(pod_placement):
+                if p_leaf is None:
+                    if group is None or group.state == GroupState.PREEMPTING:
+                        raise api.internal_error(
+                            f"The first pod in group {group_name} was "
+                            "allocated invalid resource"
+                        )
+                    # Placement lost (e.g. reconfiguration): recover it from
+                    # the other pods' annotations (reference: utils.go:131-138).
+                    mbi.pod_placements[pod_index], chain = (
+                        retrieve_missing_pod_placement(
+                            group, pod_leaf_num, pod_index
+                        )
+                    )
+                    common.log.warning(
+                        "pod placement has been invalid and is retrieved from "
+                        "annotation of other pods: node %s, leaf cells %s",
+                        mbi.pod_placements[pod_index].physical_node,
+                        mbi.pod_placements[pod_index].physical_leaf_cell_indices,
+                    )
+                else:
+                    assert isinstance(p_leaf, PhysicalCell)
+                    if not mbi.pod_placements[pod_index].physical_node:
+                        mbi.pod_placements[pod_index].physical_node = p_leaf.nodes[0]
+                    mbi.pod_placements[pod_index].physical_leaf_cell_indices[
+                        leaf_index
+                    ] = p_leaf.leaf_cell_indices[0]
+                    if group_virtual is not None:
+                        v_leaf = group_virtual[pod_leaf_num][pod_index][leaf_index]
+                        assert isinstance(v_leaf, VirtualCell)
+                        mbi.pod_placements[pod_index].preassigned_cell_types[
+                            leaf_index
+                        ] = cell_level_to_type[v_leaf.chain][
+                            v_leaf.preassigned_cell.level
+                        ]
+                    else:
+                        mbi.pod_placements[pod_index].preassigned_cell_types[
+                            leaf_index
+                        ] = ""
+        if pod_leaf_num == current_leaf_cell_num:
+            selected_node = mbi.pod_placements[current_pod_index].physical_node
+            selected_indices = mbi.pod_placements[
+                current_pod_index
+            ].physical_leaf_cell_indices
+            first = group_physical[current_leaf_cell_num][current_pod_index][0]
+            if first is not None:
+                chain = first.chain
+        bind_info.append(mbi)
+    return bind_info, selected_node, selected_indices, chain
+
+
+def generate_pod_schedule_result(
+    group_physical: Optional[Placement],
+    group_virtual: Optional[Placement],
+    preemption_victims: Optional[Dict[str, Dict[str, Pod]]],
+    wait_reason: str,
+    cell_level_to_type: Dict[CellChain, Dict[CellLevel, api.CellType]],
+    current_leaf_cell_num: int,
+    current_pod_index: int,
+    group: Optional[AffinityGroup],
+    group_name: str,
+    pod: Pod,
+) -> PodScheduleResult:
+    """(reference: utils.go:38-79)"""
+    if group_physical is None:
+        common.log.info("[%s]: Pod needs to wait, reason: %s", pod.key, wait_reason)
+        return PodScheduleResult(pod_wait_info=PodWaitInfo(reason=wait_reason))
+    if preemption_victims:
+        return PodScheduleResult(
+            pod_preempt_info=generate_pod_preempt_info(preemption_victims, pod)
+        )
+    bind_info, node, indices, chain = generate_affinity_group_bind_info(
+        group_physical,
+        group_virtual,
+        cell_level_to_type,
+        current_leaf_cell_num,
+        current_pod_index,
+        group,
+        group_name,
+    )
+    common.log.info(
+        "[%s]: pod is decided to be scheduled to node %s, leaf cells %s",
+        pod.key, node, indices,
+    )
+    return PodScheduleResult(
+        pod_bind_info=api.PodBindInfo(
+            node=node,
+            leaf_cell_isolation=indices,
+            cell_chain=chain,
+            affinity_group_bind_info=bind_info,
+        )
+    )
+
+
+###############################################################################
+# The core
+###############################################################################
+
+
+class HivedCore:
+    """The scheduling algorithm (reference: hived_algorithm.go:40-105).
+
+    Thread-safety contract: the framework serializes all calls
+    (reference: internal/types.go:67-75); this class itself is not locked.
+    """
+
+    def __init__(self, config: Config):
+        cc = compiler.parse_config(config)
+        self.compiled = cc
+        self.full_cell_list = cc.physical_full_list
+        self.free_cell_list = cc.physical_free_list
+        self.vc_free_cell_num = cc.vc_free_cell_num
+        self.cell_types = cc.cell_level_to_type
+        self.cell_chains = cc.leaf_cell_type_to_chain
+        self.chain_to_leaf_type = cc.chain_to_leaf_type
+        self.affinity_groups: Dict[str, AffinityGroup] = {}
+
+        self.vc_schedulers: Dict[api.VirtualClusterName, IntraVCScheduler] = {
+            vc: IntraVCScheduler(
+                cc.virtual_non_pinned_full[vc],
+                cc.virtual_non_pinned_free[vc],
+                cc.virtual_pinned[vc],
+                cc.cell_level_to_leaf_num,
+            )
+            for vc in cc.virtual_non_pinned_full
+        }
+        self.opportunistic_schedulers: Dict[CellChain, TopologyAwareScheduler] = {
+            chain: TopologyAwareScheduler(
+                ccl, cc.cell_level_to_leaf_num[chain], cross_priority_pack=False
+            )
+            for chain, ccl in self.full_cell_list.items()
+        }
+
+        # VC-safety and bad-cell bookkeeping
+        # (reference: hived_algorithm.go:52-93).
+        self.all_vc_free_cell_num: Dict[CellChain, Dict[CellLevel, int]] = {}
+        self.total_left_cell_num: Dict[CellChain, Dict[CellLevel, int]] = {}
+        self.bad_free_cells: Dict[CellChain, ChainCellList] = {}
+        self.vc_doomed_bad_cells: Dict[
+            api.VirtualClusterName, Dict[CellChain, ChainCellList]
+        ] = {}
+        self.all_vc_doomed_bad_cell_num: Dict[CellChain, Dict[CellLevel, int]] = {}
+        self.bad_nodes: Set[str] = set()
+        # Opportunistic cells currently charged to each VC, for the inspect
+        # API (reference: utils.go:419-452 OT virtual cells).
+        self._ot_cells: Dict[api.VirtualClusterName, List[PhysicalCell]] = {}
+
+        self._init_cell_nums()
+        self._init_pinned_cells(cc.physical_pinned)
+        self._init_bad_nodes()
+
+    # -- init ---------------------------------------------------------------
+
+    def _init_cell_nums(self) -> None:
+        """Aggregate VC quotas, compute total capacity per level, and
+        validate the VCs fit the physical cluster
+        (reference: hived_algorithm.go:369-410)."""
+        for vc, vc_free in self.vc_free_cell_num.items():
+            self.vc_doomed_bad_cells[vc] = {}
+            for chain, chain_free in vc_free.items():
+                self.vc_doomed_bad_cells[vc][chain] = ChainCellList()
+                self.all_vc_free_cell_num.setdefault(chain, {})
+                for level, n in chain_free.items():
+                    self.all_vc_free_cell_num[chain][level] = (
+                        self.all_vc_free_cell_num[chain].get(level, 0) + n
+                    )
+        for chain, chain_free in self.all_vc_free_cell_num.items():
+            ccl = self.full_cell_list.get(chain)
+            if ccl is None:
+                raise api.bad_request(
+                    f"Illegal initial VC assignment: Chain {chain} does not "
+                    "exist in physical cluster"
+                )
+            top = ccl.top_level
+            available = len(ccl[top])
+            self.total_left_cell_num[chain] = {top: available}
+            self.bad_free_cells[chain] = ChainCellList()
+            self.all_vc_doomed_bad_cell_num[chain] = {}
+            for l in range(top, LOWEST_LEVEL - 1, -1):
+                left = available - chain_free.get(l, 0)
+                if left < 0:
+                    raise api.bad_request(
+                        "Illegal initial VC assignment: Insufficient physical "
+                        f"cells at chain {chain} level {l}: "
+                        f"{chain_free.get(l, 0)} needed, {available} available"
+                    )
+                if l > LOWEST_LEVEL:
+                    child_num = len(ccl[l][0].children)
+                    available = left * child_num
+                    self.total_left_cell_num[chain][l - 1] = (
+                        self.total_left_cell_num[chain][l] * child_num
+                    )
+
+    def _init_pinned_cells(
+        self,
+        pinned: Dict[api.VirtualClusterName, Dict[api.PinnedCellId, PhysicalCell]],
+    ) -> None:
+        """Static bindings for pinned cells
+        (reference: hived_algorithm.go:439-449)."""
+        for vcn, vc_pinned in pinned.items():
+            for pid, pinned_physical in vc_pinned.items():
+                self._allocate_preassigned_cell(pinned_physical, vcn, False)
+                virtual_list = self.vc_schedulers[vcn].pinned_cells[pid]
+                pinned_virtual = virtual_list[virtual_list.top_level][0]
+                assert isinstance(pinned_virtual, VirtualCell)
+                allocation.bind_cell(pinned_physical, pinned_virtual)
+
+    def _init_bad_nodes(self) -> None:
+        """All nodes are bad until the informer says otherwise
+        (reference: hived_algorithm.go:453-465)."""
+        for ccl in self.full_cell_list.values():
+            for c in ccl[ccl.top_level]:
+                assert isinstance(c, PhysicalCell)
+                for n in c.nodes:
+                    self.set_bad_node(n)
+
+    # -- node events --------------------------------------------------------
+
+    def add_node(self, node: Node) -> None:
+        if not is_node_healthy(node):
+            self.set_bad_node(node.name)
+        else:
+            self.set_healthy_node(node.name)
+
+    def update_node(self, old: Node, new: Node) -> None:
+        if is_node_healthy(old) != is_node_healthy(new):
+            if is_node_healthy(old):
+                self.set_bad_node(new.name)
+            else:
+                self.set_healthy_node(new.name)
+
+    def delete_node(self, node: Node) -> None:
+        self.set_bad_node(node.name)
+
+    def set_bad_node(self, node_name: str) -> None:
+        """(reference: hived_algorithm.go:467-481)"""
+        if node_name in self.bad_nodes:
+            return
+        self.bad_nodes.add(node_name)
+        for ccl in self.full_cell_list.values():
+            for leaf in ccl[LOWEST_LEVEL]:
+                assert isinstance(leaf, PhysicalCell)
+                if leaf.nodes[0] == node_name:
+                    self._set_bad_cell(leaf)
+
+    def set_healthy_node(self, node_name: str) -> None:
+        """(reference: hived_algorithm.go:484-498)"""
+        if node_name not in self.bad_nodes:
+            return
+        self.bad_nodes.discard(node_name)
+        for ccl in self.full_cell_list.values():
+            for leaf in ccl[LOWEST_LEVEL]:
+                assert isinstance(leaf, PhysicalCell)
+                if leaf.nodes[0] == node_name:
+                    self._set_healthy_cell(leaf)
+
+    def _set_bad_cell(self, c: PhysicalCell) -> None:
+        """Mark bad, propagate up, track in bad-free lists or bind into the
+        VC view (reference: hived_algorithm.go:500-523)."""
+        if not c.healthy:
+            return
+        c.set_healthiness(False)
+        if c.parent is not None:
+            self._set_bad_cell(c.parent)
+        if in_free_cell_list(c):
+            self._add_bad_free_cell(c)
+        elif c.virtual_cell is None and not c.split:
+            # An ancestor is bound to a virtual cell: bind c too so the VC
+            # scheduler sees this failure.
+            vc = allocation.get_unbound_virtual_cell(
+                c.parent.virtual_cell.children
+            )
+            c.set_virtual_cell(vc)
+            vc.set_physical_cell(c)
+            common.log.info(
+                "Virtual cell %s is bound to physical cell %s (bad)",
+                vc.address, c.address,
+            )
+
+    def _set_healthy_cell(self, c: PhysicalCell) -> None:
+        """(reference: hived_algorithm.go:526-560)"""
+        if c.healthy:
+            return
+        c.set_healthiness(True)
+        if in_free_cell_list(c):
+            self._remove_bad_free_cell(c)
+        elif c.virtual_cell is not None:
+            vc = c.virtual_cell
+            if not c.pinned and c.priority < MIN_GUARANTEED_PRIORITY:
+                # The binding existed only because the cell was bad.
+                c.set_virtual_cell(None)
+                vc.set_physical_cell(None)
+                common.log.info(
+                    "Virtual cell %s is unbound from physical cell %s "
+                    "(healthy again)", vc.address, c.address,
+                )
+                if vc.parent is None:
+                    # A preassigned cell unbound here must be a doomed bad cell.
+                    self.vc_doomed_bad_cells[vc.vc][c.chain].remove(c, c.level)
+                    self.all_vc_doomed_bad_cell_num[c.chain][c.level] -= 1
+                    self._release_preassigned_cell(c, vc.vc, True)
+        if c.parent is None:
+            return
+        for buddy in c.parent.children:
+            assert isinstance(buddy, PhysicalCell)
+            if not buddy.healthy:
+                return
+        self._set_healthy_cell(c.parent)
+
+    def _add_bad_free_cell(self, c: PhysicalCell) -> None:
+        """(reference: hived_algorithm.go:563-583)"""
+        chain, level = c.chain, c.level
+        self.bad_free_cells[chain][level].append(c)
+        if self.all_vc_free_cell_num.get(chain, {}).get(level, 0) > (
+            self.total_left_cell_num[chain][level]
+            - len(self.bad_free_cells[chain][level])
+        ):
+            common.log.warning(
+                "Cell type %s (chain %s level %s) now has fewer healthy cells "
+                "than the total free cells of all the VCs. Certain VCs' cells "
+                "may be doomed to be bad.",
+                self.cell_types[chain].get(level), chain, level,
+            )
+            self._try_bind_doomed_bad_cell(chain, level)
+
+    def _remove_bad_free_cell(self, c: PhysicalCell) -> None:
+        """(reference: hived_algorithm.go:586-602)"""
+        chain, level = c.chain, c.level
+        self.bad_free_cells[chain].remove(c, level)
+        self._try_unbind_doomed_bad_cell(chain, level)
+
+    def _try_bind_doomed_bad_cell(self, chain: CellChain, level: CellLevel) -> None:
+        """If a VC's free cells exceed healthy free physical cells, bind bad
+        free cells into the VC so the failure is visible
+        (reference: hived_algorithm.go:604-630)."""
+        for vc_name, vc_free in self.vc_free_cell_num.items():
+            if chain not in vc_free:
+                continue
+            while vc_free[chain].get(level, 0) > (
+                self.total_left_cell_num[chain][level]
+                - len(self.bad_free_cells[chain][level])
+            ):
+                pc = self.bad_free_cells[chain][level][0]
+                assert isinstance(pc, PhysicalCell)
+                preassigned = self.vc_schedulers[vc_name].non_pinned_preassigned
+                if chain not in preassigned:
+                    break  # pinned-only quota in this chain: nothing to doom
+                vc = allocation.get_unbound_virtual_cell(preassigned[chain][level])
+                if vc is None:
+                    break
+                pc.set_virtual_cell(vc)
+                vc.set_physical_cell(pc)
+                common.log.warning(
+                    "Cell %s is doomed to be bad and bound to %s (VC %s)",
+                    vc.address, pc.address, vc_name,
+                )
+                self.vc_doomed_bad_cells[vc_name][chain][level].append(pc)
+                self.all_vc_doomed_bad_cell_num[chain][level] = (
+                    self.all_vc_doomed_bad_cell_num[chain].get(level, 0) + 1
+                )
+                self._allocate_preassigned_cell(pc, vc_name, True)
+
+    def _try_unbind_doomed_bad_cell(self, chain: CellChain, level: CellLevel) -> None:
+        """(reference: hived_algorithm.go:632-653)"""
+        for vc_name, vc_free in self.vc_free_cell_num.items():
+            if chain not in vc_free:
+                continue
+            while self.vc_doomed_bad_cells[vc_name][chain][level] and vc_free[
+                chain
+            ].get(level, 0) < (
+                self.total_left_cell_num[chain][level]
+                - len(self.bad_free_cells[chain][level])
+            ):
+                pc = self.vc_doomed_bad_cells[vc_name][chain][level][0]
+                assert isinstance(pc, PhysicalCell)
+                common.log.info(
+                    "Cell %s is no longer doomed to be bad and is unbound "
+                    "from %s", pc.virtual_cell.address, pc.address,
+                )
+                pc.virtual_cell.set_physical_cell(None)
+                pc.set_virtual_cell(None)
+                self.vc_doomed_bad_cells[vc_name][chain].remove(pc, level)
+                self.all_vc_doomed_bad_cell_num[chain][level] -= 1
+                self._release_preassigned_cell(pc, vc_name, True)
+
+    # -- scheduling ---------------------------------------------------------
+
+    def schedule(
+        self,
+        pod: Pod,
+        suggested_nodes: List[str],
+        phase: SchedulingPhase,
+    ) -> PodScheduleResult:
+        """(reference: hived_algorithm.go:180-224)"""
+        common.log.info("[%s]: Scheduling pod in %s phase...", pod.key, phase.value)
+        s = extract_pod_scheduling_spec(pod)
+        suggested = set(suggested_nodes)
+        group_physical: Optional[Placement] = None
+        group_virtual: Optional[Placement] = None
+        victims: Optional[Dict[str, Dict[str, Pod]]] = None
+        wait_reason = ""
+        pod_index = 0
+
+        g = self.affinity_groups.get(s.affinity_group.name)
+        if g is not None:
+            group_physical, group_virtual, victims, pod_index = (
+                self._schedule_pod_from_existing_group(g, s, suggested, phase, pod)
+            )
+        # The group may have been a preempting group deleted just above.
+        if self.affinity_groups.get(s.affinity_group.name) is None:
+            group_physical, group_virtual, victims, wait_reason = (
+                self._schedule_pod_from_new_group(s, suggested, phase, pod)
+            )
+        return generate_pod_schedule_result(
+            group_physical,
+            group_virtual,
+            victims,
+            wait_reason,
+            self.cell_types,
+            s.leaf_cell_number,
+            pod_index,
+            self.affinity_groups.get(s.affinity_group.name),
+            s.affinity_group.name,
+            pod,
+        )
+
+    def _schedule_pod_from_existing_group(
+        self,
+        g: AffinityGroup,
+        s: api.PodSchedulingSpec,
+        suggested: Set[str],
+        phase: SchedulingPhase,
+        pod: Pod,
+    ) -> Tuple[
+        Optional[Placement],
+        Optional[Placement],
+        Optional[Dict[str, Dict[str, Pod]]],
+        int,
+    ]:
+        """(reference: hived_algorithm.go:658-714)"""
+        group_physical: Optional[Placement] = None
+        group_virtual: Optional[Placement] = None
+        victims: Optional[Dict[str, Dict[str, Pod]]] = None
+        pod_index = 0
+        bad_or_non_suggested = collect_bad_or_non_suggested_nodes(
+            g.physical_placement, suggested, g.ignore_k8s_suggested_nodes
+        )
+        if g.state == GroupState.ALLOCATED:
+            common.log.info(
+                "[%s]: Pod is from an affinity group that is already "
+                "allocated: %s", pod.key, g.name,
+            )
+            group_physical = g.physical_placement
+            group_virtual = g.virtual_placement
+            if bad_or_non_suggested:
+                # Insist on the previous decision even so
+                # (reference: hived_algorithm.go:677-682).
+                common.log.warning(
+                    "[%s]: Some nodes allocated to affinity group %s are no "
+                    "longer healthy and within K8s suggested nodes: %s",
+                    pod.key, g.name, sorted(bad_or_non_suggested),
+                )
+            pod_index = get_new_pod_index(
+                g.allocated_pods.get(s.leaf_cell_number, [])
+            )
+            if pod_index == -1:
+                raise api.bad_request(
+                    f"Requesting more pods than the configured number for "
+                    f"{s.leaf_cell_number} leaf cells "
+                    f"({g.total_pod_nums.get(s.leaf_cell_number, 0)} pods) in "
+                    f"affinity group {s.affinity_group.name}"
+                )
+        else:  # GroupState.PREEMPTING
+            common.log.info(
+                "[%s]: Pod is from an affinity group that is preempting "
+                "others: %s", pod.key, g.name,
+            )
+            if phase == SchedulingPhase.PREEMPTING and bad_or_non_suggested:
+                # Cancel and reschedule elsewhere; only Preempting-phase
+                # suggested nodes consider preemption
+                # (reference: hived_algorithm.go:692-702).
+                common.log.info(
+                    "[%s]: Canceling affinity group %s's preemption because "
+                    "its placement is no longer fully healthy and within "
+                    "Preempting-phase suggested nodes", pod.key, g.name,
+                )
+                self._delete_preempting_affinity_group(g, pod)
+            else:
+                group_physical = g.physical_placement
+                group_virtual = g.virtual_placement
+                victims, _ = collect_preemption_victims(group_physical)
+                if not victims:
+                    common.log.info(
+                        "Preemption victims have been cleaned up for the "
+                        "preemptor affinity group %s", g.name,
+                    )
+                g.preempting_pods[pod.uid] = pod
+        return group_physical, group_virtual, victims, pod_index
+
+    def _schedule_pod_from_new_group(
+        self,
+        s: api.PodSchedulingSpec,
+        suggested: Set[str],
+        phase: SchedulingPhase,
+        pod: Pod,
+    ) -> Tuple[
+        Optional[Placement],
+        Optional[Placement],
+        Optional[Dict[str, Dict[str, Pod]]],
+        str,
+    ]:
+        """(reference: hived_algorithm.go:716-754)"""
+        group_physical, group_virtual, wait_reason = self._schedule_new_group(
+            pod, s, suggested
+        )
+        if group_physical is None:
+            return None, None, None, wait_reason
+        victims, overlapping_preemptors = collect_preemption_victims(group_physical)
+        if phase == SchedulingPhase.PREEMPTING:
+            # Cancel any lower-priority preemptor overlapping us, then commit
+            # our own preemption so concurrent preemptors cannot deadlock on
+            # the same victims (reference: hived_algorithm.go:733-747).
+            for preemptor in overlapping_preemptors:
+                common.log.info(
+                    "[%s]: Canceling affinity group %s's preemption because "
+                    "it is further preempted by a higher-priority affinity "
+                    "group %s", pod.key, preemptor.name, s.affinity_group.name,
+                )
+                self._delete_preempting_affinity_group(preemptor, pod)
+            if victims:
+                self._create_preempting_affinity_group(
+                    s, group_physical, group_virtual, pod
+                )
+        elif victims:
+            common.log.info(
+                "[%s]: Found preemption victims in non-Preempting phase, "
+                "skipping", pod.key,
+            )
+        return group_physical, group_virtual, victims, wait_reason
+
+    def _schedule_new_group(
+        self,
+        pod: Pod,
+        s: api.PodSchedulingSpec,
+        suggested: Set[str],
+    ) -> Tuple[Optional[Placement], Optional[Placement], str]:
+        """(reference: hived_algorithm.go:756-821)"""
+        common.log.info(
+            "[%s]: Scheduling new affinity group %s", pod.key, s.affinity_group.name
+        )
+        sr = SchedulingRequest(
+            vc=s.virtual_cluster,
+            pinned_cell_id=s.pinned_cell_id,
+            priority=s.priority,
+            affinity_group_name=s.affinity_group.name,
+            affinity_group_pod_nums={},
+            suggested_nodes=suggested,
+            ignore_suggested_nodes=s.ignore_k8s_suggested_nodes,
+        )
+        for m in s.affinity_group.members:
+            sr.affinity_group_pod_nums[m.leaf_cell_number] = (
+                sr.affinity_group_pod_nums.get(m.leaf_cell_number, 0) + m.pod_number
+            )
+        self._validate_scheduling_request(sr, pod)
+        if sr.pinned_cell_id:
+            return self._handle_scheduling_request(sr)
+        if s.leaf_cell_type:
+            if s.leaf_cell_type not in self.cell_chains:
+                raise api.bad_request(
+                    f"[{pod.key}]: Pod requesting leaf cell type "
+                    f"{s.leaf_cell_type} which the whole cluster does not have"
+                )
+            return self._schedule_group_for_leaf_type(
+                sr, s.leaf_cell_type, pod, True
+            )
+        return self._schedule_group_for_any_leaf_type(sr, pod)
+
+    def _schedule_group_for_leaf_type(
+        self,
+        sr: SchedulingRequest,
+        leaf_cell_type: str,
+        pod: Pod,
+        type_specified: bool,
+    ) -> Tuple[Optional[Placement], Optional[Placement], str]:
+        """Try every chain containing the chip SKU
+        (reference: hived_algorithm.go:824-854)."""
+        vc_has_type = False
+        failed_reason = ""
+        for chain in self.cell_chains.get(leaf_cell_type, []):
+            if (
+                sr.priority < MIN_GUARANTEED_PRIORITY
+                or chain in self.vc_schedulers[sr.vc].non_pinned_preassigned
+            ):
+                vc_has_type = True
+                sr.chain = chain
+                physical, virtual, failed_reason = self._handle_scheduling_request(
+                    sr
+                )
+                if physical is not None:
+                    return physical, virtual, ""
+        if (
+            type_specified
+            and sr.priority >= MIN_GUARANTEED_PRIORITY
+            and not vc_has_type
+        ):
+            raise api.bad_request(
+                f"[{pod.key}]: Pod requesting leaf cell type {leaf_cell_type} "
+                f"which VC {sr.vc} does not have"
+            )
+        return None, None, failed_reason
+
+    def _schedule_group_for_any_leaf_type(
+        self, sr: SchedulingRequest, pod: Pod
+    ) -> Tuple[Optional[Placement], Optional[Placement], str]:
+        """(reference: hived_algorithm.go:857-877)"""
+        failed_reason = ""
+        for leaf_cell_type in sorted(self.cell_chains):
+            physical, virtual, type_failed_reason = (
+                self._schedule_group_for_leaf_type(sr, leaf_cell_type, pod, False)
+            )
+            if physical is not None:
+                return physical, virtual, ""
+            if type_failed_reason:
+                failed_reason = type_failed_reason
+        return None, None, failed_reason
+
+    def _validate_scheduling_request(self, sr: SchedulingRequest, pod: Pod) -> None:
+        """(reference: hived_algorithm.go:879-895)"""
+        message = ""
+        if sr.vc not in self.vc_schedulers:
+            message = f"VC {sr.vc} does not exists!"
+        elif sr.pinned_cell_id:
+            if sr.pinned_cell_id not in self.vc_schedulers[sr.vc].pinned_cells:
+                message = (
+                    f"VC {sr.vc} does not have pinned cell {sr.pinned_cell_id}"
+                )
+            elif sr.priority == OPPORTUNISTIC_PRIORITY:
+                message = (
+                    "opportunistic pod not supported to use pinned cell "
+                    f"{sr.pinned_cell_id}"
+                )
+        if message:
+            raise api.bad_request(f"[{pod.key}]: {message}")
+
+    def _handle_scheduling_request(
+        self, sr: SchedulingRequest
+    ) -> Tuple[Optional[Placement], Optional[Placement], str]:
+        """(reference: hived_algorithm.go:898-920)"""
+        if sr.priority >= MIN_GUARANTEED_PRIORITY:
+            return self._schedule_guaranteed_group(sr)
+        physical, failed_reason = self._schedule_opportunistic_group(sr)
+        return physical, None, failed_reason
+
+    def _schedule_guaranteed_group(
+        self, sr: SchedulingRequest
+    ) -> Tuple[Optional[Placement], Optional[Placement], str]:
+        """Intra-VC placement, then map it onto the physical cluster with
+        buddy allocation (reference: hived_algorithm.go:900-942)."""
+        virtual, failed_reason = self.vc_schedulers[sr.vc].schedule(sr)
+        if virtual is None:
+            return None, None, failed_reason
+        bindings: Dict[api.CellAddress, PhysicalCell] = {}
+        leaf_cell_nums = sorted(sr.affinity_group_pod_nums)
+        lazy_preempted = self._try_lazy_preempt(
+            virtual, leaf_cell_nums, sr.affinity_group_name
+        )
+        preassigned, non_preassigned = build_binding_paths(
+            virtual, leaf_cell_nums, bindings
+        )
+        chain = sr.chain or (
+            next(iter(virtual.values()))[0][0].chain if virtual else ""
+        )
+        free_cell_num_copy = dict(self.all_vc_free_cell_num.get(chain, {}))
+        ok = allocation.map_virtual_placement_to_physical(
+            preassigned,
+            non_preassigned,
+            self.free_cell_list[chain].shallow_copy(),
+            free_cell_num_copy,
+            sr.suggested_nodes,
+            sr.ignore_suggested_nodes,
+            bindings,
+        )
+        if ok:
+            return (
+                virtual_to_physical_placement(virtual, bindings, leaf_cell_nums),
+                virtual,
+                "",
+            )
+        for group_name, placement in lazy_preempted.items():
+            self._revert_lazy_preempt(self.affinity_groups[group_name], placement)
+        failed_node_type = (
+            "bad" if sr.ignore_suggested_nodes else "bad or non-suggested"
+        )
+        return None, None, (
+            f"Mapping the virtual placement would need to use at least one "
+            f"{failed_node_type} node"
+        )
+
+    def _try_lazy_preempt(
+        self, virtual: Placement, leaf_cell_nums: List[int], group_name: str
+    ) -> Dict[str, Placement]:
+        """(reference: hived_algorithm.go:945-965)"""
+        preempted: Dict[str, Placement] = {}
+        for n in leaf_cell_nums:
+            for pod_placement in virtual[n]:
+                for leaf in pod_placement:
+                    assert isinstance(leaf, VirtualCell)
+                    p_leaf = leaf.physical_cell
+                    if (
+                        p_leaf is not None
+                        and p_leaf.state == CellState.USED
+                        and p_leaf.using_group is not None
+                        and p_leaf.using_group.lazy_preemption_enable
+                    ):
+                        preempted[p_leaf.using_group.name] = (
+                            self._lazy_preempt_group(
+                                p_leaf.using_group, group_name
+                            )
+                        )
+        return preempted
+
+    def _schedule_opportunistic_group(
+        self, sr: SchedulingRequest
+    ) -> Tuple[Optional[Placement], str]:
+        """(reference: hived_algorithm.go:968-980)"""
+        placement, failed_reason = self.opportunistic_schedulers[sr.chain].schedule(
+            sr.affinity_group_pod_nums,
+            OPPORTUNISTIC_PRIORITY,
+            sr.suggested_nodes,
+            sr.ignore_suggested_nodes,
+        )
+        if placement is None:
+            return None, f"{failed_reason} when scheduling in physical cluster"
+        return placement, ""
+
+    # -- pod lifecycle ------------------------------------------------------
+
+    def add_unallocated_pod(self, pod: Pod) -> None:
+        """(reference: hived_algorithm.go:226-227; no-op)"""
+
+    def delete_unallocated_pod(self, pod: Pod) -> None:
+        """Cancel a preemption when its last preemptor pod dies
+        (reference: hived_algorithm.go:229-245)."""
+        s = extract_pod_scheduling_spec(pod)
+        g = self.affinity_groups.get(s.affinity_group.name)
+        if g is not None and g.state == GroupState.PREEMPTING:
+            if pod.uid in g.preempting_pods:
+                del g.preempting_pods[pod.uid]
+            if not g.preempting_pods:
+                common.log.info(
+                    "[%s]: Canceling affinity group %s's preemption because "
+                    "its pods are all deleted", pod.key, g.name,
+                )
+                self._delete_preempting_affinity_group(g, pod)
+
+    def add_allocated_pod(self, pod: Pod) -> None:
+        """Confirm an assume-bind or replay a recovered pod
+        (reference: hived_algorithm.go:247-270)."""
+        s = extract_pod_scheduling_spec(pod)
+        info = extract_pod_bind_info(pod)
+        common.log.info(
+            "[%s]: Adding allocated pod to affinity group %s (node %s, leaf "
+            "cells %s)", pod.key, s.affinity_group.name, info.node,
+            info.leaf_cell_isolation,
+        )
+        pod_index = 0
+        g = self.affinity_groups.get(s.affinity_group.name)
+        if g is not None:
+            if g.state == GroupState.PREEMPTING:
+                self._allocate_preempting_affinity_group(g, pod)
+            pod_index = get_allocated_pod_index(info, s.leaf_cell_number)
+            if pod_index == -1:
+                common.log.error(
+                    "[%s]: Pod placement not found in group %s: node %s, leaf "
+                    "cells %s", pod.key, s.affinity_group.name, info.node,
+                    info.leaf_cell_isolation,
+                )
+                return
+        else:
+            self._create_allocated_affinity_group(s, info, pod)
+        self.affinity_groups[s.affinity_group.name].allocated_pods[
+            s.leaf_cell_number
+        ][pod_index] = pod
+
+    def delete_allocated_pod(self, pod: Pod) -> None:
+        """(reference: hived_algorithm.go:272-296)"""
+        s = extract_pod_scheduling_spec(pod)
+        info = extract_pod_bind_info(pod)
+        common.log.info(
+            "[%s]: Deleting allocated pod from affinity group %s",
+            pod.key, s.affinity_group.name,
+        )
+        g = self.affinity_groups.get(s.affinity_group.name)
+        if g is None:
+            common.log.error(
+                "[%s]: Group %s not found when deleting pod",
+                pod.key, s.affinity_group.name,
+            )
+            return
+        pod_index = get_allocated_pod_index(info, s.leaf_cell_number)
+        if pod_index == -1:
+            common.log.error(
+                "[%s]: Pod placement not found in group %s: node %s, leaf "
+                "cells %s", pod.key, s.affinity_group.name, info.node,
+                info.leaf_cell_isolation,
+            )
+            return
+        g.allocated_pods[s.leaf_cell_number][pod_index] = None
+        if all_pods_released(g.allocated_pods):
+            self._delete_allocated_affinity_group(g, pod)
+
+    # -- group lifecycle ----------------------------------------------------
+
+    def _create_allocated_affinity_group(
+        self, s: api.PodSchedulingSpec, info: api.PodBindInfo, pod: Pod
+    ) -> None:
+        """Create a group from a bind-info annotation (recovery / first
+        assume-bind) (reference: hived_algorithm.go:982-1041)."""
+        common.log.info(
+            "[%s]: Creating new allocated affinity group: %s",
+            pod.key, s.affinity_group.name,
+        )
+        new_group = AffinityGroup(
+            s.affinity_group,
+            s.virtual_cluster,
+            s.lazy_preemption_enable,
+            s.priority,
+            GroupState.ALLOCATED,
+        )
+        should_lazy_preempt = False
+        for gms in info.affinity_group_bind_info:
+            if not gms.pod_placements:
+                continue
+            leaf_cell_number = len(gms.pod_placements[0].physical_leaf_cell_indices)
+            for pod_index, pp in enumerate(gms.pod_placements):
+                node = pp.physical_node
+                for leaf_index in range(len(pp.physical_leaf_cell_indices)):
+                    p_leaf, v_leaf, lazy_preempt = self._find_allocated_leaf_cell(
+                        leaf_index,
+                        pp.physical_leaf_cell_indices,
+                        pp.preassigned_cell_types,
+                        info.cell_chain,
+                        node,
+                        should_lazy_preempt,
+                        s,
+                        new_group,
+                        pod,
+                    )
+                    if p_leaf is None:
+                        # The leaf no longer exists in the spec: ignore it but
+                        # keep the rest of the pod's cells
+                        # (reference: hived_algorithm.go:1000-1005).
+                        continue
+                    new_group.physical_placement[leaf_cell_number][pod_index][
+                        leaf_index
+                    ] = p_leaf
+                    if lazy_preempt is None:
+                        new_group.virtual_placement = None
+                    elif v_leaf is not None:
+                        new_group.virtual_placement[leaf_cell_number][pod_index][
+                            leaf_index
+                        ] = v_leaf
+                        if (
+                            in_free_cell_list(p_leaf)
+                            and v_leaf.preassigned_cell.priority > FREE_PRIORITY
+                        ):
+                            # Post-reconfiguration: the chosen virtual cell's
+                            # preassigned cell is already bound elsewhere;
+                            # destroy that binding by lazy-preempting its
+                            # groups (reference: hived_algorithm.go:1013-1019).
+                            self._lazy_preempt_cell(
+                                v_leaf.preassigned_cell, new_group.name
+                            )
+                    else:
+                        should_lazy_preempt = should_lazy_preempt or lazy_preempt
+                    safety_ok, reason = self._allocate_leaf_cell(
+                        p_leaf, v_leaf, s.priority, new_group.vc
+                    )
+                    p_leaf.add_using_group(new_group)
+                    set_cell_state(p_leaf, CellState.USED)
+                    if not safety_ok:
+                        should_lazy_preempt = True
+                        common.log.warning("[%s]: %s", pod.key, reason)
+        if should_lazy_preempt:
+            self._lazy_preempt_group(new_group, new_group.name)
+        self.affinity_groups[s.affinity_group.name] = new_group
+
+    def _delete_allocated_affinity_group(self, g: AffinityGroup, pod: Pod) -> None:
+        """(reference: hived_algorithm.go:1044-1073)"""
+        common.log.info(
+            "[%s]: All pods complete, deleting allocated affinity group: %s",
+            pod.key, g.name,
+        )
+        for pod_placements in g.physical_placement.values():
+            for pod_placement in pod_placements:
+                for leaf in pod_placement:
+                    if leaf is None:
+                        continue
+                    assert isinstance(leaf, PhysicalCell)
+                    leaf.delete_using_group(g)
+                    if leaf.state == CellState.USED:
+                        self._release_leaf_cell(leaf, g.vc)
+                        set_cell_state(leaf, CellState.FREE)
+                    else:  # RESERVING: already allocated to a preemptor
+                        set_cell_state(leaf, CellState.RESERVED)
+        del self.affinity_groups[g.name]
+
+    def _create_preempting_affinity_group(
+        self,
+        s: api.PodSchedulingSpec,
+        physical: Placement,
+        virtual: Optional[Placement],
+        pod: Pod,
+    ) -> None:
+        """Reserve cells for a preemptor immediately so concurrent preemptors
+        cannot deadlock on the same victims
+        (reference: hived_algorithm.go:1076-1113)."""
+        common.log.info(
+            "[%s]: Creating new preempting affinity group: %s",
+            pod.key, s.affinity_group.name,
+        )
+        new_group = AffinityGroup(
+            s.affinity_group,
+            s.virtual_cluster,
+            s.lazy_preemption_enable,
+            s.priority,
+            GroupState.PREEMPTING,
+        )
+        new_group.physical_placement = physical
+        new_group.virtual_placement = virtual
+        for leaf_num in physical:
+            for pod_index in range(len(physical[leaf_num])):
+                for leaf_index, leaf in enumerate(physical[leaf_num][pod_index]):
+                    assert isinstance(leaf, PhysicalCell)
+                    v_leaf = virtual[leaf_num][pod_index][leaf_index]
+                    assert isinstance(v_leaf, VirtualCell)
+                    if leaf.state == CellState.USED:
+                        using_group = leaf.using_group
+                        self._release_leaf_cell(leaf, using_group.vc)
+                        using_group.state = GroupState.BEING_PREEMPTED
+                    self._allocate_leaf_cell(leaf, v_leaf, s.priority, new_group.vc)
+                    leaf.add_reserving_or_reserved_group(new_group)
+                    # Reserving if someone still uses it, Reserved if free
+                    # (a Reserving/Reserved cell would have had its previous
+                    # preemption canceled in schedule()).
+                    if leaf.state == CellState.USED:
+                        set_cell_state(leaf, CellState.RESERVING)
+                    else:
+                        set_cell_state(leaf, CellState.RESERVED)
+        new_group.preempting_pods[pod.uid] = pod
+        self.affinity_groups[s.affinity_group.name] = new_group
+
+    def _delete_preempting_affinity_group(self, g: AffinityGroup, pod: Pod) -> None:
+        """Revoke an ongoing preemption: return Reserving cells to their
+        being-preempted groups, free Reserved cells
+        (reference: hived_algorithm.go:1116-1145)."""
+        for leaf_num in g.physical_placement:
+            for pod_index in range(len(g.physical_placement[leaf_num])):
+                for leaf in g.physical_placement[leaf_num][pod_index]:
+                    assert isinstance(leaf, PhysicalCell)
+                    self._release_leaf_cell(leaf, g.vc)
+                    leaf.delete_reserving_or_reserved_group(
+                        leaf.reserving_or_reserved_group
+                    )
+                    if leaf.state == CellState.RESERVING:
+                        set_cell_state(leaf, CellState.USED)
+                        being_preempted = leaf.using_group
+                        being_preempted_v_leaf: Optional[VirtualCell] = None
+                        if being_preempted.virtual_placement is not None:
+                            being_preempted_v_leaf = retrieve_virtual_cell(
+                                being_preempted.physical_placement,
+                                being_preempted.virtual_placement,
+                                leaf,
+                            )
+                        self._allocate_leaf_cell(
+                            leaf,
+                            being_preempted_v_leaf,
+                            being_preempted.priority,
+                            being_preempted.vc,
+                        )
+                    else:  # RESERVED
+                        set_cell_state(leaf, CellState.FREE)
+        del self.affinity_groups[g.name]
+        common.log.info(
+            "[%s]: Preempting affinity group %s deleted", pod.key, g.name
+        )
+
+    def _allocate_preempting_affinity_group(
+        self, g: AffinityGroup, pod: Pod
+    ) -> None:
+        """Preemption complete: Reserved -> Used, group -> Allocated
+        (reference: hived_algorithm.go:1148-1163)."""
+        for leaf_num in g.physical_placement:
+            for pod_index in range(len(g.physical_placement[leaf_num])):
+                for leaf in g.physical_placement[leaf_num][pod_index]:
+                    assert isinstance(leaf, PhysicalCell)
+                    leaf.delete_reserving_or_reserved_group(g)
+                    leaf.add_using_group(g)
+                    set_cell_state(leaf, CellState.USED)
+        g.state = GroupState.ALLOCATED
+        g.preempting_pods = {}
+        common.log.info(
+            "[%s]: Preempting affinity group %s transitioned to allocated",
+            pod.key, g.name,
+        )
+
+    def _lazy_preempt_group(
+        self, victim: AffinityGroup, preemptor: str
+    ) -> Optional[Placement]:
+        """Downgrade a group to opportunistic: release its virtual placement
+        (reference: hived_algorithm.go:1166-1190)."""
+        if victim.virtual_placement is None:
+            return None
+        for pod_virtual_placements in victim.virtual_placement.values():
+            for pod_virtual_placement in pod_virtual_placements:
+                for leaf in pod_virtual_placement:
+                    if leaf is None:
+                        continue
+                    assert isinstance(leaf, VirtualCell)
+                    p_leaf = leaf.physical_cell
+                    self._release_leaf_cell(p_leaf, victim.vc)
+                    self._allocate_leaf_cell(
+                        p_leaf, None, OPPORTUNISTIC_PRIORITY, victim.vc
+                    )
+        original = victim.virtual_placement
+        victim.virtual_placement = None
+        victim.lazy_preemption_status = {
+            "preemptor": preemptor,
+            "preemptionTime": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        common.log.info(
+            "Affinity group %s is lazy preempted from VC by %s",
+            victim.name, preemptor,
+        )
+        return original
+
+    def _lazy_preempt_cell(self, c: VirtualCell, preemptor: str) -> None:
+        """(reference: hived_algorithm.go:1193-1200)"""
+        if c.level == LOWEST_LEVEL and c.state == CellState.USED:
+            self._lazy_preempt_group(c.physical_cell.using_group, preemptor)
+        for child in c.children:
+            assert isinstance(child, VirtualCell)
+            self._lazy_preempt_cell(child, preemptor)
+
+    def _revert_lazy_preempt(
+        self, g: AffinityGroup, virtual: Optional[Placement]
+    ) -> None:
+        """(reference: hived_algorithm.go:1203-1220)"""
+        if virtual is None:
+            return
+        for leaf_num in g.physical_placement:
+            for pod_index in range(len(g.physical_placement[leaf_num])):
+                for leaf_index, leaf in enumerate(
+                    g.physical_placement[leaf_num][pod_index]
+                ):
+                    if leaf is None:
+                        continue
+                    assert isinstance(leaf, PhysicalCell)
+                    v_leaf = virtual[leaf_num][pod_index][leaf_index]
+                    assert isinstance(v_leaf, VirtualCell)
+                    self._release_leaf_cell(leaf, g.vc)
+                    self._allocate_leaf_cell(leaf, v_leaf, g.priority, g.vc)
+        g.virtual_placement = virtual
+        g.lazy_preemption_status = None
+        common.log.info("Lazy preemption of affinity group %s is reverted", g.name)
+
+    def _find_allocated_leaf_cell(
+        self,
+        index: int,
+        physical_leaf_cell_indices: List[int],
+        preassigned_cell_types: List[api.CellType],
+        chain: CellChain,
+        node: str,
+        lazy_preempted: bool,
+        s: api.PodSchedulingSpec,
+        group: AffinityGroup,
+        pod: Pod,
+    ) -> Tuple[Optional[PhysicalCell], Optional[VirtualCell], Optional[bool]]:
+        """Locate the physical and virtual leaf cells for a replayed pod.
+        Returns (p_leaf, v_leaf, lazy_preempt) where lazy_preempt None means
+        the group is opportunistic (no virtual placement)
+        (reference: hived_algorithm.go:1223-1291)."""
+        priority = s.priority
+        leaf_index_value = physical_leaf_cell_indices[index]
+        p_leaf = find_physical_leaf_cell(
+            self.full_cell_list, chain, node, leaf_index_value
+        )
+        if p_leaf is None:
+            common.log.warning(
+                "[%s]: Cannot find leaf cell %s on node %s: not found in the "
+                "spec. Pod ignored", pod.key, leaf_index_value, node,
+            )
+            return None, None, False
+        if not preassigned_cell_types:
+            common.log.warning(
+                "[%s]: Cannot find virtual cell: preassigned cell not found "
+                "in pod bind info", pod.key,
+            )
+            return p_leaf, None, True
+        if group.virtual_placement is not None and not lazy_preempted:
+            preassigned_type = preassigned_cell_types[index]
+            if preassigned_type:
+                message = ""
+                v_leaf: Optional[VirtualCell] = None
+                preassigned_level: Optional[CellLevel] = None
+                for l, t in self.cell_types.get(p_leaf.chain, {}).items():
+                    if t == preassigned_type:
+                        preassigned_level = l
+                if preassigned_level is None:
+                    message = (
+                        f"Preassigned cell type {preassigned_type} not found "
+                        f"in chain {p_leaf.chain}"
+                    )
+                elif s.virtual_cluster not in self.vc_schedulers:
+                    message = f"VC {s.virtual_cluster} not found"
+                else:
+                    vcs = self.vc_schedulers[s.virtual_cluster]
+                    if s.pinned_cell_id:
+                        vccl = vcs.pinned_cells.get(s.pinned_cell_id)
+                        target = str(s.pinned_cell_id)
+                    else:
+                        vccl = vcs.non_pinned_preassigned.get(p_leaf.chain)
+                        target = str(p_leaf.chain)
+                    if vccl is None:
+                        message = (
+                            f"VC {s.virtual_cluster} has no cell for {target}"
+                        )
+                    else:
+                        v_leaf, message = allocation.map_physical_cell_to_virtual(
+                            p_leaf, vccl, preassigned_level, priority
+                        )
+                if v_leaf is None:
+                    common.log.warning(
+                        "[%s]: Cannot find virtual cell: %s", pod.key, message
+                    )
+                    return p_leaf, None, True
+                return p_leaf, v_leaf, False
+            return p_leaf, None, None
+        return p_leaf, None, False
+
+    # -- leaf cell allocate / release ---------------------------------------
+
+    def _allocate_leaf_cell(
+        self,
+        p_leaf: PhysicalCell,
+        v_leaf: Optional[VirtualCell],
+        p: CellPriority,
+        vcn: api.VirtualClusterName,
+    ) -> Tuple[bool, str]:
+        """Create bindings, allocate the preassigned cell if newly bound, set
+        priorities (reference: hived_algorithm.go:1294-1324)."""
+        safety_ok, reason = True, ""
+        if v_leaf is not None:
+            allocation.set_cell_priority(v_leaf, p)
+            allocation.update_used_leaf_cell_numbers(v_leaf, p, True)
+            allocation.set_cell_priority(p_leaf, p)
+            allocation.update_used_leaf_cell_numbers(p_leaf, p, True)
+            pac = v_leaf.preassigned_cell
+            preassigned_newly_bound = pac.physical_cell is None
+            if p_leaf.virtual_cell is None:
+                # The binding may already exist (e.g. the cell was bad).
+                allocation.bind_cell(p_leaf, v_leaf)
+            if preassigned_newly_bound:
+                safety_ok, reason = self._allocate_preassigned_cell(
+                    pac.physical_cell, vcn, False
+                )
+        else:
+            allocation.set_cell_priority(p_leaf, OPPORTUNISTIC_PRIORITY)
+            allocation.update_used_leaf_cell_numbers(
+                p_leaf, OPPORTUNISTIC_PRIORITY, True
+            )
+            self._ot_cells.setdefault(vcn, []).append(p_leaf)
+        return safety_ok, reason
+
+    def _release_leaf_cell(
+        self, p_leaf: PhysicalCell, vcn: api.VirtualClusterName
+    ) -> None:
+        """(reference: hived_algorithm.go:1327-1353)"""
+        v_leaf = p_leaf.virtual_cell
+        if v_leaf is not None:
+            allocation.update_used_leaf_cell_numbers(
+                v_leaf, v_leaf.priority, False
+            )
+            allocation.set_cell_priority(v_leaf, FREE_PRIORITY)
+            preassigned_physical = v_leaf.preassigned_cell.physical_cell
+            if p_leaf.healthy:
+                # Never unbind a bad cell: the binding keeps the failure
+                # visible in the VC.
+                allocation.unbind_cell(p_leaf)
+            doomed = self.vc_doomed_bad_cells.get(vcn, {}).get(
+                preassigned_physical.chain
+            )
+            if (
+                not preassigned_physical.pinned
+                and v_leaf.preassigned_cell.priority < MIN_GUARANTEED_PRIORITY
+                and not (
+                    doomed is not None
+                    and doomed.contains(
+                        preassigned_physical, preassigned_physical.level
+                    )
+                )
+            ):
+                self._release_preassigned_cell(preassigned_physical, vcn, False)
+        else:
+            ot = self._ot_cells.get(vcn, [])
+            for i, c in enumerate(ot):
+                if c.address == p_leaf.address:
+                    ot.pop(i)
+                    break
+        allocation.update_used_leaf_cell_numbers(p_leaf, p_leaf.priority, False)
+        allocation.set_cell_priority(p_leaf, FREE_PRIORITY)
+
+    # -- preassigned cell allocate / release (buddy split/merge) ------------
+
+    def _allocate_preassigned_cell(
+        self, c: PhysicalCell, vcn: api.VirtualClusterName, doomed_bad: bool
+    ) -> Tuple[bool, str]:
+        """Remove from the free list (splitting ancestors) and maintain the
+        triple bookkeeping + doomed-bad-cell checks along every affected
+        level (reference: hived_algorithm.go:1356-1427; the inline comments
+        there explain each branch and are mirrored below)."""
+        safety_ok, reason = True, ""
+        chain, level = c.chain, c.level
+        self.vc_free_cell_num[vcn].setdefault(chain, {}).setdefault(level, 0)
+        self.vc_free_cell_num[vcn][chain][level] -= 1
+        self.all_vc_free_cell_num.setdefault(chain, {}).setdefault(level, 0)
+        self.all_vc_free_cell_num[chain][level] -= 1
+        self.total_left_cell_num[chain][level] -= 1
+        split_level_up_to = self._remove_cell_from_free_list(c)
+
+        parent = c.parent
+        for l in range(level + 1, split_level_up_to + 1):
+            self.total_left_cell_num[chain][l] -= 1
+            if (
+                self.total_left_cell_num[chain][l]
+                < self.all_vc_free_cell_num.get(chain, {}).get(l, 0)
+            ):
+                safety_ok = False
+                reason = (
+                    "Adding pod would lead to broken safety: cell type "
+                    f"{self.cell_types[chain].get(l)}, "
+                    f"{self.total_left_cell_num[chain][l]} left, "
+                    f"{self.all_vc_free_cell_num[chain][l]} free cells in all "
+                    "VCs"
+                )
+            assert isinstance(parent, PhysicalCell)
+            if not parent.healthy:
+                # Bad parent: neither vcFreeCellNum nor healthy-free count
+                # changes; just remove it from the bad free cells.
+                self.bad_free_cells[chain].remove(parent, l)
+            else:
+                # Healthy parent consumed: healthy free count decreases.
+                self._try_bind_doomed_bad_cell(chain, l)
+            parent = parent.parent
+        if not c.healthy:
+            self._allocate_bad_cell(c)
+            if not doomed_bad:
+                self._try_unbind_doomed_bad_cell(chain, level)
+        else:
+            self._try_bind_doomed_bad_cell(chain, level)
+        num_to_reduce = len(c.children)
+        for l in range(level - 1, LOWEST_LEVEL - 1, -1):
+            self.total_left_cell_num[chain][l] -= num_to_reduce
+            if (
+                self.total_left_cell_num[chain][l]
+                < self.all_vc_free_cell_num.get(chain, {}).get(l, 0)
+            ):
+                safety_ok = False
+                reason = (
+                    "Adding pod would lead to broken safety: cell type "
+                    f"{self.cell_types[chain].get(l)}, "
+                    f"{self.total_left_cell_num[chain][l]} left, "
+                    f"{self.all_vc_free_cell_num[chain][l]} free cells in all "
+                    "VCs"
+                )
+            if not doomed_bad:
+                self._try_bind_doomed_bad_cell(chain, l)
+            num_to_reduce *= len(self.full_cell_list[chain][l][0].children) if (
+                l > LOWEST_LEVEL
+            ) else 0
+        return safety_ok, reason
+
+    def _allocate_bad_cell(self, c: PhysicalCell) -> None:
+        """(reference: hived_algorithm.go:1430-1448)"""
+        if self.bad_free_cells[c.chain].contains(c, c.level):
+            self.bad_free_cells[c.chain].remove(c, c.level)
+        if c.virtual_cell is None:
+            vc = allocation.get_unbound_virtual_cell(
+                c.parent.virtual_cell.children
+            )
+            c.set_virtual_cell(vc)
+            vc.set_physical_cell(c)
+            common.log.info(
+                "Virtual cell %s is bound to physical cell %s (bad)",
+                vc.address, c.address,
+            )
+        for child in c.children:
+            assert isinstance(child, PhysicalCell)
+            if not child.healthy:
+                self._allocate_bad_cell(child)
+
+    def _release_preassigned_cell(
+        self, c: PhysicalCell, vcn: api.VirtualClusterName, doomed_bad: bool
+    ) -> None:
+        """(reference: hived_algorithm.go:1451-1483)"""
+        chain, level = c.chain, c.level
+        self.vc_free_cell_num[vcn].setdefault(chain, {}).setdefault(level, 0)
+        self.vc_free_cell_num[vcn][chain][level] += 1
+        self.all_vc_free_cell_num.setdefault(chain, {}).setdefault(level, 0)
+        self.all_vc_free_cell_num[chain][level] += 1
+        self.total_left_cell_num[chain][level] += 1
+        merge_level_up_to = self._add_cell_to_free_list(c)
+
+        parent = c.parent
+        for l in range(level + 1, merge_level_up_to + 1):
+            self.total_left_cell_num[chain][l] += 1
+            assert isinstance(parent, PhysicalCell)
+            if not parent.healthy:
+                self.bad_free_cells[chain][l].append(parent)
+            else:
+                self._try_unbind_doomed_bad_cell(chain, l)
+            parent = parent.parent
+        if not c.healthy:
+            self._release_bad_cell(c)
+            if not doomed_bad:
+                self._try_bind_doomed_bad_cell(chain, level)
+        else:
+            self._try_unbind_doomed_bad_cell(chain, level)
+        num_to_add = len(c.children)
+        for l in range(level - 1, LOWEST_LEVEL - 1, -1):
+            self.total_left_cell_num[chain][l] += num_to_add
+            if not doomed_bad:
+                self._try_unbind_doomed_bad_cell(chain, l)
+            num_to_add *= len(self.full_cell_list[chain][l][0].children) if (
+                l > LOWEST_LEVEL
+            ) else 0
+
+    def _release_bad_cell(self, c: PhysicalCell) -> None:
+        """(reference: hived_algorithm.go:1486-1500)"""
+        self.bad_free_cells[c.chain][c.level].append(c)
+        if c.virtual_cell is not None:
+            vc = c.virtual_cell
+            c.set_virtual_cell(None)
+            vc.set_physical_cell(None)
+            common.log.info(
+                "Virtual cell %s is unbound from physical cell %s",
+                vc.address, c.address,
+            )
+        for child in c.children:
+            assert isinstance(child, PhysicalCell)
+            if not child.healthy:
+                self._release_bad_cell(child)
+
+    def _remove_cell_from_free_list(self, c: PhysicalCell) -> CellLevel:
+        """Remove from the free list, splitting parents as needed; returns
+        the highest level actually split
+        (reference: hived_algorithm.go:1503-1527)."""
+        chain = c.chain
+        while True:
+            terminate = False
+            l = c.level
+            parent = c.parent
+            if parent is not None:
+                if parent.split:
+                    terminate = True
+                else:
+                    self.free_cell_list[chain][l].extend(parent.children)
+                    parent.split = True
+            else:
+                terminate = True
+            self.free_cell_list[chain].remove(c, l)
+            if terminate:
+                return l
+            c = parent
+
+    def _add_cell_to_free_list(self, c: PhysicalCell) -> CellLevel:
+        """Add to the free list, merging buddies recursively; returns the
+        highest level actually merged
+        (reference: hived_algorithm.go:1530-1565)."""
+        chain = c.chain
+        while True:
+            terminate = False
+            l = c.level
+            parent = c.parent
+            if parent is not None:
+                all_buddy_free = all(
+                    cell_equal(buddy, c)
+                    or self.free_cell_list[chain].contains(buddy, l)
+                    for buddy in parent.children
+                )
+                if not all_buddy_free:
+                    terminate = True
+                else:
+                    for buddy in parent.children:
+                        if not cell_equal(buddy, c):
+                            self.free_cell_list[chain].remove(buddy, l)
+                    parent.split = False
+            else:
+                terminate = True
+            if terminate:
+                self.free_cell_list[chain][l].append(c)
+                return l
+            c = parent
+
+    # -- inspect API --------------------------------------------------------
+
+    def get_all_affinity_groups(self) -> Dict:
+        """(reference: hived_algorithm.go:298-309)"""
+        return {"items": [g.to_status() for g in self.affinity_groups.values()]}
+
+    def get_affinity_group(self, name: str) -> Dict:
+        g = self.affinity_groups.get(name)
+        if g is None:
+            raise api.bad_request(
+                f"Affinity group {name} does not exist since it is not "
+                "allocated or preempting"
+            )
+        return g.to_status()
+
+    def get_cluster_status(self) -> Dict:
+        return {
+            "physicalCluster": self.get_physical_cluster_status(),
+            "virtualClusters": self.get_all_virtual_clusters_status(),
+        }
+
+    def get_physical_cluster_status(self) -> List[Dict]:
+        """Generated on demand by walking the physical trees (the reference
+        maintains mirrored apiStatus objects instead,
+        hived_algorithm.go:412-437)."""
+        return [
+            self._physical_cell_status(
+                c, leaf_type=self.chain_to_leaf_type.get(chain)
+            )
+            for chain, ccl in self.full_cell_list.items()
+            for c in ccl[ccl.top_level]
+            if isinstance(c, PhysicalCell)
+        ]
+
+    def get_all_virtual_clusters_status(self) -> Dict[str, List[Dict]]:
+        return {vc: self.get_virtual_cluster_status(vc) for vc in self.vc_schedulers}
+
+    def get_virtual_cluster_status(self, vcn: api.VirtualClusterName) -> List[Dict]:
+        if vcn not in self.vc_schedulers:
+            raise api.bad_request(f"VC {vcn} not found")
+        vcs = self.vc_schedulers[vcn]
+        out: List[Dict] = []
+        for chain, ccl in vcs.non_pinned_preassigned.items():
+            leaf_type = self.chain_to_leaf_type.get(chain)
+            for level in sorted(ccl.levels):
+                for c in ccl[level]:
+                    assert isinstance(c, VirtualCell)
+                    out.append(self._virtual_cell_status(c, leaf_type=leaf_type))
+        for pid, ccl in vcs.pinned_cells.items():
+            for c in ccl[ccl.top_level]:
+                assert isinstance(c, VirtualCell)
+                out.append(
+                    self._virtual_cell_status(
+                        c, leaf_type=self.chain_to_leaf_type.get(c.chain)
+                    )
+                )
+        # Opportunistic cells used by this VC (reference: utils.go:419-436).
+        for p_leaf in self._ot_cells.get(vcn, []):
+            ps = self._physical_cell_status(p_leaf, shallow=True)
+            out.append(
+                {
+                    "leafCellType": self.chain_to_leaf_type.get(p_leaf.chain, ""),
+                    "cellType": p_leaf.cell_type,
+                    "cellAddress": p_leaf.address + "-opp",
+                    "cellState": CellState.USED.value,
+                    "cellHealthiness": (
+                        api.CELL_HEALTHY if p_leaf.healthy else api.CELL_BAD
+                    ),
+                    "cellPriority": OPPORTUNISTIC_PRIORITY,
+                    "physicalCell": ps,
+                }
+            )
+        return out
+
+    def _physical_cell_status(
+        self,
+        c: PhysicalCell,
+        leaf_type: Optional[str] = None,
+        shallow: bool = False,
+    ) -> Dict:
+        d: Dict = {
+            "cellType": c.cell_type,
+            "isNodeLevel": c.is_node_level,
+            "cellAddress": c.address,
+            "cellState": c.state.value,
+            "cellHealthiness": api.CELL_HEALTHY if c.healthy else api.CELL_BAD,
+            "cellPriority": c.priority,
+        }
+        if leaf_type:
+            d["leafCellType"] = leaf_type
+        if c.virtual_cell is not None:
+            d["vc"] = c.virtual_cell.vc
+        elif any(
+            c.address == oc.address for ocs in self._ot_cells.values() for oc in ocs
+        ):
+            d["vc"] = next(
+                vcn
+                for vcn, ocs in self._ot_cells.items()
+                if any(c.address == oc.address for oc in ocs)
+            )
+        if shallow:
+            return d
+        if c.virtual_cell is not None:
+            d["virtualCell"] = self._virtual_cell_status(c.virtual_cell, shallow=True)
+        if c.children:
+            d["cellChildren"] = [
+                self._physical_cell_status(child)
+                for child in c.children
+                if isinstance(child, PhysicalCell)
+            ]
+        return d
+
+    def _virtual_cell_status(
+        self,
+        c: VirtualCell,
+        leaf_type: Optional[str] = None,
+        shallow: bool = False,
+    ) -> Dict:
+        d: Dict = {
+            "cellType": c.cell_type,
+            "isNodeLevel": c.is_node_level,
+            "cellAddress": c.address,
+            "cellState": c.state.value,
+            "cellHealthiness": api.CELL_HEALTHY if c.healthy else api.CELL_BAD,
+            "cellPriority": c.priority,
+        }
+        if leaf_type:
+            d["leafCellType"] = leaf_type
+        if shallow:
+            return d
+        if c.physical_cell is not None:
+            d["physicalCell"] = self._physical_cell_status(
+                c.physical_cell, shallow=True
+            )
+        if c.children:
+            d["cellChildren"] = [
+                self._virtual_cell_status(child)
+                for child in c.children
+                if isinstance(child, VirtualCell)
+            ]
+        return d

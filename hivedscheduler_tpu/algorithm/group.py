@@ -84,10 +84,14 @@ class AffinityGroup:
                 "lazyPreemptionStatus": self.lazy_preemption_status,
                 "physicalPlacement": physical_placement_to_node_indices(
                     self.physical_placement
-                ),
+                )
+                if self.physical_placement is not None
+                else {},
                 "virtualPlacement": virtual_placement_to_preassigned_map(
                     self.virtual_placement
-                ),
+                )
+                if self.virtual_placement is not None
+                else {},
                 "allocatedPods": [
                     getattr(p, "uid", None)
                     for pods in self.allocated_pods.values()

@@ -1,0 +1,88 @@
+"""Per-VC scheduling facade.
+
+Python equivalent of the reference's ``pkg/algorithm/intra_vc_scheduler.go``:
+routes a request to the topology-aware scheduler of the target chain or
+pinned cell, with cross-priority packing enabled (high priority avoids
+preemption globally inside a VC).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import common
+from ..api import types as api
+from .cell import Cell, CellChain, CellLevel, CellPriority, ChainCellList
+from .placement import TopologyAwareScheduler
+
+
+@dataclass
+class SchedulingRequest:
+    """(reference: algorithm/types.go:43-53 ``schedulingRequest``)"""
+
+    vc: api.VirtualClusterName
+    priority: CellPriority
+    affinity_group_name: str
+    affinity_group_pod_nums: Dict[int, int]  # leaf cell num -> pod num
+    pinned_cell_id: api.PinnedCellId = ""
+    chain: CellChain = ""
+    suggested_nodes: Optional[Set[str]] = None
+    ignore_suggested_nodes: bool = True
+
+
+class IntraVCScheduler:
+    """(reference: intra_vc_scheduler.go:45-117 ``defaultIntraVCScheduler``)"""
+
+    def __init__(
+        self,
+        non_pinned_full: Dict[CellChain, ChainCellList],
+        non_pinned_preassigned: Dict[CellChain, ChainCellList],
+        pinned_cells: Dict[api.PinnedCellId, ChainCellList],
+        leaf_cell_nums: Dict[CellChain, Dict[CellLevel, int]],
+    ):
+        self.non_pinned_full = non_pinned_full
+        self.non_pinned_preassigned = non_pinned_preassigned
+        self.pinned_cells = pinned_cells
+        self._chain_schedulers = {
+            chain: TopologyAwareScheduler(
+                ccl, leaf_cell_nums[chain], cross_priority_pack=True
+            )
+            for chain, ccl in non_pinned_full.items()
+        }
+        self._pinned_schedulers = {
+            pid: TopologyAwareScheduler(
+                ccl,
+                leaf_cell_nums[ccl[1][0].chain],
+                cross_priority_pack=True,
+            )
+            for pid, ccl in pinned_cells.items()
+        }
+
+    def schedule(
+        self, sr: SchedulingRequest
+    ) -> Tuple[Optional[Dict[int, List[List[Cell]]]], str]:
+        """(reference: intra_vc_scheduler.go:92-117)"""
+        if sr.pinned_cell_id:
+            scheduler = self._pinned_schedulers.get(sr.pinned_cell_id)
+            target = f"pinned cell {sr.pinned_cell_id}"
+        else:
+            scheduler = self._chain_schedulers.get(sr.chain)
+            target = f"chain {sr.chain}"
+        common.log.debug(
+            "Processing scheduling request in VC %s: %s, leaf cell numbers %s, "
+            "priority %s",
+            sr.vc, target, sr.affinity_group_pod_nums, sr.priority,
+        )
+        placement: Optional[Dict[int, List[List[Cell]]]] = None
+        failed_reason = ""
+        if scheduler is not None:
+            placement, failed_reason = scheduler.schedule(
+                sr.affinity_group_pod_nums,
+                sr.priority,
+                sr.suggested_nodes,
+                sr.ignore_suggested_nodes,
+            )
+        if placement is None:
+            return None, f"{failed_reason} when scheduling in VC {sr.vc}"
+        return placement, ""

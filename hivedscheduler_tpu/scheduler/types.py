@@ -1,0 +1,237 @@
+"""Internal contracts: pod/node models, pod state machine, schedule results.
+
+Python equivalent of the reference's ``pkg/internal`` (types.go:34-236,
+utils.go:108-290). The K8s objects are modeled as plain dataclasses so the
+whole algorithm layer is a hermetic, simulation-testable state machine — the
+same property the reference's test suite exploits
+(hived_algorithm_test.go:41-64).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from .. import common
+from ..api import constants, types as api
+
+
+@dataclass
+class Pod:
+    """The slice of a K8s Pod the scheduler needs
+    (reference: core.Pod fields used across pkg/internal/utils.go)."""
+
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    annotations: Dict[str, str] = field(default_factory=dict)
+    node_name: str = ""  # spec.nodeName; non-empty means bound
+    phase: str = "Pending"  # Pending | Running | Succeeded | Failed
+    # container resource limits (for the scheduling-enable gate)
+    resource_limits: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return f"{self.uid}({self.namespace}/{self.name})"
+
+
+@dataclass
+class Node:
+    """The slice of a K8s Node the scheduler needs."""
+
+    name: str
+    unschedulable: bool = False
+    ready: bool = True
+
+
+def is_completed(pod: Pod) -> bool:
+    """(reference: internal/utils.go:108-111)"""
+    return pod.phase in ("Succeeded", "Failed")
+
+
+def is_live(pod: Pod) -> bool:
+    return not is_completed(pod)
+
+
+def is_hived_enabled(pod: Pod) -> bool:
+    """The extended-resource gate: at least one container sets our resource
+    limit positive (reference: internal/utils.go:115-140)."""
+    return pod.resource_limits.get(constants.RESOURCE_NAME_POD_SCHEDULING_ENABLE, 0) > 0
+
+
+def is_interested(pod: Pod) -> bool:
+    """(reference: internal/utils.go:142-147)"""
+    return is_live(pod) and is_hived_enabled(pod)
+
+
+def is_bound(pod: Pod) -> bool:
+    """(reference: internal/utils.go:149-153)"""
+    return pod.node_name != "" and is_live(pod)
+
+
+def is_unbound(pod: Pod) -> bool:
+    return pod.node_name == "" and is_live(pod)
+
+
+def is_node_healthy(node: Node) -> bool:
+    """Schedulable and Ready (reference: internal/utils.go:160-170)."""
+    return not node.unschedulable and node.ready
+
+
+class SchedulingPhase(str, enum.Enum):
+    """(reference: internal/types.go:102-114)"""
+
+    # Called from the filter route: suggested nodes fit the pod without
+    # preempting anyone.
+    FILTERING = "Filtering"
+    # Called from the preempt route: suggested nodes fit the pod after
+    # preempting all lower-priority pods.
+    PREEMPTING = "Preempting"
+
+
+class PodState(str, enum.Enum):
+    """Pod states tracked by the scheduler framework
+    (reference: internal/types.go:154-194)."""
+
+    WAITING = "Waiting"
+    PREEMPTING = "Preempting"
+    BINDING = "Binding"
+    BOUND = "Bound"
+
+
+def is_allocated_state(state: PodState) -> bool:
+    return state in (PodState.BINDING, PodState.BOUND)
+
+
+@dataclass
+class PodWaitInfo:
+    """(reference: internal/types.go:198-201)"""
+
+    reason: str = ""
+
+
+@dataclass
+class PodPreemptInfo:
+    """Victim pods for the current preemptor
+    (reference: internal/types.go:204-216)."""
+
+    victim_pods: List[Pod] = field(default_factory=list)
+
+
+@dataclass
+class PodScheduleResult:
+    """Exactly one of the three fields is set
+    (reference: internal/types.go:116-136)."""
+
+    pod_wait_info: Optional[PodWaitInfo] = None
+    pod_preempt_info: Optional[PodPreemptInfo] = None
+    pod_bind_info: Optional[api.PodBindInfo] = None
+
+
+@dataclass
+class PodScheduleStatus:
+    """Per-pod tracking record in the framework
+    (reference: internal/types.go:139-152)."""
+
+    pod: Pod
+    pod_state: PodState
+    pod_bind_attempts: int = 0
+    pod_schedule_result: Optional[PodScheduleResult] = None
+
+
+def new_binding_pod(pod: Pod, bind_info: api.PodBindInfo) -> Pod:
+    """A copy of the pod with the binding decision applied: node set, the
+    isolation + bind-info annotations attached
+    (reference: internal/utils.go:172-186)."""
+    annotations = dict(pod.annotations)
+    annotations[constants.ANNOTATION_POD_LEAF_CELL_ISOLATION] = (
+        common.to_indices_string(bind_info.leaf_cell_isolation)
+    )
+    annotations[constants.ANNOTATION_POD_BIND_INFO] = common.to_yaml(
+        bind_info.to_dict()
+    )
+    return Pod(
+        name=pod.name,
+        namespace=pod.namespace,
+        uid=pod.uid,
+        annotations=annotations,
+        node_name=bind_info.node,
+        phase=pod.phase,
+        resource_limits=dict(pod.resource_limits),
+    )
+
+
+def extract_pod_bind_info(allocated_pod: Pod) -> api.PodBindInfo:
+    """(reference: internal/utils.go:200-213; trusted input, assert-style)"""
+    annotation = allocated_pod.annotations.get(constants.ANNOTATION_POD_BIND_INFO, "")
+    if not annotation:
+        raise api.bad_request(
+            f"Pod does not contain or contains empty annotation: "
+            f"{constants.ANNOTATION_POD_BIND_INFO}"
+        )
+    return api.PodBindInfo.from_dict(common.from_yaml(annotation) or {})
+
+
+def extract_pod_scheduling_spec(pod: Pod) -> api.PodSchedulingSpec:
+    """Deserialize + default + validate the user-provided scheduling spec
+    (reference: internal/utils.go:230-289). All failures are user errors
+    (HTTP 400)."""
+    err_pfx = f"Pod annotation {constants.ANNOTATION_POD_SCHEDULING_SPEC}: "
+    annotation = pod.annotations.get(constants.ANNOTATION_POD_SCHEDULING_SPEC, "")
+    if not annotation:
+        raise api.bad_request(err_pfx + "Annotation does not exist or is empty")
+    try:
+        raw = common.from_yaml(annotation) or {}
+        spec = api.PodSchedulingSpec.from_dict(raw)
+        if "ignoreK8sSuggestedNodes" not in raw:
+            spec.ignore_k8s_suggested_nodes = True
+    except api.WebServerError:
+        raise
+    except Exception as e:  # malformed YAML and the like
+        raise api.bad_request(err_pfx + str(e))
+
+    # Defaulting: a pod with no affinity group forms a singleton gang
+    # (reference: internal/utils.go:242-250).
+    if spec.affinity_group is None:
+        spec.affinity_group = api.AffinityGroupSpec(
+            name=f"{pod.namespace}/{pod.name}",
+            members=[
+                api.AffinityGroupMemberSpec(
+                    pod_number=1, leaf_cell_number=spec.leaf_cell_number
+                )
+            ],
+        )
+
+    # Validation (reference: internal/utils.go:253-287).
+    if not spec.virtual_cluster:
+        raise api.bad_request(err_pfx + "VirtualCluster is empty")
+    if spec.priority < constants.OPPORTUNISTIC_PRIORITY:
+        raise api.bad_request(
+            err_pfx + f"Priority is less than {constants.OPPORTUNISTIC_PRIORITY}"
+        )
+    if spec.priority > constants.MAX_GUARANTEED_PRIORITY:
+        raise api.bad_request(
+            err_pfx + f"Priority is greater than {constants.MAX_GUARANTEED_PRIORITY}"
+        )
+    if spec.leaf_cell_number <= 0:
+        raise api.bad_request(err_pfx + "LeafCellNumber is non-positive")
+    if not spec.affinity_group.name:
+        raise api.bad_request(err_pfx + "AffinityGroup.Name is empty")
+    pod_in_group = False
+    for member in spec.affinity_group.members:
+        if member.pod_number <= 0:
+            raise api.bad_request(
+                err_pfx + "AffinityGroup.Members has non-positive PodNumber"
+            )
+        if member.leaf_cell_number <= 0:
+            raise api.bad_request(
+                err_pfx + "AffinityGroup.Members has non-positive LeafCellNumber"
+            )
+        if member.leaf_cell_number == spec.leaf_cell_number:
+            pod_in_group = True
+    if not pod_in_group:
+        raise api.bad_request(
+            err_pfx + "AffinityGroup.Members does not contains current Pod"
+        )
+    return spec

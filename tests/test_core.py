@@ -1,0 +1,492 @@
+"""Simulation-style tests for the core algorithm.
+
+Mirrors the reference's single test file (hived_algorithm_test.go, 1144 LoC):
+a hermetic, white-box simulation driving the exact algorithm interface the
+production framework drives — Schedule -> new_binding_pod ->
+add_allocated_pod / delete_allocated_pod — against the devious TPU design
+config, with golden expected placements, stateful preemption, bad-node
+dynamics, and work-preserving reconfiguration.
+"""
+
+import logging
+
+import pytest
+import yaml
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.algorithm.cell import CellState
+from hivedscheduler_tpu.algorithm.core import HivedCore
+from hivedscheduler_tpu.algorithm.group import GroupState
+from hivedscheduler_tpu.api import constants, types as api
+from hivedscheduler_tpu.scheduler.types import (
+    Pod,
+    SchedulingPhase,
+    extract_pod_bind_info,
+    new_binding_pod,
+)
+
+from .test_config_compiler import tpu_design_config
+
+common.init_logging(logging.ERROR)
+
+
+def make_pod(
+    name,
+    uid,
+    vc,
+    priority,
+    leaf_type,
+    leaf_num,
+    group=None,
+    pinned_cell_id="",
+    lazy_preemption=False,
+    ignore_suggested=True,
+):
+    spec = {
+        "virtualCluster": vc,
+        "priority": priority,
+        "leafCellType": leaf_type,
+        "leafCellNumber": leaf_num,
+        "lazyPreemptionEnable": lazy_preemption,
+        "ignoreK8sSuggestedNodes": ignore_suggested,
+    }
+    if pinned_cell_id:
+        spec["pinnedCellId"] = pinned_cell_id
+    if group:
+        spec["affinityGroup"] = group
+    return Pod(
+        name=name,
+        uid=uid,
+        annotations={
+            constants.ANNOTATION_POD_SCHEDULING_SPEC: yaml.safe_dump(spec)
+        },
+        resource_limits={constants.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1},
+    )
+
+
+class Sim:
+    """Drives the SchedulerAlgorithm interface like the framework would."""
+
+    def __init__(self, config=None):
+        self.core = HivedCore(config or tpu_design_config())
+        self.all_nodes = sorted(
+            {
+                n
+                for ccl in self.core.full_cell_list.values()
+                for c in ccl[ccl.top_level]
+                for n in c.nodes
+            }
+        )
+        for n in self.all_nodes:
+            self.core.set_healthy_node(n)
+        self.bound = {}  # uid -> binding pod
+
+    def schedule(self, pod, phase=SchedulingPhase.FILTERING, suggested=None):
+        return self.core.schedule(
+            pod, self.all_nodes if suggested is None else suggested, phase
+        )
+
+    def bind(self, pod, result):
+        assert result.pod_bind_info is not None
+        bp = new_binding_pod(pod, result.pod_bind_info)
+        bp.phase = "Running"
+        self.core.add_allocated_pod(bp)
+        self.bound[pod.uid] = bp
+        return bp
+
+    def schedule_and_bind(self, pod, phase=SchedulingPhase.FILTERING, suggested=None):
+        r = self.schedule(pod, phase, suggested)
+        assert r.pod_bind_info is not None, (
+            pod.name,
+            r.pod_wait_info and r.pod_wait_info.reason,
+        )
+        return self.bind(pod, r)
+
+    def delete(self, pod):
+        self.core.delete_allocated_pod(self.bound.pop(pod.uid))
+
+
+@pytest.fixture()
+def sim():
+    return Sim()
+
+
+def test_single_pod_lifecycle(sim):
+    pod = make_pod("j1-0", "u1", "VC1", 0, "v5e-chip", 4)
+    bp = sim.schedule_and_bind(pod)
+    assert bp.node_name.startswith("v5e16")
+    g = sim.core.get_affinity_group("default/j1-0")
+    assert g["status"]["state"] == "Allocated"
+    assert list(g["status"]["physicalPlacement"].values()) == [[0, 1, 2, 3]]
+    sim.delete(pod)
+    with pytest.raises(api.WebServerError):
+        sim.core.get_affinity_group("default/j1-0")
+    # All cells back to free: a second identical pod gets a placement again.
+    sim.schedule_and_bind(make_pod("j2-0", "u2", "VC1", 0, "v5e-chip", 4))
+
+
+def test_gang_on_v5p16_topology_guarantee(sim):
+    group = {"name": "bert", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    pods = [
+        make_pod(f"bert-{i}", f"bu{i}", "VC1", 1, "v5p-chip", 4, group)
+        for i in range(4)
+    ]
+    nodes = set()
+    for p in pods:
+        bp = sim.schedule_and_bind(p)
+        nodes.add(bp.node_name)
+    # All 4 hosts within ONE v5p-16 cell (ICI-contiguous sub-slice).
+    host_ids = sorted(int(n.split("w")[1]) for n in nodes)
+    assert len(nodes) == 4
+    assert host_ids in ([0, 1, 2, 3], [4, 5, 6, 7], [8, 9, 10, 11], [12, 13, 14, 15])
+    g = sim.core.get_affinity_group("bert")
+    assert len(g["status"]["allocatedPods"]) == 4
+
+
+def test_gang_oversubscription_rejected(sim):
+    group = {"name": "tiny", "members": [{"podNumber": 1, "leafCellNumber": 2}]}
+    sim.schedule_and_bind(make_pod("t-0", "tu0", "VC1", 0, "v5e-chip", 2, group))
+    with pytest.raises(api.WebServerError) as e:
+        sim.schedule(make_pod("t-1", "tu1", "VC1", 0, "v5e-chip", 2, group))
+    assert e.value.code == 400
+
+
+def test_vc_quota_exceeded_waits(sim):
+    # VC1 has one v5e-16 (16 chips); a 32-chip request must wait.
+    group = {"name": "big", "members": [{"podNumber": 8, "leafCellNumber": 4}]}
+    r = sim.schedule(make_pod("big-0", "bg0", "VC1", 0, "v5e-chip", 4, group))
+    assert r.pod_wait_info is not None
+
+
+def test_invalid_requests(sim):
+    with pytest.raises(api.WebServerError):
+        sim.schedule(make_pod("x", "xu", "noVC", 0, "v5e-chip", 1))
+    with pytest.raises(api.WebServerError):
+        sim.schedule(make_pod("x", "xu", "VC1", 0, "no-such-chip", 1))
+    # VC1 has no cpu quota: guaranteed request for cpu must be rejected.
+    with pytest.raises(api.WebServerError):
+        sim.schedule(make_pod("x", "xu", "VC1", 0, "cpu-socket", 1))
+    # Opportunistic pinned-cell use is rejected.
+    with pytest.raises(api.WebServerError):
+        sim.schedule(
+            make_pod("x", "xu", "VC1", -1, "v5p-chip", 4,
+                     pinned_cell_id="VC1-PIN-V5P16")
+        )
+
+
+def test_pinned_cell_scheduling(sim):
+    group = {"name": "pinned-job", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    pods = [
+        make_pod(
+            f"pin-{i}", f"pu{i}", "VC1", 2, "", 4, group,
+            pinned_cell_id="VC1-PIN-V5P16",
+        )
+        for i in range(4)
+    ]
+    nodes = set()
+    for p in pods:
+        bp = sim.schedule_and_bind(p)
+        nodes.add(bp.node_name)
+    # The pinned v5p-16 is exactly hosts w0-w3.
+    assert nodes == {"v5p64-w0", "v5p64-w1", "v5p64-w2", "v5p64-w3"}
+
+
+def test_opportunistic_and_guaranteed_preemption():
+    sim = Sim()
+    # Fill both v5e-16 slices with an opportunistic gang (32 chips).
+    group_o = {"name": "opp", "members": [{"podNumber": 8, "leafCellNumber": 4}]}
+    opp_pods = [
+        make_pod(f"opp-{i}", f"ou{i}", "VC2", -1, "v5e-chip", 4, group_o)
+        for i in range(8)
+    ]
+    for p in opp_pods:
+        sim.schedule_and_bind(p)
+
+    # A guaranteed VC1 pod now needs preemption: Filtering phase only reports
+    # victims; Preempting phase commits the preemption.
+    gpod = make_pod("guar-0", "gu0", "VC1", 1, "v5e-chip", 4)
+    r = sim.schedule(gpod, SchedulingPhase.FILTERING)
+    assert r.pod_preempt_info is not None
+    # Filtering phase never commits preemption state.
+    assert "default/guar-0" not in sim.core.affinity_groups
+    r = sim.schedule(gpod, SchedulingPhase.PREEMPTING)
+    assert r.pod_preempt_info is not None
+    g = sim.core.affinity_groups["default/guar-0"]
+    assert g.state == GroupState.PREEMPTING
+    # The opportunistic group is now being preempted; its cells Reserving.
+    assert sim.core.affinity_groups["opp"].state == GroupState.BEING_PREEMPTED
+
+    # Victims get deleted (K8s kills the whole gang; HiveD releases cells).
+    for p in opp_pods:
+        sim.delete(p)
+    assert "opp" not in sim.core.affinity_groups
+    # Preemptor pod comes back through filter: victims gone -> bind.
+    r = sim.schedule(gpod, SchedulingPhase.FILTERING)
+    assert r.pod_bind_info is not None
+    sim.bind(gpod, r)
+    assert sim.core.affinity_groups["default/guar-0"].state == GroupState.ALLOCATED
+
+
+def test_preemption_cancellation_returns_cells():
+    sim = Sim()
+    # An allocated guaranteed group at priority 1 on one v5e-16.
+    group_low = {"name": "low", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    low_pods = [
+        make_pod(f"low-{i}", f"lu{i}", "VC1", 1, "v5e-chip", 4, group_low)
+        for i in range(4)
+    ]
+    for p in low_pods:
+        sim.schedule_and_bind(p)
+
+    # VC1's quota is just 1 v5e-16, so a higher-priority VC1 job must preempt.
+    group_high = {"name": "high", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    high_pods = [
+        make_pod(f"high-{i}", f"hu{i}", "VC1", 5, "v5e-chip", 4, group_high)
+        for i in range(4)
+    ]
+    r = sim.schedule(high_pods[0], SchedulingPhase.PREEMPTING)
+    assert r.pod_preempt_info is not None
+    assert sim.core.affinity_groups["high"].state == GroupState.PREEMPTING
+    assert sim.core.affinity_groups["low"].state == GroupState.BEING_PREEMPTED
+
+    # The preemptor pod dies before preemption completes -> cancellation:
+    # cells return to the being-preempted group.
+    sim.core.delete_unallocated_pod(high_pods[0])
+    assert "high" not in sim.core.affinity_groups
+    low = sim.core.affinity_groups["low"]
+    for pod_placements in low.physical_placement.values():
+        for pp in pod_placements:
+            for leaf in pp:
+                assert leaf.state == CellState.USED
+                assert leaf.using_group is low
+
+
+def test_preemptor_preempts_preemptor():
+    sim = Sim()
+    group_o = {"name": "opp", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    opp_pods = [
+        make_pod(f"opp-{i}", f"ou{i}", "VC1", -1, "v5e-chip", 4, group_o)
+        for i in range(4)
+    ]
+    for p in opp_pods:
+        sim.schedule_and_bind(p)
+    # Fill the second v5e-16 too so preemptors must overlap with "opp".
+    group_o2 = {"name": "opp2", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    for i in range(4):
+        sim.schedule_and_bind(
+            make_pod(f"opp2-{i}", f"o2u{i}", "VC2", -1, "v5e-chip", 4, group_o2)
+        )
+
+    # Preemptor A (VC1, priority 2) reserves the cells of one slice.
+    pa = make_pod("pa-0", "pau0", "VC1", 2, "v5e-chip", 4,
+                  {"name": "A", "members": [{"podNumber": 4, "leafCellNumber": 4}]})
+    r = sim.schedule(pa, SchedulingPhase.PREEMPTING)
+    assert r.pod_preempt_info is not None
+    assert sim.core.affinity_groups["A"].state == GroupState.PREEMPTING
+
+    # Preemptor B (VC1, priority 9) overlaps A -> A's preemption is canceled.
+    pb = make_pod("pb-0", "pbu0", "VC1", 9, "v5e-chip", 4,
+                  {"name": "B", "members": [{"podNumber": 4, "leafCellNumber": 4}]})
+    r = sim.schedule(pb, SchedulingPhase.PREEMPTING)
+    assert r.pod_preempt_info is not None
+    assert "A" not in sim.core.affinity_groups
+    assert sim.core.affinity_groups["B"].state == GroupState.PREEMPTING
+
+
+def test_lazy_preemption():
+    sim = Sim()
+    # A lazy-preemptable guaranteed group fills VC1's v5e-16 quota.
+    group_l = {"name": "lazy", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    lazy_pods = [
+        make_pod(f"lz-{i}", f"zu{i}", "VC1", 0, "v5e-chip", 4, group_l,
+                 lazy_preemption=True)
+        for i in range(4)
+    ]
+    for p in lazy_pods:
+        sim.schedule_and_bind(p)
+    # A higher-priority VC1 job arrives; instead of hard preemption, the lazy
+    # group is downgraded to opportunistic and the new job takes the quota.
+    hp = make_pod("hp-0", "hpu0", "VC1", 5, "v5e-chip", 4)
+    r = sim.schedule(hp, SchedulingPhase.FILTERING)
+    assert r.pod_bind_info is not None
+    sim.bind(hp, r)
+    lazy = sim.core.affinity_groups["lazy"]
+    assert lazy.virtual_placement is None
+    assert lazy.lazy_preemption_status["preemptor"] == "default/hp-0"
+    g = sim.core.get_affinity_group("lazy")
+    assert g["status"]["lazyPreemptionStatus"] is not None
+
+
+def doomed_num(core, chain):
+    return sum(core.all_vc_doomed_bad_cell_num.get(chain, {}).values())
+
+
+def test_bad_node_avoidance_and_doomed_cells():
+    sim = Sim()
+    # One bad slice: each VC individually still fits the healthy slice, so
+    # no cell is doomed (the check is per-VC, not global)
+    # (reference: hived_algorithm.go:604-630).
+    for i in range(4):
+        sim.core.set_bad_node(f"v5e16a-w{i}")
+    assert doomed_num(sim.core, "v5e-16") == 0
+    # A guaranteed pod avoids the bad slice.
+    bp = sim.schedule_and_bind(make_pod("ok-0", "oku0", "VC1", 0, "v5e-chip", 4))
+    assert bp.node_name.startswith("v5e16b")
+    sim.delete(make_pod("ok-0", "oku0", "VC1", 0, "v5e-chip", 4))
+
+    # Both slices bad: each VC's free v5e-16 is now doomed and bound to a bad
+    # physical cell, visible to intra-VC scheduling and the inspect API.
+    for i in range(4):
+        sim.core.set_bad_node(f"v5e16b-w{i}")
+    assert doomed_num(sim.core, "v5e-16") == 2
+    r = sim.schedule(make_pod("w-0", "wu0", "VC1", 0, "v5e-chip", 4))
+    assert r.pod_wait_info is not None
+    # One slice recovers: freed capacity un-dooms BOTH cells (each VC
+    # individually fits again; the check is per-VC).
+    for i in range(4):
+        sim.core.set_healthy_node(f"v5e16a-w{i}")
+    assert doomed_num(sim.core, "v5e-16") == 0
+    bp = sim.schedule_and_bind(make_pod("ok-1", "oku1", "VC1", 0, "v5e-chip", 4))
+    assert bp.node_name.startswith("v5e16a")
+    for i in range(4):
+        sim.core.set_healthy_node(f"v5e16b-w{i}")
+    assert doomed_num(sim.core, "v5e-16") == 0
+
+
+def test_safe_relaxed_buddy_alloc_under_bad_nodes():
+    sim = Sim()
+    # VC2 owns one v5p-16. Make hosts of the first TWO v5p-16 sub-cells bad
+    # after the cube is still whole: buddy alloc at level 4 would pick a bad
+    # cell, so the relaxed path splits the remaining healthy capacity.
+    for w in range(4):
+        sim.core.set_bad_node(f"v5p64-w{w}")
+    # VC2's v5p-16 job should still get a healthy placement (w4-w15).
+    group = {"name": "v2job", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    nodes = set()
+    for i in range(4):
+        bp = sim.schedule_and_bind(
+            make_pod(f"v2-{i}", f"v2u{i}", "VC2", 1, "v5p-chip", 4, group)
+        )
+        nodes.add(bp.node_name)
+    assert all(int(n.split("w")[1]) >= 4 for n in nodes)
+
+
+def test_suggested_nodes_fail_filtering():
+    sim = Sim()
+    # With ignoreK8sSuggestedNodes=False and suggested nodes excluding all
+    # v5e nodes, the pod must wait.
+    pod = make_pod("sg-0", "sgu0", "VC1", 0, "v5e-chip", 4,
+                   ignore_suggested=False)
+    r = sim.schedule(pod, suggested=["cpu-0", "cpu-1"])
+    assert r.pod_wait_info is not None
+    # With suggested covering slice b, placement lands there.
+    r = sim.schedule(pod, suggested=[f"v5e16b-w{i}" for i in range(4)])
+    assert r.pod_bind_info is not None
+    assert r.pod_bind_info.node.startswith("v5e16b")
+
+
+def test_cross_vc_isolation(sim):
+    # VC2's quota must be respected independently: both VCs can hold a
+    # v5e-16 concurrently (2 slices exist).
+    g1 = {"name": "vc1g", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    g2 = {"name": "vc2g", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    for i in range(4):
+        sim.schedule_and_bind(
+            make_pod(f"a-{i}", f"au{i}", "VC1", 0, "v5e-chip", 4, g1)
+        )
+    for i in range(4):
+        sim.schedule_and_bind(
+            make_pod(f"b-{i}", f"bu{i}", "VC2", 0, "v5e-chip", 4, g2)
+        )
+    n1 = set(sim.core.get_affinity_group("vc1g")["status"]["physicalPlacement"])
+    n2 = set(sim.core.get_affinity_group("vc2g")["status"]["physicalPlacement"])
+    assert not (n1 & n2)
+
+
+def test_work_preserving_reconfiguration():
+    sim = Sim()
+    # Allocate a v5e-16 gang in VC1 and a CPU pod in VC2.
+    g1 = {"name": "keepme", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    pods = [
+        make_pod(f"k-{i}", f"ku{i}", "VC1", 0, "v5e-chip", 4, g1)
+        for i in range(4)
+    ]
+    bound = [sim.schedule_and_bind(p) for p in pods]
+
+    # Restart with a config where VC1's v5e-16 quota MOVED to VC2:
+    # replaying the pods should keep them running but lazy-preempt the group
+    # (its VC can no longer hold it).
+    cfg = tpu_design_config()
+    cfg.virtual_clusters["VC1"].virtual_cells = [
+        c for c in cfg.virtual_clusters["VC1"].virtual_cells
+        if c.cell_type != "v5e-16"
+    ]
+    cfg.virtual_clusters["VC2"].virtual_cells.append(
+        api.VirtualCellSpec(cell_number=1, cell_type="v5e-16")
+    )
+    sim2 = Sim(cfg)
+    for bp in bound:
+        sim2.core.add_allocated_pod(bp)
+    g = sim2.core.affinity_groups["keepme"]
+    assert g.state == GroupState.ALLOCATED  # still running (work preserved)
+    assert g.virtual_placement is None  # but lazy preempted out of the VC
+    assert g.lazy_preemption_status is not None
+    # Same-config restart preserves the virtual placement (no lazy preempt).
+    sim3 = Sim()
+    for bp in bound:
+        sim3.core.add_allocated_pod(bp)
+    g3 = sim3.core.affinity_groups["keepme"]
+    assert g3.virtual_placement is not None
+    assert g3.lazy_preemption_status is None
+
+
+def test_recovery_replays_placement_exactly():
+    sim = Sim()
+    pod = make_pod("r-0", "ru0", "VC1", 0, "v5e-chip", 4)
+    bp = sim.schedule_and_bind(pod)
+    info_before = extract_pod_bind_info(bp)
+
+    sim2 = Sim()
+    sim2.core.add_allocated_pod(bp)
+    g = sim2.core.get_affinity_group("default/r-0")
+    assert g["status"]["physicalPlacement"] == {
+        info_before.node: info_before.leaf_cell_isolation
+    }
+    # The exact leaf cells are Used in the new instance.
+    chain = info_before.cell_chain
+    for leaf in sim2.core.full_cell_list[chain][1]:
+        if (
+            leaf.nodes[0] == info_before.node
+            and leaf.leaf_cell_indices[0] in info_before.leaf_cell_isolation
+        ):
+            assert leaf.state == CellState.USED
+
+
+def test_inspect_statuses(sim):
+    pod = make_pod("i-0", "iu0", "VC1", 3, "v5e-chip", 4)
+    sim.schedule_and_bind(pod)
+    status = sim.core.get_cluster_status()
+    assert "physicalCluster" in status and "virtualClusters" in status
+    # Find the used physical cells and check mirrored state/priority.
+    pc_status = status["physicalCluster"]
+    used = []
+
+    def walk(cells):
+        for c in cells:
+            if c.get("cellState") == "Used" and not c.get("cellChildren"):
+                used.append(c)
+            walk(c.get("cellChildren", []))
+
+    walk(pc_status)
+    assert len(used) == 4
+    assert all(c["cellPriority"] == 3 for c in used)
+    assert all(c["vc"] == "VC1" for c in used)
+    # Opportunistic pod shows up as a fake OT cell in the VC status.
+    opod = make_pod("o-0", "olu0", "VC2", -1, "v5p-chip", 4)
+    sim.schedule_and_bind(opod)
+    vc2 = sim.core.get_virtual_cluster_status("VC2")
+    ot = [c for c in vc2 if c["cellAddress"].endswith("-opp")]
+    assert len(ot) == 4 and all(c["cellPriority"] == -1 for c in ot)
+    sim.delete(opod)
+    vc2 = sim.core.get_virtual_cluster_status("VC2")
+    assert not [c for c in vc2 if c["cellAddress"].endswith("-opp")]
