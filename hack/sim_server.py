@@ -1,0 +1,67 @@
+"""Launch a real hivedscheduler-tpu server over a small simulated v5e cluster.
+
+Stands in for the informer loop: node events are injected from the config;
+pod events arrive over a tiny side endpoint is NOT implemented — instead pods
+are pre-informed here (two waiting pods), exactly what the pod informer would
+deliver before the default scheduler calls filter.
+"""
+import sys, yaml
+
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]))
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.api import constants
+from hivedscheduler_tpu.api.config import Config
+from hivedscheduler_tpu.scheduler.framework import HivedScheduler, NullKubeClient
+from hivedscheduler_tpu.scheduler.types import Node, Pod
+from hivedscheduler_tpu.webserver.server import WebServer
+
+common.init_logging()
+
+config = Config.from_dict({
+    "webServerAddress": "127.0.0.1:9096",
+    "physicalCluster": {
+        "cellTypes": {
+            "v5e-2chip": {"childCellType": "v5e-chip", "childCellNumber": 2},
+            "v5e-host": {"childCellType": "v5e-2chip", "childCellNumber": 2,
+                          "isNodeLevel": True},
+            "v5e-16": {"childCellType": "v5e-host", "childCellNumber": 4},
+        },
+        "physicalCells": [
+            {"cellType": "v5e-16",
+             "cellChildren": [{"cellAddress": f"tpu-w{i}"} for i in range(4)]},
+        ],
+    },
+    "virtualClusters": {
+        "vc-research": {"virtualCells": [{"cellType": "v5e-16.v5e-host",
+                                           "cellNumber": 4}]},
+    },
+})
+
+s = HivedScheduler(config, kube_client=NullKubeClient())
+for i in range(4):
+    s.add_node(Node(name=f"tpu-w{i}"))
+
+def mk_pod(name, uid, leaf_num, group=None):
+    spec = {"virtualCluster": "vc-research", "priority": 1,
+            "leafCellType": "v5e-chip", "leafCellNumber": leaf_num}
+    if group:
+        spec["affinityGroup"] = group
+    return Pod(name=name, uid=uid,
+               annotations={constants.ANNOTATION_POD_SCHEDULING_SPEC:
+                            yaml.safe_dump(spec)},
+               resource_limits={constants.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})
+
+# A 2-pod gang (8 chips over 2 hosts) + a singleton (4 chips).
+gang = {"name": "bert-gang", "members": [{"podNumber": 2, "leafCellNumber": 4}]}
+for pod in [mk_pod("bert-0", "uid-bert-0", 4, gang),
+            mk_pod("bert-1", "uid-bert-1", 4, gang),
+            mk_pod("solo-0", "uid-solo-0", 4)]:
+    s.add_pod(pod)
+
+ws = WebServer(s)
+ws.start()
+print("READY", flush=True)
+import time
+while True:
+    time.sleep(60)

@@ -1724,9 +1724,10 @@ class HivedCore:
         """Generated on demand by walking the physical trees (the reference
         maintains mirrored apiStatus objects instead,
         hived_algorithm.go:412-437)."""
+        ot_vc_map = self._ot_cell_vc_by_address()
         return [
             self._physical_cell_status(
-                c, leaf_type=self.chain_to_leaf_type.get(chain)
+                c, leaf_type=self.chain_to_leaf_type.get(chain), ot_vc_map=ot_vc_map
             )
             for chain, ccl in self.full_cell_list.items()
             for c in ccl[ccl.top_level]
@@ -1773,11 +1774,20 @@ class HivedCore:
             )
         return out
 
+    def _ot_cell_vc_by_address(self) -> Dict[str, api.VirtualClusterName]:
+        """address -> VC for synthesized opportunistic virtual cells."""
+        return {
+            oc.address: vcn
+            for vcn, ocs in self._ot_cells.items()
+            for oc in ocs
+        }
+
     def _physical_cell_status(
         self,
         c: PhysicalCell,
         leaf_type: Optional[str] = None,
         shallow: bool = False,
+        ot_vc_map: Optional[Dict[str, api.VirtualClusterName]] = None,
     ) -> Dict:
         d: Dict = {
             "cellType": c.cell_type,
@@ -1789,23 +1799,19 @@ class HivedCore:
         }
         if leaf_type:
             d["leafCellType"] = leaf_type
+        if ot_vc_map is None:
+            ot_vc_map = self._ot_cell_vc_by_address()
         if c.virtual_cell is not None:
             d["vc"] = c.virtual_cell.vc
-        elif any(
-            c.address == oc.address for ocs in self._ot_cells.values() for oc in ocs
-        ):
-            d["vc"] = next(
-                vcn
-                for vcn, ocs in self._ot_cells.items()
-                if any(c.address == oc.address for oc in ocs)
-            )
+        elif c.address in ot_vc_map:
+            d["vc"] = ot_vc_map[c.address]
         if shallow:
             return d
         if c.virtual_cell is not None:
             d["virtualCell"] = self._virtual_cell_status(c.virtual_cell, shallow=True)
         if c.children:
             d["cellChildren"] = [
-                self._physical_cell_status(child)
+                self._physical_cell_status(child, ot_vc_map=ot_vc_map)
                 for child in c.children
                 if isinstance(child, PhysicalCell)
             ]

@@ -50,8 +50,14 @@ class AffinityGroup:
         self.vc = vc
         self.lazy_preemption_enable = lazy_preemption_enable
         # Whether binding to non-suggested nodes is acceptable (bad nodes
-        # never are) (reference: types.go:139-141).
-        self.ignore_k8s_suggested_nodes = True
+        # never are). Always False at group level, matching the reference
+        # (types.go:139-141 is never assigned in newAlgoAffinityGroup): a
+        # PREEMPTING group whose reservation falls outside the current
+        # Preempting-phase candidate nodes must have its preemption canceled
+        # and rescheduled (hived_algorithm.go:692-702) — with True here that
+        # cancellation could never trigger and the preemptor would wait
+        # forever on victims the default scheduler will never preempt.
+        self.ignore_k8s_suggested_nodes = False
         self.priority = priority
         # leaf_cell_num -> pod count
         self.total_pod_nums: Dict[int, int] = {}

@@ -1,0 +1,243 @@
+"""K8s Scheduler Extender wire types.
+
+The default scheduler speaks the scheduler-extender HTTP protocol; these are
+the request/response DTOs for the filter/bind/preempt verbs, matching the
+upstream wire format (capitalized JSON keys) the reference consumes via its
+vendored ``k8s.io/kubernetes/pkg/scheduler/api`` package
+(reference: pkg/webserver/webserver.go:167-240 decodes/encodes these).
+
+Pods arrive as (a subset of) K8s Pod JSON; :func:`pod_from_k8s` projects that
+onto our internal :class:`~hivedscheduler_tpu.scheduler.types.Pod` the way the
+reference's ``internal.ToPod`` casts informer objects.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..scheduler.types import Pod
+
+
+def pod_from_k8s(obj: Dict[str, Any]) -> Pod:
+    """Project K8s Pod JSON onto the internal Pod model.
+
+    Reads metadata.{name,namespace,uid,annotations}, spec.nodeName,
+    status.phase, and the per-container extended-resource limits used by the
+    scheduling-enable gate (reference: pkg/internal/utils.go:115-140).
+    """
+    metadata = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    limits: Dict[str, int] = {}
+    for container in spec.get("containers") or []:
+        resources = (container.get("resources") or {}).get("limits") or {}
+        for name, quantity in resources.items():
+            try:
+                limits[name] = limits.get(name, 0) + int(quantity)
+            except (TypeError, ValueError):
+                continue
+    return Pod(
+        name=str(metadata.get("name", "") or ""),
+        namespace=str(metadata.get("namespace") or "default"),
+        uid=str(metadata.get("uid", "") or ""),
+        annotations={
+            str(k): str(v) for k, v in (metadata.get("annotations") or {}).items()
+        },
+        node_name=str(spec.get("nodeName", "") or ""),
+        phase=str(status.get("phase") or "Pending"),
+        resource_limits=limits,
+    )
+
+
+def pod_to_k8s(pod: Pod) -> Dict[str, Any]:
+    """Inverse of :func:`pod_from_k8s` (round-trips the fields we model)."""
+    return {
+        "metadata": {
+            "name": pod.name,
+            "namespace": pod.namespace,
+            "uid": pod.uid,
+            "annotations": dict(pod.annotations),
+        },
+        "spec": {
+            "nodeName": pod.node_name,
+            "containers": [
+                {
+                    "resources": {
+                        "limits": {k: v for k, v in pod.resource_limits.items()}
+                    }
+                }
+            ],
+        },
+        "status": {"phase": pod.phase},
+    }
+
+
+@dataclass
+class ExtenderArgs:
+    """POST body of /v1/extender/filter."""
+
+    pod: Pod
+    node_names: List[str] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ExtenderArgs":
+        return ExtenderArgs(
+            pod=pod_from_k8s(d.get("Pod") or {}),
+            node_names=[str(n) for n in (d.get("NodeNames") or [])],
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"Pod": pod_to_k8s(self.pod), "NodeNames": list(self.node_names)}
+
+
+@dataclass
+class ExtenderFilterResult:
+    """Response of /v1/extender/filter: either the nodes that fit, or a map
+    node->reason of nodes that failed (the reference also abuses FailedNodes
+    to surface wait reasons, scheduler.go:573-585)."""
+
+    node_names: Optional[List[str]] = None
+    failed_nodes: Dict[str, str] = field(default_factory=dict)
+    error: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "NodeNames": self.node_names,
+            "FailedNodes": dict(self.failed_nodes),
+            "Error": self.error,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ExtenderFilterResult":
+        return ExtenderFilterResult(
+            node_names=(
+                [str(n) for n in d["NodeNames"]] if d.get("NodeNames") is not None
+                else None
+            ),
+            failed_nodes={
+                str(k): str(v) for k, v in (d.get("FailedNodes") or {}).items()
+            },
+            error=str(d.get("Error", "") or ""),
+        )
+
+
+@dataclass
+class ExtenderBindingArgs:
+    """POST body of /v1/extender/bind."""
+
+    pod_name: str = ""
+    pod_namespace: str = "default"
+    pod_uid: str = ""
+    node: str = ""
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ExtenderBindingArgs":
+        return ExtenderBindingArgs(
+            pod_name=str(d.get("PodName", "") or ""),
+            pod_namespace=str(d.get("PodNamespace") or "default"),
+            pod_uid=str(d.get("PodUID", "") or ""),
+            node=str(d.get("Node", "") or ""),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "PodName": self.pod_name,
+            "PodNamespace": self.pod_namespace,
+            "PodUID": self.pod_uid,
+            "Node": self.node,
+        }
+
+
+@dataclass
+class ExtenderBindingResult:
+    error: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"Error": self.error}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ExtenderBindingResult":
+        return ExtenderBindingResult(error=str(d.get("Error", "") or ""))
+
+
+@dataclass
+class MetaPod:
+    uid: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"UID": self.uid}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "MetaPod":
+        return MetaPod(uid=str(d.get("UID", "") or ""))
+
+
+@dataclass
+class MetaVictims:
+    pods: List[MetaPod] = field(default_factory=list)
+    num_pdb_violations: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "Pods": [p.to_dict() for p in self.pods],
+            "NumPDBViolations": self.num_pdb_violations,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "MetaVictims":
+        return MetaVictims(
+            pods=[MetaPod.from_dict(p) for p in (d.get("Pods") or [])],
+            num_pdb_violations=int(d.get("NumPDBViolations") or 0),
+        )
+
+
+@dataclass
+class ExtenderPreemptionArgs:
+    """POST body of /v1/extender/preempt. The default scheduler proposes
+    candidate victims per node; the extender answers with the victims it
+    actually needs (reference: scheduler.go:629-721)."""
+
+    pod: Pod = field(default_factory=lambda: Pod(name=""))
+    node_name_to_meta_victims: Dict[str, MetaVictims] = field(default_factory=dict)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ExtenderPreemptionArgs":
+        return ExtenderPreemptionArgs(
+            pod=pod_from_k8s(d.get("Pod") or {}),
+            node_name_to_meta_victims={
+                str(node): MetaVictims.from_dict(v)
+                for node, v in (d.get("NodeNameToMetaVictims") or {}).items()
+            },
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "Pod": pod_to_k8s(self.pod),
+            "NodeNameToMetaVictims": {
+                node: v.to_dict()
+                for node, v in self.node_name_to_meta_victims.items()
+            },
+        }
+
+
+@dataclass
+class ExtenderPreemptionResult:
+    node_name_to_meta_victims: Dict[str, MetaVictims] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "NodeNameToMetaVictims": {
+                node: v.to_dict()
+                for node, v in self.node_name_to_meta_victims.items()
+            }
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ExtenderPreemptionResult":
+        return ExtenderPreemptionResult(
+            node_name_to_meta_victims={
+                str(node): MetaVictims.from_dict(v)
+                for node, v in (d.get("NodeNameToMetaVictims") or {}).items()
+            }
+        )

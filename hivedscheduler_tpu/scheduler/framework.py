@@ -1,0 +1,541 @@
+"""The scheduling framework: the bridge between K8s and the core algorithm.
+
+Python equivalent of the reference's ``pkg/scheduler/scheduler.go`` (L53-745):
+it owns the pod-schedule-status map (the ground truth of the scheduling view),
+serializes all scheduling under one lock, executes the assume-bind trick on
+the filter path, insists on previous binds, force-binds when the default
+scheduler stalls, and replays bound pods at startup for crash recovery.
+
+Instead of client-go informers, the framework exposes plain event-handler
+methods (``add_pod``/``update_pod``/``delete_pod``, ``add_node``/...) that an
+informer loop (``scheduler.informer``), a test harness, or a simulator drives
+— the same seam the reference's test suite exploits
+(hived_algorithm_test.go:41-64).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional
+
+from .. import common
+from ..api import constants, extender as ei, types as api
+from ..api.config import Config
+from ..algorithm.core import HivedCore
+from .types import (
+    Node,
+    Pod,
+    PodScheduleResult,
+    PodScheduleStatus,
+    PodState,
+    SchedulingPhase,
+    is_allocated_state,
+    is_bound,
+    is_interested,
+    new_binding_pod,
+)
+
+
+class KubeClient:
+    """The thin slice of the K8s API the framework writes through: pod binds.
+
+    Production deployments plug in :class:`~hivedscheduler_tpu.scheduler.kube.
+    KubeAPIClient`; tests plug in a fake that records binds. Reads go through
+    the framework's own node/pod caches (the reference reads via listers,
+    writes via kClient; scheduler.go:57-95).
+    """
+
+    def bind_pod(self, binding_pod: Pod) -> None:
+        """Write the binding (target node + annotations) to the cluster
+        (reference: internal/utils.go:291-314 ``BindPod``)."""
+        raise NotImplementedError
+
+
+class NullKubeClient(KubeClient):
+    """A no-op client for simulations: binds are recorded, not executed."""
+
+    def __init__(self) -> None:
+        self.bound_pods: List[Pod] = []
+
+    def bind_pod(self, binding_pod: Pod) -> None:
+        self.bound_pods.append(binding_pod)
+
+
+class SchedulerMetrics:
+    """Minimal latency metrics (SURVEY.md §5 build note: the reference has
+    none; the north-star metric is gang-schedule p50 latency)."""
+
+    # Ring of the most recent samples: bounded memory, and the per-scrape
+    # percentile sort stays O(window log window) no matter the uptime.
+    WINDOW = 4096
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.filter_latencies_s: List[float] = []
+        self._next_slot = 0
+        self.filter_count = 0
+        self.bind_count = 0
+        self.preempt_count = 0
+        self.wait_count = 0
+
+    def observe_filter(self, seconds: float, outcome: str) -> None:
+        with self._lock:
+            self.filter_count += 1
+            if len(self.filter_latencies_s) < self.WINDOW:
+                self.filter_latencies_s.append(seconds)
+            else:
+                self.filter_latencies_s[self._next_slot] = seconds
+                self._next_slot = (self._next_slot + 1) % self.WINDOW
+            if outcome == "bind":
+                self.bind_count += 1
+            elif outcome == "preempt":
+                self.preempt_count += 1
+            else:
+                self.wait_count += 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            lat = sorted(self.filter_latencies_s)
+            n = len(lat)
+
+            def pct(p: float) -> float:
+                if n == 0:
+                    return 0.0
+                return lat[min(n - 1, int(p * n))]
+
+            return {
+                "filterCount": self.filter_count,
+                "filterLatencyP50Ms": pct(0.50) * 1e3,
+                "filterLatencyP99Ms": pct(0.99) * 1e3,
+                "bindCount": self.bind_count,
+                "preemptCount": self.preempt_count,
+                "waitCount": self.wait_count,
+            }
+
+
+class HivedScheduler:
+    """(reference: pkg/scheduler/scheduler.go:53-120)"""
+
+    def __init__(
+        self,
+        config: Config,
+        kube_client: Optional[KubeClient] = None,
+        # Injectable executor for force binds; the default spawns a thread the
+        # way the reference spawns a goroutine (scheduler.go:505,533). Tests
+        # pass a synchronous executor for determinism.
+        force_bind_executor: Optional[Callable[[Callable[[], None]], None]] = None,
+    ) -> None:
+        self.config = config
+        self.kube_client = kube_client or NullKubeClient()
+        self.core = HivedCore(config)
+        self.metrics = SchedulerMetrics()
+        # One lock serializes scheduling and all state mutation; Schedule() is
+        # never executed concurrently (reference: scheduler.go:104-108).
+        self._lock = threading.RLock()
+        # uid -> PodScheduleStatus for all live hived pods
+        # (reference: scheduler.go:110-115).
+        self.pod_schedule_statuses: Dict[str, PodScheduleStatus] = {}
+        # Node cache standing in for the node lister (used by
+        # validate_pod_bind_info; reference: scheduler.go:385-421).
+        self.nodes: Dict[str, Node] = {}
+        self._spawn = force_bind_executor or self._default_executor
+
+    @staticmethod
+    def _default_executor(fn: Callable[[], None]) -> None:
+        threading.Thread(target=fn, daemon=True).start()
+
+    # ------------------------------------------------------------------ #
+    # Recovery (reference: scheduler.go:196-216 Run)
+    # ------------------------------------------------------------------ #
+
+    def recover(self, nodes: Iterable[Node], pods: Iterable[Pod]) -> None:
+        """Replay the current cluster state before serving requests: every
+        bound hived pod re-enters via add_pod -> add_bound_pod ->
+        AddAllocatedPod, rebuilding all cell state from annotations."""
+        for node in nodes:
+            self.add_node(node)
+        for pod in pods:
+            if is_interested(pod):
+                self.add_pod(pod)
+
+    # ------------------------------------------------------------------ #
+    # Node events (reference: scheduler.go:218-251)
+    # ------------------------------------------------------------------ #
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            self.nodes[node.name] = node
+            self.core.add_node(node)
+
+    def update_node(self, old: Node, new: Node) -> None:
+        with self._lock:
+            self.nodes[new.name] = new
+            self.core.update_node(old, new)
+
+    def delete_node(self, node: Node) -> None:
+        with self._lock:
+            self.nodes.pop(node.name, None)
+            self.core.delete_node(node)
+
+    # ------------------------------------------------------------------ #
+    # Pod events (reference: scheduler.go:253-360)
+    # ------------------------------------------------------------------ #
+
+    def add_pod(self, pod: Pod) -> None:
+        if not is_interested(pod):
+            return
+        if is_bound(pod):
+            self._add_bound_pod(pod)
+        else:
+            self._add_unbound_pod(pod)
+
+    def update_pod(self, old: Pod, new: Pod) -> None:
+        # An informer may deliver an Update with UID changed when a delete is
+        # immediately followed by a create (reference: scheduler.go:265-271).
+        if old.uid != new.uid:
+            self.delete_pod(old)
+            self.add_pod(new)
+            return
+        if not is_interested(new):
+            # Completed pods leave the scheduling view.
+            if is_interested(old) or new.uid in self.pod_schedule_statuses:
+                self.delete_pod(new)
+            return
+        old_bound, new_bound = is_bound(old), is_bound(new)
+        if not old_bound and new_bound:
+            self._add_bound_pod(new)
+        elif old_bound and not new_bound:
+            raise AssertionError(
+                f"[{new.key}]: Pod updated from bound to unbound: "
+                f"previous bound node: {old.node_name}"
+            )
+
+    def delete_pod(self, pod: Pod) -> None:
+        with self._lock:
+            status = self.pod_schedule_statuses.get(pod.uid)
+            if status is None:
+                return
+            if is_allocated_state(status.pod_state):
+                self.core.delete_allocated_pod(status.pod)
+            else:
+                self.core.delete_unallocated_pod(status.pod)
+            del self.pod_schedule_statuses[pod.uid]
+
+    def _add_bound_pod(self, pod: Pod) -> None:
+        with self._lock:
+            status = self.pod_schedule_statuses.get(pod.uid)
+            if status is not None and is_allocated_state(status.pod_state):
+                # Already allocated (assume-bind): the placement never changes
+                # again; just confirm Bound (reference: scheduler.go:314-328).
+                if status.pod_state != PodState.BOUND:
+                    self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                        pod=status.pod, pod_state=PodState.BOUND
+                    )
+                return
+            # Recovery of a pod bound before we started.
+            self.core.add_allocated_pod(pod)
+            self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                pod=pod, pod_state=PodState.BOUND
+            )
+
+    def _add_unbound_pod(self, pod: Pod) -> None:
+        with self._lock:
+            if pod.uid in self.pod_schedule_statuses:
+                return
+            self.core.add_unallocated_pod(pod)
+            self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                pod=pod, pod_state=PodState.WAITING
+            )
+
+    # ------------------------------------------------------------------ #
+    # Admission + bind validation (reference: scheduler.go:362-466)
+    # ------------------------------------------------------------------ #
+
+    def _admission_check(self, uid: str) -> PodScheduleStatus:
+        """Only live unbound hived pods may be scheduled
+        (reference: scheduler.go:364-383)."""
+        status = self.pod_schedule_statuses.get(uid)
+        if status is None:
+            raise api.bad_request(
+                "Pod does not exist, completed or has not been informed to "
+                "the scheduler"
+            )
+        if status.pod_state == PodState.BOUND:
+            raise api.bad_request(
+                f"Pod has already been bound to node {status.pod.node_name}"
+            )
+        return status
+
+    def _validate_pod_bind_info(
+        self, bind_info: api.PodBindInfo, suggested_nodes: List[str]
+    ) -> Optional[str]:
+        """Detect a probably-stale decision: target node gone, or outside the
+        default scheduler's suggestions (reference: scheduler.go:385-421)."""
+        node = bind_info.node
+        if node not in self.nodes:
+            return (
+                f"The scheduling algorithm decided to bind on node {node}, but "
+                f"the node does not exist or has not been informed to the "
+                f"scheduler"
+            )
+        if node not in suggested_nodes:
+            return (
+                f"The scheduling algorithm decided to bind on node {node} but "
+                f"the node is not within the selected nodes from the K8s "
+                f"default scheduler"
+            )
+        return None
+
+    def _should_force_bind(
+        self, status: PodScheduleStatus, suggested_nodes: List[str]
+    ) -> bool:
+        """Keep binding regardless of potentially-stale decisions: after
+        enough failed attempts, or as soon as the decision looks invalid,
+        bypass the default scheduler (reference: scheduler.go:423-466; the
+        long comment there argues why insisting is safe: a truly-bad bind
+        fails the pod naturally and K8s retries it)."""
+        if status.pod_bind_attempts >= self.config.force_pod_bind_threshold:
+            common.log.warning(
+                "[%s]: Will force bind Pod: binding tried %d times, reaching "
+                "ForcePodBindThreshold %d",
+                status.pod.key,
+                status.pod_bind_attempts,
+                self.config.force_pod_bind_threshold,
+            )
+            return True
+        assert status.pod_schedule_result is not None
+        bind_info = status.pod_schedule_result.pod_bind_info
+        assert bind_info is not None
+        err = self._validate_pod_bind_info(bind_info, suggested_nodes)
+        if err is not None:
+            common.log.warning("[%s]: Will force bind Pod: %s", status.pod.key, err)
+            return True
+        return False
+
+    def _force_bind(self, binding_pod: Pod) -> None:
+        """Shadow of bind_routine bypassing the default scheduler
+        (reference: scheduler.go:471-483)."""
+        try:
+            self.bind_routine(
+                ei.ExtenderBindingArgs(
+                    pod_name=binding_pod.name,
+                    pod_namespace=binding_pod.namespace,
+                    pod_uid=binding_pod.uid,
+                    node=binding_pod.node_name,
+                )
+            )
+        except api.WebServerError as e:
+            # One force-bind failure is ignorable; it will be retried on the
+            # next filter round (reference: HandleWebServerPanic).
+            common.log.warning(
+                "[%s]: forceBindExecutor: %s", binding_pod.key, e
+            )
+
+    # ------------------------------------------------------------------ #
+    # Filter (reference: scheduler.go:485-587)
+    # ------------------------------------------------------------------ #
+
+    def filter_routine(self, args: ei.ExtenderArgs) -> ei.ExtenderFilterResult:
+        start = time.monotonic()
+        with self._lock:
+            result, outcome = self._filter_locked(args)
+        self.metrics.observe_filter(time.monotonic() - start, outcome)
+        return result
+
+    def _filter_locked(self, args):
+        pod = args.pod
+        suggested_nodes = args.node_names
+
+        status = self._admission_check(pod.uid)
+        if status.pod_state == PodState.BINDING:
+            # Insist on the previous bind result: binding is idempotent and
+            # the algorithm has already assumed it allocated
+            # (reference: scheduler.go:497-510).
+            binding_pod = status.pod
+            status.pod_bind_attempts += 1
+            if self._should_force_bind(status, suggested_nodes):
+                self._spawn(lambda: self._force_bind(binding_pod))
+            return (
+                ei.ExtenderFilterResult(node_names=[binding_pod.node_name]),
+                "bind",
+            )
+
+        # podState is Waiting or Preempting: carry out a new scheduling.
+        result = self.core.schedule(pod, suggested_nodes, SchedulingPhase.FILTERING)
+
+        if result.pod_bind_info is not None:
+            binding_pod = new_binding_pod(pod, result.pod_bind_info)
+            # Assume-bind: mark allocated NOW so the next pod schedules
+            # against updated state without waiting for the K8s bind
+            # round-trip (reference: scheduler.go:518-530).
+            self.core.add_allocated_pod(binding_pod)
+            new_status = PodScheduleStatus(
+                pod=binding_pod,
+                pod_state=PodState.BINDING,
+                pod_schedule_result=result,
+            )
+            self.pod_schedule_statuses[pod.uid] = new_status
+            if self._should_force_bind(new_status, suggested_nodes):
+                self._spawn(lambda: self._force_bind(binding_pod))
+            common.log.info("[%s]: Pod is binding to %s", pod.key, binding_pod.node_name)
+            return (
+                ei.ExtenderFilterResult(node_names=[binding_pod.node_name]),
+                "bind",
+            )
+
+        if result.pod_preempt_info is not None:
+            # FailedNodes tell the default scheduler preemption may help
+            # (reference: scheduler.go:540-559).
+            failed_nodes: Dict[str, str] = {}
+            for victim in result.pod_preempt_info.victim_pods:
+                node = victim.node_name
+                if node not in failed_nodes:
+                    failed_nodes[node] = (
+                        f"node({node}) has preemptible Pods: {victim.key}"
+                    )
+                else:
+                    failed_nodes[node] += ", " + victim.key
+            common.log.info(
+                "[%s]: Pod is waiting for preemptRoutine: %s", pod.key, failed_nodes
+            )
+            return ei.ExtenderFilterResult(failed_nodes=failed_nodes), "preempt"
+
+        self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+            pod=pod, pod_state=PodState.WAITING, pod_schedule_result=result
+        )
+        # Optionally block to achieve better FIFO (reference: scheduler.go:567-571).
+        if self.config.waiting_pod_scheduling_block_ms > 0:
+            time.sleep(self.config.waiting_pod_scheduling_block_ms / 1e3)
+        wait_reason = "Pod is waiting for preemptible or free resource to appear"
+        if result.pod_wait_info is not None and result.pod_wait_info.reason:
+            wait_reason += ": " + result.pod_wait_info.reason
+        common.log.info("[%s]: %s", pod.key, wait_reason)
+        # Fake FailedNodes expose the wait reason alongside the default
+        # scheduler's own reasons (reference: scheduler.go:573-585).
+        return (
+            ei.ExtenderFilterResult(
+                failed_nodes={constants.COMPONENT_NAME: wait_reason}
+            ),
+            "wait",
+        )
+
+    # ------------------------------------------------------------------ #
+    # Bind (reference: scheduler.go:589-627)
+    # ------------------------------------------------------------------ #
+
+    def bind_routine(self, args: ei.ExtenderBindingArgs) -> ei.ExtenderBindingResult:
+        """Idempotent: may be called multiple times for the same pod; once a
+        pod is allocated its placement never changes."""
+        with self._lock:
+            status = self._admission_check(args.pod_uid)
+            if status.pod_state == PodState.BINDING:
+                binding_pod = status.pod
+                if binding_pod.node_name != args.node:
+                    raise api.bad_request(
+                        f"Pod binding node mismatch: expected "
+                        f"{binding_pod.node_name}, received {args.node}"
+                    )
+                self.kube_client.bind_pod(binding_pod)
+                return ei.ExtenderBindingResult()
+            raise api.bad_request(
+                f"Pod cannot be bound without a scheduling placement: Pod "
+                f"current scheduling state {status.pod_state.value}, received "
+                f"node {args.node}"
+            )
+
+    # ------------------------------------------------------------------ #
+    # Preempt (reference: scheduler.go:629-721)
+    # ------------------------------------------------------------------ #
+
+    def preempt_routine(
+        self, args: ei.ExtenderPreemptionArgs
+    ) -> ei.ExtenderPreemptionResult:
+        with self._lock:
+            pod = args.pod
+            # In the Preempting phase the candidate nodes are those where the
+            # default scheduler found lower-priority victims.
+            suggested_nodes = list(args.node_name_to_meta_victims.keys())
+
+            status = self._admission_check(pod.uid)
+            if status.pod_state == PodState.BINDING:
+                raise api.bad_request(
+                    f"Pod has already been binding to node {status.pod.node_name}"
+                )
+
+            # Whether Waiting or Preempting, schedule afresh: a previous
+            # preemption result may be stale (reference: scheduler.go:655-668).
+            result = self.core.schedule(
+                pod, suggested_nodes, SchedulingPhase.PREEMPTING
+            )
+
+            if result.pod_bind_info is not None:
+                # Free resource appeared; the pod will bind via the filter
+                # path (the algorithm does NOT assume-bind in this phase).
+                common.log.info(
+                    "[%s]: Pod is waiting for filterRoutine as free resource "
+                    "appeared",
+                    pod.key,
+                )
+                return ei.ExtenderPreemptionResult()
+
+            if result.pod_preempt_info is not None:
+                self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                    pod=pod,
+                    pod_state=PodState.PREEMPTING,
+                    pod_schedule_result=result,
+                )
+                nodes_victims: Dict[str, ei.MetaVictims] = {}
+                for victim in result.pod_preempt_info.victim_pods:
+                    node = victim.node_name
+                    nodes_victims.setdefault(node, ei.MetaVictims()).pods.append(
+                        ei.MetaPod(uid=victim.uid)
+                    )
+                common.log.info(
+                    "[%s]: Pod is preempting victims on nodes %s",
+                    pod.key,
+                    sorted(nodes_victims),
+                )
+                return ei.ExtenderPreemptionResult(
+                    node_name_to_meta_victims=nodes_victims
+                )
+
+            self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                pod=pod, pod_state=PodState.WAITING, pod_schedule_result=result
+            )
+            wait_reason = "Pod is waiting for preemptible or free resource to appear"
+            if result.pod_wait_info is not None and result.pod_wait_info.reason:
+                wait_reason += ": " + result.pod_wait_info.reason
+            common.log.info("[%s]: %s", pod.key, wait_reason)
+            return ei.ExtenderPreemptionResult()
+
+    # ------------------------------------------------------------------ #
+    # Inspect delegates (reference: scheduler.go:723-745)
+    # ------------------------------------------------------------------ #
+
+    def get_all_affinity_groups(self) -> Dict:
+        with self._lock:
+            return self.core.get_all_affinity_groups()
+
+    def get_affinity_group(self, name: str) -> Dict:
+        with self._lock:
+            return self.core.get_affinity_group(name)
+
+    def get_cluster_status(self) -> Dict:
+        with self._lock:
+            return self.core.get_cluster_status()
+
+    def get_physical_cluster_status(self) -> List[Dict]:
+        with self._lock:
+            return self.core.get_physical_cluster_status()
+
+    def get_all_virtual_clusters_status(self) -> Dict[str, List[Dict]]:
+        with self._lock:
+            return self.core.get_all_virtual_clusters_status()
+
+    def get_virtual_cluster_status(self, vcn: str) -> List[Dict]:
+        with self._lock:
+            return self.core.get_virtual_cluster_status(vcn)
+
+    def get_metrics(self) -> Dict:
+        return self.metrics.snapshot()

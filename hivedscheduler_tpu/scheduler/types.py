@@ -182,10 +182,9 @@ def extract_pod_scheduling_spec(pod: Pod) -> api.PodSchedulingSpec:
     if not annotation:
         raise api.bad_request(err_pfx + "Annotation does not exist or is empty")
     try:
-        raw = common.from_yaml(annotation) or {}
-        spec = api.PodSchedulingSpec.from_dict(raw)
-        if "ignoreK8sSuggestedNodes" not in raw:
-            spec.ignore_k8s_suggested_nodes = True
+        # from_dict defaults ignoreK8sSuggestedNodes to True when absent
+        # (reference: api/types.go:86 `default:"true"`).
+        spec = api.PodSchedulingSpec.from_dict(common.from_yaml(annotation) or {})
     except api.WebServerError:
         raise
     except Exception as e:  # malformed YAML and the like

@@ -1,0 +1,180 @@
+"""HTTP web server: the scheduler-extender endpoints + the inspect REST API.
+
+Python equivalent of the reference's ``pkg/webserver/webserver.go`` (L46-300):
+JSON decode/validate of extender args, dispatch to the framework's routines,
+inspect handlers with deep-copied status, and error→HTTP mapping (the
+reference recovers webserver panics and maps WebServerError to its code,
+webserver.go:136-165; everything else becomes a 500).
+
+Uses the stdlib ThreadingHTTPServer — the request handlers themselves
+serialize on the framework's scheduler lock, matching the reference's
+concurrency contract (scheduler.go:104-108).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional, Tuple
+
+from .. import common
+from ..api import constants, extender as ei, types as api
+from ..scheduler.framework import HivedScheduler
+
+METRICS_PATH = constants.INSPECT_PATH + "/metrics"
+
+
+class WebServer:
+    """(reference: webserver/webserver.go:46-91)"""
+
+    def __init__(self, scheduler: HivedScheduler, address: Optional[str] = None):
+        self.scheduler = scheduler
+        addr = address if address is not None else scheduler.config.webserver_address
+        host, _, port = addr.rpartition(":")
+        self.host = host or "0.0.0.0"
+        self.port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle (reference: webserver.go:93-134 AsyncRun)
+    # ------------------------------------------------------------------ #
+
+    def start(self) -> None:
+        handler = _make_handler(self.scheduler)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        # Report the actually-bound port (port 0 picks a free one — used by
+        # the tests and the simulator).
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        common.log.info(
+            "%s webserver listening on %s:%d",
+            constants.COMPONENT_NAME, self.host, self.port,
+        )
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+
+def _make_handler(scheduler: HivedScheduler):
+    class Handler(BaseHTTPRequestHandler):
+        # Silence per-request stderr lines; structured logging happens in the
+        # routines themselves.
+        def log_message(self, fmt, *args):  # noqa: N802
+            common.log.debug("webserver: " + fmt, *args)
+
+        # -------------------------------------------------------------- #
+        # Plumbing
+        # -------------------------------------------------------------- #
+
+        def _read_json(self) -> Dict:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length > 0 else b""
+            if not body:
+                raise api.bad_request("Empty request body")
+            try:
+                return json.loads(body)
+            except json.JSONDecodeError as e:
+                raise api.bad_request(f"Failed to unmarshal request body: {e}")
+
+        def _reply(self, code: int, payload: Dict) -> None:
+            data = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _reply_error(self, e: Exception) -> None:
+            """(reference: webserver.go:136-165 panic→HTTP mapping)"""
+            if isinstance(e, api.WebServerError):
+                self._reply(e.code, {"code": e.code, "message": e.message})
+            else:
+                common.log.exception("webserver handler error")
+                self._reply(500, {"code": 500, "message": str(e)})
+
+        # -------------------------------------------------------------- #
+        # Extender verbs (reference: webserver.go:167-240)
+        # -------------------------------------------------------------- #
+
+        def do_POST(self) -> None:  # noqa: N802
+            path = self.path.rstrip("/") or "/"
+            try:
+                if path == constants.FILTER_PATH:
+                    args = ei.ExtenderArgs.from_dict(self._read_json())
+                    # Errors inside filter must be reported in-band in the
+                    # Error field so the default scheduler sees them
+                    # (reference: serveFilterPath recovers to
+                    # ExtenderFilterResult{Error}).
+                    try:
+                        result = scheduler.filter_routine(args)
+                    except api.WebServerError as e:
+                        result = ei.ExtenderFilterResult(error=e.message)
+                    self._reply(200, result.to_dict())
+                elif path == constants.BIND_PATH:
+                    args2 = ei.ExtenderBindingArgs.from_dict(self._read_json())
+                    try:
+                        result2 = scheduler.bind_routine(args2)
+                    except api.WebServerError as e:
+                        result2 = ei.ExtenderBindingResult(error=e.message)
+                    self._reply(200, result2.to_dict())
+                elif path == constants.PREEMPT_PATH:
+                    args3 = ei.ExtenderPreemptionArgs.from_dict(self._read_json())
+                    # Preempt has no in-band Error field; protocol errors map
+                    # to HTTP status codes.
+                    result3 = scheduler.preempt_routine(args3)
+                    self._reply(200, result3.to_dict())
+                else:
+                    raise api.not_found(f"Cannot found resource: {self.path}")
+            except Exception as e:  # noqa: BLE001
+                self._reply_error(e)
+
+        # -------------------------------------------------------------- #
+        # Inspect API (reference: webserver.go:242-300)
+        # -------------------------------------------------------------- #
+
+        def do_GET(self) -> None:  # noqa: N802
+            try:
+                payload = self._route_get(self.path)
+                self._reply(200, payload)
+            except Exception as e:  # noqa: BLE001
+                self._reply_error(e)
+
+        def _route_get(self, path: str):
+            agp = constants.AFFINITY_GROUPS_PATH
+            vcp = constants.VIRTUAL_CLUSTERS_PATH
+            if path == agp or path == agp.rstrip("/"):
+                return scheduler.get_all_affinity_groups()
+            if path.startswith(agp):
+                name = path[len(agp):].strip("/")
+                return scheduler.get_affinity_group(name)
+            if path == constants.PHYSICAL_CLUSTER_PATH:
+                return scheduler.get_physical_cluster_status()
+            if path == vcp or path == vcp.rstrip("/"):
+                return scheduler.get_all_virtual_clusters_status()
+            if path.startswith(vcp):
+                name = path[len(vcp):].strip("/")
+                return scheduler.get_virtual_cluster_status(name)
+            if path == constants.CLUSTER_STATUS_PATH:
+                return scheduler.get_cluster_status()
+            if path == METRICS_PATH:
+                return scheduler.get_metrics()
+            if path == constants.VERSION_PATH or path == constants.ROOT_PATH:
+                return {
+                    "component": constants.COMPONENT_NAME,
+                    "version": _version(),
+                }
+            raise api.not_found(f"Cannot found resource: {path}")
+
+    return Handler
+
+
+def _version() -> str:
+    from .. import __version__
+
+    return __version__

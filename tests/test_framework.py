@@ -1,0 +1,345 @@
+"""Tests for the scheduling framework: pod state machine, assume-bind,
+insist-previous-bind, force-bind, preemption round-trip, recovery.
+
+The framework is driven exactly like production: informer-style events
+(add_pod/delete_pod/add_node) plus the three extender routines — the seam the
+reference exploits for its hermetic suite (scheduler.go is plumbing around
+the same calls; see SURVEY.md §3.2-3.5 call stacks).
+"""
+
+import logging
+
+import pytest
+import yaml
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.api import constants, extender as ei, types as api
+from hivedscheduler_tpu.scheduler.framework import HivedScheduler, NullKubeClient
+from hivedscheduler_tpu.scheduler.types import Node, Pod, PodState
+
+from .test_config_compiler import tpu_design_config
+from .test_core import make_pod
+
+common.init_logging(logging.ERROR)
+
+
+def sync_executor(fn):
+    fn()
+
+
+@pytest.fixture()
+def sched():
+    s = HivedScheduler(
+        tpu_design_config(),
+        kube_client=NullKubeClient(),
+        force_bind_executor=sync_executor,
+    )
+    for name in sorted(
+        {
+            n
+            for ccl in s.core.full_cell_list.values()
+            for c in ccl[ccl.top_level]
+            for n in c.nodes
+        }
+    ):
+        s.add_node(Node(name=name))
+    return s
+
+
+def all_nodes(sched):
+    return sorted(sched.nodes.keys())
+
+
+def filter_pod(sched, pod, suggested=None):
+    return sched.filter_routine(
+        ei.ExtenderArgs(pod=pod, node_names=suggested or all_nodes(sched))
+    )
+
+
+def test_filter_bind_lifecycle(sched):
+    pod = make_pod("j1-0", "u1", "VC1", 0, "v5e-chip", 4)
+    sched.add_pod(pod)
+    assert sched.pod_schedule_statuses["u1"].pod_state == PodState.WAITING
+
+    result = filter_pod(sched, pod)
+    assert result.node_names and len(result.node_names) == 1
+    node = result.node_names[0]
+    status = sched.pod_schedule_statuses["u1"]
+    assert status.pod_state == PodState.BINDING
+    # The binding pod carries the isolation + bind-info + TPU env annotations.
+    assert constants.ANNOTATION_POD_BIND_INFO in status.pod.annotations
+
+    bind_result = sched.bind_routine(
+        ei.ExtenderBindingArgs(
+            pod_name="j1-0", pod_namespace="default", pod_uid="u1", node=node
+        )
+    )
+    assert bind_result.error == ""
+    assert len(sched.kube_client.bound_pods) == 1
+
+    # The informer confirms the bind.
+    bound = sched.kube_client.bound_pods[0]
+    bound.phase = "Running"
+    sched.update_pod(pod, bound)
+    assert sched.pod_schedule_statuses["u1"].pod_state == PodState.BOUND
+
+    # Bound pods are rejected from re-scheduling (reconciled by K8s).
+    with pytest.raises(api.WebServerError) as e:
+        filter_pod(sched, pod)
+    assert e.value.code == 400
+
+    # Deleting releases the cells for reuse.
+    sched.delete_pod(bound)
+    assert "u1" not in sched.pod_schedule_statuses
+    pod2 = make_pod("j2-0", "u2", "VC1", 0, "v5e-chip", 4)
+    sched.add_pod(pod2)
+    assert filter_pod(sched, pod2).node_names
+
+
+def test_filter_insists_previous_bind_and_force_binds(sched):
+    sched.config.force_pod_bind_threshold = 2
+    pod = make_pod("j1-0", "u1", "VC1", 0, "v5e-chip", 4)
+    sched.add_pod(pod)
+    node = filter_pod(sched, pod).node_names[0]
+
+    # Re-entering filter insists on the same node, counting attempts.
+    assert filter_pod(sched, pod).node_names == [node]
+    assert sched.pod_schedule_statuses["u1"].pod_bind_attempts == 1
+    assert sched.kube_client.bound_pods == []
+
+    # Threshold reached -> force bind bypasses the default scheduler.
+    assert filter_pod(sched, pod).node_names == [node]
+    assert len(sched.kube_client.bound_pods) == 1
+    assert sched.kube_client.bound_pods[0].node_name == node
+
+
+def test_force_bind_on_invalid_suggested_nodes(sched):
+    # The algorithm ignores suggested nodes (ignoreK8sSuggestedNodes default),
+    # so a bind decision outside them triggers an immediate proactive force
+    # bind (reference: scheduler.go:457-462).
+    pod = make_pod("j1-0", "u1", "VC2", 0, "cpu-socket", 1)
+    sched.add_pod(pod)
+    v5e_only = [n for n in all_nodes(sched) if n.startswith("v5e")]
+    result = filter_pod(sched, pod, suggested=v5e_only)
+    assert result.node_names == [sched.kube_client.bound_pods[0].node_name]
+    assert result.node_names[0].startswith("cpu-")
+
+
+def test_bind_without_placement_is_rejected(sched):
+    pod = make_pod("j1-0", "u1", "VC1", 0, "v5e-chip", 4)
+    sched.add_pod(pod)
+    with pytest.raises(api.WebServerError) as e:
+        sched.bind_routine(
+            ei.ExtenderBindingArgs(pod_name="j1-0", pod_uid="u1", node="v5e16a-w0")
+        )
+    assert e.value.code == 400
+
+
+def test_wait_when_no_capacity(sched):
+    # VC2 has no v5p quota beyond one v5p-16; ask for more than the quota.
+    pods = [
+        make_pod(
+            f"big-{i}",
+            f"ub{i}",
+            "VC2",
+            0,
+            "v5p-chip",
+            16,
+            group={
+                "name": "bigger",
+                "members": [{"podNumber": 2, "leafCellNumber": 16}],
+            },
+        )
+        for i in range(2)
+    ]
+    sched.add_pod(pods[0])
+    result = filter_pod(sched, pods[0])
+    assert result.node_names is None
+    assert constants.COMPONENT_NAME in result.failed_nodes
+    assert sched.pod_schedule_statuses["ub0"].pod_state == PodState.WAITING
+
+
+def test_preemption_round_trip(sched):
+    # Fill every v5e chip with opportunistic singleton pods (9 x 4 chips:
+    # two v5e-16 slices + the solo host = 36 chips).
+    victims = []
+    for i in range(9):
+        op = make_pod(f"op-{i}", f"uo{i}", "VC2", -1, "v5e-chip", 4)
+        sched.add_pod(op)
+        r = filter_pod(sched, op)
+        assert r.node_names, f"opportunistic pod {i} did not bind"
+        victims.append(sched.pod_schedule_statuses[f"uo{i}"].pod)
+
+    # A guaranteed VC2 gang needs a whole v5e-16 -> filter says preemption
+    # may help (FailedNodes lists victim nodes).
+    gang = {"name": "gp", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    p1 = make_pod("p-0", "up0", "VC2", 10, "v5e-chip", 4, group=gang)
+    sched.add_pod(p1)
+    r = filter_pod(sched, p1)
+    assert r.node_names is None
+    victim_nodes = [n for n in r.failed_nodes if n != constants.COMPONENT_NAME]
+    assert victim_nodes
+
+    # The default scheduler calls preempt; the algorithm hands back one
+    # node's victims per round (utils.go:82-105), and its Reserving/Reserved
+    # cells guarantee convergence across rounds.
+    all_victim_uids = set()
+    for _ in range(8):
+        pr = sched.preempt_routine(
+            ei.ExtenderPreemptionArgs(
+                pod=p1,
+                node_name_to_meta_victims={
+                    n: ei.MetaVictims() for n in victim_nodes
+                },
+            )
+        )
+        if not pr.node_name_to_meta_victims:
+            break  # free resource appeared; bind via filter now
+        assert sched.pod_schedule_statuses["up0"].pod_state == PodState.PREEMPTING
+        round_uids = {
+            mp.uid
+            for v in pr.node_name_to_meta_victims.values()
+            for mp in v.pods
+        }
+        all_victim_uids |= round_uids
+        # K8s deletes the victims; the informer tells us.
+        for v in victims:
+            if v.uid in round_uids:
+                sched.delete_pod(v)
+    assert len(all_victim_uids) == 4
+
+    # The preemptor gang now binds pod by pod.
+    nodes = set()
+    for i in range(4):
+        p = make_pod(f"p-{i}", f"up{i}", "VC2", 10, "v5e-chip", 4, group=gang)
+        if i > 0:
+            sched.add_pod(p)
+        r = filter_pod(sched, p)
+        assert r.node_names, f"preemptor pod {i} did not bind"
+        nodes.add(r.node_names[0])
+    # Topology guarantee: the gang landed on one v5e-16 slice's 4 hosts.
+    assert len(nodes) == 4
+    assert len({n[: len("v5e16a")] for n in nodes}) == 1
+
+
+def test_preempt_routine_without_victims_waits(sched):
+    pod = make_pod(
+        "big",
+        "ub",
+        "VC2",
+        0,
+        "v5p-chip",
+        16,
+        group={"name": "big2", "members": [{"podNumber": 2, "leafCellNumber": 16}]},
+    )
+    sched.add_pod(pod)
+    pr = sched.preempt_routine(
+        ei.ExtenderPreemptionArgs(pod=pod, node_name_to_meta_victims={})
+    )
+    assert pr.node_name_to_meta_victims == {}
+    assert sched.pod_schedule_statuses["ub"].pod_state == PodState.WAITING
+
+
+def test_recovery_replays_bound_pods():
+    config = tpu_design_config()
+    s1 = HivedScheduler(
+        config, kube_client=NullKubeClient(), force_bind_executor=sync_executor
+    )
+    node_names = sorted(
+        {
+            n
+            for ccl in s1.core.full_cell_list.values()
+            for c in ccl[ccl.top_level]
+            for n in c.nodes
+        }
+    )
+    for n in node_names:
+        s1.add_node(Node(name=n))
+
+    pods = [
+        make_pod("a-0", "ua", "VC1", 0, "v5e-chip", 4),
+        # One pod holds at most one TPU-VM host's 4 chips.
+        make_pod("b-0", "ub", "VC2", 5, "v5p-chip", 4),
+    ]
+    bound = []
+    for p in pods:
+        s1.add_pod(p)
+        r = s1.filter_routine(ei.ExtenderArgs(pod=p, node_names=node_names))
+        assert r.node_names
+        bp = s1.pod_schedule_statuses[p.uid].pod
+        bp.phase = "Running"
+        bound.append(bp)
+
+    # A fresh scheduler (e.g. after crash/restart) recovers the exact view
+    # from the pod annotations alone.
+    s2 = HivedScheduler(
+        tpu_design_config(),
+        kube_client=NullKubeClient(),
+        force_bind_executor=sync_executor,
+    )
+    s2.recover([Node(name=n) for n in node_names], bound)
+    for p in pods:
+        assert s2.pod_schedule_statuses[p.uid].pod_state == PodState.BOUND
+    g1 = s2.get_affinity_group("default/a-0")
+    assert g1["status"]["state"] == "Allocated"
+
+    # The recovered view blocks double-allocation of the same cells: the
+    # placements of new pods don't overlap the recovered ones.
+    recovered_placement = {
+        (pl["physicalNode"], tuple(pl["physicalLeafCellIndices"]))
+        for name in ("default/a-0", "default/b-0")
+        for member in s2.get_affinity_group(name)["status"][
+            "physicalPlacement"
+        ].items()
+        for pl in [{"physicalNode": member[0], "physicalLeafCellIndices": member[1]}]
+    }
+    p3 = make_pod("c-0", "uc", "VC1", 0, "v5e-chip", 4)
+    s2.add_pod(p3)
+    r3 = s2.filter_routine(ei.ExtenderArgs(pod=p3, node_names=node_names))
+    assert r3.node_names
+    info = yaml.safe_load(
+        s2.pod_schedule_statuses["uc"].pod.annotations[
+            constants.ANNOTATION_POD_BIND_INFO
+        ]
+    )
+    assert (
+        info["node"],
+        tuple(info["leafCellIsolation"]),
+    ) not in recovered_placement
+
+
+def test_update_pod_uid_change_decomposes(sched):
+    pod = make_pod("j1-0", "u1", "VC1", 0, "v5e-chip", 4)
+    sched.add_pod(pod)
+    filter_pod(sched, pod)
+    reborn = make_pod("j1-0", "u1-new", "VC1", 0, "v5e-chip", 4)
+    sched.update_pod(sched.pod_schedule_statuses["u1"].pod, reborn)
+    assert "u1" not in sched.pod_schedule_statuses
+    assert sched.pod_schedule_statuses["u1-new"].pod_state == PodState.WAITING
+
+
+def test_completed_pod_leaves_view(sched):
+    pod = make_pod("j1-0", "u1", "VC1", 0, "v5e-chip", 4)
+    sched.add_pod(pod)
+    filter_pod(sched, pod)
+    done = sched.pod_schedule_statuses["u1"].pod
+    finished = Pod(
+        name=done.name,
+        namespace=done.namespace,
+        uid=done.uid,
+        annotations=dict(done.annotations),
+        node_name=done.node_name,
+        phase="Succeeded",
+        resource_limits=dict(done.resource_limits),
+    )
+    sched.update_pod(done, finished)
+    assert "u1" not in sched.pod_schedule_statuses
+
+
+def test_metrics_accumulate(sched):
+    pod = make_pod("j1-0", "u1", "VC1", 0, "v5e-chip", 4)
+    sched.add_pod(pod)
+    filter_pod(sched, pod)
+    m = sched.get_metrics()
+    assert m["filterCount"] == 1 and m["bindCount"] == 1
+    assert m["filterLatencyP50Ms"] >= 0

@@ -1,0 +1,159 @@
+"""End-to-end HTTP tests: a real socket, the extender wire protocol, and the
+inspect REST API (reference surface: webserver/webserver.go:167-300)."""
+
+import json
+import logging
+import urllib.error
+import urllib.request
+
+import pytest
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.api import constants, extender as ei
+from hivedscheduler_tpu.scheduler.framework import HivedScheduler, NullKubeClient
+from hivedscheduler_tpu.scheduler.types import Node
+from hivedscheduler_tpu.webserver.server import WebServer
+
+from .test_config_compiler import tpu_design_config
+from .test_core import make_pod
+
+common.init_logging(logging.ERROR)
+
+
+@pytest.fixture()
+def server():
+    sched = HivedScheduler(tpu_design_config(), kube_client=NullKubeClient())
+    for name in sorted(
+        {
+            n
+            for ccl in sched.core.full_cell_list.values()
+            for c in ccl[ccl.top_level]
+            for n in c.nodes
+        }
+    ):
+        sched.add_node(Node(name=name))
+    ws = WebServer(sched, address="127.0.0.1:0")
+    ws.start()
+    yield ws
+    ws.stop()
+
+
+def post(server, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def get(server, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{server.port}{path}") as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_filter_bind_over_http(server):
+    sched = server.scheduler
+    pod = make_pod("j1-0", "u1", "VC1", 0, "v5e-chip", 4)
+    sched.add_pod(pod)
+
+    args = ei.ExtenderArgs(pod=pod, node_names=sorted(sched.nodes))
+    code, body = post(server, constants.FILTER_PATH, args.to_dict())
+    assert code == 200
+    result = ei.ExtenderFilterResult.from_dict(body)
+    assert result.error == "" and result.node_names
+
+    code, body = post(
+        server,
+        constants.BIND_PATH,
+        ei.ExtenderBindingArgs(
+            pod_name="j1-0",
+            pod_namespace="default",
+            pod_uid="u1",
+            node=result.node_names[0],
+        ).to_dict(),
+    )
+    assert code == 200 and body["Error"] == ""
+    assert len(sched.kube_client.bound_pods) == 1
+
+
+def test_filter_error_is_in_band(server):
+    # Unknown pod (never informed) -> admission error surfaces in the Error
+    # field with HTTP 200, the way the default scheduler expects.
+    pod = make_pod("ghost", "ug", "VC1", 0, "v5e-chip", 4)
+    code, body = post(
+        server,
+        constants.FILTER_PATH,
+        ei.ExtenderArgs(pod=pod, node_names=[]).to_dict(),
+    )
+    assert code == 200
+    assert "not been informed" in body["Error"]
+
+
+def test_preempt_over_http(server):
+    sched = server.scheduler
+    pod = make_pod(
+        "big",
+        "ub",
+        "VC2",
+        0,
+        "v5p-chip",
+        16,
+        group={"name": "big3", "members": [{"podNumber": 2, "leafCellNumber": 16}]},
+    )
+    sched.add_pod(pod)
+    code, body = post(
+        server,
+        constants.PREEMPT_PATH,
+        ei.ExtenderPreemptionArgs(pod=pod).to_dict(),
+    )
+    assert code == 200
+    assert body["NodeNameToMetaVictims"] == {}
+
+
+def test_inspect_api(server):
+    sched = server.scheduler
+    pod = make_pod("j1-0", "u1", "VC1", 0, "v5e-chip", 4)
+    sched.add_pod(pod)
+    post(
+        server,
+        constants.FILTER_PATH,
+        ei.ExtenderArgs(pod=pod, node_names=sorted(sched.nodes)).to_dict(),
+    )
+
+    code, groups = get(server, constants.AFFINITY_GROUPS_PATH)
+    assert code == 200 and "default/j1-0" in {
+        g["metadata"]["name"] for g in groups["items"]
+    }
+
+    code, group = get(server, constants.AFFINITY_GROUPS_PATH + "default/j1-0")
+    assert code == 200 and group["status"]["state"] == "Allocated"
+
+    code, status = get(server, constants.CLUSTER_STATUS_PATH)
+    assert code == 200
+    assert "physicalCluster" in status and "virtualClusters" in status
+
+    code, pc = get(server, constants.PHYSICAL_CLUSTER_PATH)
+    assert code == 200 and isinstance(pc, list) and pc
+
+    code, vcs = get(server, constants.VIRTUAL_CLUSTERS_PATH)
+    assert code == 200 and set(vcs) == {"VC1", "VC2"}
+
+    code, vc1 = get(server, constants.VIRTUAL_CLUSTERS_PATH + "VC1")
+    assert code == 200 and isinstance(vc1, list)
+
+    code, metrics = get(server, constants.INSPECT_PATH + "/metrics")
+    assert code == 200 and metrics["filterCount"] == 1
+
+
+def test_inspect_not_found(server):
+    # Missing group is a user error (reference: hived_algorithm.go:318-320
+    # uses BadRequest, not NotFound).
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get(server, constants.AFFINITY_GROUPS_PATH + "missing/group")
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        get(server, "/nope")
+    assert e.value.code == 404
