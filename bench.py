@@ -1,0 +1,196 @@
+"""Benchmark: gang-schedule p50 latency (BASELINE.json headline metric).
+
+Drives the production scheduling path (HivedScheduler.filter_routine — the
+same code the HTTP extender calls, including assume-bind and bind-info
+generation) over a simulated large TPU fleet: 4 v5p-64 cubes (64 hosts) +
+8 v5e-16 slices (32 hosts) + 8 standalone v5e hosts, two VCs, with gang
+sizes mixed 1/2/4/16-pod and steady job churn.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no numbers (BASELINE.md: "published": {}); this run
+*establishes* the baseline, so vs_baseline is ours/target where target is
+the 10 ms p50 budget implied by the reference's 5 s extender HTTP timeout
+and its ~50 ms FIFO block knob (BASELINE.md) — lower is better.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import random
+import statistics
+import time
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.api import constants, extender as ei
+from hivedscheduler_tpu.api.config import Config
+from hivedscheduler_tpu.api.types import CellTypeSpec
+from hivedscheduler_tpu.scheduler.framework import HivedScheduler, NullKubeClient
+from hivedscheduler_tpu.scheduler.types import Node, Pod
+from hivedscheduler_tpu.tpu import topology
+
+common.init_logging(logging.ERROR)
+
+TARGET_P50_MS = 10.0
+
+
+def build_config() -> Config:
+    cell_types = {}
+    cell_types.update(topology.v5p_cell_types(max_hosts=16))
+    cell_types.update(topology.v5e_cell_types(max_hosts=4))
+    physical = []
+    for cube in range(4):
+        physical.append(
+            topology.make_physical_cell(
+                "v5p-64",
+                [f"v5p-c{cube}-w{i}" for i in range(16)],
+                cell_types,
+            ).to_dict()
+        )
+    for s in range(8):
+        physical.append(
+            topology.make_physical_cell(
+                "v5e-16", [f"v5e-s{s}-w{i}" for i in range(4)], cell_types
+            ).to_dict()
+        )
+    for h in range(8):
+        physical.append(
+            topology.make_physical_cell(
+                "v5e-host", [f"v5e-solo-{h}"], cell_types
+            ).to_dict()
+        )
+    return Config.from_dict(
+        {
+            "physicalCluster": {
+                "cellTypes": {
+                    n: {
+                        "childCellType": s.child_cell_type,
+                        "childCellNumber": s.child_cell_number,
+                        "isNodeLevel": s.is_node_level,
+                    }
+                    for n, s in cell_types.items()
+                },
+                "physicalCells": physical,
+            },
+            "virtualClusters": {
+                "prod": {
+                    "virtualCells": [
+                        {"cellType": "v5p-64", "cellNumber": 2},
+                        {"cellType": "v5e-16", "cellNumber": 4},
+                    ]
+                },
+                "research": {
+                    "virtualCells": [
+                        {"cellType": "v5p-64.v5p-16", "cellNumber": 8},
+                        {"cellType": "v5e-16", "cellNumber": 4},
+                        {"cellType": "v5e-host", "cellNumber": 8},
+                    ]
+                },
+            },
+        }
+    )
+
+
+def make_pod(name, uid, vc, priority, leaf_type, leaf_num, group):
+    import yaml
+
+    spec = {
+        "virtualCluster": vc,
+        "priority": priority,
+        "leafCellType": leaf_type,
+        "leafCellNumber": leaf_num,
+        "affinityGroup": group,
+    }
+    return Pod(
+        name=name,
+        uid=uid,
+        annotations={constants.ANNOTATION_POD_SCHEDULING_SPEC: yaml.safe_dump(spec)},
+        resource_limits={constants.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1},
+    )
+
+
+# (vc, leaf_type, pods, chips_per_pod)
+GANG_SHAPES = [
+    ("prod", "v5p-chip", 16, 4),     # whole v5p-64 gang
+    ("prod", "v5e-chip", 4, 4),      # v5e-16 gang
+    ("research", "v5p-chip", 4, 4),  # v5p-16 gang
+    ("research", "v5e-chip", 4, 4),
+    ("research", "v5e-chip", 1, 4),  # singleton host
+    ("research", "v5e-chip", 1, 2),  # sub-host
+]
+
+
+def run(n_gangs: int = 120, seed: int = 0):
+    sched = HivedScheduler(build_config(), kube_client=NullKubeClient())
+    nodes = sorted(
+        {
+            n
+            for ccl in sched.core.full_cell_list.values()
+            for c in ccl[ccl.top_level]
+            for n in c.nodes
+        }
+    )
+    for n in nodes:
+        sched.add_node(Node(name=n))
+
+    rng = random.Random(seed)
+    gang_latencies_ms = []
+    live = []  # (gang_name, [bound pods])
+    for g in range(n_gangs):
+        vc, leaf_type, n_pods, chips = GANG_SHAPES[g % len(GANG_SHAPES)]
+        gname = f"g{g}"
+        group = {
+            "name": gname,
+            "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
+        }
+        pods = [
+            make_pod(f"{gname}-{i}", f"{gname}-u{i}", vc, 0, leaf_type, chips, group)
+            for i in range(n_pods)
+        ]
+        for p in pods:
+            sched.add_pod(p)
+        t0 = time.perf_counter()
+        bound = []
+        ok = True
+        for p in pods:
+            r = sched.filter_routine(ei.ExtenderArgs(pod=p, node_names=nodes))
+            if not r.node_names:
+                ok = False
+                break
+            bound.append(sched.pod_schedule_statuses[p.uid].pod)
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        if ok:
+            gang_latencies_ms.append(elapsed_ms)
+            live.append((gname, bound))
+        else:
+            # Cluster full: free the oldest gangs (job churn), drop this
+            # gang's partial state.
+            for p in pods:
+                sched.delete_pod(p)
+            for _, old in live[: max(1, len(live) // 3)]:
+                for p in old:
+                    sched.delete_pod(p)
+            live = live[max(1, len(live) // 3):]
+
+    p50 = statistics.median(gang_latencies_ms)
+    p99 = sorted(gang_latencies_ms)[
+        min(len(gang_latencies_ms) - 1, int(0.99 * len(gang_latencies_ms)))
+    ]
+    return p50, p99, len(gang_latencies_ms)
+
+
+if __name__ == "__main__":
+    # Warm-up pass (imports, allocator caches), then the measured pass.
+    run(n_gangs=24, seed=1)
+    p50, p99, n = run()
+    print(
+        json.dumps(
+            {
+                "metric": "gang_schedule_p50_latency",
+                "value": round(p50, 3),
+                "unit": "ms",
+                "vs_baseline": round(p50 / TARGET_P50_MS, 3),
+                "extra": {"p99_ms": round(p99, 3), "gangs_scheduled": n},
+            }
+        )
+    )

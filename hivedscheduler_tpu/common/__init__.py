@@ -7,12 +7,18 @@ Python sets/dicts replace the reference's hand-rolled ``Set``.
 
 from __future__ import annotations
 
+import functools
 import json
 import logging
 import sys
 from typing import Any, Iterable, List
 
 import yaml
+
+# libyaml C codecs are ~10x the pure-Python ones; annotation YAML dominates
+# the scheduling hot path otherwise (bind-info parse on every replay).
+_SafeLoader = getattr(yaml, "CSafeLoader", yaml.SafeLoader)
+_SafeDumper = getattr(yaml, "CSafeDumper", yaml.SafeDumper)
 
 log = logging.getLogger("hivedscheduler_tpu")
 
@@ -32,13 +38,23 @@ def init_logging(level: int = logging.INFO) -> None:
 
 def to_yaml(obj: Any) -> str:
     """Serialize to YAML (reference: common/utils.go:176-181 ``ToYaml``)."""
-    return yaml.safe_dump(obj, default_flow_style=False, sort_keys=False)
+    return yaml.dump(
+        obj, Dumper=_SafeDumper, default_flow_style=False, sort_keys=False
+    )
 
 
 def from_yaml(text: str) -> Any:
     """Deserialize YAML; raises on malformed input
     (reference: common/utils.go:183-189 ``FromYaml`` panics on error)."""
-    return yaml.safe_load(text)
+    return yaml.load(text, Loader=_SafeLoader)
+
+
+@functools.lru_cache(maxsize=8192)
+def from_yaml_cached(text: str) -> Any:
+    """Memoized parse for hot annotation strings (bind info is re-parsed on
+    every group-replay lookup). Callers must treat the result as immutable —
+    copy before mutating."""
+    return from_yaml(text)
 
 
 def to_json(obj: Any) -> str:

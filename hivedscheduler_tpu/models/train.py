@@ -1,0 +1,125 @@
+"""Sharded training step: next-token loss, AdamW, declarative parallelism.
+
+The full jax.distributed training loop a HiveD-placed gang runs: params and
+optimizer state sharded by the logical-axis rules (ZeRO-3 over ``fsdp``, tp
+over heads/mlp), batch sharded over (dp, fsdp) and sequence over sp. Every
+collective (gradient psum, fsdp all-gathers, ring-attention ppermute) is
+inserted by XLA from the shardings — none is hand-written except the ring.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel import sharding
+from . import transformer
+
+Params = Dict[str, Any]
+
+
+def next_token_loss(
+    params: Params,
+    tokens: jax.Array,  # [B, S]
+    config: transformer.TransformerConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1]. The whole
+    sequence goes through the model (keeps static shapes / sp divisibility);
+    the last position's logits are simply not scored."""
+    logits = transformer.forward(params, tokens, config, mesh)  # [B,S,V] f32
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    targets = tokens[:, 1:]
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_optimizer(
+    learning_rate: float = 3e-4, weight_decay: float = 0.1
+) -> optax.GradientTransformation:
+    return optax.adamw(
+        learning_rate=learning_rate,
+        b1=0.9,
+        b2=0.95,
+        weight_decay=weight_decay,
+    )
+
+
+def train_step(
+    params: Params,
+    opt_state: Any,
+    tokens: jax.Array,
+    config: transformer.TransformerConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Optional[Mesh] = None,
+) -> Tuple[Params, Any, jax.Array]:
+    loss, grads = jax.value_and_grad(next_token_loss)(
+        params, tokens, config, mesh
+    )
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+def init_sharded(
+    config: transformer.TransformerConfig,
+    mesh: Mesh,
+    key: jax.Array,
+    optimizer: optax.GradientTransformation,
+) -> Tuple[Params, Any, Any, Any]:
+    """Initialize params + optimizer state directly into their shardings
+    (jit with out_shardings => no host-side full copy ever exists).
+
+    Returns (params, opt_state, param_shardings, opt_shardings).
+    """
+    logical = transformer.logical_axes(config)
+    param_sh = sharding.tree_shardings(mesh, logical)
+
+    params_shape = jax.eval_shape(functools.partial(transformer.init, config), key)
+    # Optimizer state mirrors the param tree (adam mu/nu) -> reuse the same
+    # sharding per leaf; scalar state (counts) is replicated.
+    opt_shape = jax.eval_shape(optimizer.init, params_shape)
+
+    def opt_leaf_sharding(leaf):
+        # Match by shape: adam moments have the same shape as their param.
+        for p_leaf, sh in zip(
+            jax.tree.leaves(params_shape), jax.tree.leaves(param_sh)
+        ):
+            if leaf.shape == p_leaf.shape and leaf.dtype == p_leaf.dtype:
+                return sh
+        return NamedSharding(mesh, P())
+
+    opt_sh = jax.tree.map(opt_leaf_sharding, opt_shape)
+
+    params = jax.jit(
+        functools.partial(transformer.init, config), out_shardings=param_sh
+    )(key)
+    opt_state = jax.jit(optimizer.init, out_shardings=opt_sh)(params)
+    return params, opt_state, param_sh, opt_sh
+
+
+def make_train_step(
+    config: transformer.TransformerConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    param_sh: Any,
+    opt_sh: Any,
+) -> Callable:
+    """The jitted, fully-sharded train step. Batch arrives sharded over
+    (dp, fsdp) x sp (use parallel.sharding.shard_batch)."""
+    token_sh = NamedSharding(mesh, sharding.spec_for(("batch", "seq")))
+
+    step = functools.partial(
+        train_step, config=config, optimizer=optimizer, mesh=mesh
+    )
+    return jax.jit(
+        step,
+        in_shardings=(param_sh, opt_sh, token_sh),
+        out_shardings=(param_sh, opt_sh, NamedSharding(mesh, P())),
+        donate_argnums=(0, 1),
+    )

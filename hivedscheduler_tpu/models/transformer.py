@@ -1,0 +1,223 @@
+"""Llama-style decoder-only transformer, TPU-first.
+
+The flagship workload for BASELINE configs 3-4 (BERT-large reuses the
+encoder-ized blocks, Llama-3-8B is the ``llama3_8b`` preset). The reference
+repo schedules such jobs but contains no model code (SURVEY.md §2.2); this is
+the jax.distributed workload the scheduler's bind-time env boots.
+
+TPU design choices:
+  - params and compute in bf16 (MXU-native), softmax/layernorm accumulate in
+    f32; the optimizer keeps f32 master state (models/train.py).
+  - one ``lax.scan`` over stacked layer params: O(1) compile time in depth.
+  - ``jax.checkpoint`` per block: activations rematerialized in backward,
+    trading MXU FLOPs for HBM (the usual TPU bottleneck).
+  - GQA (n_kv_heads < n_heads) shrinks KV cache/bandwidth.
+  - parallelism is all declarative: logical axis names on every param
+    (``logical_axes``) + sharding constraints on activations; the mesh rule
+    table (parallel/sharding.py) decides dp/fsdp/sp/tp. Ring attention
+    (parallel/ring.py) engages when the mesh has sp > 1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..ops.attention import mha_reference
+from ..parallel import ring, sharding
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq_len: int = 8192
+    rope_theta: float = 500000.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    tied_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def llama3_8b() -> TransformerConfig:
+    """Llama-3-8B shapes (BASELINE config 4)."""
+    return TransformerConfig(
+        vocab_size=128256,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        max_seq_len=8192,
+        rope_theta=500000.0,
+    )
+
+
+def tiny(vocab: int = 512) -> TransformerConfig:
+    """Small config for tests / compile checks."""
+    return TransformerConfig(
+        vocab_size=vocab,
+        d_model=128,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=256,
+        max_seq_len=512,
+        rope_theta=10000.0,
+        dtype=jnp.float32,
+        remat=False,
+    )
+
+
+def init(config: TransformerConfig, key: jax.Array) -> Params:
+    """Stacked-layer param tree ([n_layers, ...] leading dim for lax.scan)."""
+    c = config
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    d, h, hk, dh, f, L = (
+        c.d_model, c.n_heads, c.n_kv_heads, c.head_dim, c.d_ff, c.n_layers,
+    )
+
+    # Master params stay f32 (the optimizer needs them); forward casts to
+    # config.dtype (bf16 on TPU) per step.
+    def norm(k, fan_in, shape):
+        return jax.random.normal(k, shape, dtype=jnp.float32) / jnp.sqrt(fan_in)
+
+    ks = jax.random.split(k_layers, 7)
+    params: Params = {
+        "embed": norm(k_embed, 1, (c.vocab_size, d)),
+        "layers": {
+            "ln1": jnp.ones((L, d), dtype=jnp.float32),
+            "wq": norm(ks[0], d, (L, d, h * dh)),
+            "wk": norm(ks[1], d, (L, d, hk * dh)),
+            "wv": norm(ks[2], d, (L, d, hk * dh)),
+            "wo": norm(ks[3], h * dh, (L, h * dh, d)),
+            "ln2": jnp.ones((L, d), dtype=jnp.float32),
+            "w_gate": norm(ks[4], d, (L, d, f)),
+            "w_up": norm(ks[5], d, (L, d, f)),
+            "w_down": norm(ks[6], f, (L, f, d)),
+        },
+        "ln_f": jnp.ones((d,), dtype=jnp.float32),
+    }
+    if not c.tied_embeddings:
+        params["lm_head"] = norm(k_head, d, (d, c.vocab_size))
+    return params
+
+
+def logical_axes(config: TransformerConfig) -> Params:
+    """Logical dim names per param; parallel/sharding.py maps them to mesh
+    axes (embed->fsdp for ZeRO-3, heads/mlp/vocab->tp)."""
+    axes: Params = {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "ln1": ("layers", None),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "ln2": ("layers", None),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "ln_f": (None,),
+    }
+    if not config.tied_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-5) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings; x: [B, S, H, D], positions: [S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    )  # [D/2]
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # [S, D/2]
+    cos = jnp.cos(angles)[None, :, None, :]
+    sin = jnp.sin(angles)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _block(
+    x: jax.Array,
+    layer: Params,
+    config: TransformerConfig,
+    mesh: Optional[Mesh],
+    use_ring: bool,
+) -> jax.Array:
+    c = config
+    b, s, d = x.shape
+
+    h = rms_norm(x, layer["ln1"])
+    q = (h @ layer["wq"]).reshape(b, s, c.n_heads, c.head_dim)
+    k = (h @ layer["wk"]).reshape(b, s, c.n_kv_heads, c.head_dim)
+    v = (h @ layer["wv"]).reshape(b, s, c.n_kv_heads, c.head_dim)
+    positions = jnp.arange(s)
+    q = rope(q, positions, c.rope_theta)
+    k = rope(k, positions, c.rope_theta)
+    q = sharding.constrain(q, "batch", "seq", "heads", None)
+    k = sharding.constrain(k, "batch", "seq", "kv_heads", None)
+    v = sharding.constrain(v, "batch", "seq", "kv_heads", None)
+    if use_ring:
+        assert mesh is not None
+        attn = ring.ring_attention(q, k, v, mesh, causal=True)
+    else:
+        attn = mha_reference(q, k, v, causal=True)
+    attn = attn.reshape(b, s, c.n_heads * c.head_dim)
+    x = x + sharding.constrain(attn @ layer["wo"], "batch", "seq", "act_embed")
+
+    h = rms_norm(x, layer["ln2"])
+    gate = jax.nn.silu(h @ layer["w_gate"])
+    up = h @ layer["w_up"]
+    ffn = (gate * up) @ layer["w_down"]
+    return x + sharding.constrain(ffn, "batch", "seq", "act_embed")
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,  # [B, S] int32
+    config: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Logits [B, S, V]. Set ``mesh`` with sp>1 to engage ring attention."""
+    c = config
+    use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
+    # Mixed precision: f32 master params -> bf16 compute copies.
+    params = jax.tree.map(lambda a: a.astype(c.dtype), params)
+    x = params["embed"][tokens]
+    x = sharding.constrain(x, "batch", "seq", "act_embed")
+
+    block = lambda x, layer: (_block(x, layer, c, mesh, use_ring), None)
+    if c.remat:
+        block = jax.checkpoint(block)
+    x, _ = jax.lax.scan(block, x, params["layers"])
+
+    x = rms_norm(x, params["ln_f"])
+    if c.tied_embeddings:
+        logits = x @ params["embed"].T
+    else:
+        logits = x @ params["lm_head"]
+    return sharding.constrain(
+        logits.astype(jnp.float32), "batch", "seq", "vocab"
+    )

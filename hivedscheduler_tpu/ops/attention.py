@@ -1,0 +1,185 @@
+"""Attention ops: XLA reference path + a Pallas flash-attention TPU kernel.
+
+The reference repo has no compute ops at all (it is a scheduler;
+SURVEY.md §2.2) — these ops exist for the BASELINE workloads the scheduler
+places (ResNet/BERT/Llama/Mixtral). Design per the TPU playbook:
+
+  - The training path uses the XLA implementation: scores/softmax/PV all fuse
+    onto MXU+VPU, XLA derives the backward pass, and bf16 keeps the MXU fed.
+  - The Pallas kernel is the forward flash attention (streaming softmax, no
+    S×S materialization in HBM) for long-context inference where the S×S
+    intermediate would blow HBM; it falls back to XLA off-TPU.
+
+GQA is supported by repeating KV heads; head_dim should be a multiple of 128
+on TPU for lane alignment (pallas_guide.md tiling constraints).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def mha_reference(
+    q: jax.Array,  # [B, Sq, Hq, D]
+    k: jax.Array,  # [B, Sk, Hkv, D]
+    v: jax.Array,  # [B, Sk, Hkv, D]
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    q_offset: int = 0,
+    kv_offset: int = 0,
+) -> jax.Array:
+    """Plain-XLA multi-head attention with f32 softmax accumulation.
+
+    ``q_offset``/``kv_offset`` are the absolute sequence positions of the
+    first query/key — that is what makes this same function the per-block
+    inner step of ring attention (parallel/ring.py), where each device holds
+    a rotating sequence shard.
+    """
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    if hq != hkv:
+        assert hq % hkv == 0, (hq, hkv)
+        k = jnp.repeat(k, hq // hkv, axis=2)
+        v = jnp.repeat(v, hq // hkv, axis=2)
+    scale = 1.0 / math.sqrt(d) if sm_scale is None else sm_scale
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(sq)[:, None]
+        k_pos = kv_offset + jnp.arange(sk)[None, :]
+        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale, causal,
+                      q_block, seq_len):
+    """One (batch*head, q-block) program: stream K/V blocks through VMEM with
+    an online softmax (m, l running stats), never materializing S×S."""
+    import jax.experimental.pallas as pl
+
+    q_idx = pl.program_id(1)
+    q = q_ref[...]  # [block_q, d]
+    block_q = q.shape[0]
+    d = q.shape[-1]
+
+    m = jnp.full((block_q,), NEG_INF, dtype=jnp.float32)
+    l = jnp.zeros((block_q,), dtype=jnp.float32)
+    acc = jnp.zeros((block_q, d), dtype=jnp.float32)
+
+    q_pos = q_idx * q_block + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(start_k, carry):
+        m_prev, l_prev, acc_prev = carry
+        k_blk = pl.load(k_ref, (pl.dslice(start_k * block_k, block_k), slice(None)))
+        v_blk = pl.load(v_ref, (pl.dslice(start_k * block_k, block_k), slice(None)))
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # [block_q, block_k]
+        if causal:
+            k_pos = start_k * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_cur[:, None])
+        alpha = jnp.exp(m_prev - m_cur)
+        l_cur = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_cur = acc_prev * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_cur, l_cur, acc_cur
+
+    num_k_blocks = seq_len // block_k
+    if causal:
+        # Only blocks at or before this q block contribute.
+        upper = jax.lax.div(
+            (q_idx + 1) * q_block + block_k - 1, jnp.int32(block_k)
+        )
+        upper = jnp.minimum(upper, num_k_blocks)
+    else:
+        upper = num_k_blocks
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
+    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "sm_scale", "block_q", "block_k")
+)
+def flash_attention_tpu(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 256,
+    block_k: int = 256,
+) -> jax.Array:
+    """Pallas flash-attention forward. Requires S % block == 0 and TPU."""
+    import jax.experimental.pallas as pl
+
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    if hkv != h:
+        k = jnp.repeat(k, h // hkv, axis=2)
+        v = jnp.repeat(v, h // hkv, axis=2)
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    assert s % block_q == 0 and s % block_k == 0, (s, block_q, block_k)
+    scale = 1.0 / math.sqrt(d) if sm_scale is None else sm_scale
+
+    # [B, S, H, D] -> [B*H, S, D] so the grid is (batch*head, q-block).
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    qt, kt, vt = to_bh(q), to_bh(k), to_bh(v)
+    kernel = functools.partial(
+        _flash_fwd_kernel,
+        block_k=block_k,
+        sm_scale=scale,
+        causal=causal,
+        q_block=block_q,
+        seq_len=s,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * h, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
+    )(qt, kt, vt)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def mha(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    use_pallas: Optional[bool] = None,
+) -> jax.Array:
+    """Dispatch: Pallas flash forward on TPU (inference-shaped calls), XLA
+    reference elsewhere and for training (XLA autodiffs + fuses it)."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    s = q.shape[1]
+    if use_pallas and s >= 256 and s % 256 == 0 and s == k.shape[1]:
+        return flash_attention_tpu(q, k, v, causal=causal, sm_scale=sm_scale)
+    return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)

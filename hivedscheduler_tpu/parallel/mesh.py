@@ -1,0 +1,116 @@
+"""Device meshes and jax.distributed bootstrap from the scheduler's env.
+
+This is the workload side of the scheduler's bind-time contract
+(``tpu/env.py``): a HiveD-placed gang boots multi-host JAX with
+:func:`initialize_from_env`, then lays out computation over a
+:func:`make_mesh` mesh. Axes follow the scaling-book recipe: shard over a
+named mesh, annotate, and let XLA insert the collectives (psum /
+all-gather / reduce-scatter over ICI).
+
+Axis conventions used across models/:
+
+  - ``dp``:   pure data parallelism (batch) — DCN-friendly, outermost.
+  - ``fsdp``: data parallelism with sharded params/optimizer (ZeRO-3 style);
+              ICI, second-outermost.
+  - ``sp``:   sequence/context parallelism (ring attention) — ICI.
+  - ``tp``:   tensor parallelism (megatron-style) — innermost, ICI-adjacent.
+  - ``ep``:   expert parallelism for MoE models (aliases fsdp capacity).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+MESH_AXES = ("dp", "fsdp", "ep", "sp", "tp")
+
+
+def initialize_from_env(env: Optional[Dict[str, str]] = None) -> None:
+    """Boot ``jax.distributed`` from the env block the scheduler injected at
+    bind time (tpu/env.py). No-op for single-process jobs.
+
+    The scheduler guarantees every gang member independently derives the same
+    coordinator/rank assignment, so this needs zero external coordination —
+    the TPU analog of reading ``NVIDIA_VISIBLE_DEVICES``
+    (reference: doc/user-manual.md:159-192).
+    """
+    e = os.environ if env is None else env
+    num = int(e.get("JAX_NUM_PROCESSES", "1"))
+    if num <= 1:
+        return
+    jax.distributed.initialize(
+        coordinator_address=e["JAX_COORDINATOR_ADDRESS"],
+        num_processes=num,
+        process_id=int(e["JAX_PROCESS_ID"]),
+    )
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical parallelism layout. Sizes must multiply to the device count;
+    size 1 axes are kept in the mesh (zero-cost) so PartitionSpecs are stable
+    across layouts."""
+
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    @property
+    def axis_sizes(self) -> Tuple[int, ...]:
+        return (self.dp, self.fsdp, self.ep, self.sp, self.tp)
+
+    def total(self) -> int:
+        return int(np.prod(self.axis_sizes))
+
+
+def make_mesh(
+    config: MeshConfig,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the named device mesh.
+
+    Axis order (dp, fsdp, ep, sp, tp) places tp on the most-adjacent devices
+    (fastest-varying => nearest in the ICI torus for TPU slices, since
+    jax device order follows the torus), dp on the least — collectives that
+    move the most bytes per step ride the shortest links.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if config.total() != len(devs):
+        raise ValueError(
+            f"MeshConfig {config.axis_sizes} needs {config.total()} devices, "
+            f"got {len(devs)}"
+        )
+    dev_array = np.array(devs).reshape(config.axis_sizes)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def single_device_mesh() -> Mesh:
+    """A 1-device mesh with the standard axes (for single-chip runs the
+    PartitionSpecs degenerate to replication)."""
+    return make_mesh(MeshConfig(), devices=jax.devices()[:1])
+
+
+def infer_mesh_config(
+    n_devices: int,
+    tp: int = 1,
+    sp: int = 1,
+    ep: int = 1,
+    fsdp: Optional[int] = None,
+) -> MeshConfig:
+    """Fill the leftover factor into fsdp (or dp when fsdp is pinned)."""
+    inner = tp * sp * ep
+    if n_devices % inner != 0:
+        raise ValueError(f"{n_devices} devices not divisible by tp*sp*ep={inner}")
+    rest = n_devices // inner
+    if fsdp is None:
+        return MeshConfig(dp=1, fsdp=rest, ep=ep, sp=sp, tp=tp)
+    if rest % fsdp != 0:
+        raise ValueError(f"residual {rest} not divisible by fsdp={fsdp}")
+    return MeshConfig(dp=rest // fsdp, fsdp=fsdp, ep=ep, sp=sp, tp=tp)

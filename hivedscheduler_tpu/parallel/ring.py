@@ -1,0 +1,120 @@
+"""Ring attention: exact attention over sequence shards with ppermute.
+
+Long-context sequence/context parallelism (first-class per the build goal;
+the reference's enabler is merely large contiguous slice allocation,
+SURVEY.md §2.2). Each ``sp`` device holds a contiguous sequence shard of
+Q/K/V; K/V blocks rotate around the ring via ``lax.ppermute`` while every
+device maintains a streaming-softmax accumulator — flash attention at
+inter-chip granularity, overlapping the ICI transfer of the next block with
+the matmuls of the current one (XLA pipelines the ppermute).
+
+Memory per device is O(S/p · d) instead of O(S · d); the S×S score matrix
+never exists anywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.attention import NEG_INF
+
+
+def _ring_attention_local(
+    q: jax.Array,  # [B, Sq, H, D] this device's query shard
+    k: jax.Array,  # [B, Sk, Hkv, D] this device's key shard (rotates)
+    v: jax.Array,
+    axis_name: str,
+    causal: bool,
+    sm_scale: Optional[float],
+) -> jax.Array:
+    """Runs under shard_map; exact attention over the full sequence."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    if hq != hkv:
+        k = jnp.repeat(k, hq // hkv, axis=2)
+        v = jnp.repeat(v, hq // hkv, axis=2)
+    scale = 1.0 / math.sqrt(d) if sm_scale is None else sm_scale
+
+    q32 = q.astype(jnp.float32)
+    # Derive the accumulators from q so they carry the same varying-manual
+    # axes type as the loop outputs (required by shard_map's scan typing;
+    # the *0 folds away after fusion).
+    zero_bhq = jnp.sum(q32, axis=3).transpose(0, 2, 1) * 0.0  # [B, H, Sq]
+    m0 = zero_bhq + NEG_INF
+    l0 = zero_bhq
+    o0 = q32 * 0.0
+
+    def step(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        # Shard i steps behind on the ring: block j = (my_idx - i) mod p.
+        k_idx = jax.lax.rem(my_idx - i + axis_size, axis_size)
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
+        ) * scale
+        if causal:
+            q_pos = my_idx * sq + jax.lax.broadcasted_iota(
+                jnp.int32, (sq, sk), 0
+            )
+            k_pos = k_idx * sk + jax.lax.broadcasted_iota(
+                jnp.int32, (sq, sk), 1
+            )
+            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        m_cur = jnp.maximum(m, jnp.max(s, axis=-1))
+        # Guard fully-masked rows (future-only blocks): exp(NEG_INF-NEG_INF)
+        # must not become 1.
+        safe_m = jnp.where(m_cur <= NEG_INF / 2, 0.0, m_cur)
+        p_blk = jnp.exp(jnp.where(s <= NEG_INF / 2, NEG_INF, s) - safe_m[..., None])
+        alpha = jnp.where(
+            m <= NEG_INF / 2, jnp.zeros_like(m), jnp.exp(m - safe_m)
+        )
+        l_cur = l * alpha + jnp.sum(p_blk, axis=-1)
+        o_cur = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p_blk, v_blk.astype(jnp.float32)
+        )
+        # Rotate K/V to the next device; the transfer overlaps the next
+        # step's compute.
+        perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o_cur, m_cur, l_cur, k_nxt, v_nxt
+
+    o, m, l, _, _ = jax.lax.fori_loop(0, axis_size, step, (o0, m0, l0, k, v))
+    l = jnp.maximum(l, 1e-20)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,  # [B, S, H, D] globally; S sharded over `sp`
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    batch_axes=("dp", "fsdp"),
+    seq_axis: str = "sp",
+    head_axis: str = "tp",
+) -> jax.Array:
+    """Exact attention with the sequence dimension sharded over ``seq_axis``.
+
+    Composable under jit: shard_map with explicit ppermute inside, XLA
+    collectives outside. Heads additionally shard over tp; batch over
+    dp/fsdp.
+    """
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    fn = functools.partial(
+        _ring_attention_local,
+        axis_name=seq_axis,
+        causal=causal,
+        sm_scale=sm_scale,
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

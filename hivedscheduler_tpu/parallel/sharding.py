@@ -1,0 +1,80 @@
+"""Logical-axis sharding rules: name every tensor dimension once, map names
+to mesh axes in one table, and derive NamedShardings for whole pytrees.
+
+This is the "annotate and let XLA do the rest" half of the scaling-book
+recipe: models label their params/activations with logical axis names
+(``("embed", "mlp")``), and a rule table decides which mesh axis each name
+shards over. Changing the parallelism layout = changing the table, not the
+model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical dim name -> mesh axis (or None = replicate). The default table
+# implements: batch over (dp, fsdp), sequence over sp (ring attention),
+# megatron-style tp over heads/mlp, fsdp-sharded embed (ZeRO-3), experts
+# over ep.
+DEFAULT_RULES: Dict[str, Any] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    # Param embed dim shards over fsdp (ZeRO-3); the activation residual
+    # stream replicates its feature dim (batch already covers fsdp).
+    "embed": "fsdp",
+    "act_embed": None,
+    "heads": "tp",
+    "kv_heads": "tp",
+    "head_dim": None,
+    "mlp": "tp",
+    "vocab": "tp",
+    "expert": "ep",
+    "layers": None,
+}
+
+
+def spec_for(
+    logical_axes: Sequence[Optional[str]],
+    rules: Optional[Dict[str, Any]] = None,
+) -> P:
+    """PartitionSpec for one tensor's logical axis names."""
+    table = DEFAULT_RULES if rules is None else rules
+    return P(*[table.get(name) if name else None for name in logical_axes])
+
+
+def tree_shardings(
+    mesh: Mesh,
+    logical_tree: Any,
+    rules: Optional[Dict[str, Any]] = None,
+) -> Any:
+    """NamedSharding pytree from a pytree of logical-axis tuples (the tree
+    structure mirrors the param tree; leaves are tuples of names)."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, spec_for(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
+def constrain(x: jax.Array, *logical_axes: Optional[str], rules=None) -> jax.Array:
+    """Sharding constraint by logical names; no-op outside a mesh context."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec_for(logical_axes, rules))
+    except (ValueError, RuntimeError):
+        return x
+
+
+def shard_batch(batch: Any, mesh: Mesh, rules=None) -> Any:
+    """Device-put a host batch with (batch, seq, ...) layout onto the mesh."""
+    table = DEFAULT_RULES if rules is None else rules
+
+    def put(x):
+        axes: Tuple[Optional[str], ...] = ("batch", "seq")[: x.ndim] + (None,) * max(
+            0, x.ndim - 2
+        )
+        return jax.device_put(x, NamedSharding(mesh, spec_for(axes, table)))
+
+    return jax.tree.map(put, batch)

@@ -15,6 +15,7 @@ informer loop (``scheduler.informer``), a test harness, or a simulator drives
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional
@@ -102,7 +103,8 @@ class SchedulerMetrics:
             def pct(p: float) -> float:
                 if n == 0:
                     return 0.0
-                return lat[min(n - 1, int(p * n))]
+                # Nearest-rank: the ceil(p*n)-th order statistic.
+                return lat[min(n - 1, max(0, math.ceil(p * n) - 1))]
 
             return {
                 "filterCount": self.filter_count,
@@ -325,9 +327,10 @@ class HivedScheduler:
                     node=binding_pod.node_name,
                 )
             )
-        except api.WebServerError as e:
-            # One force-bind failure is ignorable; it will be retried on the
-            # next filter round (reference: HandleWebServerPanic).
+        except Exception as e:  # noqa: BLE001
+            # One force-bind failure — protocol error OR kube transport
+            # error — is ignorable; it will be retried on the next filter
+            # round (reference: HandleWebServerPanic recovers everything).
             common.log.warning(
                 "[%s]: forceBindExecutor: %s", binding_pod.key, e
             )
@@ -427,22 +430,27 @@ class HivedScheduler:
     def bind_routine(self, args: ei.ExtenderBindingArgs) -> ei.ExtenderBindingResult:
         """Idempotent: may be called multiple times for the same pod; once a
         pod is allocated its placement never changes."""
+        # Validate under the lock, but perform the apiserver write outside
+        # it: a bind is a full network RTT, and holding the exclusive lock
+        # through it would serialize gang binds and stall all filtering
+        # (the reference holds only a read lock here, scheduler.go:595-596).
+        # Safe because a BINDING pod's placement is immutable.
         with self._lock:
             status = self._admission_check(args.pod_uid)
-            if status.pod_state == PodState.BINDING:
-                binding_pod = status.pod
-                if binding_pod.node_name != args.node:
-                    raise api.bad_request(
-                        f"Pod binding node mismatch: expected "
-                        f"{binding_pod.node_name}, received {args.node}"
-                    )
-                self.kube_client.bind_pod(binding_pod)
-                return ei.ExtenderBindingResult()
-            raise api.bad_request(
-                f"Pod cannot be bound without a scheduling placement: Pod "
-                f"current scheduling state {status.pod_state.value}, received "
-                f"node {args.node}"
-            )
+            if status.pod_state != PodState.BINDING:
+                raise api.bad_request(
+                    f"Pod cannot be bound without a scheduling placement: Pod "
+                    f"current scheduling state {status.pod_state.value}, "
+                    f"received node {args.node}"
+                )
+            binding_pod = status.pod
+            if binding_pod.node_name != args.node:
+                raise api.bad_request(
+                    f"Pod binding node mismatch: expected "
+                    f"{binding_pod.node_name}, received {args.node}"
+                )
+        self.kube_client.bind_pod(binding_pod)
+        return ei.ExtenderBindingResult()
 
     # ------------------------------------------------------------------ #
     # Preempt (reference: scheduler.go:629-721)

@@ -142,14 +142,20 @@ class PodScheduleStatus:
 
 def new_binding_pod(pod: Pod, bind_info: api.PodBindInfo) -> Pod:
     """A copy of the pod with the binding decision applied: node set, the
-    isolation + bind-info annotations attached
-    (reference: internal/utils.go:172-186)."""
+    isolation + bind-info + TPU env annotations attached
+    (reference: internal/utils.go:172-186; the TPU env block replaces the
+    reference's single NVIDIA_VISIBLE_DEVICES-style isolation var)."""
+    from ..tpu import env as tpu_env  # late import: tpu depends on api only
+
     annotations = dict(pod.annotations)
     annotations[constants.ANNOTATION_POD_LEAF_CELL_ISOLATION] = (
         common.to_indices_string(bind_info.leaf_cell_isolation)
     )
     annotations[constants.ANNOTATION_POD_BIND_INFO] = common.to_yaml(
         bind_info.to_dict()
+    )
+    annotations[constants.ANNOTATION_POD_TPU_ENV] = common.to_yaml(
+        tpu_env.pod_tpu_env(bind_info)
     )
     return Pod(
         name=pod.name,
@@ -170,7 +176,10 @@ def extract_pod_bind_info(allocated_pod: Pod) -> api.PodBindInfo:
             f"Pod does not contain or contains empty annotation: "
             f"{constants.ANNOTATION_POD_BIND_INFO}"
         )
-    return api.PodBindInfo.from_dict(common.from_yaml(annotation) or {})
+    # Cached parse: the group-replay paths re-read the same annotation many
+    # times per scheduling round; from_dict copies every field, so sharing
+    # the parsed dict is safe.
+    return api.PodBindInfo.from_dict(common.from_yaml_cached(annotation) or {})
 
 
 def extract_pod_scheduling_spec(pod: Pod) -> api.PodSchedulingSpec:

@@ -1,0 +1,82 @@
+"""The TPU / jax.distributed environment contract emitted at bind time.
+
+The reference's device-isolation mechanism is one env var derived from one
+annotation (``pod-leaf-cell-isolation`` -> ``NVIDIA_VISIBLE_DEVICES``,
+reference: pkg/internal/utils.go:172-186, doc/user-manual.md:159-192). A JAX
+multi-host TPU gang needs more: every worker must agree on the coordinator
+address, the process count, and its own process id — and the assignment must
+be consistent across the gang even though each pod is bound independently.
+
+This module derives that whole block deterministically from the group's bind
+info (which every binding pod carries in full, since it doubles as the crash
+-recovery record): workers are ordered by (node name, first chip index), so
+any pod of the gang — or the recovered scheduler — computes the identical
+assignment with no coordination (SURVEY.md §7.4 hard part 5).
+
+Containers lift the annotation into env vars via an init container or a
+downward-API volume, the way the reference maps its isolation annotation to
+``NVIDIA_VISIBLE_DEVICES`` (doc/user-manual.md:164-186).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from ..api import types as api
+
+# The port worker 0 serves jax.distributed coordination on. Any free port
+# works as long as the whole gang agrees; this one is JAX's conventional
+# default for `jax.distributed.initialize`.
+COORDINATOR_PORT = 8476
+
+
+def _worker_order(info: api.PodBindInfo) -> List[Tuple[str, Tuple[int, ...]]]:
+    """All pod placements of the gang as (node, chip indices), in the
+    deterministic worker order: sorted by (node, first chip index).
+
+    Node names sort in ICI order when slices are declared with
+    ``tpu.topology.make_physical_cell`` (worker 0..N-1 addresses); within a
+    node, the lowest chip index breaks ties between sub-host pods.
+    """
+    placements: List[Tuple[str, Tuple[int, ...]]] = []
+    for member in info.affinity_group_bind_info:
+        for placement in member.pod_placements:
+            placements.append(
+                (
+                    placement.physical_node,
+                    tuple(placement.physical_leaf_cell_indices),
+                )
+            )
+    placements.sort(key=lambda p: (p[0], p[1][0] if p[1] else -1))
+    return placements
+
+
+def pod_tpu_env(info: api.PodBindInfo) -> Dict[str, str]:
+    """The env block for the pod bound by ``info``.
+
+    Keys:
+      - ``TPU_VISIBLE_CHIPS``: this host's chip indices granted to the pod
+        (the TPU analog of the reference's device isolation).
+      - ``TPU_WORKER_ID`` / ``JAX_PROCESS_ID``: this pod's rank in the gang.
+      - ``TPU_WORKER_HOSTNAMES``: all gang hostnames in worker order.
+      - ``JAX_COORDINATOR_ADDRESS``: worker 0's host:port.
+      - ``JAX_NUM_PROCESSES``: gang size.
+    """
+    order = _worker_order(info)
+    me = (info.node, tuple(info.leaf_cell_isolation))
+    try:
+        worker_id = order.index(me)
+    except ValueError:
+        raise api.internal_error(
+            f"Pod placement {me} not found in its own affinity group bind "
+            f"info; cannot derive a TPU worker id"
+        )
+    hostnames = [node for node, _ in order]
+    return {
+        "TPU_VISIBLE_CHIPS": ",".join(str(i) for i in info.leaf_cell_isolation),
+        "TPU_WORKER_ID": str(worker_id),
+        "JAX_PROCESS_ID": str(worker_id),
+        "TPU_WORKER_HOSTNAMES": ",".join(hostnames),
+        "JAX_COORDINATOR_ADDRESS": f"{hostnames[0]}:{COORDINATOR_PORT}",
+        "JAX_NUM_PROCESSES": str(len(order)),
+    }

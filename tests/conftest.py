@@ -1,11 +1,36 @@
-"""Test env: force an 8-device virtual CPU mesh before jax is imported, so
-multi-chip sharding tests run hermetically without TPU hardware."""
+"""Test env: force a hermetic 8-device virtual CPU mesh.
+
+Two things must happen before any backend initializes:
+  - ``xla_force_host_platform_device_count=8`` so multi-chip sharding tests
+    run without TPU hardware;
+  - the out-of-tree TPU PJRT plugin (registered by the host image's
+    sitecustomize, e.g. the axon tunnel) must be deregistered — merely
+    setting ``JAX_PLATFORMS=cpu`` does not stop its factory from
+    initializing, and a dead tunnel then hangs ``jax.devices()`` forever.
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+
+def _force_cpu_backend() -> None:
+    # The plugin's site hook may have imported jax already (snapshotting
+    # JAX_PLATFORMS at interpreter start) — override the live config value so
+    # only the cpu backend ever initializes. The plugin stays *registered*
+    # (deregistering breaks MLIR platform lookups); it just never runs.
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        # On jax versions without this config, JAX_PLATFORMS alone decides.
+        pass
+
+
+_force_cpu_backend()

@@ -1,0 +1,84 @@
+"""Tests for the jax.distributed env contract emitted at bind time.
+
+Every pod of a gang must independently derive the identical worker-id
+assignment from its own bind-info annotation (SURVEY.md §7.4 hard part 5)."""
+
+import logging
+
+import yaml
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.api import constants
+from hivedscheduler_tpu.tpu.env import COORDINATOR_PORT
+
+from .test_core import Sim, make_pod
+
+common.init_logging(logging.ERROR)
+
+
+def env_of(binding_pod):
+    return yaml.safe_load(
+        binding_pod.annotations[constants.ANNOTATION_POD_TPU_ENV]
+    )
+
+
+def test_gang_env_is_consistent_and_deterministic():
+    sim = Sim()
+    gang = {"name": "g16", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    bound = [
+        sim.schedule_and_bind(
+            make_pod(f"w-{i}", f"u{i}", "VC1", 0, "v5e-chip", 4, group=gang)
+        )
+        for i in range(4)
+    ]
+    envs = [env_of(bp) for bp in bound]
+
+    # Ranks are a permutation of 0..3; every pod agrees on the roster.
+    assert sorted(int(e["TPU_WORKER_ID"]) for e in envs) == [0, 1, 2, 3]
+    rosters = {e["TPU_WORKER_HOSTNAMES"] for e in envs}
+    assert len(rosters) == 1
+    hostnames = rosters.pop().split(",")
+    assert len(hostnames) == 4
+
+    # Every pod agrees on the coordinator: worker 0's host.
+    coords = {e["JAX_COORDINATOR_ADDRESS"] for e in envs}
+    assert coords == {f"{hostnames[0]}:{COORDINATOR_PORT}"}
+    assert all(e["JAX_NUM_PROCESSES"] == "4" for e in envs)
+    assert all(e["JAX_PROCESS_ID"] == e["TPU_WORKER_ID"] for e in envs)
+
+    # The rank matches the position of the pod's own host in the roster.
+    for bp, e in zip(bound, envs):
+        assert hostnames[int(e["TPU_WORKER_ID"])] == bp.node_name
+        assert e["TPU_VISIBLE_CHIPS"] == bp.annotations[
+            constants.ANNOTATION_POD_LEAF_CELL_ISOLATION
+        ]
+
+
+def test_sub_host_pods_get_distinct_ranks_on_same_node():
+    sim = Sim()
+    # Two 2-chip pods of one gang can share a host; ranks must still be
+    # distinct and ordered by chip index.
+    gang = {"name": "g2", "members": [{"podNumber": 2, "leafCellNumber": 2}]}
+    bound = [
+        sim.schedule_and_bind(
+            make_pod(f"s-{i}", f"su{i}", "VC2", 0, "v5e-chip", 2, group=gang)
+        )
+        for i in range(2)
+    ]
+    envs = [env_of(bp) for bp in bound]
+    assert sorted(int(e["TPU_WORKER_ID"]) for e in envs) == [0, 1]
+    if bound[0].node_name == bound[1].node_name:
+        first = min(envs, key=lambda e: int(e["TPU_WORKER_ID"]))
+        second = max(envs, key=lambda e: int(e["TPU_WORKER_ID"]))
+        assert int(first["TPU_VISIBLE_CHIPS"].split(",")[0]) < int(
+            second["TPU_VISIBLE_CHIPS"].split(",")[0]
+        )
+
+
+def test_singleton_env():
+    sim = Sim()
+    bp = sim.schedule_and_bind(make_pod("solo", "us", "VC1", 0, "v5e-chip", 4))
+    e = env_of(bp)
+    assert e["TPU_WORKER_ID"] == "0"
+    assert e["JAX_NUM_PROCESSES"] == "1"
+    assert e["JAX_COORDINATOR_ADDRESS"].startswith(bp.node_name)
