@@ -1,0 +1,27 @@
+"""Shared workload bootstrap: lift the scheduler's pod-tpu-env annotation
+(delivered via the HIVED_TPU_ENV downward-API env var) into the process env
+and initialize jax.distributed."""
+
+from __future__ import annotations
+
+import os
+
+import yaml
+
+
+def bootstrap_distributed() -> int:
+    """Returns this worker's process index (0 for single-process jobs)."""
+    blob = os.environ.get("HIVED_TPU_ENV", "")
+    if blob:
+        for key, value in (yaml.safe_load(blob) or {}).items():
+            os.environ.setdefault(key, str(value))
+    from hivedscheduler_tpu.parallel.mesh import initialize_from_env
+
+    initialize_from_env()
+    return int(os.environ.get("JAX_PROCESS_ID", "0"))
+
+
+def synthetic_tokens(key, batch, seq, vocab):
+    import jax
+
+    return jax.random.randint(key, (batch, seq), 0, vocab)

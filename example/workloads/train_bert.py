@@ -1,0 +1,53 @@
+"""BASELINE config 3: BERT-large MLM pretrain on a 4-host v5e-16 gang
+(16 chips: fsdp=8 x tp=2)."""
+
+import jax
+import optax
+
+from common import bootstrap_distributed, synthetic_tokens
+from hivedscheduler_tpu.models import bert
+from hivedscheduler_tpu.parallel import mesh as pmesh, sharding
+
+
+def main():
+    bootstrap_distributed()
+    n = len(jax.devices())
+    cfg = pmesh.infer_mesh_config(n, tp=min(2, n))
+    mesh = pmesh.make_mesh(cfg)
+
+    config = bert.bert_large()
+    param_sh = sharding.tree_shardings(mesh, bert.logical_axes(config))
+    params = jax.jit(
+        lambda k: bert.init(config, k), out_shardings=param_sh
+    )(jax.random.PRNGKey(0))
+    opt = optax.adamw(1e-4)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(bert.mlm_loss)(
+            params, tokens, targets, config, mesh
+        )
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    key = jax.random.PRNGKey(1)
+    for i in range(20):
+        key, k1, k2 = jax.random.split(key, 3)
+        tokens = synthetic_tokens(k1, 8 * cfg.dp * cfg.fsdp, 512,
+                                  config.vocab_size)
+        # Mask 15% of positions.
+        mask = jax.random.bernoulli(k2, 0.15, tokens.shape)
+        targets = jax.numpy.where(mask, tokens, -100)
+        tokens = jax.numpy.where(mask, 103, tokens)  # [MASK]
+        params, opt_state, loss = step(
+            params,
+            opt_state,
+            sharding.shard_batch(tokens, mesh),
+            sharding.shard_batch(targets, mesh),
+        )
+        print(f"step {i} mlm loss {float(loss):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""BASELINE config 4: Llama-3-8B pretrain on a v5p-64 gang (64 chips:
+fsdp=8 x sp=2 x tp=4 — long-context ring attention over sp)."""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+
+from common import bootstrap_distributed, synthetic_tokens
+from hivedscheduler_tpu.models import train, transformer
+from hivedscheduler_tpu.parallel import mesh as pmesh, sharding
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--opportunistic", action="store_true")
+    parser.add_argument("--steps", type=int, default=50)
+    args = parser.parse_args()
+
+    bootstrap_distributed()
+    n = len(jax.devices())
+    tp = 4 if n % 4 == 0 else 1
+    sp = 2 if n % (tp * 2) == 0 else 1
+    cfg = pmesh.infer_mesh_config(n, tp=tp, sp=sp)
+    mesh = pmesh.make_mesh(cfg)
+
+    config = transformer.llama3_8b()
+    optimizer = train.make_optimizer()
+    with jax.set_mesh(mesh):
+        params, opt_state, param_sh, opt_sh = train.init_sharded(
+            config, mesh, jax.random.PRNGKey(0), optimizer
+        )
+        step = train.make_train_step(config, mesh, optimizer, param_sh, opt_sh)
+        key = jax.random.PRNGKey(1)
+        batch = 1 * cfg.dp * cfg.fsdp
+        for i in range(args.steps):
+            key, k = jax.random.split(key)
+            tokens = sharding.shard_batch(
+                synthetic_tokens(k, batch, config.max_seq_len,
+                                 config.vocab_size),
+                mesh,
+            )
+            params, opt_state, loss = step(params, opt_state, tokens)
+            print(f"step {i} loss {float(loss):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

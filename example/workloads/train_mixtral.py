@@ -1,0 +1,49 @@
+"""BASELINE config 5: Mixtral 8x7B expert-parallel on the pinned v5p-16
+(16 chips: ep=8 x tp=2 — experts ride the all-to-all over ICI)."""
+
+import jax
+import optax
+
+from common import bootstrap_distributed, synthetic_tokens
+from hivedscheduler_tpu.models import mixtral
+from hivedscheduler_tpu.parallel import mesh as pmesh, sharding
+
+
+def main():
+    bootstrap_distributed()
+    n = len(jax.devices())
+    ep = 8 if n % 8 == 0 else (4 if n % 4 == 0 else 1)
+    tp = 2 if n % (ep * 2) == 0 else 1
+    cfg = pmesh.infer_mesh_config(n, ep=ep, tp=tp)
+    mesh = pmesh.make_mesh(cfg)
+
+    config = mixtral.mixtral_8x7b()
+    param_sh = sharding.tree_shardings(mesh, mixtral.logical_axes(config))
+    params = jax.jit(
+        lambda k: mixtral.init(config, k), out_shardings=param_sh
+    )(jax.random.PRNGKey(0))
+    opt = optax.adamw(1e-4)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(mixtral.lm_loss)(
+            params, tokens, config, mesh
+        )
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    key = jax.random.PRNGKey(1)
+    for i in range(30):
+        key, k = jax.random.split(key)
+        tokens = sharding.shard_batch(
+            synthetic_tokens(k, 4 * cfg.dp * cfg.fsdp, 4096,
+                             config.vocab_size),
+            mesh,
+        )
+        params, opt_state, loss = step(params, opt_state, tokens)
+        print(f"step {i} loss {float(loss):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

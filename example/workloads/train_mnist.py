@@ -1,0 +1,49 @@
+"""BASELINE config 1: CPU MNIST (single pod, one cpu-socket cell).
+
+A minimal MLP on synthetic MNIST-shaped data (the container has no egress;
+swap in the real dataset via a mounted volume in production)."""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    k1, k2, kx = jax.random.split(key, 3)
+    params = {
+        "w1": jax.random.normal(k1, (784, 256)) * 0.05,
+        "b1": jnp.zeros(256),
+        "w2": jax.random.normal(k2, (256, 10)) * 0.05,
+        "b2": jnp.zeros(10),
+    }
+    images = jax.random.normal(kx, (512, 784))
+    labels = jax.random.randint(kx, (512,), 0, 10)
+
+    def loss_fn(p, x, y):
+        h = jax.nn.relu(x @ p["w1"] + p["b1"])
+        logits = h @ p["w2"] + p["b2"]
+        return -jnp.mean(
+            jnp.take_along_axis(
+                jax.nn.log_softmax(logits), y[:, None], axis=-1
+            )
+        )
+
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(p, o, x, y):
+        loss, grads = jax.value_and_grad(loss_fn)(p, x, y)
+        updates, o = opt.update(grads, o)
+        return optax.apply_updates(p, updates), o, loss
+
+    for i in range(100):
+        params, opt_state, loss = step(params, opt_state, images, labels)
+        if i % 20 == 0:
+            print(f"step {i} loss {float(loss):.4f}", flush=True)
+    print("done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

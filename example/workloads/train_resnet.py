@@ -1,0 +1,45 @@
+"""BASELINE config 2: ResNet-50 on one v5e host (4 chips, data parallel)."""
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from common import bootstrap_distributed
+from hivedscheduler_tpu.models import resnet
+from hivedscheduler_tpu.parallel import mesh as pmesh, sharding
+
+
+def main():
+    bootstrap_distributed()
+    n = len(jax.devices())
+    mesh = pmesh.make_mesh(pmesh.MeshConfig(dp=n))
+
+    config = resnet.ResNetConfig()
+    params, stats = resnet.init(config, jax.random.PRNGKey(0))
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, stats, opt_state, images, labels):
+        (loss, new_stats), grads = jax.value_and_grad(
+            resnet.loss_fn, has_aux=True
+        )(params, stats, images, labels, config)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), new_stats, opt_state, loss
+
+    key = jax.random.PRNGKey(1)
+    for i in range(20):
+        images = sharding.shard_batch(
+            jax.random.normal(key, (32 * n, 224, 224, 3)), mesh
+        )
+        labels = sharding.shard_batch(
+            jax.random.randint(key, (32 * n,), 0, 1000), mesh
+        )
+        params, stats, opt_state, loss = step(
+            params, stats, opt_state, images, labels
+        )
+        print(f"step {i} loss {float(loss):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

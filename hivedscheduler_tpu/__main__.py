@@ -1,0 +1,97 @@
+"""CLI entry point: ``python -m hivedscheduler_tpu [--config path]``.
+
+Production equivalent of the reference's ``cmd/hivedscheduler/main.go``:
+init logging, load config, recover from the cluster (or start empty in
+--standalone mode), serve the extender + inspect API, and exit(1) when the
+config file changes so the supervisor restarts us into the work-preserving
+recovery path (reference: api/config.go:202-217 WatchConfig).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+import time
+
+from . import common
+from .api.config import config_fingerprint, load_config
+from .scheduler.framework import HivedScheduler
+from .scheduler.types import Node
+from .webserver.server import WebServer
+
+CONFIG_POLL_SECONDS = 2.0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="hivedscheduler-tpu")
+    parser.add_argument(
+        "--config",
+        default=os.environ.get("CONFIG", "./hivedscheduler.yaml"),
+        help="scheduler config YAML (default: $CONFIG or ./hivedscheduler.yaml)",
+    )
+    parser.add_argument(
+        "--standalone",
+        action="store_true",
+        help="no kube apiserver: mark all configured nodes healthy and serve "
+        "(for simulation/e2e harnesses)",
+    )
+    parser.add_argument("-v", "--verbose", action="store_true")
+    args = parser.parse_args(argv)
+
+    common.init_logging(logging.DEBUG if args.verbose else logging.INFO)
+    config = load_config(args.config)
+    # Standalone has no informer, so filter-time auto-admission stands in
+    # for pod events.
+    scheduler = HivedScheduler(config, auto_admit=args.standalone)
+
+    if args.standalone:
+        # The constructor already defaulted kube_client to a NullKubeClient.
+        for name in sorted(
+            {
+                n
+                for ccl in scheduler.core.full_cell_list.values()
+                for c in ccl[ccl.top_level]
+                for n in c.nodes
+            }
+        ):
+            scheduler.add_node(Node(name=name))
+    else:
+        from .scheduler.kube import InformerLoop, KubeAPIClient
+
+        apiserver = config.kube_apiserver_address or os.environ.get(
+            "KUBE_APISERVER_ADDRESS", "https://kubernetes.default.svc"
+        )
+        client = KubeAPIClient(apiserver)
+        scheduler.kube_client = client
+        # Recovery completes before we accept scheduling requests
+        # (reference: scheduler.go:200-212).
+        InformerLoop(scheduler, client).start()
+
+    server = WebServer(scheduler)
+    server.start()
+
+    # Restart-based reconfiguration: exit on config change; the supervisor
+    # (K8s) restarts us and recovery replays allocated pods against the new
+    # config (reference semantics: api/config.go:202-217).
+    fingerprint = config_fingerprint(args.config)
+    try:
+        while True:
+            time.sleep(CONFIG_POLL_SECONDS)
+            try:
+                current = config_fingerprint(args.config)
+            except OSError:
+                continue
+            if current != fingerprint:
+                common.log.warning(
+                    "Config file %s changed; exiting for work-preserving "
+                    "restart", args.config,
+                )
+                return 1
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,168 @@
+"""BERT-style bidirectional encoder for MLM pretraining (BASELINE config 3:
+BERT-large on a 4-host v5e-16 gang).
+
+Reuses the decoder's primitives where they coincide (rms_norm is replaced by
+classic LayerNorm to match BERT; attention is the same op, non-causal).
+Parallelism identical to the decoder: logical axes + the shared rule table,
+so the same dp/fsdp/tp layouts apply; sp/ring attention is unnecessary at
+BERT sequence lengths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..ops.attention import mha_reference
+from ..parallel import sharding
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 1024
+    n_layers: int = 24
+    n_heads: int = 16
+    d_ff: int = 4096
+    max_seq_len: int = 512
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def bert_large() -> BertConfig:
+    return BertConfig()
+
+
+def tiny(vocab: int = 512) -> BertConfig:
+    return BertConfig(
+        vocab_size=vocab,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        d_ff=128,
+        max_seq_len=128,
+        dtype=jnp.float32,
+        remat=False,
+    )
+
+
+def init(config: BertConfig, key: jax.Array) -> Params:
+    c = config
+    d, f, L = c.d_model, c.d_ff, c.n_layers
+    keys = jax.random.split(key, 8)
+
+    def norm(k, fan_in, shape):
+        return jax.random.normal(k, shape, dtype=jnp.float32) / jnp.sqrt(fan_in)
+
+    return {
+        "embed": norm(keys[0], 1, (c.vocab_size, d)),
+        "pos_embed": norm(keys[1], 1, (c.max_seq_len, d)) * 0.02,
+        "layers": {
+            "ln1_scale": jnp.ones((L, d), jnp.float32),
+            "ln1_bias": jnp.zeros((L, d), jnp.float32),
+            "wqkv": norm(keys[2], d, (L, d, 3 * d)),
+            "wo": norm(keys[3], d, (L, d, d)),
+            "ln2_scale": jnp.ones((L, d), jnp.float32),
+            "ln2_bias": jnp.zeros((L, d), jnp.float32),
+            "w_up": norm(keys[4], d, (L, d, f)),
+            "w_down": norm(keys[5], f, (L, f, d)),
+        },
+        "ln_f_scale": jnp.ones((d,), jnp.float32),
+        "ln_f_bias": jnp.zeros((d,), jnp.float32),
+        "mlm_head": norm(keys[6], d, (d, c.vocab_size)),
+    }
+
+
+def logical_axes(config: BertConfig) -> Params:
+    return {
+        "embed": ("vocab", "embed"),
+        "pos_embed": (None, "embed"),
+        "layers": {
+            "ln1_scale": ("layers", None),
+            "ln1_bias": ("layers", None),
+            "wqkv": ("layers", "embed", "heads"),
+            "wo": ("layers", "heads", "embed"),
+            "ln2_scale": ("layers", None),
+            "ln2_bias": ("layers", None),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "ln_f_scale": (None,),
+        "ln_f_bias": (None,),
+        "mlm_head": ("embed", "vocab"),
+    }
+
+
+def layer_norm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _block(x, layer, config):
+    c = config
+    b, s, d = x.shape
+    h = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
+    qkv = h @ layer["wqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(b, s, c.n_heads, c.head_dim)
+    k = k.reshape(b, s, c.n_heads, c.head_dim)
+    v = v.reshape(b, s, c.n_heads, c.head_dim)
+    q = sharding.constrain(q, "batch", "seq", "heads", None)
+    attn = mha_reference(q, k, v, causal=False)
+    attn = attn.reshape(b, s, d)
+    x = x + sharding.constrain(attn @ layer["wo"], "batch", "seq", "act_embed")
+
+    h = layer_norm(x, layer["ln2_scale"], layer["ln2_bias"])
+    ffn = jax.nn.gelu(h @ layer["w_up"]) @ layer["w_down"]
+    return x + sharding.constrain(ffn, "batch", "seq", "act_embed")
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,  # [B, S]
+    config: BertConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """MLM logits [B, S, V]."""
+    c = config
+    params = jax.tree.map(lambda a: a.astype(c.dtype), params)
+    s = tokens.shape[1]
+    x = params["embed"][tokens] + params["pos_embed"][None, :s]
+    x = sharding.constrain(x, "batch", "seq", "act_embed")
+
+    block = lambda x, layer: (_block(x, layer, c), None)
+    if c.remat:
+        block = jax.checkpoint(block)
+    x, _ = jax.lax.scan(block, x, params["layers"])
+
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"])
+    logits = x @ params["mlm_head"]
+    return logits.astype(jnp.float32)
+
+
+def mlm_loss(
+    params: Params,
+    tokens: jax.Array,
+    targets: jax.Array,  # original token at masked positions, -100 elsewhere
+    config: BertConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    logits = forward(params, tokens, config, mesh)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    mask = targets >= 0
+    safe_targets = jnp.where(mask, targets, 0)
+    ll = jnp.take_along_axis(logp, safe_targets[..., None], axis=-1)[..., 0]
+    return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1)

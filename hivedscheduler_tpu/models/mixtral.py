@@ -1,0 +1,248 @@
+"""Mixtral-style sparse-MoE decoder with expert parallelism (BASELINE
+config 5: Mixtral 8x7B expert-parallel on a pinned-cell VC).
+
+GShard-style static-shape MoE, the TPU-native formulation: top-2 routing is
+expressed as dense one-hot dispatch/combine einsums against a fixed expert
+capacity — no dynamic shapes, no sort; everything lands on the MXU and the
+``ep``-sharded expert dim turns the dispatch einsum into an XLA all-to-all
+over ICI. Attention/RoPE/norms are shared with models/transformer.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from ..ops.attention import mha_reference
+from ..parallel import ring, sharding
+from .transformer import rms_norm, rope
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MixtralConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    max_seq_len: int = 8192
+    rope_theta: float = 1000000.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def mixtral_8x7b() -> MixtralConfig:
+    return MixtralConfig()
+
+
+def tiny(vocab: int = 512) -> MixtralConfig:
+    return MixtralConfig(
+        vocab_size=vocab,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        n_experts=4,
+        experts_per_token=2,
+        max_seq_len=256,
+        rope_theta=10000.0,
+        dtype=jnp.float32,
+        remat=False,
+    )
+
+
+def init(config: MixtralConfig, key: jax.Array) -> Params:
+    c = config
+    d, h, hk, dh, f, L, E = (
+        c.d_model, c.n_heads, c.n_kv_heads, c.head_dim, c.d_ff, c.n_layers,
+        c.n_experts,
+    )
+    ks = jax.random.split(key, 10)
+
+    def norm(k, fan_in, shape):
+        return jax.random.normal(k, shape, dtype=jnp.float32) / jnp.sqrt(fan_in)
+
+    return {
+        "embed": norm(ks[0], 1, (c.vocab_size, d)),
+        "layers": {
+            "ln1": jnp.ones((L, d), jnp.float32),
+            "wq": norm(ks[1], d, (L, d, h * dh)),
+            "wk": norm(ks[2], d, (L, d, hk * dh)),
+            "wv": norm(ks[3], d, (L, d, hk * dh)),
+            "wo": norm(ks[4], h * dh, (L, h * dh, d)),
+            "ln2": jnp.ones((L, d), jnp.float32),
+            "router": norm(ks[5], d, (L, d, E)),
+            "w_gate": norm(ks[6], d, (L, E, d, f)),
+            "w_up": norm(ks[7], d, (L, E, d, f)),
+            "w_down": norm(ks[8], f, (L, E, f, d)),
+        },
+        "ln_f": jnp.ones((d,), jnp.float32),
+        "lm_head": norm(ks[9], d, (d, c.vocab_size)),
+    }
+
+
+def logical_axes(config: MixtralConfig) -> Params:
+    return {
+        "embed": ("vocab", "embed"),
+        "layers": {
+            "ln1": ("layers", None),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "ln2": ("layers", None),
+            "router": ("layers", "embed", None),
+            # Experts shard over ep; within an expert, tp shards the ffn.
+            "w_gate": ("layers", "expert", "embed", "mlp"),
+            "w_up": ("layers", "expert", "embed", "mlp"),
+            "w_down": ("layers", "expert", "mlp", "embed"),
+        },
+        "ln_f": (None,),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def moe_ffn(
+    h: jax.Array,  # [B, S, D]
+    layer: Params,
+    config: MixtralConfig,
+) -> Tuple[jax.Array, jax.Array]:
+    """Top-2 routed expert FFN; returns (out [B,S,D], aux_loss).
+
+    Static-shape dispatch: tokens -> [E, C] slots via one-hot einsums
+    (GShard). Tokens over capacity are dropped (their combine weight is 0);
+    the aux load-balancing loss pushes the router toward uniform load.
+    """
+    c = config
+    b, s, d = h.shape
+    E, K = c.n_experts, c.experts_per_token
+    T = b * s
+    capacity = max(K, int(math.ceil(K * T / E * c.capacity_factor)))
+
+    x = h.reshape(T, d)
+    router_logits = (x @ layer["router"]).astype(jnp.float32)  # [T, E]
+    gates = jax.nn.softmax(router_logits, axis=-1)
+
+    # Iteratively pick top-K experts per token, assigning capacity positions
+    # expert-by-expert so earlier tokens win slots (deterministic). Each
+    # round's positions start AFTER the expert's occupancy from previous
+    # rounds (GShard: position_in_expert_2 += sum(mask1) per expert) —
+    # otherwise round-2 tokens collide with round-1 slots.
+    combine = jnp.zeros((T, E, capacity), dtype=jnp.float32)
+    remaining = gates
+    expert_occupancy = jnp.zeros((E,), dtype=jnp.float32)
+    aux_me = jnp.zeros((E,), dtype=jnp.float32)
+    aux_ce = jnp.zeros((E,), dtype=jnp.float32)
+    for _ in range(K):
+        idx = jnp.argmax(remaining, axis=-1)  # [T]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [T, E]
+        gate_k = jnp.sum(gates * onehot, axis=-1)  # [T]
+        # Position of each token within its chosen expert's capacity.
+        pos = (jnp.cumsum(onehot, axis=0) - onehot) + expert_occupancy[None, :]
+        pos_in_expert = jnp.sum(pos * onehot, axis=-1).astype(jnp.int32)
+        fits = pos_in_expert < capacity
+        slot = jax.nn.one_hot(pos_in_expert, capacity, dtype=jnp.float32)
+        combine = combine + (
+            onehot[:, :, None] * slot[:, None, :] *
+            (gate_k * fits)[:, None, None]
+        )
+        expert_occupancy = expert_occupancy + jnp.sum(onehot, axis=0)
+        aux_me = aux_me + jnp.mean(gates * onehot, axis=0)
+        aux_ce = aux_ce + jnp.mean(onehot, axis=0)
+        remaining = remaining * (1.0 - onehot)
+
+    # Load-balancing loss (Switch/GShard): E * sum(me * ce), K-normalized.
+    aux_loss = E * jnp.sum(aux_me * aux_ce) / (K * K)
+
+    dispatch = (combine > 0.0).astype(h.dtype)  # [T, E, C]
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)  # [E, C, D]
+    expert_in = sharding.constrain(expert_in, "expert", None, None)
+    gate = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"])
+    )
+    up = jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"])
+    expert_out = jnp.einsum("ecf,efd->ecd", gate * up, layer["w_down"])
+    expert_out = sharding.constrain(expert_out, "expert", None, None)
+    out = jnp.einsum(
+        "tec,ecd->td", combine.astype(h.dtype), expert_out
+    )  # [T, D]
+    # Renormalize top-K gate mass (Mixtral normalizes the K gates to sum 1).
+    denom = jnp.sum(combine, axis=(1, 2)).astype(h.dtype)  # [T]
+    out = out / jnp.maximum(denom, 1e-9)[:, None]
+    return out.reshape(b, s, d), aux_loss
+
+
+def _block(x, layer, config, mesh, use_ring):
+    c = config
+    b, s, d = x.shape
+    h = rms_norm(x, layer["ln1"])
+    q = (h @ layer["wq"]).reshape(b, s, c.n_heads, c.head_dim)
+    k = (h @ layer["wk"]).reshape(b, s, c.n_kv_heads, c.head_dim)
+    v = (h @ layer["wv"]).reshape(b, s, c.n_kv_heads, c.head_dim)
+    positions = jnp.arange(s)
+    q = rope(q, positions, c.rope_theta)
+    k = rope(k, positions, c.rope_theta)
+    if use_ring:
+        attn = ring.ring_attention(q, k, v, mesh, causal=True)
+    else:
+        attn = mha_reference(q, k, v, causal=True)
+    x = x + attn.reshape(b, s, d) @ layer["wo"]
+
+    h = rms_norm(x, layer["ln2"])
+    moe_out, aux = moe_ffn(h, layer, c)
+    return x + moe_out, aux
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    config: MixtralConfig,
+    mesh: Optional[Mesh] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Returns (logits [B,S,V], total aux load-balancing loss)."""
+    c = config
+    use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
+    params = jax.tree.map(lambda a: a.astype(c.dtype), params)
+    x = params["embed"][tokens]
+    x = sharding.constrain(x, "batch", "seq", "act_embed")
+
+    def block(x, layer):
+        y, aux = _block(x, layer, c, mesh, use_ring)
+        return y, aux
+
+    if c.remat:
+        block = jax.checkpoint(block)
+    x, aux_losses = jax.lax.scan(block, x, params["layers"])
+
+    x = rms_norm(x, params["ln_f"])
+    logits = (x @ params["lm_head"]).astype(jnp.float32)
+    return logits, jnp.sum(aux_losses)
+
+
+def lm_loss(
+    params: Params,
+    tokens: jax.Array,
+    config: MixtralConfig,
+    mesh: Optional[Mesh] = None,
+    aux_weight: float = 0.01,
+) -> jax.Array:
+    logits, aux = forward(params, tokens, config, mesh)
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    ll = jnp.take_along_axis(logp, tokens[:, 1:][..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll) + aux_weight * aux

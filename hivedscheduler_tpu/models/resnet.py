@@ -1,0 +1,176 @@
+"""ResNet-50, TPU-first (BASELINE config 2: single-host v5e-4 data parallel).
+
+Functional JAX implementation: NCHW->NHWC (TPU conv layout), bf16 compute
+with f32 batch-norm statistics, ``lax.conv_general_dilated`` so XLA tiles
+convs onto the MXU. Parallelism is batch-only (dp/fsdp), matching the
+single-host BASELINE config; params replicate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+# (blocks per stage) for ResNet-50
+STAGES = (3, 4, 6, 3)
+STAGE_WIDTHS = (256, 512, 1024, 2048)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return jax.random.normal(key, (kh, kw, cin, cout), dtype=jnp.float32) * (
+        2.0 / fan_in
+    ) ** 0.5
+
+
+def _bn_init(c):
+    return {
+        "scale": jnp.ones((c,), jnp.float32),
+        "bias": jnp.zeros((c,), jnp.float32),
+    }
+
+
+def _bn_stats(c):
+    return {
+        "mean": jnp.zeros((c,), jnp.float32),
+        "var": jnp.ones((c,), jnp.float32),
+    }
+
+
+def init(config: ResNetConfig, key: jax.Array) -> Tuple[Params, Params]:
+    """Returns (params, batch_stats)."""
+    keys = iter(jax.random.split(key, 200))
+    params: Params = {
+        "stem": {"conv": _conv_init(next(keys), 7, 7, 3, config.width),
+                  "bn": _bn_init(config.width)},
+        "stages": [],
+        "head": jax.random.normal(
+            next(keys), (STAGE_WIDTHS[-1], config.num_classes), dtype=jnp.float32
+        ) / STAGE_WIDTHS[-1] ** 0.5,
+    }
+    stats: Params = {"stem": _bn_stats(config.width), "stages": []}
+    cin = config.width
+    for stage_idx, n_blocks in enumerate(STAGES):
+        cout = STAGE_WIDTHS[stage_idx]
+        mid = cout // 4
+        stage_p, stage_s = [], []
+        for b in range(n_blocks):
+            block_p = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, mid),
+                "bn1": _bn_init(mid),
+                "conv2": _conv_init(next(keys), 3, 3, mid, mid),
+                "bn2": _bn_init(mid),
+                "conv3": _conv_init(next(keys), 1, 1, mid, cout),
+                "bn3": _bn_init(cout),
+            }
+            block_s = {
+                "bn1": _bn_stats(mid),
+                "bn2": _bn_stats(mid),
+                "bn3": _bn_stats(cout),
+            }
+            if b == 0:
+                block_p["proj"] = _conv_init(next(keys), 1, 1, cin, cout)
+                block_p["bn_proj"] = _bn_init(cout)
+                block_s["bn_proj"] = _bn_stats(cout)
+            stage_p.append(block_p)
+            stage_s.append(block_s)
+            cin = cout
+        params["stages"].append(stage_p)
+        stats["stages"].append(stage_s)
+    return params, stats
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x,
+        w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _bn(x, p, s, train: bool, momentum=0.9, eps=1e-5):
+    """Batch norm; returns (y, new_stats). Stats stay f32."""
+    if train:
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=(0, 1, 2))
+        var = jnp.var(x32, axis=(0, 1, 2))
+        new_s = {
+            "mean": momentum * s["mean"] + (1 - momentum) * mean,
+            "var": momentum * s["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = jax.lax.rsqrt(var + eps) * p["scale"]
+    y = (x.astype(jnp.float32) - mean) * inv + p["bias"]
+    return y.astype(x.dtype), new_s
+
+
+def forward(
+    params: Params,
+    stats: Params,
+    images: jax.Array,  # [B, H, W, 3]
+    config: ResNetConfig,
+    train: bool = False,
+) -> Tuple[jax.Array, Params]:
+    """Returns (logits [B, num_classes], new_batch_stats)."""
+    x = images.astype(config.dtype)
+    x = _conv(x, params["stem"]["conv"], stride=2)
+    x, stem_s = _bn(x, params["stem"]["bn"], stats["stem"], train)
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+
+    new_stats: Params = {"stem": stem_s, "stages": []}
+    for stage_idx, stage in enumerate(params["stages"]):
+        stage_stats = []
+        for b, block in enumerate(stage):
+            stride = 2 if (b == 0 and stage_idx > 0) else 1
+            shortcut = x
+            y = _conv(x, block["conv1"])
+            y, s1 = _bn(y, block["bn1"], stats["stages"][stage_idx][b]["bn1"], train)
+            y = jax.nn.relu(y)
+            y = _conv(y, block["conv2"], stride=stride)
+            y, s2 = _bn(y, block["bn2"], stats["stages"][stage_idx][b]["bn2"], train)
+            y = jax.nn.relu(y)
+            y = _conv(y, block["conv3"])
+            y, s3 = _bn(y, block["bn3"], stats["stages"][stage_idx][b]["bn3"], train)
+            bs = {"bn1": s1, "bn2": s2, "bn3": s3}
+            if "proj" in block:
+                shortcut = _conv(x, block["proj"], stride=stride)
+                shortcut, sp = _bn(
+                    shortcut,
+                    block["bn_proj"],
+                    stats["stages"][stage_idx][b]["bn_proj"],
+                    train,
+                )
+                bs["bn_proj"] = sp
+            x = jax.nn.relu(y + shortcut)
+            stage_stats.append(bs)
+        new_stats["stages"].append(stage_stats)
+
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))  # global average pool
+    logits = x @ params["head"]
+    return logits, new_stats
+
+
+def loss_fn(params, stats, images, labels, config, train=True):
+    logits, new_stats = forward(params, stats, images, config, train=train)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[:, None], axis=-1)
+    return -jnp.mean(ll), new_stats

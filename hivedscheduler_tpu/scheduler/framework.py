@@ -127,6 +127,11 @@ class HivedScheduler:
         # way the reference spawns a goroutine (scheduler.go:505,533). Tests
         # pass a synchronous executor for determinism.
         force_bind_executor: Optional[Callable[[Callable[[], None]], None]] = None,
+        # Standalone/simulation mode: admit never-informed pods at filter
+        # time instead of relying on an informer to deliver them first
+        # (production keeps the reference behavior: reject and let the
+        # default scheduler retry after the informer catches up).
+        auto_admit: bool = False,
     ) -> None:
         self.config = config
         self.kube_client = kube_client or NullKubeClient()
@@ -141,6 +146,7 @@ class HivedScheduler:
         # Node cache standing in for the node lister (used by
         # validate_pod_bind_info; reference: scheduler.go:385-421).
         self.nodes: Dict[str, Node] = {}
+        self.auto_admit = auto_admit
         self._spawn = force_bind_executor or self._default_executor
 
     @staticmethod
@@ -254,10 +260,15 @@ class HivedScheduler:
     # Admission + bind validation (reference: scheduler.go:362-466)
     # ------------------------------------------------------------------ #
 
-    def _admission_check(self, uid: str) -> PodScheduleStatus:
+    def _admission_check(
+        self, uid: str, pod: Optional[Pod] = None
+    ) -> PodScheduleStatus:
         """Only live unbound hived pods may be scheduled
         (reference: scheduler.go:364-383)."""
         status = self.pod_schedule_statuses.get(uid)
+        if status is None and self.auto_admit and pod is not None:
+            self._add_unbound_pod(pod)
+            status = self.pod_schedule_statuses.get(uid)
         if status is None:
             raise api.bad_request(
                 "Pod does not exist, completed or has not been informed to "
@@ -350,7 +361,7 @@ class HivedScheduler:
         pod = args.pod
         suggested_nodes = args.node_names
 
-        status = self._admission_check(pod.uid)
+        status = self._admission_check(pod.uid, pod)
         if status.pod_state == PodState.BINDING:
             # Insist on the previous bind result: binding is idempotent and
             # the algorithm has already assumed it allocated
@@ -465,7 +476,7 @@ class HivedScheduler:
             # default scheduler found lower-priority victims.
             suggested_nodes = list(args.node_name_to_meta_victims.keys())
 
-            status = self._admission_check(pod.uid)
+            status = self._admission_check(pod.uid, pod)
             if status.pod_state == PodState.BINDING:
                 raise api.bad_request(
                     f"Pod has already been binding to node {status.pod.node_name}"

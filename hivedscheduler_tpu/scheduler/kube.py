@@ -1,0 +1,350 @@
+"""Minimal Kubernetes API client + informer loop (stdlib only).
+
+Production equivalent of the reference's client-go usage: a REST client for
+the two writes/reads the scheduler needs (pod binding, list/watch of pods
+and nodes), and an informer-style loop that converts watch events into the
+framework's add/update/delete callbacks (reference:
+pkg/scheduler/scheduler.go:132-173, pkg/internal/utils.go:291-314).
+
+In-cluster auth: service-account bearer token + CA bundle from the standard
+paths; out-of-cluster: pass the apiserver address (e.g. via kubectl proxy).
+No third-party deps — urllib with a persistent-ish connection per watch.
+"""
+
+from __future__ import annotations
+
+import json
+import ssl
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, Iterable, Optional
+
+from .. import common
+from ..api import constants, extender as ei
+from .framework import HivedScheduler, KubeClient
+from .types import Node, Pod, is_interested
+
+SA_TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"  # noqa: S105
+SA_CA_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+
+
+class KubeAPIClient(KubeClient):
+    """The thin K8s REST surface the scheduler needs."""
+
+    def __init__(
+        self,
+        base_url: str,
+        token_path: Optional[str] = SA_TOKEN_PATH,
+        ca_path: Optional[str] = SA_CA_PATH,
+        timeout_s: float = 10.0,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self._token = None
+        if token_path:
+            try:
+                with open(token_path) as f:
+                    self._token = f.read().strip()
+            except OSError:
+                self._token = None
+        self._ssl_context: Optional[ssl.SSLContext] = None
+        if self.base_url.startswith("https"):
+            ctx = ssl.create_default_context()
+            if ca_path:
+                try:
+                    ctx.load_verify_locations(ca_path)
+                except OSError:
+                    pass
+            self._ssl_context = ctx
+
+    # A watch request is bounded server-side (timeoutSeconds) and the socket
+    # read is bounded client-side, so a half-open TCP connection can never
+    # freeze an informer thread forever.
+    WATCH_TIMEOUT_SECONDS = 300
+    WATCH_READ_TIMEOUT_S = 330.0
+
+    def _request(
+        self, method: str, path: str, body: Optional[dict] = None,
+        stream: bool = False,
+    ):
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=json.dumps(body).encode() if body is not None else None,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        if self._token:
+            req.add_header("Authorization", f"Bearer {self._token}")
+        resp = urllib.request.urlopen(  # noqa: S310
+            req,
+            timeout=self.WATCH_READ_TIMEOUT_S if stream else self.timeout_s,
+            context=self._ssl_context,
+        )
+        if stream:
+            return resp
+        with resp:
+            return json.loads(resp.read() or b"{}")
+
+    # ---------------- writes ---------------- #
+
+    def bind_pod(self, binding_pod: Pod) -> None:
+        """POST the Binding subresource, carrying our annotations — K8s
+        merges Binding metadata annotations onto the pod, which is how the
+        bind-info 'checkpoint' is persisted atomically with the bind
+        (reference: internal/utils.go:291-314)."""
+        body = {
+            "apiVersion": "v1",
+            "kind": "Binding",
+            "metadata": {
+                "name": binding_pod.name,
+                "namespace": binding_pod.namespace,
+                "uid": binding_pod.uid,
+                "annotations": {
+                    key: binding_pod.annotations[key]
+                    for key in (
+                        constants.ANNOTATION_POD_LEAF_CELL_ISOLATION,
+                        constants.ANNOTATION_POD_BIND_INFO,
+                        constants.ANNOTATION_POD_TPU_ENV,
+                    )
+                    if key in binding_pod.annotations
+                },
+            },
+            "target": {
+                "apiVersion": "v1",
+                "kind": "Node",
+                "name": binding_pod.node_name,
+            },
+        }
+        self._request(
+            "POST",
+            f"/api/v1/namespaces/{binding_pod.namespace}/pods/"
+            f"{binding_pod.name}/binding",
+            body,
+        )
+
+    # ---------------- reads ---------------- #
+
+    def list_raw(self, path: str) -> Dict:
+        """List returning the raw object (items + metadata.resourceVersion)."""
+        return self._request("GET", path)
+
+    def list_nodes(self) -> Iterable[Node]:
+        for item in self.list_raw("/api/v1/nodes").get("items", []):
+            yield _node_from_k8s(item)
+
+    def list_pods(self) -> Iterable[Pod]:
+        for item in self.list_raw("/api/v1/pods").get("items", []):
+            yield ei.pod_from_k8s(item)
+
+    def watch(
+        self, path: str, resource_version: str = ""
+    ) -> Iterable[Dict]:
+        """Yield watch events from one bounded watch request. Returns when
+        the server closes the stream (timeoutSeconds) — the caller tracks
+        resourceVersion and relists on gaps (InformerLoop)."""
+        url = (
+            f"{path}?watch=true&allowWatchBookmarks=true"
+            f"&timeoutSeconds={self.WATCH_TIMEOUT_SECONDS}"
+        )
+        if resource_version:
+            url += f"&resourceVersion={resource_version}"
+        resp = self._request("GET", url, stream=True)
+        with resp:
+            for line in resp:
+                if line.strip():
+                    yield json.loads(line)
+
+
+def _node_from_k8s(obj: Dict) -> Node:
+    status = obj.get("status") or {}
+    ready = any(
+        c.get("type") == "Ready" and c.get("status") == "True"
+        for c in status.get("conditions", [])
+    )
+    return Node(
+        name=str((obj.get("metadata") or {}).get("name", "")),
+        unschedulable=bool((obj.get("spec") or {}).get("unschedulable", False)),
+        ready=ready,
+    )
+
+
+class InformerLoop:
+    """Watch nodes + pods, dispatch to the framework (reference informer
+    callbacks, scheduler.go:218-304). ``start`` performs the initial list
+    (recovery) before returning, so the caller can gate webserver startup on
+    it exactly like the reference's WaitForCacheSync (scheduler.go:200-212).
+
+    Fault model (what client-go reflectors provide and this loop must too):
+    every watch is bounded; when it ends — or the resourceVersion is too old
+    (410 Gone) — the loop RELISTS and diffs against its cache, synthesizing
+    ADDED/MODIFIED/DELETED for anything that changed during the gap. That is
+    what prevents a deleted pod's cells from leaking forever after a missed
+    DELETE event. Reconnects back off exponentially.
+    """
+
+    BACKOFF_INITIAL_S = 0.5
+    BACKOFF_MAX_S = 30.0
+
+    def __init__(self, scheduler: HivedScheduler, client: KubeAPIClient):
+        self.scheduler = scheduler
+        self.client = client
+        self._threads: list[threading.Thread] = []
+        self._known_pods: Dict[str, Pod] = {}
+        self._known_nodes: Dict[str, Node] = {}
+
+    def start(self) -> None:
+        nodes_rv = self._relist_nodes()
+        pods_rv = self._relist_pods(initial=True)
+        for path, handler, relist, rv in (
+            ("/api/v1/nodes", self._on_node_event, self._relist_nodes,
+             nodes_rv),
+            ("/api/v1/pods", self._on_pod_event, self._relist_pods, pods_rv),
+        ):
+            t = threading.Thread(
+                target=self._watch_loop,
+                args=(path, handler, relist, rv),
+                daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    # ---------------- relist (the recovery primitive) ---------------- #
+
+    def _relist_nodes(self) -> str:
+        data = self.client.list_raw("/api/v1/nodes")
+        fresh = {
+            n.name: n
+            for n in (_node_from_k8s(i) for i in data.get("items", []))
+        }
+        for name in list(self._known_nodes):
+            if name not in fresh:
+                self.scheduler.delete_node(self._known_nodes.pop(name))
+        for name, node in fresh.items():
+            old = self._known_nodes.get(name)
+            self._known_nodes[name] = node
+            if old is None:
+                self.scheduler.add_node(node)
+            else:
+                self.scheduler.update_node(old, node)
+        return str((data.get("metadata") or {}).get("resourceVersion", ""))
+
+    def _relist_pods(self, initial: bool = False) -> str:
+        data = self.client.list_raw("/api/v1/pods")
+        fresh = {
+            p.uid: p
+            for p in (ei.pod_from_k8s(i) for i in data.get("items", []))
+            if is_interested(p)
+        }
+        for uid in list(self._known_pods):
+            if uid not in fresh:
+                self.scheduler.delete_pod(self._known_pods.pop(uid))
+        for uid, pod in fresh.items():
+            old = self._known_pods.get(uid)
+            self._known_pods[uid] = pod
+            if old is None or initial:
+                self.scheduler.add_pod(pod)
+            else:
+                self.scheduler.update_pod(old, pod)
+        return str((data.get("metadata") or {}).get("resourceVersion", ""))
+
+    # ---------------- watch loop ---------------- #
+
+    def _watch_loop(
+        self,
+        path: str,
+        handler: Callable[[Dict], str],
+        relist: Callable[[], str],
+        resource_version: str,
+    ) -> None:
+        backoff = self.BACKOFF_INITIAL_S
+        while True:
+            try:
+                for event in self.client.watch(path, resource_version):
+                    backoff = self.BACKOFF_INITIAL_S
+                    if event.get("type") == "ERROR":
+                        # Typically 410 Gone: our resourceVersion expired.
+                        raise _WatchGap(str(event.get("object")))
+                    rv = self._handle(event, handler)
+                    if rv:
+                        resource_version = rv
+                # Bounded watch ended normally; resume from the last RV.
+            except _WatchGap as e:
+                common.log.warning("watch %s gap (%s); relisting", path, e)
+                resource_version = self._safe_relist(relist)
+            except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+                common.log.warning(
+                    "watch %s reconnecting in %.1fs: %s", path, backoff, e
+                )
+                time.sleep(backoff)
+                backoff = min(backoff * 2, self.BACKOFF_MAX_S)
+                # The connection may have dropped events; relist to repair.
+                resource_version = self._safe_relist(relist)
+
+    def _safe_relist(self, relist: Callable[[], str]) -> str:
+        try:
+            return relist()
+        except Exception as e:  # noqa: BLE001
+            common.log.warning("relist failed, will retry: %s", e)
+            return ""
+
+    def _handle(self, event: Dict, handler: Callable[[Dict], str]) -> str:
+        try:
+            handler(event)
+        except Exception:  # noqa: BLE001
+            common.log.exception("informer handler error")
+        return str(
+            ((event.get("object") or {}).get("metadata") or {}).get(
+                "resourceVersion", ""
+            )
+        )
+
+    # ---------------- event handlers ---------------- #
+
+    def _on_node_event(self, event: Dict) -> None:
+        kind = event.get("type")
+        if kind == "BOOKMARK":
+            return
+        node = _node_from_k8s(event.get("object") or {})
+        if kind == "ADDED":
+            self._known_nodes[node.name] = node
+            self.scheduler.add_node(node)
+        elif kind == "MODIFIED":
+            old = self._known_nodes.get(node.name)
+            self._known_nodes[node.name] = node
+            if old is None:
+                self.scheduler.add_node(node)
+            else:
+                self.scheduler.update_node(old, node)
+        elif kind == "DELETED":
+            self._known_nodes.pop(node.name, None)
+            self.scheduler.delete_node(node)
+
+    def _on_pod_event(self, event: Dict) -> None:
+        kind = event.get("type")
+        if kind == "BOOKMARK":
+            return
+        pod = ei.pod_from_k8s(event.get("object") or {})
+        if kind == "ADDED":
+            if is_interested(pod):
+                self._known_pods[pod.uid] = pod
+                self.scheduler.add_pod(pod)
+        elif kind == "MODIFIED":
+            old = self._known_pods.get(pod.uid)
+            if old is None:
+                # First sighting (became interested late, or its ADDED fell
+                # in a watch gap): admit it now.
+                if is_interested(pod):
+                    self._known_pods[pod.uid] = pod
+                    self.scheduler.add_pod(pod)
+                return
+            self._known_pods[pod.uid] = pod
+            self.scheduler.update_pod(old, pod)
+        elif kind == "DELETED":
+            self._known_pods.pop(pod.uid, None)
+            self.scheduler.delete_pod(pod)
+
+
+class _WatchGap(Exception):
+    """The watch stream reported an ERROR event (e.g. 410 Gone)."""

@@ -1,0 +1,57 @@
+"""End-to-end CLI test: `python -m hivedscheduler_tpu --standalone` serves
+the example config over HTTP and exits on config change (restart-based
+reconfiguration, reference: api/config.go:202-217)."""
+
+import json
+import pathlib
+import shutil
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+PORT = 19473  # unlikely-to-collide test port
+
+
+def wait_http(url, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            with urllib.request.urlopen(url, timeout=1) as resp:
+                return json.loads(resp.read())
+        except (urllib.error.URLError, OSError):
+            time.sleep(0.2)
+    raise TimeoutError(url)
+
+
+def test_cli_standalone_serves_and_restarts_on_config_change(tmp_path):
+    config_path = tmp_path / "hivedscheduler.yaml"
+    text = (REPO / "example/config/hivedscheduler.yaml").read_text()
+    config_path.write_text(text.replace('":9096"', f'"127.0.0.1:{PORT}"'))
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "hivedscheduler_tpu", "--standalone",
+         "--config", str(config_path)],
+        cwd=REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        status = wait_http(f"http://127.0.0.1:{PORT}/v1/inspect/clusterstatus")
+        assert set(status["virtualClusters"]) == {"prod", "research"}
+        # 2 v5p-64 + 2 v5e-16 + 1 v5e host + 2 cpu hosts
+        assert len(status["physicalCluster"]) == 7
+
+        version = wait_http(f"http://127.0.0.1:{PORT}/v1")
+        assert version["component"] == "hivedscheduler-tpu"
+
+        # Touching the config with new content must make the process exit
+        # (the supervisor then restarts it into recovery).
+        config_path.write_text(config_path.read_text() + "\n# changed\n")
+        assert proc.wait(timeout=30) == 1
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()

@@ -1,0 +1,133 @@
+"""Informer loop tests with a fake kube client: event dispatch, unknown-pod
+MODIFIED admission, and relist-and-diff recovery after a watch gap (the
+missed-DELETE cell-leak scenario)."""
+
+import logging
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.api import constants
+from hivedscheduler_tpu.scheduler.framework import HivedScheduler, NullKubeClient
+from hivedscheduler_tpu.scheduler.kube import InformerLoop
+from hivedscheduler_tpu.scheduler.types import PodState
+
+from .test_config_compiler import tpu_design_config
+from .test_core import make_pod
+
+common.init_logging(logging.ERROR)
+
+
+def pod_to_k8s_item(pod):
+    return {
+        "metadata": {
+            "name": pod.name,
+            "namespace": pod.namespace,
+            "uid": pod.uid,
+            "annotations": dict(pod.annotations),
+            "resourceVersion": "1",
+        },
+        "spec": {
+            "nodeName": pod.node_name,
+            "containers": [
+                {"resources": {"limits": dict(pod.resource_limits)}}
+            ],
+        },
+        "status": {"phase": pod.phase},
+    }
+
+
+def node_item(name):
+    return {
+        "metadata": {"name": name, "resourceVersion": "1"},
+        "spec": {},
+        "status": {"conditions": [{"type": "Ready", "status": "True"}]},
+    }
+
+
+class FakeKube(NullKubeClient):
+    """Only the surface InformerLoop uses: list_raw (watch isn't started in
+    these tests — we call the relist/_on_*_event seams directly)."""
+
+    def __init__(self, nodes, pods):
+        super().__init__()
+        self.nodes = nodes
+        self.pods = pods
+
+    def list_raw(self, path):
+        items = (
+            [node_item(n) for n in self.nodes]
+            if path.endswith("nodes")
+            else [pod_to_k8s_item(p) for p in self.pods]
+        )
+        return {"items": items, "metadata": {"resourceVersion": "9"}}
+
+
+def build(nodes, pods):
+    sched = HivedScheduler(tpu_design_config())
+    fake = FakeKube(nodes, pods)
+    loop = InformerLoop(sched, fake)
+    return sched, fake, loop
+
+
+def all_node_names(sched):
+    return sorted(
+        {
+            n
+            for ccl in sched.core.full_cell_list.values()
+            for c in ccl[ccl.top_level]
+            for n in c.nodes
+        }
+    )
+
+
+def test_initial_relist_recovers_and_watch_delete_releases():
+    sched0 = HivedScheduler(tpu_design_config())
+    names = all_node_names(sched0)
+
+    pod = make_pod("a-0", "ua", "VC1", 0, "v5e-chip", 4)
+    sched, fake, loop = build(names, [])
+    rv = loop._relist_nodes()
+    assert rv == "9"
+    loop._relist_pods(initial=True)
+
+    # Unbound pod arrives via watch.
+    loop._on_pod_event({"type": "ADDED", "object": pod_to_k8s_item(pod)})
+    assert sched.pod_schedule_statuses["ua"].pod_state == PodState.WAITING
+
+    # DELETED releases it.
+    loop._on_pod_event({"type": "DELETED", "object": pod_to_k8s_item(pod)})
+    assert "ua" not in sched.pod_schedule_statuses
+
+
+def test_modified_for_unknown_pod_admits_it():
+    sched, fake, loop = build(all_node_names(HivedScheduler(tpu_design_config())), [])
+    loop._relist_nodes()
+    pod = make_pod("late", "ul", "VC1", 0, "v5e-chip", 4)
+    # The pod's ADDED fell into a watch gap; only MODIFIED arrives.
+    loop._on_pod_event({"type": "MODIFIED", "object": pod_to_k8s_item(pod)})
+    assert sched.pod_schedule_statuses["ul"].pod_state == PodState.WAITING
+
+
+def test_relist_diff_synthesizes_missed_delete():
+    names = all_node_names(HivedScheduler(tpu_design_config()))
+    pod = make_pod("gone", "ug", "VC1", 0, "v5e-chip", 4)
+    sched, fake, loop = build(names, [])
+    loop._relist_nodes()
+    loop._relist_pods(initial=True)
+    loop._on_pod_event({"type": "ADDED", "object": pod_to_k8s_item(pod)})
+    assert "ug" in sched.pod_schedule_statuses
+
+    # The pod is deleted while the watch is down: no DELETED event ever
+    # arrives. The reconnect relist must notice and release it.
+    fake.pods = []
+    loop._relist_pods()
+    assert "ug" not in sched.pod_schedule_statuses
+
+
+def test_relist_diff_synthesizes_missed_node_delete():
+    names = all_node_names(HivedScheduler(tpu_design_config()))
+    sched, fake, loop = build(names, [])
+    loop._relist_nodes()
+    assert "v5e16a-w0" in sched.nodes
+    fake.nodes = [n for n in names if n != "v5e16a-w0"]
+    loop._relist_nodes()
+    assert "v5e16a-w0" not in sched.nodes
