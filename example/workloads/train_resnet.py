@@ -29,11 +29,12 @@ def main():
 
     key = jax.random.PRNGKey(1)
     for i in range(20):
+        key, k_img, k_lbl = jax.random.split(key, 3)
         images = sharding.shard_batch(
-            jax.random.normal(key, (32 * n, 224, 224, 3)), mesh
+            jax.random.normal(k_img, (32 * n, 224, 224, 3)), mesh
         )
         labels = sharding.shard_batch(
-            jax.random.randint(key, (32 * n,), 0, 1000), mesh
+            jax.random.randint(k_lbl, (32 * n,), 0, 1000), mesh
         )
         params, stats, opt_state, loss = step(
             params, stats, opt_state, images, labels

@@ -45,8 +45,88 @@ def to_yaml(obj: Any) -> str:
 
 def from_yaml(text: str) -> Any:
     """Deserialize YAML; raises on malformed input
-    (reference: common/utils.go:183-189 ``FromYaml`` panics on error)."""
+    (reference: common/utils.go:183-189 ``FromYaml`` panics on error).
+
+    JSON is valid YAML — documents that look like JSON take the C json
+    parser (the bind-info annotation is written that way; see
+    new_binding_pod), everything else the libyaml loader."""
+    stripped = text.lstrip()
+    if stripped[:1] in ("{", "["):
+        try:
+            return json.loads(text)
+        except json.JSONDecodeError:
+            pass
     return yaml.load(text, Loader=_SafeLoader)
+
+
+_BARE_SCALAR = __import__("re").compile(r"^[A-Za-z][A-Za-z0-9_./-]*$")
+_BOOLISH = {"true", "false", "yes", "no", "on", "off", "null", "~"}
+
+
+def _fast_scalar(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if v is None:
+        return "null"
+    s = str(v)
+    if _BARE_SCALAR.match(s) and s.lower() not in _BOOLISH:
+        return s
+    return json.dumps(s)  # JSON string quoting is valid YAML
+
+
+def _fast_emit(obj: Any, indent: str, lines: List[str]) -> None:
+    pad = indent
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            key = _fast_scalar(k)
+            if isinstance(v, dict) and v:
+                lines.append(f"{pad}{key}:")
+                _fast_emit(v, indent + "  ", lines)
+            elif isinstance(v, list) and v:
+                lines.append(f"{pad}{key}:")
+                _fast_emit(v, indent, lines)
+            elif isinstance(v, (dict, list)):
+                lines.append(f"{pad}{key}: {'{}' if isinstance(v, dict) else '[]'}")
+            else:
+                lines.append(f"{pad}{key}: {_fast_scalar(v)}")
+    elif isinstance(obj, list):
+        for item in obj:
+            if isinstance(item, dict) and item:
+                first, *rest = item.items()
+                k, v = first
+                if isinstance(v, (dict, list)) and v:
+                    lines.append(f"{pad}- {_fast_scalar(k)}:")
+                    _fast_emit(v, indent + ("    " if isinstance(v, dict) else "  "), lines)
+                else:
+                    lines.append(
+                        f"{pad}- {_fast_scalar(k)}: "
+                        f"{'{}' if v == {} else '[]' if v == [] else _fast_scalar(v)}"
+                    )
+                sub: List[str] = []
+                _fast_emit(dict(rest), indent + "  ", sub)
+                lines.extend(sub)
+            elif isinstance(item, list):
+                if not item:
+                    lines.append(f"{pad}- []")
+                else:
+                    lines.append(f"{pad}-")
+                    _fast_emit(item, indent + "  ", lines)
+            elif item == {}:
+                lines.append(f"{pad}- {{}}")
+            else:
+                lines.append(f"{pad}- {_fast_scalar(item)}")
+
+
+def to_yaml_fast(obj: Any) -> str:
+    """Hand-rolled YAML emitter for the annotation hot path (bind info / env
+    blocks): plain dicts/lists/scalars only, ~20x PyYAML's Python
+    representer. Output is ordinary block YAML, readable by any loader;
+    round-trip is asserted in tests."""
+    lines: List[str] = []
+    _fast_emit(obj, "", lines)
+    return "\n".join(lines) + "\n"
 
 
 @functools.lru_cache(maxsize=8192)

@@ -1,0 +1,75 @@
+"""Sharded checkpoint/resume for training jobs (orbax-backed).
+
+The scheduler's own checkpoint story is pod annotations (SURVEY.md §5);
+this is the *workload* half: periodically persist sharded params/opt-state
+so a preempted or rescheduled gang (the scheduler's whole point) resumes
+instead of restarting. Orbax writes each process's shards in parallel and
+restores directly into the target NamedShardings — no host-side full copy.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+
+
+def _manager(directory: str, max_to_keep: int = 3):
+    import orbax.checkpoint as ocp
+
+    return ocp.CheckpointManager(
+        directory,
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep, create=True
+        ),
+    )
+
+
+class TrainCheckpointer:
+    """Save/restore (params, opt_state, step) with their shardings."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self._mgr = _manager(self.directory, max_to_keep)
+
+    def save(self, step: int, params: Any, opt_state: Any) -> None:
+        import orbax.checkpoint as ocp
+
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardSave(params),
+                opt_state=ocp.args.StandardSave(opt_state),
+            ),
+        )
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def restore(
+        self,
+        params_like: Any,
+        opt_state_like: Any,
+        step: Optional[int] = None,
+    ) -> Tuple[Any, Any, int]:
+        """Restore into the shardings/dtypes of the provided abstract trees
+        (pass the live trees or jax.eval_shape results + shardings)."""
+        import orbax.checkpoint as ocp
+
+        step = self.latest_step() if step is None else step
+        assert step is not None, f"no checkpoint found under {self.directory}"
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(params_like),
+                opt_state=ocp.args.StandardRestore(opt_state_like),
+            ),
+        )
+        return restored["params"], restored["opt_state"], step
+
+    def close(self) -> None:
+        self._mgr.close()

@@ -33,6 +33,10 @@ SA_CA_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
 class KubeAPIClient(KubeClient):
     """The thin K8s REST surface the scheduler needs."""
 
+    # Bound SA tokens expire (~1h) and the kubelet rotates the file; re-read
+    # it periodically the way client-go does.
+    TOKEN_REFRESH_S = 300.0
+
     def __init__(
         self,
         base_url: str,
@@ -42,13 +46,10 @@ class KubeAPIClient(KubeClient):
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
-        self._token = None
-        if token_path:
-            try:
-                with open(token_path) as f:
-                    self._token = f.read().strip()
-            except OSError:
-                self._token = None
+        self._token_path = token_path
+        self._token: Optional[str] = None
+        self._token_read_at = 0.0
+        self._refresh_token()
         self._ssl_context: Optional[ssl.SSLContext] = None
         if self.base_url.startswith("https"):
             ctx = ssl.create_default_context()
@@ -65,10 +66,25 @@ class KubeAPIClient(KubeClient):
     WATCH_TIMEOUT_SECONDS = 300
     WATCH_READ_TIMEOUT_S = 330.0
 
+    def _refresh_token(self) -> None:
+        if not self._token_path:
+            return
+        try:
+            with open(self._token_path) as f:
+                self._token = f.read().strip()
+        except OSError:
+            self._token = self._token  # keep the previous one if any
+        self._token_read_at = time.monotonic()
+
     def _request(
         self, method: str, path: str, body: Optional[dict] = None,
         stream: bool = False,
     ):
+        if (
+            self._token_path
+            and time.monotonic() - self._token_read_at > self.TOKEN_REFRESH_S
+        ):
+            self._refresh_token()
         req = urllib.request.Request(
             self.base_url + path,
             data=json.dumps(body).encode() if body is not None else None,
@@ -267,6 +283,10 @@ class InformerLoop:
                         # Typically 410 Gone: our resourceVersion expired.
                         raise _WatchGap(str(event.get("object")))
                     rv = self._handle(event, handler)
+                    if rv is None:
+                        # Handler failed: do NOT advance past the event —
+                        # relist to reapply the lost change.
+                        raise _WatchGap("handler failure")
                     if rv:
                         resource_version = rv
                 # Bounded watch ended normally; resume from the last RV.
@@ -289,11 +309,16 @@ class InformerLoop:
             common.log.warning("relist failed, will retry: %s", e)
             return ""
 
-    def _handle(self, event: Dict, handler: Callable[[Dict], str]) -> str:
+    def _handle(
+        self, event: Dict, handler: Callable[[Dict], str]
+    ) -> Optional[str]:
+        """Returns the event's resourceVersion, or None on handler failure
+        (the caller then relists instead of advancing past the event)."""
         try:
             handler(event)
         except Exception:  # noqa: BLE001
             common.log.exception("informer handler error")
+            return None
         return str(
             ((event.get("object") or {}).get("metadata") or {}).get(
                 "resourceVersion", ""

@@ -151,10 +151,13 @@ def new_binding_pod(pod: Pod, bind_info: api.PodBindInfo) -> Pod:
     annotations[constants.ANNOTATION_POD_LEAF_CELL_ISOLATION] = (
         common.to_indices_string(bind_info.leaf_cell_isolation)
     )
-    annotations[constants.ANNOTATION_POD_BIND_INFO] = common.to_yaml(
+    # Compact JSON (valid YAML, parsed at C speed on replay): bind-info
+    # serialization+parse happens per pod per filter round and dominates
+    # large-gang latency with the generic YAML codec.
+    annotations[constants.ANNOTATION_POD_BIND_INFO] = common.to_json(
         bind_info.to_dict()
     )
-    annotations[constants.ANNOTATION_POD_TPU_ENV] = common.to_yaml(
+    annotations[constants.ANNOTATION_POD_TPU_ENV] = common.to_yaml_fast(
         tpu_env.pod_tpu_env(bind_info)
     )
     return Pod(

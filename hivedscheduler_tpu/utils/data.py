@@ -1,0 +1,91 @@
+"""Input pipeline: token datasets with async host->device prefetch.
+
+Keeps the MXU fed: while step N computes, batch N+1 is already being
+device_put onto the mesh (double buffering). Sources are memory-mapped
+token files (np.memmap — zero-copy reads, no framework dependency) or any
+iterator of numpy arrays.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..parallel import sharding
+
+
+class TokenFileDataset:
+    """Fixed-length sample view over a flat token file (dtype uint16/32).
+
+    ``path`` is a binary file of token ids; sample i is the half-open
+    window [i*seq_len, (i+1)*seq_len + 1) — the +1 provides the shifted
+    next-token target inside the same sample.
+    """
+
+    def __init__(self, path: str, seq_len: int, dtype=np.uint16):
+        self.tokens = np.memmap(path, dtype=dtype, mode="r")
+        self.seq_len = seq_len
+        self.n_samples = (len(self.tokens) - 1) // seq_len
+        if self.n_samples <= 0:
+            raise ValueError(
+                f"{path}: {len(self.tokens)} tokens < one sample of "
+                f"{seq_len + 1}"
+            )
+
+    def batches(
+        self, batch_size: int, seed: int = 0, epochs: Optional[int] = None
+    ) -> Iterator[np.ndarray]:
+        """Yield [batch, seq_len+1] int32 batches, shuffled per epoch."""
+        rng = np.random.default_rng(seed)
+        epoch = 0
+        while epochs is None or epoch < epochs:
+            order = rng.permutation(self.n_samples)
+            for start in range(0, self.n_samples - batch_size + 1, batch_size):
+                idx = order[start:start + batch_size]
+                batch = np.stack(
+                    [
+                        self.tokens[i * self.seq_len:(i + 1) * self.seq_len + 1]
+                        for i in idx
+                    ]
+                )
+                yield batch.astype(np.int32)
+            epoch += 1
+
+
+def prefetch_to_mesh(
+    batches: Iterable[Any],
+    mesh: Mesh,
+    buffer_size: int = 2,
+    put: Optional[Callable[[Any, Mesh], Any]] = None,
+) -> Iterator[Any]:
+    """Async device transfer: a background thread device_puts up to
+    ``buffer_size`` batches ahead onto the mesh (batch/seq sharding by
+    default), so the transfer overlaps the previous step's compute."""
+    put = put or sharding.shard_batch
+    q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
+    END = object()
+
+    def worker():
+        try:
+            for batch in batches:
+                q.put(put(batch, mesh))
+            q.put(END)
+        except BaseException as e:  # noqa: BLE001
+            # Surface data-source / transfer failures to the consumer —
+            # never let a broken pipeline look like a clean end-of-data.
+            q.put(e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is END:
+            return
+        if isinstance(item, BaseException):
+            raise item
+        yield item

@@ -1,0 +1,78 @@
+"""Round-trip tests for the annotation codecs: the fast YAML emitter must
+produce documents any YAML loader reads back identically, and the JSON fast
+path must be transparent."""
+
+import json
+import random
+import string
+
+import yaml
+
+from hivedscheduler_tpu import common
+
+
+def rand_obj(rng, depth=0):
+    if depth > 3:
+        return rng.choice([1, "leaf", None])
+    kind = rng.random()
+    if kind < 0.4:
+        return {
+            f"k{i}{rng.choice('abc')}": rand_obj(rng, depth + 1)
+            for i in range(rng.randint(0, 4))
+        }
+    if kind < 0.7:
+        return [rand_obj(rng, depth + 1) for _ in range(rng.randint(0, 4))]
+    return rng.choice(
+        [
+            rng.randint(-100, 10000),
+            "".join(
+                rng.choices(string.ascii_letters + "-./_", k=rng.randint(1, 12))
+            ),
+            "has space & colon: here",
+            "",
+            "true",
+            "123",
+            "v5p-w0",
+            None,
+            True,
+            False,
+            3.5,
+        ]
+    )
+
+
+def test_fast_yaml_fuzz_roundtrip():
+    rng = random.Random(7)
+    for _ in range(2000):
+        obj = {"root": rand_obj(rng)}
+        text = common.to_yaml_fast(obj)
+        assert yaml.safe_load(text) == obj, (obj, text)
+
+
+def test_fast_yaml_bind_info_shape():
+    info = {
+        "node": "v5p-w0",
+        "leafCellIsolation": [0, 1, 2, 3],
+        "cellChain": "v5p-64",
+        "affinityGroupBindInfo": [
+            {
+                "podPlacements": [
+                    {
+                        "physicalNode": f"v5p-w{i}",
+                        "physicalLeafCellIndices": [0, 1, 2, 3],
+                        "preassignedCellTypes": ["v5p-64"] * 4,
+                    }
+                    for i in range(16)
+                ]
+            }
+        ],
+    }
+    assert yaml.safe_load(common.to_yaml_fast(info)) == info
+
+
+def test_from_yaml_json_fast_path():
+    obj = {"a": [1, 2, {"b": "x y"}], "n": None}
+    assert common.from_yaml(json.dumps(obj)) == obj
+    assert common.from_yaml(common.to_yaml(obj)) == obj
+    # A YAML doc that merely starts with '{' but isn't JSON still parses.
+    assert common.from_yaml("{a: 1, b: two}") == {"a": 1, "b": "two"}

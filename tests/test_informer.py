@@ -123,6 +123,46 @@ def test_relist_diff_synthesizes_missed_delete():
     assert "ug" not in sched.pod_schedule_statuses
 
 
+def test_handler_failure_does_not_advance_resource_version():
+    # A failing handler must make _handle return None so the watch loop
+    # relists instead of skipping the event.
+    sched, fake, loop = build([], [])
+
+    def bad_handler(event):
+        raise RuntimeError("boom")
+
+    rv = loop._handle(
+        {"type": "ADDED", "object": {"metadata": {"resourceVersion": "5"}}},
+        bad_handler,
+    )
+    assert rv is None
+    rv = loop._handle(
+        {"type": "ADDED", "object": {"metadata": {"resourceVersion": "5"}}},
+        lambda e: None,
+    )
+    assert rv == "5"
+
+
+def test_prefetch_propagates_worker_errors():
+    import pytest
+
+    from hivedscheduler_tpu.parallel import mesh as pmesh
+    from hivedscheduler_tpu.utils.data import prefetch_to_mesh
+    import jax
+
+    mesh = pmesh.make_mesh(pmesh.MeshConfig(fsdp=8), devices=jax.devices())
+
+    def broken():
+        yield __import__("numpy").zeros((8, 4), dtype="int32")
+        raise OSError("storage went away")
+
+    it = prefetch_to_mesh(broken(), mesh)
+    next(it)
+    with pytest.raises(OSError, match="storage went away"):
+        for _ in it:
+            pass
+
+
 def test_relist_diff_synthesizes_missed_node_delete():
     names = all_node_names(HivedScheduler(tpu_design_config()))
     sched, fake, loop = build(names, [])
