@@ -658,17 +658,32 @@ class HivedCore:
                 self._allocate_preassigned_cell(pc, vc_name, True)
 
     def _try_unbind_doomed_bad_cell(self, chain: CellChain, level: CellLevel) -> None:
-        """(reference: hived_algorithm.go:632-653)"""
+        """(reference: hived_algorithm.go:632-653, with one deliberate fix:
+        a doomed-bound cell whose healthy children are MEANWHILE hosting a
+        real allocation — possible because partially-bad cells remain
+        placeable — must not be unbound/released while in use. The reference
+        pops list[0] unguarded; its setHealthyCell applies exactly this
+        priority guard on the sibling path (hived_algorithm.go:535-547), so
+        we apply it here too. Without it, releasing the cell back to the
+        free list while pods run on it corrupts the free lists (found by
+        sequence fuzzing).)"""
         for vc_name, vc_free in self.vc_free_cell_num.items():
             if chain not in vc_free:
                 continue
-            while self.vc_doomed_bad_cells[vc_name][chain][level] and vc_free[
-                chain
-            ].get(level, 0) < (
+            while vc_free[chain].get(level, 0) < (
                 self.total_left_cell_num[chain][level]
                 - len(self.bad_free_cells[chain][level])
             ):
-                pc = self.vc_doomed_bad_cells[vc_name][chain][level][0]
+                pc = next(
+                    (
+                        c
+                        for c in self.vc_doomed_bad_cells[vc_name][chain][level]
+                        if c.priority < MIN_GUARANTEED_PRIORITY
+                    ),
+                    None,
+                )
+                if pc is None:
+                    break  # all doomed cells of this VC/level are in use
                 assert isinstance(pc, PhysicalCell)
                 common.log.info(
                     "Cell %s is no longer doomed to be bad and is unbound "
@@ -1196,7 +1211,13 @@ class HivedCore:
                     assert isinstance(leaf, PhysicalCell)
                     leaf.delete_using_group(g)
                     if leaf.state == CellState.USED:
-                        self._release_leaf_cell(leaf, g.vc)
+                        self._release_leaf_cell(
+                            leaf,
+                            g.vc,
+                            # No virtual placement = opportunistic mode
+                            # (including lazily-preempted groups).
+                            opportunistic=g.virtual_placement is None,
+                        )
                         set_cell_state(leaf, CellState.FREE)
                     else:  # RESERVING: already allocated to a preemptor
                         set_cell_state(leaf, CellState.RESERVED)
@@ -1233,7 +1254,11 @@ class HivedCore:
                     assert isinstance(v_leaf, VirtualCell)
                     if leaf.state == CellState.USED:
                         using_group = leaf.using_group
-                        self._release_leaf_cell(leaf, using_group.vc)
+                        self._release_leaf_cell(
+                            leaf,
+                            using_group.vc,
+                            opportunistic=using_group.virtual_placement is None,
+                        )
                         using_group.state = GroupState.BEING_PREEMPTED
                     self._allocate_leaf_cell(leaf, v_leaf, s.priority, new_group.vc)
                     leaf.add_reserving_or_reserved_group(new_group)
@@ -1355,7 +1380,12 @@ class HivedCore:
                     assert isinstance(leaf, PhysicalCell)
                     v_leaf = virtual[leaf_num][pod_index][leaf_index]
                     assert isinstance(v_leaf, VirtualCell)
-                    self._release_leaf_cell(leaf, g.vc)
+                    # The group is currently opportunistic (lazy-preempted);
+                    # release in that mode so an overlaid doomed-bad binding
+                    # of another VC cannot hijack the release.
+                    self._release_leaf_cell(
+                        leaf, g.vc, opportunistic=g.virtual_placement is None
+                    )
                     self._allocate_leaf_cell(leaf, v_leaf, g.priority, g.vc)
         g.virtual_placement = virtual
         g.lazy_preemption_status = None
@@ -1470,10 +1500,20 @@ class HivedCore:
         return safety_ok, reason
 
     def _release_leaf_cell(
-        self, p_leaf: PhysicalCell, vcn: api.VirtualClusterName
+        self,
+        p_leaf: PhysicalCell,
+        vcn: api.VirtualClusterName,
+        opportunistic: bool = False,
     ) -> None:
-        """(reference: hived_algorithm.go:1327-1353)"""
-        v_leaf = p_leaf.virtual_cell
+        """(reference: hived_algorithm.go:1327-1353, with one deliberate
+        fix: the branch must key off the GROUP's allocation mode, not off
+        ``p_leaf.virtual_cell`` — a doomed-bad binding (possibly of ANOTHER
+        VC) can be overlaid onto cells an opportunistic pod is using, and
+        the reference would then walk the virtual branch and release the
+        other VC's preassigned cell against this VC's counters (found by
+        sequence fuzzing). Allocation already keys off the group's virtual
+        placement; release now mirrors it."""
+        v_leaf = None if opportunistic else p_leaf.virtual_cell
         if v_leaf is not None:
             allocation.update_used_leaf_cell_numbers(
                 v_leaf, v_leaf.priority, False
@@ -1487,17 +1527,34 @@ class HivedCore:
             doomed = self.vc_doomed_bad_cells.get(vcn, {}).get(
                 preassigned_physical.chain
             )
+            is_doomed = doomed is not None and doomed.contains(
+                preassigned_physical, preassigned_physical.level
+            )
             if (
                 not preassigned_physical.pinned
                 and v_leaf.preassigned_cell.priority < MIN_GUARANTEED_PRIORITY
-                and not (
-                    doomed is not None
-                    and doomed.contains(
+            ):
+                if not is_doomed:
+                    self._release_preassigned_cell(
+                        preassigned_physical, vcn, False
+                    )
+                elif preassigned_physical.healthy:
+                    # The cell was doomed bad but healed while its healthy
+                    # part hosted this allocation (so setHealthyCell could
+                    # not retire it — the cell was in use). Now the last use
+                    # is gone and unbind_cell has destroyed the top binding:
+                    # retire the doomed entry and release for real.
+                    doomed.remove(
                         preassigned_physical, preassigned_physical.level
                     )
-                )
-            ):
-                self._release_preassigned_cell(preassigned_physical, vcn, False)
+                    self.all_vc_doomed_bad_cell_num[
+                        preassigned_physical.chain
+                    ][preassigned_physical.level] -= 1
+                    self._release_preassigned_cell(
+                        preassigned_physical, vcn, False
+                    )
+                # else: still bad and doomed-listed; keep the doomed binding
+                # (a bad child is still bound, so unbind_cell stopped early).
         else:
             ot = self._ot_cells.get(vcn, [])
             for i, c in enumerate(ot):
@@ -1533,13 +1590,7 @@ class HivedCore:
                 < self.all_vc_free_cell_num.get(chain, {}).get(l, 0)
             ):
                 safety_ok = False
-                reason = (
-                    "Adding pod would lead to broken safety: cell type "
-                    f"{self.cell_types[chain].get(l)}, "
-                    f"{self.total_left_cell_num[chain][l]} left, "
-                    f"{self.all_vc_free_cell_num[chain][l]} free cells in all "
-                    "VCs"
-                )
+                reason = self._safety_reason(chain, l)
             assert isinstance(parent, PhysicalCell)
             if not parent.healthy:
                 # Bad parent: neither vcFreeCellNum nor healthy-free count
@@ -1563,19 +1614,26 @@ class HivedCore:
                 < self.all_vc_free_cell_num.get(chain, {}).get(l, 0)
             ):
                 safety_ok = False
-                reason = (
-                    "Adding pod would lead to broken safety: cell type "
-                    f"{self.cell_types[chain].get(l)}, "
-                    f"{self.total_left_cell_num[chain][l]} left, "
-                    f"{self.all_vc_free_cell_num[chain][l]} free cells in all "
-                    "VCs"
-                )
+                reason = self._safety_reason(chain, l)
             if not doomed_bad:
                 self._try_bind_doomed_bad_cell(chain, l)
             num_to_reduce *= len(self.full_cell_list[chain][l][0].children) if (
                 l > LOWEST_LEVEL
             ) else 0
         return safety_ok, reason
+
+    def _safety_reason(self, chain: CellChain, l: CellLevel) -> str:
+        """Safety-violation message. Uses .get throughout: total_left can be
+        transiently negative while a nested doomed-bad-cell bind runs in the
+        middle of an alloc/release loop (the reference tolerates this via
+        Go's zero-value maps and ignores safetyOk for doomed binds)."""
+        return (
+            "Adding pod would lead to broken safety: cell type "
+            f"{self.cell_types[chain].get(l)}, "
+            f"{self.total_left_cell_num[chain].get(l, 0)} left, "
+            f"{self.all_vc_free_cell_num.get(chain, {}).get(l, 0)} free "
+            "cells in all VCs"
+        )
 
     def _allocate_bad_cell(self, c: PhysicalCell) -> None:
         """(reference: hived_algorithm.go:1430-1448)"""

@@ -10,6 +10,8 @@ from __future__ import annotations
 import functools
 import json
 import logging
+import math
+import re
 import sys
 from typing import Any, Iterable, List
 
@@ -59,15 +61,27 @@ def from_yaml(text: str) -> Any:
     return yaml.load(text, Loader=_SafeLoader)
 
 
-_BARE_SCALAR = __import__("re").compile(r"^[A-Za-z][A-Za-z0-9_./-]*$")
+_BARE_SCALAR = re.compile(r"^[A-Za-z][A-Za-z0-9_./-]*$")
 _BOOLISH = {"true", "false", "yes", "no", "on", "off", "null", "~"}
 
 
 def _fast_scalar(v: Any) -> str:
     if isinstance(v, bool):
         return "true" if v else "false"
-    if isinstance(v, (int, float)):
+    if isinstance(v, int):
         return repr(v)
+    if isinstance(v, float):
+        # YAML's float resolver needs a dot before any exponent: repr(1e-05)
+        # = "1e-05" would round-trip as a STRING under PyYAML.
+        if math.isnan(v):
+            return ".nan"
+        if math.isinf(v):
+            return ".inf" if v > 0 else "-.inf"
+        s = repr(v)
+        if "e" in s and "." not in s:
+            mantissa, _, exponent = s.partition("e")
+            s = f"{mantissa}.0e{exponent}"
+        return s
     if v is None:
         return "null"
     s = str(v)

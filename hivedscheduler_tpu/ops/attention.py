@@ -1,29 +1,38 @@
-"""Attention ops: XLA reference path + a Pallas flash-attention TPU kernel.
+"""Attention ops: XLA reference path + Pallas flash-attention TPU kernels
+(forward AND backward, wired through custom_vjp).
 
 The reference repo has no compute ops at all (it is a scheduler;
 SURVEY.md §2.2) — these ops exist for the BASELINE workloads the scheduler
-places (ResNet/BERT/Llama/Mixtral). Design per the TPU playbook:
+places. Design per the TPU playbook:
 
-  - The training path uses the XLA implementation: scores/softmax/PV all fuse
-    onto MXU+VPU, XLA derives the backward pass, and bf16 keeps the MXU fed.
-  - The Pallas kernel is the forward flash attention (streaming softmax, no
-    S×S materialization in HBM) for long-context inference where the S×S
-    intermediate would blow HBM; it falls back to XLA off-TPU.
+  - Flash forward: streaming softmax over K/V blocks in VMEM; the S×S score
+    matrix never exists in HBM. Saves the per-row logsumexp for backward.
+  - Flash backward: two kernels — dK/dV per key-block (sweeping query
+    blocks) and dQ per query-block (sweeping key blocks) — recomputing P
+    from Q,K and the saved LSE instead of storing it (remat: FLOPs for HBM,
+    the usual TPU trade).
+  - Off-TPU (and for short sequences) everything falls back to the XLA
+    implementation, which fuses fine and autodiffs itself.
 
-GQA is supported by repeating KV heads; head_dim should be a multiple of 128
-on TPU for lane alignment (pallas_guide.md tiling constraints).
+GQA is supported by repeating KV heads; head_dim should be a multiple of
+128 on TPU for lane alignment (pallas_guide.md tiling constraints).
+Set ``attention.INTERPRET = True`` to run the kernels in interpreter mode
+(hermetic CPU tests do this).
 """
 
 from __future__ import annotations
 
 import functools
 import math
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+# Interpreter mode for pallas kernels (CPU tests); real TPU runs leave False.
+INTERPRET = False
 
 
 def mha_reference(
@@ -60,29 +69,32 @@ def mha_reference(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale, causal,
-                      q_block, seq_len):
-    """One (batch*head, q-block) program: stream K/V blocks through VMEM with
-    an online softmax (m, l running stats), never materializing S×S."""
+###############################################################################
+# Pallas kernels. All operate on [B*H, S, D] ("bh" layout); the public entry
+# reshapes/transposes around them.
+###############################################################################
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, sm_scale,
+                causal, block_q, seq_len):
     import jax.experimental.pallas as pl
 
     q_idx = pl.program_id(1)
     q = q_ref[...]  # [block_q, d]
-    block_q = q.shape[0]
     d = q.shape[-1]
 
-    m = jnp.full((block_q,), NEG_INF, dtype=jnp.float32)
-    l = jnp.zeros((block_q,), dtype=jnp.float32)
-    acc = jnp.zeros((block_q, d), dtype=jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((block_q,), dtype=jnp.float32)
+    acc0 = jnp.zeros((block_q, d), dtype=jnp.float32)
 
-    q_pos = q_idx * q_block + jax.lax.broadcasted_iota(
+    q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
 
     def body(start_k, carry):
         m_prev, l_prev, acc_prev = carry
-        k_blk = pl.load(k_ref, (pl.dslice(start_k * block_k, block_k), slice(None)))
-        v_blk = pl.load(v_ref, (pl.dslice(start_k * block_k, block_k), slice(None)))
+        k_blk = k_ref[pl.dslice(start_k * block_k, block_k), :]
+        v_blk = v_ref[pl.dslice(start_k * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -104,67 +116,293 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, sm_scale, causal,
 
     num_k_blocks = seq_len // block_k
     if causal:
-        # Only blocks at or before this q block contribute.
-        upper = jax.lax.div(
-            (q_idx + 1) * q_block + block_k - 1, jnp.int32(block_k)
+        upper = jnp.minimum(
+            jax.lax.div((q_idx + 1) * block_q + block_k - 1,
+                        jnp.int32(block_k)),
+            num_k_blocks,
         )
-        upper = jnp.minimum(upper, num_k_blocks)
     else:
         upper = num_k_blocks
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m, l, acc))
+    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
     o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[...] = m + jnp.log(l)
+
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, *, block_q, sm_scale, causal, block_k,
+                     seq_len):
+    """One (batch*head, k-block) program: accumulate dK, dV over q blocks."""
+    import jax.experimental.pallas as pl
+
+    k_idx = pl.program_id(1)
+    k_blk = k_ref[...]  # [block_k, d]
+    v_blk = v_ref[...]
+    d = k_blk.shape[-1]
+
+    k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+
+    def body(q_i, carry):
+        dk, dv = carry
+        q = q_ref[pl.dslice(q_i * block_q, block_q), :]
+        do = do_ref[pl.dslice(q_i * block_q, block_q), :]
+        lse = lse_ref[pl.dslice(q_i * block_q, block_q)]
+        delta = delta_ref[pl.dslice(q_i * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # [block_q, block_k]
+        if causal:
+            q_pos = q_i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
+        # dV += P^T dO
+        dv = dv + jax.lax.dot_general(
+            p, do.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dP = dO V^T ; dS = P * (dP - delta)
+        dp = jax.lax.dot_general(
+            do.astype(jnp.float32), v_blk.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        # dK += dS^T Q * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        return dk, dv
+
+    num_q_blocks = seq_len // block_q
+    if causal:
+        # Only q blocks at or after this k block see it.
+        lower = jax.lax.div(k_idx * block_k, jnp.int32(block_q))
+    else:
+        lower = jnp.int32(0)
+    dk0 = jnp.zeros((block_k, d), dtype=jnp.float32)
+    dv0 = jnp.zeros((block_k, d), dtype=jnp.float32)
+    dk, dv = jax.lax.fori_loop(lower, num_q_blocks, body, (dk0, dv0))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_k, sm_scale, causal, block_q, seq_len):
+    """One (batch*head, q-block) program: accumulate dQ over k blocks."""
+    import jax.experimental.pallas as pl
+
+    q_idx = pl.program_id(1)
+    q = q_ref[...]
+    do = do_ref[...]
+    lse = lse_ref[...]
+    delta = delta_ref[...]
+    d = q.shape[-1]
+
+    q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+
+    def body(k_i, dq):
+        k_blk = k_ref[pl.dslice(k_i * block_k, block_k), :]
+        v_blk = v_ref[pl.dslice(k_i * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+        if causal:
+            k_pos = k_i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            do.astype(jnp.float32), v_blk.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta[:, None])
+        return dq + jax.lax.dot_general(
+            ds, k_blk.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale
+
+    num_k_blocks = seq_len // block_k
+    if causal:
+        upper = jnp.minimum(
+            jax.lax.div((q_idx + 1) * block_q + block_k - 1,
+                        jnp.int32(block_k)),
+            num_k_blocks,
+        )
+    else:
+        upper = num_k_blocks
+    dq = jax.lax.fori_loop(
+        0, upper, body, jnp.zeros((block_q, d), dtype=jnp.float32)
+    )
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+
+def _flash_fwd_bh(qt, kt, vt, causal, scale, block_q, block_k):
+    import jax.experimental.pallas as pl
+
+    bh, s, d = qt.shape
+    kernel = functools.partial(
+        _fwd_kernel, block_k=block_k, sm_scale=scale, causal=causal,
+        block_q=block_q, seq_len=s,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), qt.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
+        interpret=INTERPRET,
+    )(qt, kt, vt)
+
+
+def _flash_bwd_bh(qt, kt, vt, ot, do, lse, causal, scale, block_q, block_k):
+    import jax.experimental.pallas as pl
+
+    bh, s, d = qt.shape
+    # delta = rowsum(dO * O): cheap elementwise, XLA fuses it.
+    delta = jnp.sum(
+        do.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1
+    )  # [bh, s]
+
+    dkdv = functools.partial(
+        _bwd_dkdv_kernel, block_q=block_q, sm_scale=scale, causal=causal,
+        block_k=block_k, seq_len=s,
+    )
+    dk, dv = pl.pallas_call(
+        dkdv,
+        grid=(bh, s // block_k),
+        in_specs=[
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),      # q
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),  # k
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),  # v
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),      # do
+            pl.BlockSpec((None, s), lambda i, j: (i, 0)),            # lse
+            pl.BlockSpec((None, s), lambda i, j: (i, 0)),            # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        ],
+        interpret=INTERPRET,
+    )(qt, kt, vt, do, lse, delta)
+
+    dqk = functools.partial(
+        _bwd_dq_kernel, block_k=block_k, sm_scale=scale, causal=causal,
+        block_q=block_q, seq_len=s,
+    )
+    dq = pl.pallas_call(
+        dqk,
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),  # q
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),      # k
+            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),      # v
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),  # do
+            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),      # lse
+            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),      # delta
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        interpret=INTERPRET,
+    )(qt, kt, vt, do, lse, delta)
+    return dq, dk, dv
+
+
+###############################################################################
+# Public flash entry: [B, S, H, D] layout, GQA, custom VJP.
+###############################################################################
 
 
 @functools.partial(
-    jax.jit, static_argnames=("causal", "sm_scale", "block_q", "block_k")
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
 )
 def flash_attention_tpu(
     q: jax.Array,  # [B, S, H, D]
-    k: jax.Array,
+    k: jax.Array,  # [B, S, Hkv, D]
     v: jax.Array,
     causal: bool = True,
     sm_scale: Optional[float] = None,
     block_q: int = 256,
     block_k: int = 256,
 ) -> jax.Array:
-    """Pallas flash-attention forward. Requires S % block == 0 and TPU."""
-    import jax.experimental.pallas as pl
+    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return out
 
+
+def _prep(q, k, v, block_q, block_k, sm_scale):
     b, s, h, d = q.shape
     hkv = k.shape[2]
+    groups = h // hkv
     if hkv != h:
-        k = jnp.repeat(k, h // hkv, axis=2)
-        v = jnp.repeat(v, h // hkv, axis=2)
+        k = jnp.repeat(k, groups, axis=2)
+        v = jnp.repeat(v, groups, axis=2)
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     assert s % block_q == 0 and s % block_k == 0, (s, block_q, block_k)
     scale = 1.0 / math.sqrt(d) if sm_scale is None else sm_scale
 
-    # [B, S, H, D] -> [B*H, S, D] so the grid is (batch*head, q-block).
     def to_bh(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
 
-    qt, kt, vt = to_bh(q), to_bh(k), to_bh(v)
-    kernel = functools.partial(
-        _flash_fwd_kernel,
-        block_k=block_k,
-        sm_scale=scale,
-        causal=causal,
-        q_block=block_q,
-        seq_len=s,
-    )
-    out = pl.pallas_call(
-        kernel,
-        grid=(b * h, s // block_q),
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * h, s, d), q.dtype),
-    )(qt, kt, vt)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return to_bh(q), to_bh(k), to_bh(v), scale, block_q, block_k, groups
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    b, s, h, d = q.shape
+    qt, kt, vt, scale, bq, bk, groups = _prep(q, k, v, block_q, block_k,
+                                              sm_scale)
+    ot, lse = _flash_fwd_bh(qt, kt, vt, causal, scale, bq, bk)
+    out = ot.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    return out, (q, k, v, ot, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g):
+    q, k, v, ot, lse = residuals
+    b, s, h, d = q.shape
+    hkv = k.shape[2]
+    qt, kt, vt, scale, bq, bk, groups = _prep(q, k, v, block_q, block_k,
+                                              sm_scale)
+    do = g.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    dq, dk, dv = _flash_bwd_bh(qt, kt, vt, ot, do, lse, causal, scale, bq, bk)
+
+    def from_bh(x, heads):
+        return x.reshape(b, heads, s, d).transpose(0, 2, 1, 3)
+
+    dq = from_bh(dq, h).astype(q.dtype)
+    dk = from_bh(dk, h)
+    dv = from_bh(dv, h)
+    if hkv != h:
+        # Sum gradients over the query heads sharing each KV head.
+        dk = dk.reshape(b, s, hkv, groups, d).sum(axis=3)
+        dv = dv.reshape(b, s, hkv, groups, d).sum(axis=3)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention_tpu.defvjp(_flash_fwd, _flash_bwd)
 
 
 def mha(
@@ -175,11 +413,11 @@ def mha(
     sm_scale: Optional[float] = None,
     use_pallas: Optional[bool] = None,
 ) -> jax.Array:
-    """Dispatch: Pallas flash forward on TPU (inference-shaped calls), XLA
-    reference elsewhere and for training (XLA autodiffs + fuses it)."""
+    """Dispatch: Pallas flash kernels (fwd+bwd) on TPU for long sequences,
+    XLA reference elsewhere."""
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     s = q.shape[1]
     if use_pallas and s >= 256 and s % 256 == 0 and s == k.shape[1]:
-        return flash_attention_tpu(q, k, v, causal=causal, sm_scale=sm_scale)
+        return flash_attention_tpu(q, k, v, causal, sm_scale)
     return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)

@@ -72,9 +72,12 @@ class KubeAPIClient(KubeClient):
         try:
             with open(self._token_path) as f:
                 self._token = f.read().strip()
+            self._token_read_at = time.monotonic()
         except OSError:
-            self._token = self._token  # keep the previous one if any
-        self._token_read_at = time.monotonic()
+            # Keep any previous token; leave the stamp so the next request
+            # retries the read immediately (e.g. projected volume not yet
+            # mounted at pod start).
+            pass
 
     def _request(
         self, method: str, path: str, body: Optional[dict] = None,
@@ -292,6 +295,10 @@ class InformerLoop:
                 # Bounded watch ended normally; resume from the last RV.
             except _WatchGap as e:
                 common.log.warning("watch %s gap (%s); relisting", path, e)
+                # Backoff here too: a deterministically-failing handler
+                # would otherwise drive an unthrottled relist loop.
+                time.sleep(backoff)
+                backoff = min(backoff * 2, self.BACKOFF_MAX_S)
                 resource_version = self._safe_relist(relist)
             except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
                 common.log.warning(

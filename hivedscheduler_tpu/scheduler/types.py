@@ -195,8 +195,13 @@ def extract_pod_scheduling_spec(pod: Pod) -> api.PodSchedulingSpec:
         raise api.bad_request(err_pfx + "Annotation does not exist or is empty")
     try:
         # from_dict defaults ignoreK8sSuggestedNodes to True when absent
-        # (reference: api/types.go:86 `default:"true"`).
-        spec = api.PodSchedulingSpec.from_dict(common.from_yaml(annotation) or {})
+        # (reference: api/types.go:86 `default:"true"`). Cached parse: every
+        # pod of a gang carries the identical annotation string, and the
+        # same pod re-enters filter on each retry; from_dict copies every
+        # field so sharing the parsed dict is safe.
+        spec = api.PodSchedulingSpec.from_dict(
+            common.from_yaml_cached(annotation) or {}
+        )
     except api.WebServerError:
         raise
     except Exception as e:  # malformed YAML and the like

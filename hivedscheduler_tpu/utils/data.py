@@ -69,23 +69,47 @@ def prefetch_to_mesh(
     put = put or sharding.shard_batch
     q: "queue.Queue" = queue.Queue(maxsize=buffer_size)
     END = object()
+    stop = threading.Event()
+
+    def _enqueue(item) -> bool:
+        # Bounded put that notices consumer abandonment: without this, a
+        # consumer that breaks out early leaves the worker blocked forever,
+        # pinning device-resident batches in HBM.
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.5)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def worker():
         try:
             for batch in batches:
-                q.put(put(batch, mesh))
-            q.put(END)
+                if not _enqueue(put(batch, mesh)):
+                    return
+            _enqueue(END)
         except BaseException as e:  # noqa: BLE001
             # Surface data-source / transfer failures to the consumer —
             # never let a broken pipeline look like a clean end-of-data.
-            q.put(e)
+            _enqueue(e)
 
     t = threading.Thread(target=worker, daemon=True)
     t.start()
-    while True:
-        item = q.get()
-        if item is END:
-            return
-        if isinstance(item, BaseException):
-            raise item
-        yield item
+    try:
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        # GeneratorExit (early consumer break) or error: release the worker
+        # and drop any buffered device batches.
+        stop.set()
+        while not q.empty():
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break

@@ -37,6 +37,9 @@ def rand_obj(rng, depth=0):
             True,
             False,
             3.5,
+            1e-05,
+            -2.5e20,
+            float("inf"),
         ]
     )
 
@@ -68,6 +71,17 @@ def test_fast_yaml_bind_info_shape():
         ],
     }
     assert yaml.safe_load(common.to_yaml_fast(info)) == info
+
+
+def test_fast_yaml_float_forms():
+    cases = {"a": 1e-05, "b": -2.5e20, "c": float("inf"),
+             "d": float("-inf"), "e": 3.5, "f": 2.0}
+    out = yaml.safe_load(common.to_yaml_fast(cases))
+    for k, v in cases.items():
+        assert isinstance(out[k], float), (k, out[k])
+        assert out[k] == v
+    nan = yaml.safe_load(common.to_yaml_fast({"n": float("nan")}))["n"]
+    assert isinstance(nan, float) and nan != nan
 
 
 def test_from_yaml_json_fast_path():

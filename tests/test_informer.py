@@ -163,6 +163,34 @@ def test_prefetch_propagates_worker_errors():
             pass
 
 
+def test_prefetch_releases_worker_on_early_break():
+    import time
+
+    import jax
+
+    from hivedscheduler_tpu.parallel import mesh as pmesh
+    from hivedscheduler_tpu.utils import data as data_mod
+    from hivedscheduler_tpu.utils.data import prefetch_to_mesh
+
+    mesh = pmesh.make_mesh(pmesh.MeshConfig(fsdp=8), devices=jax.devices())
+    produced = []
+
+    def source():
+        import numpy as np
+
+        for i in range(100):
+            produced.append(i)
+            yield np.zeros((8, 4), dtype="int32")
+
+    it = prefetch_to_mesh(source(), mesh, buffer_size=2)
+    next(it)
+    it.close()  # consumer abandons early
+    time.sleep(1.0)
+    # The worker must have stopped: with buffer_size=2 it can be at most a
+    # few items ahead, never draining the whole source.
+    assert len(produced) < 10, len(produced)
+
+
 def test_relist_diff_synthesizes_missed_node_delete():
     names = all_node_names(HivedScheduler(tpu_design_config()))
     sched, fake, loop = build(names, [])
