@@ -1,0 +1,184 @@
+"""Autoregressive decoding with a static KV cache (TPU-friendly inference).
+
+Same weights as ``models/transformer.py``; decoding is reformulated for
+XLA: a fixed-capacity cache ([layers, batch, max_len, kv_heads, head_dim]),
+``lax.dynamic_update_slice`` writes at the current position, and a position
+mask instead of dynamic shapes — one compiled ``decode_step`` serves every
+position. Prefill processes the prompt in one causal forward pass while
+filling the cache (MXU-batched), then steps generate token by token.
+
+GQA keeps the cache small (kv_heads << heads): for Llama-3-8B shapes the
+bf16 cache is 8192 pos x 8 kv heads x 128 dim x 2 x 32 layers = 1 GiB per
+sequence — the reason GQA is the default.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import NEG_INF
+from .transformer import Params, TransformerConfig, rms_norm, rope
+
+
+class KVCache(NamedTuple):
+    k: jax.Array  # [L, B, S_max, Hkv, D]
+    v: jax.Array  # [L, B, S_max, Hkv, D]
+    length: jax.Array  # [] int32: filled positions
+
+
+def init_cache(
+    config: TransformerConfig, batch: int, max_len: int
+) -> KVCache:
+    c = config
+    shape = (c.n_layers, batch, max_len, c.n_kv_heads, c.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dtype=c.dtype),
+        v=jnp.zeros(shape, dtype=c.dtype),
+        length=jnp.zeros((), dtype=jnp.int32),
+    )
+
+
+def _attend_cached(
+    q: jax.Array,  # [B, T, H, D]
+    k_cache: jax.Array,  # [B, S_max, Hkv, D]
+    v_cache: jax.Array,
+    q_offset: jax.Array,  # [] int32: absolute position of q[0]
+    config: TransformerConfig,
+) -> jax.Array:
+    c = config
+    b, t, h, d = q.shape
+    s_max = k_cache.shape[1]
+    if c.n_kv_heads != h:
+        k_cache = jnp.repeat(k_cache, h // c.n_kv_heads, axis=2)
+        v_cache = jnp.repeat(v_cache, h // c.n_kv_heads, axis=2)
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+    ) / jnp.sqrt(jnp.float32(d))
+    q_pos = q_offset + jnp.arange(t)[:, None]
+    k_pos = jnp.arange(s_max)[None, :]
+    mask = q_pos >= k_pos  # causal over absolute positions; empty slots
+    # beyond q_offset+t are masked by causality automatically.
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
+
+
+def _block_cached(
+    x: jax.Array,  # [B, T, D]
+    layer: Params,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    pos: jax.Array,
+    config: TransformerConfig,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decoder block over cached KV; returns (x, new_k, new_v)."""
+    c = config
+    b, t, d = x.shape
+    h = rms_norm(x, layer["ln1"])
+    q = (h @ layer["wq"]).reshape(b, t, c.n_heads, c.head_dim)
+    k = (h @ layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim)
+    v = (h @ layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim)
+    positions = pos + jnp.arange(t)
+    q = rope(q, positions, c.rope_theta)
+    k = rope(k, positions, c.rope_theta)
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    attn = _attend_cached(q, k_cache, v_cache, pos, c)
+    x = x + attn.reshape(b, t, c.n_heads * c.head_dim) @ layer["wo"]
+    hh = rms_norm(x, layer["ln2"])
+    ffn = (jax.nn.silu(hh @ layer["w_gate"]) * (hh @ layer["w_up"])) @ layer[
+        "w_down"
+    ]
+    return x + ffn, k_cache, v_cache
+
+
+def _forward_cached(
+    params: Params,
+    tokens: jax.Array,  # [B, T]
+    cache: KVCache,
+    config: TransformerConfig,
+) -> Tuple[jax.Array, KVCache]:
+    c = config
+    params = jax.tree.map(lambda a: a.astype(c.dtype), params)
+    x = params["embed"][tokens]
+    pos = cache.length
+
+    def block(x, layer_and_cache):
+        layer, k_c, v_c = layer_and_cache
+        x, k_c, v_c = _block_cached(x, layer, k_c, v_c, pos, c)
+        return x, (k_c, v_c)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        block, x, (params["layers"], cache.k, cache.v)
+    )
+    x = rms_norm(x, params["ln_f"])
+    if c.tied_embeddings:
+        logits = x @ params["embed"].T
+    else:
+        logits = x @ params["lm_head"]
+    new_cache = KVCache(
+        k=new_k, v=new_v, length=cache.length + tokens.shape[1]
+    )
+    return logits.astype(jnp.float32), new_cache
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def prefill(
+    params: Params,
+    prompt: jax.Array,  # [B, T_prompt]
+    cache: KVCache,
+    config: TransformerConfig,
+) -> Tuple[jax.Array, KVCache]:
+    """Fill the cache with the prompt; returns (last-position logits, cache)."""
+    logits, cache = _forward_cached(params, prompt, cache, config)
+    return logits[:, -1], cache
+
+
+@functools.partial(jax.jit, static_argnames=("config",))
+def decode_step(
+    params: Params,
+    token: jax.Array,  # [B] int32: previous token
+    cache: KVCache,
+    config: TransformerConfig,
+) -> Tuple[jax.Array, KVCache]:
+    """One decoding step; returns (logits [B, V], cache)."""
+    logits, cache = _forward_cached(params, token[:, None], cache, config)
+    return logits[:, 0], cache
+
+
+def generate(
+    params: Params,
+    prompt: jax.Array,  # [B, T_prompt]
+    config: TransformerConfig,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key: jax.Array | None = None,
+) -> jax.Array:
+    """Greedy (temperature=0) or sampled generation; returns
+    [B, T_prompt + max_new_tokens]."""
+    b, t = prompt.shape
+    cache = init_cache(config, b, t + max_new_tokens)
+    logits, cache = prefill(params, prompt, cache, config)
+    out = [prompt]
+    token = _select(logits, temperature, key)
+    for i in range(max_new_tokens):
+        out.append(token[:, None])
+        if i == max_new_tokens - 1:
+            break
+        logits, cache = decode_step(params, token, cache, config)
+        if key is not None:
+            key = jax.random.split(key, 1)[0]
+        token = _select(logits, temperature, key)
+    return jnp.concatenate(out, axis=1)
+
+
+def _select(logits: jax.Array, temperature: float, key) -> jax.Array:
+    if temperature <= 0.0 or key is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.random.categorical(key, logits / temperature, axis=-1).astype(
+        jnp.int32
+    )
