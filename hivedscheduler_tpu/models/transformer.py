@@ -205,7 +205,9 @@ def forward(
     use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
     # Mixed precision: f32 master params -> bf16 compute copies.
     params = jax.tree.map(lambda a: a.astype(c.dtype), params)
-    x = params["embed"][tokens]
+    # Vocab-parallel lookup when possible: a plain gather on a tp-sharded
+    # table makes SPMD replicate the result (involuntary full remat).
+    x = sharding.embed_lookup(params["embed"], tokens, mesh)
     x = sharding.constrain(x, "batch", "seq", "act_embed")
 
     block = lambda x, layer: (_block(x, layer, c, mesh, use_ring), None)

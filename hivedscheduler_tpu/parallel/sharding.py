@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 # logical dim name -> mesh axis (or None = replicate). The default table
@@ -65,6 +66,100 @@ def constrain(x: jax.Array, *logical_axes: Optional[str], rules=None) -> jax.Arr
         return jax.lax.with_sharding_constraint(x, spec_for(logical_axes, rules))
     except (ValueError, RuntimeError):
         return x
+
+
+def embed_lookup(
+    table: jax.Array,
+    tokens: jax.Array,
+    mesh: Optional[Mesh],
+    rules: Optional[Dict[str, Any]] = None,
+) -> jax.Array:
+    """Embedding lookup, vocab-parallel when the mesh/shapes allow it.
+
+    shard_map needs every sharded dim evenly divisible by its mesh axes;
+    when that doesn't hold (tiny test configs, odd batch sizes), fall back
+    to the plain gather, which GSPMD handles (at the cost of the
+    involuntary-remat replication this path exists to avoid).
+    """
+    table_rules = DEFAULT_RULES if rules is None else rules
+
+    def _size(name):
+        ax = table_rules.get(name)
+        if ax is None or mesh is None:
+            return 1
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        n = 1
+        for a in axes:
+            n *= mesh.shape.get(a, 1)
+        return n
+
+    divisible = (
+        mesh is not None
+        and table.shape[0] % _size("vocab") == 0
+        and table.shape[1] % _size("embed") == 0
+        and tokens.shape[0] % _size("batch") == 0
+        and tokens.shape[1] % _size("seq") == 0
+    )
+    if mesh is not None and mesh.size > 1 and divisible:
+        return vocab_parallel_embed(table, tokens, mesh, rules)
+    return table[tokens]
+
+
+def vocab_parallel_embed(
+    table: jax.Array,  # [V, D], sharded (vocab->tp, embed->fsdp)
+    tokens: jax.Array,  # [B, S] int, sharded (batch, seq)
+    mesh: Mesh,
+    rules: Optional[Dict[str, Any]] = None,
+) -> jax.Array:
+    """Megatron-style vocab-parallel embedding lookup.
+
+    A plain ``table[tokens]`` on a tp-sharded table makes XLA's SPMD
+    partitioner replicate the gathered tensor ("involuntary full
+    rematerialization"), because it cannot reshard through a gather. Instead,
+    each tp shard gathers only the rows it owns (out-of-range indices masked
+    to zero) and a ``psum`` over tp combines them; the embed dim is then
+    all-gathered over fsdp. Output is [B, S, D] sharded (batch, seq, -),
+    exactly what the first block consumes.
+    """
+    table_rules = DEFAULT_RULES if rules is None else rules
+
+    def _axes(name):
+        ax = table_rules.get(name)
+        return ax if isinstance(ax, tuple) or ax is None else (ax,)
+
+    vocab_ax = _axes("vocab")
+    embed_ax = _axes("embed")
+    batch_ax = _axes("batch")
+    seq_ax = _axes("seq")
+
+    def lookup(local_table, local_tokens):
+        # Unshard the embed dim FIRST (the usual ZeRO-3 param all-gather).
+        # It must not happen after the lookup: batch shards over fsdp too,
+        # so post-lookup rows differ across fsdp peers and combining their
+        # embed shards would mix different tokens' embeddings.
+        if embed_ax:
+            local_table = jax.lax.all_gather(
+                local_table, embed_ax, axis=-1, tiled=True
+            )
+        vshard = local_table.shape[0]
+        lo = jnp.int32(0)
+        for name in vocab_ax or ():
+            lo = lo * mesh.shape[name] + jax.lax.axis_index(name)
+        lo = lo * vshard
+        local = local_tokens - lo
+        ok = (local >= 0) & (local < vshard)
+        out = local_table[jnp.clip(local, 0, vshard - 1)]
+        out = jnp.where(ok[..., None], out, jnp.zeros((), out.dtype))
+        if vocab_ax:
+            out = jax.lax.psum(out, vocab_ax)
+        return out
+
+    return jax.shard_map(
+        lookup,
+        mesh=mesh,
+        in_specs=(P(vocab_ax, embed_ax), P(batch_ax, seq_ax)),
+        out_specs=P(batch_ax, seq_ax, None),
+    )(table, tokens)
 
 
 def shard_batch(batch: Any, mesh: Mesh, rules=None) -> Any:
