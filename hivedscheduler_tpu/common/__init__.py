@@ -84,7 +84,15 @@ def _fast_scalar(v: Any) -> str:
         return s
     if v is None:
         return "null"
-    s = str(v)
+    if not isinstance(v, str):
+        # Fail at serialization time, not at replay: str(v) on a tuple/
+        # bytes/date would emit text that parses back as a different value,
+        # silently corrupting the bind-info annotation.
+        raise TypeError(
+            f"to_yaml_fast supports dict/list/str/int/float/bool/None "
+            f"leaves only, got {type(v).__name__}: {v!r}"
+        )
+    s = v
     if _BARE_SCALAR.match(s) and s.lower() not in _BOOLISH:
         return s
     return json.dumps(s)  # JSON string quoting is valid YAML

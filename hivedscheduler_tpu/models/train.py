@@ -81,20 +81,22 @@ def init_sharded(
     param_sh = sharding.tree_shardings(mesh, logical)
 
     params_shape = jax.eval_shape(functools.partial(transformer.init, config), key)
-    # Optimizer state mirrors the param tree (adam mu/nu) -> reuse the same
-    # sharding per leaf; scalar state (counts) is replicated.
+    # Optimizer state embeds copies of the param tree (adam mu/nu): any
+    # sub-tree structurally identical to the param tree gets the param
+    # shardings leaf-for-leaf; every other leaf (counts, scalars) is
+    # replicated. Structural matching — unlike shape matching — cannot
+    # mis-shard a moment when two params share a shape.
     opt_shape = jax.eval_shape(optimizer.init, params_shape)
+    param_treedef = jax.tree.structure(params_shape)
 
-    def opt_leaf_sharding(leaf):
-        # Match by shape: adam moments have the same shape as their param.
-        for p_leaf, sh in zip(
-            jax.tree.leaves(params_shape), jax.tree.leaves(param_sh)
-        ):
-            if leaf.shape == p_leaf.shape and leaf.dtype == p_leaf.dtype:
-                return sh
-        return NamedSharding(mesh, P())
+    def _is_param_tree(node):
+        return jax.tree.structure(node) == param_treedef
 
-    opt_sh = jax.tree.map(opt_leaf_sharding, opt_shape)
+    opt_sh = jax.tree.map(
+        lambda node: param_sh if _is_param_tree(node) else NamedSharding(mesh, P()),
+        opt_shape,
+        is_leaf=_is_param_tree,
+    )
 
     params = jax.jit(
         functools.partial(transformer.init, config), out_shardings=param_sh

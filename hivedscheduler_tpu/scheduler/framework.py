@@ -445,7 +445,10 @@ class HivedScheduler:
         # it: a bind is a full network RTT, and holding the exclusive lock
         # through it would serialize gang binds and stall all filtering
         # (the reference holds only a read lock here, scheduler.go:595-596).
-        # Safe because a BINDING pod's placement is immutable.
+        # Safe because a BINDING pod's placement is immutable AND the
+        # Binding carries the pod UID as an apiserver precondition
+        # (kube.py bind_pod), so a delete+recreate of the same pod name
+        # between validation and write cannot receive the stale bind.
         with self._lock:
             status = self._admission_check(args.pod_uid)
             if status.pod_state != PodState.BINDING:

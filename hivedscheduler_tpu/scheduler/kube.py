@@ -112,7 +112,13 @@ class KubeAPIClient(KubeClient):
         """POST the Binding subresource, carrying our annotations — K8s
         merges Binding metadata annotations onto the pod, which is how the
         bind-info 'checkpoint' is persisted atomically with the bind
-        (reference: internal/utils.go:291-314)."""
+        (reference: internal/utils.go:291-314).
+
+        SAFETY: ``metadata.uid`` is a UID *precondition* — the apiserver
+        rejects the Binding if the live pod's UID differs. bind_routine
+        relies on this when it performs the write outside the scheduler
+        lock: a concurrent delete+recreate of the same pod name yields a
+        new UID, so a stale Binding can never land on the new pod."""
         body = {
             "apiVersion": "v1",
             "kind": "Binding",

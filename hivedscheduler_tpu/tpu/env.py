@@ -20,6 +20,7 @@ downward-API volume, the way the reference maps its isolation annotation to
 
 from __future__ import annotations
 
+import re
 from typing import Dict, List, Tuple
 
 from ..api import types as api
@@ -30,13 +31,24 @@ from ..api import types as api
 COORDINATOR_PORT = 8476
 
 
+def _natural_key(name: str) -> Tuple:
+    """Sort key treating digit runs as numbers: w2 < w10 (plain string sort
+    would give w0, w1, w10, ..., w15, w2 — physically wrong worker order for
+    slices with >= 10 hosts)."""
+    return tuple(
+        int(tok) if tok.isdigit() else tok for tok in re.split(r"(\d+)", name)
+    )
+
+
 def _worker_order(info: api.PodBindInfo) -> List[Tuple[str, Tuple[int, ...]]]:
     """All pod placements of the gang as (node, chip indices), in the
-    deterministic worker order: sorted by (node, first chip index).
+    deterministic worker order: sorted by (natural node name, first chip
+    index).
 
     Node names sort in ICI order when slices are declared with
-    ``tpu.topology.make_physical_cell`` (worker 0..N-1 addresses); within a
-    node, the lowest chip index breaks ties between sub-host pods.
+    ``tpu.topology.make_physical_cell`` (worker 0..N-1 addresses); the
+    natural sort keeps that true past 10 hosts. Within a node, the lowest
+    chip index breaks ties between sub-host pods.
     """
     placements: List[Tuple[str, Tuple[int, ...]]] = []
     for member in info.affinity_group_bind_info:
@@ -47,7 +59,9 @@ def _worker_order(info: api.PodBindInfo) -> List[Tuple[str, Tuple[int, ...]]]:
                     tuple(placement.physical_leaf_cell_indices),
                 )
             )
-    placements.sort(key=lambda p: (p[0], p[1][0] if p[1] else -1))
+    placements.sort(
+        key=lambda p: (_natural_key(p[0]), p[1][0] if p[1] else -1)
+    )
     return placements
 
 

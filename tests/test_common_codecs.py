@@ -52,6 +52,18 @@ def test_fast_yaml_fuzz_roundtrip():
         assert yaml.safe_load(text) == obj, (obj, text)
 
 
+def test_fast_yaml_rejects_unsupported_leaf_types():
+    import datetime
+
+    import pytest
+
+    for bad in [(1, 2), b"bytes", datetime.date(2026, 1, 1), {1, 2}]:
+        with pytest.raises(TypeError):
+            common.to_yaml_fast({"k": bad})
+        with pytest.raises(TypeError):
+            common.to_yaml_fast([bad])
+
+
 def test_fast_yaml_bind_info_shape():
     info = {
         "node": "v5p-w0",

@@ -69,6 +69,25 @@ def test_sharded_forward_matches_single_device(tiny_config, tiny_params):
     )
 
 
+def test_optimizer_state_shardings_are_structural(tiny_config):
+    # wq and wo have identical shapes in the tiny config ([L, 128, 128]) but
+    # transposed logical axes; shape-matched sharding assignment would give
+    # wo's adam moments wq's sharding. Structural matching must not.
+    mesh = pmesh.make_mesh(
+        pmesh.MeshConfig(fsdp=2, sp=2, tp=2), devices=jax.devices()
+    )
+    optimizer = train.make_optimizer()
+    params, opt_state, param_sh, opt_sh = train.init_sharded(
+        tiny_config, mesh, jax.random.PRNGKey(0), optimizer
+    )
+    mu_sh = opt_sh[0].mu
+    assert mu_sh["layers"]["wq"] == param_sh["layers"]["wq"]
+    assert mu_sh["layers"]["wo"] == param_sh["layers"]["wo"]
+    assert mu_sh["layers"]["wq"].spec != mu_sh["layers"]["wo"].spec
+    # Non-moment state (adam step count) is replicated.
+    assert opt_sh[0].count.spec == P()
+
+
 def test_train_step_decreases_loss(tiny_config):
     optimizer = train.make_optimizer(learning_rate=1e-3)
     params = transformer.init(tiny_config, jax.random.PRNGKey(0))

@@ -75,6 +75,36 @@ def test_sub_host_pods_get_distinct_ranks_on_same_node():
         )
 
 
+def test_worker_order_is_natural_not_lexicographic():
+    # w0..w11: a lexicographic sort would give w0,w1,w10,w11,w2,... and
+    # assign worker ids that disagree with the physical slice order.
+    from hivedscheduler_tpu.api import types as api
+    from hivedscheduler_tpu.tpu.env import pod_tpu_env
+
+    n = 12
+    member = api.AffinityGroupMemberBindInfo(
+        pod_placements=[
+            api.PodPlacementInfo(
+                physical_node=f"w{i}", physical_leaf_cell_indices=[0, 1, 2, 3]
+            )
+            for i in range(n)
+        ]
+    )
+    for i in range(n):
+        info = api.PodBindInfo(
+            node=f"w{i}",
+            leaf_cell_isolation=[0, 1, 2, 3],
+            cell_chain="v5p-64",
+            affinity_group_bind_info=[member],
+        )
+        e = pod_tpu_env(info)
+        assert e["TPU_WORKER_ID"] == str(i), (i, e["TPU_WORKER_ID"])
+        assert e["TPU_WORKER_HOSTNAMES"] == ",".join(
+            f"w{j}" for j in range(n)
+        )
+        assert e["JAX_COORDINATOR_ADDRESS"].startswith("w0:")
+
+
 def test_singleton_env():
     sim = Sim()
     bp = sim.schedule_and_bind(make_pod("solo", "us", "VC1", 0, "v5e-chip", 4))
