@@ -17,8 +17,11 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import random
 import statistics
+import subprocess
+import sys
 import time
 
 from hivedscheduler_tpu import common
@@ -179,10 +182,49 @@ def run(n_gangs: int = 120, seed: int = 0):
     return p50, p99, len(gang_latencies_ms)
 
 
+def model_perf() -> dict:
+    """tokens/sec/chip + MFU on the default JAX backend (the real TPU when
+    the driver runs this), via a subprocess with a hard timeout: a dead TPU
+    tunnel hangs jax.devices() forever, and that must degrade to a skipped
+    stage, not a hung benchmark. Keeps jax out of this process entirely."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    # Fast probe first: a dead tunnel hangs backend init indefinitely, and
+    # wasting the full perf timeout on it would risk the whole bench run.
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            cwd=here,
+        )
+    except subprocess.TimeoutExpired:
+        return {"skipped": "backend probe timed out (TPU tunnel dead?)"}
+    if probe.returncode != 0:
+        return {"skipped": f"backend probe rc={probe.returncode}"}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "hivedscheduler_tpu.models.perf"],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            cwd=here,
+        )
+    except subprocess.TimeoutExpired:
+        return {"skipped": "model perf timed out"}
+    if proc.returncode != 0:
+        return {"skipped": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+    try:
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+    except (json.JSONDecodeError, IndexError):
+        return {"skipped": f"unparseable output: {proc.stdout[-200:]}"}
+
+
 if __name__ == "__main__":
     # Warm-up pass (imports, allocator caches), then the measured pass.
     run(n_gangs=24, seed=1)
     p50, p99, n = run()
+    perf = model_perf()
     print(
         json.dumps(
             {
@@ -190,7 +232,11 @@ if __name__ == "__main__":
                 "value": round(p50, 3),
                 "unit": "ms",
                 "vs_baseline": round(p50 / TARGET_P50_MS, 3),
-                "extra": {"p99_ms": round(p99, 3), "gangs_scheduled": n},
+                "extra": {
+                    "p99_ms": round(p99, 3),
+                    "gangs_scheduled": n,
+                    "model_perf": perf,
+                },
             }
         )
     )

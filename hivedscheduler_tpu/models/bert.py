@@ -17,7 +17,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from ..ops.attention import mha_reference
 from ..parallel import sharding
 
 Params = Dict[str, Any]
@@ -111,7 +110,7 @@ def layer_norm(x, scale, bias, eps=1e-5):
     return (y * scale + bias).astype(x.dtype)
 
 
-def _block(x, layer, config):
+def _block(x, layer, config, mesh):
     c = config
     b, s, d = x.shape
     h = layer_norm(x, layer["ln1_scale"], layer["ln1_bias"])
@@ -121,7 +120,7 @@ def _block(x, layer, config):
     k = k.reshape(b, s, c.n_heads, c.head_dim)
     v = v.reshape(b, s, c.n_heads, c.head_dim)
     q = sharding.constrain(q, "batch", "seq", "heads", None)
-    attn = mha_reference(q, k, v, causal=False)
+    attn = sharding.sharded_mha(q, k, v, mesh, causal=False)
     attn = attn.reshape(b, s, d)
     x = x + sharding.constrain(attn @ layer["wo"], "batch", "seq", "act_embed")
 
@@ -143,7 +142,7 @@ def forward(
     x = params["embed"][tokens] + params["pos_embed"][None, :s]
     x = sharding.constrain(x, "batch", "seq", "act_embed")
 
-    block = lambda x, layer: (_block(x, layer, c), None)
+    block = lambda x, layer: (_block(x, layer, c, mesh), None)
     if c.remat:
         block = jax.checkpoint(block)
     x, _ = jax.lax.scan(block, x, params["layers"])

@@ -18,7 +18,6 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from ..ops.attention import mha_reference
 from ..parallel import ring, sharding
 from .transformer import rms_norm, rope
 
@@ -201,7 +200,7 @@ def _block(x, layer, config, mesh, use_ring):
     if use_ring:
         attn = ring.ring_attention(q, k, v, mesh, causal=True)
     else:
-        attn = mha_reference(q, k, v, causal=True)
+        attn = sharding.sharded_mha(q, k, v, mesh, causal=True)
     x = x + attn.reshape(b, s, d) @ layer["wo"]
 
     h = rms_norm(x, layer["ln2"])

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from ..ops.attention import mha_reference
+from ..ops.attention import mha
 from ..parallel import ring, sharding
 
 Params = Dict[str, Any]
@@ -183,7 +183,9 @@ def _block(
         assert mesh is not None
         attn = ring.ring_attention(q, k, v, mesh, causal=True)
     else:
-        attn = mha_reference(q, k, v, causal=True)
+        # Pallas flash kernels on TPU (shard_map-wrapped under a mesh,
+        # since GSPMD cannot partition a pallas_call); XLA reference off-TPU.
+        attn = sharding.sharded_mha(q, k, v, mesh, causal=True)
     attn = attn.reshape(b, s, c.n_heads * c.head_dim)
     x = x + sharding.constrain(attn @ layer["wo"], "batch", "seq", "act_embed")
 

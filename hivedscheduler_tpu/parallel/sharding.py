@@ -162,6 +162,61 @@ def vocab_parallel_embed(
     )(table, tokens)
 
 
+def sharded_mha(
+    q: jax.Array,  # [B, S, H, D]
+    k: jax.Array,  # [B, S, Hkv, D]
+    v: jax.Array,
+    mesh: Optional[Mesh],
+    causal: bool = True,
+    rules: Optional[Dict[str, Any]] = None,
+) -> jax.Array:
+    """Attention through the TPU flash-kernel dispatcher, shard_map-wrapped
+    when a multi-device mesh is active.
+
+    GSPMD cannot partition a ``pallas_call``; attention is embarrassingly
+    parallel over batch and heads, so an explicit shard_map over
+    (batch, heads) makes the kernel run per-shard. Requires batch/heads
+    divisible by their mesh axes and tp | kv_heads (so each shard keeps
+    whole GQA groups); otherwise falls back to the XLA reference path,
+    which GSPMD partitions itself.
+    """
+    from ..ops import attention as att
+
+    table = DEFAULT_RULES if rules is None else rules
+
+    def _size(name):
+        ax = table.get(name)
+        if ax is None or mesh is None:
+            return 1
+        axes = ax if isinstance(ax, tuple) else (ax,)
+        n = 1
+        for a in axes:
+            n *= mesh.shape.get(a, 1)
+        return n
+
+    if mesh is None or mesh.size == 1:
+        return att.mha(q, k, v, causal=causal)
+
+    divisible = (
+        q.shape[0] % _size("batch") == 0
+        and q.shape[2] % _size("heads") == 0
+        and k.shape[2] % _size("kv_heads") == 0
+        and _size("heads") == _size("kv_heads")
+        and _size("seq") == 1  # sp>1 goes through ring attention instead
+    )
+    if not divisible:
+        return att.mha_reference(q, k, v, causal=causal)
+
+    spec_q = spec_for(("batch", None, "heads", None), table)
+    spec_kv = spec_for(("batch", None, "kv_heads", None), table)
+    return jax.shard_map(
+        lambda a, b, c: att.mha(a, b, c, causal=causal),
+        mesh=mesh,
+        in_specs=(spec_q, spec_kv, spec_kv),
+        out_specs=spec_q,
+    )(q, k, v)
+
+
 def shard_batch(batch: Any, mesh: Mesh, rules=None) -> Any:
     """Device-put a host batch with (batch, seq, ...) layout onto the mesh."""
     table = DEFAULT_RULES if rules is None else rules
