@@ -31,6 +31,7 @@ from .cell import (
     OPPORTUNISTIC_PRIORITY,
     PhysicalCell,
     VirtualCell,
+    cell_equal,
 )
 from .group import BindingPathVertex
 
@@ -312,9 +313,23 @@ def map_physical_cell_to_virtual(
 ) -> Tuple[Optional[VirtualCell], str]:
     """Inverse mapping used when replaying an allocated pod after restart:
     find the virtual cell a physical cell should bind to
-    (reference: cell_allocation.go:320-350)."""
+    (reference: cell_allocation.go:320-350, plus one deliberate fix: an
+    existing binding is only reusable if it belongs to THIS VC's cell list —
+    the reference returns any binding unchecked, so a replayed pod whose
+    cells carry another VC's doomed-bad binding would silently record that
+    VC's virtual cells as its own placement, corrupting both VCs' counters
+    (found by the restart-replay fuzzer))."""
     if c.virtual_cell is not None:
-        return c.virtual_cell, ""
+        pac = c.virtual_cell.preassigned_cell
+        if any(
+            cell_equal(pac, candidate)
+            for candidate in vccl[preassigned_level]
+        ):
+            return c.virtual_cell, ""
+        return None, (
+            f"physical cell {c.address} is bound to virtual cell "
+            f"{c.virtual_cell.address} of another VC"
+        )
     if c.level == preassigned_level:
         preassigned = get_lowest_priority_virtual_cell(
             vccl[preassigned_level], p

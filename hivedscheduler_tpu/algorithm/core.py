@@ -1075,21 +1075,26 @@ class HivedCore:
             "cells %s)", pod.key, s.affinity_group.name, info.node,
             info.leaf_cell_isolation,
         )
-        pod_index = 0
         g = self.affinity_groups.get(s.affinity_group.name)
         if g is not None:
             if g.state == GroupState.PREEMPTING:
                 self._allocate_preempting_affinity_group(g, pod)
-            pod_index = get_allocated_pod_index(info, s.leaf_cell_number)
-            if pod_index == -1:
-                common.log.error(
-                    "[%s]: Pod placement not found in group %s: node %s, leaf "
-                    "cells %s", pod.key, s.affinity_group.name, info.node,
-                    info.leaf_cell_isolation,
-                )
-                return
         else:
             self._create_allocated_affinity_group(s, info, pod)
+        # The slot index ALWAYS comes from the pod's placement position in
+        # the bind info — including for the pod that just created the group
+        # during recovery: hardcoding 0 there would collide with a later
+        # same-sized pod whose true index is 0, silently dropping one of
+        # them. (The reference hardcodes 0 in that branch,
+        # hived_algorithm.go:250-262 — a latent recovery-order bug.)
+        pod_index = get_allocated_pod_index(info, s.leaf_cell_number)
+        if pod_index == -1:
+            common.log.error(
+                "[%s]: Pod placement not found in group %s: node %s, leaf "
+                "cells %s", pod.key, s.affinity_group.name, info.node,
+                info.leaf_cell_isolation,
+            )
+            return
         self.affinity_groups[s.affinity_group.name].allocated_pods[
             s.leaf_cell_number
         ][pod_index] = pod
@@ -1425,6 +1430,20 @@ class HivedCore:
             )
             return p_leaf, None, True
         if group.virtual_placement is not None and not lazy_preempted:
+            # Replay may find another VC's DOOMED binding sitting on this
+            # pod's cells: the fresh core marked nodes bad before the pod
+            # replayed, so the doomed binder saw the cell as free and
+            # grabbed it. The real allocation takes precedence — evict the
+            # advisory binding (it re-dooms onto a genuinely free bad cell
+            # at the next doomed-bind check).
+            cur: Optional[PhysicalCell] = p_leaf
+            while cur is not None and cur.virtual_cell is None:
+                cur = cur.parent  # type: ignore[assignment]
+            if (
+                cur is not None
+                and cur.virtual_cell.vc != s.virtual_cluster
+            ):
+                self._evict_doomed_binding(cur)
             preassigned_type = preassigned_cell_types[index]
             if preassigned_type:
                 message = ""
@@ -1464,6 +1483,32 @@ class HivedCore:
                 return p_leaf, v_leaf, False
             return p_leaf, None, None
         return p_leaf, None, False
+
+    def _evict_doomed_binding(self, pc: PhysicalCell) -> None:
+        """Remove another VC's doomed-bad binding from ``pc`` so a replayed
+        real allocation can claim the cell. No-op unless ``pc`` is in that
+        VC's doomed list (a non-doomed foreign binding is a true conflict,
+        left for the mapping to reject into lazy preemption)."""
+        vc = pc.virtual_cell
+        vcn = vc.vc
+        doomed = self.vc_doomed_bad_cells.get(vcn, {}).get(pc.chain)
+        if doomed is None or not doomed.contains(pc, pc.level):
+            return
+        if pc.priority >= MIN_GUARANTEED_PRIORITY:
+            # The doomed cell hosts a live allocation of its own VC — that
+            # is a genuine occupancy conflict, not an advisory binding;
+            # leave it for the mapping to reject into lazy preemption.
+            return
+        common.log.warning(
+            "Evicting doomed binding %s -> %s (VC %s): the cell hosts a "
+            "replayed allocation of another VC",
+            vc.address, pc.address, vcn,
+        )
+        pc.set_virtual_cell(None)
+        vc.set_physical_cell(None)
+        doomed.remove(pc, pc.level)
+        self.all_vc_doomed_bad_cell_num[pc.chain][pc.level] -= 1
+        self._release_preassigned_cell(pc, vcn, True)
 
     # -- leaf cell allocate / release ---------------------------------------
 
@@ -1553,8 +1598,20 @@ class HivedCore:
                     self._release_preassigned_cell(
                         preassigned_physical, vcn, False
                     )
-                # else: still bad and doomed-listed; keep the doomed binding
-                # (a bad child is still bound, so unbind_cell stopped early).
+                else:
+                    # Still bad and doomed-listed: the doomed binding must
+                    # survive the release. Usually a bound bad child stops
+                    # the unbind walk early, but when the bad descendants
+                    # were never bound (they went bad BEFORE the doomed
+                    # binding existed, so _set_bad_cell had no bound parent
+                    # to hang them under), the walk reaches the top and
+                    # destroys the doomed binding — restore it. (Found by
+                    # the restart-replay fuzzer; the reference has the same
+                    # unguarded walk, cell_allocation.go:401-420.)
+                    pac = v_leaf.preassigned_cell
+                    if pac.physical_cell is None:
+                        preassigned_physical.set_virtual_cell(pac)
+                        pac.set_physical_cell(preassigned_physical)
         else:
             ot = self._ot_cells.get(vcn, [])
             for i, c in enumerate(ot):

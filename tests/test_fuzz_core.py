@@ -93,3 +93,141 @@ def run_sequence(seed: int, steps: int = 80) -> None:
 def test_fuzz_scheduling_node_flaps(seed_block):
     for seed in range(seed_block * 20, (seed_block + 1) * 20):
         run_sequence(seed)
+
+
+def group_statuses(core):
+    """Comparable snapshot of all allocated groups (recovery ground truth)."""
+    out = {}
+    for name, g in sorted(core.affinity_groups.items()):
+        s = g.to_status()["status"]
+        out[name] = (
+            s["state"],
+            s["priority"],
+            {k: sorted(v) for k, v in s["physicalPlacement"].items()},
+            sorted(s["allocatedPods"]),
+        )
+    return out
+
+
+def replay_into_fresh_core(bound, bad_nodes, nodes):
+    """Simulate scheduler restart: fresh core + informer replay of bound
+    pods (annotation-only state) in a scrambled but deterministic order."""
+    core = HivedCore(tpu_design_config())
+    for n in nodes:
+        if n in bad_nodes:
+            core.set_bad_node(n)
+        else:
+            core.set_healthy_node(n)
+    for uid in sorted(bound, reverse=True):
+        core.add_allocated_pod(bound[uid])
+    return core
+
+
+def run_gang_replay_sequence(seed: int, steps: int = 60) -> None:
+    """Fuzz heterogeneous gangs + restart-replay interleavings.
+
+    Gangs mix member shapes (the reference's group9 7+5 analog,
+    hived_algorithm_test.go:93-95); at random points the whole scheduler
+    'restarts' — a fresh core is rebuilt purely from the bound pods'
+    annotations and must reproduce the live core's group state exactly
+    (the reference's reconfiguration test shape, L1042-1092).
+    """
+    rng = random.Random(seed ^ 0xBEEF)
+    core = HivedCore(tpu_design_config())
+    nodes = sorted(
+        {
+            n
+            for ccl in core.full_cell_list.values()
+            for c in ccl[ccl.top_level]
+            for n in c.nodes
+        }
+    )
+    for n in nodes:
+        core.set_healthy_node(n)
+    bound = {}  # uid -> binding pod
+    gangs = {}  # name -> [uids]
+    bad_nodes = set()
+
+    def try_gang(step):
+        gname = f"g{step}"
+        # 1-3 member specs with mixed sizes (sub-host and whole-host).
+        members = [
+            {"podNumber": rng.randint(1, 2), "leafCellNumber": rng.choice([1, 2, 4])}
+            for _ in range(rng.randint(1, 3))
+        ]
+        group = {"name": gname, "members": members}
+        vc = rng.choice(["VC1", "VC2"])
+        leaf_type = rng.choice(["v5e-chip", "v5p-chip"])
+        pods = []
+        for m_i, m in enumerate(members):
+            for p_i in range(m["podNumber"]):
+                uid = f"{gname}-{m_i}-{p_i}"
+                pods.append(
+                    make_pod(
+                        uid, uid, vc, 0, leaf_type, m["leafCellNumber"],
+                        group=group,
+                    )
+                )
+        staged = []
+        for p in pods:
+            r = core.schedule(p, nodes, SchedulingPhase.FILTERING)
+            if r.pod_bind_info is None:
+                # Gang doesn't fit: roll back the assumed part.
+                for bp in staged:
+                    core.delete_allocated_pod(bp)
+                return
+            bp = new_binding_pod(p, r.pod_bind_info)
+            bp.phase = "Running"
+            core.add_allocated_pod(bp)
+            staged.append(bp)
+        for bp in staged:
+            bound[bp.uid] = bp
+        gangs[gname] = [bp.uid for bp in staged]
+
+    for step in range(steps):
+        op = rng.random()
+        if op < 0.35:
+            try_gang(step)
+        elif op < 0.55 and gangs:
+            gname = rng.choice(sorted(gangs))
+            for uid in gangs.pop(gname):
+                core.delete_allocated_pod(bound.pop(uid))
+        elif op < 0.65:
+            n = rng.choice(nodes)
+            bad_nodes.add(n)
+            core.set_bad_node(n)
+        elif op < 0.75:
+            n = rng.choice(nodes)
+            bad_nodes.discard(n)
+            core.set_healthy_node(n)
+        else:
+            # Scheduler restart: recovered state must match live state.
+            recovered = replay_into_fresh_core(bound, bad_nodes, nodes)
+            live, rec = group_statuses(core), group_statuses(recovered)
+            assert live == rec, (
+                f"seed {seed} step {step}: recovery mismatch\n"
+                f"live: {live}\nrecovered: {rec}"
+            )
+            # Continue ON the recovered core: post-restart operation must be
+            # indistinguishable (the strongest property of the replay).
+            core = recovered
+        err = doomed_invariant(core)
+        assert err is None, f"seed {seed} step {step}: {err}"
+
+    # Drain everything; no leaks.
+    for n in nodes:
+        core.set_healthy_node(n)
+    for gname in sorted(gangs):
+        for uid in gangs[gname]:
+            core.delete_allocated_pod(bound.pop(uid))
+    for chain, ccl in core.full_cell_list.items():
+        for cell in ccl[ccl.top_level]:
+            assert cell.state.value == "Free", (
+                f"seed {seed}: leak {chain} {cell.address} {cell.state.value}"
+            )
+
+
+@pytest.mark.parametrize("seed_block", range(4))
+def test_fuzz_hetero_gangs_with_restart_replay(seed_block):
+    for seed in range(seed_block * 15, (seed_block + 1) * 15):
+        run_gang_replay_sequence(seed)

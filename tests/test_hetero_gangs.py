@@ -1,0 +1,175 @@
+"""Heterogeneous-member affinity groups (the PP scheduling analog).
+
+The reference exercises a gang whose members have different device counts
+(group9, a 7-GPU + 5-GPU pod pair: hived_algorithm_test.go:93-95, with
+totalPodNums keyed by leaf-cell count at types.go:141). These tests drive
+the same shape through the full lifecycle — schedule -> bind -> recovery
+replay -> delete — plus the recovery-disambiguation case the advisor
+flagged (two same-sized pods of one gang on one node).
+"""
+
+import logging
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.scheduler.types import SchedulingPhase
+
+from .test_core import Sim, make_pod
+
+common.init_logging(logging.ERROR)
+
+
+def hetero_gang(name):
+    """One 4-chip pod + two 2-chip pods: a driver stage and two worker
+    stages of a pipeline job."""
+    return {
+        "name": name,
+        "members": [
+            {"podNumber": 1, "leafCellNumber": 4},
+            {"podNumber": 2, "leafCellNumber": 2},
+        ],
+    }
+
+
+def schedule_hetero(sim, vc="VC2", leaf_type="v5e-chip", priority=0):
+    g = hetero_gang("pp-gang")
+    pods = [
+        make_pod("pp-a", "u-a", vc, priority, leaf_type, 4, group=g),
+        make_pod("pp-b", "u-b", vc, priority, leaf_type, 2, group=g),
+        make_pod("pp-c", "u-c", vc, priority, leaf_type, 2, group=g),
+    ]
+    return pods, [sim.schedule_and_bind(p) for p in pods]
+
+
+def test_hetero_gang_schedule_bind_delete():
+    sim = Sim()
+    pods, bound = schedule_hetero(sim)
+
+    status = sim.core.get_affinity_group("pp-gang")["status"]
+    assert status["state"] == "Allocated"
+    assert sorted(status["allocatedPods"]) == ["u-a", "u-b", "u-c"]
+    # 4 + 2 + 2 chips placed in total.
+    placed = [i for chips in status["physicalPlacement"].values() for i in chips]
+    assert len(placed) == 8
+
+    g = sim.core.affinity_groups["pp-gang"]
+    assert g.total_pod_nums == {4: 1, 2: 2}
+    assert [p is not None for p in g.allocated_pods[4]] == [True]
+    assert [p is not None for p in g.allocated_pods[2]] == [True, True]
+
+    # Deleting only the 4-chip member keeps the group alive; slots empty
+    # correctly per member size.
+    sim.delete(pods[0])
+    g = sim.core.affinity_groups["pp-gang"]
+    assert g.allocated_pods[4] == [None]
+    assert sorted(
+        p.uid for p in g.allocated_pods[2] if p is not None
+    ) == ["u-b", "u-c"]
+
+    sim.delete(pods[1])
+    sim.delete(pods[2])
+    assert "pp-gang" not in sim.core.affinity_groups
+
+
+def test_hetero_gang_recovery_replay():
+    sim = Sim()
+    pods, bound = schedule_hetero(sim)
+    want = sim.core.get_affinity_group("pp-gang")["status"]
+
+    # Scheduler restart: a fresh core sees only the informer replay of the
+    # bound pods (in an arbitrary order).
+    fresh = Sim()
+    for bp in [bound[2], bound[0], bound[1]]:
+        fresh.core.add_allocated_pod(bp)
+        fresh.bound[bp.uid] = bp
+
+    got = fresh.core.get_affinity_group("pp-gang")["status"]
+    assert got["physicalPlacement"] == want["physicalPlacement"]
+    assert got["virtualPlacement"] == want["virtualPlacement"]
+    assert sorted(got["allocatedPods"]) == sorted(want["allocatedPods"])
+    g = fresh.core.affinity_groups["pp-gang"]
+    # Every slot of every member size recovered exactly one pod.
+    assert [p is not None for p in g.allocated_pods[4]] == [True]
+    assert [p is not None for p in g.allocated_pods[2]] == [True, True]
+
+    # The recovered state must be fully releasable (no leaked cells).
+    for p in pods:
+        fresh.delete(p)
+    assert "pp-gang" not in fresh.core.affinity_groups
+    for chain, ccl in fresh.core.full_cell_list.items():
+        for cell in ccl[ccl.top_level]:
+            # VC2 shares the tree with live VC1 state in other tests; here
+            # nothing else was ever allocated.
+            assert cell.state.value == "Free", (chain, cell.address)
+
+
+def test_same_size_members_same_node_recovery_no_alias():
+    """Two same-sized pods of one gang landing on ONE node: recovery must
+    map each to its own slot by chip indices, not alias both to slot 0
+    (advisor finding on get_allocated_pod_index, core.py:107-122)."""
+    sim = Sim()
+    g = {"name": "twins", "members": [{"podNumber": 2, "leafCellNumber": 2}]}
+    pods = [
+        make_pod(
+            "tw-0", "u-tw0", "VC2", 0, "v5e-chip", 2, group=g,
+            ignore_suggested=False,
+        ),
+        make_pod(
+            "tw-1", "u-tw1", "VC2", 0, "v5e-chip", 2, group=g,
+            ignore_suggested=False,
+        ),
+    ]
+    # The v5e-solo host (2+2 chips with nonstandard indices) forces both
+    # sub-host pods onto the same node.
+    bound = [
+        sim.schedule_and_bind(p, suggested=["v5e-solo"]) for p in pods
+    ]
+    assert bound[0].node_name == bound[1].node_name == "v5e-solo"
+    chips0 = sim.bound["u-tw0"].annotations[
+        "hivedscheduler.tpu.io/pod-leaf-cell-isolation"
+    ]
+    chips1 = sim.bound["u-tw1"].annotations[
+        "hivedscheduler.tpu.io/pod-leaf-cell-isolation"
+    ]
+    assert chips0 != chips1
+
+    fresh = Sim()
+    for bp in bound:
+        fresh.core.add_allocated_pod(bp)
+        fresh.bound[bp.uid] = bp
+    g2 = fresh.core.affinity_groups["twins"]
+    recovered = [p.uid for p in g2.allocated_pods[2] if p is not None]
+    assert sorted(recovered) == ["u-tw0", "u-tw1"], recovered
+
+    for p in pods:
+        fresh.delete(p)
+    assert "twins" not in fresh.core.affinity_groups
+
+
+def test_hetero_gang_preemption_and_insufficiency():
+    """A low-priority hetero gang is preempted by a high-priority one; a
+    gang too large for the VC quota fails cleanly."""
+    sim = Sim()
+    pods, bound = schedule_hetero(sim, priority=0)
+
+    # VC2 has one v5e-16 (16 chips) + one v5e-host (4 chips); the hetero
+    # gang took 8 chips of something. A 16-chip high-priority gang on the
+    # v5e chain must be able to preempt the low one if placements overlap.
+    big = {"name": "big", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    big_pods = [
+        make_pod(f"big-{i}", f"u-big{i}", "VC2", 10, "v5e-chip", 4, group=big)
+        for i in range(4)
+    ]
+    results = [
+        sim.schedule(p, phase=SchedulingPhase.PREEMPTING) for p in big_pods
+    ]
+    # Either it fits in free space (bind infos) or it preempts the gang.
+    victims = {
+        v.uid
+        for r in results
+        if r.pod_preempt_info is not None
+        for v in r.pod_preempt_info.victim_pods
+    }
+    binds = [r for r in results if r.pod_bind_info is not None]
+    assert victims or len(binds) == len(big_pods)
+    if victims:
+        assert victims <= {"u-a", "u-b", "u-c"}
