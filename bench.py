@@ -179,7 +179,75 @@ def run(n_gangs: int = 120, seed: int = 0):
     p99 = sorted(gang_latencies_ms)[
         min(len(gang_latencies_ms) - 1, int(0.99 * len(gang_latencies_ms)))
     ]
-    return p50, p99, len(gang_latencies_ms)
+    return p50, p99, len(gang_latencies_ms), sched, live
+
+
+def bench_preempt(sched, nodes, n_calls: int = 30) -> float:
+    """p50 latency of the production preempt verb on the loaded cluster:
+    a high-priority gang preempts, then cancels (shrunken suggested set),
+    repeatedly — exercising commit + cancellation, the two expensive
+    preemption paths."""
+    lat = []
+    victims_template = {n: {} for n in nodes}
+    for i in range(n_calls):
+        group = {
+            "name": f"preemptor-{i}",
+            "members": [{"podNumber": 4, "leafCellNumber": 4}],
+        }
+        pod = make_pod(
+            f"preemptor-{i}-0", f"preemptor-{i}-u0", "prod", 100,
+            "v5p-chip", 4, group,
+        )
+        sched.add_pod(pod)
+        t0 = time.perf_counter()
+        sched.preempt_routine(
+            ei.ExtenderPreemptionArgs(
+                pod=pod, node_name_to_meta_victims=dict(victims_template)
+            )
+        )
+        # Cancel by rescheduling with an empty candidate set.
+        sched.preempt_routine(
+            ei.ExtenderPreemptionArgs(pod=pod, node_name_to_meta_victims={})
+        )
+        lat.append((time.perf_counter() - t0) * 1e3)
+        sched.delete_pod(pod)
+    return statistics.median(lat)
+
+
+def bench_recovery(sched) -> dict:
+    """Full restart recovery: rebuild a fresh scheduler purely from the
+    bound pods' annotations (the informer replay path), timed end-to-end —
+    the reference's work-preserving restart story (SURVEY §5)."""
+    bound = [
+        st.pod
+        for st in sched.pod_schedule_statuses.values()
+        if st.pod is not None and st.pod.node_name
+    ]
+    nodes = sorted(
+        {
+            n
+            for ccl in sched.core.full_cell_list.values()
+            for c in ccl[ccl.top_level]
+            for n in c.nodes
+        }
+    )
+    t0 = time.perf_counter()
+    fresh = HivedScheduler(build_config(), kube_client=NullKubeClient())
+    for n in nodes:
+        fresh.add_node(Node(name=n))
+    for bp in bound:
+        bp2 = Pod(
+            name=bp.name, namespace=bp.namespace, uid=bp.uid,
+            annotations=bp.annotations, node_name=bp.node_name,
+            phase="Running", resource_limits=bp.resource_limits,
+        )
+        fresh.add_pod(bp2)
+    elapsed_ms = (time.perf_counter() - t0) * 1e3
+    return {
+        "replay_total_ms": round(elapsed_ms, 2),
+        "pods_replayed": len(bound),
+        "replay_per_pod_ms": round(elapsed_ms / max(1, len(bound)), 3),
+    }
 
 
 def model_perf() -> dict:
@@ -223,7 +291,17 @@ def model_perf() -> dict:
 if __name__ == "__main__":
     # Warm-up pass (imports, allocator caches), then the measured pass.
     run(n_gangs=24, seed=1)
-    p50, p99, n = run()
+    p50, p99, n, sched, live = run()
+    nodes = sorted(
+        {
+            nn
+            for ccl in sched.core.full_cell_list.values()
+            for c in ccl[ccl.top_level]
+            for nn in c.nodes
+        }
+    )
+    preempt_p50 = bench_preempt(sched, nodes)
+    recovery = bench_recovery(sched)
     perf = model_perf()
     print(
         json.dumps(
@@ -235,6 +313,8 @@ if __name__ == "__main__":
                 "extra": {
                     "p99_ms": round(p99, 3),
                     "gangs_scheduled": n,
+                    "preempt_p50_ms": round(preempt_p50, 3),
+                    "recovery": recovery,
                     "model_perf": perf,
                 },
             }

@@ -20,6 +20,7 @@ downward-API volume, the way the reference maps its isolation annotation to
 
 from __future__ import annotations
 
+import functools
 import re
 from typing import Dict, List, Tuple
 
@@ -50,19 +51,30 @@ def _worker_order(info: api.PodBindInfo) -> List[Tuple[str, Tuple[int, ...]]]:
     natural sort keeps that true past 10 hosts. Within a node, the lowest
     chip index breaks ties between sub-host pods.
     """
-    placements: List[Tuple[str, Tuple[int, ...]]] = []
-    for member in info.affinity_group_bind_info:
-        for placement in member.pod_placements:
-            placements.append(
-                (
-                    placement.physical_node,
-                    tuple(placement.physical_leaf_cell_indices),
-                )
-            )
-    placements.sort(
-        key=lambda p: (_natural_key(p[0]), p[1][0] if p[1] else -1)
+    placements: Tuple[Tuple[str, Tuple[int, ...]], ...] = tuple(
+        (
+            placement.physical_node,
+            tuple(placement.physical_leaf_cell_indices),
+        )
+        for member in info.affinity_group_bind_info
+        for placement in member.pod_placements
     )
-    return placements
+    return list(_sorted_worker_order(placements))
+
+
+@functools.lru_cache(maxsize=4096)
+def _sorted_worker_order(
+    placements: Tuple[Tuple[str, Tuple[int, ...]], ...]
+) -> Tuple[Tuple[str, Tuple[int, ...]], ...]:
+    """Content-keyed memo of the natural sort: every pod of a gang carries
+    the identical placement list, so the O(n log n) ordering runs once per
+    gang instead of once per pod per filter round."""
+    return tuple(
+        sorted(
+            placements,
+            key=lambda p: (_natural_key(p[0]), p[1][0] if p[1] else -1),
+        )
+    )
 
 
 def pod_tpu_env(info: api.PodBindInfo) -> Dict[str, str]:
