@@ -462,6 +462,126 @@ def test_recovery_replays_placement_exactly():
             assert leaf.state == CellState.USED
 
 
+def test_reconfiguration_mutation_cases():
+    """The reference's four reconfiguration mutation classes in one restart
+    (hived_algorithm_test.go:1042-1092): shrunk VC quota, physical cell
+    address not found, physical cell split into smaller top cells (chain
+    move), and clean deletion of everything replayed."""
+    sim = Sim()
+    # A and A2: two separate 4-chip groups on VC1's two v5p-16 cells.
+    a = sim.schedule_and_bind(
+        make_pod("a", "ua", "VC1", 0, "v5p-chip", 4,
+                 ignore_suggested=False),
+        phase=SchedulingPhase.PREEMPTING, suggested=["v5p64-w12"],
+    )
+    a2 = sim.schedule_and_bind(
+        make_pod("a2", "ua2", "VC1", 0, "v5p-chip", 4,
+                 ignore_suggested=False),
+        phase=SchedulingPhase.PREEMPTING, suggested=["v5p64-w8"],
+    )
+    # B: VC2 pod on the node whose address will disappear.
+    b = sim.schedule_and_bind(
+        make_pod("b", "ub", "VC2", 0, "v5p-chip", 4,
+                 ignore_suggested=False),
+        phase=SchedulingPhase.PREEMPTING, suggested=["v5p64-w13"],
+    )
+    # C: VC1 v5e gang on the slice that will be split into host cells.
+    gc = {"name": "cg", "members": [{"podNumber": 2, "leafCellNumber": 4}]}
+    c_pods = [
+        make_pod(f"c{i}", f"uc{i}", "VC1", 0, "v5e-chip", 4, group=gc,
+                 ignore_suggested=False)
+        for i in range(2)
+    ]
+    c_bound = [
+        sim.schedule_and_bind(
+            p, phase=SchedulingPhase.PREEMPTING,
+            suggested=["v5e16b-w0", "v5e16b-w1"],
+        )
+        for p in c_pods
+    ]
+    assert {bp.node_name for bp in c_bound} == {"v5e16b-w0", "v5e16b-w1"}
+
+    # --- Mutate the config -------------------------------------------- #
+    cfg = tpu_design_config()
+    # 1) VC1's non-pinned v5p-16 quota shrinks 2 -> 1.
+    for vc_cell in cfg.virtual_clusters["VC1"].virtual_cells:
+        if vc_cell.cell_type == "v5p-64.v5p-16":
+            vc_cell.cell_number = 1
+    # 2) v5p64-w13's address disappears (renamed out from under B).
+    for spec in cfg.physical_cluster.physical_cells:
+        if spec.cell_type != "v5p-64":
+            continue
+        for sub in spec.cell_children:
+            for host in sub.cell_children:
+                if host.cell_address.endswith("/v5p64-w13"):
+                    host.cell_address = host.cell_address.replace(
+                        "v5p64-w13", "v5p64-gone"
+                    )
+    # 3) The v5e16b slice is split into 4 standalone v5e-host cells (same
+    #    node names, different chain).
+    split_hosts = []
+    kept = []
+    for spec in cfg.physical_cluster.physical_cells:
+        if spec.cell_type == "v5e-16" and any(
+            h.cell_address.endswith("v5e16b-w0") for h in spec.cell_children
+        ):
+            for host in spec.cell_children:
+                node = host.cell_address.split("/")[-1]
+                split_hosts.append(
+                    api.PhysicalCellSpec(
+                        cell_type="v5e-host", cell_address=node
+                    )
+                )
+        else:
+            kept.append(spec)
+    cfg.physical_cluster.physical_cells = kept + split_hosts
+    from hivedscheduler_tpu.api.config import default_physical_cells
+
+    default_physical_cells(cfg.physical_cluster)
+
+    # --- Restart + replay --------------------------------------------- #
+    sim2 = Sim(cfg)
+    for bp in [a, a2, b] + c_bound:
+        sim2.core.add_allocated_pod(bp)
+
+    # Quota shrink: first-replayed A keeps its virtual placement, A2 is
+    # lazy-preempted (work-preserving: still Allocated, still on w8).
+    ga = sim2.core.affinity_groups["default/a"]
+    ga2 = sim2.core.affinity_groups["default/a2"]
+    assert ga.state == GroupState.ALLOCATED
+    assert ga.virtual_placement is not None
+    assert ga2.state == GroupState.ALLOCATED
+    assert ga2.virtual_placement is None
+    assert ga2.lazy_preemption_status is not None
+    assert sorted(
+        ga2.to_status()["status"]["physicalPlacement"]
+    ) == ["v5p64-w8"]
+
+    # Missing cell: B's pod is ignored (no placement recovered), and the
+    # core survives both the replay and the (idempotent) delete.
+    gb = sim2.core.affinity_groups.get("default/b")
+    if gb is not None:
+        assert gb.to_status()["status"]["physicalPlacement"] == {}
+    sim2.core.delete_allocated_pod(b)
+
+    # Chain move: C's cells now live in the v5e-host chain; the pods keep
+    # running on their original nodes, lazy-preempted out of the old
+    # v5e-16 virtual cells (which can no longer bind split physical cells).
+    gcr = sim2.core.affinity_groups["cg"]
+    assert gcr.state == GroupState.ALLOCATED
+    assert sorted(gcr.to_status()["status"]["physicalPlacement"]) == [
+        "v5e16b-w0", "v5e16b-w1"
+    ]
+    assert gcr.virtual_placement is None
+
+    # Everything replayed can be deleted cleanly; no leaked cell state.
+    for bp in [a, a2] + c_bound:
+        sim2.core.delete_allocated_pod(bp)
+    for chain, ccl in sim2.core.full_cell_list.items():
+        for cell in ccl[ccl.top_level]:
+            assert cell.state == CellState.FREE, (chain, cell.address)
+
+
 def test_inspect_statuses(sim):
     pod = make_pod("i-0", "iu0", "VC1", 3, "v5e-chip", 4)
     sim.schedule_and_bind(pod)
