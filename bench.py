@@ -270,22 +270,36 @@ def model_perf() -> dict:
         return {"skipped": "backend probe timed out (TPU tunnel dead?)"}
     if probe.returncode != 0:
         return {"skipped": f"backend probe rc={probe.returncode}"}
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-m", "hivedscheduler_tpu.models.perf"],
-            capture_output=True,
-            text=True,
-            timeout=600,
-            cwd=here,
-        )
-    except subprocess.TimeoutExpired:
-        return {"skipped": "model perf timed out"}
-    if proc.returncode != 0:
-        return {"skipped": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
-    try:
-        return json.loads(proc.stdout.strip().splitlines()[-1])
-    except (json.JSONDecodeError, IndexError):
-        return {"skipped": f"unparseable output: {proc.stdout[-200:]}"}
+    def attempt(extra_env: dict) -> dict:
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "hivedscheduler_tpu.models.perf"],
+                capture_output=True,
+                text=True,
+                timeout=600,
+                cwd=here,
+                env={**os.environ, **extra_env},
+            )
+        except subprocess.TimeoutExpired:
+            return {"skipped": "model perf timed out"}
+        if proc.returncode != 0:
+            return {"skipped": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+        try:
+            return json.loads(proc.stdout.strip().splitlines()[-1])
+        except (json.JSONDecodeError, IndexError):
+            return {"skipped": f"unparseable output: {proc.stdout[-200:]}"}
+
+    result = attempt({})
+    if "skipped" in result and "timed out" not in result["skipped"]:
+        # Degradation path: a hard crash in the Pallas kernels (e.g. a Mosaic
+        # compiler abort the in-process fallback can't catch) must downgrade
+        # the tokens/sec number to the XLA path, never erase it.
+        retry = attempt({"HIVED_DISABLE_PALLAS": "1"})
+        if "skipped" not in retry:
+            retry["attention_fallback"] = "xla"
+            retry["attention_fallback_reason"] = result["skipped"]
+            return retry
+    return result
 
 
 if __name__ == "__main__":

@@ -34,6 +34,15 @@ NEG_INF = -1e30
 # Interpreter mode for pallas kernels (CPU tests); real TPU runs leave False.
 INTERPRET = False
 
+# Degradation switch: force the XLA path even on TPU (see ``mha``).
+DISABLE_PALLAS = False
+
+# Mosaic requires the last two dims of every block to respect the (8, 128)
+# tile. Per-row scalars (logsumexp, delta) therefore cannot be rank-1 blocks:
+# they are stored broadcast across a 128-wide lane dimension, the same layout
+# jax.experimental.pallas.ops.tpu.flash_attention uses (MIN_BLOCK_SIZE).
+LANE = 128
+
 
 def mha_reference(
     q: jax.Array,  # [B, Sq, Hq, D]
@@ -126,30 +135,43 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, sm_scale,
     m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[...] = m + jnp.log(l)
+    lse_ref[...] = jnp.broadcast_to(
+        (m + jnp.log(l))[:, None], (block_q, LANE)
+    )
 
 
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                     dk_ref, dv_ref, *, block_q, sm_scale, causal, block_k,
-                     seq_len):
-    """One (batch*head, k-block) program: accumulate dK, dV over q blocks."""
+                     dk_ref, dv_ref, acc_dk, acc_dv, *, block_q, sm_scale,
+                     causal, block_k):
+    """One (batch*head, k-block, q-block) grid step: accumulate this q
+    block's dK/dV contribution into VMEM scratch; flush on the last q step.
+
+    The q sweep is a *grid dimension*, not an in-kernel loop over full-
+    sequence refs: only one (block_q, d) slab of q/do and one
+    (block_q, LANE) slab of lse/delta is resident at a time, so VMEM stays
+    O(block) instead of O(seq) — the fori_loop formulation ran out of
+    scoped VMEM at seq 8192 (full-s refs alone are ~12 MB of the 16 MB
+    budget). The dk/dv out-spec index is constant in the innermost grid
+    dim, which is the Mosaic output-revisiting pattern.
+    """
     import jax.experimental.pallas as pl
 
     k_idx = pl.program_id(1)
-    k_blk = k_ref[...]  # [block_k, d]
-    v_blk = v_ref[...]
-    d = k_blk.shape[-1]
+    q_i = pl.program_id(2)
 
-    k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1
-    )
+    @pl.when(q_i == 0)
+    def _init():
+        acc_dk[...] = jnp.zeros_like(acc_dk)
+        acc_dv[...] = jnp.zeros_like(acc_dv)
 
-    def body(q_i, carry):
-        dk, dv = carry
-        q = q_ref[pl.dslice(q_i * block_q, block_q), :]
-        do = do_ref[pl.dslice(q_i * block_q, block_q), :]
-        lse = lse_ref[pl.dslice(q_i * block_q, block_q)]
-        delta = delta_ref[pl.dslice(q_i * block_q, block_q)]
+    def compute():
+        k_blk = k_ref[...]  # [block_k, d]
+        v_blk = v_ref[...]
+        q = q_ref[...]      # [block_q, d]
+        do = do_ref[...]
+        # lse/delta are lane-broadcast [block_q, LANE]; lane 0 is the scalar.
+        lse = lse_ref[:, 0:1]    # [block_q, 1]
+        delta = delta_ref[:, 0:1]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -158,10 +180,13 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q_pos = q_i * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
+            k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])  # [block_q, block_k]
+        p = jnp.exp(s - lse)  # [block_q, block_k]
         # dV += P^T dO
-        dv = dv + jax.lax.dot_general(
+        acc_dv[...] += jax.lax.dot_general(
             p, do.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -171,80 +196,83 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta)
         # dK += dS^T Q * scale
-        dk = dk + jax.lax.dot_general(
+        acc_dk[...] += jax.lax.dot_general(
             ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
-        return dk, dv
 
-    num_q_blocks = seq_len // block_q
     if causal:
-        # Only q blocks at or after this k block see it.
-        lower = jax.lax.div(k_idx * block_k, jnp.int32(block_q))
+        # Skip q blocks strictly above the diagonal for this k block.
+        @pl.when((q_i + 1) * block_q > k_idx * block_k)
+        def _():
+            compute()
     else:
-        lower = jnp.int32(0)
-    dk0 = jnp.zeros((block_k, d), dtype=jnp.float32)
-    dv0 = jnp.zeros((block_k, d), dtype=jnp.float32)
-    dk, dv = jax.lax.fori_loop(lower, num_q_blocks, body, (dk0, dv0))
-    dk_ref[...] = dk.astype(dk_ref.dtype)
-    dv_ref[...] = dv.astype(dv_ref.dtype)
+        compute()
+
+    @pl.when(q_i == pl.num_programs(2) - 1)
+    def _flush():
+        dk_ref[...] = acc_dk[...].astype(dk_ref.dtype)
+        dv_ref[...] = acc_dv[...].astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, block_k, sm_scale, causal, block_q, seq_len):
-    """One (batch*head, q-block) program: accumulate dQ over k blocks."""
+                   acc_dq, *, block_k, sm_scale, causal, block_q):
+    """One (batch*head, q-block, k-block) grid step: accumulate this k
+    block's dQ contribution into VMEM scratch; flush on the last k step.
+    Same O(block)-VMEM restructuring as _bwd_dkdv_kernel."""
     import jax.experimental.pallas as pl
 
     q_idx = pl.program_id(1)
-    q = q_ref[...]
-    do = do_ref[...]
-    lse = lse_ref[...]
-    delta = delta_ref[...]
-    d = q.shape[-1]
+    k_i = pl.program_id(2)
 
-    q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
-    )
+    @pl.when(k_i == 0)
+    def _init():
+        acc_dq[...] = jnp.zeros_like(acc_dq)
 
-    def body(k_i, dq):
-        k_blk = k_ref[pl.dslice(k_i * block_k, block_k), :]
-        v_blk = v_ref[pl.dslice(k_i * block_k, block_k), :]
+    def compute():
+        q = q_ref[...]
+        do = do_ref[...]
+        lse = lse_ref[:, 0:1]    # lane-broadcast [block_q, LANE]; lane 0
+        delta = delta_ref[:, 0:1]
+        k_blk = k_ref[...]
+        v_blk = v_ref[...]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
         if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
             k_pos = k_i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
+        p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do.astype(jnp.float32), v_blk.astype(jnp.float32),
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta[:, None])
-        return dq + jax.lax.dot_general(
+        ds = p * (dp - delta)
+        acc_dq[...] += jax.lax.dot_general(
             ds, k_blk.astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale
 
-    num_k_blocks = seq_len // block_k
     if causal:
-        upper = jnp.minimum(
-            jax.lax.div((q_idx + 1) * block_q + block_k - 1,
-                        jnp.int32(block_k)),
-            num_k_blocks,
-        )
+        # Skip k blocks entirely above the diagonal for this q block.
+        @pl.when(k_i * block_k < (q_idx + 1) * block_q)
+        def _():
+            compute()
     else:
-        upper = num_k_blocks
-    dq = jax.lax.fori_loop(
-        0, upper, body, jnp.zeros((block_q, d), dtype=jnp.float32)
-    )
-    dq_ref[...] = dq.astype(dq_ref.dtype)
+        compute()
+
+    @pl.when(k_i == pl.num_programs(2) - 1)
+    def _flush():
+        dq_ref[...] = acc_dq[...].astype(dq_ref.dtype)
 
 
 def _flash_fwd_bh(qt, kt, vt, causal, scale, block_q, block_k):
@@ -265,11 +293,11 @@ def _flash_fwd_bh(qt, kt, vt, causal, scale, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((None, block_q, LANE), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), qt.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, LANE), jnp.float32),
         ],
         interpret=INTERPRET,
     )(qt, kt, vt)
@@ -277,56 +305,68 @@ def _flash_fwd_bh(qt, kt, vt, causal, scale, block_q, block_k):
 
 def _flash_bwd_bh(qt, kt, vt, ot, do, lse, causal, scale, block_q, block_k):
     import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = qt.shape
-    # delta = rowsum(dO * O): cheap elementwise, XLA fuses it.
+    # delta = rowsum(dO * O): cheap elementwise, XLA fuses it. Lane-broadcast
+    # to [bh, s, LANE] to match the tiled layout the kernels require.
     delta = jnp.sum(
         do.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1
-    )  # [bh, s]
+    )
+    delta = jnp.broadcast_to(delta[..., None], (bh, s, LANE))
 
     dkdv = functools.partial(
         _bwd_dkdv_kernel, block_q=block_q, sm_scale=scale, causal=causal,
-        block_k=block_k, seq_len=s,
+        block_k=block_k,
     )
     dk, dv = pl.pallas_call(
         dkdv,
-        grid=(bh, s // block_k),
+        grid=(bh, s // block_k, s // block_q),
         in_specs=[
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),      # q
-            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),  # k
-            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),  # v
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),      # do
-            pl.BlockSpec((None, s), lambda i, j: (i, 0)),            # lse
-            pl.BlockSpec((None, s), lambda i, j: (i, 0)),            # delta
+            pl.BlockSpec((None, block_q, d), lambda i, j, q: (i, q, 0)),  # q
+            pl.BlockSpec((None, block_k, d), lambda i, j, q: (i, j, 0)),  # k
+            pl.BlockSpec((None, block_k, d), lambda i, j, q: (i, j, 0)),  # v
+            pl.BlockSpec((None, block_q, d), lambda i, j, q: (i, q, 0)),  # do
+            pl.BlockSpec((None, block_q, LANE),
+                         lambda i, j, q: (i, q, 0)),                      # lse
+            pl.BlockSpec((None, block_q, LANE),
+                         lambda i, j, q: (i, q, 0)),                    # delta
         ],
         out_specs=[
-            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j, q: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), lambda i, j, q: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
             jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=INTERPRET,
     )(qt, kt, vt, do, lse, delta)
 
     dqk = functools.partial(
         _bwd_dq_kernel, block_k=block_k, sm_scale=scale, causal=causal,
-        block_q=block_q, seq_len=s,
+        block_q=block_q,
     )
     dq = pl.pallas_call(
         dqk,
-        grid=(bh, s // block_q),
+        grid=(bh, s // block_q, s // block_k),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),  # q
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),      # k
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),      # v
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),  # do
-            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),      # lse
-            pl.BlockSpec((None, block_q), lambda i, j: (i, j)),      # delta
+            pl.BlockSpec((None, block_q, d), lambda i, j, k: (i, j, 0)),  # q
+            pl.BlockSpec((None, block_k, d), lambda i, j, k: (i, k, 0)),  # k
+            pl.BlockSpec((None, block_k, d), lambda i, j, k: (i, k, 0)),  # v
+            pl.BlockSpec((None, block_q, d), lambda i, j, k: (i, j, 0)),  # do
+            pl.BlockSpec((None, block_q, LANE),
+                         lambda i, j, k: (i, j, 0)),                      # lse
+            pl.BlockSpec((None, block_q, LANE),
+                         lambda i, j, k: (i, j, 0)),                    # delta
         ],
-        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j, k: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=INTERPRET,
     )(qt, kt, vt, do, lse, delta)
     return dq, dk, dv
@@ -414,9 +454,18 @@ def mha(
     use_pallas: Optional[bool] = None,
 ) -> jax.Array:
     """Dispatch: Pallas flash kernels (fwd+bwd) on TPU for long sequences,
-    XLA reference elsewhere."""
+    XLA reference elsewhere. ``HIVED_DISABLE_PALLAS=1`` (or setting
+    ``attention.DISABLE_PALLAS``) forces the XLA path — the degradation
+    switch perf/bench harnesses flip so a kernel regression downgrades the
+    throughput number instead of erasing it."""
+    import os
+
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
+        use_pallas = (
+            jax.default_backend() == "tpu"
+            and not DISABLE_PALLAS
+            and os.environ.get("HIVED_DISABLE_PALLAS", "0") != "1"
+        )
     s = q.shape[1]
     if use_pallas and s >= 256 and s % 256 == 0 and s == k.shape[1]:
         return flash_attention_tpu(q, k, v, causal, sm_scale)
