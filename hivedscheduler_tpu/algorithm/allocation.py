@@ -326,6 +326,16 @@ def map_physical_cell_to_virtual(
             for candidate in vccl[preassigned_level]
         ):
             return c.virtual_cell, ""
+        target = vccl[preassigned_level][0] if vccl[preassigned_level] else None
+        if target is not None and getattr(target, "vc", None) == c.virtual_cell.vc:
+            # Same VC, different cell list: the binding belongs to a pinned
+            # cell while the replay targets the non-pinned quota (or vice
+            # versa) — not a foreign-VC conflict.
+            return None, (
+                f"physical cell {c.address} is bound to virtual cell "
+                f"{c.virtual_cell.address} of the same VC but outside the "
+                "target (pinned vs non-pinned) cell list"
+            )
         return None, (
             f"physical cell {c.address} is bound to virtual cell "
             f"{c.virtual_cell.address} of another VC"

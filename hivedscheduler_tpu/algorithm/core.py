@@ -691,6 +691,7 @@ class HivedCore:
                 )
                 pc.virtual_cell.set_physical_cell(None)
                 pc.set_virtual_cell(None)
+                self._unbind_bad_descendants(pc)
                 self.vc_doomed_bad_cells[vc_name][chain].remove(pc, level)
                 self.all_vc_doomed_bad_cell_num[chain][level] -= 1
                 self._release_preassigned_cell(pc, vc_name, True)
@@ -1506,9 +1507,35 @@ class HivedCore:
         )
         pc.set_virtual_cell(None)
         vc.set_physical_cell(None)
+        self._unbind_bad_descendants(pc)
         doomed.remove(pc, pc.level)
         self.all_vc_doomed_bad_cell_num[pc.chain][pc.level] -= 1
         self._release_preassigned_cell(pc, vcn, True)
+
+    def _unbind_bad_descendants(self, pc: PhysicalCell) -> None:
+        """Clear the advisory bad-cell bindings under a cell whose own
+        binding was just removed.
+
+        ``_set_bad_cell`` binds a bad cell whenever its parent is bound, so
+        a doomed-bound cell accumulates descendant bindings as nodes under
+        it go bad. Unbinding only the top pair (as the reference's
+        ``tryUnbindDoomedBadCell`` does via a single unbind) would leave
+        those virtual children pointing at physical cells that no longer
+        belong to their VC; a later dynamic bind of the preassigned cell
+        then walks into the stale pointers and corrupts both VCs' cell
+        state across doomed-bind/heal cycles (full-walk analog of the
+        reference's unbindCell, cell_allocation.go:401-420)."""
+        for child in pc.children:
+            assert isinstance(child, PhysicalCell)
+            if child.virtual_cell is not None:
+                v = child.virtual_cell
+                child.set_virtual_cell(None)
+                v.set_physical_cell(None)
+                common.log.info(
+                    "Unbound bad descendant binding %s -> %s",
+                    v.address, child.address,
+                )
+            self._unbind_bad_descendants(child)
 
     # -- leaf cell allocate / release ---------------------------------------
 

@@ -200,7 +200,8 @@ def _node_health_and_suggested(
     suggested_nodes: Optional[Set[str]],
     ignore_suggested: bool,
 ) -> Tuple[bool, bool, api.CellAddress]:
-    """(reference: topology_aware_scheduler.go:268-289)"""
+    """(reference: topology_aware_scheduler.go:268-289, with one deliberate
+    improvement over the reference for unbound virtual cells — see below)"""
     if isinstance(c, PhysicalCell):
         return (
             c.healthy,
@@ -218,6 +219,26 @@ def _node_health_and_suggested(
             or pc.nodes[0] in suggested_nodes,
             pc.address,
         )
+    if isinstance(c, VirtualCell) and not ignore_suggested and suggested_nodes is not None:
+        # Unbound virtual cell: the reference scores it "location unknown →
+        # suggested", but if an ANCESTOR is already bound, this cell can only
+        # ever map inside that ancestor's physical cell — so score it against
+        # the ancestor's node set. Without this, intra-VC packing happily
+        # places a pod into a bound-elsewhere preassigned cell and the
+        # virtual→physical mapping then dies on suggested-node grounds where
+        # an alternate (still-free) preassigned cell would have worked; the
+        # reference waits in that situation
+        # (topology_aware_scheduler.go:243-266), we bind.
+        anc = c.parent
+        while anc is not None:
+            if isinstance(anc, VirtualCell) and anc.physical_cell is not None:
+                pc = anc.physical_cell
+                return (
+                    True,
+                    any(n in suggested_nodes for n in pc.nodes),
+                    pc.address,
+                )
+            anc = anc.parent
     return True, True, ""
 
 

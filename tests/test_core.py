@@ -479,11 +479,14 @@ def test_reconfiguration_mutation_cases():
                  ignore_suggested=False),
         phase=SchedulingPhase.PREEMPTING, suggested=["v5p64-w8"],
     )
-    # B: VC2 pod on the node whose address will disappear.
+    # B: VC2 pod on the node whose address will disappear. (w4 sits in the
+    # one v5p-16 still free for VC2's quota — VC1's groups hold w8-11 and
+    # w12-15; demanding a node inside a cell bound to another VC would be
+    # an infeasible placement under VC-quota semantics.)
     b = sim.schedule_and_bind(
         make_pod("b", "ub", "VC2", 0, "v5p-chip", 4,
                  ignore_suggested=False),
-        phase=SchedulingPhase.PREEMPTING, suggested=["v5p64-w13"],
+        phase=SchedulingPhase.PREEMPTING, suggested=["v5p64-w4"],
     )
     # C: VC1 v5e gang on the slice that will be split into host cells.
     gc = {"name": "cg", "members": [{"podNumber": 2, "leafCellNumber": 4}]}
@@ -507,15 +510,15 @@ def test_reconfiguration_mutation_cases():
     for vc_cell in cfg.virtual_clusters["VC1"].virtual_cells:
         if vc_cell.cell_type == "v5p-64.v5p-16":
             vc_cell.cell_number = 1
-    # 2) v5p64-w13's address disappears (renamed out from under B).
+    # 2) v5p64-w4's address disappears (renamed out from under B).
     for spec in cfg.physical_cluster.physical_cells:
         if spec.cell_type != "v5p-64":
             continue
         for sub in spec.cell_children:
             for host in sub.cell_children:
-                if host.cell_address.endswith("/v5p64-w13"):
+                if host.cell_address.endswith("/v5p64-w4"):
                     host.cell_address = host.cell_address.replace(
-                        "v5p64-w13", "v5p64-gone"
+                        "v5p64-w4", "v5p64-gone"
                     )
     # 3) The v5e16b slice is split into 4 standalone v5e-host cells (same
     #    node names, different chain).
@@ -535,6 +538,13 @@ def test_reconfiguration_mutation_cases():
         else:
             kept.append(spec)
     cfg.physical_cluster.physical_cells = kept + split_hosts
+    # The split leaves only one physical v5e-16; VC1's v5e-16 quota must go
+    # with it (the config would otherwise be an illegal VC assignment), which
+    # is what lazy-preempts the cg group below.
+    cfg.virtual_clusters["VC1"].virtual_cells = [
+        c for c in cfg.virtual_clusters["VC1"].virtual_cells
+        if c.cell_type != "v5e-16"
+    ]
     from hivedscheduler_tpu.api.config import default_physical_cells
 
     default_physical_cells(cfg.physical_cluster)
@@ -610,3 +620,67 @@ def test_inspect_statuses(sim):
     sim.delete(opod)
     vc2 = sim.core.get_virtual_cluster_status("VC2")
     assert not [c for c in vc2 if c["cellAddress"].endswith("-opp")]
+
+
+def _assert_no_dangling_virtual_bindings(core, vc, chain):
+    """Every virtual cell of ``vc``'s ``chain`` tree must be unbound."""
+    ccl = core.vc_schedulers[vc].non_pinned_full[chain]
+    for level in range(1, ccl.top_level + 1):
+        for c in ccl[level]:
+            assert c.physical_cell is None, (vc, c.address)
+
+
+def test_doomed_unbind_clears_descendant_bindings():
+    """Regression for the doomed-binding recursive unbind: a doomed-bound
+    cell accumulates descendant bindings as nodes under it go bad
+    (core._set_bad_cell binds bad children of a bound parent); when capacity
+    heals and the doomed binding is removed, those descendant bindings must
+    go too, or the next doomed-bind/heal cycle walks into stale pointers."""
+    sim = Sim()
+    chain = "v5e-16"
+    # Slice a fully bad, then ONE node of slice b bad: both slice-level
+    # cells are bad, so each VC's free v5e-16 gets doomed-bound.
+    for i in range(4):
+        sim.core.set_bad_node(f"v5e16a-w{i}")
+    sim.core.set_bad_node("v5e16b-w0")
+    assert doomed_num(sim.core, chain) == 2
+    # A second node of slice b goes bad AFTER the doomed binding exists:
+    # this creates descendant bindings under whichever doomed cell covers
+    # slice b (host + chips of w1 bind into the VC's virtual children).
+    sim.core.set_bad_node("v5e16b-w1")
+
+    # Slice a heals: capacity un-dooms both cells. No virtual binding —
+    # top-level or descendant — may survive anywhere in either VC tree.
+    for i in range(4):
+        sim.core.set_healthy_node(f"v5e16a-w{i}")
+    assert doomed_num(sim.core, chain) == 0
+    for vc in ("VC1", "VC2"):
+        _assert_no_dangling_virtual_bindings(sim.core, vc, chain)
+
+    # Re-doom (slice a bad again) and heal everything: same invariant, and
+    # the per-chain counters return to zero.
+    for i in range(4):
+        sim.core.set_bad_node(f"v5e16a-w{i}")
+    assert doomed_num(sim.core, chain) == 2
+    for i in range(4):
+        sim.core.set_healthy_node(f"v5e16a-w{i}")
+    for i in range(2):
+        sim.core.set_healthy_node(f"v5e16b-w{i}")
+    assert doomed_num(sim.core, chain) == 0
+    for vc in ("VC1", "VC2"):
+        _assert_no_dangling_virtual_bindings(sim.core, vc, chain)
+    # The healed cluster still schedules a guaranteed v5e gang cleanly.
+    bp = sim.schedule_and_bind(make_pod("post", "upost", "VC1", 0, "v5e-chip", 4))
+    assert bp.node_name
+
+
+def test_any_leaf_type(sim):
+    """Omitting leafCellType ("") tries every chain the VC has quota in
+    (reference: hived_algorithm.go:857-877 scheduleAffinityGroupForAnyLeafCellType)."""
+    # VC2 has v5p, v5e-16, v5e-host and cpu quota; an untyped 2-cell request
+    # lands in SOME chain's cells.
+    bp = sim.schedule_and_bind(make_pod("any", "anyu", "VC2", 0, "", 2))
+    assert bp.node_name
+    # Untyped requests also work for opportunistic pods.
+    bo = sim.schedule_and_bind(make_pod("anyo", "anyou", "VC2", -1, "", 2))
+    assert bo.node_name
