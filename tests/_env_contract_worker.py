@@ -1,0 +1,55 @@
+"""Worker process for the multi-process env-contract test.
+
+Boots ``jax.distributed`` purely from the env block the scheduler emitted at
+bind time (rank, process count), runs one cross-process collective, and
+checks every rank shows up exactly once. Run as:
+
+    python _env_contract_worker.py '<env-json>' <coordinator-port>
+
+The scheduler emits real cluster hostnames in JAX_COORDINATOR_ADDRESS; those
+do not resolve inside the test harness, so the coordinator host is rewritten
+to loopback — the *contract* under test (consistent rank/count/coordinator
+agreement across independently-bound pods) is untouched.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    env = json.loads(sys.argv[1])
+    port = sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    num = int(env["JAX_NUM_PROCESSES"])
+    pid = int(env["JAX_PROCESS_ID"])
+    assert env["TPU_WORKER_ID"] == env["JAX_PROCESS_ID"]
+
+    import jax
+
+    # A site hook may have imported jax before this script ran, snapshotting
+    # JAX_PLATFORMS at interpreter start — override the live config value the
+    # same way tests/conftest.py does.
+    jax.config.update("jax_platforms", "cpu")
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num,
+        process_id=pid,
+    )
+    assert jax.process_count() == num, (jax.process_count(), num)
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    got = multihost_utils.process_allgather(
+        np.array([pid], dtype=np.int32)
+    ).ravel()
+    expect = np.arange(num, dtype=np.int32)
+    assert (got == expect).all(), (got.tolist(), expect.tolist())
+    print(json.dumps({"pid": pid, "roster": got.tolist()}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
