@@ -290,10 +290,17 @@ def model_perf() -> dict:
             return {"skipped": f"unparseable output: {proc.stdout[-200:]}"}
 
     result = attempt({})
-    if "skipped" in result and "timed out" not in result["skipped"]:
-        # Degradation path: a hard crash in the Pallas kernels (e.g. a Mosaic
+    if (
+        "skipped" in result
+        and "timed out" not in result["skipped"]
+        and os.environ.get("HIVED_DISABLE_PALLAS", "0") != "1"
+    ):
+        # Degradation path: a hard CRASH in the Pallas kernels (e.g. a Mosaic
         # compiler abort the in-process fallback can't catch) must downgrade
-        # the tokens/sec number to the XLA path, never erase it.
+        # the tokens/sec number to the XLA path, never erase it. Soft
+        # failures never reach here: perf.py reports them as data (exit 0,
+        # "train_error" keys) after its own single in-process retry, so one
+        # persistent non-Pallas failure costs at most two runs total.
         retry = attempt({"HIVED_DISABLE_PALLAS": "1"})
         if "skipped" not in retry:
             retry["attention_fallback"] = "xla"

@@ -458,15 +458,27 @@ def mha(
     ``attention.DISABLE_PALLAS``) forces the XLA path — the degradation
     switch perf/bench harnesses flip so a kernel regression downgrades the
     throughput number instead of erasing it."""
-    import os
-
     if use_pallas is None:
-        use_pallas = (
-            jax.default_backend() == "tpu"
-            and not DISABLE_PALLAS
-            and os.environ.get("HIVED_DISABLE_PALLAS", "0") != "1"
-        )
-    s = q.shape[1]
-    if use_pallas and s >= 256 and s % 256 == 0 and s == k.shape[1]:
+        use_pallas = pallas_wanted()
+    if use_pallas and pallas_shape_ok(q.shape[1], k.shape[1]):
         return flash_attention_tpu(q, k, v, causal, sm_scale)
     return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+
+
+def pallas_wanted() -> bool:
+    """True when the dispatcher would *want* the Pallas path: TPU backend
+    and neither kill switch set. Single source of truth for ``mha`` and the
+    perf harness's ``pallas_used`` label."""
+    import os
+
+    return (
+        jax.default_backend() == "tpu"
+        and not DISABLE_PALLAS
+        and os.environ.get("HIVED_DISABLE_PALLAS", "0") != "1"
+    )
+
+
+def pallas_shape_ok(sq: int, sk: int) -> bool:
+    """Shape gate of the Pallas path: long-enough, block-aligned
+    self-attention."""
+    return sq >= 256 and sq % 256 == 0 and sq == sk
