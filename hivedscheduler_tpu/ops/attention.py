@@ -84,60 +84,72 @@ def mha_reference(
 ###############################################################################
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, sm_scale,
-                causal, block_q, seq_len):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc, *,
+                block_k, sm_scale, causal, block_q):
+    """One (batch*head, q-block, k-block) grid step of the streaming-softmax
+    forward: update the online max/sum/accumulator in VMEM scratch, flush
+    o/lse on the last k step.
+
+    The k sweep is a grid dimension (not an in-kernel loop over full-
+    sequence refs), so VMEM holds only (block, d) slabs — the same
+    O(block)-VMEM restructuring as the backward kernels, which is what lets
+    the sequence length scale to long-context sizes. o/lse out-spec indices
+    are constant in the innermost grid dim (Mosaic output revisiting)."""
     import jax.experimental.pallas as pl
 
     q_idx = pl.program_id(1)
-    q = q_ref[...]  # [block_q, d]
-    d = q.shape[-1]
+    k_i = pl.program_id(2)
 
-    m0 = jnp.full((block_q,), NEG_INF, dtype=jnp.float32)
-    l0 = jnp.zeros((block_q,), dtype=jnp.float32)
-    acc0 = jnp.zeros((block_q, d), dtype=jnp.float32)
+    @pl.when(k_i == 0)
+    def _init():
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+        acc_sc[...] = jnp.zeros_like(acc_sc)
 
-    q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0
-    )
-
-    def body(start_k, carry):
-        m_prev, l_prev, acc_prev = carry
-        k_blk = k_ref[pl.dslice(start_k * block_k, block_k), :]
-        v_blk = v_ref[pl.dslice(start_k * block_k, block_k), :]
+    def compute():
+        q = q_ref[...]      # [block_q, d]
+        k_blk = k_ref[...]  # [block_k, d]
+        v_blk = v_ref[...]
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * sm_scale  # [block_q, block_k]
         if causal:
-            k_pos = start_k * block_k + jax.lax.broadcasted_iota(
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = k_i * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_cur[:, None])
+        m_prev = m_sc[:, 0:1]  # [block_q, 1] (lane-broadcast scratch)
+        l_prev = l_sc[:, 0:1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_cur)
         alpha = jnp.exp(m_prev - m_cur)
-        l_cur = l_prev * alpha + jnp.sum(p, axis=-1)
-        acc_cur = acc_prev * alpha[:, None] + jax.lax.dot_general(
+        l_cur = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_sc[...] = acc_sc[...] * alpha + jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_cur, l_cur, acc_cur
+        m_sc[...] = jnp.broadcast_to(m_cur, m_sc.shape)
+        l_sc[...] = jnp.broadcast_to(l_cur, l_sc.shape)
 
-    num_k_blocks = seq_len // block_k
     if causal:
-        upper = jnp.minimum(
-            jax.lax.div((q_idx + 1) * block_q + block_k - 1,
-                        jnp.int32(block_k)),
-            num_k_blocks,
-        )
+        # Skip k blocks entirely above the diagonal for this q block.
+        @pl.when(k_i * block_k < (q_idx + 1) * block_q)
+        def _():
+            compute()
     else:
-        upper = num_k_blocks
-    m, l, acc = jax.lax.fori_loop(0, upper, body, (m0, l0, acc0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[...] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[...] = jnp.broadcast_to(
-        (m + jnp.log(l))[:, None], (block_q, LANE)
-    )
+        compute()
+
+    @pl.when(k_i == pl.num_programs(2) - 1)
+    def _flush():
+        l = jnp.maximum(l_sc[:, 0:1], 1e-30)
+        o_ref[...] = (acc_sc[...] / l).astype(o_ref.dtype)
+        lse_ref[...] = jnp.broadcast_to(
+            m_sc[:, 0:1] + jnp.log(l), (block_q, LANE)
+        )
 
 
 def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -277,27 +289,43 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _flash_fwd_bh(qt, kt, vt, causal, scale, block_q, block_k):
     import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     bh, s, d = qt.shape
     kernel = functools.partial(
         _fwd_kernel, block_k=block_k, sm_scale=scale, causal=causal,
-        block_q=block_q, seq_len=s,
+        block_q=block_q,
     )
+    if causal:
+        # Clamp above-diagonal k indices to the diagonal block: Mosaic
+        # dedups repeated block indices, so the skipped (pl.when-gated)
+        # steps re-address the already-resident block instead of DMA-ing
+        # K/V blocks the kernel never reads.
+        def kv_index(i, j, k):
+            return (i, jnp.minimum(k, ((j + 1) * block_q - 1) // block_k), 0)
+    else:
+        def kv_index(i, j, k):
+            return (i, k, 0)
     return pl.pallas_call(
         kernel,
-        grid=(bh, s // block_q),
+        grid=(bh, s // block_q, s // block_k),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((None, s, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j, k: (i, j, 0)),
+            pl.BlockSpec((None, block_k, d), kv_index),
+            pl.BlockSpec((None, block_k, d), kv_index),
         ],
         out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((None, block_q, LANE), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, block_q, d), lambda i, j, k: (i, j, 0)),
+            pl.BlockSpec((None, block_q, LANE), lambda i, j, k: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), qt.dtype),
             jax.ShapeDtypeStruct((bh, s, LANE), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, LANE), jnp.float32),
+            pltpu.VMEM((block_q, LANE), jnp.float32),
         ],
         interpret=INTERPRET,
     )(qt, kt, vt)
@@ -319,18 +347,25 @@ def _flash_bwd_bh(qt, kt, vt, ot, do, lse, causal, scale, block_q, block_k):
         _bwd_dkdv_kernel, block_q=block_q, sm_scale=scale, causal=causal,
         block_k=block_k,
     )
+    if causal:
+        # Below-diagonal q blocks contribute nothing to this k block: clamp
+        # their indices to the diagonal so the gated-off steps do not DMA
+        # q/do/lse/delta blocks the kernel never reads.
+        def q_index(i, j, q):
+            return (i, jnp.maximum(q, (j * block_k) // block_q), 0)
+    else:
+        def q_index(i, j, q):
+            return (i, q, 0)
     dk, dv = pl.pallas_call(
         dkdv,
         grid=(bh, s // block_k, s // block_q),
         in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda i, j, q: (i, q, 0)),  # q
+            pl.BlockSpec((None, block_q, d), q_index),                    # q
             pl.BlockSpec((None, block_k, d), lambda i, j, q: (i, j, 0)),  # k
             pl.BlockSpec((None, block_k, d), lambda i, j, q: (i, j, 0)),  # v
-            pl.BlockSpec((None, block_q, d), lambda i, j, q: (i, q, 0)),  # do
-            pl.BlockSpec((None, block_q, LANE),
-                         lambda i, j, q: (i, q, 0)),                      # lse
-            pl.BlockSpec((None, block_q, LANE),
-                         lambda i, j, q: (i, q, 0)),                    # delta
+            pl.BlockSpec((None, block_q, d), q_index),                    # do
+            pl.BlockSpec((None, block_q, LANE), q_index),                 # lse
+            pl.BlockSpec((None, block_q, LANE), q_index),                 # delta
         ],
         out_specs=[
             pl.BlockSpec((None, block_k, d), lambda i, j, q: (i, j, 0)),
@@ -351,13 +386,19 @@ def _flash_bwd_bh(qt, kt, vt, ot, do, lse, causal, scale, block_q, block_k):
         _bwd_dq_kernel, block_k=block_k, sm_scale=scale, causal=causal,
         block_q=block_q,
     )
+    if causal:
+        def kv_index(i, j, k):  # clamp above-diagonal k blocks (as fwd)
+            return (i, jnp.minimum(k, ((j + 1) * block_q - 1) // block_k), 0)
+    else:
+        def kv_index(i, j, k):
+            return (i, k, 0)
     dq = pl.pallas_call(
         dqk,
         grid=(bh, s // block_q, s // block_k),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j, k: (i, j, 0)),  # q
-            pl.BlockSpec((None, block_k, d), lambda i, j, k: (i, k, 0)),  # k
-            pl.BlockSpec((None, block_k, d), lambda i, j, k: (i, k, 0)),  # v
+            pl.BlockSpec((None, block_k, d), kv_index),                   # k
+            pl.BlockSpec((None, block_k, d), kv_index),                   # v
             pl.BlockSpec((None, block_q, d), lambda i, j, k: (i, j, 0)),  # do
             pl.BlockSpec((None, block_q, LANE),
                          lambda i, j, k: (i, j, 0)),                      # lse

@@ -78,3 +78,26 @@ def test_mha_dispatch_uses_reference_off_tpu():
     out = A.mha(q, k, v)  # short seq + cpu -> reference path
     ref = A.mha_reference(q, k, v)
     np.testing.assert_allclose(np.array(out), np.array(ref))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("bq,bk", [(128, 64), (64, 128)])
+def test_flash_mismatched_blocks(causal, bq, bk):
+    """block_q != block_k exercises the diagonal clamps in all three
+    kernels' causal index maps and the grid-sweep bounds."""
+    q, k, v = make_qkv(s=256)
+    ref = A.mha_reference(q, k, v, causal=causal)
+    out = A.flash_attention_tpu(q, k, v, causal, None, bq, bk)
+    assert float(jnp.max(jnp.abs(ref - out))) < 2e-5
+
+    def loss_ref(q, k, v):
+        return jnp.sum(A.mha_reference(q, k, v, causal=causal) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(A.flash_attention_tpu(q, k, v, causal, None, bq, bk) ** 2)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        scale = float(jnp.max(jnp.abs(a))) + 1e-6
+        assert float(jnp.max(jnp.abs(a - b))) / scale < 1e-4
