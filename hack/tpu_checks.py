@@ -1,0 +1,77 @@
+"""On-chip validation of the Pallas flash-attention kernels.
+
+Runs forward AND backward against the XLA reference on the real TPU (NOT in
+interpreter mode — Mosaic tiling/VMEM errors only surface on hardware) and
+prints one JSON line. This is the check the CPU test suite cannot perform;
+run it whenever the kernels change:
+
+    python hack/tpu_checks.py            # exits nonzero on failure
+
+Timing uses host-fetch sync (see models/perf.host_sync): through the axon
+tunnel, jax.block_until_ready is a no-op and yields physically impossible
+numbers.
+"""
+import json
+import sys
+
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hivedscheduler_tpu.ops import attention as A
+
+
+def main() -> None:
+    backend = jax.default_backend()
+    result = {"backend": backend, "device": str(jax.devices()[0])}
+    if backend != "tpu":
+        print(json.dumps({**result, "skipped": "not on TPU"}))
+        return
+
+    B, S, H, D, Hkv = 2, 1024, 8, 128, 4
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (B, S, Hkv, D), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (B, S, Hkv, D), jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            A.flash_attention_tpu(q, k, v, True, None, 256, 256).astype(
+                jnp.float32
+            )
+            ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(A.mha_reference(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+    of = np.asarray(
+        jax.jit(lambda q, k, v: A.flash_attention_tpu(q, k, v, True, None, 256, 256))(
+            q, k, v
+        ),
+        dtype=np.float32,
+    )
+    orf = np.asarray(
+        jax.jit(lambda q, k, v: A.mha_reference(q, k, v, causal=True))(q, k, v),
+        dtype=np.float32,
+    )
+    result["fwd_max_abs_err"] = float(np.abs(of - orf).max())
+    assert result["fwd_max_abs_err"] < 0.06, result
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gr = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    for name, a, b in zip("qkv", gf, gr):
+        a = np.asarray(a, dtype=np.float32)
+        b = np.asarray(b, dtype=np.float32)
+        rel = float(np.abs(a - b).max() / (np.abs(b).max() + 1e-9))
+        result[f"d{name}_rel_err"] = round(rel, 5)
+        assert rel < 0.05, (name, result)
+
+    result["ok"] = True
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()
