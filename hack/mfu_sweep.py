@@ -1,0 +1,49 @@
+"""MFU tuning sweep on the real chip: batch size x remat policy.
+
+Runs ``models/perf.bench_train_step`` under a few shape/remat settings and
+prints one JSON line per config (host-fetch-synced timing, like the main
+harness). Use it to pick the default bench shape after kernel changes:
+
+    python hack/mfu_sweep.py            # ~10-20 min through the tunnel
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]))
+
+CONFIGS = [
+    {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "full"},   # current default
+    {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "dots"},
+    {"HIVED_PERF_BATCH": "4", "HIVED_PERF_REMAT": "full"},
+    {"HIVED_PERF_BATCH": "4", "HIVED_PERF_REMAT": "dots"},
+    {"HIVED_PERF_BATCH": "8", "HIVED_PERF_REMAT": "full"},
+]
+
+
+def main() -> None:
+    import jax
+
+    if jax.default_backend() != "tpu":
+        print(json.dumps({"skipped": "not on TPU"}))
+        return
+    from hivedscheduler_tpu.models import perf
+
+    for cfg in CONFIGS:
+        os.environ.update(cfg)
+        try:
+            r = perf.bench_train_step(on_tpu=True)
+            r["config"] = cfg
+            peak = perf.peak_flops(jax.devices()[0].device_kind) or 0
+            if peak:
+                r["mfu"] = round(
+                    r["flops_per_token"] * r["tokens_per_sec_per_chip"] / peak,
+                    4,
+                )
+        except Exception as exc:
+            r = {"config": cfg, "error": f"{type(exc).__name__}: {exc}"[:200]}
+        print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    main()

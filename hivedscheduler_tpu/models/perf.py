@@ -46,12 +46,17 @@ def bench_config(on_tpu: bool):
     """Largest flagship config that comfortably fits one chip (f32 master
     params + adam moments + remat'd activations ~5.5 GB at the TPU shape),
     with head_dim=128 for MXU/lane alignment; a miniature shape off-TPU so
-    CPU smoke runs finish."""
+    CPU smoke runs finish. ``HIVED_PERF_BATCH``/``HIVED_PERF_SEQ`` override
+    the TPU shape for tuning sweeps without code edits."""
+    import os
+
     import jax.numpy as jnp
 
     from . import transformer
 
     if on_tpu:
+        batch = int(os.environ.get("HIVED_PERF_BATCH", "2"))
+        seq = int(os.environ.get("HIVED_PERF_SEQ", "8192"))
         return transformer.TransformerConfig(
             vocab_size=32768,
             d_model=1024,
@@ -59,10 +64,11 @@ def bench_config(on_tpu: bool):
             n_heads=8,
             n_kv_heads=8,
             d_ff=4096,
-            max_seq_len=8192,
+            max_seq_len=seq,
             dtype=jnp.bfloat16,
             remat=True,
-        ), 2, 8192  # batch, seq
+            remat_policy=os.environ.get("HIVED_PERF_REMAT", "full"),
+        ), batch, seq
     return transformer.TransformerConfig(
         vocab_size=2048,
         d_model=256,

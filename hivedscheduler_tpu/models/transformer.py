@@ -45,6 +45,10 @@ class TransformerConfig:
     rope_theta: float = 500000.0
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "full": recompute everything in backward (min memory).
+    # "dots": save matmul (MXU) outputs, recompute only elementwise — less
+    # recompute FLOPs for ~b*s*(d+d_ff) extra bytes per layer.
+    remat_policy: str = "full"
     tied_embeddings: bool = False
 
     @property
@@ -214,7 +218,12 @@ def forward(
 
     block = lambda x, layer: (_block(x, layer, c, mesh, use_ring), None)
     if c.remat:
-        block = jax.checkpoint(block)
+        policy = (
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            if c.remat_policy == "dots"
+            else None  # full remat: recompute everything
+        )
+        block = jax.checkpoint(block, policy=policy)
     x, _ = jax.lax.scan(block, x, params["layers"])
 
     x = rms_norm(x, params["ln_f"])
