@@ -684,3 +684,38 @@ def test_any_leaf_type(sim):
     # Untyped requests also work for opportunistic pods.
     bo = sim.schedule_and_bind(make_pod("anyo", "anyou", "VC2", -1, "", 2))
     assert bo.node_name
+
+
+def test_unbound_virtual_cell_scored_by_bound_ancestor():
+    """The deliberate improvement over the reference (placement.py
+    _node_health_and_suggested): an unbound virtual cell under a BOUND
+    preassigned ancestor is scored against the ancestor's physical nodes,
+    so intra-VC packing does not walk into a bound-elsewhere cell and then
+    die on suggested-node grounds in the mapping. The reference waits here
+    (topology_aware_scheduler.go:243-266); we bind on the alternate free
+    preassigned cell in the same round."""
+    sim = Sim()
+    # a1 claims one of VC1's two v5p-16 cells, on w12 (cell w12-15).
+    a1 = sim.schedule_and_bind(
+        make_pod("s-a1", "sua1", "VC1", 0, "v5p-chip", 4,
+                 ignore_suggested=False),
+        phase=SchedulingPhase.PREEMPTING, suggested=["v5p64-w12"],
+    )
+    assert a1.node_name == "v5p64-w12"
+    # a2 asks for a node OUTSIDE that cell: the packer must choose the
+    # still-free preassigned cell (mapping to w8-11), not pack into
+    # w12-15's spare hosts and fail.
+    a2 = sim.schedule_and_bind(
+        make_pod("s-a2", "sua2", "VC1", 0, "v5p-chip", 4,
+                 ignore_suggested=False),
+        phase=SchedulingPhase.PREEMPTING, suggested=["v5p64-w8"],
+    )
+    assert a2.node_name == "v5p64-w8"
+    # And when the suggested node IS a spare host of the bound cell, the
+    # packer still uses it (ancestor's node set intersects suggested).
+    a3 = sim.schedule_and_bind(
+        make_pod("s-a3", "sua3", "VC1", 0, "v5p-chip", 4,
+                 ignore_suggested=False),
+        phase=SchedulingPhase.PREEMPTING, suggested=["v5p64-w13"],
+    )
+    assert a3.node_name == "v5p64-w13"
