@@ -25,6 +25,36 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.attention import NEG_INF
 
 
+# Per-(batch, head) score-matrix budget for one local update: chunk the
+# query dim so a ring step's scores stay <= ~4M f32 elements per head,
+# keeping local memory O(cq * sk) however long the shard is.
+_SCORE_BUDGET = 4 * 1024 * 1024
+
+
+def _q_chunk_size(sq: int, sk: int, q_chunk: Optional[int]) -> int:
+    if q_chunk is not None and q_chunk <= 0:
+        raise ValueError(f"q_chunk must be positive, got {q_chunk}")
+    if q_chunk is not None and sq % q_chunk == 0:
+        return q_chunk
+    if q_chunk is None and sq * sk <= _SCORE_BUDGET:
+        return sq
+    # Auto-size (or repair a non-divisor request): largest divisor of sq
+    # not exceeding the target — never silently fall back to unchunked.
+    target = (
+        q_chunk if q_chunk is not None else max(1, _SCORE_BUDGET // sk)
+    )
+    best = 1
+    c = 1
+    while c * c <= sq:
+        if sq % c == 0:
+            if c <= target:
+                best = max(best, c)
+            if sq // c <= target:
+                best = max(best, sq // c)
+        c += 1
+    return best
+
+
 def _ring_attention_local(
     q: jax.Array,  # [B, Sq, H, D] this device's query shard
     k: jax.Array,  # [B, Sk, Hkv, D] this device's key shard (rotates)
@@ -32,8 +62,14 @@ def _ring_attention_local(
     axis_name: str,
     causal: bool,
     sm_scale: Optional[float],
+    q_chunk: Optional[int] = None,
 ) -> jax.Array:
-    """Runs under shard_map; exact attention over the full sequence."""
+    """Runs under shard_map; exact attention over the full sequence.
+
+    The local update is q-chunked (``_q_chunk_size``): one ring step's
+    score tile is [B, H, cq, Sk] instead of [B, H, Sq, Sk], so local
+    memory stays bounded however long the per-device shard grows — the
+    host-level analog of the flash kernels' O(block) VMEM."""
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, sq, hq, d = q.shape
@@ -42,52 +78,83 @@ def _ring_attention_local(
         k = jnp.repeat(k, hq // hkv, axis=2)
         v = jnp.repeat(v, hq // hkv, axis=2)
     scale = 1.0 / math.sqrt(d) if sm_scale is None else sm_scale
+    cq = _q_chunk_size(sq, sk, q_chunk)
+    nc = sq // cq
 
-    q32 = q.astype(jnp.float32)
+    # Chunk-shaped layout throughout the ring loop (one relayout before, one
+    # after, instead of slice/stitch per step): q/o are [nc, B, cq, H, D],
+    # m/l are [nc, B, H, cq].
+    q32 = (
+        q.astype(jnp.float32)
+        .reshape(b, nc, cq, hq, d)
+        .transpose(1, 0, 2, 3, 4)
+    )
     # Derive the accumulators from q so they carry the same varying-manual
     # axes type as the loop outputs (required by shard_map's scan typing;
     # the *0 folds away after fusion).
-    zero_bhq = jnp.sum(q32, axis=3).transpose(0, 2, 1) * 0.0  # [B, H, Sq]
-    m0 = zero_bhq + NEG_INF
-    l0 = zero_bhq
+    zero_ml = jnp.sum(q32, axis=4).transpose(0, 1, 3, 2) * 0.0  # [nc,B,H,cq]
+    m0 = zero_ml + NEG_INF
+    l0 = zero_ml
     o0 = q32 * 0.0
+    # Absolute position of each chunk's first query row.
+    pos0 = my_idx * sq + jnp.arange(nc, dtype=jnp.int32) * cq  # [nc]
+
+    def update(qc, oc, mc, lc, k_blk, v_blk, q_pos0, k_idx):
+        """Streaming-softmax update of one q chunk against one K/V block.
+        qc/oc: [B, cq, H, D]; mc/lc: [B, H, cq]."""
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", qc, k_blk.astype(jnp.float32)
+        ) * scale  # [B, H, cq, sk]
+        if causal:
+            q_pos = q_pos0 + jax.lax.broadcasted_iota(jnp.int32, (cq, sk), 0)
+            k_pos = k_idx * sk + jax.lax.broadcasted_iota(
+                jnp.int32, (cq, sk), 1
+            )
+            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        m_cur = jnp.maximum(mc, jnp.max(s, axis=-1))
+        # Guard fully-masked rows (future-only blocks): exp(NEG_INF-NEG_INF)
+        # must not become 1.
+        safe_m = jnp.where(m_cur <= NEG_INF / 2, 0.0, m_cur)
+        p_blk = jnp.exp(
+            jnp.where(s <= NEG_INF / 2, NEG_INF, s) - safe_m[..., None]
+        )
+        alpha = jnp.where(
+            mc <= NEG_INF / 2, jnp.zeros_like(mc), jnp.exp(mc - safe_m)
+        )
+        l_cur = lc * alpha + jnp.sum(p_blk, axis=-1)
+        o_cur = oc * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p_blk, v_blk.astype(jnp.float32)
+        )
+        return o_cur, m_cur, l_cur
+
+    # Remat the chunk update: without it, differentiating the chunk map
+    # stores every chunk's p_blk ([B, H, cq, sk] each) and the backward
+    # pass reconstitutes the full O(Sq*Sk) score matrix the chunking
+    # exists to avoid. Recomputing s/p per chunk keeps the bound under AD.
+    update_ck = jax.checkpoint(update)
 
     def step(i, carry):
         o, m, l, k_blk, v_blk = carry
         # Shard i steps behind on the ring: block j = (my_idx - i) mod p.
         k_idx = jax.lax.rem(my_idx - i + axis_size, axis_size)
-        s = jnp.einsum(
-            "bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32)
-        ) * scale
-        if causal:
-            q_pos = my_idx * sq + jax.lax.broadcasted_iota(
-                jnp.int32, (sq, sk), 0
-            )
-            k_pos = k_idx * sk + jax.lax.broadcasted_iota(
-                jnp.int32, (sq, sk), 1
-            )
-            s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
-        m_cur = jnp.maximum(m, jnp.max(s, axis=-1))
-        # Guard fully-masked rows (future-only blocks): exp(NEG_INF-NEG_INF)
-        # must not become 1.
-        safe_m = jnp.where(m_cur <= NEG_INF / 2, 0.0, m_cur)
-        p_blk = jnp.exp(jnp.where(s <= NEG_INF / 2, NEG_INF, s) - safe_m[..., None])
-        alpha = jnp.where(
-            m <= NEG_INF / 2, jnp.zeros_like(m), jnp.exp(m - safe_m)
-        )
-        l_cur = l * alpha + jnp.sum(p_blk, axis=-1)
-        o_cur = o * alpha.transpose(0, 2, 1)[..., None] + jnp.einsum(
-            "bhqk,bkhd->bqhd", p_blk, v_blk.astype(jnp.float32)
-        )
+
+        def chunk(args):
+            qc, oc, mc, lc, p0 = args
+            return update_ck(qc, oc, mc, lc, k_blk, v_blk, p0, k_idx)
+
+        o, m, l = jax.lax.map(chunk, (q32, o, m, l, pos0))
         # Rotate K/V to the next device; the transfer overlaps the next
         # step's compute.
         perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
         k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
-        return o_cur, m_cur, l_cur, k_nxt, v_nxt
+        return o, m, l, k_nxt, v_nxt
 
     o, m, l, _, _ = jax.lax.fori_loop(0, axis_size, step, (o0, m0, l0, k, v))
     l = jnp.maximum(l, 1e-20)
+    # Back to [B, Sq, H, D] / [B, H, Sq] once, after the loop.
+    o = o.transpose(1, 0, 2, 3, 4).reshape(b, sq, hq, d)
+    l = l.transpose(1, 2, 0, 3).reshape(b, hq, sq)
     return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
@@ -101,12 +168,14 @@ def ring_attention(
     batch_axes=("dp", "fsdp"),
     seq_axis: str = "sp",
     head_axis: str = "tp",
+    q_chunk: Optional[int] = None,
 ) -> jax.Array:
     """Exact attention with the sequence dimension sharded over ``seq_axis``.
 
     Composable under jit: shard_map with explicit ppermute inside, XLA
     collectives outside. Heads additionally shard over tp; batch over
-    dp/fsdp.
+    dp/fsdp. ``q_chunk`` bounds the local score tile (auto-sized from the
+    shard length by default; see ``_q_chunk_size``).
     """
     spec = P(batch_axes, seq_axis, head_axis, None)
     fn = functools.partial(
@@ -114,6 +183,7 @@ def ring_attention(
         axis_name=seq_axis,
         causal=causal,
         sm_scale=sm_scale,
+        q_chunk=q_chunk,
     )
     return jax.shard_map(
         fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
