@@ -220,7 +220,111 @@ def bench_attention(on_tpu: bool) -> dict:
     return out
 
 
+def bench_zoo(on_tpu: bool) -> dict:
+    """Optional (HIVED_PERF_ZOO=1): one-chip step timings for the other
+    model families — BERT-large MLM train step, ResNet-50 train step, and
+    flagship decode throughput — evidence the whole zoo runs on hardware,
+    not just the flagship."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    out = {}
+    n = 4 if on_tpu else 2
+
+    from . import bert as bert_mod
+
+    bconfig = bert_mod.bert_large() if on_tpu else bert_mod.tiny()
+    bbatch, bseq = (8, 512) if on_tpu else (2, 64)
+    bparams = jax.jit(lambda k: bert_mod.init(bconfig, k))(jax.random.PRNGKey(0))
+    bopt = optax.adamw(1e-4)
+    bstate = jax.jit(bopt.init)(bparams)
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (bbatch, bseq), 0, bconfig.vocab_size
+    )
+    mask = jax.random.bernoulli(jax.random.PRNGKey(2), 0.15, (bbatch, bseq))
+
+    @jax.jit
+    def bert_step(p, s, t, m):
+        loss, grads = jax.value_and_grad(bert_mod.mlm_loss)(p, t, m, bconfig)
+        updates, s = bopt.update(grads, s, p)
+        return optax.apply_updates(p, updates), s, loss
+
+    bparams, bstate, bloss = bert_step(bparams, bstate, tokens, mask)
+    host_sync(bloss)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        bparams, bstate, bloss = bert_step(bparams, bstate, tokens, mask)
+    host_sync(bloss)
+    bdt = (time.perf_counter() - t0) / n
+    out["bert_large_step_ms"] = round(bdt * 1e3, 2)
+    out["bert_tokens_per_sec"] = round(bbatch * bseq / bdt, 1)
+
+    from . import resnet as resnet_mod
+
+    rconfig = resnet_mod.ResNetConfig()
+    rbatch, rsize = (64, 224) if on_tpu else (2, 32)
+    rparams, rstats = resnet_mod.init(rconfig, jax.random.PRNGKey(0))
+    ropt = optax.sgd(0.1, momentum=0.9)
+    rstate = jax.jit(ropt.init)(rparams)
+    images = jax.random.normal(
+        jax.random.PRNGKey(3), (rbatch, rsize, rsize, 3), jnp.bfloat16
+    )
+    labels = jax.random.randint(
+        jax.random.PRNGKey(4), (rbatch,), 0, rconfig.num_classes
+    )
+
+    @jax.jit
+    def resnet_step(p, stats, s, x, y):
+        (loss, stats), grads = jax.value_and_grad(
+            resnet_mod.loss_fn, has_aux=True
+        )(p, stats, x, y, rconfig, train=True)
+        updates, s = ropt.update(grads, s)
+        return optax.apply_updates(p, updates), stats, s, loss
+
+    rparams, rstats, rstate, rloss = resnet_step(
+        rparams, rstats, rstate, images, labels
+    )
+    host_sync(rloss)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        rparams, rstats, rstate, rloss = resnet_step(
+            rparams, rstats, rstate, images, labels
+        )
+    host_sync(rloss)
+    rdt = (time.perf_counter() - t0) / n
+    out["resnet50_step_ms"] = round(rdt * 1e3, 2)
+    out["resnet50_images_per_sec"] = round(rbatch / rdt, 1)
+
+    from . import generate, transformer
+
+    gconfig, _, _ = bench_config(on_tpu)
+    gparams = jax.jit(lambda k: transformer.init(gconfig, k))(
+        jax.random.PRNGKey(5)
+    )
+    gbatch, prompt_len, new_tokens = (8, 128, 32) if on_tpu else (2, 16, 8)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(6), (gbatch, prompt_len), 0, gconfig.vocab_size
+    )
+    cache = generate.init_cache(gconfig, gbatch, prompt_len + new_tokens + 1)
+    logits, cache = generate.prefill(gparams, prompt, cache, gconfig)
+    token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # Warm the decode_step compile, then time the steady-state loop.
+    logits, cache = generate.decode_step(gparams, token, cache, gconfig)
+    host_sync(logits)
+    t0 = time.perf_counter()
+    for _ in range(new_tokens):
+        logits, cache = generate.decode_step(gparams, token, cache, gconfig)
+    host_sync(logits)
+    gdt = (time.perf_counter() - t0) / new_tokens
+    out["decode_step_ms"] = round(gdt * 1e3, 2)
+    out["decode_tokens_per_sec"] = round(gbatch / gdt, 1)
+    return out
+
+
 def main() -> None:
+    import os
+
     import jax
 
     dev = jax.devices()[0]
@@ -282,6 +386,11 @@ def main() -> None:
                 "MFU outside (0, 1] — timing sync not trustworthy"
             )
     result.update(bench_attention(on_tpu))
+    if os.environ.get("HIVED_PERF_ZOO", "0") == "1":
+        try:
+            result["zoo"] = bench_zoo(on_tpu)
+        except Exception as exc:  # optional stage: degrade, never crash
+            result["zoo"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
     print(json.dumps(result))
 
 

@@ -39,6 +39,38 @@ def doomed_invariant(core):
     return None
 
 
+def binding_invariant(core):
+    """Every virtual<->physical binding must be two-way consistent: a
+    one-way pointer is exactly the dangling-descendant corruption the
+    doomed-unbind walk exists to prevent."""
+    for vcn, sched in core.vc_schedulers.items():
+        cls = dict(sched.non_pinned_full)
+        cls.update(sched.pinned_cells)
+        for key, ccl in cls.items():
+            for lvl, cells in ccl.levels.items():
+                for vc in cells:
+                    pc = vc.physical_cell
+                    if pc is not None and pc.virtual_cell is not vc:
+                        return (
+                            f"{vcn}/{key}: virtual {vc.address} -> physical "
+                            f"{pc.address} not reciprocated"
+                        )
+    for chain, ccl in core.full_cell_list.items():
+        for lvl, cells in ccl.levels.items():
+            for pc in cells:
+                v = pc.virtual_cell
+                if v is not None and v.physical_cell is not pc:
+                    return (
+                        f"{chain}: physical {pc.address} -> virtual "
+                        f"{v.address} not reciprocated"
+                    )
+    return None
+
+
+def all_invariants(core):
+    return doomed_invariant(core) or binding_invariant(core)
+
+
 def run_sequence(seed: int, steps: int = 80) -> None:
     rng = random.Random(seed)
     core = HivedCore(tpu_design_config())
@@ -74,7 +106,7 @@ def run_sequence(seed: int, steps: int = 80) -> None:
             core.set_bad_node(rng.choice(nodes))
         else:
             core.set_healthy_node(rng.choice(nodes))
-        err = doomed_invariant(core)
+        err = all_invariants(core)
         assert err is None, f"seed {seed} step {step}: {err}"
 
     # Drain: heal everything, delete everything -> all cells must be Free.
@@ -211,7 +243,7 @@ def run_gang_replay_sequence(seed: int, steps: int = 60) -> None:
             # Continue ON the recovered core: post-restart operation must be
             # indistinguishable (the strongest property of the replay).
             core = recovered
-        err = doomed_invariant(core)
+        err = all_invariants(core)
         assert err is None, f"seed {seed} step {step}: {err}"
 
     # Drain everything; no leaks.
