@@ -23,18 +23,100 @@ from . import transformer
 Params = Dict[str, Any]
 
 
+# Vocab sizes at or above this use the fused chunked loss on single-chip
+# paths: a [B, S, V] f32 logits tensor at e.g. V=128k, S=8k is multiple GB
+# of pure HBM traffic that the chunked online-logsumexp never materializes.
+FUSED_LOSS_MIN_VOCAB = 32768
+_LOSS_CHUNK = 8192  # vocab elements per chunk
+
+
+def _chunked_ce(
+    x: jax.Array,        # [N, D] compute dtype (final hidden, scored rows)
+    head: jax.Array,     # [D, V]
+    targets: jax.Array,  # [N] int32
+    chunk: int,
+) -> jax.Array:
+    """Exact mean cross-entropy without materializing [N, V] logits: scan
+    vocab chunks with an online logsumexp; each chunk's logits are remat'd
+    in backward (jax.checkpoint), so peak memory is O(N * chunk). The
+    flash-attention trade (FLOPs for HBM) applied to the LM head. A vocab
+    that does not divide the chunk gets one static remainder step."""
+    n, d = x.shape
+    v = head.shape[1]
+    nc, rem = divmod(v, chunk)
+
+    def update(carry, start, w, width):
+        m, s, tl = carry
+        logits = (x @ w).astype(jnp.float32)  # [N, width]
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        local = targets - start
+        in_chunk = (local >= 0) & (local < width)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, width - 1)[:, None], axis=1
+        )[:, 0]
+        tl = jnp.where(in_chunk, picked, tl)
+        return m_new, s, tl
+
+    def step(carry, c):
+        w = jax.lax.dynamic_slice_in_dim(head, c * chunk, chunk, axis=1)
+        return update(carry, c * chunk, w, chunk), None
+
+    carry = (
+        jnp.full((n,), -jnp.inf, dtype=jnp.float32),
+        jnp.zeros((n,), dtype=jnp.float32),
+        jnp.zeros((n,), dtype=jnp.float32),
+    )
+    if nc:
+        carry, _ = jax.lax.scan(
+            jax.checkpoint(step), carry, jnp.arange(nc, dtype=jnp.int32)
+        )
+    if rem:
+        w_tail = jax.lax.slice_in_dim(head, nc * chunk, v, axis=1)
+        carry = jax.checkpoint(
+            lambda cr: update(cr, nc * chunk, w_tail, rem)
+        )(carry)
+    m, s, tl = carry
+    lse = m + jnp.log(s)
+    return jnp.mean(lse - tl)
+
+
 def next_token_loss(
     params: Params,
     tokens: jax.Array,  # [B, S]
     config: transformer.TransformerConfig,
     mesh: Optional[Mesh] = None,
+    fused: Optional[bool] = None,
+    chunk: int = _LOSS_CHUNK,
 ) -> jax.Array:
     """Causal LM loss: predict tokens[:, 1:] from tokens[:, :-1]. The whole
     sequence goes through the model (keeps static shapes / sp divisibility);
-    the last position's logits are simply not scored."""
+    the last position's logits are simply not scored.
+
+    ``fused`` selects the vocab-chunked logsumexp path (no [B, S, V]
+    logits tensor). Default: on for large vocab whenever the vocab
+    dimension is unsharded (single chip, or dp/fsdp/sp-only meshes); off
+    when tp shards the vocab — there the chunk slices would fight the
+    sharding, and GSPMD's own partitioned softmax handles it well."""
+    if fused is None:
+        fused = (
+            config.vocab_size >= FUSED_LOSS_MIN_VOCAB
+            and (mesh is None or mesh.shape.get("tp", 1) == 1)
+        )
+    targets = tokens[:, 1:]
+    if fused:
+        x, head = transformer.forward_hidden(params, tokens, config, mesh)
+        b, s, d = x.shape
+        return _chunked_ce(
+            x[:, :-1].reshape(b * (s - 1), d),
+            head,
+            targets.reshape(-1),
+            chunk,
+        )
     logits = transformer.forward(params, tokens, config, mesh)  # [B,S,V] f32
     logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
-    targets = tokens[:, 1:]
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     return -jnp.mean(ll)
 

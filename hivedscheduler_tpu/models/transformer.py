@@ -21,7 +21,7 @@ TPU design choices:
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -200,13 +200,15 @@ def _block(
     return x + sharding.constrain(ffn, "batch", "seq", "act_embed")
 
 
-def forward(
+def forward_hidden(
     params: Params,
     tokens: jax.Array,  # [B, S] int32
     config: TransformerConfig,
     mesh: Optional[Mesh] = None,
-) -> jax.Array:
-    """Logits [B, S, V]. Set ``mesh`` with sp>1 to engage ring attention."""
+) -> Tuple[jax.Array, jax.Array]:
+    """Final normed hidden states [B, S, D] (compute dtype) and the LM-head
+    weight [D, V] — the pieces the fused vocab-chunked loss consumes without
+    ever materializing [B, S, V] logits."""
     c = config
     use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
     # Mixed precision: f32 master params -> bf16 compute copies.
@@ -227,10 +229,19 @@ def forward(
     x, _ = jax.lax.scan(block, x, params["layers"])
 
     x = rms_norm(x, params["ln_f"])
-    if c.tied_embeddings:
-        logits = x @ params["embed"].T
-    else:
-        logits = x @ params["lm_head"]
+    head = params["embed"].T if c.tied_embeddings else params["lm_head"]
+    return x, head
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,  # [B, S] int32
+    config: TransformerConfig,
+    mesh: Optional[Mesh] = None,
+) -> jax.Array:
+    """Logits [B, S, V]. Set ``mesh`` with sp>1 to engage ring attention."""
+    x, head = forward_hidden(params, tokens, config, mesh)
+    logits = x @ head
     return sharding.constrain(
         logits.astype(jnp.float32), "batch", "seq", "vocab"
     )

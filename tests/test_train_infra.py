@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from hivedscheduler_tpu.models import checkpoint, train, transformer
 from hivedscheduler_tpu.parallel import mesh as pmesh, sharding
@@ -60,3 +61,63 @@ def test_dataset_shuffles_deterministically(tmp_path):
     c = [b.copy() for b in ds.batches(4, seed=2, epochs=1)]
     np.testing.assert_array_equal(np.concatenate(a), np.concatenate(b))
     assert not np.array_equal(np.concatenate(a), np.concatenate(c))
+
+
+@pytest.mark.parametrize(
+    "chunk_of_vocab",
+    [lambda v: v // 4,        # even split
+     lambda v: v // 4 + 7],   # non-divisor: exercises the remainder step
+)
+def test_fused_chunked_loss_matches_reference(chunk_of_vocab):
+    """The vocab-chunked logsumexp loss must equal the materialized
+    log_softmax path exactly (values and gradients), including when the
+    chunk does not divide the vocab."""
+    from hivedscheduler_tpu.models import train, transformer
+
+    config = transformer.tiny()
+    params = transformer.init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 32), 0, config.vocab_size
+    )
+    chunk = chunk_of_vocab(config.vocab_size)
+
+    ref = train.next_token_loss(params, tokens, config, fused=False)
+    fused = train.next_token_loss(params, tokens, config, fused=True,
+                                  chunk=chunk)
+    assert abs(float(ref) - float(fused)) < 1e-5, (ref, fused)
+
+    gr = jax.grad(
+        lambda p: train.next_token_loss(p, tokens, config, fused=False)
+    )(params)
+    gf = jax.grad(
+        lambda p: train.next_token_loss(p, tokens, config, fused=True,
+                                        chunk=chunk)
+    )(params)
+    for a, b in zip(jax.tree.leaves(gr), jax.tree.leaves(gf)):
+        scale = float(jnp.max(jnp.abs(a))) + 1e-8
+        assert float(jnp.max(jnp.abs(a - b))) / scale < 1e-4
+
+
+def test_fused_loss_engages_and_matches_on_fsdp_mesh():
+    """dp/fsdp-only meshes leave the vocab unsharded, so the fused path is
+    the default there too; it must match the unfused loss under the mesh."""
+    from hivedscheduler_tpu.models import train, transformer
+    from hivedscheduler_tpu.parallel import mesh as pmesh, sharding
+
+    config = transformer.tiny()
+    params = transformer.init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (8, 32), 0, config.vocab_size
+    )
+    mesh = pmesh.make_mesh(pmesh.MeshConfig(fsdp=8), devices=jax.devices())
+    param_sh = sharding.tree_shardings(mesh, transformer.logical_axes(config))
+    sp = jax.device_put(params, param_sh)
+    st = sharding.shard_batch(tokens, mesh)
+    ref = train.next_token_loss(params, tokens, config, fused=False)
+    fused = jax.jit(
+        lambda p, t: train.next_token_loss(
+            p, t, config, mesh=mesh, fused=True,
+            chunk=config.vocab_size // 4,
+        )
+    )(sp, st)
+    assert abs(float(ref) - float(fused)) < 1e-4, (ref, fused)
