@@ -224,3 +224,62 @@ def test_usage_counter_propagation(compiled):
     assert root.used_leaf_cells_at_priority == {5: 1}
     allocation.update_used_leaf_cell_numbers(leaf, 5, False)
     assert root.used_leaf_cells_at_priority == {}
+
+
+def test_virtual_to_physical_mapping_backtracks(compiled):
+    """Backtracking in map_virtual_cells_to_physical (the reference's
+    backtracking-cell-binding case, hived_algorithm_test.go:818-852): the
+    first sibling's greedy pick must be UNDONE when it starves a later
+    sibling, and an alternative assignment found.
+
+    Setup: two sibling host vertices inside one v5e-16 — one needing 2
+    chips, one needing all 4. Physical host X has 2 chips already bound
+    (only 2 usable), host Y is fully free; opportunistic usage on X makes
+    Y sort first, so the 2-chip vertex greedily takes Y, the 4-chip vertex
+    then fails on X, and only backtracking (2-chip -> X, 4-chip -> Y)
+    can succeed.
+    """
+    slice_a = compiled.physical_full_list["v5e-16"][4][0]
+    host_x, host_y = slice_a.children[0], slice_a.children[1]
+    preassigned = compiled.virtual_non_pinned_free["VC1"]["v5e-16"][4][0]
+    vh2, vh4 = preassigned.children[0], preassigned.children[1]
+
+    # Two chips of X bound elsewhere (stand-in virtual cells are enough for
+    # the `virtual_cell is not None` usability filter).
+    other = compiled.virtual_non_pinned_free["VC2"]["v5e-16"][4][0]
+    x_chips = [c for sub in host_x.children for c in sub.children]
+    x_chips[0].set_virtual_cell(other.children[0].children[0].children[0])
+    x_chips[1].set_virtual_cell(other.children[0].children[0].children[1])
+    # Opportunistic usage on X pushes it after Y in the packing sort.
+    mark_used(x_chips[2], OPPORTUNISTIC_PRIORITY)
+
+    def host_vertex(vh, n_subs):
+        hv = BindingPathVertex(vh)
+        for sub in vh.children[:n_subs]:  # 2-chip sub-cells
+            sv = BindingPathVertex(sub)
+            for leaf in sub.children:
+                sv.children_to_bind.append(BindingPathVertex(leaf))
+            hv.children_to_bind.append(sv)
+        return hv
+
+    v2 = host_vertex(vh2, 1)   # 2 chips (one sub-cell)
+    v4 = host_vertex(vh4, 2)   # 4 chips (both sub-cells)
+
+    bindings = {}
+    ok, _ = allocation.map_virtual_cells_to_physical(
+        [v2, v4], [host_x, host_y], None, True, bindings, return_picked=False
+    )
+    assert ok, "backtracking must find the (v2->X, v4->Y) assignment"
+    # v4's four chips all landed on Y; v2's two on X's usable chips.
+    v4_targets = {
+        bindings[leaf.cell.address].parent.parent.address
+        for sub in v4.children_to_bind
+        for leaf in sub.children_to_bind
+    }
+    assert v4_targets == {host_y.address}
+    v2_targets = {
+        bindings[leaf.cell.address].parent.parent.address
+        for sub in v2.children_to_bind
+        for leaf in sub.children_to_bind
+    }
+    assert v2_targets == {host_x.address}
