@@ -150,6 +150,39 @@ def decode_step(
     return logits[:, 0], cache
 
 
+@functools.partial(
+    jax.jit, static_argnames=("config", "max_new_tokens")
+)
+def generate_greedy_scan(
+    params: Params,
+    prompt: jax.Array,  # [B, T_prompt]
+    config: TransformerConfig,
+    max_new_tokens: int,
+) -> jax.Array:
+    """Greedy generation as ONE compiled program: prefill + a lax.scan over
+    decode steps, cache carried through the scan. Semantically identical to
+    ``generate(temperature=0)`` but with a single dispatch for the whole
+    sequence — the Python-loop version pays per-token dispatch latency,
+    which dominates decode through any remote/tunneled runtime."""
+    b, t = prompt.shape
+    cache = init_cache(config, b, t + max_new_tokens)
+    logits, cache = _forward_cached(params, prompt, cache, config)
+    token = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+    def step(carry, _):
+        token, cache = carry
+        logits, cache = _forward_cached(params, token[:, None], cache, config)
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        return (nxt, cache), nxt
+
+    (_, _), rest = jax.lax.scan(
+        step, (token, cache), None, length=max_new_tokens - 1
+    )
+    return jnp.concatenate(
+        [prompt, token[:, None], rest.T.astype(jnp.int32)], axis=1
+    )
+
+
 def generate(
     params: Params,
     prompt: jax.Array,  # [B, T_prompt]

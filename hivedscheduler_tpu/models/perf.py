@@ -319,6 +319,21 @@ def bench_zoo(on_tpu: bool) -> dict:
     gdt = (time.perf_counter() - t0) / new_tokens
     out["decode_step_ms"] = round(gdt * 1e3, 2)
     out["decode_tokens_per_sec"] = round(gbatch / gdt, 1)
+
+    # Whole-sequence scan decode: one dispatch for prefill + all steps —
+    # isolates per-call dispatch overhead from on-chip decode speed.
+    seq = generate.generate_greedy_scan(
+        gparams, prompt, gconfig, max_new_tokens=new_tokens
+    )
+    host_sync(seq)  # compile
+    t0 = time.perf_counter()
+    seq = generate.generate_greedy_scan(
+        gparams, prompt, gconfig, max_new_tokens=new_tokens
+    )
+    host_sync(seq)
+    sdt = (time.perf_counter() - t0) / new_tokens
+    out["decode_scan_step_ms"] = round(sdt * 1e3, 2)
+    out["decode_scan_tokens_per_sec"] = round(gbatch / sdt, 1)
     return out
 
 

@@ -72,3 +72,18 @@ def test_sampled_generation_respects_temperature():
                           key=jax.random.PRNGKey(11))
     # Different keys should (overwhelmingly likely) sample different tails.
     assert not np.array_equal(np.array(a), np.array(b))
+
+
+def test_scan_generate_matches_python_loop():
+    """generate_greedy_scan (one compiled program) must produce exactly the
+    Python-loop greedy sequence."""
+    from hivedscheduler_tpu.models import generate as G, transformer
+
+    config = transformer.tiny()
+    params = transformer.init(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                config.vocab_size)
+    ref = G.generate(params, prompt, config, max_new_tokens=12)
+    out = G.generate_greedy_scan(params, prompt, config, max_new_tokens=12)
+    assert out.shape == ref.shape
+    assert (jax.device_get(out) == jax.device_get(ref)).all()
