@@ -276,7 +276,10 @@ def model_perf() -> dict:
                 [sys.executable, "-m", "hivedscheduler_tpu.models.perf"],
                 capture_output=True,
                 text=True,
-                timeout=600,
+                # Remote (tunnel) compiles of the Pallas train step + the
+                # 8k XLA attention reference are minutes each; 600 s was
+                # measured too tight for the full flash run.
+                timeout=1500,
                 cwd=here,
                 env={**os.environ, **extra_env},
             )
