@@ -156,3 +156,26 @@ def test_ring_attention_q_chunked_gradients():
         b = np.array(jax.device_get(b))
         scale = np.abs(a).max() + 1e-6
         assert np.abs(a - b).max() / scale < 1e-4
+
+
+def test_ring_q_chunk_sizing_properties():
+    """_q_chunk_size must always return a positive divisor of sq, repair
+    non-divisor requests to the largest divisor <= the request, and reject
+    nonpositive requests."""
+    from hivedscheduler_tpu.parallel.ring import _SCORE_BUDGET, _q_chunk_size
+
+    for sq in (64, 768, 1536, 8192):
+        for sk in (64, 8192, 65536):
+            for req in (None, 4, 7, 1024, sq):
+                if req is not None and req <= 0:
+                    continue
+                cq = _q_chunk_size(sq, sk, req)
+                assert cq > 0 and sq % cq == 0, (sq, sk, req, cq)
+                if req is None and sq * sk > _SCORE_BUDGET:
+                    assert cq * sk <= _SCORE_BUDGET or cq == 1
+                if req is not None:
+                    assert cq <= max(req, 1) or sq % req == 0
+    with pytest.raises(ValueError):
+        _q_chunk_size(64, 64, 0)
+    with pytest.raises(ValueError):
+        _q_chunk_size(64, 64, -4)
