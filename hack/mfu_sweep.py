@@ -14,10 +14,21 @@ sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]
 
 CONFIGS = [
     {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "full"},   # current default
-    {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "dots"},
-    {"HIVED_PERF_BATCH": "4", "HIVED_PERF_REMAT": "full"},
-    {"HIVED_PERF_BATCH": "4", "HIVED_PERF_REMAT": "dots"},
-    {"HIVED_PERF_BATCH": "8", "HIVED_PERF_REMAT": "full"},
+    {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "flash"},
+    {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "dots+flash"},
+    {"HIVED_PERF_BATCH": "4", "HIVED_PERF_REMAT": "flash"},
+    {"HIVED_PERF_BATCH": "4", "HIVED_PERF_REMAT": "dots+flash"},
+    {"HIVED_PERF_BATCH": "8", "HIVED_PERF_REMAT": "flash"},
+    # Block-size exploration at the best-known remat setting. Block sizes
+    # are module attributes read at trace time; main() patches them onto
+    # the imported module per config (the env vars alone only affect fresh
+    # processes).
+    {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "flash",
+     "HIVED_FLASH_BLOCK_Q": "512", "HIVED_FLASH_BLOCK_K": "512"},
+    {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "flash",
+     "HIVED_FLASH_BLOCK_Q": "256", "HIVED_FLASH_BLOCK_K": "512"},
+    {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "flash",
+     "HIVED_FLASH_BLOCK_Q": "512", "HIVED_FLASH_BLOCK_K": "256"},
 ]
 
 
@@ -28,18 +39,33 @@ def main() -> None:
         print(json.dumps({"skipped": "not on TPU"}))
         return
     from hivedscheduler_tpu.models import perf
+    from hivedscheduler_tpu.ops import attention as att
 
     for cfg in CONFIGS:
         os.environ.update(cfg)
+        # BLOCK_Q/BLOCK_K are read from the env at import time; propagate
+        # overrides to the already-imported module for in-process sweeps
+        # (falling back to the module's own shipped defaults, not a copy).
+        att.BLOCK_Q = int(cfg.get("HIVED_FLASH_BLOCK_Q", att.DEFAULT_BLOCK_Q))
+        att.BLOCK_K = int(cfg.get("HIVED_FLASH_BLOCK_K", att.DEFAULT_BLOCK_K))
         try:
             r = perf.bench_train_step(on_tpu=True)
             r["config"] = cfg
-            peak = perf.peak_flops(jax.devices()[0].device_kind) or 0
-            if peak:
-                r["mfu"] = round(
-                    r["flops_per_token"] * r["tokens_per_sec_per_chip"] / peak,
-                    4,
+            # Whether the flash path actually ran for this config: a block
+            # setting the shape gate rejects silently benchmarks the XLA
+            # reference, which must not masquerade as a flash measurement.
+            r["pallas_used"] = bool(
+                att.pallas_wanted() and att.pallas_shape_ok(r["seq"], r["seq"])
+            )
+            # Same guarded MFU as the main harness: a broken sync must
+            # print mfu_rejected, not a >1 number a tuning decision trusts.
+            r.update(
+                perf.mfu_fields(
+                    r["flops_per_token"],
+                    r["tokens_per_sec_per_chip"],
+                    jax.devices()[0].device_kind,
                 )
+            )
         except Exception as exc:
             r = {"config": cfg, "error": f"{type(exc).__name__}: {exc}"[:200]}
         print(json.dumps(r), flush=True)

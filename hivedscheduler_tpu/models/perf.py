@@ -42,6 +42,30 @@ def peak_flops(device_kind: str) -> float | None:
     return None
 
 
+def mfu_fields(flops_per_token: float, tokens_per_sec: float,
+               device_kind: str) -> dict:
+    """MFU against the chip's peak, with the physical-plausibility guard.
+
+    An MFU outside (0, 1] means the timing sync failed (e.g. an environment
+    where even the host fetch is faked): refuse to publish the number rather
+    than report >100% utilization as a result. Shared by the main harness
+    and hack/mfu_sweep.py so no publisher skips the guard."""
+    peak = peak_flops(device_kind)
+    if peak is None:
+        return {}
+    fields: dict = {"peak_bf16_flops": peak}
+    mfu = flops_per_token * tokens_per_sec / peak
+    if 0.0 < mfu <= 1.0:
+        fields["mfu"] = round(mfu, 4)
+    else:
+        fields["mfu"] = None
+        fields["mfu_rejected"] = round(mfu, 4)
+        fields["mfu_rejected_reason"] = (
+            "MFU outside (0, 1] — timing sync not trustworthy"
+        )
+    return fields
+
+
 def bench_config(on_tpu: bool):
     """Largest flagship config that comfortably fits one chip (f32 master
     params + adam moments + remat'd activations ~5.5 GB at the TPU shape),
@@ -383,25 +407,13 @@ def main() -> None:
         train_res["attention_fallback"] = "xla"
         train_res["attention_fallback_reason"] = first_error
     result.update(train_res)
-    peak = peak_flops(kind)
-    if peak is not None:
-        result["peak_bf16_flops"] = peak
-        mfu = (
-            train_res["flops_per_token"]
-            * train_res["tokens_per_sec_per_chip"]
-            / peak
+    result.update(
+        mfu_fields(
+            train_res["flops_per_token"],
+            train_res["tokens_per_sec_per_chip"],
+            kind,
         )
-        # A physically impossible MFU means the timing sync failed (e.g. an
-        # environment where even the host fetch is faked): refuse to publish
-        # the number rather than report >100% utilization as a result.
-        if 0.0 < mfu <= 1.0:
-            result["mfu"] = round(mfu, 4)
-        else:
-            result["mfu"] = None
-            result["mfu_rejected"] = round(mfu, 4)
-            result["mfu_rejected_reason"] = (
-                "MFU outside (0, 1] — timing sync not trustworthy"
-            )
+    )
     result.update(bench_attention(on_tpu))
     if os.environ.get("HIVED_PERF_ZOO", "0") == "1":
         try:

@@ -48,6 +48,12 @@ class TransformerConfig:
     # "full": recompute everything in backward (min memory).
     # "dots": save matmul (MXU) outputs, recompute only elementwise — less
     # recompute FLOPs for ~b*s*(d+d_ff) extra bytes per layer.
+    # "flash": save only the flash-attention kernel residuals (bf16 out +
+    # thin f32 lse, named in ops/attention._flash_fwd) — the backward then
+    # skips the whole pallas forward recompute (the block's most expensive
+    # piece) for ~2*b*s*d_model extra bytes per layer; everything else
+    # remats.
+    # "dots+flash": both of the above.
     remat_policy: str = "full"
     tied_embeddings: bool = False
 
@@ -200,6 +206,28 @@ def _block(
     return x + sharding.constrain(ffn, "batch", "seq", "act_embed")
 
 
+def _remat_policy(name: str):
+    """Map a config's remat_policy string to a jax.checkpoint policy.
+    On paths without the flash kernels (XLA fallback, ring attention) the
+    "flash" names simply never appear, degrading to full remat — correct,
+    just without the saved-residual speedup."""
+    p = jax.checkpoint_policies
+    flash = p.save_only_these_names("flash_out", "flash_lse")
+    policies = {
+        "full": None,
+        "dots": p.dots_with_no_batch_dims_saveable,
+        "flash": flash,
+        "dots+flash": p.save_from_both_policies(
+            p.dots_with_no_batch_dims_saveable, flash
+        ),
+    }
+    if name not in policies:
+        raise ValueError(
+            f"unknown remat_policy {name!r}; one of {sorted(policies)}"
+        )
+    return policies[name]
+
+
 def forward_hidden(
     params: Params,
     tokens: jax.Array,  # [B, S] int32
@@ -220,12 +248,7 @@ def forward_hidden(
 
     block = lambda x, layer: (_block(x, layer, c, mesh, use_ring), None)
     if c.remat:
-        policy = (
-            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            if c.remat_policy == "dots"
-            else None  # full remat: recompute everything
-        )
-        block = jax.checkpoint(block, policy=policy)
+        block = jax.checkpoint(block, policy=_remat_policy(c.remat_policy))
     x, _ = jax.lax.scan(block, x, params["layers"])
 
     x = rms_norm(x, params["ln_f"])

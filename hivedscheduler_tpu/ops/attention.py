@@ -24,12 +24,22 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 NEG_INF = -1e30
+
+# Default Pallas block sizes, env-tunable for on-chip sweeps
+# (hack/mfu_sweep.py) without code edits. 256x256 is the measured default;
+# the shape gate below adapts to whatever is configured.
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+BLOCK_Q = int(os.environ.get("HIVED_FLASH_BLOCK_Q", str(DEFAULT_BLOCK_Q)))
+BLOCK_K = int(os.environ.get("HIVED_FLASH_BLOCK_K", str(DEFAULT_BLOCK_K)))
 
 # Interpreter mode for pallas kernels (CPU tests); real TPU runs leave False.
 INTERPRET = False
@@ -427,8 +437,8 @@ def flash_attention_tpu(
     v: jax.Array,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 256,
-    block_k: int = 256,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
 ) -> jax.Array:
     out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
     return out
@@ -457,6 +467,18 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     qt, kt, vt, scale, bq, bk, groups = _prep(q, k, v, block_q, block_k,
                                               sm_scale)
     ot, lse = _flash_fwd_bh(qt, kt, vt, causal, scale, bq, bk)
+    # Name the backward's residuals so a remat policy can pin them
+    # (transformer remat_policy="flash": save_only_these_names). With the
+    # kernel outputs saved, the rematerialized forward inside backward
+    # DCEs the whole pallas_call — the most expensive recompute in the
+    # block — while q/k/v are still cheaply recomputed from the carry.
+    # Only lane 0 of the lane-broadcast lse is information; save the thin
+    # [bh, s, 1] slice and rebroadcast (cheap, recomputed in backward) so
+    # the policy pins 1/LANE-th of the f32 array.
+    ot = checkpoint_name(ot, "flash_out")
+    lse = jnp.broadcast_to(
+        checkpoint_name(lse[:, :, :1], "flash_lse"), lse.shape
+    )
     out = ot.reshape(b, h, s, d).transpose(0, 2, 1, 3)
     return out, (q, k, v, ot, lse)
 
@@ -502,7 +524,7 @@ def mha(
     if use_pallas is None:
         use_pallas = pallas_wanted()
     if use_pallas and pallas_shape_ok(q.shape[1], k.shape[1]):
-        return flash_attention_tpu(q, k, v, causal, sm_scale)
+        return flash_attention_tpu(q, k, v, causal, sm_scale, BLOCK_Q, BLOCK_K)
     return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
 
 
@@ -521,5 +543,21 @@ def pallas_wanted() -> bool:
 
 def pallas_shape_ok(sq: int, sk: int) -> bool:
     """Shape gate of the Pallas path: long-enough, block-aligned
-    self-attention."""
-    return sq >= 256 and sq % 256 == 0 and sq == sk
+    self-attention. Block-aligned means divisible by the *effective* blocks
+    (what ``_prep`` uses after clamping each block to the sequence length):
+    under an 8k-tuned BLOCK_K=512, a 768-long input must route to the XLA
+    fallback here rather than trip ``_prep``'s divisibility assert. The
+    effective blocks are also the last two dims of the in-kernel score
+    matrix, so they must respect Mosaic's (8, 128) tile themselves —
+    without that check a clamped block (e.g. sq=300 < BLOCK) would pass
+    the divisibility test trivially and crash in lowering."""
+    bq = min(BLOCK_Q, sq)
+    bk = min(BLOCK_K, sq)
+    return (
+        sq >= 256
+        and sq == sk
+        and sq % bq == 0
+        and sq % bk == 0
+        and bq % 8 == 0
+        and bk % 128 == 0
+    )
