@@ -12,23 +12,28 @@ import sys
 
 sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]))
 
+# Round-4 sweep results (v5e, 268M params, batch 2 x seq 8192) that picked
+# the shipped defaults (remat=flash, blocks 512x1024 -> MFU 0.541):
+# full/256x256 0.265, flash/256x256 0.329, flash/512x512 0.494,
+# flash/512x1024 0.541, flash/512x2048 0.537, flash/1024x1024 0.009 (VMEM
+# collapse), batch 4/8 and dots+flash all worse. Raw rows:
+# example/logs/perf_tpu_round4.md.
 CONFIGS = [
-    {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "full"},   # current default
-    {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "flash"},
+    {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "flash"},  # current default
+    {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "full"},
     {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "dots+flash"},
     {"HIVED_PERF_BATCH": "4", "HIVED_PERF_REMAT": "flash"},
-    {"HIVED_PERF_BATCH": "4", "HIVED_PERF_REMAT": "dots+flash"},
     {"HIVED_PERF_BATCH": "8", "HIVED_PERF_REMAT": "flash"},
-    # Block-size exploration at the best-known remat setting. Block sizes
-    # are module attributes read at trace time; main() patches them onto
-    # the imported module per config (the env vars alone only affect fresh
+    # Block-size exploration around the shipped optimum. Block sizes are
+    # module attributes read at trace time; main() patches them onto the
+    # imported module per config (the env vars alone only affect fresh
     # processes).
     {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "flash",
      "HIVED_FLASH_BLOCK_Q": "512", "HIVED_FLASH_BLOCK_K": "512"},
     {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "flash",
-     "HIVED_FLASH_BLOCK_Q": "256", "HIVED_FLASH_BLOCK_K": "512"},
+     "HIVED_FLASH_BLOCK_Q": "256", "HIVED_FLASH_BLOCK_K": "1024"},
     {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "flash",
-     "HIVED_FLASH_BLOCK_Q": "512", "HIVED_FLASH_BLOCK_K": "256"},
+     "HIVED_FLASH_BLOCK_Q": "512", "HIVED_FLASH_BLOCK_K": "2048"},
 ]
 
 

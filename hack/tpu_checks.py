@@ -25,12 +25,16 @@ from hivedscheduler_tpu.ops import attention as A
 
 def main() -> None:
     backend = jax.default_backend()
-    result = {"backend": backend, "device": str(jax.devices()[0])}
+    result = {"backend": backend, "device": str(jax.devices()[0]),
+              "block_q_limit": A.BLOCK_Q, "block_k_limit": A.BLOCK_K}
     if backend != "tpu":
         print(json.dumps({**result, "skipped": "not on TPU"}))
         return
 
     B, S, H, D, Hkv = 2, 1024, 8, 128, 4
+    # Validate the blocks mha would actually dispatch for this shape (the
+    # production path fits the configured limits to the sequence).
+    BQ, BK = A.fit_block(A.BLOCK_Q, S, 8), A.fit_block(A.BLOCK_K, S, 128)
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
     k = jax.random.normal(ks[1], (B, S, Hkv, D), jnp.bfloat16)
@@ -38,7 +42,7 @@ def main() -> None:
 
     def loss_flash(q, k, v):
         return jnp.sum(
-            A.flash_attention_tpu(q, k, v, True, None, 256, 256).astype(
+            A.flash_attention_tpu(q, k, v, True, None, BQ, BK).astype(
                 jnp.float32
             )
             ** 2
@@ -48,7 +52,7 @@ def main() -> None:
         return jnp.sum(A.mha_reference(q, k, v, causal=True).astype(jnp.float32) ** 2)
 
     of = np.asarray(
-        jax.jit(lambda q, k, v: A.flash_attention_tpu(q, k, v, True, None, 256, 256))(
+        jax.jit(lambda q, k, v: A.flash_attention_tpu(q, k, v, True, None, BQ, BK))(
             q, k, v
         ),
         dtype=np.float32,

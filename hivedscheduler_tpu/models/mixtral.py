@@ -18,7 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh
 
-from ..parallel import ring, sharding
+from ..parallel import sharding
 from .transformer import rms_norm, rope
 
 Params = Dict[str, Any]
@@ -39,6 +39,9 @@ class MixtralConfig:
     rope_theta: float = 1000000.0
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # Sequence-parallel backend when the mesh has sp > 1 (see
+    # parallel/sharding.sp_attention): auto | ring | ulysses.
+    sp_mode: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -187,7 +190,7 @@ def moe_ffn(
     return out.reshape(b, s, d), aux_loss
 
 
-def _block(x, layer, config, mesh, use_ring):
+def _block(x, layer, config, mesh, use_sp):
     c = config
     b, s, d = x.shape
     h = rms_norm(x, layer["ln1"])
@@ -197,8 +200,10 @@ def _block(x, layer, config, mesh, use_ring):
     positions = jnp.arange(s)
     q = rope(q, positions, c.rope_theta)
     k = rope(k, positions, c.rope_theta)
-    if use_ring:
-        attn = ring.ring_attention(q, k, v, mesh, causal=True)
+    if use_sp:
+        attn = sharding.sp_attention(
+            q, k, v, mesh, causal=True, sp_mode=c.sp_mode
+        )
     else:
         attn = sharding.sharded_mha(q, k, v, mesh, causal=True)
     x = x + attn.reshape(b, s, d) @ layer["wo"]
@@ -216,13 +221,14 @@ def forward(
 ) -> Tuple[jax.Array, jax.Array]:
     """Returns (logits [B,S,V], total aux load-balancing loss)."""
     c = config
-    use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
+    sharding.validate_sp_mode(c.sp_mode)
+    use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
     params = jax.tree.map(lambda a: a.astype(c.dtype), params)
     x = params["embed"][tokens]
     x = sharding.constrain(x, "batch", "seq", "act_embed")
 
     def block(x, layer):
-        y, aux = _block(x, layer, c, mesh, use_ring)
+        y, aux = _block(x, layer, c, mesh, use_sp)
         return y, aux
 
     if c.remat:

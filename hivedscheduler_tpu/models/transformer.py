@@ -14,8 +14,10 @@ TPU design choices:
   - GQA (n_kv_heads < n_heads) shrinks KV cache/bandwidth.
   - parallelism is all declarative: logical axis names on every param
     (``logical_axes``) + sharding constraints on activations; the mesh rule
-    table (parallel/sharding.py) decides dp/fsdp/sp/tp. Ring attention
-    (parallel/ring.py) engages when the mesh has sp > 1.
+    table (parallel/sharding.py) decides dp/fsdp/sp/tp. When the mesh has
+    sp > 1, sequence parallelism engages: Ulysses all-to-all
+    (parallel/ulysses.py) where head counts divide, ring attention
+    (parallel/ring.py) otherwise — see ``TransformerConfig.sp_mode``.
 """
 
 from __future__ import annotations
@@ -28,7 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from ..ops.attention import mha
-from ..parallel import ring, sharding
+from ..parallel import sharding
 
 Params = Dict[str, Any]
 
@@ -56,6 +58,11 @@ class TransformerConfig:
     # "dots+flash": both of the above.
     remat_policy: str = "full"
     tied_embeddings: bool = False
+    # Sequence-parallel backend when the mesh has sp > 1 (see
+    # parallel/sharding.sp_attention): "auto" picks Ulysses all-to-all when
+    # legal AND the flash kernels will run locally (lower traffic), ring
+    # attention otherwise; "ring"/"ulysses" force one.
+    sp_mode: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -174,7 +181,7 @@ def _block(
     layer: Params,
     config: TransformerConfig,
     mesh: Optional[Mesh],
-    use_ring: bool,
+    use_sp: bool,
 ) -> jax.Array:
     c = config
     b, s, d = x.shape
@@ -189,9 +196,11 @@ def _block(
     q = sharding.constrain(q, "batch", "seq", "heads", None)
     k = sharding.constrain(k, "batch", "seq", "kv_heads", None)
     v = sharding.constrain(v, "batch", "seq", "kv_heads", None)
-    if use_ring:
+    if use_sp:
         assert mesh is not None
-        attn = ring.ring_attention(q, k, v, mesh, causal=True)
+        attn = sharding.sp_attention(
+            q, k, v, mesh, causal=True, sp_mode=c.sp_mode
+        )
     else:
         # Pallas flash kernels on TPU (shard_map-wrapped under a mesh,
         # since GSPMD cannot partition a pallas_call); XLA reference off-TPU.
@@ -238,7 +247,8 @@ def forward_hidden(
     weight [D, V] — the pieces the fused vocab-chunked loss consumes without
     ever materializing [B, S, V] logits."""
     c = config
-    use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
+    sharding.validate_sp_mode(c.sp_mode)
+    use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
     # Mixed precision: f32 master params -> bf16 compute copies.
     params = jax.tree.map(lambda a: a.astype(c.dtype), params)
     # Vocab-parallel lookup when possible: a plain gather on a tp-sharded
@@ -246,7 +256,7 @@ def forward_hidden(
     x = sharding.embed_lookup(params["embed"], tokens, mesh)
     x = sharding.constrain(x, "batch", "seq", "act_embed")
 
-    block = lambda x, layer: (_block(x, layer, c, mesh, use_ring), None)
+    block = lambda x, layer: (_block(x, layer, c, mesh, use_sp), None)
     if c.remat:
         block = jax.checkpoint(block, policy=_remat_policy(c.remat_policy))
     x, _ = jax.lax.scan(block, x, params["layers"])
@@ -262,7 +272,8 @@ def forward(
     config: TransformerConfig,
     mesh: Optional[Mesh] = None,
 ) -> jax.Array:
-    """Logits [B, S, V]. Set ``mesh`` with sp>1 to engage ring attention."""
+    """Logits [B, S, V]. Set ``mesh`` with sp>1 to engage sequence-parallel
+    attention (Ulysses or ring per ``config.sp_mode``)."""
     x, head = forward_hidden(params, tokens, config, mesh)
     logits = x @ head
     return sharding.constrain(

@@ -34,10 +34,13 @@ from jax.ad_checkpoint import checkpoint_name
 NEG_INF = -1e30
 
 # Default Pallas block sizes, env-tunable for on-chip sweeps
-# (hack/mfu_sweep.py) without code edits. 256x256 is the measured default;
-# the shape gate below adapts to whatever is configured.
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 256
+# (hack/mfu_sweep.py) without code edits; the shape gate below adapts to
+# whatever is configured. 512x1024 is the measured optimum on v5e at the
+# bench shape (seq 8192, head_dim 128): MFU 0.541 vs 0.329 at 256x256 in
+# the same sweep session (remat=flash both); 1024x1024 collapses (VMEM),
+# 2048-wide K is flat — see doc/perf.md.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
 BLOCK_Q = int(os.environ.get("HIVED_FLASH_BLOCK_Q", str(DEFAULT_BLOCK_Q)))
 BLOCK_K = int(os.environ.get("HIVED_FLASH_BLOCK_K", str(DEFAULT_BLOCK_K)))
 
@@ -524,7 +527,11 @@ def mha(
     if use_pallas is None:
         use_pallas = pallas_wanted()
     if use_pallas and pallas_shape_ok(q.shape[1], k.shape[1]):
-        return flash_attention_tpu(q, k, v, causal, sm_scale, BLOCK_Q, BLOCK_K)
+        s = q.shape[1]
+        return flash_attention_tpu(
+            q, k, v, causal, sm_scale,
+            fit_block(BLOCK_Q, s, 8), fit_block(BLOCK_K, s, 128),
+        )
     return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
 
 
@@ -541,23 +548,29 @@ def pallas_wanted() -> bool:
     )
 
 
+def fit_block(limit: int, s: int, align: int) -> int:
+    """Largest block <= ``limit`` that divides ``s`` and is a multiple of
+    ``align`` (the Mosaic tile constraint for that score-matrix dim), or 0
+    when none exists. This is what lets an 8k-tuned BLOCK_K=1024 still run
+    the flash kernels at seq 768 (with 768-wide blocks) instead of silently
+    demoting every non-multiple-of-1024 length to the O(S^2) XLA path."""
+    for b in range(min(limit, s) // align * align, 0, -align):
+        if s % b == 0:
+            return b
+    return 0
+
+
 def pallas_shape_ok(sq: int, sk: int) -> bool:
-    """Shape gate of the Pallas path: long-enough, block-aligned
-    self-attention. Block-aligned means divisible by the *effective* blocks
-    (what ``_prep`` uses after clamping each block to the sequence length):
-    under an 8k-tuned BLOCK_K=512, a 768-long input must route to the XLA
-    fallback here rather than trip ``_prep``'s divisibility assert. The
-    effective blocks are also the last two dims of the in-kernel score
-    matrix, so they must respect Mosaic's (8, 128) tile themselves —
-    without that check a clamped block (e.g. sq=300 < BLOCK) would pass
-    the divisibility test trivially and crash in lowering."""
-    bq = min(BLOCK_Q, sq)
-    bk = min(BLOCK_K, sq)
+    """Shape gate of the Pallas path: long-enough self-attention for which
+    some Mosaic-tile-aligned blocks exist under the configured limits
+    (``fit_block``; ``mha`` dispatches with exactly those fitted blocks).
+    The effective blocks are the last two dims of the in-kernel score
+    matrix, hence the (8, 128) alignment requirement — e.g. sq=300 has no
+    valid block and must route to the XLA fallback rather than crash in
+    lowering."""
     return (
         sq >= 256
         and sq == sk
-        and sq % bq == 0
-        and sq % bk == 0
-        and bq % 8 == 0
-        and bk % 128 == 0
+        and fit_block(BLOCK_Q, sq, 8) > 0
+        and fit_block(BLOCK_K, sq, 128) > 0
     )

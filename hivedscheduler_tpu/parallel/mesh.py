@@ -12,7 +12,8 @@ Axis conventions used across models/:
   - ``dp``:   pure data parallelism (batch) — DCN-friendly, outermost.
   - ``fsdp``: data parallelism with sharded params/optimizer (ZeRO-3 style);
               ICI, second-outermost.
-  - ``sp``:   sequence/context parallelism (ring attention) — ICI.
+  - ``sp``:   sequence/context parallelism (Ulysses all-to-all or ring
+              attention; parallel/sharding.sp_attention picks) — ICI.
   - ``tp``:   tensor parallelism (megatron-style) — innermost, ICI-adjacent.
   - ``ep``:   expert parallelism for MoE models (aliases fsdp capacity).
 """

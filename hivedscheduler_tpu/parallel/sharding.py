@@ -36,6 +36,29 @@ DEFAULT_RULES: Dict[str, Any] = {
     "layers": None,
 }
 
+# Sequence-parallel backends accepted by sp_attention and the model
+# configs' sp_mode fields (validated eagerly via validate_sp_mode).
+SP_MODES = ("auto", "ring", "ulysses")
+
+
+def validate_sp_mode(sp_mode: str) -> None:
+    if sp_mode not in SP_MODES:
+        raise ValueError(
+            f"unknown sp_mode {sp_mode!r}; one of {'/'.join(SP_MODES)}"
+        )
+
+
+def axes_size(axis, mesh: Optional[Mesh]) -> int:
+    """Total device count over a mesh-axis spec (None, a name, or a tuple
+    of names — the shapes logical-axis rules produce)."""
+    if axis is None or mesh is None:
+        return 1
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    n = 1
+    for a in axes:
+        n *= mesh.shape.get(a, 1)
+    return n
+
 
 def spec_for(
     logical_axes: Sequence[Optional[str]],
@@ -84,14 +107,7 @@ def embed_lookup(
     table_rules = DEFAULT_RULES if rules is None else rules
 
     def _size(name):
-        ax = table_rules.get(name)
-        if ax is None or mesh is None:
-            return 1
-        axes = ax if isinstance(ax, tuple) else (ax,)
-        n = 1
-        for a in axes:
-            n *= mesh.shape.get(a, 1)
-        return n
+        return axes_size(table_rules.get(name), mesh)
 
     divisible = (
         mesh is not None
@@ -185,14 +201,7 @@ def sharded_mha(
     table = DEFAULT_RULES if rules is None else rules
 
     def _size(name):
-        ax = table.get(name)
-        if ax is None or mesh is None:
-            return 1
-        axes = ax if isinstance(ax, tuple) else (ax,)
-        n = 1
-        for a in axes:
-            n *= mesh.shape.get(a, 1)
-        return n
+        return axes_size(table.get(name), mesh)
 
     if mesh is None or mesh.size == 1:
         return att.mha(q, k, v, causal=causal)
@@ -228,3 +237,52 @@ def shard_batch(batch: Any, mesh: Mesh, rules=None) -> Any:
         return jax.device_put(x, NamedSharding(mesh, spec_for(axes, table)))
 
     return jax.tree.map(put, batch)
+
+
+def sp_attention(
+    q: jax.Array,  # [B, S, H, D] globally; S sharded over `sp`
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    sp_mode: str = "auto",
+) -> jax.Array:
+    """Sequence-parallel attention dispatcher — the single place that picks
+    between the two SP backends, shared by every model:
+
+      - "ulysses" (parallel/ulysses.py): all-to-all head/sequence re-shard;
+        lower traffic and the local full-sequence call uses the Pallas
+        flash kernels. Requires the head counts to divide the mesh.
+      - "ring" (parallel/ring.py): K/V rotation with a streaming softmax;
+        no head requirement, and local memory is O(chunk) by construction.
+
+    "auto" picks Ulysses only when it is both legal (``can_ulysses``) AND
+    its local attention would run the flash kernels — without the kernels
+    the local step falls back to the O(S^2)-memory XLA reference, while
+    ring keeps its score tile bounded, so ring is the safer default there
+    (e.g. HIVED_DISABLE_PALLAS=1, non-TPU backends, gate-rejected shapes).
+    An explicit sp_mode overrides that heuristic either way.
+    """
+    from ..ops import attention as att
+    from . import ring, ulysses
+
+    validate_sp_mode(sp_mode)
+    h, hkv, s = q.shape[2], k.shape[2], q.shape[1]
+    legal = ulysses.can_ulysses(mesh, h, hkv, s)
+    if sp_mode == "ulysses" and not legal:
+        raise ValueError(
+            f"sp_mode='ulysses' but heads/seq do not divide the mesh: "
+            f"heads={h} kv_heads={hkv} seq={s} mesh={dict(mesh.shape)}"
+        )
+    use_ulysses = sp_mode == "ulysses" or (
+        sp_mode == "auto"
+        and legal
+        and att.pallas_wanted()
+        and att.pallas_shape_ok(s, s)
+    )
+    if use_ulysses:
+        return ulysses.ulysses_attention(
+            q, k, v, mesh, causal=causal, sm_scale=sm_scale
+        )
+    return ring.ring_attention(q, k, v, mesh, causal=causal, sm_scale=sm_scale)

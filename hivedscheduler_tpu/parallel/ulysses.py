@@ -1,0 +1,138 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence re-shard.
+
+The second sequence-parallel backend next to ring attention (parallel/
+ring.py), per the build goal's "ring attention or all-to-all sequence/
+context parallelism" — this repo ships both because they win in different
+regimes. The reference scheduler has no compute path at all (SURVEY.md
+§2.2); its enabler is contiguous-slice placement, which is exactly what
+makes these ICI collectives fast.
+
+Mechanics (DeepSpeed-Ulysses / GSPMD all-to-all pattern): Q/K/V arrive
+sequence-sharded over ``sp``. One ``all_to_all`` per tensor trades the head
+dimension for the sequence dimension — each device ends up holding the FULL
+sequence for H/sp of the heads — then attention runs entirely locally, and
+one ``all_to_all`` on the output restores sequence sharding. Attention is
+embarrassingly parallel over heads, so the local step is exact.
+
+vs ring attention:
+  - Ulysses moves Q/K/V/O once each (4 all-to-alls of the *shard*, i.e.
+    O(S/p·d) bytes per device per tensor); ring moves K/V p-1 times
+    (2·(p-1) ppermutes). For long sequences with enough heads, Ulysses is
+    the lower-traffic schedule.
+  - The local attention is a single full-sequence call, so the Pallas
+    flash kernels (ops/attention.py) apply unchanged — ring's streaming
+    inner step cannot use them (it never sees the full sequence).
+  - The catch: parallelism is capped by heads — needs sp | H (and sp | Hkv
+    for GQA, else K/V heads are replicated first). Ring has no head
+    requirement, which is why it stays the fallback (``can_ulysses``).
+
+Memory per device is O(S·H/p·d) — same total as ring, laid out
+head-sharded instead of sequence-sharded.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import attention as att
+from .sharding import axes_size
+
+
+
+
+def can_ulysses(
+    mesh: Mesh,
+    n_heads: int,
+    n_kv_heads: int,
+    seq_len: int,
+    seq_axis: str = "sp",
+    head_axis: str = "tp",
+) -> bool:
+    """Whether the all-to-all schedule applies: every device must receive a
+    whole number of (tp-local) Q heads, and the sequence must re-assemble
+    evenly. K/V heads only need tp-divisibility — ``_ulysses_local``
+    expands GQA K/V heads to the Q head count when sp does not divide
+    them, which needs the usual GQA condition (Q heads a multiple of KV
+    heads) to hold per tp shard."""
+    sp = axes_size(seq_axis, mesh)
+    tp = axes_size(head_axis, mesh)
+    if sp <= 1:
+        return False
+    if not (
+        n_heads % (tp * sp) == 0
+        and n_kv_heads % tp == 0
+        and seq_len % sp == 0
+    ):
+        return False
+    hq_tp = n_heads // tp
+    hkv_tp = n_kv_heads // tp
+    return hkv_tp % sp == 0 or hq_tp % hkv_tp == 0
+
+
+def _ulysses_local(
+    q: jax.Array,  # [b, S/sp, H_tp, D] this device's shards
+    k: jax.Array,  # [b, S/sp, Hkv_tp, D]
+    v: jax.Array,
+    axis_name: str,
+    causal: bool,
+    sm_scale: Optional[float],
+) -> jax.Array:
+    """Runs under shard_map. all_to_all to full-sequence/sharded-heads,
+    local (flash-dispatched) attention, all_to_all back."""
+    sp = jax.lax.psum(1, axis_name)
+    hq, hkv = q.shape[2], k.shape[2]
+    if hkv % sp != 0:
+        # GQA with fewer KV heads than the sp degree: expand K/V to the Q
+        # head count first so both all_to_alls split identically and every
+        # device's Q-head subset travels with exactly its own GQA group —
+        # splitting the raw hkv heads would pair local head j with kv head
+        # j instead of j // group. Costs (hq/hkv)x the minimal KV traffic;
+        # only the hkv % sp != 0 fallback pays it.
+        k = jnp.repeat(k, hq // hkv, axis=2)
+        v = jnp.repeat(v, hq // hkv, axis=2)
+    # Trade heads for sequence: [b, S/sp, h, D] -> [b, S, h/sp, D].
+    k = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    v = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    q = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    # Full sequence locally: the Pallas flash kernels dispatch when on TPU.
+    o = att.mha(q, k, v, causal=causal, sm_scale=sm_scale)
+    # Back to sequence-sharded: [b, S, H_tp/sp, D] -> [b, S/sp, H_tp, D].
+    return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,  # [B, S, H, D] globally; S sharded over `sp`
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    batch_axes=("dp", "fsdp"),
+    seq_axis: str = "sp",
+    head_axis: str = "tp",
+) -> jax.Array:
+    """Exact attention with the sequence sharded over ``seq_axis``, computed
+    by all-to-all head re-sharding. Same signature/spec contract as
+    ``ring.ring_attention`` so callers can switch per ``can_ulysses``."""
+    if not can_ulysses(
+        mesh, q.shape[2], k.shape[2], q.shape[1], seq_axis, head_axis
+    ):
+        raise ValueError(
+            f"ulysses_attention needs sp|heads and sp|seq: heads={q.shape[2]} "
+            f"kv_heads={k.shape[2]} seq={q.shape[1]} mesh={dict(mesh.shape)}"
+        )
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    fn = functools.partial(
+        _ulysses_local,
+        axis_name=seq_axis,
+        causal=causal,
+        sm_scale=sm_scale,
+    )
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+    )(q, k, v)

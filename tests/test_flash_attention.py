@@ -180,20 +180,26 @@ def test_unknown_remat_policy_rejected():
         T._remat_policy("nonsense")
 
 
-def test_shape_gate_rejects_tile_misaligned_clamped_blocks(monkeypatch):
-    """With tuned blocks larger than the sequence, the clamped block IS the
-    sequence: the gate must still enforce Mosaic's (8, 128) score tiling
-    and per-block divisibility, not pass sq % sq == 0 trivially."""
+def test_shape_gate_and_block_fitting(monkeypatch):
+    """The gate accepts exactly the shapes for which tile-aligned blocks
+    exist under the configured limits, and fit_block picks the largest
+    dividing block — big tuned defaults must not demote e.g. seq 768 to
+    the XLA path, nor let a misaligned length reach Mosaic."""
+    assert A.fit_block(512, 8192, 8) == 512
+    assert A.fit_block(1024, 8192, 128) == 1024
+    assert A.fit_block(1024, 768, 128) == 768   # whole-seq block fits
+    assert A.fit_block(512, 768, 128) == 384    # largest dividing multiple
+    assert A.fit_block(512, 300, 8) == 0        # 300 has no 8-aligned divisor
+    assert A.fit_block(512, 256, 128) == 256
     monkeypatch.setattr(A, "BLOCK_Q", 512)
-    monkeypatch.setattr(A, "BLOCK_K", 512)
-    assert not A.pallas_shape_ok(300, 300)  # clamped block not tile-aligned
-    assert not A.pallas_shape_ok(768, 768)  # 768 % 512 != 0
-    assert A.pallas_shape_ok(256, 256)      # clamped to 256: aligned
+    monkeypatch.setattr(A, "BLOCK_K", 1024)
+    assert not A.pallas_shape_ok(300, 300)   # no tile-aligned block exists
+    assert A.pallas_shape_ok(768, 768)       # runs with fitted 384/768
+    assert A.pallas_shape_ok(1536, 1536)
+    assert A.pallas_shape_ok(256, 256)
     assert A.pallas_shape_ok(8192, 8192)
-    monkeypatch.setattr(A, "BLOCK_Q", 256)
-    monkeypatch.setattr(A, "BLOCK_K", 256)
-    assert A.pallas_shape_ok(768, 768)
     assert not A.pallas_shape_ok(768, 1024)  # cross-attention: XLA path
+    assert not A.pallas_shape_ok(128, 128)   # too short to pay kernel cost
 
 
 def test_mfu_guard_rejects_impossible_numbers():

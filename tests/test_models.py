@@ -10,6 +10,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from hivedscheduler_tpu.models import train, transformer
 from hivedscheduler_tpu.parallel import mesh as pmesh, sharding
 from hivedscheduler_tpu.parallel.ring import ring_attention
+from hivedscheduler_tpu.parallel import ulysses
 from hivedscheduler_tpu.ops.attention import mha_reference
 
 
@@ -179,3 +180,157 @@ def test_ring_q_chunk_sizing_properties():
         _q_chunk_size(64, 64, 0)
     with pytest.raises(ValueError):
         _q_chunk_size(64, 64, -4)
+
+
+# --------------------------------------------------------------------------
+# Ulysses all-to-all sequence parallelism (parallel/ulysses.py)
+
+
+def _sp_fixture(h=4, hkv=4, sp=4, fsdp=2, tp=1):
+    cfg = {"sp": sp, "fsdp": fsdp}
+    if tp > 1:
+        cfg["tp"] = tp
+    mesh = pmesh.make_mesh(pmesh.MeshConfig(**cfg), devices=jax.devices())
+    B, S, D = 2, 64, 16
+    q = jax.random.normal(jax.random.PRNGKey(0), (B, S, h, D))
+    k = jax.random.normal(jax.random.PRNGKey(1), (B, S, hkv, D))
+    v = jax.random.normal(jax.random.PRNGKey(2), (B, S, hkv, D))
+    spec = NamedSharding(mesh, P(("dp", "fsdp"), "sp", "tp", None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    return mesh, (q, k, v), (qs, ks, vs)
+
+
+@pytest.mark.parametrize(
+    "h,hkv,sp,tp",
+    [
+        (4, 4, 4, 1),   # MHA: KV heads split over sp
+        (8, 2, 4, 1),   # GQA: KV heads replicated (hkv % sp != 0)
+        (4, 2, 2, 2),   # sp x tp combined
+    ],
+)
+def test_ulysses_attention_matches_reference(h, hkv, sp, tp):
+    mesh, (q, k, v), (qs, ks, vs) = _sp_fixture(h=h, hkv=hkv, sp=sp, tp=tp)
+    assert ulysses.can_ulysses(mesh, h, hkv, q.shape[1])
+    for causal in (True, False):
+        ref = mha_reference(q, k, v, causal=causal)
+        out = jax.device_get(
+            jax.jit(
+                lambda a, b, c: ulysses.ulysses_attention(
+                    a, b, c, mesh, causal=causal
+                )
+            )(qs, ks, vs)
+        )
+        assert float(np.abs(np.array(ref) - out).max()) < 2e-5, causal
+
+
+def test_ulysses_gradients_match_reference():
+    mesh, (q, k, v), (qs, ks, vs) = _sp_fixture(h=4, hkv=2, sp=4)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(mha_reference(q, k, v, causal=True) ** 2)
+
+    def loss_uly(q, k, v):
+        return jnp.sum(ulysses.ulysses_attention(q, k, v, mesh) ** 2)
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gu = jax.device_get(jax.jit(jax.grad(loss_uly, argnums=(0, 1, 2)))(qs, ks, vs))
+    for a, b in zip(gr, gu):
+        scale = float(jnp.max(jnp.abs(a))) + 1e-6
+        assert float(np.abs(np.array(a) - np.array(b)).max()) / scale < 1e-4
+
+
+def test_can_ulysses_divisibility_rules():
+    mesh = pmesh.make_mesh(
+        pmesh.MeshConfig(sp=4, fsdp=2), devices=jax.devices()
+    )
+    assert ulysses.can_ulysses(mesh, 4, 4, 64)
+    assert ulysses.can_ulysses(mesh, 8, 2, 64)    # replicate branch: 2|2
+    assert not ulysses.can_ulysses(mesh, 6, 6, 64)   # 6 % 4 != 0
+    assert not ulysses.can_ulysses(mesh, 4, 4, 66)   # seq % 4 != 0
+    assert not ulysses.can_ulysses(mesh, 4, 3, 64)   # 4 q % 3 kv != 0
+    nosp = pmesh.make_mesh(pmesh.MeshConfig(fsdp=8), devices=jax.devices())
+    assert not ulysses.can_ulysses(nosp, 8, 8, 64)
+    with pytest.raises(ValueError, match="ulysses_attention needs"):
+        ulysses.ulysses_attention(
+            jnp.zeros((1, 66, 4, 8)), jnp.zeros((1, 66, 4, 8)),
+            jnp.zeros((1, 66, 4, 8)), mesh,
+        )
+
+
+def test_transformer_sp_modes_match_single_device(tiny_config, tiny_params):
+    """The sharded forward must be backend-independent: auto (Ulysses for
+    the tiny config's 4q/2kv heads), forced ring, and single-device must
+    all agree."""
+    import dataclasses as dc
+
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(3), (4, 64), 0, tiny_config.vocab_size
+    )
+    ref = transformer.forward(tiny_params, tokens, tiny_config)
+    mesh = pmesh.make_mesh(
+        pmesh.MeshConfig(fsdp=2, sp=2, tp=2), devices=jax.devices()
+    )
+    logical = transformer.logical_axes(tiny_config)
+    param_sh = sharding.tree_shardings(mesh, logical)
+    sharded_params = jax.device_put(tiny_params, param_sh)
+    for mode in ("auto", "ring", "ulysses"):
+        c = dc.replace(tiny_config, sp_mode=mode)
+        assert ulysses.can_ulysses(mesh, c.n_heads, c.n_kv_heads, 64)
+        with mesh:
+            out = jax.jit(
+                lambda p, t: transformer.forward(p, t, c, mesh=mesh)
+            )(sharded_params, tokens)
+        assert (
+            float(np.abs(np.array(ref) - np.array(jax.device_get(out))).max())
+            < 2e-4
+        ), mode
+
+
+def test_transformer_rejects_bad_sp_mode(tiny_config, tiny_params):
+    import dataclasses as dc
+
+    c = dc.replace(tiny_config, sp_mode="rign")
+    with pytest.raises(ValueError, match="sp_mode"):
+        transformer.forward(tiny_params, jnp.zeros((2, 64), jnp.int32), c)
+
+
+def test_sp_attention_auto_is_pallas_aware(monkeypatch):
+    """auto must pick Ulysses only when the local full-sequence attention
+    would run the flash kernels; otherwise ring keeps memory bounded
+    (Ulysses' XLA fallback materializes the full S x S score matrix)."""
+    from hivedscheduler_tpu.ops import attention as att
+    from hivedscheduler_tpu.parallel import ring as ring_mod
+    from hivedscheduler_tpu.parallel import ulysses as uly_mod
+
+    mesh, (q, k, v), (qs, ks, vs) = _sp_fixture(h=4, hkv=4, sp=4)
+    # Stub both backends: this test checks SELECTION only (the numerics of
+    # each backend have their own tests above), and the simulated
+    # flash-available branch must not actually run Mosaic kernels on CPU.
+    calls = []
+    monkeypatch.setattr(
+        ring_mod, "ring_attention",
+        lambda q, *a, **kw: calls.append("ring") or q,
+    )
+    monkeypatch.setattr(
+        uly_mod, "ulysses_attention",
+        lambda q, *a, **kw: calls.append("ulysses") or q,
+    )
+
+    # CPU backend: pallas_wanted() is False -> auto routes to ring.
+    sharding.sp_attention(qs, ks, vs, mesh)
+    assert calls == ["ring"]
+    # Flash available (simulated; S=64 would fail the real gate, so stub
+    # both predicates): auto routes to Ulysses.
+    monkeypatch.setattr(att, "pallas_wanted", lambda: True)
+    monkeypatch.setattr(att, "pallas_shape_ok", lambda sq, sk: True)
+    sharding.sp_attention(qs, ks, vs, mesh)
+    assert calls == ["ring", "ulysses"]
+    # Flash wanted but the shape gate rejects: back to ring.
+    monkeypatch.setattr(att, "pallas_shape_ok", lambda sq, sk: False)
+    sharding.sp_attention(qs, ks, vs, mesh)
+    assert calls == ["ring", "ulysses", "ring"]
+    # Explicit override beats the heuristic.
+    sharding.sp_attention(qs, ks, vs, mesh, sp_mode="ulysses")
+    assert calls[-1] == "ulysses"
+    with pytest.raises(ValueError, match="sp_mode"):
+        sharding.sp_attention(qs, ks, vs, mesh, sp_mode="rign")
