@@ -35,6 +35,11 @@ def main() -> None:
     # Validate the blocks mha would actually dispatch for this shape (the
     # production path fits the configured limits to the sequence).
     BQ, BK = A.fit_block(A.BLOCK_Q, S, 8), A.fit_block(A.BLOCK_K, S, 128)
+    if not (BQ and BK):
+        print(json.dumps({**result, "error":
+            f"no valid blocks for S={S} under limits "
+            f"({A.BLOCK_Q}, {A.BLOCK_K})"}))
+        sys.exit(1)
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
     k = jax.random.normal(ks[1], (B, S, Hkv, D), jnp.bfloat16)

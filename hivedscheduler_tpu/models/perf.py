@@ -92,7 +92,7 @@ def bench_config(on_tpu: bool):
             dtype=jnp.bfloat16,
             remat=True,
             # "flash" (pin the flash kernel residuals, remat the rest)
-            # measured 1.25x over full remat on-chip; see doc/perf.md.
+            # measured 1.24x over full remat on-chip; see doc/perf.md.
             remat_policy=os.environ.get("HIVED_PERF_REMAT", "flash"),
         ), batch, seq
     return transformer.TransformerConfig(
