@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from ..ops.attention import mha
-from ..parallel import sharding
+from ..parallel import pipeline, sharding
 
 Params = Dict[str, Any]
 
@@ -63,6 +63,9 @@ class TransformerConfig:
     # legal AND the flash kernels will run locally (lower traffic), ring
     # attention otherwise; "ring"/"ulysses" force one.
     sp_mode: str = "auto"
+    # GPipe microbatch count when the mesh has pp > 1 (parallel/pipeline.py);
+    # None = min(batch, 2*pp). The bubble is (pp-1)/(M+pp-1) of step time.
+    pp_microbatches: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -259,7 +262,14 @@ def forward_hidden(
     block = lambda x, layer: (_block(x, layer, c, mesh, use_sp), None)
     if c.remat:
         block = jax.checkpoint(block, policy=_remat_policy(c.remat_policy))
-    x, _ = jax.lax.scan(block, x, params["layers"])
+    if mesh is not None and sharding.axes_size("pp", mesh) > 1:
+        # Layer stack sharded over pp stages: GPipe microbatch pipeline
+        # (same per-microbatch computation, pipelined schedule).
+        x = pipeline.pipeline_blocks(
+            params["layers"], x, mesh, block, c.pp_microbatches
+        )
+    else:
+        x, _ = jax.lax.scan(block, x, params["layers"])
 
     x = rms_norm(x, params["ln_f"])
     head = params["embed"].T if c.tied_embeddings else params["lm_head"]

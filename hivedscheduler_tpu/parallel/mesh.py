@@ -16,6 +16,10 @@ Axis conventions used across models/:
               attention; parallel/sharding.sp_attention picks) — ICI.
   - ``tp``:   tensor parallelism (megatron-style) — innermost, ICI-adjacent.
   - ``ep``:   expert parallelism for MoE models (aliases fsdp capacity).
+  - ``pp``:   pipeline parallelism (GPipe microbatching over layer stages;
+              parallel/pipeline.py) — outermost after dp: stage hops move
+              one activation per tick, the lightest traffic, so they can
+              ride DCN.
 """
 
 from __future__ import annotations
@@ -28,7 +32,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-MESH_AXES = ("dp", "fsdp", "ep", "sp", "tp")
+MESH_AXES = ("dp", "pp", "fsdp", "ep", "sp", "tp")
 
 
 def initialize_from_env(env: Optional[Dict[str, str]] = None) -> None:
@@ -58,6 +62,7 @@ class MeshConfig:
     across layouts."""
 
     dp: int = 1
+    pp: int = 1
     fsdp: int = 1
     ep: int = 1
     sp: int = 1
@@ -65,7 +70,7 @@ class MeshConfig:
 
     @property
     def axis_sizes(self) -> Tuple[int, ...]:
-        return (self.dp, self.fsdp, self.ep, self.sp, self.tp)
+        return (self.dp, self.pp, self.fsdp, self.ep, self.sp, self.tp)
 
     def total(self) -> int:
         return int(np.prod(self.axis_sizes))
@@ -77,7 +82,7 @@ def make_mesh(
 ) -> Mesh:
     """Build the named device mesh.
 
-    Axis order (dp, fsdp, ep, sp, tp) places tp on the most-adjacent devices
+    Axis order (dp, pp, fsdp, ep, sp, tp) places tp on the most-adjacent devices
     (fastest-varying => nearest in the ICI torus for TPU slices, since
     jax device order follows the torus), dp on the least — collectives that
     move the most bytes per step ride the shortest links.
@@ -103,15 +108,18 @@ def infer_mesh_config(
     tp: int = 1,
     sp: int = 1,
     ep: int = 1,
+    pp: int = 1,
     fsdp: Optional[int] = None,
 ) -> MeshConfig:
     """Fill the leftover factor into fsdp (or dp when fsdp is pinned)."""
-    inner = tp * sp * ep
+    inner = tp * sp * ep * pp
     if n_devices % inner != 0:
-        raise ValueError(f"{n_devices} devices not divisible by tp*sp*ep={inner}")
+        raise ValueError(
+            f"{n_devices} devices not divisible by tp*sp*ep*pp={inner}"
+        )
     rest = n_devices // inner
     if fsdp is None:
-        return MeshConfig(dp=1, fsdp=rest, ep=ep, sp=sp, tp=tp)
+        return MeshConfig(dp=1, pp=pp, fsdp=rest, ep=ep, sp=sp, tp=tp)
     if rest % fsdp != 0:
         raise ValueError(f"residual {rest} not divisible by fsdp={fsdp}")
-    return MeshConfig(dp=rest // fsdp, fsdp=fsdp, ep=ep, sp=sp, tp=tp)
+    return MeshConfig(dp=rest // fsdp, pp=pp, fsdp=fsdp, ep=ep, sp=sp, tp=tp)

@@ -1,0 +1,114 @@
+"""Pipeline parallelism: GPipe microbatching over a ``pp`` mesh axis.
+
+The workload-level half of the reference's pipeline story: at the scheduler
+level PP is a heterogeneous-member gang (SURVEY.md §2.2, reference test
+`pkg/algorithm/hived_algorithm_test.go:93-95`); here the placed workload
+actually splits the layer stack across stages. TPU-first formulation:
+
+  - The stacked layer params ``[L, ...]`` shard their leading dim over
+    ``pp`` (logical axis name "layers" in parallel/sharding.DEFAULT_RULES),
+    so each stage holds L/P contiguous layers — the memory win that lets a
+    model deeper than one slice's HBM train at all.
+  - One ``shard_map`` manual over ONLY the pp axis (``axis_names={"pp"}``);
+    dp/fsdp/sp/tp stay auto, so the per-stage computation keeps its GSPMD
+    shardings and collectives — pipeline composes with every other axis.
+  - The schedule is a ``lax.scan`` over M + P - 1 ticks. Each tick: every
+    stage ppermutes its activation to the next stage, stage 0 injects the
+    next microbatch, every stage applies its local layers (a nested scan).
+    Static shapes, no data-dependent control flow — one XLA program.
+  - Backward is just ``jax.grad`` through the scan: ppermute transposes to
+    the reverse rotation, giving the symmetric reverse schedule. Remat
+    composes per-block exactly as in the unpipelined path.
+
+The GPipe bubble is (P-1)/(M+P-1) of each stage's time; raise
+``n_microbatches`` to amortize it (at B/M >= 1 per microbatch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .sharding import axes_size
+
+BlockFn = Callable[[jax.Array, Any], tuple]
+
+
+def pipeline_blocks(
+    layers: Any,  # pytree of [L, ...] stacked layer params
+    x: jax.Array,  # [B, S, D] activations entering the layer stack
+    mesh: Mesh,
+    block_fn: BlockFn,  # (x, layer) -> (x, _), the lax.scan body
+    n_microbatches: Optional[int] = None,
+    axis: str = "pp",
+) -> jax.Array:
+    """Apply all L stacked layers to x, pipelined over the ``axis`` stages.
+
+    Drop-in replacement for ``x, _ = lax.scan(block_fn, x, layers)`` when
+    the mesh has pp > 1 (falls back to exactly that when pp == 1). The
+    result is bitwise the same computation per microbatch; only the
+    schedule differs.
+    """
+    p = axes_size(axis, mesh)
+    if p <= 1:
+        out, _ = jax.lax.scan(block_fn, x, layers)
+        return out
+
+    n_layers = jax.tree.leaves(layers)[0].shape[0]
+    if n_layers % p != 0:
+        raise ValueError(f"n_layers={n_layers} not divisible by pp={p}")
+    b = x.shape[0]
+    if n_microbatches is not None:
+        m = n_microbatches
+        if b % m != 0:
+            raise ValueError(f"batch={b} not divisible by n_microbatches={m}")
+    else:
+        # Largest divisor of b not exceeding 2*p: deepest legal pipeline
+        # fill without rejecting awkward batch sizes (worst case m=1, which
+        # degenerates to sequential stages but stays correct).
+        m = max(d for d in range(1, min(b, 2 * p) + 1) if b % d == 0)
+
+    def stage_apply(stage_layers, h):
+        out, _ = jax.lax.scan(block_fn, h, stage_layers)
+        return out
+
+    def local(stage_layers, x_full):
+        # stage_layers: this stage's [L/P, ...] slice; x_full: the whole
+        # [B, S, D] batch (replicated over pp; still sharded over the auto
+        # axes). Only stage 0 reads it, only stage P-1's outputs survive.
+        s_idx = jax.lax.axis_index(axis)
+        mb = x_full.reshape(m, b // m, *x_full.shape[1:])
+        fwd = [(i, i + 1) for i in range(p - 1)]
+
+        def tick(state, t):
+            recv = jax.lax.ppermute(state, axis, fwd)
+            inject = jax.lax.dynamic_index_in_dim(
+                mb, jnp.minimum(t, m - 1), keepdims=False
+            )
+            cur = jnp.where(s_idx == 0, inject, recv)
+            out = stage_apply(stage_layers, cur)
+            return out, out
+
+        # The carry is varying over pp (each stage holds a different
+        # activation); the zeros init must carry that type too (shard_map
+        # scan vma typing).
+        init = jax.lax.pcast(jnp.zeros_like(mb[0]), (axis,), to="varying")
+        _, ys = jax.lax.scan(tick, init, jnp.arange(m + p - 1))
+        # Microbatch i exits the last stage at tick i + p - 1; every other
+        # stage's ys rows are bubble garbage. Mask + psum broadcasts the
+        # last stage's rows to all pp ranks without an all_gather's x P
+        # memory spike.
+        outs = jnp.where(s_idx == p - 1, ys[p - 1 :], 0)
+        outs = jax.lax.psum(outs, axis)
+        return outs.reshape(x_full.shape)
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        axis_names={axis},
+    )(layers, x)

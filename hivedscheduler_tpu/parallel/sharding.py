@@ -33,7 +33,10 @@ DEFAULT_RULES: Dict[str, Any] = {
     "mlp": "tp",
     "vocab": "tp",
     "expert": "ep",
-    "layers": None,
+    # Stacked-layer leading dim shards over pp (pipeline stages). All
+    # meshes carry a pp axis (size 1 without pipelining — MeshConfig keeps
+    # every axis), so this is replication unless pp > 1.
+    "layers": "pp",
 }
 
 # Sequence-parallel backends accepted by sp_attention and the model

@@ -131,7 +131,9 @@ def test_dryrun_multichip_8():
 
 def test_mesh_config_inference():
     cfg = pmesh.infer_mesh_config(8, tp=2, sp=2)
-    assert cfg.axis_sizes == (1, 2, 1, 2, 2)
+    assert cfg.axis_sizes == (1, 1, 2, 1, 2, 2)  # (dp, pp, fsdp, ep, sp, tp)
+    cfg = pmesh.infer_mesh_config(8, tp=2, pp=2)
+    assert cfg.axis_sizes == (1, 2, 2, 1, 1, 2)
     with pytest.raises(ValueError):
         pmesh.infer_mesh_config(8, tp=3)
 
@@ -334,3 +336,126 @@ def test_sp_attention_auto_is_pallas_aware(monkeypatch):
     assert calls[-1] == "ulysses"
     with pytest.raises(ValueError, match="sp_mode"):
         sharding.sp_attention(qs, ks, vs, mesh, sp_mode="rign")
+
+
+# --------------------------------------------------------------------------
+# Pipeline parallelism (parallel/pipeline.py)
+
+
+def _mlp_stack(L=8, D=32):
+    layers = {
+        "w": jax.random.normal(jax.random.PRNGKey(0), (L, D, D)) * 0.1,
+        "b": jax.random.normal(jax.random.PRNGKey(1), (L, D)) * 0.1,
+    }
+
+    def block(h, layer):
+        return jnp.tanh(h @ layer["w"] + layer["b"]), None
+
+    return layers, block
+
+
+@pytest.mark.parametrize("pp,m", [(2, 2), (4, 4), (4, 2)])
+def test_pipeline_blocks_matches_scan(pp, m):
+    from hivedscheduler_tpu.parallel import pipeline
+
+    mesh = pmesh.make_mesh(
+        pmesh.MeshConfig(pp=pp, fsdp=8 // pp), devices=jax.devices()
+    )
+    layers, block = _mlp_stack()
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 16, 32))
+    ref, _ = jax.lax.scan(block, x, layers)
+    with jax.set_mesh(mesh):
+        out = jax.jit(
+            lambda l, x: pipeline.pipeline_blocks(
+                l, x, mesh, block, n_microbatches=m
+            )
+        )(layers, x)
+    assert float(jnp.abs(ref - out).max()) < 1e-5
+
+
+def test_pipeline_blocks_gradients_match_scan():
+    from hivedscheduler_tpu.parallel import pipeline
+
+    mesh = pmesh.make_mesh(pmesh.MeshConfig(pp=4, fsdp=2), devices=jax.devices())
+    layers, block = _mlp_stack()
+    x = jax.random.normal(jax.random.PRNGKey(2), (4, 16, 32))
+
+    def loss_ref(l, x):
+        y, _ = jax.lax.scan(block, x, l)
+        return jnp.sum(y**2)
+
+    def loss_pp(l, x):
+        return jnp.sum(
+            pipeline.pipeline_blocks(l, x, mesh, block, n_microbatches=2) ** 2
+        )
+
+    gr = jax.grad(loss_ref)(layers, x)
+    with jax.set_mesh(mesh):
+        gp = jax.jit(jax.grad(loss_pp))(layers, x)
+    for k in gr:
+        rel = float(
+            jnp.abs(gr[k] - gp[k]).max() / (jnp.abs(gr[k]).max() + 1e-9)
+        )
+        assert rel < 1e-5, k
+
+
+def test_pipeline_blocks_divisibility_errors():
+    from hivedscheduler_tpu.parallel import pipeline
+
+    mesh = pmesh.make_mesh(pmesh.MeshConfig(pp=4, fsdp=2), devices=jax.devices())
+    layers, block = _mlp_stack(L=6)  # 6 % 4 != 0
+    x = jnp.zeros((4, 16, 32))
+    with pytest.raises(ValueError, match="n_layers"):
+        pipeline.pipeline_blocks(layers, x, mesh, block)
+    layers, block = _mlp_stack(L=8)
+    with pytest.raises(ValueError, match="n_microbatches"):
+        pipeline.pipeline_blocks(layers, x, mesh, block, n_microbatches=3)
+
+
+def test_transformer_pp_matches_single_device(tiny_config, tiny_params):
+    """Full transformer under a pp x fsdp x tp mesh (layers sharded over
+    stages, GPipe schedule) must match the single-device forward, and the
+    full sharded train step must run."""
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(3), (4, 64), 0, tiny_config.vocab_size
+    )
+    ref = transformer.forward(tiny_params, tokens, tiny_config)
+    mesh = pmesh.make_mesh(
+        pmesh.MeshConfig(pp=2, fsdp=2, tp=2), devices=jax.devices()
+    )
+    sh = sharding.tree_shardings(mesh, transformer.logical_axes(tiny_config))
+    sp = jax.device_put(tiny_params, sh)
+    with jax.set_mesh(mesh):
+        out = jax.jit(
+            lambda p, t: transformer.forward(p, t, tiny_config, mesh=mesh)
+        )(sp, tokens)
+    assert (
+        float(np.abs(np.array(ref) - np.array(jax.device_get(out))).max())
+        < 2e-4
+    )
+
+    optimizer = train.make_optimizer()
+    with jax.set_mesh(mesh):
+        p2, o2, psh, osh = train.init_sharded(
+            tiny_config, mesh, jax.random.PRNGKey(0), optimizer
+        )
+        step = train.make_train_step(tiny_config, mesh, optimizer, psh, osh)
+        tok = sharding.shard_batch(jnp.zeros((4, 64), dtype=jnp.int32), mesh)
+        p2, o2, loss = step(p2, o2, tok)
+    assert jnp.isfinite(jax.device_get(loss))
+
+
+def test_pipeline_default_microbatches_fits_awkward_batches():
+    """The default microbatch count must adapt to the batch (largest
+    divisor <= 2*pp), not reject batches that are not multiples of 2*pp."""
+    from hivedscheduler_tpu.parallel import pipeline
+
+    mesh = pmesh.make_mesh(pmesh.MeshConfig(pp=2, fsdp=4), devices=jax.devices())
+    layers, block = _mlp_stack(L=4)
+    x = jax.random.normal(jax.random.PRNGKey(2), (6, 16, 32))  # 6 % 4 != 0
+    ref, _ = jax.lax.scan(block, x, layers)
+    with jax.set_mesh(mesh):
+        out = jax.jit(
+            lambda l, x: pipeline.pipeline_blocks(l, x, mesh, block)
+        )(layers, x)  # default m -> 3
+    assert float(jnp.abs(ref - out).max()) < 1e-5
