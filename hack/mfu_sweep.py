@@ -53,6 +53,12 @@ def main() -> None:
         # (falling back to the module's own shipped defaults, not a copy).
         att.BLOCK_Q = int(cfg.get("HIVED_FLASH_BLOCK_Q", att.DEFAULT_BLOCK_Q))
         att.BLOCK_K = int(cfg.get("HIVED_FLASH_BLOCK_K", att.DEFAULT_BLOCK_K))
+        att.BLOCK_Q_BWD = int(
+            cfg.get("HIVED_FLASH_BLOCK_Q_BWD", att.DEFAULT_BLOCK_Q_BWD)
+        )
+        att.BLOCK_K_BWD = int(
+            cfg.get("HIVED_FLASH_BLOCK_K_BWD", att.DEFAULT_BLOCK_K_BWD)
+        )
         try:
             r = perf.bench_train_step(on_tpu=True)
             r["config"] = cfg

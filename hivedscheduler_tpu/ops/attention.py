@@ -43,6 +43,21 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 1024
 BLOCK_Q = int(os.environ.get("HIVED_FLASH_BLOCK_Q", str(DEFAULT_BLOCK_Q)))
 BLOCK_K = int(os.environ.get("HIVED_FLASH_BLOCK_K", str(DEFAULT_BLOCK_K)))
+# The backward kernels are tunable separately, but the shipped defaults
+# stay uniform with the forward: an isolated fwd+bwd microbench preferred
+# square 512x512 backward tiles, yet the full train step measured best
+# with 512x1024 everywhere (0.541 MFU vs 0.523 at bwd 512x512) — block
+# choices interact with the surrounding step (fusion, scheduling, HBM
+# pressure), so the full train step, not an isolated microbench, is the
+# ground truth for defaults.
+DEFAULT_BLOCK_Q_BWD = DEFAULT_BLOCK_Q
+DEFAULT_BLOCK_K_BWD = DEFAULT_BLOCK_K
+BLOCK_Q_BWD = int(
+    os.environ.get("HIVED_FLASH_BLOCK_Q_BWD", str(DEFAULT_BLOCK_Q_BWD))
+)
+BLOCK_K_BWD = int(
+    os.environ.get("HIVED_FLASH_BLOCK_K_BWD", str(DEFAULT_BLOCK_K_BWD))
+)
 
 # Interpreter mode for pallas kernels (CPU tests); real TPU runs leave False.
 INTERPRET = False
@@ -432,7 +447,7 @@ def _flash_bwd_bh(qt, kt, vt, ot, do, lse, causal, scale, block_q, block_k):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
 )
 def flash_attention_tpu(
     q: jax.Array,  # [B, S, H, D]
@@ -442,8 +457,12 @@ def flash_attention_tpu(
     sm_scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    block_q_bwd: Optional[int] = None,  # None: same as block_q
+    block_k_bwd: Optional[int] = None,  # None: same as block_k
 ) -> jax.Array:
-    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    out, _ = _flash_fwd(
+        q, k, v, causal, sm_scale, block_q, block_k, block_q_bwd, block_k_bwd
+    )
     return out
 
 
@@ -465,7 +484,8 @@ def _prep(q, k, v, block_q, block_k, sm_scale):
     return to_bh(q), to_bh(k), to_bh(v), scale, block_q, block_k, groups
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k,
+               block_q_bwd=None, block_k_bwd=None):
     b, s, h, d = q.shape
     qt, kt, vt, scale, bq, bk, groups = _prep(q, k, v, block_q, block_k,
                                               sm_scale)
@@ -486,12 +506,17 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
     return out, (q, k, v, ot, lse)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, residuals, g):
+def _flash_bwd(causal, sm_scale, block_q, block_k, block_q_bwd, block_k_bwd,
+               residuals, g):
     q, k, v, ot, lse = residuals
     b, s, h, d = q.shape
     hkv = k.shape[2]
-    qt, kt, vt, scale, bq, bk, groups = _prep(q, k, v, block_q, block_k,
-                                              sm_scale)
+    qt, kt, vt, scale, bq, bk, groups = _prep(
+        q, k, v,
+        block_q if block_q_bwd is None else block_q_bwd,
+        block_k if block_k_bwd is None else block_k_bwd,
+        sm_scale,
+    )
     do = g.transpose(0, 2, 1, 3).reshape(b * h, s, d)
     dq, dk, dv = _flash_bwd_bh(qt, kt, vt, ot, do, lse, causal, scale, bq, bk)
 
@@ -531,6 +556,7 @@ def mha(
         return flash_attention_tpu(
             q, k, v, causal, sm_scale,
             fit_block(BLOCK_Q, s, 8), fit_block(BLOCK_K, s, 128),
+            fit_block(BLOCK_Q_BWD, s, 8), fit_block(BLOCK_K_BWD, s, 128),
         )
     return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
 
@@ -573,4 +599,6 @@ def pallas_shape_ok(sq: int, sk: int) -> bool:
         and sq == sk
         and fit_block(BLOCK_Q, sq, 8) > 0
         and fit_block(BLOCK_K, sq, 128) > 0
+        and fit_block(BLOCK_Q_BWD, sq, 8) > 0
+        and fit_block(BLOCK_K_BWD, sq, 128) > 0
     )

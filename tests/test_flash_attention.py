@@ -193,6 +193,8 @@ def test_shape_gate_and_block_fitting(monkeypatch):
     assert A.fit_block(512, 256, 128) == 256
     monkeypatch.setattr(A, "BLOCK_Q", 512)
     monkeypatch.setattr(A, "BLOCK_K", 1024)
+    monkeypatch.setattr(A, "BLOCK_Q_BWD", 512)
+    monkeypatch.setattr(A, "BLOCK_K_BWD", 1024)
     assert not A.pallas_shape_ok(300, 300)   # no tile-aligned block exists
     assert A.pallas_shape_ok(768, 768)       # runs with fitted 384/768
     assert A.pallas_shape_ok(1536, 1536)
@@ -210,3 +212,32 @@ def test_mfu_guard_rejects_impossible_numbers():
     bad = perf.mfu_fields(2.2e9, 8.75e7, "TPU v5 lite")  # 87.5M tok/s "measured"
     assert bad["mfu"] is None and bad["mfu_rejected"] > 1
     assert perf.mfu_fields(2.2e9, 1.0, "unknown-device") == {}
+
+
+def test_flash_split_bwd_blocks_match_reference():
+    """Distinct backward block shapes (independent of the forward's)
+    must not change gradients — only the backward kernels' tiling."""
+    q, k, v = make_qkv(s=256)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(A.mha_reference(q, k, v, causal=True) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            A.flash_attention_tpu(q, k, v, True, None, 64, 256, 128, 64) ** 2
+        )
+
+    out_err = float(
+        jnp.max(
+            jnp.abs(
+                A.mha_reference(q, k, v)
+                - A.flash_attention_tpu(q, k, v, True, None, 64, 256, 128, 64)
+            )
+        )
+    )
+    assert out_err < 2e-5
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        scale = float(jnp.max(jnp.abs(a))) + 1e-6
+        assert float(jnp.max(jnp.abs(a - b))) / scale < 1e-4
