@@ -22,6 +22,11 @@ actually splits the layer stack across stages. TPU-first formulation:
 
 The GPipe bubble is (P-1)/(M+P-1) of each stage's time; raise
 ``n_microbatches`` to amortize it (at B/M >= 1 per microbatch).
+
+Scope: blocks whose scan body returns (x, None) — the dense transformer.
+MoE blocks scale their router statistics (capacity, load-balancing aux)
+with the visible batch, so microbatching them changes those semantics;
+MoE models parallelize over ``ep`` instead (models/mixtral.py).
 """
 
 from __future__ import annotations
