@@ -125,11 +125,23 @@ def main() -> None:
     # var and common.bootstrap_distributed lifts it (example manifest).
     env["HIVED_TPU_ENV"] = bound.annotations[constants.ANNOTATION_POD_TPU_ENV]
     env["TRAIN_STEPS"] = str(args.steps)
-    env["PYTHONPATH"] = str(REPO)
     if args.cpu_smoke:
+        # Hermetic: REPLACE PYTHONPATH so the host's PJRT-plugin
+        # sitecustomize (e.g. the axon tunnel's) never loads — its factory
+        # initializes even under JAX_PLATFORMS=cpu and hangs forever on a
+        # dead tunnel (same hazard tests/conftest.py documents).
+        env["PYTHONPATH"] = str(REPO)
         env["JAX_PLATFORMS"] = "cpu"
         env["TRAIN_BATCH"] = "2"
         env["TRAIN_IMAGE_SIZE"] = "64"
+    else:
+        # On-device: PREPEND — the host PYTHONPATH carries the plugin
+        # registration the child needs; dropping it leaves JAX_PLATFORMS
+        # pointing at a backend the child can no longer register.
+        env["PYTHONPATH"] = os.pathsep.join(
+            [str(REPO)]
+            + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+        )
     print(f"[e2e] launching train_resnet.py (steps={args.steps})", flush=True)
     rc = subprocess.run(
         [sys.executable, str(REPO / "example/workloads/train_resnet.py")],
