@@ -36,6 +36,10 @@ common.init_logging(logging.ERROR)
 
 TARGET_P50_MS = 10.0
 
+# Breadcrumb attached to any skipped model_perf stage: where the last
+# complete on-chip measurements live.
+LAST_RECORDED_RUN = "example/logs/perf_tpu_round4.md"
+
 
 def build_config() -> Config:
     cell_types = {}
@@ -267,9 +271,15 @@ def model_perf() -> dict:
             cwd=here,
         )
     except subprocess.TimeoutExpired:
-        return {"skipped": "backend probe timed out (TPU tunnel dead?)"}
+        return {
+            "skipped": "backend probe timed out (TPU tunnel dead?)",
+            "last_recorded_run": LAST_RECORDED_RUN,
+        }
     if probe.returncode != 0:
-        return {"skipped": f"backend probe rc={probe.returncode}"}
+        return {
+            "skipped": f"backend probe rc={probe.returncode}",
+            "last_recorded_run": LAST_RECORDED_RUN,
+        }
     def attempt(extra_env: dict) -> dict:
         try:
             proc = subprocess.run(
@@ -284,13 +294,22 @@ def model_perf() -> dict:
                 env={**os.environ, **extra_env},
             )
         except subprocess.TimeoutExpired:
-            return {"skipped": "model perf timed out"}
+            return {
+                "skipped": "model perf timed out",
+                "last_recorded_run": LAST_RECORDED_RUN,
+            }
         if proc.returncode != 0:
-            return {"skipped": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+            return {
+                "skipped": f"rc={proc.returncode}: {proc.stderr[-300:]}",
+                "last_recorded_run": LAST_RECORDED_RUN,
+            }
         try:
             return json.loads(proc.stdout.strip().splitlines()[-1])
         except (json.JSONDecodeError, IndexError):
-            return {"skipped": f"unparseable output: {proc.stdout[-200:]}"}
+            return {
+                "skipped": f"unparseable output: {proc.stdout[-200:]}",
+                "last_recorded_run": LAST_RECORDED_RUN,
+            }
 
     result = attempt({})
     if (
