@@ -252,6 +252,19 @@ def forward_hidden(
     c = config
     sharding.validate_sp_mode(c.sp_mode)
     use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
+    if use_sp and mesh.shape.get("pp", 1) > 1:
+        # The SP backends are full shard_maps and the pipeline is a manual
+        # region; this JAX's partitioner (Shardy) rejects nested manual
+        # computations over an already-bound axis, and the partial-manual
+        # workaround lowers unreliably (verified: forward sometimes lowers,
+        # backward mistypes cotangent varying-axes). Refuse clearly rather
+        # than crash mid-trace; shard long sequences with sp x fsdp x tp,
+        # or pipeline with pp x fsdp x tp.
+        raise NotImplementedError(
+            "pp > 1 with sp > 1 is not supported: sequence-parallel "
+            "attention cannot nest inside the pipeline's manual region "
+            f"(mesh={dict(mesh.shape)})"
+        )
     # Mixed precision: f32 master params -> bf16 compute copies.
     params = jax.tree.map(lambda a: a.astype(c.dtype), params)
     # Vocab-parallel lookup when possible: a plain gather on a tp-sharded

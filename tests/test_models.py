@@ -459,3 +459,16 @@ def test_pipeline_default_microbatches_fits_awkward_batches():
             lambda l, x: pipeline.pipeline_blocks(l, x, mesh, block)
         )(layers, x)  # default m -> 3
     assert float(jnp.abs(ref - out).max()) < 1e-5
+
+
+def test_pp_with_sp_is_rejected_clearly(tiny_config, tiny_params):
+    """pp + sp would nest a full shard_map inside the pipeline's manual
+    region, which the partitioner rejects (unreliably, sometimes only in
+    backward); the model must refuse up front with an actionable error."""
+    mesh = pmesh.make_mesh(
+        pmesh.MeshConfig(pp=2, sp=2, tp=2), devices=jax.devices()
+    )
+    with pytest.raises(NotImplementedError, match="pp > 1 with sp > 1"):
+        transformer.forward(
+            tiny_params, jnp.zeros((2, 64), jnp.int32), tiny_config, mesh=mesh
+        )
