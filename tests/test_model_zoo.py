@@ -175,3 +175,37 @@ def test_mixtral_train_decreases_loss():
     for _ in range(5):
         params, opt_state, loss = step(params, opt_state, tokens)
     assert float(loss) < float(loss0)
+
+
+def test_mixtral_sp_mesh_matches_single_device():
+    """Mixtral routes its attention through sharding.sp_attention when the
+    mesh has sp > 1; the sharded forward (sp x ep) must match the
+    single-device logits and aux loss for every sp_mode."""
+    import dataclasses
+
+    config = mixtral.tiny()
+    params = mixtral.init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(
+        jax.random.PRNGKey(1), (2, 64), 0, config.vocab_size
+    )
+    ref_logits, ref_aux = mixtral.forward(params, tokens, config)
+
+    mesh = pmesh.make_mesh(
+        pmesh.MeshConfig(sp=2, ep=2, fsdp=2), devices=jax.devices()
+    )
+    sh = sharding.tree_shardings(mesh, mixtral.logical_axes(config))
+    sp_params = jax.device_put(params, sh)
+    for mode in ("auto", "ring", "ulysses"):
+        c = dataclasses.replace(config, sp_mode=mode)
+        with jax.set_mesh(mesh):
+            logits, aux = jax.jit(
+                lambda p, t: mixtral.forward(p, t, c, mesh=mesh)
+            )(sp_params, tokens)
+        np.testing.assert_allclose(
+            np.array(ref_logits), np.array(jax.device_get(logits)),
+            atol=5e-4, rtol=5e-3, err_msg=mode,
+        )
+        np.testing.assert_allclose(
+            float(ref_aux), float(jax.device_get(aux)), rtol=1e-4,
+            err_msg=mode,
+        )

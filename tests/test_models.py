@@ -472,3 +472,34 @@ def test_pp_with_sp_is_rejected_clearly(tiny_config, tiny_params):
         transformer.forward(
             tiny_params, jnp.zeros((2, 64), jnp.int32), tiny_config, mesh=mesh
         )
+
+
+def test_pipeline_property_sweep():
+    """Property check across (pp, microbatches, depth, batch) combos:
+    the pipelined stack always equals the plain scan."""
+    from hivedscheduler_tpu.parallel import pipeline
+
+    rng = 0
+    for pp, m, L, B in [
+        (2, None, 2, 2),
+        (2, 1, 4, 3),     # m=1: degenerate sequential pipeline
+        (4, 8, 4, 8),     # more microbatches than stages
+        (8, 2, 8, 6),     # whole mesh is pipeline
+        (4, None, 8, 5),  # default m adapts to awkward batch (m=5)
+    ]:
+        fsdp = 8 // pp
+        mesh = pmesh.make_mesh(
+            pmesh.MeshConfig(pp=pp, fsdp=fsdp), devices=jax.devices()
+        )
+        layers, block = _mlp_stack(L=L)
+        rng += 1
+        x = jax.random.normal(jax.random.PRNGKey(rng), (B, 8, 32))
+        ref, _ = jax.lax.scan(block, x, layers)
+        with jax.set_mesh(mesh):
+            out = jax.jit(
+                lambda l, x: pipeline.pipeline_blocks(
+                    l, x, mesh, block, n_microbatches=m
+                )
+            )(layers, x)
+        err = float(jnp.abs(ref - out).max())
+        assert err < 1e-5, (pp, m, L, B, err)
