@@ -1,0 +1,80 @@
+"""Pipeline-parallel pretraining: layer stages across TWO v5p-16 slices.
+
+Demonstrates the one parallelism whose traffic tolerates DCN: pipeline
+stage hops move a single microbatch activation per tick, so the two
+affinity-group members can be *separate* cells — the scheduler guarantees
+each member one contiguous v5p-16 (fsdp x tp ride that slice's ICI) while
+pp crosses between them. Contrast train_longctx.py, whose ring attention
+must stay inside one slice.
+
+Mesh: pp=2 (one stage per slice) x fsdp x tp within each slice.
+"""
+
+import argparse
+
+import jax
+
+from common import bootstrap_distributed, synthetic_tokens
+from hivedscheduler_tpu.models import train, transformer
+from hivedscheduler_tpu.parallel import mesh as pmesh, sharding
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--seq", type=int, default=4096)
+    parser.add_argument(
+        "--model", choices=["llama8b", "tiny"], default="llama8b",
+        help="tiny = smoke-test shapes (CPU virtual mesh)",
+    )
+    parser.add_argument("--microbatches", type=int, default=None)
+    args = parser.parse_args()
+
+    bootstrap_distributed()
+    n = len(jax.devices())
+    base = (
+        transformer.llama3_8b() if args.model == "llama8b"
+        else transformer.tiny()
+    )
+    if n % 2 != 0:
+        raise SystemExit(f"pipeline demo needs an even device count, got {n}")
+    pp = 2
+    # tp must divide the KV heads (whole GQA groups per shard); the rest
+    # of each stage's slice is fsdp.
+    tp = next(
+        t for t in (4, 2, 1)
+        if (n // pp) % t == 0 and base.n_kv_heads % t == 0
+    )
+    fsdp = n // (pp * tp)
+    config = type(base)(**{
+        **base.__dict__,
+        "max_seq_len": args.seq,
+        "pp_microbatches": args.microbatches,
+    })
+    if config.n_layers % pp != 0:
+        raise SystemExit(
+            f"pp={pp} stages must divide n_layers={config.n_layers}"
+        )
+
+    mesh = pmesh.make_mesh(pmesh.MeshConfig(pp=pp, fsdp=fsdp, tp=tp))
+    print(f"mesh: {dict(mesh.shape)}", flush=True)
+    optimizer = train.make_optimizer()
+    with jax.set_mesh(mesh):
+        params, opt_state, param_sh, opt_sh = train.init_sharded(
+            config, mesh, jax.random.PRNGKey(0), optimizer
+        )
+        step = train.make_train_step(config, mesh, optimizer, param_sh, opt_sh)
+        key = jax.random.PRNGKey(1)
+        for i in range(args.steps):
+            key, k = jax.random.split(key)
+            tokens = sharding.shard_batch(
+                synthetic_tokens(k, args.batch, args.seq, config.vocab_size),
+                mesh,
+            )
+            params, opt_state, loss = step(params, opt_state, tokens)
+            print(f"step {i} loss {float(loss):.4f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
