@@ -101,3 +101,125 @@ def test_concurrent_filter_bind_delete_node_flap():
         for cell in ccl[ccl.top_level]:
             assert cell.state.value in ("Free",), (chain, cell.address,
                                                     cell.state)
+
+
+def test_concurrent_inspect_and_preempt_during_churn():
+    """Readers (the inspect REST surface) and the preempt verb race
+    scheduling churn: status DTO construction walks live cell trees, and
+    preemption commits/cancels reservations — none of it may crash or
+    observe a torn view (e.g. a group in the listing whose detail lookup
+    then explodes)."""
+    import json
+
+    sched = HivedScheduler(tpu_design_config(), kube_client=NullKubeClient())
+    nodes = sorted(
+        {
+            n
+            for ccl in sched.core.full_cell_list.values()
+            for c in ccl[ccl.top_level]
+            for n in c.nodes
+        }
+    )
+    for n in nodes:
+        sched.add_node(Node(name=n))
+
+    errors = []
+    stop = threading.Event()
+
+    def churn(worker_id: int):
+        rng = random.Random(worker_id)
+        try:
+            for i in range(25):
+                uid = f"c{worker_id}-{i}"
+                pod = make_pod(uid, uid, rng.choice(["VC1", "VC2"]),
+                               rng.choice([-1, 0, 5]), "v5e-chip", 2)
+                sched.add_pod(pod)
+                r = sched.filter_routine(
+                    ei.ExtenderArgs(pod=pod, node_names=nodes)
+                )
+                if r.node_names and rng.random() < 0.6:
+                    status = sched.pod_schedule_statuses.get(uid)
+                    if status is not None:
+                        sched.delete_pod(status.pod)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def preemptor(worker_id: int):
+        rng = random.Random(1000 + worker_id)
+        try:
+            for i in range(15):
+                uid = f"p{worker_id}-{i}"
+                pod = make_pod(uid, uid, "VC1", 90, "v5e-chip", 4)
+                sched.add_pod(pod)
+                sched.preempt_routine(
+                    ei.ExtenderPreemptionArgs(
+                        pod=pod,
+                        node_name_to_meta_victims={
+                            n: ei.MetaVictims() for n in nodes
+                        },
+                    )
+                )
+                # Cancel (empty candidate set), then drop the pod.
+                sched.preempt_routine(
+                    ei.ExtenderPreemptionArgs(
+                        pod=pod, node_name_to_meta_victims={}
+                    )
+                )
+                status = sched.pod_schedule_statuses.get(uid)
+                if status is not None and status.pod is not None:
+                    sched.delete_pod(status.pod)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    from hivedscheduler_tpu.api.types import WebServerError
+
+    def inspector():
+        try:
+            while not stop.is_set():
+                groups = sched.get_all_affinity_groups()
+                # Every listed group must be detail-readable; a clean
+                # miss (deleted between list and get) raises the 404
+                # equivalent WebServerError, which is fine — anything
+                # else (KeyError/AttributeError from a torn DTO walk) is
+                # exactly the bug this test hunts and must propagate.
+                for item in groups.get("items", []):
+                    name = item["metadata"]["name"]
+                    try:
+                        sched.get_affinity_group(name)
+                    except WebServerError:
+                        pass  # deleted between list and get: fine
+                sched.get_cluster_status()
+                sched.get_all_virtual_clusters_status()
+                # DTOs must stay JSON-serializable mid-churn.
+                json.dumps(sched.get_metrics())
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = (
+        [threading.Thread(target=churn, args=(i,)) for i in range(4)]
+        + [threading.Thread(target=preemptor, args=(i,)) for i in range(2)]
+    )
+    insp = threading.Thread(target=inspector, daemon=True)
+    insp.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    stop.set()
+    insp.join(timeout=10)
+    assert not any(t.is_alive() for t in threads), "deadlocked threads"
+    assert not insp.is_alive(), "inspector deadlocked"
+    assert not errors, errors
+
+    # No leaked reservations from the preempt commit/cancel churn: after
+    # draining every pod, all cells must return to Free (mirrors the
+    # sibling test's post-churn invariant).
+    for status in list(sched.pod_schedule_statuses.values()):
+        if status.pod is not None:
+            sched.delete_pod(status.pod)
+    assert sched.get_all_affinity_groups() == {"items": []}
+    for chain, ccl in sched.core.full_cell_list.items():
+        for cell in ccl[ccl.top_level]:
+            assert cell.state.value in ("Free",), (
+                chain, cell.address, cell.state,
+            )
