@@ -129,14 +129,7 @@ GANG_SHAPES = [
 
 def run(n_gangs: int = 120, seed: int = 0):
     sched = HivedScheduler(build_config(), kube_client=NullKubeClient())
-    nodes = sorted(
-        {
-            n
-            for ccl in sched.core.full_cell_list.values()
-            for c in ccl[ccl.top_level]
-            for n in c.nodes
-        }
-    )
+    nodes = sched.core.configured_node_names()
     for n in nodes:
         sched.add_node(Node(name=n))
 
@@ -227,14 +220,7 @@ def bench_recovery(sched) -> dict:
         for st in sched.pod_schedule_statuses.values()
         if st.pod is not None and st.pod.node_name
     ]
-    nodes = sorted(
-        {
-            n
-            for ccl in sched.core.full_cell_list.values()
-            for c in ccl[ccl.top_level]
-            for n in c.nodes
-        }
-    )
+    nodes = sched.core.configured_node_names()
     t0 = time.perf_counter()
     fresh = HivedScheduler(build_config(), kube_client=NullKubeClient())
     for n in nodes:
@@ -335,14 +321,7 @@ if __name__ == "__main__":
     # Warm-up pass (imports, allocator caches), then the measured pass.
     run(n_gangs=24, seed=1)
     p50, p99, n, sched, live = run()
-    nodes = sorted(
-        {
-            nn
-            for ccl in sched.core.full_cell_list.values()
-            for c in ccl[ccl.top_level]
-            for nn in c.nodes
-        }
-    )
+    nodes = sched.core.configured_node_names()
     preempt_p50 = bench_preempt(sched, nodes)
     recovery = bench_recovery(sched)
     perf = model_perf()

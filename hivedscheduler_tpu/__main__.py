@@ -24,6 +24,27 @@ from .webserver.server import WebServer
 CONFIG_POLL_SECONDS = 2.0
 
 
+def validate_config(path: str) -> int:
+    """Compile the config exactly as startup would (YAML -> Config ->
+    HivedAlgorithm cell trees, including the VC-quota-fits-capacity
+    checks) and report. Exit 0 on a valid config, 1 with the rejection
+    reason otherwise — usable as a pre-deploy lint."""
+    try:
+        config = load_config(path)
+        scheduler = HivedScheduler(config)
+    except Exception as exc:  # noqa: BLE001 — any rejection is the answer
+        print(f"INVALID: {type(exc).__name__}: {exc}")
+        return 1
+    chains = scheduler.core.full_cell_list
+    n_nodes = len(scheduler.core.configured_node_names())
+    print(
+        f"OK: {len(chains)} chains, {n_nodes} nodes, "
+        f"{len(config.virtual_clusters)} VCs "
+        f"({', '.join(sorted(config.virtual_clusters))})"
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(prog="hivedscheduler-tpu")
     parser.add_argument(
@@ -37,9 +58,24 @@ def main(argv=None) -> int:
         help="no kube apiserver: mark all configured nodes healthy and serve "
         "(for simulation/e2e harnesses)",
     )
+    parser.add_argument(
+        "--validate-config",
+        action="store_true",
+        help="compile the config (cell chains, physical cells, VC quotas "
+        "vs capacity) and exit: 0 = valid, 1 = rejected — a pre-deploy "
+        "lint for CI",
+    )
     parser.add_argument("-v", "--verbose", action="store_true")
     args = parser.parse_args(argv)
 
+    if args.validate_config:
+        # Lint mode: constructor chatter (init marks every node bad until
+        # informed, so doomed-binding warnings always fire) would drown
+        # the verdict line.
+        common.init_logging(
+            logging.DEBUG if args.verbose else logging.ERROR
+        )
+        return validate_config(args.config)
     common.init_logging(logging.DEBUG if args.verbose else logging.INFO)
     config = load_config(args.config)
     # Standalone has no informer, so filter-time auto-admission stands in
@@ -48,14 +84,7 @@ def main(argv=None) -> int:
 
     if args.standalone:
         # The constructor already defaulted kube_client to a NullKubeClient.
-        for name in sorted(
-            {
-                n
-                for ccl in scheduler.core.full_cell_list.values()
-                for c in ccl[ccl.top_level]
-                for n in c.nodes
-            }
-        ):
+        for name in scheduler.core.configured_node_names():
             scheduler.add_node(Node(name=name))
     else:
         from .scheduler.kube import InformerLoop, KubeAPIClient

@@ -1841,6 +1841,19 @@ class HivedCore:
                 return l
             c = parent
 
+    def configured_node_names(self) -> List[str]:
+        """Sorted node names of every configured top-level cell — the
+        fleet the config describes (standalone boot, benches, and lint
+        all enumerate it)."""
+        return sorted(
+            {
+                n
+                for ccl in self.full_cell_list.values()
+                for c in ccl[ccl.top_level]
+                for n in c.nodes
+            }
+        )
+
     # -- inspect API --------------------------------------------------------
 
     def get_all_affinity_groups(self) -> Dict:

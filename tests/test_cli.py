@@ -55,3 +55,35 @@ def test_cli_standalone_serves_and_restarts_on_config_change(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+
+
+def test_validate_config_mode(tmp_path, capsys):
+    """--validate-config compiles the config and exits 0/1 with a verdict
+    line — the pre-deploy lint."""
+    from hivedscheduler_tpu.__main__ import main
+
+    good = REPO / "example/config/hivedscheduler.yaml"
+    assert main(["--validate-config", "--config", str(good)]) == 0
+    assert capsys.readouterr().out.startswith("OK: ")
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text(
+        "physicalCluster:\n"
+        "  cellTypes:\n"
+        "    v5e-host: {childCellType: v5e-chip, childCellNumber: 4,"
+        " isNodeLevel: true}\n"
+        "  physicalCells:\n"
+        "    - cellType: v5e-host\n"
+        "      cellAddress: host-a\n"
+        "virtualClusters:\n"
+        "  vc1:\n"
+        "    virtualCells:\n"
+        "      - cellType: v5e-host\n"
+        "        cellNumber: 5\n"
+    )
+    assert main(["--validate-config", "--config", str(bad)]) == 1
+    # The rejection must be the quota-vs-capacity check this fixture
+    # targets — not a YAML typo or a missing file.
+    out = capsys.readouterr().out
+    assert out.startswith("INVALID: ")
+    assert "Insufficient physical cells" in out
