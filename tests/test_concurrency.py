@@ -20,14 +20,7 @@ common.init_logging(logging.CRITICAL)
 
 def test_concurrent_filter_bind_delete_node_flap():
     sched = HivedScheduler(tpu_design_config(), kube_client=NullKubeClient())
-    nodes = sorted(
-        {
-            n
-            for ccl in sched.core.full_cell_list.values()
-            for c in ccl[ccl.top_level]
-            for n in c.nodes
-        }
-    )
+    nodes = sched.core.configured_node_names()
     for n in nodes:
         sched.add_node(Node(name=n))
 
@@ -112,14 +105,7 @@ def test_concurrent_inspect_and_preempt_during_churn():
     import json
 
     sched = HivedScheduler(tpu_design_config(), kube_client=NullKubeClient())
-    nodes = sorted(
-        {
-            n
-            for ccl in sched.core.full_cell_list.values()
-            for c in ccl[ccl.top_level]
-            for n in c.nodes
-        }
-    )
+    nodes = sched.core.configured_node_names()
     for n in nodes:
         sched.add_node(Node(name=n))
 

@@ -69,14 +69,7 @@ def build(nodes, pods):
 
 
 def all_node_names(sched):
-    return sorted(
-        {
-            n
-            for ccl in sched.core.full_cell_list.values()
-            for c in ccl[ccl.top_level]
-            for n in c.nodes
-        }
-    )
+    return sched.core.configured_node_names()
 
 
 def test_initial_relist_recovers_and_watch_delete_releases():

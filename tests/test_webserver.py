@@ -23,14 +23,7 @@ common.init_logging(logging.ERROR)
 @pytest.fixture()
 def server():
     sched = HivedScheduler(tpu_design_config(), kube_client=NullKubeClient())
-    for name in sorted(
-        {
-            n
-            for ccl in sched.core.full_cell_list.values()
-            for c in ccl[ccl.top_level]
-            for n in c.nodes
-        }
-    ):
+    for name in sched.core.configured_node_names():
         sched.add_node(Node(name=name))
     ws = WebServer(sched, address="127.0.0.1:0")
     ws.start()
