@@ -173,3 +173,103 @@ def test_hetero_gang_preemption_and_insufficiency():
     assert victims or len(binds) == len(big_pods)
     if victims:
         assert victims <= {"u-a", "u-b", "u-c"}
+
+
+def test_pp_gang_members_land_on_whole_v5p16s():
+    """The llama-pp example's shape (example/request/llama-pp.yaml): a
+    2-member gang, 4 pods x 4 chips each, on a v5p-64. Every member must
+    occupy the 4 hosts of exactly ONE v5p-16 sub-cell (its stage's ICI
+    domain), and the two members must take different v5p-16s."""
+    from hivedscheduler_tpu.api.config import Config
+    from hivedscheduler_tpu.api import extender as ei
+    from hivedscheduler_tpu.scheduler.framework import (
+        HivedScheduler, NullKubeClient,
+    )
+    from hivedscheduler_tpu.scheduler.types import Node
+    from hivedscheduler_tpu.tpu import topology
+
+    cell_types = topology.v5p_cell_types(max_hosts=16)
+    hosts = [f"v5p-w{i}" for i in range(16)]
+    config = Config.from_dict(
+        {
+            "physicalCluster": {
+                "cellTypes": {
+                    n: {
+                        "childCellType": s.child_cell_type,
+                        "childCellNumber": s.child_cell_number,
+                        "isNodeLevel": s.is_node_level,
+                    }
+                    for n, s in cell_types.items()
+                },
+                "physicalCells": [
+                    topology.make_physical_cell(
+                        "v5p-64", hosts, cell_types
+                    ).to_dict()
+                ],
+            },
+            "virtualClusters": {
+                "prod": {"virtualCells": [{"cellType": "v5p-64",
+                                           "cellNumber": 1}]},
+            },
+        }
+    )
+    sched = HivedScheduler(config, kube_client=NullKubeClient())
+    for n in sched.core.configured_node_names():
+        sched.add_node(Node(name=n))
+
+    group = {
+        "name": "prod/llama-pp",
+        "members": [
+            {"podNumber": 4, "leafCellNumber": 4},
+            {"podNumber": 4, "leafCellNumber": 4},
+        ],
+    }
+    nodes_by_pod = {}
+    for i in range(8):
+        uid = f"pp-{i}"
+        pod = make_pod(uid, uid, "prod", 0, "v5p-chip", 4, group=group)
+        sched.add_pod(pod)
+        r = sched.filter_routine(
+            ei.ExtenderArgs(pod=pod, node_names=list(hosts))
+        )
+        assert r.node_names, (i, r.error, r.failed_nodes)
+        nodes_by_pod[uid] = r.node_names[0]
+
+    # 8 distinct whole hosts (4 chips each).
+    used = list(nodes_by_pod.values())
+    assert len(set(used)) == 8
+
+    # Partition the used hosts by v5p-16 membership (make_physical_cell
+    # assigns children in order: v5p-16 #j = hosts 4j..4j+3).
+    def sub16(host):
+        return int(host.split("w")[1]) // 4
+
+    placement = sched.core.get_affinity_group("prod/llama-pp")["status"][
+        "physicalPlacement"
+    ]
+    groups_hit = {}
+    for host in placement:
+        groups_hit.setdefault(sub16(host), set()).add(host)
+    # Exactly two v5p-16s, each fully occupied (4 hosts).
+    assert len(groups_hit) == 2, groups_hit
+    for g, hs in groups_hit.items():
+        assert len(hs) == 4, (g, hs)
+
+    # The per-STAGE guarantee: identical-shape members are interchangeable
+    # to the scheduler, so stage membership is derived from the env
+    # contract's worker order (tpu/env.py natural sort). Workers 0-3 must
+    # share one quad and workers 4-7 the other — i.e. the worker-ordered
+    # host list groups quads contiguously.
+    import yaml
+
+    from hivedscheduler_tpu.api import constants
+
+    any_pod = sched.pod_schedule_statuses["pp-0"].pod
+    block = yaml.safe_load(
+        any_pod.annotations[constants.ANNOTATION_POD_TPU_ENV]
+    )
+    roster = block["TPU_WORKER_HOSTNAMES"].split(",")
+    assert len(roster) == 8
+    assert len({sub16(h) for h in roster[:4]}) == 1, roster
+    assert len({sub16(h) for h in roster[4:]}) == 1, roster
+    assert sub16(roster[0]) != sub16(roster[4])
