@@ -24,10 +24,9 @@ CONFIGS = [
     {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "dots+flash"},
     {"HIVED_PERF_BATCH": "4", "HIVED_PERF_REMAT": "flash"},
     {"HIVED_PERF_BATCH": "8", "HIVED_PERF_REMAT": "flash"},
-    # Block-size exploration around the shipped optimum. Block sizes are
-    # module attributes read at trace time; main() patches them onto the
-    # imported module per config (the env vars alone only affect fresh
-    # processes).
+    # Block-size exploration around the shipped optimum. Block limits are
+    # resolved from the env at dispatch time (attention.block_limits), so
+    # setting the env vars per config is enough even in-process.
     {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "flash",
      "HIVED_FLASH_BLOCK_Q": "512", "HIVED_FLASH_BLOCK_K": "512"},
     {"HIVED_PERF_BATCH": "2", "HIVED_PERF_REMAT": "flash",
@@ -46,19 +45,16 @@ def main() -> None:
     from hivedscheduler_tpu.models import perf
     from hivedscheduler_tpu.ops import attention as att
 
+    block_keys = (
+        "HIVED_FLASH_BLOCK_Q", "HIVED_FLASH_BLOCK_K",
+        "HIVED_FLASH_BLOCK_Q_BWD", "HIVED_FLASH_BLOCK_K_BWD",
+    )
     for cfg in CONFIGS:
+        # Clear block overrides from the previous config so a config without
+        # them benches the shipped defaults, not the prior row's blocks.
+        for key in block_keys:
+            os.environ.pop(key, None)
         os.environ.update(cfg)
-        # BLOCK_Q/BLOCK_K are read from the env at import time; propagate
-        # overrides to the already-imported module for in-process sweeps
-        # (falling back to the module's own shipped defaults, not a copy).
-        att.BLOCK_Q = int(cfg.get("HIVED_FLASH_BLOCK_Q", att.DEFAULT_BLOCK_Q))
-        att.BLOCK_K = int(cfg.get("HIVED_FLASH_BLOCK_K", att.DEFAULT_BLOCK_K))
-        att.BLOCK_Q_BWD = int(
-            cfg.get("HIVED_FLASH_BLOCK_Q_BWD", att.DEFAULT_BLOCK_Q_BWD)
-        )
-        att.BLOCK_K_BWD = int(
-            cfg.get("HIVED_FLASH_BLOCK_K_BWD", att.DEFAULT_BLOCK_K_BWD)
-        )
         try:
             r = perf.bench_train_step(on_tpu=True)
             r["config"] = cfg

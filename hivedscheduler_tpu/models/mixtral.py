@@ -222,6 +222,16 @@ def forward(
     """Returns (logits [B,S,V], total aux load-balancing loss)."""
     c = config
     sharding.validate_sp_mode(c.sp_mode)
+    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        # Mixtral's forward is a plain lax.scan — it never pipelines. With
+        # DEFAULT_RULES mapping "layers" -> "pp", a pp>1 mesh would silently
+        # shard the stacked layer params over pp and force a cross-stage
+        # gather every layer: correct numerics, pathological performance.
+        # Scale Mixtral over ep instead (parallel/pipeline.py docstring).
+        raise NotImplementedError(
+            "mixtral.forward does not pipeline; use ep (expert) parallelism "
+            f"instead of pp (mesh has pp={mesh.shape['pp']})"
+        )
     use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
     params = jax.tree.map(lambda a: a.astype(c.dtype), params)
     x = params["embed"][tokens]

@@ -59,6 +59,24 @@ BLOCK_K_BWD = int(
     os.environ.get("HIVED_FLASH_BLOCK_K_BWD", str(DEFAULT_BLOCK_K_BWD))
 )
 
+
+def block_limits() -> Tuple[int, int, int, int]:
+    """Effective (block_q, block_k, block_q_bwd, block_k_bwd) limits,
+    resolved at *dispatch* time: a ``HIVED_FLASH_BLOCK_*`` env var set now
+    wins over the value captured at import, so env overrides behave the
+    same in-process as across processes. The module attributes remain the
+    fallback so tests/harnesses may still monkeypatch them directly."""
+    def _resolve(env_key: str, attr_value: int) -> int:
+        raw = os.environ.get(env_key)
+        return int(raw) if raw is not None else attr_value
+
+    return (
+        _resolve("HIVED_FLASH_BLOCK_Q", BLOCK_Q),
+        _resolve("HIVED_FLASH_BLOCK_K", BLOCK_K),
+        _resolve("HIVED_FLASH_BLOCK_Q_BWD", BLOCK_Q_BWD),
+        _resolve("HIVED_FLASH_BLOCK_K_BWD", BLOCK_K_BWD),
+    )
+
 # Interpreter mode for pallas kernels (CPU tests); real TPU runs leave False.
 INTERPRET = False
 
@@ -553,10 +571,11 @@ def mha(
         use_pallas = pallas_wanted()
     if use_pallas and pallas_shape_ok(q.shape[1], k.shape[1]):
         s = q.shape[1]
+        bq, bk, bq_bwd, bk_bwd = block_limits()
         return flash_attention_tpu(
             q, k, v, causal, sm_scale,
-            fit_block(BLOCK_Q, s, 8), fit_block(BLOCK_K, s, 128),
-            fit_block(BLOCK_Q_BWD, s, 8), fit_block(BLOCK_K_BWD, s, 128),
+            fit_block(bq, s, 8), fit_block(bk, s, 128),
+            fit_block(bq_bwd, s, 8), fit_block(bk_bwd, s, 128),
         )
     return mha_reference(q, k, v, causal=causal, sm_scale=sm_scale)
 
@@ -594,11 +613,12 @@ def pallas_shape_ok(sq: int, sk: int) -> bool:
     matrix, hence the (8, 128) alignment requirement — e.g. sq=300 has no
     valid block and must route to the XLA fallback rather than crash in
     lowering."""
+    bq, bk, bq_bwd, bk_bwd = block_limits()
     return (
         sq >= 256
         and sq == sk
-        and fit_block(BLOCK_Q, sq, 8) > 0
-        and fit_block(BLOCK_K, sq, 128) > 0
-        and fit_block(BLOCK_Q_BWD, sq, 8) > 0
-        and fit_block(BLOCK_K_BWD, sq, 128) > 0
+        and fit_block(bq, sq, 8) > 0
+        and fit_block(bk, sq, 128) > 0
+        and fit_block(bq_bwd, sq, 8) > 0
+        and fit_block(bk_bwd, sq, 128) > 0
     )

@@ -241,3 +241,25 @@ def test_flash_split_bwd_blocks_match_reference():
     for a, b in zip(gr, gf):
         scale = float(jnp.max(jnp.abs(a))) + 1e-6
         assert float(jnp.max(jnp.abs(a - b))) / scale < 1e-4
+
+
+def test_block_limits_read_env_at_dispatch_time(monkeypatch):
+    """Setting HIVED_FLASH_BLOCK_* after import must take effect on the
+    next mha() dispatch (block_limits resolves env at call time); unset
+    vars fall back to the module attributes so monkeypatching still works."""
+    monkeypatch.delenv("HIVED_FLASH_BLOCK_Q", raising=False)
+    monkeypatch.setattr(A, "BLOCK_Q", 512)
+    monkeypatch.setattr(A, "BLOCK_K", 1024)
+    monkeypatch.setattr(A, "BLOCK_Q_BWD", 512)
+    monkeypatch.setattr(A, "BLOCK_K_BWD", 1024)
+    assert A.block_limits() == (512, 1024, 512, 1024)
+    # Env set post-import wins at dispatch time (the advisor's scenario).
+    monkeypatch.setenv("HIVED_FLASH_BLOCK_Q", "256")
+    monkeypatch.setenv("HIVED_FLASH_BLOCK_K_BWD", "512")
+    assert A.block_limits() == (256, 1024, 512, 512)
+    # The shape gate sees the same dispatch-time values: a seq divisible
+    # only by the env-set block must flip the gate without re-import.
+    monkeypatch.setenv("HIVED_FLASH_BLOCK_Q", "0")
+    assert not A.pallas_shape_ok(8192, 8192)
+    monkeypatch.setenv("HIVED_FLASH_BLOCK_Q", "512")
+    assert A.pallas_shape_ok(8192, 8192)

@@ -209,3 +209,17 @@ def test_mixtral_sp_mesh_matches_single_device():
             float(ref_aux), float(jax.device_get(aux)), rtol=1e-4,
             err_msg=mode,
         )
+
+
+def test_mixtral_refuses_pp_mesh():
+    """Mixtral never pipelines (plain lax.scan over layers); a pp>1 mesh
+    would silently shard stacked layer params over pp and gather them
+    cross-stage every layer. The forward must refuse loudly and point at
+    ep parallelism instead."""
+    config = mixtral.tiny()
+    params = mixtral.init(config, jax.random.PRNGKey(0))
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    mesh = pmesh.make_mesh(pmesh.MeshConfig(pp=2, ep=4),
+                           devices=jax.devices())
+    with pytest.raises(NotImplementedError, match="ep .*parallelism"):
+        mixtral.forward(params, tokens, config, mesh)
