@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from ..ops.attention import mha
-from ..parallel import pipeline, sharding
+from ..parallel import pipeline, ring, sharding
 
 Params = Dict[str, Any]
 
@@ -64,7 +64,9 @@ class TransformerConfig:
     # attention otherwise; "ring"/"ulysses" force one.
     sp_mode: str = "auto"
     # GPipe microbatch count when the mesh has pp > 1 (parallel/pipeline.py);
-    # None = min(batch, 2*pp). The bubble is (pp-1)/(M+pp-1) of step time.
+    # None = the largest divisor of batch <= 2*pp (pipeline_blocks), which
+    # can be smaller than 2*pp — e.g. batch=10, pp=4 gives M=5, not 8.
+    # The bubble is (pp-1)/(M+pp-1) of step time.
     pp_microbatches: Optional[int] = None
 
     @property
@@ -185,21 +187,37 @@ def _block(
     config: TransformerConfig,
     mesh: Optional[Mesh],
     use_sp: bool,
+    sp_manual: bool = False,
 ) -> jax.Array:
+    """One pre-norm block. ``sp_manual``: the block is being traced inside a
+    shard_map that is already manual over the sp axis (the pp x sp pipeline,
+    parallel/pipeline.py seq_axis) — x is the LOCAL sequence shard, so rope
+    positions offset by the shard index, attention goes straight to the
+    ring's local collectives (a nested sp shard_map would be illegal), and
+    sharding constraints that mention the now-manual seq axis are skipped
+    (weight shardings still drive the auto-axes partitioning)."""
     c = config
     b, s, d = x.shape
+    con = (lambda t, *axes: t) if sp_manual else sharding.constrain
 
     h = rms_norm(x, layer["ln1"])
     q = (h @ layer["wq"]).reshape(b, s, c.n_heads, c.head_dim)
     k = (h @ layer["wk"]).reshape(b, s, c.n_kv_heads, c.head_dim)
     v = (h @ layer["wv"]).reshape(b, s, c.n_kv_heads, c.head_dim)
-    positions = jnp.arange(s)
+    if sp_manual:
+        positions = jax.lax.axis_index("sp") * s + jnp.arange(s)
+    else:
+        positions = jnp.arange(s)
     q = rope(q, positions, c.rope_theta)
     k = rope(k, positions, c.rope_theta)
-    q = sharding.constrain(q, "batch", "seq", "heads", None)
-    k = sharding.constrain(k, "batch", "seq", "kv_heads", None)
-    v = sharding.constrain(v, "batch", "seq", "kv_heads", None)
-    if use_sp:
+    q = con(q, "batch", "seq", "heads", None)
+    k = con(k, "batch", "seq", "kv_heads", None)
+    v = con(v, "batch", "seq", "kv_heads", None)
+    if sp_manual:
+        attn = ring._ring_attention_local(
+            q, k, v, axis_name="sp", causal=True, sm_scale=None
+        )
+    elif use_sp:
         assert mesh is not None
         attn = sharding.sp_attention(
             q, k, v, mesh, causal=True, sp_mode=c.sp_mode
@@ -209,13 +227,13 @@ def _block(
         # since GSPMD cannot partition a pallas_call); XLA reference off-TPU.
         attn = sharding.sharded_mha(q, k, v, mesh, causal=True)
     attn = attn.reshape(b, s, c.n_heads * c.head_dim)
-    x = x + sharding.constrain(attn @ layer["wo"], "batch", "seq", "act_embed")
+    x = x + con(attn @ layer["wo"], "batch", "seq", "act_embed")
 
     h = rms_norm(x, layer["ln2"])
     gate = jax.nn.silu(h @ layer["w_gate"])
     up = h @ layer["w_up"]
     ffn = (gate * up) @ layer["w_down"]
-    return x + sharding.constrain(ffn, "batch", "seq", "act_embed")
+    return x + con(ffn, "batch", "seq", "act_embed")
 
 
 def _remat_policy(name: str):
@@ -252,18 +270,14 @@ def forward_hidden(
     c = config
     sharding.validate_sp_mode(c.sp_mode)
     use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
-    if use_sp and mesh.shape.get("pp", 1) > 1:
-        # The SP backends are full shard_maps and the pipeline is a manual
-        # region; this JAX's partitioner (Shardy) rejects nested manual
-        # computations over an already-bound axis, and the partial-manual
-        # workaround lowers unreliably (verified: forward sometimes lowers,
-        # backward mistypes cotangent varying-axes). Refuse clearly rather
-        # than crash mid-trace; shard long sequences with sp x fsdp x tp,
-        # or pipeline with pp x fsdp x tp.
+    use_pp = mesh is not None and mesh.shape.get("pp", 1) > 1
+    if use_sp and use_pp and c.sp_mode == "ulysses":
+        # Inside the pipeline's manual region only the ring backend runs
+        # (its ppermute/psum are manual-friendly); the Ulysses all-to-all
+        # re-shard assumes GSPMD auto heads/seq axes.
         raise NotImplementedError(
-            "pp > 1 with sp > 1 is not supported: sequence-parallel "
-            "attention cannot nest inside the pipeline's manual region "
-            f"(mesh={dict(mesh.shape)})"
+            "pp > 1 composes with sp > 1 via ring attention only; "
+            f"sp_mode='ulysses' is not supported (mesh={dict(mesh.shape)})"
         )
     # Mixed precision: f32 master params -> bf16 compute copies.
     params = jax.tree.map(lambda a: a.astype(c.dtype), params)
@@ -272,14 +286,20 @@ def forward_hidden(
     x = sharding.embed_lookup(params["embed"], tokens, mesh)
     x = sharding.constrain(x, "batch", "seq", "act_embed")
 
-    block = lambda x, layer: (_block(x, layer, c, mesh, use_sp), None)
+    sp_manual = use_sp and use_pp
+    block = lambda x, layer: (
+        _block(x, layer, c, mesh, use_sp, sp_manual=sp_manual), None
+    )
     if c.remat:
         block = jax.checkpoint(block, policy=_remat_policy(c.remat_policy))
-    if mesh is not None and sharding.axes_size("pp", mesh) > 1:
+    if use_pp:
         # Layer stack sharded over pp stages: GPipe microbatch pipeline
-        # (same per-microbatch computation, pipelined schedule).
+        # (same per-microbatch computation, pipelined schedule). With sp > 1
+        # the sp axis joins the manual region: activations stay seq-sharded
+        # and the blocks run ring attention's local collectives directly.
         x = pipeline.pipeline_blocks(
-            params["layers"], x, mesh, block, c.pp_microbatches
+            params["layers"], x, mesh, block, c.pp_microbatches,
+            seq_axis="sp" if sp_manual else None,
         )
     else:
         x, _ = jax.lax.scan(block, x, params["layers"])

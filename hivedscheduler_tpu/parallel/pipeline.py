@@ -10,8 +10,11 @@ actually splits the layer stack across stages. TPU-first formulation:
     so each stage holds L/P contiguous layers — the memory win that lets a
     model deeper than one slice's HBM train at all.
   - One ``shard_map`` manual over ONLY the pp axis (``axis_names={"pp"}``);
-    dp/fsdp/sp/tp stay auto, so the per-stage computation keeps its GSPMD
-    shardings and collectives — pipeline composes with every other axis.
+    dp/fsdp/tp stay auto, so the per-stage computation keeps its GSPMD
+    shardings and collectives. Sequence parallelism composes by joining the
+    manual region (``seq_axis="sp"``): activations enter seq-sharded and the
+    block runs ring attention's manual collectives directly (the SP
+    backends' own shard_map cannot nest inside an already-manual axis).
   - The schedule is a ``lax.scan`` over M + P - 1 ticks. Each tick: every
     stage ppermutes its activation to the next stage, stage 0 injects the
     next microbatch, every stage applies its local layers (a nested scan).
@@ -27,6 +30,12 @@ Scope: blocks whose scan body returns (x, None) — the dense transformer.
 MoE blocks scale their router statistics (capacity, load-balancing aux)
 with the visible batch, so microbatching them changes those semantics;
 MoE models parallelize over ``ep`` instead (models/mixtral.py).
+
+Composition: dp/fsdp/tp stay auto alongside pp. Sequence parallelism
+composes via ``seq_axis`` (ring backend only — the Ulysses all-to-all
+re-shard needs auto seq/head axes); verified fwd+bwd against the
+single-device reference in tests/test_models.py::test_pp_x_sp_matches_
+single_device and the dryrun gate's "pp-x-sp" check.
 """
 
 from __future__ import annotations
@@ -49,6 +58,7 @@ def pipeline_blocks(
     block_fn: BlockFn,  # (x, layer) -> (x, _), the lax.scan body
     n_microbatches: Optional[int] = None,
     axis: str = "pp",
+    seq_axis: Optional[str] = None,
 ) -> jax.Array:
     """Apply all L stacked layers to x, pipelined over the ``axis`` stages.
 
@@ -56,6 +66,13 @@ def pipeline_blocks(
     the mesh has pp > 1 (falls back to exactly that when pp == 1). The
     result is bitwise the same computation per microbatch; only the
     schedule differs.
+
+    ``seq_axis``: also make that axis manual in the shard_map and keep the
+    activations sequence-sharded over it through the pipeline. The caller's
+    ``block_fn`` must then be manual-region aware: run attention via the
+    ring's local collectives (``ring._ring_attention_local``) and offset
+    positional encodings by ``axis_index(seq_axis)`` — see
+    models/transformer._block(sp_manual=True).
     """
     p = axes_size(axis, mesh)
     if p <= 1:
@@ -110,10 +127,12 @@ def pipeline_blocks(
         outs = jax.lax.psum(outs, axis)
         return outs.reshape(x_full.shape)
 
+    manual_axes = {axis} | ({seq_axis} if seq_axis else set())
+    x_spec = P(None, seq_axis) if seq_axis else P()
     return jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(axis), P()),
-        out_specs=P(),
-        axis_names={axis},
+        in_specs=(P(axis), x_spec),
+        out_specs=x_spec,
+        axis_names=manual_axes,
     )(layers, x)
