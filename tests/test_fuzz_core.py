@@ -19,7 +19,7 @@ import random
 import pytest
 
 from hivedscheduler_tpu import common
-from hivedscheduler_tpu.algorithm.core import HivedCore
+from hivedscheduler_tpu.algorithm.core import HivedCore, in_free_cell_list
 from hivedscheduler_tpu.scheduler.types import SchedulingPhase, new_binding_pod
 
 from .test_config_compiler import tpu_design_config
@@ -67,8 +67,125 @@ def binding_invariant(core):
     return None
 
 
+def safety_invariant(core):
+    """The VC-safety guarantee itself (SURVEY §7.4 hard part 2): in every
+    quiescent state the physical cells still available at each chain/level
+    must cover the sum of all VCs' free (unallocated-quota) cells there —
+    total_left_cell_num >= all_vc_free_cell_num. Opportunistic pods never
+    decrement total_left (their cells stay reclaimable in the free list),
+    so this must hold at every step boundary, node flaps included."""
+    for chain, levels in core.total_left_cell_num.items():
+        for lvl, left in levels.items():
+            free = core.all_vc_free_cell_num.get(chain, {}).get(lvl, 0)
+            if left < free:
+                return (
+                    f"safety broken: {chain}@{lvl} total_left={left} < "
+                    f"all_vc_free={free}"
+                )
+    return None
+
+
+def counter_consistency_invariant(core):
+    """The three counter families must stay mutually consistent:
+      - all_vc_free_cell_num == sum over VCs of vc_free_cell_num,
+      - total_left_cell_num == what the physical free list implies
+        (free cells at or above the level, times the fan-out product),
+      - bad_free_cells is a subset of the free list's unhealthy cells.
+    These are updated at distant call sites (allocate/release/split/merge/
+    doomed bind); a missed or double update is invisible to scenario tests
+    until placements drift."""
+    # all_vc_free == Σ_vc vc_free
+    summed = {}
+    for vcn, chains in core.vc_free_cell_num.items():
+        for chain, levels in chains.items():
+            for lvl, n in levels.items():
+                summed.setdefault(chain, {}).setdefault(lvl, 0)
+                summed[chain][lvl] += n
+    for chain, levels in core.all_vc_free_cell_num.items():
+        for lvl, n in levels.items():
+            got = summed.get(chain, {}).get(lvl, 0)
+            if got != n:
+                return (
+                    f"all_vc_free {chain}@{lvl}={n} != sum of per-VC "
+                    f"counters {got}"
+                )
+    # total_left == Σ_{l' >= l} len(free_list[l']) * fanout(l' -> l)
+    for chain, levels in core.total_left_cell_num.items():
+        ccl = core.free_cell_list[chain]
+        full = core.full_cell_list[chain]
+        for lvl, n in levels.items():
+            implied = 0
+            for lp in range(lvl, full.top_level + 1):
+                count = len(ccl[lp]) if lp in ccl.levels else 0
+                fanout = 1
+                for k in range(lvl + 1, lp + 1):
+                    fanout *= len(full[k][0].children)
+                implied += count * fanout
+            if implied != n:
+                return (
+                    f"total_left {chain}@{lvl}={n} but free list implies "
+                    f"{implied}"
+                )
+    # Every bad_free entry is unhealthy and still free (its own free-list
+    # entry may live at an unsplit ancestor — in_free_cell_list semantics).
+    for chain, ccl in core.bad_free_cells.items():
+        for lvl, cells in ccl.levels.items():
+            for c in cells:
+                if c.healthy:
+                    return f"bad_free {c.address}@{lvl} is healthy"
+                if not in_free_cell_list(c):
+                    return f"bad_free {c.address}@{lvl} is not free"
+    return None
+
+
+def priority_count_invariant(core):
+    """used_leaf_cells_at_priority must be the exact subtree census: for a
+    leaf, {priority: 1} when allocated; for inner cells, the element-wise
+    sum of the children's maps; and a parent's priority is the max of its
+    children's (cell_allocation.go:425-454 semantics)."""
+    def check(cell):
+        if not cell.children:
+            expect = (
+                {cell.priority: 1}
+                if cell.used_leaf_cells_at_priority
+                else {}
+            )
+            if cell.used_leaf_cells_at_priority not in ({}, expect):
+                return (
+                    f"leaf {cell.address} priority={cell.priority} counters="
+                    f"{cell.used_leaf_cells_at_priority}"
+                )
+            return None
+        acc = {}
+        for ch in cell.children:
+            err = check(ch)
+            if err:
+                return err
+            for p, k in ch.used_leaf_cells_at_priority.items():
+                acc[p] = acc.get(p, 0) + k
+        if acc != cell.used_leaf_cells_at_priority:
+            return (
+                f"{cell.address}: counters {cell.used_leaf_cells_at_priority}"
+                f" != children sum {acc}"
+            )
+        return None
+
+    for chain, ccl in core.full_cell_list.items():
+        for top in ccl[ccl.top_level]:
+            err = check(top)
+            if err:
+                return f"{chain}: {err}"
+    return None
+
+
 def all_invariants(core):
-    return doomed_invariant(core) or binding_invariant(core)
+    return (
+        doomed_invariant(core)
+        or binding_invariant(core)
+        or safety_invariant(core)
+        or counter_consistency_invariant(core)
+        or priority_count_invariant(core)
+    )
 
 
 def run_sequence(seed: int, steps: int = 80) -> None:

@@ -17,6 +17,16 @@ Covered sub-scenarios (reference analog in parens):
   - doomed-bad-cell visibility: free VC cells turn bad exactly when the
     healthy free pool can no longer satisfy all VCs' free quota, and heal
     back as capacity returns (L909-999)
+  - stateful preemption chain: commit, preemptor-preempts-preemptor
+    (Preempting group deleted, real pods stay the victims), cancellation
+    returning cells to the being-preempted group, completion after victim
+    eviction onto the exact vacated cells (L566-608)
+  - safe-relaxed buddy allocation under bad nodes: a bad free cell at the
+    request level forces a safety-bounded split of a higher-level cell,
+    with exact placements through the bad/heal cycle (cell_allocation.go:84-150)
+  - reconfiguration replay: restart with shrunken quota + renamed node,
+    exact recovered placements (kept / lazy-preempted / dropped) and exact
+    post-restart binds (L1042-1092)
 
 Run with ``GOLDEN_GENERATE=1`` to print the actual outcome table (used
 once to freeze the goldens after verifying each by hand).
@@ -412,6 +422,106 @@ DOOMED = [
 ]
 
 
+PREEMPTION_CHAIN = [
+    # Fill VC2's single non-pinned v5p-16 quota with a prio-0 gang (fresh
+    # sim packs from cell 0/3 = w12-w15, as in NORMAL_OPS).
+    step("c01", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w12", (0, 1, 2, 3)),
+         group=("clow", 4)),
+    step("c02", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w13", (0, 1, 2, 3)),
+         group=("clow", 4)),
+    step("c03", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w14", (0, 1, 2, 3)),
+         group=("clow", 4)),
+    step("c04", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w15", (0, 1, 2, 3)),
+         group=("clow", 4)),
+    # prio-5 preemptor COMMITS (Preempting phase, placement inside the
+    # suggested set): clow transitions to BeingPreempted.
+    step("c05", "VC2", 5, "v5p-chip", 4,
+         ("preempt", {"u-c01", "u-c02", "u-c03", "u-c04"}),
+         group=("cmid", 4),
+         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         phase=P),
+    group_state("cmid", "Preempting"),
+    group_state("clow", "BeingPreempted"),
+    # PREEMPTOR-PREEMPTS-PREEMPTOR (reference L566-608): a prio-10 gang
+    # wants the same cells. The Preempting cmid group holds them but has no
+    # running pods — it is deleted outright; the VICTIM set is still clow's
+    # real pods.
+    step("c06", "VC2", 10, "v5p-chip", 4,
+         ("preempt", {"u-c01", "u-c02", "u-c03", "u-c04"}),
+         group=("chigh", 4),
+         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         phase=P),
+    group_state("chigh", "Preempting"),
+    group_state("cmid", "absent"),
+    group_state("clow", "BeingPreempted"),
+    # CANCELLATION: the suggested set no longer covers chigh's committed
+    # placement -> the preemptor is deleted and its reserved cells RETURN
+    # to the being-preempted group (clow keeps running on w12-w15; the
+    # reference never reverts the BeingPreempted marker itself,
+    # hived_algorithm.go:1116-1144).
+    step("c07", "VC2", 10, "v5p-chip", 4, ("wait",), group=("chigh", 4),
+         suggested=["v5p64-w12", "v5p64-w13"], phase=P),
+    group_state("chigh", "absent"),
+    group_state("clow", "BeingPreempted"),
+    # The returned cells are really clow's again: deleting clow's pods
+    # frees them, and a re-committed preemptor...
+    step("c08", "VC2", 5, "v5p-chip", 4,
+         ("preempt", {"u-c01", "u-c02", "u-c03", "u-c04"}),
+         group=("cmid2", 4),
+         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         phase=P),
+    group_state("cmid2", "Preempting"),
+    # ...completes once K8s evicts the victims (the deletes below), its
+    # pods binding the exact cells the victims held.
+    delete("c01"),
+    delete("c02"),
+    delete("c03"),
+    delete("c04"),
+    step("c09", "VC2", 5, "v5p-chip", 4, ("bind", "v5p64-w12", (0, 1, 2, 3)),
+         group=("cmid2", 4),
+         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         phase=P),
+    step("c10", "VC2", 5, "v5p-chip", 4, ("bind", "v5p64-w13", (0, 1, 2, 3)),
+         group=("cmid2", 4),
+         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         phase=P),
+    step("c11", "VC2", 5, "v5p-chip", 4, ("bind", "v5p64-w14", (0, 1, 2, 3)),
+         group=("cmid2", 4),
+         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         phase=P),
+    step("c12", "VC2", 5, "v5p-chip", 4, ("bind", "v5p64-w15", (0, 1, 2, 3)),
+         group=("cmid2", 4),
+         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         phase=P),
+    group_state("cmid2", "Allocated"),
+]
+
+RELAXED_BUDDY = [
+    # CPU chain: VC2 owns 2 cpu-socket quota; physically 2 hosts x 2
+    # sockets, free list initially holds the hosts whole. The first socket
+    # pod buddy-splits cpu-1 (packing order) and takes socket 0.
+    step("x01", "VC2", 0, "cpu-socket", 1, ("bind", "cpu-1", (0,))),
+    # The host with the remaining free socket dies: the level-1 free list
+    # now holds only a BAD socket, while a whole healthy host (cpu-0) sits
+    # at level 2.
+    bad("cpu-1"),
+    # Plain buddy alloc at level 1 would pick the bad socket;
+    # safe_relaxed_buddy_alloc must instead split cpu-0 (splittable: its
+    # level-2 free count exceeds the VC quota reserved at that level) and
+    # bind the healthy socket — exact placement, not just "somewhere".
+    step("x02", "VC2", 0, "cpu-socket", 1, ("bind", "cpu-0", (0,))),
+    # Quota exhausted: a third guaranteed socket waits even though cpu-0's
+    # second socket is physically free.
+    step("x03", "VC2", 0, "cpu-socket", 1, ("wait",)),
+    # Heal + release: packing prefers cpu-0's second socket (the
+    # partially-used, already-split host) over reopening the healed cpu-1
+    # — the packing sort works on post-relaxed-split state.
+    heal("cpu-1"),
+    delete("x01"),
+    step("x04", "VC2", 0, "cpu-socket", 1, ("bind", "cpu-0", (1,))),
+]
+
+
 def test_golden_normal_ops():
     run_table(NORMAL_OPS)
 
@@ -439,3 +549,104 @@ def test_golden_backtracking_cell_binding():
 
 def test_golden_doomed_bad_cells():
     run_table(DOOMED)
+
+
+def test_golden_preemption_chain():
+    run_table(PREEMPTION_CHAIN)
+
+
+def test_golden_safe_relaxed_buddy():
+    run_table(RELAXED_BUDDY)
+
+
+# --------------------------------------------------------------------------- #
+# Reconfiguration replay, golden: exact placements before AND after a
+# restart with a mutated config (reference reconfiguration test shape,
+# hived_algorithm_test.go:1042-1092), then exact post-restart binds.
+# --------------------------------------------------------------------------- #
+
+RECONFIG_BEFORE = [
+    # Two VC1 v5p-16 groups pinned by suggestion to cells 0/3 and 0/2.
+    step("m01", "VC1", 0, "v5p-chip", 4, ("bind", "v5p64-w12", (0, 1, 2, 3)),
+         suggested=["v5p64-w12"], phase=P),
+    step("m02", "VC1", 0, "v5p-chip", 4, ("bind", "v5p64-w8", (0, 1, 2, 3)),
+         suggested=["v5p64-w8"], phase=P),
+    # A VC2 group on the node whose address will be renamed away.
+    step("m03", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w4", (0, 1, 2, 3)),
+         suggested=["v5p64-w4"], phase=P),
+]
+
+
+def test_golden_reconfiguration_replay():
+    from hivedscheduler_tpu.api.config import default_physical_cells
+
+    from .test_config_compiler import tpu_design_config
+    from .test_core import Sim
+
+    runner = run_table(RECONFIG_BEFORE)
+
+    # Mutated config: VC1's non-pinned v5p-16 quota shrinks 2 -> 1 and
+    # v5p64-w4 is renamed out of existence.
+    cfg = tpu_design_config()
+    for vc_cell in cfg.virtual_clusters["VC1"].virtual_cells:
+        if vc_cell.cell_type == "v5p-64.v5p-16":
+            vc_cell.cell_number = 1
+    for spec in cfg.physical_cluster.physical_cells:
+        if spec.cell_type != "v5p-64":
+            continue
+        for sub in spec.cell_children:
+            for host in sub.cell_children:
+                if host.cell_address.endswith("/v5p64-w4"):
+                    host.cell_address = host.cell_address.replace(
+                        "v5p64-w4", "v5p64-gone"
+                    )
+    default_physical_cells(cfg.physical_cluster)
+
+    sim2 = Sim(cfg)
+    for name in sorted(runner.bound):  # deterministic replay order
+        sim2.core.add_allocated_pod(runner.bound[name])
+
+    # Quota shrink: first-replayed m01 keeps the remaining virtual cell,
+    # m02 is lazy-preempted — but both keep their EXACT physical cells.
+    g1 = sim2.core.affinity_groups["default/m01"]
+    g2 = sim2.core.affinity_groups["default/m02"]
+    assert g1.state.value == "Allocated" and g1.virtual_placement is not None
+    assert sorted(g1.to_status()["status"]["physicalPlacement"]) == [
+        "v5p64-w12"
+    ]
+    assert g2.state.value == "Allocated" and g2.virtual_placement is None
+    assert g2.lazy_preemption_status is not None
+    assert sorted(g2.to_status()["status"]["physicalPlacement"]) == [
+        "v5p64-w8"
+    ]
+    # Renamed-away node: m03's placement cannot be recovered.
+    g3 = sim2.core.affinity_groups.get("default/m03")
+    assert g3 is None or g3.to_status()["status"]["physicalPlacement"] == {}
+
+    # Post-restart scheduling sees the recovered occupancy EXACTLY: VC2's
+    # v5p quota is free again (m03 unrecovered), and the renamed host is
+    # schedulable under its new name.
+    runner.sim.core = sim2.core
+    post = [
+        step("m04", "VC2", 0, "v5p-chip", 4,
+             ("bind", "v5p64-gone", (0, 1, 2, 3)),
+             suggested=["v5p64-gone"], phase=P),
+        # VC1's one remaining virtual v5p-16 is bound to 0/3 (recovered for
+        # m01): a new VC1 singleton packs into that same cell's next host.
+        step("m05", "VC1", 0, "v5p-chip", 4,
+             ("bind", "v5p64-w13", (0, 1, 2, 3))),
+        # But a whole-cell gang (4 x 4 chips) no longer fits the shrunken
+        # quota — 0/3 is partially used by m01/m05 and there is no second
+        # virtual cell. Exact quota-exhaustion wait.
+        step("m06", "VC1", 0, "v5p-chip", 4, ("wait",), group=("mg", 4)),
+    ]
+    for i, row in enumerate(post):
+        got = runner.run(row)
+        if GENERATE:
+            print(f"post{i} {row['name']} -> {got}")
+            continue
+        want = row["expect"]
+        if want[0] == "bind":
+            assert got == ("bind", want[1], tuple(want[2])), (row["name"], got)
+        else:
+            assert got[0] == want[0], (row["name"], got)
