@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Poll the TPU tunnel; when it answers, run the on-chip perf benchmark for
+# the headline shape and the 800m sizing shape. Each successful run
+# persists example/logs/perf_last_measured*.json (models/perf.py
+# persist_result), which bench.py re-emits inline whenever the live path
+# is skipped — this loop is how a flaky tunnel still yields driver-visible
+# numbers. Usage: nohup bash hack/perf_when_alive.sh >/tmp/perf_loop.log 2>&1 &
+set -u
+cd "$(dirname "$0")/.."
+PROBE='import jax; assert jax.default_backend() == "tpu", jax.default_backend()'
+while true; do
+    echo "[$(date -u +%H:%M:%S)] probing TPU tunnel..."
+    if timeout 90 python -c "$PROBE" 2>/dev/null; then
+        echo "[$(date -u +%H:%M:%S)] tunnel alive: running 268m bench"
+        timeout 2400 python -m hivedscheduler_tpu.models.perf
+        echo "[$(date -u +%H:%M:%S)] running 800m sizing bench"
+        HIVED_PERF_MODEL=800m timeout 2400 python -m hivedscheduler_tpu.models.perf
+        echo "[$(date -u +%H:%M:%S)] done; artifacts in example/logs/"
+        break
+    fi
+    sleep 300
+done

@@ -66,12 +66,28 @@ def mfu_fields(flops_per_token: float, tokens_per_sec: float,
     return fields
 
 
+# On-chip model presets (HIVED_PERF_MODEL). "268m" is the historical bench
+# shape; "800m" is the largest AdamW-f32-master config that fits a 16 GB
+# v5e chip: peak HBM ~= 18 B/param (4+4+4 f32 master/mu/nu + 2 bf16
+# compute copy + 4 grads — the grad tree is fully live at the end of the
+# backward scan) + ~0.9 GB saved activations at batch 1 x seq 8192 under
+# the flash remat policy => 795M x 18 B + 0.9 GB ~= 15.2 GB (doc/perf.md
+# memory table). GQA (kv_heads=8 vs 16 heads) trims attention params the
+# same way the 8B flagship does (llama3_8b uses 32/8).
+MODEL_PRESETS = {
+    "268m": dict(d_model=1024, n_layers=12, n_heads=8, n_kv_heads=8,
+                 d_ff=4096, default_batch=2),
+    "800m": dict(d_model=2048, n_layers=12, n_heads=16, n_kv_heads=8,
+                 d_ff=6912, default_batch=1),
+}
+
+
 def bench_config(on_tpu: bool):
-    """Largest flagship config that comfortably fits one chip (f32 master
-    params + adam moments + remat'd activations ~5.5 GB at the TPU shape),
-    with head_dim=128 for MXU/lane alignment; a miniature shape off-TPU so
-    CPU smoke runs finish. ``HIVED_PERF_BATCH``/``HIVED_PERF_SEQ`` override
-    the TPU shape for tuning sweeps without code edits."""
+    """Flagship bench config, env-selectable (``HIVED_PERF_MODEL``: one of
+    MODEL_PRESETS, default "268m") with head_dim=128 for MXU/lane
+    alignment; a miniature shape off-TPU so CPU smoke runs finish.
+    ``HIVED_PERF_BATCH``/``HIVED_PERF_SEQ`` override the shape for tuning
+    sweeps without code edits."""
     import os
 
     import jax.numpy as jnp
@@ -79,15 +95,18 @@ def bench_config(on_tpu: bool):
     from . import transformer
 
     if on_tpu:
-        batch = int(os.environ.get("HIVED_PERF_BATCH", "2"))
+        preset = MODEL_PRESETS[os.environ.get("HIVED_PERF_MODEL", "268m")]
+        batch = int(
+            os.environ.get("HIVED_PERF_BATCH", str(preset["default_batch"]))
+        )
         seq = int(os.environ.get("HIVED_PERF_SEQ", "8192"))
         return transformer.TransformerConfig(
             vocab_size=32768,
-            d_model=1024,
-            n_layers=12,
-            n_heads=8,
-            n_kv_heads=8,
-            d_ff=4096,
+            d_model=preset["d_model"],
+            n_layers=preset["n_layers"],
+            n_heads=preset["n_heads"],
+            n_kv_heads=preset["n_kv_heads"],
+            d_ff=preset["d_ff"],
             max_seq_len=seq,
             dtype=jnp.bfloat16,
             remat=True,
@@ -365,6 +384,74 @@ def bench_zoo(on_tpu: bool) -> dict:
     return out
 
 
+def artifact_path() -> str:
+    """Where successful on-chip runs are persisted (HIVED_PERF_ARTIFACT
+    overrides). Lives under example/logs/ next to the human-readable perf
+    session logs, so the provenance chain is one directory. Non-default
+    model presets get their own file (perf_last_measured_800m.json) so a
+    sizing run never overwrites the headline-shape measurement bench.py
+    re-emits on skip."""
+    import os
+
+    model = os.environ.get("HIVED_PERF_MODEL", "268m")
+    name = (
+        "perf_last_measured.json" if model == "268m"
+        else f"perf_last_measured_{model}.json"
+    )
+    default = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+        "example", "logs", name,
+    )
+    return os.environ.get("HIVED_PERF_ARTIFACT", default)
+
+
+def persist_result(result: dict, on_tpu: bool) -> None:
+    """Persist a successful on-chip measurement (atomically) so bench.py can
+    emit it inline as ``last_measured`` whenever the live TPU path is later
+    unreachable — four rounds of builder-log-only perf evidence is the gap
+    this closes. CPU smoke runs and failed runs never overwrite a real
+    measurement. Best-effort: persistence failure must not fail the run."""
+    import os
+    import subprocess
+
+    if not on_tpu or "tokens_per_sec_per_chip" not in result:
+        return
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        ).stdout.strip() or None
+    except Exception:
+        commit = None
+    record = {
+        **result,
+        "provenance": {
+            "measured_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "git_commit": commit,
+            "recorded_by": "hivedscheduler_tpu.models.perf",
+            "env_overrides": {
+                k: v for k, v in os.environ.items()
+                if k.startswith(("HIVED_PERF_", "HIVED_FLASH_",
+                                 "HIVED_DISABLE_"))
+            },
+        },
+    }
+    try:
+        path = artifact_path()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+    except OSError:
+        pass
+
+
 def main() -> None:
     import os
 
@@ -422,6 +509,7 @@ def main() -> None:
             result["zoo"] = bench_zoo(on_tpu)
         except Exception as exc:  # optional stage: degrade, never crash
             result["zoo"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    persist_result(result, on_tpu)
     print(json.dumps(result))
 
 

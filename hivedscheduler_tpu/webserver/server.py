@@ -63,6 +63,12 @@ class WebServer:
 
 def _make_handler(scheduler: HivedScheduler):
     class Handler(BaseHTTPRequestHandler):
+        # HTTP/1.1 keep-alive: the default scheduler reuses its extender
+        # connection (Go net/http does); HTTP/1.0's close-per-request would
+        # add a TCP setup to every filter call. Every reply sets
+        # Content-Length, which 1.1 requires.
+        protocol_version = "HTTP/1.1"
+
         # Silence per-request stderr lines; structured logging happens in the
         # routines themselves.
         def log_message(self, fmt, *args):  # noqa: N802
@@ -72,9 +78,16 @@ def _make_handler(scheduler: HivedScheduler):
         # Plumbing
         # -------------------------------------------------------------- #
 
-        def _read_json(self) -> Dict:
+        def _drain_body(self) -> bytes:
+            """Read the full request body. MUST run before any reply on a
+            POST: with HTTP/1.1 keep-alive, unread body bytes stay in the
+            stream and the NEXT request on the connection is parsed
+            starting at them (found by review: a 404 on an unknown path
+            desynced every subsequent request of the connection)."""
             length = int(self.headers.get("Content-Length") or 0)
-            body = self.rfile.read(length) if length > 0 else b""
+            return self.rfile.read(length) if length > 0 else b""
+
+        def _parse_json(self, body: bytes) -> Dict:
             if not body:
                 raise api.bad_request("Empty request body")
             try:
@@ -104,9 +117,10 @@ def _make_handler(scheduler: HivedScheduler):
 
         def do_POST(self) -> None:  # noqa: N802
             path = self.path.rstrip("/") or "/"
+            body = self._drain_body()  # always, before any reply (keep-alive)
             try:
                 if path == constants.FILTER_PATH:
-                    args = ei.ExtenderArgs.from_dict(self._read_json())
+                    args = ei.ExtenderArgs.from_dict(self._parse_json(body))
                     # Errors inside filter must be reported in-band in the
                     # Error field so the default scheduler sees them
                     # (reference: serveFilterPath recovers to
@@ -117,14 +131,18 @@ def _make_handler(scheduler: HivedScheduler):
                         result = ei.ExtenderFilterResult(error=e.message)
                     self._reply(200, result.to_dict())
                 elif path == constants.BIND_PATH:
-                    args2 = ei.ExtenderBindingArgs.from_dict(self._read_json())
+                    args2 = ei.ExtenderBindingArgs.from_dict(
+                        self._parse_json(body)
+                    )
                     try:
                         result2 = scheduler.bind_routine(args2)
                     except api.WebServerError as e:
                         result2 = ei.ExtenderBindingResult(error=e.message)
                     self._reply(200, result2.to_dict())
                 elif path == constants.PREEMPT_PATH:
-                    args3 = ei.ExtenderPreemptionArgs.from_dict(self._read_json())
+                    args3 = ei.ExtenderPreemptionArgs.from_dict(
+                        self._parse_json(body)
+                    )
                     # Preempt has no in-band Error field; protocol errors map
                     # to HTTP status codes.
                     result3 = scheduler.preempt_routine(args3)

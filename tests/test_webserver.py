@@ -150,3 +150,31 @@ def test_inspect_not_found(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         get(server, "/nope")
     assert e.value.code == 404
+
+
+def test_keepalive_connection_survives_error_paths(server):
+    """HTTP/1.1 keep-alive: a POST whose handler replies WITHOUT consuming
+    the body (unknown path -> 404) must still drain it, or the leftover
+    bytes desync every later request on the reused connection (found by
+    review; reproduced before the _drain_body fix)."""
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", server.port)
+    body = json.dumps({"junk": "x" * 256})
+    headers = {"Content-Type": "application/json"}
+    # Error-path request with a body the handler never parses.
+    conn.request("POST", "/no/such/path", body, headers)
+    r1 = conn.getresponse()
+    assert r1.status == 404
+    r1.read()
+    # Same connection must still speak clean HTTP afterwards, repeatedly.
+    for _ in range(2):
+        conn.request("POST", constants.BIND_PATH, json.dumps({
+            "PodName": "nope", "PodNamespace": "default",
+            "PodUID": "u-nope", "Node": "tpu-w0",
+        }), headers)
+        r = conn.getresponse()
+        assert r.status == 200
+        payload = json.loads(r.read())
+        assert "Error" in payload  # in-band extender result, not HTML junk
+    conn.close()
