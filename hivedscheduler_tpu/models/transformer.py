@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 
 from ..ops.attention import mha
-from ..parallel import pipeline, ring, sharding
+from ..parallel import pipeline, sharding
 
 Params = Dict[str, Any]
 
@@ -192,10 +192,11 @@ def _block(
     """One pre-norm block. ``sp_manual``: the block is being traced inside a
     shard_map that is already manual over the sp axis (the pp x sp pipeline,
     parallel/pipeline.py seq_axis) — x is the LOCAL sequence shard, so rope
-    positions offset by the shard index, attention goes straight to the
-    ring's local collectives (a nested sp shard_map would be illegal), and
-    sharding constraints that mention the now-manual seq axis are skipped
-    (weight shardings still drive the auto-axes partitioning)."""
+    positions offset by the shard index, attention dispatches through
+    sp_attention_manual (the backends' local collectives — a nested sp
+    shard_map would be illegal), and sharding constraints that mention the
+    now-manual seq axis are skipped (weight shardings still drive the
+    auto-axes partitioning)."""
     c = config
     b, s, d = x.shape
     con = (lambda t, *axes: t) if sp_manual else sharding.constrain
@@ -214,8 +215,8 @@ def _block(
     k = con(k, "batch", "seq", "kv_heads", None)
     v = con(v, "batch", "seq", "kv_heads", None)
     if sp_manual:
-        attn = ring._ring_attention_local(
-            q, k, v, axis_name="sp", causal=True, sm_scale=None
+        attn = sharding.sp_attention_manual(
+            q, k, v, mesh, causal=True, sp_mode=c.sp_mode
         )
     elif use_sp:
         assert mesh is not None
@@ -271,14 +272,6 @@ def forward_hidden(
     sharding.validate_sp_mode(c.sp_mode)
     use_sp = mesh is not None and mesh.shape.get("sp", 1) > 1
     use_pp = mesh is not None and mesh.shape.get("pp", 1) > 1
-    if use_sp and use_pp and c.sp_mode == "ulysses":
-        # Inside the pipeline's manual region only the ring backend runs
-        # (its ppermute/psum are manual-friendly); the Ulysses all-to-all
-        # re-shard assumes GSPMD auto heads/seq axes.
-        raise NotImplementedError(
-            "pp > 1 composes with sp > 1 via ring attention only; "
-            f"sp_mode='ulysses' is not supported (mesh={dict(mesh.shape)})"
-        )
     # Mixed precision: f32 master params -> bf16 compute copies.
     params = jax.tree.map(lambda a: a.astype(c.dtype), params)
     # Vocab-parallel lookup when possible: a plain gather on a tp-sharded

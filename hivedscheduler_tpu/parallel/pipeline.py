@@ -32,10 +32,12 @@ with the visible batch, so microbatching them changes those semantics;
 MoE models parallelize over ``ep`` instead (models/mixtral.py).
 
 Composition: dp/fsdp/tp stay auto alongside pp. Sequence parallelism
-composes via ``seq_axis`` (ring backend only — the Ulysses all-to-all
-re-shard needs auto seq/head axes); verified fwd+bwd against the
-single-device reference in tests/test_models.py::test_pp_x_sp_matches_
-single_device and the dryrun gate's "pp-x-sp" check.
+composes via ``seq_axis`` — the sp axis joins the manual region and the
+blocks dispatch through ``sharding.sp_attention_manual`` (ring ppermute
+loop or Ulysses all_to_alls, both manual-friendly); verified fwd+bwd
+against the single-device reference for BOTH backends in
+tests/test_models.py::test_pp_x_sp_matches_single_device and the dryrun
+gate's "pp-x-sp" check.
 """
 
 from __future__ import annotations
@@ -70,8 +72,8 @@ def pipeline_blocks(
     ``seq_axis``: also make that axis manual in the shard_map and keep the
     activations sequence-sharded over it through the pipeline. The caller's
     ``block_fn`` must then be manual-region aware: run attention via the
-    ring's local collectives (``ring._ring_attention_local``) and offset
-    positional encodings by ``axis_index(seq_axis)`` — see
+    SP backends' local collectives (``sharding.sp_attention_manual``) and
+    offset positional encodings by ``axis_index(seq_axis)`` — see
     models/transformer._block(sp_manual=True).
     """
     p = axes_size(axis, mesh)

@@ -272,12 +272,7 @@ def sp_attention(
 
     validate_sp_mode(sp_mode)
     h, hkv, s = q.shape[2], k.shape[2], q.shape[1]
-    legal = ulysses.can_ulysses(mesh, h, hkv, s)
-    if sp_mode == "ulysses" and not legal:
-        raise ValueError(
-            f"sp_mode='ulysses' but heads/seq do not divide the mesh: "
-            f"heads={h} kv_heads={hkv} seq={s} mesh={dict(mesh.shape)}"
-        )
+    legal = _ulysses_legal_or_raise(mesh, h, hkv, s, sp_mode)
     use_ulysses = sp_mode == "ulysses" or (
         sp_mode == "auto"
         and legal
@@ -289,3 +284,59 @@ def sp_attention(
             q, k, v, mesh, causal=causal, sm_scale=sm_scale
         )
     return ring.ring_attention(q, k, v, mesh, causal=causal, sm_scale=sm_scale)
+
+
+def _ulysses_legal_or_raise(
+    mesh: Mesh, h: int, hkv: int, s_global: int, sp_mode: str
+) -> bool:
+    """Shared legality gate of both sp_attention dispatchers: an explicit
+    sp_mode='ulysses' on an incompatible mesh is a user error."""
+    from . import ulysses
+
+    legal = ulysses.can_ulysses(mesh, h, hkv, s_global)
+    if sp_mode == "ulysses" and not legal:
+        raise ValueError(
+            f"sp_mode='ulysses' but heads/seq do not divide the mesh: "
+            f"heads={h} kv_heads={hkv} seq={s_global} "
+            f"mesh={dict(mesh.shape)}"
+        )
+    return legal
+
+
+def sp_attention_manual(
+    q: jax.Array,  # [B, S/sp, H, D]: the LOCAL seq shard; heads still auto
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    sp_mode: str = "auto",
+) -> jax.Array:
+    """``sp_attention``'s twin for callers ALREADY inside a shard_map that
+    is manual over the sp axis (the pp x sp pipeline,
+    parallel/pipeline.py seq_axis): dispatches straight to the backends'
+    local bodies — the ring ppermute loop or the Ulysses all_to_alls —
+    since nesting another shard_map over sp would be illegal.
+
+    The backend heuristic deliberately differs from ``sp_attention``:
+    batch (dp/fsdp) and heads (tp) stay GSPMD-auto inside the region, and
+    a ``pallas_call`` cannot sit on auto-sharded operands, so Ulysses's
+    usual advantage (flash kernels on the local full sequence) is void
+    here — "auto" therefore always picks ring (whose streaming XLA ops
+    partition fine and keep O(chunk) memory). An explicit
+    sp_mode='ulysses' still runs, with the XLA-reference local attention
+    (exact, partitionable, O(S^2) score memory)."""
+    from . import ring, ulysses
+
+    validate_sp_mode(sp_mode)
+    sp = axes_size("sp", mesh)
+    h, hkv = q.shape[2], k.shape[2]
+    s_global = q.shape[1] * sp  # q holds the local shard here
+    _ulysses_legal_or_raise(mesh, h, hkv, s_global, sp_mode)
+    if sp_mode == "ulysses":
+        return ulysses._ulysses_local(
+            q, k, v, "sp", causal, sm_scale,
+            head_shard_factor=axes_size("tp", mesh),
+            use_pallas=False,
+        )
+    return ring._ring_attention_local(q, k, v, "sp", causal, sm_scale)

@@ -81,12 +81,26 @@ def _ulysses_local(
     axis_name: str,
     causal: bool,
     sm_scale: Optional[float],
+    head_shard_factor: int = 1,
+    use_pallas: Optional[bool] = None,
 ) -> jax.Array:
     """Runs under shard_map. all_to_all to full-sequence/sharded-heads,
-    local (flash-dispatched) attention, all_to_all back."""
+    local (flash-dispatched) attention, all_to_all back.
+
+    ``head_shard_factor``: number of AUTO shards still dividing the head
+    axis. 1 when every mesh axis is manual (ulysses_attention's own
+    shard_map). Inside a partially-manual region (the pp x sp pipeline,
+    where tp stays auto) the traced head dim is the pre-tp global count,
+    so the GQA-repeat decision below must divide it out to see the real
+    per-device head count.
+
+    ``use_pallas``: forwarded to the local ``att.mha``. Partial-manual
+    callers pass False: a ``pallas_call`` cannot sit on operands GSPMD
+    still shards (batch over dp/fsdp, heads over tp) — the XLA reference
+    path partitions fine."""
     sp = jax.lax.psum(1, axis_name)
     hq, hkv = q.shape[2], k.shape[2]
-    if hkv % sp != 0:
+    if (hkv // head_shard_factor) % sp != 0:
         # GQA with fewer KV heads than the sp degree: expand K/V to the Q
         # head count first so both all_to_alls split identically and every
         # device's Q-head subset travels with exactly its own GQA group —
@@ -99,8 +113,10 @@ def _ulysses_local(
     k = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     v = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
     q = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    # Full sequence locally: the Pallas flash kernels dispatch when on TPU.
-    o = att.mha(q, k, v, causal=causal, sm_scale=sm_scale)
+    # Full sequence locally: the Pallas flash kernels dispatch when on TPU
+    # (unless the caller disabled them — see use_pallas above).
+    o = att.mha(q, k, v, causal=causal, sm_scale=sm_scale,
+                use_pallas=use_pallas)
     # Back to sequence-sharded: [b, S, H_tp/sp, D] -> [b, S/sp, H_tp, D].
     return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2, tiled=True)
 

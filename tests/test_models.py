@@ -469,32 +469,38 @@ def test_pipeline_default_microbatches_fits_awkward_batches():
     assert float(jnp.abs(ref - out).max()) < 1e-5
 
 
-def test_pp_x_sp_matches_single_device(tiny_config, tiny_params):
+@pytest.mark.parametrize("sp_mode", ["ring", "ulysses"])
+def test_pp_x_sp_matches_single_device(tiny_config, tiny_params, sp_mode):
     """pp x sp composition: the sp axis joins the pipeline's manual region
-    and the blocks run ring attention's local collectives directly
-    (pipeline_blocks seq_axis / _block sp_manual). Forward AND backward
-    must match the single-device reference — rope offsets, the ring's
-    causal masking across stages, and the cotangent typing through the
-    scan are all load-bearing here."""
+    and the blocks dispatch through sp_attention_manual — the ring's
+    ppermute loop or the Ulysses all_to_alls run directly inside the
+    manual region (pipeline_blocks seq_axis / _block sp_manual). Forward
+    AND backward must match the single-device reference — rope offsets,
+    causal masking across stages, the Ulysses head_shard_factor under
+    auto-tp, and the cotangent typing through the scan are all
+    load-bearing here."""
+    import dataclasses
+
     import numpy as np
 
     from hivedscheduler_tpu.models import train
 
+    config = dataclasses.replace(tiny_config, sp_mode=sp_mode)
     tokens = jnp.zeros((4, 256), dtype=jnp.int32)
-    ref_logits = transformer.forward(tiny_params, tokens, tiny_config)
+    ref_logits = transformer.forward(tiny_params, tokens, config)
     ref_loss, ref_grads = jax.value_and_grad(
-        lambda p: train.next_token_loss(p, tokens, tiny_config, None)
+        lambda p: train.next_token_loss(p, tokens, config, None)
     )(tiny_params)
 
     mesh = pmesh.make_mesh(
         pmesh.MeshConfig(pp=2, sp=2, tp=2), devices=jax.devices()
     )
-    sh = sharding.tree_shardings(mesh, transformer.logical_axes(tiny_config))
+    sh = sharding.tree_shardings(mesh, transformer.logical_axes(config))
     sp_params = jax.device_put(tiny_params, sh)
     st = sharding.shard_batch(tokens, mesh)
     with jax.set_mesh(mesh):
         logits = jax.jit(
-            lambda p, t: transformer.forward(p, t, tiny_config, mesh)
+            lambda p, t: transformer.forward(p, t, config, mesh)
         )(sp_params, st)
         np.testing.assert_allclose(
             np.array(ref_logits), np.array(jax.device_get(logits)),
@@ -502,7 +508,7 @@ def test_pp_x_sp_matches_single_device(tiny_config, tiny_params):
         )
         loss, grads = jax.jit(
             jax.value_and_grad(
-                lambda p, t: train.next_token_loss(p, t, tiny_config, mesh)
+                lambda p, t: train.next_token_loss(p, t, config, mesh)
             )
         )(sp_params, st)
         assert abs(float(loss) - float(ref_loss)) < 5e-3
@@ -514,22 +520,6 @@ def test_pp_x_sp_matches_single_device(tiny_config, tiny_params):
                 np.array(a), np.array(jax.device_get(b)),
                 atol=2e-3, rtol=2e-2, err_msg=str(ka),
             )
-
-
-def test_pp_x_sp_ulysses_is_rejected_clearly(tiny_config, tiny_params):
-    """Only the ring backend composes with the pipeline's manual region;
-    an explicit sp_mode='ulysses' on a pp x sp mesh must refuse up front
-    with an actionable error, not crash mid-trace."""
-    import dataclasses
-
-    mesh = pmesh.make_mesh(
-        pmesh.MeshConfig(pp=2, sp=2, tp=2), devices=jax.devices()
-    )
-    config = dataclasses.replace(tiny_config, sp_mode="ulysses")
-    with pytest.raises(NotImplementedError, match="ring attention only"):
-        transformer.forward(
-            tiny_params, jnp.zeros((2, 64), jnp.int32), config, mesh=mesh
-        )
 
 
 def test_pipeline_property_sweep():
