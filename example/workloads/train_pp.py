@@ -7,7 +7,10 @@ each member one contiguous v5p-16 (fsdp x tp ride that slice's ICI) while
 pp crosses between them. Contrast train_longctx.py, whose ring attention
 must stay inside one slice.
 
-Mesh: pp=2 (one stage per slice) x fsdp x tp within each slice.
+Mesh: pp=2 (one stage per slice) x fsdp x tp within each slice. Pass
+--sp 2 to also shard the sequence: the sp axis joins the pipeline's
+manual region and each stage runs ring attention over its slice's ICI
+(parallel/pipeline.py seq_axis) — pipelined long-context training.
 """
 
 import argparse
@@ -29,6 +32,11 @@ def main():
         help="tiny = smoke-test shapes (CPU virtual mesh)",
     )
     parser.add_argument("--microbatches", type=int, default=None)
+    parser.add_argument(
+        "--sp", type=int, default=1,
+        help="sequence-parallel degree inside each stage (ring attention "
+        "in the pipeline's manual region)",
+    )
     args = parser.parse_args()
 
     bootstrap_distributed()
@@ -40,13 +48,18 @@ def main():
     if n % 2 != 0:
         raise SystemExit(f"pipeline demo needs an even device count, got {n}")
     pp = 2
+    if args.sp < 1 or n % (pp * args.sp) != 0:
+        raise SystemExit(
+            f"--sp {args.sp} must divide the per-stage device count "
+            f"({n} devices / pp={pp})"
+        )
     # tp must divide the KV heads (whole GQA groups per shard); the rest
-    # of each stage's slice is fsdp.
+    # of each stage's slice is fsdp after the requested sp.
     tp = next(
         t for t in (4, 2, 1)
-        if (n // pp) % t == 0 and base.n_kv_heads % t == 0
+        if (n // (pp * args.sp)) % t == 0 and base.n_kv_heads % t == 0
     )
-    fsdp = n // (pp * tp)
+    fsdp = n // (pp * args.sp * tp)
     config = type(base)(**{
         **base.__dict__,
         "max_seq_len": args.seq,
@@ -57,7 +70,9 @@ def main():
             f"pp={pp} stages must divide n_layers={config.n_layers}"
         )
 
-    mesh = pmesh.make_mesh(pmesh.MeshConfig(pp=pp, fsdp=fsdp, tp=tp))
+    mesh = pmesh.make_mesh(
+        pmesh.MeshConfig(pp=pp, sp=args.sp, fsdp=fsdp, tp=tp)
+    )
     print(f"mesh: {dict(mesh.shape)}", flush=True)
     optimizer = train.make_optimizer()
     with jax.set_mesh(mesh):

@@ -158,11 +158,23 @@ def _find_leaf_in_chain(
 ) -> Optional[PhysicalCell]:
     if chain not in full_cell_list:
         return None
-    for c in full_cell_list[chain][LOWEST_LEVEL]:
-        assert isinstance(c, PhysicalCell)
-        if node in c.nodes:
-            if leaf_cell_index < 0 or leaf_cell_index in c.leaf_cell_indices:
-                return c
+    ccl = full_cell_list[chain]
+    # Per-node index, built lazily and cached on the list object: the FULL
+    # cell list's leaf membership is fixed at config-compile time (only
+    # free lists mutate), and every assume-bind replays each pod's leaves
+    # through this lookup — the linear scan over all chain leaves was the
+    # single largest profile entry in the gang-latency bench.
+    cache = getattr(ccl, "_node_leaf_cache", None)
+    if cache is None:
+        cache = {}
+        for c in ccl[LOWEST_LEVEL]:
+            assert isinstance(c, PhysicalCell)
+            for n in c.nodes:
+                cache.setdefault(n, []).append(c)
+        ccl._node_leaf_cache = cache
+    for c in cache.get(node, ()):
+        if leaf_cell_index < 0 or leaf_cell_index in c.leaf_cell_indices:
+            return c
     return None
 
 
