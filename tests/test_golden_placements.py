@@ -107,6 +107,12 @@ def group_state(gname, want):
     return {"op": "group_state", "name": gname, "expect": want}
 
 
+def lazy_status(gname, want):
+    """Row asserting whether a group is currently lazy-preempted (True) or
+    holds its virtual placement (False)."""
+    return {"op": "lazy_status", "name": gname, "expect": want}
+
+
 def check_doomed(vc, chain, level, n_bad):
     """Row asserting how many of the VC's FREE preassigned cells are bad
     (doomed) right now (the doomed-bad-cell visibility contract,
@@ -142,6 +148,9 @@ class Runner:
         if op == "group_state":
             g = self.sim.core.affinity_groups.get(row["name"])
             return ("group_state", "absent" if g is None else g.state.value)
+        if op == "lazy_status":
+            g = self.sim.core.affinity_groups[row["name"]]
+            return ("lazy_status", g.lazy_preemption_status is not None)
         if op == "doomed_count":
             vcs = self.sim.core.vc_schedulers[row["vc"]]
             cells = vcs.non_pinned_preassigned[row["chain"]][row["level"]]
@@ -214,6 +223,9 @@ def run_table(table):
             continue
         if row["op"] == "group_state":
             assert got == ("group_state", want), (i, row["name"], got)
+            continue
+        if row["op"] == "lazy_status":
+            assert got == ("lazy_status", want), (i, row["name"], got)
             continue
         if want[0] == "bind":
             assert got == ("bind", want[1], tuple(want[2])), (
@@ -549,6 +561,48 @@ def test_golden_backtracking_cell_binding():
 
 def test_golden_doomed_bad_cells():
     run_table(DOOMED)
+
+
+LAZY_PREEMPTION = [
+    # A lazy-preemption-enabled 2-pod gang on VC1's v5e-16 quota (fresh
+    # sim: packing opens slice a first).
+    step("z01", "VC1", 0, "v5e-chip", 4, ("bind", "v5e16a-w0", (0, 1, 2, 3)),
+         group=("lzg", 2), lazy=True),
+    step("z02", "VC1", 0, "v5e-chip", 4, ("bind", "v5e16a-w1", (0, 1, 2, 3)),
+         group=("lzg", 2), lazy=True),
+    lazy_status("lzg", False),
+    # A same-host-count higher-priority pod does NOT trigger the downgrade:
+    # it packs into the same virtual cell's free leaves (no leaf overlap).
+    step("z03", "VC1", 5, "v5e-chip", 4, ("bind", "v5e16a-w2", (0, 1, 2, 3))),
+    lazy_status("lzg", False),
+    delete("z03"),
+    # A WHOLE-slice prio-5 gang needs every leaf of VC1's single virtual
+    # v5e-16 — leaf-level overlap with lzg triggers the LAZY path: lzg is
+    # downgraded (keeps running on its exact physical hosts, loses the
+    # virtual placement; its preassigned cell returns to the free pool as
+    # opportunistically-used capacity) and the gang's virtual cell re-binds
+    # to the untouched slice b. No pod is ever evicted.
+    step("z04", "VC1", 5, "v5e-chip", 4, ("bind", "v5e16b-w0", (0, 1, 2, 3)),
+         group=("hpg", 4)),
+    step("z05", "VC1", 5, "v5e-chip", 4, ("bind", "v5e16b-w1", (0, 1, 2, 3)),
+         group=("hpg", 4)),
+    step("z06", "VC1", 5, "v5e-chip", 4, ("bind", "v5e16b-w2", (0, 1, 2, 3)),
+         group=("hpg", 4)),
+    step("z07", "VC1", 5, "v5e-chip", 4, ("bind", "v5e16b-w3", (0, 1, 2, 3)),
+         group=("hpg", 4)),
+    group_state("lzg", "Allocated"),
+    lazy_status("lzg", True),
+    # Slice a (still hosting the downgraded group on w0-w1 at this point)
+    # is where VC2's quota now lives: after lzg's first pod releases w0, a
+    # guaranteed VC2 job lands on slice a — on w2 (z03's earlier hole;
+    # packing prefers it over the just-freed w0).
+    delete("z01"),
+    step("z08", "VC2", 0, "v5e-chip", 4, ("bind", "v5e16a-w2", (0, 1, 2, 3))),
+]
+
+
+def test_golden_lazy_preemption():
+    run_table(LAZY_PREEMPTION)
 
 
 def test_golden_preemption_chain():

@@ -178,3 +178,71 @@ def test_keepalive_connection_survives_error_paths(server):
         payload = json.loads(r.read())
         assert "Error" in payload  # in-band extender result, not HTML junk
     conn.close()
+
+
+def test_concurrent_keepalive_clients(server):
+    """Threaded stress over the live server: N keep-alive connections
+    interleave filter/inspect/error-path requests concurrently. The
+    handlers serialize on the scheduler lock; every response must still be
+    well-formed JSON with the right shape (the ThreadingHTTPServer +
+    HTTP/1.1 + body-drain combination is what this locks in)."""
+    import http.client
+    import threading
+
+    import yaml as _yaml
+
+    nodes = [f"v5e16a-w{i}" for i in range(4)]
+    errors = []
+
+    def client(tid):
+        try:
+            conn = http.client.HTTPConnection("127.0.0.1", server.port)
+            for i in range(12):
+                kind = (tid + i) % 3
+                if kind == 0:  # filter for an uninformed pod: in-band error
+                    spec = _yaml.safe_dump({
+                        "virtualCluster": "VC1", "priority": 0,
+                        "leafCellType": "v5e-chip", "leafCellNumber": 1,
+                    })
+                    body = json.dumps({
+                        "Pod": {"metadata": {
+                            "name": f"t{tid}-{i}", "namespace": "default",
+                            "uid": f"t{tid}-{i}",
+                            "annotations": {
+                                constants.ANNOTATION_POD_SCHEDULING_SPEC:
+                                    spec,
+                            },
+                        }},
+                        "NodeNames": nodes,
+                    })
+                    conn.request("POST", constants.FILTER_PATH, body,
+                                 {"Content-Type": "application/json"})
+                    r = conn.getresponse()
+                    payload = json.loads(r.read())
+                    assert r.status == 200 and "Error" in payload, payload
+                elif kind == 1:  # inspect
+                    conn.request("GET", constants.CLUSTER_STATUS_PATH)
+                    r = conn.getresponse()
+                    payload = json.loads(r.read())
+                    assert r.status == 200, payload
+                    assert "physicalCluster" in payload
+                else:  # error path with an unread body (keep-alive drain)
+                    conn.request("POST", "/bogus", json.dumps({"x": "y" * 64}),
+                                 {"Content-Type": "application/json"})
+                    r = conn.getresponse()
+                    payload = json.loads(r.read())
+                    assert r.status == 404 and payload["code"] == 404, payload
+            conn.close()
+        except Exception as exc:  # noqa: BLE001
+            errors.append(f"t{tid}: {type(exc).__name__}: {exc}")
+
+    threads = [threading.Thread(target=client, args=(t,)) for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    # A wedged server (the regression class this test exists to catch)
+    # leaves clients blocked in getresponse(): fail loudly, don't hang at
+    # teardown with an empty error list.
+    assert not any(t.is_alive() for t in threads), "client threads hung"
+    assert not errors, errors
