@@ -150,37 +150,58 @@ def decode_step(
     return logits[:, 0], cache
 
 
-@functools.partial(
-    jax.jit, static_argnames=("config", "max_new_tokens")
-)
 def generate_greedy_scan(
     params: Params,
     prompt: jax.Array,  # [B, T_prompt]
     config: TransformerConfig,
     max_new_tokens: int,
 ) -> jax.Array:
-    """Greedy generation as ONE compiled program: prefill + a lax.scan over
-    decode steps, cache carried through the scan. Semantically identical to
+    """Greedy generation as ONE compiled program. Semantically identical to
     ``generate(temperature=0)`` but with a single dispatch for the whole
     sequence — the Python-loop version pays per-token dispatch latency,
-    which dominates decode through any remote/tunneled runtime."""
-    b, t = prompt.shape
-    cache = init_cache(config, b, t + max_new_tokens)
-    logits, cache = _forward_cached(params, prompt, cache, config)
-    token = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-
-    def step(carry, _):
-        token, cache = carry
-        logits, cache = _forward_cached(params, token[:, None], cache, config)
-        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
-        return (nxt, cache), nxt
-
-    (_, _), rest = jax.lax.scan(
-        step, (token, cache), None, length=max_new_tokens - 1
+    which dominates decode through any remote/tunneled runtime. Delegates
+    to ``generate_scan``: at temperature 0 the sampling branch compiles to
+    the same argmax program and the key is never consumed."""
+    return generate_scan(
+        params, prompt, config, max_new_tokens,
+        jax.random.PRNGKey(0), temperature=0.0,
     )
-    return jnp.concatenate(
-        [prompt, token[:, None], rest.T.astype(jnp.int32)], axis=1
-    )
+
+
+def sample_logits(
+    logits: jax.Array,  # [..., V]
+    key: jax.Array | None,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Temperature / top-k / top-p (nucleus) sampling; greedy when
+    ``temperature <= 0`` or ``key is None``.
+
+    TPU-friendly static-shape formulation: top-k masks below the k-th
+    logit (``lax.top_k``), top-p masks tokens whose EXCLUSIVE prefix mass
+    in the sorted distribution reaches ``top_p`` (the top-1 token is
+    always kept) — no dynamic shapes, so this jits and scans."""
+    if temperature <= 0.0 or key is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / temperature
+    v = logits.shape[-1]
+    if top_k and top_k < v:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p < 1.0:
+        sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_desc, axis=-1)
+        exclusive_mass = jnp.cumsum(probs, axis=-1) - probs
+        keep = exclusive_mass < top_p
+        # Force-keep the best token: top_p <= 0 would otherwise mask the
+        # whole row and degenerate to UNIFORM sampling over the vocab.
+        keep = keep.at[..., 0].set(True)
+        threshold = jnp.min(
+            jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < threshold, NEG_INF, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
 def generate(
@@ -190,6 +211,8 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     key: jax.Array | None = None,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled generation; returns
     [B, T_prompt + max_new_tokens]."""
@@ -197,21 +220,62 @@ def generate(
     cache = init_cache(config, b, t + max_new_tokens)
     logits, cache = prefill(params, prompt, cache, config)
     out = [prompt]
-    token = _select(logits, temperature, key)
+
+    def next_key():
+        # Split-then-use: sampling must never consume a key that later
+        # derives another (JAX key-reuse discipline) — same schedule shape
+        # as generate_scan's step().
+        nonlocal key
+        if key is None:
+            return None
+        key, sub = jax.random.split(key)
+        return sub
+
+    token = sample_logits(logits, next_key(), temperature, top_k, top_p)
     for i in range(max_new_tokens):
         out.append(token[:, None])
         if i == max_new_tokens - 1:
             break
         logits, cache = decode_step(params, token, cache, config)
-        if key is not None:
-            key = jax.random.split(key, 1)[0]
-        token = _select(logits, temperature, key)
+        token = sample_logits(logits, next_key(), temperature, top_k, top_p)
     return jnp.concatenate(out, axis=1)
 
 
-def _select(logits: jax.Array, temperature: float, key) -> jax.Array:
-    if temperature <= 0.0 or key is None:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(key, logits / temperature, axis=-1).astype(
-        jnp.int32
+@functools.partial(
+    jax.jit,
+    static_argnames=("config", "max_new_tokens", "temperature", "top_k",
+                     "top_p"),
+)
+def generate_scan(
+    params: Params,
+    prompt: jax.Array,  # [B, T_prompt]
+    config: TransformerConfig,
+    max_new_tokens: int,
+    key: jax.Array,
+    temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+) -> jax.Array:
+    """Sampled generation as ONE compiled program (the sampling sibling of
+    ``generate_greedy_scan``): prefill + a lax.scan over decode steps with
+    the PRNG key split inside the scan carry. Temperature/top-k/top-p are
+    static (they select the compiled masking program)."""
+    b, t = prompt.shape
+    cache = init_cache(config, b, t + max_new_tokens)
+    logits, cache = _forward_cached(params, prompt, cache, config)
+    key, sub = jax.random.split(key)
+    token = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
+
+    def step(carry, _):
+        token, cache, key = carry
+        logits, cache = _forward_cached(params, token[:, None], cache, config)
+        key, sub = jax.random.split(key)
+        nxt = sample_logits(logits[:, 0], sub, temperature, top_k, top_p)
+        return (nxt, cache, key), nxt
+
+    (_, _, _), rest = jax.lax.scan(
+        step, (token, cache, key), None, length=max_new_tokens - 1
+    )
+    return jnp.concatenate(
+        [prompt, token[:, None], rest.T.astype(jnp.int32)], axis=1
     )

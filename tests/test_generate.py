@@ -130,3 +130,84 @@ def test_decode_under_tp_mesh_matches_single_device():
         np.array(ref_logits), np.array(jax.device_get(got_logits)),
         atol=5e-4, rtol=5e-3,
     )
+
+
+def test_sample_logits_top_k_and_top_p_masks():
+    """top-k restricts support to the k best ids; top-p to the smallest
+    prefix of the sorted distribution reaching p mass (top-1 always kept);
+    temperature<=0 is exact greedy regardless of the masks."""
+    logits = jnp.log(jnp.array(
+        [[0.45, 0.30, 0.15, 0.06, 0.04],
+         [0.96, 0.01, 0.01, 0.01, 0.01]]
+    ))
+    # Greedy path ignores key and masks.
+    out = generate.sample_logits(logits, None, temperature=0.0, top_k=2)
+    assert out.tolist() == [0, 0]
+    # top_k=2: only ids {0,1} (row 0) / {0, any-tied} ever sampled.
+    seen0 = set()
+    for i in range(200):
+        tok = generate.sample_logits(
+            logits, jax.random.PRNGKey(i), temperature=1.0, top_k=2
+        )
+        seen0.add(int(tok[0]))
+        assert int(tok[0]) in (0, 1)
+    assert seen0 == {0, 1}  # both survivors actually reachable
+    # top_p=0.5 on row 0: exclusive prefix mass {0: 0.0, 1: 0.45, 2: 0.75}
+    # -> ids {0,1} survive. Row 1: 0.96 alone covers p; only id 0 survives.
+    for i in range(200):
+        tok = generate.sample_logits(
+            logits, jax.random.PRNGKey(1000 + i), temperature=1.0, top_p=0.5
+        )
+        assert int(tok[0]) in (0, 1)
+        assert int(tok[1]) == 0
+    # top_p=1.0 / top_k=V leave the distribution untouched: every id
+    # reachable on the flat-ish row 0.
+    seen = set()
+    for i in range(400):
+        tok = generate.sample_logits(
+            logits, jax.random.PRNGKey(2000 + i), temperature=1.0,
+            top_k=5, top_p=1.0,
+        )
+        seen.add(int(tok[0]))
+    assert seen == {0, 1, 2, 3, 4}
+
+
+def test_generate_scan_sampled_deterministic_and_in_vocab():
+    """The one-dispatch sampled scan: deterministic for a fixed key,
+    prompt prefix preserved, tokens within vocab, and key-sensitive.
+    (Exact token parity with generate() is not asserted: the Python loop
+    re-splits per host-loop step while the scan splits in the carry, so
+    the two key schedules legitimately differ.)"""
+    config = transformer.tiny()
+    params = transformer.init(config, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                config.vocab_size)
+    out1 = generate.generate_scan(
+        params, prompt, config, 6, jax.random.PRNGKey(7),
+        temperature=0.8, top_k=50, top_p=0.9,
+    )
+    out2 = generate.generate_scan(
+        params, prompt, config, 6, jax.random.PRNGKey(7),
+        temperature=0.8, top_k=50, top_p=0.9,
+    )
+    assert out1.shape == (2, 14)
+    assert (out1 == out2).all()
+    assert (out1[:, :8] == prompt).all()
+    assert int(out1.max()) < config.vocab_size and int(out1.min()) >= 0
+    # A different key changes the continuation (overwhelmingly likely).
+    out3 = generate.generate_scan(
+        params, prompt, config, 6, jax.random.PRNGKey(8),
+        temperature=0.8, top_k=50, top_p=0.9,
+    )
+    assert not (out1 == out3).all()
+
+
+def test_sample_logits_top_p_zero_is_near_greedy():
+    """top_p=0.0 (maximally restrictive) must keep exactly the best token,
+    never degenerate to uniform sampling over a fully-masked row."""
+    logits = jnp.log(jnp.array([[0.45, 0.30, 0.15, 0.06, 0.04]]))
+    for i in range(50):
+        tok = generate.sample_logits(
+            logits, jax.random.PRNGKey(i), temperature=1.0, top_p=0.0
+        )
+        assert int(tok[0]) == 0
