@@ -14,7 +14,7 @@ from typing import Any, Callable, Iterable, Iterator, Optional
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding
 
 from ..parallel import sharding
 
@@ -37,24 +37,79 @@ class TokenFileDataset:
                 f"{seq_len + 1}"
             )
 
-    def batches(
+    def sample_indices(
         self, batch_size: int, seed: int = 0, epochs: Optional[int] = None
     ) -> Iterator[np.ndarray]:
-        """Yield [batch, seq_len+1] int32 batches, shuffled per epoch."""
+        """Yield per-batch sample-index arrays, shuffled per epoch.
+        Deterministic in ``seed``: every process of a gang derives the
+        identical order (the basis of ``sharded_batches``)."""
         rng = np.random.default_rng(seed)
         epoch = 0
         while epochs is None or epoch < epochs:
             order = rng.permutation(self.n_samples)
             for start in range(0, self.n_samples - batch_size + 1, batch_size):
-                idx = order[start:start + batch_size]
-                batch = np.stack(
-                    [
-                        self.tokens[i * self.seq_len:(i + 1) * self.seq_len + 1]
-                        for i in idx
-                    ]
-                )
-                yield batch.astype(np.int32)
+                yield order[start:start + batch_size]
             epoch += 1
+
+    def gather(self, idx: np.ndarray) -> np.ndarray:
+        """Materialize the [len(idx), seq_len+1] int32 rows for ``idx``."""
+        return np.stack(
+            [
+                self.tokens[i * self.seq_len:(i + 1) * self.seq_len + 1]
+                for i in idx
+            ]
+        ).astype(np.int32)
+
+    def batches(
+        self, batch_size: int, seed: int = 0, epochs: Optional[int] = None
+    ) -> Iterator[np.ndarray]:
+        """Yield [batch, seq_len+1] int32 batches, shuffled per epoch."""
+        for idx in self.sample_indices(batch_size, seed, epochs):
+            yield self.gather(idx)
+
+
+def sharded_batches(
+    dataset: TokenFileDataset,
+    global_batch: int,
+    mesh: Mesh,
+    seed: int = 0,
+    epochs: Optional[int] = None,
+) -> Iterator[jax.Array]:
+    """Multi-host input pipeline: yield GLOBAL [global_batch, seq+1]
+    jax.Arrays of which this process materializes only its own rows.
+
+    Every process draws the same deterministic sample order (shared
+    ``seed`` — the scheduler's bind-time env guarantees gang members can
+    agree on one without coordination) and slices its contiguous
+    ``global_batch / process_count`` row range; the global array is
+    assembled with ``jax.make_array_from_process_local_data``, so no host
+    ever holds (or reads from disk) more than its shard. Single-process
+    degenerates to a device_put of the full batch. The reference has no
+    input pipeline at all (it schedules; workloads bring their own) — this
+    is the TPU-native equivalent of per-rank dataset sharding in its
+    example workloads' TF parameter-server jobs.
+
+    The process layout comes strictly from the live runtime
+    (``jax.process_index/process_count``): it must agree with what
+    ``make_array_from_process_local_data`` uses to place the rows, so it
+    is not overridable."""
+    pi = jax.process_index()
+    pc = jax.process_count()
+    if global_batch % pc != 0:
+        raise ValueError(
+            f"global_batch={global_batch} not divisible by "
+            f"process_count={pc}"
+        )
+    local = global_batch // pc
+    ns = NamedSharding(mesh, sharding.spec_for(("batch", "seq")))
+    global_shape = (global_batch, dataset.seq_len + 1)
+    for idx in dataset.sample_indices(global_batch, seed, epochs):
+        # Slice the shared order FIRST: only this process's rows are ever
+        # read from the memmap or held in host memory.
+        local_rows = dataset.gather(idx[pi * local:(pi + 1) * local])
+        yield jax.make_array_from_process_local_data(
+            ns, local_rows, global_shape
+        )
 
 
 def prefetch_to_mesh(

@@ -1,0 +1,56 @@
+"""Shared harness for tests that spawn real OS processes running
+jax.distributed workers (test_env_multiproc, test_train_infra)."""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from typing import List, Sequence
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_workers(
+    worker_path: str,
+    argv_per_worker: Sequence[Sequence[str]],
+    timeout: int = 180,
+) -> List[dict]:
+    """Spawn one python process per argv list, with the parent's virtual
+    8-device mesh scrubbed from the environment (each worker controls its
+    own backend), wait for all, and parse each worker's LAST stdout line
+    as JSON. On any failure the remaining workers are reaped — one worker
+    dying leaves its peers blocked inside jax.distributed.initialize."""
+    child_env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker_path, *argv],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            env=child_env,
+        )
+        for argv in argv_per_worker
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, (p.returncode, err[-2000:])
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    return outs

@@ -1,0 +1,71 @@
+"""Worker process for the multi-host sharded-input test.
+
+Boots ``jax.distributed`` (2 processes x 4 virtual CPU devices = one
+8-device global mesh), iterates ``utils.data.sharded_batches`` over a
+shared token file — each process materializing ONLY its own rows — and
+reduces the assembled global batch with a jitted sum, which forces the
+cross-process sharded execution. Prints one JSON line:
+{"pid", "totals": [sum per batch], "shape"}.
+
+Run as: python _sharded_data_worker.py <pid> <num> <port> <token-file>
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    pid, num, port, path = (
+        int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num,
+        process_id=pid,
+    )
+    assert jax.process_count() == num
+    assert len(jax.devices()) == 4 * num  # global devices
+
+    import numpy as np
+
+    from hivedscheduler_tpu.parallel import mesh as pmesh
+    from hivedscheduler_tpu.utils import data
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = pmesh.make_mesh(
+        pmesh.MeshConfig(fsdp=len(jax.devices())), devices=jax.devices()
+    )
+    ds = data.TokenFileDataset(path, seq_len=16, dtype=np.uint16)
+    row_sums = []
+    shape = None
+    # Per-GLOBAL-ROW sums, replicated to every process: positional, so a
+    # batch assembled with rows at the wrong global positions (correct
+    # content, wrong placement) changes the output — a plain total would
+    # be permutation-invariant and mask exactly that bug.
+    per_row = jax.jit(
+        lambda x: x.astype("int32").sum(axis=1),
+        out_shardings=NamedSharding(mesh, P()),
+    )
+    for batch in data.sharded_batches(ds, global_batch=8, mesh=mesh,
+                                      seed=7, epochs=1):
+        shape = list(batch.shape)
+        row_sums.append(np.asarray(jax.device_get(per_row(batch))).tolist())
+    print(json.dumps({"pid": pid, "row_sums": row_sums, "shape": shape}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,26 +11,18 @@ forms a working distributed runtime.
 import json
 import logging
 import os
-import socket
-import subprocess
-import sys
 
 import yaml
 
 from hivedscheduler_tpu import common
 from hivedscheduler_tpu.api import constants
 
+from ._multiproc import free_port, run_workers
 from .test_core import Sim, make_pod
 
 common.init_logging(logging.ERROR)
 
 GANG_SIZE = 2
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def test_gang_env_blocks_boot_a_real_jax_distributed_runtime():
@@ -53,38 +45,9 @@ def test_gang_env_blocks_boot_a_real_jax_distributed_runtime():
     assert len({e["JAX_COORDINATOR_ADDRESS"] for e in envs}) == 1
     assert all(int(e["JAX_NUM_PROCESSES"]) == GANG_SIZE for e in envs)
 
-    port = _free_port()
+    port = free_port()
     worker = os.path.join(os.path.dirname(__file__), "_env_contract_worker.py")
-    # A clean env per process: the conftest's 8-device virtual mesh must not
-    # leak in (each worker is one process = one CPU device).
-    child_env = {
-        k: v
-        for k, v in os.environ.items()
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
-    }
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, json.dumps(e), str(port)],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-            env=child_env,
-        )
-        for e in envs
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=180)
-            assert p.returncode == 0, (p.returncode, err[-2000:])
-            outs.append(json.loads(out.strip().splitlines()[-1]))
-    finally:
-        # One worker failing leaves its peers blocked inside
-        # jax.distributed.initialize — reap them or they outlive the test.
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-                p.communicate()
+    outs = run_workers(worker, [[json.dumps(e), str(port)] for e in envs])
 
     roster = list(range(GANG_SIZE))
     assert sorted(o["pid"] for o in outs) == roster
