@@ -1,5 +1,13 @@
 """BASELINE config 4: Llama-3-8B pretrain on a v5p-64 gang (64 chips:
-fsdp=8 x sp=2 x tp=4 — long-context ring attention over sp)."""
+fsdp=8 x sp=2 x tp=4 — long-context ring attention over sp).
+
+``--data tokens.bin`` switches from synthetic tokens to the multi-host
+sharded input pipeline (utils/data.sharded_batches + async prefetch):
+every gang member reads only its addressable box of each global batch —
+its devices' batch rows, and only its sequence columns when sp spans
+hosts — with the shared sample order derived from the seed; no input
+coordination, the same property as the scheduler's bind-time env
+contract."""
 
 import argparse
 
@@ -15,6 +23,9 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--opportunistic", action="store_true")
     parser.add_argument("--steps", type=int, default=50)
+    parser.add_argument("--data", default=None,
+                        help="flat uint16 token file (memmap'd); omit for "
+                        "synthetic tokens")
     args = parser.parse_args()
 
     bootstrap_distributed()
@@ -31,16 +42,36 @@ def main():
             config, mesh, jax.random.PRNGKey(0), optimizer
         )
         step = train.make_train_step(config, mesh, optimizer, param_sh, opt_sh)
-        key = jax.random.PRNGKey(1)
         batch = 1 * cfg.dp * cfg.fsdp
-        for i in range(args.steps):
-            key, k = jax.random.split(key)
-            tokens = sharding.shard_batch(
-                synthetic_tokens(k, batch, config.max_seq_len,
-                                 config.vocab_size),
+        if args.data:
+            from hivedscheduler_tpu.utils import data as data_mod
+
+            # Samples are seq_len+1 wide (the +1 is the shifted next-token
+            # target next_token_loss derives internally), so seq_len-1
+            # keeps the batch width exactly max_seq_len — divisible by the
+            # sp sharding, no slicing of the assembled global array.
+            ds = data_mod.TokenFileDataset(args.data, config.max_seq_len - 1)
+            batches = data_mod.prefetch_to_mesh(
+                # sharded_batches yields ready global arrays; prefetch just
+                # pipelines the host-side gather ahead of the step.
+                data_mod.sharded_batches(ds, batch, mesh, seed=1),
                 mesh,
+                put=lambda b, _mesh: b,
             )
-            params, opt_state, loss = step(params, opt_state, tokens)
+        else:
+            def _synthetic():
+                key = jax.random.PRNGKey(1)
+                while True:
+                    key, k = jax.random.split(key)
+                    yield sharding.shard_batch(
+                        synthetic_tokens(k, batch, config.max_seq_len,
+                                         config.vocab_size),
+                        mesh,
+                    )
+
+            batches = _synthetic()
+        for i in range(args.steps):
+            params, opt_state, loss = step(params, opt_state, next(batches))
             print(f"step {i} loss {float(loss):.4f}", flush=True)
 
 

@@ -43,6 +43,13 @@ class TokenFileDataset:
         """Yield per-batch sample-index arrays, shuffled per epoch.
         Deterministic in ``seed``: every process of a gang derives the
         identical order (the basis of ``sharded_batches``)."""
+        if batch_size > self.n_samples:
+            # Would otherwise yield nothing and, with epochs=None, spin
+            # forever re-permuting — fail fast with the actual cause.
+            raise ValueError(
+                f"batch_size={batch_size} > {self.n_samples} samples in "
+                "the dataset"
+            )
         rng = np.random.default_rng(seed)
         epoch = 0
         while epochs is None or epoch < epochs:
@@ -68,6 +75,48 @@ class TokenFileDataset:
             yield self.gather(idx)
 
 
+def _addressable_box(
+    ns: NamedSharding, global_shape: tuple
+) -> tuple:
+    """This process's addressable region of a 2-D NamedSharding as one
+    contiguous box ((row_lo, row_hi), (col_lo, col_hi)).
+
+    Derived from the sharding itself (``devices_indices_map``), never from
+    an assumed process->rows mapping: in multi-host meshes a process's
+    devices can sit at ANY batch block, and the sequence axis (sp) can
+    cross process boundaries too. Raises when the addressable region is
+    not a box (a layout interleaving this process's devices
+    non-contiguously), which per-process materialization cannot serve."""
+    imap = ns.devices_indices_map(global_shape)
+    rows, cols = set(), set()
+    for d in ns.addressable_devices:
+        r, c = imap[d]
+        rows.add((r.start or 0,
+                  global_shape[0] if r.stop is None else r.stop))
+        cols.add((c.start or 0,
+                  global_shape[1] if c.stop is None else c.stop))
+
+    def _contiguous(spans, what):
+        spans = sorted(spans)
+        for (a0, b0), (a1, b1) in zip(spans, spans[1:]):
+            if b0 != a1:
+                raise ValueError(
+                    f"process-addressable {what} spans {spans} are not "
+                    "contiguous; choose a process-aligned mesh layout for "
+                    "sharded_batches"
+                )
+        return spans[0][0], spans[-1][1]
+
+    if len(rows) * len(cols) != len(
+        {imap[d] for d in ns.addressable_devices}
+    ):
+        raise ValueError(
+            "process-addressable shards do not form a box; choose a "
+            "process-aligned mesh layout for sharded_batches"
+        )
+    return _contiguous(rows, "rows"), _contiguous(cols, "cols")
+
+
 def sharded_batches(
     dataset: TokenFileDataset,
     global_batch: int,
@@ -76,37 +125,28 @@ def sharded_batches(
     epochs: Optional[int] = None,
 ) -> Iterator[jax.Array]:
     """Multi-host input pipeline: yield GLOBAL [global_batch, seq+1]
-    jax.Arrays of which this process materializes only its own rows.
+    jax.Arrays of which this process materializes only its own region.
 
     Every process draws the same deterministic sample order (shared
     ``seed`` — the scheduler's bind-time env guarantees gang members can
-    agree on one without coordination) and slices its contiguous
-    ``global_batch / process_count`` row range; the global array is
-    assembled with ``jax.make_array_from_process_local_data``, so no host
-    ever holds (or reads from disk) more than its shard. Single-process
-    degenerates to a device_put of the full batch. The reference has no
-    input pipeline at all (it schedules; workloads bring their own) — this
-    is the TPU-native equivalent of per-rank dataset sharding in its
-    example workloads' TF parameter-server jobs.
-
-    The process layout comes strictly from the live runtime
-    (``jax.process_index/process_count``): it must agree with what
-    ``make_array_from_process_local_data`` uses to place the rows, so it
-    is not overridable."""
-    pi = jax.process_index()
-    pc = jax.process_count()
-    if global_batch % pc != 0:
-        raise ValueError(
-            f"global_batch={global_batch} not divisible by "
-            f"process_count={pc}"
-        )
-    local = global_batch // pc
+    agree on one without coordination) and materializes exactly its
+    ADDRESSABLE box of the global array — the batch rows its devices own
+    (any block, not an assumed contiguous range) and, when the sequence
+    axis is sharded across processes too (sp spanning hosts), only that
+    column range; the global array is assembled with
+    ``jax.make_array_from_process_local_data``, so no host reads from
+    disk or holds more than its region. Single-process degenerates to a
+    device_put of the full batch. The reference has no input pipeline at
+    all (it schedules; workloads bring their own) — this is the
+    TPU-native equivalent of per-rank dataset sharding in its example
+    workloads' TF parameter-server jobs."""
     ns = NamedSharding(mesh, sharding.spec_for(("batch", "seq")))
     global_shape = (global_batch, dataset.seq_len + 1)
+    (row_lo, row_hi), (col_lo, col_hi) = _addressable_box(ns, global_shape)
     for idx in dataset.sample_indices(global_batch, seed, epochs):
-        # Slice the shared order FIRST: only this process's rows are ever
+        # Slice the shared order FIRST: only this process's region is ever
         # read from the memmap or held in host memory.
-        local_rows = dataset.gather(idx[pi * local:(pi + 1) * local])
+        local_rows = dataset.gather(idx[row_lo:row_hi])[:, col_lo:col_hi]
         yield jax.make_array_from_process_local_data(
             ns, local_rows, global_shape
         )

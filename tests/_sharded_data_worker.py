@@ -1,13 +1,15 @@
-"""Worker process for the multi-host sharded-input test.
+"""Worker process for the multi-host sharded-input tests.
 
-Boots ``jax.distributed`` (2 processes x 4 virtual CPU devices = one
-8-device global mesh), iterates ``utils.data.sharded_batches`` over a
-shared token file — each process materializing ONLY its own rows — and
-reduces the assembled global batch with a jitted sum, which forces the
-cross-process sharded execution. Prints one JSON line:
-{"pid", "totals": [sum per batch], "shape"}.
+Boots ``jax.distributed``, builds the requested mesh layout over the
+global devices, iterates ``utils.data.sharded_batches`` over a shared
+token file — each process materializing ONLY its addressable box — and
+reports POSITIONAL per-global-row sums (replicated via out_shardings),
+so rows assembled at the wrong global position turn the parent's
+comparison red. Prints one JSON line:
+{"pid", "row_sums": [[...] per batch], "shape"}.
 
 Run as: python _sharded_data_worker.py <pid> <num> <port> <token-file>
+        <devices-per-proc> <layout: fsdp | fsdp_sp> [seq-len]
 """
 
 import json
@@ -21,10 +23,12 @@ def main() -> None:
     pid, num, port, path = (
         int(sys.argv[1]), int(sys.argv[2]), sys.argv[3], sys.argv[4]
     )
+    dev_per_proc = int(sys.argv[5]) if len(sys.argv) > 5 else 4
+    layout = sys.argv[6] if len(sys.argv) > 6 else "fsdp"
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=4"
+        + f" --xla_force_host_platform_device_count={dev_per_proc}"
     ).strip()
 
     import jax
@@ -36,19 +40,22 @@ def main() -> None:
         process_id=pid,
     )
     assert jax.process_count() == num
-    assert len(jax.devices()) == 4 * num  # global devices
+    n_global = dev_per_proc * num
+    assert len(jax.devices()) == n_global
 
     import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from hivedscheduler_tpu.parallel import mesh as pmesh
     from hivedscheduler_tpu.utils import data
 
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    mesh = pmesh.make_mesh(
-        pmesh.MeshConfig(fsdp=len(jax.devices())), devices=jax.devices()
-    )
-    ds = data.TokenFileDataset(path, seq_len=16, dtype=np.uint16)
+    if layout == "fsdp_sp":
+        cfg = pmesh.MeshConfig(fsdp=n_global // 2, sp=2)
+    else:
+        cfg = pmesh.MeshConfig(fsdp=n_global)
+    mesh = pmesh.make_mesh(cfg, devices=jax.devices())
+    seq_len = int(sys.argv[7]) if len(sys.argv) > 7 else 16
+    ds = data.TokenFileDataset(path, seq_len=seq_len, dtype=np.uint16)
     row_sums = []
     shape = None
     # Per-GLOBAL-ROW sums, replicated to every process: positional, so a

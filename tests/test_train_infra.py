@@ -209,7 +209,8 @@ def test_sharded_batches_across_real_processes(tmp_path):
     worker = os.path.join(os.path.dirname(__file__), "_sharded_data_worker.py")
     outs = run_workers(
         worker,
-        [[str(pid), "2", str(port), str(path)] for pid in range(2)],
+        [[str(pid), "2", str(port), str(path), "4", "fsdp"]
+         for pid in range(2)],
     )
 
     assert all(o["shape"] == [8, 17] for o in outs)
@@ -217,6 +218,46 @@ def test_sharded_batches_across_real_processes(tmp_path):
     assert outs[0]["row_sums"] == outs[1]["row_sums"]
     # ...whose rows sit at exactly the shared-seed reference positions.
     ds = data.TokenFileDataset(str(path), seq_len=16)
+    expect = [
+        b.astype(np.int64).sum(axis=1).tolist()
+        for b in ds.batches(8, seed=7, epochs=1)
+    ]
+    assert outs[0]["row_sums"] == expect and len(expect) > 0
+
+
+def test_sharded_batches_when_seq_axis_crosses_processes(tmp_path):
+    """4 processes x 1 device on an fsdp=2 x sp=2 mesh: each process's
+    addressable region is a QUARTER box (half the rows x half the seq
+    columns). sharded_batches must derive that box from the sharding —
+    the assumed-contiguous-rows formulation cannot serve this layout —
+    and the assembled global arrays must still match the reference row
+    for row."""
+    import os
+
+    import numpy as np
+
+    from hivedscheduler_tpu.utils import data
+
+    from ._multiproc import free_port, run_workers
+
+    path = tmp_path / "tokens.bin"
+    rng = np.random.default_rng(2)
+    rng.integers(0, 500, size=2048, dtype=np.uint16).tofile(path)
+
+    port = free_port()
+    worker = os.path.join(os.path.dirname(__file__), "_sharded_data_worker.py")
+    outs = run_workers(
+        worker,
+        # seq_len 15 -> sample width 16, divisible by sp=2 (the +1 target
+        # column is part of the sharded width).
+        [[str(pid), "4", str(port), str(path), "1", "fsdp_sp", "15"]
+         for pid in range(4)],
+        timeout=240,
+    )
+
+    assert all(o["shape"] == [8, 16] for o in outs)
+    assert all(o["row_sums"] == outs[0]["row_sums"] for o in outs)
+    ds = data.TokenFileDataset(str(path), seq_len=15)
     expect = [
         b.astype(np.int64).sum(axis=1).tolist()
         for b in ds.batches(8, seed=7, epochs=1)
