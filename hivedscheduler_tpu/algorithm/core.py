@@ -424,6 +424,19 @@ class HivedCore:
         self.chain_to_leaf_type = cc.chain_to_leaf_type
         self.affinity_groups: Dict[str, AffinityGroup] = {}
 
+        # Validate every VC-referenced chain against the physical cluster
+        # BEFORE constructing the intra-VC schedulers: an unknown chain
+        # (e.g. a dotted quota type naming a nonexistent top cell) would
+        # otherwise escape as a raw KeyError from scheduler construction
+        # instead of the reference's user error (hived_algorithm.go:374-380).
+        for vc, vc_free in self.vc_free_cell_num.items():
+            for chain in vc_free:
+                if chain not in self.full_cell_list:
+                    raise api.bad_request(
+                        f"Illegal initial VC assignment: Chain {chain} "
+                        "does not exist in physical cluster"
+                    )
+
         self.vc_schedulers: Dict[api.VirtualClusterName, IntraVCScheduler] = {
             vc: IntraVCScheduler(
                 cc.virtual_non_pinned_full[vc],

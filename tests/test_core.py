@@ -719,3 +719,68 @@ def test_unbound_virtual_cell_scored_by_bound_ancestor():
         phase=SchedulingPhase.PREEMPTING, suggested=["v5p64-w13"],
     )
     assert a3.node_name == "v5p64-w13"
+
+
+def test_illegal_initial_vc_assignment_is_a_user_error():
+    """Over-subscribed VC quotas must be rejected at construction with the
+    reference's 'Illegal initial VC assignment' user error (a config
+    mistake, not a crash loop) — hived_algorithm_test.go:1094-1106."""
+    # Quota exceeding physical capacity: VC1 wants 3 v5e-16, only 2 exist.
+    cfg = tpu_design_config()
+    for vc_cell in cfg.virtual_clusters["VC1"].virtual_cells:
+        if vc_cell.cell_type == "v5e-16":
+            vc_cell.cell_number = 3
+    with pytest.raises(api.WebServerError, match="Illegal initial VC") as e:
+        HivedCore(cfg)
+    assert e.value.code == 400
+
+    # Undefined cell type: caught by the config compiler.
+    cfg2 = tpu_design_config()
+    cfg2.virtual_clusters["VC1"].virtual_cells.append(
+        api.VirtualCellSpec(cell_number=1, cell_type="no-such-type")
+    )
+    with pytest.raises(api.WebServerError, match="not found in cell types") as e2:
+        HivedCore(cfg2)
+    assert e2.value.code == 400
+
+    # Dotted quota type naming a chain with no physical cells: must be the
+    # same user error, not a raw KeyError from scheduler construction
+    # (found by review: the chain guard ran after IntraVCScheduler init).
+    cfg3 = tpu_design_config()
+    cfg3.physical_cluster.cell_types["ghost-16"] = api.CellTypeSpec(
+        child_cell_type="v5e-host", child_cell_number=4
+    )
+    cfg3.virtual_clusters["VC1"].virtual_cells.append(
+        api.VirtualCellSpec(cell_number=1, cell_type="ghost-16")
+    )
+    with pytest.raises(
+        api.WebServerError, match="Illegal initial VC assignment: Chain"
+    ) as e3:
+        HivedCore(cfg3)
+    assert e3.value.code == 400
+
+
+def test_safe_relaxed_buddy_safety_panic():
+    """safe_relaxed_buddy_alloc must raise the internal 'VC Safety Broken'
+    error when the bookkeeping claims more quota-reserved cells at a level
+    than the free list holds (splittable < 0) — the state the triple
+    bookkeeping exists to make impossible (reference's safety panic case,
+    hived_algorithm_test.go:1001-1040)."""
+    from hivedscheduler_tpu.algorithm import allocation
+    from hivedscheduler_tpu.algorithm.group import BindingPathVertex
+
+    core = HivedCore(tpu_design_config())
+    chain = "v5e-16"
+    free_list = core.free_cell_list[chain]
+    top = free_list.top_level
+    vcs = core.vc_schedulers["VC1"]
+    vc_cell = vcs.non_pinned_preassigned[chain][top][0]
+    vertex = BindingPathVertex(vc_cell)
+    # Corrupted counters: claim 3 reserved top-level cells while the free
+    # list holds 2 -> splittable = -1 at the top level.
+    with pytest.raises(api.WebServerError, match="Safety Broken") as e:
+        allocation.safe_relaxed_buddy_alloc(
+            vertex, free_list, {top: len(free_list[top]) + 1},
+            top - 1, None, True, {},
+        )
+    assert e.value.code >= 500  # internal invariant, not a user error
