@@ -74,8 +74,13 @@ def _block_cached(
     v_cache: jax.Array,
     pos: jax.Array,
     config: TransformerConfig,
+    ffn=None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """One decoder block over cached KV; returns (x, new_k, new_v)."""
+    """One decoder block over cached KV; returns (x, new_k, new_v).
+
+    ``ffn``: optional hook ``(h_normed, layer) -> out`` replacing the
+    dense SwiGLU — how the MoE family reuses this exact attention-cache
+    machinery (mixtral.decode_ffn)."""
     c = config
     b, t, d = x.shape
     h = rms_norm(x, layer["ln1"])
@@ -90,10 +95,13 @@ def _block_cached(
     attn = _attend_cached(q, k_cache, v_cache, pos, c)
     x = x + attn.reshape(b, t, c.n_heads * c.head_dim) @ layer["wo"]
     hh = rms_norm(x, layer["ln2"])
-    ffn = (jax.nn.silu(hh @ layer["w_gate"]) * (hh @ layer["w_up"])) @ layer[
-        "w_down"
-    ]
-    return x + ffn, k_cache, v_cache
+    if ffn is None:
+        out = (
+            jax.nn.silu(hh @ layer["w_gate"]) * (hh @ layer["w_up"])
+        ) @ layer["w_down"]
+    else:
+        out = ffn(hh, layer)
+    return x + out, k_cache, v_cache
 
 
 def _forward_cached(
@@ -101,6 +109,7 @@ def _forward_cached(
     tokens: jax.Array,  # [B, T]
     cache: KVCache,
     config: TransformerConfig,
+    ffn=None,
 ) -> Tuple[jax.Array, KVCache]:
     c = config
     params = jax.tree.map(lambda a: a.astype(c.dtype), params)
@@ -109,7 +118,7 @@ def _forward_cached(
 
     def block(x, layer_and_cache):
         layer, k_c, v_c = layer_and_cache
-        x, k_c, v_c = _block_cached(x, layer, k_c, v_c, pos, c)
+        x, k_c, v_c = _block_cached(x, layer, k_c, v_c, pos, c, ffn)
         return x, (k_c, v_c)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -126,27 +135,31 @@ def _forward_cached(
     return logits.astype(jnp.float32), new_cache
 
 
-@functools.partial(jax.jit, static_argnames=("config",))
+@functools.partial(jax.jit, static_argnames=("config", "ffn"))
 def prefill(
     params: Params,
     prompt: jax.Array,  # [B, T_prompt]
     cache: KVCache,
     config: TransformerConfig,
+    ffn=None,
 ) -> Tuple[jax.Array, KVCache]:
-    """Fill the cache with the prompt; returns (last-position logits, cache)."""
-    logits, cache = _forward_cached(params, prompt, cache, config)
+    """Fill the cache with the prompt; returns (last-position logits, cache).
+    ``ffn`` is static: reuse ONE hook object across calls (a fresh closure
+    per call would retrace)."""
+    logits, cache = _forward_cached(params, prompt, cache, config, ffn)
     return logits[:, -1], cache
 
 
-@functools.partial(jax.jit, static_argnames=("config",))
+@functools.partial(jax.jit, static_argnames=("config", "ffn"))
 def decode_step(
     params: Params,
     token: jax.Array,  # [B] int32: previous token
     cache: KVCache,
     config: TransformerConfig,
+    ffn=None,
 ) -> Tuple[jax.Array, KVCache]:
     """One decoding step; returns (logits [B, V], cache)."""
-    logits, cache = _forward_cached(params, token[:, None], cache, config)
+    logits, cache = _forward_cached(params, token[:, None], cache, config, ffn)
     return logits[:, 0], cache
 
 
@@ -166,6 +179,7 @@ def generate_greedy_scan(
         params, prompt, config, max_new_tokens,
         jax.random.PRNGKey(0), temperature=0.0,
     )
+
 
 
 def sample_logits(
@@ -213,12 +227,14 @@ def generate(
     key: jax.Array | None = None,
     top_k: int = 0,
     top_p: float = 1.0,
+    ffn=None,
 ) -> jax.Array:
     """Greedy (temperature=0) or sampled generation; returns
-    [B, T_prompt + max_new_tokens]."""
+    [B, T_prompt + max_new_tokens]. ``ffn``: MoE decode hook
+    (mixtral.decode_ffn) — reuse one object across calls."""
     b, t = prompt.shape
     cache = init_cache(config, b, t + max_new_tokens)
-    logits, cache = prefill(params, prompt, cache, config)
+    logits, cache = prefill(params, prompt, cache, config, ffn=ffn)
     out = [prompt]
 
     def next_key():
@@ -236,7 +252,7 @@ def generate(
         out.append(token[:, None])
         if i == max_new_tokens - 1:
             break
-        logits, cache = decode_step(params, token, cache, config)
+        logits, cache = decode_step(params, token, cache, config, ffn=ffn)
         token = sample_logits(logits, next_key(), temperature, top_k, top_p)
     return jnp.concatenate(out, axis=1)
 
@@ -244,7 +260,7 @@ def generate(
 @functools.partial(
     jax.jit,
     static_argnames=("config", "max_new_tokens", "temperature", "top_k",
-                     "top_p"),
+                     "top_p", "ffn"),
 )
 def generate_scan(
     params: Params,
@@ -255,6 +271,7 @@ def generate_scan(
     temperature: float = 1.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    ffn=None,
 ) -> jax.Array:
     """Sampled generation as ONE compiled program (the sampling sibling of
     ``generate_greedy_scan``): prefill + a lax.scan over decode steps with
@@ -262,13 +279,14 @@ def generate_scan(
     static (they select the compiled masking program)."""
     b, t = prompt.shape
     cache = init_cache(config, b, t + max_new_tokens)
-    logits, cache = _forward_cached(params, prompt, cache, config)
+    logits, cache = _forward_cached(params, prompt, cache, config, ffn)
     key, sub = jax.random.split(key)
     token = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
 
     def step(carry, _):
         token, cache, key = carry
-        logits, cache = _forward_cached(params, token[:, None], cache, config)
+        logits, cache = _forward_cached(params, token[:, None], cache, config,
+                                        ffn)
         key, sub = jax.random.split(key)
         nxt = sample_logits(logits[:, 0], sub, temperature, top_k, top_p)
         return (nxt, cache, key), nxt

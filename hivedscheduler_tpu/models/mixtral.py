@@ -11,6 +11,7 @@ over ICI. Attention/RoPE/norms are shared with models/transformer.py.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Dict, Optional, Tuple
 
@@ -42,6 +43,9 @@ class MixtralConfig:
     # Sequence-parallel backend when the mesh has sp > 1 (see
     # parallel/sharding.sp_attention): auto | ring | ulysses.
     sp_mode: str = "auto"
+    # Part of the shared decode-config contract (generate._forward_cached);
+    # Mixtral ships untied heads.
+    tied_embeddings: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -248,6 +252,27 @@ def forward(
     x = rms_norm(x, params["ln_f"])
     logits = (x @ params["lm_head"]).astype(jnp.float32)
     return logits, jnp.sum(aux_losses)
+
+
+@functools.lru_cache(maxsize=None)
+def decode_ffn(config: MixtralConfig):
+    """FFN hook for the shared KV-cache decode machinery
+    (``generate.prefill/decode_step``'s ``ffn`` parameter): the routed MoE
+    layer applied to the current token(s); the aux load-balancing loss is
+    a training-only signal and is dropped. Cached per config so the jitted
+    decode functions see ONE static hook object (a fresh closure per call
+    would retrace).
+
+    Inference note: expert capacity scales with the visible token count
+    (GShard batched-capacity semantics), so a decode step's capacity is
+    computed over the step's B tokens — raise ``capacity_factor`` if
+    routing collisions at tiny decode batches matter."""
+
+    def ffn(h: jax.Array, layer: Params) -> jax.Array:
+        out, _ = moe_ffn(h, layer, config)
+        return out
+
+    return ffn
 
 
 def lm_loss(

@@ -211,3 +211,39 @@ def test_sample_logits_top_p_zero_is_near_greedy():
             logits, jax.random.PRNGKey(i), temperature=1.0, top_p=0.0
         )
         assert int(tok[0]) == 0
+
+
+def test_mixtral_cached_decode_matches_full_forward():
+    """The MoE family rides the same KV-cache machinery via the ffn hook:
+    prefill + per-token decode logits must match the full Mixtral forward
+    position for position. capacity_factor is raised so no token is ever
+    capacity-dropped — GShard capacity scales with the visible token
+    count, which legitimately differs between a 1-token decode step and
+    the full sequence; with drops impossible both formulations route
+    identically and parity is exact."""
+    import dataclasses
+
+    from hivedscheduler_tpu.models import mixtral
+
+    config = dataclasses.replace(mixtral.tiny(), capacity_factor=16.0)
+    params = mixtral.init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                                config.vocab_size)
+    full_logits, _aux = mixtral.forward(params, tokens, config)
+
+    ffn = mixtral.decode_ffn(config)
+    assert mixtral.decode_ffn(config) is ffn  # one static hook per config
+    cache = generate.init_cache(config, 2, 12)
+    logits, cache = generate.prefill(params, tokens[:, :5], cache, config,
+                                     ffn=ffn)
+    np.testing.assert_allclose(
+        np.array(full_logits[:, 4]), np.array(logits), atol=2e-4, rtol=2e-3
+    )
+    for t in range(5, 12):
+        logits, cache = generate.decode_step(
+            params, tokens[:, t], cache, config, ffn=ffn
+        )
+        np.testing.assert_allclose(
+            np.array(full_logits[:, t]), np.array(logits),
+            atol=2e-4, rtol=2e-3, err_msg=f"position {t}",
+        )
