@@ -247,3 +247,49 @@ def test_mixtral_cached_decode_matches_full_forward():
             np.array(full_logits[:, t]), np.array(logits),
             atol=2e-4, rtol=2e-3, err_msg=f"position {t}",
         )
+
+
+def test_mixtral_cached_decode_under_ep_mesh():
+    """MoE decode with the experts sharded over ep: per-step logits must
+    match the single-device cached decode (the routed FFN's dispatch
+    all-to-all runs inside the jitted decode step)."""
+    import dataclasses
+
+    from hivedscheduler_tpu.models import mixtral
+    from hivedscheduler_tpu.parallel import mesh as pmesh, sharding as psh
+
+    config = dataclasses.replace(mixtral.tiny(), capacity_factor=16.0)
+    params = mixtral.init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0,
+                                config.vocab_size)
+    ffn = mixtral.decode_ffn(config)
+
+    cache = generate.init_cache(config, 2, 10)
+    ref_logits, ref_cache = generate.prefill(
+        params, tokens[:, :6], cache, config, ffn=ffn
+    )
+
+    mesh = pmesh.make_mesh(pmesh.MeshConfig(fsdp=2, ep=4),
+                           devices=jax.devices())
+    sh = psh.tree_shardings(mesh, mixtral.logical_axes(config))
+    sp = jax.device_put(params, sh)
+    with jax.set_mesh(mesh):
+        cache2 = generate.init_cache(config, 2, 10)
+        logits, cache2 = generate.prefill(
+            sp, tokens[:, :6], cache2, config, ffn=ffn
+        )
+        np.testing.assert_allclose(
+            np.array(ref_logits), np.array(jax.device_get(logits)),
+            atol=2e-4, rtol=2e-3,
+        )
+        for t in range(6, 10):
+            ref_logits, ref_cache = generate.decode_step(
+                params, tokens[:, t], ref_cache, config, ffn=ffn
+            )
+            logits, cache2 = generate.decode_step(
+                sp, tokens[:, t], cache2, config, ffn=ffn
+            )
+            np.testing.assert_allclose(
+                np.array(ref_logits), np.array(jax.device_get(logits)),
+                atol=2e-4, rtol=2e-3, err_msg=f"position {t}",
+            )
