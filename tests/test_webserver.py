@@ -246,3 +246,56 @@ def test_concurrent_keepalive_clients(server):
     # teardown with an empty error list.
     assert not any(t.is_alive() for t in threads), "client threads hung"
     assert not errors, errors
+
+
+def test_wire_fuzz_malformed_requests(server):
+    """Adversarial wire fuzz: random malformed bodies (truncated JSON,
+    binary junk, huge flat payloads, wrong content types, deep nesting)
+    against every POST verb on reused keep-alive connections. The server
+    must answer every request with well-formed JSON (400/404/200-in-band)
+    and never desync or hang the connection."""
+    import http.client
+    import random
+
+    rng = random.Random(0)
+    verbs = [constants.FILTER_PATH, constants.BIND_PATH,
+             constants.PREEMPT_PATH, "/v1/extender/unknown"]
+
+    def junk_body():
+        choice = rng.randrange(6)
+        if choice == 0:
+            return b""
+        if choice == 1:
+            return rng.randbytes(rng.randrange(1, 200))
+        if choice == 2:  # truncated JSON
+            return json.dumps({"Pod": {"metadata": {"name": "x"}}})[
+                : rng.randrange(1, 30)
+            ].encode()
+        if choice == 3:  # wrong-typed fields
+            return json.dumps({"Pod": rng.choice([7, "str", [1, 2]]),
+                               "NodeNames": rng.choice([3, {"a": 1}])}).encode()
+        if choice == 4:  # deep nesting
+            payload = "x"
+            for _ in range(50):
+                payload = {"k": payload}
+            return json.dumps(payload).encode()
+        return json.dumps({"flat": "y" * rng.randrange(1, 5000)}).encode()
+
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=10)
+    for i in range(120):
+        path = rng.choice(verbs)
+        body = junk_body()
+        conn.request("POST", path, body,
+                     {"Content-Type": "application/json"})
+        r = conn.getresponse()
+        payload = json.loads(r.read())  # every reply is well-formed JSON
+        assert r.status in (200, 400, 404, 500), (path, r.status)
+        assert isinstance(payload, dict), (path, payload)
+    # The same connection still serves a legitimate request afterwards.
+    conn.request("POST", constants.BIND_PATH, json.dumps({
+        "PodName": "nope", "PodNamespace": "default",
+        "PodUID": "u-nope", "Node": "tpu-w0",
+    }), {"Content-Type": "application/json"})
+    r = conn.getresponse()
+    assert r.status == 200 and "Error" in json.loads(r.read())
+    conn.close()
