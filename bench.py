@@ -18,7 +18,6 @@ from __future__ import annotations
 import json
 import logging
 import os
-import random
 import statistics
 import subprocess
 import sys
@@ -147,40 +146,37 @@ GANG_SHAPES = [
 ]
 
 
-def run(n_gangs: int = 120, seed: int = 0):
-    sched = HivedScheduler(build_config(), kube_client=NullKubeClient())
-    nodes = sched.core.configured_node_names()
-    for n in nodes:
-        sched.add_node(Node(name=n))
 
-    rng = random.Random(seed)
-    gang_latencies_ms = []
-    live = []  # (gang_name, [bound pods])
+def _drive_gangs(sched, schedule_pod, n_gangs, prefix="g"):
+    """Shared gang generator + churn loop for the latency stages: submit
+    GANG_SHAPES-mix gangs, time each whole gang via ``schedule_pod`` (in-
+    process or over the wire), and churn the oldest gangs when the cluster
+    fills. Returns (latencies_ms, live)."""
+    lat, live = [], []
     for g in range(n_gangs):
         vc, leaf_type, n_pods, chips = GANG_SHAPES[g % len(GANG_SHAPES)]
-        gname = f"g{g}"
+        gname = f"{prefix}{g}"
         group = {
             "name": gname,
             "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
         }
         pods = [
-            make_pod(f"{gname}-{i}", f"{gname}-u{i}", vc, 0, leaf_type, chips, group)
+            make_pod(f"{gname}-{i}", f"{gname}-u{i}", vc, 0, leaf_type,
+                     chips, group)
             for i in range(n_pods)
         ]
         for p in pods:
             sched.add_pod(p)
         t0 = time.perf_counter()
-        bound = []
-        ok = True
+        ok, bound = True, []
         for p in pods:
-            r = sched.filter_routine(ei.ExtenderArgs(pod=p, node_names=nodes))
-            if not r.node_names:
+            if not schedule_pod(p):
                 ok = False
                 break
             bound.append(sched.pod_schedule_statuses[p.uid].pod)
         elapsed_ms = (time.perf_counter() - t0) * 1e3
         if ok:
-            gang_latencies_ms.append(elapsed_ms)
+            lat.append(elapsed_ms)
             live.append((gname, bound))
         else:
             # Cluster full: free the oldest gangs (job churn), drop this
@@ -188,15 +184,31 @@ def run(n_gangs: int = 120, seed: int = 0):
             for p in pods:
                 sched.delete_pod(p)
             for _, old in live[: max(1, len(live) // 3)]:
-                for p in old:
-                    sched.delete_pod(p)
+                for q in old:
+                    sched.delete_pod(q)
             live = live[max(1, len(live) // 3):]
+    return lat, live
 
-    p50 = statistics.median(gang_latencies_ms)
-    p99 = sorted(gang_latencies_ms)[
-        min(len(gang_latencies_ms) - 1, int(0.99 * len(gang_latencies_ms)))
-    ]
-    return p50, p99, len(gang_latencies_ms), sched, live
+
+def _percentiles(lat):
+    p50 = statistics.median(lat)
+    p99 = sorted(lat)[min(len(lat) - 1, int(0.99 * len(lat)))]
+    return p50, p99
+
+
+def run(n_gangs: int = 120):
+    sched = HivedScheduler(build_config(), kube_client=NullKubeClient())
+    nodes = sched.core.configured_node_names()
+    for n in nodes:
+        sched.add_node(Node(name=n))
+
+    def schedule_pod(p):
+        r = sched.filter_routine(ei.ExtenderArgs(pod=p, node_names=nodes))
+        return bool(r.node_names)
+
+    lat, live = _drive_gangs(sched, schedule_pod, n_gangs)
+    p50, p99 = _percentiles(lat)
+    return p50, p99, len(lat), sched, live
 
 
 def bench_preempt(sched, nodes, n_calls: int = 30) -> float:
@@ -281,51 +293,18 @@ def bench_http(n_gangs: int = 60) -> dict:
     try:
         conn = http.client.HTTPConnection("127.0.0.1", ws.port)
         headers = {"Content-Type": "application/json"}
-        lat, live = [], []
-        for g in range(n_gangs):
-            vc, leaf_type, n_pods, chips = GANG_SHAPES[g % len(GANG_SHAPES)]
-            gname = f"h{g}"
-            group = {
-                "name": gname,
-                "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
-            }
-            pods = [
-                make_pod(
-                    f"{gname}-{i}", f"{gname}-u{i}", vc, 0, leaf_type, chips,
-                    group,
-                )
-                for i in range(n_pods)
-            ]
-            for p in pods:
-                sched.add_pod(p)
-            t0 = time.perf_counter()
-            ok = True
-            for p in pods:
-                body = json.dumps(
-                    ei.ExtenderArgs(pod=p, node_names=nodes).to_dict()
-                )
-                conn.request("POST", constants.FILTER_PATH, body, headers)
-                resp = json.loads(conn.getresponse().read())
-                if not resp.get("NodeNames"):
-                    ok = False
-                    break
-            elapsed_ms = (time.perf_counter() - t0) * 1e3
-            if ok:
-                lat.append(elapsed_ms)
-                live.append(
-                    (gname,
-                     [sched.pod_schedule_statuses[p.uid].pod for p in pods])
-                )
-            else:  # cluster full: churn the oldest gangs, as in run()
-                for p in pods:
-                    sched.delete_pod(p)
-                for _, old in live[: max(1, len(live) // 3)]:
-                    for q in old:
-                        sched.delete_pod(q)
-                live = live[max(1, len(live) // 3):]
+
+        def schedule_pod(p):
+            body = json.dumps(
+                ei.ExtenderArgs(pod=p, node_names=nodes).to_dict()
+            )
+            conn.request("POST", constants.FILTER_PATH, body, headers)
+            resp = json.loads(conn.getresponse().read())
+            return bool(resp.get("NodeNames"))
+
+        lat, _ = _drive_gangs(sched, schedule_pod, n_gangs, prefix="h")
         conn.close()
-        p50 = statistics.median(lat)
-        p99 = sorted(lat)[min(len(lat) - 1, int(0.99 * len(lat)))]
+        p50, p99 = _percentiles(lat)
         return {
             "http_gang_p50_ms": round(p50, 3),
             "http_gang_p99_ms": round(p99, 3),
@@ -399,7 +378,7 @@ def model_perf() -> dict:
 
 if __name__ == "__main__":
     # Warm-up pass (imports, allocator caches), then the measured pass.
-    run(n_gangs=24, seed=1)
+    run(n_gangs=24)
     p50, p99, n, sched, live = run()
     nodes = sched.core.configured_node_names()
     preempt_p50 = bench_preempt(sched, nodes)
