@@ -22,4 +22,4 @@ Layer map (mirrors reference SURVEY.md section 1):
                    framework (the five BASELINE.json configs)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.5.0"
