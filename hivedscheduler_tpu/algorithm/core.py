@@ -486,13 +486,13 @@ class HivedCore:
                     self.all_vc_free_cell_num[chain][level] = (
                         self.all_vc_free_cell_num[chain].get(level, 0) + n
                     )
-        for chain, chain_free in self.all_vc_free_cell_num.items():
-            ccl = self.full_cell_list.get(chain)
-            if ccl is None:
-                raise api.bad_request(
-                    f"Illegal initial VC assignment: Chain {chain} does not "
-                    "exist in physical cluster"
-                )
+        # Capacity-side structures (total_left, bad-free, doomed counters)
+        # exist for EVERY physical chain, including chains no VC currently
+        # has quota in — node-health tracking walks all chains, and a
+        # quota-less chain is a legitimate config (capacity not yet
+        # assigned; found by the reconfiguration-mutation fuzzer).
+        for chain, ccl in self.full_cell_list.items():
+            chain_free = self.all_vc_free_cell_num.get(chain, {})
             top = ccl.top_level
             available = len(ccl[top])
             self.total_left_cell_num[chain] = {top: available}

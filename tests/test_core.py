@@ -784,3 +784,30 @@ def test_safe_relaxed_buddy_safety_panic():
             top - 1, None, True, {},
         )
     assert e.value.code >= 500  # internal invariant, not a user error
+
+
+def test_quota_less_chain_survives_node_health_tracking():
+    """A physical chain no VC currently has quota in is a legitimate config
+    (capacity not yet assigned). Node-health tracking walks ALL chains, so
+    the capacity-side bookkeeping must exist for quota-less chains too —
+    found by the reconfiguration-mutation fuzzer (bad_free_cells KeyError
+    on the cpu chain after its quota was removed across a restart)."""
+    cfg = tpu_design_config()
+    cells = cfg.virtual_clusters["VC2"].virtual_cells
+    cells[:] = [c for c in cells if c.cell_type != "cpu-host.cpu-socket"]
+    core = HivedCore(cfg)
+    # Flap the now-unowned chain's nodes through bad/healthy.
+    core.set_healthy_node("cpu-0")
+    core.set_bad_node("cpu-0")
+    core.set_healthy_node("cpu-0")
+    core.set_healthy_node("cpu-1")
+    # The chain stays schedulable opportunistically (no quota, priority -1).
+    from .test_fuzz_core import configured_nodes
+
+    nodes = configured_nodes(core)
+    for n in nodes:
+        core.set_healthy_node(n)
+    opp = make_pod("op-0", "opu0", "VC2", -1, "cpu-socket", 1)
+    r = core.schedule(opp, nodes, SchedulingPhase.FILTERING)
+    assert r.pod_bind_info is not None
+    assert r.pod_bind_info.node.startswith("cpu-")

@@ -28,6 +28,19 @@ from .test_core import make_pod
 common.init_logging(logging.CRITICAL)
 
 
+
+def configured_nodes(core):
+    """All node names of the compiled cluster, sorted (the fake informer's
+    node roster)."""
+    return sorted(
+        {
+            n
+            for ccl in core.full_cell_list.values()
+            for c in ccl[ccl.top_level]
+            for n in c.nodes
+        }
+    )
+
 def doomed_invariant(core):
     """Every doomed-listed cell must hold its virtual binding."""
     for vcn, chains in core.vc_doomed_bad_cells.items():
@@ -191,14 +204,7 @@ def all_invariants(core):
 def run_sequence(seed: int, steps: int = 80) -> None:
     rng = random.Random(seed)
     core = HivedCore(tpu_design_config())
-    nodes = sorted(
-        {
-            n
-            for ccl in core.full_cell_list.values()
-            for c in ccl[ccl.top_level]
-            for n in c.nodes
-        }
-    )
+    nodes = configured_nodes(core)
     for n in nodes:
         core.set_healthy_node(n)
     bound = {}
@@ -283,14 +289,7 @@ def run_gang_replay_sequence(seed: int, steps: int = 60) -> None:
     """
     rng = random.Random(seed ^ 0xBEEF)
     core = HivedCore(tpu_design_config())
-    nodes = sorted(
-        {
-            n
-            for ccl in core.full_cell_list.values()
-            for c in ccl[ccl.top_level]
-            for n in c.nodes
-        }
-    )
+    nodes = configured_nodes(core)
     for n in nodes:
         core.set_healthy_node(n)
     bound = {}  # uid -> binding pod

@@ -1,0 +1,126 @@
+"""Reconfiguration-mutation fuzzing: random quota reshuffles across
+restarts, with replay + invariants + work preservation checked each time.
+
+The existing fuzzers restart into the SAME config (test_fuzz_core's
+replay); the golden/behavioral reconfig tests mutate the config but along
+fixed scripts. This fuzzer closes the gap: at random points the scheduler
+"restarts" into a RANDOMLY mutated (but always legal) config — v5p/v5e
+quota moved between VCs, cpu quota shrunk/grown — and the replayed core
+must (a) keep every still-placeable pod on its exact physical cells,
+(b) lazy-preempt (never evict) groups whose quota moved away, (c) hold
+every structural + counter invariant, and (d) keep scheduling correctly
+on the mutated config afterwards.
+"""
+
+import logging
+import random
+
+import pytest
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.algorithm.core import HivedCore
+from hivedscheduler_tpu.api import types as api
+from hivedscheduler_tpu.scheduler.types import SchedulingPhase, new_binding_pod
+
+from .test_config_compiler import tpu_design_config
+from .test_core import make_pod
+from .test_fuzz_core import all_invariants, configured_nodes
+
+common.init_logging(logging.CRITICAL)
+
+
+def mutate_config(rng):
+    """A random LEGAL quota layout of the design cluster. Physical
+    capacities: 3 non-pinned v5p-16 (one more is pinned to VC1), 2 v5e-16,
+    1 v5e-host, 4 cpu sockets."""
+    cfg = tpu_design_config()
+    v5p = rng.choice([(2, 1), (1, 1), (1, 2), (2, 0), (0, 2), (3, 0)])
+    v5e = rng.choice([(1, 1), (2, 0), (0, 2)])
+    cpu2 = rng.choice([0, 1, 2, 3])
+
+    def set_quota(vc, cell_type, n):
+        cells = cfg.virtual_clusters[vc].virtual_cells
+        cells[:] = [c for c in cells if c.cell_type != cell_type]
+        if n > 0:
+            cells.append(api.VirtualCellSpec(cell_number=n, cell_type=cell_type))
+
+    set_quota("VC1", "v5p-64.v5p-16", v5p[0])
+    set_quota("VC2", "v5p-64.v5p-16", v5p[1])
+    set_quota("VC1", "v5e-16", v5e[0])
+    set_quota("VC2", "v5e-16", v5e[1])
+    set_quota("VC2", "cpu-host.cpu-socket", cpu2)
+    return cfg
+
+
+def run_reconfig_fuzz(seed: int, steps: int = 50) -> None:
+    rng = random.Random(seed ^ 0xC0FFEE)
+    core = HivedCore(tpu_design_config())
+    nodes = configured_nodes(core)
+    for n in nodes:
+        core.set_healthy_node(n)
+    bound = {}  # uid -> binding pod
+
+    for step in range(steps):
+        op = rng.random()
+        if op < 0.45:
+            uid = f"p{step}"
+            pod = make_pod(
+                uid, uid, rng.choice(["VC1", "VC2"]), rng.choice([-1, 0, 5]),
+                rng.choice(["v5e-chip", "v5p-chip", "cpu-socket"]),
+                rng.choice([1, 2, 4]),
+            )
+            try:
+                r = core.schedule(pod, nodes, SchedulingPhase.FILTERING)
+            except api.WebServerError as e:
+                # User errors (e.g. requesting a type the VC has no quota
+                # for under the current mutation) fail the pod with a 4xx —
+                # production behavior, not a fuzz finding.
+                assert e.code < 500, f"seed {seed} step {step}: {e}"
+                r = None
+            if r is not None and r.pod_bind_info is not None:
+                bp = new_binding_pod(pod, r.pod_bind_info)
+                bp.phase = "Running"
+                core.add_allocated_pod(bp)
+                bound[uid] = bp
+        elif op < 0.65 and bound:
+            uid = rng.choice(sorted(bound))
+            core.delete_allocated_pod(bound.pop(uid))
+        else:
+            # RESTART into a mutated config: replay all bound pods.
+            placements_before = {
+                uid: (bp.node_name, bp.annotations)
+                for uid, bp in bound.items()
+            }
+            core = HivedCore(mutate_config(rng))
+            for n in nodes:
+                core.set_healthy_node(n)
+            for uid in sorted(bound):
+                core.add_allocated_pod(bound[uid])
+            # Work preservation: every replayed pod whose group was
+            # recovered still sits on its exact node (never migrated,
+            # never evicted by the scheduler).
+            for name, g in core.affinity_groups.items():
+                st = g.to_status()["status"]
+                for uid, (node, _ann) in placements_before.items():
+                    if uid in st["allocatedPods"]:
+                        assert node in st["physicalPlacement"], (
+                            f"seed {seed} step {step}: {uid} moved off "
+                            f"{node} in {name}"
+                        )
+        err = all_invariants(core)
+        assert err is None, f"seed {seed} step {step}: {err}"
+
+    # Drain on whatever config is current; no leaks.
+    for uid in sorted(bound):
+        core.delete_allocated_pod(bound.pop(uid))
+    for chain, ccl in core.full_cell_list.items():
+        for cell in ccl[ccl.top_level]:
+            assert cell.state.value == "Free", (
+                f"seed {seed}: leak {chain} {cell.address} {cell.state.value}"
+            )
+
+
+@pytest.mark.parametrize("seed_block", range(4))
+def test_fuzz_reconfiguration_mutations(seed_block):
+    for seed in range(seed_block * 10, (seed_block + 1) * 10):
+        run_reconfig_fuzz(seed)
