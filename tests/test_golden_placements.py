@@ -27,6 +27,10 @@ Covered sub-scenarios (reference analog in parens):
   - reconfiguration replay: restart with shrunken quota + renamed node,
     exact recovered placements (kept / lazy-preempted / dropped) and exact
     post-restart binds (L1042-1092)
+  - heterogeneous gang: mixed 4-chip/2-chip members inside one LCA cell,
+    exact hole reuse, member-list mismatch rejection (group9, L93-95)
+  - lazy preemption: leaf-overlap downgrade vs pack-beside no-op, quota
+    migration to the vacated slice
 
 Run with ``GOLDEN_GENERATE=1`` to print the actual outcome table (used
 once to freeze the goldens after verifying each by hand).
@@ -599,6 +603,52 @@ LAZY_PREEMPTION = [
     delete("z01"),
     step("z08", "VC2", 0, "v5e-chip", 4, ("bind", "v5e16a-w2", (0, 1, 2, 3))),
 ]
+
+
+HETERO_GANG = [
+    # A heterogeneous gang (the reference's 7+5-member group9 analog,
+    # hived_algorithm_test.go:93-95): two 4-chip members + two 2-chip
+    # members, scheduled transactionally on VC1's v5e quota. Exact
+    # placements: the whole gang lands inside ONE v5e-16 (its LCA cell) —
+    # the 2-chip member's pods pack host w0, the 4-chip members take whole
+    # hosts w1/w2 (the group placement is computed once, at t01).
+    step("t01", "VC1", 0, "v5e-chip", 4,
+         ("bind", "v5e16a-w1", (0, 1, 2, 3)),
+         group=("hg", 4),
+         members=[{"podNumber": 2, "leafCellNumber": 4},
+                  {"podNumber": 2, "leafCellNumber": 2}]),
+    step("t02", "VC1", 0, "v5e-chip", 4,
+         ("bind", "v5e16a-w2", (0, 1, 2, 3)),
+         group=("hg", 4),
+         members=[{"podNumber": 2, "leafCellNumber": 4},
+                  {"podNumber": 2, "leafCellNumber": 2}]),
+    step("t03", "VC1", 0, "v5e-chip", 2,
+         ("bind", "v5e16a-w0", (0, 1)),
+         group=("hg", 4),
+         members=[{"podNumber": 2, "leafCellNumber": 4},
+                  {"podNumber": 2, "leafCellNumber": 2}]),
+    step("t04", "VC1", 0, "v5e-chip", 2,
+         ("bind", "v5e16a-w0", (2, 3)),
+         group=("hg", 4),
+         members=[{"podNumber": 2, "leafCellNumber": 4},
+                  {"podNumber": 2, "leafCellNumber": 2}]),
+    # Deleting one 2-chip member frees its exact chips; a same-shape pod
+    # of the same gang re-binds them.
+    delete("t04"),
+    step("t05", "VC1", 0, "v5e-chip", 2,
+         ("bind", "v5e16a-w0", (2, 3)),
+         group=("hg", 4),
+         members=[{"podNumber": 2, "leafCellNumber": 4},
+                  {"podNumber": 2, "leafCellNumber": 2}]),
+    # A gang whose member list disagrees with the live group: user error.
+    step("t06", "VC1", 0, "v5e-chip", 2, ("fail",),
+         group=("hg", 4),
+         members=[{"podNumber": 3, "leafCellNumber": 2}]),
+]
+
+
+def test_golden_hetero_gang():
+    run_table(HETERO_GANG)
 
 
 def test_golden_lazy_preemption():
