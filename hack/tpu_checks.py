@@ -25,8 +25,11 @@ from hivedscheduler_tpu.ops import attention as A
 
 def main() -> None:
     backend = jax.default_backend()
+    # The same dispatch-time resolution mha() uses (env wins over module
+    # attributes), so the report matches what production would run.
+    bq_lim, bk_lim, _, _ = A.block_limits()
     result = {"backend": backend, "device": str(jax.devices()[0]),
-              "block_q_limit": A.BLOCK_Q, "block_k_limit": A.BLOCK_K}
+              "block_q_limit": bq_lim, "block_k_limit": bk_lim}
     if backend != "tpu":
         print(json.dumps({**result, "skipped": "not on TPU"}))
         return
@@ -34,11 +37,11 @@ def main() -> None:
     B, S, H, D, Hkv = 2, 1024, 8, 128, 4
     # Validate the blocks mha would actually dispatch for this shape (the
     # production path fits the configured limits to the sequence).
-    BQ, BK = A.fit_block(A.BLOCK_Q, S, 8), A.fit_block(A.BLOCK_K, S, 128)
+    BQ, BK = A.fit_block(bq_lim, S, 8), A.fit_block(bk_lim, S, 128)
     if not (BQ and BK):
         print(json.dumps({**result, "error":
             f"no valid blocks for S={S} under limits "
-            f"({A.BLOCK_Q}, {A.BLOCK_K})"}))
+            f"({bq_lim}, {bk_lim})"}))
         sys.exit(1)
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
