@@ -293,7 +293,6 @@ def bench_http(n_gangs: int = 60) -> dict:
     try:
         conn = http.client.HTTPConnection("127.0.0.1", ws.port)
         headers = {"Content-Type": "application/json"}
-
         def schedule_pod(p):
             body = json.dumps(
                 ei.ExtenderArgs(pod=p, node_names=nodes).to_dict()
@@ -301,6 +300,15 @@ def bench_http(n_gangs: int = 60) -> dict:
             conn.request("POST", constants.FILTER_PATH, body, headers)
             resp = json.loads(conn.getresponse().read())
             return bool(resp.get("NodeNames"))
+
+        # Warm-up: one request for an UNINFORMED pod — exercises TCP setup,
+        # JSON codec, and handler dispatch through the same path as the
+        # measured calls, returns an in-band error, and changes no
+        # scheduler state; the first measured gang then pays only its own
+        # cost.
+        schedule_pod(
+            make_pod("warm-0", "warm-u0", "prod", 0, "v5e-chip", 1, None)
+        )
 
         lat, _ = _drive_gangs(sched, schedule_pod, n_gangs, prefix="h")
         conn.close()
