@@ -37,7 +37,22 @@ TARGET_P50_MS = 10.0
 
 # Breadcrumb attached to any skipped model_perf stage: where the last
 # complete on-chip measurements live (human-readable session log).
-LAST_RECORDED_RUN = "example/logs/perf_tpu_round4.md"
+LAST_RECORDED_RUN = "example/logs/perf_tpu_round5.md"
+
+
+def _load_artifact(model: str | None = None) -> dict | None:
+    """Load a persisted on-chip measurement via THE writer's own path
+    resolution (env override + per-model suffix; perf.artifact_path is
+    the single owner of the naming rule). perf.py's module level is
+    stdlib-only, so this import never drags the JAX stack into the bench
+    process. None when absent/unreadable."""
+    try:
+        from hivedscheduler_tpu.models.perf import artifact_path
+
+        with open(artifact_path(model)) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError, ImportError):
+        return None
 
 
 def _skip(reason: str) -> dict:
@@ -47,17 +62,26 @@ def _skip(reason: str) -> dict:
     evidence from live to cached-with-provenance, never to a bare file-path
     breadcrumb."""
     out = {"skipped": reason, "last_recorded_run": LAST_RECORDED_RUN}
-    try:
-        # THE writer's own resolution (env override + per-model suffix) —
-        # perf.py's module level is stdlib-only, so this import never drags
-        # the JAX stack into the bench process.
-        from hivedscheduler_tpu.models.perf import artifact_path
-
-        with open(artifact_path()) as f:
-            out["last_measured"] = json.load(f)
-    except (OSError, json.JSONDecodeError, ImportError):
-        pass
+    measured = _load_artifact()
+    if measured is not None:
+        out["last_measured"] = measured
     return out
+
+
+def _attach_sizing(result: dict) -> dict:
+    """Attach the persisted 800m sizing measurement (the largest
+    single-chip AdamW-f32-master shape, doc/perf.md) to the model_perf
+    stage output — live OR skipped: the live path benches the headline
+    268m shape only, so the ≥0.8B evidence rides along from its own
+    artifact, provenance included. Skipped when this run IS the 800m
+    preset — the live result (or _skip's last_measured) already carries
+    that shape."""
+    if os.environ.get("HIVED_PERF_MODEL") == "800m":
+        return result
+    sizing = _load_artifact("800m")
+    if sizing is not None:
+        result["sizing_800m"] = sizing
+    return result
 
 
 def build_config() -> Config:
@@ -339,9 +363,9 @@ def model_perf() -> dict:
             cwd=here,
         )
     except subprocess.TimeoutExpired:
-        return _skip("backend probe timed out (TPU tunnel dead?)")
+        return _attach_sizing(_skip("backend probe timed out (TPU tunnel dead?)"))
     if probe.returncode != 0:
-        return _skip(f"backend probe rc={probe.returncode}")
+        return _attach_sizing(_skip(f"backend probe rc={probe.returncode}"))
     def attempt(extra_env: dict) -> dict:
         try:
             proc = subprocess.run(
@@ -376,12 +400,17 @@ def model_perf() -> dict:
         # failures never reach here: perf.py reports them as data (exit 0,
         # "train_error" keys) after its own single in-process retry, so one
         # persistent non-Pallas failure costs at most two runs total.
-        retry = attempt({"HIVED_DISABLE_PALLAS": "1"})
+        # The optional stages are flash-kernel evidence and (long-context
+        # especially) quadratic-cost on the XLA path — disable them so the
+        # salvage retry fits the subprocess timeout; its job is one
+        # tokens/sec number.
+        retry = attempt({"HIVED_DISABLE_PALLAS": "1",
+                         "HIVED_PERF_LONGCTX": "0", "HIVED_PERF_ZOO": "0"})
         if "skipped" not in retry:
             retry["attention_fallback"] = "xla"
             retry["attention_fallback_reason"] = result["skipped"]
-            return retry
-    return result
+            return _attach_sizing(retry)
+    return _attach_sizing(result)
 
 
 if __name__ == "__main__":

@@ -214,6 +214,92 @@ def test_mfu_guard_rejects_impossible_numbers():
     assert perf.mfu_fields(2.2e9, 1.0, "unknown-device") == {}
 
 
+def test_long_context_sweep_rows(monkeypatch):
+    """bench_long_context produces one measured row per configured seq via
+    the same bench_train_step path, and an unparseable entry degrades to
+    an error row instead of crashing the run (the headline benches have
+    already been paid for by the time the sweep runs). On CPU the
+    miniature shape runs regardless of the requested seq, so this is
+    cheap."""
+    from hivedscheduler_tpu.models import perf
+
+    monkeypatch.setenv("HIVED_PERF_LONGCTX_SEQS", "512,16k")
+    rows = perf.bench_long_context(on_tpu=False)
+    assert len(rows) == 2
+    assert "tokens_per_sec_per_chip" in rows[0]
+    assert "unparseable" in rows[1]["error"]
+
+
+def test_persist_result_refuses_degraded_runs(tmp_path, monkeypatch):
+    """An XLA-fallback or rejected-MFU run must never overwrite the cached
+    flash measurement (bench.py's HIVED_DISABLE_PALLAS salvage retry would
+    otherwise clobber the real artifact with a ~16-30x slower run)."""
+    from hivedscheduler_tpu.models import perf
+    from hivedscheduler_tpu.ops import attention as att
+
+    art = tmp_path / "a.json"
+    monkeypatch.setenv("HIVED_PERF_ARTIFACT", str(art))
+    good = {"tokens_per_sec_per_chip": 1.0, "mfu": 0.5}
+
+    monkeypatch.setattr(att, "pallas_wanted", lambda: True)
+    perf.persist_result({**good, "attention_fallback": "xla"}, on_tpu=True)
+    assert not art.exists()
+    perf.persist_result(
+        {**good, "mfu": None, "mfu_rejected": 5.0}, on_tpu=True
+    )
+    assert not art.exists()
+    monkeypatch.setattr(att, "pallas_wanted", lambda: False)  # kill switch
+    perf.persist_result(good, on_tpu=True)
+    assert not art.exists()
+    monkeypatch.setattr(att, "pallas_wanted", lambda: True)
+    perf.persist_result(good, on_tpu=True)   # healthy run persists
+    assert art.exists()
+
+
+def test_persist_result_carries_forward_good_stage_evidence(
+    tmp_path, monkeypatch
+):
+    """A headline success whose optional stages degraded (or were not
+    requested) must not destroy previously-cached good sweep/zoo rows:
+    degraded rows are dropped, prior evidence carried forward with a
+    marker."""
+    import json
+
+    from hivedscheduler_tpu.models import perf
+    from hivedscheduler_tpu.ops import attention as att
+
+    art = tmp_path / "a.json"
+    monkeypatch.setenv("HIVED_PERF_ARTIFACT", str(art))
+    monkeypatch.setattr(att, "pallas_wanted", lambda: True)
+    good_row = {"seq": 16384, "tokens_per_sec_per_chip": 2.0, "mfu": 0.5}
+    perf.persist_result(
+        {"tokens_per_sec_per_chip": 1.0, "mfu": 0.5,
+         "long_context": [good_row], "zoo": {"bert_large_step_ms": 1.0}},
+        on_tpu=True,
+    )
+    # Next run: headline fine, sweep all-error, zoo whole-stage error.
+    perf.persist_result(
+        {"tokens_per_sec_per_chip": 1.1, "mfu": 0.5,
+         "long_context": [{"seq": 131072, "error": "RESOURCE_EXHAUSTED"}],
+         "zoo": {"error": "boom"}},
+        on_tpu=True,
+    )
+    rec = json.loads(art.read_text())
+    assert rec["tokens_per_sec_per_chip"] == 1.1        # headline updated
+    assert rec["long_context"] == [good_row]            # evidence kept
+    assert rec["zoo"] == {"bert_large_step_ms": 1.0}
+    assert sorted(rec["carried_forward"]) == ["long_context", "zoo"]
+    # Partial degradation: only the clean rows persist, no carry-forward.
+    perf.persist_result(
+        {"tokens_per_sec_per_chip": 1.2, "mfu": 0.5,
+         "long_context": [good_row, {"seq": 131072, "error": "oom"}]},
+        on_tpu=True,
+    )
+    rec = json.loads(art.read_text())
+    assert rec["long_context"] == [good_row]
+    assert rec["carried_forward"] == ["zoo"]
+
+
 def test_flash_split_bwd_blocks_match_reference():
     """Distinct backward block shapes (independent of the forward's)
     must not change gradients — only the backward kernels' tiling."""
