@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import socket
 import statistics
 import subprocess
 import sys
@@ -315,7 +316,20 @@ def bench_http(n_gangs: int = 60) -> dict:
     ws = WebServer(sched, address="127.0.0.1:0")
     ws.start()
     try:
-        conn = http.client.HTTPConnection("127.0.0.1", ws.port)
+        class NoDelayConnection(http.client.HTTPConnection):
+            """Client side of the same Nagle/delayed-ACK fix as the
+            server's disable_nagle_algorithm (Go's net/http sets both by
+            default). Set in connect() so the option survives the
+            transparent auto-reconnects http.client performs when the
+            server closes a keep-alive connection."""
+
+            def connect(self):
+                super().connect()
+                self.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+
+        conn = NoDelayConnection("127.0.0.1", ws.port)
         headers = {"Content-Type": "application/json"}
         def schedule_pod(p):
             body = json.dumps(
@@ -359,7 +373,11 @@ def model_perf() -> dict:
             [sys.executable, "-c", "import jax; print(jax.default_backend())"],
             capture_output=True,
             text=True,
-            timeout=120,
+            # 300 s: a healthy-but-slow tunnel was measured taking >120 s
+            # to answer backend init on a loaded 1-core host; a dead one
+            # hangs far past any timeout, so the extra patience only costs
+            # the genuinely-dead case.
+            timeout=int(os.environ.get("HIVED_BENCH_PROBE_TIMEOUT", "300")),
             cwd=here,
         )
     except subprocess.TimeoutExpired:

@@ -529,6 +529,21 @@ def persist_result(result: dict, on_tpu: bool) -> None:
             },
         },
     }
+    def carry_forward(stage: str) -> None:
+        """Copy the previous artifact's rows for ``stage``, keyed under
+        ``carried_forward`` with the ORIGINAL provenance block — the new
+        record's top-level provenance must not claim old rows were
+        measured under this run's commit/env."""
+        if stage in prev:
+            record[stage] = prev[stage]
+            marker = dict(record.get("carried_forward", {}))
+            # If prev itself carried these rows, keep the TRUE origin's
+            # provenance, not prev's.
+            marker[stage] = prev.get("carried_forward", {}).get(
+                stage, prev.get("provenance", {})
+            )
+            record["carried_forward"] = marker
+
     lc = record.get("long_context")
     if isinstance(lc, list):
         clean = [r for r in lc
@@ -539,15 +554,13 @@ def persist_result(result: dict, on_tpu: bool) -> None:
             record.pop("long_context")
     elif lc is not None:   # whole-stage error dict
         record.pop("long_context")
-    if "long_context" not in record and "long_context" in prev:
-        record["long_context"] = prev["long_context"]
-        record.setdefault("carried_forward", []).append("long_context")
+    if "long_context" not in record:
+        carry_forward("long_context")
     zoo = record.get("zoo")
     if isinstance(zoo, dict) and "error" in zoo:
         record.pop("zoo")
-    if "zoo" not in record and "zoo" in prev:
-        record["zoo"] = prev["zoo"]
-        record.setdefault("carried_forward", []).append("zoo")
+    if "zoo" not in record:
+        carry_forward("zoo")
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"

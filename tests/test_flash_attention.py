@@ -288,7 +288,12 @@ def test_persist_result_carries_forward_good_stage_evidence(
     assert rec["tokens_per_sec_per_chip"] == 1.1        # headline updated
     assert rec["long_context"] == [good_row]            # evidence kept
     assert rec["zoo"] == {"bert_large_step_ms": 1.0}
-    assert sorted(rec["carried_forward"]) == ["long_context", "zoo"]
+    # The marker carries the ORIGINAL run's provenance per stage, so the
+    # new top-level provenance never claims old rows as its own.
+    cf = rec["carried_forward"]
+    assert sorted(cf) == ["long_context", "zoo"]
+    orig = json.loads(json.dumps(cf["long_context"]))
+    assert orig["recorded_by"] == "hivedscheduler_tpu.models.perf"
     # Partial degradation: only the clean rows persist, no carry-forward.
     perf.persist_result(
         {"tokens_per_sec_per_chip": 1.2, "mfu": 0.5,
@@ -297,7 +302,9 @@ def test_persist_result_carries_forward_good_stage_evidence(
     )
     rec = json.loads(art.read_text())
     assert rec["long_context"] == [good_row]
-    assert rec["carried_forward"] == ["zoo"]
+    assert sorted(rec["carried_forward"]) == ["zoo"]
+    # Chained carry-forward preserves the TRUE origin's provenance.
+    assert rec["carried_forward"]["zoo"] == orig
 
 
 def test_flash_split_bwd_blocks_match_reference():
