@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import sys
 import time
 
@@ -275,6 +276,34 @@ def bench_attention(on_tpu: bool) -> dict:
     return out
 
 
+def _env_int_csv(name: str, default: str):
+    """Parse a comma-separated integer env knob, yielding ``(value, None)``
+    per parseable entry and ``(None, error_row)`` per garbage entry — the
+    shared degrade-never-crash rule for the optional sweep stages
+    (an unparseable entry becomes an error row, never a crash in a run
+    that already paid for the headline benches)."""
+    for tok in os.environ.get(name, default).split(","):
+        if not tok.strip():
+            continue
+        try:
+            yield int(tok), None
+        except ValueError:
+            yield None, {"error": f"unparseable entry {tok!r} in {name}"}
+
+
+def _flagship_params(config):
+    """The deterministic flagship-model params used by every serving-side
+    stage (zoo decode + decode sweep) — one PRNG convention so the stages
+    bench the same weights."""
+    import jax
+
+    from . import transformer
+
+    return jax.jit(lambda k: transformer.init(config, k))(
+        jax.random.PRNGKey(5)
+    )
+
+
 def bench_long_context(on_tpu: bool) -> list:
     """Optional (HIVED_PERF_LONGCTX=1): train-step rows at 16k and 32k
     tokens of context (batch 1), demonstrating the O(block)-VMEM flash
@@ -285,22 +314,13 @@ def bench_long_context(on_tpu: bool) -> list:
     e.g. "16384,32768,65536" for a 64k row; unparseable entries become
     error rows rather than crashing a run that already paid for the
     headline benches."""
-    import os
-
     import jax
 
     kind = getattr(jax.devices()[0], "device_kind", "")
     rows = []
-    for tok in os.environ.get(
-        "HIVED_PERF_LONGCTX_SEQS", "16384,32768"
-    ).split(","):
-        if not tok.strip():
-            continue
-        try:
-            seq = int(tok)
-        except ValueError:
-            rows.append({"error": f"unparseable seq {tok!r} in "
-                                  "HIVED_PERF_LONGCTX_SEQS"})
+    for seq, bad in _env_int_csv("HIVED_PERF_LONGCTX_SEQS", "16384,32768"):
+        if bad is not None:
+            rows.append(bad)
             continue
         try:
             row = bench_train_step(on_tpu, batch=1, seq=seq)
@@ -320,6 +340,72 @@ def bench_long_context(on_tpu: bool) -> list:
             row = {"seq": seq,
                    "error": f"{type(exc).__name__}: {exc}"[:300]}
         rows.append(row)
+    return rows
+
+
+def bench_decode_sweep(on_tpu: bool) -> list:
+    """Optional (HIVED_PERF_DECODE=1): serving decode throughput vs batch
+    size on the flagship model. Single-chip decode is HBM-bandwidth-bound
+    (every token step re-reads all the weights), so aggregate tokens/sec
+    should scale near-linearly with batch until KV-cache reads take over —
+    this sweep is the measured version of that claim, and the large-batch
+    row is the chip's real serving throughput (the zoo's batch-8 row
+    mostly measures weight-read amortized over too few requests).
+
+    Methodology: times the one-dispatch ``generate_greedy_scan`` at two
+    generation lengths and reports the MARGINAL per-token cost
+    ``(t_long - t_short) / (n_long - n_short)`` — the prefill cost and the
+    single host dispatch are identical in both and cancel exactly, so the
+    row is pure steady-state decode speed even through a high-latency
+    tunnel. Each length is timed twice and the min taken (dispatch jitter
+    is one-sided). HIVED_PERF_DECODE_BATCHES overrides the sweep points;
+    unparseable or failing rows degrade to error rows."""
+    import jax
+
+    from . import generate
+
+    config, _, _ = bench_config(on_tpu)
+    params = _flagship_params(config)
+    prompt_len = 128 if on_tpu else 16
+    n_short, n_long = (16, 80) if on_tpu else (2, 6)
+    rows = []
+    for batch, bad in _env_int_csv("HIVED_PERF_DECODE_BATCHES", "8,32,64"):
+        if bad is not None:
+            rows.append(bad)
+            continue
+        try:
+            prompt = jax.random.randint(
+                jax.random.PRNGKey(6), (batch, prompt_len), 0,
+                config.vocab_size,
+            )
+            best = {}
+            for n_new in (n_short, n_long):
+                seq = generate.generate_greedy_scan(
+                    params, prompt, config, max_new_tokens=n_new
+                )
+                host_sync(seq)  # compile
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    seq = generate.generate_greedy_scan(
+                        params, prompt, config, max_new_tokens=n_new
+                    )
+                    host_sync(seq)
+                    dt = time.perf_counter() - t0
+                    best[n_new] = min(best.get(n_new, dt), dt)
+            marginal = (best[n_long] - best[n_short]) / (n_long - n_short)
+            if marginal <= 0:  # jitter swamped the 64-step delta
+                rows.append({"batch": batch,
+                             "error": "non-positive marginal step time "
+                                      "(host timing jitter)"})
+                continue
+            rows.append({
+                "batch": batch,
+                "decode_ms_per_token": round(marginal * 1e3, 3),
+                "tokens_per_sec": round(batch / marginal, 1),
+            })
+        except Exception as exc:  # optional: degrade, never crash
+            rows.append({"batch": batch,
+                         "error": f"{type(exc).__name__}: {exc}"[:300]})
     return rows
 
 
@@ -399,12 +485,10 @@ def bench_zoo(on_tpu: bool) -> dict:
     out["resnet50_step_ms"] = round(rdt * 1e3, 2)
     out["resnet50_images_per_sec"] = round(rbatch / rdt, 1)
 
-    from . import generate, transformer
+    from . import generate
 
     gconfig, _, _ = bench_config(on_tpu)
-    gparams = jax.jit(lambda k: transformer.init(gconfig, k))(
-        jax.random.PRNGKey(5)
-    )
+    gparams = _flagship_params(gconfig)
     gbatch, prompt_len, new_tokens = (8, 128, 32) if on_tpu else (2, 16, 8)
     prompt = jax.random.randint(
         jax.random.PRNGKey(6), (gbatch, prompt_len), 0, gconfig.vocab_size
@@ -472,6 +556,54 @@ def artifact_path(model: str | None = None) -> str:
     )
 
 
+# The optional, env-gated measurement stages that persist_result carries
+# forward across runs and bench.py re-attaches to live results — ONE
+# definition so the artifact's writer and reader can never drift.
+CARRY_STAGES = ("long_context", "zoo", "decode_sweep")
+
+
+def carried_provenance(record: dict, stage: str) -> dict:
+    """The TRUE origin provenance for ``stage`` rows in a persisted
+    artifact: the artifact's ``carried_forward`` marker when it names the
+    stage (tolerating the legacy list format, which recorded only stage
+    names, no provenance), else the artifact's top-level provenance.
+    Shared by persist_result's carry-forward and bench.py's
+    ``_merge_carried`` so the two consumers of the artifact format can
+    never diverge. Stdlib-only, like everything at this module's top
+    level."""
+    marker = record.get("carried_forward")
+    if isinstance(marker, dict) and stage in marker:
+        return marker[stage]
+    return record.get("provenance", {})
+
+
+def stage_rows_clean(val):
+    """The single cleaning rule for an optional stage's value: a list
+    keeps only its clean rows (None when none survive); a whole-stage
+    error dict is None; anything else is already clean. Both the artifact
+    writer (persist_result) and reader (bench's merge) define "stage
+    effectively present" through this function."""
+    if isinstance(val, list):
+        clean = [r for r in val
+                 if "error" not in r and "mfu_rejected" not in r]
+        return clean or None
+    if isinstance(val, dict) and "error" in val:
+        return None
+    return val
+
+
+def attach_carried(dst: dict, src: dict, stage: str) -> None:
+    """Copy ``src``'s rows for ``stage`` into ``dst`` and record the
+    dict-format ``carried_forward`` marker pointing at the TRUE origin's
+    provenance (normalizing a legacy list-format marker on ``dst`` away
+    rather than crashing on it)."""
+    dst[stage] = src[stage]
+    cf = dst.get("carried_forward")
+    marker = dict(cf) if isinstance(cf, dict) else {}
+    marker[stage] = carried_provenance(src, stage)
+    dst["carried_forward"] = marker
+
+
 def persist_result(result: dict, on_tpu: bool) -> None:
     """Persist a successful on-chip measurement (atomically) so bench.py can
     emit it inline as ``last_measured`` whenever the live TPU path is later
@@ -529,38 +661,19 @@ def persist_result(result: dict, on_tpu: bool) -> None:
             },
         },
     }
-    def carry_forward(stage: str) -> None:
-        """Copy the previous artifact's rows for ``stage``, keyed under
-        ``carried_forward`` with the ORIGINAL provenance block — the new
-        record's top-level provenance must not claim old rows were
-        measured under this run's commit/env."""
-        if stage in prev:
-            record[stage] = prev[stage]
-            marker = dict(record.get("carried_forward", {}))
-            # If prev itself carried these rows, keep the TRUE origin's
-            # provenance, not prev's.
-            marker[stage] = prev.get("carried_forward", {}).get(
-                stage, prev.get("provenance", {})
-            )
-            record["carried_forward"] = marker
-
-    lc = record.get("long_context")
-    if isinstance(lc, list):
-        clean = [r for r in lc
-                 if "error" not in r and "mfu_rejected" not in r]
-        if clean:
-            record["long_context"] = clean
-        else:
-            record.pop("long_context")
-    elif lc is not None:   # whole-stage error dict
-        record.pop("long_context")
-    if "long_context" not in record:
-        carry_forward("long_context")
-    zoo = record.get("zoo")
-    if isinstance(zoo, dict) and "error" in zoo:
-        record.pop("zoo")
-    if "zoo" not in record:
-        carry_forward("zoo")
+    for stage in CARRY_STAGES:
+        if stage in record:
+            clean = stage_rows_clean(record[stage])
+            if clean is None:
+                record.pop(stage)
+            else:
+                record[stage] = clean
+        if stage not in record and stage in prev:
+            # Carry the previous artifact's rows forward under the TRUE
+            # origin's provenance — the new record's top-level provenance
+            # must not claim old rows were measured under this run's
+            # commit/env.
+            attach_carried(record, prev, stage)
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + ".tmp"
@@ -644,6 +757,13 @@ def main() -> None:
             result["zoo"] = bench_zoo(on_tpu)
         except Exception as exc:  # optional stage: degrade, never crash
             result["zoo"] = {"error": f"{type(exc).__name__}: {exc}"[:300]}
+    if os.environ.get("HIVED_PERF_DECODE", "0") == "1":
+        try:
+            result["decode_sweep"] = bench_decode_sweep(on_tpu)
+        except Exception as exc:  # optional stage: degrade, never crash
+            result["decode_sweep"] = {
+                "error": f"{type(exc).__name__}: {exc}"[:300]
+            }
     persist_result(result, on_tpu)
     print(json.dumps(result))
 

@@ -160,3 +160,20 @@ def v5p_cell_types(max_hosts: int = 16) -> Dict[str, api.CellTypeSpec]:
     -> v5p-64 (16 hosts, the 4x4x4 cube)."""
     counts = [c for c in (4, 16) if c <= max_hosts]
     return make_cell_types("v5p", chips_per_host=4, slice_host_counts=counts)
+
+
+def v6e_cell_types(max_hosts: int = 64) -> Dict[str, api.CellTypeSpec]:
+    """v6e (Trillium) chains: chip -> 2-chip -> host(4, 2x2) -> v6e-16
+    (4 hosts) -> v6e-64 (16 hosts) -> v6e-256 (64 hosts, the full 16x16
+    torus — Trillium's largest single ICI domain; beyond 256 chips is
+    multislice over DCN, i.e. separate top-level cells here)."""
+    counts = [c for c in (4, 16, 64) if c <= max_hosts]
+    return make_cell_types("v6e", chips_per_host=4, slice_host_counts=counts)
+
+
+def v4_cell_types(max_hosts: int = 16) -> Dict[str, api.CellTypeSpec]:
+    """v4 chains: chip -> 2-chip -> host(4) -> v4-16 (4 hosts) -> v4-64
+    (16 hosts, one 4x4x4 cube) — the legacy-fleet generation, same host
+    shape as v5p."""
+    counts = [c for c in (4, 16) if c <= max_hosts]
+    return make_cell_types("v4", chips_per_host=4, slice_host_counts=counts)

@@ -280,3 +280,51 @@ def test_pod_spec_roundtrip():
         }
     )
     assert api.PodBindInfo.from_dict(bi.to_dict()) == bi
+
+
+def test_v6e_and_v4_generation_chains():
+    """Trillium (v6e) and legacy v4 presets compile into full chains: the
+    v6e chain tops out at v6e-256 (the full 16x16 torus, 64 hosts — the
+    largest single ICI domain; larger deployments are multislice over DCN,
+    i.e. separate top-level cells), v4 at the 4x4x4 cube."""
+    v6e = compiler.build_cell_chains(topology.v6e_cell_types())
+    top = v6e["v6e-256"]
+    assert top.leaf_cell_number == 256
+    assert top.has_node and top.is_multi_nodes
+    # chip(1) -> 2-chip(2) -> host(3) -> v6e-16(4) -> v6e-64(5) -> v6e-256(6)
+    assert top.level == 6
+    assert v6e["v6e-64"].leaf_cell_number == 64
+    assert v6e["v6e-host"].has_node and not v6e["v6e-host"].is_multi_nodes
+
+    v4 = compiler.build_cell_chains(topology.v4_cell_types())
+    assert v4["v4-64"].leaf_cell_number == 64
+    assert v4["v4-64"].level == 5
+
+    # A v6e-256 physical cell nests 64 host names without loss, and a VC
+    # can take quota at any sub-slice level of the chain.
+    cell_types = topology.v6e_cell_types()
+    spec = topology.make_physical_cell(
+        "v6e-256", [f"v6e-w{i}" for i in range(64)], cell_types
+    )
+    cfg = Config.from_dict({
+        "physicalCluster": {
+            "cellTypes": {
+                n: {"childCellType": s.child_cell_type,
+                    "childCellNumber": s.child_cell_number,
+                    "isNodeLevel": s.is_node_level}
+                for n, s in cell_types.items()
+            },
+            "physicalCells": [spec.to_dict()],
+        },
+        "virtualClusters": {
+            "vc-a": {"virtualCells": [
+                {"cellType": "v6e-256.v6e-64", "cellNumber": 2},
+                {"cellType": "v6e-256.v6e-64.v6e-16", "cellNumber": 4},
+            ]},
+        },
+    })
+    cc = compiler.parse_config(cfg)
+    assert "v6e-256" in cc.physical_full_list
+    quota = cc.vc_free_cell_num["vc-a"]["v6e-256"]
+    assert quota[5] == 2  # two v6e-64 sub-slices (level 5)
+    assert quota[4] == 4  # four v6e-16 sub-slices (level 4)
