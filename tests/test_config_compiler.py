@@ -29,12 +29,7 @@ def tpu_design_config() -> Config:
         {
             "physicalCluster": {
                 "cellTypes": {
-                    name: {
-                        "childCellType": s.child_cell_type,
-                        "childCellNumber": s.child_cell_number,
-                        "isNodeLevel": s.is_node_level,
-                    }
-                    for name, s in cell_types.items()
+                    name: s.to_dict() for name, s in cell_types.items()
                 },
                 "physicalCells": [
                     # One v5p-64 cube: 16 hosts, 4 groups of 4; first v5p-16
@@ -308,12 +303,7 @@ def test_v6e_and_v4_generation_chains():
     )
     cfg = Config.from_dict({
         "physicalCluster": {
-            "cellTypes": {
-                n: {"childCellType": s.child_cell_type,
-                    "childCellNumber": s.child_cell_number,
-                    "isNodeLevel": s.is_node_level}
-                for n, s in cell_types.items()
-            },
+            "cellTypes": {n: s.to_dict() for n, s in cell_types.items()},
             "physicalCells": [spec.to_dict()],
         },
         "virtualClusters": {

@@ -31,6 +31,10 @@ Covered sub-scenarios (reference analog in parens):
     exact hole reuse, member-list mismatch rejection (group9, L93-95)
   - lazy preemption: leaf-overlap downgrade vs pack-beside no-op, quota
     migration to the vacated slice
+  - v6e-256 deep chain: 6-level buddy splits from a Trillium 256-chip
+    torus, gangs at two sub-slice levels, quota-exhaustion wait vs
+    unassigned slack, opportunistic packing + preemption by guaranteed
+    load, hole reuse over cube re-split after merge-back
 
 Run with ``GOLDEN_GENERATE=1`` to print the actual outcome table (used
 once to freeze the goldens after verifying each by hand).
@@ -132,8 +136,8 @@ def check_doomed(vc, chain, level, n_bad):
 
 
 class Runner:
-    def __init__(self):
-        self.sim = Sim()
+    def __init__(self, cfg=None):
+        self.sim = Sim(cfg)
         self.bound = {}  # step name -> binding pod
         self.pods = {}  # step name -> pod
 
@@ -212,8 +216,8 @@ class Runner:
         return ("wait",)
 
 
-def run_table(table):
-    runner = Runner()
+def run_table(table, cfg=None):
+    runner = Runner(cfg)
     for i, row in enumerate(table):
         got = runner.run(row)
         if GENERATE:
@@ -754,3 +758,119 @@ def test_golden_reconfiguration_replay():
             assert got == ("bind", want[1], tuple(want[2])), (row["name"], got)
         else:
             assert got[0] == want[0], (row["name"], got)
+
+
+# --------------------------------------------------------------------------- #
+# v6e-256 (Trillium) deep-chain scenario: one full 64-host torus, chain
+# chip(1) -> 2-chip(2) -> host(3) -> v6e-16(4) -> v6e-64(5) -> v6e-256(6).
+# VC prod: 2x v6e-64 (32 hosts of quota); VC research: 4x v6e-16 (16
+# hosts); 16 hosts of physical slack belong to no VC (opportunistic-only
+# capacity). Exercises the new generation preset through the FULL
+# algorithm: 6-level buddy splits from a 256-chip root, gang packing at
+# two sub-slice levels, quota-exhaustion waits, opportunistic placement
+# on unassigned capacity, and preemption of it by guaranteed load.
+# --------------------------------------------------------------------------- #
+
+
+def v6e_config():
+    from hivedscheduler_tpu.api.config import Config
+    from hivedscheduler_tpu.tpu import topology
+
+    cell_types = topology.v6e_cell_types()
+    spec = topology.make_physical_cell(
+        "v6e-256", [f"v6e-w{i}" for i in range(64)], cell_types
+    )
+    return Config.from_dict({
+        "physicalCluster": {
+            "cellTypes": {n: s.to_dict() for n, s in cell_types.items()},
+            "physicalCells": [spec.to_dict()],
+        },
+        "virtualClusters": {
+            "prod": {"virtualCells": [
+                {"cellType": "v6e-256.v6e-64", "cellNumber": 2},
+            ]},
+            "research": {"virtualCells": [
+                {"cellType": "v6e-256.v6e-64.v6e-16", "cellNumber": 4},
+            ]},
+        },
+    })
+
+
+def _gang(prefix, vc, prio, n_pods, chips, binds):
+    """n_pods rows for one gang; ``binds`` is the expected (node, chips)
+    list in schedule order, or ("wait",)/("preempt", ...) applied to the
+    first pod only (the gang decision)."""
+    rows = []
+    for i in range(n_pods):
+        if isinstance(binds, list):
+            expect = ("bind", binds[i][0], binds[i][1])
+        else:
+            expect = binds if i == 0 else None
+        rows.append(step(f"{prefix}-{i}", vc, prio, "v6e-chip", chips,
+                         expect, group=(prefix, n_pods)))
+    return rows
+
+
+def test_golden_v6e256_deep_chain():
+    table = []
+    # research 4-host gang -> one whole v6e-16.
+    table += _gang("bert-a", "research", 0, 4, 4, [
+        ("v6e-w0", (0, 1, 2, 3)), ("v6e-w1", (0, 1, 2, 3)),
+        ("v6e-w2", (0, 1, 2, 3)), ("v6e-w3", (0, 1, 2, 3)),
+    ])
+    # prod 16-host gang -> one whole v6e-64 (not the one bert-a split).
+    table += _gang("train-a", "prod", 0, 16, 4, [
+        (f"v6e-w{i}", (0, 1, 2, 3)) for i in range(16, 32)
+    ])
+    # research half-host pod: ICI-adjacent chip pair on the next free host
+    # inside research's bound v6e-16 region.
+    table += [step("half", "research", 0, "v6e-chip", 2,
+                   ("bind", "v6e-w4", (0, 1)))]
+    # opportunistic gang (no VC quota consumed): crossPriorityPack packs
+    # it beside the existing load in the first cube (w5-w8), NOT onto the
+    # pristine w48+ slack — opportunistic jobs fill holes so whole cells
+    # stay free for guaranteed gangs.
+    table += _gang("opp-a", "research", -1, 4, 4, [
+        ("v6e-w5", (0, 1, 2, 3)), ("v6e-w6", (0, 1, 2, 3)),
+        ("v6e-w7", (0, 1, 2, 3)), ("v6e-w8", (0, 1, 2, 3)),
+    ])
+    # second guaranteed prod v6e-64 gang: quota says yes; buddy
+    # allocation picks the w32-47 cube — the lowest-address free v6e-64
+    # (w0-15 is split by research + the opportunistic gang; w32-47 and
+    # w48-63 are both pristine, address order breaks the tie).
+    table += _gang("train-b", "prod", 0, 16, 4, [
+        (f"v6e-w{i}", (0, 1, 2, 3)) for i in range(32, 48)
+    ])
+    # prod is now at quota: a third guaranteed gang must wait, NOT take
+    # the free slack (that capacity belongs to no VC).
+    table += _gang("train-c", "prod", 0, 16, 4, ("wait",))
+    # research still has 3 free v6e-16s of quota, but its virtual cells
+    # map into the first cube where the opportunistic gang squats:
+    # guaranteed load preempts it (Preempting phase commits the
+    # preemptor; victim node is random by design, so rows assert
+    # membership in the opp gang).
+    opp_uids = frozenset(f"u-opp-a-{i}" for i in range(4))
+    for i in range(4):
+        table += [step(f"bert-b-{i}", "research", 0, "v6e-chip", 4,
+                       ("preempt", opp_uids), group=("bert-b", 4),
+                       phase=P)]
+    # K8s evicts the victims; the preemptor's pods then bind onto the
+    # committed placement: the vacated w5-w7 plus w12 — crossPriorityPack
+    # packs into the partially-used quarters (w4 holds the half-pod, w8
+    # held a victim when the preemption was committed) instead of opening
+    # the untouched w8-w11 quarter as a single LCA cell. Same
+    # pack-over-affinity trade as the reference's intra-VC scheduler.
+    table += [delete(f"opp-a-{i}") for i in range(4)]
+    table += _gang("bert-b", "research", 0, 4, 4, [
+        ("v6e-w5", (0, 1, 2, 3)), ("v6e-w6", (0, 1, 2, 3)),
+        ("v6e-w7", (0, 1, 2, 3)), ("v6e-w12", (0, 1, 2, 3)),
+    ])
+    # Delete train-a: the whole w16-31 cube merges back; a research gang
+    # STILL packs the remaining first-cube holes (w13-15 beside bert-b's
+    # w12, plus the now-free w8) rather than splitting the restored cube.
+    table += [delete(f"train-a-{i}") for i in range(16)]
+    table += _gang("bert-c", "research", 0, 4, 4, [
+        ("v6e-w13", (0, 1, 2, 3)), ("v6e-w14", (0, 1, 2, 3)),
+        ("v6e-w15", (0, 1, 2, 3)), ("v6e-w8", (0, 1, 2, 3)),
+    ])
+    run_table(table, cfg=v6e_config())
