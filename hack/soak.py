@@ -61,19 +61,27 @@ def main():
                         help=f"first {name} seed (pick past the ranges "
                              "recorded in validation_round5.md)")
     args = ap.parse_args()
+    # Validate every requested harness UP FRONT: discovering a missing
+    # --start flag after an earlier harness soaked for hours would throw
+    # that run's record away. Seed 0 onward is CI + recorded-soak
+    # territory; a run that silently re-covers it would be reported as
+    # fresh.
+    requested = [
+        (name, fn) for name, fn in HARNESSES.items()
+        if getattr(args, name) > 0
+    ]
+    for name, _ in requested:
+        if getattr(args, f"{name}_start") <= 0:
+            ap.error(
+                f"--{name}-start is required (pick a range past the "
+                "ones recorded in example/logs/validation_round5.md)"
+            )
     results = []
-    for name, fn in HARNESSES.items():
-        count = getattr(args, name)
-        if count > 0:
-            start = getattr(args, f"{name}_start")
-            if start <= 0:
-                # Seed 0 onward is CI + recorded-soak territory; a run
-                # that silently re-covers it would be reported as fresh.
-                ap.error(
-                    f"--{name}-start is required (pick a range past the "
-                    "ones recorded in example/logs/validation_round5.md)"
-                )
-            results.append(soak(name, fn, start, count))
+    for name, fn in requested:
+        results.append(
+            soak(name, fn, getattr(args, f"{name}_start"),
+                 getattr(args, name))
+        )
     print(json.dumps({"clean": True, "runs": results}))
 
 

@@ -148,21 +148,22 @@ def train_step(
     return params, opt_state, loss
 
 
-def init_sharded(
+def shardings_for(
     config: transformer.TransformerConfig,
     mesh: Mesh,
-    key: jax.Array,
     optimizer: optax.GradientTransformation,
-) -> Tuple[Params, Any, Any, Any]:
-    """Initialize params + optimizer state directly into their shardings
-    (jit with out_shardings => no host-side full copy ever exists).
-
-    Returns (params, opt_state, param_shardings, opt_shardings).
-    """
+) -> Tuple[Any, Any, Any, Any]:
+    """Shape-only sharding plan for the flagship train state:
+    (param_shardings, opt_shardings, params_shape, opt_shape), computed
+    entirely with ``jax.eval_shape`` — nothing is allocated, so this also
+    serves compile/lowering gates on shapes far too big for the host
+    (the 8B-on-virtual-v5p-64 lowering check)."""
     logical = transformer.logical_axes(config)
     param_sh = sharding.tree_shardings(mesh, logical)
 
-    params_shape = jax.eval_shape(functools.partial(transformer.init, config), key)
+    params_shape = jax.eval_shape(
+        functools.partial(transformer.init, config), jax.random.PRNGKey(0)
+    )
     # Optimizer state embeds copies of the param tree (adam mu/nu): any
     # sub-tree structurally identical to the param tree gets the param
     # shardings leaf-for-leaf; every other leaf (counts, scalars) is
@@ -179,7 +180,21 @@ def init_sharded(
         opt_shape,
         is_leaf=_is_param_tree,
     )
+    return param_sh, opt_sh, params_shape, opt_shape
 
+
+def init_sharded(
+    config: transformer.TransformerConfig,
+    mesh: Mesh,
+    key: jax.Array,
+    optimizer: optax.GradientTransformation,
+) -> Tuple[Params, Any, Any, Any]:
+    """Initialize params + optimizer state directly into their shardings
+    (jit with out_shardings => no host-side full copy ever exists).
+
+    Returns (params, opt_state, param_shardings, opt_shardings).
+    """
+    param_sh, opt_sh, _, _ = shardings_for(config, mesh, optimizer)
     params = jax.jit(
         functools.partial(transformer.init, config), out_shardings=param_sh
     )(key)
