@@ -293,3 +293,53 @@ def test_mixtral_cached_decode_under_ep_mesh():
                 np.array(ref_logits), np.array(jax.device_get(logits)),
                 atol=2e-4, rtol=2e-3, err_msg=f"position {t}",
             )
+
+
+def test_chunked_prefill_matches_single_prefill():
+    """Prefill in two chunks (second chunk enters at pos>0) must equal one
+    whole-prompt prefill — the runtime lax.cond that routes empty-cache
+    prefill to the flash-dispatch path must keep chunked prefill on the
+    cached path, exactly."""
+    config = transformer.tiny()
+    params = transformer.init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 24), 0,
+                                config.vocab_size)
+
+    cache1 = generate.init_cache(config, 2, 32)
+    last1, cache1 = generate.prefill(params, tokens, cache1, config)
+
+    cache2 = generate.init_cache(config, 2, 32)
+    _, cache2 = generate.prefill(params, tokens[:, :10], cache2, config)
+    last2, cache2 = generate.prefill(params, tokens[:, 10:], cache2, config)
+
+    np.testing.assert_allclose(
+        np.array(last1), np.array(last2), atol=2e-4, rtol=2e-3
+    )
+    assert int(cache1.length) == int(cache2.length) == 24
+    np.testing.assert_allclose(
+        np.array(cache1.k), np.array(cache2.k), atol=2e-5, rtol=2e-4
+    )
+
+
+def test_prefill_inside_caller_jit_matches_host_prefill():
+    """prefill under a caller's jit (cache.length is a tracer -> the
+    runtime-cond 'auto' attention program) must match the host-call path
+    (concrete length -> trace-time-specialized flash program)."""
+    config = transformer.tiny()
+    params = transformer.init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 16), 0,
+                                config.vocab_size)
+
+    cache = generate.init_cache(config, 2, 24)
+    host_last, _ = generate.prefill(params, tokens, cache, config)
+
+    @jax.jit
+    def wrapped(p, t):
+        c = generate.init_cache(config, 2, 24)
+        last, c = generate.prefill(p, t, c, config)
+        return last
+
+    np.testing.assert_allclose(
+        np.array(wrapped(params, tokens)), np.array(host_last),
+        atol=2e-4, rtol=2e-3,
+    )
