@@ -71,5 +71,24 @@ class TrainCheckpointer:
         )
         return restored["params"], restored["opt_state"], step
 
+    def restore_params(
+        self, params_like: Any, step: Optional[int] = None
+    ) -> Tuple[Any, int]:
+        """Params-only restore (the serving path): orbax Composite restore
+        of a subset of the saved items — the optimizer moments (2x the
+        param bytes of I/O and transient device memory) are never read or
+        materialized."""
+        import orbax.checkpoint as ocp
+
+        step = self.latest_step() if step is None else step
+        assert step is not None, f"no checkpoint found under {self.directory}"
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(params_like),
+            ),
+        )
+        return restored["params"], step
+
     def close(self) -> None:
         self._mgr.close()

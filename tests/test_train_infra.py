@@ -29,6 +29,27 @@ def test_checkpoint_roundtrip_sharded(tmp_path):
     for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(r_params)):
         np.testing.assert_array_equal(np.array(a), np.array(b))
         assert a.sharding == b.sharding
+
+    # Params-only restore (the serving path): same bits, DIFFERENT target
+    # shardings (a serving mesh need not match the trainer's), optimizer
+    # items never touched.
+    smesh = pmesh.make_mesh(
+        pmesh.MeshConfig(fsdp=2, tp=4), devices=jax.devices()
+    )
+    s_sh = sharding.tree_shardings(smesh, transformer.logical_axes(config))
+    p_like = jax.tree.map(
+        lambda a, shd: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=shd),
+        params, s_sh,
+    )
+    s_params, s_step = ckpt.restore_params(p_like)
+    assert s_step == 7
+    for a, b, like in zip(
+        jax.tree.leaves(params),
+        jax.tree.leaves(s_params),
+        jax.tree.leaves(p_like),
+    ):
+        np.testing.assert_array_equal(np.array(a), np.array(b))
+        assert b.sharding == like.sharding
     ckpt.close()
 
 
