@@ -19,7 +19,7 @@ import argparse
 import time
 
 import jax
-import jax.numpy as jnp
+import numpy as np
 
 from common import bootstrap_distributed, synthetic_tokens
 from hivedscheduler_tpu.models import generate, transformer
@@ -45,6 +45,14 @@ def main():
     tp = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
     cfg = pmesh.infer_mesh_config(n, tp=tp)
     mesh = pmesh.make_mesh(cfg)
+    # The batch axis shards dp x fsdp ways (DEFAULT_RULES), so snap the
+    # requested batch to a shardable multiple (at least one row per data-
+    # parallel shard) — same mesh-derived sizing the trainers use —
+    # instead of crashing on big gangs.
+    per = cfg.dp * cfg.fsdp
+    batch = max(args.batch // per, 1) * per
+    if batch != args.batch:
+        print(f"batch {args.batch} -> {batch} (multiple of dp*fsdp={per})")
 
     config = (transformer.llama3_8b() if args.model == "llama3_8b"
               else transformer.tiny())
@@ -81,7 +89,7 @@ def main():
             # to inference on a multi-host gang.
             prompt = sharding.shard_batch(
                 synthetic_tokens(
-                    pk, args.batch, args.prompt_len, config.vocab_size
+                    pk, batch, args.prompt_len, config.vocab_size
                 ),
                 mesh,
             )
@@ -92,11 +100,17 @@ def main():
             )
             seq.block_until_ready()
             dt = time.perf_counter() - t0
-            total_new = args.batch * args.new_tokens
+            total_new = batch * args.new_tokens
+            # seq is batch-sharded across the gang: row 0 is addressable
+            # only on the host holding it, so each process reports its own
+            # first LOCAL row (fetching a remote shard would crash the
+            # other gang members).
+            local = np.asarray(seq.addressable_shards[0].data)
+            ids = local[0, args.prompt_len:args.prompt_len + 4].tolist()
             print(
                 f"request {r}: {total_new} tokens in {dt*1e3:.1f} ms "
                 f"({total_new/dt:.0f} tok/s aggregate), "
-                f"first sampled ids {[int(t) for t in seq[0, args.prompt_len:args.prompt_len+4]]}"
+                f"first local sampled ids {ids}"
             )
 
 

@@ -50,6 +50,22 @@ class TrainCheckpointer:
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
+    def _restore_items(self, step: Optional[int], **likes: Any):
+        """Composite restore of the named items into the shardings/dtypes
+        of the provided abstract trees; shared step resolution."""
+        import orbax.checkpoint as ocp
+
+        step = self.latest_step() if step is None else step
+        assert step is not None, f"no checkpoint found under {self.directory}"
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(**{
+                name: ocp.args.StandardRestore(like)
+                for name, like in likes.items()
+            }),
+        )
+        return restored, step
+
     def restore(
         self,
         params_like: Any,
@@ -58,16 +74,8 @@ class TrainCheckpointer:
     ) -> Tuple[Any, Any, int]:
         """Restore into the shardings/dtypes of the provided abstract trees
         (pass the live trees or jax.eval_shape results + shardings)."""
-        import orbax.checkpoint as ocp
-
-        step = self.latest_step() if step is None else step
-        assert step is not None, f"no checkpoint found under {self.directory}"
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                params=ocp.args.StandardRestore(params_like),
-                opt_state=ocp.args.StandardRestore(opt_state_like),
-            ),
+        restored, step = self._restore_items(
+            step, params=params_like, opt_state=opt_state_like
         )
         return restored["params"], restored["opt_state"], step
 
@@ -78,16 +86,7 @@ class TrainCheckpointer:
         of a subset of the saved items — the optimizer moments (2x the
         param bytes of I/O and transient device memory) are never read or
         materialized."""
-        import orbax.checkpoint as ocp
-
-        step = self.latest_step() if step is None else step
-        assert step is not None, f"no checkpoint found under {self.directory}"
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                params=ocp.args.StandardRestore(params_like),
-            ),
-        )
+        restored, step = self._restore_items(step, params=params_like)
         return restored["params"], step
 
     def close(self) -> None:

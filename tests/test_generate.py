@@ -4,6 +4,7 @@ teacher-forcing equivalence position by position."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from hivedscheduler_tpu.models import generate, transformer
 
@@ -343,3 +344,30 @@ def test_prefill_inside_caller_jit_matches_host_prefill():
         np.array(wrapped(params, tokens)), np.array(host_last),
         atol=2e-4, rtol=2e-3,
     )
+
+
+@pytest.mark.parametrize("n_heads,n_kv", [(4, 4), (4, 1)])
+def test_cached_decode_parity_across_gqa_ratios(n_heads, n_kv):
+    """The grouped-GQA cache attention must stay exact at every group
+    size: g=1 (MHA, no grouping) and g=4 (deep grouping) beside the g=2
+    the tiny() suite already covers."""
+    import dataclasses
+
+    config = dataclasses.replace(
+        transformer.tiny(), n_heads=n_heads, n_kv_heads=n_kv
+    )
+    params = transformer.init(config, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(4), (2, 12), 0,
+                                config.vocab_size)
+    full = transformer.forward(params, tokens, config)
+
+    cache = generate.init_cache(config, 2, 12)
+    _, cache = generate.prefill(params, tokens[:, :8], cache, config)
+    for pos in range(8, 12):
+        logits, cache = generate.decode_step(
+            params, tokens[:, pos], cache, config
+        )
+        np.testing.assert_allclose(
+            np.array(logits), np.array(full[:, pos]), atol=2e-4, rtol=2e-3,
+            err_msg=f"g={n_heads // n_kv} position {pos}",
+        )
