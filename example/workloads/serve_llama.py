@@ -1,14 +1,16 @@
-"""Serving workload: Llama-style generation on a HiveD-placed TPU pod.
+"""Serving workload: generation on a HiveD-placed TPU pod.
 
 The serving sibling of ``train_llama.py``: boot ``jax.distributed`` from
-the scheduler's bind-time env, build a tp×fsdp mesh over the gang's chips,
-shard the weights (megatron tp rules from ``parallel/sharding.py``), and
-serve batches of prompts with flash-kernel prefill (`generate.prefill`
-specializes fresh-cache prompts onto `ops.attention.mha`) plus the
-one-dispatch sampled decode scan. Loads an orbax checkpoint when
-``--ckpt`` is given (``models/checkpoint.py`` restores straight into the
-mesh's shardings — the elastic-resume path), else random weights and the
-tiny config so the example runs anywhere.
+the scheduler's bind-time env, build a mesh over the gang's chips
+(tp×fsdp for the dense family; ep×fsdp for ``--model mixtral_*``, which
+serves the MoE family through the SAME KV-cache machinery via the
+``decode_ffn`` hook), shard the weights (``parallel/sharding.py`` rules),
+and serve batches of prompts with flash-kernel prefill
+(``generate.prefill`` specializes fresh-cache prompts onto
+``ops.attention.mha``) plus the one-dispatch sampled decode scan. Loads
+an orbax checkpoint when ``--ckpt`` is given (``models/checkpoint.py``
+restores params-only straight into the serving shardings), else random
+weights and the tiny config so the example runs anywhere.
 
 Request yaml: ``example/request/serve-llama.yaml`` (same gang/cell shapes
 as the trainer: the scheduler guarantees the ICI-contiguous sub-slice the
@@ -28,8 +30,13 @@ from hivedscheduler_tpu.parallel import mesh as pmesh, sharding
 
 def main():
     parser = argparse.ArgumentParser()
-    parser.add_argument("--model", choices=["tiny", "llama3_8b"],
-                        default="tiny")
+    parser.add_argument(
+        "--model",
+        choices=["tiny", "llama3_8b", "mixtral_tiny", "mixtral_8x7b"],
+        default="tiny",
+        help="mixtral_* serve the MoE family through the same KV-cache "
+             "machinery via the decode_ffn hook (experts shard over ep)",
+    )
     parser.add_argument("--ckpt", default=None,
                         help="orbax checkpoint dir; omit for random init")
     parser.add_argument("--batch", type=int, default=8)
@@ -42,8 +49,22 @@ def main():
 
     bootstrap_distributed()
     n = len(jax.devices())
-    tp = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
-    cfg = pmesh.infer_mesh_config(n, tp=tp)
+    moe = args.model.startswith("mixtral")
+    if moe:
+        from hivedscheduler_tpu.models import mixtral
+
+        config = (mixtral.mixtral_8x7b() if args.model == "mixtral_8x7b"
+                  else mixtral.tiny())
+        model_mod, ffn = mixtral, mixtral.decode_ffn(config)
+        ep = config.n_experts if n % config.n_experts == 0 else (
+            2 if n % 2 == 0 else 1)
+        cfg = pmesh.infer_mesh_config(n, ep=ep)
+    else:
+        config = (transformer.llama3_8b() if args.model == "llama3_8b"
+                  else transformer.tiny())
+        model_mod, ffn = transformer, None
+        tp = 4 if n % 4 == 0 else (2 if n % 2 == 0 else 1)
+        cfg = pmesh.infer_mesh_config(n, tp=tp)
     mesh = pmesh.make_mesh(cfg)
     # The batch axis shards dp x fsdp ways (DEFAULT_RULES), so snap the
     # requested batch to a shardable multiple (at least one row per data-
@@ -54,10 +75,8 @@ def main():
     if batch != args.batch:
         print(f"batch {args.batch} -> {batch} (multiple of dp*fsdp={per})")
 
-    config = (transformer.llama3_8b() if args.model == "llama3_8b"
-              else transformer.tiny())
     with jax.set_mesh(mesh):
-        sh = sharding.tree_shardings(mesh, transformer.logical_axes(config))
+        sh = sharding.tree_shardings(mesh, model_mod.logical_axes(config))
         if args.ckpt:
             from hivedscheduler_tpu.models import checkpoint
 
@@ -65,7 +84,7 @@ def main():
             # abstract leaves (eval_shape + NamedSharding) are all orbax
             # needs, and the trainer's optimizer moments are never read.
             pshape = jax.eval_shape(
-                lambda k: transformer.init(config, k), jax.random.PRNGKey(0)
+                lambda k: model_mod.init(config, k), jax.random.PRNGKey(0)
             )
             p_like = jax.tree.map(
                 lambda s, shd: jax.ShapeDtypeStruct(
@@ -78,7 +97,7 @@ def main():
             print(f"restored checkpoint step {step} from {args.ckpt}")
         else:
             params = jax.jit(
-                lambda k: transformer.init(config, k), out_shardings=sh
+                lambda k: model_mod.init(config, k), out_shardings=sh
             )(jax.random.PRNGKey(0))
 
         key = jax.random.PRNGKey(7)
@@ -96,7 +115,7 @@ def main():
             t0 = time.perf_counter()
             seq = generate.generate_scan(
                 params, prompt, config, args.new_tokens, sk,
-                temperature=args.temperature, top_p=args.top_p,
+                temperature=args.temperature, top_p=args.top_p, ffn=ffn,
             )
             seq.block_until_ready()
             dt = time.perf_counter() - t0

@@ -149,20 +149,24 @@ def train_step(
 
 
 def shardings_for(
-    config: transformer.TransformerConfig,
+    config: Any,
     mesh: Mesh,
     optimizer: optax.GradientTransformation,
+    model: Any = transformer,
 ) -> Tuple[Any, Any, Any, Any]:
-    """Shape-only sharding plan for the flagship train state:
+    """Shape-only sharding plan for a model's train state:
     (param_shardings, opt_shardings, params_shape, opt_shape), computed
     entirely with ``jax.eval_shape`` — nothing is allocated, so this also
     serves compile/lowering gates on shapes far too big for the host
-    (the 8B-on-virtual-v5p-64 lowering check)."""
-    logical = transformer.logical_axes(config)
+    (the 8B / Mixtral-8x7B virtual-v5p-64 lowering checks). ``model``
+    supplies ``init(config, key)`` + ``logical_axes(config)``; the
+    flagship transformer by default, ``models.mixtral`` for the MoE
+    family."""
+    logical = model.logical_axes(config)
     param_sh = sharding.tree_shardings(mesh, logical)
 
     params_shape = jax.eval_shape(
-        functools.partial(transformer.init, config), jax.random.PRNGKey(0)
+        functools.partial(model.init, config), jax.random.PRNGKey(0)
     )
     # Optimizer state embeds copies of the param tree (adam mu/nu): any
     # sub-tree structurally identical to the param tree gets the param

@@ -104,22 +104,15 @@ jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
-from hivedscheduler_tpu.models import mixtral
+from hivedscheduler_tpu.models import mixtral, train
 from hivedscheduler_tpu.parallel import mesh as pmesh, sharding
 
 mconfig = mixtral.mixtral_8x7b()
 mesh = pmesh.make_mesh(pmesh.MeshConfig(fsdp=8, ep=8))
 opt = optax.adamw(1e-3)
 with jax.set_mesh(mesh):
-    msh = sharding.tree_shardings(mesh, mixtral.logical_axes(mconfig))
-    pshape = jax.eval_shape(functools.partial(mixtral.init, mconfig),
-                            jax.random.PRNGKey(0))
-    oshape = jax.eval_shape(opt.init, pshape)
-    treedef = jax.tree.structure(pshape)
-    is_p = lambda node: jax.tree.structure(node) == treedef
-    osh = jax.tree.map(
-        lambda node: msh if is_p(node) else NamedSharding(mesh, P()),
-        oshape, is_leaf=is_p)
+    msh, osh, pshape, oshape = train.shardings_for(
+        mconfig, mesh, opt, model=mixtral)
     tok_sh = NamedSharding(mesh, sharding.spec_for(("batch", "seq")))
 
     def moe_step(p, s, t):
