@@ -98,7 +98,7 @@ def test_llama3_8b_train_step_partitions_on_v5p64_mesh():
 
 MOE_CHILD = """
 import sys; sys.path.insert(0, %(repo)r)
-import functools, json
+import json
 import jax
 jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp

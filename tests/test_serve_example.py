@@ -1,0 +1,46 @@
+"""The serving workload example must keep running end-to-end — it is the
+operator-facing entry (example/request/serve-llama.yaml) for both model
+families. Runs in a child process with the CPU backend forced the same
+way the workload's own docs prescribe for off-cluster smoke runs (the
+axon plugin ignores JAX_PLATFORMS in env, so the child sets the jax
+config before backend init)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys, runpy
+sys.argv = ["serve_llama.py", "--model", %(model)r, "--batch", "4",
+            "--prompt-len", "16", "--new-tokens", "4", "--requests", "1"]
+sys.path.insert(0, %(workloads)r)
+runpy.run_path(%(script)r, run_name="__main__")
+"""
+
+
+@pytest.mark.parametrize("model", ["tiny", "mixtral_tiny"])
+def test_serve_example_generates(model):
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    workloads = os.path.join(REPO, "example", "workloads")
+    proc = subprocess.run(
+        [sys.executable, "-c", CHILD % {
+            "model": model,
+            "workloads": workloads,
+            "script": os.path.join(workloads, "serve_llama.py"),
+        }],
+        capture_output=True,
+        text=True,
+        timeout=560,
+        cwd=REPO,
+        env=env,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "16 tokens in" in proc.stdout  # 4 rows x 4 new tokens
+    assert "first local sampled ids" in proc.stdout
