@@ -221,8 +221,8 @@ def _drive_gangs(sched, schedule_pod, n_gangs, prefix="g"):
     """Shared gang generator + churn loop for the latency stages: submit
     GANG_SHAPES-mix gangs, time each whole gang via ``schedule_pod`` (in-
     process or over the wire), and churn the oldest gangs when the cluster
-    fills. Returns (latencies_ms, live)."""
-    lat, live = [], []
+    fills. Returns (latencies_ms, live, pods_scheduled)."""
+    lat, live, pods_scheduled = [], [], 0
     for g in range(n_gangs):
         vc, leaf_type, n_pods, chips = GANG_SHAPES[g % len(GANG_SHAPES)]
         gname = f"{prefix}{g}"
@@ -248,6 +248,7 @@ def _drive_gangs(sched, schedule_pod, n_gangs, prefix="g"):
         if ok:
             lat.append(elapsed_ms)
             live.append((gname, bound))
+            pods_scheduled += len(bound)
         else:
             # Cluster full: free the oldest gangs (job churn), drop this
             # gang's partial state.
@@ -257,7 +258,7 @@ def _drive_gangs(sched, schedule_pod, n_gangs, prefix="g"):
                 for q in old:
                     sched.delete_pod(q)
             live = live[max(1, len(live) // 3):]
-    return lat, live
+    return lat, live, pods_scheduled
 
 
 def _percentiles(lat):
@@ -276,9 +277,12 @@ def run(n_gangs: int = 120):
         r = sched.filter_routine(ei.ExtenderArgs(pod=p, node_names=nodes))
         return bool(r.node_names)
 
-    lat, live = _drive_gangs(sched, schedule_pod, n_gangs)
+    lat, live, pods = _drive_gangs(sched, schedule_pod, n_gangs)
     p50, p99 = _percentiles(lat)
-    return p50, p99, len(lat), sched, live
+    # Sustained filter-path rate: every scheduled pod's filter call (incl.
+    # assume-bind state updates) over the summed in-schedule time.
+    pods_per_sec = pods / (sum(lat) / 1e3) if lat else 0.0
+    return p50, p99, len(lat), sched, live, pods_per_sec
 
 
 def bench_preempt(sched, nodes, n_calls: int = 30) -> float:
@@ -393,7 +397,7 @@ def bench_http(n_gangs: int = 60) -> dict:
             make_pod("warm-0", "warm-u0", "prod", 0, "v5e-chip", 1, None)
         )
 
-        lat, _ = _drive_gangs(sched, schedule_pod, n_gangs, prefix="h")
+        lat, _, _ = _drive_gangs(sched, schedule_pod, n_gangs, prefix="h")
         conn.close()
         p50, p99 = _percentiles(lat)
         return {
@@ -496,7 +500,7 @@ def model_perf() -> dict:
 if __name__ == "__main__":
     # Warm-up pass (imports, allocator caches), then the measured pass.
     run(n_gangs=24)
-    p50, p99, n, sched, live = run()
+    p50, p99, n, sched, live, pods_per_sec = run()
     nodes = sched.core.configured_node_names()
     preempt_p50 = bench_preempt(sched, nodes)
     recovery = bench_recovery(sched)
@@ -512,6 +516,7 @@ if __name__ == "__main__":
                 "extra": {
                     "p99_ms": round(p99, 3),
                     "gangs_scheduled": n,
+                    "filter_throughput_pods_per_sec": round(pods_per_sec, 1),
                     "preempt_p50_ms": round(preempt_p50, 3),
                     "recovery": recovery,
                     "http": http_stats,
