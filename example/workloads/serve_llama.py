@@ -44,8 +44,19 @@ def main():
     parser.add_argument("--new-tokens", type=int, default=64)
     parser.add_argument("--temperature", type=float, default=0.8)
     parser.add_argument("--top-p", type=float, default=0.95)
+    parser.add_argument("--int8", action="store_true",
+                        help="serve int8-quantized linears (dense family "
+                             "only; models/quantize.py) — halves weight "
+                             "HBM reads on the weight-bound decode path")
     parser.add_argument("--requests", type=int, default=4)
     args = parser.parse_args()
+    if args.int8 and args.model.startswith("mixtral"):
+        # Pure-argparse check: fail BEFORE any mesh build or checkpoint
+        # restore (a ~47B Mixtral restore is minutes of I/O to waste).
+        raise SystemExit(
+            "--int8 quantizes the dense family's linears; the MoE "
+            "expert weights are out of scope (models/quantize.py)"
+        )
 
     bootstrap_distributed()
     n = len(jax.devices())
@@ -99,6 +110,11 @@ def main():
             params = jax.jit(
                 lambda k: model_mod.init(config, k), out_shardings=sh
             )(jax.random.PRNGKey(0))
+        if args.int8:
+            from hivedscheduler_tpu.models import quantize
+
+            params = quantize.quantize_params(params)
+            print("serving int8-quantized linears")
 
         key = jax.random.PRNGKey(7)
         for r in range(args.requests):

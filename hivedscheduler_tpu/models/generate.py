@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import NEG_INF, mha
+from .quantize import quantized_matmul as _mm
 from .transformer import Params, TransformerConfig, rms_norm, rope
 
 
@@ -98,9 +99,11 @@ def _block_cached(
     c = config
     b, t, d = x.shape
     h = rms_norm(x, layer["ln1"])
-    q = (h @ layer["wq"]).reshape(b, t, c.n_heads, c.head_dim)
-    k = (h @ layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim)
-    v = (h @ layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim)
+    # _mm accepts plain or int8-quantized weight leaves (models/quantize):
+    # the whole decode path serves either representation.
+    q = _mm(h, layer["wq"]).reshape(b, t, c.n_heads, c.head_dim)
+    k = _mm(h, layer["wk"]).reshape(b, t, c.n_kv_heads, c.head_dim)
+    v = _mm(h, layer["wv"]).reshape(b, t, c.n_kv_heads, c.head_dim)
     positions = pos + jnp.arange(t)
     q = rope(q, positions, c.rope_theta)
     k = rope(k, positions, c.rope_theta)
@@ -129,12 +132,13 @@ def _block_cached(
         )
     else:  # t == 1 (decode step) or an explicitly chunked prefill
         attn = _attend_cached(q, k_cache, v_cache, pos, c)
-    x = x + attn.reshape(b, t, c.n_heads * c.head_dim) @ layer["wo"]
+    x = x + _mm(attn.reshape(b, t, c.n_heads * c.head_dim), layer["wo"])
     hh = rms_norm(x, layer["ln2"])
     if ffn is None:
-        out = (
-            jax.nn.silu(hh @ layer["w_gate"]) * (hh @ layer["w_up"])
-        ) @ layer["w_down"]
+        out = _mm(
+            jax.nn.silu(_mm(hh, layer["w_gate"])) * _mm(hh, layer["w_up"]),
+            layer["w_down"],
+        )
     else:
         out = ffn(hh, layer)
     return x + out, k_cache, v_cache
@@ -149,7 +153,13 @@ def _forward_cached(
     attn_mode: str = "auto",
 ) -> Tuple[jax.Array, KVCache]:
     c = config
-    params = jax.tree.map(lambda a: a.astype(c.dtype), params)
+    # Unify compute dtype, but int8-quantized weight leaves must survive
+    # as int8 — casting them here would materialize dequantized copies
+    # and erase the halved HBM traffic quantization exists for (the
+    # per-matmul cast in quantize.quantized_matmul fuses into the read).
+    params = jax.tree.map(
+        lambda a: a if a.dtype == jnp.int8 else a.astype(c.dtype), params
+    )
     x = params["embed"][tokens]
     pos = cache.length
 
@@ -167,7 +177,7 @@ def _forward_cached(
     if c.tied_embeddings:
         logits = x @ params["embed"].T
     else:
-        logits = x @ params["lm_head"]
+        logits = _mm(x, params["lm_head"])
     new_cache = KVCache(
         k=new_k, v=new_v, length=cache.length + tokens.shape[1]
     )

@@ -1,0 +1,67 @@
+"""Int8 weight quantization for the serving path.
+
+Single-chip decode is bound by re-reading the weights from HBM every
+token step (doc/perf.md's decode roofline): per-output-channel symmetric
+int8 halves that traffic. The quantized tree drops into the existing
+KV-cache decode machinery unchanged — ``generate``'s matmuls accept
+either a plain array or a ``{"w": int8, "scale": f32}`` leaf and cast at
+load, letting XLA fuse the int8→bf16 convert into the matmul's weight
+read. Training and the MoE expert weights are out of scope (training
+wants full precision; GShard dispatch reads experts per-token anyway).
+
+Accuracy contract (tested): per-channel symmetric int8 keeps every
+dequantized weight within one quantization step of the original
+(|w - dq(w)| <= scale/2 with scale = max|channel|/127), and the decode
+scan remains bit-identical to the stepwise decode under the SAME
+quantized weights — the representation changes, the machinery's
+exactness does not.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import Params
+
+# The decode-path linear weights ([in, out] matmuls re-read every step).
+# Norms are vectors, embeddings are gathered by row (not a full-matrix
+# read), and rotary has no weights — all stay in the compute dtype.
+LAYER_LINEAR_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_weight(w: jax.Array) -> Dict[str, jax.Array]:
+    """Per-output-channel symmetric int8 of an [in, out] matrix."""
+    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / 127.0
+    scale = jnp.maximum(scale, 1e-8)  # all-zero channels
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    return {"w": q.astype(jnp.int8), "scale": scale}
+
+
+def quantized_matmul(x: jax.Array, w: Any) -> jax.Array:
+    """``x @ w`` where ``w`` is a plain array OR a quantized leaf. The
+    int8 weights are cast to the activation dtype at load (XLA fuses the
+    convert into the matmul read) and the per-output-channel scale is
+    applied to the product."""
+    if isinstance(w, dict):
+        return (x @ w["w"].astype(x.dtype)) * w["scale"].astype(x.dtype)
+    return x @ w
+
+
+def quantize_params(params: Params) -> Params:
+    """Quantize the flagship transformer's decode-path linears: the
+    stacked per-layer matmuls (vmapped over the layer axis, so the scan
+    in ``generate._forward_cached`` unstacks the quantized leaves
+    per-layer) and the untied ``lm_head``. Everything else passes
+    through unchanged."""
+    out = dict(params)
+    layers = params["layers"]
+    out["layers"] = {
+        k: (jax.vmap(quantize_weight)(v) if k in LAYER_LINEAR_KEYS else v)
+        for k, v in layers.items()
+    }
+    if "lm_head" in params:
+        out["lm_head"] = quantize_weight(params["lm_head"])
+    return out
