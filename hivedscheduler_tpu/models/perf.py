@@ -368,11 +368,10 @@ def bench_decode_sweep(on_tpu: bool) -> list:
     params = _flagship_params(config)
     prompt_len = 128 if on_tpu else 16
     n_short, n_long = (16, 80) if on_tpu else (2, 6)
-    rows = []
-    for batch, bad in _env_int_csv("HIVED_PERF_DECODE_BATCHES", "8,32,64"):
-        if bad is not None:
-            rows.append(bad)
-            continue
+
+    def marginal_row(p, batch, extra=None):
+        """One sweep row: marginal steady-state decode cost for ``p``
+        (fp or int8 weights) at ``batch``."""
         try:
             prompt = jax.random.randint(
                 jax.random.PRNGKey(6), (batch, prompt_len), 0,
@@ -381,31 +380,50 @@ def bench_decode_sweep(on_tpu: bool) -> list:
             best = {}
             for n_new in (n_short, n_long):
                 seq = generate.generate_greedy_scan(
-                    params, prompt, config, max_new_tokens=n_new
+                    p, prompt, config, max_new_tokens=n_new
                 )
                 host_sync(seq)  # compile
                 for _ in range(2):
                     t0 = time.perf_counter()
                     seq = generate.generate_greedy_scan(
-                        params, prompt, config, max_new_tokens=n_new
+                        p, prompt, config, max_new_tokens=n_new
                     )
                     host_sync(seq)
                     dt = time.perf_counter() - t0
                     best[n_new] = min(best.get(n_new, dt), dt)
             marginal = (best[n_long] - best[n_short]) / (n_long - n_short)
             if marginal <= 0:  # jitter swamped the 64-step delta
-                rows.append({"batch": batch,
-                             "error": "non-positive marginal step time "
-                                      "(host timing jitter)"})
-                continue
-            rows.append({
+                return {"batch": batch,
+                        "error": "non-positive marginal step time "
+                                 "(host timing jitter)", **(extra or {})}
+            return {
                 "batch": batch,
                 "decode_ms_per_token": round(marginal * 1e3, 3),
                 "tokens_per_sec": round(batch / marginal, 1),
-            })
+                **(extra or {}),
+            }
         except Exception as exc:  # optional: degrade, never crash
-            rows.append({"batch": batch,
-                         "error": f"{type(exc).__name__}: {exc}"[:300]})
+            return {"batch": batch,
+                    "error": f"{type(exc).__name__}: {exc}"[:300],
+                    **(extra or {})}
+
+    rows, batches = [], []
+    for batch, bad in _env_int_csv("HIVED_PERF_DECODE_BATCHES", "8,32,64"):
+        if bad is not None:
+            rows.append(bad)
+            continue
+        batches.append(batch)
+        rows.append(marginal_row(params, batch))
+    if batches and os.environ.get("HIVED_PERF_DECODE_INT8", "1") == "1":
+        # Int8-quantized weights at the largest sweep batch: the
+        # weight-HBM half of the decode roofline measured against the fp
+        # row above (models/quantize.py).
+        from . import quantize
+
+        rows.append(marginal_row(
+            quantize.quantize_params(params), max(batches),
+            extra={"int8": True},
+        ))
 
     # Time-to-first-token at a long prompt: prefill dispatches its causal
     # self-attention to the flash kernels (generate._block_cached), which

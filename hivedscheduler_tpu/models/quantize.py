@@ -34,9 +34,15 @@ LAYER_LINEAR_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 def quantize_weight(w: jax.Array) -> Dict[str, jax.Array]:
     """Per-output-channel symmetric int8 of an [in, out] matrix."""
-    scale = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0) / 127.0
+    # Fail fast at the API boundary: a higher-rank array here means a
+    # tree this scheme doesn't model (e.g. MoE expert stacks [E, in,
+    # out], where axis-0 max would scale ACROSS experts) — reject with a
+    # clear error instead of corrupting silently.
+    assert w.ndim == 2, f"expected [in, out] weight, got shape {w.shape}"
+    wf = w.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(wf), axis=0) / 127.0
     scale = jnp.maximum(scale, 1e-8)  # all-zero channels
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127)
+    q = jnp.clip(jnp.round(wf / scale), -127, 127)
     return {"w": q.astype(jnp.int8), "scale": scale}
 
 

@@ -87,3 +87,13 @@ def test_quantized_tree_smaller_and_plain_leaves_untouched():
     # Non-linear leaves pass through by identity.
     assert qparams["embed"] is params["embed"]
     assert qparams["layers"]["ln1"] is params["layers"]["ln1"]
+
+
+def test_quantize_rejects_non_matrix_weights():
+    """MoE expert stacks ([E, in, out] under the vmapped layer axis) are
+    not modeled by the per-output-channel scheme — the API boundary must
+    reject them loudly, not scale across experts silently."""
+    import pytest
+
+    with pytest.raises(AssertionError, match="expected \\[in, out\\]"):
+        quantize.quantize_weight(jnp.zeros((2, 4, 8)))
