@@ -285,6 +285,26 @@ def run(n_gangs: int = 120):
     return p50, p99, len(lat), sched, live, pods_per_sec
 
 
+def smoke(n_gangs: int = 24) -> dict:
+    """Scheduler-only smoke stage: gang-schedule p50, sustained pods/sec,
+    and the per-phase filter breakdown (lock-wait / core-schedule /
+    leaf-cell search) at a small gang count — no HTTP, no recovery, no
+    TPU/model stages. Env-gated in ``__main__`` via ``HIVED_BENCH_SMOKE=1``
+    (gang count override: ``HIVED_BENCH_SMOKE_GANGS``), and wired into
+    tier-1 by tests/test_bench_smoke.py so a hot-path regression fails CI
+    in seconds instead of surfacing in the full driver bench."""
+    p50, p99, n, sched, live, pods_per_sec = run(n_gangs=n_gangs)
+    m = sched.get_metrics()
+    return {
+        "gang_schedule_p50_ms": round(p50, 3),
+        "gang_schedule_p99_ms": round(p99, 3),
+        "gangs_scheduled": n,
+        "pods_per_sec": round(pods_per_sec, 1),
+        "filter_count": m["filterCount"],
+        "phases": m["phases"],
+    }
+
+
 def bench_preempt(sched, nodes, n_calls: int = 30) -> float:
     """p50 latency of the production preempt verb on the loaded cluster:
     a high-priority gang preempts, then cancels (shrunken suggested set),
@@ -498,6 +518,31 @@ def model_perf() -> dict:
 
 
 if __name__ == "__main__":
+    if os.environ.get("HIVED_BENCH_SMOKE") == "1":
+        try:
+            smoke_gangs = int(os.environ.get("HIVED_BENCH_SMOKE_GANGS", "24"))
+        except ValueError:
+            smoke_gangs = 24
+        if smoke_gangs <= 0:
+            # Degrade-never-crash, like _probe_timeout: a zero/negative
+            # override would hand statistics.median an empty sample.
+            smoke_gangs = 24
+        run(n_gangs=8)  # warm-up
+        result = smoke(smoke_gangs)
+        print(
+            json.dumps(
+                {
+                    "metric": "gang_schedule_p50_latency_smoke",
+                    "value": result["gang_schedule_p50_ms"],
+                    "unit": "ms",
+                    "vs_baseline": round(
+                        result["gang_schedule_p50_ms"] / TARGET_P50_MS, 3
+                    ),
+                    "extra": result,
+                }
+            )
+        )
+        sys.exit(0)
     # Warm-up pass (imports, allocator caches), then the measured pass.
     run(n_gangs=24)
     p50, p99, n, sched, live, pods_per_sec = run()

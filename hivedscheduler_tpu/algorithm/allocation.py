@@ -16,7 +16,7 @@ partition of the torus.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from ..api import types as api
 from .. import common
@@ -77,7 +77,14 @@ def buddy_alloc(
         ):
             free_list.remove(c, current_level)
             return True
-        free_list.levels[current_level - 1] = []
+        # Backtrack: withdraw exactly the children we offered. (The original
+        # code cleared the whole level — dropping any PRE-EXISTING free cells
+        # at it, so a later vertex of the same mapping could spuriously fail
+        # or split more than VC safety allowed; demonstrated by
+        # tests/test_buddy_backtracking.py. A failed recursive call restores
+        # its own splits, so all of c's children are still present here.)
+        for child in c.children:
+            free_list.remove(child, current_level - 1)
     return False
 
 
@@ -127,7 +134,7 @@ def safe_relaxed_buddy_alloc(
         splittable_num[l] -= cell_num
         for _ in range(l, current_level, -1):
             split_list = [child for sc in split_list for child in sc.children]
-        free_list.levels[current_level] = split_list + free_list[current_level]
+        free_list.prepend(split_list, current_level)
         ok, picked = map_virtual_cells_to_physical(
             [vertex],
             free_list[current_level],
@@ -213,7 +220,7 @@ def map_virtual_placement_to_physical(
 
 
 def get_usable_physical_cells(
-    candidates: List[Cell],
+    candidates: Iterable[Cell],
     num_needed: int,
     suggested_nodes: Optional[Set[str]],
     ignore_suggested: bool,
@@ -221,7 +228,9 @@ def get_usable_physical_cells(
     """Filter candidates for binding: unbound, not a bad single-node cell,
     and (unless ignored) having at least one suggested node; prefer cells with
     fewer opportunistic pods to reduce preemption
-    (reference: cell_allocation.go:200-249)."""
+    (reference: cell_allocation.go:200-249). ``candidates`` may be a plain
+    child list or an address-indexed CellList level — only iterated here;
+    membership tests against the free list go through the index."""
     usable: List[PhysicalCell] = []
     for c in candidates:
         assert isinstance(c, PhysicalCell)
@@ -243,7 +252,7 @@ def get_usable_physical_cells(
 
 def map_virtual_cells_to_physical(
     vertices: List[BindingPathVertex],
-    candidates: List[Cell],
+    candidates: Iterable[Cell],
     suggested_nodes: Optional[Set[str]],
     ignore_suggested: bool,
     bindings: Dict[api.CellAddress, PhysicalCell],

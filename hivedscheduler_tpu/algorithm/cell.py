@@ -10,17 +10,29 @@ L34-48, GenericCell L58-128, PhysicalCell L130-313, VirtualCell L315-423) and
 the container types in ``pkg/algorithm/types.go`` (CellList L55, ChainCellList
 L97). Unlike the reference, inspect-API statuses are generated on demand by
 walking the trees (see core.py) instead of being incrementally mirrored.
+
+Two departures from the reference for the gang-schedule hot path
+(doc/hot-path.md):
+
+- ``CellList``/``ChainCellList`` are address-indexed: membership and removal
+  are O(1) dict operations instead of linear ``cell_equal`` scans, so the
+  backtracking buddy allocator no longer pays O(free-list) per backtrack.
+- Cells carry a ``view_reg`` back-pointer to the cluster view that scores
+  them (placement.TopologyAwareScheduler): every mutation that can change a
+  node's packing score marks only the touched node dirty, letting the view
+  re-score incrementally instead of rebuilding per request.
 """
 
 from __future__ import annotations
 
 import enum
-from typing import Dict, List, Optional, TYPE_CHECKING
+from typing import Dict, Iterable, Iterator, List, Optional, Tuple, TYPE_CHECKING
 
 from ..api import types as api
 
 if TYPE_CHECKING:
     from .group import AffinityGroup
+    from .placement import TopologyAwareScheduler
 
 CellChain = str
 CellLevel = int
@@ -69,6 +81,7 @@ class Cell:
         "healthy",
         "total_leaf_cell_num",
         "used_leaf_cells_at_priority",
+        "view_reg",
     )
 
     def __init__(
@@ -97,7 +110,11 @@ class Cell:
         # (reference: hived_algorithm.go:453-465).
         self.healthy = True
         self.total_leaf_cell_num = total_leaf_cell_num
-        #
+        # (scheduler, is_anchor) when a cluster view scores this cell:
+        # is_anchor=True for the node-anchor cells that back a _NodeView,
+        # False for their ancestors (binding changes above node level).
+        # See TopologyAwareScheduler._register_view.
+        self.view_reg: Optional[Tuple["TopologyAwareScheduler", bool]] = None
 
         # Leaf-cell usage per priority, for VC-safety and preemption decisions
         # (reference: cell.go:104-106, 122-127).
@@ -115,6 +132,9 @@ class Cell:
             self.used_leaf_cells_at_priority.pop(priority, None)
         else:
             self.used_leaf_cells_at_priority[priority] = n
+        reg = self.view_reg
+        if reg is not None and reg[1]:
+            reg[0].mark_dirty(self.address)
 
     def __repr__(self) -> str:
         return f"{type(self).__name__}({self.address}, p={self.priority})"
@@ -176,8 +196,18 @@ class PhysicalCell(Cell):
         """Healthiness mirrors into the bound virtual cell
         (reference: cell.go:302-313)."""
         self.healthy = healthy
-        if self.virtual_cell is not None:
-            self.virtual_cell.healthy = healthy
+        reg = self.view_reg
+        if reg is not None and reg[1]:
+            reg[0].mark_dirty(self.address)
+        vc = self.virtual_cell
+        if vc is not None:
+            vc.healthy = healthy
+            # The virtual view scores a bound anchor off the PHYSICAL cell's
+            # healthiness (placement._node_health_and_suggested), so the
+            # bound virtual node must be re-scored too.
+            reg = vc.view_reg
+            if reg is not None and reg[1]:
+                reg[0].mark_dirty(vc.address)
 
     def add_using_group(self, g: "AffinityGroup") -> None:
         """(reference: cell.go:225-232; conflicting adds are logged, last
@@ -223,6 +253,81 @@ class VirtualCell(Cell):
             self.healthy = True
         else:
             self.healthy = cell.healthy
+        reg = self.view_reg
+        if reg is not None:
+            if reg[1]:
+                reg[0].mark_dirty(self.address)
+            else:
+                # A binding (dis)appearing ABOVE node level changes how every
+                # unbound node under it scores against suggested nodes; the
+                # view treats it as an epoch, not a per-node dirty mark.
+                reg[0].bump_binding_stamp()
+
+
+class CellList:
+    """An ordered, address-indexed collection of cells.
+
+    Replaces the plain ``List[Cell]`` per-level storage of the reference's
+    ChainCellList: backed by an insertion-ordered dict keyed by cell address,
+    so ``contains``/``remove`` are O(1) while iteration order (which the
+    packing sort and buddy allocator depend on) is preserved exactly —
+    removing an entry keeps the relative order of the rest, like
+    ``list.pop(i)`` did.
+    """
+
+    __slots__ = ("_cells",)
+
+    def __init__(self, cells: Iterable[Cell] = ()):
+        self._cells: Dict[api.CellAddress, Cell] = {
+            c.address: c for c in cells
+        }
+
+    def __iter__(self) -> Iterator[Cell]:
+        return iter(self._cells.values())
+
+    def __len__(self) -> int:
+        return len(self._cells)
+
+    def __bool__(self) -> bool:
+        return bool(self._cells)
+
+    def __getitem__(self, index: int) -> Cell:
+        if index == 0:
+            # The hot case ([0] peeking by the buddy allocator / compiler).
+            try:
+                return next(iter(self._cells.values()))
+            except StopIteration:
+                raise IndexError("cell list index out of range")
+        return list(self._cells.values())[index]
+
+    def append(self, c: Cell) -> None:
+        self._cells[c.address] = c
+
+    def extend(self, cells: Iterable[Cell]) -> None:
+        for c in cells:
+            self._cells[c.address] = c
+
+    def contains(self, c: Cell) -> bool:
+        return c.address in self._cells
+
+    def __contains__(self, c: Cell) -> bool:
+        return c.address in self._cells
+
+    def remove(self, c: Cell) -> None:
+        try:
+            del self._cells[c.address]
+        except KeyError:
+            raise api.internal_error(
+                f"Cell not found in list when removing: {c.address}"
+            )
+
+    def copy(self) -> "CellList":
+        copied = CellList()
+        copied._cells = dict(self._cells)
+        return copied
+
+    def __repr__(self) -> str:
+        return repr([c.address for c in self])
 
 
 class ChainCellList:
@@ -230,12 +335,15 @@ class ChainCellList:
     (reference: algorithm/types.go:97-131 ``ChainCellList``)."""
 
     def __init__(self, top_level: CellLevel = 0):
-        self.levels: Dict[CellLevel, List[Cell]] = {
-            l: [] for l in range(LOWEST_LEVEL, top_level + 1)
+        self.levels: Dict[CellLevel, CellList] = {
+            l: CellList() for l in range(LOWEST_LEVEL, top_level + 1)
         }
 
-    def __getitem__(self, level: CellLevel) -> List[Cell]:
-        return self.levels.setdefault(level, [])
+    def __getitem__(self, level: CellLevel) -> CellList:
+        lst = self.levels.get(level)
+        if lst is None:
+            lst = self.levels[level] = CellList()
+        return lst
 
     def __contains__(self, level: CellLevel) -> bool:
         return level in self.levels
@@ -245,21 +353,22 @@ class ChainCellList:
         return max(self.levels) if self.levels else 0
 
     def contains(self, c: Cell, level: CellLevel) -> bool:
-        return any(cell_equal(c, cc) for cc in self.levels.get(level, []))
+        lst = self.levels.get(level)
+        return lst is not None and lst.contains(c)
 
     def remove(self, c: Cell, level: CellLevel) -> None:
-        lst = self.levels[level]
-        for i, cc in enumerate(lst):
-            if cell_equal(c, cc):
-                lst.pop(i)
-                return
-        raise api.internal_error(
-            f"Cell not found in list when removing: {c.address}"
-        )
+        self.levels[level].remove(c)
+
+    def prepend(self, cells: List[Cell], level: CellLevel) -> None:
+        """Insert ``cells`` BEFORE the current entries of ``level`` (the
+        relaxed buddy allocator offers freshly split cells first)."""
+        merged = CellList(cells)
+        merged.extend(self.levels.get(level, ()))
+        self.levels[level] = merged
 
     def shallow_copy(self) -> "ChainCellList":
         copied = ChainCellList()
-        copied.levels = {l: list(cl) for l, cl in self.levels.items()}
+        copied.levels = {l: cl.copy() for l, cl in self.levels.items()}
         return copied
 
     def __repr__(self) -> str:

@@ -62,7 +62,7 @@ from .group import (
     virtual_to_physical_placement,
 )
 from .intra_vc import IntraVCScheduler, SchedulingRequest
-from .placement import TopologyAwareScheduler
+from .placement import PhaseStats, TopologyAwareScheduler
 
 ###############################################################################
 # Free-standing helpers (reference: pkg/algorithm/utils.go)
@@ -279,6 +279,33 @@ def generate_pod_preempt_info(
     return PodPreemptInfo(victim_pods=victim_pods)
 
 
+def select_pod_from_bind_info(
+    bind_info: List[api.AffinityGroupMemberBindInfo],
+    group_physical: Placement,
+    current_leaf_cell_num: int,
+    current_pod_index: int,
+    chain: str,
+) -> Tuple[str, List[int], str]:
+    """Pick the current pod's (node, chip indices, chain) out of an
+    already-generated group-level bind info record — the cache-hit
+    counterpart of the selection block inside
+    ``generate_affinity_group_bind_info``."""
+    node, indices = "", []
+    for mbi in bind_info:
+        if mbi.pod_placements and len(
+            mbi.pod_placements[0].physical_leaf_cell_indices
+        ) == current_leaf_cell_num:
+            node = mbi.pod_placements[current_pod_index].physical_node
+            indices = mbi.pod_placements[
+                current_pod_index
+            ].physical_leaf_cell_indices
+            first = group_physical[current_leaf_cell_num][current_pod_index][0]
+            if first is not None:
+                chain = first.chain
+            break
+    return node, indices, chain
+
+
 def generate_affinity_group_bind_info(
     group_physical: Placement,
     group_virtual: Optional[Placement],
@@ -290,10 +317,25 @@ def generate_affinity_group_bind_info(
 ) -> Tuple[List[api.AffinityGroupMemberBindInfo], str, List[int], str]:
     """Translate placements into the durable bind-info record; also returns
     the current pod's (node, chip indices, chain)
-    (reference: utils.go:108-174)."""
+    (reference: utils.go:108-174).
+
+    The group-level record is memoized on the AffinityGroup: a gang's
+    placements are fixed once allocated, so the reference's per-pod
+    regeneration is O(gang²) across one gang's admission — every pod after
+    the first reuses the cached record and only re-derives its own (node,
+    chips) selection. The cache is invalidated when the virtual placement
+    changes (lazy preemption / revert; see those methods)."""
+    if group is not None and group.bind_info_cache is not None:
+        cached_info, cached_chain = group.bind_info_cache
+        node, indices, chain = select_pod_from_bind_info(
+            cached_info,
+            group_physical,
+            current_leaf_cell_num,
+            current_pod_index,
+            cached_chain,
+        )
+        return cached_info, node, indices, chain
     bind_info: List[api.AffinityGroupMemberBindInfo] = []
-    selected_node = ""
-    selected_indices: List[int] = []
     chain = ""
     for pod_leaf_num in sorted(group_physical):
         pod_placements = group_physical[pod_leaf_num]
@@ -346,16 +388,13 @@ def generate_affinity_group_bind_info(
                         mbi.pod_placements[pod_index].preassigned_cell_types[
                             leaf_index
                         ] = ""
-        if pod_leaf_num == current_leaf_cell_num:
-            selected_node = mbi.pod_placements[current_pod_index].physical_node
-            selected_indices = mbi.pod_placements[
-                current_pod_index
-            ].physical_leaf_cell_indices
-            first = group_physical[current_leaf_cell_num][current_pod_index][0]
-            if first is not None:
-                chain = first.chain
         bind_info.append(mbi)
-    return bind_info, selected_node, selected_indices, chain
+    node, indices, chain = select_pod_from_bind_info(
+        bind_info, group_physical, current_leaf_cell_num, current_pod_index, chain
+    )
+    if group is not None:
+        group.bind_info_cache = (bind_info, chain)
+    return bind_info, node, indices, chain
 
 
 def generate_pod_schedule_result(
@@ -437,18 +476,27 @@ class HivedCore:
                         "does not exist in physical cluster"
                     )
 
+        # Per-phase latency accumulators shared with every topology-aware
+        # scheduler (leaf-cell search) and the framework (lock-wait /
+        # core-schedule); surfaced via framework.get_metrics().
+        self.phase_stats = PhaseStats()
+
         self.vc_schedulers: Dict[api.VirtualClusterName, IntraVCScheduler] = {
             vc: IntraVCScheduler(
                 cc.virtual_non_pinned_full[vc],
                 cc.virtual_non_pinned_free[vc],
                 cc.virtual_pinned[vc],
                 cc.cell_level_to_leaf_num,
+                phase_stats=self.phase_stats,
             )
             for vc in cc.virtual_non_pinned_full
         }
         self.opportunistic_schedulers: Dict[CellChain, TopologyAwareScheduler] = {
             chain: TopologyAwareScheduler(
-                ccl, cc.cell_level_to_leaf_num[chain], cross_priority_pack=False
+                ccl,
+                cc.cell_level_to_leaf_num[chain],
+                cross_priority_pack=False,
+                phase_stats=self.phase_stats,
             )
             for chain, ccl in self.full_cell_list.items()
         }
@@ -728,11 +776,17 @@ class HivedCore:
         pod: Pod,
         suggested_nodes: List[str],
         phase: SchedulingPhase,
+        spec: Optional[api.PodSchedulingSpec] = None,
+        suggested_set: Optional[Set[str]] = None,
     ) -> PodScheduleResult:
-        """(reference: hived_algorithm.go:180-224)"""
+        """(reference: hived_algorithm.go:180-224)
+
+        ``spec``/``suggested_set`` let the framework parse the annotation and
+        build the node set OUTSIDE its lock (framework.filter_routine); when
+        omitted they are derived here, preserving the old call contract."""
         common.log.info("[%s]: Scheduling pod in %s phase...", pod.key, phase.value)
-        s = extract_pod_scheduling_spec(pod)
-        suggested = set(suggested_nodes)
+        s = spec if spec is not None else extract_pod_scheduling_spec(pod)
+        suggested = suggested_set if suggested_set is not None else set(suggested_nodes)
         group_physical: Optional[Placement] = None
         group_virtual: Optional[Placement] = None
         victims: Optional[Dict[str, Dict[str, Pod]]] = None
@@ -1377,6 +1431,9 @@ class HivedCore:
                     )
         original = victim.virtual_placement
         victim.virtual_placement = None
+        # The cached group bind info embeds preassignedCellTypes derived from
+        # the virtual placement — regenerate on next use.
+        victim.bind_info_cache = None
         victim.lazy_preemption_status = {
             "preemptor": preemptor,
             "preemptionTime": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
@@ -1420,6 +1477,7 @@ class HivedCore:
                     self._allocate_leaf_cell(leaf, v_leaf, g.priority, g.vc)
         g.virtual_placement = virtual
         g.lazy_preemption_status = None
+        g.bind_info_cache = None  # preassignedCellTypes are back
         common.log.info("Lazy preemption of affinity group %s is reverted", g.name)
 
     def _find_allocated_leaf_cell(

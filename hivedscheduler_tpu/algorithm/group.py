@@ -78,6 +78,13 @@ class AffinityGroup:
         }
         self.state = state
         self.lazy_preemption_status: Optional[Dict[str, Any]] = None
+        # Memoized group-level bind info (core.generate_affinity_group_bind_info):
+        # (member_bind_info_list, chain). The group's placements are fixed once
+        # allocated, so every pod of the gang shares the identical group-level
+        # record; only the per-pod (node, chip indices) selection differs.
+        # Invalidated whenever the VIRTUAL placement changes (lazy preemption
+        # and its revert change the preassigned cell types inside the record).
+        self.bind_info_cache: Optional[Tuple[List[Any], str]] = None
 
     def to_status(self) -> Dict[str, Any]:
         """Inspect DTO (reference: types.go:189-214 ``ToAffinityGroup``)."""

@@ -14,7 +14,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from .. import common
 from ..api import types as api
 from .cell import Cell, CellChain, CellLevel, CellPriority, ChainCellList
-from .placement import TopologyAwareScheduler
+from .placement import PhaseStats, TopologyAwareScheduler
 
 
 @dataclass
@@ -40,13 +40,17 @@ class IntraVCScheduler:
         non_pinned_preassigned: Dict[CellChain, ChainCellList],
         pinned_cells: Dict[api.PinnedCellId, ChainCellList],
         leaf_cell_nums: Dict[CellChain, Dict[CellLevel, int]],
+        phase_stats: Optional[PhaseStats] = None,
     ):
         self.non_pinned_full = non_pinned_full
         self.non_pinned_preassigned = non_pinned_preassigned
         self.pinned_cells = pinned_cells
         self._chain_schedulers = {
             chain: TopologyAwareScheduler(
-                ccl, leaf_cell_nums[chain], cross_priority_pack=True
+                ccl,
+                leaf_cell_nums[chain],
+                cross_priority_pack=True,
+                phase_stats=phase_stats,
             )
             for chain, ccl in non_pinned_full.items()
         }
@@ -55,6 +59,7 @@ class IntraVCScheduler:
                 ccl,
                 leaf_cell_nums[ccl[1][0].chain],
                 cross_priority_pack=True,
+                phase_stats=phase_stats,
             )
             for pid, ccl in pinned_cells.items()
         }

@@ -8,10 +8,21 @@ LCA-affinity chip search inside a host (L309-463).
 On TPU, "best affinity" = lowest common ancestor in the cell tree = smallest
 enclosing ICI sub-slice, so minimizing the LCA level is exactly minimizing
 ICI hop distance between the chips granted to one pod.
+
+Unlike the reference (which re-scores and re-sorts every node per request,
+topology_aware_scheduler.go:256-266), the cluster view here is persistent
+and incrementally maintained: cell mutations mark only the touched node
+anchors dirty (cell.py ``view_reg``), and ``_update_cluster_view`` re-scores
+just those — skipping both scoring and sorting entirely when nothing changed
+and the request parameters match the previous call. See doc/hot-path.md for
+the invalidation contract, and tests/test_placement_equivalence.py for the
+differential proof against the naive rebuild.
 """
 
 from __future__ import annotations
 
+import os
+import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..api import types as api
@@ -27,6 +38,51 @@ from .cell import (
     PhysicalCell,
     VirtualCell,
 )
+
+# Differential-test escape hatch: when True, every scheduler built afterwards
+# re-scores and re-sorts the full view on every request (the reference's
+# behavior). tests/test_placement_equivalence.py runs a naive core against an
+# incremental one and asserts identical placements.
+NAIVE_VIEW_DEFAULT = os.environ.get("HIVED_NAIVE_VIEW", "0") == "1"
+
+# Above this many dirty nodes a full re-sort is assumed cheaper than any
+# bookkeeping finesse; below it Timsort's natural-run detection makes the
+# near-sorted re-sort effectively linear anyway, so the threshold only
+# controls when we bother computing the dirty subset at all.
+FULL_RESCORE_FRACTION = 0.5
+
+
+class PhaseStats:
+    """Per-phase latency accumulators for the filter hot path (lock-wait,
+    core-schedule, leaf-cell search), shared by the framework and every
+    TopologyAwareScheduler of one core. Mutated under the scheduler lock;
+    snapshots are read-only and tolerate torn floats."""
+
+    __slots__ = ("phases",)
+
+    def __init__(self) -> None:
+        # phase name -> [count, total_seconds]
+        self.phases: Dict[str, List[float]] = {}
+
+    def add(self, phase: str, seconds: float, n: int = 1) -> None:
+        entry = self.phases.get(phase)
+        if entry is None:
+            entry = self.phases[phase] = [0, 0.0]
+        entry[0] += n
+        entry[1] += seconds
+
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        out: Dict[str, Dict[str, float]] = {}
+        # list(): a concurrent add() may insert a phase key mid-scrape (the
+        # metrics endpoint reads without the scheduler lock); torn floats are
+        # fine, a resized-dict iteration error is not.
+        for phase, (count, total) in list(self.phases.items()):
+            out[phase] = {
+                "count": int(count),
+                "totalMs": round(total * 1e3, 3),
+                "avgMs": round(total / count * 1e3, 4) if count else 0.0,
+            }
+        return out
 
 
 class _NodeView:
@@ -94,7 +150,10 @@ class TopologyAwareScheduler:
     (reference: topology_aware_scheduler.go:36-115).
 
     The view is built once from a chain cell list (physical for opportunistic
-    scheduling, virtual for intra-VC scheduling) and re-scored per request.
+    scheduling, virtual for intra-VC scheduling) and maintained incrementally:
+    cell mutations call :meth:`mark_dirty` / :meth:`bump_binding_stamp`
+    through their ``view_reg`` back-pointer (see cell.py), and only the dirty
+    nodes are re-scored per request.
     """
 
     def __init__(
@@ -102,10 +161,54 @@ class TopologyAwareScheduler:
         ccl: ChainCellList,
         level_leaf_cell_num: Dict[CellLevel, int],
         cross_priority_pack: bool,
+        phase_stats: Optional[PhaseStats] = None,
+        naive: Optional[bool] = None,
     ):
         self.level_leaf_cell_num = level_leaf_cell_num
         self.cross_priority_pack = cross_priority_pack
+        self.phase_stats = phase_stats
+        self.naive = NAIVE_VIEW_DEFAULT if naive is None else naive
         self.cluster_view = self._build_cluster_view(ccl)
+        self._views_by_addr: Dict[api.CellAddress, _NodeView] = {
+            v.cell.address: v for v in self.cluster_view
+        }
+        # Invalidation state: addresses of anchors whose score inputs changed
+        # since the last refresh, plus an epoch stamp for binding changes
+        # above node level (they shift the suggested-node scoring of every
+        # unbound node underneath at once).
+        self._dirty: Set[api.CellAddress] = set()
+        self._binding_stamp = 0
+        self._scored_stamp = -1
+        # Request-parameter cache: scores are a pure function of
+        # (cell state, priority, cross_priority_pack, suggested set when it
+        # matters); identical parameters + clean view = skip everything.
+        self._last_priority: Optional[CellPriority] = None
+        self._last_ignore: Optional[bool] = None
+        self._last_suggested: Optional[Set[str]] = None
+        self._never_scored = True
+        if not self.naive:
+            self._register_view()
+
+    # -- invalidation hooks (called from cell.py mutators) ------------------ #
+
+    def mark_dirty(self, address: api.CellAddress) -> None:
+        self._dirty.add(address)
+
+    def bump_binding_stamp(self) -> None:
+        self._binding_stamp += 1
+
+    def _register_view(self) -> None:
+        """Give every node anchor (and its ancestors) a back-pointer so cell
+        mutations can invalidate exactly the views they affect."""
+        for v in self.cluster_view:
+            anchor = v.cell
+            anchor.view_reg = (self, True)
+            parent = anchor.parent
+            while parent is not None and parent.view_reg is None:
+                parent.view_reg = (self, False)
+                parent = parent.parent
+
+    # -- view construction & scoring ---------------------------------------- #
 
     @staticmethod
     def _build_cluster_view(ccl: ChainCellList) -> List[_NodeView]:
@@ -135,13 +238,50 @@ class TopologyAwareScheduler:
         suggested_nodes: Optional[Set[str]],
         ignore_suggested: bool,
     ) -> None:
-        """(reference: topology_aware_scheduler.go:256-266 and the
-        health/suggested probing at L268-289)"""
-        for n in self.cluster_view:
-            n.update_for_priority(p, self.cross_priority_pack)
+        """Re-score only what changed, then restore the packing order
+        (reference: topology_aware_scheduler.go:256-266 re-scores everything;
+        the incremental path must produce byte-identical results — the sort
+        is the same stable in-place sort over the same persistent list, so
+        equality of scores implies equality of order)."""
+        view = self.cluster_view
+        if self.naive:
+            dirty_views: List[_NodeView] = view
+        else:
+            params_changed = (
+                self._never_scored
+                or p != self._last_priority
+                or ignore_suggested != self._last_ignore
+                or (
+                    not ignore_suggested
+                    and (
+                        suggested_nodes != self._last_suggested
+                        or self._scored_stamp != self._binding_stamp
+                    )
+                )
+            )
+            if params_changed or len(self._dirty) > len(view) * FULL_RESCORE_FRACTION:
+                dirty_views = view
+            elif self._dirty:
+                by_addr = self._views_by_addr
+                dirty_views = [by_addr[a] for a in self._dirty]
+            else:
+                return  # clean view, same parameters: still scored & sorted
+        cross = self.cross_priority_pack
+        for n in dirty_views:
+            n.update_for_priority(p, cross)
             n.healthy, n.suggested, n.node_address = _node_health_and_suggested(
                 n.cell, suggested_nodes, ignore_suggested
             )
+        # Stable in-place sort of the persistent list: with only a few dirty
+        # nodes the list is near-sorted and Timsort's run detection makes
+        # this effectively linear.
+        view.sort(key=_NodeView.sort_key)
+        self._dirty.clear()
+        self._never_scored = False
+        self._last_priority = p
+        self._last_ignore = ignore_suggested
+        self._last_suggested = suggested_nodes
+        self._scored_stamp = self._binding_stamp
 
     def schedule(
         self,
@@ -155,7 +295,9 @@ class TopologyAwareScheduler:
 
         First tries at opportunistic priority (no preemption); if that fails
         and the request is guaranteed, retries at the real priority, allowing
-        lower-priority cells to be treated as free (preemption).
+        lower-priority cells to be treated as free (preemption). The retry is
+        the only second view refresh — and with the parameter cache it costs
+        nothing when the gang priority IS opportunistic.
         """
         sorted_leaf_nums: List[int] = []
         for leaf_num, pod_num in pod_leaf_cell_numbers.items():
@@ -180,6 +322,8 @@ class TopologyAwareScheduler:
         if picked is None:
             return None, failed_reason
 
+        ps = self.phase_stats
+        t0 = time.perf_counter() if ps is not None else 0.0
         placements: Dict[int, List[List[Cell]]] = {}
         node_available: Dict[api.CellAddress, List[Cell]] = {}
         for pod_index, leaf_num in enumerate(sorted_leaf_nums):
@@ -192,6 +336,8 @@ class TopologyAwareScheduler:
                 self.level_leaf_cell_num,
             )
             placements.setdefault(leaf_num, []).append(chips)
+        if ps is not None:
+            ps.add("leafCellSearch", time.perf_counter() - t0, len(sorted_leaf_nums))
         return placements, ""
 
 
@@ -248,8 +394,8 @@ def _find_nodes_for_pods(
     """Greedy assignment of pods (sorted by chip count) to the packed-sorted
     node list (reference: topology_aware_scheduler.go:291-337). A node that
     fits but is bad / non-suggested fails the whole attempt so the caller can
-    fall back (relaxed split or K8s retry)."""
-    view.sort(key=_NodeView.sort_key)
+    fall back (relaxed split or K8s retry). The caller
+    (``_update_cluster_view``) guarantees the view is already sorted."""
     picked = [0] * len(leaf_cell_nums)
     pod_index = 0
     picked_leaf_num = 0

@@ -24,6 +24,7 @@ from .. import common
 from ..api import constants, extender as ei, types as api
 from ..api.config import Config
 from ..algorithm.core import HivedCore
+from ..algorithm.placement import PhaseStats
 from .types import (
     Node,
     Pod,
@@ -31,6 +32,7 @@ from .types import (
     PodScheduleStatus,
     PodState,
     SchedulingPhase,
+    extract_pod_scheduling_spec,
     is_allocated_state,
     is_bound,
     is_interested,
@@ -64,8 +66,11 @@ class NullKubeClient(KubeClient):
 
 
 class SchedulerMetrics:
-    """Minimal latency metrics (SURVEY.md §5 build note: the reference has
-    none; the north-star metric is gang-schedule p50 latency)."""
+    """Latency metrics (SURVEY.md §5 build note: the reference has none; the
+    north-star metric is gang-schedule p50 latency), including the per-phase
+    filter breakdown: lock-wait and core-schedule are recorded here, the
+    leaf-cell search inside placement accumulates into the core's shared
+    PhaseStats (merged by HivedScheduler.get_metrics)."""
 
     # Ring of the most recent samples: bounded memory, and the per-scrape
     # percentile sort stays O(window log window) no matter the uptime.
@@ -79,8 +84,17 @@ class SchedulerMetrics:
         self.bind_count = 0
         self.preempt_count = 0
         self.wait_count = 0
+        # Framework-side phases (same accumulator/formatter as the core's
+        # leaf-cell-search stats, so the merged "phases" payload is uniform).
+        self.phase_stats = PhaseStats()
 
-    def observe_filter(self, seconds: float, outcome: str) -> None:
+    def observe_filter(
+        self,
+        seconds: float,
+        outcome: str,
+        lock_wait_s: float = 0.0,
+        core_schedule_s: Optional[float] = None,
+    ) -> None:
         with self._lock:
             self.filter_count += 1
             if len(self.filter_latencies_s) < self.WINDOW:
@@ -88,6 +102,11 @@ class SchedulerMetrics:
             else:
                 self.filter_latencies_s[self._next_slot] = seconds
                 self._next_slot = (self._next_slot + 1) % self.WINDOW
+            self.phase_stats.add("lockWait", lock_wait_s)
+            if core_schedule_s is not None:
+                # None = the insist-on-previous-bind path, which never enters
+                # the core; counts stay consistent with actual schedule calls.
+                self.phase_stats.add("coreSchedule", core_schedule_s)
             if outcome == "bind":
                 self.bind_count += 1
             elif outcome == "preempt":
@@ -113,6 +132,7 @@ class SchedulerMetrics:
                 "bindCount": self.bind_count,
                 "preemptCount": self.preempt_count,
                 "waitCount": self.wait_count,
+                "phases": self.phase_stats.snapshot(),
             }
 
 
@@ -352,12 +372,34 @@ class HivedScheduler:
 
     def filter_routine(self, args: ei.ExtenderArgs) -> ei.ExtenderFilterResult:
         start = time.monotonic()
+        pod = args.pod
+        # Outside the lock: everything that is a pure function of the request
+        # — the YAML spec decode+validation and the suggested-node set build
+        # are per-request O(spec) / O(cluster) work that previously sat inside
+        # the critical section, serializing concurrent filter calls behind
+        # it. (Result serialization is already outside: the webserver encodes
+        # the returned ExtenderFilterResult after this method exits.) A spec
+        # error is captured, not raised: the BINDING insist path never reads
+        # the spec, and a bound pod whose annotation was corrupted after the
+        # decision must still get its bind re-affirmed (old behavior).
+        spec = spec_error = None
+        try:
+            spec = extract_pod_scheduling_spec(pod)
+        except api.WebServerError as e:
+            spec_error = e
+        suggested_set = set(args.node_names)
+        lock_t0 = time.monotonic()
         with self._lock:
-            result, outcome = self._filter_locked(args)
-        self.metrics.observe_filter(time.monotonic() - start, outcome)
+            lock_wait = time.monotonic() - lock_t0
+            result, outcome, core_s = self._filter_locked(
+                args, spec, spec_error, suggested_set
+            )
+        self.metrics.observe_filter(
+            time.monotonic() - start, outcome, lock_wait, core_s
+        )
         return result
 
-    def _filter_locked(self, args):
+    def _filter_locked(self, args, spec, spec_error, suggested_set):
         pod = args.pod
         suggested_nodes = args.node_names
 
@@ -373,10 +415,21 @@ class HivedScheduler:
             return (
                 ei.ExtenderFilterResult(node_names=[binding_pod.node_name]),
                 "bind",
+                None,  # insist path: the core never ran
             )
 
         # podState is Waiting or Preempting: carry out a new scheduling.
-        result = self.core.schedule(pod, suggested_nodes, SchedulingPhase.FILTERING)
+        if spec_error is not None:
+            raise spec_error
+        core_t0 = time.monotonic()
+        result = self.core.schedule(
+            pod,
+            suggested_nodes,
+            SchedulingPhase.FILTERING,
+            spec=spec,
+            suggested_set=suggested_set,
+        )
+        core_s = time.monotonic() - core_t0
 
         if result.pod_bind_info is not None:
             binding_pod = new_binding_pod(pod, result.pod_bind_info)
@@ -396,6 +449,7 @@ class HivedScheduler:
             return (
                 ei.ExtenderFilterResult(node_names=[binding_pod.node_name]),
                 "bind",
+                core_s,
             )
 
         if result.pod_preempt_info is not None:
@@ -413,7 +467,11 @@ class HivedScheduler:
             common.log.info(
                 "[%s]: Pod is waiting for preemptRoutine: %s", pod.key, failed_nodes
             )
-            return ei.ExtenderFilterResult(failed_nodes=failed_nodes), "preempt"
+            return (
+                ei.ExtenderFilterResult(failed_nodes=failed_nodes),
+                "preempt",
+                core_s,
+            )
 
         self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
             pod=pod, pod_state=PodState.WAITING, pod_schedule_result=result
@@ -432,6 +490,7 @@ class HivedScheduler:
                 failed_nodes={constants.COMPONENT_NAME: wait_reason}
             ),
             "wait",
+            core_s,
         )
 
     # ------------------------------------------------------------------ #
@@ -560,4 +619,8 @@ class HivedScheduler:
             return self.core.get_virtual_cluster_status(vcn)
 
     def get_metrics(self) -> Dict:
-        return self.metrics.snapshot()
+        snap = self.metrics.snapshot()
+        # Merge the core-side phase accumulators (leaf-cell search happens
+        # inside the topology-aware schedulers; see placement.PhaseStats).
+        snap["phases"].update(self.core.phase_stats.snapshot())
+        return snap

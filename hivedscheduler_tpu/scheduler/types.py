@@ -10,6 +10,7 @@ same property the reference's test suite exploits
 from __future__ import annotations
 
 import enum
+import functools
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
@@ -188,18 +189,49 @@ def extract_pod_bind_info(allocated_pod: Pod) -> api.PodBindInfo:
 def extract_pod_scheduling_spec(pod: Pod) -> api.PodSchedulingSpec:
     """Deserialize + default + validate the user-provided scheduling spec
     (reference: internal/utils.go:230-289). All failures are user errors
-    (HTTP 400)."""
-    err_pfx = f"Pod annotation {constants.ANNOTATION_POD_SCHEDULING_SPEC}: "
+    (HTTP 400).
+
+    The returned spec is CACHED per annotation string and shared when the
+    annotation names an affinity group: every pod of a gang carries the
+    identical annotation, and the same pod re-enters filter on each retry,
+    so the DTO construction runs once per distinct spec on the hot path
+    (doc/hot-path.md). Callers must treat the result as read-only. A spec
+    WITHOUT an affinity group is never cached — its singleton-gang default
+    is derived from the pod's own identity below."""
     annotation = pod.annotations.get(constants.ANNOTATION_POD_SCHEDULING_SPEC, "")
     if not annotation:
-        raise api.bad_request(err_pfx + "Annotation does not exist or is empty")
+        raise api.bad_request(
+            f"Pod annotation {constants.ANNOTATION_POD_SCHEDULING_SPEC}: "
+            "Annotation does not exist or is empty"
+        )
+    spec = _parse_pod_scheduling_spec(annotation)
+    if spec is not None:
+        return spec
+
+    # No affinity group in the annotation: build a per-pod spec (uncached —
+    # the default group name is this pod's identity, so two pods with the
+    # byte-identical annotation must NOT share it).
+    spec = _decode_pod_scheduling_spec(annotation)
+    spec.affinity_group = api.AffinityGroupSpec(
+        name=f"{pod.namespace}/{pod.name}",
+        members=[
+            api.AffinityGroupMemberSpec(
+                pod_number=1, leaf_cell_number=spec.leaf_cell_number
+            )
+        ],
+    )
+    _validate_pod_scheduling_spec(spec)
+    return spec
+
+
+def _decode_pod_scheduling_spec(annotation: str) -> api.PodSchedulingSpec:
+    err_pfx = f"Pod annotation {constants.ANNOTATION_POD_SCHEDULING_SPEC}: "
     try:
         # from_dict defaults ignoreK8sSuggestedNodes to True when absent
-        # (reference: api/types.go:86 `default:"true"`). Cached parse: every
-        # pod of a gang carries the identical annotation string, and the
-        # same pod re-enters filter on each retry; from_dict copies every
-        # field so sharing the parsed dict is safe.
-        spec = api.PodSchedulingSpec.from_dict(
+        # (reference: api/types.go:86 `default:"true"`). Cached parse: the
+        # YAML->dict decode is shared; from_dict copies every field so
+        # sharing the parsed dict is safe.
+        return api.PodSchedulingSpec.from_dict(
             common.from_yaml_cached(annotation) or {}
         )
     except api.WebServerError:
@@ -207,18 +239,22 @@ def extract_pod_scheduling_spec(pod: Pod) -> api.PodSchedulingSpec:
     except Exception as e:  # malformed YAML and the like
         raise api.bad_request(err_pfx + str(e))
 
-    # Defaulting: a pod with no affinity group forms a singleton gang
-    # (reference: internal/utils.go:242-250).
-    if spec.affinity_group is None:
-        spec.affinity_group = api.AffinityGroupSpec(
-            name=f"{pod.namespace}/{pod.name}",
-            members=[
-                api.AffinityGroupMemberSpec(
-                    pod_number=1, leaf_cell_number=spec.leaf_cell_number
-                )
-            ],
-        )
 
+@functools.lru_cache(maxsize=8192)
+def _parse_pod_scheduling_spec(annotation: str) -> Optional[api.PodSchedulingSpec]:
+    """Decode + validate, returning None when the spec has no affinity group
+    (the pod-dependent singleton default cannot be cached). Exceptions are
+    not cached by lru_cache: a malformed annotation re-raises its
+    bad_request on every call, exactly as before."""
+    spec = _decode_pod_scheduling_spec(annotation)
+    if spec.affinity_group is None:
+        return None
+    _validate_pod_scheduling_spec(spec)
+    return spec
+
+
+def _validate_pod_scheduling_spec(spec: api.PodSchedulingSpec) -> None:
+    err_pfx = f"Pod annotation {constants.ANNOTATION_POD_SCHEDULING_SPEC}: "
     # Validation (reference: internal/utils.go:253-287).
     if not spec.virtual_cluster:
         raise api.bad_request(err_pfx + "VirtualCluster is empty")
@@ -250,4 +286,3 @@ def extract_pod_scheduling_spec(pod: Pod) -> api.PodSchedulingSpec:
         raise api.bad_request(
             err_pfx + "AffinityGroup.Members does not contains current Pod"
         )
-    return spec

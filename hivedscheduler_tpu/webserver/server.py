@@ -22,6 +22,9 @@ from .. import common
 from ..api import constants, extender as ei, types as api
 from ..scheduler.framework import HivedScheduler
 
+# Latency metrics + the per-phase filter breakdown (lockWait / coreSchedule /
+# leafCellSearch — see doc/hot-path.md); served from the same inspect tree as
+# the cluster-status endpoints.
 METRICS_PATH = constants.INSPECT_PATH + "/metrics"
 
 

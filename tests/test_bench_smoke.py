@@ -1,0 +1,47 @@
+"""Tier-1 wiring of bench.py's scheduler-only smoke stage.
+
+Runs the production filter path over a small gang load (the same fleet and
+gang mix as the driver bench, fewer gangs) and fails CI when the
+gang-schedule p50 regresses catastrophically or the per-phase metrics stop
+adding up. The latency ceiling is deliberately generous — CI machines are
+slow and shared — it exists to catch order-of-magnitude hot-path
+regressions (an accidental O(cluster) rebuild per pod), not single-digit
+percent drift (the driver bench tracks that).
+"""
+
+import json
+
+import bench
+
+# Current p50 at this load is ~1-2 ms in-process; 150 ms = two orders of
+# magnitude of CI headroom while still failing on a complexity regression.
+SMOKE_P50_BUDGET_MS = 150.0
+SMOKE_GANGS = 16
+
+
+def test_bench_smoke_p50_and_phase_breakdown():
+    result = bench.smoke(n_gangs=SMOKE_GANGS)
+
+    assert result["gangs_scheduled"] > 0
+    assert 0.0 < result["gang_schedule_p50_ms"] < SMOKE_P50_BUDGET_MS, result
+    assert result["pods_per_sec"] > 0
+
+    # The per-phase breakdown must be present and internally consistent
+    # with the observed filter calls (ISSUE acceptance criterion).
+    phases = result["phases"]
+    assert phases["lockWait"]["count"] == result["filter_count"]
+    # Every filter call in the smoke run schedules afresh (each pod is
+    # filtered exactly once — no insist retries), so the core ran per call.
+    assert phases["coreSchedule"]["count"] == result["filter_count"]
+    # The chip search ran for every successfully placed pod.
+    assert phases["leafCellSearch"]["count"] > 0
+    for name in ("lockWait", "coreSchedule", "leafCellSearch"):
+        p = phases[name]
+        assert p["totalMs"] >= 0 and p["avgMs"] >= 0, (name, p)
+    # The sub-phases cannot exceed the in-lock schedule time they nest in.
+    assert phases["leafCellSearch"]["totalMs"] <= (
+        phases["coreSchedule"]["totalMs"] + 1.0
+    )
+
+    # The record is JSON-serializable as emitted by HIVED_BENCH_SMOKE=1.
+    json.dumps(result)

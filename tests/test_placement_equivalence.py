@@ -1,0 +1,286 @@
+"""Differential test: incremental cluster view ≡ naive per-request rebuild.
+
+The tentpole hot-path optimization (doc/hot-path.md) replaces the reference's
+per-request cluster-view re-score/re-sort with a dirty-set-invalidated
+incremental view (placement.TopologyAwareScheduler) and address-indexed free
+lists (cell.CellList). Both are pure optimizations: placements must be
+IDENTICAL to the naive path, or the dirty-tracking contract is broken.
+
+This suite runs ≥200 randomized scenarios — random fleets, gang mixes,
+priorities, deletes, node bad/heal flips, suggested-node windows, and both
+scheduling phases — through two cores built from the same config:
+
+  - the *naive* core re-scores and re-sorts every node on every request
+    (``placement.NAIVE_VIEW_DEFAULT`` / the reference's behavior,
+    topology_aware_scheduler.go:256-266),
+  - the *incremental* core re-scores only dirty nodes,
+
+and asserts every schedule call returns the same outcome class and, for
+binds, the same node + chip indices.
+"""
+
+import logging
+import random
+
+import pytest
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.algorithm import placement
+from hivedscheduler_tpu.algorithm.core import HivedCore
+from hivedscheduler_tpu.api.config import Config
+from hivedscheduler_tpu.scheduler.types import SchedulingPhase, new_binding_pod
+from hivedscheduler_tpu.tpu import topology
+
+from .test_core import make_pod
+
+common.init_logging(logging.CRITICAL)
+
+N_SCENARIOS = 220
+MAX_EVENTS = 14
+
+
+def random_config(rnd: random.Random) -> Config:
+    """A small random fleet: 1-2 v5e-16 slices + 0-2 solo hosts + 0-1
+    v5p-16, with two VCs whose quotas are randomly carved from it."""
+    cell_types = {}
+    cell_types.update(topology.v5e_cell_types(max_hosts=4))
+    cell_types.update(topology.v5p_cell_types(max_hosts=4))
+    physical = []
+    n_slices = rnd.randint(1, 2)
+    for s in range(n_slices):
+        physical.append(
+            topology.make_physical_cell(
+                "v5e-16", [f"s{s}-w{i}" for i in range(4)], cell_types
+            ).to_dict()
+        )
+    n_solo = rnd.randint(0, 2)
+    for h in range(n_solo):
+        physical.append(
+            topology.make_physical_cell(
+                "v5e-host", [f"solo-{h}"], cell_types
+            ).to_dict()
+        )
+    n_v5p = rnd.randint(0, 1)
+    for c in range(n_v5p):
+        physical.append(
+            topology.make_physical_cell(
+                "v5p-16", [f"p{c}-w{i}" for i in range(4)], cell_types
+            ).to_dict()
+        )
+
+    vc_a = {"virtualCells": []}
+    vc_b = {"virtualCells": []}
+    # Split the v5e-16 quota between the VCs at two levels.
+    if n_slices == 2:
+        vc_a["virtualCells"].append({"cellType": "v5e-16", "cellNumber": 1})
+        vc_b["virtualCells"].append(
+            {"cellType": "v5e-16.v5e-host", "cellNumber": rnd.randint(1, 4)}
+        )
+    else:
+        vc_a["virtualCells"].append(
+            {"cellType": "v5e-16.v5e-host", "cellNumber": 2}
+        )
+        vc_b["virtualCells"].append(
+            {"cellType": "v5e-16.v5e-host", "cellNumber": 2}
+        )
+    if n_solo:
+        vc_b["virtualCells"].append(
+            {"cellType": "v5e-host", "cellNumber": rnd.randint(1, n_solo)}
+        )
+    if n_v5p:
+        vc_a["virtualCells"].append(
+            {"cellType": "v5p-16.v5p-host", "cellNumber": rnd.randint(1, 4)}
+        )
+    return Config.from_dict(
+        {
+            "physicalCluster": {
+                "cellTypes": {
+                    n: {
+                        "childCellType": s.child_cell_type,
+                        "childCellNumber": s.child_cell_number,
+                        "isNodeLevel": s.is_node_level,
+                    }
+                    for n, s in cell_types.items()
+                },
+                "physicalCells": physical,
+            },
+            "virtualClusters": {"A": vc_a, "B": vc_b},
+        }
+    )
+
+
+class Core:
+    """One side of the differential pair."""
+
+    def __init__(self, config: Config, naive: bool):
+        saved = placement.NAIVE_VIEW_DEFAULT
+        placement.NAIVE_VIEW_DEFAULT = naive
+        try:
+            self.core = HivedCore(config)
+        finally:
+            placement.NAIVE_VIEW_DEFAULT = saved
+        self.nodes = sorted(
+            {
+                n
+                for ccl in self.core.full_cell_list.values()
+                for c in ccl[ccl.top_level]
+                for n in c.nodes
+            }
+        )
+        for n in self.nodes:
+            self.core.set_healthy_node(n)
+        self.bound = {}  # event name -> [binding pods]
+
+    def outcome(self, name, pod, phase, suggested, seed):
+        """Schedule one pod; on bind, commit it (assume-bind) like the
+        framework does. Seeded so the core's random victim-node pick cannot
+        diverge between the two sides."""
+        random.seed(seed)
+        r = self.core.schedule(
+            pod, suggested if suggested is not None else self.nodes, phase
+        )
+        if r.pod_bind_info is not None:
+            bp = new_binding_pod(pod, r.pod_bind_info)
+            bp.phase = "Running"
+            self.core.add_allocated_pod(bp)
+            self.bound.setdefault(name, []).append(bp)
+            return (
+                "bind",
+                r.pod_bind_info.node,
+                tuple(r.pod_bind_info.leaf_cell_isolation),
+            )
+        if r.pod_preempt_info is not None:
+            return (
+                "preempt",
+                frozenset(v.uid for v in r.pod_preempt_info.victim_pods),
+            )
+        return ("wait",)
+
+    def delete(self, name):
+        for bp in self.bound.pop(name, []):
+            self.core.delete_allocated_pod(bp)
+
+
+def run_scenario(seed: int):
+    rnd = random.Random(seed)
+    cfg_builder = lambda: random_config(random.Random(seed))  # noqa: E731
+    naive = Core(cfg_builder(), naive=True)
+    incr = Core(cfg_builder(), naive=False)
+    assert naive.nodes == incr.nodes
+
+    live = []
+    gang_id = 0
+    for event_index in range(rnd.randint(6, MAX_EVENTS)):
+        roll = rnd.random()
+        if roll < 0.15 and live:
+            name = rnd.choice(live)
+            live.remove(name)
+            naive.delete(name)
+            incr.delete(name)
+            continue
+        if roll < 0.25 and naive.nodes:
+            node = rnd.choice(naive.nodes)
+            if rnd.random() < 0.5:
+                naive.core.set_bad_node(node)
+                incr.core.set_bad_node(node)
+            else:
+                naive.core.set_healthy_node(node)
+                incr.core.set_healthy_node(node)
+            continue
+
+        # New gang.
+        gang_id += 1
+        name = f"g{seed}-{gang_id}"
+        vc = rnd.choice(["A", "B"])
+        leaf_type = rnd.choice(["v5e-chip", "v5e-chip", "v5p-chip"])
+        priority = rnd.choice([-1, 0, 0, 5])
+        n_pods = rnd.choice([1, 1, 2, 4])
+        chips = rnd.choice([1, 2, 4])
+        phase = (
+            SchedulingPhase.PREEMPTING
+            if rnd.random() < 0.3
+            else SchedulingPhase.FILTERING
+        )
+        suggested = None
+        if rnd.random() < 0.3:
+            k = rnd.randint(1, len(naive.nodes))
+            suggested = sorted(rnd.sample(naive.nodes, k))
+        group = {
+            "name": name,
+            "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
+        }
+        all_bound = True
+        for i in range(n_pods):
+            pod = make_pod(
+                f"{name}-{i}",
+                f"u-{name}-{i}",
+                vc,
+                priority,
+                leaf_type,
+                chips,
+                group=group,
+                ignore_suggested=suggested is None,
+            )
+            seed_i = seed * 100_000 + event_index * 100 + i
+            try:
+                got_naive = naive.outcome(name, pod, phase, suggested, seed_i)
+            except Exception as e_naive:  # noqa: BLE001
+                random.seed(seed_i)
+                with pytest.raises(type(e_naive)):
+                    incr.outcome(name, pod, phase, suggested, seed_i)
+                all_bound = False
+                break
+            got_incr = incr.outcome(name, pod, phase, suggested, seed_i)
+            assert got_naive == got_incr, (
+                seed, event_index, name, i, got_naive, got_incr
+            )
+            if got_naive[0] != "bind":
+                all_bound = False
+                break
+        if all_bound:
+            live.append(name)
+        else:
+            # Gang partially placed: release it on both sides (framework
+            # deletes partial gangs on failure the same way).
+            naive.delete(name)
+            incr.delete(name)
+
+
+def test_incremental_view_equals_naive_rebuild():
+    for seed in range(N_SCENARIOS):
+        run_scenario(seed)
+
+
+def test_incremental_view_dirty_tracking_under_churn():
+    """A deeper single-config soak: one fleet, heavy churn over many more
+    events, verifying cached scores never go stale across long sequences
+    (the randomized scenarios above are broad; this one is deep)."""
+    for seed in (10_001, 10_002):
+        rnd = random.Random(seed)
+        naive = Core(random_config(random.Random(seed)), naive=True)
+        incr = Core(random_config(random.Random(seed)), naive=False)
+        live = []
+        for step in range(120):
+            if rnd.random() < 0.35 and live:
+                name = rnd.choice(live)
+                live.remove(name)
+                naive.delete(name)
+                incr.delete(name)
+                continue
+            name = f"s{seed}-{step}"
+            chips = rnd.choice([1, 2, 4])
+            pod = make_pod(
+                f"{name}-0", f"u-{name}", rnd.choice(["A", "B"]),
+                rnd.choice([-1, 0]), "v5e-chip", chips,
+                group={"name": name,
+                       "members": [{"podNumber": 1, "leafCellNumber": chips}]},
+            )
+            seed_i = seed + step
+            a = naive.outcome(name, pod, SchedulingPhase.FILTERING, None, seed_i)
+            b = incr.outcome(name, pod, SchedulingPhase.FILTERING, None, seed_i)
+            assert a == b, (seed, step, a, b)
+            if a[0] == "bind":
+                live.append(name)
+            else:
+                naive.delete(name)
+                incr.delete(name)
