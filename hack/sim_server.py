@@ -59,6 +59,10 @@ for pod in [mk_pod("bert-0", "uid-bert-0", 4, gang),
             mk_pod("solo-0", "uid-solo-0", 4)]:
     s.add_pod(pod)
 
+# The manual node/pod seeding above IS this process's "initial replay";
+# flip /readyz the way InformerLoop.start() / recover() would.
+s.mark_ready()
+
 ws = WebServer(s)
 ws.start()
 print("READY", flush=True)

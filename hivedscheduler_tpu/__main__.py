@@ -87,15 +87,23 @@ def main(argv=None) -> int:
         for name in scheduler.core.configured_node_names():
             scheduler.add_node(Node(name=name))
     else:
-        from .scheduler.kube import InformerLoop, KubeAPIClient
+        from .scheduler.kube import (
+            InformerLoop,
+            KubeAPIClient,
+            RetryingKubeClient,
+        )
 
         apiserver = config.kube_apiserver_address or os.environ.get(
             "KUBE_APISERVER_ADDRESS", "https://kubernetes.default.svc"
         )
         client = KubeAPIClient(apiserver)
-        scheduler.kube_client = client
+        # Write path goes through the fault absorber: transient apiserver
+        # errors are retried with backoff; terminal 404/409 failures release
+        # the assume-bind allocation (doc/fault-model.md).
+        scheduler.kube_client = RetryingKubeClient(client, scheduler=scheduler)
         # Recovery completes before we accept scheduling requests
-        # (reference: scheduler.go:200-212).
+        # (reference: scheduler.go:200-212); /readyz turns 200 when the
+        # informer's initial replay is done.
         InformerLoop(scheduler, client).start()
 
     server = WebServer(scheduler)

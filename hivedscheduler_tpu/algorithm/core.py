@@ -85,6 +85,15 @@ def all_children_same_state(c: PhysicalCell, s: CellState) -> bool:
     return all(child.state == s for child in c.children)
 
 
+def _cells_overlap(a: Cell, b: Cell) -> bool:
+    """True when one cell's subtree contains the other (same chain)."""
+    hi, lo = (a, b) if a.level >= b.level else (b, a)
+    cur: Optional[Cell] = lo
+    while cur is not None and cur.level < hi.level:
+        cur = cur.parent
+    return cur is not None and cell_equal(cur, hi)
+
+
 def set_cell_state(c: PhysicalCell, s: CellState) -> None:
     """Propagate state up: a parent is Used if ANY child is Used; it takes
     the other states only when ALL children share them
@@ -514,6 +523,14 @@ class HivedCore:
         # Opportunistic cells currently charged to each VC, for the inspect
         # API (reference: utils.go:419-452 OT virtual cells).
         self._ot_cells: Dict[api.VirtualClusterName, List[PhysicalCell]] = {}
+        # (chain, level) -> count of doomed-bad shortfalls that must be
+        # re-checked after the current pod replay completes: evicting a
+        # doomed binding mid-replay leaves the shortfall unaddressed, but
+        # re-dooming immediately could grab the very virtual cell the
+        # replayed pod is about to claim — so the check is deferred to
+        # add_allocated_pod, and the safety checks discount the pending
+        # units meanwhile (the freed quota is spoken for, not actually free).
+        self._pending_doomed_checks: Dict[Tuple[CellChain, CellLevel], int] = {}
 
         self._init_cell_nums()
         self._init_pinned_cells(cc.physical_pinned)
@@ -710,6 +727,11 @@ class HivedCore:
                 self.total_left_cell_num[chain][level]
                 - len(self.bad_free_cells[chain][level])
             ):
+                if len(self.bad_free_cells[chain][level]) == 0:
+                    # Shortfall with no bad free cell to bind (possible when
+                    # a deferred re-check runs after the last bad cell was
+                    # claimed): nothing to doom until one appears.
+                    break
                 pc = self.bad_free_cells[chain][level][0]
                 assert isinstance(pc, PhysicalCell)
                 preassigned = self.vc_schedulers[vc_name].non_pinned_preassigned
@@ -762,12 +784,20 @@ class HivedCore:
                     "Cell %s is no longer doomed to be bad and is unbound "
                     "from %s", pc.virtual_cell.address, pc.address,
                 )
-                pc.virtual_cell.set_physical_cell(None)
-                pc.set_virtual_cell(None)
-                self._unbind_bad_descendants(pc)
-                self.vc_doomed_bad_cells[vc_name][chain].remove(pc, level)
-                self.all_vc_doomed_bad_cell_num[chain][level] -= 1
-                self._release_preassigned_cell(pc, vc_name, True)
+                self._unbind_doomed_cell(pc)
+
+    def _unbind_doomed_cell(self, pc: PhysicalCell) -> None:
+        """Destroy a doomed-bad advisory binding and release its quota
+        allocation — the shared tail of doomed retirement and the two
+        replay-eviction paths. Callers log their own reason first."""
+        vc = pc.virtual_cell
+        vcn = vc.vc
+        vc.set_physical_cell(None)
+        pc.set_virtual_cell(None)
+        self._unbind_bad_descendants(pc)
+        self.vc_doomed_bad_cells[vcn][pc.chain].remove(pc, pc.level)
+        self.all_vc_doomed_bad_cell_num[pc.chain][pc.level] -= 1
+        self._release_preassigned_cell(pc, vcn, True)
 
     # -- scheduling ---------------------------------------------------------
 
@@ -1145,9 +1175,50 @@ class HivedCore:
                 )
                 self._delete_preempting_affinity_group(g, pod)
 
+    def validate_allocated_pod(self, pod: Pod) -> None:
+        """Pure precheck for replaying a bound pod (crash recovery): raises a
+        WebServerError — WITHOUT mutating any cell state — when the pod's
+        annotations cannot be replayed against the current config, so the
+        framework can quarantine it instead of aborting recovery mid-mutation.
+
+        Rejected inputs: undecodable scheduling-spec/bind-info annotations,
+        a bind info that does not contain the pod's own placement, and a
+        placement none of whose leaf cells exist in the current config (the
+        reference silently ignores such pods, hived_algorithm.go:1000-1005;
+        partially-found placements are still tolerated below for
+        work-preserving reconfiguration)."""
+        s = extract_pod_scheduling_spec(pod)
+        info = extract_pod_bind_info(pod)
+        if get_allocated_pod_index(info, s.leaf_cell_number) == -1:
+            raise api.bad_request(
+                f"Pod placement not found in its bind info: node {info.node}, "
+                f"leaf cells {info.leaf_cell_isolation}"
+            )
+        if not any(
+            find_physical_leaf_cell(self.full_cell_list, info.cell_chain,
+                                    info.node, idx) is not None
+            for idx in info.leaf_cell_isolation
+        ):
+            raise api.bad_request(
+                f"None of the pod's leaf cells (node {info.node}, chain "
+                f"{info.cell_chain}, indices {info.leaf_cell_isolation}) "
+                "exist in the current configuration"
+            )
+
     def add_allocated_pod(self, pod: Pod) -> None:
         """Confirm an assume-bind or replay a recovered pod
         (reference: hived_algorithm.go:247-270)."""
+        try:
+            self._add_allocated_pod(pod)
+        finally:
+            # Must run even when the replay raises (and the framework
+            # quarantines the pod): evictions performed before the failure
+            # incremented the pending discounts, and leaving them would
+            # make _effective_vc_free under-count allVCFree in every later
+            # safety check.
+            self._flush_pending_doomed_checks()
+
+    def _add_allocated_pod(self, pod: Pod) -> None:
         s = extract_pod_scheduling_spec(pod)
         info = extract_pod_bind_info(pod)
         common.log.info(
@@ -1178,6 +1249,14 @@ class HivedCore:
         self.affinity_groups[s.affinity_group.name].allocated_pods[
             s.leaf_cell_number
         ][pod_index] = pod
+
+    def _flush_pending_doomed_checks(self) -> None:
+        """Replay evictions may have deferred doomed-shortfall re-checks;
+        once the replayed pod's quota is consumed, re-dooming cannot steal
+        from it."""
+        while self._pending_doomed_checks:
+            (chain, level), _ = self._pending_doomed_checks.popitem()
+            self._try_bind_doomed_bad_cell(chain, level)
 
     def delete_allocated_pod(self, pod: Pod) -> None:
         """(reference: hived_algorithm.go:272-296)"""
@@ -1514,20 +1593,6 @@ class HivedCore:
             )
             return p_leaf, None, True
         if group.virtual_placement is not None and not lazy_preempted:
-            # Replay may find another VC's DOOMED binding sitting on this
-            # pod's cells: the fresh core marked nodes bad before the pod
-            # replayed, so the doomed binder saw the cell as free and
-            # grabbed it. The real allocation takes precedence — evict the
-            # advisory binding (it re-dooms onto a genuinely free bad cell
-            # at the next doomed-bind check).
-            cur: Optional[PhysicalCell] = p_leaf
-            while cur is not None and cur.virtual_cell is None:
-                cur = cur.parent  # type: ignore[assignment]
-            if (
-                cur is not None
-                and cur.virtual_cell.vc != s.virtual_cluster
-            ):
-                self._evict_doomed_binding(cur)
             preassigned_type = preassigned_cell_types[index]
             if preassigned_type:
                 message = ""
@@ -1556,9 +1621,72 @@ class HivedCore:
                             f"VC {s.virtual_cluster} has no cell for {target}"
                         )
                     else:
+                        # The subtree the pod's preassigned cell will claim.
+                        anchor: Optional[PhysicalCell] = p_leaf
+                        while (
+                            anchor is not None
+                            and anchor.level < preassigned_level
+                        ):
+                            anchor = anchor.parent  # type: ignore[assignment]
+                        if anchor is not None and not s.pinned_cell_id:
+                            # Replay may find DOOMED advisory bindings
+                            # overlapping the claim: recovery marks nodes
+                            # bad before pods replay, so the doomed binder
+                            # saw these cells as free and grabbed them —
+                            # at or above the anchor (blocking the binding
+                            # path) or strictly inside it (splitting the
+                            # anchor out of the free list). The real
+                            # allocation takes precedence: evict them; each
+                            # doom is re-bound onto a non-overlapping bad
+                            # free cell when one exists.
+                            self._evict_doomed_overlapping(
+                                anchor, s.virtual_cluster
+                            )
                         v_leaf, message = allocation.map_physical_cell_to_virtual(
                             p_leaf, vccl, preassigned_level, priority
                         )
+                        if (
+                            v_leaf is None
+                            and not s.pinned_cell_id
+                            and self._evict_doomed_binding_for_vc(
+                                s.virtual_cluster, p_leaf.chain,
+                                preassigned_level,
+                            )
+                        ):
+                            # A doomed-bad binding of this pod's OWN VC was
+                            # squatting on the quota cell the replay needs
+                            # (bound to a DIFFERENT physical cell), so the
+                            # real allocation failed to map — degrading the
+                            # whole group to opportunistic and losing its VC
+                            # membership across a restart. The advisory
+                            # binding yields; the shortfall is re-checked
+                            # once the pod's quota is consumed
+                            # (add_allocated_pod flushes the deferred
+                            # checks). Found by the chaos harness
+                            # restart-equivalence invariant.
+                            v_leaf, message = (
+                                allocation.map_physical_cell_to_virtual(
+                                    p_leaf, vccl, preassigned_level, priority
+                                )
+                            )
+                        if (
+                            v_leaf is not None
+                            and anchor is not None
+                            and not s.pinned_cell_id
+                            and v_leaf.preassigned_cell.physical_cell is None
+                            and not in_free_cell_list(anchor)
+                        ):
+                            # The mapping found a virtual cell but the
+                            # physical anchor is not claimable (e.g. a
+                            # foreign REAL allocation splits it — possible
+                            # after overlapped safety violations). Degrade
+                            # to opportunistic instead of crashing the
+                            # replay mid-mutation.
+                            v_leaf = None
+                            message = (
+                                f"physical cell {anchor.address} is not a "
+                                "free cell (split or allocated elsewhere)"
+                            )
                 if v_leaf is None:
                     common.log.warning(
                         "[%s]: Cannot find virtual cell: %s", pod.key, message
@@ -1568,11 +1696,93 @@ class HivedCore:
             return p_leaf, None, None
         return p_leaf, None, False
 
-    def _evict_doomed_binding(self, pc: PhysicalCell) -> None:
-        """Remove another VC's doomed-bad binding from ``pc`` so a replayed
-        real allocation can claim the cell. No-op unless ``pc`` is in that
-        VC's doomed list (a non-doomed foreign binding is a true conflict,
-        left for the mapping to reject into lazy preemption)."""
+    def _evict_doomed_binding_for_vc(
+        self, vcn: api.VirtualClusterName, chain: CellChain, level: CellLevel
+    ) -> bool:
+        """Evict one of ``vcn``'s own doomed-bad bindings at (chain, level)
+        so a replayed real allocation can claim the virtual quota cell the
+        advisory binding holds. Skips doomed cells hosting live guaranteed
+        allocations (same priority guard as _try_unbind_doomed_bad_cell).
+        Returns True if a binding was evicted."""
+        doomed = self.vc_doomed_bad_cells.get(vcn, {}).get(chain)
+        if doomed is None:
+            return False
+        pc = next(
+            (
+                c
+                for c in doomed[level]
+                if c.priority < MIN_GUARANTEED_PRIORITY
+            ),
+            None,
+        )
+        if pc is None:
+            return False
+        assert isinstance(pc, PhysicalCell)
+        common.log.warning(
+            "Evicting doomed binding %s -> %s (VC %s): the VC's replayed "
+            "allocation needs the virtual quota cell",
+            pc.virtual_cell.address, pc.address, vcn,
+        )
+        self._unbind_doomed_cell(pc)
+        key = (chain, level)
+        self._pending_doomed_checks[key] = (
+            self._pending_doomed_checks.get(key, 0) + 1
+        )
+        return True
+
+    def _evict_doomed_overlapping(
+        self, anchor: PhysicalCell, vcn: api.VirtualClusterName
+    ) -> None:
+        """Evict doomed-bad advisory bindings overlapping the subtree
+        ``anchor`` — the physical region a replayed pod's preassigned cell
+        is about to claim. Both directions matter: a foreign doom at or
+        above the anchor blocks the binding path, while a doom strictly
+        inside it (any VC's) leaves the anchor split and un-allocatable.
+        Real bindings are left alone (genuine conflicts degrade to lazy
+        preemption, as before)."""
+        cur: Optional[PhysicalCell] = anchor
+        while cur is not None and cur.virtual_cell is None:
+            cur = cur.parent  # type: ignore[assignment]
+        if cur is not None:
+            # Climb to the TOP of the binding chain: the doomed LISTING
+            # lives at the quota level where the doomed bind happened,
+            # while _set_bad_cell hangs advisory descendant bindings all
+            # the way down to the leaves.
+            while (
+                cur.parent is not None
+                and cur.parent.virtual_cell is not None
+            ):
+                cur = cur.parent  # type: ignore[assignment]
+            if cur.virtual_cell.vc != vcn:
+                # Same-VC bindings on the path are reused by the mapping.
+                self._evict_doomed_binding(cur, avoid=anchor)
+        stack: List[PhysicalCell] = [anchor]
+        while stack:
+            c = stack.pop()
+            for child in c.children:
+                assert isinstance(child, PhysicalCell)
+                if child.virtual_cell is not None:
+                    # Doomed (any VC): evict; a real binding is someone
+                    # else's region — do not descend either way.
+                    self._evict_doomed_binding(child, avoid=anchor)
+                    continue
+                stack.append(child)
+
+    def _evict_doomed_binding(
+        self, pc: PhysicalCell, avoid: Optional[PhysicalCell] = None
+    ) -> None:
+        """Remove a doomed-bad advisory binding from ``pc`` so a replayed
+        real allocation can claim the region. No-op unless ``pc`` is in its
+        VC's doomed list (a non-doomed binding is a true conflict, left for
+        the mapping to reject into lazy preemption).
+
+        The doom is immediately re-bound ("swapped") onto another bad free
+        cell not overlapping ``avoid`` when one exists: leaving the
+        shortfall unaddressed until the deferred check would transiently
+        inflate allVCFreeCellNum at the evicted level, and the replayed
+        pod's own safety checks then see phantom broken safety and
+        lazy-preempt the group (found by the chaos harness
+        restart-equivalence invariant)."""
         vc = pc.virtual_cell
         vcn = vc.vc
         doomed = self.vc_doomed_bad_cells.get(vcn, {}).get(pc.chain)
@@ -1584,16 +1794,73 @@ class HivedCore:
             # leave it for the mapping to reject into lazy preemption.
             return
         common.log.warning(
-            "Evicting doomed binding %s -> %s (VC %s): the cell hosts a "
-            "replayed allocation of another VC",
+            "Evicting doomed binding %s -> %s (VC %s): the cell overlaps a "
+            "replayed real allocation",
             vc.address, pc.address, vcn,
         )
-        pc.set_virtual_cell(None)
-        vc.set_physical_cell(None)
-        self._unbind_bad_descendants(pc)
-        doomed.remove(pc, pc.level)
-        self.all_vc_doomed_bad_cell_num[pc.chain][pc.level] -= 1
-        self._release_preassigned_cell(pc, vcn, True)
+        chain, level = pc.chain, pc.level
+        self._unbind_doomed_cell(pc)
+        if not self._swap_doomed_binding(vcn, chain, level, pc, avoid):
+            key = (chain, level)
+            self._pending_doomed_checks[key] = (
+                self._pending_doomed_checks.get(key, 0) + 1
+            )
+
+    def _swap_doomed_binding(
+        self,
+        vcn: api.VirtualClusterName,
+        chain: CellChain,
+        level: CellLevel,
+        evicted: PhysicalCell,
+        avoid: Optional[PhysicalCell],
+    ) -> bool:
+        """Re-bind an evicted doom onto a different bad free cell at the
+        same (chain, level) — the choice the continuous timeline would have
+        made, since there the real allocation existed before the doom. The
+        replacement must not be the evicted cell itself nor overlap the
+        region being replayed. Returns True when the doom was re-bound."""
+        vc_free = self.vc_free_cell_num.get(vcn, {}).get(chain, {})
+        if vc_free.get(level, 0) <= (
+            self.total_left_cell_num[chain][level]
+            - len(self.bad_free_cells[chain][level])
+        ):
+            return False  # shortfall no longer holds; nothing to re-doom
+        preassigned = self.vc_schedulers[vcn].non_pinned_preassigned
+        if chain not in preassigned:
+            return False
+        target = allocation.get_unbound_virtual_cell(preassigned[chain][level])
+        if target is None:
+            return False
+        candidate = next(
+            (
+                c
+                for c in self.bad_free_cells[chain][level]
+                # Bad-free cells are unbound by construction (dooming
+                # removes the cell from this list); the binding check is
+                # defensive — clobbering an existing binding would corrupt
+                # both VCs' doomed accounting.
+                if c.virtual_cell is None  # type: ignore[union-attr]
+                and not cell_equal(c, evicted)
+                and (avoid is None or not _cells_overlap(c, avoid))
+            ),
+            None,
+        )
+        if candidate is None:
+            return False
+        assert isinstance(candidate, PhysicalCell)
+        candidate.set_virtual_cell(target)
+        target.set_physical_cell(candidate)
+        common.log.warning(
+            "Cell %s is doomed to be bad and bound to %s (VC %s, swapped "
+            "from %s)", target.address, candidate.address, vcn,
+            evicted.address,
+        )
+        self.vc_doomed_bad_cells[vcn][chain][level].append(candidate)
+        self.all_vc_doomed_bad_cell_num[chain][level] = (
+            self.all_vc_doomed_bad_cell_num[chain].get(level, 0) + 1
+        )
+        self._allocate_preassigned_cell(candidate, vcn, True)
+        return True
 
     def _unbind_bad_descendants(self, pc: PhysicalCell) -> None:
         """Clear the advisory bad-cell bindings under a cell whose own
@@ -1754,7 +2021,7 @@ class HivedCore:
             self.total_left_cell_num[chain][l] -= 1
             if (
                 self.total_left_cell_num[chain][l]
-                < self.all_vc_free_cell_num.get(chain, {}).get(l, 0)
+                < self._effective_vc_free(chain, l)
             ):
                 safety_ok = False
                 reason = self._safety_reason(chain, l)
@@ -1778,7 +2045,7 @@ class HivedCore:
             self.total_left_cell_num[chain][l] -= num_to_reduce
             if (
                 self.total_left_cell_num[chain][l]
-                < self.all_vc_free_cell_num.get(chain, {}).get(l, 0)
+                < self._effective_vc_free(chain, l)
             ):
                 safety_ok = False
                 reason = self._safety_reason(chain, l)
@@ -1788,6 +2055,16 @@ class HivedCore:
                 l > LOWEST_LEVEL
             ) else 0
         return safety_ok, reason
+
+    def _effective_vc_free(self, chain: CellChain, l: CellLevel) -> int:
+        """allVCFreeCellNum discounted by pending doomed re-checks: quota
+        freed by a mid-replay doom eviction is spoken for (it re-dooms when
+        the replay completes), so the safety checks must not count it as
+        free — otherwise the replayed group sees phantom broken safety and
+        gets lazy-preempted out of its VC."""
+        return self.all_vc_free_cell_num.get(chain, {}).get(
+            l, 0
+        ) - self._pending_doomed_checks.get((chain, l), 0)
 
     def _safety_reason(self, chain: CellChain, l: CellLevel) -> str:
         """Safety-violation message. Uses .get throughout: total_left can be

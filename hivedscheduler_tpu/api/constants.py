@@ -54,3 +54,15 @@ AFFINITY_GROUPS_PATH = INSPECT_PATH + "/affinitygroups/"
 CLUSTER_STATUS_PATH = INSPECT_PATH + "/clusterstatus"
 PHYSICAL_CLUSTER_PATH = CLUSTER_STATUS_PATH + "/physicalcluster"
 VIRTUAL_CLUSTERS_PATH = CLUSTER_STATUS_PATH + "/virtualclusters/"
+
+# Pods whose recovery replay failed (corrupt bind-info annotation, cells
+# absent from the current config) are parked here instead of crashing
+# recovery; see doc/fault-model.md.
+QUARANTINE_PATH = INSPECT_PATH + "/quarantine"
+
+# Probe endpoints (no reference analog; the reference relies on the informer
+# WaitForCacheSync ordering alone). /healthz is liveness (process up);
+# /readyz gates on recovery completion so K8s does not route extender
+# traffic to a scheduler still replaying bound pods.
+HEALTHZ_PATH = "/healthz"
+READYZ_PATH = "/readyz"

@@ -31,6 +31,7 @@ from .types import (
     PodScheduleResult,
     PodScheduleStatus,
     PodState,
+    QuarantineRecord,
     SchedulingPhase,
     extract_pod_scheduling_spec,
     is_allocated_state,
@@ -84,6 +85,13 @@ class SchedulerMetrics:
         self.bind_count = 0
         self.preempt_count = 0
         self.wait_count = 0
+        # Fault-plane counters (doc/fault-model.md): bind write-path retries
+        # and terminal failures (RetryingKubeClient), plus pods quarantined
+        # during recovery replay.
+        self.bind_retry_count = 0
+        self.bind_give_up_count = 0
+        self.bind_terminal_count = 0
+        self.quarantine_count = 0
         # Framework-side phases (same accumulator/formatter as the core's
         # leaf-cell-search stats, so the merged "phases" payload is uniform).
         self.phase_stats = PhaseStats()
@@ -114,6 +122,22 @@ class SchedulerMetrics:
             else:
                 self.wait_count += 1
 
+    def observe_bind_retry(self) -> None:
+        with self._lock:
+            self.bind_retry_count += 1
+
+    def observe_bind_give_up(self) -> None:
+        with self._lock:
+            self.bind_give_up_count += 1
+
+    def observe_bind_terminal(self) -> None:
+        with self._lock:
+            self.bind_terminal_count += 1
+
+    def observe_quarantine(self) -> None:
+        with self._lock:
+            self.quarantine_count += 1
+
     def snapshot(self) -> Dict:
         with self._lock:
             lat = sorted(self.filter_latencies_s)
@@ -132,6 +156,10 @@ class SchedulerMetrics:
                 "bindCount": self.bind_count,
                 "preemptCount": self.preempt_count,
                 "waitCount": self.wait_count,
+                "bindRetryCount": self.bind_retry_count,
+                "bindGiveUpCount": self.bind_give_up_count,
+                "bindTerminalFailureCount": self.bind_terminal_count,
+                "quarantineCount": self.quarantine_count,
                 "phases": self.phase_stats.snapshot(),
             }
 
@@ -166,7 +194,18 @@ class HivedScheduler:
         # Node cache standing in for the node lister (used by
         # validate_pod_bind_info; reference: scheduler.go:385-421).
         self.nodes: Dict[str, Node] = {}
+        # uid -> QuarantineRecord: bound pods whose recovery replay failed
+        # (corrupt bind info, cells gone from the config). Parked instead of
+        # aborting recovery; surfaced via /v1/inspect/quarantine.
+        self.quarantined_pods: Dict[str, QuarantineRecord] = {}
+        # Readiness gate: /readyz stays 503 until recovery (the initial
+        # list replay) completes, mirroring the reference's WaitForCacheSync
+        # ordering (scheduler.go:200-212).
+        self._ready = threading.Event()
         self.auto_admit = auto_admit
+        if auto_admit:
+            # Standalone/simulation mode has no recovery phase.
+            self._ready.set()
         self._spawn = force_bind_executor or self._default_executor
 
     @staticmethod
@@ -180,12 +219,59 @@ class HivedScheduler:
     def recover(self, nodes: Iterable[Node], pods: Iterable[Pod]) -> None:
         """Replay the current cluster state before serving requests: every
         bound hived pod re-enters via add_pod -> add_bound_pod ->
-        AddAllocatedPod, rebuilding all cell state from annotations."""
+        AddAllocatedPod, rebuilding all cell state from annotations.
+
+        Fault contract: one unreplayable pod must not abort recovery —
+        add_pod quarantines bound pods whose annotations cannot be replayed
+        (see _add_bound_pod); anything else escaping is caught here so the
+        remaining pods still recover. Readiness (/readyz) flips only after
+        the full replay."""
         for node in nodes:
             self.add_node(node)
         for pod in pods:
-            if is_interested(pod):
+            if not is_interested(pod):
+                continue
+            try:
                 self.add_pod(pod)
+            except Exception as e:  # noqa: BLE001
+                self._quarantine_pod(pod, e)
+        self.mark_ready()
+
+    def mark_ready(self) -> None:
+        """Recovery (initial list replay) complete: /readyz turns 200."""
+        self._ready.set()
+
+    def is_ready(self) -> bool:
+        return self._ready.is_set()
+
+    def _quarantine_pod(self, pod: Pod, error: Exception) -> None:
+        """Park an unreplayable bound pod: logged, counted, surfaced via the
+        inspect API, and excluded from the scheduling view. Must be called
+        with or without the lock held (RLock re-entry)."""
+        with self._lock:
+            if pod.uid in self.quarantined_pods:
+                return
+            common.log.error(
+                "[%s]: quarantining pod bound to node %s: recovery replay "
+                "failed: %s", pod.key, pod.node_name, error,
+            )
+            self.quarantined_pods[pod.uid] = QuarantineRecord(
+                pod=pod,
+                reason=f"{type(error).__name__}: {error}",
+                quarantined_at=time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+                ),
+            )
+            self.metrics.observe_quarantine()
+
+    def get_quarantine(self) -> Dict:
+        """Inspect payload for /v1/inspect/quarantine."""
+        with self._lock:
+            return {
+                "items": [
+                    r.to_dict() for r in self.quarantined_pods.values()
+                ]
+            }
 
     # ------------------------------------------------------------------ #
     # Node events (reference: scheduler.go:218-251)
@@ -230,24 +316,53 @@ class HivedScheduler:
             if is_interested(old) or new.uid in self.pod_schedule_statuses:
                 self.delete_pod(new)
             return
+        record = self.quarantined_pods.get(new.uid)
+        if record is not None and new.annotations != record.pod.annotations:
+            # The pod changed since it was quarantined (e.g. an operator
+            # repaired the bind-info annotation): give replay another try.
+            with self._lock:
+                self.quarantined_pods.pop(new.uid, None)
+            self.add_pod(new)
+            return
         old_bound, new_bound = is_bound(old), is_bound(new)
         if not old_bound and new_bound:
             self._add_bound_pod(new)
         elif old_bound and not new_bound:
-            raise AssertionError(
-                f"[{new.key}]: Pod updated from bound to unbound: "
-                f"previous bound node: {old.node_name}"
+            # K8s never unbinds a pod in place, so this event is a corrupt or
+            # reordered watch stream. The reference asserts here
+            # (scheduler.go:280-284) — which kills the informer thread and
+            # freezes the scheduling view. Degrade instead: treat it as
+            # delete+re-add so the view stays consistent with whatever the
+            # apiserver now claims.
+            common.log.error(
+                "[%s]: Pod updated from bound to unbound (previous bound "
+                "node: %s); degrading to delete+re-add", new.key,
+                old.node_name,
             )
+            self.delete_pod(old)
+            self.add_pod(new)
 
     def delete_pod(self, pod: Pod) -> None:
         with self._lock:
+            # A quarantined pod holds no cell state; just drop the record.
+            self.quarantined_pods.pop(pod.uid, None)
             status = self.pod_schedule_statuses.get(pod.uid)
             if status is None:
                 return
-            if is_allocated_state(status.pod_state):
-                self.core.delete_allocated_pod(status.pod)
-            else:
-                self.core.delete_unallocated_pod(status.pod)
+            try:
+                if is_allocated_state(status.pod_state):
+                    self.core.delete_allocated_pod(status.pod)
+                else:
+                    self.core.delete_unallocated_pod(status.pod)
+            except Exception:  # noqa: BLE001
+                # A delete that fails half-way must still drop the status:
+                # replaying it forever would wedge the informer on one pod
+                # (the core logs-and-continues on unknown placements, so
+                # anything raising here is unexpected corruption).
+                common.log.exception(
+                    "[%s]: error releasing pod from the core; dropping its "
+                    "status anyway", pod.key,
+                )
             del self.pod_schedule_statuses[pod.uid]
 
     def _add_bound_pod(self, pod: Pod) -> None:
@@ -261,8 +376,21 @@ class HivedScheduler:
                         pod=status.pod, pod_state=PodState.BOUND
                     )
                 return
-            # Recovery of a pod bound before we started.
-            self.core.add_allocated_pod(pod)
+            if pod.uid in self.quarantined_pods:
+                # Relists re-deliver quarantined pods every gap repair; the
+                # verdict does not change until the pod itself does.
+                return
+            # Recovery of a pod bound before we started. Validate BEFORE
+            # mutating cell state: a corrupt bind-info annotation or a
+            # placement gone from the config quarantines this one pod
+            # instead of aborting the whole recovery replay
+            # (pre-fault-model behavior: raise through recover()).
+            try:
+                self.core.validate_allocated_pod(pod)
+                self.core.add_allocated_pod(pod)
+            except Exception as e:  # noqa: BLE001
+                self._quarantine_pod(pod, e)
+                return
             self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
                 pod=pod, pod_state=PodState.BOUND
             )
@@ -525,6 +653,25 @@ class HivedScheduler:
         self.kube_client.bind_pod(binding_pod)
         return ei.ExtenderBindingResult()
 
+    def handle_terminal_bind_failure(self, binding_pod: Pod) -> None:
+        """The bind write failed terminally (pod gone: 404, or replaced: 409
+        UID-precondition): the assume-bind allocation would hold the gang's
+        cells forever, since no informer DELETE will ever arrive for a pod
+        that was never bound. Release it; if the pod still exists unbound,
+        the default scheduler re-filters it and it is re-admitted cleanly
+        (called by RetryingKubeClient, outside the scheduler lock)."""
+        with self._lock:
+            status = self.pod_schedule_statuses.get(binding_pod.uid)
+            if status is None or status.pod_state != PodState.BINDING:
+                # Never allocated, or already confirmed Bound (the informer
+                # owns the lifecycle from there).
+                return
+            common.log.error(
+                "[%s]: releasing allocation after terminal bind failure "
+                "(node %s)", binding_pod.key, binding_pod.node_name,
+            )
+            self.delete_pod(status.pod)
+
     # ------------------------------------------------------------------ #
     # Preempt (reference: scheduler.go:629-721)
     # ------------------------------------------------------------------ #
@@ -623,4 +770,7 @@ class HivedScheduler:
         # Merge the core-side phase accumulators (leaf-cell search happens
         # inside the topology-aware schedulers; see placement.PhaseStats).
         snap["phases"].update(self.core.phase_stats.snapshot())
+        with self._lock:
+            snap["quarantinedPodCount"] = len(self.quarantined_pods)
+        snap["ready"] = self.is_ready()
         return snap

@@ -14,6 +14,7 @@ No third-party deps — urllib with a persistent-ish connection per watch.
 from __future__ import annotations
 
 import json
+import random
 import ssl
 import threading
 import time
@@ -23,11 +24,154 @@ from typing import Callable, Dict, Iterable, Optional
 
 from .. import common
 from ..api import constants, extender as ei
-from .framework import HivedScheduler, KubeClient
+from .framework import HivedScheduler, KubeClient, SchedulerMetrics
 from .types import Node, Pod, is_interested
 
 SA_TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"  # noqa: S105
 SA_CA_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
+
+
+class KubeAPIError(Exception):
+    """An apiserver request that completed with an HTTP error status.
+
+    Carries the status code (for the RetryingKubeClient classifier) and the
+    response body (apiserver Status messages say WHY a bind was rejected —
+    urllib's bare HTTPError drops it, which made bind/relist failures
+    undiagnosable from logs)."""
+
+    def __init__(self, method: str, path: str, status: int, body: str):
+        self.method = method
+        self.path = path
+        self.status = status
+        self.body = body
+        super().__init__(
+            f"{method} {path}: HTTP {status}: {body[:512] or '<empty body>'}"
+        )
+
+
+def is_already_bound_conflict(e: Exception, node: str) -> bool:
+    """A 409 from the Binding subresource for a pod ALREADY bound to the
+    same node. bind_routine is idempotent by design (the force-bind
+    executor races the extender bind path), so a duplicate Binding POST is
+    a normal occurrence — the apiserver answers 409 "already assigned to
+    node X". That is SUCCESS (the desired state holds), not the
+    UID-precondition 409 that signals pod replacement; treating it as
+    terminal would release a live gang's allocation."""
+    if not (isinstance(e, KubeAPIError) and e.status == 409):
+        return False
+    body = e.body or ""
+    return (
+        ("already assigned" in body or "already bound" in body)
+        and node in body
+    )
+
+
+def is_retryable_kube_error(e: Exception) -> bool:
+    """Classify a bind/write failure. Retryable: transport errors (refused,
+    reset, timeout, TLS), apiserver 5xx, and 429 throttling. Terminal: other
+    HTTP statuses — notably 404 (pod deleted before the bind landed) and 409
+    (UID precondition: the pod was deleted and recreated, so the decision
+    belongs to a dead incarnation)."""
+    if isinstance(e, KubeAPIError):
+        return e.status >= 500 or e.status == 429
+    if isinstance(e, urllib.error.HTTPError):  # not wrapped by _request
+        return e.code >= 500 or e.code == 429
+    return isinstance(e, (urllib.error.URLError, OSError, TimeoutError))
+
+
+class RetryingKubeClient(KubeClient):
+    """Write-path fault absorber wrapping any KubeClient.
+
+    Retryable bind errors (transport / 5xx / 429) get capped exponential
+    backoff with jitter; terminal errors (404 pod-gone, 409 UID-precondition)
+    release the pod's assume-bind allocation through the scheduler so the
+    gang's cells are not leaked forever — no informer DELETE ever arrives
+    for a pod that was never bound. Counters land in SchedulerMetrics
+    (bindRetryCount / bindGiveUpCount / bindTerminalFailureCount).
+
+    ``sleep`` and ``jitter_rng`` are injectable so the chaos harness can run
+    the real retry loop deterministically and without wall-clock delays.
+    """
+
+    MAX_ATTEMPTS = 5
+    BACKOFF_INITIAL_S = 0.2
+    BACKOFF_MAX_S = 5.0
+    JITTER_FRACTION = 0.25
+
+    def __init__(
+        self,
+        inner: KubeClient,
+        scheduler: Optional[HivedScheduler] = None,
+        metrics: Optional[SchedulerMetrics] = None,
+        max_attempts: int = MAX_ATTEMPTS,
+        backoff_initial_s: float = BACKOFF_INITIAL_S,
+        backoff_max_s: float = BACKOFF_MAX_S,
+        sleep: Callable[[float], None] = time.sleep,
+        jitter_rng: Optional[random.Random] = None,
+    ) -> None:
+        self.inner = inner
+        self.scheduler = scheduler
+        self.metrics = metrics or (scheduler.metrics if scheduler else None)
+        self.max_attempts = max_attempts
+        self.backoff_initial_s = backoff_initial_s
+        self.backoff_max_s = backoff_max_s
+        self._sleep = sleep
+        self._rng = jitter_rng or random.Random()
+
+    def bind_pod(self, binding_pod: Pod) -> None:
+        backoff = self.backoff_initial_s
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                self.inner.bind_pod(binding_pod)
+                if attempt > 1:
+                    common.log.info(
+                        "[%s]: bind succeeded on attempt %d",
+                        binding_pod.key, attempt,
+                    )
+                return
+            except Exception as e:  # noqa: BLE001
+                if is_already_bound_conflict(e, binding_pod.node_name):
+                    # Duplicate bind of an already-bound pod (idempotent
+                    # retry / force-bind race): the desired state holds.
+                    common.log.info(
+                        "[%s]: pod already bound to %s; treating bind as "
+                        "succeeded", binding_pod.key, binding_pod.node_name,
+                    )
+                    return
+                if not is_retryable_kube_error(e):
+                    if self.metrics is not None:
+                        self.metrics.observe_bind_terminal()
+                    common.log.error(
+                        "[%s]: terminal bind failure, releasing allocation: "
+                        "%s", binding_pod.key, e,
+                    )
+                    if self.scheduler is not None:
+                        self.scheduler.handle_terminal_bind_failure(
+                            binding_pod
+                        )
+                    raise
+                if attempt == self.max_attempts:
+                    if self.metrics is not None:
+                        self.metrics.observe_bind_give_up()
+                    # Keep the allocation: the pod still exists, the next
+                    # filter round insists on the same placement and the
+                    # force-bind path retries the write.
+                    common.log.error(
+                        "[%s]: bind still failing after %d attempts, giving "
+                        "up this round: %s", binding_pod.key, attempt, e,
+                    )
+                    raise
+                if self.metrics is not None:
+                    self.metrics.observe_bind_retry()
+                delay = min(backoff, self.backoff_max_s)
+                delay *= 1.0 + self.JITTER_FRACTION * self._rng.random()
+                common.log.warning(
+                    "[%s]: transient bind failure (attempt %d/%d), retrying "
+                    "in %.2fs: %s", binding_pod.key, attempt,
+                    self.max_attempts, delay, e,
+                )
+                self._sleep(delay)
+                backoff = min(backoff * 2, self.backoff_max_s)
 
 
 class KubeAPIClient(KubeClient):
@@ -96,11 +240,22 @@ class KubeAPIClient(KubeClient):
         )
         if self._token:
             req.add_header("Authorization", f"Bearer {self._token}")
-        resp = urllib.request.urlopen(  # noqa: S310
-            req,
-            timeout=self.WATCH_READ_TIMEOUT_S if stream else self.timeout_s,
-            context=self._ssl_context,
-        )
+        try:
+            resp = urllib.request.urlopen(  # noqa: S310
+                req,
+                timeout=self.WATCH_READ_TIMEOUT_S if stream else self.timeout_s,
+                context=self._ssl_context,
+            )
+        except urllib.error.HTTPError as e:
+            # Read and attach the apiserver Status body (the reason a bind /
+            # relist was rejected) plus the status code for the retry
+            # classifier; HTTPError alone stringifies to just "HTTP Error
+            # 409: Conflict".
+            try:
+                detail = e.read().decode("utf-8", "replace")
+            except OSError:
+                detail = ""
+            raise KubeAPIError(method, path, e.code, detail) from e
         if stream:
             return resp
         with resp:
@@ -218,10 +373,14 @@ class InformerLoop:
         self._threads: list[threading.Thread] = []
         self._known_pods: Dict[str, Pod] = {}
         self._known_nodes: Dict[str, Node] = {}
+        self._stop = threading.Event()
 
     def start(self) -> None:
         nodes_rv = self._relist_nodes()
         pods_rv = self._relist_pods(initial=True)
+        # The initial lists ARE recovery: every bound pod replayed. Flip
+        # /readyz before serving watches (WaitForCacheSync ordering).
+        self.scheduler.mark_ready()
         for path, handler, relist, rv in (
             ("/api/v1/nodes", self._on_node_event, self._relist_nodes,
              nodes_rv),
@@ -234,6 +393,12 @@ class InformerLoop:
             )
             t.start()
             self._threads.append(t)
+
+    def stop(self) -> None:
+        """Ask the watch loops to exit (they wake from backoff sleeps
+        immediately; a loop blocked inside a watch read exits at the next
+        server-side timeout bound)."""
+        self._stop.set()
 
     # ---------------- relist (the recovery primitive) ---------------- #
 
@@ -284,7 +449,7 @@ class InformerLoop:
         resource_version: str,
     ) -> None:
         backoff = self.BACKOFF_INITIAL_S
-        while True:
+        while not self._stop.is_set():
             try:
                 for event in self.client.watch(path, resource_version):
                     backoff = self.BACKOFF_INITIAL_S
@@ -303,24 +468,39 @@ class InformerLoop:
                 common.log.warning("watch %s gap (%s); relisting", path, e)
                 # Backoff here too: a deterministically-failing handler
                 # would otherwise drive an unthrottled relist loop.
-                time.sleep(backoff)
+                self._stop.wait(backoff)
                 backoff = min(backoff * 2, self.BACKOFF_MAX_S)
-                resource_version = self._safe_relist(relist)
-            except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+                resource_version = self._relist_until_success(relist, path)
+            except (
+                urllib.error.URLError, KubeAPIError, OSError,
+                json.JSONDecodeError,
+            ) as e:
                 common.log.warning(
                     "watch %s reconnecting in %.1fs: %s", path, backoff, e
                 )
-                time.sleep(backoff)
+                self._stop.wait(backoff)
                 backoff = min(backoff * 2, self.BACKOFF_MAX_S)
                 # The connection may have dropped events; relist to repair.
-                resource_version = self._safe_relist(relist)
+                resource_version = self._relist_until_success(relist, path)
 
-    def _safe_relist(self, relist: Callable[[], str]) -> str:
-        try:
-            return relist()
-        except Exception as e:  # noqa: BLE001
-            common.log.warning("relist failed, will retry: %s", e)
-            return ""
+    def _relist_until_success(self, relist: Callable[[], str], path: str) -> str:
+        """Retry the relist (with backoff) until it succeeds. Returning ""
+        after one failed attempt — the old behavior — restarted the watch
+        from resourceVersion "" while the diff caches (_known_pods /
+        _known_nodes) were still stale, so subsequent events were applied
+        against an unsynced cache; the watch must never resume before a
+        relist has actually repaired the cache."""
+        backoff = self.BACKOFF_INITIAL_S
+        while not self._stop.is_set():
+            try:
+                return relist()
+            except Exception as e:  # noqa: BLE001
+                common.log.warning(
+                    "relist %s failed, retrying in %.1fs: %s", path, backoff, e
+                )
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2, self.BACKOFF_MAX_S)
+        return ""
 
     def _handle(
         self, event: Dict, handler: Callable[[Dict], str]

@@ -141,6 +141,30 @@ class PodScheduleStatus:
     pod_schedule_result: Optional[PodScheduleResult] = None
 
 
+@dataclass
+class QuarantineRecord:
+    """A bound pod whose recovery replay failed (corrupt bind-info, cells
+    absent from the current config). The pod is parked here — visible via
+    /v1/inspect/quarantine — instead of aborting recovery; its cells are
+    NOT charged to the scheduling view (no reference analog: the reference
+    panics out of createAllocatedAffinityGroup on the same inputs)."""
+
+    pod: Pod
+    reason: str
+    quarantined_at: str  # RFC 3339 UTC
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "podKey": self.pod.key,
+            "podName": self.pod.name,
+            "podNamespace": self.pod.namespace,
+            "podUid": self.pod.uid,
+            "node": self.pod.node_name,
+            "reason": self.reason,
+            "quarantinedAt": self.quarantined_at,
+        }
+
+
 def new_binding_pod(pod: Pod, bind_info: api.PodBindInfo) -> Pod:
     """A copy of the pod with the binding decision applied: node set, the
     isolation + bind-info + TPU env annotations attached

@@ -176,6 +176,18 @@ def _make_handler(scheduler: HivedScheduler):
         def _route_get(self, path: str):
             agp = constants.AFFINITY_GROUPS_PATH
             vcp = constants.VIRTUAL_CLUSTERS_PATH
+            if path == constants.HEALTHZ_PATH:
+                # Liveness: the process serves HTTP. (Readiness is separate:
+                # a recovering scheduler is alive but must not get traffic.)
+                return {"status": "ok"}
+            if path == constants.READYZ_PATH:
+                if not scheduler.is_ready():
+                    raise api.WebServerError(
+                        503, "recovering: initial cluster replay in progress"
+                    )
+                return {"status": "ready"}
+            if path == constants.QUARANTINE_PATH:
+                return scheduler.get_quarantine()
             if path == agp or path == agp.rstrip("/"):
                 return scheduler.get_all_affinity_groups()
             if path.startswith(agp):

@@ -1,0 +1,757 @@
+"""Deterministic chaos harness: seeded fault schedules + invariant auditing.
+
+The fault plane (doc/fault-model.md) is exercised end to end: a real
+``HivedScheduler`` driven through the production extender routines, with a
+scripted flaky ``KubeClient`` behind the retrying write path, while a seeded
+generator interleaves
+
+  - node bad/heal churn (informer node events),
+  - pod create/delete mid-gang (including MISSED deletes — watch gaps —
+    repaired by relists exactly like the informer's relist-and-diff),
+  - injected bind-write faults (transient bursts that retry to success,
+    exhausted bursts that give up, and terminal 409/404 failures that must
+    release the assume-bind allocation),
+  - bind-info annotation corruption (recovery must quarantine exactly the
+    corrupted pod),
+  - crash-restart: a fresh scheduler + ``recover()`` from the surviving
+    cluster state, checked for restart-equivalence against the continuous
+    scheduler's durable projection.
+
+After every event the harness audits structural invariants over the live
+core (``audit_invariants``):
+
+  1. cell conservation — the free lists partition the chain: their
+     descendant leaf sets are disjoint and the per-level derivable cell
+     counts equal ``total_left_cell_num`` exactly; per-leaf state machine
+     consistency (USED <-> using group, FREE => free priority);
+  2. doomed-bad-cell consistency — the global doomed counters equal the
+     per-VC doomed lists, every doomed cell is still bound to its VC, and
+     the VC free-quota ledgers sum correctly;
+  3. zero leaked cells — after the final teardown (relist + delete every
+     pod + heal every node) the core fingerprint equals the pristine
+     fingerprint captured at start;
+  4. restart-equivalence — at every crash-restart, each surviving bound pod
+     recovers with an identical placement, corrupted pods land in
+     quarantine and nowhere else, and the recovered core's counters, leaf
+     states, and probe-schedule outcomes match the continuous scheduler's
+     durable projection.
+
+Everything is seeded (config, event schedule, retry jitter, victim picks),
+so every schedule is exactly reproducible from its integer seed.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import deque
+from typing import Dict, Iterator, List, Optional, Set
+
+from hivedscheduler_tpu.algorithm.cell import (
+    Cell,
+    CellState,
+    FREE_PRIORITY,
+    LOWEST_LEVEL,
+    MIN_GUARANTEED_PRIORITY,
+    PhysicalCell,
+)
+from hivedscheduler_tpu.algorithm.core import HivedCore, in_free_cell_list
+from hivedscheduler_tpu.api import constants, extender as ei, types as api
+from hivedscheduler_tpu.scheduler.framework import HivedScheduler, KubeClient
+from hivedscheduler_tpu.scheduler.kube import KubeAPIError, RetryingKubeClient
+from hivedscheduler_tpu.scheduler.types import (
+    Node,
+    Pod,
+    PodState,
+    SchedulingPhase,
+)
+
+from .test_core import make_pod
+from .test_placement_equivalence import random_config
+
+MAX_BIND_ATTEMPTS = 4
+
+
+def transient_fault() -> Exception:
+    """A retryable apiserver failure (5xx)."""
+    return KubeAPIError("POST", "/binding", 503, "etcdserver: leader changed")
+
+
+def terminal_fault(status: int = 409) -> Exception:
+    """A terminal bind failure: 409 = UID precondition (pod was deleted and
+    recreated), 404 = pod gone."""
+    return KubeAPIError(
+        "POST", "/binding", status,
+        "the UID in the precondition does not match the UID in record",
+    )
+
+
+class ScriptedKubeClient(KubeClient):
+    """Records binds like NullKubeClient, but fails per an injected fault
+    script: each bind attempt pops one entry from the queue (None = succeed,
+    an exception = raise it). An empty queue always succeeds."""
+
+    def __init__(self) -> None:
+        self.bound: Dict[str, Pod] = {}
+        self.fault_queue: deque = deque()
+
+    def bind_pod(self, binding_pod: Pod) -> None:
+        if self.fault_queue:
+            fault = self.fault_queue.popleft()
+            if fault is not None:
+                raise fault
+        self.bound[binding_pod.uid] = binding_pod
+
+
+###############################################################################
+# Invariant auditing
+###############################################################################
+
+
+def _leaves(c: Cell) -> Iterator[PhysicalCell]:
+    if not c.children:
+        assert isinstance(c, PhysicalCell)
+        yield c
+        return
+    for child in c.children:
+        yield from _leaves(child)
+
+
+def _count_at_level(c: Cell, level: int) -> int:
+    if c.level == level:
+        return 1
+    if c.level < level or not c.children:
+        return 0
+    return sum(_count_at_level(child, level) for child in c.children)
+
+
+def audit_invariants(sched: HivedScheduler, ctx: str = "") -> None:
+    """Structural invariants over the live core; raises AssertionError with
+    ``ctx`` on any violation. Cheap enough to run after every chaos event."""
+    core = sched.core
+    for chain, ccl in core.full_cell_list.items():
+        top = ccl.top_level
+        # --- invariant 1a: the free list partitions the chain ------------- #
+        derived = {l: 0 for l in range(LOWEST_LEVEL, top + 1)}
+        covered: Set[str] = set()
+        for level in range(LOWEST_LEVEL, top + 1):
+            for c in core.free_cell_list[chain][level]:
+                assert c.level == level, (ctx, chain, level, c.address)
+                for l in range(LOWEST_LEVEL, level + 1):
+                    derived[l] += _count_at_level(c, l)
+                for leaf in _leaves(c):
+                    assert leaf.address not in covered, (
+                        ctx, chain, "free lists overlap", leaf.address,
+                    )
+                    covered.add(leaf.address)
+        for l in range(LOWEST_LEVEL, top + 1):
+            assert core.total_left_cell_num[chain].get(l, 0) == derived[l], (
+                ctx, chain, l, "totalLeft != cells derivable from free list",
+                core.total_left_cell_num[chain].get(l, 0), derived[l],
+            )
+        # --- invariant 1b: per-leaf state machine ------------------------- #
+        for leaf in ccl[LOWEST_LEVEL]:
+            assert isinstance(leaf, PhysicalCell)
+            if leaf.state == CellState.USED:
+                assert leaf.using_group is not None, (ctx, leaf.address)
+            if leaf.using_group is not None:
+                assert leaf.state in (CellState.USED, CellState.RESERVING), (
+                    ctx, leaf.address, leaf.state,
+                )
+            if leaf.state == CellState.FREE:
+                assert leaf.using_group is None, (ctx, leaf.address)
+                assert leaf.priority == FREE_PRIORITY, (
+                    ctx, leaf.address, leaf.priority,
+                )
+        # --- bad-free entries are actually bad and actually free ---------- #
+        for level in range(LOWEST_LEVEL, top + 1):
+            for c in core.bad_free_cells[chain][level]:
+                assert isinstance(c, PhysicalCell)
+                assert not c.healthy, (ctx, chain, level, c.address)
+                assert in_free_cell_list(c), (ctx, chain, level, c.address)
+
+    # --- invariant 2: doomed-bad-cell counter consistency ----------------- #
+    doomed_sum: Dict[str, Dict[int, int]] = {}
+    for vcn, per_chain in core.vc_doomed_bad_cells.items():
+        for chain, ccl in per_chain.items():
+            for level, cl in ccl.levels.items():
+                if len(cl) == 0:
+                    continue
+                doomed_sum.setdefault(chain, {})
+                doomed_sum[chain][level] = doomed_sum[chain].get(level, 0) + len(cl)
+                for c in cl:
+                    assert isinstance(c, PhysicalCell)
+                    assert c.virtual_cell is not None, (ctx, vcn, c.address)
+                    assert c.virtual_cell.vc == vcn, (ctx, vcn, c.address)
+    for chain, per_level in core.all_vc_doomed_bad_cell_num.items():
+        for level, n in per_level.items():
+            assert n >= 0, (ctx, chain, level, n)
+            assert doomed_sum.get(chain, {}).get(level, 0) == n, (
+                ctx, chain, level, "doomed counter mismatch",
+                doomed_sum.get(chain, {}).get(level, 0), n,
+            )
+
+    # --- VC free-quota ledgers sum to the global ledger ------------------- #
+    vc_sum: Dict[str, Dict[int, int]] = {}
+    for vcn, per_chain in core.vc_free_cell_num.items():
+        for chain, per_level in per_chain.items():
+            for level, n in per_level.items():
+                vc_sum.setdefault(chain, {})
+                vc_sum[chain][level] = vc_sum[chain].get(level, 0) + n
+    for chain in set(vc_sum) | set(core.all_vc_free_cell_num):
+        levels = set(vc_sum.get(chain, {})) | set(
+            core.all_vc_free_cell_num.get(chain, {})
+        )
+        for level in levels:
+            assert vc_sum.get(chain, {}).get(level, 0) == (
+                core.all_vc_free_cell_num.get(chain, {}).get(level, 0)
+            ), (ctx, chain, level, "vcFree sum != allVCFree")
+
+    # --- allocated groups reference live, non-free cells ------------------ #
+    for g in core.affinity_groups.values():
+        for rows in g.physical_placement.values():
+            for row in rows:
+                for leaf in row:
+                    if leaf is None:
+                        continue
+                    assert isinstance(leaf, PhysicalCell)
+                    assert leaf.state != CellState.FREE, (
+                        ctx, g.name, leaf.address,
+                    )
+
+
+###############################################################################
+# Core fingerprints (pristine / restart-equivalence comparison)
+###############################################################################
+
+
+def _norm_counters(d: Dict) -> Dict:
+    """Drop zero entries so lazily-setdefault'd ledgers compare equal."""
+    out: Dict = {}
+    for chain, per_level in d.items():
+        kept = {l: n for l, n in per_level.items() if n != 0}
+        if kept:
+            out[str(chain)] = kept
+    return out
+
+
+def counters_fingerprint(core: HivedCore) -> Dict:
+    return {
+        "vcFree": {
+            str(vcn): _norm_counters(per) for vcn, per in
+            sorted(core.vc_free_cell_num.items())
+        },
+        "allVCFree": _norm_counters(core.all_vc_free_cell_num),
+        "totalLeft": _norm_counters(core.total_left_cell_num),
+        "doomed": _norm_counters(core.all_vc_doomed_bad_cell_num),
+        "badFree": {
+            str(chain): {
+                l: len(cl) for l, cl in ccl.levels.items() if len(cl)
+            }
+            for chain, ccl in sorted(core.bad_free_cells.items())
+        },
+        "otCells": {
+            str(vcn): len(cells)
+            for vcn, cells in sorted(core._ot_cells.items()) if cells
+        },
+        "groups": sorted(core.affinity_groups),
+    }
+
+
+def leaf_fingerprint(core: HivedCore) -> Dict[str, tuple]:
+    out = {}
+    for ccl in core.full_cell_list.values():
+        for leaf in ccl[LOWEST_LEVEL]:
+            assert isinstance(leaf, PhysicalCell)
+            out[leaf.address] = (
+                leaf.state.value,
+                leaf.priority,
+                leaf.healthy,
+                leaf.using_group.name if leaf.using_group else None,
+            )
+    return out
+
+
+def free_set_fingerprint(core: HivedCore) -> Dict:
+    return {
+        str(chain): {
+            l: sorted(c.address for c in cl)
+            for l, cl in ccl.levels.items() if len(cl)
+        }
+        for chain, ccl in sorted(core.free_cell_list.items())
+    }
+
+
+def core_fingerprint(core: HivedCore) -> Dict:
+    return {
+        "counters": counters_fingerprint(core),
+        "leaves": leaf_fingerprint(core),
+        "freeSet": free_set_fingerprint(core),
+    }
+
+
+def advisory_doom_count(core: HivedCore) -> int:
+    """Doomed-bad bindings NOT hosting live guaranteed allocations. These
+    are pure advisory markers whose creation is history-dependent (the doom
+    allocates the VC's quota when the shortfall first appears and is only
+    retired when a surplus appears), so ledgers they touch cannot be
+    reconstructed by a restart."""
+    n = 0
+    for per_chain in core.vc_doomed_bad_cells.values():
+        for ccl in per_chain.values():
+            for cl in ccl.levels.values():
+                for c in cl:
+                    if c.priority < MIN_GUARANTEED_PRIORITY:
+                        n += 1
+    return n
+
+
+def probe_outcomes(core: HivedCore, nodes: List[str], seed: int) -> List[tuple]:
+    """Schedule (WITHOUT committing) a fixed probe battery; the outcome
+    classes characterize the capacity the core believes it has. FILTERING
+    probes for never-seen groups are read-only against the core."""
+    outs: List[tuple] = []
+    for i, (vc, chips, prio) in enumerate(
+        [("A", 1, 0), ("A", 4, 0), ("B", 1, 0), ("B", 4, -1), ("A", 2, 5)]
+    ):
+        pod = make_pod(
+            f"probe-{i}", f"u-probe-{i}", vc, prio, "v5e-chip", chips,
+            group={
+                "name": f"probe-{seed}-{i}",
+                "members": [{"podNumber": 1, "leafCellNumber": chips}],
+            },
+        )
+        random.seed(seed * 1000 + i)
+        try:
+            r = core.schedule(pod, nodes, SchedulingPhase.FILTERING)
+        except api.WebServerError:
+            outs.append(("rejected",))
+            continue
+        if r.pod_bind_info is not None:
+            outs.append(("bind",))
+        elif r.pod_preempt_info is not None:
+            outs.append(("preempt",))
+        else:
+            outs.append(("wait",))
+    return outs
+
+
+###############################################################################
+# The harness
+###############################################################################
+
+
+class ChaosHarness:
+    """One seeded chaos schedule. ``run()`` executes the schedule, auditing
+    invariants after every event, performing at least one crash-restart, and
+    finishing with the zero-leak teardown."""
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self.rnd = random.Random(seed)
+        # Global random is consumed by the core's victim-node pick; pin it
+        # so every schedule is reproducible from the seed alone.
+        random.seed(seed ^ 0x5EED)
+        self.kube = ScriptedKubeClient()
+        self.retry_sleeps: List[float] = []
+        # The apiserver truth: uid -> Pod as the cluster currently holds it.
+        self.cluster_pods: Dict[str, Pod] = {}
+        self.corrupted: Set[str] = set()
+        self.gangs: Dict[str, List[str]] = {}  # gang name -> uids
+        self.gang_seq = 0
+        # Coverage counters (the seed-set tests assert aggregate coverage).
+        self.stats = {
+            "restarts": 0,
+            "corruptions": 0,
+            "transient_faults": 0,
+            "give_up_faults": 0,
+            "terminal_faults": 0,
+            "missed_deletes": 0,
+            "relists": 0,
+            "node_flips": 0,
+            "binds": 0,
+        }
+        self.scheduler = self._new_scheduler()
+        self.node_health = {
+            n: True for n in self.scheduler.core.configured_node_names()
+        }
+        for n in self.node_health:
+            self.scheduler.add_node(Node(name=n))
+        self.scheduler.mark_ready()
+        self.pristine = core_fingerprint(self.scheduler.core)
+
+    # ------------------------------------------------------------------ #
+
+    def _config(self):
+        return random_config(random.Random(self.seed))
+
+    def _new_scheduler(self) -> HivedScheduler:
+        sched = HivedScheduler(
+            self._config(), force_bind_executor=lambda fn: fn()
+        )
+        sched.kube_client = RetryingKubeClient(
+            self.kube,
+            scheduler=sched,
+            max_attempts=MAX_BIND_ATTEMPTS,
+            backoff_initial_s=0.01,
+            backoff_max_s=0.08,
+            sleep=self.retry_sleeps.append,  # recorded, never slept
+            jitter_rng=random.Random(self.seed ^ 0xBEEF),
+        )
+        return sched
+
+    def live_nodes(self) -> List[str]:
+        return sorted(self.node_health)
+
+    # ---------------- events ---------------- #
+
+    def gang_create(self) -> None:
+        self.gang_seq += 1
+        name = f"g{self.seed}-{self.gang_seq}"
+        vc = self.rnd.choice(["A", "B"])
+        leaf_type = self.rnd.choice(["v5e-chip", "v5e-chip", "v5p-chip"])
+        priority = self.rnd.choice([-1, 0, 0, 5])
+        n_pods = self.rnd.choice([1, 1, 2, 4])
+        chips = self.rnd.choice([1, 2, 4])
+        group = {
+            "name": name,
+            "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
+        }
+        uids = []
+        for i in range(n_pods):
+            pod = make_pod(
+                f"{name}-{i}", f"u-{name}-{i}", vc, priority, leaf_type,
+                chips, group=group,
+            )
+            self.cluster_pods[pod.uid] = pod
+            uids.append(pod.uid)
+            self.scheduler.add_pod(pod)
+            try:
+                result = self.scheduler.filter_routine(
+                    ei.ExtenderArgs(pod=pod, node_names=self.live_nodes())
+                )
+            except api.WebServerError:
+                # Rejected spec for this cluster (e.g. the VC has no such
+                # chip type): K8s would loop on it; drop it instead.
+                self.scheduler.delete_pod(pod)
+                del self.cluster_pods[pod.uid]
+                uids.pop()
+                continue
+            if not result.node_names:
+                continue  # waiting or preempt-hinted; stays Pending
+            try:
+                self.scheduler.bind_routine(
+                    ei.ExtenderBindingArgs(
+                        pod_name=pod.name,
+                        pod_namespace=pod.namespace,
+                        pod_uid=pod.uid,
+                        node=result.node_names[0],
+                    )
+                )
+            except Exception:  # noqa: BLE001
+                # Exhausted transient burst (allocation kept; the next
+                # filter insists) or terminal failure (allocation already
+                # released by handle_terminal_bind_failure).
+                continue
+            bound = self.kube.bound.get(pod.uid)
+            if bound is not None:
+                # The informer confirms the bind (MODIFIED with nodeName).
+                bound.phase = "Running"
+                self.scheduler.update_pod(pod, bound)
+                self.cluster_pods[pod.uid] = bound
+                self.stats["binds"] += 1
+        if uids:
+            self.gangs[name] = uids
+
+    def delete_pods(self, uids: List[str], missed: bool) -> None:
+        """Delete pods from the apiserver truth; deliver the DELETED events
+        unless the watch 'missed' them (repaired by a later relist or
+        restart)."""
+        for uid in uids:
+            pod = self.cluster_pods.pop(uid, None)
+            self.kube.bound.pop(uid, None)
+            self.corrupted.discard(uid)
+            if pod is None:
+                continue
+            if missed:
+                self.stats["missed_deletes"] += 1
+                continue
+            status = self.scheduler.pod_schedule_statuses.get(uid)
+            self.scheduler.delete_pod(status.pod if status else pod)
+        for name, members in list(self.gangs.items()):
+            remaining = [u for u in members if u in self.cluster_pods]
+            if remaining:
+                self.gangs[name] = remaining
+            else:
+                del self.gangs[name]
+
+    def gang_delete(self, missed: bool = False) -> None:
+        if not self.gangs:
+            return
+        name = self.rnd.choice(sorted(self.gangs))
+        self.delete_pods(list(self.gangs[name]), missed)
+
+    def pod_delete_mid_gang(self, missed: bool = False) -> None:
+        if not self.gangs:
+            return
+        name = self.rnd.choice(sorted(self.gangs))
+        uid = self.rnd.choice(self.gangs[name])
+        self.delete_pods([uid], missed)
+
+    def node_flip(self) -> None:
+        node = self.rnd.choice(self.live_nodes())
+        healthy = self.node_health[node]
+        self.node_health[node] = not healthy
+        self.stats["node_flips"] += 1
+        self.scheduler.update_node(
+            Node(name=node, ready=healthy), Node(name=node, ready=not healthy)
+        )
+
+    def inject_faults(self) -> None:
+        roll = self.rnd.random()
+        if roll < 0.5:
+            n = self.rnd.randint(1, MAX_BIND_ATTEMPTS - 1)
+            self.kube.fault_queue.extend(transient_fault() for _ in range(n))
+            self.stats["transient_faults"] += 1
+        elif roll < 0.75:
+            self.kube.fault_queue.extend(
+                transient_fault() for _ in range(MAX_BIND_ATTEMPTS)
+            )
+            self.stats["give_up_faults"] += 1
+        else:
+            self.kube.fault_queue.append(
+                terminal_fault(self.rnd.choice([404, 409]))
+            )
+            self.stats["terminal_faults"] += 1
+
+    def corrupt_annotation(self) -> None:
+        """Corrupt a bound pod's bind-info in the apiserver truth: the live
+        scheduler already holds the good copy, so only recovery notices —
+        and must quarantine exactly this pod."""
+        bound = [
+            uid for uid, p in sorted(self.cluster_pods.items())
+            if p.node_name and uid not in self.corrupted
+        ]
+        if not bound:
+            return
+        uid = self.rnd.choice(bound)
+        pod = self.cluster_pods[uid]
+        style = self.rnd.randrange(3)
+        if style == 0:
+            corrupt = "{unterminated: ["  # undecodable YAML/JSON
+        elif style == 1:
+            # Valid YAML, placement referencing cells that don't exist.
+            corrupt = (
+                '{"node": "ghost-node", "leafCellIsolation": [97], '
+                '"cellChain": "no-such-chain", "affinityGroupBindInfo": '
+                '[{"podPlacements": [{"physicalNode": "ghost-node", '
+                '"physicalLeafCellIndices": [97], '
+                '"preassignedCellTypes": [""]}]}]}'
+            )
+        else:
+            corrupt = ""  # annotation emptied
+        annotations = dict(pod.annotations)
+        annotations[constants.ANNOTATION_POD_BIND_INFO] = corrupt
+        self.cluster_pods[uid] = Pod(
+            name=pod.name,
+            namespace=pod.namespace,
+            uid=pod.uid,
+            annotations=annotations,
+            node_name=pod.node_name,
+            phase=pod.phase,
+            resource_limits=dict(pod.resource_limits),
+        )
+        self.corrupted.add(uid)
+        self.stats["corruptions"] += 1
+
+    def relist(self) -> None:
+        """The informer's relist-and-diff gap repair against the truth."""
+        self.stats["relists"] += 1
+        for uid in list(self.scheduler.pod_schedule_statuses):
+            if uid not in self.cluster_pods:
+                status = self.scheduler.pod_schedule_statuses[uid]
+                self.scheduler.delete_pod(status.pod)
+        for uid in list(self.scheduler.quarantined_pods):
+            if uid not in self.cluster_pods:
+                self.scheduler.delete_pod(
+                    self.scheduler.quarantined_pods[uid].pod
+                )
+        for pod in list(self.cluster_pods.values()):
+            self.scheduler.add_pod(pod)
+
+    # ---------------- crash-restart + equivalence ---------------- #
+
+    def expected_quarantine(self) -> Set[str]:
+        return {
+            uid for uid in self.corrupted
+            if self.cluster_pods.get(uid) is not None
+            and self.cluster_pods[uid].node_name
+        }
+
+    def crash_restart(self) -> None:
+        """Invariant 4: a fresh scheduler recovered from the surviving
+        cluster state must be equivalent to the continuous scheduler's
+        durable projection."""
+        self.stats["restarts"] += 1
+        old = self.scheduler
+        new = self._new_scheduler()
+        new.recover(
+            [Node(name=n, ready=h) for n, h in sorted(self.node_health.items())],
+            [self.cluster_pods[uid] for uid in sorted(self.cluster_pods)],
+        )
+        assert new.is_ready(), (self.seed, "recover() must flip readiness")
+
+        expected_q = self.expected_quarantine()
+        assert set(new.quarantined_pods) == expected_q, (
+            self.seed, "quarantine mismatch",
+            set(new.quarantined_pods), expected_q,
+        )
+        for uid in expected_q:
+            assert uid not in new.pod_schedule_statuses, (self.seed, uid)
+
+        # Every durable (confirmed-bound, surviving, uncorrupted) pod must
+        # recover with an identical placement.
+        iso = constants.ANNOTATION_POD_LEAF_CELL_ISOLATION
+        for uid, status in old.pod_schedule_statuses.items():
+            if (
+                status.pod_state != PodState.BOUND
+                or uid not in self.cluster_pods
+                or uid in expected_q
+            ):
+                continue
+            ns = new.pod_schedule_statuses.get(uid)
+            assert ns is not None and ns.pod_state == PodState.BOUND, (
+                self.seed, uid, "bound pod lost across restart",
+            )
+            assert ns.pod.node_name == status.pod.node_name, (
+                self.seed, uid, ns.pod.node_name, status.pod.node_name,
+            )
+            assert ns.pod.annotations.get(iso) == status.pod.annotations.get(
+                iso
+            ), (self.seed, uid, "isolation changed across restart")
+
+        # Project the continuous scheduler down to its durable state: forget
+        # unconfirmed assume-binds (their bind never reached the apiserver —
+        # a real crash forgets them and K8s re-filters), stale pods whose
+        # delete the watch missed, and corrupted pods (quarantined on the
+        # recovered side).
+        for uid, status in list(old.pod_schedule_statuses.items()):
+            if (
+                status.pod_state != PodState.BOUND
+                or uid not in self.cluster_pods
+                or uid in expected_q
+            ):
+                old.delete_pod(status.pod)
+
+        old_counters = counters_fingerprint(old.core)
+        new_counters = counters_fingerprint(new.core)
+        # The doomed-bad subsystem is hysteretic: a doom is created when a
+        # VC-quota shortfall first APPEARS (allocating the quota to an
+        # arbitrary bad free cell) and retired only when a surplus appears,
+        # so its listing — and every ledger its allocation moved — depends
+        # on event history a restart cannot replay (the reference shares
+        # this). Ledger parity is therefore asserted strictly whenever no
+        # ADVISORY doom is live on either side; doomed bindings hosting
+        # real allocations are fine (the real allocation pins the same
+        # ledgers on both sides). The unconditional checks — per-leaf
+        # state/priority/owner, group placements, opportunistic charges,
+        # quarantine, and probe outcomes — are what catch lost or
+        # duplicated allocations.
+        hysteretic = ("doomed",)
+        strict = (
+            advisory_doom_count(old.core) == 0
+            and advisory_doom_count(new.core) == 0
+        )
+        if not strict:
+            hysteretic = (
+                "doomed", "badFree", "vcFree", "allVCFree", "totalLeft",
+            )
+        old_cmp = {k: v for k, v in old_counters.items() if k not in hysteretic}
+        new_cmp = {k: v for k, v in new_counters.items() if k not in hysteretic}
+        assert old_cmp == new_cmp, (
+            self.seed, "counter fingerprints diverge across restart",
+            old_cmp, new_cmp,
+        )
+        assert leaf_fingerprint(old.core) == leaf_fingerprint(new.core), (
+            self.seed, "leaf states diverge across restart",
+        )
+        if strict and not old_counters["doomed"] and not new_counters["doomed"]:
+            # With no doomed-bad bindings at all, the free SET is fully
+            # determined by the durable allocations (doomed binds pick an
+            # arbitrary bad cell, the one legitimate source of divergence).
+            assert free_set_fingerprint(old.core) == free_set_fingerprint(
+                new.core
+            ), (self.seed, "free sets diverge across restart")
+        if strict:
+            # Probe-schedule equivalence needs the same gate: an advisory
+            # doom pins a VC's quota to an arbitrary partially-bad cell,
+            # and guaranteed probes can ride its healthy chips — capacity a
+            # restart cannot re-derive once the physical layout moved on.
+            nodes = self.live_nodes()
+            assert probe_outcomes(
+                old.core, nodes, self.seed
+            ) == probe_outcomes(new.core, nodes, self.seed), (
+                self.seed, "probe outcomes diverge across restart",
+            )
+
+        audit_invariants(new, f"seed={self.seed} post-restart")
+        self.scheduler = new
+
+    # ---------------- teardown (invariant 3) ---------------- #
+
+    def teardown_and_assert_no_leaks(self) -> None:
+        self.relist()
+        self.delete_pods(list(self.cluster_pods), missed=False)
+        for n, healthy in sorted(self.node_health.items()):
+            if not healthy:
+                self.node_health[n] = True
+                self.scheduler.update_node(
+                    Node(name=n, ready=False), Node(name=n, ready=True)
+                )
+        audit_invariants(self.scheduler, f"seed={self.seed} teardown")
+        assert not self.scheduler.pod_schedule_statuses, self.seed
+        assert not self.scheduler.quarantined_pods, self.seed
+        assert not self.scheduler.core.affinity_groups, self.seed
+        final = core_fingerprint(self.scheduler.core)
+        assert final == self.pristine, (
+            self.seed, "cells leaked: final state != pristine state",
+            final, self.pristine,
+        )
+
+    # ---------------- the schedule ---------------- #
+
+    def step(self, i: int) -> None:
+        roll = self.rnd.random()
+        if roll < 0.34:
+            self.gang_create()
+        elif roll < 0.44:
+            self.gang_delete(missed=False)
+        elif roll < 0.50:
+            self.gang_delete(missed=True)
+        elif roll < 0.58:
+            self.pod_delete_mid_gang(missed=self.rnd.random() < 0.4)
+        elif roll < 0.72:
+            self.node_flip()
+        elif roll < 0.80:
+            self.inject_faults()
+        elif roll < 0.87:
+            self.relist()
+        elif roll < 0.93:
+            self.corrupt_annotation()
+        else:
+            self.crash_restart()
+
+    def run(self, n_events: Optional[int] = None) -> Dict[str, int]:
+        n = n_events if n_events is not None else self.rnd.randint(10, 16)
+        for i in range(n):
+            self.step(i)
+            audit_invariants(self.scheduler, f"seed={self.seed} step={i}")
+        # Every schedule exercises at least one crash-restart (acceptance:
+        # node churn x pod churn x bind faults x >= 1 restart per seed).
+        self.crash_restart()
+        audit_invariants(self.scheduler, f"seed={self.seed} final-restart")
+        self.teardown_and_assert_no_leaks()
+        return self.stats
+
+
+def run_chaos_schedule(seed: int, n_events: Optional[int] = None) -> Dict[str, int]:
+    return ChaosHarness(seed).run(n_events)

@@ -1,0 +1,285 @@
+"""Tier-1 wiring of the seeded chaos harness (tests/chaos.py) plus targeted
+acceptance tests for the fault plane (doc/fault-model.md).
+
+The sweep runs ``HIVED_CHAOS_ROUNDS`` seeded schedules (default 220 — the CI
+floor; export a larger value for soak runs, mirroring the HIVED_BENCH_SMOKE
+pattern): each schedule interleaves node bad/heal churn, pod churn, missed
+deletes, injected bind faults, and annotation corruption, performs at least
+one crash-restart, audits the four invariants after every event, and must
+tear down to a pristine core (zero leaked cells).
+"""
+
+import os
+import random
+
+import pytest
+
+from hivedscheduler_tpu.api import constants, extender as ei
+from hivedscheduler_tpu.scheduler.framework import HivedScheduler
+from hivedscheduler_tpu.scheduler.kube import RetryingKubeClient
+from hivedscheduler_tpu.scheduler.types import Node, PodState
+
+from . import chaos
+from .test_core import make_pod
+from .test_placement_equivalence import random_config
+
+# Coverage floor for CI; HIVED_CHAOS_ROUNDS=N runs N schedules (soak).
+CHAOS_ROUNDS = int(os.environ.get("HIVED_CHAOS_ROUNDS", "0")) or 220
+
+# Seeds whose schedules corrupt a surviving bound pod's bind-info BEFORE a
+# crash-restart — the schedules that die if recovery regresses from
+# quarantining to raising (see test_rebroken_recover_is_caught below).
+CORRUPTION_RESTART_SEEDS = (0, 2, 6, 8, 15, 20)
+
+
+def test_chaos_seed_sweep():
+    stats = {
+        "restarts": 0, "corruptions": 0, "transient_faults": 0,
+        "give_up_faults": 0, "terminal_faults": 0, "missed_deletes": 0,
+        "relists": 0, "node_flips": 0, "binds": 0,
+    }
+    for seed in range(CHAOS_ROUNDS):
+        for k, v in chaos.run_chaos_schedule(seed).items():
+            stats[k] += v
+    # The sweep must actually exercise the fault plane, not skate past it:
+    # every schedule crash-restarts at least once, and across the seed set
+    # every injected fault class fires.
+    assert stats["restarts"] >= CHAOS_ROUNDS, stats
+    assert stats["binds"] > CHAOS_ROUNDS, stats
+    for key in (
+        "corruptions", "transient_faults", "give_up_faults",
+        "terminal_faults", "missed_deletes", "relists", "node_flips",
+    ):
+        assert stats[key] > 0, (key, stats)
+
+
+def test_rebroken_recover_is_caught(monkeypatch):
+    """Acceptance: a deliberately re-broken recover() — raising on an
+    unreplayable pod instead of quarantining, the pre-fault-model behavior
+    — is caught by the pinned seeds. Guards the harness's sensitivity: if
+    this passes while quarantine is broken, the chaos sweep is blind."""
+
+    def raise_through(self, pod, error):
+        raise error
+
+    monkeypatch.setattr(HivedScheduler, "_quarantine_pod", raise_through)
+    caught = 0
+    for seed in CORRUPTION_RESTART_SEEDS:
+        try:
+            chaos.run_chaos_schedule(seed)
+        except Exception:  # noqa: BLE001
+            caught += 1
+    assert caught == len(CORRUPTION_RESTART_SEEDS), (
+        "re-broken recover() escaped the pinned chaos seeds"
+    )
+
+
+def _booted_scheduler(seed=7):
+    sched = HivedScheduler(
+        random_config(random.Random(seed)),
+        kube_client=chaos.ScriptedKubeClient(),
+        force_bind_executor=lambda fn: fn(),
+    )
+    for n in sched.core.configured_node_names():
+        sched.add_node(Node(name=n))
+    sched.mark_ready()
+    return sched
+
+
+def _bind_one(sched, name, uid, vc="A", chips=2):
+    pod = make_pod(
+        name, uid, vc, 0, "v5e-chip", chips,
+        group={"name": name,
+               "members": [{"podNumber": 1, "leafCellNumber": chips}]},
+    )
+    sched.add_pod(pod)
+    nodes = sorted(sched.nodes)
+    result = sched.filter_routine(ei.ExtenderArgs(pod=pod, node_names=nodes))
+    assert result.node_names, (name, result.failed_nodes)
+    sched.bind_routine(
+        ei.ExtenderBindingArgs(
+            pod_name=pod.name, pod_namespace=pod.namespace,
+            pod_uid=pod.uid, node=result.node_names[0],
+        )
+    )
+    client = sched.kube_client
+    if isinstance(client, RetryingKubeClient):
+        client = client.inner
+    bound = client.bound[uid]
+    bound.phase = "Running"
+    sched.update_pod(pod, bound)
+    return bound
+
+
+def test_corrupt_bind_info_quarantines_exactly_that_pod():
+    """Acceptance: recovery with one corrupted bind-info annotation
+    quarantines exactly that pod — visible via get_quarantine() (the
+    /v1/inspect/quarantine payload) — and every other replayed pod keeps an
+    identical placement."""
+    s1 = _booted_scheduler()
+    good = _bind_one(s1, "good-0", "u-good", vc="A")
+    bad = _bind_one(s1, "bad-0", "u-bad", vc="B")
+    bad.annotations[constants.ANNOTATION_POD_BIND_INFO] = "{unterminated: ["
+
+    s2 = _booted_scheduler()
+    s2.recover([], [good, bad])
+    assert set(s2.quarantined_pods) == {"u-bad"}
+    assert "u-bad" not in s2.pod_schedule_statuses
+    q = s2.get_quarantine()["items"]
+    assert len(q) == 1 and q[0]["podUid"] == "u-bad"
+    assert q[0]["reason"]
+
+    st = s2.pod_schedule_statuses["u-good"]
+    assert st.pod_state == PodState.BOUND
+    iso = constants.ANNOTATION_POD_LEAF_CELL_ISOLATION
+    assert st.pod.node_name == good.node_name
+    assert st.pod.annotations[iso] == good.annotations[iso]
+    assert s2.get_metrics()["quarantinedPodCount"] == 1
+    chaos.audit_invariants(s2, "corrupt-recovery")
+
+    # Deleting the quarantined pod clears the record without touching cells.
+    s2.delete_pod(bad)
+    assert not s2.quarantined_pods
+    chaos.audit_invariants(s2, "post-delete")
+
+
+def test_transient_bind_failure_retries_to_success():
+    """Acceptance: an injected transient failure is retried to success with
+    exponential backoff, observable via the new retry counters."""
+    sched = _booted_scheduler()
+    inner = sched.kube_client
+    sleeps = []
+    sched.kube_client = RetryingKubeClient(
+        inner, scheduler=sched,
+        backoff_initial_s=0.01, backoff_max_s=1.0,
+        sleep=sleeps.append, jitter_rng=random.Random(1),
+    )
+    inner.fault_queue.extend(
+        [chaos.transient_fault(), chaos.transient_fault()]
+    )
+    _bind_one(sched, "j-0", "u-j")
+    assert "u-j" in inner.bound
+    m = sched.get_metrics()
+    assert m["bindRetryCount"] == 2
+    assert m["bindTerminalFailureCount"] == 0
+    assert m["bindGiveUpCount"] == 0
+    assert len(sleeps) == 2 and sleeps[1] > sleeps[0]  # exponential
+
+
+def test_terminal_bind_failure_releases_cells():
+    """Acceptance: an injected 409 UID-precondition failure releases the
+    pod's cells — the scheduler view returns to pristine once the pod is
+    gone (no leak)."""
+    sched = _booted_scheduler()
+    inner = sched.kube_client
+    sched.kube_client = RetryingKubeClient(
+        inner, scheduler=sched, sleep=lambda s: None,
+        jitter_rng=random.Random(1),
+    )
+    pristine = chaos.core_fingerprint(sched.core)
+
+    pod = make_pod(
+        "t-0", "u-t", "A", 0, "v5e-chip", 2,
+        group={"name": "t-0",
+               "members": [{"podNumber": 1, "leafCellNumber": 2}]},
+    )
+    sched.add_pod(pod)
+    nodes = sorted(sched.nodes)
+    result = sched.filter_routine(ei.ExtenderArgs(pod=pod, node_names=nodes))
+    assert result.node_names
+    inner.fault_queue.append(chaos.terminal_fault(409))
+    with pytest.raises(Exception):
+        sched.bind_routine(
+            ei.ExtenderBindingArgs(
+                pod_name=pod.name, pod_namespace=pod.namespace,
+                pod_uid=pod.uid, node=result.node_names[0],
+            )
+        )
+    # handle_terminal_bind_failure released the assume-bind allocation.
+    assert "u-t" not in sched.pod_schedule_statuses
+    assert "u-t" not in inner.bound
+    assert sched.get_metrics()["bindTerminalFailureCount"] == 1
+    assert chaos.core_fingerprint(sched.core) == pristine
+    chaos.audit_invariants(sched, "post-terminal")
+
+
+def test_duplicate_bind_conflict_is_success_not_release():
+    """A 409 'already assigned to node X' from a DUPLICATE bind (idempotent
+    retry / force-bind race) must be treated as success: releasing the
+    allocation on it would double-allocate a live gang's cells."""
+    sched = _booted_scheduler()
+    inner = sched.kube_client
+    sched.kube_client = RetryingKubeClient(
+        inner, scheduler=sched, sleep=lambda s: None,
+        jitter_rng=random.Random(1),
+    )
+    bound = _bind_one(sched, "a-0", "u-a")
+    # The second (racing) bind hits the apiserver's already-assigned 409.
+    inner.fault_queue.append(
+        chaos.KubeAPIError(
+            "POST", "/binding", 409,
+            f'pod "a-0" is already assigned to node "{bound.node_name}"',
+        )
+    )
+    sched.kube_client.bind_pod(bound)  # must not raise
+    assert sched.pod_schedule_statuses["u-a"].pod_state == PodState.BOUND
+    assert sched.get_metrics()["bindTerminalFailureCount"] == 0
+    chaos.audit_invariants(sched, "duplicate-bind")
+
+
+def test_exhausted_retries_keep_allocation_for_reinsist():
+    """A bind that keeps failing transiently gives up WITHOUT releasing: the
+    pod stays BINDING and the next filter round insists on the placement
+    (the write is retried via force bind)."""
+    sched = _booted_scheduler()
+    inner = sched.kube_client
+    sched.kube_client = RetryingKubeClient(
+        inner, scheduler=sched, max_attempts=3, sleep=lambda s: None,
+        jitter_rng=random.Random(1),
+    )
+    pod = make_pod(
+        "x-0", "u-x", "A", 0, "v5e-chip", 2,
+        group={"name": "x-0",
+               "members": [{"podNumber": 1, "leafCellNumber": 2}]},
+    )
+    sched.add_pod(pod)
+    nodes = sorted(sched.nodes)
+    result = sched.filter_routine(ei.ExtenderArgs(pod=pod, node_names=nodes))
+    node = result.node_names[0]
+    inner.fault_queue.extend(chaos.transient_fault() for _ in range(3))
+    with pytest.raises(Exception):
+        sched.bind_routine(
+            ei.ExtenderBindingArgs(
+                pod_name=pod.name, pod_namespace=pod.namespace,
+                pod_uid=pod.uid, node=node,
+            )
+        )
+    st = sched.pod_schedule_statuses["u-x"]
+    assert st.pod_state == PodState.BINDING
+    assert sched.get_metrics()["bindGiveUpCount"] == 1
+    # The fault script is drained; the re-filtered pod insists and binds.
+    r2 = sched.filter_routine(ei.ExtenderArgs(pod=pod, node_names=nodes))
+    assert r2.node_names == [node]
+    sched.bind_routine(
+        ei.ExtenderBindingArgs(
+            pod_name=pod.name, pod_namespace=pod.namespace,
+            pod_uid=pod.uid, node=node,
+        )
+    )
+    assert "u-x" in inner.bound
+
+
+def test_bound_to_unbound_update_degrades_not_crashes():
+    """A bound→unbound update (corrupt watch stream) must not raise out of
+    the informer path: it degrades to delete+re-add."""
+    sched = _booted_scheduler()
+    bound = _bind_one(sched, "d-0", "u-d")
+    unbound = make_pod(
+        "d-0", "u-d", "A", 0, "v5e-chip", 2,
+        group={"name": "d-0",
+               "members": [{"podNumber": 1, "leafCellNumber": 2}]},
+    )
+    sched.update_pod(bound, unbound)  # must not raise
+    st = sched.pod_schedule_statuses["u-d"]
+    assert st.pod_state == PodState.WAITING
+    chaos.audit_invariants(sched, "bound-to-unbound")

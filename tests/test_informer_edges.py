@@ -1,0 +1,277 @@
+"""Informer fault-path tests: the watch loop's relist-until-success repair,
+ERROR-event gaps, handler-failure non-advancement, first-sighting admission
+variants, UID-change decomposition with allocated pods, apiserver error-body
+capture, and the webserver probe endpoints (/healthz, /readyz,
+/v1/inspect/quarantine)."""
+
+import io
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.api import constants
+from hivedscheduler_tpu.scheduler.framework import HivedScheduler, NullKubeClient
+from hivedscheduler_tpu.scheduler.kube import (
+    InformerLoop,
+    KubeAPIClient,
+    KubeAPIError,
+    is_retryable_kube_error,
+)
+from hivedscheduler_tpu.scheduler.types import Node, PodState
+from hivedscheduler_tpu.webserver.server import WebServer
+
+from .test_config_compiler import tpu_design_config
+from .test_core import make_pod
+from .test_informer import node_item, pod_to_k8s_item
+
+common.init_logging(logging.CRITICAL)
+
+
+class ScriptedWatchClient(NullKubeClient):
+    """Drives one _watch_loop deterministically: scripted watch outcomes and
+    scripted relist failures."""
+
+    def __init__(self, relist_failures=0, first_watch_events=None):
+        super().__init__()
+        self.watch_rvs = []
+        self.list_calls = 0
+        self.relist_failures = relist_failures
+        self.first_watch_events = first_watch_events or []
+
+    def list_raw(self, path):
+        self.list_calls += 1
+        if self.relist_failures > 0:
+            self.relist_failures -= 1
+            raise OSError("apiserver unavailable")
+        return {"items": [], "metadata": {"resourceVersion": "42"}}
+
+    def watch(self, path, resource_version=""):
+        self.watch_rvs.append(resource_version)
+        if len(self.watch_rvs) == 1 and self.first_watch_events:
+            return iter(self.first_watch_events)
+        raise OSError("connection reset")
+
+
+def run_watch_loop_until(loop, client, cond, relist, rv="", timeout=5.0):
+    loop.BACKOFF_INITIAL_S = 0.001
+    loop.BACKOFF_MAX_S = 0.002
+    t = threading.Thread(
+        target=loop._watch_loop,
+        args=("/api/v1/nodes", loop._on_node_event, relist, rv),
+        daemon=True,
+    )
+    t.start()
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline and not cond():
+        time.sleep(0.005)
+    loop.stop()
+    t.join(timeout=5.0)
+    assert not t.is_alive(), "watch loop did not stop"
+    assert cond(), "condition never reached"
+
+
+def test_watch_loop_retries_relist_until_success_before_rewatching():
+    """Satellite fix: a failed relist must be retried (with backoff) until
+    it succeeds BEFORE the watch resumes. The old behavior returned "" after
+    one failed attempt and re-watched from resourceVersion "" against a
+    stale diff cache."""
+    sched = HivedScheduler(tpu_design_config())
+    client = ScriptedWatchClient(relist_failures=2)
+    loop = InformerLoop(sched, client)
+    run_watch_loop_until(
+        loop, client, lambda: len(client.watch_rvs) >= 2,
+        loop._relist_nodes, rv="7",
+    )
+    assert client.watch_rvs[0] == "7"
+    # Three list attempts: two scripted failures, then success.
+    assert client.list_calls >= 3
+    # The re-watch resumed from the SUCCESSFUL relist's resourceVersion —
+    # never from "" (which would mean watching against an unsynced cache).
+    assert client.watch_rvs[1] == "42"
+    assert "" not in client.watch_rvs
+
+
+def test_error_event_triggers_relist_gap_repair():
+    """A watch ERROR event (e.g. 410 Gone) must relist, not advance."""
+    sched = HivedScheduler(tpu_design_config())
+    client = ScriptedWatchClient(
+        first_watch_events=[
+            {"type": "ERROR", "object": {"code": 410, "reason": "Gone"}}
+        ]
+    )
+    loop = InformerLoop(sched, client)
+    run_watch_loop_until(
+        loop, client, lambda: len(client.watch_rvs) >= 2,
+        loop._relist_nodes, rv="7",
+    )
+    assert client.list_calls >= 1
+    assert client.watch_rvs[1] == "42"
+
+
+def test_handler_failure_relists_instead_of_advancing(monkeypatch):
+    """A handler exception must NOT advance the resourceVersion past the
+    failed event: the loop relists to reapply the lost change."""
+    sched = HivedScheduler(tpu_design_config())
+    client = ScriptedWatchClient(
+        first_watch_events=[
+            {"type": "ADDED", "object": node_item("v5e16a-w0")},
+        ]
+    )
+    loop = InformerLoop(sched, client)
+    calls = {"n": 0}
+
+    real_add_node = sched.add_node
+
+    def flaky_add_node(node):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("boom")
+        real_add_node(node)
+
+    monkeypatch.setattr(sched, "add_node", flaky_add_node)
+    run_watch_loop_until(
+        loop, client, lambda: len(client.watch_rvs) >= 2,
+        loop._relist_nodes, rv="7",
+    )
+    # The event's own resourceVersion ("1", from node_item) must never be
+    # used to resume: the failed handler forces a relist, and the resume RV
+    # comes from the relist.
+    assert client.watch_rvs[1] == "42"
+    assert "1" not in client.watch_rvs
+
+
+def test_modified_first_sighting_of_bound_pod_recovers_it():
+    """A bound pod whose ADDED fell into a watch gap is admitted through the
+    recovery path on MODIFIED (kube.py MODIFIED-as-first-sighting)."""
+    sched = HivedScheduler(tpu_design_config())
+    for n in sched.core.configured_node_names():
+        sched.add_node(Node(name=n))
+    loop = InformerLoop(sched, NullKubeClient())
+
+    from hivedscheduler_tpu.scheduler.types import (
+        SchedulingPhase,
+        new_binding_pod,
+    )
+
+    pod = make_pod("a-0", "ua", "VC1", 0, "v5e-chip", 4)
+    r = sched.core.schedule(
+        pod, sorted(sched.nodes), SchedulingPhase.FILTERING
+    )
+    bound = new_binding_pod(pod, r.pod_bind_info)
+    bound.phase = "Running"
+    loop._on_pod_event({"type": "MODIFIED", "object": pod_to_k8s_item(bound)})
+    assert sched.pod_schedule_statuses["ua"].pod_state == PodState.BOUND
+
+
+def test_modified_first_sighting_of_uninterested_pod_is_ignored():
+    sched = HivedScheduler(tpu_design_config())
+    loop = InformerLoop(sched, NullKubeClient())
+    pod = make_pod("noop", "un", "VC1", 0, "v5e-chip", 4)
+    pod.resource_limits = {}  # not hived-enabled
+    loop._on_pod_event({"type": "MODIFIED", "object": pod_to_k8s_item(pod)})
+    assert "un" not in sched.pod_schedule_statuses
+    assert "un" not in loop._known_pods
+
+
+def test_uid_change_with_allocated_old_pod_releases_and_readmits():
+    """Delete+recreate race surfacing as an update with a changed UID: the
+    old (allocated) pod's cells are released and the new incarnation is
+    admitted as WAITING (framework.py update_pod UID branch)."""
+    from hivedscheduler_tpu.api import extender as ei
+
+    sched = HivedScheduler(
+        tpu_design_config(), force_bind_executor=lambda fn: fn()
+    )
+    for n in sched.core.configured_node_names():
+        sched.add_node(Node(name=n))
+    pod = make_pod("r-0", "u-old", "VC1", 0, "v5e-chip", 4)
+    sched.add_pod(pod)
+    result = sched.filter_routine(
+        ei.ExtenderArgs(pod=pod, node_names=sorted(sched.nodes))
+    )
+    assert result.node_names
+    assert sched.pod_schedule_statuses["u-old"].pod_state == PodState.BINDING
+
+    reborn = make_pod("r-0", "u-new", "VC1", 0, "v5e-chip", 4)
+    sched.update_pod(sched.pod_schedule_statuses["u-old"].pod, reborn)
+    assert "u-old" not in sched.pod_schedule_statuses
+    assert sched.pod_schedule_statuses["u-new"].pod_state == PodState.WAITING
+    # The released cells are immediately reusable by the new incarnation.
+    r2 = sched.filter_routine(
+        ei.ExtenderArgs(pod=reborn, node_names=sorted(sched.nodes))
+    )
+    assert r2.node_names
+
+
+def test_kube_api_error_carries_status_and_body(monkeypatch):
+    """Satellite fix: _request must surface the apiserver error body (the
+    Status message says WHY a bind was rejected) and the status code for
+    the retry classifier."""
+    client = KubeAPIClient("http://127.0.0.1:1", token_path=None)
+    body = json.dumps(
+        {"kind": "Status", "message": "pods \"x\" not found"}
+    ).encode()
+
+    def fake_urlopen(req, timeout=None, context=None):
+        raise urllib.error.HTTPError(
+            req.full_url, 404, "Not Found", {}, io.BytesIO(body)
+        )
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    with pytest.raises(KubeAPIError) as e:
+        client.list_raw("/api/v1/pods")
+    assert e.value.status == 404
+    assert "not found" in e.value.body
+    assert "not found" in str(e.value)
+    assert not is_retryable_kube_error(e.value)
+    assert is_retryable_kube_error(
+        KubeAPIError("POST", "/x", 503, "leader changed")
+    )
+    assert is_retryable_kube_error(OSError("conn reset"))
+
+
+def test_probe_endpoints_and_quarantine_inspect():
+    """/healthz is always 200; /readyz flips with recovery; the quarantine
+    inspect endpoint serves the parked pods."""
+    sched = HivedScheduler(tpu_design_config())
+    ws = WebServer(sched, address="127.0.0.1:0")
+    ws.start()
+    try:
+        base = f"http://127.0.0.1:{ws.port}"
+
+        def get(path):
+            with urllib.request.urlopen(base + path) as resp:
+                return resp.status, json.loads(resp.read())
+
+        code, payload = get(constants.HEALTHZ_PATH)
+        assert code == 200 and payload["status"] == "ok"
+
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(constants.READYZ_PATH)
+        assert e.value.code == 503
+
+        sched.recover(
+            [Node(name=n) for n in sched.core.configured_node_names()], []
+        )
+        code, payload = get(constants.READYZ_PATH)
+        assert code == 200 and payload["status"] == "ready"
+
+        corrupt = make_pod("c-0", "u-c", "VC1", 0, "v5e-chip", 4)
+        corrupt.node_name = "v5e16a-w0"
+        corrupt.annotations[constants.ANNOTATION_POD_BIND_INFO] = "{bad: ["
+        sched.add_pod(corrupt)
+        assert "u-c" in sched.quarantined_pods
+        code, payload = get(constants.QUARANTINE_PATH)
+        assert code == 200
+        assert [i["podUid"] for i in payload["items"]] == ["u-c"]
+        code, metrics = get(constants.INSPECT_PATH + "/metrics")
+        assert metrics["quarantinedPodCount"] == 1
+        assert metrics["ready"] is True
+    finally:
+        ws.stop()
