@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Chaos-harness soak driver: run the full seeded fault schedule — node/pod
+# churn, bind faults, annotation corruption, preemption lifecycle (incl.
+# crash during Reserving/Reserved), reconfiguration restarts — at
+# HIVED_CHAOS_ROUNDS scale, outside tier-1 (the wrapper test is marked
+# `slow`; tier-1 filters it out with -m 'not slow').
+#
+#   HIVED_CHAOS_ROUNDS=5000 HIVED_CHAOS_START=10000 hack/soak.sh
+#
+# Defaults: 2000 seeds starting at 220 (past the tier-1 range 0..219, so a
+# soak always covers fresh seeds). Any invariant violation fails the run
+# with the seed in the assertion. Fuzz-harness soaks live in hack/soak.py.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export HIVED_CHAOS_ROUNDS="${HIVED_CHAOS_ROUNDS:-2000}"
+export HIVED_CHAOS_START="${HIVED_CHAOS_START:-220}"
+export JAX_PLATFORMS=cpu
+
+echo "chaos soak: seeds ${HIVED_CHAOS_START}..$((HIVED_CHAOS_START + HIVED_CHAOS_ROUNDS - 1))"
+exec python -m pytest tests/test_chaos_soak.py -m slow -q "$@"

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import random
 import time
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from .. import common
 from ..api import types as api
@@ -35,6 +35,7 @@ from ..scheduler.types import (
     PodWaitInfo,
     SchedulingPhase,
     extract_pod_bind_info,
+    extract_pod_preempt_info,
     extract_pod_scheduling_spec,
     is_node_healthy,
 )
@@ -274,12 +275,18 @@ def retrieve_virtual_cell(
 
 
 def generate_pod_preempt_info(
-    victims: Dict[str, Dict[str, Pod]], pod: Pod
+    victims: Dict[str, Dict[str, Pod]],
+    pod: Pod,
+    rng: Optional[random.Random] = None,
 ) -> PodPreemptInfo:
     """Pick one node's victims (K8s preempts one node at a time; random node
-    to spread preemptors) (reference: utils.go:82-105)."""
+    to spread preemptors) (reference: utils.go:82-105).
+
+    ``rng`` makes the pick seedable (chaos/probe determinism: the harness
+    sets ``HivedCore.preempt_rng``); None keeps the process-random default
+    production has always used."""
     nodes = sorted(victims)
-    node_to_preempt = nodes[random.randrange(len(nodes))]
+    node_to_preempt = nodes[(rng or random).randrange(len(nodes))]
     victim_pods = list(victims[node_to_preempt].values())
     common.log.info(
         "[%s]: need to preempt pods %s",
@@ -417,6 +424,7 @@ def generate_pod_schedule_result(
     group: Optional[AffinityGroup],
     group_name: str,
     pod: Pod,
+    preempt_rng: Optional[random.Random] = None,
 ) -> PodScheduleResult:
     """(reference: utils.go:38-79)"""
     if group_physical is None:
@@ -424,7 +432,9 @@ def generate_pod_schedule_result(
         return PodScheduleResult(pod_wait_info=PodWaitInfo(reason=wait_reason))
     if preemption_victims:
         return PodScheduleResult(
-            pod_preempt_info=generate_pod_preempt_info(preemption_victims, pod)
+            pod_preempt_info=generate_pod_preempt_info(
+                preemption_victims, pod, preempt_rng
+            )
         )
     bind_info, node, indices, chain = generate_affinity_group_bind_info(
         group_physical,
@@ -531,6 +541,35 @@ class HivedCore:
         # add_allocated_pod, and the safety checks discount the pending
         # units meanwhile (the freed quota is spoken for, not actually free).
         self._pending_doomed_checks: Dict[Tuple[CellChain, CellLevel], int] = {}
+        # Seedable source for the preemption victim-node pick; the chaos
+        # harness and probe battery replace it with a seeded Random so
+        # preemption schedules are deterministic per seed. Production keeps
+        # process randomness.
+        self.preempt_rng: Optional[random.Random] = None
+        # Doomed-ledger persistence support (doc/fault-model.md
+        # "Reconfiguration plane"): every advisory-binding change bumps the
+        # epoch so the framework knows when to rewrite the ledger ConfigMap,
+        # and during recovery the persisted ledger seeds the preference map
+        # so dooms re-bind to the SAME bad cells the pre-crash scheduler
+        # chose instead of arbitrary ones (that arbitrariness is what made
+        # the doomed subsystem non-reconstructible before).
+        self.doomed_epoch = 0
+        self.preferred_doomed: Dict[
+            Tuple[api.VirtualClusterName, CellChain, CellLevel], Set[str]
+        ] = {}
+        # While True (recovery with a loaded ledger), the persisted ledger
+        # is AUTHORITATIVE: organic doom bind/retire is suspended and
+        # rebuild_doomed_from_ledger is the only creator. Recovery replays
+        # through intermediate states (final node health, no pods yet) the
+        # continuous timeline never visited, so organic shortfall checks
+        # there would create — or retire — advisory bindings the pre-crash
+        # scheduler did not have.
+        self.doomed_ledger_mode = False
+        # Optional hook observing preempting-group lifecycle transitions
+        # ("cancelled" / "allocated"), called with the group while its
+        # preempting_pods are still populated. The framework uses it to
+        # clear preempt-info annotations outside the scheduler lock.
+        self.preemption_observer: Optional[Callable[[AffinityGroup, str], None]] = None
 
         self._init_cell_nums()
         self._init_pinned_cells(cc.physical_pinned)
@@ -673,7 +712,19 @@ class HivedCore:
             self._remove_bad_free_cell(c)
         elif c.virtual_cell is not None:
             vc = c.virtual_cell
-            if not c.pinned and c.priority < MIN_GUARANTEED_PRIORITY:
+            if (
+                not c.pinned
+                and c.priority < MIN_GUARANTEED_PRIORITY
+                and not (self.doomed_ledger_mode and vc.parent is None)
+            ):
+                # (In ledger mode, a preassigned — i.e. doomed — binding
+                # healing during the recovery health replay must SURVIVE
+                # until the pod replay decides its fate: the pre-crash
+                # scheduler kept it because a guaranteed allocation rode
+                # its healthy chips, and that allocation has not replayed
+                # yet. Dooms still unpinned when recovery finishes are
+                # retired by clear_preferred_doomed; the heal itself still
+                # propagates below.)
                 # The binding existed only because the cell was bad.
                 c.set_virtual_cell(None)
                 vc.set_physical_cell(None)
@@ -685,6 +736,7 @@ class HivedCore:
                     # A preassigned cell unbound here must be a doomed bad cell.
                     self.vc_doomed_bad_cells[vc.vc][c.chain].remove(c, c.level)
                     self.all_vc_doomed_bad_cell_num[c.chain][c.level] -= 1
+                    self.doomed_epoch += 1
                     self._release_preassigned_cell(c, vc.vc, True)
         if c.parent is None:
             return
@@ -720,6 +772,8 @@ class HivedCore:
         """If a VC's free cells exceed healthy free physical cells, bind bad
         free cells into the VC so the failure is visible
         (reference: hived_algorithm.go:604-630)."""
+        if self.doomed_ledger_mode:
+            return  # recovery: the persisted ledger is authoritative
         for vc_name, vc_free in self.vc_free_cell_num.items():
             if chain not in vc_free:
                 continue
@@ -750,6 +804,7 @@ class HivedCore:
                 self.all_vc_doomed_bad_cell_num[chain][level] = (
                     self.all_vc_doomed_bad_cell_num[chain].get(level, 0) + 1
                 )
+                self.doomed_epoch += 1
                 self._allocate_preassigned_cell(pc, vc_name, True)
 
     def _try_unbind_doomed_bad_cell(self, chain: CellChain, level: CellLevel) -> None:
@@ -762,6 +817,8 @@ class HivedCore:
         we apply it here too. Without it, releasing the cell back to the
         free list while pods run on it corrupts the free lists (found by
         sequence fuzzing).)"""
+        if self.doomed_ledger_mode:
+            return  # recovery: the persisted ledger is authoritative
         for vc_name, vc_free in self.vc_free_cell_num.items():
             if chain not in vc_free:
                 continue
@@ -797,7 +854,147 @@ class HivedCore:
         self._unbind_bad_descendants(pc)
         self.vc_doomed_bad_cells[vcn][pc.chain].remove(pc, pc.level)
         self.all_vc_doomed_bad_cell_num[pc.chain][pc.level] -= 1
+        self.doomed_epoch += 1
         self._release_preassigned_cell(pc, vcn, True)
+
+    # -- doomed-ledger persistence ------------------------------------------
+
+    def doomed_ledger_snapshot(self) -> Dict:
+        """Serialize the doomed-bad bindings for the scheduler-owned
+        ConfigMap: which bad cell each VC's unsatisfiable quota is pinned
+        to. Deterministically ordered so identical states produce identical
+        ConfigMap payloads."""
+        vcs: Dict[str, List[Dict]] = {}
+        for vcn, per_chain in sorted(self.vc_doomed_bad_cells.items()):
+            entries: List[Dict] = []
+            for chain, ccl in sorted(per_chain.items()):
+                for level, cl in sorted(ccl.levels.items()):
+                    for c in cl:
+                        entries.append(
+                            {
+                                "chain": str(chain),
+                                "level": int(level),
+                                "address": c.address,
+                            }
+                        )
+            if entries:
+                entries.sort(key=lambda e: (e["chain"], e["level"], e["address"]))
+                vcs[str(vcn)] = entries
+        return {"epoch": self.doomed_epoch, "vcs": vcs}
+
+    def set_preferred_doomed(self, ledger: Optional[Dict]) -> None:
+        """Install the persisted ledger for the recovery replay. A dict —
+        even one listing zero dooms — is authoritative and enters ledger
+        mode (organic doom bind/retire suspended; see doomed_ledger_mode);
+        None (first boot, or the ConfigMap read failed) keeps the organic
+        behavior. Entries naming VCs, chains, or cells absent from the
+        current config are ignored — a reconfiguration between restarts
+        legitimately invalidates them."""
+        self.preferred_doomed = {}
+        self.doomed_ledger_mode = isinstance(ledger, dict)
+        if not ledger:
+            return
+        for vcn, entries in (ledger.get("vcs") or {}).items():
+            if vcn not in self.vc_free_cell_num:
+                continue
+            for e in entries:
+                try:
+                    key = (vcn, str(e["chain"]), int(e["level"]))
+                    address = str(e["address"])
+                except (KeyError, TypeError, ValueError):
+                    continue
+                if key[1] not in self.full_cell_list:
+                    continue
+                self.preferred_doomed.setdefault(key, set()).add(address)
+
+    def clear_preferred_doomed(self) -> None:
+        """Recovery done: steady-state doom choices revert to the organic
+        shortfall-driven behavior so a recovered scheduler behaves exactly
+        like a fresh one from here on. Ledger dooms that fully healed
+        during the replay and were NOT pinned by a replayed allocation are
+        retired first — the continuous timeline's heal/release paths would
+        have retired them (a healed doom survives only while in use), and
+        _set_healthy_cell deliberately kept them alive through the health
+        replay for exactly the pinned case."""
+        if self.doomed_ledger_mode:
+            for per_chain in self.vc_doomed_bad_cells.values():
+                for ccl in per_chain.values():
+                    for level in list(ccl.levels):
+                        for c in list(ccl.levels[level]):
+                            if (
+                                c.healthy
+                                and c.priority < MIN_GUARANTEED_PRIORITY
+                            ):
+                                assert isinstance(c, PhysicalCell)
+                                common.log.info(
+                                    "Retiring healed, unpinned ledger doom "
+                                    "%s", c.address,
+                                )
+                                self._unbind_doomed_cell(c)
+        self.preferred_doomed = {}
+        self.doomed_ledger_mode = False
+
+    def rebuild_doomed_from_ledger(self) -> None:
+        """Make the advisory doomed set exactly the persisted ledger's:
+        retire the organic dooms the constructor's all-nodes-bad bootstrap
+        bound (they predate the ledger and sit on arbitrary cells), then
+        bind precisely the ledger's (VC, chain, level, address) entries.
+        Called by recover() before the node-health replay, while every
+        cell is still marked bad — the ledger cells (bad on the pre-crash
+        side, or they would not be listed) are guaranteed bindable. No-op
+        outside ledger mode (first boot: organic dooming stands)."""
+        if not self.doomed_ledger_mode:
+            return
+        for vcn, per_chain in self.vc_doomed_bad_cells.items():
+            for chain, ccl in per_chain.items():
+                for level in list(ccl.levels):
+                    for c in list(ccl.levels[level]):
+                        if c.priority < MIN_GUARANTEED_PRIORITY:
+                            assert isinstance(c, PhysicalCell)
+                            self._unbind_doomed_cell(c)
+        for (vcn, chain, level), addresses in sorted(
+            self.preferred_doomed.items()
+        ):
+            doomed = self.vc_doomed_bad_cells.get(vcn, {}).get(chain)
+            preassigned = self.vc_schedulers[vcn].non_pinned_preassigned
+            if doomed is None or chain not in preassigned:
+                continue
+            for address in sorted(addresses):
+                if any(c.address == address for c in doomed[level]):
+                    continue
+                pc = next(
+                    (
+                        c
+                        for c in self.bad_free_cells[chain][level]
+                        if c.address == address and c.virtual_cell is None
+                    ),
+                    None,
+                )
+                if pc is None:
+                    common.log.warning(
+                        "Ledger doom %s (VC %s, chain %s level %s) is no "
+                        "longer a bad free cell; dropping the entry",
+                        address, vcn, chain, level,
+                    )
+                    continue
+                assert isinstance(pc, PhysicalCell)
+                vc = allocation.get_unbound_virtual_cell(
+                    preassigned[chain][level]
+                )
+                if vc is None:
+                    continue
+                pc.set_virtual_cell(vc)
+                vc.set_physical_cell(pc)
+                common.log.warning(
+                    "Cell %s is doomed to be bad and bound to %s (VC %s, "
+                    "from the persisted ledger)", vc.address, pc.address, vcn,
+                )
+                self.vc_doomed_bad_cells[vcn][chain][level].append(pc)
+                self.all_vc_doomed_bad_cell_num[chain][level] = (
+                    self.all_vc_doomed_bad_cell_num[chain].get(level, 0) + 1
+                )
+                self.doomed_epoch += 1
+                self._allocate_preassigned_cell(pc, vcn, True)
 
     # -- scheduling ---------------------------------------------------------
 
@@ -844,6 +1041,7 @@ class HivedCore:
             self.affinity_groups.get(s.affinity_group.name),
             s.affinity_group.name,
             pod,
+            self.preempt_rng,
         )
 
     def _schedule_pod_from_existing_group(
@@ -1416,60 +1614,118 @@ class HivedCore:
                     assert isinstance(leaf, PhysicalCell)
                     v_leaf = virtual[leaf_num][pod_index][leaf_index]
                     assert isinstance(v_leaf, VirtualCell)
-                    if leaf.state == CellState.USED:
-                        using_group = leaf.using_group
-                        self._release_leaf_cell(
-                            leaf,
-                            using_group.vc,
-                            opportunistic=using_group.virtual_placement is None,
-                        )
-                        using_group.state = GroupState.BEING_PREEMPTED
-                    self._allocate_leaf_cell(leaf, v_leaf, s.priority, new_group.vc)
-                    leaf.add_reserving_or_reserved_group(new_group)
-                    # Reserving if someone still uses it, Reserved if free
-                    # (a Reserving/Reserved cell would have had its previous
-                    # preemption canceled in schedule()).
-                    if leaf.state == CellState.USED:
-                        set_cell_state(leaf, CellState.RESERVING)
-                    else:
-                        set_cell_state(leaf, CellState.RESERVED)
+                    self._reserve_leaf_for_preemptor(leaf, v_leaf, new_group)
         new_group.preempting_pods[pod.uid] = pod
         self.affinity_groups[s.affinity_group.name] = new_group
+
+    def _reserve_leaf_for_preemptor(
+        self, leaf: PhysicalCell, v_leaf: VirtualCell, group: AffinityGroup
+    ) -> None:
+        """The per-leaf Reserving/Reserved transition shared by live
+        preemption creation and crash recovery of preempting groups: release
+        any victim using the leaf (its group becomes BeingPreempted),
+        allocate the preemptor's virtual leaf, and reserve."""
+        if leaf.state == CellState.USED:
+            using_group = leaf.using_group
+            self._release_leaf_cell(
+                leaf,
+                using_group.vc,
+                opportunistic=using_group.virtual_placement is None,
+            )
+            using_group.state = GroupState.BEING_PREEMPTED
+        self._allocate_leaf_cell(leaf, v_leaf, group.priority, group.vc)
+        leaf.add_reserving_or_reserved_group(group)
+        # Reserving if someone still uses it, Reserved if free (a
+        # Reserving/Reserved cell would have had its previous preemption
+        # canceled in schedule()).
+        if leaf.state == CellState.USED:
+            set_cell_state(leaf, CellState.RESERVING)
+        else:
+            set_cell_state(leaf, CellState.RESERVED)
+
+    def _unreserve_leaf_for_preemptor(
+        self, leaf: PhysicalCell, vcn: api.VirtualClusterName
+    ) -> Optional[AffinityGroup]:
+        """Per-leaf inverse of _reserve_leaf_for_preemptor, shared by the
+        live cancellation walk and the recovery rollback: release the
+        preemptor's allocation, drop the reservation pointer, and either
+        return a Reserving cell to its victim (re-allocated at the
+        victim's priority; the victim group is returned so callers can
+        re-check its BeingPreempted state) or free a Reserved cell."""
+        self._release_leaf_cell(leaf, vcn)
+        leaf.delete_reserving_or_reserved_group(
+            leaf.reserving_or_reserved_group
+        )
+        if leaf.state == CellState.RESERVING:
+            set_cell_state(leaf, CellState.USED)
+            being_preempted = leaf.using_group
+            being_preempted_v_leaf: Optional[VirtualCell] = None
+            if being_preempted.virtual_placement is not None:
+                being_preempted_v_leaf = retrieve_virtual_cell(
+                    being_preempted.physical_placement,
+                    being_preempted.virtual_placement,
+                    leaf,
+                )
+            self._allocate_leaf_cell(
+                leaf,
+                being_preempted_v_leaf,
+                being_preempted.priority,
+                being_preempted.vc,
+            )
+            return being_preempted
+        set_cell_state(leaf, CellState.FREE)  # RESERVED
+        return None
 
     def _delete_preempting_affinity_group(self, g: AffinityGroup, pod: Pod) -> None:
         """Revoke an ongoing preemption: return Reserving cells to their
         being-preempted groups, free Reserved cells
         (reference: hived_algorithm.go:1116-1145)."""
+        restored: List[AffinityGroup] = []
         for leaf_num in g.physical_placement:
             for pod_index in range(len(g.physical_placement[leaf_num])):
                 for leaf in g.physical_placement[leaf_num][pod_index]:
                     assert isinstance(leaf, PhysicalCell)
-                    self._release_leaf_cell(leaf, g.vc)
-                    leaf.delete_reserving_or_reserved_group(
-                        leaf.reserving_or_reserved_group
-                    )
-                    if leaf.state == CellState.RESERVING:
-                        set_cell_state(leaf, CellState.USED)
-                        being_preempted = leaf.using_group
-                        being_preempted_v_leaf: Optional[VirtualCell] = None
-                        if being_preempted.virtual_placement is not None:
-                            being_preempted_v_leaf = retrieve_virtual_cell(
-                                being_preempted.physical_placement,
-                                being_preempted.virtual_placement,
-                                leaf,
-                            )
-                        self._allocate_leaf_cell(
-                            leaf,
-                            being_preempted_v_leaf,
-                            being_preempted.priority,
-                            being_preempted.vc,
-                        )
-                    else:  # RESERVED
-                        set_cell_state(leaf, CellState.FREE)
+                    victim = self._unreserve_leaf_for_preemptor(leaf, g.vc)
+                    if victim is not None and all(
+                        victim is not r for r in restored
+                    ):
+                        restored.append(victim)
         del self.affinity_groups[g.name]
+        # First-class cancel transition: victims whose last reservation just
+        # vanished return to Allocated. (The reference leaves them
+        # BeingPreempted forever; with group state now part of the durable
+        # restart-equivalence contract, a recovered scheduler — which
+        # replays them as Allocated — would otherwise diverge.)
+        self._restore_being_preempted_groups(restored)
+        if self.preemption_observer is not None:
+            self.preemption_observer(g, "cancelled")
         common.log.info(
             "[%s]: Preempting affinity group %s deleted", pod.key, g.name
         )
+
+    def _restore_being_preempted_groups(
+        self, groups: List[AffinityGroup]
+    ) -> None:
+        """BeingPreempted -> Allocated for victim groups none of whose cells
+        remain reserved by any preemptor (a victim can be overlapped by
+        several preemptors on disjoint leaves; it stays BeingPreempted while
+        any reservation survives)."""
+        for vg in groups:
+            if vg.state != GroupState.BEING_PREEMPTED:
+                continue
+            if any(
+                leaf is not None
+                and leaf.reserving_or_reserved_group is not None
+                for rows in vg.physical_placement.values()
+                for row in rows
+                for leaf in row
+            ):
+                continue
+            vg.state = GroupState.ALLOCATED
+            common.log.info(
+                "Affinity group %s is no longer being preempted "
+                "(preemption cancelled)", vg.name,
+            )
 
     def _allocate_preempting_affinity_group(
         self, g: AffinityGroup, pod: Pod
@@ -1484,11 +1740,255 @@ class HivedCore:
                     leaf.add_using_group(g)
                     set_cell_state(leaf, CellState.USED)
         g.state = GroupState.ALLOCATED
+        if self.preemption_observer is not None:
+            # Observed BEFORE preempting_pods resets: the framework clears
+            # the preempt-info annotations those pods still carry.
+            self.preemption_observer(g, "allocated")
         g.preempting_pods = {}
         common.log.info(
             "[%s]: Preempting affinity group %s transitioned to allocated",
             pod.key, g.name,
         )
+
+    # -- preemption crash recovery ------------------------------------------
+
+    def get_preempt_info_payload(self, name: str) -> Optional[Dict]:
+        """The reserved placement of a PREEMPTING group in PodBindInfo dict
+        shape — what the framework patches onto preemptor pods so the
+        reservation survives a crash. None when the group is not preempting
+        (nothing durable to record)."""
+        g = self.affinity_groups.get(name)
+        if (
+            g is None
+            or g.state != GroupState.PREEMPTING
+            or g.virtual_placement is None
+            or not g.physical_placement
+        ):
+            return None
+        leaf_num = sorted(g.physical_placement)[0]
+        bind_info, _node, _indices, chain = generate_affinity_group_bind_info(
+            g.physical_placement,
+            g.virtual_placement,
+            self.cell_types,
+            leaf_num,
+            0,
+            g,
+            g.name,
+        )
+        return api.PodBindInfo(
+            node="",
+            leaf_cell_isolation=[],
+            cell_chain=chain,
+            affinity_group_bind_info=bind_info,
+        ).to_dict()
+
+    def recover_preempting_affinity_group(self, pod: Pod) -> Tuple[bool, str]:
+        """Replay a preempting affinity group from the preempt-info
+        annotation a preemptor pod carried when the scheduler crashed:
+        re-reserve the cells (victims still alive become BeingPreempted
+        again, exactly like the live path) or cancel the preemption when
+        the reservation is no longer replayable — cells gone from the
+        config, grabbed by another preemptor, occupied by an
+        equal-or-higher-priority group, unhealthy, or ALL victims vanished
+        while the scheduler was down (nothing left to preempt: the pod
+        re-schedules fresh onto the now-free cells).
+
+        Returns (recovered, reason); ``reason`` explains a cancellation."""
+        s = extract_pod_scheduling_spec(pod)
+        name = s.affinity_group.name
+        g = self.affinity_groups.get(name)
+        if g is not None:
+            if g.state == GroupState.PREEMPTING:
+                # Another pod of the gang already replayed the reservation.
+                g.preempting_pods[pod.uid] = pod
+                return True, ""
+            return False, f"group {name} was already recovered as {g.state.value}"
+        info = extract_pod_preempt_info(pod)
+        new_group = AffinityGroup(
+            s.affinity_group,
+            s.virtual_cluster,
+            s.lazy_preemption_enable,
+            s.priority,
+            GroupState.PREEMPTING,
+        )
+        # Pass 1 — pure: locate every reserved leaf and apply the cancel
+        # guards WITHOUT mutating, so a cancelled recovery leaves no trace.
+        # The annotation is user-writable pod metadata, so the shape checks
+        # are load-bearing: ragged rows, duplicate member records, or
+        # duplicate leaf references must cancel here — reserving them would
+        # double-count quota or strand half-reserved cells.
+        located: Dict[int, List[List[PhysicalCell]]] = {}
+        located_types: Dict[int, List[List[str]]] = {}
+        seen_leaves: Set[str] = set()
+        any_victim = False
+        for gms in info.affinity_group_bind_info:
+            if not gms.pod_placements:
+                continue
+            leaf_num = len(gms.pod_placements[0].physical_leaf_cell_indices)
+            if (
+                leaf_num in located
+                or leaf_num not in new_group.physical_placement
+                or len(gms.pod_placements)
+                != len(new_group.physical_placement[leaf_num])
+            ):
+                return False, "reserved placement does not match the group spec"
+            rows: List[List[PhysicalCell]] = []
+            type_rows: List[List[str]] = []
+            for pp in gms.pod_placements:
+                if len(pp.physical_leaf_cell_indices) != leaf_num:
+                    return False, (
+                        "reserved placement does not match the group spec"
+                    )
+                row: List[PhysicalCell] = []
+                type_row: List[str] = []
+                for i, idx in enumerate(pp.physical_leaf_cell_indices):
+                    p_leaf = find_physical_leaf_cell(
+                        self.full_cell_list, info.cell_chain,
+                        pp.physical_node, idx,
+                    )
+                    if p_leaf is None:
+                        return False, (
+                            f"reserved leaf {idx} on node {pp.physical_node} "
+                            "no longer exists in the configuration"
+                        )
+                    if not p_leaf.healthy:
+                        # Mirrors the live cancel-on-bad-placement rule
+                        # (_schedule_pod_from_existing_group, Preempting).
+                        return False, (
+                            f"reserved leaf {p_leaf.address} is no longer "
+                            "healthy"
+                        )
+                    if p_leaf.state in (CellState.RESERVING, CellState.RESERVED):
+                        return False, (
+                            f"reserved leaf {p_leaf.address} is held by "
+                            "another preemptor"
+                        )
+                    if (
+                        p_leaf.state == CellState.USED
+                        and p_leaf.using_group is not None
+                        and p_leaf.using_group.priority >= s.priority
+                    ):
+                        # A stale reservation: the cell was re-allocated to
+                        # an equal-or-higher-priority group since.
+                        return False, (
+                            f"reserved leaf {p_leaf.address} is used by "
+                            "an equal-or-higher-priority group "
+                            f"({p_leaf.using_group.name})"
+                        )
+                    if p_leaf.address in seen_leaves:
+                        return False, (
+                            f"reserved leaf {p_leaf.address} is referenced "
+                            "twice by the preempt info"
+                        )
+                    seen_leaves.add(p_leaf.address)
+                    if p_leaf.state == CellState.USED:
+                        any_victim = True
+                    row.append(p_leaf)
+                    type_row.append(
+                        pp.preassigned_cell_types[i]
+                        if i < len(pp.preassigned_cell_types)
+                        else ""
+                    )
+                rows.append(row)
+                type_rows.append(type_row)
+            located[leaf_num] = rows
+            located_types[leaf_num] = type_rows
+        if not located or set(located) != set(new_group.physical_placement):
+            return False, "reserved placement does not match the group spec"
+        if not any_victim:
+            return False, "victims vanished while the scheduler was down"
+        # Pass 2 — mutating: map each leaf into the VC and reserve it,
+        # interleaved exactly like the live allocation order (a sibling's
+        # mapping depends on the bindings the previous leaf created). A
+        # mapping failure mid-way (e.g. quota moved away by a
+        # reconfiguration) — or anything unexpected raising — rolls the
+        # partial reservation back: leaked Reserved cells owned by a group
+        # that never registered would be unfreeable forever.
+        reserved: List[PhysicalCell] = []
+        try:
+            try:
+                for leaf_num in sorted(located):
+                    for pod_index, row in enumerate(located[leaf_num]):
+                        for leaf_index, p_leaf in enumerate(row):
+                            v_leaf, message = self._map_reserved_virtual_leaf(
+                                p_leaf,
+                                located_types[leaf_num][pod_index][leaf_index],
+                                s,
+                            )
+                            if v_leaf is None:
+                                self._rollback_partial_reservation(
+                                    new_group, reserved
+                                )
+                                return False, message
+                            new_group.physical_placement[leaf_num][pod_index][
+                                leaf_index
+                            ] = p_leaf
+                            new_group.virtual_placement[leaf_num][pod_index][
+                                leaf_index
+                            ] = v_leaf
+                            self._reserve_leaf_for_preemptor(
+                                p_leaf, v_leaf, new_group
+                            )
+                            reserved.append(p_leaf)
+            except Exception:
+                self._rollback_partial_reservation(new_group, reserved)
+                raise
+            new_group.preempting_pods[pod.uid] = pod
+            self.affinity_groups[name] = new_group
+            common.log.info(
+                "[%s]: Recovered preempting affinity group %s "
+                "(Reserving/Reserved reservation replayed)", pod.key, name,
+            )
+            return True, ""
+        finally:
+            # Mirror add_allocated_pod: the mapping's doomed evictions
+            # registered deferred shortfall re-checks; once the reservation
+            # has consumed (or rolled back) the quota, leaving them would
+            # make _effective_vc_free under-count allVCFree in every later
+            # safety check.
+            self._flush_pending_doomed_checks()
+
+    def _map_reserved_virtual_leaf(
+        self, p_leaf: PhysicalCell, preassigned_type: str,
+        s: api.PodSchedulingSpec,
+    ) -> Tuple[Optional[VirtualCell], str]:
+        """Preemption-recovery face of the shared replay mapping
+        (_map_replayed_leaf_to_virtual): a failure cancels the preemption
+        instead of degrading the group to opportunistic — a preemptor
+        without VC membership would be meaningless, its whole point is
+        claiming guaranteed quota."""
+        if not preassigned_type:
+            return None, "preassigned cell type missing from preempt info"
+        return self._map_replayed_leaf_to_virtual(p_leaf, preassigned_type, s)
+
+    def _rollback_partial_reservation(
+        self, group: AffinityGroup, reserved: List[PhysicalCell]
+    ) -> None:
+        """Undo the leaves a failed preemption recovery already reserved —
+        the same per-leaf inverse (_unreserve_leaf_for_preemptor) the live
+        cancellation walk in _delete_preempting_affinity_group uses."""
+        restored: List[AffinityGroup] = []
+        for leaf in reserved:
+            victim = self._unreserve_leaf_for_preemptor(leaf, group.vc)
+            if victim is not None and all(victim is not r for r in restored):
+                restored.append(victim)
+        self._restore_being_preempted_groups(restored)
+
+    def cancel_preemption(self, name: str, pod: Pod, reason: str = "") -> bool:
+        """Cancel a PREEMPTING group by name — the public form of the
+        cancellation transition (used by the framework and the chaos
+        harness's durable projection). Returns True when a group was
+        actually cancelled."""
+        g = self.affinity_groups.get(name)
+        if g is None or g.state != GroupState.PREEMPTING:
+            return False
+        if reason:
+            common.log.info(
+                "[%s]: Canceling affinity group %s's preemption: %s",
+                pod.key, name, reason,
+            )
+        self._delete_preempting_affinity_group(g, pod)
+        return True
 
     def _lazy_preempt_group(
         self, victim: AffinityGroup, preemptor: str
@@ -1595,98 +2095,9 @@ class HivedCore:
         if group.virtual_placement is not None and not lazy_preempted:
             preassigned_type = preassigned_cell_types[index]
             if preassigned_type:
-                message = ""
-                v_leaf: Optional[VirtualCell] = None
-                preassigned_level: Optional[CellLevel] = None
-                for l, t in self.cell_types.get(p_leaf.chain, {}).items():
-                    if t == preassigned_type:
-                        preassigned_level = l
-                if preassigned_level is None:
-                    message = (
-                        f"Preassigned cell type {preassigned_type} not found "
-                        f"in chain {p_leaf.chain}"
-                    )
-                elif s.virtual_cluster not in self.vc_schedulers:
-                    message = f"VC {s.virtual_cluster} not found"
-                else:
-                    vcs = self.vc_schedulers[s.virtual_cluster]
-                    if s.pinned_cell_id:
-                        vccl = vcs.pinned_cells.get(s.pinned_cell_id)
-                        target = str(s.pinned_cell_id)
-                    else:
-                        vccl = vcs.non_pinned_preassigned.get(p_leaf.chain)
-                        target = str(p_leaf.chain)
-                    if vccl is None:
-                        message = (
-                            f"VC {s.virtual_cluster} has no cell for {target}"
-                        )
-                    else:
-                        # The subtree the pod's preassigned cell will claim.
-                        anchor: Optional[PhysicalCell] = p_leaf
-                        while (
-                            anchor is not None
-                            and anchor.level < preassigned_level
-                        ):
-                            anchor = anchor.parent  # type: ignore[assignment]
-                        if anchor is not None and not s.pinned_cell_id:
-                            # Replay may find DOOMED advisory bindings
-                            # overlapping the claim: recovery marks nodes
-                            # bad before pods replay, so the doomed binder
-                            # saw these cells as free and grabbed them —
-                            # at or above the anchor (blocking the binding
-                            # path) or strictly inside it (splitting the
-                            # anchor out of the free list). The real
-                            # allocation takes precedence: evict them; each
-                            # doom is re-bound onto a non-overlapping bad
-                            # free cell when one exists.
-                            self._evict_doomed_overlapping(
-                                anchor, s.virtual_cluster
-                            )
-                        v_leaf, message = allocation.map_physical_cell_to_virtual(
-                            p_leaf, vccl, preassigned_level, priority
-                        )
-                        if (
-                            v_leaf is None
-                            and not s.pinned_cell_id
-                            and self._evict_doomed_binding_for_vc(
-                                s.virtual_cluster, p_leaf.chain,
-                                preassigned_level,
-                            )
-                        ):
-                            # A doomed-bad binding of this pod's OWN VC was
-                            # squatting on the quota cell the replay needs
-                            # (bound to a DIFFERENT physical cell), so the
-                            # real allocation failed to map — degrading the
-                            # whole group to opportunistic and losing its VC
-                            # membership across a restart. The advisory
-                            # binding yields; the shortfall is re-checked
-                            # once the pod's quota is consumed
-                            # (add_allocated_pod flushes the deferred
-                            # checks). Found by the chaos harness
-                            # restart-equivalence invariant.
-                            v_leaf, message = (
-                                allocation.map_physical_cell_to_virtual(
-                                    p_leaf, vccl, preassigned_level, priority
-                                )
-                            )
-                        if (
-                            v_leaf is not None
-                            and anchor is not None
-                            and not s.pinned_cell_id
-                            and v_leaf.preassigned_cell.physical_cell is None
-                            and not in_free_cell_list(anchor)
-                        ):
-                            # The mapping found a virtual cell but the
-                            # physical anchor is not claimable (e.g. a
-                            # foreign REAL allocation splits it — possible
-                            # after overlapped safety violations). Degrade
-                            # to opportunistic instead of crashing the
-                            # replay mid-mutation.
-                            v_leaf = None
-                            message = (
-                                f"physical cell {anchor.address} is not a "
-                                "free cell (split or allocated elsewhere)"
-                            )
+                v_leaf, message = self._map_replayed_leaf_to_virtual(
+                    p_leaf, preassigned_type, s
+                )
                 if v_leaf is None:
                     common.log.warning(
                         "[%s]: Cannot find virtual cell: %s", pod.key, message
@@ -1695,6 +2106,101 @@ class HivedCore:
                 return p_leaf, v_leaf, False
             return p_leaf, None, None
         return p_leaf, None, False
+
+    def _map_replayed_leaf_to_virtual(
+        self,
+        p_leaf: PhysicalCell,
+        preassigned_type: api.CellType,
+        s: api.PodSchedulingSpec,
+    ) -> Tuple[Optional[VirtualCell], str]:
+        """The inverse physical->virtual mapping shared by the two replay
+        paths — allocated pods (bound-pod crash recovery) and preempting
+        groups (Reserving/Reserved recovery): resolve the preassigned type
+        to a level, find the VC cell list, evict overlapping doomed
+        advisory bindings, map with the same-VC-squatter retry, and reject
+        mappings whose physical anchor is not claimable. The callers decide
+        what a failure means: degrade to opportunistic (allocated replay,
+        reference hived_algorithm.go:1223-1291) or cancel the preemption
+        (a preemptor without VC membership would be meaningless)."""
+        priority = s.priority
+        preassigned_level: Optional[CellLevel] = None
+        for l, t in self.cell_types.get(p_leaf.chain, {}).items():
+            if t == preassigned_type:
+                preassigned_level = l
+        if preassigned_level is None:
+            return None, (
+                f"Preassigned cell type {preassigned_type} not found "
+                f"in chain {p_leaf.chain}"
+            )
+        if s.virtual_cluster not in self.vc_schedulers:
+            return None, f"VC {s.virtual_cluster} not found"
+        vcs = self.vc_schedulers[s.virtual_cluster]
+        if s.pinned_cell_id:
+            vccl = vcs.pinned_cells.get(s.pinned_cell_id)
+            target = str(s.pinned_cell_id)
+        else:
+            vccl = vcs.non_pinned_preassigned.get(p_leaf.chain)
+            target = str(p_leaf.chain)
+        if vccl is None:
+            return None, f"VC {s.virtual_cluster} has no cell for {target}"
+        # The subtree the pod's preassigned cell will claim.
+        anchor: Optional[PhysicalCell] = p_leaf
+        while anchor is not None and anchor.level < preassigned_level:
+            anchor = anchor.parent  # type: ignore[assignment]
+        if (
+            anchor is not None
+            and not s.pinned_cell_id
+            and len(vccl[preassigned_level]) > 0
+        ):
+            # Replay may find DOOMED advisory bindings overlapping the
+            # claim: recovery marks nodes bad before pods replay, so the
+            # doomed binder saw these cells as free and grabbed them — at
+            # or above the anchor (blocking the binding path) or strictly
+            # inside it (splitting the anchor out of the free list). The
+            # real allocation takes precedence: evict them; each doom is
+            # re-bound onto a non-overlapping bad free cell when one
+            # exists. Gated on the VC actually having cells at the
+            # preassigned level: evictions are in service of THIS mapping,
+            # and a VC whose quota moved away in a reconfiguration (the
+            # pod is about to degrade/cancel) must leave other VCs' dooms
+            # alone (found by the strict-ledger chaos equivalence).
+            self._evict_doomed_overlapping(anchor, s.virtual_cluster)
+        v_leaf, message = allocation.map_physical_cell_to_virtual(
+            p_leaf, vccl, preassigned_level, priority
+        )
+        if (
+            v_leaf is None
+            and not s.pinned_cell_id
+            and self._evict_doomed_binding_for_vc(
+                s.virtual_cluster, p_leaf.chain, preassigned_level
+            )
+        ):
+            # A doomed-bad binding of this pod's OWN VC was squatting on
+            # the quota cell the replay needs (bound to a DIFFERENT
+            # physical cell), so the real allocation failed to map. The
+            # advisory binding yields; the shortfall is re-checked once
+            # the pod's quota is consumed (add_allocated_pod flushes the
+            # deferred checks). Found by the chaos harness
+            # restart-equivalence invariant.
+            v_leaf, message = allocation.map_physical_cell_to_virtual(
+                p_leaf, vccl, preassigned_level, priority
+            )
+        if (
+            v_leaf is not None
+            and anchor is not None
+            and not s.pinned_cell_id
+            and v_leaf.preassigned_cell.physical_cell is None
+            and not in_free_cell_list(anchor)
+        ):
+            # The mapping found a virtual cell but the physical anchor is
+            # not claimable (e.g. a foreign REAL allocation splits it —
+            # possible after overlapped safety violations). Fail the
+            # mapping instead of crashing the replay mid-mutation.
+            return None, (
+                f"physical cell {anchor.address} is not a free cell "
+                "(split or allocated elsewhere)"
+            )
+        return v_leaf, message
 
     def _evict_doomed_binding_for_vc(
         self, vcn: api.VirtualClusterName, chain: CellChain, level: CellLevel
@@ -1831,19 +2337,21 @@ class HivedCore:
         target = allocation.get_unbound_virtual_cell(preassigned[chain][level])
         if target is None:
             return False
+        eligible = [
+            c
+            for c in self.bad_free_cells[chain][level]
+            # Bad-free cells are unbound by construction (dooming
+            # removes the cell from this list); the binding check is
+            # defensive — clobbering an existing binding would corrupt
+            # both VCs' doomed accounting.
+            if c.virtual_cell is None  # type: ignore[union-attr]
+            and not cell_equal(c, evicted)
+            and (avoid is None or not _cells_overlap(c, avoid))
+        ]
+        pref = self.preferred_doomed.get((vcn, chain, level))
         candidate = next(
-            (
-                c
-                for c in self.bad_free_cells[chain][level]
-                # Bad-free cells are unbound by construction (dooming
-                # removes the cell from this list); the binding check is
-                # defensive — clobbering an existing binding would corrupt
-                # both VCs' doomed accounting.
-                if c.virtual_cell is None  # type: ignore[union-attr]
-                and not cell_equal(c, evicted)
-                and (avoid is None or not _cells_overlap(c, avoid))
-            ),
-            None,
+            (c for c in eligible if pref and c.address in pref),
+            eligible[0] if eligible else None,
         )
         if candidate is None:
             return False
@@ -1859,6 +2367,7 @@ class HivedCore:
         self.all_vc_doomed_bad_cell_num[chain][level] = (
             self.all_vc_doomed_bad_cell_num[chain].get(level, 0) + 1
         )
+        self.doomed_epoch += 1
         self._allocate_preassigned_cell(candidate, vcn, True)
         return True
 
@@ -1972,6 +2481,7 @@ class HivedCore:
                     self.all_vc_doomed_bad_cell_num[
                         preassigned_physical.chain
                     ][preassigned_physical.level] -= 1
+                    self.doomed_epoch += 1
                     self._release_preassigned_cell(
                         preassigned_physical, vcn, False
                     )

@@ -33,6 +33,11 @@ class Config:
     force_pod_bind_threshold: int = 3
     # FIFO-vs-throughput knob (reference: api/config.go:71-77, default 0).
     waiting_pod_scheduling_block_ms: int = 0
+    # Per-request deadline budget for the extender handlers (no reference
+    # analog): caps the RetryingKubeClient backoff schedule so a stuck bind
+    # cannot hold an HTTP worker for the full retry budget
+    # (doc/fault-model.md). 0 disables the cap.
+    request_deadline_seconds: float = 30.0
     physical_cluster: api.PhysicalClusterSpec = field(
         default_factory=api.PhysicalClusterSpec
     )
@@ -44,6 +49,7 @@ class Config:
     def from_dict(d: dict) -> "Config":
         fpbt = d.get("forcePodBindThreshold")
         wait_ms = d.get("waitingPodSchedulingBlockMilliSec")
+        deadline_s = d.get("requestDeadlineSeconds")
         c = Config(
             kube_apiserver_address=d.get("kubeApiServerAddress"),
             kube_config_file_path=d.get("kubeConfigFilePath"),
@@ -52,6 +58,9 @@ class Config:
             # pointer-nil defaulting, api/config.go:100-102).
             force_pod_bind_threshold=3 if fpbt is None else int(fpbt),
             waiting_pod_scheduling_block_ms=0 if wait_ms is None else int(wait_ms),
+            request_deadline_seconds=(
+                30.0 if deadline_s is None else float(deadline_s)
+            ),
             physical_cluster=api.PhysicalClusterSpec.from_dict(
                 d.get("physicalCluster")
             ),

@@ -35,6 +35,21 @@ ANNOTATION_POD_BIND_INFO = GROUP_NAME + "/pod-bind-info"
 # works out of the box. See tpu/env.py.
 ANNOTATION_POD_TPU_ENV = GROUP_NAME + "/pod-tpu-env"
 
+# Written when a pod's affinity group starts preempting (no reference
+# analog): the reserved placement in PodBindInfo YAML format, patched onto
+# the (still unbound) preemptor pod so a scheduler restart can replay the
+# Reserving/Reserved reservation instead of losing it. Cleared when the
+# preemption completes or is cancelled; superseded by the bind-info
+# annotation once the pod binds (doc/fault-model.md "Preemption plane").
+ANNOTATION_POD_PREEMPT_INFO = GROUP_NAME + "/pod-preempt-info"
+
+# The scheduler-owned ConfigMap persisting the advisory doomed-bad-cell
+# ledger (which bad cell each VC's unsatisfiable quota is pinned to), so a
+# restart reconstructs the same advisory bindings instead of re-deriving
+# arbitrary ones (doc/fault-model.md "Reconfiguration plane").
+DOOMED_LEDGER_CONFIG_MAP_NAME = "hivedscheduler-doomed-ledger"
+DOOMED_LEDGER_CONFIG_MAP_KEY = "ledger"
+
 # Priority space (reference: api/constants.go:58-62).
 MAX_GUARANTEED_PRIORITY = 1000
 MIN_GUARANTEED_PRIORITY = 0
@@ -51,6 +66,9 @@ PREEMPT_PATH = EXTENDER_PATH + "/preempt"
 
 INSPECT_PATH = VERSION_PATH + "/inspect"
 AFFINITY_GROUPS_PATH = INSPECT_PATH + "/affinitygroups/"
+# The live advisory doomed-bad ledger plus its persistence epochs (what is
+# in memory vs what has landed in the ConfigMap).
+DOOMED_LEDGER_PATH = INSPECT_PATH + "/doomedledger"
 CLUSTER_STATUS_PATH = INSPECT_PATH + "/clusterstatus"
 PHYSICAL_CLUSTER_PATH = CLUSTER_STATUS_PATH + "/physicalcluster"
 VIRTUAL_CLUSTERS_PATH = CLUSTER_STATUS_PATH + "/virtualclusters/"

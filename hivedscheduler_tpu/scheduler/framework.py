@@ -34,6 +34,7 @@ from .types import (
     QuarantineRecord,
     SchedulingPhase,
     extract_pod_scheduling_spec,
+    has_pod_preempt_info,
     is_allocated_state,
     is_bound,
     is_interested,
@@ -54,6 +55,26 @@ class KubeClient:
         """Write the binding (target node + annotations) to the cluster
         (reference: internal/utils.go:291-314 ``BindPod``)."""
         raise NotImplementedError
+
+    # Optional capabilities below: default no-ops so simulations and fakes
+    # that only care about binds keep working unchanged. Production
+    # (KubeAPIClient) implements all three; RetryingKubeClient wraps them
+    # with the same backoff policy as binds.
+
+    def patch_pod_annotations(
+        self, pod: Pod, annotations: Dict[str, Optional[str]]
+    ) -> None:
+        """Merge-patch annotations onto a live pod (None value = remove).
+        Used to checkpoint preemption reservations onto preemptor pods
+        (doc/fault-model.md "Preemption plane")."""
+
+    def persist_scheduler_state(self, payload: str) -> None:
+        """Write the scheduler-owned state blob (the doomed ledger) to its
+        ConfigMap."""
+
+    def load_scheduler_state(self) -> Optional[str]:
+        """Read the scheduler-owned state blob; None when absent."""
+        return None
 
 
 class NullKubeClient(KubeClient):
@@ -92,6 +113,15 @@ class SchedulerMetrics:
         self.bind_give_up_count = 0
         self.bind_terminal_count = 0
         self.quarantine_count = 0
+        # Preempt/reconfig-plane counters: retry rounds cut short by the
+        # per-request deadline budget, doomed-ledger ConfigMap writes (and
+        # writes that exhausted their retries), and preemption recoveries
+        # (replayed vs cancelled) at restart.
+        self.request_deadline_exceeded_count = 0
+        self.ledger_persist_count = 0
+        self.ledger_persist_failure_count = 0
+        self.preemption_recovered_count = 0
+        self.preemption_cancelled_on_recovery_count = 0
         # Framework-side phases (same accumulator/formatter as the core's
         # leaf-cell-search stats, so the merged "phases" payload is uniform).
         self.phase_stats = PhaseStats()
@@ -138,6 +168,24 @@ class SchedulerMetrics:
         with self._lock:
             self.quarantine_count += 1
 
+    def observe_deadline_exceeded(self) -> None:
+        with self._lock:
+            self.request_deadline_exceeded_count += 1
+
+    def observe_ledger_persist(self, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self.ledger_persist_count += 1
+            else:
+                self.ledger_persist_failure_count += 1
+
+    def observe_preemption_recovery(self, recovered: bool) -> None:
+        with self._lock:
+            if recovered:
+                self.preemption_recovered_count += 1
+            else:
+                self.preemption_cancelled_on_recovery_count += 1
+
     def snapshot(self) -> Dict:
         with self._lock:
             lat = sorted(self.filter_latencies_s)
@@ -160,6 +208,17 @@ class SchedulerMetrics:
                 "bindGiveUpCount": self.bind_give_up_count,
                 "bindTerminalFailureCount": self.bind_terminal_count,
                 "quarantineCount": self.quarantine_count,
+                "requestDeadlineExceededCount": (
+                    self.request_deadline_exceeded_count
+                ),
+                "doomedLedgerPersistCount": self.ledger_persist_count,
+                "doomedLedgerPersistFailureCount": (
+                    self.ledger_persist_failure_count
+                ),
+                "preemptionRecoveredCount": self.preemption_recovered_count,
+                "preemptionCancelledOnRecoveryCount": (
+                    self.preemption_cancelled_on_recovery_count
+                ),
                 "phases": self.phase_stats.snapshot(),
             }
 
@@ -207,10 +266,106 @@ class HivedScheduler:
             # Standalone/simulation mode has no recovery phase.
             self._ready.set()
         self._spawn = force_bind_executor or self._default_executor
+        # Preempt/reconfig fault plane (doc/fault-model.md): deferred kube
+        # side effects collected under the lock and flushed when the
+        # OUTERMOST mutator exits (network writes never run under the
+        # scheduler lock). _mutation_depth is per-thread because mutators
+        # nest (update_pod -> delete_pod+add_pod, recover -> everything).
+        self._mutation_depth = threading.local()
+        self._pending_annotation_clears: List[Pod] = []
+        self._persisted_doomed_epoch = -1
+        self._ledger_write_lock = threading.Lock()
+        self.core.preemption_observer = self._on_preemption_event
 
     @staticmethod
     def _default_executor(fn: Callable[[], None]) -> None:
         threading.Thread(target=fn, daemon=True).start()
+
+    # ------------------------------------------------------------------ #
+    # Deferred kube side effects (preempt/reconfig fault plane)
+    # ------------------------------------------------------------------ #
+
+    def _enter_mutation(self) -> None:
+        self._mutation_depth.d = getattr(self._mutation_depth, "d", 0) + 1
+
+    def _exit_mutation(self) -> None:
+        self._mutation_depth.d -= 1
+        if self._mutation_depth.d == 0:
+            self._flush_side_effects()
+
+    def _on_preemption_event(self, group, event: str) -> None:
+        """Core observer (called under the scheduler lock): a preempting
+        group completed or was cancelled — its pods' preempt-info
+        annotations are stale; clear them once the lock is released."""
+        self._pending_annotation_clears.extend(group.preempting_pods.values())
+
+    def _flush_side_effects(self) -> None:
+        """Run the kube writes collected during the mutation that just
+        ended: preempt-info annotation clears and the doomed-ledger
+        ConfigMap. Both are ADVISORY (recovery fidelity, not correctness of
+        the live view), so failures log and count — never raise into the
+        scheduling path."""
+        self._flush_annotation_clears()
+        self._persist_doomed_ledger()
+
+    def _flush_annotation_clears(self) -> None:
+        with self._lock:
+            clears, self._pending_annotation_clears = (
+                self._pending_annotation_clears, []
+            )
+        for pod in clears:
+            try:
+                self.kube_client.patch_pod_annotations(
+                    pod, {constants.ANNOTATION_POD_PREEMPT_INFO: None}
+                )
+            except Exception as e:  # noqa: BLE001
+                common.log.warning(
+                    "[%s]: clearing stale preempt-info annotation failed "
+                    "(recovery tolerates stale annotations): %s", pod.key, e,
+                )
+
+    def _persist_doomed_ledger(self) -> None:
+        """Write the advisory doomed-bad ledger to its scheduler-owned
+        ConfigMap when it changed since the last successful write. The
+        write runs outside the scheduler lock; _ledger_write_lock serializes
+        concurrent flushes so snapshots cannot land out of order."""
+        # Fast path BEFORE the write lock: a mutator that changed nothing
+        # doomed (the overwhelmingly common case — every filter call ends
+        # here) must not block behind another thread's in-flight ConfigMap
+        # write. Benign race: a stale read just means the next flush (or
+        # the in-flight writer's re-snapshot) picks the change up.
+        with self._lock:
+            if self.core.doomed_epoch == self._persisted_doomed_epoch:
+                return
+        with self._ledger_write_lock:
+            with self._lock:
+                epoch = self.core.doomed_epoch
+                if epoch == self._persisted_doomed_epoch:
+                    return
+                snapshot = self.core.doomed_ledger_snapshot()
+            try:
+                self.kube_client.persist_scheduler_state(
+                    common.to_json(snapshot)
+                )
+            except Exception as e:  # noqa: BLE001
+                self.metrics.observe_ledger_persist(False)
+                common.log.warning(
+                    "doomed-ledger ConfigMap write failed (epoch %d; a "
+                    "restart before the next successful write recovers "
+                    "with a stale ledger): %s", epoch, e,
+                )
+                return
+            self.metrics.observe_ledger_persist(True)
+            self._persisted_doomed_epoch = epoch
+
+    def get_doomed_ledger(self) -> Dict:
+        """Inspect payload for /v1/inspect/doomedledger: the live advisory
+        doomed-bad bindings plus the persistence epochs (live vs last
+        successfully written)."""
+        with self._lock:
+            snap = self.core.doomed_ledger_snapshot()
+            snap["persistedEpoch"] = self._persisted_doomed_epoch
+        return snap
 
     # ------------------------------------------------------------------ #
     # Recovery (reference: scheduler.go:196-216 Run)
@@ -219,23 +374,128 @@ class HivedScheduler:
     def recover(self, nodes: Iterable[Node], pods: Iterable[Pod]) -> None:
         """Replay the current cluster state before serving requests: every
         bound hived pod re-enters via add_pod -> add_bound_pod ->
-        AddAllocatedPod, rebuilding all cell state from annotations.
+        AddAllocatedPod, rebuilding all cell state from annotations; then
+        preempting affinity groups are replayed from the preempt-info
+        annotations their (unbound) preemptor pods carry, re-reserving
+        cells whose victims are still alive and cancelling reservations
+        that are no longer replayable.
+
+        The persisted doomed ledger is loaded FIRST and installed as the
+        core's doomed-cell preference map, so the advisory doomed-bad
+        bindings reconstruct onto the same cells the pre-crash scheduler
+        chose (doc/fault-model.md "Reconfiguration plane").
 
         Fault contract: one unreplayable pod must not abort recovery —
         add_pod quarantines bound pods whose annotations cannot be replayed
         (see _add_bound_pod); anything else escaping is caught here so the
         remaining pods still recover. Readiness (/readyz) flips only after
         the full replay."""
-        for node in nodes:
-            self.add_node(node)
-        for pod in pods:
-            if not is_interested(pod):
-                continue
+        pod_list = list(pods)
+        ledger_payload = None
+        try:
+            ledger_payload = self.kube_client.load_scheduler_state()
+        except Exception as e:  # noqa: BLE001
+            common.log.warning(
+                "doomed-ledger ConfigMap read failed; recovering without "
+                "it (advisory dooms re-derive arbitrarily): %s", e,
+            )
+        self.begin_recovery(ledger_payload)
+        try:
+            for node in nodes:
+                self.add_node(node)
+            for pod in pod_list:
+                if not is_interested(pod):
+                    continue
+                try:
+                    self.add_pod(pod)
+                except Exception as e:  # noqa: BLE001
+                    self._quarantine_pod(pod, e)
+        except BaseException:
+            self._abort_recovery()
+            raise
+        self.finish_recovery(pod_list)
+
+    def begin_recovery(self, ledger_payload: Optional[str]) -> None:
+        """Phase 1 of recovery, before the node/pod replay: install the
+        persisted doomed ledger (authoritative when present — organic doom
+        churn suspends and the doomed set rebuilds to exactly the ledger)
+        and suspend side-effect flushes until finish_recovery. Paired with
+        finish_recovery; the InformerLoop boot path brackets its initial
+        relists with the two so it recovers identically to recover()."""
+        self._enter_mutation()
+        ledger = None
+        if ledger_payload:
             try:
-                self.add_pod(pod)
+                ledger = common.from_yaml(ledger_payload) or None
             except Exception as e:  # noqa: BLE001
-                self._quarantine_pod(pod, e)
-        self.mark_ready()
+                common.log.warning(
+                    "doomed-ledger payload undecodable; recovering without "
+                    "it: %s", e,
+                )
+        self.core.set_preferred_doomed(ledger)
+        # The constructor's all-nodes-bad bootstrap already bound advisory
+        # dooms to arbitrary cells; rebuild the doomed set to exactly the
+        # ledger's before any health or pod replay.
+        self.core.rebuild_doomed_from_ledger()
+
+    def finish_recovery(self, pods: List[Pod]) -> None:
+        """Phase 2 of recovery, after the bound-pod replay: replay
+        preempting groups from preempt-info annotations, drop the ledger
+        preferences (steady-state doom choices must not keep preferring
+        the pre-crash layout), flip readiness, and flush the recovered
+        ledger to the ConfigMap (the recovered state is now canonical)."""
+        try:
+            self._recover_preempting_pods(pods)
+        finally:
+            self.core.clear_preferred_doomed()
+            self.mark_ready()
+            self._exit_mutation()
+
+    def _abort_recovery(self) -> None:
+        """The replay between begin_recovery and finish_recovery raised:
+        drop the ledger preferences and re-enable side-effect flushes
+        WITHOUT flipping readiness or persisting anything — the caller
+        propagates the failure (and the process restarts), exactly the
+        pre-recovery contract."""
+        self.core.clear_preferred_doomed()
+        # Bare depth decrement, not _exit_mutation: a half-replayed state
+        # must not overwrite the ConfigMap ledger.
+        self._mutation_depth.d -= 1
+
+    def _recover_preempting_pods(self, pods: List[Pod]) -> None:
+        """The Reserving/Reserved half of recovery: replay preempting
+        affinity groups from preempt-info annotations on unbound pods.
+        Bound pods are already replayed (their bind info supersedes any
+        stale preempt info). A reservation that cannot be replayed is
+        cancelled and its annotation cleared — the pod re-schedules fresh."""
+        for pod in pods:
+            if not is_interested(pod) or is_bound(pod):
+                continue
+            if not has_pod_preempt_info(pod):
+                continue
+            with self._lock:
+                try:
+                    recovered, reason = (
+                        self.core.recover_preempting_affinity_group(pod)
+                    )
+                except Exception as e:  # noqa: BLE001
+                    common.log.error(
+                        "[%s]: preemption recovery raised; canceling the "
+                        "reservation: %s", pod.key, e,
+                    )
+                    recovered, reason = False, str(e)
+                if recovered:
+                    self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                        pod=pod, pod_state=PodState.PREEMPTING
+                    )
+                    self.metrics.observe_preemption_recovery(True)
+                else:
+                    common.log.warning(
+                        "[%s]: preemption not recovered (%s); clearing its "
+                        "preempt-info annotation", pod.key, reason,
+                    )
+                    self.metrics.observe_preemption_recovery(False)
+                    self._pending_annotation_clears.append(pod)
 
     def mark_ready(self) -> None:
         """Recovery (initial list replay) complete: /readyz turns 200."""
@@ -278,19 +538,31 @@ class HivedScheduler:
     # ------------------------------------------------------------------ #
 
     def add_node(self, node: Node) -> None:
-        with self._lock:
-            self.nodes[node.name] = node
-            self.core.add_node(node)
+        self._enter_mutation()
+        try:
+            with self._lock:
+                self.nodes[node.name] = node
+                self.core.add_node(node)
+        finally:
+            self._exit_mutation()
 
     def update_node(self, old: Node, new: Node) -> None:
-        with self._lock:
-            self.nodes[new.name] = new
-            self.core.update_node(old, new)
+        self._enter_mutation()
+        try:
+            with self._lock:
+                self.nodes[new.name] = new
+                self.core.update_node(old, new)
+        finally:
+            self._exit_mutation()
 
     def delete_node(self, node: Node) -> None:
-        with self._lock:
-            self.nodes.pop(node.name, None)
-            self.core.delete_node(node)
+        self._enter_mutation()
+        try:
+            with self._lock:
+                self.nodes.pop(node.name, None)
+                self.core.delete_node(node)
+        finally:
+            self._exit_mutation()
 
     # ------------------------------------------------------------------ #
     # Pod events (reference: scheduler.go:253-360)
@@ -299,12 +571,23 @@ class HivedScheduler:
     def add_pod(self, pod: Pod) -> None:
         if not is_interested(pod):
             return
-        if is_bound(pod):
-            self._add_bound_pod(pod)
-        else:
-            self._add_unbound_pod(pod)
+        self._enter_mutation()
+        try:
+            if is_bound(pod):
+                self._add_bound_pod(pod)
+            else:
+                self._add_unbound_pod(pod)
+        finally:
+            self._exit_mutation()
 
     def update_pod(self, old: Pod, new: Pod) -> None:
+        self._enter_mutation()
+        try:
+            self._update_pod(old, new)
+        finally:
+            self._exit_mutation()
+
+    def _update_pod(self, old: Pod, new: Pod) -> None:
         # An informer may deliver an Update with UID changed when a delete is
         # immediately followed by a create (reference: scheduler.go:265-271).
         if old.uid != new.uid:
@@ -343,6 +626,13 @@ class HivedScheduler:
             self.add_pod(new)
 
     def delete_pod(self, pod: Pod) -> None:
+        self._enter_mutation()
+        try:
+            self._delete_pod(pod)
+        finally:
+            self._exit_mutation()
+
+    def _delete_pod(self, pod: Pod) -> None:
         with self._lock:
             # A quarantined pod holds no cell state; just drop the record.
             self.quarantined_pods.pop(pod.uid, None)
@@ -499,6 +789,13 @@ class HivedScheduler:
     # ------------------------------------------------------------------ #
 
     def filter_routine(self, args: ei.ExtenderArgs) -> ei.ExtenderFilterResult:
+        self._enter_mutation()
+        try:
+            return self._filter_routine(args)
+        finally:
+            self._exit_mutation()
+
+    def _filter_routine(self, args: ei.ExtenderArgs) -> ei.ExtenderFilterResult:
         start = time.monotonic()
         pod = args.pod
         # Outside the lock: everything that is a pure function of the request
@@ -660,23 +957,86 @@ class HivedScheduler:
         that was never bound. Release it; if the pod still exists unbound,
         the default scheduler re-filters it and it is re-admitted cleanly
         (called by RetryingKubeClient, outside the scheduler lock)."""
-        with self._lock:
-            status = self.pod_schedule_statuses.get(binding_pod.uid)
-            if status is None or status.pod_state != PodState.BINDING:
-                # Never allocated, or already confirmed Bound (the informer
-                # owns the lifecycle from there).
-                return
-            common.log.error(
-                "[%s]: releasing allocation after terminal bind failure "
-                "(node %s)", binding_pod.key, binding_pod.node_name,
-            )
-            self.delete_pod(status.pod)
+        self._enter_mutation()
+        try:
+            with self._lock:
+                status = self.pod_schedule_statuses.get(binding_pod.uid)
+                if status is None or status.pod_state != PodState.BINDING:
+                    # Never allocated, or already confirmed Bound (the
+                    # informer owns the lifecycle from there).
+                    return
+                common.log.error(
+                    "[%s]: releasing allocation after terminal bind failure "
+                    "(node %s)", binding_pod.key, binding_pod.node_name,
+                )
+                self._delete_pod(status.pod)
+        finally:
+            self._exit_mutation()
 
     # ------------------------------------------------------------------ #
     # Preempt (reference: scheduler.go:629-721)
     # ------------------------------------------------------------------ #
 
     def preempt_routine(
+        self, args: ei.ExtenderPreemptionArgs
+    ) -> ei.ExtenderPreemptionResult:
+        self._enter_mutation()
+        try:
+            with self._lock:
+                result = self._preempt_locked(args)
+                patch = self._preempt_annotation_patch(args.pod)
+            if patch is not None:
+                # Checkpoint the reservation onto the preemptor pod OUTSIDE
+                # the lock (it is a kube write): a crash between the
+                # reservation and this patch simply loses the reservation —
+                # exactly the pre-PR behavior — while a crash after it
+                # recovers the Reserving/Reserved state. Advisory, so a
+                # failed patch only logs.
+                pod, value = patch
+                try:
+                    self.kube_client.patch_pod_annotations(
+                        pod, {constants.ANNOTATION_POD_PREEMPT_INFO: value}
+                    )
+                    pod.annotations[
+                        constants.ANNOTATION_POD_PREEMPT_INFO
+                    ] = value
+                except Exception as e:  # noqa: BLE001
+                    common.log.warning(
+                        "[%s]: preempt-info checkpoint patch failed (the "
+                        "reservation will not survive a crash): %s",
+                        pod.key, e,
+                    )
+            return result
+        finally:
+            self._exit_mutation()
+
+    def _preempt_annotation_patch(self, pod: Pod):
+        """Under the lock: decide whether the pod needs its preempt-info
+        annotation (re)written — it is PREEMPTING and its group's current
+        reservation differs from what the pod already carries."""
+        status = self.pod_schedule_statuses.get(pod.uid)
+        if status is None or status.pod_state != PodState.PREEMPTING:
+            return None
+        try:
+            s = extract_pod_scheduling_spec(pod)
+            payload = self.core.get_preempt_info_payload(s.affinity_group.name)
+        except api.WebServerError:
+            return None
+        if payload is None:
+            return None
+        # The pod's checkpoint is being (re)affirmed: drop any clear a
+        # cancellation queued for it earlier in THIS round (core.schedule
+        # cancels a stale reservation and immediately recreates it in one
+        # call) — the exit-time flush must not erase a live checkpoint.
+        self._pending_annotation_clears = [
+            p for p in self._pending_annotation_clears if p.uid != pod.uid
+        ]
+        value = common.to_json(payload)
+        if pod.annotations.get(constants.ANNOTATION_POD_PREEMPT_INFO) == value:
+            return None
+        return status.pod, value
+
+    def _preempt_locked(
         self, args: ei.ExtenderPreemptionArgs
     ) -> ei.ExtenderPreemptionResult:
         with self._lock:

@@ -30,6 +30,38 @@ from .types import Node, Pod, is_interested
 SA_TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"  # noqa: S105
 SA_CA_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
 
+# In-cluster namespace (for the scheduler-owned state ConfigMap).
+SA_NAMESPACE_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/namespace"
+
+
+# ---------------------------------------------------------------------------
+# Per-request deadline budget (doc/fault-model.md, ROADMAP "webserver request
+# timeouts"): the webserver arms a thread-local deadline around each extender
+# request; RetryingKubeClient refuses to start a backoff sleep that would
+# cross it, so a stuck bind cannot hold an HTTP worker for the full retry
+# schedule. Thread-local because extender handlers run one request per
+# thread (ThreadingHTTPServer) and the kube write happens on that thread.
+# ---------------------------------------------------------------------------
+
+_REQUEST_DEADLINE = threading.local()
+
+
+def set_request_deadline(budget_s: float) -> None:
+    """Arm the calling thread's deadline ``budget_s`` seconds from now."""
+    _REQUEST_DEADLINE.at = time.monotonic() + budget_s
+
+
+def clear_request_deadline() -> None:
+    _REQUEST_DEADLINE.at = None
+
+
+def request_deadline_remaining() -> Optional[float]:
+    """Seconds until the calling thread's deadline; None when unarmed."""
+    at = getattr(_REQUEST_DEADLINE, "at", None)
+    if at is None:
+        return None
+    return at - time.monotonic()
+
 
 class KubeAPIError(Exception):
     """An apiserver request that completed with an HTTP error status.
@@ -60,9 +92,13 @@ def is_already_bound_conflict(e: Exception, node: str) -> bool:
     if not (isinstance(e, KubeAPIError) and e.status == 409):
         return False
     body = e.body or ""
+    # Match the QUOTED node name: apiserver messages quote it ('already
+    # assigned to node "node-1"'), and a raw substring check would accept a
+    # conflict for a different node whose name merely contains ours
+    # (node-1 vs node-10) — silently keeping a stale allocation.
     return (
         ("already assigned" in body or "already bound" in body)
-        and node in body
+        and f'"{node}"' in body
     )
 
 
@@ -161,10 +197,17 @@ class RetryingKubeClient(KubeClient):
                         "up this round: %s", binding_pod.key, attempt, e,
                     )
                     raise
+                delay = self._next_retry_delay(
+                    backoff, f"[{binding_pod.key}]: bind", e
+                )
+                if delay is None:
+                    # Sleeping would cross the HTTP request's deadline: give
+                    # up THIS round early (allocation kept, same as retry
+                    # exhaustion — the next filter insists and force-bind
+                    # retries the write) so the worker thread is freed.
+                    raise
                 if self.metrics is not None:
                     self.metrics.observe_bind_retry()
-                delay = min(backoff, self.backoff_max_s)
-                delay *= 1.0 + self.JITTER_FRACTION * self._rng.random()
                 common.log.warning(
                     "[%s]: transient bind failure (attempt %d/%d), retrying "
                     "in %.2fs: %s", binding_pod.key, attempt,
@@ -172,6 +215,70 @@ class RetryingKubeClient(KubeClient):
                 )
                 self._sleep(delay)
                 backoff = min(backoff * 2, self.backoff_max_s)
+
+    def _next_retry_delay(
+        self, backoff: float, context: str, error: Exception
+    ) -> Optional[float]:
+        """The shared retry-scheduling policy: the next jittered delay, or
+        None when sleeping that long would cross the calling thread's armed
+        request deadline (counted in requestDeadlineExceededCount; the
+        caller gives up its round early)."""
+        delay = min(backoff, self.backoff_max_s)
+        delay *= 1.0 + self.JITTER_FRACTION * self._rng.random()
+        remaining = request_deadline_remaining()
+        if remaining is not None and remaining < delay:
+            if self.metrics is not None:
+                self.metrics.observe_deadline_exceeded()
+            common.log.error(
+                "%s: giving up retries early: next backoff (%.2fs) would "
+                "exceed the request deadline (%.2fs left): %s",
+                context, delay, max(remaining, 0.0), error,
+            )
+            return None
+        return delay
+
+    def _retrying_op(self, describe: str, attempt_fn: Callable):
+        """The bind retry policy for the auxiliary kube operations
+        (annotation patches, scheduler-state ConfigMap reads/writes):
+        transient errors back off and retry, terminal errors raise
+        immediately, and an armed request deadline caps the total budget.
+        Returns attempt_fn()'s value."""
+        backoff = self.backoff_initial_s
+        for attempt in range(1, self.max_attempts + 1):
+            try:
+                return attempt_fn()
+            except Exception as e:  # noqa: BLE001
+                if not is_retryable_kube_error(e) or attempt == self.max_attempts:
+                    raise
+                delay = self._next_retry_delay(backoff, describe, e)
+                if delay is None:
+                    raise
+                common.log.warning(
+                    "%s: transient failure (attempt %d/%d), retrying in "
+                    "%.2fs: %s", describe, attempt, self.max_attempts,
+                    delay, e,
+                )
+                self._sleep(delay)
+                backoff = min(backoff * 2, self.backoff_max_s)
+
+    def patch_pod_annotations(self, pod, annotations) -> None:
+        self._retrying_op(
+            f"[{pod.key}]: annotation patch",
+            lambda: self.inner.patch_pod_annotations(pod, annotations),
+        )
+
+    def persist_scheduler_state(self, payload: str) -> None:
+        self._retrying_op(
+            "scheduler-state ConfigMap write",
+            lambda: self.inner.persist_scheduler_state(payload),
+        )
+
+    def load_scheduler_state(self) -> Optional[str]:
+        # Reads share the retry policy; a missing ConfigMap is None, not an
+        # error (first boot).
+        return self._retrying_op(
+            "scheduler-state ConfigMap read", self.inner.load_scheduler_state
+        )
 
 
 class KubeAPIClient(KubeClient):
@@ -225,7 +332,7 @@ class KubeAPIClient(KubeClient):
 
     def _request(
         self, method: str, path: str, body: Optional[dict] = None,
-        stream: bool = False,
+        stream: bool = False, content_type: str = "application/json",
     ):
         if (
             self._token_path
@@ -236,7 +343,7 @@ class KubeAPIClient(KubeClient):
             self.base_url + path,
             data=json.dumps(body).encode() if body is not None else None,
             method=method,
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": content_type},
         )
         if self._token:
             req.add_header("Authorization", f"Bearer {self._token}")
@@ -302,6 +409,64 @@ class KubeAPIClient(KubeClient):
             f"/api/v1/namespaces/{binding_pod.namespace}/pods/"
             f"{binding_pod.name}/binding",
             body,
+        )
+
+    def patch_pod_annotations(self, pod, annotations) -> None:
+        """Merge-patch annotations onto a live pod (None = remove the key).
+        Used to checkpoint the preemption reservation onto preemptor pods;
+        JSON merge-patch nulls delete map keys (RFC 7386), which is exactly
+        the clear semantics the cancel path needs."""
+        self._request(
+            "PATCH",
+            f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}",
+            {"metadata": {"annotations": dict(annotations)}},
+            content_type="application/merge-patch+json",
+        )
+
+    def _state_namespace(self) -> str:
+        ns = getattr(self, "_namespace", None)
+        if ns is None:
+            try:
+                with open(SA_NAMESPACE_PATH) as f:
+                    ns = f.read().strip() or "default"
+            except OSError:
+                ns = "default"
+            self._namespace = ns
+        return ns
+
+    def persist_scheduler_state(self, payload: str) -> None:
+        """Write the scheduler-owned state ConfigMap (the doomed ledger):
+        PUT replace, falling back to POST create on 404 (first boot)."""
+        ns = self._state_namespace()
+        name = constants.DOOMED_LEDGER_CONFIG_MAP_NAME
+        body = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": ns},
+            "data": {constants.DOOMED_LEDGER_CONFIG_MAP_KEY: payload},
+        }
+        try:
+            self._request(
+                "PUT", f"/api/v1/namespaces/{ns}/configmaps/{name}", body
+            )
+        except KubeAPIError as e:
+            if e.status != 404:
+                raise
+            self._request("POST", f"/api/v1/namespaces/{ns}/configmaps", body)
+
+    def load_scheduler_state(self) -> Optional[str]:
+        ns = self._state_namespace()
+        name = constants.DOOMED_LEDGER_CONFIG_MAP_NAME
+        try:
+            obj = self._request(
+                "GET", f"/api/v1/namespaces/{ns}/configmaps/{name}"
+            )
+        except KubeAPIError as e:
+            if e.status == 404:
+                return None
+            raise
+        return (obj.get("data") or {}).get(
+            constants.DOOMED_LEDGER_CONFIG_MAP_KEY
         )
 
     # ---------------- reads ---------------- #
@@ -376,11 +541,34 @@ class InformerLoop:
         self._stop = threading.Event()
 
     def start(self) -> None:
-        nodes_rv = self._relist_nodes()
-        pods_rv = self._relist_pods(initial=True)
-        # The initial lists ARE recovery: every bound pod replayed. Flip
-        # /readyz before serving watches (WaitForCacheSync ordering).
-        self.scheduler.mark_ready()
+        # The initial lists ARE recovery: bracket them with the framework's
+        # recovery phases so this path replays identically to recover() —
+        # the persisted doomed ledger loads first (authoritative doom
+        # reconstruction) and preempting groups replay from preempt-info
+        # annotations after the bound pods. finish_recovery flips /readyz
+        # before the watches start (WaitForCacheSync ordering).
+        ledger_payload = None
+        try:
+            # Through the scheduler's client (RetryingKubeClient in
+            # production), not the raw one: a transient apiserver blip at
+            # boot must not silently discard the persisted ledger.
+            ledger_payload = self.scheduler.kube_client.load_scheduler_state()
+        except Exception as e:  # noqa: BLE001
+            common.log.warning(
+                "doomed-ledger ConfigMap read failed; recovering without "
+                "it: %s", e,
+            )
+        self.scheduler.begin_recovery(ledger_payload)
+        try:
+            nodes_rv = self._relist_nodes()
+            pods_rv = self._relist_pods(initial=True)
+        except BaseException:
+            # Boot failed mid-replay: do not flip /readyz or persist a
+            # half-replayed ledger; the caller propagates and the process
+            # restarts (pre-PR contract).
+            self.scheduler._abort_recovery()
+            raise
+        self.scheduler.finish_recovery(list(self._known_pods.values()))
         for path, handler, relist, rv in (
             ("/api/v1/nodes", self._on_node_event, self._relist_nodes,
              nodes_rv),

@@ -196,18 +196,38 @@ def new_binding_pod(pod: Pod, bind_info: api.PodBindInfo) -> Pod:
     )
 
 
-def extract_pod_bind_info(allocated_pod: Pod) -> api.PodBindInfo:
-    """(reference: internal/utils.go:200-213; trusted input, assert-style)"""
-    annotation = allocated_pod.annotations.get(constants.ANNOTATION_POD_BIND_INFO, "")
+def _extract_bind_shaped_annotation(pod: Pod, key: str) -> api.PodBindInfo:
+    """Decode a PodBindInfo-shaped annotation. Cached parse: the
+    group-replay paths re-read the same annotation many times per
+    scheduling round; from_dict copies every field, so sharing the parsed
+    dict is safe."""
+    annotation = pod.annotations.get(key, "")
     if not annotation:
         raise api.bad_request(
-            f"Pod does not contain or contains empty annotation: "
-            f"{constants.ANNOTATION_POD_BIND_INFO}"
+            f"Pod does not contain or contains empty annotation: {key}"
         )
-    # Cached parse: the group-replay paths re-read the same annotation many
-    # times per scheduling round; from_dict copies every field, so sharing
-    # the parsed dict is safe.
     return api.PodBindInfo.from_dict(common.from_yaml_cached(annotation) or {})
+
+
+def extract_pod_bind_info(allocated_pod: Pod) -> api.PodBindInfo:
+    """(reference: internal/utils.go:200-213; trusted input, assert-style)"""
+    return _extract_bind_shaped_annotation(
+        allocated_pod, constants.ANNOTATION_POD_BIND_INFO
+    )
+
+
+def extract_pod_preempt_info(allocated_pod: Pod) -> api.PodBindInfo:
+    """Decode the reserved-placement annotation a preempting pod carries
+    (same PodBindInfo shape as the bind-info annotation; ``node`` and
+    ``leaf_cell_isolation`` are unused — the pod is not bound). Raises the
+    same user error as :func:`extract_pod_bind_info` when absent/corrupt."""
+    return _extract_bind_shaped_annotation(
+        allocated_pod, constants.ANNOTATION_POD_PREEMPT_INFO
+    )
+
+
+def has_pod_preempt_info(pod: Pod) -> bool:
+    return bool(pod.annotations.get(constants.ANNOTATION_POD_PREEMPT_INFO, ""))
 
 
 def extract_pod_scheduling_spec(pod: Pod) -> api.PodSchedulingSpec:

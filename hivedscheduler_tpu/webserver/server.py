@@ -20,6 +20,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from .. import common
 from ..api import constants, extender as ei, types as api
+from ..scheduler import kube as kube_mod
 from ..scheduler.framework import HivedScheduler
 
 # Latency metrics + the per-phase filter breakdown (lockWait / coreSchedule /
@@ -128,6 +129,14 @@ def _make_handler(scheduler: HivedScheduler):
         def do_POST(self) -> None:  # noqa: N802
             path = self.path.rstrip("/") or "/"
             body = self._drain_body()  # always, before any reply (keep-alive)
+            # Arm this worker thread's deadline budget: kube writes issued
+            # while serving the request (bind, preempt-info checkpoint)
+            # refuse backoff sleeps that would cross it, so a stuck
+            # apiserver cannot hold the worker for the full retry schedule
+            # (requestDeadlineExceededCount counts early give-ups).
+            budget = scheduler.config.request_deadline_seconds
+            if budget > 0:
+                kube_mod.set_request_deadline(budget)
             try:
                 if path == constants.FILTER_PATH:
                     args = ei.ExtenderArgs.from_dict(self._parse_json(body))
@@ -161,6 +170,8 @@ def _make_handler(scheduler: HivedScheduler):
                     raise api.not_found(f"Cannot found resource: {self.path}")
             except Exception as e:  # noqa: BLE001
                 self._reply_error(e)
+            finally:
+                kube_mod.clear_request_deadline()
 
         # -------------------------------------------------------------- #
         # Inspect API (reference: webserver.go:242-300)
@@ -188,6 +199,8 @@ def _make_handler(scheduler: HivedScheduler):
                 return {"status": "ready"}
             if path == constants.QUARANTINE_PATH:
                 return scheduler.get_quarantine()
+            if path == constants.DOOMED_LEDGER_PATH:
+                return scheduler.get_doomed_ledger()
             if path == agp or path == agp.rstrip("/"):
                 return scheduler.get_all_affinity_groups()
             if path.startswith(agp):

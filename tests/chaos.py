@@ -54,7 +54,12 @@ from hivedscheduler_tpu.algorithm.cell import (
     MIN_GUARANTEED_PRIORITY,
     PhysicalCell,
 )
-from hivedscheduler_tpu.algorithm.core import HivedCore, in_free_cell_list
+from hivedscheduler_tpu.algorithm.core import (
+    HivedCore,
+    collect_preemption_victims,
+    in_free_cell_list,
+)
+from hivedscheduler_tpu.algorithm.group import GroupState
 from hivedscheduler_tpu.api import constants, extender as ei, types as api
 from hivedscheduler_tpu.scheduler.framework import HivedScheduler, KubeClient
 from hivedscheduler_tpu.scheduler.kube import KubeAPIError, RetryingKubeClient
@@ -63,6 +68,7 @@ from hivedscheduler_tpu.scheduler.types import (
     Pod,
     PodState,
     SchedulingPhase,
+    extract_pod_scheduling_spec,
 )
 
 from .test_core import make_pod
@@ -88,11 +94,21 @@ def terminal_fault(status: int = 409) -> Exception:
 class ScriptedKubeClient(KubeClient):
     """Records binds like NullKubeClient, but fails per an injected fault
     script: each bind attempt pops one entry from the queue (None = succeed,
-    an exception = raise it). An empty queue always succeeds."""
+    an exception = raise it). An empty queue always succeeds.
+
+    Also plays the apiserver for the two auxiliary write paths the
+    preempt/reconfig fault plane added: the scheduler-state ConfigMap
+    (``state`` survives harness crash-restarts because the client object
+    does) and pod annotation patches (forwarded to ``on_patch`` so the
+    harness can fold them into its cluster truth)."""
 
     def __init__(self) -> None:
         self.bound: Dict[str, Pod] = {}
         self.fault_queue: deque = deque()
+        self.state: Optional[str] = None  # the doomed-ledger ConfigMap
+        self.state_writes = 0
+        self.on_patch = None  # callable(pod, patch) or None
+        self.patches: List[tuple] = []
 
     def bind_pod(self, binding_pod: Pod) -> None:
         if self.fault_queue:
@@ -100,6 +116,18 @@ class ScriptedKubeClient(KubeClient):
             if fault is not None:
                 raise fault
         self.bound[binding_pod.uid] = binding_pod
+
+    def persist_scheduler_state(self, payload: str) -> None:
+        self.state = payload
+        self.state_writes += 1
+
+    def load_scheduler_state(self) -> Optional[str]:
+        return self.state
+
+    def patch_pod_annotations(self, pod, annotations) -> None:
+        self.patches.append((pod.uid, dict(annotations)))
+        if self.on_patch is not None:
+            self.on_patch(pod, annotations)
 
 
 ###############################################################################
@@ -143,12 +171,28 @@ def audit_invariants(sched: HivedScheduler, ctx: str = "") -> None:
                         ctx, chain, "free lists overlap", leaf.address,
                     )
                     covered.add(leaf.address)
+                    # Invariant 5 (reservation conservation, half 1): no
+                    # cell is both in the free lists and Reserved/Reserving
+                    # — a reservation always allocates its preassigned cell
+                    # out of the free lists. A free-covered USED leaf is
+                    # legal only for opportunistic occupancy (that is how
+                    # preemption victims arise).
+                    assert leaf.state not in (
+                        CellState.RESERVING, CellState.RESERVED,
+                    ), (ctx, chain, "reserved cell in free list", leaf.address)
+                    if leaf.state == CellState.USED:
+                        assert leaf.priority < MIN_GUARANTEED_PRIORITY, (
+                            ctx, chain, "guaranteed allocation in free list",
+                            leaf.address, leaf.priority,
+                        )
         for l in range(LOWEST_LEVEL, top + 1):
             assert core.total_left_cell_num[chain].get(l, 0) == derived[l], (
                 ctx, chain, l, "totalLeft != cells derivable from free list",
                 core.total_left_cell_num[chain].get(l, 0), derived[l],
             )
         # --- invariant 1b: per-leaf state machine ------------------------- #
+        # --- + invariant 5 (reservation conservation, half 2): the leaf    #
+        #     reservation pointers and the Reserving/Reserved states agree  #
         for leaf in ccl[LOWEST_LEVEL]:
             assert isinstance(leaf, PhysicalCell)
             if leaf.state == CellState.USED:
@@ -162,6 +206,27 @@ def audit_invariants(sched: HivedScheduler, ctx: str = "") -> None:
                 assert leaf.priority == FREE_PRIORITY, (
                     ctx, leaf.address, leaf.priority,
                 )
+            reserved = leaf.state in (CellState.RESERVING, CellState.RESERVED)
+            assert reserved == (leaf.reserving_or_reserved_group is not None), (
+                ctx, leaf.address, leaf.state,
+                "reservation pointer and state disagree",
+            )
+            if leaf.state == CellState.RESERVED:
+                assert leaf.using_group is None, (ctx, leaf.address)
+            if leaf.state == CellState.RESERVING:
+                assert leaf.using_group is not None, (ctx, leaf.address)
+            if reserved:
+                g = leaf.reserving_or_reserved_group
+                assert g.state == GroupState.PREEMPTING, (
+                    ctx, leaf.address, g.name, g.state,
+                )
+                assert any(
+                    leaf is pl
+                    for rows in g.physical_placement.values()
+                    for row in rows
+                    for pl in row
+                ), (ctx, leaf.address, g.name,
+                    "reserved leaf not in its preemptor's placement")
         # --- bad-free entries are actually bad and actually free ---------- #
         for level in range(LOWEST_LEVEL, top + 1):
             for c in core.bad_free_cells[chain][level]:
@@ -207,6 +272,9 @@ def audit_invariants(sched: HivedScheduler, ctx: str = "") -> None:
             ), (ctx, chain, level, "vcFree sum != allVCFree")
 
     # --- allocated groups reference live, non-free cells ------------------ #
+    # --- + invariant 5 (reservation conservation, group side): a           #
+    #     PREEMPTING group's cells are exactly Reserving/Reserved and point #
+    #     back at it; a BeingPreempted group's cells are Used or Reserving  #
     for g in core.affinity_groups.values():
         for rows in g.physical_placement.values():
             for row in rows:
@@ -217,6 +285,17 @@ def audit_invariants(sched: HivedScheduler, ctx: str = "") -> None:
                     assert leaf.state != CellState.FREE, (
                         ctx, g.name, leaf.address,
                     )
+                    if g.state == GroupState.PREEMPTING:
+                        assert leaf.state in (
+                            CellState.RESERVING, CellState.RESERVED,
+                        ), (ctx, g.name, leaf.address, leaf.state)
+                        assert leaf.reserving_or_reserved_group is g, (
+                            ctx, g.name, leaf.address,
+                        )
+                    elif g.state == GroupState.BEING_PREEMPTED:
+                        assert leaf.state in (
+                            CellState.USED, CellState.RESERVING,
+                        ), (ctx, g.name, leaf.address, leaf.state)
 
 
 ###############################################################################
@@ -253,7 +332,10 @@ def counters_fingerprint(core: HivedCore) -> Dict:
             str(vcn): len(cells)
             for vcn, cells in sorted(core._ot_cells.items()) if cells
         },
-        "groups": sorted(core.affinity_groups),
+        "groups": sorted(
+            (name, g.state.value)
+            for name, g in core.affinity_groups.items()
+        ),
     }
 
 
@@ -267,6 +349,8 @@ def leaf_fingerprint(core: HivedCore) -> Dict[str, tuple]:
                 leaf.priority,
                 leaf.healthy,
                 leaf.using_group.name if leaf.using_group else None,
+                leaf.reserving_or_reserved_group.name
+                if leaf.reserving_or_reserved_group else None,
             )
     return out
 
@@ -321,11 +405,15 @@ def probe_outcomes(core: HivedCore, nodes: List[str], seed: int) -> List[tuple]:
             },
         )
         random.seed(seed * 1000 + i)
+        saved_rng = core.preempt_rng
+        core.preempt_rng = random.Random(seed * 1000 + i)
         try:
             r = core.schedule(pod, nodes, SchedulingPhase.FILTERING)
         except api.WebServerError:
             outs.append(("rejected",))
             continue
+        finally:
+            core.preempt_rng = saved_rng
         if r.pod_bind_info is not None:
             outs.append(("bind",))
         elif r.pod_preempt_info is not None:
@@ -345,19 +433,33 @@ class ChaosHarness:
     invariants after every event, performing at least one crash-restart, and
     finishing with the zero-leak teardown."""
 
+    # A PREEMPTING group must complete, cancel, or lose its victims within
+    # this many harness events, or the harness force-resolves it and
+    # asserts the resolution lands (invariant 6: preemption progress).
+    PREEMPT_PROGRESS_BOUND = 7
+
     def __init__(self, seed: int):
         self.seed = seed
         self.rnd = random.Random(seed)
-        # Global random is consumed by the core's victim-node pick; pin it
-        # so every schedule is reproducible from the seed alone.
+        # Global random is pinned for any residual consumer; the core's
+        # victim-node pick itself now takes the injectable preempt_rng.
         random.seed(seed ^ 0x5EED)
         self.kube = ScriptedKubeClient()
+        self.kube.on_patch = self._apply_annotation_patch
         self.retry_sleeps: List[float] = []
         # The apiserver truth: uid -> Pod as the cluster currently holds it.
         self.cluster_pods: Dict[str, Pod] = {}
         self.corrupted: Set[str] = set()
         self.gangs: Dict[str, List[str]] = {}  # gang name -> uids
         self.gang_seq = 0
+        # Active preemptions: gang name -> {"uids": preemptor pod uids,
+        # "since": event index} (invariant 6 tracks age; victims are read
+        # live off the core's group placement).
+        self.preemptions: Dict[str, Dict] = {}
+        self.event_i = 0
+        # Config state: reconfigure events swap the two VCs' quota between
+        # restarts (a legal mutation on any fleet this generator builds).
+        self.config_swapped = False
         # Coverage counters (the seed-set tests assert aggregate coverage).
         self.stats = {
             "restarts": 0,
@@ -369,6 +471,13 @@ class ChaosHarness:
             "relists": 0,
             "node_flips": 0,
             "binds": 0,
+            "preempts": 0,
+            "preempt_resolved": 0,
+            "preempt_cancelled": 0,
+            "preempt_restarts": 0,
+            "preempt_recovered": 0,
+            "preempt_cancelled_on_recovery": 0,
+            "reconfigs": 0,
         }
         self.scheduler = self._new_scheduler()
         self.node_health = {
@@ -382,7 +491,14 @@ class ChaosHarness:
     # ------------------------------------------------------------------ #
 
     def _config(self):
-        return random_config(random.Random(self.seed))
+        cfg = random_config(random.Random(self.seed))
+        if self.config_swapped:
+            # The reconfiguration mutation: VC A and VC B trade their whole
+            # quota assignment (total demand unchanged, so always legal).
+            cfg.virtual_clusters["A"], cfg.virtual_clusters["B"] = (
+                cfg.virtual_clusters["B"], cfg.virtual_clusters["A"],
+            )
+        return cfg
 
     def _new_scheduler(self) -> HivedScheduler:
         sched = HivedScheduler(
@@ -397,12 +513,75 @@ class ChaosHarness:
             sleep=self.retry_sleeps.append,  # recorded, never slept
             jitter_rng=random.Random(self.seed ^ 0xBEEF),
         )
+        # Victim-node picks are seeded so preemption schedules replay
+        # exactly per seed.
+        sched.core.preempt_rng = random.Random(self.seed ^ 0xF00D)
         return sched
+
+    def _apply_annotation_patch(self, pod: Pod, patch: Dict) -> None:
+        """Fold a scheduler-issued annotation patch into the apiserver
+        truth (merge semantics: None removes the key)."""
+        cur = self.cluster_pods.get(pod.uid)
+        if cur is None:
+            return  # patching a deleted pod: the apiserver would 404
+        annotations = dict(cur.annotations)
+        for k, v in patch.items():
+            if v is None:
+                annotations.pop(k, None)
+            else:
+                annotations[k] = v
+        self.cluster_pods[pod.uid] = Pod(
+            name=cur.name,
+            namespace=cur.namespace,
+            uid=cur.uid,
+            annotations=annotations,
+            node_name=cur.node_name,
+            phase=cur.phase,
+            resource_limits=dict(cur.resource_limits),
+        )
 
     def live_nodes(self) -> List[str]:
         return sorted(self.node_health)
 
     # ---------------- events ---------------- #
+
+    def _filter_and_bind(self, pod: Pod) -> str:
+        """Drive one pod through the production filter (+bind on success).
+        Returns "bound" / "pending" / "rejected"; a rejected pod is dropped
+        from the cluster truth (K8s would loop on it)."""
+        try:
+            result = self.scheduler.filter_routine(
+                ei.ExtenderArgs(pod=pod, node_names=self.live_nodes())
+            )
+        except api.WebServerError:
+            self.scheduler.delete_pod(pod)
+            self.cluster_pods.pop(pod.uid, None)
+            return "rejected"
+        if not result.node_names:
+            return "pending"  # waiting or preempt-hinted
+        try:
+            self.scheduler.bind_routine(
+                ei.ExtenderBindingArgs(
+                    pod_name=pod.name,
+                    pod_namespace=pod.namespace,
+                    pod_uid=pod.uid,
+                    node=result.node_names[0],
+                )
+            )
+        except Exception:  # noqa: BLE001
+            # Exhausted transient burst (allocation kept; the next filter
+            # insists) or terminal failure (allocation already released by
+            # handle_terminal_bind_failure).
+            return "pending"
+        bound = self.kube.bound.get(pod.uid)
+        if bound is None:
+            return "pending"
+        # The informer confirms the bind (MODIFIED with nodeName).
+        bound.phase = "Running"
+        self.scheduler.update_pod(pod, bound)
+        self.cluster_pods[pod.uid] = bound
+        self.stats["binds"] += 1
+        return "bound"
 
     def gang_create(self) -> None:
         self.gang_seq += 1
@@ -425,40 +604,8 @@ class ChaosHarness:
             self.cluster_pods[pod.uid] = pod
             uids.append(pod.uid)
             self.scheduler.add_pod(pod)
-            try:
-                result = self.scheduler.filter_routine(
-                    ei.ExtenderArgs(pod=pod, node_names=self.live_nodes())
-                )
-            except api.WebServerError:
-                # Rejected spec for this cluster (e.g. the VC has no such
-                # chip type): K8s would loop on it; drop it instead.
-                self.scheduler.delete_pod(pod)
-                del self.cluster_pods[pod.uid]
+            if self._filter_and_bind(pod) == "rejected":
                 uids.pop()
-                continue
-            if not result.node_names:
-                continue  # waiting or preempt-hinted; stays Pending
-            try:
-                self.scheduler.bind_routine(
-                    ei.ExtenderBindingArgs(
-                        pod_name=pod.name,
-                        pod_namespace=pod.namespace,
-                        pod_uid=pod.uid,
-                        node=result.node_names[0],
-                    )
-                )
-            except Exception:  # noqa: BLE001
-                # Exhausted transient burst (allocation kept; the next
-                # filter insists) or terminal failure (allocation already
-                # released by handle_terminal_bind_failure).
-                continue
-            bound = self.kube.bound.get(pod.uid)
-            if bound is not None:
-                # The informer confirms the bind (MODIFIED with nodeName).
-                bound.phase = "Running"
-                self.scheduler.update_pod(pod, bound)
-                self.cluster_pods[pod.uid] = bound
-                self.stats["binds"] += 1
         if uids:
             self.gangs[name] = uids
 
@@ -505,6 +652,175 @@ class ChaosHarness:
         self.scheduler.update_node(
             Node(name=node, ready=healthy), Node(name=node, ready=not healthy)
         )
+
+    # ---------------- preemption plane ---------------- #
+
+    def preempt_start(self) -> None:
+        """Create a high-priority gang and drive it through the production
+        preempt phase (filter -> preempt_routine): when the cluster is
+        occupied by lower-priority work a PREEMPTING group appears, its
+        cells go Reserving/Reserved, and the reservation is checkpointed
+        onto the preemptor pods via the preempt-info annotation."""
+        # Target occupied capacity: copy the VC + chip type of an existing
+        # bound gang (and out-prioritize it) so the placement actually has
+        # victims; a blind pick mostly lands on free cells and just binds.
+        vc = self.rnd.choice(["A", "B"])
+        leaf_type = self.rnd.choice(["v5e-chip", "v5e-chip", "v5p-chip"])
+        bound_pods = [
+            p for p in self.cluster_pods.values() if p.node_name
+        ]
+        if bound_pods:
+            target = self.rnd.choice(sorted(bound_pods, key=lambda p: p.uid))
+            try:
+                ts = extract_pod_scheduling_spec(target)
+                vc = self.rnd.choice([ts.virtual_cluster, vc])
+                leaf_type = ts.leaf_cell_type or leaf_type
+            except api.WebServerError:
+                pass
+        self.gang_seq += 1
+        name = f"g{self.seed}-{self.gang_seq}"
+        priority = self.rnd.choice([5, 9, 9])
+        n_pods = self.rnd.choice([1, 1, 2])
+        chips = self.rnd.choice([1, 2, 4])
+        group = {
+            "name": name,
+            "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
+        }
+        uids = []
+        for i in range(n_pods):
+            pod = make_pod(
+                f"{name}-{i}", f"u-{name}-{i}", vc, priority, leaf_type,
+                chips, group=group,
+            )
+            self.cluster_pods[pod.uid] = pod
+            uids.append(pod.uid)
+            self.scheduler.add_pod(pod)
+            outcome = self._filter_and_bind(pod)
+            if outcome == "rejected":
+                uids.pop()
+                continue
+            if outcome == "bound":
+                continue  # free resource: a plain gang after all
+            # Pending: the Preempting phase (the default scheduler found
+            # lower-priority victims on these nodes).
+            try:
+                self.scheduler.preempt_routine(
+                    ei.ExtenderPreemptionArgs(
+                        pod=pod,
+                        node_name_to_meta_victims={
+                            n: ei.MetaVictims() for n in self.live_nodes()
+                        },
+                    )
+                )
+            except api.WebServerError:
+                pass
+        g = self.scheduler.core.affinity_groups.get(name)
+        if g is not None and g.state == GroupState.PREEMPTING:
+            self.preemptions[name] = {"uids": uids, "since": self.event_i}
+            self.stats["preempts"] += 1
+        elif uids:
+            self.gangs[name] = uids
+
+    def _live_victims(self, name: str) -> List[str]:
+        """Victim pod uids a PREEMPTING group is still waiting on, read
+        live off its reservation."""
+        g = self.scheduler.core.affinity_groups.get(name)
+        if g is None or g.state != GroupState.PREEMPTING:
+            return []
+        victims, _ = collect_preemption_victims(g.physical_placement)
+        return sorted(
+            {v.uid for per_node in victims.values() for v in per_node.values()}
+        )
+
+    def _sync_preemptions(self) -> None:
+        """Reconcile the tracking map with the core: drop preemptions that
+        completed or cancelled (their surviving pods become plain gang
+        members for the later events)."""
+        for name in list(self.preemptions):
+            info = self.preemptions[name]
+            info["uids"] = [
+                u for u in info["uids"] if u in self.cluster_pods
+            ]
+            g = self.scheduler.core.affinity_groups.get(name)
+            if g is not None and g.state == GroupState.PREEMPTING:
+                continue
+            del self.preemptions[name]
+            if info["uids"]:
+                self.gangs[name] = info["uids"]
+
+    def preempt_victim_delete(self) -> None:
+        """Victim-deletion-mid-preempt: the kubelet killed one victim pod
+        (possibly in a watch gap). RESERVING cells whose last victim goes
+        become RESERVED."""
+        if not self.preemptions:
+            return
+        name = self.rnd.choice(sorted(self.preemptions))
+        victims = self._live_victims(name)
+        if not victims:
+            self.preempt_resolve(name)
+            return
+        self.delete_pods(
+            [self.rnd.choice(victims)], missed=self.rnd.random() < 0.3
+        )
+        self._sync_preemptions()
+
+    def preempt_resolve(self, name: Optional[str] = None) -> None:
+        """Finish a preemption: delete its remaining victims, then re-filter
+        the preemptor pods — with the victims gone the group binds and
+        transitions Reserved -> Used -> Allocated."""
+        if name is None:
+            if not self.preemptions:
+                return
+            name = self.rnd.choice(sorted(self.preemptions))
+        info = self.preemptions.get(name)
+        if info is None:
+            return
+        victims = self._live_victims(name)
+        if victims:
+            self.delete_pods(victims, missed=False)
+        for uid in list(info["uids"]):
+            pod = self.cluster_pods.get(uid)
+            if pod is not None:
+                self._filter_and_bind(pod)
+        if self.scheduler.core.affinity_groups.get(name) is not None and (
+            self.scheduler.core.affinity_groups[name].state
+            != GroupState.PREEMPTING
+        ):
+            self.stats["preempt_resolved"] += 1
+        self._sync_preemptions()
+
+    def preempt_cancel(self) -> None:
+        """Delete a preemptor gang's own pods mid-preempt: the preemption
+        cancels, Reserving cells return to their victims, Reserved cells
+        free, and the victims' BeingPreempted state clears."""
+        if not self.preemptions:
+            return
+        name = self.rnd.choice(sorted(self.preemptions))
+        self.delete_pods(list(self.preemptions[name]["uids"]), missed=False)
+        self.stats["preempt_cancelled"] += 1
+        self._sync_preemptions()
+
+    def check_preemption_progress(self) -> None:
+        """Invariant 6 (preemption progress): a PREEMPTING group either
+        completes, cancels, or loses its victims within
+        PREEMPT_PROGRESS_BOUND events; past the bound the harness forces
+        the resolution (repairing any missed deletes first) and asserts it
+        lands — a preemption that cannot make progress even when driven is
+        a wedged state machine."""
+        for name in list(self.preemptions):
+            info = self.preemptions.get(name)
+            if info is None or self.event_i - info["since"] <= (
+                self.PREEMPT_PROGRESS_BOUND
+            ):
+                continue
+            self.relist()  # repair missed victim/preemptor deletes
+            self.preempt_resolve(name)
+            g = self.scheduler.core.affinity_groups.get(name)
+            assert g is None or g.state != GroupState.PREEMPTING, (
+                self.seed, name,
+                "preemption made no progress within the event bound",
+            )
+            self._sync_preemptions()
 
     def inject_faults(self) -> None:
         roll = self.rnd.random()
@@ -587,18 +903,43 @@ class ChaosHarness:
             and self.cluster_pods[uid].node_name
         }
 
-    def crash_restart(self) -> None:
+    def crash_restart(self, reconfigure: bool = False) -> None:
         """Invariant 4: a fresh scheduler recovered from the surviving
         cluster state must be equivalent to the continuous scheduler's
-        durable projection."""
+        durable projection — asserted STRICTLY (full quota ledgers, free
+        sets, doomed listings, probe outcomes) now that the persisted
+        doomed ledger pins the advisory bindings and preempt-info
+        annotations replay the Reserving/Reserved reservations.
+
+        ``reconfigure`` restarts into a MUTATED config (the two VCs swap
+        their quota) instead: cross-config equivalence is meaningless, so
+        the checks become the reconfiguration contract — work preservation
+        (every durable bound pod keeps its exact placement), quarantine
+        fidelity, and the structural invariants — and the teardown pristine
+        baseline is rebased onto the new config."""
         self.stats["restarts"] += 1
         old = self.scheduler
+        if any(
+            g.state == GroupState.PREEMPTING
+            for g in old.core.affinity_groups.values()
+        ):
+            # Crash during Reserving/Reserved (the sensitivity meta-test
+            # pins seeds where this fires).
+            self.stats["preempt_restarts"] += 1
+        if reconfigure:
+            self.stats["reconfigs"] += 1
+            self.config_swapped = not self.config_swapped
         new = self._new_scheduler()
         new.recover(
             [Node(name=n, ready=h) for n, h in sorted(self.node_health.items())],
             [self.cluster_pods[uid] for uid in sorted(self.cluster_pods)],
         )
         assert new.is_ready(), (self.seed, "recover() must flip readiness")
+        m = new.metrics.snapshot()
+        self.stats["preempt_recovered"] += m["preemptionRecoveredCount"]
+        self.stats["preempt_cancelled_on_recovery"] += (
+            m["preemptionCancelledOnRecoveryCount"]
+        )
 
         expected_q = self.expected_quarantine()
         assert set(new.quarantined_pods) == expected_q, (
@@ -609,7 +950,8 @@ class ChaosHarness:
             assert uid not in new.pod_schedule_statuses, (self.seed, uid)
 
         # Every durable (confirmed-bound, surviving, uncorrupted) pod must
-        # recover with an identical placement.
+        # recover with an identical placement — under reconfiguration too
+        # (work preservation: quota moves lazy-preempt, never migrate).
         iso = constants.ANNOTATION_POD_LEAF_CELL_ISOLATION
         for uid, status in old.pod_schedule_statuses.items():
             if (
@@ -629,72 +971,83 @@ class ChaosHarness:
                 iso
             ), (self.seed, uid, "isolation changed across restart")
 
+        if not reconfigure:
+            self._assert_restart_equivalence(old, new, expected_q)
+        else:
+            # Rebase the zero-leak baseline: teardown drains onto the NEW
+            # config, so pristine is a fresh all-healthy core of it.
+            baseline = HivedScheduler(
+                self._config(), force_bind_executor=lambda fn: fn()
+            )
+            for n in sorted(self.node_health):
+                baseline.add_node(Node(name=n))
+            self.pristine = core_fingerprint(baseline.core)
+
+        audit_invariants(new, f"seed={self.seed} post-restart")
+        self.scheduler = new
+        self._sync_preemptions()
+
+    def _assert_restart_equivalence(
+        self, old: HivedScheduler, new: HivedScheduler, expected_q: Set[str]
+    ) -> None:
+        # The projection below mutates the OLD scheduler only for
+        # comparison; its side-effect writes (ledger persists, annotation
+        # clears) must not leak into the shared apiserver truth the NEW
+        # scheduler now owns. Doom churn freezes too: the phantom-pod
+        # deletions below would otherwise run organic doom maintenance at
+        # trigger points the recovered side (pinned to the persisted
+        # ledger) never visits — both sides must hold exactly the
+        # crash-time ledger when compared.
+        old.kube_client = KubeClient()
+        old.core.doomed_ledger_mode = True
         # Project the continuous scheduler down to its durable state: forget
         # unconfirmed assume-binds (their bind never reached the apiserver —
         # a real crash forgets them and K8s re-filters), stale pods whose
         # delete the watch missed, and corrupted pods (quarantined on the
-        # recovered side).
+        # recovered side). WAITING and PREEMPTING pods are durable (pending
+        # pods in the cluster; the latter carry the preempt-info
+        # annotation), so they survive the projection.
         for uid, status in list(old.pod_schedule_statuses.items()):
             if (
-                status.pod_state != PodState.BOUND
+                status.pod_state == PodState.BINDING
                 or uid not in self.cluster_pods
                 or uid in expected_q
             ):
                 old.delete_pod(status.pod)
+        # A reservation whose victims are ALL gone is not durable state:
+        # recovery cancels it (the pod re-schedules fresh onto the now-free
+        # cells) — apply the same transition to the continuous side.
+        for name, g in list(old.core.affinity_groups.items()):
+            if g.state != GroupState.PREEMPTING:
+                continue
+            victims, _ = collect_preemption_victims(g.physical_placement)
+            if not victims:
+                old.core.cancel_preemption(
+                    name, Pod(name="projection", uid="projection"),
+                    "projection: victims all vanished",
+                )
 
+        # Strict, ungated equivalence (the pre-ledger harness gated the
+        # ledger/free-set/probe comparisons on "no advisory dooms live";
+        # the persisted doomed ledger closed exactly that gap).
         old_counters = counters_fingerprint(old.core)
         new_counters = counters_fingerprint(new.core)
-        # The doomed-bad subsystem is hysteretic: a doom is created when a
-        # VC-quota shortfall first APPEARS (allocating the quota to an
-        # arbitrary bad free cell) and retired only when a surplus appears,
-        # so its listing — and every ledger its allocation moved — depends
-        # on event history a restart cannot replay (the reference shares
-        # this). Ledger parity is therefore asserted strictly whenever no
-        # ADVISORY doom is live on either side; doomed bindings hosting
-        # real allocations are fine (the real allocation pins the same
-        # ledgers on both sides). The unconditional checks — per-leaf
-        # state/priority/owner, group placements, opportunistic charges,
-        # quarantine, and probe outcomes — are what catch lost or
-        # duplicated allocations.
-        hysteretic = ("doomed",)
-        strict = (
-            advisory_doom_count(old.core) == 0
-            and advisory_doom_count(new.core) == 0
-        )
-        if not strict:
-            hysteretic = (
-                "doomed", "badFree", "vcFree", "allVCFree", "totalLeft",
-            )
-        old_cmp = {k: v for k, v in old_counters.items() if k not in hysteretic}
-        new_cmp = {k: v for k, v in new_counters.items() if k not in hysteretic}
-        assert old_cmp == new_cmp, (
+        assert old_counters == new_counters, (
             self.seed, "counter fingerprints diverge across restart",
-            old_cmp, new_cmp,
+            old_counters, new_counters,
         )
         assert leaf_fingerprint(old.core) == leaf_fingerprint(new.core), (
             self.seed, "leaf states diverge across restart",
         )
-        if strict and not old_counters["doomed"] and not new_counters["doomed"]:
-            # With no doomed-bad bindings at all, the free SET is fully
-            # determined by the durable allocations (doomed binds pick an
-            # arbitrary bad cell, the one legitimate source of divergence).
-            assert free_set_fingerprint(old.core) == free_set_fingerprint(
-                new.core
-            ), (self.seed, "free sets diverge across restart")
-        if strict:
-            # Probe-schedule equivalence needs the same gate: an advisory
-            # doom pins a VC's quota to an arbitrary partially-bad cell,
-            # and guaranteed probes can ride its healthy chips — capacity a
-            # restart cannot re-derive once the physical layout moved on.
-            nodes = self.live_nodes()
-            assert probe_outcomes(
-                old.core, nodes, self.seed
-            ) == probe_outcomes(new.core, nodes, self.seed), (
-                self.seed, "probe outcomes diverge across restart",
-            )
-
-        audit_invariants(new, f"seed={self.seed} post-restart")
-        self.scheduler = new
+        assert free_set_fingerprint(old.core) == free_set_fingerprint(
+            new.core
+        ), (self.seed, "free sets diverge across restart")
+        nodes = self.live_nodes()
+        assert probe_outcomes(
+            old.core, nodes, self.seed
+        ) == probe_outcomes(new.core, nodes, self.seed), (
+            self.seed, "probe outcomes diverge across restart",
+        )
 
     # ---------------- teardown (invariant 3) ---------------- #
 
@@ -720,25 +1073,37 @@ class ChaosHarness:
     # ---------------- the schedule ---------------- #
 
     def step(self, i: int) -> None:
+        self.event_i = i
         roll = self.rnd.random()
-        if roll < 0.34:
+        if roll < 0.26:
             self.gang_create()
-        elif roll < 0.44:
+        elif roll < 0.34:
             self.gang_delete(missed=False)
-        elif roll < 0.50:
+        elif roll < 0.39:
             self.gang_delete(missed=True)
-        elif roll < 0.58:
+        elif roll < 0.45:
             self.pod_delete_mid_gang(missed=self.rnd.random() < 0.4)
-        elif roll < 0.72:
+        elif roll < 0.55:
             self.node_flip()
-        elif roll < 0.80:
+        elif roll < 0.60:
             self.inject_faults()
-        elif roll < 0.87:
+        elif roll < 0.65:
             self.relist()
-        elif roll < 0.93:
+        elif roll < 0.70:
             self.corrupt_annotation()
-        else:
+        elif roll < 0.78:
+            self.preempt_start()
+        elif roll < 0.82:
+            self.preempt_victim_delete()
+        elif roll < 0.86:
+            self.preempt_resolve()
+        elif roll < 0.90:
+            self.preempt_cancel()
+        elif roll < 0.95:
             self.crash_restart()
+        else:
+            self.crash_restart(reconfigure=True)
+        self.check_preemption_progress()
 
     def run(self, n_events: Optional[int] = None) -> Dict[str, int]:
         n = n_events if n_events is not None else self.rnd.randint(10, 16)
@@ -747,6 +1112,7 @@ class ChaosHarness:
             audit_invariants(self.scheduler, f"seed={self.seed} step={i}")
         # Every schedule exercises at least one crash-restart (acceptance:
         # node churn x pod churn x bind faults x >= 1 restart per seed).
+        self.event_i = n
         self.crash_restart()
         audit_invariants(self.scheduler, f"seed={self.seed} final-restart")
         self.teardown_and_assert_no_leaks()

@@ -2,11 +2,17 @@
 acceptance tests for the fault plane (doc/fault-model.md).
 
 The sweep runs ``HIVED_CHAOS_ROUNDS`` seeded schedules (default 220 — the CI
-floor; export a larger value for soak runs, mirroring the HIVED_BENCH_SMOKE
-pattern): each schedule interleaves node bad/heal churn, pod churn, missed
-deletes, injected bind faults, and annotation corruption, performs at least
-one crash-restart, audits the four invariants after every event, and must
-tear down to a pristine core (zero leaked cells).
+floor; export a larger value for soak runs, or use hack/soak.sh /
+tests/test_chaos_soak.py): each schedule interleaves node bad/heal churn,
+pod churn, missed deletes, injected bind faults, annotation corruption,
+preemption lifecycle events (preempt_routine, victim deletion mid-preempt,
+preemptor cancellation, crash during Reserving/Reserved), and
+reconfiguration restarts (quota swapped between VCs), performs at least one
+crash-restart, audits the invariants after every event — including
+reservation conservation and preemption progress — asserts STRICT
+restart-equivalence (full quota ledgers, free sets, doomed listings, probe
+outcomes; no advisory-doom hysteresis gate, thanks to the persisted doomed
+ledger), and must tear down to a pristine core (zero leaked cells).
 """
 
 import os
@@ -14,7 +20,10 @@ import random
 
 import pytest
 
+from hivedscheduler_tpu.algorithm.cell import CellState
+from hivedscheduler_tpu.algorithm.group import GroupState
 from hivedscheduler_tpu.api import constants, extender as ei
+from hivedscheduler_tpu.scheduler import kube as kube_mod
 from hivedscheduler_tpu.scheduler.framework import HivedScheduler
 from hivedscheduler_tpu.scheduler.kube import RetryingKubeClient
 from hivedscheduler_tpu.scheduler.types import Node, PodState
@@ -29,26 +38,40 @@ CHAOS_ROUNDS = int(os.environ.get("HIVED_CHAOS_ROUNDS", "0")) or 220
 # Seeds whose schedules corrupt a surviving bound pod's bind-info BEFORE a
 # crash-restart — the schedules that die if recovery regresses from
 # quarantining to raising (see test_rebroken_recover_is_caught below).
-CORRUPTION_RESTART_SEEDS = (0, 2, 6, 8, 15, 20)
+CORRUPTION_RESTART_SEEDS = (11, 12, 14, 16, 20, 21)
+
+# Seeds whose schedules crash-restart while a PREEMPTING group holds a
+# Reserving/Reserved reservation — the schedules that die if
+# Reserving/Reserved recovery is re-broken (sensitivity meta-test below).
+RESERVING_RECOVERY_SEEDS = (15, 19, 28, 37, 67, 86)
 
 
 def test_chaos_seed_sweep():
     stats = {
         "restarts": 0, "corruptions": 0, "transient_faults": 0,
         "give_up_faults": 0, "terminal_faults": 0, "missed_deletes": 0,
-        "relists": 0, "node_flips": 0, "binds": 0,
+        "relists": 0, "node_flips": 0, "binds": 0, "preempts": 0,
+        "preempt_resolved": 0, "preempt_cancelled": 0,
+        "preempt_restarts": 0, "preempt_recovered": 0,
+        "preempt_cancelled_on_recovery": 0, "reconfigs": 0,
     }
     for seed in range(CHAOS_ROUNDS):
         for k, v in chaos.run_chaos_schedule(seed).items():
             stats[k] += v
     # The sweep must actually exercise the fault plane, not skate past it:
     # every schedule crash-restarts at least once, and across the seed set
-    # every injected fault class fires.
+    # every injected fault class fires — including the preempt/reconfig
+    # plane: preemptions start, restart mid-Reserving/Reserved, recover or
+    # cancel on recovery, resolve, cancel live, and configs mutate between
+    # restarts.
     assert stats["restarts"] >= CHAOS_ROUNDS, stats
     assert stats["binds"] > CHAOS_ROUNDS, stats
     for key in (
         "corruptions", "transient_faults", "give_up_faults",
         "terminal_faults", "missed_deletes", "relists", "node_flips",
+        "preempts", "preempt_resolved", "preempt_cancelled",
+        "preempt_restarts", "preempt_recovered",
+        "preempt_cancelled_on_recovery", "reconfigs",
     ):
         assert stats[key] > 0, (key, stats)
 
@@ -71,6 +94,29 @@ def test_rebroken_recover_is_caught(monkeypatch):
             caught += 1
     assert caught == len(CORRUPTION_RESTART_SEEDS), (
         "re-broken recover() escaped the pinned chaos seeds"
+    )
+
+
+def test_rebroken_reserving_recovery_is_caught(monkeypatch):
+    """Sensitivity meta-test for the preemption plane: disable the
+    Reserving/Reserved recovery replay (the pre-PR behavior — a crash
+    simply forgot every reservation) and assert the pinned
+    crash-during-preemption seeds fail their strict restart-equivalence.
+    If this passes while the replay is broken, the sweep is blind to the
+    preemption plane."""
+
+    monkeypatch.setattr(
+        HivedScheduler, "_recover_preempting_pods",
+        lambda self, pods: None,
+    )
+    caught = 0
+    for seed in RESERVING_RECOVERY_SEEDS:
+        try:
+            chaos.run_chaos_schedule(seed)
+        except Exception:  # noqa: BLE001
+            caught += 1
+    assert caught == len(RESERVING_RECOVERY_SEEDS), (
+        "re-broken Reserving/Reserved recovery escaped the pinned seeds"
     )
 
 
@@ -267,6 +313,295 @@ def test_exhausted_retries_keep_allocation_for_reinsist():
         )
     )
     assert "u-x" in inner.bound
+
+
+def _shared_cluster():
+    """A ScriptedKubeClient + apiserver-truth dict wired so scheduler
+    annotation patches land on the cluster's pod objects (what the chaos
+    harness does, in miniature for the targeted tests)."""
+    kube = chaos.ScriptedKubeClient()
+    cluster = {}
+
+    def on_patch(pod, patch):
+        cur = cluster.get(pod.uid)
+        if cur is None:
+            return
+        for k, v in patch.items():
+            if v is None:
+                cur.annotations.pop(k, None)
+            else:
+                cur.annotations[k] = v
+
+    kube.on_patch = on_patch
+    return kube, cluster
+
+
+def _sched_on(kube, seed=7):
+    sched = HivedScheduler(
+        random_config(random.Random(seed)), force_bind_executor=lambda fn: fn()
+    )
+    sched.kube_client = RetryingKubeClient(
+        kube, scheduler=sched, sleep=lambda s: None,
+        jitter_rng=random.Random(1),
+    )
+    sched.core.preempt_rng = random.Random(42)
+    return sched
+
+
+def _boot(sched):
+    for n in sched.core.configured_node_names():
+        sched.add_node(Node(name=n))
+    sched.mark_ready()
+    return sched
+
+
+def _start_preemption(kube, cluster):
+    """Fill VC A's whole v5e-16 quota with a priority-0 gang, then drive a
+    priority-5 pod through filter + preempt_routine: a PREEMPTING group
+    with a live Reserving reservation, checkpointed onto the pod."""
+    s1 = _boot(_sched_on(kube))
+    nodes = sorted(s1.nodes)
+    group = {"name": "lowpri",
+             "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    for i in range(4):
+        pod = make_pod(
+            f"low-{i}", f"u-low-{i}", "A", 0, "v5e-chip", 4, group=group
+        )
+        cluster[pod.uid] = pod
+        s1.add_pod(pod)
+        r = s1.filter_routine(ei.ExtenderArgs(pod=pod, node_names=nodes))
+        assert r.node_names, (i, r.failed_nodes)
+        s1.bind_routine(
+            ei.ExtenderBindingArgs(
+                pod_name=pod.name, pod_namespace=pod.namespace,
+                pod_uid=pod.uid, node=r.node_names[0],
+            )
+        )
+        bound = kube.bound[pod.uid]
+        bound.phase = "Running"
+        s1.update_pod(pod, bound)
+        cluster[pod.uid] = bound
+    pre = make_pod(
+        "hi-0", "u-hi", "A", 5, "v5e-chip", 4,
+        group={"name": "hi", "members": [{"podNumber": 1, "leafCellNumber": 4}]},
+    )
+    cluster[pre.uid] = pre
+    s1.add_pod(pre)
+    r = s1.filter_routine(ei.ExtenderArgs(pod=pre, node_names=nodes))
+    assert not r.node_names and r.failed_nodes  # preempt-hinted
+    pr = s1.preempt_routine(
+        ei.ExtenderPreemptionArgs(
+            pod=pre,
+            node_name_to_meta_victims={n: ei.MetaVictims() for n in nodes},
+        )
+    )
+    assert pr.node_name_to_meta_victims, "no victims proposed"
+    g = s1.core.affinity_groups["hi"]
+    assert g.state == GroupState.PREEMPTING
+    return s1, pre, nodes
+
+
+def test_preempting_reservation_survives_restart():
+    """Acceptance (tentpole 2): a crash during Reserving is recovered from
+    the preempt-info annotation — the reservation, victim BeingPreempted
+    states, and every leaf state replay exactly; the recovered preemption
+    then completes normally once the victims die."""
+    kube, cluster = _shared_cluster()
+    s1, pre, nodes = _start_preemption(kube, cluster)
+    # The reservation checkpoint landed on the apiserver truth.
+    assert constants.ANNOTATION_POD_PREEMPT_INFO in cluster["u-hi"].annotations
+    g = s1.core.affinity_groups["hi"]
+    states = {
+        leaf.state
+        for rows in g.physical_placement.values()
+        for row in rows for leaf in row
+    }
+    assert states == {CellState.RESERVING}  # victims still alive
+
+    # Crash + recover from the surviving cluster state.
+    s2 = _sched_on(kube)
+    s2.recover(
+        [Node(name=n) for n in nodes],
+        [cluster[u] for u in sorted(cluster)],
+    )
+    g2 = s2.core.affinity_groups.get("hi")
+    assert g2 is not None and g2.state == GroupState.PREEMPTING
+    assert s2.pod_schedule_statuses["u-hi"].pod_state == PodState.PREEMPTING
+    assert s2.get_metrics()["preemptionRecoveredCount"] == 1
+    assert chaos.leaf_fingerprint(s2.core) == chaos.leaf_fingerprint(s1.core)
+    low = s2.core.affinity_groups["lowpri"]
+    assert low.state == GroupState.BEING_PREEMPTED
+    chaos.audit_invariants(s2, "preempt-recovered")
+
+    # The recovered preemption completes: victims die, the preemptor binds
+    # on its reserved cells.
+    for i in range(4):
+        s2.delete_pod(cluster.pop(f"u-low-{i}"))
+    r = s2.filter_routine(ei.ExtenderArgs(pod=pre, node_names=nodes))
+    assert r.node_names
+    s2.bind_routine(
+        ei.ExtenderBindingArgs(
+            pod_name=pre.name, pod_namespace=pre.namespace,
+            pod_uid=pre.uid, node=r.node_names[0],
+        )
+    )
+    assert s2.core.affinity_groups["hi"].state == GroupState.ALLOCATED
+    # Completion cleared the now-stale preempt-info checkpoint.
+    assert constants.ANNOTATION_POD_PREEMPT_INFO not in (
+        cluster["u-hi"].annotations
+    )
+    chaos.audit_invariants(s2, "preempt-completed")
+
+
+def test_preemption_cancelled_when_victims_vanished_while_down():
+    """Acceptance (tentpole 2): victims deleted while the scheduler was
+    down cancel the recovered preemption — the reservation is not
+    replayed, the stale annotation is cleared, and the preemptor simply
+    re-schedules fresh onto the now-free cells."""
+    kube, cluster = _shared_cluster()
+    s1, pre, nodes = _start_preemption(kube, cluster)
+    for i in range(4):  # the kubelet killed the victims while we were down
+        cluster.pop(f"u-low-{i}")
+        kube.bound.pop(f"u-low-{i}", None)
+    s2 = _sched_on(kube)
+    s2.recover(
+        [Node(name=n) for n in nodes],
+        [cluster[u] for u in sorted(cluster)],
+    )
+    assert "hi" not in s2.core.affinity_groups
+    assert s2.get_metrics()["preemptionCancelledOnRecoveryCount"] == 1
+    assert constants.ANNOTATION_POD_PREEMPT_INFO not in (
+        cluster["u-hi"].annotations
+    )
+    chaos.audit_invariants(s2, "preempt-cancelled-on-recovery")
+    # The pod re-schedules fresh (the cells are free now).
+    r = s2.filter_routine(ei.ExtenderArgs(pod=pre, node_names=nodes))
+    assert r.node_names
+    chaos.audit_invariants(s2, "preempt-rescheduled")
+
+
+def test_doomed_ledger_persists_and_reconstructs():
+    """Acceptance (tentpole 1): advisory doomed-bad bindings are persisted
+    to the scheduler-state ConfigMap on every change and a restart
+    reconstructs the SAME bindings (cells included), making the doomed
+    subsystem restart-equivalent — the exact gap the PR-2 harness gated
+    around."""
+    kube, cluster = _shared_cluster()
+    s1 = _boot(_sched_on(kube))
+    nodes = sorted(s1.nodes)
+    # One bad node in each v5e-16 slice: no healthy whole slice is left,
+    # so VC A's slice-level quota is doomed onto one of them.
+    bad = {"s0-w0", "s1-w0"}
+    for n in sorted(bad):
+        s1.update_node(Node(name=n), Node(name=n, ready=False))
+    snap = s1.get_doomed_ledger()
+    assert snap["vcs"].get("A"), snap
+    assert kube.state is not None and '"A"' in kube.state  # persisted
+    assert snap["persistedEpoch"] == s1.core.doomed_epoch
+
+    s2 = _sched_on(kube)
+    s2.recover(
+        [Node(name=n, ready=n not in bad) for n in nodes],
+        [],
+    )
+    assert (
+        s2.core.doomed_ledger_snapshot()["vcs"]
+        == s1.core.doomed_ledger_snapshot()["vcs"]
+    ), "recovered doomed bindings differ from the persisted ledger"
+    assert chaos.free_set_fingerprint(s2.core) == (
+        chaos.free_set_fingerprint(s1.core)
+    )
+    chaos.audit_invariants(s2, "ledger-reconstructed")
+
+
+def test_unquarantine_replay_rebinds_cells():
+    """Satellite: a quarantined bound pod whose annotation is corrected is
+    re-admitted and its cells re-bound — not just dropped from the
+    quarantine list (previously only the quarantine entry was asserted)."""
+    s1 = _booted_scheduler()
+    good = _bind_one(s1, "fix-0", "u-fix", vc="A")
+    good_ann = dict(good.annotations)
+    good.annotations[constants.ANNOTATION_POD_BIND_INFO] = "{unterminated: ["
+
+    s2 = _booted_scheduler()
+    s2.recover([], [good])
+    assert set(s2.quarantined_pods) == {"u-fix"}
+    assert "fix-0" not in s2.core.affinity_groups
+    pristine = chaos.core_fingerprint(s2.core)
+
+    # The operator repairs the annotation; the informer delivers MODIFIED.
+    from hivedscheduler_tpu.scheduler.types import Pod
+    fixed = Pod(
+        name=good.name, namespace=good.namespace, uid=good.uid,
+        annotations=good_ann, node_name=good.node_name, phase=good.phase,
+        resource_limits=dict(good.resource_limits),
+    )
+    s2.update_pod(good, fixed)
+    assert not s2.quarantined_pods
+    st = s2.pod_schedule_statuses["u-fix"]
+    assert st.pod_state == PodState.BOUND
+    # The cells are actually re-bound: the group exists and its leaves are
+    # Used again (the core changed, not just the quarantine list).
+    assert "fix-0" in s2.core.affinity_groups
+    assert chaos.core_fingerprint(s2.core) != pristine
+    g = s2.core.affinity_groups["fix-0"]
+    for rows in g.physical_placement.values():
+        for row in rows:
+            for leaf in row:
+                assert leaf is not None and leaf.state == CellState.USED
+    chaos.audit_invariants(s2, "unquarantine-replay")
+
+
+def test_request_deadline_caps_bind_retry_budget():
+    """Satellite: an armed per-request deadline makes RetryingKubeClient
+    give up a retry round early (allocation kept, like retry exhaustion)
+    instead of holding the HTTP worker for the full backoff schedule."""
+    sched = _booted_scheduler()
+    inner = sched.kube_client
+    sleeps = []
+    sched.kube_client = RetryingKubeClient(
+        inner, scheduler=sched, max_attempts=5,
+        backoff_initial_s=0.2, backoff_max_s=5.0,
+        sleep=sleeps.append, jitter_rng=random.Random(1),
+    )
+    pod = make_pod(
+        "dl-0", "u-dl", "A", 0, "v5e-chip", 2,
+        group={"name": "dl-0",
+               "members": [{"podNumber": 1, "leafCellNumber": 2}]},
+    )
+    sched.add_pod(pod)
+    nodes = sorted(sched.nodes)
+    result = sched.filter_routine(ei.ExtenderArgs(pod=pod, node_names=nodes))
+    node = result.node_names[0]
+    inner.fault_queue.extend(chaos.transient_fault() for _ in range(4))
+    kube_mod.set_request_deadline(0.1)  # < first backoff (0.2s)
+    try:
+        with pytest.raises(Exception):
+            sched.bind_routine(
+                ei.ExtenderBindingArgs(
+                    pod_name=pod.name, pod_namespace=pod.namespace,
+                    pod_uid=pod.uid, node=node,
+                )
+            )
+    finally:
+        kube_mod.clear_request_deadline()
+    m = sched.get_metrics()
+    assert m["requestDeadlineExceededCount"] == 1
+    assert sleeps == []  # gave up before the first backoff sleep
+    # Allocation kept: the next filter insists, and with the deadline
+    # cleared the remaining fault burst retries through to success.
+    st = sched.pod_schedule_statuses["u-dl"]
+    assert st.pod_state == PodState.BINDING
+    r2 = sched.filter_routine(ei.ExtenderArgs(pod=pod, node_names=nodes))
+    assert r2.node_names == [node]
+    sched.kube_client._sleep = lambda s: None
+    sched.bind_routine(
+        ei.ExtenderBindingArgs(
+            pod_name=pod.name, pod_namespace=pod.namespace,
+            pod_uid=pod.uid, node=node,
+        )
+    )
+    assert "u-dl" in inner.bound
 
 
 def test_bound_to_unbound_update_degrades_not_crashes():

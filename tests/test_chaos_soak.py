@@ -1,0 +1,39 @@
+"""Slow-marked chaos soak: HIVED_CHAOS_ROUNDS-scale seed sweeps with the
+full event mix (preempt + reconfigure on), excluded from tier-1 by the
+``-m 'not slow'`` filter so CI wall time is unchanged. Driven by
+``hack/soak.sh``; run directly with e.g.
+
+    HIVED_CHAOS_ROUNDS=5000 HIVED_CHAOS_START=10000 \
+        python -m pytest tests/test_chaos_soak.py -m slow -q
+
+``HIVED_CHAOS_START`` defaults past the tier-1 range (0..219) so soaks
+cover fresh seeds instead of re-running CI's.
+"""
+
+import os
+
+import pytest
+
+from . import chaos
+
+SOAK_ROUNDS = int(os.environ.get("HIVED_CHAOS_ROUNDS", "0")) or 2000
+SOAK_START = int(os.environ.get("HIVED_CHAOS_START", "0")) or 220
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    "HIVED_CHAOS_ROUNDS" not in os.environ
+    and "HIVED_CHAOS_START" not in os.environ,
+    reason="soak only: set HIVED_CHAOS_ROUNDS/START (hack/soak.sh does) — "
+    "a bare `pytest tests/` must stay fast even without the -m filter",
+)
+def test_chaos_soak():
+    stats = {}
+    for seed in range(SOAK_START, SOAK_START + SOAK_ROUNDS):
+        for k, v in chaos.run_chaos_schedule(seed).items():
+            stats[k] = stats.get(k, 0) + v
+    # A soak that somehow never preempts or reconfigures is not soaking
+    # the plane this harness exists to cover.
+    assert stats["restarts"] >= SOAK_ROUNDS, stats
+    for key in ("preempts", "preempt_restarts", "reconfigs"):
+        assert stats[key] > 0, (key, stats)

@@ -350,12 +350,15 @@ SUGGESTED_NODES = [
     group_state("sg1", "BeingPreempted"),
     # Same preemptor, but the suggested set no longer covers the committed
     # placement: the preemption is CANCELED (group deleted), pod waits.
-    # The victims stay BeingPreempted (the reference never reverts that
-    # state; the cells themselves are returned, hived_algorithm.go:1116-44).
+    # The victims return to Allocated with their cells (first-class cancel
+    # transition, doc/fault-model.md "Preemption plane"; the reference
+    # leaves them BeingPreempted forever, hived_algorithm.go:1116-44 —
+    # with group state part of the restart-equivalence contract, a
+    # recovered scheduler replaying them as Allocated would diverge).
     step("s07", "VC2", 5, "v5p-chip", 4, ("wait",), group=("sg2", 4),
          suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14"], phase=P),
     group_state("sg2", "absent"),
-    group_state("sg1", "BeingPreempted"),
+    group_state("sg1", "Allocated"),
 ]
 
 BACKTRACKING = [
@@ -475,14 +478,14 @@ PREEMPTION_CHAIN = [
     group_state("cmid", "absent"),
     group_state("clow", "BeingPreempted"),
     # CANCELLATION: the suggested set no longer covers chigh's committed
-    # placement -> the preemptor is deleted and its reserved cells RETURN
-    # to the being-preempted group (clow keeps running on w12-w15; the
-    # reference never reverts the BeingPreempted marker itself,
-    # hived_algorithm.go:1116-1144).
+    # placement -> the preemptor is deleted, its reserved cells RETURN to
+    # the being-preempted group, and clow — no reservation left on any of
+    # its cells — returns to Allocated (first-class cancel transition; the
+    # reference never reverts the marker, hived_algorithm.go:1116-1144).
     step("c07", "VC2", 10, "v5p-chip", 4, ("wait",), group=("chigh", 4),
          suggested=["v5p64-w12", "v5p64-w13"], phase=P),
     group_state("chigh", "absent"),
-    group_state("clow", "BeingPreempted"),
+    group_state("clow", "Allocated"),
     # The returned cells are really clow's again: deleting clow's pods
     # frees them, and a re-committed preemptor...
     step("c08", "VC2", 5, "v5p-chip", 4,

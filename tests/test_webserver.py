@@ -139,6 +139,13 @@ def test_inspect_api(server):
 
     code, metrics = get(server, constants.INSPECT_PATH + "/metrics")
     assert code == 200 and metrics["filterCount"] == 1
+    assert metrics["requestDeadlineExceededCount"] == 0
+    assert "doomedLedgerPersistCount" in metrics
+
+    code, ledger = get(server, constants.DOOMED_LEDGER_PATH)
+    assert code == 200
+    assert set(ledger) >= {"epoch", "vcs", "persistedEpoch"}
+    assert ledger["vcs"] == {}  # healthy cluster: nothing doomed
 
 
 def test_inspect_not_found(server):
