@@ -33,14 +33,35 @@ config = Config.from_dict({
         ],
     },
     "virtualClusters": {
+        # 3 of the 4 hosts: leaves slack so a chip fault degrades capacity
+        # without dooming the partially-bad host onto the VC (sub-host work
+        # then still lands on its healthy chips; see ROADMAP "Chip-granular
+        # dooming" for the quota-at-the-edge case).
         "vc-research": {"virtualCells": [{"cellType": "v5e-16.v5e-host",
-                                           "cellNumber": 4}]},
+                                           "cellNumber": 3}]},
     },
 })
 
 s = HivedScheduler(config, kube_client=NullKubeClient())
 for i in range(4):
     s.add_node(Node(name=f"tpu-w{i}"))
+
+# Exercise the hardware health plane (doc/fault-model.md "Hardware health
+# plane") the way the node informer would: tpu-w2 reports chip 3 bad via
+# the device-health annotation (the host still serves <=3-chip work on its
+# healthy chips), and tpu-w3 is drained for maintenance (no new
+# placements; anything already running would keep its cells). Inspect at
+# GET /v1/inspect/health.
+s.update_node(
+    Node(name="tpu-w2"),
+    Node(name="tpu-w2",
+         annotations={constants.ANNOTATION_NODE_DEVICE_HEALTH: "3"}),
+)
+s.update_node(
+    Node(name="tpu-w3"),
+    Node(name="tpu-w3",
+         annotations={constants.ANNOTATION_NODE_DRAIN: "*"}),
+)
 
 def mk_pod(name, uid, leaf_num, group=None):
     spec = {"virtualCluster": "vc-research", "priority": 1,
@@ -52,11 +73,13 @@ def mk_pod(name, uid, leaf_num, group=None):
                             yaml.safe_dump(spec)},
                resource_limits={constants.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})
 
-# A 2-pod gang (8 chips over 2 hosts) + a singleton (4 chips).
+# A 2-pod gang (8 chips over 2 hosts), a full-host singleton (4 chips),
+# and a 3-chip singleton that fits the chip-degraded host's healthy chips.
 gang = {"name": "bert-gang", "members": [{"podNumber": 2, "leafCellNumber": 4}]}
 for pod in [mk_pod("bert-0", "uid-bert-0", 4, gang),
             mk_pod("bert-1", "uid-bert-1", 4, gang),
-            mk_pod("solo-0", "uid-solo-0", 4)]:
+            mk_pod("solo-0", "uid-solo-0", 4),
+            mk_pod("small-0", "uid-small-0", 3)]:
     s.add_pod(pod)
 
 # The manual node/pod seeding above IS this process's "initial replay";

@@ -1,7 +1,9 @@
 #!/usr/bin/env bash
 # Chaos-harness soak driver: run the full seeded fault schedule — node/pod
 # churn, bind faults, annotation corruption, preemption lifecycle (incl.
-# crash during Reserving/Reserved), reconfiguration restarts — at
+# crash during Reserving/Reserved), reconfiguration restarts, and the
+# hardware health plane (chip faults, flap storms, maintenance drains,
+# write-path faults for the preempt checkpoint + doomed ledger) — at
 # HIVED_CHAOS_ROUNDS scale, outside tier-1 (the wrapper test is marked
 # `slow`; tier-1 filters it out with -m 'not slow').
 #
@@ -10,6 +12,12 @@
 # Defaults: 2000 seeds starting at 220 (past the tier-1 range 0..219, so a
 # soak always covers fresh seeds). Any invariant violation fails the run
 # with the seed in the assertion. Fuzz-harness soaks live in hack/soak.py.
+#
+# Event-mix sweep: HIVED_CHAOS_SWEEP=1 runs the soak once per mix in
+# HIVED_CHAOS_MIXES (default: the baseline mix, a health-heavy mix, and a
+# drain/flap-focused mix), splitting the seed range across mixes. A single
+# custom mix can be passed directly: HIVED_CHAOS_MIX="health:3" hack/soak.sh
+# (see tests/chaos.py event_weights for the knob grammar).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -17,5 +25,18 @@ export HIVED_CHAOS_ROUNDS="${HIVED_CHAOS_ROUNDS:-2000}"
 export HIVED_CHAOS_START="${HIVED_CHAOS_START:-220}"
 export JAX_PLATFORMS=cpu
 
-echo "chaos soak: seeds ${HIVED_CHAOS_START}..$((HIVED_CHAOS_START + HIVED_CHAOS_ROUNDS - 1))"
+if [[ "${HIVED_CHAOS_SWEEP:-0}" == "1" ]]; then
+  IFS=';' read -r -a mixes <<< "${HIVED_CHAOS_MIXES:-;health:3;flap_storm:4,drain_toggle:4,inject_write_faults:3}"
+  per_mix=$(( HIVED_CHAOS_ROUNDS / ${#mixes[@]} ))
+  start="${HIVED_CHAOS_START}"
+  for mix in "${mixes[@]}"; do
+    echo "chaos soak: mix='${mix:-default}' seeds ${start}..$((start + per_mix - 1))"
+    HIVED_CHAOS_MIX="${mix}" HIVED_CHAOS_ROUNDS="${per_mix}" HIVED_CHAOS_START="${start}" \
+      python -m pytest tests/test_chaos_soak.py -m slow -q "$@"
+    start=$(( start + per_mix ))
+  done
+  exit 0
+fi
+
+echo "chaos soak: mix='${HIVED_CHAOS_MIX:-default}' seeds ${HIVED_CHAOS_START}..$((HIVED_CHAOS_START + HIVED_CHAOS_ROUNDS - 1))"
 exec python -m pytest tests/test_chaos_soak.py -m slow -q "$@"

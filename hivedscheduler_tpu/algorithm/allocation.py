@@ -236,7 +236,18 @@ def get_usable_physical_cells(
         assert isinstance(c, PhysicalCell)
         if c.virtual_cell is not None:
             continue
-        if len(c.nodes) == 1 and not c.healthy:
+        if not c.children:
+            # Leaf candidate: bad or draining chips are never bound
+            # (checked directly — white-box tests toggle leaf.healthy
+            # without the setter, so the counter is advisory here).
+            if (not c.healthy) or c.draining:
+                continue
+        elif c.unusable_leaf_num >= c.total_leaf_cell_num:
+            # Every chip inside is bad or draining: nothing to serve. A
+            # PARTIALLY degraded cell stays a candidate — chip-granular
+            # health: the recursion below it skips the degraded chips, so a
+            # host with one dead chip still serves smaller work (the old
+            # whole-cell `not c.healthy` gate condemned the host).
             continue
         if not ignore_suggested and suggested_nodes is not None:
             if all(n not in suggested_nodes for n in c.nodes):
@@ -244,8 +255,24 @@ def get_usable_physical_cells(
         usable.append(c)
     if len(usable) < num_needed:
         return None
+    # Sort: fewer opportunistic pods first (reduce preemption), then fewer
+    # bad/draining chips (a partially-degraded cell is placeable — the
+    # whole point of chip-granular health — but a pristine one must win
+    # while it exists, or a VC's quota gets bound to degraded hardware
+    # with healthy capacity sitting free), then config order. Every key is
+    # a pure function of cell STATE, never of free-list insertion order —
+    # the list's internal order is history-dependent and not reconstructed
+    # by crash recovery, so an order-broken tie would make a recovered
+    # scheduler place differently than the continuous one (found by the
+    # chaos harness's probe-equivalence once drains made such ties
+    # consequential). config_order equals a fresh boot's insertion order,
+    # so fresh-cluster placements are unchanged.
     usable.sort(
-        key=lambda c: c.used_leaf_cells_at_priority.get(OPPORTUNISTIC_PRIORITY, 0)
+        key=lambda c: (
+            c.used_leaf_cells_at_priority.get(OPPORTUNISTIC_PRIORITY, 0),
+            c.unusable_leaf_num,
+            c.config_order,
+        )
     )
     return usable
 

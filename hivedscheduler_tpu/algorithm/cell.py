@@ -82,6 +82,8 @@ class Cell:
         "total_leaf_cell_num",
         "used_leaf_cells_at_priority",
         "view_reg",
+        "unusable_leaf_num",
+        "config_order",
     )
 
     def __init__(
@@ -110,6 +112,18 @@ class Cell:
         # (reference: hived_algorithm.go:453-465).
         self.healthy = True
         self.total_leaf_cell_num = total_leaf_cell_num
+        # Count of leaf cells under (or at) this cell that cannot take NEW
+        # placements: bad (health plane) or draining (maintenance plane).
+        # Maintained incrementally by the leaf-level setters below so the
+        # placement hot path can gate candidates in O(1) instead of walking
+        # subtrees. Only meaningful on physical cells; virtual views derive
+        # usability from their bound physical cells at re-score time.
+        self.unusable_leaf_num = 0
+        # Position in the config-compile traversal: the canonical,
+        # state-pure candidate tiebreak (free-list insertion order is
+        # history-dependent and not reconstructed by crash recovery, so it
+        # must never decide a placement; see get_usable_physical_cells).
+        self.config_order = 0
         # (scheduler, is_anchor) when a cluster view scores this cell:
         # is_anchor=True for the node-anchor cells that back a _NodeView,
         # False for their ancestors (binding changes above node level).
@@ -158,6 +172,7 @@ class PhysicalCell(Cell):
         "virtual_cell",
         "split",
         "pinned",
+        "draining",
     )
 
     def __init__(self, *args, **kwargs):
@@ -172,6 +187,11 @@ class PhysicalCell(Cell):
         self.virtual_cell: Optional["VirtualCell"] = None
         self.split = False
         self.pinned = False
+        # Maintenance drain (health plane): a draining cell takes no NEW
+        # placements but keeps whatever is already running on it. Orthogonal
+        # to healthiness — a drained chip is fine hardware being emptied for
+        # maintenance, so it must not enter the bad-free / doomed accounting.
+        self.draining = False
 
     def set_physical_resources(
         self, nodes: List[str], leaf_cell_indices: List[int]
@@ -192,9 +212,36 @@ class PhysicalCell(Cell):
     def set_priority(self, p: CellPriority) -> None:
         self.priority = p
 
+    def _bump_unusable(self, delta: int) -> None:
+        """Propagate a leaf usability change up the tree (O(depth)) and
+        invalidate the cluster views scoring any ancestor. The dirty marks
+        MUST ride this walk, not the healthiness propagation: when a chip
+        under an already-unhealthy anchor changes usability, _set_bad_cell
+        short-circuits before reaching the anchor, yet the anchor's
+        usable-capacity score changed (found by the node-flap fuzzer)."""
+        cur: Optional[Cell] = self
+        while cur is not None:
+            cur.unusable_leaf_num += delta
+            reg = cur.view_reg
+            if reg is not None and reg[1]:
+                reg[0].mark_dirty(cur.address)
+            vc = cur.virtual_cell if isinstance(cur, PhysicalCell) else None
+            if vc is not None:
+                vreg = vc.view_reg
+                if vreg is not None and vreg[1]:
+                    vreg[0].mark_dirty(vc.address)
+            cur = cur.parent
+
     def set_healthiness(self, healthy: bool) -> None:
         """Healthiness mirrors into the bound virtual cell
         (reference: cell.go:302-313)."""
+        if not self.children:
+            # Leaf transition: maintain the unusable-leaf counters (a leaf
+            # is unusable when bad OR draining; count it once).
+            before = (not self.healthy) or self.draining
+            after = (not healthy) or self.draining
+            if after != before:
+                self._bump_unusable(1 if after else -1)
         self.healthy = healthy
         reg = self.view_reg
         if reg is not None and reg[1]:
@@ -208,6 +255,25 @@ class PhysicalCell(Cell):
             reg = vc.view_reg
             if reg is not None and reg[1]:
                 reg[0].mark_dirty(vc.address)
+
+    def set_draining(self, draining: bool) -> None:
+        """Maintenance-drain transition (leaf cells only — the health plane
+        applies drains chip by chip). Maintains the unusable-leaf counters
+        and invalidates the cluster views the same way a health transition
+        does, so a drained chip stops being offered to new placements on the
+        very next schedule call."""
+        if self.draining == draining:
+            return
+        before = (not self.healthy) or self.draining
+        self.draining = draining
+        after = (not self.healthy) or draining
+        if not self.children and after != before:
+            # The bump walk also dirties every view scoring an ancestor
+            # (drain is leaf-only, so unlike healthiness there is no other
+            # propagation that would reach the node anchor). A toggle that
+            # does NOT change usability (the chip is also bad) changes no
+            # placement-visible score, so no invalidation is needed.
+            self._bump_unusable(1 if after else -1)
 
     def add_using_group(self, g: "AffinityGroup") -> None:
         """(reference: cell.go:225-232; conflicting adds are logged, last
@@ -253,10 +319,19 @@ class VirtualCell(Cell):
             self.healthy = True
         else:
             self.healthy = cell.healthy
-        reg = self.view_reg
-        if reg is not None:
+        # Find the nearest registered ancestor: cells BELOW the node anchor
+        # carry no view_reg of their own, but the anchor's usable-capacity
+        # score now reads leaf bindings (an advisory bad-binding appearing
+        # on a virtual chip changes _node_unusable_free), so their binding
+        # changes must dirty the anchor too (found by the chaos harness's
+        # probe-equivalence at 600-seed scale).
+        target: Optional[Cell] = self
+        while target is not None and target.view_reg is None:
+            target = target.parent
+        if target is not None:
+            reg = target.view_reg
             if reg[1]:
-                reg[0].mark_dirty(self.address)
+                reg[0].mark_dirty(target.address)
             else:
                 # A binding (dis)appearing ABOVE node level changes how every
                 # unbound node under it scores against suggested nodes; the

@@ -104,6 +104,7 @@ class _PhysicalBuilder:
         self.free_list: Dict[CellChain, ChainCellList] = {}
         self.pinned_cells: Dict[api.PinnedCellId, PhysicalCell] = {}
         self._chain: CellChain = ""
+        self._order = 0
 
     def build(
         self,
@@ -149,6 +150,11 @@ class _PhysicalBuilder:
             cell_type=ce.cell_type,
             is_node_level=ce.has_node and not ce.is_multi_nodes,
         )
+        # Canonical candidate tiebreak: the compile traversal position (==
+        # a fresh boot's free-list insertion order), NOT the live list
+        # order, which is history-dependent and not recovered.
+        self._order += 1
+        cell.config_order = self._order
         self.full_list.setdefault(self._chain, ChainCellList())
         self.full_list[self._chain][ce.level].append(cell)
         if spec.pinned_cell_id:

@@ -530,6 +530,26 @@ class HivedCore:
         ] = {}
         self.all_vc_doomed_bad_cell_num: Dict[CellChain, Dict[CellLevel, int]] = {}
         self.bad_nodes: Set[str] = set()
+        # Chip-granular health plane (doc/fault-model.md "Hardware health
+        # plane"): chip indices marked bad per node (device-health
+        # annotation / node conditions) and chip indices draining per node
+        # (maintenance annotation). Badness composes with node badness — a
+        # leaf is bad while EITHER holds; draining is orthogonal to badness
+        # (no doomed/bad-free accounting, placement exclusion only).
+        self.bad_chips: Dict[str, Set[int]] = {}
+        self.draining_chips: Dict[str, Set[int]] = {}
+        # node -> its leaf cells across every chain, precomputed once: the
+        # cell population is fixed at config-compile time, and the health
+        # plane consults this on EVERY node event (a relist delivers N of
+        # them) — a per-event full-cluster leaf scan under the scheduler
+        # lock would stall filtering at fleet scale.
+        self._node_leaf_index: Dict[str, List[PhysicalCell]] = {}
+        for ccl in self.full_cell_list.values():
+            for cell in ccl[LOWEST_LEVEL]:
+                assert isinstance(cell, PhysicalCell)
+                self._node_leaf_index.setdefault(cell.nodes[0], []).append(
+                    cell
+                )
         # Opportunistic cells currently charged to each VC, for the inspect
         # API (reference: utils.go:419-452 OT virtual cells).
         self._ot_cells: Dict[api.VirtualClusterName, List[PhysicalCell]] = {}
@@ -657,28 +677,118 @@ class HivedCore:
 
     def delete_node(self, node: Node) -> None:
         self.set_bad_node(node.name)
+        # Drains are lifted on node delete (the annotation died with the
+        # node object); chip-badness records die with it too — the leaves
+        # stay bad through the node badness above, and a re-added node's
+        # annotations are re-applied from scratch.
+        self.apply_drain(node.name, set())
+        self.bad_chips.pop(node.name, None)
+
+    def _node_leaf_cells(
+        self, node_name: str, chip_index: Optional[int] = None
+    ) -> List[PhysicalCell]:
+        """Leaf cells on a node (optionally: only those holding one chip
+        index) across every chain, from the precomputed index."""
+        leaves = self._node_leaf_index.get(node_name, [])
+        if chip_index is None:
+            return leaves
+        return [
+            leaf for leaf in leaves if chip_index in leaf.leaf_cell_indices
+        ]
+
+    def node_chip_indices(self, node_name: str) -> Set[int]:
+        """Every chip index the config places on a node (used to expand a
+        whole-node drain into per-chip drains)."""
+        return {
+            i
+            for leaf in self._node_leaf_index.get(node_name, [])
+            for i in leaf.leaf_cell_indices
+        }
 
     def set_bad_node(self, node_name: str) -> None:
         """(reference: hived_algorithm.go:467-481)"""
         if node_name in self.bad_nodes:
             return
         self.bad_nodes.add(node_name)
-        for ccl in self.full_cell_list.values():
-            for leaf in ccl[LOWEST_LEVEL]:
-                assert isinstance(leaf, PhysicalCell)
-                if leaf.nodes[0] == node_name:
-                    self._set_bad_cell(leaf)
+        for leaf in self._node_leaf_cells(node_name):
+            self._set_bad_cell(leaf)
 
     def set_healthy_node(self, node_name: str) -> None:
-        """(reference: hived_algorithm.go:484-498)"""
+        """(reference: hived_algorithm.go:484-498, chip-granular: leaves
+        individually marked bad by the device-health plane stay bad when
+        the node as a whole heals)"""
         if node_name not in self.bad_nodes:
             return
         self.bad_nodes.discard(node_name)
-        for ccl in self.full_cell_list.values():
-            for leaf in ccl[LOWEST_LEVEL]:
-                assert isinstance(leaf, PhysicalCell)
-                if leaf.nodes[0] == node_name:
-                    self._set_healthy_cell(leaf)
+        bad_chips = self.bad_chips.get(node_name, set())
+        for leaf in self._node_leaf_cells(node_name):
+            if not bad_chips or bad_chips.isdisjoint(leaf.leaf_cell_indices):
+                self._set_healthy_cell(leaf)
+
+    # -- chip-granular health + maintenance drains --------------------------
+
+    def set_bad_leaf(self, node_name: str, chip_index: int) -> None:
+        """Mark one chip's leaf cell bad (device-health plane). Partial
+        badness propagates up the cell tree through the ordinary
+        _set_bad_cell walk — the host stays placeable for work fitting its
+        remaining healthy chips."""
+        chips = self.bad_chips.setdefault(node_name, set())
+        if chip_index in chips:
+            return
+        chips.add(chip_index)
+        if node_name in self.bad_nodes:
+            return  # already bad via the node; the record alone suffices
+        for leaf in self._node_leaf_cells(node_name, chip_index):
+            self._set_bad_cell(leaf)
+
+    def set_healthy_leaf(self, node_name: str, chip_index: int) -> None:
+        """Heal one chip's leaf cell. No-op while the node itself is bad —
+        the chip record is dropped, and the node-level heal decides."""
+        chips = self.bad_chips.get(node_name)
+        if chips is None or chip_index not in chips:
+            return
+        chips.discard(chip_index)
+        if not chips:
+            del self.bad_chips[node_name]
+        if node_name in self.bad_nodes:
+            return
+        for leaf in self._node_leaf_cells(node_name, chip_index):
+            self._set_healthy_cell(leaf)
+
+    def apply_drain(self, node_name: str, chip_indices: Set[int]) -> None:
+        """Reconcile a node's draining chip set (maintenance plane): the
+        listed chips take no new placements; running gangs keep their
+        cells. Draining is NOT badness — no doomed-bad binding, no
+        bad-free accounting — so lifting a drain is always a pure
+        placement-visibility change."""
+        current = self.draining_chips.get(node_name, set())
+        if current == chip_indices:
+            return
+        for leaf in self._node_leaf_cells(node_name):
+            want = any(i in chip_indices for i in leaf.leaf_cell_indices)
+            if leaf.draining != want:
+                leaf.set_draining(want)
+        if chip_indices:
+            self.draining_chips[node_name] = set(chip_indices)
+        else:
+            self.draining_chips.pop(node_name, None)
+
+    def health_snapshot(self) -> Dict:
+        """The core half of /v1/inspect/health: applied badness and drains
+        (the framework adds the damper and stranded-gang views)."""
+        return {
+            "badNodes": sorted(self.bad_nodes),
+            "badChips": {
+                n: sorted(c)
+                for n, c in sorted(self.bad_chips.items())
+                if c
+            },
+            "drainingChips": {
+                n: sorted(c)
+                for n, c in sorted(self.draining_chips.items())
+                if c
+            },
+        }
 
     def _set_bad_cell(self, c: PhysicalCell) -> None:
         """Mark bad, propagate up, track in bad-free lists or bind into the
@@ -1866,13 +1976,20 @@ class HivedCore:
                     if (
                         p_leaf.state == CellState.USED
                         and p_leaf.using_group is not None
-                        and p_leaf.using_group.priority >= s.priority
+                        and p_leaf.priority >= s.priority
                     ):
-                        # A stale reservation: the cell was re-allocated to
-                        # an equal-or-higher-priority group since.
+                        # A stale reservation: the cell was re-allocated at
+                        # an equal-or-higher priority since. Compared via
+                        # the LEAF's priority (the allocation's effective
+                        # priority), not the using group's spec priority: a
+                        # lazy-preempted victim occupies its cells at
+                        # OPPORTUNISTIC priority while its spec priority
+                        # may equal the preemptor's — the live preemption
+                        # legitimately reserved over it, so its recovery
+                        # must too (found by the chaos health-event mix).
                         return False, (
-                            f"reserved leaf {p_leaf.address} is used by "
-                            "an equal-or-higher-priority group "
+                            f"reserved leaf {p_leaf.address} is used at "
+                            "an equal-or-higher priority "
                             f"({p_leaf.using_group.name})"
                         )
                     if p_leaf.address in seen_leaves:

@@ -94,6 +94,8 @@ class _NodeView:
         "free_at_priority",
         "used_same_priority",
         "used_higher_priority",
+        "unusable_free",
+        "degraded",
         "healthy",
         "suggested",
         "node_address",
@@ -104,6 +106,15 @@ class _NodeView:
         self.free_at_priority = 0
         self.used_same_priority = 0
         self.used_higher_priority = 0
+        # Leaves counted free at the scoring priority that cannot take new
+        # placements (bad or draining): the health plane is chip-granular,
+        # so a host with one dead chip still serves smaller work —
+        # free_at_priority - unusable_free is the node's REAL new-placement
+        # capacity (see _node_unusable_free).
+        self.unusable_free = 0
+        # Sort-only: any bad/draining chip in the anchor's physical subtree
+        # (partially-degraded hosts remain placeable but pack last).
+        self.degraded = False
         self.healthy = True
         self.suggested = True
         self.node_address: api.CellAddress = ""
@@ -126,11 +137,12 @@ class _NodeView:
                 self.free_at_priority -= num
 
     def sort_key(self) -> Tuple:
-        """Packing sort: healthy first, suggested first, more same-priority
-        usage first, less higher-priority usage first
-        (reference: topology_aware_scheduler.go:232-253)."""
+        """Packing sort: fully-usable first (healthy AND nothing draining —
+        partially-degraded hosts are placeable but dispreferred), suggested
+        first, more same-priority usage first, less higher-priority usage
+        first (reference: topology_aware_scheduler.go:232-253)."""
         return (
-            not self.healthy,
+            self.degraded,
             not self.suggested,
             -self.used_same_priority,
             self.used_higher_priority,
@@ -272,6 +284,8 @@ class TopologyAwareScheduler:
             n.healthy, n.suggested, n.node_address = _node_health_and_suggested(
                 n.cell, suggested_nodes, ignore_suggested
             )
+            n.unusable_free = _node_unusable_free(n.cell, p)
+            n.degraded = (not n.healthy) or _node_degraded(n.cell)
         # Stable in-place sort of the persistent list: with only a few dirty
         # nodes the list is near-sorted and Timsort's run detection makes
         # this effectively linear.
@@ -341,6 +355,82 @@ class TopologyAwareScheduler:
         return placements, ""
 
 
+def _leaf_unusable(c: Cell) -> bool:
+    """A leaf cell that cannot take NEW placements: bad or draining. For
+    virtual leaves the verdict comes from the bound physical chip; an
+    unbound virtual leaf has no hardware yet, so the (drain/health-aware)
+    virtual->physical mapping decides later."""
+    if isinstance(c, PhysicalCell):
+        return (not c.healthy) or c.draining
+    if isinstance(c, VirtualCell) and c.physical_cell is not None:
+        pc = c.physical_cell
+        return (not pc.healthy) or pc.draining
+    return False
+
+
+def _node_unusable_free(cell: Cell, p: CellPriority) -> int:
+    """Leaves of this node anchor that are counted free at priority ``p``
+    but are actually unusable (bad or draining) — the chip-granular
+    correction to the node's free count. The contract is exact alignment
+    with ``_collect_leaf_cells``: free_at_priority - unusable_free equals
+    the number of chips the in-node search will actually offer, or the
+    picked-node assert fires. That forces the walk to use the SAME priority
+    space as the free count: virtual priorities for a virtual anchor (an
+    opportunistic squatter on a bad chip has physical priority -1 but
+    virtual FREE — counting it by physical priority double-excludes it;
+    found by the node-flap fuzzer), physical priorities for a physical
+    anchor."""
+    if isinstance(cell, VirtualCell):
+        if cell.physical_cell is None:
+            return 0  # no hardware yet: mapping decides
+        n = 0
+        stack: List[Cell] = [cell]
+        while stack:
+            c = stack.pop()
+            if c.children:
+                stack.extend(c.children)
+            else:
+                assert isinstance(c, VirtualCell)
+                pc = c.physical_cell
+                if (
+                    pc is not None
+                    and ((not pc.healthy) or pc.draining)
+                    and c.priority < p
+                ):
+                    n += 1
+        return n
+    assert isinstance(cell, PhysicalCell)
+    if cell.healthy and cell.unusable_leaf_num == 0:
+        # Fast path: fully usable (the overwhelmingly common case). Checked
+        # alongside `healthy` so white-box tests that toggle leaf.healthy
+        # without the setter still get the walk below.
+        return 0
+    n = 0
+    stack = [cell]
+    while stack:
+        c = stack.pop()
+        if c.children:
+            stack.extend(c.children)
+        elif ((not c.healthy) or c.draining) and c.priority < p:
+            # priority >= p leaves are already excluded from the free count.
+            n += 1
+    return n
+
+
+def _node_degraded(cell: Cell) -> bool:
+    """Sort-only view of hardware degradation: any bad or draining chip in
+    the anchor's PHYSICAL subtree (for a bound virtual anchor too — an
+    unbound draining chip is invisible to the virtual capacity walk but
+    still makes the node a worse packing target). Unbound virtual anchors
+    have no hardware yet and sort clean."""
+    if isinstance(cell, VirtualCell):
+        cell = cell.physical_cell
+        if cell is None:
+            return False
+    assert isinstance(cell, PhysicalCell)
+    return (not cell.healthy) or cell.unusable_leaf_num > 0
+
+
 def _node_health_and_suggested(
     c: Cell,
     suggested_nodes: Optional[Set[str]],
@@ -392,19 +482,24 @@ def _find_nodes_for_pods(
     view: List[_NodeView], leaf_cell_nums: List[int]
 ) -> Tuple[Optional[List[int]], str]:
     """Greedy assignment of pods (sorted by chip count) to the packed-sorted
-    node list (reference: topology_aware_scheduler.go:291-337). A node that
-    fits but is bad / non-suggested fails the whole attempt so the caller can
-    fall back (relaxed split or K8s retry). The caller
-    (``_update_cluster_view``) guarantees the view is already sorted."""
+    node list (reference: topology_aware_scheduler.go:291-337, made
+    chip-granular: capacity is counted over USABLE chips — bad and draining
+    leaves are discounted — so a host with one dead chip still serves
+    smaller pods instead of condemning the whole node). A node that fits
+    only by counting unusable chips is skipped (recorded as the failure
+    reason); a usable node outside the suggested set still fails the whole
+    attempt so the caller can fall back (relaxed split or K8s retry). The
+    caller (``_update_cluster_view``) guarantees the view is already
+    sorted."""
     picked = [0] * len(leaf_cell_nums)
     pod_index = 0
     picked_leaf_num = 0
     node_index = 0
+    bad_reason = ""
     while node_index < len(view):
         n = view[node_index]
-        if n.free_at_priority - picked_leaf_num >= leaf_cell_nums[pod_index]:
-            if not n.healthy:
-                return None, f"have to use at least one bad node {n.node_address}"
+        needed = leaf_cell_nums[pod_index]
+        if n.free_at_priority - n.unusable_free - picked_leaf_num >= needed:
             if not n.suggested:
                 return (
                     None,
@@ -416,9 +511,19 @@ def _find_nodes_for_pods(
             if pod_index == len(leaf_cell_nums):
                 return picked, ""
         else:
+            if (
+                not bad_reason
+                and n.unusable_free > 0
+                and n.free_at_priority - picked_leaf_num >= needed
+            ):
+                # Would fit counting its bad/draining chips: the truthful
+                # wait reason when nothing else fits either.
+                bad_reason = (
+                    f"have to use at least one bad node {n.node_address}"
+                )
             picked_leaf_num = 0
             node_index += 1
-    return None, "insufficient capacity"
+    return None, bad_reason or "insufficient capacity"
 
 
 def _optimal_affinity(
@@ -458,10 +563,14 @@ def _collect_leaf_cells(
     c: Cell, p: CellPriority, free: List[Cell], preemptible: List[Cell]
 ) -> None:
     """Collect free then preemptible (strictly lower priority) chips in a
-    node (reference: topology_aware_scheduler.go:465-476)."""
+    node (reference: topology_aware_scheduler.go:465-476). Bad and draining
+    chips are never offered — chip-granular health means the rest of the
+    node still is."""
     if c.level > LOWEST_LEVEL:
         for cc in c.children:
             _collect_leaf_cells(cc, p, free, preemptible)
+    elif _leaf_unusable(c):
+        return
     elif c.priority == FREE_PRIORITY:
         free.append(c)
     elif c.priority < p:

@@ -38,6 +38,24 @@ class Config:
     # cannot hold an HTTP worker for the full retry budget
     # (doc/fault-model.md). 0 disables the cap.
     request_deadline_seconds: float = 30.0
+    # Hardware health plane (doc/fault-model.md "Hardware health plane").
+    # Flap damping: once a node/chip health target has flapped
+    # `health_flap_threshold` times within `health_flap_window` health
+    # ticks, further transitions are HELD until `health_flap_hold` quiet
+    # ticks pass, then the latest desired state applies (a settled
+    # transition is never lost). Event-clocked — one tick per informer
+    # relist / watch-cycle end (health_tick), NOT per observation, so the
+    # window is cluster-size-independent and chaos schedules stay
+    # deterministic. Threshold 0 disables damping.
+    health_flap_threshold: int = 3
+    health_flap_window: int = 8
+    health_flap_hold: int = 4
+    # Stranded-gang remediation policy: when True, gangs holding bad or
+    # draining cells are lazily evicted (their pods deleted through the
+    # kube client) once the underlying health transition has settled;
+    # when False (default) they are only surfaced (/v1/inspect/health,
+    # strandedGroupCount).
+    stranded_gang_eviction: bool = False
     physical_cluster: api.PhysicalClusterSpec = field(
         default_factory=api.PhysicalClusterSpec
     )
@@ -50,6 +68,9 @@ class Config:
         fpbt = d.get("forcePodBindThreshold")
         wait_ms = d.get("waitingPodSchedulingBlockMilliSec")
         deadline_s = d.get("requestDeadlineSeconds")
+        flap_t = d.get("healthFlapThreshold")
+        flap_w = d.get("healthFlapWindow")
+        flap_h = d.get("healthFlapHold")
         c = Config(
             kube_apiserver_address=d.get("kubeApiServerAddress"),
             kube_config_file_path=d.get("kubeConfigFilePath"),
@@ -61,6 +82,10 @@ class Config:
             request_deadline_seconds=(
                 30.0 if deadline_s is None else float(deadline_s)
             ),
+            health_flap_threshold=3 if flap_t is None else int(flap_t),
+            health_flap_window=8 if flap_w is None else int(flap_w),
+            health_flap_hold=4 if flap_h is None else int(flap_h),
+            stranded_gang_eviction=bool(d.get("strandedGangEviction", False)),
             physical_cluster=api.PhysicalClusterSpec.from_dict(
                 d.get("physicalCluster")
             ),

@@ -43,6 +43,21 @@ ANNOTATION_POD_TPU_ENV = GROUP_NAME + "/pod-tpu-env"
 # annotation once the pod binds (doc/fault-model.md "Preemption plane").
 ANNOTATION_POD_PREEMPT_INFO = GROUP_NAME + "/pod-preempt-info"
 
+# Node annotation (hardware health plane): comma-separated chip indices the
+# device plane reports BAD on this node (e.g. "1,3"). Absent/empty = all
+# chips healthy. Per-chip node conditions of type
+# "<GROUP_NAME>/chip-<index>" with status "False" mean the same thing; the
+# scheduler merges both sources. Chip badness composes with node badness —
+# a chip is bad while either holds — and is damped by the same flap gate.
+ANNOTATION_NODE_DEVICE_HEALTH = GROUP_NAME + "/device-health"
+
+# Node annotation (maintenance plane): drain request. "*" (or "all"/"true")
+# cordons every chip on the node; a comma-separated index list ("0,2")
+# drains just those chips. Draining cells take no NEW placements; running
+# gangs keep their cells. Lifted when the annotation clears or the node is
+# deleted. Never damped — drains are deliberate operator actions.
+ANNOTATION_NODE_DRAIN = GROUP_NAME + "/drain"
+
 # The scheduler-owned ConfigMap persisting the advisory doomed-bad-cell
 # ledger (which bad cell each VC's unsatisfiable quota is pinned to), so a
 # restart reconstructs the same advisory bindings instead of re-deriving
@@ -77,6 +92,11 @@ VIRTUAL_CLUSTERS_PATH = CLUSTER_STATUS_PATH + "/virtualclusters/"
 # absent from the current config) are parked here instead of crashing
 # recovery; see doc/fault-model.md.
 QUARANTINE_PATH = INSPECT_PATH + "/quarantine"
+
+# The hardware health plane: applied bad nodes/chips, maintenance drains,
+# flap-damper state (held transitions), and stranded gangs (groups holding
+# bad or draining cells). See doc/fault-model.md "Hardware health plane".
+HEALTH_PATH = INSPECT_PATH + "/health"
 
 # Probe endpoints (no reference analog; the reference relies on the informer
 # WaitForCacheSync ordering alone). /healthz is liveness (process up);

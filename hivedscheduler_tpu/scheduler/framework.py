@@ -25,6 +25,7 @@ from ..api import constants, extender as ei, types as api
 from ..api.config import Config
 from ..algorithm.core import HivedCore
 from ..algorithm.placement import PhaseStats
+from . import health as health_mod
 from .types import (
     Node,
     Pod,
@@ -38,6 +39,7 @@ from .types import (
     is_allocated_state,
     is_bound,
     is_interested,
+    is_node_healthy,
     new_binding_pod,
 )
 
@@ -75,6 +77,10 @@ class KubeClient:
     def load_scheduler_state(self) -> Optional[str]:
         """Read the scheduler-owned state blob; None when absent."""
         return None
+
+    def evict_pod(self, pod: Pod) -> None:
+        """Delete a pod (stranded-gang remediation). The informer's DELETED
+        event then releases its cells through the normal lifecycle."""
 
 
 class NullKubeClient(KubeClient):
@@ -122,6 +128,16 @@ class SchedulerMetrics:
         self.ledger_persist_failure_count = 0
         self.preemption_recovered_count = 0
         self.preemption_cancelled_on_recovery_count = 0
+        # Health-plane counters (doc/fault-model.md "Hardware health
+        # plane"): transitions actually applied to the core, observations
+        # held by the flap damper, held transitions later settled, doomed
+        # dooms whose ledger writes were coalesced into one ConfigMap
+        # write, and stranded-gang evictions issued.
+        self.health_transition_count = 0
+        self.health_damped_count = 0
+        self.health_settled_count = 0
+        self.ledger_coalesced_count = 0
+        self.stranded_eviction_count = 0
         # Framework-side phases (same accumulator/formatter as the core's
         # leaf-cell-search stats, so the merged "phases" payload is uniform).
         self.phase_stats = PhaseStats()
@@ -186,6 +202,26 @@ class SchedulerMetrics:
             else:
                 self.preemption_cancelled_on_recovery_count += 1
 
+    def observe_health_transition(self) -> None:
+        with self._lock:
+            self.health_transition_count += 1
+
+    def observe_health_damped(self) -> None:
+        with self._lock:
+            self.health_damped_count += 1
+
+    def observe_health_settled(self) -> None:
+        with self._lock:
+            self.health_settled_count += 1
+
+    def observe_ledger_coalesced(self, n: int) -> None:
+        with self._lock:
+            self.ledger_coalesced_count += n
+
+    def observe_stranded_eviction(self) -> None:
+        with self._lock:
+            self.stranded_eviction_count += 1
+
     def snapshot(self) -> Dict:
         with self._lock:
             lat = sorted(self.filter_latencies_s)
@@ -219,6 +255,11 @@ class SchedulerMetrics:
                 "preemptionCancelledOnRecoveryCount": (
                     self.preemption_cancelled_on_recovery_count
                 ),
+                "healthTransitionCount": self.health_transition_count,
+                "healthDampedCount": self.health_damped_count,
+                "healthSettledCount": self.health_settled_count,
+                "doomedLedgerCoalescedCount": self.ledger_coalesced_count,
+                "strandedEvictionCount": self.stranded_eviction_count,
                 "phases": self.phase_stats.snapshot(),
             }
 
@@ -276,6 +317,34 @@ class HivedScheduler:
         self._persisted_doomed_epoch = -1
         self._ledger_write_lock = threading.Lock()
         self.core.preemption_observer = self._on_preemption_event
+        # Hardware health plane (doc/fault-model.md "Hardware health
+        # plane"): node/chip health observations pass through an
+        # event-clocked flap damper before touching the core, so a flapping
+        # node settles instead of storming doom churn and ledger rewrites.
+        # Drains apply undamped (deliberate operator actions).
+        self._health_clock = 0
+        self._damper = health_mod.FlapDamper(
+            config.health_flap_threshold,
+            config.health_flap_window,
+            config.health_flap_hold,
+        )
+        # Per-node chip targets the damper has ever been told about, so a
+        # chip dropping OUT of the device-health annotation is observed as
+        # a heal rather than silently forgotten.
+        self._chip_targets: Dict[str, set] = {}
+        # Stranded-gang remediation: groups already evicted (never evict a
+        # gang twice), the pod uids whose delete already landed (a partial
+        # failure re-arms the gang but must not re-delete these), and the
+        # pods queued for eviction, flushed outside the lock at mutator
+        # exit like every other kube write.
+        self._evicted_groups: set = set()
+        self._evicted_pod_uids: set = set()
+        self._pending_evictions: List = []
+        # Set when an eviction write failed: the next mutator-exit flush
+        # re-runs the stranded check so the retry does not have to wait
+        # for another health transition (which may never come on a quiet
+        # cluster).
+        self._eviction_retry_pending = False
 
     @staticmethod
     def _default_executor(fn: Callable[[], None]) -> None:
@@ -306,6 +375,15 @@ class HivedScheduler:
         the live view), so failures log and count — never raise into the
         scheduling path."""
         self._flush_annotation_clears()
+        self._flush_evictions()
+        if self._eviction_retry_pending:
+            # A prior eviction write failed: re-detect and re-queue now
+            # (one retry round per flush — a still-failing write re-sets
+            # the flag for the NEXT mutator exit, so this cannot loop).
+            with self._lock:
+                self._eviction_retry_pending = False
+                self._check_stranded_locked()
+            self._flush_evictions()
         self._persist_doomed_ledger()
 
     def _flush_annotation_clears(self) -> None:
@@ -356,6 +434,14 @@ class HivedScheduler:
                 )
                 return
             self.metrics.observe_ledger_persist(True)
+            if self._persisted_doomed_epoch >= 0:
+                # N epoch bumps since the last landed write collapsed into
+                # one ConfigMap write: the per-mutator flush (plus flap
+                # damping upstream) is what keeps heavy node churn from
+                # storming the apiserver with ledger rewrites.
+                coalesced = epoch - self._persisted_doomed_epoch - 1
+                if coalesced > 0:
+                    self.metrics.observe_ledger_coalesced(coalesced)
             self._persisted_doomed_epoch = epoch
 
     def get_doomed_ledger(self) -> Dict:
@@ -534,7 +620,9 @@ class HivedScheduler:
             }
 
     # ------------------------------------------------------------------ #
-    # Node events (reference: scheduler.go:218-251)
+    # Node events (reference: scheduler.go:218-251), routed through the
+    # hardware health plane: ready-state and per-chip device health pass
+    # the flap damper; drains apply directly.
     # ------------------------------------------------------------------ #
 
     def add_node(self, node: Node) -> None:
@@ -542,7 +630,7 @@ class HivedScheduler:
         try:
             with self._lock:
                 self.nodes[node.name] = node
-                self.core.add_node(node)
+                self._observe_node_health(node)
         finally:
             self._exit_mutation()
 
@@ -551,7 +639,7 @@ class HivedScheduler:
         try:
             with self._lock:
                 self.nodes[new.name] = new
-                self.core.update_node(old, new)
+                self._observe_node_health(new)
         finally:
             self._exit_mutation()
 
@@ -560,9 +648,238 @@ class HivedScheduler:
         try:
             with self._lock:
                 self.nodes.pop(node.name, None)
+                # The node's flap history and chip targets die with it; the
+                # core lifts its drain and marks it bad.
+                self._damper.forget_node(node.name)
+                self._chip_targets.pop(node.name, None)
                 self.core.delete_node(node)
+                self.metrics.observe_health_transition()
+                self._check_stranded_locked()
         finally:
             self._exit_mutation()
+
+    # ------------------------------------------------------------------ #
+    # Health plane (doc/fault-model.md "Hardware health plane")
+    # ------------------------------------------------------------------ #
+
+    def _observe_node_health(self, node: Node) -> None:
+        """Under the lock: feed the node's desired health (ready-state +
+        device-health chips) through the flap damper, apply what the damper
+        admits plus anything it settles, and reconcile the (undamped) drain
+        annotation.
+
+        The damper clock deliberately does NOT advance per observation:
+        it ticks only via health_tick() (informer relists and watch-cycle
+        ends, or one tick per harness event). Advancing per node event
+        would make the window cluster-size-dependent — with more
+        heartbeating nodes than `health_flap_window`, one node's
+        consecutive flips would always fall out of its own window and
+        damping would be mathematically inert at fleet scale."""
+        clock = self._health_clock
+        applied = False
+        applied |= self._observe_target(
+            ("node", node.name), is_node_healthy(node), clock
+        )
+        bad_chips = health_mod.device_bad_chips(node)
+        targets = self._chip_targets.setdefault(node.name, set())
+        targets |= bad_chips
+        for chip in sorted(targets):
+            applied |= self._observe_target(
+                ("chip", node.name, chip), chip not in bad_chips, clock
+            )
+        applied |= self._apply_settled(clock)
+        drain = health_mod.drain_chip_indices(
+            node, self.core.node_chip_indices(node.name)
+        )
+        if drain != self.core.draining_chips.get(node.name, set()):
+            self.core.apply_drain(node.name, drain)
+            applied = True
+        if applied:
+            self._check_stranded_locked()
+
+    def _observe_target(self, target, desired_healthy: bool, clock) -> bool:
+        rec_before = self._damper.pending_count()
+        if self._damper.observe(target, desired_healthy, clock):
+            self._apply_health_transition(target, desired_healthy)
+            return True
+        if self._damper.pending_count() > rec_before:
+            self.metrics.observe_health_damped()
+        return False
+
+    def _apply_health_transition(self, target, healthy: bool) -> None:
+        if target[0] == "node":
+            if healthy:
+                self.core.set_healthy_node(target[1])
+            else:
+                self.core.set_bad_node(target[1])
+        else:  # ("chip", node, index)
+            if healthy:
+                self.core.set_healthy_leaf(target[1], target[2])
+            else:
+                self.core.set_bad_leaf(target[1], target[2])
+        self.metrics.observe_health_transition()
+
+    def _apply_settled(self, clock) -> bool:
+        applied = False
+        for target, healthy in self._damper.settled(clock):
+            self._apply_health_transition(target, healthy)
+            self.metrics.observe_health_settled()
+            applied = True
+        return applied
+
+    def health_tick(self) -> None:
+        """Advance the event clock without a node observation, settling any
+        quiet held transitions. Called by the informer on relists (and by
+        harnesses each event) so a flap that simply stops still settles."""
+        self._enter_mutation()
+        try:
+            with self._lock:
+                self._health_clock += 1
+                if self._apply_settled(self._health_clock):
+                    self._check_stranded_locked()
+        finally:
+            self._exit_mutation()
+
+    def settle_health_now(self) -> None:
+        """Force-apply every held transition immediately (teardown and
+        restart-projection paths that need the damper drained)."""
+        self._enter_mutation()
+        try:
+            with self._lock:
+                applied = False
+                for target, healthy in self._damper.force_settle():
+                    self._apply_health_transition(target, healthy)
+                    self.metrics.observe_health_settled()
+                    applied = True
+                if applied:
+                    self._check_stranded_locked()
+        finally:
+            self._exit_mutation()
+
+    def health_pending_count(self) -> int:
+        with self._lock:
+            return self._damper.pending_count()
+
+    def _stranded_groups_locked(self) -> List[Dict]:
+        """Gangs holding bad or draining cells — placed before the hardware
+        degraded (new placements never land on such cells)."""
+        out: List[Dict] = []
+        for name, g in sorted(self.core.affinity_groups.items()):
+            bad: List[str] = []
+            draining: List[str] = []
+            for rows in g.physical_placement.values():
+                for row in rows:
+                    for leaf in row:
+                        if leaf is None:
+                            continue
+                        if not leaf.healthy:
+                            bad.append(leaf.address)
+                        elif leaf.draining:
+                            draining.append(leaf.address)
+            if bad or draining:
+                out.append(
+                    {
+                        "name": name,
+                        "vc": str(g.vc),
+                        "state": g.state.value,
+                        "badCells": sorted(bad),
+                        "drainingCells": sorted(draining),
+                    }
+                )
+        return out
+
+    def _stranded_group_count_locked(self) -> int:
+        """Count-only variant with per-group early exit: the metrics scrape
+        runs under the scheduler lock and must not build the full per-cell
+        attribution lists the inspect endpoint serves."""
+        n = 0
+        for g in self.core.affinity_groups.values():
+            if any(
+                leaf is not None and (not leaf.healthy or leaf.draining)
+                for rows in g.physical_placement.values()
+                for row in rows
+                for leaf in row
+            ):
+                n += 1
+        return n
+
+    def _check_stranded_locked(self) -> None:
+        """Stranded-gang remediation under the eviction policy knob: queue
+        the pods of newly-stranded gangs for (lazy) eviction. Runs after
+        APPLIED health transitions only, so a flap held by the damper never
+        evicts anybody."""
+        if not self.config.stranded_gang_eviction:
+            return
+        for rec in self._stranded_groups_locked():
+            name = rec["name"]
+            if name in self._evicted_groups:
+                continue
+            g = self.core.affinity_groups.get(name)
+            if g is None:
+                continue
+            pods = [
+                p
+                for pods in g.allocated_pods.values()
+                for p in pods
+                if p is not None and p.uid not in self._evicted_pod_uids
+            ]
+            if not pods:
+                continue
+            self._evicted_groups.add(name)
+            self._pending_evictions.extend((name, p) for p in pods)
+        # Groups that completed/died release their eviction memory.
+        self._evicted_groups &= set(self.core.affinity_groups)
+        live_uids = {
+            p.uid
+            for g in self.core.affinity_groups.values()
+            for pods in g.allocated_pods.values()
+            for p in pods
+            if p is not None
+        }
+        self._evicted_pod_uids &= live_uids
+
+    def _flush_evictions(self) -> None:
+        with self._lock:
+            evictions, self._pending_evictions = self._pending_evictions, []
+        for group_name, pod in evictions:
+            try:
+                self.kube_client.evict_pod(pod)
+                with self._lock:
+                    self._evicted_pod_uids.add(pod.uid)
+                self.metrics.observe_stranded_eviction()
+                common.log.warning(
+                    "[%s]: evicted (stranded gang remediation: the gang "
+                    "holds bad or draining cells)", pod.key,
+                )
+            except Exception as e:  # noqa: BLE001
+                # Re-arm the gang so the next flush's stranded re-check
+                # retries — only the pods whose delete never landed are
+                # re-queued (_evicted_pod_uids).
+                with self._lock:
+                    self._evicted_groups.discard(group_name)
+                    self._eviction_retry_pending = True
+                common.log.warning(
+                    "[%s]: stranded-gang eviction failed (retried at the "
+                    "next flush): %s", pod.key, e,
+                )
+
+    def get_health(self) -> Dict:
+        """Inspect payload for /v1/inspect/health: applied badness and
+        drains (core), held transitions (damper), and stranded gangs."""
+        with self._lock:
+            payload = self.core.health_snapshot()
+            payload["clock"] = self._health_clock
+            payload["damper"] = {
+                "pendingCount": self._damper.pending_count(),
+                "held": self._damper.snapshot(),
+            }
+            stranded = self._stranded_groups_locked()
+            payload["strandedGroups"] = stranded
+            payload["strandedGroupCount"] = len(stranded)
+            payload["evictionPolicy"] = (
+                "evict" if self.config.stranded_gang_eviction else "surface"
+            )
+        return payload
 
     # ------------------------------------------------------------------ #
     # Pod events (reference: scheduler.go:253-360)
@@ -1132,5 +1449,14 @@ class HivedScheduler:
         snap["phases"].update(self.core.phase_stats.snapshot())
         with self._lock:
             snap["quarantinedPodCount"] = len(self.quarantined_pods)
+            snap["strandedGroupCount"] = self._stranded_group_count_locked()
+            snap["badNodeCount"] = len(self.core.bad_nodes)
+            snap["badChipCount"] = sum(
+                len(c) for c in self.core.bad_chips.values()
+            )
+            snap["drainingChipCount"] = sum(
+                len(c) for c in self.core.draining_chips.values()
+            )
+            snap["healthPendingCount"] = self._damper.pending_count()
         snap["ready"] = self.is_ready()
         return snap

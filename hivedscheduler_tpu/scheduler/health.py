@@ -1,0 +1,202 @@
+"""The hardware health plane: chip-granular health inputs + flap damping.
+
+TPUs fail and get maintained at finer granularity than nodes: Cloud TPU
+surfaces per-chip faults and advance maintenance notices, while the node
+object stays Ready. This module turns those signals into the core's
+chip-granular health primitives (doc/fault-model.md "Hardware health
+plane"):
+
+- :func:`device_bad_chips` parses the device-health annotation and the
+  per-chip node conditions into the set of BAD chip indices on a node;
+- :func:`drain_chip_indices` parses the drain annotation into the set of
+  DRAINING chip indices (no new placements; running gangs keep cells);
+- :class:`FlapDamper` is the hysteresis gate health transitions pass
+  through before being applied, so a flapping node settles instead of
+  storming doom-bind/retire churn and doomed-ledger rewrites.
+
+The damper is **event-clocked**: time is a counter of explicit ticks
+(`HivedScheduler.health_tick` — one per informer relist / watch-cycle end,
+or one per harness event), never the wall clock, so chaos schedules replay
+deterministically from their seed. Observations do NOT advance the clock:
+a per-observation clock would scale the window with cluster size and turn
+damping off exactly on large fleets. Semantics:
+
+- the FIRST observation of a target always applies (recovery replays the
+  current cluster state through the damper with no delay);
+- a transition applies immediately unless the target has already flapped
+  ``threshold`` times within the last ``window`` clock ticks — then the
+  desired state is HELD (pending) and kept up to date as further flips
+  arrive;
+- once ``hold`` ticks pass with no further flip, the LATEST desired state
+  applies ("a settled transition is never lost");
+- a flip back to the applied state simply clears the pending hold (there
+  is nothing left to settle).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Dict, List, Optional, Set, Tuple
+
+from ..api import constants
+from .types import Node
+
+# A damper target: ("node", node_name) or ("chip", node_name, chip_index).
+Target = Tuple
+
+_CHIP_CONDITION_PREFIX = constants.GROUP_NAME + "/chip-"
+
+_DRAIN_ALL = ("*", "all", "true")
+
+
+def _parse_indices(value: str) -> Set[int]:
+    out: Set[int] = set()
+    for part in value.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            out.add(int(part))
+        except ValueError:
+            continue  # operator typo: ignore the token, keep the rest
+    return out
+
+
+def device_bad_chips(node: Node) -> Set[int]:
+    """Chip indices reported bad on this node: the device-health annotation
+    (comma-separated indices) merged with per-chip node conditions
+    (``<group>/chip-<i>`` status False)."""
+    bad = _parse_indices(
+        node.annotations.get(constants.ANNOTATION_NODE_DEVICE_HEALTH, "")
+    )
+    for ctype, ok in node.conditions.items():
+        if not ok and ctype.startswith(_CHIP_CONDITION_PREFIX):
+            try:
+                bad.add(int(ctype[len(_CHIP_CONDITION_PREFIX):]))
+            except ValueError:
+                continue
+    return bad
+
+
+def drain_chip_indices(node: Node, all_chips: Set[int]) -> Set[int]:
+    """Chip indices the drain annotation cordons on this node: the whole
+    node ("*"/"all"/"true") or a comma-separated index list; absent/empty
+    means no drain."""
+    value = node.annotations.get(constants.ANNOTATION_NODE_DRAIN, "").strip()
+    if not value:
+        return set()
+    if value.lower() in _DRAIN_ALL:
+        return set(all_chips)
+    # Clamp to chips the config actually places on the node (an index for
+    # hardware we do not manage is a no-op, not an error — and a node the
+    # config does not manage at all has nothing to drain).
+    return _parse_indices(value) & all_chips
+
+
+class _TargetRecord:
+    __slots__ = ("applied", "pending", "stamps", "last_flip")
+
+    def __init__(self, applied: bool):
+        self.applied = applied
+        self.pending: Optional[bool] = None
+        self.stamps: Deque[int] = deque()
+        self.last_flip = -(1 << 30)
+
+
+class FlapDamper:
+    """Per-target hysteresis for health transitions (see module docstring).
+    threshold <= 0 disables damping entirely (every observation applies)."""
+
+    def __init__(self, threshold: int, window: int, hold: int):
+        self.threshold = threshold
+        self.window = max(1, window)
+        self.hold = max(1, hold)
+        self._records: Dict[Target, _TargetRecord] = {}
+
+    def observe(self, target: Target, desired: bool, clock: int) -> bool:
+        """Record a desired health state for a target at ``clock``. Returns
+        True when the transition should be applied NOW; False when it is a
+        no-op or held for settling (collect via :meth:`settled`)."""
+        rec = self._records.get(target)
+        if rec is None:
+            # First sighting always applies: recovery replays the current
+            # cluster state with zero delay, and a brand-new node cannot
+            # have flapped yet.
+            self._records[target] = _TargetRecord(desired)
+            return True
+        if desired == rec.applied:
+            # Flapped back before the hold expired: nothing to settle.
+            rec.pending = None
+            return False
+        if rec.pending is not None and desired == rec.pending:
+            # A REPEATED identical observation of a held target (kubelet
+            # heartbeats, relist re-deliveries) is not a flip: re-stamping
+            # it would extend the hold forever and a genuinely-bad node
+            # would never settle bad.
+            return False
+        rec.stamps.append(clock)
+        rec.last_flip = clock
+        while rec.stamps and rec.stamps[0] <= clock - self.window:
+            rec.stamps.popleft()
+        if self.threshold > 0 and len(rec.stamps) >= self.threshold:
+            rec.pending = desired
+            return False
+        rec.applied = desired
+        return True
+
+    def settled(self, clock: int) -> List[Tuple[Target, bool]]:
+        """Held transitions whose targets stayed quiet for ``hold`` ticks:
+        their latest desired state is promoted to applied and returned for
+        the caller to enact."""
+        out: List[Tuple[Target, bool]] = []
+        for target, rec in self._records.items():
+            if rec.pending is None:
+                continue
+            if clock - rec.last_flip >= self.hold:
+                rec.applied = rec.pending
+                rec.pending = None
+                out.append((target, rec.applied))
+        return out
+
+    def force_settle(self) -> List[Tuple[Target, bool]]:
+        """Promote every held transition immediately (teardown / projection
+        paths that need the damper drained deterministically)."""
+        out: List[Tuple[Target, bool]] = []
+        for target, rec in self._records.items():
+            if rec.pending is not None:
+                rec.applied = rec.pending
+                rec.pending = None
+                out.append((target, rec.applied))
+        return out
+
+    def pending_count(self) -> int:
+        return sum(
+            1 for rec in self._records.values() if rec.pending is not None
+        )
+
+    def forget_node(self, node_name: str) -> None:
+        """Drop every record touching a node (node deleted: its flap
+        history dies with it)."""
+        for target in [
+            t for t in self._records if t[1] == node_name
+        ]:
+            del self._records[target]
+
+    def snapshot(self) -> List[Dict]:
+        """Inspect view: the currently-held transitions."""
+        out: List[Dict] = []
+        for target, rec in sorted(self._records.items(), key=str):
+            if rec.pending is None:
+                continue
+            entry: Dict = {
+                "target": (
+                    f"node:{target[1]}"
+                    if target[0] == "node"
+                    else f"chip:{target[1]}:{target[2]}"
+                ),
+                "applied": "healthy" if rec.applied else "bad",
+                "pending": "healthy" if rec.pending else "bad",
+                "lastFlipClock": rec.last_flip,
+            }
+            out.append(entry)
+        return out

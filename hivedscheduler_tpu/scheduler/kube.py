@@ -280,6 +280,19 @@ class RetryingKubeClient(KubeClient):
             "scheduler-state ConfigMap read", self.inner.load_scheduler_state
         )
 
+    def evict_pod(self, pod: Pod) -> None:
+        try:
+            self._retrying_op(
+                f"[{pod.key}]: stranded-gang eviction",
+                lambda: self.inner.evict_pod(pod),
+            )
+        except KubeAPIError as e:
+            if e.status == 404:
+                # Already gone (deleted by a prior eviction round or by its
+                # owner): the desired state holds — eviction is idempotent.
+                return
+            raise
+
 
 class KubeAPIClient(KubeClient):
     """The thin K8s REST surface the scheduler needs."""
@@ -423,6 +436,14 @@ class KubeAPIClient(KubeClient):
             content_type="application/merge-patch+json",
         )
 
+    def evict_pod(self, pod) -> None:
+        """Delete a pod (stranded-gang remediation): the informer's DELETED
+        event then releases its cells through the normal lifecycle."""
+        self._request(
+            "DELETE",
+            f"/api/v1/namespaces/{pod.namespace}/pods/{pod.name}",
+        )
+
     def _state_namespace(self) -> str:
         ns = getattr(self, "_namespace", None)
         if ns is None:
@@ -504,14 +525,22 @@ class KubeAPIClient(KubeClient):
 
 def _node_from_k8s(obj: Dict) -> Node:
     status = obj.get("status") or {}
-    ready = any(
-        c.get("type") == "Ready" and c.get("status") == "True"
+    meta = obj.get("metadata") or {}
+    conditions = {
+        str(c.get("type", "")): c.get("status") == "True"
         for c in status.get("conditions", [])
-    )
+        if c.get("type")
+    }
     return Node(
-        name=str((obj.get("metadata") or {}).get("name", "")),
+        name=str(meta.get("name", "")),
         unschedulable=bool((obj.get("spec") or {}).get("unschedulable", False)),
-        ready=ready,
+        ready=conditions.get("Ready", False),
+        # Health-plane inputs: the device-health / drain annotations and
+        # the per-chip conditions (scheduler.health parses them).
+        annotations={
+            str(k): str(v) for k, v in (meta.get("annotations") or {}).items()
+        },
+        conditions=conditions,
     )
 
 
@@ -652,6 +681,10 @@ class InformerLoop:
                     if rv:
                         resource_version = rv
                 # Bounded watch ended normally; resume from the last RV.
+                # Tick the health plane so held flaps settle on quiet
+                # clusters (one tick per watch period, deterministic in
+                # tests because test informers drive events directly).
+                self.scheduler.health_tick()
             except _WatchGap as e:
                 common.log.warning("watch %s gap (%s); relisting", path, e)
                 # Backoff here too: a deterministically-failing handler
@@ -659,6 +692,10 @@ class InformerLoop:
                 self._stop.wait(backoff)
                 backoff = min(backoff * 2, self.BACKOFF_MAX_S)
                 resource_version = self._relist_until_success(relist, path)
+                # Advance the health plane's event clock: a flap that
+                # simply stopped still settles even with no further node
+                # events arriving.
+                self.scheduler.health_tick()
             except (
                 urllib.error.URLError, KubeAPIError, OSError,
                 json.JSONDecodeError,

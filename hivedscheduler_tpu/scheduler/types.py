@@ -44,6 +44,10 @@ class Node:
     name: str
     unschedulable: bool = False
     ready: bool = True
+    # Health-plane inputs: the device-health / drain annotations and the
+    # node conditions (type -> status=="True"), parsed by scheduler.health.
+    annotations: Dict[str, str] = field(default_factory=dict)
+    conditions: Dict[str, bool] = field(default_factory=dict)
 
 
 def is_completed(pod: Pod) -> bool:

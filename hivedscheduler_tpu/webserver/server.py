@@ -201,6 +201,8 @@ def _make_handler(scheduler: HivedScheduler):
                 return scheduler.get_quarantine()
             if path == constants.DOOMED_LEDGER_PATH:
                 return scheduler.get_doomed_ledger()
+            if path == constants.HEALTH_PATH:
+                return scheduler.get_health()
             if path == agp or path == agp.rstrip("/"):
                 return scheduler.get_all_affinity_groups()
             if path.startswith(agp):

@@ -6,13 +6,20 @@ floor; export a larger value for soak runs, or use hack/soak.sh /
 tests/test_chaos_soak.py): each schedule interleaves node bad/heal churn,
 pod churn, missed deletes, injected bind faults, annotation corruption,
 preemption lifecycle events (preempt_routine, victim deletion mid-preempt,
-preemptor cancellation, crash during Reserving/Reserved), and
-reconfiguration restarts (quota swapped between VCs), performs at least one
-crash-restart, audits the invariants after every event — including
-reservation conservation and preemption progress — asserts STRICT
-restart-equivalence (full quota ledgers, free sets, doomed listings, probe
-outcomes; no advisory-doom hysteresis gate, thanks to the persisted doomed
-ledger), and must tear down to a pristine core (zero leaked cells).
+preemptor cancellation, crash during Reserving/Reserved), reconfiguration
+restarts (quota swapped between VCs), and the hardware health plane (chip
+faults/heals via the device-health annotation, flap storms held by the
+damper, maintenance drains, and scripted write-path faults for the
+preempt-info checkpoint + doomed-ledger ConfigMap). Every schedule performs
+at least one crash-restart, audits the invariants after every event —
+including reservation conservation, preemption progress, and health
+consistency (applied badness == cell-tree propagation == inspect view;
+draining cells never newly placed; damping never loses a settled
+transition) — asserts STRICT restart-equivalence (full quota ledgers, free
+sets, doomed listings, probe outcomes) except at crashes landing inside a
+documented degraded window (stale ledger/checkpoint, damper-held
+transitions), where recovery DETERMINISM is asserted instead, and must
+tear down to a pristine core (zero leaked cells).
 """
 
 import os
@@ -38,32 +45,33 @@ CHAOS_ROUNDS = int(os.environ.get("HIVED_CHAOS_ROUNDS", "0")) or 220
 # Seeds whose schedules corrupt a surviving bound pod's bind-info BEFORE a
 # crash-restart — the schedules that die if recovery regresses from
 # quarantining to raising (see test_rebroken_recover_is_caught below).
-CORRUPTION_RESTART_SEEDS = (11, 12, 14, 16, 20, 21)
+# (Re-derived for the PR-4 health-plane event mix; the mix change shifts
+# every schedule's rng stream, so the PR-3 pins no longer apply.)
+CORRUPTION_RESTART_SEEDS = (3, 8, 11, 23, 27, 33)
 
 # Seeds whose schedules crash-restart while a PREEMPTING group holds a
 # Reserving/Reserved reservation — the schedules that die if
 # Reserving/Reserved recovery is re-broken (sensitivity meta-test below).
-RESERVING_RECOVERY_SEEDS = (15, 19, 28, 37, 67, 86)
+RESERVING_RECOVERY_SEEDS = (52, 80, 104, 118, 137, 179)
+
+# Seeds whose schedules run a flap storm — the schedules that die if flap
+# damping is disabled (the harness asserts the damper holds a storm to at
+# most threshold-1 applied transitions; see test_disabled_damping_is_caught).
+DAMPING_DISABLED_SEEDS = (3, 4, 10, 11, 12, 13)
 
 
 def test_chaos_seed_sweep():
-    stats = {
-        "restarts": 0, "corruptions": 0, "transient_faults": 0,
-        "give_up_faults": 0, "terminal_faults": 0, "missed_deletes": 0,
-        "relists": 0, "node_flips": 0, "binds": 0, "preempts": 0,
-        "preempt_resolved": 0, "preempt_cancelled": 0,
-        "preempt_restarts": 0, "preempt_recovered": 0,
-        "preempt_cancelled_on_recovery": 0, "reconfigs": 0,
-    }
+    stats = {}
     for seed in range(CHAOS_ROUNDS):
         for k, v in chaos.run_chaos_schedule(seed).items():
-            stats[k] += v
+            stats[k] = stats.get(k, 0) + v
     # The sweep must actually exercise the fault plane, not skate past it:
     # every schedule crash-restarts at least once, and across the seed set
-    # every injected fault class fires — including the preempt/reconfig
-    # plane: preemptions start, restart mid-Reserving/Reserved, recover or
-    # cancel on recovery, resolve, cancel live, and configs mutate between
-    # restarts.
+    # every injected fault class fires — the preempt/reconfig plane
+    # (preemptions start, restart mid-Reserving/Reserved, recover or
+    # cancel on recovery, resolve, cancel live, configs mutate between
+    # restarts) AND the health plane (chip faults/heals, flap storms,
+    # drains, write-path faults whose stale state degrades a crash).
     assert stats["restarts"] >= CHAOS_ROUNDS, stats
     assert stats["binds"] > CHAOS_ROUNDS, stats
     for key in (
@@ -72,6 +80,8 @@ def test_chaos_seed_sweep():
         "preempts", "preempt_resolved", "preempt_cancelled",
         "preempt_restarts", "preempt_recovered",
         "preempt_cancelled_on_recovery", "reconfigs",
+        "chip_faults", "chip_heals", "flap_storms", "drains",
+        "patch_faults", "state_faults", "degraded_crashes",
     ):
         assert stats[key] > 0, (key, stats)
 
@@ -117,6 +127,38 @@ def test_rebroken_reserving_recovery_is_caught(monkeypatch):
             caught += 1
     assert caught == len(RESERVING_RECOVERY_SEEDS), (
         "re-broken Reserving/Reserved recovery escaped the pinned seeds"
+    )
+
+
+def test_disabled_damping_is_caught(monkeypatch):
+    """Sensitivity meta-test for the health plane: disable flap damping
+    (every observation applies immediately — the pre-PR-4 behavior where a
+    flapping node stormed doom churn) and assert the pinned flap-storm
+    seeds fail the harness's damping bound (a storm must apply at most
+    threshold-1 transitions). If this passes while damping is broken, the
+    sweep is blind to the health plane."""
+    from hivedscheduler_tpu.scheduler import health
+
+    def passthrough(self, target, desired, clock):
+        rec = self._records.get(target)
+        if rec is None:
+            self._records[target] = health._TargetRecord(desired)
+            return True
+        if desired == rec.applied:
+            rec.pending = None
+            return False
+        rec.applied = desired
+        return True
+
+    monkeypatch.setattr(health.FlapDamper, "observe", passthrough)
+    caught = 0
+    for seed in DAMPING_DISABLED_SEEDS:
+        try:
+            chaos.run_chaos_schedule(seed)
+        except Exception:  # noqa: BLE001
+            caught += 1
+    assert caught == len(DAMPING_DISABLED_SEEDS), (
+        "disabled flap damping escaped the pinned chaos seeds"
     )
 
 

@@ -1,13 +1,17 @@
 """Slow-marked chaos soak: HIVED_CHAOS_ROUNDS-scale seed sweeps with the
-full event mix (preempt + reconfigure on), excluded from tier-1 by the
-``-m 'not slow'`` filter so CI wall time is unchanged. Driven by
-``hack/soak.sh``; run directly with e.g.
+full event mix (preempt + reconfigure + health plane on), excluded from
+tier-1 by the ``-m 'not slow'`` filter so CI wall time is unchanged.
+Driven by ``hack/soak.sh``; run directly with e.g.
 
     HIVED_CHAOS_ROUNDS=5000 HIVED_CHAOS_START=10000 \
         python -m pytest tests/test_chaos_soak.py -m slow -q
 
 ``HIVED_CHAOS_START`` defaults past the tier-1 range (0..219) so soaks
-cover fresh seeds instead of re-running CI's.
+cover fresh seeds instead of re-running CI's. ``HIVED_CHAOS_MIX`` reweights
+the event mix (see tests/chaos.py event_weights) — e.g.
+``HIVED_CHAOS_MIX=health:3`` triples the whole health-plane family
+(node flaps, chip faults/heals, flap storms, drain toggles) so soaks can
+hammer the hardware health plane specifically; hack/soak.sh sweeps it.
 """
 
 import os
@@ -32,8 +36,22 @@ def test_chaos_soak():
     for seed in range(SOAK_START, SOAK_START + SOAK_ROUNDS):
         for k, v in chaos.run_chaos_schedule(seed).items():
             stats[k] = stats.get(k, 0) + v
-    # A soak that somehow never preempts or reconfigures is not soaking
-    # the plane this harness exists to cover.
+    # A soak that somehow never preempts, reconfigures, or exercises the
+    # health plane is not soaking the planes this harness exists to cover.
+    # (Health events may be weighted OUT via HIVED_CHAOS_MIX; only insist
+    # on them when their weights are live.)
     assert stats["restarts"] >= SOAK_ROUNDS, stats
-    for key in ("preempts", "preempt_restarts", "reconfigs"):
+    weights = dict(chaos.event_weights())
+    required = []
+    if weights.get("preempt_start"):
+        required += ["preempts", "preempt_restarts"]
+    if weights.get("reconfigure_restart"):
+        required.append("reconfigs")
+    if weights.get("chip_fault"):
+        required.append("chip_faults")
+    if weights.get("flap_storm"):
+        required.append("flap_storms")
+    if weights.get("drain_toggle"):
+        required.append("drains")
+    for key in required:
         assert stats[key] > 0, (key, stats)

@@ -262,21 +262,23 @@ def run_table(table, cfg=None):
 # --------------------------------------------------------------------------- #
 
 NORMAL_OPS = [
-    # Packing: singletons pack onto one host before opening the next; the
-    # cluster-view packing sort starts at cell 0/3 (w12-w15).
-    step("n01", "VC1", 0, "v5p-chip", 2, ("bind", "v5p64-w12", (0, 1))),
-    step("n02", "VC1", 0, "v5p-chip", 2, ("bind", "v5p64-w12", (2, 3))),
-    step("n03", "VC1", 0, "v5p-chip", 1, ("bind", "v5p64-w13", (0,))),
-    step("n04", "VC1", 0, "v5p-chip", 4, ("bind", "v5p64-w14", (0, 1, 2, 3))),
-    # Whole-v5p-16-sized gang: packing fills 0/3's last free host first,
-    # then crosses into 0/1 (pack-over-affinity, crossPriorityPack).
-    step("n05", "VC1", 0, "v5p-chip", 4, ("bind", "v5p64-w15", (0, 1, 2, 3)),
+    # Packing: singletons pack onto one host before opening the next; cell
+    # candidates tie-break by config order (PR 4: placement is a pure
+    # function of cell state, never of free-list history), so the packing
+    # starts at cell 0/1 (w4-w7) — the lowest-order non-pinned free cell.
+    step("n01", "VC1", 0, "v5p-chip", 2, ("bind", "v5p64-w4", (0, 1))),
+    step("n02", "VC1", 0, "v5p-chip", 2, ("bind", "v5p64-w4", (2, 3))),
+    step("n03", "VC1", 0, "v5p-chip", 1, ("bind", "v5p64-w5", (0,))),
+    step("n04", "VC1", 0, "v5p-chip", 4, ("bind", "v5p64-w6", (0, 1, 2, 3))),
+    # Whole-v5p-16-sized gang: packing fills 0/1's last free host first,
+    # then crosses into 0/2 (pack-over-affinity, crossPriorityPack).
+    step("n05", "VC1", 0, "v5p-chip", 4, ("bind", "v5p64-w7", (0, 1, 2, 3)),
          group=("g16", 4)),
-    step("n06", "VC1", 0, "v5p-chip", 4, ("bind", "v5p64-w4", (0, 1, 2, 3)),
+    step("n06", "VC1", 0, "v5p-chip", 4, ("bind", "v5p64-w8", (0, 1, 2, 3)),
          group=("g16", 4)),
-    step("n07", "VC1", 0, "v5p-chip", 4, ("bind", "v5p64-w5", (0, 1, 2, 3)),
+    step("n07", "VC1", 0, "v5p-chip", 4, ("bind", "v5p64-w9", (0, 1, 2, 3)),
          group=("g16", 4)),
-    step("n08", "VC1", 0, "v5p-chip", 4, ("bind", "v5p64-w6", (0, 1, 2, 3)),
+    step("n08", "VC1", 0, "v5p-chip", 4, ("bind", "v5p64-w10", (0, 1, 2, 3)),
          group=("g16", 4)),
     # Pinned-cell pod lands inside the pinned v5p-16 (w0-w3).
     step("n09", "VC1", 0, "v5p-chip", 4, ("bind", "v5p64-w0", (0, 1, 2, 3)),
@@ -286,8 +288,8 @@ NORMAL_OPS = [
     # ...but an opportunistic pod may use idle capacity (here: the pinned
     # cell's idle host — opportunistic pods share everything).
     step("n11", "VC1", -1, "v5p-chip", 4, ("bind", "v5p64-w1", (0, 1, 2, 3))),
-    # VC2's guaranteed v5p pod opens the free 0/2 cell.
-    step("n12", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w8", (0, 1, 2, 3))),
+    # VC2's guaranteed v5p pod opens the free 0/3 cell.
+    step("n12", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w12", (0, 1, 2, 3))),
     # VC2 v5e-16 gang of 4 pods.
     step("n13", "VC2", 0, "v5e-chip", 4, ("bind", "v5e16a-w0", (0, 1, 2, 3)),
          group=("g18", 4)),
@@ -302,8 +304,8 @@ NORMAL_OPS = [
     step("n17", "VC2", 0, "v5e-chip", 2, ("bind", "v5e-solo", (6, 7))),
     step("n18", "VC2", 0, "v5e-chip", 2, ("bind", "v5e-solo", (4, 5))),
     # CPU chain.
-    step("n19", "VC2", 0, "cpu-socket", 1, ("bind", "cpu-1", (0,))),
-    step("n20", "VC2", 0, "cpu-socket", 1, ("bind", "cpu-1", (1,))),
+    step("n19", "VC2", 0, "cpu-socket", 1, ("bind", "cpu-0", (0,))),
+    step("n20", "VC2", 0, "cpu-socket", 1, ("bind", "cpu-0", (1,))),
     # VC1's v5e-16 quota: a 2x4 gang on the b slice.
     step("n21", "VC1", 0, "v5e-chip", 4, ("bind", "v5e16b-w0", (0, 1, 2, 3)),
          group=("g19", 2)),
@@ -312,8 +314,8 @@ NORMAL_OPS = [
     # Deletes open holes; the next pods re-pack INTO the holes exactly.
     delete("n02"),
     delete("n03"),
-    step("n23", "VC1", 0, "v5p-chip", 2, ("bind", "v5p64-w12", (2, 3))),
-    step("n24", "VC1", 0, "v5p-chip", 1, ("bind", "v5p64-w13", (0,))),
+    step("n23", "VC1", 0, "v5p-chip", 2, ("bind", "v5p64-w4", (2, 3))),
+    step("n24", "VC1", 0, "v5p-chip", 1, ("bind", "v5p64-w5", (0,))),
     # Oversubscribed gang member count -> user error.
     step("n25", "VC1", 0, "v5p-chip", 4, ("fail",), group=("g16", 4)),
     # Unknown VC / unknown pinned cell -> user error.
@@ -322,20 +324,20 @@ NORMAL_OPS = [
 ]
 
 SUGGESTED_NODES = [
-    step("s01", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w12", (0, 1, 2, 3)),
+    step("s01", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w4", (0, 1, 2, 3)),
          group=("sg1", 4)),
-    step("s02", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w13", (0, 1, 2, 3)),
+    step("s02", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w5", (0, 1, 2, 3)),
          group=("sg1", 4)),
-    step("s03", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w14", (0, 1, 2, 3)),
+    step("s03", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w6", (0, 1, 2, 3)),
          group=("sg1", 4)),
-    step("s04", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w15", (0, 1, 2, 3)),
+    step("s04", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w7", (0, 1, 2, 3)),
          group=("sg1", 4)),
     # Filtering phase returns the preemption HINT (victims of this pod's
     # placement) but NEVER commits: no preempting group may exist after.
     step("s05", "VC2", 5, "v5p-chip", 4,
          ("preempt", {"u-s01", "u-s02", "u-s03", "u-s04"}),
          group=("sg2", 4),
-         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         suggested=["v5p64-w4", "v5p64-w5", "v5p64-w6", "v5p64-w7"],
          phase=F),
     group_state("sg2", "absent"),
     # Preempting phase with the placement inside suggested nodes: the
@@ -344,7 +346,7 @@ SUGGESTED_NODES = [
     step("s06", "VC2", 5, "v5p-chip", 4,
          ("preempt", {"u-s01", "u-s02", "u-s03", "u-s04"}),
          group=("sg2", 4),
-         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         suggested=["v5p64-w4", "v5p64-w5", "v5p64-w6", "v5p64-w7"],
          phase=P),
     group_state("sg2", "Preempting"),
     group_state("sg1", "BeingPreempted"),
@@ -356,7 +358,7 @@ SUGGESTED_NODES = [
     # with group state part of the restart-equivalence contract, a
     # recovered scheduler replaying them as Allocated would diverge).
     step("s07", "VC2", 5, "v5p-chip", 4, ("wait",), group=("sg2", 4),
-         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14"], phase=P),
+         suggested=["v5p64-w4", "v5p64-w5", "v5p64-w6"], phase=P),
     group_state("sg2", "absent"),
     group_state("sg1", "Allocated"),
 ]
@@ -448,20 +450,20 @@ DOOMED = [
 PREEMPTION_CHAIN = [
     # Fill VC2's single non-pinned v5p-16 quota with a prio-0 gang (fresh
     # sim packs from cell 0/3 = w12-w15, as in NORMAL_OPS).
-    step("c01", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w12", (0, 1, 2, 3)),
+    step("c01", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w4", (0, 1, 2, 3)),
          group=("clow", 4)),
-    step("c02", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w13", (0, 1, 2, 3)),
+    step("c02", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w5", (0, 1, 2, 3)),
          group=("clow", 4)),
-    step("c03", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w14", (0, 1, 2, 3)),
+    step("c03", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w6", (0, 1, 2, 3)),
          group=("clow", 4)),
-    step("c04", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w15", (0, 1, 2, 3)),
+    step("c04", "VC2", 0, "v5p-chip", 4, ("bind", "v5p64-w7", (0, 1, 2, 3)),
          group=("clow", 4)),
     # prio-5 preemptor COMMITS (Preempting phase, placement inside the
     # suggested set): clow transitions to BeingPreempted.
     step("c05", "VC2", 5, "v5p-chip", 4,
          ("preempt", {"u-c01", "u-c02", "u-c03", "u-c04"}),
          group=("cmid", 4),
-         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         suggested=["v5p64-w4", "v5p64-w5", "v5p64-w6", "v5p64-w7"],
          phase=P),
     group_state("cmid", "Preempting"),
     group_state("clow", "BeingPreempted"),
@@ -472,7 +474,7 @@ PREEMPTION_CHAIN = [
     step("c06", "VC2", 10, "v5p-chip", 4,
          ("preempt", {"u-c01", "u-c02", "u-c03", "u-c04"}),
          group=("chigh", 4),
-         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         suggested=["v5p64-w4", "v5p64-w5", "v5p64-w6", "v5p64-w7"],
          phase=P),
     group_state("chigh", "Preempting"),
     group_state("cmid", "absent"),
@@ -483,7 +485,7 @@ PREEMPTION_CHAIN = [
     # its cells — returns to Allocated (first-class cancel transition; the
     # reference never reverts the marker, hived_algorithm.go:1116-1144).
     step("c07", "VC2", 10, "v5p-chip", 4, ("wait",), group=("chigh", 4),
-         suggested=["v5p64-w12", "v5p64-w13"], phase=P),
+         suggested=["v5p64-w4", "v5p64-w5"], phase=P),
     group_state("chigh", "absent"),
     group_state("clow", "Allocated"),
     # The returned cells are really clow's again: deleting clow's pods
@@ -491,7 +493,7 @@ PREEMPTION_CHAIN = [
     step("c08", "VC2", 5, "v5p-chip", 4,
          ("preempt", {"u-c01", "u-c02", "u-c03", "u-c04"}),
          group=("cmid2", 4),
-         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         suggested=["v5p64-w4", "v5p64-w5", "v5p64-w6", "v5p64-w7"],
          phase=P),
     group_state("cmid2", "Preempting"),
     # ...completes once K8s evicts the victims (the deletes below), its
@@ -500,21 +502,21 @@ PREEMPTION_CHAIN = [
     delete("c02"),
     delete("c03"),
     delete("c04"),
-    step("c09", "VC2", 5, "v5p-chip", 4, ("bind", "v5p64-w12", (0, 1, 2, 3)),
+    step("c09", "VC2", 5, "v5p-chip", 4, ("bind", "v5p64-w4", (0, 1, 2, 3)),
          group=("cmid2", 4),
-         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         suggested=["v5p64-w4", "v5p64-w5", "v5p64-w6", "v5p64-w7"],
          phase=P),
-    step("c10", "VC2", 5, "v5p-chip", 4, ("bind", "v5p64-w13", (0, 1, 2, 3)),
+    step("c10", "VC2", 5, "v5p-chip", 4, ("bind", "v5p64-w5", (0, 1, 2, 3)),
          group=("cmid2", 4),
-         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         suggested=["v5p64-w4", "v5p64-w5", "v5p64-w6", "v5p64-w7"],
          phase=P),
-    step("c11", "VC2", 5, "v5p-chip", 4, ("bind", "v5p64-w14", (0, 1, 2, 3)),
+    step("c11", "VC2", 5, "v5p-chip", 4, ("bind", "v5p64-w6", (0, 1, 2, 3)),
          group=("cmid2", 4),
-         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         suggested=["v5p64-w4", "v5p64-w5", "v5p64-w6", "v5p64-w7"],
          phase=P),
-    step("c12", "VC2", 5, "v5p-chip", 4, ("bind", "v5p64-w15", (0, 1, 2, 3)),
+    step("c12", "VC2", 5, "v5p-chip", 4, ("bind", "v5p64-w7", (0, 1, 2, 3)),
          group=("cmid2", 4),
-         suggested=["v5p64-w12", "v5p64-w13", "v5p64-w14", "v5p64-w15"],
+         suggested=["v5p64-w4", "v5p64-w5", "v5p64-w6", "v5p64-w7"],
          phase=P),
     group_state("cmid2", "Allocated"),
 ]
@@ -522,26 +524,26 @@ PREEMPTION_CHAIN = [
 RELAXED_BUDDY = [
     # CPU chain: VC2 owns 2 cpu-socket quota; physically 2 hosts x 2
     # sockets, free list initially holds the hosts whole. The first socket
-    # pod buddy-splits cpu-1 (packing order) and takes socket 0.
-    step("x01", "VC2", 0, "cpu-socket", 1, ("bind", "cpu-1", (0,))),
+    # pod buddy-splits cpu-0 (config-order tiebreak) and takes socket 0.
+    step("x01", "VC2", 0, "cpu-socket", 1, ("bind", "cpu-0", (0,))),
     # The host with the remaining free socket dies: the level-1 free list
-    # now holds only a BAD socket, while a whole healthy host (cpu-0) sits
+    # now holds only a BAD socket, while a whole healthy host (cpu-1) sits
     # at level 2.
-    bad("cpu-1"),
+    bad("cpu-0"),
     # Plain buddy alloc at level 1 would pick the bad socket;
-    # safe_relaxed_buddy_alloc must instead split cpu-0 (splittable: its
+    # safe_relaxed_buddy_alloc must instead split cpu-1 (splittable: its
     # level-2 free count exceeds the VC quota reserved at that level) and
     # bind the healthy socket — exact placement, not just "somewhere".
-    step("x02", "VC2", 0, "cpu-socket", 1, ("bind", "cpu-0", (0,))),
-    # Quota exhausted: a third guaranteed socket waits even though cpu-0's
+    step("x02", "VC2", 0, "cpu-socket", 1, ("bind", "cpu-1", (0,))),
+    # Quota exhausted: a third guaranteed socket waits even though cpu-1's
     # second socket is physically free.
     step("x03", "VC2", 0, "cpu-socket", 1, ("wait",)),
-    # Heal + release: packing prefers cpu-0's second socket (the
-    # partially-used, already-split host) over reopening the healed cpu-1
+    # Heal + release: packing prefers cpu-1's second socket (the
+    # partially-used, already-split host) over reopening the healed cpu-0
     # — the packing sort works on post-relaxed-split state.
-    heal("cpu-1"),
+    heal("cpu-0"),
     delete("x01"),
-    step("x04", "VC2", 0, "cpu-socket", 1, ("bind", "cpu-0", (1,))),
+    step("x04", "VC2", 0, "cpu-socket", 1, ("bind", "cpu-1", (1,))),
 ]
 
 

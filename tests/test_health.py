@@ -1,0 +1,573 @@
+"""Hardware health plane acceptance tests (doc/fault-model.md "Hardware
+health plane"): chip-granular badness, flap damping, maintenance drains,
+stranded-gang remediation, the /v1/inspect/health endpoint, and the
+doomed-ledger write coalescing that pairs with damping.
+"""
+
+import json
+import logging
+import random
+import urllib.request
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.algorithm.cell import CellState
+from hivedscheduler_tpu.api import constants, extender as ei
+from hivedscheduler_tpu.scheduler import health
+from hivedscheduler_tpu.scheduler.framework import HivedScheduler
+from hivedscheduler_tpu.scheduler.kube import RetryingKubeClient
+from hivedscheduler_tpu.scheduler.types import Node, PodState
+from hivedscheduler_tpu.webserver.server import WebServer
+
+from . import chaos
+from .test_core import Sim, make_pod
+from .test_placement_equivalence import random_config
+
+common.init_logging(logging.ERROR)
+
+
+def _node(name, ready=True, bad_chips=(), drain=None):
+    annotations = {}
+    if bad_chips:
+        annotations[constants.ANNOTATION_NODE_DEVICE_HEALTH] = ",".join(
+            str(i) for i in sorted(bad_chips)
+        )
+    if drain is not None:
+        annotations[constants.ANNOTATION_NODE_DRAIN] = drain
+    return Node(name=name, ready=ready, annotations=annotations)
+
+
+def _booted(seed=7, **config_overrides):
+    cfg = random_config(random.Random(seed))
+    for k, v in config_overrides.items():
+        setattr(cfg, k, v)
+    sched = HivedScheduler(
+        cfg,
+        kube_client=chaos.ScriptedKubeClient(),
+        force_bind_executor=lambda fn: fn(),
+    )
+    for n in sched.core.configured_node_names():
+        sched.add_node(_node(n))
+    sched.mark_ready()
+    return sched
+
+
+def _bind_gang(sched, name, vc="A", chips=2, n_pods=1, priority=0):
+    group = {
+        "name": name,
+        "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
+    }
+    nodes = sorted(sched.nodes)
+    bound = []
+    for i in range(n_pods):
+        pod = make_pod(
+            f"{name}-{i}", f"u-{name}-{i}", vc, priority, "v5e-chip", chips,
+            group=group,
+        )
+        sched.add_pod(pod)
+        result = sched.filter_routine(
+            ei.ExtenderArgs(pod=pod, node_names=nodes)
+        )
+        assert result.node_names, (name, i, result.failed_nodes)
+        sched.bind_routine(
+            ei.ExtenderBindingArgs(
+                pod_name=pod.name, pod_namespace=pod.namespace,
+                pod_uid=pod.uid, node=result.node_names[0],
+            )
+        )
+        client = sched.kube_client
+        if isinstance(client, RetryingKubeClient):
+            client = client.inner
+        bp = client.bound[pod.uid]
+        bp.phase = "Running"
+        sched.update_pod(pod, bp)
+        bound.append(bp)
+    return bound
+
+
+# --------------------------------------------------------------------- #
+# Chip-granular badness (tentpole 1)
+# --------------------------------------------------------------------- #
+
+
+def test_partial_host_serves_smaller_gangs():
+    """Golden chip-level placements around one dead chip (v5e16a-w0 chip
+    0): pristine hardware is preferred while it exists, but constrained to
+    the degraded host, 3-chip work lands on exactly its healthy chips —
+    the old whole-node health model condemned the host outright."""
+    sim = Sim()
+    sim.core.set_bad_leaf("v5e16a-w0", 0)
+    # Constrained to the degraded host (K8s suggested nodes): 3-chip work
+    # fits on exactly the three healthy chips.
+    p3b = make_pod(
+        "deg2", "u-deg2", "VC2", 0, "v5e-chip", 3,
+        group={"name": "deg2",
+               "members": [{"podNumber": 1, "leafCellNumber": 3}]},
+        ignore_suggested=False,
+    )
+    r3b = sim.schedule(p3b, suggested=["v5e16a-w0"])
+    assert r3b.pod_bind_info is not None, (
+        r3b.pod_wait_info and r3b.pod_wait_info.reason
+    )
+    assert r3b.pod_bind_info.node == "v5e16a-w0"
+    assert sorted(r3b.pod_bind_info.leaf_cell_isolation) == [1, 2, 3]
+    sim.bind(p3b, r3b)
+    # Unconstrained: the degraded hardware loses to pristine hardware (the
+    # candidate sort dis-prefers cells with unusable chips).
+    p3 = make_pod(
+        "deg", "u-deg", "VC1", 0, "v5e-chip", 3,
+        group={"name": "deg", "members": [{"podNumber": 1, "leafCellNumber": 3}]},
+    )
+    r3 = sim.schedule(p3)
+    assert r3.pod_bind_info is not None
+    assert r3.pod_bind_info.node != "v5e16a-w0"
+    sim.bind(p3, r3)
+    # Full-host work cannot fit there — it waits rather than spanning the
+    # dead chip.
+    p4 = make_pod(
+        "full", "u-full", "VC2", 0, "v5e-chip", 4,
+        group={"name": "full",
+               "members": [{"podNumber": 1, "leafCellNumber": 4}]},
+        ignore_suggested=False,
+    )
+    r4 = sim.schedule(p4, suggested=["v5e16a-w0"])
+    assert r4.pod_bind_info is None
+
+
+def test_chip_heal_restores_full_host():
+    sim = Sim()
+    sim.core.set_bad_leaf("v5e16a-w0", 2)
+    sim.core.set_healthy_leaf("v5e16a-w0", 2)
+    for ccl in sim.core.full_cell_list.values():
+        for leaf in ccl[1]:
+            assert leaf.healthy, leaf.address
+    assert not sim.core.bad_chips
+
+
+def test_chip_badness_survives_node_heal():
+    """A chip marked bad by the device plane stays bad across a node-level
+    bad/heal cycle; only the device plane may clear it."""
+    sim = Sim()
+    sim.core.set_bad_leaf("v5e16a-w0", 1)
+    sim.core.set_bad_node("v5e16a-w0")
+    sim.core.set_healthy_node("v5e16a-w0")
+    bad = [
+        leaf.address
+        for ccl in sim.core.full_cell_list.values()
+        for leaf in ccl[1]
+        if not leaf.healthy
+    ]
+    # Exactly the chip-1 leaf of that host stays bad.
+    assert bad == [leaf.address for leaf in
+                   sim.core._node_leaf_cells("v5e16a-w0", 1)], bad
+    sim.core.set_healthy_leaf("v5e16a-w0", 1)
+    assert not any(
+        not leaf.healthy
+        for ccl in sim.core.full_cell_list.values()
+        for leaf in ccl[1]
+    )
+
+
+# --------------------------------------------------------------------- #
+# Flap damping (tentpole 2)
+# --------------------------------------------------------------------- #
+
+
+def test_single_transitions_apply_immediately():
+    sched = _booted()
+    sched.update_node(_node("s0-w0"), _node("s0-w0", ready=False))
+    assert "s0-w0" in sched.core.bad_nodes
+    sched.update_node(_node("s0-w0", ready=False), _node("s0-w0"))
+    assert "s0-w0" not in sched.core.bad_nodes
+    assert sched.health_pending_count() == 0
+
+
+def test_flap_storm_is_damped_then_settles():
+    """A storming node applies at most threshold-1 transitions; once quiet,
+    the LATEST desired state settles after the hold — never lost."""
+    sched = _booted()
+    t = sched.config.health_flap_threshold
+    before = sched.metrics.snapshot()["healthTransitionCount"]
+    ready = True
+    for _ in range(2 * t + 1):  # odd: the storm ends mid-hold, desired bad
+        ready = not ready
+        sched.update_node(_node("s0-w0"), _node("s0-w0", ready=ready))
+    applied = sched.metrics.snapshot()["healthTransitionCount"] - before
+    assert applied <= t - 1
+    assert sched.health_pending_count() == 1
+    assert sched.metrics.snapshot()["healthDampedCount"] >= 1
+    assert "s0-w0" not in sched.core.bad_nodes  # held, not yet applied
+    # Quiet for `hold` ticks: the LATEST desired state (bad) settles.
+    for _ in range(sched.config.health_flap_hold):
+        sched.health_tick()
+    assert sched.health_pending_count() == 0
+    assert "s0-w0" in sched.core.bad_nodes  # settled to desired
+    assert sched.metrics.snapshot()["healthSettledCount"] == 1
+
+
+def test_damped_and_undamped_converge():
+    """Equivalence: once a flap sequence settles, the damped scheduler's
+    steady state is identical to an undamped one's."""
+    damped = _booted()
+    undamped = _booted(health_flap_threshold=0)
+    flips = [False, True, False, True, False]  # ends bad
+    for ready in flips:
+        for sched in (damped, undamped):
+            sched.update_node(_node("s1-w0"), _node("s1-w0", ready=ready))
+    for _ in range(damped.config.health_flap_hold + 1):
+        damped.health_tick()
+        undamped.health_tick()
+    assert damped.health_pending_count() == 0
+    assert chaos.leaf_fingerprint(damped.core) == chaos.leaf_fingerprint(
+        undamped.core
+    )
+    assert chaos.counters_fingerprint(damped.core) == (
+        chaos.counters_fingerprint(undamped.core)
+    )
+
+
+def test_damping_suppresses_ledger_churn():
+    """The flap gate's point: a storming node must not rewrite the doomed
+    ledger on every flip."""
+    sched = _booted()
+    kube = sched.kube_client
+    # Make the node's badness matter to a VC (doom): fill nothing, just
+    # flap — ledger writes happen on doom churn; damped flips stop both.
+    writes_before = kube.state_writes
+    ready = True
+    for _ in range(12):
+        ready = not ready
+        sched.update_node(_node("s0-w0"), _node("s0-w0", ready=ready))
+    # Undamped, every bad flip can churn dooms (config-dependent); damped,
+    # the writes are bounded by the threshold.
+    assert kube.state_writes - writes_before <= (
+        sched.config.health_flap_threshold + 1
+    )
+
+
+# --------------------------------------------------------------------- #
+# Maintenance drains (tentpole 3)
+# --------------------------------------------------------------------- #
+
+
+def test_drain_cordons_new_placements_only():
+    sched = _booted()
+    # An existing gang on the node keeps its cells through the drain.
+    bound = _bind_gang(sched, "resident", chips=4)
+    resident_node = bound[0].node_name
+    sched.update_node(
+        _node(resident_node), _node(resident_node, drain="*")
+    )
+    g = sched.core.affinity_groups["resident"]
+    for rows in g.physical_placement.values():
+        for row in rows:
+            for leaf in row:
+                assert leaf.state == CellState.USED  # untouched
+    # New placements avoid the drained node entirely.
+    for i in range(8):
+        pod = make_pod(
+            f"new-{i}", f"u-new-{i}", "A", 0, "v5e-chip", 2,
+            group={"name": f"new-{i}",
+                   "members": [{"podNumber": 1, "leafCellNumber": 2}]},
+        )
+        sched.add_pod(pod)
+        r = sched.filter_routine(
+            ei.ExtenderArgs(pod=pod, node_names=sorted(sched.nodes))
+        )
+        if not r.node_names:
+            break
+        assert r.node_names[0] != resident_node, i
+        sched.bind_routine(
+            ei.ExtenderBindingArgs(
+                pod_name=pod.name, pod_namespace=pod.namespace,
+                pod_uid=pod.uid, node=r.node_names[0],
+            )
+        )
+    # Lifting the drain makes the node placeable again (after the resident
+    # gang leaves).
+    sched.update_node(_node(resident_node, drain="*"), _node(resident_node))
+    assert resident_node not in sched.core.draining_chips
+
+
+def test_partial_drain_leaves_other_chips_placeable():
+    sched = _booted()
+    sched.update_node(_node("s0-w0"), _node("s0-w0", drain="0,1"))
+    assert sched.core.draining_chips["s0-w0"] == {0, 1}
+    leaves = sched.core._node_leaf_cells("s0-w0")
+    assert {leaf.draining for leaf in leaves} == {True, False}
+
+
+def test_drain_lifted_on_node_delete():
+    sched = _booted()
+    sched.update_node(_node("s0-w0"), _node("s0-w0", drain="*"))
+    assert sched.core.draining_chips.get("s0-w0")
+    sched.delete_node(_node("s0-w0", drain="*"))
+    assert "s0-w0" not in sched.core.draining_chips
+    assert all(
+        not leaf.draining for leaf in sched.core._node_leaf_cells("s0-w0")
+    )
+    # Drains are undamped and deliberate; the deleted node is simply bad.
+    assert "s0-w0" in sched.core.bad_nodes
+
+
+# --------------------------------------------------------------------- #
+# Stranded gangs (tentpole 4)
+# --------------------------------------------------------------------- #
+
+
+def test_stranded_gang_surfaced():
+    sched = _booted()
+    bound = _bind_gang(sched, "victim", chips=4)
+    node = bound[0].node_name
+    sched.update_node(_node(node), _node(node, ready=False))
+    payload = sched.get_health()
+    assert payload["strandedGroupCount"] == 1
+    rec = payload["strandedGroups"][0]
+    assert rec["name"] == "victim" and rec["badCells"]
+    assert sched.get_metrics()["strandedGroupCount"] == 1
+    # Draining strands too (separately listed).
+    sched.update_node(_node(node, ready=False), _node(node, drain="*"))
+    rec = sched.get_health()["strandedGroups"][0]
+    assert rec["drainingCells"]
+
+
+def test_stranded_gang_evicted_under_policy():
+    sched = _booted(stranded_gang_eviction=True)
+    kube = sched.kube_client
+    bound = _bind_gang(sched, "evictee", chips=4)
+    node = bound[0].node_name
+    _bind_gang(sched, "bystander", vc="B", chips=1)
+    sched.update_node(_node(node), _node(node, ready=False))
+    assert kube.evicted == ["u-evictee-0"]
+    assert sched.get_metrics()["strandedEvictionCount"] == 1
+    # Idempotent: further health churn does not re-evict the same gang.
+    sched.update_node(_node(node, ready=False), _node(node, ready=False))
+    sched.health_tick()
+    assert kube.evicted == ["u-evictee-0"]
+    # Bystanders on healthy hardware are untouched.
+    assert "u-bystander-0" not in kube.evicted
+
+
+def test_no_eviction_while_damper_holds():
+    """Lazy eviction acts on SETTLED health only: a flap storm must not
+    evict anybody while the damper is holding the transition."""
+    sched = _booted(stranded_gang_eviction=True)
+    kube = sched.kube_client
+    bound = _bind_gang(sched, "flappy", chips=4)
+    node = bound[0].node_name
+    t = sched.config.health_flap_threshold
+    ready = True
+    evicted_mid_storm = None
+    for _ in range(2 * t):
+        ready = not ready
+        sched.update_node(_node(node), _node(node, ready=ready))
+    # The storm's first (undamped) bad transition may evict — that is the
+    # threshold's pre-damping window. What must NOT happen: eviction from
+    # a held transition while the damper is still holding.
+    evicted_mid_storm = list(kube.evicted)
+    for _ in range(sched.config.health_flap_hold):
+        sched.health_tick()
+    # After settling to the final desired state (healthy), no NEW eviction
+    # may have been issued by the held transitions.
+    assert kube.evicted == evicted_mid_storm
+
+
+# --------------------------------------------------------------------- #
+# Crash-safety: replay reconstructs health, damping, and drain state
+# --------------------------------------------------------------------- #
+
+
+def test_health_state_recovers_from_annotations():
+    kube = chaos.ScriptedKubeClient()
+
+    def fresh():
+        sched = HivedScheduler(
+            random_config(random.Random(7)), force_bind_executor=lambda fn: fn()
+        )
+        sched.kube_client = RetryingKubeClient(
+            kube, scheduler=sched, sleep=lambda s: None,
+            jitter_rng=random.Random(1),
+        )
+        return sched
+
+    s1 = fresh()
+    nodes = {}
+    for n in HivedScheduler(
+        random_config(random.Random(7))
+    ).core.configured_node_names():
+        nodes[n] = _node(n)
+        s1.add_node(nodes[n])
+    s1.mark_ready()
+    bound = _bind_gang(s1, "survivor", chips=2)
+    nodes["s0-w0"] = _node("s0-w0", bad_chips=[1])
+    s1.update_node(_node("s0-w0"), nodes["s0-w0"])
+    nodes["s1-w0"] = _node("s1-w0", drain="*")
+    s1.update_node(_node("s1-w0"), nodes["s1-w0"])
+
+    s2 = fresh()
+    s2.recover(list(nodes.values()), bound)
+    assert s2.core.bad_chips == s1.core.bad_chips
+    assert s2.core.draining_chips == s1.core.draining_chips
+    assert chaos.leaf_fingerprint(s2.core) == chaos.leaf_fingerprint(s1.core)
+    assert chaos.counters_fingerprint(s2.core) == (
+        chaos.counters_fingerprint(s1.core)
+    )
+    st = s2.pod_schedule_statuses["u-survivor-0"]
+    assert st.pod_state == PodState.BOUND
+    chaos.audit_invariants(s2, "health-recovery")
+
+
+# --------------------------------------------------------------------- #
+# Ledger coalescing (satellite) + inspect endpoint
+# --------------------------------------------------------------------- #
+
+
+def test_ledger_writes_coalesce_per_mutation():
+    """N doomed-ledger epoch bumps inside ONE mutator exit produce ONE
+    ConfigMap write, and the coalesced count records the collapsed bumps.
+    (Config seed 1 + node s0-w2 is a pinned multi-doom event: that node
+    going bad dooms cells for more than one quota at once.)"""
+    sched = _booted(seed=1)
+    kube = sched.kube_client
+    for n in ("s0-w0", "s0-w1"):  # build the healthy-capacity shortfall
+        sched.update_node(_node(n), _node(n, ready=False))
+    e0, w0 = sched.core.doomed_epoch, kube.state_writes
+    sched.update_node(_node("s0-w2"), _node("s0-w2", ready=False))
+    jump = sched.core.doomed_epoch - e0
+    assert jump >= 2, "pinned multi-doom event no longer multi-dooms"
+    assert kube.state_writes - w0 == 1, (
+        "multiple dooms in one mutation must coalesce into one ledger write"
+    )
+    assert sched.get_metrics()["doomedLedgerCoalescedCount"] >= jump - 1
+
+
+def test_health_endpoint_served():
+    sched = _booted()
+    sched.update_node(_node("s0-w0"), _node("s0-w0", bad_chips=[0]))
+    sched.update_node(_node("s1-w0"), _node("s1-w0", drain="*"))
+    sched.config.webserver_address = "127.0.0.1:0"
+    server = WebServer(sched)
+    server.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}{constants.HEALTH_PATH}"
+        ) as resp:
+            payload = json.loads(resp.read())
+    finally:
+        server.stop()
+    assert payload["badChips"] == {"s0-w0": [0]}
+    assert "s1-w0" in payload["drainingChips"]
+    assert payload["evictionPolicy"] == "surface"
+    assert "strandedGroups" in payload and "damper" in payload
+
+
+# --------------------------------------------------------------------- #
+# health.py unit coverage: parsing + damper semantics
+# --------------------------------------------------------------------- #
+
+
+def test_device_health_parsing_annotation_and_conditions():
+    node = Node(
+        name="n",
+        annotations={constants.ANNOTATION_NODE_DEVICE_HEALTH: "1, 3,junk"},
+        conditions={
+            constants.GROUP_NAME + "/chip-2": False,
+            constants.GROUP_NAME + "/chip-0": True,
+            "Ready": True,
+        },
+    )
+    assert health.device_bad_chips(node) == {1, 2, 3}
+
+
+def test_drain_parsing():
+    all_chips = {0, 1, 2, 3}
+    for value, expected in (
+        ("*", all_chips),
+        ("all", all_chips),
+        ("true", all_chips),
+        ("0,2", {0, 2}),
+        ("0,9", {0}),  # unknown chip clamped away
+        ("", set()),
+    ):
+        node = Node(
+            name="n",
+            annotations={constants.ANNOTATION_NODE_DRAIN: value}
+            if value
+            else {},
+        )
+        assert health.drain_chip_indices(node, all_chips) == expected, value
+
+
+def test_damper_semantics():
+    d = health.FlapDamper(threshold=3, window=8, hold=4)
+    t = ("node", "n1")
+    assert d.observe(t, True, 1)  # first sighting applies
+    assert d.observe(t, False, 2)  # 1st flip in window
+    assert d.observe(t, True, 3)  # 2nd flip
+    assert not d.observe(t, False, 4)  # 3rd flip: held
+    assert d.pending_count() == 1
+    assert not d.observe(t, True, 5)  # flip back == applied: hold cleared
+    assert d.pending_count() == 0
+    assert not d.observe(t, False, 6)  # held again (still in window)
+    assert d.settled(8) == []  # quiet for 2 < hold
+    assert d.settled(10) == [(t, False)]  # quiet for 4: latest state lands
+    assert d.pending_count() == 0
+    # Old stamps age out of the window: a fresh flip applies again.
+    assert d.observe(t, True, 40)
+    d.forget_node("n1")
+    assert d.observe(t, False, 41)  # forgotten: first sighting again
+
+
+def test_repeated_identical_observation_does_not_extend_hold():
+    """A held target keeps being re-delivered unchanged (kubelet heartbeats,
+    relists): those are NOT flips — the hold must still expire and the
+    transition settle, or a genuinely-bad node would never be marked bad."""
+    d = health.FlapDamper(threshold=3, window=8, hold=4)
+    t = ("node", "n1")
+    d.observe(t, True, 1)
+    d.observe(t, False, 2)
+    d.observe(t, True, 3)
+    assert not d.observe(t, False, 4)  # 3rd flip in window: held
+    for clock in range(5, 8):
+        assert not d.observe(t, False, clock)  # heartbeats, not flips
+    # Quiet since the REAL flip at clock 4: settles at 4 + hold.
+    assert d.settled(8) == [(t, False)]
+
+
+def test_partial_eviction_failure_retries_only_missing_pods():
+    """A gang whose eviction partially failed is re-armed, but pods whose
+    delete already landed are not re-deleted (and not double-counted)."""
+    sched = _booted(stranded_gang_eviction=True)
+    kube = sched.kube_client
+    bound = _bind_gang(sched, "gang2", chips=2, n_pods=2)
+    node0 = bound[0].node_name
+    sched.kube_client = RetryingKubeClient(
+        kube, scheduler=sched, max_attempts=2, sleep=lambda s: None,
+        jitter_rng=random.Random(1),
+    )
+    # The second pod's delete fails terminally-retryable until we clear it.
+    fail = chaos.KubeAPIError("DELETE", "/pods/x", 503, "apiserver down")
+    kube.on_evict = lambda pod: (_ for _ in ()).throw(fail) if (
+        pod.uid == "u-gang2-1"
+    ) else None
+    sched.update_node(_node(node0), _node(node0, ready=False))
+    assert kube.evicted == ["u-gang2-0"]  # pod 1's delete never landed
+    assert sched.get_metrics()["strandedEvictionCount"] == 1
+    # A later APPLIED health transition re-checks stranded gangs: only the
+    # missing pod is re-attempted (pod 0 is not re-deleted).
+    kube.on_evict = None
+    sched.update_node(
+        _node(node0, ready=False),
+        _node(node0, ready=False, bad_chips=[0]),
+    )
+    assert kube.evicted == ["u-gang2-0", "u-gang2-1"]
+    assert sched.get_metrics()["strandedEvictionCount"] == 2
+
+
+def test_damper_disabled_applies_everything():
+    d = health.FlapDamper(threshold=0, window=8, hold=4)
+    t = ("chip", "n1", 0)
+    assert d.observe(t, True, 1)
+    for clock in range(2, 20):
+        # Every genuine flip applies immediately when damping is off.
+        assert d.observe(t, clock % 2 == 1, clock)
+    assert d.pending_count() == 0
