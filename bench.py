@@ -307,9 +307,11 @@ def smoke(n_gangs: int = 24) -> dict:
 
 def bench_preempt(sched, nodes, n_calls: int = 30) -> float:
     """p50 latency of the production preempt verb on the loaded cluster:
-    a high-priority gang preempts, then cancels (shrunken suggested set),
-    repeatedly — exercising commit + cancellation, the two expensive
-    preemption paths."""
+    a high-priority gang preempts, is re-probed (the extender re-enters
+    the preempt verb for each preemptor pod every round while victims
+    terminate — the path the epoch-gated victims cache serves), then
+    cancels (shrunken suggested set) — commit, probe, and cancellation,
+    the three expensive preemption paths."""
     lat = []
     victims_template = {n: {} for n in nodes}
     for i in range(n_calls):
@@ -328,6 +330,12 @@ def bench_preempt(sched, nodes, n_calls: int = 30) -> float:
                 pod=pod, node_name_to_meta_victims=dict(victims_template)
             )
         )
+        # Re-probe the now-PREEMPTING gang (same candidate set).
+        sched.preempt_routine(
+            ei.ExtenderPreemptionArgs(
+                pod=pod, node_name_to_meta_victims=dict(victims_template)
+            )
+        )
         # Cancel by rescheduling with an empty candidate set.
         sched.preempt_routine(
             ei.ExtenderPreemptionArgs(pod=pod, node_name_to_meta_victims={})
@@ -335,6 +343,186 @@ def bench_preempt(sched, nodes, n_calls: int = 30) -> float:
         lat.append((time.perf_counter() - t0) * 1e3)
         sched.delete_pod(pod)
     return statistics.median(lat)
+
+
+# ---------------------------------------------------------------------- #
+# Concurrent filter stage (HIVED_BENCH_CONCURRENT=1): lock sharding A/B
+# ---------------------------------------------------------------------- #
+
+
+def build_concurrent_config(
+    n_families: int, hosts_per_family: int, block_ms: int = 0
+) -> Config:
+    """A fleet of ``n_families`` hardware families, each its own leaf SKU
+    and therefore its own cell chain and its own VC — the shape lock
+    sharding is built for: filter calls of different families share no
+    chain, so their critical sections interleave instead of queuing.
+
+    ``block_ms`` sets the FIFO fairness knob (the reference blocks waiting
+    pods ~50 ms INSIDE the scheduler lock, scheduler.go:567-571, to get
+    better FIFO ordering): under the single lock that block stalls every
+    family's scheduling; under sharding it stalls only the waiting pod's
+    own chain — the concurrency win this stage measures."""
+    cell_types: dict = {}
+    physical = []
+    vcs = {}
+    for i in range(n_families):
+        chip, host, slice_t = f"cc{i}-chip", f"cc{i}-host", f"cc{i}-slice"
+        cell_types[host] = CellTypeSpec(
+            child_cell_type=chip, child_cell_number=4, is_node_level=True
+        )
+        cell_types[slice_t] = CellTypeSpec(
+            child_cell_type=host, child_cell_number=4
+        )
+        n_slices = max(1, hosts_per_family // 4)
+        for s in range(n_slices):
+            physical.append(
+                topology.make_physical_cell(
+                    slice_t,
+                    [f"cc{i}-s{s}-w{j}" for j in range(4)],
+                    cell_types,
+                ).to_dict()
+            )
+        vcs[f"vc{i}"] = {
+            "virtualCells": [{"cellType": slice_t, "cellNumber": n_slices}]
+        }
+    return Config.from_dict(
+        {
+            "physicalCluster": {
+                "cellTypes": {
+                    n: {
+                        "childCellType": s.child_cell_type,
+                        "childCellNumber": s.child_cell_number,
+                        "isNodeLevel": s.is_node_level,
+                    }
+                    for n, s in cell_types.items()
+                },
+                "physicalCells": physical,
+            },
+            "virtualClusters": vcs,
+            "waitingPodSchedulingBlockMilliSec": block_ms,
+        }
+    )
+
+
+def _drive_family(sched, nodes, family, n_gangs):
+    """One thread's load: churn gangs of one family's SKU through the
+    production filter path (auto-admit: no informer add_pod events, so the
+    loop's only global-order acquisitions are the churn deletes)."""
+    live, pods_scheduled = [], 0
+    chip = f"cc{family}-chip"
+    vc = f"vc{family}"
+    for g in range(n_gangs):
+        n_pods = (1, 2, 4)[g % 3]
+        gname = f"cc{family}-g{g}"
+        group = {
+            "name": gname,
+            "members": [{"podNumber": n_pods, "leafCellNumber": 4}],
+        }
+        pods = [
+            make_pod(f"{gname}-{i}", f"{gname}-u{i}", vc, 0, chip, 4, group)
+            for i in range(n_pods)
+        ]
+        ok, bound = True, []
+        for p in pods:
+            r = sched.filter_routine(ei.ExtenderArgs(pod=p, node_names=nodes))
+            if not r.node_names:
+                ok = False
+                break
+            bound.append(sched.pod_schedule_statuses[p.uid].pod)
+        if ok:
+            live.append(bound)
+            pods_scheduled += len(bound)
+        else:
+            for p in pods:
+                sched.delete_pod(p)
+            for old in live[: max(1, len(live) // 3)]:
+                for q in old:
+                    sched.delete_pod(q)
+            live = live[max(1, len(live) // 3):]
+    return pods_scheduled
+
+
+def bench_concurrent(
+    threads: int = 4,
+    gangs_per_thread: int = 120,
+    hosts_per_family: int = 16,
+    block_ms: int = 20,
+) -> dict:
+    """Aggregate filter throughput with ``threads`` workers driving
+    DISJOINT chains concurrently, sharded locks vs the HIVED_GLOBAL_LOCK
+    single-lock escape hatch — same fleet, same load, interleaved in one
+    process. Reports pods/sec for both, the speedup, and the
+    lockWait/coreSchedule split of each run (doc/hot-path.md)."""
+    import threading as _threading
+
+    cfg_builder = lambda: build_concurrent_config(  # noqa: E731
+        threads, hosts_per_family, block_ms
+    )
+
+    def run_once(force_global: bool) -> dict:
+        sched = HivedScheduler(
+            cfg_builder(),
+            kube_client=NullKubeClient(),
+            auto_admit=True,
+            global_lock=force_global,
+        )
+        all_nodes = sched.core.configured_node_names()
+        for n in all_nodes:
+            sched.add_node(Node(name=n))
+        family_nodes = {
+            i: [n for n in all_nodes if n.startswith(f"cc{i}-")]
+            for i in range(threads)
+        }
+        totals = [0] * threads
+        barrier = _threading.Barrier(threads + 1)
+
+        def worker(i: int) -> None:
+            barrier.wait()
+            totals[i] = _drive_family(
+                sched, family_nodes[i], i, gangs_per_thread
+            )
+
+        ts = [
+            _threading.Thread(target=worker, args=(i,)) for i in range(threads)
+        ]
+        for t in ts:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in ts:
+            t.join()
+        wall_s = time.perf_counter() - t0
+        m = sched.get_metrics()
+        return {
+            "pods_scheduled": sum(totals),
+            "wall_s": round(wall_s, 3),
+            "pods_per_sec": round(sum(totals) / wall_s, 1) if wall_s else 0.0,
+            "filter_count": m["filterCount"],
+            "phases": {
+                k: v
+                for k, v in m["phases"].items()
+                if k in ("lockWait", "coreSchedule")
+            },
+            "lockWaitByChain": m["lockWaitByChain"],
+        }
+
+    sharded = run_once(False)
+    single = run_once(True)
+    speedup = (
+        round(sharded["pods_per_sec"] / single["pods_per_sec"], 2)
+        if single["pods_per_sec"]
+        else 0.0
+    )
+    return {
+        "threads": threads,
+        "gangs_per_thread": gangs_per_thread,
+        "hosts_per_family": hosts_per_family,
+        "fifo_block_ms": block_ms,
+        "sharded": sharded,
+        "global_lock": single,
+        "speedup_vs_global_lock": speedup,
+    }
 
 
 def bench_recovery(sched) -> dict:
@@ -518,6 +706,28 @@ def model_perf() -> dict:
 
 
 if __name__ == "__main__":
+    if os.environ.get("HIVED_BENCH_CONCURRENT") == "1":
+        try:
+            conc_threads = int(
+                os.environ.get("HIVED_BENCH_CONCURRENT_THREADS", "4")
+            )
+        except ValueError:
+            conc_threads = 4
+        if conc_threads <= 0:
+            conc_threads = 4
+        result = bench_concurrent(threads=conc_threads)
+        print(
+            json.dumps(
+                {
+                    "metric": "concurrent_filter_pods_per_sec",
+                    "value": result["sharded"]["pods_per_sec"],
+                    "unit": "pods/s",
+                    "vs_baseline": result["speedup_vs_global_lock"],
+                    "extra": result,
+                }
+            )
+        )
+        sys.exit(0)
     if os.environ.get("HIVED_BENCH_SMOKE") == "1":
         try:
             smoke_gangs = int(os.environ.get("HIVED_BENCH_SMOKE_GANGS", "24"))

@@ -84,6 +84,7 @@ class Cell:
         "view_reg",
         "unusable_leaf_num",
         "config_order",
+        "epoch_ref",
     )
 
     def __init__(
@@ -129,10 +130,22 @@ class Cell:
         # False for their ancestors (binding changes above node level).
         # See TopologyAwareScheduler._register_view.
         self.view_reg: Optional[Tuple["TopologyAwareScheduler", bool]] = None
+        # Per-chain mutation epoch (a shared one-element list installed by
+        # HivedCore): every status-visible mutation — state, priority,
+        # healthiness, draining, bindings — bumps it, so the mirrored
+        # inspect statuses and the preempt-probe victims cache can tell
+        # "nothing in this chain changed" in O(1) instead of re-walking
+        # the tree (doc/hot-path.md "Preempt-path indexing").
+        self.epoch_ref: Optional[List[int]] = None
 
         # Leaf-cell usage per priority, for VC-safety and preemption decisions
         # (reference: cell.go:104-106, 122-127).
         self.used_leaf_cells_at_priority: Dict[CellPriority, int] = {}
+
+    def _bump_epoch(self) -> None:
+        ref = self.epoch_ref
+        if ref is not None:
+            ref[0] += 1
 
     def set_children(self, children: List["Cell"]) -> None:
         self.children = children
@@ -206,11 +219,13 @@ class PhysicalCell(Cell):
         """State changes mirror into the bound virtual cell
         (reference: cell.go:195-205)."""
         self.state = s
+        self._bump_epoch()
         if self.virtual_cell is not None:
             self.virtual_cell.state = s
 
     def set_priority(self, p: CellPriority) -> None:
         self.priority = p
+        self._bump_epoch()
 
     def _bump_unusable(self, delta: int) -> None:
         """Propagate a leaf usability change up the tree (O(depth)) and
@@ -243,6 +258,7 @@ class PhysicalCell(Cell):
             if after != before:
                 self._bump_unusable(1 if after else -1)
         self.healthy = healthy
+        self._bump_epoch()
         reg = self.view_reg
         if reg is not None and reg[1]:
             reg[0].mark_dirty(self.address)
@@ -266,6 +282,7 @@ class PhysicalCell(Cell):
             return
         before = (not self.healthy) or self.draining
         self.draining = draining
+        self._bump_epoch()
         after = (not self.healthy) or draining
         if not self.children and after != before:
             # The bump walk also dirties every view scoring an ancestor
@@ -291,6 +308,7 @@ class PhysicalCell(Cell):
 
     def set_virtual_cell(self, cell: Optional["VirtualCell"]) -> None:
         self.virtual_cell = cell
+        self._bump_epoch()
 
 
 class VirtualCell(Cell):
@@ -309,11 +327,13 @@ class VirtualCell(Cell):
 
     def set_priority(self, p: CellPriority) -> None:
         self.priority = p
+        self._bump_epoch()
 
     def set_physical_cell(self, cell: Optional[PhysicalCell]) -> None:
         """Unbinding resets state/health since a dangling virtual cell has no
         hardware underneath (reference: cell.go:401-420)."""
         self.physical_cell = cell
+        self._bump_epoch()
         if cell is None:
             self.state = CellState.FREE
             self.healthy = True

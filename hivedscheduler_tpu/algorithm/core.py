@@ -21,6 +21,7 @@ Responsibilities:
 from __future__ import annotations
 
 import random
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -137,6 +138,19 @@ def all_pods_released(allocated_pods: Dict[int, List[Optional[Pod]]]) -> bool:
     return all(p is None for pods in allocated_pods.values() for p in pods)
 
 
+def group_chain(g: AffinityGroup) -> Optional[CellChain]:
+    """The cell chain a group's placement lives in (a gang is scheduled
+    transactionally onto ONE chain; group.py module docstring). None while
+    no leaf is placed yet. Cells never change chain, so the first non-None
+    leaf answers."""
+    for pod_placements in g.physical_placement.values():
+        for pod_placement in pod_placements:
+            for leaf in pod_placement:
+                if leaf is not None:
+                    return leaf.chain
+    return None
+
+
 def find_physical_leaf_cell(
     full_cell_list: Dict[CellChain, ChainCellList],
     chain: CellChain,
@@ -215,9 +229,16 @@ def collect_preemption_victims(
 ) -> Tuple[Dict[str, Dict[str, Pod]], List[AffinityGroup]]:
     """Victim pods (gang-preempted: all pods of any overlapping group) and
     the preempting groups whose reservations overlap this placement
-    (reference: utils.go:202-248)."""
+    (reference: utils.go:202-248).
+
+    Each victim GROUP's pods are walked once, on the first leaf that
+    names it — the reference re-walks the whole gang per overlapping leaf,
+    which is O(leaves x gang size) for the common case of preempting one
+    big gang. Insertion order of the victims dicts is unchanged (the first
+    occurrence ordered the entries before too; re-visits only overwrote)."""
     victims: Dict[str, Dict[str, Pod]] = {}  # node -> uid -> pod
     overlapping_preemptors: List[AffinityGroup] = []
+    seen_victim_groups: List[AffinityGroup] = []
     for pod_placements in placement.values():
         for pod_placement in pod_placements:
             for leaf in pod_placement:
@@ -226,10 +247,15 @@ def collect_preemption_victims(
                 assert isinstance(leaf, PhysicalCell)
                 state = leaf.state
                 if state in (CellState.USED, CellState.RESERVING):
-                    for pods in leaf.using_group.allocated_pods.values():
-                        for v in pods:
-                            if v is not None:
-                                victims.setdefault(v.node_name, {})[v.uid] = v
+                    ug = leaf.using_group
+                    if all(ug is not sg for sg in seen_victim_groups):
+                        seen_victim_groups.append(ug)
+                        for pods in ug.allocated_pods.values():
+                            for v in pods:
+                                if v is not None:
+                                    victims.setdefault(
+                                        v.node_name, {}
+                                    )[v.uid] = v
                 if state in (CellState.RESERVING, CellState.RESERVED):
                     g = leaf.reserving_or_reserved_group
                     if g is not None and all(
@@ -258,20 +284,6 @@ def retrieve_missing_pod_placement(
         f"retrieving placement for pod {pod_index} with leaf cell number "
         f"{leaf_cell_num}"
     )
-
-
-def retrieve_virtual_cell(
-    physical: Placement, virtual: Placement, p_leaf: PhysicalCell
-) -> Optional[VirtualCell]:
-    """(reference: utils.go:271-287)"""
-    for leaf_num, pod_placements in physical.items():
-        for pod_index, pod_placement in enumerate(pod_placements):
-            for leaf_index, leaf in enumerate(pod_placement):
-                if leaf is not None and cell_equal(leaf, p_leaf):
-                    v = virtual[leaf_num][pod_index][leaf_index]
-                    assert v is None or isinstance(v, VirtualCell)
-                    return v
-    return None
 
 
 def generate_pod_preempt_info(
@@ -455,7 +467,10 @@ def generate_pod_schedule_result(
             leaf_cell_isolation=indices,
             cell_chain=chain,
             affinity_group_bind_info=bind_info,
-        )
+        ),
+        # Batched admission: the framework hands this straight back to
+        # add_allocated_pod, skipping the per-pod decode + index scan.
+        pod_index=current_pod_index,
     )
 
 
@@ -520,6 +535,37 @@ class HivedCore:
             for chain, ccl in self.full_cell_list.items()
         }
 
+        # Per-chain mutation epochs: one shared counter per chain, installed
+        # as epoch_ref on every physical AND virtual cell of that chain.
+        # Any status-visible cell mutation bumps it (cell.py), as does a
+        # pod-slot change in a group of that chain (add/delete_allocated_pod)
+        # — so "epoch unchanged" certifies both the mirrored inspect
+        # statuses and the preempt-probe victims caches are still fresh.
+        self.chain_epochs: Dict[CellChain, List[int]] = {}
+        self._install_epoch_refs()
+        # Lock-sharding contract hook (scheduler.locks): the framework
+        # installs ChainShardedLock.require_global here so the cross-chain
+        # mutators below (node/chip health, drains, node deletes) ASSERT
+        # they run under the total-order global mode. None for bare cores
+        # (tests, benches driving the core directly, single-threaded).
+        self.lock_validator: Optional[Callable[[], None]] = None
+        # Hot-path counters (surfaced via framework.get_metrics): pods
+        # admitted through the batched (decode-free) gang admission path,
+        # and preempt probes served from the epoch-gated victims cache.
+        # Guarded by _counter_lock — chains mutate them concurrently.
+        self.gang_admission_batched_count = 0
+        self.preempt_probe_incremental_count = 0
+        self._counter_lock = threading.Lock()
+        # Mirrored inspect statuses (the reference maintains apiStatus
+        # mirrors, hived_algorithm.go:412-437; we rebuild per chain only
+        # when its epoch moved): chain -> (epoch, [top-cell status dicts]),
+        # VC -> (total epoch, status list). Returned structures are shared
+        # and read-only by contract (the webserver JSON-encodes them).
+        self._phys_status_cache: Dict[CellChain, Tuple[int, List[Dict]]] = {}
+        self._vc_status_cache: Dict[
+            api.VirtualClusterName, Tuple[int, List[Dict]]
+        ] = {}
+
         # VC-safety and bad-cell bookkeeping
         # (reference: hived_algorithm.go:52-93).
         self.all_vc_free_cell_num: Dict[CellChain, Dict[CellLevel, int]] = {}
@@ -551,8 +597,15 @@ class HivedCore:
                     cell
                 )
         # Opportunistic cells currently charged to each VC, for the inspect
-        # API (reference: utils.go:419-452 OT virtual cells).
-        self._ot_cells: Dict[api.VirtualClusterName, List[PhysicalCell]] = {}
+        # API (reference: utils.go:419-452 OT virtual cells). Keyed by cell
+        # address (insertion-ordered, so the inspect output order matches
+        # the old list exactly): with the lock sharded per chain, two
+        # chains can allocate/release opportunistically into the same VC
+        # concurrently, and dict item ops are atomic where a list
+        # scan-and-pop is not.
+        self._ot_cells: Dict[
+            api.VirtualClusterName, Dict[api.CellAddress, PhysicalCell]
+        ] = {}
         # (chain, level) -> count of doomed-bad shortfalls that must be
         # re-checked after the current pod replay completes: evicting a
         # doomed binding mid-replay leaves the shortfall unaddressed, but
@@ -560,7 +613,12 @@ class HivedCore:
         # replayed pod is about to claim — so the check is deferred to
         # add_allocated_pod, and the safety checks discount the pending
         # units meanwhile (the freed quota is spoken for, not actually free).
-        self._pending_doomed_checks: Dict[Tuple[CellChain, CellLevel], int] = {}
+        # THREAD-LOCAL under lock sharding: the deferral is scoped to one
+        # replay call, whose chains the calling thread holds locked — a
+        # concurrent replay in another chain must neither see these
+        # discounts (different chains) nor steal the deferred re-checks
+        # at its own flush.
+        self._pending_doomed_local = threading.local()
         # Seedable source for the preemption victim-node pick; the chaos
         # harness and probe battery replace it with a seeded Random so
         # preemption schedules are deterministic per seed. Production keeps
@@ -574,6 +632,7 @@ class HivedCore:
         # chose instead of arbitrary ones (that arbitrariness is what made
         # the doomed subsystem non-reconstructible before).
         self.doomed_epoch = 0
+        self._doomed_epoch_lock = threading.Lock()
         self.preferred_doomed: Dict[
             Tuple[api.VirtualClusterName, CellChain, CellLevel], Set[str]
         ] = {}
@@ -660,6 +719,63 @@ class HivedCore:
                 for n in c.nodes:
                     self.set_bad_node(n)
 
+    def _install_epoch_refs(self) -> None:
+        """Give every cell (physical and virtual, pinned included) of a
+        chain the chain's shared mutation-epoch counter. Cell membership is
+        fixed at config-compile time, so this runs once."""
+
+        def ref(chain: CellChain) -> List[int]:
+            r = self.chain_epochs.get(chain)
+            if r is None:
+                r = self.chain_epochs[chain] = [0]
+            return r
+
+        def install(ccl, r: Optional[List[int]] = None) -> None:
+            for cl in ccl.levels.values():
+                for c in cl:
+                    c.epoch_ref = r if r is not None else ref(c.chain)
+
+        for chain, ccl in self.full_cell_list.items():
+            install(ccl, ref(chain))
+        for vcs in self.vc_schedulers.values():
+            for chain, ccl in vcs.non_pinned_full.items():
+                install(ccl, ref(chain))
+            for ccl in vcs.pinned_cells.values():
+                install(ccl)
+
+    def chain_epoch(self, chain: CellChain) -> int:
+        r = self.chain_epochs.get(chain)
+        return r[0] if r is not None else 0
+
+    def bump_chain_epoch(self, chain: CellChain) -> None:
+        """Explicit bump for mutations that change chain-visible state
+        WITHOUT touching a cell: pod-slot assignments in a group's
+        allocated_pods (the victims caches list those pods)."""
+        r = self.chain_epochs.get(chain)
+        if r is not None:
+            r[0] += 1
+
+    def epoch_total(self) -> int:
+        """Monotonic sum over all chain epochs (epochs only grow, so equal
+        totals imply equal per-chain epochs) — the VC-status cache key."""
+        return sum(r[0] for r in self.chain_epochs.values())
+
+    def _bump_doomed_epoch(self) -> None:
+        with self._doomed_epoch_lock:
+            self.doomed_epoch += 1
+
+    def _require_global(self) -> None:
+        """Assert the calling thread holds the global lock order before a
+        cross-chain mutation (no-op on bare cores; see lock_validator)."""
+        if self.lock_validator is not None:
+            self.lock_validator()
+
+    def _pending_doomed(self) -> Dict[Tuple[CellChain, CellLevel], int]:
+        d = getattr(self._pending_doomed_local, "d", None)
+        if d is None:
+            d = self._pending_doomed_local.d = {}
+        return d
+
     # -- node events --------------------------------------------------------
 
     def add_node(self, node: Node) -> None:
@@ -676,6 +792,7 @@ class HivedCore:
                 self.set_healthy_node(new.name)
 
     def delete_node(self, node: Node) -> None:
+        self._require_global()
         self.set_bad_node(node.name)
         # Drains are lifted on node delete (the annotation died with the
         # node object); chip-badness records die with it too — the leaves
@@ -707,6 +824,7 @@ class HivedCore:
 
     def set_bad_node(self, node_name: str) -> None:
         """(reference: hived_algorithm.go:467-481)"""
+        self._require_global()
         if node_name in self.bad_nodes:
             return
         self.bad_nodes.add(node_name)
@@ -717,6 +835,7 @@ class HivedCore:
         """(reference: hived_algorithm.go:484-498, chip-granular: leaves
         individually marked bad by the device-health plane stay bad when
         the node as a whole heals)"""
+        self._require_global()
         if node_name not in self.bad_nodes:
             return
         self.bad_nodes.discard(node_name)
@@ -732,6 +851,7 @@ class HivedCore:
         badness propagates up the cell tree through the ordinary
         _set_bad_cell walk — the host stays placeable for work fitting its
         remaining healthy chips."""
+        self._require_global()
         chips = self.bad_chips.setdefault(node_name, set())
         if chip_index in chips:
             return
@@ -744,6 +864,7 @@ class HivedCore:
     def set_healthy_leaf(self, node_name: str, chip_index: int) -> None:
         """Heal one chip's leaf cell. No-op while the node itself is bad —
         the chip record is dropped, and the node-level heal decides."""
+        self._require_global()
         chips = self.bad_chips.get(node_name)
         if chips is None or chip_index not in chips:
             return
@@ -761,6 +882,7 @@ class HivedCore:
         cells. Draining is NOT badness — no doomed-bad binding, no
         bad-free accounting — so lifting a drain is always a pure
         placement-visibility change."""
+        self._require_global()
         current = self.draining_chips.get(node_name, set())
         if current == chip_indices:
             return
@@ -846,7 +968,7 @@ class HivedCore:
                     # A preassigned cell unbound here must be a doomed bad cell.
                     self.vc_doomed_bad_cells[vc.vc][c.chain].remove(c, c.level)
                     self.all_vc_doomed_bad_cell_num[c.chain][c.level] -= 1
-                    self.doomed_epoch += 1
+                    self._bump_doomed_epoch()
                     self._release_preassigned_cell(c, vc.vc, True)
         if c.parent is None:
             return
@@ -914,7 +1036,7 @@ class HivedCore:
                 self.all_vc_doomed_bad_cell_num[chain][level] = (
                     self.all_vc_doomed_bad_cell_num[chain].get(level, 0) + 1
                 )
-                self.doomed_epoch += 1
+                self._bump_doomed_epoch()
                 self._allocate_preassigned_cell(pc, vc_name, True)
 
     def _try_unbind_doomed_bad_cell(self, chain: CellChain, level: CellLevel) -> None:
@@ -964,7 +1086,7 @@ class HivedCore:
         self._unbind_bad_descendants(pc)
         self.vc_doomed_bad_cells[vcn][pc.chain].remove(pc, pc.level)
         self.all_vc_doomed_bad_cell_num[pc.chain][pc.level] -= 1
-        self.doomed_epoch += 1
+        self._bump_doomed_epoch()
         self._release_preassigned_cell(pc, vcn, True)
 
     # -- doomed-ledger persistence ------------------------------------------
@@ -1103,7 +1225,7 @@ class HivedCore:
                 self.all_vc_doomed_bad_cell_num[chain][level] = (
                     self.all_vc_doomed_bad_cell_num[chain].get(level, 0) + 1
                 )
-                self.doomed_epoch += 1
+                self._bump_doomed_epoch()
                 self._allocate_preassigned_cell(pc, vcn, True)
 
     # -- scheduling ---------------------------------------------------------
@@ -1218,7 +1340,7 @@ class HivedCore:
             else:
                 group_physical = g.physical_placement
                 group_virtual = g.virtual_placement
-                victims, _ = collect_preemption_victims(group_physical)
+                victims, _ = self._collect_victims_cached(g)
                 if not victims:
                     common.log.info(
                         "Preemption victims have been cleaned up for the "
@@ -1226,6 +1348,32 @@ class HivedCore:
                     )
                 g.preempting_pods[pod.uid] = pod
         return group_physical, group_virtual, victims, pod_index
+
+    def _collect_victims_cached(
+        self, g: AffinityGroup
+    ) -> Tuple[Dict[str, Dict[str, Pod]], List[AffinityGroup]]:
+        """Epoch-gated victims collection for repeated preempt probes of an
+        existing PREEMPTING gang: every pod of the gang re-probes per
+        extender round while victims terminate, and each probe used to
+        re-walk the whole placement plus every victim gang's pod list. The
+        chain mutation epoch certifies nothing placement- or pod-visible
+        moved in the gang's chain since the last walk, so the cached result
+        is byte-identical to a fresh one (victim deletions bump the epoch
+        via the released cells AND the pod-slot bump in
+        delete_allocated_pod). Results are shared read-only."""
+        chain = group_chain(g)
+        epoch = self.chain_epoch(chain) if chain is not None else -1
+        cached = g.victims_cache
+        if cached is not None and chain is not None and cached[0] == epoch:
+            with self._counter_lock:
+                self.preempt_probe_incremental_count += 1
+            return cached[1], cached[2]
+        victims, overlapping = collect_preemption_victims(
+            g.physical_placement
+        )
+        if chain is not None:
+            g.victims_cache = (epoch, victims, overlapping)
+        return victims, overlapping
 
     def _schedule_pod_from_new_group(
         self,
@@ -1513,11 +1661,24 @@ class HivedCore:
                 "exist in the current configuration"
             )
 
-    def add_allocated_pod(self, pod: Pod) -> None:
+    def add_allocated_pod(
+        self,
+        pod: Pod,
+        spec: Optional[api.PodSchedulingSpec] = None,
+        bind_info: Optional[api.PodBindInfo] = None,
+        pod_index: Optional[int] = None,
+    ) -> None:
         """Confirm an assume-bind or replay a recovered pod
-        (reference: hived_algorithm.go:247-270)."""
+        (reference: hived_algorithm.go:247-270).
+
+        ``spec``/``bind_info``/``pod_index`` are the batched-admission
+        pass-through (doc/hot-path.md): the filter path just GENERATED the
+        bind info and knows the pod's slot index, so re-decoding the
+        annotations it serialized — once per pod of the gang — is pure
+        waste. Recovery replay omits them and decodes from the annotations
+        as before (there, the annotations are the only source of truth)."""
         try:
-            self._add_allocated_pod(pod)
+            self._add_allocated_pod(pod, spec, bind_info, pod_index)
         finally:
             # Must run even when the replay raises (and the framework
             # quarantines the pod): evictions performed before the failure
@@ -1526,9 +1687,20 @@ class HivedCore:
             # safety check.
             self._flush_pending_doomed_checks()
 
-    def _add_allocated_pod(self, pod: Pod) -> None:
-        s = extract_pod_scheduling_spec(pod)
-        info = extract_pod_bind_info(pod)
+    def _add_allocated_pod(
+        self,
+        pod: Pod,
+        spec: Optional[api.PodSchedulingSpec] = None,
+        bind_info: Optional[api.PodBindInfo] = None,
+        given_pod_index: Optional[int] = None,
+    ) -> None:
+        s = spec if spec is not None else extract_pod_scheduling_spec(pod)
+        if bind_info is not None:
+            info = bind_info
+            with self._counter_lock:
+                self.gang_admission_batched_count += 1
+        else:
+            info = extract_pod_bind_info(pod)
         common.log.info(
             "[%s]: Adding allocated pod to affinity group %s (node %s, leaf "
             "cells %s)", pod.key, s.affinity_group.name, info.node,
@@ -1546,7 +1718,14 @@ class HivedCore:
         # same-sized pod whose true index is 0, silently dropping one of
         # them. (The reference hardcodes 0 in that branch,
         # hived_algorithm.go:250-262 — a latent recovery-order bug.)
-        pod_index = get_allocated_pod_index(info, s.leaf_cell_number)
+        # The batched-admission path passes the index through: the schedule
+        # call that generated the bind info selected this pod's placement
+        # by exactly that index, so re-deriving it per pod is an O(gang)
+        # scan that made gang admission O(gang²) in aggregate.
+        if given_pod_index is not None:
+            pod_index = given_pod_index
+        else:
+            pod_index = get_allocated_pod_index(info, s.leaf_cell_number)
         if pod_index == -1:
             common.log.error(
                 "[%s]: Pod placement not found in group %s: node %s, leaf "
@@ -1554,16 +1733,21 @@ class HivedCore:
                 info.leaf_cell_isolation,
             )
             return
-        self.affinity_groups[s.affinity_group.name].allocated_pods[
-            s.leaf_cell_number
-        ][pod_index] = pod
+        group = self.affinity_groups[s.affinity_group.name]
+        group.allocated_pods[s.leaf_cell_number][pod_index] = pod
+        # Pod-slot change: chain-visible (the victims caches list these
+        # pods) but touches no cell — bump the chain epoch explicitly.
+        chain = group_chain(group)
+        if chain is not None:
+            self.bump_chain_epoch(chain)
 
     def _flush_pending_doomed_checks(self) -> None:
         """Replay evictions may have deferred doomed-shortfall re-checks;
         once the replayed pod's quota is consumed, re-dooming cannot steal
         from it."""
-        while self._pending_doomed_checks:
-            (chain, level), _ = self._pending_doomed_checks.popitem()
+        pending = self._pending_doomed()
+        while pending:
+            (chain, level), _ = pending.popitem()
             self._try_bind_doomed_bad_cell(chain, level)
 
     def delete_allocated_pod(self, pod: Pod) -> None:
@@ -1590,6 +1774,10 @@ class HivedCore:
             )
             return
         g.allocated_pods[s.leaf_cell_number][pod_index] = None
+        chain = group_chain(g)
+        if chain is not None:
+            # Victim sets listing this gang's pods are stale now.
+            self.bump_chain_epoch(chain)
         if all_pods_released(g.allocated_pods):
             self._delete_allocated_affinity_group(g, pod)
 
@@ -1771,11 +1959,17 @@ class HivedCore:
             being_preempted = leaf.using_group
             being_preempted_v_leaf: Optional[VirtualCell] = None
             if being_preempted.virtual_placement is not None:
-                being_preempted_v_leaf = retrieve_virtual_cell(
-                    being_preempted.physical_placement,
-                    being_preempted.virtual_placement,
-                    leaf,
-                )
+                # Indexed form of retrieve_virtual_cell (utils.go:271-287):
+                # the victim group's coordinate index answers in O(1)
+                # instead of scanning its whole physical placement per
+                # leaf — cancelling a preemption over a big gang was
+                # O(placement²) in these walks.
+                coords = being_preempted.find_leaf_coords(leaf.address)
+                if coords is not None:
+                    n_, i_, j_ = coords
+                    v = being_preempted.virtual_placement[n_][i_][j_]
+                    assert v is None or isinstance(v, VirtualCell)
+                    being_preempted_v_leaf = v
             self._allocate_leaf_cell(
                 leaf,
                 being_preempted_v_leaf,
@@ -2348,9 +2542,8 @@ class HivedCore:
         )
         self._unbind_doomed_cell(pc)
         key = (chain, level)
-        self._pending_doomed_checks[key] = (
-            self._pending_doomed_checks.get(key, 0) + 1
-        )
+        pending = self._pending_doomed()
+        pending[key] = pending.get(key, 0) + 1
         return True
 
     def _evict_doomed_overlapping(
@@ -2425,9 +2618,8 @@ class HivedCore:
         self._unbind_doomed_cell(pc)
         if not self._swap_doomed_binding(vcn, chain, level, pc, avoid):
             key = (chain, level)
-            self._pending_doomed_checks[key] = (
-                self._pending_doomed_checks.get(key, 0) + 1
-            )
+            pending = self._pending_doomed()
+            pending[key] = pending.get(key, 0) + 1
 
     def _swap_doomed_binding(
         self,
@@ -2484,7 +2676,7 @@ class HivedCore:
         self.all_vc_doomed_bad_cell_num[chain][level] = (
             self.all_vc_doomed_bad_cell_num[chain].get(level, 0) + 1
         )
-        self.doomed_epoch += 1
+        self._bump_doomed_epoch()
         self._allocate_preassigned_cell(candidate, vcn, True)
         return True
 
@@ -2544,7 +2736,7 @@ class HivedCore:
             allocation.update_used_leaf_cell_numbers(
                 p_leaf, OPPORTUNISTIC_PRIORITY, True
             )
-            self._ot_cells.setdefault(vcn, []).append(p_leaf)
+            self._ot_cells.setdefault(vcn, {})[p_leaf.address] = p_leaf
         return safety_ok, reason
 
     def _release_leaf_cell(
@@ -2598,7 +2790,7 @@ class HivedCore:
                     self.all_vc_doomed_bad_cell_num[
                         preassigned_physical.chain
                     ][preassigned_physical.level] -= 1
-                    self.doomed_epoch += 1
+                    self._bump_doomed_epoch()
                     self._release_preassigned_cell(
                         preassigned_physical, vcn, False
                     )
@@ -2617,11 +2809,7 @@ class HivedCore:
                         preassigned_physical.set_virtual_cell(pac)
                         pac.set_physical_cell(preassigned_physical)
         else:
-            ot = self._ot_cells.get(vcn, [])
-            for i, c in enumerate(ot):
-                if c.address == p_leaf.address:
-                    ot.pop(i)
-                    break
+            self._ot_cells.get(vcn, {}).pop(p_leaf.address, None)
         allocation.update_used_leaf_cell_numbers(p_leaf, p_leaf.priority, False)
         allocation.set_cell_priority(p_leaf, FREE_PRIORITY)
 
@@ -2691,7 +2879,7 @@ class HivedCore:
         gets lazy-preempted out of its VC."""
         return self.all_vc_free_cell_num.get(chain, {}).get(
             l, 0
-        ) - self._pending_doomed_checks.get((chain, l), 0)
+        ) - self._pending_doomed().get((chain, l), 0)
 
     def _safety_reason(self, chain: CellChain, l: CellLevel) -> str:
         """Safety-violation message. Uses .get throughout: total_left can be
@@ -2863,18 +3051,35 @@ class HivedCore:
         }
 
     def get_physical_cluster_status(self) -> List[Dict]:
-        """Generated on demand by walking the physical trees (the reference
-        maintains mirrored apiStatus objects instead,
-        hived_algorithm.go:412-437)."""
-        ot_vc_map = self._ot_cell_vc_by_address()
-        return [
-            self._physical_cell_status(
-                c, leaf_type=self.chain_to_leaf_type.get(chain), ot_vc_map=ot_vc_map
-            )
-            for chain, ccl in self.full_cell_list.items()
-            for c in ccl[ccl.top_level]
-            if isinstance(c, PhysicalCell)
-        ]
+        """Mirrored statuses, the reference's approach
+        (hived_algorithm.go:412-437) keyed on the per-chain mutation
+        epochs: a chain whose epoch did not move since the last request
+        serves its cached status list; only dirty chains re-walk their
+        trees. Opportunistic-cell VC attribution changes always bump the
+        owning leaf's chain (the allocate/release priority writes), so the
+        per-chain key covers the ot map too. Returned dicts are shared and
+        read-only by contract (the webserver JSON-encodes them; tests only
+        assert on them)."""
+        out: List[Dict] = []
+        ot_vc_map: Optional[Dict[str, api.VirtualClusterName]] = None
+        for chain, ccl in self.full_cell_list.items():
+            epoch = self.chain_epoch(chain)
+            cached = self._phys_status_cache.get(chain)
+            if cached is None or cached[0] != epoch:
+                if ot_vc_map is None:
+                    ot_vc_map = self._ot_cell_vc_by_address()
+                statuses = [
+                    self._physical_cell_status(
+                        c,
+                        leaf_type=self.chain_to_leaf_type.get(chain),
+                        ot_vc_map=ot_vc_map,
+                    )
+                    for c in ccl[ccl.top_level]
+                    if isinstance(c, PhysicalCell)
+                ]
+                cached = self._phys_status_cache[chain] = (epoch, statuses)
+            out.extend(cached[1])
+        return out
 
     def get_all_virtual_clusters_status(self) -> Dict[str, List[Dict]]:
         return {vc: self.get_virtual_cluster_status(vc) for vc in self.vc_schedulers}
@@ -2882,6 +3087,21 @@ class HivedCore:
     def get_virtual_cluster_status(self, vcn: api.VirtualClusterName) -> List[Dict]:
         if vcn not in self.vc_schedulers:
             raise api.bad_request(f"VC {vcn} not found")
+        # Mirror cache, keyed on the all-chain epoch total: a VC's status
+        # reads its own chains' virtual trees plus opportunistic cells that
+        # can live in ANY chain, so the conservative key is the sum (epochs
+        # only grow — equal totals imply nothing changed anywhere).
+        total = self.epoch_total()
+        cached = self._vc_status_cache.get(vcn)
+        if cached is not None and cached[0] == total:
+            return cached[1]
+        out = self._build_virtual_cluster_status(vcn)
+        self._vc_status_cache[vcn] = (total, out)
+        return out
+
+    def _build_virtual_cluster_status(
+        self, vcn: api.VirtualClusterName
+    ) -> List[Dict]:
         vcs = self.vc_schedulers[vcn]
         out: List[Dict] = []
         for chain, ccl in vcs.non_pinned_preassigned.items():
@@ -2899,7 +3119,7 @@ class HivedCore:
                     )
                 )
         # Opportunistic cells used by this VC (reference: utils.go:419-436).
-        for p_leaf in self._ot_cells.get(vcn, []):
+        for p_leaf in self._ot_cells.get(vcn, {}).values():
             ps = self._physical_cell_status(p_leaf, shallow=True)
             out.append(
                 {
@@ -2919,9 +3139,9 @@ class HivedCore:
     def _ot_cell_vc_by_address(self) -> Dict[str, api.VirtualClusterName]:
         """address -> VC for synthesized opportunistic virtual cells."""
         return {
-            oc.address: vcn
+            addr: vcn
             for vcn, ocs in self._ot_cells.items()
-            for oc in ocs
+            for addr in ocs
         }
 
     def _physical_cell_status(

@@ -85,6 +85,36 @@ class AffinityGroup:
         # Invalidated whenever the VIRTUAL placement changes (lazy preemption
         # and its revert change the preassigned cell types inside the record).
         self.bind_info_cache: Optional[Tuple[List[Any], str]] = None
+        # Preempt-probe victims cache: (chain mutation epoch, victims,
+        # overlapping preemptors) — repeated preempt probes of the same
+        # PREEMPTING gang are O(1) while nothing in the gang's chain moved
+        # (core._collect_victims_cached; doc/hot-path.md "Preempt-path
+        # indexing"). Epoch-gated, so no explicit invalidation sites.
+        self.victims_cache: Optional[Tuple[int, Any, Any]] = None
+        # Physical-placement coordinate index: leaf address ->
+        # (leaf_num, pod_index, leaf_index), built lazily by
+        # find_leaf_coords. Physical placements never move once assigned
+        # (slots only ever go from None to a cell during creation/replay),
+        # so the index only needs rebuilding when it misses an address.
+        self._leaf_coords: Optional[Dict[str, Tuple[int, int, int]]] = None
+
+    def find_leaf_coords(self, address: str) -> Optional[Tuple[int, int, int]]:
+        """O(1) lookup of a physical leaf's position inside the group's
+        placement — the indexed replacement for the O(placement) scan the
+        reservation-state walks (core.retrieve_virtual_cell) used to pay
+        per leaf, making preemption cancel/rollback O(placement²)."""
+        coords = self._leaf_coords
+        if coords is None or address not in coords:
+            coords = {}
+            for leaf_num, pod_placements in self.physical_placement.items():
+                for pod_index, pod_placement in enumerate(pod_placements):
+                    for leaf_index, leaf in enumerate(pod_placement):
+                        if leaf is not None:
+                            coords[leaf.address] = (
+                                leaf_num, pod_index, leaf_index
+                            )
+            self._leaf_coords = coords
+        return coords.get(address)
 
     def to_status(self) -> Dict[str, Any]:
         """Inspect DTO (reference: types.go:189-214 ``ToAffinityGroup``)."""

@@ -22,6 +22,7 @@ differential proof against the naive rebuild.
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -55,21 +56,25 @@ FULL_RESCORE_FRACTION = 0.5
 class PhaseStats:
     """Per-phase latency accumulators for the filter hot path (lock-wait,
     core-schedule, leaf-cell search), shared by the framework and every
-    TopologyAwareScheduler of one core. Mutated under the scheduler lock;
-    snapshots are read-only and tolerate torn floats."""
+    TopologyAwareScheduler of one core. With the scheduler lock sharded per
+    chain, two chains' schedulers can accumulate concurrently, so ``add``
+    takes its own (uncontended-cheap) lock; snapshots are read-only and
+    tolerate torn floats."""
 
-    __slots__ = ("phases",)
+    __slots__ = ("phases", "_lock")
 
     def __init__(self) -> None:
         # phase name -> [count, total_seconds]
         self.phases: Dict[str, List[float]] = {}
+        self._lock = threading.Lock()
 
     def add(self, phase: str, seconds: float, n: int = 1) -> None:
-        entry = self.phases.get(phase)
-        if entry is None:
-            entry = self.phases[phase] = [0, 0.0]
-        entry[0] += n
-        entry[1] += seconds
+        with self._lock:
+            entry = self.phases.get(phase)
+            if entry is None:
+                entry = self.phases[phase] = [0, 0.0]
+            entry[0] += n
+            entry[1] += seconds
 
     def snapshot(self) -> Dict[str, Dict[str, float]]:
         out: Dict[str, Dict[str, float]] = {}

@@ -2,9 +2,14 @@
 
 Python equivalent of the reference's ``pkg/scheduler/scheduler.go`` (L53-745):
 it owns the pod-schedule-status map (the ground truth of the scheduling view),
-serializes all scheduling under one lock, executes the assume-bind trick on
-the filter path, insists on previous binds, force-binds when the default
-scheduler stalls, and replays bound pods at startup for crash recovery.
+serializes scheduling per CELL CHAIN (the reference uses one global lock,
+scheduler.go:104-108; see scheduler.locks and doc/hot-path.md "The
+lock-sharding contract" — filter/bind/preempt calls for disjoint chains
+proceed concurrently, whole-cluster mutators take the total-order global
+mode, and HIVED_GLOBAL_LOCK=1 restores the single-lock behavior), executes
+the assume-bind trick on the filter path, insists on previous binds,
+force-binds when the default scheduler stalls, and replays bound pods at
+startup for crash recovery.
 
 Instead of client-go informers, the framework exposes plain event-handler
 methods (``add_pod``/``update_pod``/``delete_pod``, ``add_node``/...) that an
@@ -18,14 +23,15 @@ from __future__ import annotations
 import math
 import threading
 import time
-from typing import Callable, Dict, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from .. import common
 from ..api import constants, extender as ei, types as api
 from ..api.config import Config
-from ..algorithm.core import HivedCore
+from ..algorithm.core import HivedCore, group_chain
 from ..algorithm.placement import PhaseStats
 from . import health as health_mod
+from .locks import ChainShardedLock
 from .types import (
     Node,
     Pod,
@@ -280,14 +286,41 @@ class HivedScheduler:
         # (production keeps the reference behavior: reject and let the
         # default scheduler retry after the informer catches up).
         auto_admit: bool = False,
+        # Lock-sharding escape hatch: True forces every section to the
+        # single-lock (all-chains) behavior for differential testing;
+        # None reads HIVED_GLOBAL_LOCK (locks.ChainShardedLock).
+        global_lock: Optional[bool] = None,
     ) -> None:
         self.config = config
         self.kube_client = kube_client or NullKubeClient()
         self.core = HivedCore(config)
         self.metrics = SchedulerMetrics()
-        # One lock serializes scheduling and all state mutation; Schedule() is
-        # never executed concurrently (reference: scheduler.go:104-108).
-        self._lock = threading.RLock()
+        # Scheduling serializes per cell chain (scheduler.locks): filter /
+        # bind / preempt acquire only the chains their pod's spec can touch,
+        # whole-cluster mutators (node/pod events, health, recovery,
+        # inspect) take the global order — which is what self._lock now IS:
+        # a guard over every chain lock, in total order, preserving the old
+        # single-lock semantics for everything that still uses it
+        # (reference: one lock, scheduler.go:104-108).
+        self._locks = ChainShardedLock(
+            self.core.full_cell_list.keys(), force_global=global_lock
+        )
+        self._lock = self._locks.global_guard
+        # Runtime teeth of the sharding contract: cross-chain core mutators
+        # assert the global order (see locks.require_global and the chaos
+        # sensitivity meta-test).
+        self.core.lock_validator = self._locks.require_global
+        # Innermost mutex for the deferred-side-effect queues below
+        # (annotation clears, evictions): they are appended to from inside
+        # chain sections and swapped out by concurrent flushes, so the
+        # global guard no longer covers them. Never held while acquiring
+        # anything else.
+        self._side_effect_lock = threading.Lock()
+        # Mixed-SKU gang guard (see _claim_group_chains): group name ->
+        # the chain-lock set its first not-yet-registered scheduler ran
+        # under. Guarded by _side_effect_lock; entries die when the group
+        # registers or its pods are deleted.
+        self._group_chain_claims: Dict[str, Tuple[str, ...]] = {}
         # uid -> PodScheduleStatus for all live hived pods
         # (reference: scheduler.go:110-115).
         self.pod_schedule_statuses: Dict[str, PodScheduleStatus] = {}
@@ -351,6 +384,142 @@ class HivedScheduler:
         threading.Thread(target=fn, daemon=True).start()
 
     # ------------------------------------------------------------------ #
+    # Lock sharding (scheduler.locks; doc/hot-path.md "The lock-sharding
+    # contract")
+    # ------------------------------------------------------------------ #
+
+    def _pod_lock_chains(
+        self, pod: Pod, spec: Optional[api.PodSchedulingSpec] = None
+    ) -> Optional[List[str]]:
+        """The cell chains a scheduling call for this pod can touch,
+        derived from the spec BEFORE lock acquisition: the chains carrying
+        the requested leaf SKU (or the pinned cell's chain), widened by the
+        chain its existing affinity group is placed in. None means "cannot
+        be narrowed" (no/undecodable spec, or an untyped pod — any-leaf-
+        type scheduling probes every chain) and degrades to the global
+        order. Reads only compile-time config plus atomic dict lookups, so
+        it is safe without locks; the caller re-derives INSIDE the section
+        (_run_chain_locked) to close the derive-then-acquire race."""
+        if spec is None:
+            try:
+                spec = extract_pod_scheduling_spec(pod)
+            except api.WebServerError:
+                return None
+        core = self.core
+        chains: Optional[List[str]] = None
+        if spec.pinned_cell_id:
+            vcs = core.vc_schedulers.get(spec.virtual_cluster)
+            pinned = (
+                vcs.pinned_cells.get(spec.pinned_cell_id)
+                if vcs is not None
+                else None
+            )
+            if pinned is None:
+                return None  # unknown pinned cell: validation rejects inside
+            chains = [pinned[pinned.top_level][0].chain]
+        elif spec.leaf_cell_type:
+            typed = core.cell_chains.get(spec.leaf_cell_type)
+            if not typed:
+                return None  # unknown SKU: schedule() rejects inside
+            chains = list(typed)
+        else:
+            return None
+        g = core.affinity_groups.get(spec.affinity_group.name)
+        if g is not None:
+            gchain = group_chain(g)
+            if gchain is not None and gchain not in chains:
+                # A gang pod whose leaf type differs from the pod that
+                # placed the group: its group state lives elsewhere.
+                chains.append(gchain)
+        if pod.node_name:
+            # Bound pod (replay / lifecycle event): its cells are on its
+            # node, and the node -> leaf index is compile-time static, so
+            # this is exact even when a reconfiguration moved the node to
+            # a chain outside the spec's SKU set (the moved-cell fallback
+            # in find_physical_leaf_cell searches every chain).
+            for leaf in core._node_leaf_index.get(pod.node_name, []):
+                if leaf.chain not in chains:
+                    chains.append(leaf.chain)
+        return chains
+
+    def _claim_group_chains(self, spec, keys: Tuple[str, ...]) -> bool:
+        """Guard against the mixed-SKU gang race: two pods of ONE gang
+        whose specs derive disjoint chain sets (different leafCellType —
+        pathological but legal input) could otherwise schedule the
+        not-yet-registered group concurrently under different locks and
+        double-create it (the loser's cells would leak on an orphaned
+        group object). The first scheduler of an unregistered group claims
+        the name with its lock set; a claim COVERED by the current keys is
+        provably finished (a live claimant would still hold those locks,
+        which we now hold) and is overridden, while an uncovered claim may
+        still be running — the caller degrades to the global order.
+        Claims die when the group registers or its pods are deleted."""
+        if spec is None or spec.affinity_group is None:
+            return True
+        name = spec.affinity_group.name
+        if self.core.affinity_groups.get(name) is not None:
+            # Registered: group existence itself now serializes (its chain
+            # is in every pod's lock set via _pod_lock_chains).
+            with self._side_effect_lock:
+                self._group_chain_claims.pop(name, None)
+            return True
+        with self._side_effect_lock:
+            cur = self._group_chain_claims.get(name)
+            if cur is not None and not set(cur).issubset(keys):
+                return False
+            self._group_chain_claims[name] = tuple(keys)
+        return True
+
+    def _drop_group_claim(self, name: Optional[str]) -> None:
+        if name:
+            with self._side_effect_lock:
+                self._group_chain_claims.pop(name, None)
+
+    def _run_chain_locked(self, pod, spec, fn):
+        """Run ``fn(section)`` under the pod's chain locks. The needed set
+        is re-derived inside the section and the section retried wider if
+        it moved (another pod of the gang can register the group in a chain
+        outside this pod's spec-derived set between derivation and
+        acquisition), and an unregistered group's name must be claimable
+        for this lock set (_claim_group_chains); bounded, then degrades to
+        the global order. Lock wait of an abandoned too-narrow section is
+        carried into the section that finally runs ``fn`` so the lockWait
+        metric reports the true total."""
+        if spec is None:
+            try:
+                spec = extract_pod_scheduling_spec(pod)
+            except api.WebServerError:
+                spec = None
+        chains = self._pod_lock_chains(pod, spec)
+        carried_wait = 0.0
+        for _ in range(2):
+            sec = self._locks.section(chains)
+            with sec:
+                if sec.keys == self._locks.all_keys:
+                    # Global: covers everything; a stale uncovered claim
+                    # must not keep degrading this gang's pods forever.
+                    if spec is not None and spec.affinity_group is not None:
+                        self._drop_group_claim(spec.affinity_group.name)
+                    sec.wait_s += carried_wait
+                    return fn(sec)
+                needed = self._pod_lock_chains(pod, spec)
+                ok = needed is not None and set(needed).issubset(sec.keys)
+                if ok and not self._claim_group_chains(spec, sec.keys):
+                    needed = None  # conflicting live claim: go global
+                    ok = False
+                if ok:
+                    sec.wait_s += carried_wait
+                    return fn(sec)
+            carried_wait += sec.wait_s
+            chains = needed
+        sec = self._locks.section(None)
+        with sec:
+            if spec is not None and spec.affinity_group is not None:
+                self._drop_group_claim(spec.affinity_group.name)
+            sec.wait_s += carried_wait
+            return fn(sec)
+
+    # ------------------------------------------------------------------ #
     # Deferred kube side effects (preempt/reconfig fault plane)
     # ------------------------------------------------------------------ #
 
@@ -363,10 +532,14 @@ class HivedScheduler:
             self._flush_side_effects()
 
     def _on_preemption_event(self, group, event: str) -> None:
-        """Core observer (called under the scheduler lock): a preempting
-        group completed or was cancelled — its pods' preempt-info
-        annotations are stale; clear them once the lock is released."""
-        self._pending_annotation_clears.extend(group.preempting_pods.values())
+        """Core observer (called under the acting thread's chain section):
+        a preempting group completed or was cancelled — its pods'
+        preempt-info annotations are stale; clear them once the locks are
+        released."""
+        with self._side_effect_lock:
+            self._pending_annotation_clears.extend(
+                group.preempting_pods.values()
+            )
 
     def _flush_side_effects(self) -> None:
         """Run the kube writes collected during the mutation that just
@@ -387,7 +560,7 @@ class HivedScheduler:
         self._persist_doomed_ledger()
 
     def _flush_annotation_clears(self) -> None:
-        with self._lock:
+        with self._side_effect_lock:
             clears, self._pending_annotation_clears = (
                 self._pending_annotation_clears, []
             )
@@ -407,14 +580,15 @@ class HivedScheduler:
         ConfigMap when it changed since the last successful write. The
         write runs outside the scheduler lock; _ledger_write_lock serializes
         concurrent flushes so snapshots cannot land out of order."""
-        # Fast path BEFORE the write lock: a mutator that changed nothing
-        # doomed (the overwhelmingly common case — every filter call ends
-        # here) must not block behind another thread's in-flight ConfigMap
-        # write. Benign race: a stale read just means the next flush (or
-        # the in-flight writer's re-snapshot) picks the change up.
-        with self._lock:
-            if self.core.doomed_epoch == self._persisted_doomed_epoch:
-                return
+        # LOCK-FREE fast path: a mutator that changed nothing doomed (the
+        # overwhelmingly common case — every filter call ends here) must
+        # neither block behind another thread's in-flight ConfigMap write
+        # nor take the all-chains global order just to compare two ints
+        # (int reads are atomic). Benign race: a stale read just means the
+        # next flush (or the in-flight writer's re-snapshot) picks the
+        # change up.
+        if self.core.doomed_epoch == self._persisted_doomed_epoch:
+            return
         with self._ledger_write_lock:
             with self._lock:
                 epoch = self.core.doomed_epoch
@@ -581,7 +755,8 @@ class HivedScheduler:
                         "preempt-info annotation", pod.key, reason,
                     )
                     self.metrics.observe_preemption_recovery(False)
-                    self._pending_annotation_clears.append(pod)
+                    with self._side_effect_lock:
+                        self._pending_annotation_clears.append(pod)
 
     def mark_ready(self) -> None:
         """Recovery (initial list replay) complete: /readyz turns 200."""
@@ -592,9 +767,11 @@ class HivedScheduler:
 
     def _quarantine_pod(self, pod: Pod, error: Exception) -> None:
         """Park an unreplayable bound pod: logged, counted, surfaced via the
-        inspect API, and excluded from the scheduling view. Must be called
-        with or without the lock held (RLock re-entry)."""
-        with self._lock:
+        inspect API, and excluded from the scheduling view. Callable from
+        any section — the record map is guarded by the innermost
+        side-effect lock (a chain section must not widen to the global
+        guard)."""
+        with self._side_effect_lock:
             if pod.uid in self.quarantined_pods:
                 return
             common.log.error(
@@ -810,41 +987,45 @@ class HivedScheduler:
         evicts anybody."""
         if not self.config.stranded_gang_eviction:
             return
-        for rec in self._stranded_groups_locked():
-            name = rec["name"]
-            if name in self._evicted_groups:
-                continue
-            g = self.core.affinity_groups.get(name)
-            if g is None:
-                continue
-            pods = [
-                p
+        # The `_evicted_*` sets and the eviction queue are shared with the
+        # concurrent flush threads; all read-modify-write maintenance runs
+        # under the (innermost) side-effect lock.
+        with self._side_effect_lock:
+            for rec in self._stranded_groups_locked():
+                name = rec["name"]
+                if name in self._evicted_groups:
+                    continue
+                g = self.core.affinity_groups.get(name)
+                if g is None:
+                    continue
+                pods = [
+                    p
+                    for pods in g.allocated_pods.values()
+                    for p in pods
+                    if p is not None and p.uid not in self._evicted_pod_uids
+                ]
+                if not pods:
+                    continue
+                self._evicted_groups.add(name)
+                self._pending_evictions.extend((name, p) for p in pods)
+            # Groups that completed/died release their eviction memory.
+            self._evicted_groups &= set(self.core.affinity_groups)
+            live_uids = {
+                p.uid
+                for g in self.core.affinity_groups.values()
                 for pods in g.allocated_pods.values()
                 for p in pods
-                if p is not None and p.uid not in self._evicted_pod_uids
-            ]
-            if not pods:
-                continue
-            self._evicted_groups.add(name)
-            self._pending_evictions.extend((name, p) for p in pods)
-        # Groups that completed/died release their eviction memory.
-        self._evicted_groups &= set(self.core.affinity_groups)
-        live_uids = {
-            p.uid
-            for g in self.core.affinity_groups.values()
-            for pods in g.allocated_pods.values()
-            for p in pods
-            if p is not None
-        }
-        self._evicted_pod_uids &= live_uids
+                if p is not None
+            }
+            self._evicted_pod_uids &= live_uids
 
     def _flush_evictions(self) -> None:
-        with self._lock:
+        with self._side_effect_lock:
             evictions, self._pending_evictions = self._pending_evictions, []
         for group_name, pod in evictions:
             try:
                 self.kube_client.evict_pod(pod)
-                with self._lock:
+                with self._side_effect_lock:
                     self._evicted_pod_uids.add(pod.uid)
                 self.metrics.observe_stranded_eviction()
                 common.log.warning(
@@ -855,7 +1036,7 @@ class HivedScheduler:
                 # Re-arm the gang so the next flush's stranded re-check
                 # retries — only the pods whose delete never landed are
                 # re-queued (_evicted_pod_uids).
-                with self._lock:
+                with self._side_effect_lock:
                     self._evicted_groups.discard(group_name)
                     self._eviction_retry_pending = True
                 common.log.warning(
@@ -890,10 +1071,17 @@ class HivedScheduler:
             return
         self._enter_mutation()
         try:
-            if is_bound(pod):
-                self._add_bound_pod(pod)
-            else:
-                self._add_unbound_pod(pod)
+            # Chain-scoped like filter: a pod event touches only its own
+            # chains' cell state (bound pods: the node's chains via the
+            # static index; unbound pods: the status map only), so informer
+            # churn no longer stalls every chain's scheduling.
+            def locked(sec):
+                if is_bound(pod):
+                    self._add_bound_pod_locked(pod)
+                else:
+                    self._admit_unbound(pod)
+
+            self._run_chain_locked(pod, None, locked)
         finally:
             self._exit_mutation()
 
@@ -945,71 +1133,96 @@ class HivedScheduler:
     def delete_pod(self, pod: Pod) -> None:
         self._enter_mutation()
         try:
-            self._delete_pod(pod)
+            # Chain-scoped (see add_pod): releasing a pod touches only its
+            # own chains' cells and group.
+            self._run_chain_locked(
+                pod, None, lambda sec: self._delete_pod_locked(pod)
+            )
         finally:
             self._exit_mutation()
 
-    def _delete_pod(self, pod: Pod) -> None:
-        with self._lock:
-            # A quarantined pod holds no cell state; just drop the record.
-            self.quarantined_pods.pop(pod.uid, None)
-            status = self.pod_schedule_statuses.get(pod.uid)
-            if status is None:
-                return
-            try:
-                if is_allocated_state(status.pod_state):
-                    self.core.delete_allocated_pod(status.pod)
-                else:
-                    self.core.delete_unallocated_pod(status.pod)
-            except Exception:  # noqa: BLE001
-                # A delete that fails half-way must still drop the status:
-                # replaying it forever would wedge the informer on one pod
-                # (the core logs-and-continues on unknown placements, so
-                # anything raising here is unexpected corruption).
-                common.log.exception(
-                    "[%s]: error releasing pod from the core; dropping its "
-                    "status anyway", pod.key,
-                )
-            del self.pod_schedule_statuses[pod.uid]
+    def _delete_pod_locked(self, pod: Pod) -> None:
+        """Body of delete_pod; the caller holds a section covering the
+        pod's chains."""
+        # A gang that dies without ever registering releases its
+        # mixed-SKU claim here (registered groups already dropped it) —
+        # but only a claim whose lock set this thread HOLDS is provably
+        # not a concurrently-running scheduler's (same rule as the claim
+        # override in _claim_group_chains).
+        try:
+            name = extract_pod_scheduling_spec(pod).affinity_group.name
+        except api.WebServerError:
+            name = None
+        if name:
+            with self._side_effect_lock:
+                claim = self._group_chain_claims.get(name)
+                if claim is not None and self._locks.holds_chains(claim):
+                    self._group_chain_claims.pop(name, None)
+        # A quarantined pod holds no cell state; just drop the record.
+        self.quarantined_pods.pop(pod.uid, None)
+        status = self.pod_schedule_statuses.get(pod.uid)
+        if status is None:
+            return
+        try:
+            if is_allocated_state(status.pod_state):
+                self.core.delete_allocated_pod(status.pod)
+            else:
+                self.core.delete_unallocated_pod(status.pod)
+        except Exception:  # noqa: BLE001
+            # A delete that fails half-way must still drop the status:
+            # replaying it forever would wedge the informer on one pod
+            # (the core logs-and-continues on unknown placements, so
+            # anything raising here is unexpected corruption).
+            common.log.exception(
+                "[%s]: error releasing pod from the core; dropping its "
+                "status anyway", pod.key,
+            )
+        del self.pod_schedule_statuses[pod.uid]
 
     def _add_bound_pod(self, pod: Pod) -> None:
-        with self._lock:
-            status = self.pod_schedule_statuses.get(pod.uid)
-            if status is not None and is_allocated_state(status.pod_state):
-                # Already allocated (assume-bind): the placement never changes
-                # again; just confirm Bound (reference: scheduler.go:314-328).
-                if status.pod_state != PodState.BOUND:
-                    self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
-                        pod=status.pod, pod_state=PodState.BOUND
-                    )
-                return
-            if pod.uid in self.quarantined_pods:
-                # Relists re-deliver quarantined pods every gap repair; the
-                # verdict does not change until the pod itself does.
-                return
-            # Recovery of a pod bound before we started. Validate BEFORE
-            # mutating cell state: a corrupt bind-info annotation or a
-            # placement gone from the config quarantines this one pod
-            # instead of aborting the whole recovery replay
-            # (pre-fault-model behavior: raise through recover()).
-            try:
-                self.core.validate_allocated_pod(pod)
-                self.core.add_allocated_pod(pod)
-            except Exception as e:  # noqa: BLE001
-                self._quarantine_pod(pod, e)
-                return
-            self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
-                pod=pod, pod_state=PodState.BOUND
-            )
+        self._run_chain_locked(
+            pod, None, lambda sec: self._add_bound_pod_locked(pod)
+        )
 
-    def _add_unbound_pod(self, pod: Pod) -> None:
-        with self._lock:
-            if pod.uid in self.pod_schedule_statuses:
-                return
-            self.core.add_unallocated_pod(pod)
-            self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
-                pod=pod, pod_state=PodState.WAITING
-            )
+    def _add_bound_pod_locked(self, pod: Pod) -> None:
+        status = self.pod_schedule_statuses.get(pod.uid)
+        if status is not None and is_allocated_state(status.pod_state):
+            # Already allocated (assume-bind): the placement never changes
+            # again; just confirm Bound (reference: scheduler.go:314-328).
+            if status.pod_state != PodState.BOUND:
+                self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                    pod=status.pod, pod_state=PodState.BOUND
+                )
+            return
+        if pod.uid in self.quarantined_pods:
+            # Relists re-deliver quarantined pods every gap repair; the
+            # verdict does not change until the pod itself does.
+            return
+        # Recovery of a pod bound before we started. Validate BEFORE
+        # mutating cell state: a corrupt bind-info annotation or a
+        # placement gone from the config quarantines this one pod
+        # instead of aborting the whole recovery replay
+        # (pre-fault-model behavior: raise through recover()).
+        try:
+            self.core.validate_allocated_pod(pod)
+            self.core.add_allocated_pod(pod)
+        except Exception as e:  # noqa: BLE001
+            self._quarantine_pod(pod, e)
+            return
+        self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+            pod=pod, pod_state=PodState.BOUND
+        )
+
+    def _admit_unbound(self, pod: Pod) -> None:
+        """Lock-free body shared by the informer add_pod path and the
+        auto-admit path — both inside the pod's CHAIN section, which must
+        not widen to the global order (lock-sharding contract)."""
+        if pod.uid in self.pod_schedule_statuses:
+            return
+        self.core.add_unallocated_pod(pod)
+        self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+            pod=pod, pod_state=PodState.WAITING
+        )
 
     # ------------------------------------------------------------------ #
     # Admission + bind validation (reference: scheduler.go:362-466)
@@ -1022,7 +1235,7 @@ class HivedScheduler:
         (reference: scheduler.go:364-383)."""
         status = self.pod_schedule_statuses.get(uid)
         if status is None and self.auto_admit and pod is not None:
-            self._add_unbound_pod(pod)
+            self._admit_unbound(pod)
             status = self.pod_schedule_statuses.get(uid)
         if status is None:
             raise api.bad_request(
@@ -1130,12 +1343,20 @@ class HivedScheduler:
         except api.WebServerError as e:
             spec_error = e
         suggested_set = set(args.node_names)
-        lock_t0 = time.monotonic()
-        with self._lock:
-            lock_wait = time.monotonic() - lock_t0
-            result, outcome, core_s = self._filter_locked(
-                args, spec, spec_error, suggested_set
-            )
+
+        # Chain-scoped critical section: filters for disjoint chains run
+        # concurrently (spec parse above and result serialization in the
+        # webserver are already outside). Each section measures its own
+        # lock wait, per chain (lockWaitByChain in the metrics); a widened
+        # retry contributes its wait too.
+        sections: List = []
+
+        def locked(sec):
+            sections.append(sec)
+            return self._filter_locked(args, spec, spec_error, suggested_set)
+
+        result, outcome, core_s = self._run_chain_locked(pod, spec, locked)
+        lock_wait = sum(s.wait_s for s in sections)
         self.metrics.observe_filter(
             time.monotonic() - start, outcome, lock_wait, core_s
         )
@@ -1177,8 +1398,17 @@ class HivedScheduler:
             binding_pod = new_binding_pod(pod, result.pod_bind_info)
             # Assume-bind: mark allocated NOW so the next pod schedules
             # against updated state without waiting for the K8s bind
-            # round-trip (reference: scheduler.go:518-530).
-            self.core.add_allocated_pod(binding_pod)
+            # round-trip (reference: scheduler.go:518-530). Batched gang
+            # admission: hand the decoded spec, the just-generated bind
+            # info, and the pod's slot index straight back to the core —
+            # the reference re-decodes the annotations it serialized one
+            # line earlier, once per pod of the gang.
+            self.core.add_allocated_pod(
+                binding_pod,
+                spec=spec,
+                bind_info=result.pod_bind_info,
+                pod_index=result.pod_index,
+            )
             new_status = PodScheduleStatus(
                 pod=binding_pod,
                 pod_state=PodState.BINDING,
@@ -1250,7 +1480,14 @@ class HivedScheduler:
         # Binding carries the pod UID as an apiserver precondition
         # (kube.py bind_pod), so a delete+recreate of the same pod name
         # between validation and write cannot receive the stale bind.
-        with self._lock:
+        # Chain-scoped: the validation only reads this pod's status, so
+        # the section for its spec's chains suffices (and is what the
+        # sync force-bind executor already holds when it re-enters here).
+        peek = self.pod_schedule_statuses.get(args.pod_uid)
+        chains = (
+            self._pod_lock_chains(peek.pod) if peek is not None else None
+        )
+        with self._locks.section(chains):
             status = self._admission_check(args.pod_uid)
             if status.pod_state != PodState.BINDING:
                 raise api.bad_request(
@@ -1273,10 +1510,13 @@ class HivedScheduler:
         cells forever, since no informer DELETE will ever arrive for a pod
         that was never bound. Release it; if the pod still exists unbound,
         the default scheduler re-filters it and it is re-admitted cleanly
-        (called by RetryingKubeClient, outside the scheduler lock)."""
+        (called by RetryingKubeClient, outside the scheduler lock — except
+        the sync force-bind test path, which re-enters holding the pod's
+        chain section; the section here is the same set, so it must NOT be
+        the global guard or it would widen)."""
         self._enter_mutation()
         try:
-            with self._lock:
+            with self._locks.section(self._pod_lock_chains(binding_pod)):
                 status = self.pod_schedule_statuses.get(binding_pod.uid)
                 if status is None or status.pod_state != PodState.BINDING:
                     # Never allocated, or already confirmed Bound (the
@@ -1286,7 +1526,7 @@ class HivedScheduler:
                     "[%s]: releasing allocation after terminal bind failure "
                     "(node %s)", binding_pod.key, binding_pod.node_name,
                 )
-                self._delete_pod(status.pod)
+                self._delete_pod_locked(status.pod)
         finally:
             self._exit_mutation()
 
@@ -1299,9 +1539,22 @@ class HivedScheduler:
     ) -> ei.ExtenderPreemptionResult:
         self._enter_mutation()
         try:
-            with self._lock:
-                result = self._preempt_locked(args)
-                patch = self._preempt_annotation_patch(args.pod)
+            # Chain-scoped like filter: preempt probes and commits touch
+            # only the pod's spec-derived chains (victims overlap the
+            # preemptor's own placement by construction).
+            spec = None
+            try:
+                spec = extract_pod_scheduling_spec(args.pod)
+            except api.WebServerError:
+                pass
+
+            def locked(sec):
+                return (
+                    self._preempt_locked(args),
+                    self._preempt_annotation_patch(args.pod),
+                )
+
+            result, patch = self._run_chain_locked(args.pod, spec, locked)
             if patch is not None:
                 # Checkpoint the reservation onto the preemptor pod OUTSIDE
                 # the lock (it is a kube write): a crash between the
@@ -1345,9 +1598,12 @@ class HivedScheduler:
         # cancellation queued for it earlier in THIS round (core.schedule
         # cancels a stale reservation and immediately recreates it in one
         # call) — the exit-time flush must not erase a live checkpoint.
-        self._pending_annotation_clears = [
-            p for p in self._pending_annotation_clears if p.uid != pod.uid
-        ]
+        # Rebind under the side-effect lock: concurrent chain sections
+        # extend this list and flushes swap it.
+        with self._side_effect_lock:
+            self._pending_annotation_clears = [
+                p for p in self._pending_annotation_clears if p.uid != pod.uid
+            ]
         value = common.to_json(payload)
         if pod.annotations.get(constants.ANNOTATION_POD_PREEMPT_INFO) == value:
             return None
@@ -1356,63 +1612,63 @@ class HivedScheduler:
     def _preempt_locked(
         self, args: ei.ExtenderPreemptionArgs
     ) -> ei.ExtenderPreemptionResult:
-        with self._lock:
-            pod = args.pod
-            # In the Preempting phase the candidate nodes are those where the
-            # default scheduler found lower-priority victims.
-            suggested_nodes = list(args.node_name_to_meta_victims.keys())
+        # Caller (preempt_routine via _run_chain_locked) holds the section.
+        pod = args.pod
+        # In the Preempting phase the candidate nodes are those where the
+        # default scheduler found lower-priority victims.
+        suggested_nodes = list(args.node_name_to_meta_victims.keys())
 
-            status = self._admission_check(pod.uid, pod)
-            if status.pod_state == PodState.BINDING:
-                raise api.bad_request(
-                    f"Pod has already been binding to node {status.pod.node_name}"
-                )
-
-            # Whether Waiting or Preempting, schedule afresh: a previous
-            # preemption result may be stale (reference: scheduler.go:655-668).
-            result = self.core.schedule(
-                pod, suggested_nodes, SchedulingPhase.PREEMPTING
+        status = self._admission_check(pod.uid, pod)
+        if status.pod_state == PodState.BINDING:
+            raise api.bad_request(
+                f"Pod has already been binding to node {status.pod.node_name}"
             )
 
-            if result.pod_bind_info is not None:
-                # Free resource appeared; the pod will bind via the filter
-                # path (the algorithm does NOT assume-bind in this phase).
-                common.log.info(
-                    "[%s]: Pod is waiting for filterRoutine as free resource "
-                    "appeared",
-                    pod.key,
-                )
-                return ei.ExtenderPreemptionResult()
+        # Whether Waiting or Preempting, schedule afresh: a previous
+        # preemption result may be stale (reference: scheduler.go:655-668).
+        result = self.core.schedule(
+            pod, suggested_nodes, SchedulingPhase.PREEMPTING
+        )
 
-            if result.pod_preempt_info is not None:
-                self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
-                    pod=pod,
-                    pod_state=PodState.PREEMPTING,
-                    pod_schedule_result=result,
-                )
-                nodes_victims: Dict[str, ei.MetaVictims] = {}
-                for victim in result.pod_preempt_info.victim_pods:
-                    node = victim.node_name
-                    nodes_victims.setdefault(node, ei.MetaVictims()).pods.append(
-                        ei.MetaPod(uid=victim.uid)
-                    )
-                common.log.info(
-                    "[%s]: Pod is preempting victims on nodes %s",
-                    pod.key,
-                    sorted(nodes_victims),
-                )
-                return ei.ExtenderPreemptionResult(
-                    node_name_to_meta_victims=nodes_victims
-                )
-
-            self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
-                pod=pod, pod_state=PodState.WAITING, pod_schedule_result=result
+        if result.pod_bind_info is not None:
+            # Free resource appeared; the pod will bind via the filter
+            # path (the algorithm does NOT assume-bind in this phase).
+            common.log.info(
+                "[%s]: Pod is waiting for filterRoutine as free resource "
+                "appeared",
+                pod.key,
             )
-            wait_reason = "Pod is waiting for preemptible or free resource to appear"
-            if result.pod_wait_info is not None and result.pod_wait_info.reason:
-                wait_reason += ": " + result.pod_wait_info.reason
-            common.log.info("[%s]: %s", pod.key, wait_reason)
             return ei.ExtenderPreemptionResult()
+
+        if result.pod_preempt_info is not None:
+            self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                pod=pod,
+                pod_state=PodState.PREEMPTING,
+                pod_schedule_result=result,
+            )
+            nodes_victims: Dict[str, ei.MetaVictims] = {}
+            for victim in result.pod_preempt_info.victim_pods:
+                node = victim.node_name
+                nodes_victims.setdefault(node, ei.MetaVictims()).pods.append(
+                    ei.MetaPod(uid=victim.uid)
+                )
+            common.log.info(
+                "[%s]: Pod is preempting victims on nodes %s",
+                pod.key,
+                sorted(nodes_victims),
+            )
+            return ei.ExtenderPreemptionResult(
+                node_name_to_meta_victims=nodes_victims
+            )
+
+        self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+            pod=pod, pod_state=PodState.WAITING, pod_schedule_result=result
+        )
+        wait_reason = "Pod is waiting for preemptible or free resource to appear"
+        if result.pod_wait_info is not None and result.pod_wait_info.reason:
+            wait_reason += ": " + result.pod_wait_info.reason
+        common.log.info("[%s]: %s", pod.key, wait_reason)
+        return ei.ExtenderPreemptionResult()
 
     # ------------------------------------------------------------------ #
     # Inspect delegates (reference: scheduler.go:723-745)
@@ -1447,6 +1703,20 @@ class HivedScheduler:
         # Merge the core-side phase accumulators (leaf-cell search happens
         # inside the topology-aware schedulers; see placement.PhaseStats).
         snap["phases"].update(self.core.phase_stats.snapshot())
+        # Concurrent-core counters (doc/hot-path.md): per-chain lock-wait
+        # breakdown (locks.GLOBAL_KEY aggregates the global-guard holders),
+        # decode-free gang admissions, and preempt probes served from the
+        # epoch-gated victims cache.
+        snap["lockSharding"] = (
+            "global" if self._locks.force_global else "chains"
+        )
+        snap["lockWaitByChain"] = self._locks.wait_snapshot()
+        snap["gangAdmissionBatchedCount"] = (
+            self.core.gang_admission_batched_count
+        )
+        snap["preemptProbeIncrementalCount"] = (
+            self.core.preempt_probe_incremental_count
+        )
         with self._lock:
             snap["quarantinedPodCount"] = len(self.quarantined_pods)
             snap["strandedGroupCount"] = self._stranded_group_count_locked()

@@ -1,0 +1,220 @@
+"""Per-chain lock sharding for the scheduling core.
+
+The reference serializes every extender callback under one scheduler lock
+(scheduler.go:104-108); PR 1 made the lock-wait share of filter latency
+measurable (``lockWait`` in the phase metrics), and this module removes it
+for the common case: scheduling state is almost entirely partitioned by
+cell chain (free lists, VC quota ledgers, doomed accounting, the cluster
+views — see doc/hot-path.md "The lock-sharding contract"), so filter/bind
+calls touching disjoint chains can proceed concurrently.
+
+Design:
+
+- one ``threading.RLock`` per cell chain, with a TOTAL acquisition order
+  (sorted chain name). Every acquisition — chain-scoped or global — takes
+  its locks in that order, so lock-ordering deadlocks are impossible as
+  long as no code path acquires a lock while holding a later-ordered one
+  it does not already hold. The manager tracks per-thread held counts so
+  that invariant (and the global-order contract below) is CHECKABLE at
+  runtime, not just documented.
+- chain-scoped sections (:meth:`ChainShardedLock.section`) acquire exactly
+  the chains a request can touch (derived from the pod's scheduling spec
+  BEFORE acquisition — see ``HivedScheduler._pod_lock_chains``).
+- the global guard (:attr:`ChainShardedLock.global_guard`) acquires EVERY
+  chain lock, in order: whole-cluster mutators (node/health events, pod
+  lifecycle events, recovery, inspect snapshots) run under it, which also
+  makes it mutually exclusive with every chain section — the semantics of
+  the old single lock, at the price of N acquisitions.
+- ``HIVED_GLOBAL_LOCK=1`` (or ``force_global=True``) is the differential
+  escape hatch: chain sections silently widen to all chains, restoring
+  the single-lock behavior exactly (tests/test_lock_sharding.py proves
+  sharded ≡ global placements and metrics-visible state).
+
+Reentrancy: RLocks make nested sections free when the needed chains are
+already held (global inside global, subset inside global, same subset
+inside itself — the force-bind path re-enters this way). A section must
+NEVER widen while narrower locks are held (subset -> global, or subset ->
+different subset): that breaks the total order. ``section`` asserts it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+GLOBAL_LOCK_ENV = "HIVED_GLOBAL_LOCK"
+
+# Pseudo-chain key under which global-guard waits are accumulated.
+GLOBAL_KEY = "*global*"
+
+
+class _Section:
+    """One chain-scoped acquisition: a fresh object per use so the measured
+    ``wait_s`` is race-free. ``keys`` are already sorted by the manager."""
+
+    __slots__ = ("_mgr", "keys", "wait_s")
+
+    def __init__(self, mgr: "ChainShardedLock", keys: Tuple[str, ...]):
+        self._mgr = mgr
+        self.keys = keys
+        self.wait_s = 0.0
+
+    def __enter__(self) -> "_Section":
+        self.wait_s = self._mgr._acquire(self.keys, per_chain_stats=True)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._mgr._release(self.keys)
+
+
+class _GlobalGuard:
+    """Drop-in replacement for the framework's old single ``RLock``:
+    ``with sched._lock:`` acquires every chain lock in total order. Shared
+    and stateless, so one instance serves all threads."""
+
+    __slots__ = ("_mgr",)
+
+    def __init__(self, mgr: "ChainShardedLock"):
+        self._mgr = mgr
+
+    def __enter__(self) -> "_GlobalGuard":
+        self._mgr._acquire(self._mgr.all_keys, per_chain_stats=False)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._mgr._release(self._mgr.all_keys)
+
+
+class ChainShardedLock:
+    """The per-chain lock table plus held-set tracking and wait metrics."""
+
+    def __init__(self, chains: Iterable[str], force_global: Optional[bool] = None):
+        self.all_keys: Tuple[str, ...] = tuple(sorted(str(c) for c in chains))
+        self._locks: Dict[str, threading.RLock] = {
+            c: threading.RLock() for c in self.all_keys
+        }
+        self.force_global = (
+            os.environ.get(GLOBAL_LOCK_ENV, "0") == "1"
+            if force_global is None
+            else force_global
+        )
+        # chain (or GLOBAL_KEY) -> [acquisitions, waited seconds]. Per-chain
+        # entries are only mutated while holding that chain's lock; the
+        # GLOBAL_KEY entry only while holding all of them — no extra lock
+        # needed.
+        self._wait_stats: Dict[str, List[float]] = {
+            c: [0, 0.0] for c in self.all_keys
+        }
+        self._wait_stats[GLOBAL_KEY] = [0, 0.0]
+        # Per-thread held-lock depths: {chain: depth}. Maintained so the
+        # core's cross-chain mutators can ASSERT they run under the global
+        # order (require_global) and section() can assert no widening.
+        self._held = threading.local()
+
+    # -- acquisition ---------------------------------------------------- #
+
+    def _held_map(self) -> Dict[str, int]:
+        d = getattr(self._held, "d", None)
+        if d is None:
+            d = self._held.d = {}
+        return d
+
+    def _acquire(self, keys: Tuple[str, ...], per_chain_stats: bool) -> float:
+        held = self._held_map()
+        waited = 0.0
+        for k in keys:
+            if held.get(k, 0):
+                # Reentrant: no wait, no stats double-count.
+                held[k] += 1
+                continue
+            t0 = time.monotonic()
+            self._locks[k].acquire()
+            dt = time.monotonic() - t0
+            waited += dt
+            held[k] = 1
+            if per_chain_stats:
+                entry = self._wait_stats[k]
+                entry[0] += 1
+                entry[1] += dt
+        if not per_chain_stats:
+            # Global guard: one aggregated entry, updated while holding
+            # every lock (so no per-chain entry can race with it either).
+            entry = self._wait_stats[GLOBAL_KEY]
+            entry[0] += 1
+            entry[1] += waited
+        return waited
+
+    def _release(self, keys: Tuple[str, ...]) -> None:
+        held = self._held_map()
+        for k in reversed(keys):
+            depth = held.get(k, 0)
+            if depth > 1:
+                held[k] = depth - 1
+            else:
+                held.pop(k, None)
+                self._locks[k].release()
+
+    def section(self, chains: Optional[Iterable[str]]) -> _Section:
+        """A context manager acquiring the given chains (total order).
+        ``None``, an empty set, an unknown chain, or force-global mode all
+        widen to every chain — unknown inputs must degrade to the SAFE
+        side, never to a narrower lock than the request can touch."""
+        if self.force_global or chains is None:
+            keys = self.all_keys
+        else:
+            wanted = {str(c) for c in chains}
+            if not wanted or not wanted.issubset(self._locks):
+                keys = self.all_keys
+            else:
+                keys = tuple(k for k in self.all_keys if k in wanted)
+        held = self._held_map()
+        if held:
+            # Widening while holding a narrower set would break the total
+            # order; only already-held (or subset) re-entry is legal.
+            fresh = [k for k in keys if not held.get(k, 0)]
+            assert not fresh or all(held.get(k, 0) for k in self.all_keys), (
+                "lock-order violation: acquiring chains %s while holding %s"
+                % (fresh, sorted(held))
+            )
+        return _Section(self, keys)
+
+    @property
+    def global_guard(self) -> _GlobalGuard:
+        return _GlobalGuard(self)
+
+    # -- introspection --------------------------------------------------- #
+
+    def holds_all(self) -> bool:
+        held = self._held_map()
+        return all(held.get(k, 0) for k in self.all_keys)
+
+    def holds_chains(self, keys: Iterable[str]) -> bool:
+        """True when the calling thread holds every listed chain lock."""
+        held = self._held_map()
+        return all(held.get(k, 0) for k in keys)
+
+    def require_global(self) -> None:
+        """Raise unless the calling thread holds EVERY chain lock. Wired
+        into the core's cross-chain mutators (node/chip health, drains,
+        node deletes) as the runtime teeth of the lock-sharding contract:
+        bypassing the global order is a bug the chaos sensitivity meta-test
+        must catch, not a silent race (doc/hot-path.md)."""
+        if not self.holds_all():
+            raise RuntimeError(
+                "cross-chain mutator called without the global lock order "
+                "(held: %s)" % sorted(self._held_map())
+            )
+
+    def wait_snapshot(self) -> Dict[str, Dict[str, float]]:
+        """Per-chain lock-wait breakdown for the metrics endpoint. Reads
+        without locks: torn floats are acceptable in a diagnostic."""
+        out: Dict[str, Dict[str, float]] = {}
+        for k, (count, total) in list(self._wait_stats.items()):
+            if count:
+                out[k] = {
+                    "count": int(count),
+                    "totalMs": round(total * 1e3, 3),
+                }
+        return out

@@ -132,6 +132,12 @@ class PodScheduleResult:
     pod_wait_info: Optional[PodWaitInfo] = None
     pod_preempt_info: Optional[PodPreemptInfo] = None
     pod_bind_info: Optional[api.PodBindInfo] = None
+    # Batched-admission pass-through (doc/hot-path.md): the pod's slot
+    # index inside its group's bind info, recorded when pod_bind_info is
+    # generated so the assume-bind path can hand the already-decoded
+    # decision straight back to core.add_allocated_pod instead of paying
+    # a bind-info decode + O(gang) index scan per pod of the gang.
+    pod_index: Optional[int] = None
 
 
 @dataclass

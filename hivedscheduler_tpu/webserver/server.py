@@ -24,8 +24,14 @@ from ..scheduler import kube as kube_mod
 from ..scheduler.framework import HivedScheduler
 
 # Latency metrics + the per-phase filter breakdown (lockWait / coreSchedule /
-# leafCellSearch — see doc/hot-path.md); served from the same inspect tree as
-# the cluster-status endpoints.
+# leafCellSearch), the per-chain lock-wait split (lockWaitByChain — the
+# sharded scheduler lock, doc/hot-path.md "The lock-sharding contract"),
+# and the concurrent-core counters (gangAdmissionBatchedCount /
+# preemptProbeIncrementalCount); served from the same inspect tree as the
+# cluster-status endpoints. The inspect status endpoints below serve
+# MIRRORED per-chain status objects (rebuilt only for chains whose
+# mutation epoch moved), so a scrape under load no longer holds the lock
+# for a full-tree walk.
 METRICS_PATH = constants.INSPECT_PATH + "/metrics"
 
 

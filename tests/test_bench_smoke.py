@@ -45,3 +45,31 @@ def test_bench_smoke_p50_and_phase_breakdown():
 
     # The record is JSON-serializable as emitted by HIVED_BENCH_SMOKE=1.
     json.dumps(result)
+
+
+def test_bench_concurrent_smoke():
+    """Tiny run of the HIVED_BENCH_CONCURRENT stage: two worker threads
+    over two disjoint chains, sharded vs forced-global, in-process. CI
+    machines are too noisy for a speedup assertion (the driver bench
+    tracks that); this guards the stage's wiring and the determinism
+    contract — each family's schedule is independent of the lock shape,
+    so both runs must place exactly the same pods."""
+    result = bench.bench_concurrent(
+        threads=2, gangs_per_thread=10, hosts_per_family=8, block_ms=1
+    )
+    assert result["sharded"]["pods_scheduled"] > 0
+    assert (
+        result["sharded"]["pods_scheduled"]
+        == result["global_lock"]["pods_scheduled"]
+    )
+    assert result["sharded"]["filter_count"] == (
+        result["global_lock"]["filter_count"]
+    )
+    assert result["speedup_vs_global_lock"] > 0
+    # The per-chain lock-wait breakdown is present for both lock shapes.
+    for side in ("sharded", "global_lock"):
+        assert "lockWaitByChain" in result[side]
+        assert result[side]["phases"]["lockWait"]["count"] == (
+            result[side]["filter_count"]
+        )
+    json.dumps(result)

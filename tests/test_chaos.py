@@ -54,6 +54,14 @@ CORRUPTION_RESTART_SEEDS = (3, 8, 11, 23, 27, 33)
 # Reserving/Reserved recovery is re-broken (sensitivity meta-test below).
 RESERVING_RECOVERY_SEEDS = (52, 80, 104, 118, 137, 179)
 
+# Seeds whose schedules apply a node/chip health transition on a
+# MULTI-chain fleet — the schedules that die if a cross-chain mutator
+# bypasses the lock-sharding global order (see
+# test_bypassed_global_lock_order_is_caught; doc/hot-path.md "The
+# lock-sharding contract"). Single-chain seeds (e.g. 2) can never catch
+# this — one chain's lock IS the global order there.
+GLOBAL_ORDER_SEEDS = (0, 1, 3, 4, 5, 6)
+
 # Seeds whose schedules run a flap storm — the schedules that die if flap
 # damping is disabled (the harness asserts the damper holds a storm to at
 # most threshold-1 applied transitions; see test_disabled_damping_is_caught).
@@ -127,6 +135,37 @@ def test_rebroken_reserving_recovery_is_caught(monkeypatch):
             caught += 1
     assert caught == len(RESERVING_RECOVERY_SEEDS), (
         "re-broken Reserving/Reserved recovery escaped the pinned seeds"
+    )
+
+
+def test_bypassed_global_lock_order_is_caught(monkeypatch):
+    """Sensitivity meta-test for the lock-sharding contract: rewrite the
+    node-event handler to take only ONE chain's lock instead of the
+    total-order global mode (the bug sharding must never regress into —
+    a health event mutating chains it does not hold) and assert the
+    pinned seeds fail on the core's require_global validator. If this
+    passes while the global order is bypassed, the contract has no
+    teeth."""
+
+    def bypassed_update_node(self, old, new):
+        self._enter_mutation()
+        try:
+            first_chain = self._locks.all_keys[:1]
+            with self._locks.section(first_chain):
+                self.nodes[new.name] = new
+                self._observe_node_health(new)
+        finally:
+            self._exit_mutation()
+
+    monkeypatch.setattr(HivedScheduler, "update_node", bypassed_update_node)
+    caught = 0
+    for seed in GLOBAL_ORDER_SEEDS:
+        try:
+            chaos.run_chaos_schedule(seed)
+        except RuntimeError:
+            caught += 1
+    assert caught == len(GLOBAL_ORDER_SEEDS), (
+        "bypassed cross-chain global order escaped the pinned chaos seeds"
     )
 
 

@@ -1,0 +1,565 @@
+"""Lock sharding (scheduler.locks): differential equivalence, concurrency,
+and contract enforcement.
+
+The concurrent scheduling core replaces the framework's single RLock with
+per-chain locks plus a total-order global mode (doc/hot-path.md "The
+lock-sharding contract"). Three things must hold:
+
+1. **Equivalence** — sharded ≡ ``HIVED_GLOBAL_LOCK=1`` single-lock runs:
+   identical filter/preempt outcomes and identical metrics-visible state
+   over randomized scenario schedules (the lock shape must never influence
+   a scheduling decision).
+2. **Concurrency** — filter calls for DISJOINT chains genuinely overlap
+   (proved with an event handshake, not timing), and a multi-threaded
+   disjoint-chain hammering leaves the core satisfying the chaos
+   invariants (cell conservation, doomed consistency, zero leaks).
+3. **Contract teeth** — cross-chain mutators assert the global order
+   (``locks.require_global``), and a section can never widen while
+   holding a narrower one (total-order protection).
+"""
+
+import json
+import logging
+import random
+import threading
+
+import pytest
+
+import bench
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.api import extender as ei, types as api
+from hivedscheduler_tpu.scheduler.framework import HivedScheduler, NullKubeClient
+from hivedscheduler_tpu.scheduler.locks import ChainShardedLock
+from hivedscheduler_tpu.scheduler.types import Node
+
+from .chaos import audit_invariants, core_fingerprint, random_config
+from .test_core import make_pod
+
+common.init_logging(logging.CRITICAL)
+
+N_EQUIVALENCE_SCENARIOS = 60
+
+
+# --------------------------------------------------------------------- #
+# 1. Differential equivalence: sharded ≡ global-lock
+# --------------------------------------------------------------------- #
+
+
+def _metrics_visible(sched: HivedScheduler) -> dict:
+    """The deterministic (non-timing) slice of the metrics payload plus the
+    full cluster state: what the ISSUE's differential proof compares."""
+    m = sched.get_metrics()
+    counters = {
+        k: v
+        for k, v in m.items()
+        if isinstance(v, (int, bool)) and "Latency" not in k
+    }
+    return {
+        "counters": counters,
+        "sharding_differs_only_here": None,  # lockSharding excluded below
+        "cluster": sched.get_cluster_status(),
+        "groups": sched.get_all_affinity_groups(),
+        "ledger": sched.core.doomed_ledger_snapshot(),
+        "fingerprint": core_fingerprint(sched.core),
+    }
+
+
+def _drive_scenario(sched: HivedScheduler, seed: int):
+    """One seeded schedule of gang churn, node flips, and preempt probes
+    through the production verbs; returns the outcome trace."""
+    rnd = random.Random(seed)
+    sched.core.preempt_rng = random.Random(seed ^ 0xF00D)
+    nodes = sched.core.configured_node_names()
+    for n in nodes:
+        sched.add_node(Node(name=n))
+    outcomes = []
+    live = {}  # gang name -> bound pods
+    gang_id = 0
+    for event in range(24):
+        roll = rnd.random()
+        if roll < 0.15 and live:
+            name = rnd.choice(sorted(live))
+            for bp in live.pop(name):
+                sched.delete_pod(bp)
+            outcomes.append(("del", name))
+            continue
+        if roll < 0.25:
+            node = rnd.choice(nodes)
+            bad = rnd.random() < 0.5
+            sched.update_node(
+                Node(name=node, ready=bad), Node(name=node, ready=not bad)
+            )
+            outcomes.append(("node", node, not bad))
+            continue
+        gang_id += 1
+        name = f"g{seed}-{gang_id}"
+        vc = rnd.choice(["A", "B"])
+        leaf_type = rnd.choice(["v5e-chip", "v5e-chip", "v5p-chip"])
+        priority = rnd.choice([-1, 0, 0, 5])
+        n_pods = rnd.choice([1, 1, 2, 4])
+        chips = rnd.choice([1, 2, 4])
+        group = {
+            "name": name,
+            "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
+        }
+        preempt = rnd.random() < 0.25
+        bound, ok = [], True
+        for i in range(n_pods):
+            pod = make_pod(
+                f"{name}-{i}", f"u-{name}-{i}", vc, priority, leaf_type,
+                chips, group=group,
+            )
+            sched.add_pod(pod)
+            if preempt:
+                try:
+                    r = sched.preempt_routine(
+                        ei.ExtenderPreemptionArgs(
+                            pod=pod,
+                            node_name_to_meta_victims={
+                                n: ei.MetaVictims() for n in nodes
+                            },
+                        )
+                    )
+                    outcomes.append(
+                        ("preempt", name, i,
+                         sorted(r.node_name_to_meta_victims or {}))
+                    )
+                except api.WebServerError as e:
+                    # A user error (e.g. SKU absent from this random fleet)
+                    # must be identical on both sides.
+                    outcomes.append(("preempt-err", name, i, e.message))
+                sched.delete_pod(pod)
+                ok = False
+                break
+            try:
+                r = sched.filter_routine(
+                    ei.ExtenderArgs(pod=pod, node_names=nodes)
+                )
+            except api.WebServerError as e:
+                outcomes.append(("filter-err", name, i, e.message))
+                sched.delete_pod(pod)
+                ok = False
+                break
+            outcomes.append(
+                ("filter", name, i, r.node_names,
+                 sorted(r.failed_nodes or {}))
+            )
+            if r.node_names:
+                bound.append(sched.pod_schedule_statuses[pod.uid].pod)
+            else:
+                ok = False
+                break
+        if ok and bound:
+            live[name] = bound
+        else:
+            for bp in bound:
+                sched.delete_pod(bp)
+            # Remaining never-scheduled pods of the gang.
+            for i in range(len(bound) + 1, n_pods):
+                pod = make_pod(
+                    f"{name}-{i}", f"u-{name}-{i}", vc, priority,
+                    leaf_type, chips, group=group,
+                )
+                sched.delete_pod(pod)
+    return outcomes
+
+
+def test_sharded_equals_global_lock_over_scenarios():
+    for seed in range(N_EQUIVALENCE_SCENARIOS):
+        cfg = lambda: random_config(random.Random(seed))  # noqa: E731
+        sharded = HivedScheduler(
+            cfg(), kube_client=NullKubeClient(), auto_admit=True,
+            global_lock=False,
+        )
+        single = HivedScheduler(
+            cfg(), kube_client=NullKubeClient(), auto_admit=True,
+            global_lock=True,
+        )
+        out_a = _drive_scenario(sharded, seed)
+        out_b = _drive_scenario(single, seed)
+        assert out_a == out_b, (seed, out_a[-3:], out_b[-3:])
+        ma, mb = _metrics_visible(sharded), _metrics_visible(single)
+        assert ma == mb, (
+            seed,
+            {k: (ma[k], mb[k]) for k in ma if ma[k] != mb[k]},
+        )
+        # The two payloads stay JSON-serializable (webserver contract).
+        json.dumps(ma["cluster"])
+
+
+# --------------------------------------------------------------------- #
+# 2. Concurrency
+# --------------------------------------------------------------------- #
+
+
+def test_disjoint_chain_sections_overlap():
+    """Deterministic proof (no timing): a thread inside chain A's section
+    signals, then waits for a second thread to ENTER chain B's section —
+    which can only happen if the two sections are concurrent. Under the
+    forced single lock the same handshake must deadlock-timeout."""
+    cfg = bench.build_concurrent_config(2, 4)
+
+    def handshake(force_global: bool) -> bool:
+        sched = HivedScheduler(
+            cfg, kube_client=NullKubeClient(), global_lock=force_global
+        )
+        chains = sorted(sched.core.full_cell_list)
+        inside_a = threading.Event()
+        inside_b = threading.Event()
+
+        def hold_a():
+            with sched._locks.section([chains[0]]):
+                inside_a.set()
+                inside_b.wait(timeout=5)
+
+        def enter_b():
+            inside_a.wait(timeout=5)
+            with sched._locks.section([chains[1]]):
+                inside_b.set()
+
+        ta = threading.Thread(target=hold_a)
+        tb = threading.Thread(target=enter_b)
+        ta.start(), tb.start()
+        overlapped = inside_b.wait(timeout=2)
+        inside_b.set()  # release hold_a either way
+        ta.join(timeout=5), tb.join(timeout=5)
+        return overlapped
+
+    assert handshake(force_global=False), "disjoint chains must overlap"
+    assert not handshake(force_global=True), (
+        "HIVED_GLOBAL_LOCK must restore mutual exclusion across chains"
+    )
+
+
+def test_concurrent_disjoint_filters_keep_invariants():
+    """N threads hammer filter/delete churn over disjoint chains (each
+    family its own SKU, chain, and VC); afterwards the chaos structural
+    invariants must hold and a full drain must return every cell to Free."""
+    n_families = 3
+    cfg = bench.build_concurrent_config(n_families, 8)
+    sched = HivedScheduler(
+        cfg, kube_client=NullKubeClient(), auto_admit=True
+    )
+    nodes = sched.core.configured_node_names()
+    for n in nodes:
+        sched.add_node(Node(name=n))
+    errors = []
+
+    def worker(fam: int):
+        try:
+            fam_nodes = [n for n in nodes if n.startswith(f"cc{fam}-")]
+            live = []
+            for g in range(40):
+                gname = f"cc{fam}-g{g}"
+                n_pods = (1, 2)[g % 2]
+                group = {
+                    "name": gname,
+                    "members": [
+                        {"podNumber": n_pods, "leafCellNumber": 4}
+                    ],
+                }
+                pods = [
+                    make_pod(
+                        f"{gname}-{i}", f"{gname}-u{i}", f"vc{fam}",
+                        0, f"cc{fam}-chip", 4, group=group,
+                    )
+                    for i in range(n_pods)
+                ]
+                bound, ok = [], True
+                for p in pods:
+                    r = sched.filter_routine(
+                        ei.ExtenderArgs(pod=p, node_names=fam_nodes)
+                    )
+                    if not r.node_names:
+                        ok = False
+                        break
+                    bound.append(sched.pod_schedule_statuses[p.uid].pod)
+                if ok:
+                    live.append(bound)
+                else:
+                    for p in pods:
+                        sched.delete_pod(p)
+                    for old in live[: max(1, len(live) // 2)]:
+                        for q in old:
+                            sched.delete_pod(q)
+                    live = live[max(1, len(live) // 2):]
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,))
+        for i in range(n_families)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not any(t.is_alive() for t in threads), "deadlocked threads"
+    assert not errors, errors[:3]
+
+    # Chaos structural invariants: cell conservation, per-leaf state
+    # machine, doomed consistency, health consistency.
+    audit_invariants(sched, "post-concurrent-churn")
+
+    # Zero leaks: drain everything, all cells return to Free.
+    for status in list(sched.pod_schedule_statuses.values()):
+        sched.delete_pod(status.pod)
+    assert sched.get_all_affinity_groups() == {"items": []}
+    for chain, ccl in sched.core.full_cell_list.items():
+        for cell in ccl[ccl.top_level]:
+            assert cell.state.value == "Free", (chain, cell.address)
+
+
+# --------------------------------------------------------------------- #
+# 3. Contract enforcement
+# --------------------------------------------------------------------- #
+
+
+def test_cross_chain_mutator_requires_global_order():
+    cfg = bench.build_concurrent_config(2, 4)
+    # Explicit sharded mode: under HIVED_GLOBAL_LOCK=1 a chain section IS
+    # the global order, so the narrow-section assertions below would not
+    # (and should not) trip.
+    sched = HivedScheduler(
+        cfg, kube_client=NullKubeClient(), global_lock=False
+    )
+    # Bare call without any section: the validator must trip.
+    with pytest.raises(RuntimeError, match="global lock order"):
+        sched.core.set_bad_node("cc0-s0-w0")
+    # Under a chain section (narrower than global): still trips.
+    chain = sorted(sched.core.full_cell_list)[0]
+    with sched._locks.section([chain]):
+        with pytest.raises(RuntimeError, match="global lock order"):
+            sched.core.apply_drain("cc0-s0-w0", {0})
+    # Under the global guard: legal.
+    with sched._lock:
+        sched.core.set_bad_node("cc0-s0-w0")
+        sched.core.set_healthy_node("cc0-s0-w0")
+
+
+def test_section_cannot_widen_while_held():
+    locks = ChainShardedLock(["a", "b", "c"], force_global=False)
+    with locks.section(["b"]):
+        with pytest.raises(AssertionError, match="lock-order violation"):
+            locks.section(["a", "b"])
+        # Re-entry of the SAME subset (the sync force-bind path) is legal.
+        with locks.section(["b"]):
+            pass
+    # Global-then-subset nesting is legal (RLock re-entry).
+    with locks.section(None):
+        with locks.section(["a"]):
+            pass
+        assert locks.holds_all()
+
+
+def test_unknown_chain_degrades_to_global():
+    locks = ChainShardedLock(["a", "b"], force_global=False)
+    sec = locks.section(["nonexistent"])
+    assert sec.keys == ("a", "b")
+    sec2 = locks.section([])
+    assert sec2.keys == ("a", "b")
+
+
+def test_mixed_sku_gang_creation_serializes():
+    """Mixed-SKU gang guard (_claim_group_chains): two pods of ONE gang
+    whose specs derive DISJOINT chain sets must not schedule the
+    unregistered group concurrently under different locks. Thread A holds
+    chain-0's section with a live claim on the gang name; pod B (chain-1
+    SKU, same gang) must degrade to the global order and BLOCK until A
+    releases — then exactly one group exists."""
+    cfg = bench.build_concurrent_config(2, 8)
+    sched = HivedScheduler(
+        cfg, kube_client=NullKubeClient(), auto_admit=True,
+        global_lock=False,
+    )
+    nodes = sched.core.configured_node_names()
+    for n in nodes:
+        sched.add_node(Node(name=n))
+    gang = {"name": "mix", "members": [{"podNumber": 2, "leafCellNumber": 4}]}
+    pod_b = make_pod("mix-1", "mix-u1", "vc1", 0, "cc1-chip", 4, group=gang)
+    spec_a = make_pod("mix-0", "mix-u0", "vc0", 0, "cc0-chip", 4, group=gang)
+
+    chain0 = [c for c in sched.core.full_cell_list if c.startswith("cc0")]
+    claimed = threading.Event()
+    release = threading.Event()
+    b_done = threading.Event()
+
+    def holder():
+        from hivedscheduler_tpu.scheduler.types import (
+            extract_pod_scheduling_spec,
+        )
+
+        with sched._locks.section(chain0):
+            assert sched._claim_group_chains(
+                extract_pod_scheduling_spec(spec_a), tuple(chain0)
+            )
+            claimed.set()
+            release.wait(timeout=10)
+
+    def filter_b():
+        r = sched.filter_routine(
+            ei.ExtenderArgs(pod=pod_b, node_names=nodes)
+        )
+        assert r.node_names, r.failed_nodes
+        b_done.set()
+
+    ta = threading.Thread(target=holder)
+    tb = threading.Thread(target=filter_b)
+    ta.start()
+    assert claimed.wait(timeout=5)
+    tb.start()
+    # B sees an uncovered live claim -> degrades to global -> blocks on
+    # chain 0, which A still holds.
+    assert not b_done.wait(timeout=0.5), (
+        "mixed-SKU gang pod must not proceed past a live foreign claim"
+    )
+    release.set()
+    assert b_done.wait(timeout=10)
+    ta.join(timeout=5), tb.join(timeout=5)
+    assert "mix" in sched.core.affinity_groups
+    # The registered group dropped the claim.
+    assert "mix" not in sched._group_chain_claims
+
+
+# --------------------------------------------------------------------- #
+# Batched admission + preempt-path indexing counters
+# --------------------------------------------------------------------- #
+
+
+def test_gang_admission_is_batched_on_the_filter_path():
+    cfg = bench.build_concurrent_config(1, 8)
+    sched = HivedScheduler(
+        cfg, kube_client=NullKubeClient(), auto_admit=True
+    )
+    nodes = sched.core.configured_node_names()
+    for n in nodes:
+        sched.add_node(Node(name=n))
+    group = {"name": "gg", "members": [{"podNumber": 4, "leafCellNumber": 4}]}
+    pods = [
+        make_pod(f"gg-{i}", f"gg-u{i}", "vc0", 0, "cc0-chip", 4, group=group)
+        for i in range(4)
+    ]
+    for p in pods:
+        r = sched.filter_routine(ei.ExtenderArgs(pod=p, node_names=nodes))
+        assert r.node_names, r.failed_nodes
+    m = sched.get_metrics()
+    # Every assume-bound pod of the gang skipped the bind-info decode.
+    assert m["gangAdmissionBatchedCount"] == 4
+    # The batched path must place pods into DISTINCT slots: all 4 pods are
+    # tracked, and a recovery-shaped replay of the same gang agrees.
+    g = sched.core.affinity_groups["gg"]
+    assert sorted(
+        p.uid for pods_ in g.allocated_pods.values() for p in pods_ if p
+    ) == sorted(p.uid for p in pods)
+
+
+def test_preempt_reprobe_is_incremental():
+    cfg = bench.build_concurrent_config(1, 8)
+    sched = HivedScheduler(
+        cfg, kube_client=NullKubeClient(), auto_admit=True
+    )
+    nodes = sched.core.configured_node_names()
+    for n in nodes:
+        sched.add_node(Node(name=n))
+    # Seeded victim-node pick: the probe comparisons below must not depend
+    # on process randomness.
+    sched.core.preempt_rng = random.Random(42)
+    # Fill the family with low-priority victims.
+    for g in range(8):
+        group = {
+            "name": f"v{g}", "members": [{"podNumber": 4, "leafCellNumber": 4}]
+        }
+        for i in range(4):
+            p = make_pod(
+                f"v{g}-{i}", f"v{g}-u{i}", "vc0", 0, "cc0-chip", 4,
+                group=group,
+            )
+            sched.filter_routine(ei.ExtenderArgs(pod=p, node_names=nodes))
+    # A high-priority preemptor commits a reservation...
+    group = {"name": "pre", "members": [{"podNumber": 2, "leafCellNumber": 4}]}
+    pod = make_pod("pre-0", "pre-u0", "vc0", 50, "cc0-chip", 4, group=group)
+    victims = {n: ei.MetaVictims() for n in nodes}
+    r = sched.preempt_routine(
+        ei.ExtenderPreemptionArgs(pod=pod, node_name_to_meta_victims=victims)
+    )
+    assert r.node_name_to_meta_victims, "expected a committed preemption"
+    before = sched.get_metrics()["preemptProbeIncrementalCount"]
+    # ... and the next probes of the same gang serve the victim set from
+    # the epoch-gated cache (the first re-probe warms it — the commit
+    # itself cannot, its own reservation mutates the chain right after —
+    # every later probe with nothing moved hits).
+    r2 = sched.preempt_routine(
+        ei.ExtenderPreemptionArgs(pod=pod, node_name_to_meta_victims=victims)
+    )
+    r3 = sched.preempt_routine(
+        ei.ExtenderPreemptionArgs(pod=pod, node_name_to_meta_victims=victims)
+    )
+    after = sched.get_metrics()["preemptProbeIncrementalCount"]
+    assert after >= before + 1
+    # (The NODE pick inside an extender result is deliberately randomized
+    # per call; the cache contract is about the victims DICT.) r3 must
+    # have served the very object r2 cached, and every returned victim is
+    # from it.
+    g = sched.core.affinity_groups["pre"]
+    assert g.victims_cache is not None
+    cached_victims = g.victims_cache[1]
+    cached_uids = {
+        uid for per_node in cached_victims.values() for uid in per_node
+    }
+    for r_probe in (r2, r3):
+        for node, v in (r_probe.node_name_to_meta_victims or {}).items():
+            assert {p.uid for p in v.pods} == set(cached_victims[node])
+    # A state change (a victim dies) invalidates the cache: the next
+    # probe recomputes, and the dead victim leaves the cached set.
+    dead_uid = sorted(cached_uids)[0]
+    dead = sched.pod_schedule_statuses[dead_uid].pod
+    sched.delete_pod(dead)
+    sched.preempt_routine(
+        ei.ExtenderPreemptionArgs(pod=pod, node_name_to_meta_victims=victims)
+    )
+    assert g.victims_cache[1] is not cached_victims
+    assert dead_uid not in {
+        uid for per_node in g.victims_cache[1].values() for uid in per_node
+    }
+
+
+# --------------------------------------------------------------------- #
+# Incremental inspect API (mirrored statuses)
+# --------------------------------------------------------------------- #
+
+
+def test_inspect_statuses_are_mirrored_and_invalidate():
+    cfg = bench.build_concurrent_config(2, 8)
+    sched = HivedScheduler(
+        cfg, kube_client=NullKubeClient(), auto_admit=True
+    )
+    nodes = sched.core.configured_node_names()
+    for n in nodes:
+        sched.add_node(Node(name=n))
+    first = sched.get_physical_cluster_status()
+    # Clean repeat: the mirror serves the SAME objects (no re-walk).
+    second = sched.get_physical_cluster_status()
+    assert all(a is b for a, b in zip(first, second))
+    vc_first = sched.get_virtual_cluster_status("vc0")
+    assert sched.get_virtual_cluster_status("vc0") is vc_first
+
+    # A mutation in family 0's chain rebuilds ONLY that chain's statuses.
+    pod = make_pod("m-0", "m-u0", "vc0", 0, "cc0-chip", 4, group=None)
+    r = sched.filter_routine(ei.ExtenderArgs(pod=pod, node_names=nodes))
+    assert r.node_names
+    third = sched.get_physical_cluster_status()
+    changed = [
+        i for i, (a, b) in enumerate(zip(second, third)) if a is not b
+    ]
+    kept = [i for i, (a, b) in enumerate(zip(second, third)) if a is b]
+    assert changed and kept, (changed, kept)
+
+    # Differential: the mirrored payload equals a cache-busted full walk.
+    sched.core._phys_status_cache.clear()
+    sched.core._vc_status_cache.clear()
+    assert sched.get_physical_cluster_status() == third
+    assert (
+        sched.get_virtual_cluster_status("vc0")
+        == sched.core._build_virtual_cluster_status("vc0")
+    )
+    json.dumps(third)
