@@ -28,6 +28,7 @@ from hivedscheduler_tpu import common
 from hivedscheduler_tpu.api import constants, extender as ei
 from hivedscheduler_tpu.api.config import Config
 from hivedscheduler_tpu.api.types import CellTypeSpec
+from hivedscheduler_tpu.scheduler import tracing as hived_tracing
 from hivedscheduler_tpu.scheduler.framework import HivedScheduler, NullKubeClient
 from hivedscheduler_tpu.scheduler.types import Node, Pod
 from hivedscheduler_tpu.tpu import topology
@@ -130,12 +131,17 @@ def _attach_sizing(result: dict) -> dict:
     return result
 
 
-def build_config() -> Config:
+def build_config(cubes: int = 4, slices: int = 8, solos: int = 8) -> Config:
+    """The bench fleet: ``cubes`` v5p-64 cubes (16 hosts each), ``slices``
+    v5e-16 slices (4 hosts each), ``solos`` standalone v5e hosts. Defaults
+    give the 104-host default load; the 432-host fleet variant
+    (doc/hot-path.md measured tables) is cubes=16, slices=40, solos=16.
+    VC quota scales with the fleet so the gang mix always fits."""
     cell_types = {}
     cell_types.update(topology.v5p_cell_types(max_hosts=16))
     cell_types.update(topology.v5e_cell_types(max_hosts=4))
     physical = []
-    for cube in range(4):
+    for cube in range(cubes):
         physical.append(
             topology.make_physical_cell(
                 "v5p-64",
@@ -143,13 +149,13 @@ def build_config() -> Config:
                 cell_types,
             ).to_dict()
         )
-    for s in range(8):
+    for s in range(slices):
         physical.append(
             topology.make_physical_cell(
                 "v5e-16", [f"v5e-s{s}-w{i}" for i in range(4)], cell_types
             ).to_dict()
         )
-    for h in range(8):
+    for h in range(solos):
         physical.append(
             topology.make_physical_cell(
                 "v5e-host", [f"v5e-solo-{h}"], cell_types
@@ -171,15 +177,15 @@ def build_config() -> Config:
             "virtualClusters": {
                 "prod": {
                     "virtualCells": [
-                        {"cellType": "v5p-64", "cellNumber": 2},
-                        {"cellType": "v5e-16", "cellNumber": 4},
+                        {"cellType": "v5p-64", "cellNumber": cubes // 2},
+                        {"cellType": "v5e-16", "cellNumber": slices // 2},
                     ]
                 },
                 "research": {
                     "virtualCells": [
-                        {"cellType": "v5p-64.v5p-16", "cellNumber": 8},
-                        {"cellType": "v5e-16", "cellNumber": 4},
-                        {"cellType": "v5e-host", "cellNumber": 8},
+                        {"cellType": "v5p-64.v5p-16", "cellNumber": 2 * cubes},
+                        {"cellType": "v5e-16", "cellNumber": slices // 2},
+                        {"cellType": "v5e-host", "cellNumber": solos},
                     ]
                 },
             },
@@ -267,8 +273,13 @@ def _percentiles(lat):
     return p50, p99
 
 
-def run(n_gangs: int = 120):
-    sched = HivedScheduler(build_config(), kube_client=NullKubeClient())
+def run(n_gangs: int = 120, config: Config | None = None,
+        trace_sample: float | None = None):
+    sched = HivedScheduler(
+        config if config is not None else build_config(),
+        kube_client=NullKubeClient(),
+        trace_sample=trace_sample,
+    )
     nodes = sched.core.configured_node_names()
     for n in nodes:
         sched.add_node(Node(name=n))
@@ -287,13 +298,19 @@ def run(n_gangs: int = 120):
 
 def smoke(n_gangs: int = 24) -> dict:
     """Scheduler-only smoke stage: gang-schedule p50, sustained pods/sec,
-    and the per-phase filter breakdown (lock-wait / core-schedule /
-    leaf-cell search) at a small gang count — no HTTP, no recovery, no
-    TPU/model stages. Env-gated in ``__main__`` via ``HIVED_BENCH_SMOKE=1``
-    (gang count override: ``HIVED_BENCH_SMOKE_GANGS``), and wired into
-    tier-1 by tests/test_bench_smoke.py so a hot-path regression fails CI
-    in seconds instead of surfacing in the full driver bench."""
-    p50, p99, n, sched, live, pods_per_sec = run(n_gangs=n_gangs)
+    the per-phase filter breakdown (lock-wait / core-schedule /
+    leaf-cell search), and a one-rep tracing-on/off p50 delta at a small
+    gang count — no HTTP, no recovery, no TPU/model stages. Env-gated in
+    ``__main__`` via ``HIVED_BENCH_SMOKE=1`` (gang count override:
+    ``HIVED_BENCH_SMOKE_GANGS``), and wired into tier-1 by
+    tests/test_bench_smoke.py so a hot-path regression fails CI in seconds
+    instead of surfacing in the full driver bench. (The driver-grade
+    tracing gate is ``bench_tracing_ab`` at the 432-host fleet; the smoke
+    delta is a wiring check, not a perf claim.)"""
+    p50, p99, n, sched, live, pods_per_sec = run(
+        n_gangs=n_gangs, trace_sample=hived_tracing.DEFAULT_SAMPLE
+    )
+    p50_off, *_ = run(n_gangs=n_gangs, trace_sample=0.0)
     m = sched.get_metrics()
     return {
         "gang_schedule_p50_ms": round(p50, 3),
@@ -302,6 +319,54 @@ def smoke(n_gangs: int = 24) -> dict:
         "pods_per_sec": round(pods_per_sec, 1),
         "filter_count": m["filterCount"],
         "phases": m["phases"],
+        "tracing_delta": {
+            "trace_sample": hived_tracing.DEFAULT_SAMPLE,
+            "p50_on_ms": round(p50, 3),
+            "p50_off_ms": round(p50_off, 3),
+            "overhead_pct": round((p50 / p50_off - 1.0) * 100.0, 2)
+            if p50_off
+            else 0.0,
+        },
+    }
+
+
+def bench_tracing_ab(
+    cubes: int = 16,
+    slices: int = 40,
+    solos: int = 16,
+    n_gangs: int = 240,
+    reps: int = 3,
+) -> dict:
+    """Tracing-overhead A/B at the 432-host fleet (ISSUE 6 acceptance):
+    gang-schedule p50 with default-sampling tracing vs tracing disabled,
+    interleaved reps (shared machine noise), medians. The acceptance gate
+    is overhead ≤ 3% of p50; ``within_budget`` records the verdict in the
+    BENCH artifact."""
+    cfg = lambda: build_config(cubes, slices, solos)  # noqa: E731
+    on_ms: list = []
+    off_ms: list = []
+    for _ in range(reps):
+        off_ms.append(run(n_gangs=n_gangs, config=cfg(), trace_sample=0.0)[0])
+        on_ms.append(
+            run(
+                n_gangs=n_gangs,
+                config=cfg(),
+                trace_sample=hived_tracing.DEFAULT_SAMPLE,
+            )[0]
+        )
+    p50_on = statistics.median(on_ms)
+    p50_off = statistics.median(off_ms)
+    overhead_pct = (p50_on / p50_off - 1.0) * 100.0 if p50_off else 0.0
+    return {
+        "fleet_hosts": 16 * cubes + 4 * slices + solos,
+        "gangs": n_gangs,
+        "reps": reps,
+        "trace_sample": hived_tracing.DEFAULT_SAMPLE,
+        "p50_tracing_on_ms": round(p50_on, 3),
+        "p50_tracing_off_ms": round(p50_off, 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "budget_pct": 3.0,
+        "within_budget": overhead_pct <= 3.0,
     }
 
 
@@ -706,6 +771,25 @@ def model_perf() -> dict:
 
 
 if __name__ == "__main__":
+    if os.environ.get("HIVED_BENCH_TRACE") == "1":
+        # Standalone tracing-overhead gate (the default driver run includes
+        # the same stage in its extra payload).
+        run(n_gangs=24)  # warm-up
+        result = bench_tracing_ab()
+        print(
+            json.dumps(
+                {
+                    "metric": "tracing_overhead_pct",
+                    "value": result["overhead_pct"],
+                    "unit": "%",
+                    "vs_baseline": round(
+                        result["overhead_pct"] / result["budget_pct"], 3
+                    ),
+                    "extra": result,
+                }
+            )
+        )
+        sys.exit(0)
     if os.environ.get("HIVED_BENCH_CONCURRENT") == "1":
         try:
             conc_threads = int(
@@ -760,6 +844,7 @@ if __name__ == "__main__":
     preempt_p50 = bench_preempt(sched, nodes)
     recovery = bench_recovery(sched)
     http_stats = bench_http()
+    tracing_ab = bench_tracing_ab()
     perf = model_perf()
     print(
         json.dumps(
@@ -775,6 +860,7 @@ if __name__ == "__main__":
                     "preempt_p50_ms": round(preempt_p50, 3),
                     "recovery": recovery,
                     "http": http_stats,
+                    "tracing_ab": tracing_ab,
                     "model_perf": perf,
                 },
             }

@@ -18,8 +18,26 @@
 # drain/flap-focused mix), splitting the seed range across mixes. A single
 # custom mix can be passed directly: HIVED_CHAOS_MIX="health:3" hack/soak.sh
 # (see tests/chaos.py event_weights for the knob grammar).
+#
+# Decision-journal artifacts: --keep-decisions [DIR] (first argument) keeps
+# the per-seed decision-journal dump a failing seed writes (the scheduler's
+# /v1/inspect/decisions ring + trace ring + metrics at the moment the
+# invariant fired — see doc/observability.md). DIR defaults to
+# ./chaos-artifacts; the dump path is appended to the failing assertion.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--keep-decisions" ]]; then
+  shift
+  if [[ $# -gt 0 && "${1:0:1}" != "-" ]]; then
+    export HIVED_CHAOS_ARTIFACT_DIR="$1"
+    shift
+  else
+    export HIVED_CHAOS_ARTIFACT_DIR="$(pwd)/chaos-artifacts"
+  fi
+  mkdir -p "${HIVED_CHAOS_ARTIFACT_DIR}"
+  echo "chaos soak: keeping decision-journal dumps in ${HIVED_CHAOS_ARTIFACT_DIR}"
+fi
 
 export HIVED_CHAOS_ROUNDS="${HIVED_CHAOS_ROUNDS:-2000}"
 export HIVED_CHAOS_START="${HIVED_CHAOS_START:-220}"

@@ -649,6 +649,12 @@ class HivedCore:
         # preempting_pods are still populated. The framework uses it to
         # clear preempt-info annotations outside the scheduler lock.
         self.preemption_observer: Optional[Callable[[AffinityGroup, str], None]] = None
+        # Decision journal (scheduler.decisions.DecisionJournal), installed
+        # by the framework. The inner scheduling gates enrich the request
+        # thread's CURRENT record (begun by filter/preempt routines) with
+        # per-chain rejection reasons; bare cores (tests, benches, the
+        # chaos probe battery) have no journal and record nothing.
+        self.decisions = None
 
         self._init_cell_nums()
         self._init_pinned_cells(cc.physical_pinned)
@@ -775,6 +781,23 @@ class HivedCore:
         if d is None:
             d = self._pending_doomed_local.d = {}
         return d
+
+    def _decision_rec(self):
+        """The request thread's in-flight decision record, or None (no
+        journal installed, or the call is not under a recorded attempt)."""
+        j = self.decisions
+        return j.current() if j is not None else None
+
+    def vc_quota_chains(self, vc: api.VirtualClusterName) -> List[CellChain]:
+        """The chains a VC holds non-pinned quota in — the exact chain set
+        a GUARANTEED pod without a leafCellType can probe
+        (_schedule_group_for_leaf_type gates every chain on membership in
+        the VC's non_pinned_preassigned). Compile-time constant per config;
+        the framework narrows untyped pods' lock sections to it."""
+        vcs = self.vc_schedulers.get(vc)
+        if vcs is None:
+            return []
+        return list(vcs.non_pinned_preassigned)
 
     # -- node events --------------------------------------------------------
 
@@ -1245,6 +1268,9 @@ class HivedCore:
         omitted they are derived here, preserving the old call contract."""
         common.log.info("[%s]: Scheduling pod in %s phase...", pod.key, phase.value)
         s = spec if spec is not None else extract_pod_scheduling_spec(pod)
+        rec = self._decision_rec()
+        if rec is not None:
+            rec.set_spec(s)
         suggested = suggested_set if suggested_set is not None else set(suggested_nodes)
         group_physical: Optional[Placement] = None
         group_virtual: Optional[Placement] = None
@@ -1297,11 +1323,17 @@ class HivedCore:
         bad_or_non_suggested = collect_bad_or_non_suggested_nodes(
             g.physical_placement, suggested, g.ignore_k8s_suggested_nodes
         )
+        rec = self._decision_rec()
         if g.state == GroupState.ALLOCATED:
             common.log.info(
                 "[%s]: Pod is from an affinity group that is already "
                 "allocated: %s", pod.key, g.name,
             )
+            if rec is not None:
+                rec.note(f"affinity group {g.name} already allocated")
+                chain = group_chain(g)
+                if chain is not None:
+                    rec.consider_chain(chain)
             group_physical = g.physical_placement
             group_virtual = g.virtual_placement
             if bad_or_non_suggested:
@@ -1327,6 +1359,8 @@ class HivedCore:
                 "[%s]: Pod is from an affinity group that is preempting "
                 "others: %s", pod.key, g.name,
             )
+            if rec is not None:
+                rec.note(f"affinity group {g.name} is preempting")
             if phase == SchedulingPhase.PREEMPTING and bad_or_non_suggested:
                 # Cancel and reschedule elsewhere; only Preempting-phase
                 # suggested nodes consider preemption
@@ -1336,6 +1370,11 @@ class HivedCore:
                     "its placement is no longer fully healthy and within "
                     "Preempting-phase suggested nodes", pod.key, g.name,
                 )
+                if rec is not None:
+                    rec.note(
+                        f"cancelled {g.name}'s preemption: placement no "
+                        "longer healthy/suggested"
+                    )
                 self._delete_preempting_affinity_group(g, pod)
             else:
                 group_physical = g.physical_placement
@@ -1441,7 +1480,13 @@ class HivedCore:
             )
         self._validate_scheduling_request(sr, pod)
         if sr.pinned_cell_id:
-            return self._handle_scheduling_request(sr)
+            physical, virtual, failed_reason = self._handle_scheduling_request(
+                sr
+            )
+            rec = self._decision_rec()
+            if rec is not None and physical is None:
+                rec.reject(f"pinned:{sr.pinned_cell_id}", failed_reason)
+            return physical, virtual, failed_reason
         if s.leaf_cell_type:
             if s.leaf_cell_type not in self.cell_chains:
                 raise api.bad_request(
@@ -1464,6 +1509,7 @@ class HivedCore:
         (reference: hived_algorithm.go:824-854)."""
         vc_has_type = False
         failed_reason = ""
+        rec = self._decision_rec()
         for chain in self.cell_chains.get(leaf_cell_type, []):
             if (
                 sr.priority < MIN_GUARANTEED_PRIORITY
@@ -1471,11 +1517,18 @@ class HivedCore:
             ):
                 vc_has_type = True
                 sr.chain = chain
+                if rec is not None:
+                    rec.consider_chain(chain)
                 physical, virtual, failed_reason = self._handle_scheduling_request(
                     sr
                 )
                 if physical is not None:
                     return physical, virtual, ""
+                if rec is not None:
+                    # Per-gate rejection: the reason string's producing
+                    # site (VC quota / chip health / drains / buddy
+                    # mapping / suggested nodes) determines the gate.
+                    rec.reject(chain, failed_reason)
         if (
             type_specified
             and sr.priority >= MIN_GUARANTEED_PRIORITY
@@ -3062,24 +3115,46 @@ class HivedCore:
         assert on them)."""
         out: List[Dict] = []
         ot_vc_map: Optional[Dict[str, api.VirtualClusterName]] = None
-        for chain, ccl in self.full_cell_list.items():
-            epoch = self.chain_epoch(chain)
+        for chain in self.full_cell_list:
             cached = self._phys_status_cache.get(chain)
-            if cached is None or cached[0] != epoch:
-                if ot_vc_map is None:
-                    ot_vc_map = self._ot_cell_vc_by_address()
-                statuses = [
-                    self._physical_cell_status(
-                        c,
-                        leaf_type=self.chain_to_leaf_type.get(chain),
-                        ot_vc_map=ot_vc_map,
-                    )
-                    for c in ccl[ccl.top_level]
-                    if isinstance(c, PhysicalCell)
-                ]
-                cached = self._phys_status_cache[chain] = (epoch, statuses)
-            out.extend(cached[1])
+            if cached is not None and cached[0] == self.chain_epoch(chain):
+                out.extend(cached[1])
+                continue
+            if ot_vc_map is None:
+                # Lazy and shared across every dirty chain of this call —
+                # the map walks all OT cells of all VCs once, not per chain.
+                ot_vc_map = self._ot_cell_vc_by_address()
+            out.extend(self.physical_chain_status(chain, ot_vc_map))
         return out
+
+    def physical_chain_status(
+        self,
+        chain: CellChain,
+        ot_vc_map: Optional[Dict[str, api.VirtualClusterName]] = None,
+    ) -> List[Dict]:
+        """One chain's mirrored top-cell status list, rebuilt only when the
+        chain's mutation epoch moved. The framework serves scrapes through
+        this per chain — an epoch-clean chain's mirror is read LOCK-FREE,
+        and a dirty chain's rebuild takes only that chain's lock section
+        instead of the whole-cluster global order (doc/observability.md).
+        ``ot_vc_map`` lets a multi-chain caller share one OT-cell walk."""
+        epoch = self.chain_epoch(chain)
+        cached = self._phys_status_cache.get(chain)
+        if cached is None or cached[0] != epoch:
+            ccl = self.full_cell_list[chain]
+            if ot_vc_map is None:
+                ot_vc_map = self._ot_cell_vc_by_address()
+            statuses = [
+                self._physical_cell_status(
+                    c,
+                    leaf_type=self.chain_to_leaf_type.get(chain),
+                    ot_vc_map=ot_vc_map,
+                )
+                for c in ccl[ccl.top_level]
+                if isinstance(c, PhysicalCell)
+            ]
+            cached = self._phys_status_cache[chain] = (epoch, statuses)
+        return cached[1]
 
     def get_all_virtual_clusters_status(self) -> Dict[str, List[Dict]]:
         return {vc: self.get_virtual_cluster_status(vc) for vc in self.vc_schedulers}

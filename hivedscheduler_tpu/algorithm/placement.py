@@ -27,6 +27,7 @@ import time
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..api import types as api
+from ..scheduler import tracing
 from .cell import (
     Cell,
     CellLevel,
@@ -100,6 +101,8 @@ class _NodeView:
         "used_same_priority",
         "used_higher_priority",
         "unusable_free",
+        "unusable_bad",
+        "unusable_draining",
         "degraded",
         "healthy",
         "suggested",
@@ -117,6 +120,11 @@ class _NodeView:
         # free_at_priority - unusable_free is the node's REAL new-placement
         # capacity (see _node_unusable_free).
         self.unusable_free = 0
+        # The bad-vs-draining split of unusable_free (diagnostic only —
+        # decision records attribute a rejection to the chip-health or the
+        # maintenance-drain gate; scheduling reads unusable_free alone).
+        self.unusable_bad = 0
+        self.unusable_draining = 0
         # Sort-only: any bad/draining chip in the anchor's physical subtree
         # (partially-degraded hosts remain placeable but pack last).
         self.degraded = False
@@ -289,7 +297,9 @@ class TopologyAwareScheduler:
             n.healthy, n.suggested, n.node_address = _node_health_and_suggested(
                 n.cell, suggested_nodes, ignore_suggested
             )
-            n.unusable_free = _node_unusable_free(n.cell, p)
+            n.unusable_free, n.unusable_bad, n.unusable_draining = (
+                _node_unusable_free(n.cell, p)
+            )
             n.degraded = (not n.healthy) or _node_degraded(n.cell)
         # Stable in-place sort of the persistent list: with only a few dirty
         # nodes the list is near-sorted and Timsort's run detection makes
@@ -356,7 +366,13 @@ class TopologyAwareScheduler:
             )
             placements.setdefault(leaf_num, []).append(chips)
         if ps is not None:
-            ps.add("leafCellSearch", time.perf_counter() - t0, len(sorted_leaf_nums))
+            dt = time.perf_counter() - t0
+            ps.add("leafCellSearch", dt, len(sorted_leaf_nums))
+            # Placement-descent span on the current request trace, if one
+            # is sampled (tracing.add_span is a None check otherwise).
+            tracing.add_span(
+                "leafCellSearch", dt, pods=len(sorted_leaf_nums)
+            )
         return placements, ""
 
 
@@ -373,7 +389,7 @@ def _leaf_unusable(c: Cell) -> bool:
     return False
 
 
-def _node_unusable_free(cell: Cell, p: CellPriority) -> int:
+def _node_unusable_free(cell: Cell, p: CellPriority) -> Tuple[int, int, int]:
     """Leaves of this node anchor that are counted free at priority ``p``
     but are actually unusable (bad or draining) — the chip-granular
     correction to the node's free count. The contract is exact alignment
@@ -384,11 +400,16 @@ def _node_unusable_free(cell: Cell, p: CellPriority) -> int:
     opportunistic squatter on a bad chip has physical priority -1 but
     virtual FREE — counting it by physical priority double-excludes it;
     found by the node-flap fuzzer), physical priorities for a physical
-    anchor."""
+    anchor.
+
+    Returns ``(unusable, bad, draining)``: the total plus its
+    bad-vs-draining split (a chip both bad and draining counts bad — the
+    decision-record gate attribution prefers the harder fault). Only the
+    total feeds scheduling; the split labels rejection reasons."""
     if isinstance(cell, VirtualCell):
         if cell.physical_cell is None:
-            return 0  # no hardware yet: mapping decides
-        n = 0
+            return 0, 0, 0  # no hardware yet: mapping decides
+        n = bad = draining = 0
         stack: List[Cell] = [cell]
         while stack:
             c = stack.pop()
@@ -403,14 +424,18 @@ def _node_unusable_free(cell: Cell, p: CellPriority) -> int:
                     and c.priority < p
                 ):
                     n += 1
-        return n
+                    if not pc.healthy:
+                        bad += 1
+                    else:
+                        draining += 1
+        return n, bad, draining
     assert isinstance(cell, PhysicalCell)
     if cell.healthy and cell.unusable_leaf_num == 0:
         # Fast path: fully usable (the overwhelmingly common case). Checked
         # alongside `healthy` so white-box tests that toggle leaf.healthy
         # without the setter still get the walk below.
-        return 0
-    n = 0
+        return 0, 0, 0
+    n = bad = draining = 0
     stack = [cell]
     while stack:
         c = stack.pop()
@@ -419,7 +444,11 @@ def _node_unusable_free(cell: Cell, p: CellPriority) -> int:
         elif ((not c.healthy) or c.draining) and c.priority < p:
             # priority >= p leaves are already excluded from the free count.
             n += 1
-    return n
+            if not c.healthy:
+                bad += 1
+            else:
+                draining += 1
+    return n, bad, draining
 
 
 def _node_degraded(cell: Cell) -> bool:
@@ -522,9 +551,16 @@ def _find_nodes_for_pods(
                 and n.free_at_priority - picked_leaf_num >= needed
             ):
                 # Would fit counting its bad/draining chips: the truthful
-                # wait reason when nothing else fits either.
+                # wait reason when nothing else fits either. Drain-only
+                # shortfalls say so — the decision journal attributes the
+                # rejection to the maintenance gate, not chip health.
+                kind = (
+                    "draining"
+                    if n.unusable_draining and not n.unusable_bad
+                    else "bad"
+                )
                 bad_reason = (
-                    f"have to use at least one bad node {n.node_address}"
+                    f"have to use at least one {kind} node {n.node_address}"
                 )
             picked_leaf_num = 0
             node_index += 1

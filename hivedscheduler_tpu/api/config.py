@@ -56,6 +56,13 @@ class Config:
     # when False (default) they are only surfaced (/v1/inspect/health,
     # strandedGroupCount).
     stranded_gang_eviction: bool = False
+    # Observability plane (doc/observability.md): bounded ring sizes for
+    # the decision journal (/v1/inspect/decisions — always on) and the
+    # sampled trace ring (/v1/inspect/traces; the sampling RATE is the
+    # HIVED_TRACE_SAMPLE env knob, not config — it must be flippable on a
+    # live process without a config rollout).
+    decision_journal_capacity: int = 512
+    trace_ring_capacity: int = 256
     physical_cluster: api.PhysicalClusterSpec = field(
         default_factory=api.PhysicalClusterSpec
     )
@@ -71,6 +78,8 @@ class Config:
         flap_t = d.get("healthFlapThreshold")
         flap_w = d.get("healthFlapWindow")
         flap_h = d.get("healthFlapHold")
+        dj_cap = d.get("decisionJournalCapacity")
+        tr_cap = d.get("traceRingCapacity")
         c = Config(
             kube_apiserver_address=d.get("kubeApiServerAddress"),
             kube_config_file_path=d.get("kubeConfigFilePath"),
@@ -86,6 +95,10 @@ class Config:
             health_flap_window=8 if flap_w is None else int(flap_w),
             health_flap_hold=4 if flap_h is None else int(flap_h),
             stranded_gang_eviction=bool(d.get("strandedGangEviction", False)),
+            decision_journal_capacity=(
+                512 if dj_cap is None else int(dj_cap)
+            ),
+            trace_ring_capacity=256 if tr_cap is None else int(tr_cap),
             physical_cluster=api.PhysicalClusterSpec.from_dict(
                 d.get("physicalCluster")
             ),

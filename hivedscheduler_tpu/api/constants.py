@@ -98,6 +98,22 @@ QUARANTINE_PATH = INSPECT_PATH + "/quarantine"
 # bad or draining cells). See doc/fault-model.md "Hardware health plane".
 HEALTH_PATH = INSPECT_PATH + "/health"
 
+# The decision journal (scheduler observability plane,
+# doc/observability.md): latest-N scheduling decisions with per-gate
+# rejection reasons; append /<uid> or /<namespace>/<name> for the per-pod
+# lookup ("why didn't my pod schedule", doc/user-manual.md).
+DECISIONS_PATH = INSPECT_PATH + "/decisions"
+
+# The sampled request-trace ring (spans: filter -> lock wait -> core
+# schedule -> placement descent -> bind write -> recovery cycles).
+TRACES_PATH = INSPECT_PATH + "/traces"
+
+# Prometheus text exposition (top-level, the conventional scrape path —
+# NOT under /v1/inspect): counters, gauges, fixed-bucket latency
+# histograms, and per-chain lock-wait series, served from lock-free
+# snapshots so a scrape never enters the chain-lock order.
+PROMETHEUS_PATH = "/metrics"
+
 # Probe endpoints (no reference analog; the reference relies on the informer
 # WaitForCacheSync ordering alone). /healthz is liveness (process up);
 # /readyz gates on recovery completion so K8s does not route extender

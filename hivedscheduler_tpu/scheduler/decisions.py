@@ -1,0 +1,259 @@
+"""The structured decision journal: why every pod landed where it did.
+
+HiveD's core promise is *explainable* sharing — a gang lands (or waits)
+because of VC quota, buddy-level topology, hardware health, and priority
+gates. This module records one :class:`DecisionRecord` per scheduling
+attempt (filter or preempt verb), containing:
+
+- the candidate cell chains considered, and the **per-gate rejection
+  reason** for every chain that turned the pod down (quota, chip health,
+  drains, buddy-level fit, suggested-node constraints);
+- the lock scope the attempt ran under (the narrowed chain set, or
+  ``"global"`` — the untyped-pod narrowing satellite records its chosen
+  set here);
+- the final verdict: a placement (node + chip indices), a preemption
+  (victim pod list), a wait (reason), an insisted previous bind, or a
+  protocol error.
+
+Served at ``/v1/inspect/decisions`` (latest-N ring + per-pod lookup) and
+dumped per-seed when a chaos-harness invariant fails (tests/chaos.py).
+
+Threading: a record is created and mutated by exactly one request thread
+(it rides a thread-local "current record" so the core's inner gates can
+enrich it without signature plumbing — the same pattern as
+``tracing.use``). Only ``commit`` touches shared state, under a private
+micro-lock that is never part of the chain-lock order.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Dict, List, Optional
+
+DEFAULT_CAPACITY = 512
+
+# Gate names (doc/observability.md "Decision records"): the stages of the
+# scheduling funnel a chain can reject a pod at.
+GATE_VC_QUOTA = "vcQuota"          # intra-VC placement found no room
+GATE_CHIP_HEALTH = "chipHealth"    # bad chips made the capacity unusable
+GATE_DRAINING = "draining"         # maintenance drains cordoned the chips
+GATE_SUGGESTED = "suggestedNodes"  # K8s suggested-node set excluded the fit
+GATE_BUDDY_FIT = "buddyFit"        # virtual→physical buddy mapping failed
+                                   # (fragmentation, doomed-bad bindings)
+GATE_CAPACITY = "capacity"         # plain insufficient physical capacity
+# (Requests rejected before scheduling — unknown VC, SKU the VC lacks,
+# over-sized gang — surface as verdict "error", not a per-chain gate.)
+
+
+def classify_reason(reason: str) -> str:
+    """Map a scheduler failure-reason string to its gate. The strings are
+    produced by a closed set of sites (placement._find_nodes_for_pods,
+    intra_vc.IntraVCScheduler.schedule, core._schedule_guaranteed_group);
+    the golden decision tests pin one scenario per gate so a reworded
+    reason that breaks classification fails loudly."""
+    r = reason or ""
+    if "draining node" in r:
+        return GATE_DRAINING
+    if "Mapping the virtual placement" in r:
+        return GATE_BUDDY_FIT
+    if "bad node" in r:
+        return GATE_CHIP_HEALTH
+    if "non-suggested node" in r:
+        return GATE_SUGGESTED
+    if "when scheduling in VC" in r:
+        return GATE_VC_QUOTA
+    return GATE_CAPACITY
+
+
+class DecisionRecord:
+    """One scheduling attempt, mutated by its request thread only."""
+
+    __slots__ = (
+        "seq", "trace_id", "pod_key", "pod_uid", "group", "vc", "priority",
+        "leaf_cell_type", "leaf_cell_number", "phase", "lock_chains",
+        "chains_considered", "attempts", "verdict", "node", "leaf_cells",
+        "victims", "wait_reason", "error", "notes", "wall_time",
+    )
+
+    def __init__(self, seq: int, pod_key: str, pod_uid: str, phase: str,
+                 trace_id: Optional[int] = None):
+        self.seq = seq
+        self.trace_id = trace_id
+        self.pod_key = pod_key
+        self.pod_uid = pod_uid
+        self.phase = phase
+        self.group = ""
+        self.vc = ""
+        self.priority: Optional[int] = None
+        self.leaf_cell_type = ""
+        self.leaf_cell_number: Optional[int] = None
+        self.lock_chains: Optional[object] = None  # list of chains | "global"
+        self.chains_considered: List[str] = []
+        self.attempts: List[Dict] = []
+        self.verdict = ""
+        self.node = ""
+        self.leaf_cells: List[int] = []
+        self.victims: List[Dict] = []
+        self.wait_reason = ""
+        self.error = ""
+        self.notes: List[str] = []
+        self.wall_time = time.time()
+
+    # -- enrichment (called from the core's gates) ---------------------- #
+
+    def set_spec(self, spec) -> None:
+        """Copy the identifying fields off a decoded PodSchedulingSpec."""
+        try:
+            self.vc = str(spec.virtual_cluster)
+            self.priority = spec.priority
+            self.leaf_cell_type = str(spec.leaf_cell_type or "")
+            self.leaf_cell_number = spec.leaf_cell_number
+            if spec.affinity_group is not None:
+                self.group = spec.affinity_group.name
+        except Exception:  # noqa: BLE001 — diagnostics must never raise
+            pass
+
+    def consider_chain(self, chain) -> None:
+        c = str(chain)
+        if c not in self.chains_considered:
+            self.chains_considered.append(c)
+
+    def reject(self, target, reason: str, gate: Optional[str] = None) -> None:
+        """One gate turning the pod down on one chain (or pinned cell)."""
+        self.attempts.append(
+            {
+                "target": str(target),
+                "gate": gate or classify_reason(reason),
+                "reason": reason,
+            }
+        )
+
+    def note(self, message: str) -> None:
+        self.notes.append(message)
+
+    # -- verdicts -------------------------------------------------------- #
+
+    def verdict_bind(self, node: str, leaf_cells: List[int]) -> None:
+        self.verdict = "bind"
+        self.node = node
+        self.leaf_cells = list(leaf_cells)
+
+    def verdict_insist(self, node: str) -> None:
+        self.verdict = "insist-bind"
+        self.node = node
+
+    def verdict_preempt(self, victim_pods) -> None:
+        self.verdict = "preempt"
+        self.victims = [
+            {"pod": v.key, "uid": v.uid, "node": v.node_name}
+            for v in victim_pods
+        ]
+
+    def verdict_wait(self, reason: str) -> None:
+        self.verdict = "wait"
+        self.wait_reason = reason
+
+    def verdict_error(self, message: str) -> None:
+        self.verdict = "error"
+        self.error = message
+
+    def to_dict(self) -> Dict:
+        d: Dict = {
+            "seq": self.seq,
+            "pod": self.pod_key,
+            "uid": self.pod_uid,
+            "phase": self.phase,
+            "group": self.group,
+            "vc": self.vc,
+            "priority": self.priority,
+            "leafCellType": self.leaf_cell_type,
+            "leafCellNumber": self.leaf_cell_number,
+            "lockChains": self.lock_chains,
+            "chainsConsidered": self.chains_considered,
+            "rejections": self.attempts,
+            "verdict": self.verdict,
+            "wallTime": round(self.wall_time, 3),
+        }
+        if self.trace_id is not None:
+            d["traceId"] = self.trace_id
+        if self.node:
+            d["node"] = self.node
+        if self.leaf_cells:
+            d["leafCells"] = self.leaf_cells
+        if self.victims:
+            d["victims"] = self.victims
+        if self.wait_reason:
+            d["waitReason"] = self.wait_reason
+        if self.error:
+            d["error"] = self.error
+        if self.notes:
+            d["notes"] = self.notes
+        return d
+
+
+class DecisionJournal:
+    """Bounded ring of committed decision records plus a per-pod index of
+    each pod's LATEST decision (the lookup the "why didn't my pod
+    schedule" walkthrough uses, doc/user-manual.md)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = max(1, capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        # uid -> latest committed record dict; bounded at 4× the ring so
+        # a long-lived cluster's dead pods cannot grow it forever, while a
+        # pod's last decision outlives its ring slot by a good margin.
+        self._by_uid: "OrderedDict[str, Dict]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._seq = itertools.count(1)
+        self._local = threading.local()
+
+    # -- record lifecycle ------------------------------------------------ #
+
+    def begin(self, pod_key: str, pod_uid: str, phase: str,
+              trace_id: Optional[int] = None) -> DecisionRecord:
+        rec = DecisionRecord(
+            next(self._seq), pod_key, pod_uid, phase, trace_id
+        )
+        self._local.rec = rec
+        return rec
+
+    def current(self) -> Optional[DecisionRecord]:
+        """The request thread's in-flight record (None outside a recorded
+        attempt — e.g. bare-core probes in tests and benches)."""
+        return getattr(self._local, "rec", None)
+
+    def commit(self, rec: DecisionRecord) -> None:
+        if getattr(self._local, "rec", None) is rec:
+            self._local.rec = None
+        d = rec.to_dict()
+        with self._lock:
+            self._ring.append(d)
+            self._by_uid[rec.pod_uid] = d
+            self._by_uid.move_to_end(rec.pod_uid)
+            while len(self._by_uid) > 4 * self.capacity:
+                self._by_uid.popitem(last=False)
+
+    # -- reads (lock only the journal's own micro-lock) ------------------ #
+
+    def snapshot(self, n: Optional[int] = None) -> List[Dict]:
+        with self._lock:
+            items = list(self._ring)
+        if n is not None and n >= 0:
+            # n=0 means zero items; the bare [-0:] slice cannot say that.
+            items = items[-n:] if n > 0 else []
+        return items
+
+    def lookup(self, key: str) -> Optional[Dict]:
+        """Latest decision for a pod, by uid or by pod key
+        (``namespace/name``)."""
+        with self._lock:
+            rec = self._by_uid.get(key)
+            if rec is not None:
+                return rec
+            for d in reversed(self._by_uid.values()):
+                if d.get("pod") == key:
+                    return d
+        return None

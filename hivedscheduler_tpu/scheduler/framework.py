@@ -31,7 +31,10 @@ from ..api.config import Config
 from ..algorithm.core import HivedCore, group_chain
 from ..algorithm.placement import PhaseStats
 from . import health as health_mod
+from . import tracing
+from .decisions import DecisionJournal
 from .locks import ChainShardedLock
+from .tracing import LatencyHistogram
 from .types import (
     Node,
     Pod,
@@ -147,6 +150,14 @@ class SchedulerMetrics:
         # Framework-side phases (same accumulator/formatter as the core's
         # leaf-cell-search stats, so the merged "phases" payload is uniform).
         self.phase_stats = PhaseStats()
+        # Fixed-bucket latency histograms (Prometheus exposition,
+        # doc/observability.md): filter and preempt verbs end-to-end, the
+        # bind kube write, and per-pod recovery replay. Each takes its own
+        # micro-lock — never the scheduler chain locks.
+        self.hist_filter = LatencyHistogram()
+        self.hist_preempt = LatencyHistogram()
+        self.hist_bind = LatencyHistogram()
+        self.hist_recovery_replay = LatencyHistogram()
 
     def observe_filter(
         self,
@@ -155,6 +166,7 @@ class SchedulerMetrics:
         lock_wait_s: float = 0.0,
         core_schedule_s: Optional[float] = None,
     ) -> None:
+        self.hist_filter.observe(seconds)
         with self._lock:
             self.filter_count += 1
             if len(self.filter_latencies_s) < self.WINDOW:
@@ -173,6 +185,18 @@ class SchedulerMetrics:
                 self.preempt_count += 1
             else:
                 self.wait_count += 1
+
+    def observe_preempt_routine(self, seconds: float) -> None:
+        """End-to-end preempt verb latency (probe/commit/cancel alike)."""
+        self.hist_preempt.observe(seconds)
+
+    def observe_bind_write(self, seconds: float) -> None:
+        """The bind_routine kube write (includes any retry backoff)."""
+        self.hist_bind.observe(seconds)
+
+    def observe_recovery_replay(self, seconds: float) -> None:
+        """One bound pod's recovery replay (recover() / informer boot)."""
+        self.hist_recovery_replay.observe(seconds)
 
     def observe_bind_retry(self) -> None:
         with self._lock:
@@ -267,6 +291,12 @@ class SchedulerMetrics:
                 "doomedLedgerCoalescedCount": self.ledger_coalesced_count,
                 "strandedEvictionCount": self.stranded_eviction_count,
                 "phases": self.phase_stats.snapshot(),
+                "latencyHistograms": {
+                    "filter": self.hist_filter.snapshot(),
+                    "preempt": self.hist_preempt.snapshot(),
+                    "bind": self.hist_bind.snapshot(),
+                    "recoveryReplay": self.hist_recovery_replay.snapshot(),
+                },
             }
 
 
@@ -290,11 +320,24 @@ class HivedScheduler:
         # single-lock (all-chains) behavior for differential testing;
         # None reads HIVED_GLOBAL_LOCK (locks.ChainShardedLock).
         global_lock: Optional[bool] = None,
+        # Tracing sample-rate override; None reads HIVED_TRACE_SAMPLE
+        # (default 0.01). The bench A/B passes explicit values.
+        trace_sample: Optional[float] = None,
     ) -> None:
         self.config = config
         self.kube_client = kube_client or NullKubeClient()
         self.core = HivedCore(config)
         self.metrics = SchedulerMetrics()
+        # Observability plane (doc/observability.md): the span tracer (ring
+        # of sampled request traces), and the always-on decision journal
+        # (per-attempt gate rejections + verdicts, /v1/inspect/decisions).
+        self.tracer = tracing.Tracer(
+            sample=trace_sample, capacity=config.trace_ring_capacity
+        )
+        self.decisions = DecisionJournal(
+            capacity=config.decision_journal_capacity
+        )
+        self.core.decisions = self.decisions
         # Scheduling serializes per cell chain (scheduler.locks): filter /
         # bind / preempt acquire only the chains their pod's spec can touch,
         # whole-cluster mutators (node/pod events, health, recovery,
@@ -378,6 +421,12 @@ class HivedScheduler:
         # for another health transition (which may never come on a quiet
         # cluster).
         self._eviction_retry_pending = False
+        # Names of currently-stranded gangs, refreshed under the lock at
+        # every applied health transition (_check_stranded_locked) and at
+        # recovery end. The lock-free metrics scrape intersects it with
+        # the live group set — groups whose pods died since the last
+        # refresh drop out without a walk (doc/observability.md).
+        self._stranded_names: set = set()
 
     @staticmethod
     def _default_executor(fn: Callable[[], None]) -> None:
@@ -393,12 +442,15 @@ class HivedScheduler:
     ) -> Optional[List[str]]:
         """The cell chains a scheduling call for this pod can touch,
         derived from the spec BEFORE lock acquisition: the chains carrying
-        the requested leaf SKU (or the pinned cell's chain), widened by the
+        the requested leaf SKU (or the pinned cell's chain; or, for a
+        GUARANTEED pod without a leafCellType, the chains its VC holds
+        non-pinned quota in — any-leaf-type scheduling only probes chains
+        passing that quota gate, core.vc_quota_chains), widened by the
         chain its existing affinity group is placed in. None means "cannot
-        be narrowed" (no/undecodable spec, or an untyped pod — any-leaf-
-        type scheduling probes every chain) and degrades to the global
-        order. Reads only compile-time config plus atomic dict lookups, so
-        it is safe without locks; the caller re-derives INSIDE the section
+        be narrowed" (no/undecodable spec, or an untyped OPPORTUNISTIC pod
+        — those probe every chain) and degrades to the global order. Reads
+        only compile-time config plus atomic dict lookups, so it is safe
+        without locks; the caller re-derives INSIDE the section
         (_run_chain_locked) to close the derive-then-acquire race."""
         if spec is None:
             try:
@@ -422,7 +474,16 @@ class HivedScheduler:
             if not typed:
                 return None  # unknown SKU: schedule() rejects inside
             chains = list(typed)
+        elif spec.priority >= constants.MIN_GUARANTEED_PRIORITY:
+            # Untyped guaranteed pod: _schedule_group_for_any_leaf_type
+            # gates every chain on membership in the VC's non-pinned
+            # quota, so that quota set IS the reachable chain set.
+            quota_chains = core.vc_quota_chains(spec.virtual_cluster)
+            if not quota_chains:
+                return None  # unknown VC / no quota: rejected inside
+            chains = list(quota_chains)
         else:
+            # Untyped opportunistic pod: probes every chain.
             return None
         g = core.affinity_groups.get(spec.affinity_group.name)
         if g is not None:
@@ -651,29 +712,41 @@ class HivedScheduler:
         remaining pods still recover. Readiness (/readyz) flips only after
         the full replay."""
         pod_list = list(pods)
+        # Recovery is rare and expensive: always trace it (force bypasses
+        # the sampling knob) so the last boot's phase breakdown is in the
+        # trace ring.
+        tr = self.tracer.trace("recovery", force=True)
         ledger_payload = None
-        try:
-            ledger_payload = self.kube_client.load_scheduler_state()
-        except Exception as e:  # noqa: BLE001
-            common.log.warning(
-                "doomed-ledger ConfigMap read failed; recovering without "
-                "it (advisory dooms re-derive arbitrarily): %s", e,
-            )
+        with tr.span("ledgerLoad"):
+            try:
+                ledger_payload = self.kube_client.load_scheduler_state()
+            except Exception as e:  # noqa: BLE001
+                common.log.warning(
+                    "doomed-ledger ConfigMap read failed; recovering without "
+                    "it (advisory dooms re-derive arbitrarily): %s", e,
+                )
         self.begin_recovery(ledger_payload)
         try:
-            for node in nodes:
-                self.add_node(node)
-            for pod in pod_list:
-                if not is_interested(pod):
-                    continue
-                try:
-                    self.add_pod(pod)
-                except Exception as e:  # noqa: BLE001
-                    self._quarantine_pod(pod, e)
+            with tr.span("nodeReplay"):
+                n_nodes = 0
+                for node in nodes:
+                    self.add_node(node)
+                    n_nodes += 1
+            with tr.span("podReplay", pods=len(pod_list)):
+                for pod in pod_list:
+                    if not is_interested(pod):
+                        continue
+                    try:
+                        self.add_pod(pod)
+                    except Exception as e:  # noqa: BLE001
+                        self._quarantine_pod(pod, e)
         except BaseException:
             self._abort_recovery()
+            tr.finish(outcome="aborted")
             raise
-        self.finish_recovery(pod_list)
+        with tr.span("preemptReplay"):
+            self.finish_recovery(pod_list)
+        tr.finish(outcome="ok", nodes=n_nodes)
 
     def begin_recovery(self, ledger_payload: Optional[str]) -> None:
         """Phase 1 of recovery, before the node/pod replay: install the
@@ -708,6 +781,10 @@ class HivedScheduler:
             self._recover_preempting_pods(pods)
         finally:
             self.core.clear_preferred_doomed()
+            # Replayed gangs may sit on hardware that broke while we were
+            # down: seed the stranded-gang gauge before serving scrapes.
+            with self._lock:
+                self._refresh_stranded_locked()
             self.mark_ready()
             self._exit_mutation()
 
@@ -965,26 +1042,31 @@ class HivedScheduler:
                 )
         return out
 
-    def _stranded_group_count_locked(self) -> int:
-        """Count-only variant with per-group early exit: the metrics scrape
-        runs under the scheduler lock and must not build the full per-cell
-        attribution lists the inspect endpoint serves."""
-        n = 0
-        for g in self.core.affinity_groups.values():
+    def _refresh_stranded_locked(self) -> None:
+        """Recompute the stranded-gang name set (per-group early exit —
+        no per-cell attribution lists). Runs under the lock at every
+        applied health transition and at recovery end; the lock-free
+        metrics scrape serves this set intersected with the live groups,
+        so a scrape never walks placements under any lock."""
+        self._stranded_names = {
+            name
+            for name, g in self.core.affinity_groups.items()
             if any(
                 leaf is not None and (not leaf.healthy or leaf.draining)
                 for rows in g.physical_placement.values()
                 for row in rows
                 for leaf in row
-            ):
-                n += 1
-        return n
+            )
+        }
 
     def _check_stranded_locked(self) -> None:
         """Stranded-gang remediation under the eviction policy knob: queue
         the pods of newly-stranded gangs for (lazy) eviction. Runs after
         APPLIED health transitions only, so a flap held by the damper never
-        evicts anybody."""
+        evicts anybody. Always refreshes the stranded gauge first — the
+        metrics plane reports stranded gangs whichever eviction policy is
+        configured."""
+        self._refresh_stranded_locked()
         if not self.config.stranded_gang_eviction:
             return
         # The `_evicted_*` sets and the eviction queue are shared with the
@@ -1057,6 +1139,9 @@ class HivedScheduler:
             stranded = self._stranded_groups_locked()
             payload["strandedGroups"] = stranded
             payload["strandedGroupCount"] = len(stranded)
+            # Piggy-back: this walk just computed the truth — refresh the
+            # lock-free gauge the metrics scrape serves.
+            self._stranded_names = {r["name"] for r in stranded}
             payload["evictionPolicy"] = (
                 "evict" if self.config.stranded_gang_eviction else "surface"
             )
@@ -1069,6 +1154,11 @@ class HivedScheduler:
     def add_pod(self, pod: Pod) -> None:
         if not is_interested(pod):
             return
+        # Pre-readiness bound-pod adds ARE the recovery replay (both the
+        # recover() path and the informer's initial relist): time each one
+        # into the recovery-replay histogram.
+        replaying = is_bound(pod) and not self._ready.is_set()
+        t0 = time.monotonic() if replaying else 0.0
         self._enter_mutation()
         try:
             # Chain-scoped like filter: a pod event touches only its own
@@ -1084,6 +1174,8 @@ class HivedScheduler:
             self._run_chain_locked(pod, None, locked)
         finally:
             self._exit_mutation()
+            if replaying:
+                self.metrics.observe_recovery_replay(time.monotonic() - t0)
 
     def update_pod(self, old: Pod, new: Pod) -> None:
         self._enter_mutation()
@@ -1328,6 +1420,10 @@ class HivedScheduler:
     def _filter_routine(self, args: ei.ExtenderArgs) -> ei.ExtenderFilterResult:
         start = time.monotonic()
         pod = args.pod
+        # Observability plane: a (sampled) span trace for the whole verb,
+        # and an (always-on) decision record begun inside the section —
+        # where the acquired lock scope is known (doc/observability.md).
+        tr = self.tracer.trace("filter", pod=pod.key)
         # Outside the lock: everything that is a pure function of the request
         # — the YAML spec decode+validation and the suggested-node set build
         # are per-request O(spec) / O(cluster) work that previously sat inside
@@ -1353,18 +1449,59 @@ class HivedScheduler:
 
         def locked(sec):
             sections.append(sec)
-            return self._filter_locked(args, spec, spec_error, suggested_set)
+            rec = self.decisions.begin(
+                pod.key, pod.uid, "filter",
+                trace_id=tr.trace_id if tr else None,
+            )
+            rec.lock_chains = self._lock_scope(sec)
+            try:
+                return self._filter_locked(
+                    args, spec, spec_error, suggested_set
+                )
+            except api.WebServerError as e:
+                rec.verdict_error(e.message)
+                raise
+            finally:
+                self.decisions.commit(rec)
 
-        result, outcome, core_s = self._run_chain_locked(pod, spec, locked)
+        outcome = "error"
+        core_s = None
+        try:
+            with tracing.use(tr):
+                result, outcome, core_s = self._run_chain_locked(
+                    pod, spec, locked
+                )
+        finally:
+            # finally, not except: the trace of a CRASHING filter (any
+            # exception, not just protocol errors) is exactly the trace a
+            # debugging session needs in the ring.
+            if tr:
+                for s in sections:
+                    tr.add_span(
+                        "lockWait", s.wait_s, chains=self._lock_scope(s)
+                    )
+                if core_s is not None:
+                    tr.add_span("coreSchedule", core_s)
+                tr.finish(outcome=outcome)
         lock_wait = sum(s.wait_s for s in sections)
         self.metrics.observe_filter(
             time.monotonic() - start, outcome, lock_wait, core_s
         )
         return result
 
+    def _lock_scope(self, sec) -> object:
+        """Display form of a section's lock scope: the chain-name list, or
+        "global" when it covers every chain."""
+        return (
+            "global"
+            if sec.keys == self._locks.all_keys
+            else [str(k) for k in sec.keys]
+        )
+
     def _filter_locked(self, args, spec, spec_error, suggested_set):
         pod = args.pod
         suggested_nodes = args.node_names
+        rec = self.decisions.current()
 
         status = self._admission_check(pod.uid, pod)
         if status.pod_state == PodState.BINDING:
@@ -1373,6 +1510,8 @@ class HivedScheduler:
             # (reference: scheduler.go:497-510).
             binding_pod = status.pod
             status.pod_bind_attempts += 1
+            if rec is not None:
+                rec.verdict_insist(binding_pod.node_name)
             if self._should_force_bind(status, suggested_nodes):
                 self._spawn(lambda: self._force_bind(binding_pod))
             return (
@@ -1418,6 +1557,11 @@ class HivedScheduler:
             if self._should_force_bind(new_status, suggested_nodes):
                 self._spawn(lambda: self._force_bind(binding_pod))
             common.log.info("[%s]: Pod is binding to %s", pod.key, binding_pod.node_name)
+            if rec is not None:
+                rec.verdict_bind(
+                    binding_pod.node_name,
+                    result.pod_bind_info.leaf_cell_isolation,
+                )
             return (
                 ei.ExtenderFilterResult(node_names=[binding_pod.node_name]),
                 "bind",
@@ -1439,6 +1583,8 @@ class HivedScheduler:
             common.log.info(
                 "[%s]: Pod is waiting for preemptRoutine: %s", pod.key, failed_nodes
             )
+            if rec is not None:
+                rec.verdict_preempt(result.pod_preempt_info.victim_pods)
             return (
                 ei.ExtenderFilterResult(failed_nodes=failed_nodes),
                 "preempt",
@@ -1455,6 +1601,8 @@ class HivedScheduler:
         if result.pod_wait_info is not None and result.pod_wait_info.reason:
             wait_reason += ": " + result.pod_wait_info.reason
         common.log.info("[%s]: %s", pod.key, wait_reason)
+        if rec is not None:
+            rec.verdict_wait(wait_reason)
         # Fake FailedNodes expose the wait reason alongside the default
         # scheduler's own reasons (reference: scheduler.go:573-585).
         return (
@@ -1501,7 +1649,18 @@ class HivedScheduler:
                     f"Pod binding node mismatch: expected "
                     f"{binding_pod.node_name}, received {args.node}"
                 )
-        self.kube_client.bind_pod(binding_pod)
+        tr = self.tracer.trace("bind", pod=binding_pod.key)
+        t0 = time.monotonic()
+        try:
+            self.kube_client.bind_pod(binding_pod)
+        finally:
+            dt = time.monotonic() - t0
+            # The bind-write histogram includes any retry backoff the
+            # RetryingKubeClient spent inside the write.
+            self.metrics.observe_bind_write(dt)
+            if tr:
+                tr.add_span("bindWrite", dt, node=binding_pod.node_name)
+                tr.finish()
         return ei.ExtenderBindingResult()
 
     def handle_terminal_bind_failure(self, binding_pod: Pod) -> None:
@@ -1538,6 +1697,9 @@ class HivedScheduler:
         self, args: ei.ExtenderPreemptionArgs
     ) -> ei.ExtenderPreemptionResult:
         self._enter_mutation()
+        start = time.monotonic()
+        tr = self.tracer.trace("preempt", pod=args.pod.key)
+        sections: List = []
         try:
             # Chain-scoped like filter: preempt probes and commits touch
             # only the pod's spec-derived chains (victims overlap the
@@ -1549,12 +1711,25 @@ class HivedScheduler:
                 pass
 
             def locked(sec):
-                return (
-                    self._preempt_locked(args),
-                    self._preempt_annotation_patch(args.pod),
+                sections.append(sec)
+                rec = self.decisions.begin(
+                    args.pod.key, args.pod.uid, "preempt",
+                    trace_id=tr.trace_id if tr else None,
                 )
+                rec.lock_chains = self._lock_scope(sec)
+                try:
+                    return (
+                        self._preempt_locked(args),
+                        self._preempt_annotation_patch(args.pod),
+                    )
+                except api.WebServerError as e:
+                    rec.verdict_error(e.message)
+                    raise
+                finally:
+                    self.decisions.commit(rec)
 
-            result, patch = self._run_chain_locked(args.pod, spec, locked)
+            with tracing.use(tr):
+                result, patch = self._run_chain_locked(args.pod, spec, locked)
             if patch is not None:
                 # Checkpoint the reservation onto the preemptor pod OUTSIDE
                 # the lock (it is a kube write): a crash between the
@@ -1578,6 +1753,13 @@ class HivedScheduler:
                     )
             return result
         finally:
+            if tr:
+                for s in sections:
+                    tr.add_span(
+                        "lockWait", s.wait_s, chains=self._lock_scope(s)
+                    )
+                tr.finish()
+            self.metrics.observe_preempt_routine(time.monotonic() - start)
             self._exit_mutation()
 
     def _preempt_annotation_patch(self, pod: Pod):
@@ -1614,6 +1796,7 @@ class HivedScheduler:
     ) -> ei.ExtenderPreemptionResult:
         # Caller (preempt_routine via _run_chain_locked) holds the section.
         pod = args.pod
+        rec = self.decisions.current()
         # In the Preempting phase the candidate nodes are those where the
         # default scheduler found lower-priority victims.
         suggested_nodes = list(args.node_name_to_meta_victims.keys())
@@ -1626,9 +1809,11 @@ class HivedScheduler:
 
         # Whether Waiting or Preempting, schedule afresh: a previous
         # preemption result may be stale (reference: scheduler.go:655-668).
+        core_t0 = time.monotonic()
         result = self.core.schedule(
             pod, suggested_nodes, SchedulingPhase.PREEMPTING
         )
+        tracing.add_span("coreSchedule", time.monotonic() - core_t0)
 
         if result.pod_bind_info is not None:
             # Free resource appeared; the pod will bind via the filter
@@ -1638,9 +1823,14 @@ class HivedScheduler:
                 "appeared",
                 pod.key,
             )
+            if rec is not None:
+                rec.verdict = "free-resource"
+                rec.note("free resource appeared; pod will bind via filter")
             return ei.ExtenderPreemptionResult()
 
         if result.pod_preempt_info is not None:
+            if rec is not None:
+                rec.verdict_preempt(result.pod_preempt_info.victim_pods)
             self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
                 pod=pod,
                 pod_state=PodState.PREEMPTING,
@@ -1668,6 +1858,8 @@ class HivedScheduler:
         if result.pod_wait_info is not None and result.pod_wait_info.reason:
             wait_reason += ": " + result.pod_wait_info.reason
         common.log.info("[%s]: %s", pod.key, wait_reason)
+        if rec is not None:
+            rec.verdict_wait(wait_reason)
         return ei.ExtenderPreemptionResult()
 
     # ------------------------------------------------------------------ #
@@ -1683,22 +1875,70 @@ class HivedScheduler:
             return self.core.get_affinity_group(name)
 
     def get_cluster_status(self) -> Dict:
-        with self._lock:
-            return self.core.get_cluster_status()
+        return {
+            "physicalCluster": self.get_physical_cluster_status(),
+            "virtualClusters": self.get_all_virtual_clusters_status(),
+        }
 
     def get_physical_cluster_status(self) -> List[Dict]:
-        with self._lock:
-            return self.core.get_physical_cluster_status()
+        """Mirrored per-chain statuses OFF the global lock order: an
+        epoch-clean chain serves its cached mirror without any lock at
+        all, and a dirty chain rebuilds under only ITS chain section — a
+        scrape loop can no longer stall filters on other chains (the
+        pre-observability behavior took the whole-cluster global mode
+        per scrape). A chain mutating between its epoch check and the
+        mirror read serves the previous complete mirror — the same
+        point-in-time semantics any scrape of a live scheduler has."""
+        out: List[Dict] = []
+        core = self.core
+        ot_vc_map = None
+        for chain in core.full_cell_list:
+            cached = core._phys_status_cache.get(chain)
+            if cached is not None and cached[0] == core.chain_epoch(chain):
+                out.extend(cached[1])
+            else:
+                with self._locks.section((chain,)):
+                    if ot_vc_map is None:
+                        # One OT-cell walk shared by every dirty chain of
+                        # this scrape (built inside the first section).
+                        ot_vc_map = core._ot_cell_vc_by_address()
+                    out.extend(core.physical_chain_status(chain, ot_vc_map))
+        return out
 
     def get_all_virtual_clusters_status(self) -> Dict[str, List[Dict]]:
-        with self._lock:
-            return self.core.get_all_virtual_clusters_status()
+        return {
+            vc: self.get_virtual_cluster_status(vc)
+            for vc in self.core.vc_schedulers
+        }
 
     def get_virtual_cluster_status(self, vcn: str) -> List[Dict]:
-        with self._lock:
-            return self.core.get_virtual_cluster_status(vcn)
+        """Epoch-keyed VC status mirror: clean reads are lock-free, a
+        dirty rebuild locks only the VC's own chains (its virtual trees
+        live there; the opportunistic cells' shallow statuses are
+        single-attribute reads, safe without their chains' locks)."""
+        core = self.core
+        cached = core._vc_status_cache.get(vcn)
+        if cached is not None and cached[0] == core.epoch_total():
+            return cached[1]
+        vcs = core.vc_schedulers.get(vcn)
+        if vcs is None:
+            # Unknown VC: let the core raise its user error.
+            return core.get_virtual_cluster_status(vcn)
+        chains = set(vcs.non_pinned_preassigned)
+        for ccl in vcs.pinned_cells.values():
+            chains.add(ccl[ccl.top_level][0].chain)
+        with self._locks.section(chains):
+            return core.get_virtual_cluster_status(vcn)
 
     def get_metrics(self) -> Dict:
+        """Metrics snapshot WITHOUT entering the chain-lock order (the
+        lock-free exposition path, doc/observability.md): every value is
+        either guarded by a private micro-lock (SchedulerMetrics, the
+        histograms, PhaseStats), an atomic-under-the-GIL container read
+        (set/dict lengths, deque/dict copies), or a gauge refreshed under
+        the lock at its mutation site (_refresh_stranded_locked). A
+        Prometheus scrape loop therefore NEVER stalls filter throughput,
+        and a wedged filter never blocks the scrape that would tell you."""
         snap = self.metrics.snapshot()
         # Merge the core-side phase accumulators (leaf-cell search happens
         # inside the topology-aware schedulers; see placement.PhaseStats).
@@ -1711,22 +1951,46 @@ class HivedScheduler:
             "global" if self._locks.force_global else "chains"
         )
         snap["lockWaitByChain"] = self._locks.wait_snapshot()
-        snap["gangAdmissionBatchedCount"] = (
-            self.core.gang_admission_batched_count
-        )
+        core = self.core
+        snap["gangAdmissionBatchedCount"] = core.gang_admission_batched_count
         snap["preemptProbeIncrementalCount"] = (
-            self.core.preempt_probe_incremental_count
+            core.preempt_probe_incremental_count
         )
-        with self._lock:
-            snap["quarantinedPodCount"] = len(self.quarantined_pods)
-            snap["strandedGroupCount"] = self._stranded_group_count_locked()
-            snap["badNodeCount"] = len(self.core.bad_nodes)
-            snap["badChipCount"] = sum(
-                len(c) for c in self.core.bad_chips.values()
-            )
-            snap["drainingChipCount"] = sum(
-                len(c) for c in self.core.draining_chips.values()
-            )
-            snap["healthPendingCount"] = self._damper.pending_count()
+        snap["traceSampledCount"] = self.tracer.sampled_count
+        snap["quarantinedPodCount"] = len(self.quarantined_pods)
+        # set(dict) and list(dict.values()) are single-opcode C-level
+        # copies — atomic under the GIL even against concurrent mutators.
+        snap["strandedGroupCount"] = len(
+            self._stranded_names & set(core.affinity_groups)
+        )
+        snap["badNodeCount"] = len(core.bad_nodes)
+        snap["badChipCount"] = sum(
+            len(c) for c in list(core.bad_chips.values())
+        )
+        snap["drainingChipCount"] = sum(
+            len(c) for c in list(core.draining_chips.values())
+        )
+        snap["healthPendingCount"] = self._damper.pending_count()
         snap["ready"] = self.is_ready()
         return snap
+
+    def get_decisions(self, n: Optional[int] = None) -> Dict:
+        """Inspect payload for /v1/inspect/decisions: the latest-N ring."""
+        return {"items": self.decisions.snapshot(n)}
+
+    def get_decision(self, key: str) -> Dict:
+        """Per-pod lookup (uid or namespace/name) of the latest decision."""
+        rec = self.decisions.lookup(key)
+        if rec is None:
+            raise api.not_found(
+                f"No decision recorded for pod {key} (journal keeps the "
+                f"last {self.decisions.capacity} decisions)"
+            )
+        return rec
+
+    def get_traces(self, n: Optional[int] = None) -> Dict:
+        """Inspect payload for /v1/inspect/traces: the sampled-span ring."""
+        return {
+            "sample": self.tracer.sample,
+            "items": self.tracer.snapshot(n),
+        }

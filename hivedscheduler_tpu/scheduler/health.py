@@ -170,8 +170,13 @@ class FlapDamper:
         return out
 
     def pending_count(self) -> int:
+        # list(...) first: the lock-free metrics scrape calls this while
+        # observers mutate the record map; a dict-resize mid-iteration
+        # must not raise (values() alone would).
         return sum(
-            1 for rec in self._records.values() if rec.pending is not None
+            1
+            for rec in list(self._records.values())
+            if rec.pending is not None
         )
 
     def forget_node(self, node_name: str) -> None:

@@ -576,28 +576,44 @@ class InformerLoop:
         # reconstruction) and preempting groups replay from preempt-info
         # annotations after the bound pods. finish_recovery flips /readyz
         # before the watches start (WaitForCacheSync ordering).
+        # Boot recovery is always traced (force bypasses sampling): the
+        # informer-driven replay is the production recovery path, and its
+        # phase breakdown belongs in the trace ring like recover()'s.
+        tr = self.scheduler.tracer.trace("recovery", force=True, via="informer")
         ledger_payload = None
-        try:
-            # Through the scheduler's client (RetryingKubeClient in
-            # production), not the raw one: a transient apiserver blip at
-            # boot must not silently discard the persisted ledger.
-            ledger_payload = self.scheduler.kube_client.load_scheduler_state()
-        except Exception as e:  # noqa: BLE001
-            common.log.warning(
-                "doomed-ledger ConfigMap read failed; recovering without "
-                "it: %s", e,
-            )
+        with tr.span("ledgerLoad"):
+            try:
+                # Through the scheduler's client (RetryingKubeClient in
+                # production), not the raw one: a transient apiserver blip at
+                # boot must not silently discard the persisted ledger.
+                ledger_payload = (
+                    self.scheduler.kube_client.load_scheduler_state()
+                )
+            except Exception as e:  # noqa: BLE001
+                common.log.warning(
+                    "doomed-ledger ConfigMap read failed; recovering without "
+                    "it: %s", e,
+                )
         self.scheduler.begin_recovery(ledger_payload)
         try:
-            nodes_rv = self._relist_nodes()
-            pods_rv = self._relist_pods(initial=True)
+            with tr.span("nodeReplay"):
+                nodes_rv = self._relist_nodes()
+            with tr.span("podReplay"):
+                pods_rv = self._relist_pods(initial=True)
         except BaseException:
             # Boot failed mid-replay: do not flip /readyz or persist a
             # half-replayed ledger; the caller propagates and the process
             # restarts (pre-PR contract).
             self.scheduler._abort_recovery()
+            tr.finish(outcome="aborted")
             raise
-        self.scheduler.finish_recovery(list(self._known_pods.values()))
+        with tr.span("preemptReplay"):
+            self.scheduler.finish_recovery(list(self._known_pods.values()))
+        tr.finish(
+            outcome="ok",
+            nodes=len(self._known_nodes),
+            pods=len(self._known_pods),
+        )
         for path, handler, relist, rv in (
             ("/api/v1/nodes", self._on_node_event, self._relist_nodes,
              nodes_rv),
@@ -717,9 +733,18 @@ class InformerLoop:
         relist has actually repaired the cache."""
         backoff = self.BACKOFF_INITIAL_S
         while not self._stop.is_set():
+            # Gap-repair relists are rare and diagnostic gold: always
+            # trace them (the watch gap they repair may have lost events).
+            tr = self.scheduler.tracer.trace(
+                "informerRelist", force=True, path=path
+            )
             try:
-                return relist()
+                with tr.span("relist"):
+                    rv = relist()
+                tr.finish(outcome="ok")
+                return rv
             except Exception as e:  # noqa: BLE001
+                tr.finish(outcome="error", error=str(e))
                 common.log.warning(
                     "relist %s failed, retrying in %.1fs: %s", path, backoff, e
                 )

@@ -1,0 +1,327 @@
+"""Low-overhead request tracing + fixed-bucket latency histograms.
+
+The scheduling observability plane (doc/observability.md) has three parts;
+this module is the first: per-request traces. A trace is a request-scoped
+bag of SPANS — named, timed phases (filter → per-chain lock wait → core
+schedule → placement descent → preempt probe → bind write → informer /
+recovery cycles) — kept in a bounded ring so the last N requests are always
+reconstructable from a live scheduler (``/v1/inspect/traces``) without any
+log scraping.
+
+Design constraints, in order:
+
+1. **Near-zero cost when off.** The sampling decision is one float compare
+   per request (``HIVED_TRACE_SAMPLE``, default ``0.01``; ``0`` disables
+   entirely). An unsampled request gets the shared :data:`NULL_TRACE`,
+   whose every method is a constant no-op — no allocation, no clock reads,
+   no thread-local writes. The bench A/B (``bench.py`` tracing stage)
+   gates the default-sampling overhead at ≤3% of gang-schedule p50.
+2. **Never inside the chain-lock order.** Spans are appended to a
+   request-owned list (single-threaded by construction); only the final
+   ring append shares state, and ``collections.deque.append`` is atomic
+   under the GIL. Reading the ring (:meth:`Tracer.snapshot`) therefore
+   never blocks a scheduling thread.
+3. **No plumbing through the algorithm layers.** Deep phases (the
+   placement descent's leaf-cell search) report through a module-level
+   thread-local *current trace* (:func:`use` / :func:`add_span`), so the
+   core and placement code need one guarded call, not a parameter on
+   every signature.
+
+The latency histograms (:class:`LatencyHistogram`) live here too: they are
+the Prometheus-facing aggregate twin of the trace ring (same phases,
+fixed buckets), updated under a private micro-lock that is NOT part of the
+chain-lock order — a scrape can never stall a filter and vice versa.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import random
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+TRACE_SAMPLE_ENV = "HIVED_TRACE_SAMPLE"
+DEFAULT_SAMPLE = 0.01
+DEFAULT_RING_CAPACITY = 256
+
+# Fixed histogram buckets (seconds). Rationale (doc/observability.md):
+# in-process filter p50 is ~1-2 ms and p99 ~15 ms at the 432-host fleet
+# (doc/hot-path.md measured tables), bind writes include an apiserver RTT
+# plus the RetryingKubeClient backoff schedule (up to seconds), and
+# recovery replay is ~0.22 ms/pod — so the buckets run 100 µs .. 2.5 s
+# with ~2.5× steps: dense where the hot path lives, wide enough that a
+# retried bind still lands in a finite bucket.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+    0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+
+def _env_sample() -> float:
+    """Parse HIVED_TRACE_SAMPLE; malformed values degrade to the default
+    (the module's degrade-never-crash contract applies to env knobs)."""
+    raw = os.environ.get(TRACE_SAMPLE_ENV)
+    if raw is None or raw == "":
+        return DEFAULT_SAMPLE
+    try:
+        v = float(raw)
+    except ValueError:
+        return DEFAULT_SAMPLE
+    return min(1.0, max(0.0, v))
+
+
+class Trace:
+    """One sampled request: an id, a start stamp, and a span list. Owned by
+    the request thread until :meth:`finish` hands it to the ring; never
+    mutated after that."""
+
+    __slots__ = ("tracer", "trace_id", "name", "attrs", "t0", "spans",
+                 "_finished")
+
+    def __init__(self, tracer: "Tracer", trace_id: int, name: str,
+                 attrs: Dict):
+        self.tracer = tracer
+        self.trace_id = trace_id
+        self.name = name
+        self.attrs = attrs
+        self.t0 = time.perf_counter()
+        self.spans: List[Dict] = []
+        self._finished = False
+
+    def __bool__(self) -> bool:
+        return True
+
+    def add_span(self, name: str, seconds: float, **attrs) -> None:
+        """Record an already-measured phase (the framework measures lock
+        wait and core-schedule time anyway; re-timing them would skew the
+        phase metrics the spans must agree with)."""
+        d: Dict = {
+            "name": name,
+            "atMs": round((time.perf_counter() - self.t0) * 1e3, 4),
+            "durMs": round(seconds * 1e3, 4),
+        }
+        if attrs:
+            d.update(attrs)
+        self.spans.append(d)
+
+    def span(self, name: str, **attrs) -> "_SpanCtx":
+        """Context manager measuring a phase inline."""
+        return _SpanCtx(self, name, attrs)
+
+    def note(self, **attrs) -> None:
+        self.attrs.update(attrs)
+
+    def finish(self, **attrs) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        if attrs:
+            self.attrs.update(attrs)
+        self.tracer._commit(self)
+
+
+class _SpanCtx:
+    __slots__ = ("_trace", "_name", "_attrs", "_t0")
+
+    def __init__(self, trace: Trace, name: str, attrs: Dict):
+        self._trace = trace
+        self._name = name
+        self._attrs = attrs
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_SpanCtx":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._trace.add_span(
+            self._name, time.perf_counter() - self._t0, **self._attrs
+        )
+
+
+class _NullTrace:
+    """Shared do-nothing trace for unsampled requests: falsy, and every
+    method is a constant-time no-op so callers never branch."""
+
+    __slots__ = ()
+
+    def __bool__(self) -> bool:
+        return False
+
+    def add_span(self, name: str, seconds: float, **attrs) -> None:
+        pass
+
+    def span(self, name: str, **attrs) -> "_NullSpanCtx":
+        return _NULL_SPAN
+
+    def note(self, **attrs) -> None:
+        pass
+
+    def finish(self, **attrs) -> None:
+        pass
+
+
+class _NullSpanCtx:
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpanCtx":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NULL_TRACE = _NullTrace()
+_NULL_SPAN = _NullSpanCtx()
+
+
+class Tracer:
+    """The sampling gate + the bounded ring of finished traces."""
+
+    def __init__(self, sample: Optional[float] = None,
+                 capacity: int = DEFAULT_RING_CAPACITY):
+        self.sample = _env_sample() if sample is None else (
+            min(1.0, max(0.0, float(sample)))
+        )
+        # deque(maxlen): appends are atomic under the GIL, old traces fall
+        # off the far end — bounded memory, no lock on the hot path.
+        self._ring: deque = deque(maxlen=max(1, capacity))
+        self._seq = itertools.count(1)
+        # Private PRNG: the sampling decision must not perturb the global
+        # `random` stream (the chaos harness seeds it for determinism).
+        self._rand = random.Random()
+        # Micro-locked: += is a three-opcode read-modify-write, and the
+        # counter feeds hived_traces_sampled_total — it must not drift
+        # under concurrent sampled requests.
+        self.sampled_count = 0
+        self._count_lock = threading.Lock()
+
+    def trace(self, name: str, force: bool = False, **attrs):
+        """Start a trace, or hand back :data:`NULL_TRACE` when the request
+        is not sampled. ``force=True`` bypasses sampling for rare,
+        high-value cycles (recovery, informer relists) whose cost is
+        negligible next to the work they wrap."""
+        if not force:
+            s = self.sample
+            if s <= 0.0:
+                return NULL_TRACE
+            if s < 1.0 and self._rand.random() >= s:
+                return NULL_TRACE
+        with self._count_lock:
+            self.sampled_count += 1
+        return Trace(self, next(self._seq), name, dict(attrs))
+
+    def _commit(self, trace: Trace) -> None:
+        self._ring.append(
+            {
+                "traceId": trace.trace_id,
+                "name": trace.name,
+                "attrs": trace.attrs,
+                "totalMs": round(
+                    (time.perf_counter() - trace.t0) * 1e3, 4
+                ),
+                "spans": trace.spans,
+            }
+        )
+
+    def snapshot(self, n: Optional[int] = None) -> List[Dict]:
+        """Most-recent-last list of finished traces. ``list(deque)`` is
+        atomic under the GIL — no lock, never blocks a scheduling thread."""
+        items = list(self._ring)
+        if n is not None and n >= 0:
+            # n=0 means zero items; the bare [-0:] slice cannot say that.
+            items = items[-n:] if n > 0 else []
+        return items
+
+
+# --------------------------------------------------------------------- #
+# Thread-local current trace: how deep phases (placement descent) report
+# without threading a trace through every algorithm signature.
+# --------------------------------------------------------------------- #
+
+_current = threading.local()
+
+
+class use:
+    """``with tracing.use(tr): ...`` installs ``tr`` as the thread's
+    current trace for the duration (no-op for NULL_TRACE). Re-entrant:
+    the previous current is restored on exit."""
+
+    __slots__ = ("_tr", "_prev")
+
+    def __init__(self, tr):
+        self._tr = tr
+
+    def __enter__(self):
+        if self._tr:
+            self._prev = getattr(_current, "tr", None)
+            _current.tr = self._tr
+        return self._tr
+
+    def __exit__(self, *exc) -> None:
+        if self._tr:
+            _current.tr = self._prev
+
+
+def current():
+    """The thread's current trace, or None."""
+    return getattr(_current, "tr", None)
+
+
+def add_span(name: str, seconds: float, **attrs) -> None:
+    """Record a span on the thread's current trace, if any. The None check
+    is the entire cost when tracing is off or the request unsampled."""
+    tr = getattr(_current, "tr", None)
+    if tr is not None:
+        tr.add_span(name, seconds, **attrs)
+
+
+# --------------------------------------------------------------------- #
+# Fixed-bucket latency histograms (Prometheus exposition)
+# --------------------------------------------------------------------- #
+
+
+class LatencyHistogram:
+    """Cumulative-on-read fixed-bucket histogram. ``observe`` takes a
+    private micro-lock (never part of the chain-lock order); ``snapshot``
+    copies under the same lock so a scrape sees a consistent
+    (buckets, sum, count) triple."""
+
+    __slots__ = ("buckets", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, buckets: Tuple[float, ...] = DEFAULT_BUCKETS):
+        self.buckets = tuple(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf overflow
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float) -> None:
+        i = 0
+        bs = self.buckets
+        n = len(bs)
+        while i < n and seconds > bs[i]:
+            i += 1
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += seconds
+            self._count += 1
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+            s = self._sum
+        cumulative: List[List] = []
+        running = 0
+        for le, c in zip(self.buckets, counts):
+            running += c
+            cumulative.append([le, running])
+        return {
+            "buckets": cumulative,  # [le_seconds, cumulative_count]
+            "count": total,         # == buckets[+Inf]
+            "sum": round(s, 6),
+        }

@@ -1,0 +1,196 @@
+"""Prometheus text exposition (format version 0.0.4) for the scheduler.
+
+Renders ``HivedScheduler.get_metrics()`` — which is LOCK-FREE by contract
+(it never enters the chain-lock order; see framework.get_metrics) — into
+the text format Prometheus scrapes at ``/metrics``:
+
+- every JSON counter/gauge as a ``hived_*`` metric (the REGISTRY below is
+  the single authoritative key→name mapping);
+- the fixed-bucket latency histograms (filter / preempt verb / bind write
+  / recovery replay) as conventional ``_bucket``/``_sum``/``_count``
+  families;
+- the per-chain lock-wait breakdown and the per-phase accumulators as
+  labeled series.
+
+The registry is deliberately explicit rather than reflective: the golden
+metrics-schema test (tests/test_observability.py) asserts BOTH directions
+— every registry entry appears in doc/observability.md, and every numeric
+key ``get_metrics`` emits is either registered or consciously excluded —
+so a counter added in code without documentation (or vice versa) fails CI
+instead of silently drifting.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+PREFIX = "hived_"
+
+# snapshot key -> (metric name, TYPE, HELP). Counters are monotonic since
+# process start; gauges are instantaneous.
+COUNTERS: Dict[str, tuple] = {
+    "filterCount": ("hived_filter_requests_total", "filter verb calls"),
+    "bindCount": ("hived_filter_bind_total", "filter calls ending in an assume-bind"),
+    "preemptCount": ("hived_filter_preempt_total", "filter calls proposing preemption"),
+    "waitCount": ("hived_filter_wait_total", "filter calls ending in a wait"),
+    "bindRetryCount": ("hived_bind_retries_total", "bind kube-write retries"),
+    "bindGiveUpCount": ("hived_bind_give_ups_total", "bind writes that exhausted retries"),
+    "bindTerminalFailureCount": ("hived_bind_terminal_failures_total", "bind writes failed terminally (404/409)"),
+    "quarantineCount": ("hived_quarantines_total", "bound pods quarantined during recovery replay"),
+    "requestDeadlineExceededCount": ("hived_request_deadline_exceeded_total", "kube retry rounds cut short by the request deadline"),
+    "doomedLedgerPersistCount": ("hived_doomed_ledger_persists_total", "successful doomed-ledger ConfigMap writes"),
+    "doomedLedgerPersistFailureCount": ("hived_doomed_ledger_persist_failures_total", "failed doomed-ledger ConfigMap writes"),
+    "doomedLedgerCoalescedCount": ("hived_doomed_ledger_coalesced_total", "doomed-epoch bumps coalesced into one ledger write"),
+    "preemptionRecoveredCount": ("hived_preemptions_recovered_total", "preempting groups replayed at restart"),
+    "preemptionCancelledOnRecoveryCount": ("hived_preemptions_cancelled_on_recovery_total", "preemption reservations cancelled at restart"),
+    "healthTransitionCount": ("hived_health_transitions_total", "health transitions applied to the core"),
+    "healthDampedCount": ("hived_health_damped_total", "health observations held by the flap damper"),
+    "healthSettledCount": ("hived_health_settled_total", "held health transitions later settled"),
+    "strandedEvictionCount": ("hived_stranded_evictions_total", "pods evicted by stranded-gang remediation"),
+    "gangAdmissionBatchedCount": ("hived_gang_admissions_batched_total", "pods admitted through the decode-free gang admission path"),
+    "preemptProbeIncrementalCount": ("hived_preempt_probes_incremental_total", "preempt probes served from the epoch-gated victims cache"),
+    "traceSampledCount": ("hived_traces_sampled_total", "requests sampled into the trace ring"),
+}
+
+GAUGES: Dict[str, tuple] = {
+    "quarantinedPodCount": ("hived_quarantined_pods", "bound pods currently quarantined"),
+    "strandedGroupCount": ("hived_stranded_groups", "gangs currently holding bad or draining cells"),
+    "badNodeCount": ("hived_bad_nodes", "nodes currently marked bad"),
+    "badChipCount": ("hived_bad_chips", "chips currently marked bad (device-health plane)"),
+    "drainingChipCount": ("hived_draining_chips", "chips currently draining (maintenance plane)"),
+    "healthPendingCount": ("hived_health_pending_transitions", "health transitions currently held by the flap damper"),
+    "ready": ("hived_ready", "1 once recovery completed (readyz), else 0"),
+}
+
+# get_metrics keys -> histogram family names.
+HISTOGRAMS: Dict[str, tuple] = {
+    "filter": ("hived_filter_latency_seconds", "filter verb end-to-end latency"),
+    "preempt": ("hived_preempt_latency_seconds", "preempt verb end-to-end latency"),
+    "bind": ("hived_bind_write_latency_seconds", "bind kube write latency (incl. retry backoff)"),
+    "recoveryReplay": ("hived_recovery_replay_latency_seconds", "per-pod recovery replay latency"),
+}
+
+# Labeled series rendered from structured snapshot values.
+LABELED: Dict[str, str] = {
+    "hived_lock_wait_seconds_total": "per-chain lock wait (chain label; '*global*' aggregates global-mode holders)",
+    "hived_lock_acquisitions_total": "per-chain lock acquisitions (chain label)",
+    "hived_phase_seconds_total": "per-phase accumulated time (phase label: lockWait, coreSchedule, leafCellSearch)",
+    "hived_phase_ops_total": "per-phase operation count (phase label)",
+}
+
+# JSON-snapshot keys that are deliberately NOT exported to Prometheus:
+# derived presentation values (windowed percentiles — Prometheus derives
+# quantiles from the histograms), structured sub-objects rendered as
+# labeled/histogram families above, and non-numeric mode flags.
+EXCLUDED_KEYS = {
+    "filterLatencyP50Ms",   # windowed percentile; use the histogram
+    "filterLatencyP99Ms",   # windowed percentile; use the histogram
+    "phases",               # rendered as hived_phase_* labeled series
+    "lockWaitByChain",      # rendered as hived_lock_* labeled series
+    "latencyHistograms",    # rendered as hived_*_latency_seconds
+    "lockSharding",         # string mode flag ("chains"/"global")
+}
+
+
+def metric_names() -> List[str]:
+    """Every family name this renderer can emit (the code-side truth the
+    golden schema test diffs against doc/observability.md)."""
+    names = [name for name, _ in COUNTERS.values()]
+    names += [name for name, _ in GAUGES.values()]
+    names += [name for name, _ in HISTOGRAMS.values()]
+    names += list(LABELED)
+    return sorted(names)
+
+
+def _escape_label(value: str) -> str:
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace("\n", "\\n")
+        .replace('"', '\\"')
+    )
+
+
+def _fmt(value: float) -> str:
+    # Integers render bare; floats keep full precision minus trailing noise.
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, int):
+        return str(value)
+    return repr(float(value))
+
+
+def render(snapshot: Dict) -> str:
+    """The text exposition body for one ``get_metrics()`` snapshot."""
+    lines: List[str] = []
+
+    def header(name: str, mtype: str, help_text: str) -> None:
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {mtype}")
+
+    for key, (name, help_text) in COUNTERS.items():
+        if key not in snapshot:
+            continue
+        header(name, "counter", help_text)
+        lines.append(f"{name} {_fmt(snapshot[key])}")
+
+    for key, (name, help_text) in GAUGES.items():
+        if key not in snapshot:
+            continue
+        header(name, "gauge", help_text)
+        lines.append(f"{name} {_fmt(snapshot[key])}")
+
+    for key, (name, help_text) in HISTOGRAMS.items():
+        hist = snapshot.get("latencyHistograms", {}).get(key)
+        if hist is None:
+            continue
+        header(name, "histogram", help_text)
+        for le, cum in hist["buckets"]:
+            lines.append(f'{name}_bucket{{le="{_fmt(le)}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {hist["count"]}')
+        lines.append(f"{name}_sum {_fmt(hist['sum'])}")
+        lines.append(f"{name}_count {hist['count']}")
+
+    # Headers are emitted even with no samples yet, so the families are
+    # discoverable on a fresh scheduler.
+    waits = snapshot.get("lockWaitByChain", {})
+    header(
+        "hived_lock_wait_seconds_total", "counter",
+        LABELED["hived_lock_wait_seconds_total"],
+    )
+    for chain, entry in sorted(waits.items()):
+        lines.append(
+            'hived_lock_wait_seconds_total{chain="%s"} %s'
+            % (_escape_label(chain), _fmt(entry["totalMs"] / 1e3))
+        )
+    header(
+        "hived_lock_acquisitions_total", "counter",
+        LABELED["hived_lock_acquisitions_total"],
+    )
+    for chain, entry in sorted(waits.items()):
+        lines.append(
+            'hived_lock_acquisitions_total{chain="%s"} %s'
+            % (_escape_label(chain), _fmt(entry["count"]))
+        )
+
+    phases = snapshot.get("phases", {})
+    header(
+        "hived_phase_seconds_total", "counter",
+        LABELED["hived_phase_seconds_total"],
+    )
+    for phase, entry in sorted(phases.items()):
+        lines.append(
+            'hived_phase_seconds_total{phase="%s"} %s'
+            % (_escape_label(phase), _fmt(entry["totalMs"] / 1e3))
+        )
+    header(
+        "hived_phase_ops_total", "counter",
+        LABELED["hived_phase_ops_total"],
+    )
+    for phase, entry in sorted(phases.items()):
+        lines.append(
+            'hived_phase_ops_total{phase="%s"} %s'
+            % (_escape_label(phase), _fmt(entry["count"]))
+        )
+
+    return "\n".join(lines) + "\n"

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional, Tuple
 
@@ -22,6 +23,7 @@ from .. import common
 from ..api import constants, extender as ei, types as api
 from ..scheduler import kube as kube_mod
 from ..scheduler.framework import HivedScheduler
+from . import prometheus
 
 # Latency metrics + the per-phase filter breakdown (lockWait / coreSchedule /
 # leafCellSearch), the per-chain lock-wait split (lockWaitByChain — the
@@ -183,16 +185,37 @@ def _make_handler(scheduler: HivedScheduler):
         # Inspect API (reference: webserver.go:242-300)
         # -------------------------------------------------------------- #
 
+        def _reply_text(self, code: int, body: str) -> None:
+            data = body.encode()
+            self.send_response(code)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+            )
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_GET(self) -> None:  # noqa: N802
             try:
-                payload = self._route_get(self.path)
+                split = urllib.parse.urlsplit(self.path)
+                if split.path == constants.PROMETHEUS_PATH:
+                    # Prometheus text exposition: served from the
+                    # LOCK-FREE metrics snapshot — a scrape never enters
+                    # the chain-lock order (doc/observability.md).
+                    self._reply_text(
+                        200,
+                        prometheus.render(scheduler.get_metrics()),
+                    )
+                    return
+                payload = self._route_get(split.path, split.query)
                 self._reply(200, payload)
             except Exception as e:  # noqa: BLE001
                 self._reply_error(e)
 
-        def _route_get(self, path: str):
+        def _route_get(self, path: str, query: str = ""):
             agp = constants.AFFINITY_GROUPS_PATH
             vcp = constants.VIRTUAL_CLUSTERS_PATH
+            dcp = constants.DECISIONS_PATH
             if path == constants.HEALTHZ_PATH:
                 # Liveness: the process serves HTTP. (Readiness is separate:
                 # a recovering scheduler is alive but must not get traffic.)
@@ -205,6 +228,13 @@ def _make_handler(scheduler: HivedScheduler):
                 return {"status": "ready"}
             if path == constants.QUARANTINE_PATH:
                 return scheduler.get_quarantine()
+            if path == dcp or path == dcp + "/":
+                return scheduler.get_decisions(_query_n(query))
+            if path.startswith(dcp + "/"):
+                # Per-pod lookup: uid, or namespace/name (may contain "/").
+                return scheduler.get_decision(path[len(dcp) + 1:])
+            if path == constants.TRACES_PATH:
+                return scheduler.get_traces(_query_n(query))
             if path == constants.DOOMED_LEDGER_PATH:
                 return scheduler.get_doomed_ledger()
             if path == constants.HEALTH_PATH:
@@ -233,6 +263,16 @@ def _make_handler(scheduler: HivedScheduler):
             raise api.not_found(f"Cannot found resource: {path}")
 
     return Handler
+
+
+def _query_n(query: str) -> Optional[int]:
+    """The latest-N knob (?n=) of the ring endpoints; malformed values
+    degrade to "everything" rather than erroring a diagnostic read."""
+    try:
+        values = urllib.parse.parse_qs(query or "").get("n")
+        return int(values[0]) if values else None
+    except (ValueError, TypeError):
+        return None
 
 
 def _version() -> str:

@@ -1570,4 +1570,44 @@ class ChaosHarness:
 
 
 def run_chaos_schedule(seed: int, n_events: Optional[int] = None) -> Dict[str, int]:
-    return ChaosHarness(seed).run(n_events)
+    harness = ChaosHarness(seed)
+    try:
+        return harness.run(n_events)
+    except AssertionError as e:
+        # Observability plane (doc/observability.md): an invariant failure
+        # dumps the live scheduler's decision journal + trace ring as a
+        # per-seed artifact, so "which attempt put the core in this state"
+        # is answerable without replaying the schedule under a debugger.
+        # HIVED_CHAOS_ARTIFACT_DIR overrides the destination (hack/soak.sh
+        # --keep-decisions sets it); any dump failure must not mask the
+        # invariant assertion itself.
+        try:
+            path = _dump_decision_artifact(harness, seed)
+            if path:
+                e.args = (*e.args, f"decision journal dumped to {path}")
+        except Exception:  # noqa: BLE001
+            common.log.exception("chaos decision-journal dump failed")
+        raise
+
+
+def _dump_decision_artifact(harness: "ChaosHarness", seed: int) -> str:
+    import json
+    import tempfile
+
+    out_dir = os.environ.get("HIVED_CHAOS_ARTIFACT_DIR") or os.path.join(
+        tempfile.gettempdir(), "hived-chaos"
+    )
+    os.makedirs(out_dir, exist_ok=True)
+    sched = harness.scheduler
+    payload = {
+        "seed": seed,
+        "eventIndex": harness.event_i,
+        "stats": harness.stats,
+        "decisions": sched.decisions.snapshot(),
+        "traces": sched.tracer.snapshot(),
+        "metrics": sched.get_metrics(),
+    }
+    path = os.path.join(out_dir, f"chaos-seed{seed}-decisions.json")
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1, default=str)
+    return path

@@ -26,6 +26,13 @@ def test_bench_smoke_p50_and_phase_breakdown():
     assert 0.0 < result["gang_schedule_p50_ms"] < SMOKE_P50_BUDGET_MS, result
     assert result["pods_per_sec"] > 0
 
+    # The tracing-on/off delta is emitted into the BENCH artifact (ISSUE 6
+    # satellite). CI machines are too noisy for an overhead assertion —
+    # the driver bench's 432-host A/B gates that — this guards the wiring.
+    delta = result["tracing_delta"]
+    assert delta["p50_on_ms"] > 0 and delta["p50_off_ms"] > 0
+    assert "overhead_pct" in delta
+
     # The per-phase breakdown must be present and internally consistent
     # with the observed filter calls (ISSUE acceptance criterion).
     phases = result["phases"]

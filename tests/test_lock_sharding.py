@@ -53,6 +53,10 @@ def _metrics_visible(sched: HivedScheduler) -> dict:
         k: v
         for k, v in m.items()
         if isinstance(v, (int, bool)) and "Latency" not in k
+        # Trace sampling is a per-scheduler coin flip by design
+        # (HIVED_TRACE_SAMPLE): the only legitimately nondeterministic
+        # counter.
+        and k != "traceSampledCount"
     }
     return {
         "counters": counters,

@@ -1,0 +1,705 @@
+"""The scheduling observability plane (doc/observability.md).
+
+Covers the ISSUE 6 acceptance surface:
+
+- `/metrics` serves Prometheus text exposition (counters + fixed-bucket
+  latency histograms + per-chain lock-wait series) WITHOUT acquiring any
+  chain lock — proven by scraping while another thread holds the global
+  (all-chain) lock mode;
+- every filter/preempt response's outcome is reconstructable from the
+  decision journal: one pinned scenario per gate (VC quota, chip health,
+  maintenance drain, buddy fit) plus a preemption with its victim list;
+- the golden metrics schema: every metric the renderer can emit exists in
+  the live `/metrics` output AND in doc/observability.md, and every
+  numeric key `get_metrics()` emits is registered or consciously
+  excluded — silent drift in either direction fails here;
+- tracing: spans for the filter pipeline, near-zero behavior when off,
+  force-traced recovery, ring bounds;
+- untyped-pod chain narrowing: a guaranteed pod without `leafCellType`
+  runs under its VC's quota chains (recorded in its decision), not the
+  global order.
+"""
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.api import constants, extender as ei
+from hivedscheduler_tpu.api.config import Config
+from hivedscheduler_tpu.scheduler import decisions as decisions_mod
+from hivedscheduler_tpu.scheduler import tracing
+from hivedscheduler_tpu.scheduler.framework import (
+    HivedScheduler,
+    NullKubeClient,
+)
+from hivedscheduler_tpu.scheduler.types import Node, Pod
+from hivedscheduler_tpu.webserver import prometheus
+from hivedscheduler_tpu.webserver.server import WebServer
+
+from .test_config_compiler import tpu_design_config
+from .test_core import make_pod
+
+common.init_logging(logging.ERROR)
+
+DOC_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "doc",
+    "observability.md",
+)
+
+
+def two_host_config() -> Config:
+    """Two standalone 4-chip v5e hosts; VC A and VC B hold one host each.
+    Small enough that every gate scenario is forced, not probabilistic."""
+    return Config.from_dict(
+        {
+            "physicalCluster": {
+                "cellTypes": {
+                    "v5e-host": {
+                        "childCellType": "v5e-chip",
+                        "childCellNumber": 4,
+                        "isNodeLevel": True,
+                    },
+                },
+                "physicalCells": [
+                    {"cellType": "v5e-host", "cellAddress": "host-a"},
+                    {"cellType": "v5e-host", "cellAddress": "host-b"},
+                ],
+            },
+            "virtualClusters": {
+                "A": {"virtualCells": [{"cellType": "v5e-host", "cellNumber": 1}]},
+                "B": {"virtualCells": [{"cellType": "v5e-host", "cellNumber": 1}]},
+            },
+        }
+    )
+
+
+def new_scheduler(config=None, trace_sample=0.0, **kw) -> HivedScheduler:
+    sched = HivedScheduler(
+        config if config is not None else two_host_config(),
+        kube_client=NullKubeClient(),
+        trace_sample=trace_sample,
+        **kw,
+    )
+    for name in sched.core.configured_node_names():
+        sched.add_node(Node(name=name))
+    return sched
+
+
+def filter_pod(sched, pod):
+    sched.add_pod(pod)
+    return sched.filter_routine(
+        ei.ExtenderArgs(pod=pod, node_names=sorted(sched.nodes))
+    )
+
+
+def gang(name, n_pods, chips):
+    return {
+        "name": name,
+        "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
+    }
+
+
+def mark_chip_bad(sched, node_name, chip="0"):
+    sched.update_node(
+        sched.nodes[node_name],
+        Node(
+            name=node_name,
+            annotations={constants.ANNOTATION_NODE_DEVICE_HEALTH: chip},
+        ),
+    )
+
+
+# --------------------------------------------------------------------- #
+# 1. Explainable decisions: one pinned scenario per gate
+# --------------------------------------------------------------------- #
+
+
+def last_decision(sched):
+    items = sched.get_decisions()["items"]
+    assert items, "no decision recorded"
+    return items[-1]
+
+
+def test_decision_bind_records_placement_and_chains():
+    sched = new_scheduler()
+    pod = make_pod("j0-0", "u0", "A", 0, "v5e-chip", 4, group=gang("g0", 1, 4))
+    r = filter_pod(sched, pod)
+    assert r.node_names
+    rec = last_decision(sched)
+    assert rec["verdict"] == "bind"
+    assert rec["node"] == r.node_names[0]
+    assert len(rec["leafCells"]) == 4
+    assert rec["chainsConsidered"] == ["v5e-host"]
+    # The pod's latest decision is addressable by uid and by ns/name.
+    assert sched.get_decision("u0")["seq"] == rec["seq"]
+    assert sched.get_decision(pod.key)["seq"] == rec["seq"]
+
+
+def test_decision_vc_quota_rejection():
+    sched = new_scheduler()
+    # VC A holds ONE host; a 2-host gang cannot fit its virtual capacity.
+    pod = make_pod(
+        "q0-0", "uq0", "A", 0, "v5e-chip", 4, group=gang("gq", 2, 4)
+    )
+    r = filter_pod(sched, pod)
+    assert not r.node_names
+    rec = last_decision(sched)
+    assert rec["verdict"] == "wait"
+    gates = {a["gate"] for a in rec["rejections"]}
+    assert decisions_mod.GATE_VC_QUOTA in gates, rec
+    # The response's wait reason and the journal's agree (outcome
+    # reconstructable from the record alone).
+    assert rec["waitReason"].split(": ", 1)[1] in str(r.failed_nodes)
+
+
+def test_decision_chip_health_rejection():
+    sched = new_scheduler()
+    mark_chip_bad(sched, "host-a")
+    mark_chip_bad(sched, "host-b")
+    # Opportunistic 4-chip pod: every host has only 3 usable chips left.
+    pod = make_pod(
+        "h0-0", "uh0", "A", -1, "v5e-chip", 4, group=gang("gh", 1, 4)
+    )
+    r = filter_pod(sched, pod)
+    assert not r.node_names
+    rec = last_decision(sched)
+    assert rec["verdict"] == "wait"
+    gates = {a["gate"] for a in rec["rejections"]}
+    assert decisions_mod.GATE_CHIP_HEALTH in gates, rec
+    assert any("bad node" in a["reason"] for a in rec["rejections"])
+
+
+def test_decision_draining_rejection():
+    sched = new_scheduler()
+    for node_name in ("host-a", "host-b"):
+        sched.update_node(
+            sched.nodes[node_name],
+            Node(
+                name=node_name,
+                annotations={constants.ANNOTATION_NODE_DRAIN: "0"},
+            ),
+        )
+    pod = make_pod(
+        "d0-0", "ud0", "A", -1, "v5e-chip", 4, group=gang("gd", 1, 4)
+    )
+    r = filter_pod(sched, pod)
+    assert not r.node_names
+    rec = last_decision(sched)
+    gates = {a["gate"] for a in rec["rejections"]}
+    assert decisions_mod.GATE_DRAINING in gates, rec
+    assert any("draining node" in a["reason"] for a in rec["rejections"])
+
+
+def test_decision_buddy_fit_rejection():
+    sched = new_scheduler()
+    # Honor the suggested-node set and offer none: intra-VC placement
+    # succeeds (unbound virtual cells carry no location), the
+    # virtual->physical buddy mapping then cannot land anywhere.
+    pod = make_pod(
+        "b0-0", "ub0", "A", 0, "v5e-chip", 4, group=gang("gb", 1, 4),
+        ignore_suggested=False,
+    )
+    sched.add_pod(pod)
+    r = sched.filter_routine(ei.ExtenderArgs(pod=pod, node_names=[]))
+    assert not r.node_names
+    rec = last_decision(sched)
+    gates = {a["gate"] for a in rec["rejections"]}
+    assert decisions_mod.GATE_BUDDY_FIT in gates, rec
+    assert any(
+        "Mapping the virtual placement" in a["reason"]
+        for a in rec["rejections"]
+    )
+
+
+def test_decision_preemption_records_victim_list():
+    sched = new_scheduler()
+    victim = make_pod(
+        "v0-0", "uv0", "A", -1, "v5e-chip", 4, group=gang("gv", 1, 4)
+    )
+    rv = filter_pod(sched, victim)
+    assert rv.node_names
+    victim_node = rv.node_names[0]
+    # Both hosts occupied so the preemptor must displace someone.
+    victim2 = make_pod(
+        "v1-0", "uv1", "B", -1, "v5e-chip", 4, group=gang("gv2", 1, 4)
+    )
+    assert filter_pod(sched, victim2).node_names
+    preemptor = make_pod(
+        "p0-0", "up0", "A", 5, "v5e-chip", 4, group=gang("gp", 1, 4)
+    )
+    sched.add_pod(preemptor)
+    r = sched.preempt_routine(
+        ei.ExtenderPreemptionArgs(
+            pod=preemptor,
+            node_name_to_meta_victims={
+                n: ei.MetaVictims() for n in sorted(sched.nodes)
+            },
+        )
+    )
+    assert r.node_name_to_meta_victims
+    rec = last_decision(sched)
+    assert rec["phase"] == "preempt"
+    assert rec["verdict"] == "preempt"
+    # The victim list in the journal IS the response's victim set.
+    journal_victims = {(v["node"], v["uid"]) for v in rec["victims"]}
+    response_victims = {
+        (node, p.uid)
+        for node, mv in r.node_name_to_meta_victims.items()
+        for p in mv.pods
+    }
+    assert journal_victims == response_victims
+    assert journal_victims & {("host-a", "uv0"), ("host-b", "uv0"),
+                              ("host-a", "uv1"), ("host-b", "uv1")}
+    assert victim_node in ("host-a", "host-b")
+
+
+def test_decision_insist_and_error_verdicts():
+    sched = new_scheduler()
+    pod = make_pod("i0-0", "ui0", "A", 0, "v5e-chip", 4, group=gang("gi", 1, 4))
+    assert filter_pod(sched, pod).node_names
+    # Second filter for the now-BINDING pod: the insist path.
+    r = sched.filter_routine(
+        ei.ExtenderArgs(pod=pod, node_names=sorted(sched.nodes))
+    )
+    assert r.node_names
+    rec = last_decision(sched)
+    assert rec["verdict"] == "insist-bind"
+    assert rec["node"] == r.node_names[0]
+    # Unknown VC: rejected before scheduling (the webserver maps the
+    # raised WebServerError to the in-band Error field), recorded as an
+    # error verdict with the user-facing message.
+    from hivedscheduler_tpu.api import types as api_types
+
+    bad = make_pod(
+        "e0-0", "ue0", "NO-SUCH-VC", 0, "v5e-chip", 4, group=gang("ge", 1, 4)
+    )
+    sched.add_pod(bad)
+    with pytest.raises(api_types.WebServerError):
+        sched.filter_routine(
+            ei.ExtenderArgs(pod=bad, node_names=sorted(sched.nodes))
+        )
+    rec2 = last_decision(sched)
+    assert rec2["verdict"] == "error"
+    assert "NO-SUCH-VC" in rec2["error"]
+
+
+def test_decision_journal_ring_is_bounded():
+    cfg = two_host_config()
+    cfg.decision_journal_capacity = 8
+    sched = new_scheduler(cfg)
+    for i in range(30):
+        pod = make_pod(
+            f"r{i}-0", f"ur{i}", "A", -1, "v5e-chip", 1,
+            group=gang(f"gr{i}", 1, 1),
+        )
+        filter_pod(sched, pod)
+        sched.delete_pod(sched.pod_schedule_statuses[pod.uid].pod)
+    items = sched.get_decisions()["items"]
+    assert len(items) == 8
+    assert items[-1]["pod"].startswith("ur29") or "r29" in items[-1]["pod"]
+
+
+# --------------------------------------------------------------------- #
+# 2. Untyped-pod chain narrowing
+# --------------------------------------------------------------------- #
+
+
+def test_untyped_guaranteed_pod_narrows_to_vc_quota_chains():
+    sched = HivedScheduler(
+        tpu_design_config(), kube_client=NullKubeClient(), trace_sample=0.0
+    )
+    for name in sched.core.configured_node_names():
+        sched.add_node(Node(name=name))
+    pod = make_pod("nt0-0", "unt0", "VC1", 0, "", 4, group=gang("gnt", 1, 4))
+    chains = sched._pod_lock_chains(pod)
+    assert chains is not None, "untyped guaranteed pod degraded to global"
+    assert set(map(str, chains)) == set(
+        map(str, sched.core.vc_quota_chains("VC1"))
+    )
+    # The schedule itself succeeds under the narrowed section, and the
+    # chosen chain set is recorded in the pod's decision.
+    r = filter_pod(sched, pod)
+    assert r.node_names
+    rec = last_decision(sched)
+    assert rec["lockChains"] != "global"
+    assert set(rec["lockChains"]) == set(map(str, chains))
+    assert set(rec["chainsConsidered"]).issubset(set(rec["lockChains"]))
+
+
+def test_untyped_opportunistic_pod_stays_global():
+    sched = HivedScheduler(
+        tpu_design_config(), kube_client=NullKubeClient(), trace_sample=0.0
+    )
+    pod = make_pod("no0-0", "uno0", "VC1", -1, "", 4, group=gang("gno", 1, 4))
+    assert sched._pod_lock_chains(pod) is None
+
+
+def test_untyped_narrowing_differential_vs_global_lock():
+    """Same untyped-pod scenario, sharded vs forced-global: identical
+    placements and identical metrics-visible outcomes."""
+    def drive(global_lock):
+        sched = HivedScheduler(
+            tpu_design_config(),
+            kube_client=NullKubeClient(),
+            global_lock=global_lock,
+            trace_sample=0.0,
+        )
+        for name in sched.core.configured_node_names():
+            sched.add_node(Node(name=name))
+        out = []
+        for i, (vc, prio) in enumerate(
+            [("VC1", 0), ("VC2", 0), ("VC1", -1), ("VC1", 3)]
+        ):
+            pod = make_pod(
+                f"ud{i}-0", f"uud{i}", vc, prio, "", 2,
+                group=gang(f"gud{i}", 2, 2),
+            )
+            r = filter_pod(sched, pod)
+            out.append((i, r.node_names, sorted(r.failed_nodes or {})))
+            pod2 = make_pod(
+                f"ud{i}-1", f"uud{i}b", vc, prio, "", 2,
+                group=gang(f"gud{i}", 2, 2),
+            )
+            r2 = filter_pod(sched, pod2)
+            out.append((i, r2.node_names, sorted(r2.failed_nodes or {})))
+        return out
+
+    assert drive(False) == drive(True)
+
+
+# --------------------------------------------------------------------- #
+# 3. Tracing
+# --------------------------------------------------------------------- #
+
+
+def test_trace_spans_cover_filter_pipeline():
+    sched = new_scheduler(trace_sample=1.0)
+    pod = make_pod("t0-0", "ut0", "A", 0, "v5e-chip", 4, group=gang("gt", 1, 4))
+    assert filter_pod(sched, pod).node_names
+    traces = sched.get_traces()["items"]
+    filt = [t for t in traces if t["name"] == "filter"]
+    assert filt, traces
+    spans = {s["name"] for s in filt[-1]["spans"]}
+    assert {"lockWait", "coreSchedule", "leafCellSearch"} <= spans
+    assert filt[-1]["attrs"]["outcome"] == "bind"
+    assert filt[-1]["traceId"] > 0
+    # Bind verb: the kube write gets its own span.
+    sched.bind_routine(
+        ei.ExtenderBindingArgs(
+            pod_name="t0-0", pod_namespace="default", pod_uid="ut0",
+            node=sched.pod_schedule_statuses["ut0"].pod.node_name,
+        )
+    )
+    binds = [t for t in sched.get_traces()["items"] if t["name"] == "bind"]
+    assert binds and {s["name"] for s in binds[-1]["spans"]} == {"bindWrite"}
+    # The decision record cross-references the trace.
+    assert any(
+        d.get("traceId") == filt[-1]["traceId"]
+        for d in sched.get_decisions()["items"]
+    )
+
+
+def test_tracing_off_records_nothing():
+    sched = new_scheduler(trace_sample=0.0)
+    pod = make_pod("t1-0", "ut1", "A", 0, "v5e-chip", 4, group=gang("gt1", 1, 4))
+    assert filter_pod(sched, pod).node_names
+    assert sched.get_traces()["items"] == []
+    assert sched.get_metrics()["traceSampledCount"] == 0
+    assert tracing.NULL_TRACE.span("x").__enter__() is not None  # no-op ctx
+
+
+def test_recovery_is_force_traced_and_histogrammed():
+    sched = new_scheduler(trace_sample=0.0)
+    pod = make_pod("t2-0", "ut2", "A", 0, "v5e-chip", 4, group=gang("gt2", 1, 4))
+    assert filter_pod(sched, pod).node_names
+    bound = sched.pod_schedule_statuses["ut2"].pod
+    fresh = HivedScheduler(
+        two_host_config(), kube_client=NullKubeClient(), trace_sample=0.0
+    )
+    fresh.recover(
+        [Node(name=n) for n in fresh.core.configured_node_names()],
+        [
+            Pod(
+                name=bound.name, namespace=bound.namespace, uid=bound.uid,
+                annotations=bound.annotations, node_name=bound.node_name,
+                phase="Running", resource_limits=bound.resource_limits,
+            )
+        ],
+    )
+    # Force-traced despite sample=0.
+    rec_traces = [
+        t for t in fresh.get_traces()["items"] if t["name"] == "recovery"
+    ]
+    assert rec_traces
+    spans = {s["name"] for s in rec_traces[-1]["spans"]}
+    assert {"ledgerLoad", "nodeReplay", "podReplay", "preemptReplay"} <= spans
+    # The per-pod replay landed in the recovery-replay histogram.
+    hist = fresh.get_metrics()["latencyHistograms"]["recoveryReplay"]
+    assert hist["count"] == 1
+
+
+def test_trace_ring_is_bounded():
+    cfg = two_host_config()
+    cfg.trace_ring_capacity = 4
+    sched = new_scheduler(cfg, trace_sample=1.0)
+    for i in range(12):
+        pod = make_pod(
+            f"tr{i}-0", f"utr{i}", "A", -1, "v5e-chip", 1,
+            group=gang(f"gtr{i}", 1, 1),
+        )
+        filter_pod(sched, pod)
+        sched.delete_pod(sched.pod_schedule_statuses[pod.uid].pod)
+    assert len(sched.get_traces()["items"]) == 4
+
+
+def test_trace_sample_env_parsing(monkeypatch):
+    monkeypatch.setenv(tracing.TRACE_SAMPLE_ENV, "0.5")
+    assert tracing.Tracer().sample == 0.5
+    monkeypatch.setenv(tracing.TRACE_SAMPLE_ENV, "garbage")
+    assert tracing.Tracer().sample == tracing.DEFAULT_SAMPLE
+    monkeypatch.setenv(tracing.TRACE_SAMPLE_ENV, "7")
+    assert tracing.Tracer().sample == 1.0
+    monkeypatch.delenv(tracing.TRACE_SAMPLE_ENV)
+    assert tracing.Tracer().sample == tracing.DEFAULT_SAMPLE
+
+
+# --------------------------------------------------------------------- #
+# 4. Prometheus exposition + the lock-free contract
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture()
+def server():
+    sched = new_scheduler(tpu_design_config(), trace_sample=1.0)
+    ws = WebServer(sched, address="127.0.0.1:0")
+    ws.start()
+    yield ws
+    ws.stop()
+
+
+def http_get(server, path, timeout=10):
+    req = urllib.request.urlopen(
+        f"http://127.0.0.1:{server.port}{path}", timeout=timeout
+    )
+    return req.status, req.headers.get("Content-Type", ""), req.read().decode()
+
+
+def test_metrics_endpoint_serves_text_exposition(server):
+    sched = server.scheduler
+    pod = make_pod("m0-0", "um0", "VC1", 0, "v5e-chip", 4, group=gang("gm", 1, 4))
+    assert filter_pod(sched, pod).node_names
+    status, ctype, body = http_get(server, constants.PROMETHEUS_PATH)
+    assert status == 200
+    assert ctype.startswith("text/plain")
+    assert "version=0.0.4" in ctype
+    # Counters, histograms with cumulative buckets, labeled series.
+    assert re.search(r"^hived_filter_requests_total 1$", body, re.M)
+    assert re.search(
+        r'^hived_filter_latency_seconds_bucket\{le="\+Inf"\} 1$', body, re.M
+    )
+    assert re.search(r"^hived_filter_latency_seconds_count 1$", body, re.M)
+    assert re.search(
+        r'^hived_lock_wait_seconds_total\{chain="[^"]+"\} ', body, re.M
+    )
+    assert re.search(r'^hived_phase_ops_total\{phase="coreSchedule"\} ', body, re.M)
+    # Histogram buckets are cumulative (monotone non-decreasing).
+    cums = [
+        int(m.group(1))
+        for m in re.finditer(
+            r'^hived_filter_latency_seconds_bucket\{le="[^+"]+"\} (\d+)$',
+            body, re.M,
+        )
+    ]
+    assert cums == sorted(cums)
+
+
+def test_metrics_scrape_never_enters_chain_lock_order(server):
+    """ISSUE 6 acceptance: scrape /metrics while a thread HOLDS the global
+    (all-chain) lock mode — the scrape must complete anyway, because the
+    exposition path takes no chain lock. A regression that re-introduces
+    a chain-lock acquisition deadlocks-then-times-out here."""
+    sched = server.scheduler
+    pod = make_pod("m1-0", "um1", "VC1", 0, "v5e-chip", 4, group=gang("gm1", 1, 4))
+    assert filter_pod(sched, pod).node_names
+
+    entered = threading.Event()
+    release = threading.Event()
+
+    def hold_global():
+        with sched._lock:  # the all-chains global mode
+            entered.set()
+            release.wait(30)
+
+    holder = threading.Thread(target=hold_global, daemon=True)
+    holder.start()
+    assert entered.wait(5)
+    try:
+        t0 = time.monotonic()
+        status, _, body = http_get(server, constants.PROMETHEUS_PATH, timeout=10)
+        elapsed = time.monotonic() - t0
+        assert status == 200
+        assert "hived_filter_requests_total" in body
+        # Well under the timeout: the scrape never queued on a chain lock.
+        assert elapsed < 5.0, elapsed
+        # The JSON twin shares the same lock-free path.
+        status2, _, body2 = http_get(
+            server, constants.INSPECT_PATH + "/metrics", timeout=10
+        )
+        assert status2 == 200 and "filterCount" in body2
+    finally:
+        release.set()
+        holder.join(5)
+
+
+def test_decisions_and_traces_http_endpoints(server):
+    sched = server.scheduler
+    pod = make_pod("m2-0", "um2", "VC1", 0, "v5e-chip", 4, group=gang("gm2", 1, 4))
+    assert filter_pod(sched, pod).node_names
+    status, _, body = http_get(server, constants.DECISIONS_PATH + "?n=1")
+    assert status == 200
+    items = json.loads(body)["items"]
+    assert len(items) == 1 and items[0]["verdict"] == "bind"
+    status, _, body = http_get(server, constants.DECISIONS_PATH + "/um2")
+    assert status == 200 and json.loads(body)["uid"] == "um2"
+    status, _, body = http_get(
+        server, constants.DECISIONS_PATH + "/" + pod.key
+    )
+    assert status == 200 and json.loads(body)["uid"] == "um2"
+    status, _, body = http_get(server, constants.TRACES_PATH + "?n=5")
+    assert status == 200
+    payload = json.loads(body)
+    assert payload["sample"] == 1.0 and payload["items"]
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        http_get(server, constants.DECISIONS_PATH + "/nope")
+    assert exc.value.code == 404
+
+
+# --------------------------------------------------------------------- #
+# 5. Golden metrics schema: code <-> /metrics <-> doc, both directions
+# --------------------------------------------------------------------- #
+
+
+def test_golden_metrics_schema(server):
+    sched = server.scheduler
+    pod = make_pod("m3-0", "um3", "VC1", 0, "v5e-chip", 4, group=gang("gm3", 1, 4))
+    assert filter_pod(sched, pod).node_names
+    _, _, body = http_get(server, constants.PROMETHEUS_PATH)
+    scraped = set(re.findall(r"^(hived_[a-z0-9_]+)(?:\{| )", body, re.M))
+    scraped |= set(re.findall(r"^# TYPE (hived_[a-z0-9_]+) ", body, re.M))
+    with open(DOC_PATH) as f:
+        doc_names = set(re.findall(r"\bhived_[a-z0-9_]+\b", f.read()))
+
+    registered = set(prometheus.metric_names())
+    hist_names = {name for name, _ in prometheus.HISTOGRAMS.values()}
+
+    def base(name):
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in hist_names:
+                return name[: -len(suffix)]
+        return name
+
+    # Direction 1: everything the renderer can emit is served AND
+    # documented.
+    missing_scrape = registered - {base(n) for n in scraped}
+    assert not missing_scrape, f"registered but not in /metrics: {missing_scrape}"
+    missing_doc = registered - {base(n) for n in doc_names}
+    assert not missing_doc, f"registered but undocumented: {missing_doc}"
+
+    # Direction 2: the doc names nothing the code cannot emit.
+    phantom = {base(n) for n in doc_names} - registered
+    assert not phantom, f"documented but not emitted: {phantom}"
+
+    # Direction 3: every numeric key the snapshot emits is registered or
+    # consciously excluded — a counter added to SchedulerMetrics without
+    # registry+doc updates fails here.
+    snap = sched.get_metrics()
+    unregistered = {
+        k
+        for k, v in snap.items()
+        if k not in prometheus.EXCLUDED_KEYS
+        and k not in prometheus.COUNTERS
+        and k not in prometheus.GAUGES
+    }
+    assert not unregistered, (
+        f"get_metrics keys neither registered nor excluded: {unregistered}"
+    )
+    # And the structured keys the renderer consumes stay present.
+    for k in ("phases", "lockWaitByChain", "latencyHistograms"):
+        assert k in snap
+
+
+# --------------------------------------------------------------------- #
+# 6. Chaos-harness decision artifacts
+# --------------------------------------------------------------------- #
+
+
+def test_chaos_invariant_failure_dumps_decision_artifact(tmp_path, monkeypatch):
+    """A failing chaos seed dumps the scheduler's decision journal (+
+    traces + metrics) as a per-seed artifact and appends the path to the
+    assertion (hack/soak.sh --keep-decisions keeps the directory)."""
+    from . import chaos
+
+    monkeypatch.setenv("HIVED_CHAOS_ARTIFACT_DIR", str(tmp_path))
+    harness = chaos.ChaosHarness(3)
+
+    def exploding_run(self, n_events=None):
+        raise AssertionError("synthetic invariant failure")
+
+    monkeypatch.setattr(chaos.ChaosHarness, "run", exploding_run)
+    monkeypatch.setattr(chaos, "ChaosHarness", lambda seed: harness)
+    with pytest.raises(AssertionError) as exc:
+        chaos.run_chaos_schedule(3)
+    dump = tmp_path / "chaos-seed3-decisions.json"
+    assert dump.exists()
+    assert str(dump) in str(exc.value)
+    payload = json.loads(dump.read_text())
+    assert payload["seed"] == 3
+    assert "decisions" in payload and "metrics" in payload
+
+
+# --------------------------------------------------------------------- #
+# 7. Lock-free stranded gauge + preempt/bind histograms
+# --------------------------------------------------------------------- #
+
+
+def test_stranded_gauge_tracks_health_and_group_lifecycle():
+    sched = new_scheduler()
+    pod = make_pod("s0-0", "us0", "A", 0, "v5e-chip", 4, group=gang("gs", 1, 4))
+    r = filter_pod(sched, pod)
+    assert r.node_names
+    node = r.node_names[0]
+    mark_chip_bad(sched, node)
+    sched.settle_health_now()
+    assert sched.get_metrics()["strandedGroupCount"] == 1
+    # Group death drops out of the gauge without a health transition.
+    sched.delete_pod(sched.pod_schedule_statuses["us0"].pod)
+    assert sched.get_metrics()["strandedGroupCount"] == 0
+
+
+def test_preempt_and_bind_histograms_observe():
+    sched = new_scheduler()
+    pod = make_pod("hb-0", "uhb", "A", 0, "v5e-chip", 4, group=gang("ghb", 1, 4))
+    assert filter_pod(sched, pod).node_names
+    sched.bind_routine(
+        ei.ExtenderBindingArgs(
+            pod_name="hb-0", pod_namespace="default", pod_uid="uhb",
+            node=sched.pod_schedule_statuses["uhb"].pod.node_name,
+        )
+    )
+    waiter = make_pod("hw-0", "uhw", "B", 5, "v5e-chip", 4, group=gang("ghw", 1, 4))
+    sched.add_pod(waiter)
+    sched.preempt_routine(
+        ei.ExtenderPreemptionArgs(pod=waiter, node_name_to_meta_victims={})
+    )
+    hists = sched.get_metrics()["latencyHistograms"]
+    assert hists["bind"]["count"] == 1
+    assert hists["preempt"]["count"] == 1
+    assert hists["filter"]["count"] == 1
