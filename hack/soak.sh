@@ -9,7 +9,7 @@
 #
 #   HIVED_CHAOS_ROUNDS=5000 HIVED_CHAOS_START=10000 hack/soak.sh
 #
-# Defaults: 2000 seeds starting at 220 (past the tier-1 range 0..219, so a
+# Defaults: 2000 seeds starting at 300 (past the tier-1 range 0..299, so a
 # soak always covers fresh seeds). Any invariant violation fails the run
 # with the seed in the assertion. Fuzz-harness soaks live in hack/soak.py.
 #
@@ -19,6 +19,12 @@
 # custom mix can be passed directly: HIVED_CHAOS_MIX="health:3" hack/soak.sh
 # (see tests/chaos.py event_weights for the knob grammar).
 #
+# Failover focus: --failover weights the HA / snapshot recovery family up
+# (snapshot flushes, snapshot corruption/staleness, lease failovers incl.
+# lease-loss-mid-bind) via the "ha" alias of HIVED_CHAOS_MIX, so a soak
+# hammers snapshot+delta recovery equivalence and the split-brain fence
+# specifically: hack/soak.sh --failover  (combines with --keep-decisions).
+#
 # Decision-journal artifacts: --keep-decisions [DIR] (first argument) keeps
 # the per-seed decision-journal dump a failing seed writes (the scheduler's
 # /v1/inspect/decisions ring + trace ring + metrics at the moment the
@@ -26,6 +32,16 @@
 # ./chaos-artifacts; the dump path is appended to the failing assertion.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--failover" ]]; then
+  shift
+  # Weight the whole HA/snapshot family up (and crash-restarts a bit) so
+  # most schedules exercise failovers + snapshot recoveries; the preset
+  # goes FIRST so caller-supplied entries (parsed later — last direct
+  # entry wins per event in event_weights) can still override it.
+  export HIVED_CHAOS_MIX="ha:4,crash_restart:2${HIVED_CHAOS_MIX:+,${HIVED_CHAOS_MIX}}"
+  echo "chaos soak: failover focus (HIVED_CHAOS_MIX=${HIVED_CHAOS_MIX})"
+fi
 
 if [[ "${1:-}" == "--keep-decisions" ]]; then
   shift
@@ -40,7 +56,7 @@ if [[ "${1:-}" == "--keep-decisions" ]]; then
 fi
 
 export HIVED_CHAOS_ROUNDS="${HIVED_CHAOS_ROUNDS:-2000}"
-export HIVED_CHAOS_START="${HIVED_CHAOS_START:-220}"
+export HIVED_CHAOS_START="${HIVED_CHAOS_START:-300}"
 export JAX_PLATFORMS=cpu
 
 if [[ "${HIVED_CHAOS_SWEEP:-0}" == "1" ]]; then

@@ -59,6 +59,14 @@ def main(argv=None) -> int:
         "(for simulation/e2e harnesses)",
     )
     parser.add_argument(
+        "--ha",
+        action="store_true",
+        default=os.environ.get("HIVED_HA", "") == "1",
+        help="active-standby mode: hold off on a coordination.k8s.io Lease, "
+        "recover and serve only while leading; /readyz is 503 on the "
+        "standby (doc/fault-model.md 'HA and snapshot recovery plane')",
+    )
+    parser.add_argument(
         "--validate-config",
         action="store_true",
         help="compile the config (cell chains, physical cells, VC quotas "
@@ -101,10 +109,57 @@ def main(argv=None) -> int:
         # errors are retried with backoff; terminal 404/409 failures release
         # the assume-bind allocation (doc/fault-model.md).
         scheduler.kube_client = RetryingKubeClient(client, scheduler=scheduler)
-        # Recovery completes before we accept scheduling requests
-        # (reference: scheduler.go:200-212); /readyz turns 200 when the
-        # informer's initial replay is done.
-        InformerLoop(scheduler, client).start()
+        informer = InformerLoop(scheduler, client)
+        if args.ha:
+            from .scheduler.ha import LeaderElector, StandbyLoop
+
+            # Epoch-seconds clock: the Lease's acquire/renew MicroTimes
+            # must be comparable across processes, so wall clock — not
+            # monotonic (kube.KubeAPIClient translates to/from MicroTime).
+            elector = LeaderElector(
+                scheduler.kube_client,
+                identity=os.environ.get("HOSTNAME") or f"hived-{os.getpid()}",
+                duration_s=config.lease_duration_seconds,
+                renew_s=config.lease_renew_seconds,
+                clock=time.time,
+            )
+            scheduler.leadership = elector
+
+            def on_started_leading() -> None:
+                # Recovery (snapshot + delta replay via the informer's
+                # initial relist) runs at the moment of acquisition;
+                # /readyz flips 200 only after it completes AND we lead.
+                informer.start()
+                scheduler.start_snapshot_flusher()
+
+            def on_stopped_leading() -> None:
+                # Deposed: the framework already fences bind writes; exit
+                # so the supervisor restarts us into a clean standby
+                # (half-recovered state must not linger).
+                common.log.error(
+                    "leadership lost; exiting for restart into standby"
+                )
+                os._exit(1)
+
+            def on_standby_beat() -> None:
+                # Hot standby: decode AND restore the latest snapshot into
+                # this process's core on every idle beat, so takeover skips
+                # both the JSON decode and the projection restore — the
+                # failover blackout is just the delta replay.
+                scheduler.prefetch_snapshot(apply=True)
+
+            StandbyLoop(
+                elector,
+                on_started_leading,
+                on_stopped_leading,
+                on_standby_beat=on_standby_beat,
+            ).start()
+        else:
+            # Recovery completes before we accept scheduling requests
+            # (reference: scheduler.go:200-212); /readyz turns 200 when the
+            # informer's initial replay is done.
+            informer.start()
+            scheduler.start_snapshot_flusher()
 
     server = WebServer(scheduler)
     server.start()

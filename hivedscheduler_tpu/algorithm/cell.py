@@ -186,6 +186,7 @@ class PhysicalCell(Cell):
         "split",
         "pinned",
         "draining",
+        "binding_reg",
     )
 
     def __init__(self, *args, **kwargs):
@@ -205,6 +206,11 @@ class PhysicalCell(Cell):
         # to healthiness — a drained chip is fine hardware being emptied for
         # maintenance, so it must not enter the bad-free / doomed accounting.
         self.draining = False
+        # Live binding registry (HivedCore.bound_physical): address -> bound
+        # physical cell, kept current by set_virtual_cell so the snapshot
+        # plane can enumerate/clear bindings without walking the cell trees.
+        # None on cells not owned by a core (unit-test fixtures).
+        self.binding_reg: Optional[Dict[api.CellAddress, "PhysicalCell"]] = None
 
     def set_physical_resources(
         self, nodes: List[str], leaf_cell_indices: List[int]
@@ -309,6 +315,12 @@ class PhysicalCell(Cell):
     def set_virtual_cell(self, cell: Optional["VirtualCell"]) -> None:
         self.virtual_cell = cell
         self._bump_epoch()
+        reg = self.binding_reg
+        if reg is not None:
+            if cell is not None:
+                reg[self.address] = self
+            else:
+                reg.pop(self.address, None)
 
 
 class VirtualCell(Cell):

@@ -64,11 +64,28 @@ from .group import (
     virtual_to_physical_placement,
 )
 from .intra_vc import IntraVCScheduler, SchedulingRequest
-from .placement import PhaseStats, TopologyAwareScheduler
+from .placement import (
+    PhaseStats,
+    TopologyAwareScheduler,
+    _ancestor_no_higher_than_node,
+)
 
 ###############################################################################
 # Free-standing helpers (reference: pkg/algorithm/utils.go)
 ###############################################################################
+
+
+def _placement_node_anchors(placement: Placement) -> Set[api.CellAddress]:
+    """The node-anchor addresses a (virtual) placement lands on — the unit
+    the mapping-retry exclusion works in (see
+    HivedCore._schedule_guaranteed_group and placement._find_nodes_for_pods)."""
+    anchors: Set[api.CellAddress] = set()
+    for pod_placements in placement.values():
+        for row in pod_placements:
+            for leaf in row:
+                if leaf is not None:
+                    anchors.add(_ancestor_no_higher_than_node(leaf).address)
+    return anchors
 
 
 def in_free_cell_list(c: PhysicalCell) -> bool:
@@ -542,7 +559,31 @@ class HivedCore:
         # — so "epoch unchanged" certifies both the mirrored inspect
         # statuses and the preempt-probe victims caches are still fresh.
         self.chain_epochs: Dict[CellChain, List[int]] = {}
+        # Snapshot-plane indexes (doc/fault-model.md "HA and snapshot
+        # recovery plane"): bound_physical is the live binding registry
+        # (address -> bound physical cell, maintained by
+        # PhysicalCell.set_virtual_cell via binding_reg) so restore can
+        # clear bindings without a tree walk, and the address indexes make
+        # export/restore_projection's address <-> cell resolution O(1);
+        # cell membership is fixed at config-compile time.
+        self.bound_physical: Dict[api.CellAddress, PhysicalCell] = {}
         self._install_epoch_refs()
+        self._phys_cell_index: Dict[api.CellAddress, PhysicalCell] = {
+            c.address: c
+            for ccl in self.full_cell_list.values()
+            for cl in ccl.levels.values()
+            for c in cl
+        }
+        self._virt_cell_index: Dict[api.CellAddress, VirtualCell] = {}
+        for vcs in self.vc_schedulers.values():
+            for ccl in vcs.non_pinned_full.values():
+                for cl in ccl.levels.values():
+                    for c in cl:
+                        self._virt_cell_index[c.address] = c
+            for ccl in vcs.pinned_cells.values():
+                for cl in ccl.levels.values():
+                    for c in cl:
+                        self._virt_cell_index[c.address] = c
         # Lock-sharding contract hook (scheduler.locks): the framework
         # installs ChainShardedLock.require_global here so the cross-chain
         # mutators below (node/chip health, drains, node deletes) ASSERT
@@ -555,6 +596,10 @@ class HivedCore:
         # Guarded by _counter_lock — chains mutate them concurrently.
         self.gang_admission_batched_count = 0
         self.preempt_probe_incremental_count = 0
+        # Guaranteed schedules that succeeded only after retrying the
+        # intra-VC placement past a failed virtual→physical mapping
+        # (chip-granular dooming fix; doc/fault-model.md).
+        self.mapping_retry_count = 0
         self._counter_lock = threading.Lock()
         # Mirrored inspect statuses (the reference maintains apiStatus
         # mirrors, hived_algorithm.go:412-437; we rebuild per chain only
@@ -596,6 +641,8 @@ class HivedCore:
                 self._node_leaf_index.setdefault(cell.nodes[0], []).append(
                     cell
                 )
+        # Lazily-filled config-static cache behind node_chip_indices().
+        self._node_chip_index: Dict[str, Set[int]] = {}
         # Opportunistic cells currently charged to each VC, for the inspect
         # API (reference: utils.go:419-452 OT virtual cells). Keyed by cell
         # address (insertion-ordered, so the inspect output order matches
@@ -743,6 +790,9 @@ class HivedCore:
 
         for chain, ccl in self.full_cell_list.items():
             install(ccl, ref(chain))
+            for cl in ccl.levels.values():
+                for c in cl:
+                    c.binding_reg = self.bound_physical
         for vcs in self.vc_schedulers.values():
             for chain, ccl in vcs.non_pinned_full.items():
                 install(ccl, ref(chain))
@@ -838,12 +888,17 @@ class HivedCore:
 
     def node_chip_indices(self, node_name: str) -> Set[int]:
         """Every chip index the config places on a node (used to expand a
-        whole-node drain into per-chip drains)."""
-        return {
-            i
-            for leaf in self._node_leaf_index.get(node_name, [])
-            for i in leaf.leaf_cell_indices
-        }
+        whole-node drain into per-chip drains). Config-static, so computed
+        once per node — the health plane consults this on every node event
+        (a relist delivers N of them)."""
+        cached = self._node_chip_index.get(node_name)
+        if cached is None:
+            cached = self._node_chip_index[node_name] = {
+                i
+                for leaf in self._node_leaf_index.get(node_name, [])
+                for i in leaf.leaf_cell_indices
+            }
+        return cached
 
     def set_bad_node(self, node_name: str) -> None:
         """(reference: hived_algorithm.go:467-481)"""
@@ -1251,6 +1306,401 @@ class HivedCore:
                 self._bump_doomed_epoch()
                 self._allocate_preassigned_cell(pc, vcn, True)
 
+    # -- snapshot projection export / restore -------------------------------
+    # (doc/fault-model.md "HA and snapshot recovery plane")
+
+    # Pristine per-cell defaults: any cell whose mutable state matches these
+    # is omitted from the export (the sparse record set) and reset to them
+    # by restore. Kept next to the export/restore pair so a new mutable
+    # field fails loudly in the golden schema test rather than silently
+    # diverging at recovery.
+    _PRISTINE_STATE = CellState.FREE
+
+    def export_projection(self) -> Dict:
+        """Serialize the core's mutable scheduling state verbatim — the
+        cell-level durable projection the chaos harness proves
+        restart-equivalent. Pure data walk under the caller's (global)
+        lock; no mutation, no I/O.
+
+        The exporter requires a NORMALIZED core: no PREEMPTING groups (so
+        no Reserving/Reserved overlays) and every ALLOCATED group anchored
+        by at least one confirmed-bound pod — the framework's flusher
+        gates on exactly that (see HivedScheduler._export_body_locked) and
+        skips the flush otherwise, so a persisted snapshot never carries
+        transient overlays a real crash would forget.
+
+        Sparse representation: only cells deviating from the pristine
+        defaults get a record, so the payload scales with allocation +
+        badness + fragmentation, not fleet size."""
+        # The two cell walks below are the flusher's main lock-held cost
+        # at fleet scale (every configured cell is visited every flush):
+        # locals are hoisted and the pristine skip is ordered cheapest-
+        # fails-first so the common (pristine) cell costs a few attribute
+        # reads, not a record build.
+        free_state = CellState.FREE
+        free_prio = FREE_PRIORITY
+        phys: Dict[str, List] = {}
+        for c in self._phys_cell_index.values():
+            used = c.used_leaf_cells_at_priority
+            if (
+                c.state is free_state
+                and c.priority == free_prio
+                and not used
+                and c.healthy
+                and not c.draining
+                and not c.split
+                and c.using_group is None
+                and c.virtual_cell is None
+                and c.unusable_leaf_num == 0
+            ):
+                continue
+            using = c.using_group
+            vcell = c.virtual_cell
+            phys[c.address] = [
+                c.state.value,
+                c.priority,
+                int(c.healthy),
+                int(c.draining),
+                int(c.split),
+                using.name if using is not None else None,
+                vcell.address if vcell is not None else None,
+                {str(p): n for p, n in used.items()},
+                c.unusable_leaf_num,
+            ]
+        virt: Dict[str, List] = {}
+        for v in self._virt_cell_index.values():
+            used = v.used_leaf_cells_at_priority
+            if (
+                v.state is free_state
+                and v.priority == free_prio
+                and not used
+                and v.healthy
+                and v.unusable_leaf_num == 0
+            ):
+                continue
+            virt[v.address] = [
+                v.state.value,
+                v.priority,
+                int(v.healthy),
+                {str(p): n for p, n in used.items()},
+                v.unusable_leaf_num,
+            ]
+
+        def dump_ccl(ccl: ChainCellList) -> Dict[str, List[str]]:
+            return {
+                str(l): [c.address for c in cl]
+                for l, cl in ccl.levels.items()
+                if len(cl)
+            }
+
+        def dump_counters(d: Dict[CellChain, Dict[CellLevel, int]]) -> Dict:
+            return {
+                str(chain): {str(l): n for l, n in per.items()}
+                for chain, per in d.items()
+            }
+
+        groups: Dict[str, Dict] = {}
+        for name, g in self.affinity_groups.items():
+            groups[name] = {
+                "spec": {
+                    "name": g.name,
+                    "members": [
+                        {"podNumber": p, "leafCellNumber": n}
+                        for n, p in sorted(g.total_pod_nums.items())
+                    ],
+                },
+                "vc": str(g.vc),
+                "lazyPreemptionEnable": bool(g.lazy_preemption_enable),
+                "priority": g.priority,
+                "state": g.state.value,
+                "ignoreSuggested": bool(g.ignore_k8s_suggested_nodes),
+                "lazyPreemptionStatus": g.lazy_preemption_status,
+                "phys": {
+                    str(n): [
+                        [c.address if c is not None else None for c in row]
+                        for row in rows
+                    ]
+                    for n, rows in g.physical_placement.items()
+                },
+                "virt": None
+                if g.virtual_placement is None
+                else {
+                    str(n): [
+                        [c.address if c is not None else None for c in row]
+                        for row in rows
+                    ]
+                    for n, rows in g.virtual_placement.items()
+                },
+            }
+        return {
+            "phys": phys,
+            "virt": virt,
+            "freeLists": {
+                str(chain): dump_ccl(ccl)
+                for chain, ccl in self.free_cell_list.items()
+            },
+            "badFree": {
+                str(chain): dump_ccl(ccl)
+                for chain, ccl in self.bad_free_cells.items()
+            },
+            "vcDoomed": {
+                str(vcn): {
+                    str(chain): dump_ccl(ccl)
+                    for chain, ccl in per_chain.items()
+                }
+                for vcn, per_chain in self.vc_doomed_bad_cells.items()
+            },
+            "otCells": {
+                str(vcn): list(cells)
+                for vcn, cells in self._ot_cells.items()
+                if cells
+            },
+            "counters": {
+                "vcFree": {
+                    str(vcn): dump_counters(per)
+                    for vcn, per in self.vc_free_cell_num.items()
+                },
+                "allVCFree": dump_counters(self.all_vc_free_cell_num),
+                "totalLeft": dump_counters(self.total_left_cell_num),
+                "allVCDoomed": dump_counters(self.all_vc_doomed_bad_cell_num),
+            },
+            "groups": groups,
+        }
+
+    def restore_projection(
+        self,
+        core_body: Dict,
+        health: Optional[Dict] = None,
+        live_node_names: Optional[Set[str]] = None,
+    ) -> None:
+        """Reinstate an exported projection by direct field assignment —
+        the O(delta) recovery fast path. Every mutable field of every cell
+        is reset to its pristine default, then the sparse records, lists,
+        counters, and groups are applied wholesale; derived caches (chain
+        epochs, cluster views, mirrored statuses) are invalidated at the
+        end, so the result does not depend on the core's prior state.
+
+        ``live_node_names`` normalizes nodes the cluster no longer has: a
+        configured node absent from the live list is marked bad, exactly
+        the state full replay leaves it in (the constructor's bootstrap
+        badness never healed by a node event).
+
+        The caller (framework.import_snapshot) wraps any failure here in a
+        wholesale reset + full annotation replay — a half-restored core is
+        never served."""
+        phys_recs = core_body.get("phys") or {}
+        virt_recs = core_body.get("virt") or {}
+        free = CellState.FREE
+        for addr, c in self._phys_cell_index.items():
+            if addr in phys_recs:
+                continue  # every field overwritten by its record below
+            c.state = free
+            c.priority = FREE_PRIORITY
+            c.healthy = True
+            c.draining = False
+            c.split = False
+            c.using_group = None
+            c.reserving_or_reserved_group = None
+            c.virtual_cell = None
+            c.unusable_leaf_num = 0
+            if c.used_leaf_cells_at_priority:
+                c.used_leaf_cells_at_priority.clear()
+        for addr, v in self._virt_cell_index.items():
+            if addr in virt_recs:
+                continue
+            v.state = free
+            v.priority = FREE_PRIORITY
+            v.healthy = True
+            v.physical_cell = None
+            v.unusable_leaf_num = 0
+            if v.used_leaf_cells_at_priority:
+                v.used_leaf_cells_at_priority.clear()
+        self.bound_physical.clear()
+
+        # Groups first (no cell pointers yet) so the physical records can
+        # resolve using-group names.
+        self.affinity_groups = {}
+        groups = self.affinity_groups
+        for name, rec in (core_body.get("groups") or {}).items():
+            g = AffinityGroup(
+                api.AffinityGroupSpec.from_dict(rec["spec"]),
+                rec["vc"],
+                bool(rec["lazyPreemptionEnable"]),
+                int(rec["priority"]),
+                GroupState(rec["state"]),
+                init_placements=False,
+            )
+            g.ignore_k8s_suggested_nodes = bool(rec["ignoreSuggested"])
+            g.lazy_preemption_status = rec["lazyPreemptionStatus"]
+            g.physical_placement = {
+                int(n): [
+                    [
+                        self._phys_cell_index[a] if a is not None else None
+                        for a in row
+                    ]
+                    for row in rows
+                ]
+                for n, rows in rec["phys"].items()
+            }
+            g.virtual_placement = (
+                None
+                if rec["virt"] is None
+                else {
+                    int(n): [
+                        [
+                            self._virt_cell_index[a] if a is not None else None
+                            for a in row
+                        ]
+                        for row in rows
+                    ]
+                    for n, rows in rec["virt"].items()
+                }
+            )
+            groups[name] = g
+
+        # Record-covered cells skipped the reset above, so every mutable
+        # field is assigned here unconditionally. (Virtual physical_cell
+        # back-pointers are derived from the physical records' bindings —
+        # record-covered virtual cells get theirs cleared first.)
+        state_by_value = {s.value: s for s in CellState}
+        for addr in virt_recs:
+            self._virt_cell_index[addr].physical_cell = None
+        for addr, rec in phys_recs.items():
+            c = self._phys_cell_index[addr]
+            c.state = state_by_value[rec[0]]
+            c.priority = rec[1]
+            c.healthy = bool(rec[2])
+            c.draining = bool(rec[3])
+            c.split = bool(rec[4])
+            c.using_group = groups[rec[5]] if rec[5] is not None else None
+            c.reserving_or_reserved_group = None
+            if rec[6] is not None:
+                v = self._virt_cell_index[rec[6]]
+                c.virtual_cell = v
+                v.physical_cell = c
+                self.bound_physical[addr] = c
+            else:
+                c.virtual_cell = None
+            c.used_leaf_cells_at_priority = {
+                int(p): n for p, n in rec[7].items()
+            }
+            c.unusable_leaf_num = rec[8]
+        for addr, rec in virt_recs.items():
+            v = self._virt_cell_index[addr]
+            v.state = state_by_value[rec[0]]
+            v.priority = rec[1]
+            v.healthy = bool(rec[2])
+            v.used_leaf_cells_at_priority = {
+                int(p): n for p, n in rec[3].items()
+            }
+            v.unusable_leaf_num = rec[4]
+
+        # Free / bad-free / doomed listings, rebuilt wholesale. Iteration
+        # order is rebuilt in config_order — the compile traversal stamp
+        # placement already uses as its only tiebreak (doc/hot-path.md),
+        # so list order carries no scheduling meaning to preserve.
+        def fill_ccl(ccl: ChainCellList, dumped: Dict) -> None:
+            for l in ccl.levels:
+                lst = ccl.levels[l]
+                if len(lst):
+                    ccl.levels[l] = type(lst)()
+            for l, addrs in (dumped or {}).items():
+                cells = [self._phys_cell_index[a] for a in addrs]
+                cells.sort(key=lambda c: c.config_order)
+                for c in cells:
+                    ccl[int(l)].append(c)
+
+        free_dump = core_body.get("freeLists") or {}
+        for chain, ccl in self.free_cell_list.items():
+            fill_ccl(ccl, free_dump.get(str(chain)))
+        bad_free_dump = core_body.get("badFree") or {}
+        for chain, ccl in self.bad_free_cells.items():
+            fill_ccl(ccl, bad_free_dump.get(str(chain)))
+        doomed_dump = core_body.get("vcDoomed") or {}
+        for vcn, per_chain in self.vc_doomed_bad_cells.items():
+            vc_dump = doomed_dump.get(str(vcn)) or {}
+            for chain, ccl in per_chain.items():
+                fill_ccl(ccl, vc_dump.get(str(chain)))
+        self._ot_cells = {}
+        for vcn, addrs in (core_body.get("otCells") or {}).items():
+            self._ot_cells[vcn] = {
+                a: self._phys_cell_index[a] for a in addrs
+            }
+
+        counters = core_body.get("counters") or {}
+
+        def fill_counters(
+            target: Dict[CellChain, Dict[CellLevel, int]], dumped: Dict
+        ) -> None:
+            for chain in list(target):
+                per = (dumped or {}).get(str(chain)) or {}
+                target[chain] = {int(l): n for l, n in per.items()}
+
+        for vcn in list(self.vc_free_cell_num):
+            fill_counters(
+                self.vc_free_cell_num[vcn],
+                (counters.get("vcFree") or {}).get(str(vcn)),
+            )
+        fill_counters(self.all_vc_free_cell_num, counters.get("allVCFree"))
+        fill_counters(self.total_left_cell_num, counters.get("totalLeft"))
+        fill_counters(
+            self.all_vc_doomed_bad_cell_num, counters.get("allVCDoomed")
+        )
+
+        # Health plane records (applied badness and drains, the same
+        # snapshot moment as the cell healthy/draining flags above).
+        health = health or {}
+        self.bad_nodes = set(health.get("badNodes") or ())
+        self.bad_chips = {
+            n: set(chips)
+            for n, chips in (health.get("badChips") or {}).items()
+            if chips
+        }
+        self.draining_chips = {
+            n: set(chips)
+            for n, chips in (health.get("drainingChips") or {}).items()
+            if chips
+        }
+
+        # Derived caches cannot be trusted after raw field assignment:
+        # every chain epoch moves (mirrored statuses, victims caches) and
+        # every cluster view re-scores wholesale at its next schedule call.
+        for ref in self.chain_epochs.values():
+            ref[0] += 1
+        self._phys_status_cache.clear()
+        self._vc_status_cache.clear()
+        for sched in self._all_topology_schedulers():
+            sched.invalidate_all()
+
+        # Nodes the live cluster no longer has stay bad — full replay never
+        # heals them out of the constructor's bootstrap badness. Runs last,
+        # through the ordinary mutators, on the now-consistent state.
+        if live_node_names is not None:
+            for n in self.configured_node_names():
+                if n not in live_node_names:
+                    self.set_bad_node(n)
+
+    def _all_topology_schedulers(self) -> List[TopologyAwareScheduler]:
+        out: List[TopologyAwareScheduler] = list(
+            self.opportunistic_schedulers.values()
+        )
+        for vcs in self.vc_schedulers.values():
+            out.extend(vcs._chain_schedulers.values())
+            out.extend(vcs._pinned_schedulers.values())
+        return out
+
+    def attach_restored_pod(
+        self, group_name: str, leaf_cell_number: int, pod_index: int, pod: Pod
+    ) -> None:
+        """Slot a snapshot-imported pod into its restored group — the
+        decode-free counterpart of _add_allocated_pod's slot assignment
+        (the cell state was already restored verbatim)."""
+        group = self.affinity_groups[group_name]
+        group.allocated_pods[leaf_cell_number][pod_index] = pod
+        chain = group_chain(group)
+        if chain is not None:
+            self.bump_chain_epoch(chain)
+
     # -- scheduling ---------------------------------------------------------
 
     def schedule(
@@ -1582,50 +2032,97 @@ class HivedCore:
         physical, failed_reason = self._schedule_opportunistic_group(sr)
         return physical, None, failed_reason
 
+    # Bound on the intra-VC → physical mapping retry loop below: each retry
+    # excludes at least one more node anchor, so the loop terminates on its
+    # own; the cap keeps the worst case (every anchor unmappable on a large
+    # VC) from turning one filter call into O(fleet) failed mappings.
+    MAPPING_RETRY_LIMIT = 16
+
     def _schedule_guaranteed_group(
         self, sr: SchedulingRequest
     ) -> Tuple[Optional[Placement], Optional[Placement], str]:
         """Intra-VC placement, then map it onto the physical cluster with
-        buddy allocation (reference: hived_algorithm.go:900-942)."""
-        virtual, failed_reason = self.vc_schedulers[sr.vc].schedule(sr)
-        if virtual is None:
-            return None, None, failed_reason
-        bindings: Dict[api.CellAddress, PhysicalCell] = {}
-        leaf_cell_nums = sorted(sr.affinity_group_pod_nums)
-        lazy_preempted = self._try_lazy_preempt(
-            virtual, leaf_cell_nums, sr.affinity_group_name
-        )
-        preassigned, non_preassigned = build_binding_paths(
-            virtual, leaf_cell_nums, bindings
-        )
-        chain = sr.chain or (
-            next(iter(virtual.values()))[0][0].chain if virtual else ""
-        )
-        free_cell_num_copy = dict(self.all_vc_free_cell_num.get(chain, {}))
-        ok = allocation.map_virtual_placement_to_physical(
-            preassigned,
-            non_preassigned,
-            self.free_cell_list[chain].shallow_copy(),
-            free_cell_num_copy,
-            sr.suggested_nodes,
-            sr.ignore_suggested_nodes,
-            bindings,
-        )
-        if ok:
-            return (
-                virtual_to_physical_placement(virtual, bindings, leaf_cell_nums),
-                virtual,
-                "",
+        buddy allocation (reference: hived_algorithm.go:900-942).
+
+        The mapping is retried through the NEXT virtual placement when it
+        fails: the intra-VC scheduler cannot see everything the mapping
+        enforces (an unbound virtual cell has no node identity to check
+        against the suggested set, and buddy allocation may find no free
+        physical cell for it), so its first choice can be unmappable while
+        an alternative — typically a doomed-bad binding whose healthy chips
+        still serve sub-host work (ROADMAP "chip-granular dooming") — would
+        map fine. Each failed attempt's node anchors are excluded and the
+        virtual placement re-run, bounded by MAPPING_RETRY_LIMIT; the
+        reference (and the pre-fix code) returned the failure verbatim,
+        waiting forever on capacity it actually had."""
+        avoid: Set[api.CellAddress] = set()
+        failed_reason = ""
+        for attempt in range(self.MAPPING_RETRY_LIMIT):
+            virtual, vc_failed_reason = self.vc_schedulers[sr.vc].schedule(
+                sr, avoid_anchors=avoid or None
             )
-        for group_name, placement in lazy_preempted.items():
-            self._revert_lazy_preempt(self.affinity_groups[group_name], placement)
-        failed_node_type = (
-            "bad" if sr.ignore_suggested_nodes else "bad or non-suggested"
-        )
-        return None, None, (
-            f"Mapping the virtual placement would need to use at least one "
-            f"{failed_node_type} node"
-        )
+            if virtual is None:
+                # Out of virtual alternatives: report the FIRST mapping
+                # failure when there was one (the virtual-space reason of a
+                # retry attempt — "insufficient capacity" with half the
+                # anchors excluded — would be misleading).
+                return None, None, failed_reason or vc_failed_reason
+            bindings: Dict[api.CellAddress, PhysicalCell] = {}
+            leaf_cell_nums = sorted(sr.affinity_group_pod_nums)
+            lazy_preempted = self._try_lazy_preempt(
+                virtual, leaf_cell_nums, sr.affinity_group_name
+            )
+            preassigned, non_preassigned = build_binding_paths(
+                virtual, leaf_cell_nums, bindings
+            )
+            chain = sr.chain or (
+                next(iter(virtual.values()))[0][0].chain if virtual else ""
+            )
+            free_cell_num_copy = dict(self.all_vc_free_cell_num.get(chain, {}))
+            ok = allocation.map_virtual_placement_to_physical(
+                preassigned,
+                non_preassigned,
+                self.free_cell_list[chain].shallow_copy(),
+                free_cell_num_copy,
+                sr.suggested_nodes,
+                sr.ignore_suggested_nodes,
+                bindings,
+            )
+            if ok:
+                if attempt > 0:
+                    with self._counter_lock:
+                        self.mapping_retry_count += 1
+                    rec = self._decision_rec()
+                    if rec is not None:
+                        rec.note(
+                            f"virtual placement retried {attempt}x after "
+                            f"mapping failures (anchors avoided: "
+                            f"{sorted(str(a) for a in avoid)})"
+                        )
+                return (
+                    virtual_to_physical_placement(
+                        virtual, bindings, leaf_cell_nums
+                    ),
+                    virtual,
+                    "",
+                )
+            for group_name, placement in lazy_preempted.items():
+                self._revert_lazy_preempt(
+                    self.affinity_groups[group_name], placement
+                )
+            if not failed_reason:
+                failed_node_type = (
+                    "bad" if sr.ignore_suggested_nodes else "bad or non-suggested"
+                )
+                failed_reason = (
+                    f"Mapping the virtual placement would need to use at "
+                    f"least one {failed_node_type} node"
+                )
+            new_anchors = _placement_node_anchors(virtual)
+            if not new_anchors - avoid:
+                break  # no new exclusion possible: a retry would loop
+            avoid |= new_anchors
+        return None, None, failed_reason
 
     def _try_lazy_preempt(
         self, virtual: Placement, leaf_cell_nums: List[int], group_name: str

@@ -45,6 +45,7 @@ class AffinityGroup:
         lazy_preemption_enable: bool,
         priority: int,
         state: GroupState,
+        init_placements: bool = True,
     ):
         self.name = spec.name
         self.vc = vc
@@ -70,12 +71,20 @@ class AffinityGroup:
             n: [None] * p for n, p in self.total_pod_nums.items()
         }
         self.preempting_pods: Dict[str, Any] = {}
-        self.physical_placement: Placement = {
-            n: [[None] * n for _ in range(p)] for n, p in self.total_pod_nums.items()
-        }
-        self.virtual_placement: Placement = {
-            n: [[None] * n for _ in range(p)] for n, p in self.total_pod_nums.items()
-        }
+        # Snapshot restore assigns complete placements wholesale
+        # (init_placements=False skips building matrices it would discard).
+        if init_placements:
+            self.physical_placement: Placement = {
+                n: [[None] * n for _ in range(p)]
+                for n, p in self.total_pod_nums.items()
+            }
+            self.virtual_placement: Placement = {
+                n: [[None] * n for _ in range(p)]
+                for n, p in self.total_pod_nums.items()
+            }
+        else:
+            self.physical_placement = {}
+            self.virtual_placement = {}
         self.state = state
         self.lazy_preemption_status: Optional[Dict[str, Any]] = None
         # Memoized group-level bind info (core.generate_affinity_group_bind_info):

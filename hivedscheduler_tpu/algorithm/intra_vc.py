@@ -65,9 +65,15 @@ class IntraVCScheduler:
         }
 
     def schedule(
-        self, sr: SchedulingRequest
+        self,
+        sr: SchedulingRequest,
+        avoid_anchors: Optional[Set] = None,
     ) -> Tuple[Optional[Dict[int, List[List[Cell]]]], str]:
-        """(reference: intra_vc_scheduler.go:92-117)"""
+        """(reference: intra_vc_scheduler.go:92-117)
+
+        ``avoid_anchors`` is the virtual→physical mapping-retry exclusion
+        (node-anchor addresses whose mapping already failed this request);
+        see TopologyAwareScheduler.schedule."""
         if sr.pinned_cell_id:
             scheduler = self._pinned_schedulers.get(sr.pinned_cell_id)
             target = f"pinned cell {sr.pinned_cell_id}"
@@ -87,6 +93,7 @@ class IntraVCScheduler:
                 sr.priority,
                 sr.suggested_nodes,
                 sr.ignore_suggested_nodes,
+                avoid_anchors=avoid_anchors,
             )
         if placement is None:
             return None, f"{failed_reason} when scheduling in VC {sr.vc}"

@@ -222,6 +222,14 @@ class TopologyAwareScheduler:
     def bump_binding_stamp(self) -> None:
         self._binding_stamp += 1
 
+    def invalidate_all(self) -> None:
+        """Wholesale invalidation: every anchor re-scores at the next
+        schedule call. The snapshot restore rewrites cell state with direct
+        field assignments (no mutator hooks), so the incremental dirty
+        marks cannot be trusted afterwards."""
+        self._dirty.update(self._views_by_addr)
+        self._binding_stamp += 1
+
     def _register_view(self) -> None:
         """Give every node anchor (and its ancestors) a back-pointer so cell
         mutations can invalidate exactly the views they affect."""
@@ -318,6 +326,7 @@ class TopologyAwareScheduler:
         priority: CellPriority,
         suggested_nodes: Optional[Set[str]] = None,
         ignore_suggested_nodes: bool = True,
+        avoid_anchors: Optional[Set[api.CellAddress]] = None,
     ) -> Tuple[Optional[Dict[int, List[List[Cell]]]], str]:
         """Place all pods of a gang; returns (placement, "") or
         (None, failure reason) (reference: topology_aware_scheduler.go:65-115).
@@ -327,6 +336,12 @@ class TopologyAwareScheduler:
         lower-priority cells to be treated as free (preemption). The retry is
         the only second view refresh — and with the parameter cache it costs
         nothing when the gang priority IS opportunistic.
+
+        ``avoid_anchors`` excludes specific node anchors (by cell address)
+        from the greedy pick WITHOUT entering the score/sort cache — it is a
+        transient per-attempt filter used by the intra-VC → physical mapping
+        retry (core._schedule_guaranteed_group): an anchor whose mapping
+        already failed is skipped so the next-best placement gets a chance.
         """
         sorted_leaf_nums: List[int] = []
         for leaf_num, pod_num in pod_leaf_cell_numbers.items():
@@ -338,7 +353,7 @@ class TopologyAwareScheduler:
             trial_priority, suggested_nodes, ignore_suggested_nodes
         )
         picked, failed_reason = _find_nodes_for_pods(
-            self.cluster_view, sorted_leaf_nums
+            self.cluster_view, sorted_leaf_nums, avoid_anchors
         )
         if picked is None and priority > OPPORTUNISTIC_PRIORITY:
             trial_priority = priority
@@ -346,7 +361,7 @@ class TopologyAwareScheduler:
                 trial_priority, suggested_nodes, ignore_suggested_nodes
             )
             picked, failed_reason = _find_nodes_for_pods(
-                self.cluster_view, sorted_leaf_nums
+                self.cluster_view, sorted_leaf_nums, avoid_anchors
             )
         if picked is None:
             return None, failed_reason
@@ -513,7 +528,9 @@ def _node_health_and_suggested(
 
 
 def _find_nodes_for_pods(
-    view: List[_NodeView], leaf_cell_nums: List[int]
+    view: List[_NodeView],
+    leaf_cell_nums: List[int],
+    avoid_anchors: Optional[Set[api.CellAddress]] = None,
 ) -> Tuple[Optional[List[int]], str]:
     """Greedy assignment of pods (sorted by chip count) to the packed-sorted
     node list (reference: topology_aware_scheduler.go:291-337, made
@@ -522,9 +539,10 @@ def _find_nodes_for_pods(
     smaller pods instead of condemning the whole node). A node that fits
     only by counting unusable chips is skipped (recorded as the failure
     reason); a usable node outside the suggested set still fails the whole
-    attempt so the caller can fall back (relaxed split or K8s retry). The
-    caller (``_update_cluster_view``) guarantees the view is already
-    sorted."""
+    attempt so the caller can fall back (relaxed split or K8s retry).
+    Anchors in ``avoid_anchors`` (a mapping-retry exclusion, see
+    ``TopologyAwareScheduler.schedule``) are skipped outright. The caller
+    (``_update_cluster_view``) guarantees the view is already sorted."""
     picked = [0] * len(leaf_cell_nums)
     pod_index = 0
     picked_leaf_num = 0
@@ -532,6 +550,12 @@ def _find_nodes_for_pods(
     bad_reason = ""
     while node_index < len(view):
         n = view[node_index]
+        if avoid_anchors is not None and n.cell.address in avoid_anchors:
+            # Restart the current pod's packing on the next anchor: skipping
+            # mid-gang must not let the greedy run treat two anchors as one.
+            picked_leaf_num = 0
+            node_index += 1
+            continue
         needed = leaf_cell_nums[pod_index]
         if n.free_at_priority - n.unusable_free - picked_leaf_num >= needed:
             if not n.suggested:

@@ -56,6 +56,14 @@ class Config:
     # when False (default) they are only surfaced (/v1/inspect/health,
     # strandedGroupCount).
     stranded_gang_eviction: bool = False
+    # Wall-clock settling floor for the flap damper (doc/fault-model.md
+    # "Hardware health plane"): when > 0, a held transition whose target
+    # stayed quiet for this many wall-clock seconds settles even without
+    # `health_flap_hold` event ticks — a quiet cluster (no informer
+    # relist/watch-cycle traffic) settles promptly. 0 (default) keeps the
+    # event clock exclusively authoritative, which chaos schedules need
+    # for determinism.
+    health_flap_hold_seconds: float = 0.0
     # Observability plane (doc/observability.md): bounded ring sizes for
     # the decision journal (/v1/inspect/decisions — always on) and the
     # sampled trace ring (/v1/inspect/traces; the sampling RATE is the
@@ -63,6 +71,19 @@ class Config:
     # live process without a config rollout).
     decision_journal_capacity: int = 512
     trace_ring_capacity: int = 256
+    # HA / snapshot recovery plane (doc/fault-model.md "HA and snapshot
+    # recovery plane"). snapshot_interval_seconds > 0 arms the background
+    # snapshot flusher (HivedScheduler.start_snapshot_flusher) that
+    # serializes the durable projection to the scheduler-owned ConfigMap
+    # family every interval; 0 (default) disables periodic snapshots
+    # (recovery then always replays annotations — the pre-snapshot
+    # behavior). The Lease knobs govern active-standby failover: the
+    # leader renews the coordination.k8s.io Lease every
+    # lease_renew_seconds and is deposed lease_duration_seconds after its
+    # last successful renewal.
+    snapshot_interval_seconds: float = 0.0
+    lease_duration_seconds: float = 15.0
+    lease_renew_seconds: float = 5.0
     physical_cluster: api.PhysicalClusterSpec = field(
         default_factory=api.PhysicalClusterSpec
     )
@@ -78,8 +99,12 @@ class Config:
         flap_t = d.get("healthFlapThreshold")
         flap_w = d.get("healthFlapWindow")
         flap_h = d.get("healthFlapHold")
+        flap_hs = d.get("healthFlapHoldSeconds")
         dj_cap = d.get("decisionJournalCapacity")
         tr_cap = d.get("traceRingCapacity")
+        snap_s = d.get("snapshotIntervalSeconds")
+        lease_d = d.get("leaseDurationSeconds")
+        lease_r = d.get("leaseRenewSeconds")
         c = Config(
             kube_apiserver_address=d.get("kubeApiServerAddress"),
             kube_config_file_path=d.get("kubeConfigFilePath"),
@@ -94,11 +119,21 @@ class Config:
             health_flap_threshold=3 if flap_t is None else int(flap_t),
             health_flap_window=8 if flap_w is None else int(flap_w),
             health_flap_hold=4 if flap_h is None else int(flap_h),
+            health_flap_hold_seconds=(
+                0.0 if flap_hs is None else float(flap_hs)
+            ),
             stranded_gang_eviction=bool(d.get("strandedGangEviction", False)),
             decision_journal_capacity=(
                 512 if dj_cap is None else int(dj_cap)
             ),
             trace_ring_capacity=256 if tr_cap is None else int(tr_cap),
+            snapshot_interval_seconds=(
+                0.0 if snap_s is None else float(snap_s)
+            ),
+            lease_duration_seconds=(
+                15.0 if lease_d is None else float(lease_d)
+            ),
+            lease_renew_seconds=5.0 if lease_r is None else float(lease_r),
             physical_cluster=api.PhysicalClusterSpec.from_dict(
                 d.get("physicalCluster")
             ),

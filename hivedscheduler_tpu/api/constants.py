@@ -65,6 +65,25 @@ ANNOTATION_NODE_DRAIN = GROUP_NAME + "/drain"
 DOOMED_LEDGER_CONFIG_MAP_NAME = "hivedscheduler-doomed-ledger"
 DOOMED_LEDGER_CONFIG_MAP_KEY = "ledger"
 
+# The scheduler-owned ConfigMap family persisting periodic state snapshots
+# (the durable projection for O(delta) recovery; doc/fault-model.md "HA and
+# snapshot recovery plane"). The manifest ConfigMap carries the meta header
+# (schema version, checksum, chunk count) plus the first body chunk;
+# payloads past the 1 MiB ConfigMap ceiling spill into
+# "<name>-<i>" chunk ConfigMaps. The manifest is written LAST so a crash
+# mid-write leaves the previous snapshot's manifest (or a checksum
+# mismatch, which recovery treats as "no snapshot").
+SNAPSHOT_CONFIG_MAP_NAME = "hivedscheduler-snapshot"
+SNAPSHOT_META_KEY = "meta"
+SNAPSHOT_CHUNK_KEY = "chunk"
+
+# The coordination.k8s.io Lease for active-standby leader election: the
+# leader renews it every leaseRenewSeconds; a standby acquires it
+# leaseDurationSeconds after the leader's last renewal and takes over
+# (recovering via snapshot + delta replay). A deposed leader refuses bind
+# writes (doc/fault-model.md "HA and snapshot recovery plane").
+LEADER_LEASE_NAME = "hivedscheduler-leader"
+
 # Priority space (reference: api/constants.go:58-62).
 MAX_GUARANTEED_PRIORITY = 1000
 MIN_GUARANTEED_PRIORITY = 0
@@ -107,6 +126,12 @@ DECISIONS_PATH = INSPECT_PATH + "/decisions"
 # The sampled request-trace ring (spans: filter -> lock wait -> core
 # schedule -> placement descent -> bind write -> recovery cycles).
 TRACES_PATH = INSPECT_PATH + "/traces"
+
+# The HA / snapshot recovery plane: leadership (identity, leader state,
+# lease holder), the last recovery's mode (snapshot+delta vs full replay)
+# and delta counts, and snapshot persistence state. See doc/fault-model.md
+# "HA and snapshot recovery plane".
+HA_PATH = INSPECT_PATH + "/ha"
 
 # Prometheus text exposition (top-level, the conventional scrape path —
 # NOT under /v1/inspect): counters, gauges, fixed-bucket latency

@@ -20,17 +20,20 @@ informer loop (``scheduler.informer``), a test harness, or a simulator drives
 
 from __future__ import annotations
 
+import json
 import math
 import threading
 import time
-from typing import Callable, Dict, Iterable, List, Optional, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from .. import common
 from ..api import constants, extender as ei, types as api
 from ..api.config import Config
-from ..algorithm.core import HivedCore, group_chain
+from ..algorithm.core import HivedCore, get_allocated_pod_index, group_chain
+from ..algorithm.group import GroupState
 from ..algorithm.placement import PhaseStats
 from . import health as health_mod
+from . import snapshot as snapshot_mod
 from . import tracing
 from .decisions import DecisionJournal
 from .locks import ChainShardedLock
@@ -43,6 +46,7 @@ from .types import (
     PodState,
     QuarantineRecord,
     SchedulingPhase,
+    extract_pod_bind_info,
     extract_pod_scheduling_spec,
     has_pod_preempt_info,
     is_allocated_state,
@@ -86,6 +90,26 @@ class KubeClient:
     def load_scheduler_state(self) -> Optional[str]:
         """Read the scheduler-owned state blob; None when absent."""
         return None
+
+    def persist_snapshot(self, chunks: List[str]) -> None:
+        """Write a state snapshot (scheduler.snapshot chunk list: meta
+        header + body chunks) to the scheduler-owned snapshot ConfigMap
+        family. Implementations must commit the meta header LAST so a
+        crash mid-write never yields a valid-looking torn snapshot."""
+
+    def load_snapshot(self) -> Optional[List[str]]:
+        """Read the persisted snapshot chunk list; None when absent."""
+        return None
+
+    def read_lease(self) -> Optional[Dict]:
+        """Read the leader-election Lease: ``{"spec": {...},
+        "resourceVersion": ...}`` or None when absent."""
+        return None
+
+    def write_lease(self, spec: Dict, resource_version=None) -> None:
+        """Write the leader Lease spec, guarded by the optimistic
+        ``resource_version`` precondition when given (two standbys racing
+        for an expired lease must not both win)."""
 
     def evict_pod(self, pod: Pod) -> None:
         """Delete a pod (stranded-gang remediation). The informer's DELETED
@@ -147,6 +171,15 @@ class SchedulerMetrics:
         self.health_settled_count = 0
         self.ledger_coalesced_count = 0
         self.stranded_eviction_count = 0
+        # HA / snapshot recovery plane (doc/fault-model.md "HA and snapshot
+        # recovery plane"): snapshot ConfigMap writes (and failures),
+        # recoveries that fell back from a present-but-unusable snapshot to
+        # the full annotation replay, and bind writes refused because this
+        # process no longer holds the leader lease.
+        self.snapshot_persist_count = 0
+        self.snapshot_persist_failure_count = 0
+        self.snapshot_fallback_count = 0
+        self.deposed_bind_refused_count = 0
         # Framework-side phases (same accumulator/formatter as the core's
         # leaf-cell-search stats, so the merged "phases" payload is uniform).
         self.phase_stats = PhaseStats()
@@ -252,6 +285,21 @@ class SchedulerMetrics:
         with self._lock:
             self.stranded_eviction_count += 1
 
+    def observe_snapshot_persist(self, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self.snapshot_persist_count += 1
+            else:
+                self.snapshot_persist_failure_count += 1
+
+    def observe_snapshot_fallback(self) -> None:
+        with self._lock:
+            self.snapshot_fallback_count += 1
+
+    def observe_deposed_bind_refused(self) -> None:
+        with self._lock:
+            self.deposed_bind_refused_count += 1
+
     def snapshot(self) -> Dict:
         with self._lock:
             lat = sorted(self.filter_latencies_s)
@@ -290,6 +338,12 @@ class SchedulerMetrics:
                 "healthSettledCount": self.health_settled_count,
                 "doomedLedgerCoalescedCount": self.ledger_coalesced_count,
                 "strandedEvictionCount": self.stranded_eviction_count,
+                "snapshotPersistCount": self.snapshot_persist_count,
+                "snapshotPersistFailureCount": (
+                    self.snapshot_persist_failure_count
+                ),
+                "snapshotFallbackCount": self.snapshot_fallback_count,
+                "deposedBindRefusedCount": self.deposed_bind_refused_count,
                 "phases": self.phase_stats.snapshot(),
                 "latencyHistograms": {
                     "filter": self.hist_filter.snapshot(),
@@ -403,6 +457,7 @@ class HivedScheduler:
             config.health_flap_threshold,
             config.health_flap_window,
             config.health_flap_hold,
+            hold_seconds=config.health_flap_hold_seconds,
         )
         # Per-node chip targets the damper has ever been told about, so a
         # chip dropping OUT of the device-health annotation is observed as
@@ -427,6 +482,66 @@ class HivedScheduler:
         # the live group set — groups whose pods died since the last
         # refresh drop out without a walk (doc/observability.md).
         self._stranded_names: set = set()
+        # HA / snapshot recovery plane (doc/fault-model.md "HA and snapshot
+        # recovery plane"). The compiled-config fingerprint stamps every
+        # snapshot (a reconfiguration invalidates cell addresses); the
+        # watermark is the informer's last-seen resourceVersion (or the
+        # harness's event index), carried in the snapshot so recovery knows
+        # the delta floor. _snapshot_pending holds imported-but-unconfirmed
+        # pod fingerprints DURING recovery only: the delta replay pops each
+        # as the live list confirms it, and finish_recovery releases the
+        # leftovers (pods deleted while we were down). Always empty in
+        # steady state.
+        self._config_fingerprint = snapshot_mod.config_fingerprint(config)
+        self._watermark = 0
+        self._recovery_ledger: Optional[Dict] = None
+        self._snapshot_pending: Dict[str, Tuple] = {}
+        # Warm-standby decode cache: (chunk family, decoded body) of the
+        # last prefetched snapshot (see prefetch_snapshot). When the
+        # standby also PRE-APPLIED the projection into its core (hot
+        # standby), _preapplied_chunks names the chunk family the live
+        # state corresponds to, so takeover can skip the restore and run
+        # only the delta replay.
+        self._prefetched_snapshot: Optional[Tuple[List[str], Dict]] = None
+        self._preapplied_chunks: Optional[List[str]] = None
+        self._last_snapshot_chunks: Optional[List[str]] = None
+        # Imported pods released mid-replay by a claim conflict: their live
+        # events may already have been visited, so finish_recovery re-adds
+        # any that are still live (full replay admits them; losing them
+        # until the next relist would not be equivalent).
+        self._snapshot_released_uids: Set[str] = set()
+        # (chain, node, leaf-index) -> importing pod uid, for conflict
+        # detection during the delta replay (entries for since-confirmed
+        # pods go stale and are ignored via the pending-map check).
+        self._snapshot_claims: Dict[Tuple, str] = {}
+        self._snapshot_imported_count = 0
+        self._snapshot_delta_count = 0
+        self._recovery_mode = "none"
+        # True between begin_recovery and finish_recovery/_abort_recovery:
+        # per-transition stranded-gang scans are suppressed while the
+        # replay applies one transition per node (finish_recovery seeds
+        # the gauge once at the end instead).
+        self._in_recovery = False
+        # Per-pod export-record memo for the flusher: a confirmed-BOUND
+        # pod object is immutable (the informer replaces the object on any
+        # change), so its serialized snapshot record is a pure function of
+        # the object. Keyed by uid, validated by object IDENTITY — the
+        # tuple keeps a strong reference to the pod, so the id can never
+        # be recycled while the entry lives. Each entry carries both the
+        # record dict (for the body) and its serialized JSON text (for the
+        # encoder's section-assembly fast path). Rebuilt (and thereby
+        # pruned) on every export walk.
+        self._snapshot_pod_export_cache: Dict[
+            str, Tuple[Pod, Dict, str]
+        ] = {}
+        self._snapshot_write_lock = threading.Lock()
+        self._flusher_stop: Optional[threading.Event] = None
+        self._flusher_thread: Optional[threading.Thread] = None
+        # Leader-election gate (scheduler.ha.LeaderElector, or anything
+        # with is_leader()). None = HA disabled: this process is always
+        # the leader (single-scheduler deployments, tests, simulators).
+        self.leadership = None
+        self._deposed_flush_logged = False
 
     @staticmethod
     def _default_executor(fn: Callable[[], None]) -> None:
@@ -607,7 +722,27 @@ class HivedScheduler:
         ended: preempt-info annotation clears and the doomed-ledger
         ConfigMap. Both are ADVISORY (recovery fidelity, not correctness of
         the live view), so failures log and count — never raise into the
-        scheduling path."""
+        scheduling path.
+
+        A DEPOSED leader drops its queues instead of flushing: the new
+        leader owns the cluster now, and a stale annotation clear or
+        eviction could erase a checkpoint (or delete a pod) the new leader
+        just placed. Dropping is safe — every queued write is advisory."""
+        if not self.is_leader():
+            with self._side_effect_lock:
+                dropped = len(self._pending_annotation_clears) + len(
+                    self._pending_evictions
+                )
+                self._pending_annotation_clears = []
+                self._pending_evictions = []
+            if dropped and not self._deposed_flush_logged:
+                self._deposed_flush_logged = True
+                common.log.warning(
+                    "deposed: dropping %d queued advisory kube writes (the "
+                    "active leader owns the cluster state)", dropped,
+                )
+            return
+        self._deposed_flush_logged = False
         self._flush_annotation_clears()
         self._flush_evictions()
         if self._eviction_retry_pending:
@@ -692,19 +827,35 @@ class HivedScheduler:
     # Recovery (reference: scheduler.go:196-216 Run)
     # ------------------------------------------------------------------ #
 
-    def recover(self, nodes: Iterable[Node], pods: Iterable[Pod]) -> None:
-        """Replay the current cluster state before serving requests: every
-        bound hived pod re-enters via add_pod -> add_bound_pod ->
-        AddAllocatedPod, rebuilding all cell state from annotations; then
-        preempting affinity groups are replayed from the preempt-info
-        annotations their (unbound) preemptor pods carry, re-reserving
-        cells whose victims are still alive and cancelling reservations
-        that are no longer replayable.
+    def recover(
+        self,
+        nodes: Iterable[Node],
+        pods: Iterable[Pod],
+        min_watermark=None,
+    ) -> None:
+        """Replay the current cluster state before serving requests.
+
+        O(delta) path (doc/fault-model.md "HA and snapshot recovery
+        plane"): when a VALID persisted snapshot exists — schema version,
+        checksum, config fingerprint, and watermark (not older than
+        ``min_watermark``) all check out — its bound pods are imported in
+        bulk through the decode-free admission path, and the live pod list
+        then acts as the DELTA replay: an imported pod whose live
+        annotations are unchanged confirms in O(1); a changed one replays
+        from its annotations; a new one replays normally; imported pods
+        absent from the live list are released at finish_recovery. Any
+        snapshot problem — or a failure mid-import — falls back to the
+        full annotation replay (snapshotFallbackCount), which is exactly
+        the pre-snapshot behavior: every bound hived pod re-enters via
+        add_pod -> add_bound_pod -> AddAllocatedPod.
 
         The persisted doomed ledger is loaded FIRST and installed as the
         core's doomed-cell preference map, so the advisory doomed-bad
         bindings reconstruct onto the same cells the pre-crash scheduler
-        chose (doc/fault-model.md "Reconfiguration plane").
+        chose (doc/fault-model.md "Reconfiguration plane"). Preempting
+        groups always replay from live preempt-info annotations (they are
+        deltas by nature — the live annotation is fresher than any
+        snapshot).
 
         Fault contract: one unreplayable pod must not abort recovery —
         add_pod quarantines bound pods whose annotations cannot be replayed
@@ -712,6 +863,7 @@ class HivedScheduler:
         remaining pods still recover. Readiness (/readyz) flips only after
         the full replay."""
         pod_list = list(pods)
+        node_list = list(nodes)
         # Recovery is rare and expensive: always trace it (force bypasses
         # the sampling knob) so the last boot's phase breakdown is in the
         # trace ring.
@@ -725,37 +877,83 @@ class HivedScheduler:
                     "doomed-ledger ConfigMap read failed; recovering without "
                     "it (advisory dooms re-derive arbitrarily): %s", e,
                 )
-        self.begin_recovery(ledger_payload)
+        with tr.span("snapshotLoad"):
+            snap = self.load_valid_snapshot(min_watermark)
+        if snap is None:
+            self.discard_preapplied_state()
+        self.begin_recovery(
+            ledger_payload, defer_doom_rebuild=snap is not None
+        )
         try:
+            if snap is not None:
+                # BEFORE the node replay: the restore reinstates the
+                # snapshot-time cell state (health included) wholesale, and
+                # the node replay then acts as the health half of the delta
+                # — an unchanged node's observation no-ops against the
+                # restored records in O(chips), a changed one applies its
+                # real transition.
+                with tr.span("snapshotImport"):
+                    self.import_snapshot(snap, node_list)
+            # The replay loops run the add_node/add_pod LOCKED BODIES under
+            # one global section instead of acquiring per event: recover()
+            # is single-threaded, already inside the begin/finish mutation
+            # bracket, and the global guard covers every chain — per-event
+            # lock churn was a measurable slice of the recovery blackout at
+            # fleet scale. (The informer boot path keeps the per-event
+            # calls: it shares the process with live traffic.)
             with tr.span("nodeReplay"):
                 n_nodes = 0
-                for node in nodes:
-                    self.add_node(node)
-                    n_nodes += 1
+                with self._lock:
+                    for node in node_list:
+                        self.nodes[node.name] = node
+                        self._observe_node_health(node)
+                        n_nodes += 1
             with tr.span("podReplay", pods=len(pod_list)):
-                for pod in pod_list:
-                    if not is_interested(pod):
-                        continue
-                    try:
-                        self.add_pod(pod)
-                    except Exception as e:  # noqa: BLE001
-                        self._quarantine_pod(pod, e)
+                with self._lock:
+                    for pod in pod_list:
+                        if not is_interested(pod):
+                            continue
+                        bound = is_bound(pod)
+                        t0 = time.monotonic() if bound else 0.0
+                        try:
+                            if bound:
+                                self._add_bound_pod_locked(pod)
+                            else:
+                                self._admit_unbound(pod)
+                        except Exception as e:  # noqa: BLE001
+                            self._quarantine_pod(pod, e)
+                        if bound:
+                            self.metrics.observe_recovery_replay(
+                                time.monotonic() - t0
+                            )
         except BaseException:
             self._abort_recovery()
             tr.finish(outcome="aborted")
             raise
         with tr.span("preemptReplay"):
             self.finish_recovery(pod_list)
-        tr.finish(outcome="ok", nodes=n_nodes)
+        tr.finish(outcome="ok", nodes=n_nodes, mode=self._recovery_mode)
 
-    def begin_recovery(self, ledger_payload: Optional[str]) -> None:
+    def begin_recovery(
+        self,
+        ledger_payload: Optional[str],
+        defer_doom_rebuild: bool = False,
+    ) -> None:
         """Phase 1 of recovery, before the node/pod replay: install the
         persisted doomed ledger (authoritative when present — organic doom
         churn suspends and the doomed set rebuilds to exactly the ledger)
         and suspend side-effect flushes until finish_recovery. Paired with
         finish_recovery; the InformerLoop boot path brackets its initial
-        relists with the two so it recovers identically to recover()."""
+        relists with the two so it recovers identically to recover().
+
+        ``defer_doom_rebuild`` is set by recover() when a validated
+        snapshot is about to be imported: the verbatim restore carries the
+        ledger's own dooms (import_snapshot's gate enforces exact
+        equality), so rebuilding on the bootstrap state first would be
+        wasted churn — the import runs the rebuild itself on the paths
+        that still need it (fallbacks)."""
         self._enter_mutation()
+        self._in_recovery = True
         ledger = None
         if ledger_payload:
             try:
@@ -765,22 +963,31 @@ class HivedScheduler:
                     "doomed-ledger payload undecodable; recovering without "
                     "it: %s", e,
                 )
+        # Kept for the mid-import fallback path: _reset_for_full_replay
+        # re-installs the same decoded ledger on its fresh core.
+        self._recovery_ledger = ledger
+        self._recovery_mode = "full"
         self.core.set_preferred_doomed(ledger)
-        # The constructor's all-nodes-bad bootstrap already bound advisory
-        # dooms to arbitrary cells; rebuild the doomed set to exactly the
-        # ledger's before any health or pod replay.
-        self.core.rebuild_doomed_from_ledger()
+        if not defer_doom_rebuild:
+            # The constructor's all-nodes-bad bootstrap already bound
+            # advisory dooms to arbitrary cells; rebuild the doomed set to
+            # exactly the ledger's before any health or pod replay.
+            self.core.rebuild_doomed_from_ledger()
 
     def finish_recovery(self, pods: List[Pod]) -> None:
-        """Phase 2 of recovery, after the bound-pod replay: replay
+        """Phase 2 of recovery, after the bound-pod replay: release
+        snapshot-imported pods the live cluster no longer has, replay
         preempting groups from preempt-info annotations, drop the ledger
         preferences (steady-state doom choices must not keep preferring
         the pre-crash layout), flip readiness, and flush the recovered
         ledger to the ConfigMap (the recovered state is now canonical)."""
         try:
+            self._readd_released_snapshot_pods(pods)
+            self._drop_vanished_snapshot_pods()
             self._recover_preempting_pods(pods)
         finally:
             self.core.clear_preferred_doomed()
+            self._in_recovery = False
             # Replayed gangs may sit on hardware that broke while we were
             # down: seed the stranded-gang gauge before serving scrapes.
             with self._lock:
@@ -795,6 +1002,7 @@ class HivedScheduler:
         propagates the failure (and the process restarts), exactly the
         pre-recovery contract."""
         self.core.clear_preferred_doomed()
+        self._in_recovery = False
         # Bare depth decrement, not _exit_mutation: a half-replayed state
         # must not overwrite the ConfigMap ledger.
         self._mutation_depth.d -= 1
@@ -841,6 +1049,620 @@ class HivedScheduler:
 
     def is_ready(self) -> bool:
         return self._ready.is_set()
+
+    # ------------------------------------------------------------------ #
+    # Snapshot plane (doc/fault-model.md "HA and snapshot recovery plane")
+    # ------------------------------------------------------------------ #
+
+    def note_watermark(self, watermark) -> None:
+        """Record the informer's resourceVersion high-water mark (or the
+        harness's event index): snapshots carry it so recovery knows which
+        deltas the snapshot already contains. Atomic assignment — safe from
+        the informer threads without a lock."""
+        self._watermark = watermark
+
+    def export_snapshot(self) -> Optional[List[str]]:
+        """Serialize the durable projection into persistable chunks (the
+        scheduler.snapshot format). The walk runs under the global guard —
+        it reads pod statuses and core state — but the JSON encode and
+        the ConfigMap write happen OUTSIDE any lock (the PR-3 doomed-ledger
+        flush pattern; the flusher never holds chain locks across I/O).
+        None while recovery is still in progress (a half-replayed view must
+        never overwrite a complete snapshot) or while the projection is not
+        normalized (see _export_body_locked) — the previous snapshot stays
+        current and the delta replay covers the gap."""
+        with self._lock:
+            if not self._ready.is_set():
+                return None
+            exported = self._export_body_locked()
+            if exported is None:
+                return None
+            body, pods_json = exported
+            watermark = self._watermark
+        return snapshot_mod.encode(
+            body, self._config_fingerprint, watermark, pods_json=pods_json
+        )
+
+    def _export_body_locked(
+        self,
+    ) -> Optional[Tuple[Dict, List[str]]]:
+        """The durable projection, exactly the state the chaos harness
+        proves restart-equivalent: the core's verbatim cell-level
+        projection (free/bad-free/doomed listings, sparse cell records,
+        quota counters, allocated groups) plus the confirmed-BOUND pods
+        with their decoded spec/bind-info and slot index (so import can
+        slot them without decoding), the applied health records, and the
+        doomed-ledger epoch.
+
+        Returns None — skip this flush — while the projection carries
+        transient overlays a real crash would forget: a PREEMPTING group
+        (its Reserving/Reserved cells replay from live preempt-info
+        annotations, never from snapshots) or an ALLOCATED group none of
+        whose pods has confirmed BOUND (an assume-bind in flight — the
+        bind write may still fail, and a real crash forgets it). Both
+        windows are short (a preemption resolving, an informer confirm in
+        flight); the flusher simply lands the snapshot on its next beat."""
+        statuses = self.pod_schedule_statuses
+        for g in self.core.affinity_groups.values():
+            if g.state != GroupState.ALLOCATED:
+                return None
+            confirmed = False
+            for slots in g.allocated_pods.values():
+                for p in slots:
+                    if p is None:
+                        continue
+                    st = statuses.get(p.uid)
+                    if st is not None and st.pod_state == PodState.BOUND:
+                        confirmed = True
+                        break
+                if confirmed:
+                    break
+            if not confirmed:
+                return None
+        iso = constants.ANNOTATION_POD_LEAF_CELL_ISOLATION
+        pods_out: List[Dict] = []
+        pods_json: List[str] = []
+        record_cache = self._snapshot_pod_export_cache
+        new_cache: Dict[str, Tuple[Pod, Dict, str]] = {}
+        for uid in sorted(self.pod_schedule_statuses):
+            status = self.pod_schedule_statuses[uid]
+            if status.pod_state != PodState.BOUND:
+                continue
+            pod = status.pod
+            cached = record_cache.get(uid)
+            if cached is not None and cached[0] is pod:
+                # Same immutable pod object as the last flush: its record
+                # (and serialized text) cannot have changed — the
+                # flusher's dominant cost at steady state was re-decoding
+                # and re-dumping bind infos that never change (see
+                # doc/hot-path.md).
+                new_cache[uid] = cached
+                pods_out.append(cached[1])
+                pods_json.append(cached[2])
+                continue
+            try:
+                spec = extract_pod_scheduling_spec(pod)
+                info = extract_pod_bind_info(pod)
+            except api.WebServerError:
+                # Unreplayable annotations: leave the pod out — recovery
+                # will quarantine it from the live annotations, exactly as
+                # full replay would.
+                continue
+            record = {
+                "name": pod.name,
+                "namespace": pod.namespace,
+                "uid": pod.uid,
+                "node": pod.node_name,
+                "phase": pod.phase,
+                "resourceLimits": dict(pod.resource_limits),
+                "annotations": {
+                    k: v
+                    for k, v in pod.annotations.items()
+                    if k
+                    in (
+                        constants.ANNOTATION_POD_SCHEDULING_SPEC,
+                        constants.ANNOTATION_POD_BIND_INFO,
+                        iso,
+                        constants.ANNOTATION_POD_TPU_ENV,
+                    )
+                },
+                "spec": spec.to_dict(),
+                "bindInfo": info.to_dict(),
+                "podIndex": get_allocated_pod_index(
+                    info, spec.leaf_cell_number
+                ),
+            }
+            record_text = json.dumps(record, separators=(",", ":"))
+            new_cache[uid] = (pod, record, record_text)
+            pods_out.append(record)
+            pods_json.append(record_text)
+        self._snapshot_pod_export_cache = new_cache
+        # No "preempting" section: import never reads one (preempting
+        # groups always replay from live preempt-info annotations — they
+        # are deltas by nature), and the ALLOCATED-only gate above means
+        # a flush can never coexist with a PREEMPTING group anyway.
+        body = {
+            "doomedEpoch": self.core.doomed_epoch,
+            "health": self.core.health_snapshot(),
+            "core": self.core.export_projection(),
+            "pods": pods_out,
+        }
+        return body, pods_json
+
+    def flush_snapshot_now(self) -> bool:
+        """One flusher step: export under the guard, write outside it.
+        Returns True when a snapshot landed. A deposed leader never writes
+        (it would clobber the new leader's snapshot stream)."""
+        if not self.is_leader():
+            return False
+        chunks = self.export_snapshot()
+        if chunks is None:
+            return False
+        # _snapshot_write_lock serializes concurrent flushes so chunk
+        # families cannot interleave; never held while holding chain locks.
+        with self._snapshot_write_lock:
+            try:
+                self.kube_client.persist_snapshot(chunks)
+            except Exception as e:  # noqa: BLE001
+                self.metrics.observe_snapshot_persist(False)
+                common.log.warning(
+                    "snapshot ConfigMap write failed (recovery falls back "
+                    "to the previous snapshot or full replay): %s", e,
+                )
+                return False
+        self.metrics.observe_snapshot_persist(True)
+        return True
+
+    def start_snapshot_flusher(
+        self, interval_s: Optional[float] = None
+    ) -> bool:
+        """Arm the background snapshot flusher: every ``interval_s``
+        (default: config snapshotIntervalSeconds; <= 0 disables) it
+        serializes + persists a snapshot and settles any wall-clock-expired
+        damper holds (the quiet-cluster settling path — no informer events
+        needed). Threads are started explicitly, never from __init__, so
+        tests and simulators construct schedulers without spawning."""
+        interval = (
+            self.config.snapshot_interval_seconds
+            if interval_s is None
+            else interval_s
+        )
+        if interval <= 0 or self._flusher_thread is not None:
+            return False
+        stop = threading.Event()
+
+        def loop() -> None:
+            while not stop.wait(interval):
+                try:
+                    self.settle_health_wall()
+                    self.flush_snapshot_now()
+                except Exception:  # noqa: BLE001
+                    common.log.exception("snapshot flusher step failed")
+
+        t = threading.Thread(
+            target=loop, name="hived-snapshot-flusher", daemon=True
+        )
+        self._flusher_stop = stop
+        self._flusher_thread = t
+        t.start()
+        return True
+
+    def stop_snapshot_flusher(self) -> None:
+        if self._flusher_stop is not None:
+            self._flusher_stop.set()
+        if self._flusher_thread is not None:
+            self._flusher_thread.join(timeout=2.0)
+        self._flusher_stop = None
+        self._flusher_thread = None
+
+    def prefetch_snapshot(self, min_watermark=None, apply: bool = False) -> bool:
+        """Standby warm-up (StandbyLoop.on_standby_beat): load + decode +
+        validate the latest persisted snapshot and keep the DECODED body
+        keyed by its chunk family, so a takeover's recovery skips the
+        multi-megabyte JSON decode — the decode happens while standing by,
+        off the failover blackout path. Returns True when a validated
+        snapshot is warm. The import never mutates the body, so the cached
+        object can be handed to recovery as-is.
+
+        ``apply=True`` makes this a HOT standby beat: the projection is
+        additionally restored into this process's own core (wholesale,
+        repeatable — the restore is state-independent), so the takeover's
+        recovery skips even the restore and runs only the delta replay
+        against the live cluster. Refused once this scheduler is ready (a
+        serving leader must never wholesale-restore under traffic). The
+        pre-apply runs outside the mutation bracket on purpose: a standby
+        is not the leader and must queue/write nothing — and the restore
+        path has no side effects to queue."""
+        try:
+            chunks = self.kube_client.load_snapshot()
+        except Exception as e:  # noqa: BLE001
+            common.log.debug("standby snapshot prefetch read failed: %s", e)
+            return self._prefetched_snapshot is not None
+        if not chunks:
+            return False
+        cached = self._prefetched_snapshot
+        if cached is not None and cached[0] == chunks:
+            if not apply or self._preapplied_chunks == chunks:
+                return True
+            snap = cached[1]
+        else:
+            snap, reason = snapshot_mod.decode(
+                chunks, self._config_fingerprint, min_watermark
+            )
+            if snap is None:
+                common.log.debug(
+                    "standby snapshot prefetch unusable: %s", reason
+                )
+                return False
+            self._prefetched_snapshot = (chunks, snap)
+        if apply and not self._ready.is_set():
+            try:
+                self._clear_imported_state()
+                self._import_snapshot_state(snap, live_names=None)
+                self._preapplied_chunks = list(chunks)
+            except Exception:  # noqa: BLE001
+                common.log.exception(
+                    "hot-standby pre-apply failed; takeover will restore "
+                    "from the decoded snapshot instead",
+                )
+                self._clear_imported_state()
+                self._preapplied_chunks = None
+        return True
+
+    def discard_preapplied_state(self) -> None:
+        """Hot-standby state with no usable snapshot at takeover (it was
+        corrupted or deleted after the pre-apply): discard the pre-applied
+        projection wholesale — the full replay must start from a virgin
+        core, and the _snapshot_pending fingerprint fast path must not
+        confirm any of the discarded imports in O(1). No-op unless a
+        pre-apply is live. Called by BOTH recovery drivers (recover() and
+        the InformerLoop boot path) when load_valid_snapshot comes back
+        empty."""
+        if self._preapplied_chunks is None:
+            return
+        self._clear_imported_state()
+        old_core = self.core
+        core = HivedCore(self.config)
+        core.decisions = self.decisions
+        core.lock_validator = self._locks.require_global
+        core.preemption_observer = self._on_preemption_event
+        core.preempt_rng = old_core.preempt_rng
+        self.core = core
+
+    def _clear_imported_state(self) -> None:
+        """Drop everything a snapshot import populated at the framework
+        level (the core side needs no clearing — restore_projection is
+        state-independent). Used between repeated hot-standby pre-applies
+        and before re-importing a changed snapshot at takeover."""
+        with self._lock:
+            self.pod_schedule_statuses.clear()
+            self.quarantined_pods.clear()
+            self._snapshot_pending.clear()
+            self._snapshot_claims.clear()
+            self._snapshot_released_uids.clear()
+            self._chip_targets.clear()
+            self._damper.reset()
+            self._preapplied_chunks = None
+
+    def load_valid_snapshot(self, min_watermark=None) -> Optional[Dict]:
+        """Load + validate the persisted snapshot. None (with
+        snapshotFallbackCount bumped when one EXISTED but was unusable)
+        means: run the full annotation replay. A missing snapshot is not a
+        fallback — it is simply a first boot.
+
+        A warm standby that prefetched the identical chunk family serves
+        the already-decoded body (byte-equality of the chunks is the cache
+        key, so a snapshot rewritten between prefetch and takeover decodes
+        fresh); the watermark floor is still re-checked — the validation
+        ladder is never skipped, only the decode."""
+        chunks = None
+        self._last_snapshot_chunks = None
+        try:
+            chunks = self.kube_client.load_snapshot()
+        except Exception as e:  # noqa: BLE001
+            common.log.warning(
+                "snapshot ConfigMap read failed; recovering by full "
+                "annotation replay: %s", e,
+            )
+            self.metrics.observe_snapshot_fallback()
+            return None
+        if not chunks:
+            return None
+        self._last_snapshot_chunks = chunks
+        cached = self._prefetched_snapshot
+        if cached is not None and cached[0] == chunks:
+            snap, reason = cached[1], ""
+            if min_watermark is not None and snapshot_mod._watermark_older(
+                snap.get("_meta", {}).get("watermark"), min_watermark
+            ):
+                snap, reason = None, "stale watermark (prefetched)"
+        else:
+            snap, reason = snapshot_mod.decode(
+                chunks, self._config_fingerprint, min_watermark
+            )
+        if snap is None:
+            common.log.warning(
+                "persisted snapshot unusable (%s); recovering by full "
+                "annotation replay", reason,
+            )
+            self.metrics.observe_snapshot_fallback()
+        return snap
+
+    def import_snapshot(self, snap: Dict, nodes: List[Node]) -> bool:
+        """Reinstate a validated snapshot's projection wholesale. On ANY
+        failure mid-import the partially-mutated state is discarded
+        (_reset_for_full_replay) and recovery proceeds as a full annotation
+        replay — degraded recovery must be deterministic, never a function
+        of how far the import got.
+
+        Doomed-ledger gate: the advisory doomed bindings are
+        history-dependent (that is why the ledger ConfigMap exists), and
+        organic doom churn is SUSPENDED during recovery — there is no
+        incremental mechanism to converge a snapshot's doomed set onto the
+        fresher ledger's. A snapshot whose dooms do not exactly match the
+        crash ledger is therefore stale for the doom subsystem and falls
+        back to the full replay (which binds the ledger's dooms on the
+        bootstrap state, the proven PR-3 path). The window is one doom
+        change between the last flush and the crash — rare at production
+        cadence, and the fallback is the deterministic degraded mode the
+        fault model already guarantees."""
+        chunks = self._last_snapshot_chunks
+        preapplied = (
+            self._preapplied_chunks is not None
+            and chunks == self._preapplied_chunks
+        )
+        if not self._snapshot_dooms_match_ledger(snap):
+            common.log.warning(
+                "persisted snapshot's doomed bindings diverge from the "
+                "crash ledger; recovering by full annotation replay",
+            )
+            self.metrics.observe_snapshot_fallback()
+            if preapplied or self._preapplied_chunks is not None:
+                self._reset_for_full_replay(nodes)
+            else:
+                # begin_recovery deferred the doom rebuild to this import;
+                # the full replay it falls back to still needs it.
+                self.core.rebuild_doomed_from_ledger()
+            return False
+        live_names = {n.name for n in nodes}
+        try:
+            if preapplied:
+                # Hot standby: the projection is already live in this
+                # process (pre-applied on a standby beat); only normalize
+                # nodes the live cluster no longer has. This is the
+                # takeover fast path — the blackout is just the delta
+                # replay.
+                with self._lock:
+                    for name in self.core.configured_node_names():
+                        if name not in live_names:
+                            self.core.set_bad_node(name)
+                    for n, chips in self.core.bad_chips.items():
+                        self._chip_targets[n] = set(chips)
+            else:
+                if self._preapplied_chunks is not None:
+                    # Pre-applied state from an OLDER snapshot: discard it
+                    # wholesale and restore the current one.
+                    self._clear_imported_state()
+                self._import_snapshot_state(snap, live_names)
+        except Exception:  # noqa: BLE001
+            common.log.exception(
+                "snapshot import failed mid-way; resetting for full "
+                "annotation replay",
+            )
+            self.metrics.observe_snapshot_fallback()
+            self._reset_for_full_replay(nodes)
+            return False
+        self._recovery_mode = "snapshot+delta"
+        return True
+
+    def _snapshot_dooms_match_ledger(self, snap: Dict) -> bool:
+        ledger = self._recovery_ledger
+        if not isinstance(ledger, dict):
+            # No authoritative ledger (first boot or failed read): organic
+            # dooming is live during recovery, which a verbatim restore
+            # cannot reproduce — unless neither side has any doom at all.
+            ledger = {}
+        ledger_dooms = {
+            (str(vcn), str(e.get("chain")), int(e.get("level", -1)),
+             str(e.get("address")))
+            for vcn, entries in (ledger.get("vcs") or {}).items()
+            for e in entries
+        }
+        snap_dooms = {
+            (str(vcn), str(chain), int(level), str(addr))
+            for vcn, per_chain in (
+                (snap.get("core") or {}).get("vcDoomed") or {}
+            ).items()
+            for chain, levels in per_chain.items()
+            for level, addrs in levels.items()
+            for addr in addrs
+        }
+        return snap_dooms == ledger_dooms
+
+    def _import_snapshot_state(
+        self, snap: Dict, live_names: Optional[Set[str]]
+    ) -> None:
+        """Restore the projection + framework maps. ``live_names`` is the
+        live node list for absent-node normalization; None during a
+        hot-standby pre-apply (the takeover normalizes against the real
+        list)."""
+        imported = 0
+        with self._lock:
+            # The restored doomed bindings ARE the ledger's (the gate in
+            # import_snapshot verified exact equality), carried with the
+            # continuous scheduler's own virtual-cell choices — no rebuild
+            # pass needed or wanted (retire+rebind churn could only pick
+            # differently).
+            self.core.restore_projection(
+                snap["core"], snap.get("health"), live_names
+            )
+            # The damper's applied-state memory described the pre-restore
+            # core; against the restored records it would swallow the node
+            # replay's re-observations as non-flips.
+            self._damper.reset()
+            # Seed the chip observation targets from the restored records:
+            # a chip bad in the snapshot but healed while we were down must
+            # be RE-OBSERVED healthy by the node replay, which only walks
+            # the live device-health annotation plus these targets.
+            for n, chips in self.core.bad_chips.items():
+                self._chip_targets[n] = set(chips)
+            for rec in snap.get("pods") or []:
+                pod = Pod(
+                    name=rec["name"],
+                    namespace=rec["namespace"],
+                    uid=rec["uid"],
+                    annotations=dict(rec["annotations"]),
+                    node_name=rec["node"],
+                    phase=rec.get("phase", "Running"),
+                    resource_limits={
+                        str(k): int(v)
+                        for k, v in (rec.get("resourceLimits") or {}).items()
+                    },
+                )
+                # Decode-free slotting: the cell state is already restored
+                # verbatim; each pod record only names its group slot. The
+                # delta replay re-checks every pod against its live
+                # annotations before trusting the import.
+                self.core.attach_restored_pod(
+                    rec["spec"]["affinityGroup"]["name"],
+                    int(rec["spec"]["leafCellNumber"]),
+                    int(rec["podIndex"]),
+                    pod,
+                )
+                self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                    pod=pod, pod_state=PodState.BOUND
+                )
+                self._snapshot_pending[pod.uid] = (
+                    self._snapshot_pod_fingerprint(pod)
+                )
+                info = rec["bindInfo"]
+                for idx in info["leafCellIsolation"]:
+                    self._snapshot_claims[
+                        (info["cellChain"], info["node"], idx)
+                    ] = pod.uid
+                imported += 1
+        self._snapshot_imported_count = imported
+        self._snapshot_delta_count = 0
+
+    @staticmethod
+    def _snapshot_pod_fingerprint(pod: Pod) -> Tuple:
+        """What makes an imported pod's state trustworthy against its live
+        twin: same node and same spec/bind-info annotations. Anything else
+        (phase flips, unrelated annotations) does not affect placement."""
+        return (
+            pod.node_name,
+            pod.annotations.get(constants.ANNOTATION_POD_SCHEDULING_SPEC),
+            pod.annotations.get(constants.ANNOTATION_POD_BIND_INFO),
+        )
+
+    def _reset_for_full_replay(self, nodes: List[Node]) -> None:
+        """Discard everything a partial snapshot import mutated: fresh
+        core, cleared trackers, the decoded ledger re-installed, and the
+        node replay re-run. Runs inside the recovery mutation bracket
+        before any live-pod replay, so the subsequent full replay is
+        byte-identical to a recovery that never saw a snapshot."""
+        old = self.core
+        core = HivedCore(self.config)
+        core.decisions = self.decisions
+        core.lock_validator = self._locks.require_global
+        core.preemption_observer = self._on_preemption_event
+        core.preempt_rng = old.preempt_rng
+        self.core = core
+        self.pod_schedule_statuses.clear()
+        self.quarantined_pods.clear()
+        self._snapshot_pending.clear()
+        self._snapshot_claims.clear()
+        self._snapshot_released_uids.clear()
+        self._snapshot_imported_count = 0
+        self._snapshot_delta_count = 0
+        self._damper = health_mod.FlapDamper(
+            self.config.health_flap_threshold,
+            self.config.health_flap_window,
+            self.config.health_flap_hold,
+            hold_seconds=self.config.health_flap_hold_seconds,
+        )
+        self._chip_targets.clear()
+        self._stranded_names = set()
+        self.nodes.clear()
+        core.set_preferred_doomed(self._recovery_ledger)
+        core.rebuild_doomed_from_ledger()
+        self._recovery_mode = "full"
+        for node in nodes:
+            self.add_node(node)
+
+    def _snapshot_claims_conflict(self, pod: Pod) -> bool:
+        """True when ``pod``'s bind-info leaf cells overlap cells a
+        still-unconfirmed snapshot import holds — the one way the import
+        can contradict the live cluster (the holder was deleted while we
+        were down and its cells were reused)."""
+        try:
+            info = extract_pod_bind_info(pod)
+        except api.WebServerError:
+            return False  # undecodable: the replay below quarantines it
+        for idx in info.leaf_cell_isolation:
+            uid = self._snapshot_claims.get((info.cell_chain, info.node, idx))
+            if (
+                uid is not None
+                and uid != pod.uid
+                and uid in self._snapshot_pending
+            ):
+                return True
+        return False
+
+    def _release_pending_snapshot_imports_locked(self) -> None:
+        """Release every imported-but-unconfirmed snapshot pod (caller
+        already holds the global guard): the conflict-repair half of the
+        delta replay — invoked when a live pod's replay collides with
+        imported state the live cluster has since superseded."""
+        for uid in sorted(self._snapshot_pending):
+            status = self.pod_schedule_statuses.get(uid)
+            if status is not None:
+                self._delete_pod_locked(status.pod)
+            self._snapshot_released_uids.add(uid)
+            self._snapshot_delta_count += 1
+        self._snapshot_pending.clear()
+        self._snapshot_claims.clear()
+
+    def _readd_released_snapshot_pods(self, pods: List[Pod]) -> None:
+        """Re-admit live pods whose snapshot import was released by a claim
+        conflict after their position in the replay had already passed —
+        they replay from their live annotations, exactly as full replay
+        admitted them."""
+        if not self._snapshot_released_uids:
+            return
+        released = self._snapshot_released_uids
+        self._snapshot_released_uids = set()
+        for pod in pods:
+            if (
+                pod.uid in released
+                and is_interested(pod)
+                and pod.uid not in self.pod_schedule_statuses
+                and pod.uid not in self.quarantined_pods
+            ):
+                try:
+                    self.add_pod(pod)
+                except Exception as e:  # noqa: BLE001
+                    self._quarantine_pod(pod, e)
+
+    def _drop_vanished_snapshot_pods(self) -> None:
+        """The deletion half of the delta replay: imported pods the live
+        list never confirmed were deleted while we were down — release
+        their cells exactly as the informer's DELETED event would have."""
+        if not self._snapshot_pending:
+            return
+        for uid in sorted(self._snapshot_pending):
+            status = self.pod_schedule_statuses.get(uid)
+            if status is not None:
+                with self._lock:
+                    common.log.warning(
+                        "[%s]: imported from snapshot but absent from the "
+                        "live cluster (deleted while down); releasing",
+                        status.pod.key,
+                    )
+                    self._delete_pod_locked(status.pod)
+            self._snapshot_delta_count += 1
+        self._snapshot_pending.clear()
+        self._snapshot_claims.clear()
 
     def _quarantine_pod(self, pod: Pod, error: Exception) -> None:
         """Park an unreplayable bound pod: logged, counted, surfaced via the
@@ -948,7 +1770,13 @@ class HivedScheduler:
         if drain != self.core.draining_chips.get(node.name, set()):
             self.core.apply_drain(node.name, drain)
             applied = True
-        if applied:
+        if applied and not self._in_recovery:
+            # Not during recovery: the replay applies one transition per
+            # node and a per-transition group scan would make recovery
+            # O(nodes x groups) — and the snapshot path restores groups
+            # BEFORE the node replay, so an early stranded-eviction there
+            # would diverge from full replay (which has no groups yet).
+            # finish_recovery seeds the stranded gauge once at the end.
             self._check_stranded_locked()
 
     def _observe_target(self, target, desired_healthy: bool, clock) -> bool:
@@ -989,6 +1817,23 @@ class HivedScheduler:
         try:
             with self._lock:
                 self._health_clock += 1
+                if self._apply_settled(self._health_clock):
+                    self._check_stranded_locked()
+        finally:
+            self._exit_mutation()
+
+    def settle_health_wall(self) -> None:
+        """Apply damper holds whose WALL-CLOCK floor expired (no event tick
+        needed): the background snapshot flusher calls this every interval
+        so a quiet cluster — no informer relist/watch-cycle traffic to
+        drive health_tick — still settles within healthFlapHoldSeconds.
+        No-op when the floor is disabled (the chaos default: the event
+        clock stays exclusively authoritative)."""
+        if self._damper.hold_seconds <= 0:
+            return
+        self._enter_mutation()
+        try:
+            with self._lock:
                 if self._apply_settled(self._health_clock):
                     self._check_stranded_locked()
         finally:
@@ -1171,7 +2016,17 @@ class HivedScheduler:
                 else:
                     self._admit_unbound(pod)
 
-            self._run_chain_locked(pod, None, locked)
+            if is_bound(pod) and self._snapshot_pending:
+                # Delta replay of a bound pod (the map is only non-empty
+                # between snapshot import and finish_recovery): a claim
+                # conflict releases unconfirmed imports on ARBITRARY
+                # chains (_release_pending_snapshot_imports_locked), so
+                # the pod's own chain section cannot cover the mutation —
+                # take the global order for the replay window.
+                with self._locks.section(None):
+                    locked(None)
+            else:
+                self._run_chain_locked(pod, None, locked)
         finally:
             self._exit_mutation()
             if replaying:
@@ -1272,6 +2127,12 @@ class HivedScheduler:
         del self.pod_schedule_statuses[pod.uid]
 
     def _add_bound_pod(self, pod: Pod) -> None:
+        if self._snapshot_pending:
+            # See add_pod: conflict repair during the delta replay can
+            # mutate chains outside this pod's own set.
+            with self._locks.section(None):
+                self._add_bound_pod_locked(pod)
+            return
         self._run_chain_locked(
             pod, None, lambda sec: self._add_bound_pod_locked(pod)
         )
@@ -1279,13 +2140,33 @@ class HivedScheduler:
     def _add_bound_pod_locked(self, pod: Pod) -> None:
         status = self.pod_schedule_statuses.get(pod.uid)
         if status is not None and is_allocated_state(status.pod_state):
-            # Already allocated (assume-bind): the placement never changes
-            # again; just confirm Bound (reference: scheduler.go:314-328).
-            if status.pod_state != PodState.BOUND:
-                self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
-                    pod=status.pod, pod_state=PodState.BOUND
-                )
-            return
+            if self._snapshot_pending:
+                # Delta replay (recovery only — the map is empty in steady
+                # state): a snapshot-imported pod is confirmed in O(1) when
+                # its live annotations match the snapshot's; a pod that
+                # changed between snapshot and crash (annotation rewrite,
+                # corrupt bind info) is NOT trusted — release the imported
+                # state and replay it from the live annotations below,
+                # exactly as full replay would have handled it.
+                pending = self._snapshot_pending.pop(pod.uid, None)
+                if pending is not None and pending != (
+                    self._snapshot_pod_fingerprint(pod)
+                ):
+                    common.log.warning(
+                        "[%s]: changed since the snapshot; replaying from "
+                        "live annotations", pod.key,
+                    )
+                    self._delete_pod_locked(status.pod)
+                    status = None
+            if status is not None:
+                # Already allocated (assume-bind or confirmed snapshot
+                # import): the placement never changes again; just confirm
+                # Bound (reference: scheduler.go:314-328).
+                if status.pod_state != PodState.BOUND:
+                    self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                        pod=status.pod, pod_state=PodState.BOUND
+                    )
+                return
         if pod.uid in self.quarantined_pods:
             # Relists re-deliver quarantined pods every gap repair; the
             # verdict does not change until the pod itself does.
@@ -1295,6 +2176,30 @@ class HivedScheduler:
         # placement gone from the config quarantines this one pod
         # instead of aborting the whole recovery replay
         # (pre-fault-model behavior: raise through recover()).
+        if not self._ready.is_set() and self._recovery_mode == "snapshot+delta":
+            # A bound pod replayed from annotations during a snapshot
+            # recovery: either absent from the snapshot (bound after it was
+            # taken) or changed since — the creation/mutation half of the
+            # delta replay (the deletion half is
+            # _drop_vanished_snapshot_pods).
+            self._snapshot_delta_count += 1
+        if self._snapshot_pending and self._snapshot_claims_conflict(pod):
+            # The live pod claims cells an imported-but-unconfirmed
+            # snapshot pod holds: a pod deleted while we were down can
+            # hold cells a newer live pod was since bound to (full replay
+            # never sees the deleted pod — the import resurrected it; the
+            # replay below would silently double-bind the cell and the
+            # vanished-pod release would then clobber the live binding).
+            # The live cluster supersedes the import: release every
+            # unconfirmed imported pod first. The released pods' own live
+            # events (later in the relist) re-admit them from annotations
+            # — slower, still correct.
+            common.log.warning(
+                "[%s]: replay conflicts with unconfirmed snapshot imports "
+                "(%d pending); releasing them and replaying from "
+                "annotations", pod.key, len(self._snapshot_pending),
+            )
+            self._release_pending_snapshot_imports_locked()
         try:
             self.core.validate_allocated_pod(pod)
             self.core.add_allocated_pod(pod)
@@ -1649,6 +2554,20 @@ class HivedScheduler:
                     f"Pod binding node mismatch: expected "
                     f"{binding_pod.node_name}, received {args.node}"
                 )
+        # HA fencing (doc/fault-model.md "HA and snapshot recovery plane"):
+        # a deposed leader must never write a bind — the new leader owns
+        # the cluster state, and a stale bind would allocate cells the new
+        # leader believes free. Checked immediately before the write; the
+        # residual window (lease expiring mid-write) is closed by the bind
+        # UID precondition + lease duration >> write timeout (see the
+        # split-brain argument in the doc).
+        if not self.is_leader():
+            self.metrics.observe_deposed_bind_refused()
+            raise api.WebServerError(
+                503,
+                "not the leader: bind refused (lease lost or standby); "
+                "the active leader will re-schedule this pod",
+            )
         tr = self.tracer.trace("bind", pod=binding_pod.key)
         t0 = time.monotonic()
         try:
@@ -1957,6 +2876,14 @@ class HivedScheduler:
             core.preempt_probe_incremental_count
         )
         snap["traceSampledCount"] = self.tracer.sampled_count
+        snap["mappingRetryCount"] = core.mapping_retry_count
+        # HA / snapshot recovery plane: counts from the LAST recovery
+        # (gauges — a restart resets them by definition), the recovery
+        # mode flag, and the leadership gauge.
+        snap["snapshotImportedPodCount"] = self._snapshot_imported_count
+        snap["snapshotDeltaPodCount"] = self._snapshot_delta_count
+        snap["recoveryMode"] = self._recovery_mode
+        snap["leader"] = self.is_leader()
         snap["quarantinedPodCount"] = len(self.quarantined_pods)
         # set(dict) and list(dict.values()) are single-opcode C-level
         # copies — atomic under the GIL even against concurrent mutators.
@@ -1973,6 +2900,42 @@ class HivedScheduler:
         snap["healthPendingCount"] = self._damper.pending_count()
         snap["ready"] = self.is_ready()
         return snap
+
+    def is_leader(self) -> bool:
+        """True when this process may write to the cluster: either HA is
+        disabled (no elector installed — single-scheduler deployments,
+        tests, simulators) or the installed elector currently holds an
+        unexpired leader lease."""
+        lead = self.leadership
+        return lead is None or lead.is_leader()
+
+    def get_ha(self) -> Dict:
+        """Inspect payload for /v1/inspect/ha: leadership, the last
+        recovery's mode and delta counts, and snapshot persistence state."""
+        lead = self.leadership
+        m = self.metrics.snapshot()
+        payload: Dict = {
+            "haEnabled": lead is not None,
+            "leader": self.is_leader(),
+            "ready": self.is_ready(),
+            "recoveryMode": self._recovery_mode,
+            "snapshot": {
+                "watermark": self._watermark,
+                "persistCount": m["snapshotPersistCount"],
+                "persistFailureCount": m["snapshotPersistFailureCount"],
+                "fallbackCount": m["snapshotFallbackCount"],
+                "importedPodCount": self._snapshot_imported_count,
+                "deltaPodCount": self._snapshot_delta_count,
+                "flusherRunning": self._flusher_thread is not None,
+            },
+        }
+        if lead is not None:
+            payload["identity"] = getattr(lead, "identity", "")
+            payload["observedHolder"] = getattr(lead, "observed_holder", "")
+            payload["leaseTransitions"] = getattr(
+                lead, "transition_count", 0
+            )
+        return payload
 
     def get_decisions(self, n: Optional[int] = None) -> Dict:
         """Inspect payload for /v1/inspect/decisions: the latest-N ring."""

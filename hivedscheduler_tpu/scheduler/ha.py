@@ -1,0 +1,264 @@
+"""Active-standby HA: lease-based leader election for the scheduler.
+
+The reference runs a single scheduler process with no leader election
+(PAPER.md) — a crash is a full scheduling blackout until the replacement
+finishes replaying every bound pod. This module adds the warm-standby
+half of the recovery plane (doc/fault-model.md "HA and snapshot recovery
+plane"):
+
+- :class:`LeaderElector` drives a ``coordination.k8s.io`` Lease through
+  any :class:`~.framework.KubeClient` (production: the REST client in
+  ``scheduler.kube``; tests: an in-memory fake). The holder renews every
+  ``renew_s``; anyone else may acquire once ``renewTime +
+  leaseDurationSeconds`` has passed. Acquisition goes through the
+  optimistic ``resourceVersion`` precondition, so two standbys racing for
+  an expired lease cannot both win.
+
+- **Self-deposal at expiry**: ``is_leader()`` is a pure local check —
+  held AND the local clock has not passed the last successful renewal
+  plus the lease duration. A leader that cannot reach the apiserver stops
+  claiming leadership the moment its lease would have expired for
+  everyone else, WITHOUT needing to observe the new holder. That is the
+  fencing half of the split-brain argument: the old leader refuses bind
+  writes (framework.bind_routine) strictly before a standby can have
+  acquired the lease.
+
+- :class:`StandbyLoop` is the production driver: hold off while another
+  process leads (optionally prefetching snapshots so takeover starts
+  warm), run recovery on acquiry, then keep renewing. ``/readyz`` stays
+  503 the whole standby phase (webserver gates on leadership AND recovery
+  completion), so K8s never routes extender traffic to the standby.
+
+Clocks are injectable (``clock=``) so the chaos harness drives failovers
+deterministically; production uses ``time.monotonic``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from .. import common
+
+
+class LeaderElector:
+    """One participant in the Lease protocol. ``try_acquire_or_renew`` is a
+    single synchronous step (testable without threads); ``run`` loops it.
+
+    The elector only needs two client methods — ``read_lease()`` and
+    ``write_lease(spec, resource_version=)`` (see framework.KubeClient) —
+    and the Lease spec shape it reads/writes is the K8s one:
+    holderIdentity, leaseDurationSeconds, acquireTime, renewTime,
+    leaseTransitions. ``renewTime``/``acquireTime`` are numbers in the
+    elector's OWN clock domain; the REST client translates to/from
+    MicroTime strings (kube.KubeAPIClient)."""
+
+    def __init__(
+        self,
+        client,
+        identity: str,
+        duration_s: float = 15.0,
+        renew_s: float = 5.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.client = client
+        self.identity = identity
+        self.duration_s = float(duration_s)
+        self.renew_s = float(renew_s)
+        self.clock = clock
+        # Local expiry of OUR leadership: last successful renewal + the
+        # lease duration. None = not the leader. This is the only state
+        # is_leader() reads, so the check is lock-free and O(1).
+        self._held_until: Optional[float] = None
+        self.observed_holder = ""
+        # Times leadership changed hands TO this elector (mirrors the
+        # Lease's leaseTransitions for this participant's acquisitions).
+        self.transition_count = 0
+
+    # ---------------- the protocol step ---------------- #
+
+    def is_leader(self) -> bool:
+        held = self._held_until
+        return held is not None and self.clock() < held
+
+    def try_acquire_or_renew(self) -> bool:
+        """One election step: renew our lease, or acquire a free/expired
+        one. Returns the (possibly unchanged) leadership verdict. Failures
+        never raise — a read/write error leaves the local state alone, and
+        self-deposal at expiry still happens via is_leader()."""
+        now = self.clock()
+        try:
+            cur = self.client.read_lease()
+        except Exception as e:  # noqa: BLE001
+            common.log.warning(
+                "leader lease read failed (leadership unchanged until "
+                "local expiry): %s", e,
+            )
+            return self.is_leader()
+        spec: Dict = {}
+        resource_version = None
+        if cur:
+            spec = dict(cur.get("spec") or {})
+            resource_version = cur.get("resourceVersion")
+        holder = str(spec.get("holderIdentity") or "")
+        self.observed_holder = holder
+        try:
+            renew_time = float(spec.get("renewTime") or 0.0)
+            duration = float(
+                spec.get("leaseDurationSeconds") or self.duration_s
+            )
+        except (TypeError, ValueError):
+            renew_time, duration = 0.0, self.duration_s
+        if holder and holder != self.identity and now < renew_time + duration:
+            # Someone else holds an unexpired lease. If we thought we were
+            # the leader, we have been superseded (e.g. clock trouble) —
+            # depose immediately rather than waiting for local expiry.
+            if self._held_until is not None:
+                common.log.warning(
+                    "leader lease now held by %s; deposing", holder,
+                )
+                self._held_until = None
+            return False
+        transitions = int(spec.get("leaseTransitions") or 0)
+        acquiring = holder != self.identity
+        new_spec = {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.duration_s),
+            "acquireTime": (
+                now if acquiring else spec.get("acquireTime", now)
+            ),
+            "renewTime": now,
+            "leaseTransitions": transitions + (1 if acquiring else 0),
+        }
+        try:
+            self.client.write_lease(
+                new_spec, resource_version=resource_version
+            )
+        except Exception as e:  # noqa: BLE001
+            # Lost the optimistic write (another standby won) or transport
+            # trouble: keep whatever leadership the last successful
+            # renewal bought — it self-expires.
+            common.log.warning(
+                "leader lease write failed (leadership unchanged until "
+                "local expiry): %s", e,
+            )
+            return self.is_leader()
+        if self._held_until is None:
+            self.transition_count += 1
+            common.log.warning(
+                "acquired leader lease as %s (transitions=%d)",
+                self.identity, new_spec["leaseTransitions"],
+            )
+        self._held_until = now + self.duration_s
+        self.observed_holder = self.identity
+        return True
+
+    def step_down(self) -> None:
+        """Voluntarily release leadership (graceful shutdown): zero the
+        renewTime so a standby acquires immediately instead of waiting a
+        full lease duration. The release is read-verify-write under the
+        optimistic precondition — a late step_down (our lease expired and
+        another elector already acquired) must NOT blank the new holder's
+        lease, which would let a third elector acquire while the new
+        holder still considers itself leader."""
+        if self._held_until is None:
+            return
+        self._held_until = None
+        try:
+            cur = self.client.read_lease()
+            if not cur:
+                return
+            spec = dict(cur.get("spec") or {})
+            if str(spec.get("holderIdentity") or "") != self.identity:
+                return  # superseded already: nothing of ours to release
+            self.client.write_lease(
+                {
+                    "holderIdentity": "",
+                    "leaseDurationSeconds": int(self.duration_s),
+                    "renewTime": 0.0,
+                    "leaseTransitions": int(
+                        spec.get("leaseTransitions") or 0
+                    ),
+                },
+                resource_version=cur.get("resourceVersion"),
+            )
+        except Exception as e:  # noqa: BLE001
+            common.log.warning("lease release write failed: %s", e)
+
+
+class StandbyLoop:
+    """The active-standby driver: hold off while another process leads,
+    take over on lease expiry, keep renewing afterwards.
+
+    ``on_started_leading`` runs ONCE, synchronously, at the moment of
+    acquisition and before the loop resumes renewing — this is where the
+    caller runs recovery (snapshot + delta replay) and starts its
+    informer; ``/readyz`` turns 200 only after it returns (the framework
+    gates readiness on recovery completion AND leadership).
+    ``on_stopped_leading`` fires if leadership is ever lost afterwards —
+    the safest production response is to exit and let the supervisor
+    restart the process into standby (the framework independently fences
+    bind writes either way).
+
+    While standing by, each idle beat invokes ``on_standby_beat`` (e.g.
+    prefetch the latest snapshot chunks so takeover starts warm)."""
+
+    def __init__(
+        self,
+        elector: LeaderElector,
+        on_started_leading: Callable[[], None],
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+        on_standby_beat: Optional[Callable[[], None]] = None,
+        sleep: Optional[Callable[[float], None]] = None,
+    ) -> None:
+        self.elector = elector
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self.on_standby_beat = on_standby_beat
+        self._stop = threading.Event()
+        self._sleep = sleep or self._stop.wait
+        self._thread: Optional[threading.Thread] = None
+        self.was_leading = False
+
+    def step(self) -> bool:
+        """One beat of the loop (synchronous, test-friendly): election
+        step, transition callbacks, standby prefetch. Returns leadership."""
+        leading = self.elector.try_acquire_or_renew()
+        if leading and not self.was_leading:
+            self.was_leading = True
+            self.on_started_leading()
+        elif not leading:
+            if self.was_leading:
+                self.was_leading = False
+                common.log.error(
+                    "leadership lost (lease expired or superseded)",
+                )
+                if self.on_stopped_leading is not None:
+                    self.on_stopped_leading()
+            if self.on_standby_beat is not None:
+                try:
+                    self.on_standby_beat()
+                except Exception:  # noqa: BLE001
+                    common.log.exception("standby beat failed")
+        return leading
+
+    def run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001
+                common.log.exception("leader election step failed")
+            self._sleep(self.elector.renew_s)
+
+    def start(self) -> None:
+        t = threading.Thread(
+            target=self.run, name="hived-leader-elector", daemon=True
+        )
+        self._thread = t
+        t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

@@ -35,6 +35,7 @@ damping off exactly on large fleets. Semantics:
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Set, Tuple
 
@@ -94,24 +95,49 @@ def drain_chip_indices(node: Node, all_chips: Set[int]) -> Set[int]:
 
 
 class _TargetRecord:
-    __slots__ = ("applied", "pending", "stamps", "last_flip")
+    __slots__ = ("applied", "pending", "stamps", "last_flip", "last_flip_wall")
 
     def __init__(self, applied: bool):
         self.applied = applied
         self.pending: Optional[bool] = None
         self.stamps: Deque[int] = deque()
         self.last_flip = -(1 << 30)
+        self.last_flip_wall = 0.0
 
 
 class FlapDamper:
     """Per-target hysteresis for health transitions (see module docstring).
-    threshold <= 0 disables damping entirely (every observation applies)."""
+    threshold <= 0 disables damping entirely (every observation applies).
 
-    def __init__(self, threshold: int, window: int, hold: int):
+    ``hold_seconds`` is the optional WALL-CLOCK settling floor (ROADMAP
+    "wall-clock damping tier"): when > 0, a held target that stayed quiet
+    for that many wall seconds settles at the next :meth:`settled` call
+    even if fewer than ``hold`` event ticks passed — so a quiet cluster
+    (whose only event-clock ticks are informer relist/watch-cycle ends,
+    minutes apart) settles promptly. The event clock stays authoritative
+    when ``hold_seconds`` is 0 (the default, and what chaos schedules use:
+    the wall clock is nondeterministic). ``now_fn`` is injectable for
+    tests."""
+
+    def __init__(
+        self,
+        threshold: int,
+        window: int,
+        hold: int,
+        hold_seconds: float = 0.0,
+        now_fn=time.monotonic,
+    ):
         self.threshold = threshold
         self.window = max(1, window)
         self.hold = max(1, hold)
+        self.hold_seconds = hold_seconds
+        self._now = now_fn
         self._records: Dict[Target, _TargetRecord] = {}
+        # Targets whose record currently holds a pending transition —
+        # settled()/pending_count() run per informer tick and per metrics
+        # scrape, so they must be O(pending), not O(all targets) (an
+        # all-records walk per node event made recovery O(nodes^2)).
+        self._pending: Dict[Target, None] = {}
 
     def observe(self, target: Target, desired: bool, clock: int) -> bool:
         """Record a desired health state for a target at ``clock``. Returns
@@ -126,7 +152,9 @@ class FlapDamper:
             return True
         if desired == rec.applied:
             # Flapped back before the hold expired: nothing to settle.
-            rec.pending = None
+            if rec.pending is not None:
+                rec.pending = None
+                self._pending.pop(target, None)
             return False
         if rec.pending is not None and desired == rec.pending:
             # A REPEATED identical observation of a held target (kubelet
@@ -136,25 +164,39 @@ class FlapDamper:
             return False
         rec.stamps.append(clock)
         rec.last_flip = clock
+        rec.last_flip_wall = self._now()
         while rec.stamps and rec.stamps[0] <= clock - self.window:
             rec.stamps.popleft()
         if self.threshold > 0 and len(rec.stamps) >= self.threshold:
             rec.pending = desired
+            self._pending[target] = None
             return False
         rec.applied = desired
         return True
 
     def settled(self, clock: int) -> List[Tuple[Target, bool]]:
-        """Held transitions whose targets stayed quiet for ``hold`` ticks:
-        their latest desired state is promoted to applied and returned for
-        the caller to enact."""
+        """Held transitions whose targets stayed quiet for ``hold`` ticks —
+        or, when the wall-clock floor is armed, for ``hold_seconds`` of
+        wall time: their latest desired state is promoted to applied and
+        returned for the caller to enact."""
+        if not self._pending:
+            return []
         out: List[Tuple[Target, bool]] = []
-        for target, rec in self._records.items():
-            if rec.pending is None:
+        now_wall = self._now() if self.hold_seconds > 0 else 0.0
+        for target in list(self._pending):
+            rec = self._records.get(target)
+            if rec is None or rec.pending is None:
+                self._pending.pop(target, None)
                 continue
-            if clock - rec.last_flip >= self.hold:
+            quiet_ticks = clock - rec.last_flip >= self.hold
+            quiet_wall = (
+                self.hold_seconds > 0
+                and now_wall - rec.last_flip_wall >= self.hold_seconds
+            )
+            if quiet_ticks or quiet_wall:
                 rec.applied = rec.pending
                 rec.pending = None
+                self._pending.pop(target, None)
                 out.append((target, rec.applied))
         return out
 
@@ -162,22 +204,30 @@ class FlapDamper:
         """Promote every held transition immediately (teardown / projection
         paths that need the damper drained deterministically)."""
         out: List[Tuple[Target, bool]] = []
-        for target, rec in self._records.items():
-            if rec.pending is not None:
+        for target in list(self._pending):
+            rec = self._records.get(target)
+            if rec is not None and rec.pending is not None:
                 rec.applied = rec.pending
                 rec.pending = None
                 out.append((target, rec.applied))
+            self._pending.pop(target, None)
         return out
 
     def pending_count(self) -> int:
-        # list(...) first: the lock-free metrics scrape calls this while
-        # observers mutate the record map; a dict-resize mid-iteration
-        # must not raise (values() alone would).
-        return sum(
-            1
-            for rec in list(self._records.values())
-            if rec.pending is not None
-        )
+        # len() alone: atomic under the GIL, safe against concurrent
+        # observers for the lock-free metrics scrape.
+        return len(self._pending)
+
+    def reset(self) -> None:
+        """Drop every record and pending hold. Called when the core's
+        health state is wholesale-replaced (snapshot restore, or the
+        virgin-core rebuild when a pre-applied standby's snapshot turns
+        out unusable at takeover): the applied-state memory describes the
+        projection being discarded, and keeping it would swallow the node
+        replay's re-observations as no-op non-flips (found by the
+        hot-standby discard test)."""
+        self._records.clear()
+        self._pending.clear()
 
     def forget_node(self, node_name: str) -> None:
         """Drop every record touching a node (node deleted: its flap
@@ -186,6 +236,7 @@ class FlapDamper:
             t for t in self._records if t[1] == node_name
         ]:
             del self._records[target]
+            self._pending.pop(target, None)
 
     def snapshot(self) -> List[Dict]:
         """Inspect view: the currently-held transitions."""

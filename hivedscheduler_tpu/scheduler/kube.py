@@ -280,6 +280,32 @@ class RetryingKubeClient(KubeClient):
             "scheduler-state ConfigMap read", self.inner.load_scheduler_state
         )
 
+    def persist_snapshot(self, chunks) -> None:
+        self._retrying_op(
+            "snapshot ConfigMap write",
+            lambda: self.inner.persist_snapshot(chunks),
+        )
+
+    def load_snapshot(self):
+        return self._retrying_op(
+            "snapshot ConfigMap read", self.inner.load_snapshot
+        )
+
+    def read_lease(self):
+        return self._retrying_op("leader Lease read", self.inner.read_lease)
+
+    def write_lease(self, spec, resource_version=None) -> None:
+        # A 409 (another participant won the optimistic write) is
+        # non-retryable by the shared classifier and raises straight
+        # through — the elector treats it correctly (leadership unchanged
+        # until local expiry). Transient transport errors retry.
+        self._retrying_op(
+            "leader Lease write",
+            lambda: self.inner.write_lease(
+                spec, resource_version=resource_version
+            ),
+        )
+
     def evict_pod(self, pod: Pod) -> None:
         try:
             self._retrying_op(
@@ -490,6 +516,194 @@ class KubeAPIClient(KubeClient):
             constants.DOOMED_LEDGER_CONFIG_MAP_KEY
         )
 
+    # ---------------- snapshot ConfigMap family ---------------- #
+
+    def _put_or_post_configmap(self, ns: str, name: str, data: Dict) -> None:
+        body = {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": ns},
+            "data": data,
+        }
+        try:
+            self._request(
+                "PUT", f"/api/v1/namespaces/{ns}/configmaps/{name}", body
+            )
+        except KubeAPIError as e:
+            if e.status != 404:
+                raise
+            self._request("POST", f"/api/v1/namespaces/{ns}/configmaps", body)
+
+    def persist_snapshot(self, chunks) -> None:
+        """Write the snapshot chunk family (scheduler.snapshot format:
+        ``chunks[0]`` is the meta header, the rest the body split at
+        ~900 KB). Body chunks land in ``<name>-<i>`` ConfigMaps FIRST and
+        the manifest (meta + chunk count) LAST — the commit point — so a
+        crash mid-write leaves either the previous complete snapshot or a
+        checksum/chunk-count mismatch the recovery ladder rejects."""
+        ns = self._state_namespace()
+        base = constants.SNAPSHOT_CONFIG_MAP_NAME
+        body_chunks = chunks[1:]
+        for i, chunk in enumerate(body_chunks):
+            self._put_or_post_configmap(
+                ns, f"{base}-{i}", {constants.SNAPSHOT_CHUNK_KEY: chunk}
+            )
+        self._put_or_post_configmap(
+            ns,
+            base,
+            {
+                constants.SNAPSHOT_META_KEY: chunks[0],
+                "chunkCount": str(len(body_chunks)),
+            },
+        )
+
+    def load_snapshot(self):
+        ns = self._state_namespace()
+        base = constants.SNAPSHOT_CONFIG_MAP_NAME
+        try:
+            manifest = self._request(
+                "GET", f"/api/v1/namespaces/{ns}/configmaps/{base}"
+            )
+        except KubeAPIError as e:
+            if e.status == 404:
+                return None
+            raise
+        data = manifest.get("data") or {}
+        meta = data.get(constants.SNAPSHOT_META_KEY)
+        if meta is None:
+            return None
+        try:
+            count = int(data.get("chunkCount") or 0)
+        except ValueError:
+            count = 0
+        chunks = [meta]
+        for i in range(count):
+            try:
+                obj = self._request(
+                    "GET", f"/api/v1/namespaces/{ns}/configmaps/{base}-{i}"
+                )
+            except KubeAPIError as e:
+                if e.status == 404:
+                    # Torn family (chunk GC'd or never written): return
+                    # what exists — the validation ladder's chunk-count
+                    # rung rejects it and recovery falls back.
+                    break
+                raise
+            chunks.append(
+                (obj.get("data") or {}).get(constants.SNAPSHOT_CHUNK_KEY, "")
+            )
+        return chunks
+
+    # ---------------- leader Lease (coordination.k8s.io) ---------------- #
+
+    def _lease_path(self) -> str:
+        ns = self._state_namespace()
+        return (
+            f"/apis/coordination.k8s.io/v1/namespaces/{ns}/leases/"
+            f"{constants.LEADER_LEASE_NAME}"
+        )
+
+    @staticmethod
+    def _micro_time(epoch_s: float) -> str:
+        return time.strftime(
+            "%Y-%m-%dT%H:%M:%S", time.gmtime(epoch_s)
+        ) + (".%06dZ" % int((epoch_s % 1) * 1e6))
+
+    @staticmethod
+    def _from_micro_time(value) -> float:
+        if not value:
+            return 0.0
+        try:
+            import calendar
+
+            base, _, frac = str(value).rstrip("Z").partition(".")
+            t = calendar.timegm(time.strptime(base, "%Y-%m-%dT%H:%M:%S"))
+            return t + (float("0." + frac) if frac else 0.0)
+        except (ValueError, OverflowError):
+            return 0.0
+
+    def read_lease(self):
+        """The Lease in the elector's shape: spec with NUMERIC
+        acquire/renew times (epoch seconds — production electors use
+        ``clock=time.time``) plus the resourceVersion for the optimistic
+        write-back."""
+        try:
+            obj = self._request("GET", self._lease_path())
+        except KubeAPIError as e:
+            if e.status == 404:
+                return None
+            raise
+        spec = obj.get("spec") or {}
+        return {
+            "spec": {
+                "holderIdentity": spec.get("holderIdentity") or "",
+                "leaseDurationSeconds": spec.get("leaseDurationSeconds"),
+                "acquireTime": self._from_micro_time(spec.get("acquireTime")),
+                "renewTime": self._from_micro_time(spec.get("renewTime")),
+                "leaseTransitions": spec.get("leaseTransitions") or 0,
+            },
+            "resourceVersion": (obj.get("metadata") or {}).get(
+                "resourceVersion"
+            ),
+        }
+
+    def write_lease(self, spec, resource_version=None) -> None:
+        ns = self._state_namespace()
+        metadata: Dict = {
+            "name": constants.LEADER_LEASE_NAME,
+            "namespace": ns,
+        }
+        if resource_version is not None:
+            # Optimistic concurrency: the PUT fails 409 when anyone else
+            # wrote since our read — exactly the standby-race guard.
+            metadata["resourceVersion"] = str(resource_version)
+        body = self._lease_body(metadata, spec)
+        if resource_version is None:
+            # No Lease observed: the write must be CREATE-ONLY. An
+            # unconditional PUT would let two standbys racing to create
+            # the very first Lease both "win" (the second overwrites the
+            # first with no precondition) — the POST is atomic, the loser
+            # gets 409 AlreadyExists and stays a standby.
+            self._request(
+                "POST",
+                f"/apis/coordination.k8s.io/v1/namespaces/{ns}/leases",
+                body,
+            )
+            return
+        try:
+            self._request("PUT", self._lease_path(), body)
+        except KubeAPIError as e:
+            if e.status != 404:
+                raise
+            # The Lease vanished between our read and the write: recreate
+            # (atomic — a racing creator wins and this raises 409).
+            body["metadata"].pop("resourceVersion", None)
+            self._request(
+                "POST",
+                f"/apis/coordination.k8s.io/v1/namespaces/{ns}/leases",
+                body,
+            )
+
+    def _lease_body(self, metadata: Dict, spec: Dict) -> Dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": "Lease",
+            "metadata": metadata,
+            "spec": {
+                "holderIdentity": spec.get("holderIdentity") or "",
+                "leaseDurationSeconds": int(
+                    spec.get("leaseDurationSeconds") or 0
+                ),
+                "acquireTime": self._micro_time(
+                    float(spec.get("acquireTime") or 0.0)
+                ),
+                "renewTime": self._micro_time(
+                    float(spec.get("renewTime") or 0.0)
+                ),
+                "leaseTransitions": int(spec.get("leaseTransitions") or 0),
+            },
+        }
+
     # ---------------- reads ---------------- #
 
     def list_raw(self, path: str) -> Dict:
@@ -594,10 +808,52 @@ class InformerLoop:
                     "doomed-ledger ConfigMap read failed; recovering without "
                     "it: %s", e,
                 )
-        self.scheduler.begin_recovery(ledger_payload)
+        with tr.span("snapshotLoad"):
+            # O(delta) recovery (doc/fault-model.md "HA and snapshot
+            # recovery plane"): with a valid snapshot imported, the initial
+            # pod relist below IS the delta replay — unchanged bound pods
+            # confirm in O(1), changed/new ones replay from annotations,
+            # and finish_recovery releases imported pods the list no
+            # longer carries.
+            snap = self.scheduler.load_valid_snapshot()
+        if snap is None:
+            # A hot standby pre-applied a snapshot that is unusable now
+            # (corrupted/deleted after the pre-apply): the full replay
+            # below must start from a virgin core, not confirm the
+            # pre-applied projection via the fingerprint fast path —
+            # recover()'s discard guard, mirrored here.
+            self.scheduler.discard_preapplied_state()
+        self.scheduler.begin_recovery(
+            ledger_payload, defer_doom_rebuild=snap is not None
+        )
         try:
+            # The live node list is FETCHED before the import but DISPATCHED
+            # after it — recover()'s ordering: the restore reinstates
+            # snapshot-time cell state (health included) wholesale, and the
+            # node dispatch then acts as the health half of the delta.
+            # Importing after the dispatch would wipe the live observations
+            # the relist just applied (a chip that broke while we were down
+            # would come back healthy until its next watch event).
+            with tr.span("nodeList"):
+                data = self.client.list_raw("/api/v1/nodes")
+                fresh_nodes = {
+                    n.name: n
+                    for n in (
+                        _node_from_k8s(i) for i in data.get("items", [])
+                    )
+                }
+                nodes_rv = str(
+                    (data.get("metadata") or {}).get("resourceVersion", "")
+                )
+            if snap is not None:
+                with tr.span("snapshotImport"):
+                    self.scheduler.import_snapshot(
+                        snap, list(fresh_nodes.values())
+                    )
             with tr.span("nodeReplay"):
-                nodes_rv = self._relist_nodes()
+                for name, node in fresh_nodes.items():
+                    self._known_nodes[name] = node
+                    self.scheduler.add_node(node)
             with tr.span("podReplay"):
                 pods_rv = self._relist_pods(initial=True)
         except BaseException:
@@ -653,6 +909,13 @@ class InformerLoop:
                 self.scheduler.update_node(old, node)
         return str((data.get("metadata") or {}).get("resourceVersion", ""))
 
+    def _note_watermark(self, rv: str) -> None:
+        """Advance the scheduler's snapshot watermark: the pod-stream
+        resourceVersion below which every change is already applied (and
+        therefore inside any snapshot exported from now on)."""
+        if rv:
+            self.scheduler.note_watermark(rv)
+
     def _relist_pods(self, initial: bool = False) -> str:
         data = self.client.list_raw("/api/v1/pods")
         fresh = {
@@ -670,7 +933,9 @@ class InformerLoop:
                 self.scheduler.add_pod(pod)
             else:
                 self.scheduler.update_pod(old, pod)
-        return str((data.get("metadata") or {}).get("resourceVersion", ""))
+        rv = str((data.get("metadata") or {}).get("resourceVersion", ""))
+        self._note_watermark(rv)
+        return rv
 
     # ---------------- watch loop ---------------- #
 
@@ -696,6 +961,10 @@ class InformerLoop:
                         raise _WatchGap("handler failure")
                     if rv:
                         resource_version = rv
+                        if handler == self._on_pod_event:
+                            # Bound-method equality, not identity: a fresh
+                            # bound-method object is created per access.
+                            self._note_watermark(rv)
                 # Bounded watch ended normally; resume from the last RV.
                 # Tick the health plane so held flaps settle on quiet
                 # clusters (one tick per watch period, deterministic in

@@ -1,0 +1,210 @@
+"""State snapshots: the serialized durable projection for O(delta) recovery.
+
+The reference keeps no database (PAPER.md): recovery replays every bound
+pod's annotation, which is minutes of scheduling blackout at a 100k-pod
+fleet. This module serializes the scheduler's DURABLE PROJECTION — exactly
+the state the chaos harness proves restart-equivalent (confirmed-bound
+pods with their decoded placements, the preemption checkpoints, applied
+health records, the doomed-ledger epoch, and the informer resourceVersion
+watermark) — into a chunked, checksummed payload a scheduler-owned
+ConfigMap family carries, so recovery becomes snapshot-import plus a
+delta replay of only what changed since the watermark
+(doc/fault-model.md "HA and snapshot recovery plane").
+
+Format: ``encode`` returns a chunk list whose FIRST element is a small
+JSON meta header (schema version, SHA-256 checksum and byte length of the
+body, chunk count, compiled-config fingerprint, watermark) and whose
+remaining elements are the JSON body split at ``CHUNK_BYTES`` boundaries
+(a ConfigMap tops out at 1 MiB; chunks leave headroom for the object
+envelope). ``decode`` is the validation ladder — every rung falls back to
+full annotation replay rather than guessing:
+
+  1. meta header decodes and carries the expected schema version;
+  2. chunk count and reassembled byte length match the header;
+  3. SHA-256 of the reassembled body matches;
+  4. the config fingerprint matches the running config (a reconfiguration
+     between snapshot and recovery invalidates every cell address);
+  5. the watermark is not older than ``min_watermark`` (the informer's
+     delta floor — a snapshot from before the watch window is stale);
+  6. the body decodes and is schema-shaped.
+
+Everything here is pure data transformation — no locks, no I/O — so the
+framework can serialize under its lock and write outside it (the PR-3
+doomed-ledger flush pattern).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, List, Optional, Tuple
+
+from ..api.config import Config
+
+# Bump when the body schema changes shape; decode refuses other versions
+# (rung 1 of the fallback ladder). The golden schema test pins the
+# serialized form in both directions. v2: the body gained the "core"
+# section (verbatim cell-level projection) and import switched from
+# per-pod re-admission to direct state restore.
+SCHEMA_VERSION = 2
+
+# Body bytes per chunk. A ConfigMap caps at 1 MiB total; 900 KB leaves
+# headroom for the object envelope and the apiserver's own accounting.
+CHUNK_BYTES = 900_000
+
+
+def config_fingerprint(config: Config) -> str:
+    """Identity of the COMPILED scheduling config: the physical topology and
+    the VC quota carve-up — everything that gives cell addresses meaning.
+    A snapshot taken under a different fingerprint is unusable (its
+    addresses may name different hardware), so decode() refuses it and
+    recovery replays annotations (which tolerate reconfiguration
+    per-placement). Webserver knobs deliberately excluded: retuning a
+    deadline must not invalidate snapshots."""
+    pc = config.physical_cluster
+    canonical = {
+        "cellTypes": {
+            str(name): {
+                "childCellType": str(ct.child_cell_type),
+                "childCellNumber": int(ct.child_cell_number),
+                "isNodeLevel": bool(ct.is_node_level),
+            }
+            for name, ct in sorted(pc.cell_types.items())
+        },
+        "physicalCells": [spec.to_dict() for spec in pc.physical_cells],
+        "virtualClusters": {
+            str(vcn): {
+                "virtualCells": [
+                    {"cellType": str(v.cell_type), "cellNumber": int(v.cell_number)}
+                    for v in spec.virtual_cells
+                ],
+                "pinnedCells": [
+                    {"pinnedCellId": str(p.pinned_cell_id)}
+                    for p in spec.pinned_cells
+                ],
+            }
+            for vcn, spec in sorted(config.virtual_clusters.items())
+        },
+    }
+    text = json.dumps(canonical, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def encode(
+    body: Dict,
+    fingerprint: str,
+    watermark,
+    schema_version: int = SCHEMA_VERSION,
+    chunk_bytes: int = CHUNK_BYTES,
+    pods_json: Optional[List[str]] = None,
+) -> List[str]:
+    """Serialize a snapshot body into the chunk list the KubeClient
+    persists: ``[meta-json, body-part-0, body-part-1, ...]``.
+
+    ``pods_json`` is the flusher's fast path: pre-serialized JSON texts
+    for the entries of ``body["pods"]``, memoized per bound pod across
+    flushes (a bound pod's record never changes, so re-dumping the pods
+    section — the bulk of the body at fleet scale — every flush was pure
+    GC churn). The section-wise assembly below is byte-identical to the
+    plain ``json.dumps(body)`` because dicts preserve insertion order
+    and the same separators are used throughout."""
+    if pods_json is None:
+        body_text = json.dumps(body, separators=(",", ":"))
+    else:
+        parts = []
+        for k, v in body.items():
+            if k == "pods":
+                parts.append('"pods":[' + ",".join(pods_json) + "]")
+            else:
+                parts.append(
+                    json.dumps(k)
+                    + ":"
+                    + json.dumps(v, separators=(",", ":"))
+                )
+        body_text = "{" + ",".join(parts) + "}"
+    data = body_text.encode()
+    chunks = [
+        body_text[i: i + chunk_bytes]
+        for i in range(0, len(body_text), chunk_bytes)
+    ] or [""]
+    meta = {
+        "schemaVersion": schema_version,
+        "checksum": hashlib.sha256(data).hexdigest(),
+        "bytes": len(data),
+        "chunks": len(chunks),
+        "configFingerprint": fingerprint,
+        "watermark": watermark,
+    }
+    return [json.dumps(meta, separators=(",", ":"))] + chunks
+
+
+def _watermark_older(watermark, floor) -> bool:
+    """True when ``watermark`` is provably older than ``floor``. K8s
+    resourceVersions are opaque strings that are integers in practice (the
+    harness uses plain ints); when either side does not parse as an int the
+    comparison is impossible and the snapshot is treated as stale — the
+    fallback is always safe, a wrong "fresh" verdict is not."""
+    try:
+        return int(watermark) < int(floor)
+    except (TypeError, ValueError):
+        return True
+
+
+def decode(
+    chunks: Optional[List[str]],
+    expected_fingerprint: str,
+    min_watermark=None,
+) -> Tuple[Optional[Dict], str]:
+    """Validate + reassemble a persisted chunk list. Returns
+    ``(body, "")`` on success or ``(None, reason)`` naming the first rung
+    of the fallback ladder that failed — the caller counts it
+    (snapshotFallbackCount) and runs the full annotation replay."""
+    if not chunks:
+        return None, "empty chunk list"
+    try:
+        meta = json.loads(chunks[0])
+    except (TypeError, ValueError) as e:
+        return None, f"meta header undecodable: {e}"
+    if not isinstance(meta, dict):
+        return None, "meta header is not an object"
+    if meta.get("schemaVersion") != SCHEMA_VERSION:
+        return None, (
+            f"schema version mismatch: snapshot {meta.get('schemaVersion')}, "
+            f"running {SCHEMA_VERSION}"
+        )
+    if meta.get("chunks") != len(chunks) - 1:
+        return None, (
+            f"chunk count mismatch: header says {meta.get('chunks')}, "
+            f"got {len(chunks) - 1}"
+        )
+    body_text = "".join(chunks[1:])
+    data = body_text.encode()
+    if meta.get("bytes") != len(data):
+        return None, (
+            f"length mismatch: header says {meta.get('bytes')} bytes, "
+            f"got {len(data)} (truncated or padded)"
+        )
+    checksum = hashlib.sha256(data).hexdigest()
+    if meta.get("checksum") != checksum:
+        return None, "checksum mismatch (corrupt snapshot)"
+    if meta.get("configFingerprint") != expected_fingerprint:
+        return None, (
+            "config fingerprint mismatch (reconfigured since the snapshot)"
+        )
+    if min_watermark is not None and _watermark_older(
+        meta.get("watermark"), min_watermark
+    ):
+        return None, (
+            f"stale watermark: snapshot at {meta.get('watermark')!r}, delta "
+            f"floor {min_watermark!r}"
+        )
+    try:
+        body = json.loads(body_text)
+    except ValueError as e:
+        return None, f"body undecodable: {e}"
+    if not isinstance(body, dict) or not isinstance(body.get("pods"), list):
+        return None, "body is not snapshot-shaped (missing pods list)"
+    if not isinstance(body.get("core"), dict):
+        return None, "body is not snapshot-shaped (missing core projection)"
+    body["_meta"] = meta
+    return body, ""

@@ -50,6 +50,11 @@ COUNTERS: Dict[str, tuple] = {
     "gangAdmissionBatchedCount": ("hived_gang_admissions_batched_total", "pods admitted through the decode-free gang admission path"),
     "preemptProbeIncrementalCount": ("hived_preempt_probes_incremental_total", "preempt probes served from the epoch-gated victims cache"),
     "traceSampledCount": ("hived_traces_sampled_total", "requests sampled into the trace ring"),
+    "mappingRetryCount": ("hived_mapping_retries_total", "guaranteed schedules that succeeded after retrying past a failed virtual-to-physical mapping"),
+    "snapshotPersistCount": ("hived_snapshot_persists_total", "successful snapshot ConfigMap writes"),
+    "snapshotPersistFailureCount": ("hived_snapshot_persist_failures_total", "failed snapshot ConfigMap writes"),
+    "snapshotFallbackCount": ("hived_snapshot_fallbacks_total", "recoveries that fell back from an unusable snapshot to full annotation replay"),
+    "deposedBindRefusedCount": ("hived_deposed_bind_refusals_total", "bind writes refused because this process no longer holds the leader lease"),
 }
 
 GAUGES: Dict[str, tuple] = {
@@ -60,6 +65,9 @@ GAUGES: Dict[str, tuple] = {
     "drainingChipCount": ("hived_draining_chips", "chips currently draining (maintenance plane)"),
     "healthPendingCount": ("hived_health_pending_transitions", "health transitions currently held by the flap damper"),
     "ready": ("hived_ready", "1 once recovery completed (readyz), else 0"),
+    "leader": ("hived_leader", "1 while this process holds (or needs no) leader lease, else 0"),
+    "snapshotImportedPodCount": ("hived_snapshot_imported_pods", "bound pods bulk-imported from the snapshot at the last recovery"),
+    "snapshotDeltaPodCount": ("hived_snapshot_delta_pods", "pods replayed or released as deltas past the snapshot at the last recovery"),
 }
 
 # get_metrics keys -> histogram family names.
@@ -89,6 +97,7 @@ EXCLUDED_KEYS = {
     "lockWaitByChain",      # rendered as hived_lock_* labeled series
     "latencyHistograms",    # rendered as hived_*_latency_seconds
     "lockSharding",         # string mode flag ("chains"/"global")
+    "recoveryMode",         # string mode flag ("none"/"full"/"snapshot+delta")
 }
 
 

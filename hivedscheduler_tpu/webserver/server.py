@@ -221,11 +221,22 @@ def _make_handler(scheduler: HivedScheduler):
                 # a recovering scheduler is alive but must not get traffic.)
                 return {"status": "ok"}
             if path == constants.READYZ_PATH:
+                # Readiness = leadership AND recovery completion: a warm
+                # standby (or a deposed leader) is alive but must receive
+                # no extender traffic — K8s routes to the active leader
+                # only (doc/fault-model.md "HA and snapshot recovery
+                # plane").
+                if not scheduler.is_leader():
+                    raise api.WebServerError(
+                        503, "standby: not the leader (lease held elsewhere)"
+                    )
                 if not scheduler.is_ready():
                     raise api.WebServerError(
                         503, "recovering: initial cluster replay in progress"
                     )
                 return {"status": "ready"}
+            if path == constants.HA_PATH:
+                return scheduler.get_ha()
             if path == constants.QUARANTINE_PATH:
                 return scheduler.get_quarantine()
             if path == dcp or path == dcp + "/":

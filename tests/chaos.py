@@ -15,7 +15,16 @@ generator interleaves
     corrupted pod),
   - crash-restart: a fresh scheduler + ``recover()`` from the surviving
     cluster state, checked for restart-equivalence against the continuous
-    scheduler's durable projection.
+    scheduler's durable projection,
+  - the HA / snapshot recovery plane (doc/fault-model.md "HA and snapshot
+    recovery plane"): periodic snapshot flushes, snapshot corruption and
+    watermark staleness (recovery must fall back to the full annotation
+    replay, deterministically), and lease-based failovers — the leader
+    self-deposes at lease expiry, the standby acquires through the
+    optimistic write, snapshot+delta recovery is asserted strictly
+    equivalent to a full replay, and a deposed leader is refused bind
+    writes (split-brain fence), including one parked between filter and
+    bind.
 
 After every event the harness audits structural invariants over the live
 core (``audit_invariants``):
@@ -64,6 +73,8 @@ from hivedscheduler_tpu.algorithm.core import (
 )
 from hivedscheduler_tpu.algorithm.group import GroupState
 from hivedscheduler_tpu.api import constants, extender as ei, types as api
+from hivedscheduler_tpu.scheduler import ha as ha_mod
+from hivedscheduler_tpu.scheduler import snapshot as snapshot_mod
 from hivedscheduler_tpu.scheduler.framework import HivedScheduler, KubeClient
 from hivedscheduler_tpu.scheduler.kube import KubeAPIError, RetryingKubeClient
 from hivedscheduler_tpu.scheduler.types import (
@@ -104,10 +115,28 @@ DEFAULT_EVENT_WEIGHTS = (
     ("inject_write_faults", 3.0),
     ("crash_restart", 5.0),
     ("reconfigure_restart", 4.0),
+    # HA / snapshot recovery plane (doc/fault-model.md "HA and snapshot
+    # recovery plane"): periodic snapshot flushes, snapshot corruption and
+    # watermark staleness (both must degrade recovery to the full
+    # annotation replay deterministically), and lease-based failovers —
+    # including losing the lease between an assume-bind and its bind write
+    # (the deposed leader must refuse the write).
+    ("snapshot_flush", 6.0),
+    ("snapshot_corrupt", 2.0),
+    ("stale_snapshot", 1.5),
+    ("failover", 3.0),
+    ("failover_mid_bind", 2.0),
 )
 
 _HEALTH_FAMILY = (
     "node_flip", "chip_fault", "chip_heal", "flap_storm", "drain_toggle",
+)
+
+# The "ha" alias of HIVED_CHAOS_MIX multiplies the whole failover/snapshot
+# family (hack/soak.sh --failover weights it up).
+_HA_FAMILY = (
+    "snapshot_flush", "snapshot_corrupt", "stale_snapshot", "failover",
+    "failover_mid_bind",
 )
 
 
@@ -128,6 +157,9 @@ def event_weights(mix_env: Optional[str] = None) -> List:
             continue
         if name.strip() == "health":
             for ev in _HEALTH_FAMILY:
+                mult[ev] = mult.get(ev, 1.0) * factor
+        elif name.strip() == "ha":
+            for ev in _HA_FAMILY:
                 mult[ev] = mult.get(ev, 1.0) * factor
         else:
             mult[name.strip()] = factor
@@ -176,6 +208,14 @@ class ScriptedKubeClient(KubeClient):
         self.state_fault_queue: deque = deque()
         self.state: Optional[str] = None  # the doomed-ledger ConfigMap
         self.state_writes = 0
+        # The snapshot ConfigMap family + leader Lease (HA plane). Both
+        # survive harness crash-restarts because the client object does —
+        # exactly like the real apiserver.
+        self.snapshot: Optional[List[str]] = None
+        self.snapshot_writes = 0
+        self.snapshot_fault_queue: deque = deque()
+        self.lease: Optional[Dict] = None
+        self.lease_rv = 0
         self.on_patch = None  # callable(pod, patch) or None
         self.on_evict = None  # callable(pod) or None
         self.patches: List[tuple] = []
@@ -198,6 +238,44 @@ class ScriptedKubeClient(KubeClient):
 
     def load_scheduler_state(self) -> Optional[str]:
         return self.state
+
+    def persist_snapshot(self, chunks) -> None:
+        if self.snapshot_fault_queue:
+            fault = self.snapshot_fault_queue.popleft()
+            if fault is not None:
+                raise fault
+        self.snapshot = list(chunks)
+        self.snapshot_writes += 1
+
+    def load_snapshot(self) -> Optional[List[str]]:
+        return list(self.snapshot) if self.snapshot is not None else None
+
+    def read_lease(self) -> Optional[Dict]:
+        if self.lease is None:
+            return None
+        return {
+            "spec": dict(self.lease["spec"]),
+            "resourceVersion": self.lease["resourceVersion"],
+        }
+
+    def write_lease(self, spec, resource_version=None) -> None:
+        # Optimistic concurrency exactly like the apiserver: a stale
+        # resourceVersion precondition fails 409 (two standbys racing for
+        # an expired lease — only the first write wins), and a write
+        # WITHOUT a resourceVersion is create-only (two standbys racing to
+        # create the very first Lease — only the first POST wins).
+        if resource_version is None:
+            if self.lease is not None:
+                raise KubeAPIError("POST", "/leases", 409, "already exists")
+        elif (
+            self.lease is not None
+            and str(resource_version) != str(self.lease["resourceVersion"])
+        ):
+            raise KubeAPIError(
+                "PUT", "/leases", 409, "resourceVersion conflict"
+            )
+        self.lease_rv += 1
+        self.lease = {"spec": dict(spec), "resourceVersion": self.lease_rv}
 
     def patch_pod_annotations(self, pod, annotations) -> None:
         if self.patch_fault_queue:
@@ -627,9 +705,23 @@ class ChaosHarness:
             "patch_faults": 0,
             "state_faults": 0,
             "degraded_crashes": 0,
+            # HA / snapshot recovery plane.
+            "snapshot_flushes": 0,
+            "snapshot_recoveries": 0,
+            "snapshot_fallbacks": 0,
+            "snapshot_doom_fallbacks": 0,
+            "snapshot_corruptions": 0,
+            "stale_snapshots": 0,
+            "failovers": 0,
+            "hot_takeovers": 0,
+            "deposed_bind_refusals": 0,
         }
         self.weights = event_weights()
         self.total_weight = sum(w for _, w in self.weights)
+        # The HA plane's deterministic wall clock: leases are acquired and
+        # expire only when a failover event advances it, so leadership is a
+        # pure function of the event schedule.
+        self.ha_clock = 100.0
         self.scheduler = self._new_scheduler()
         self.node_health = {
             n: True for n in self.scheduler.core.configured_node_names()
@@ -944,6 +1036,118 @@ class ChaosHarness:
             target.extend(
                 transient_fault() for _ in range(MAX_BIND_ATTEMPTS)
             )
+
+    # ---------------- HA / snapshot recovery plane ---------------- #
+
+    LEASE_DURATION_S = 10.0
+    LEASE_RENEW_S = 3.0
+
+    def _new_elector(self, identity: str) -> ha_mod.LeaderElector:
+        return ha_mod.LeaderElector(
+            self.kube,
+            identity,
+            duration_s=self.LEASE_DURATION_S,
+            renew_s=self.LEASE_RENEW_S,
+            clock=lambda: self.ha_clock,
+        )
+
+    def snapshot_flush(self) -> None:
+        """One snapshot-flusher beat: stamp the watermark (the harness's
+        event index plays the informer's resourceVersion) and persist the
+        durable projection to the scripted snapshot ConfigMap."""
+        self.scheduler.note_watermark(self.event_i)
+        if self.scheduler.flush_snapshot_now():
+            self.stats["snapshot_flushes"] += 1
+
+    def snapshot_corrupt(self) -> None:
+        """Corrupt the persisted snapshot (one of the validation ladder's
+        failure shapes): recovery must detect it and fall back to the full
+        annotation replay — deterministically, never a partial import."""
+        snap = self.kube.snapshot
+        if not snap:
+            return
+        import json as _json
+
+        mode = self.rnd.choice(
+            ["truncate", "flip", "garbage_meta", "schema", "drop_chunk"]
+        )
+        if mode == "truncate":
+            snap[-1] = snap[-1][: len(snap[-1]) // 2]
+        elif mode == "flip":
+            i = self.rnd.randrange(1, len(snap))
+            if not snap[i]:
+                return
+            pos = self.rnd.randrange(len(snap[i]))
+            flipped = "X" if snap[i][pos] != "X" else "Y"
+            snap[i] = snap[i][:pos] + flipped + snap[i][pos + 1:]
+        elif mode == "garbage_meta":
+            snap[0] = "not-json{{{"
+        elif mode == "schema":
+            try:
+                meta = _json.loads(snap[0])
+            except ValueError:
+                return  # meta already garbled by an earlier corruption
+            meta["schemaVersion"] = snapshot_mod.SCHEMA_VERSION + 1
+            snap[0] = _json.dumps(meta, separators=(",", ":"))
+        elif mode == "drop_chunk":
+            if len(snap) > 1:
+                snap.pop()
+        self.stats["snapshot_corruptions"] += 1
+
+    def stale_snapshot(self) -> None:
+        """Rewind the persisted snapshot's watermark below the informer's
+        delta floor (the harness always recovers with floor 0): rung 5 of
+        the validation ladder must refuse it and fall back."""
+        snap = self.kube.snapshot
+        if not snap:
+            return
+        import json as _json
+
+        try:
+            meta = _json.loads(snap[0])
+        except ValueError:
+            return  # meta already garbled by an earlier corruption
+        meta["watermark"] = -1
+        snap[0] = _json.dumps(meta, separators=(",", ":"))
+        self.stats["stale_snapshots"] += 1
+
+    def failover(self) -> None:
+        self.crash_restart(failover=True)
+
+    def failover_mid_bind(self) -> None:
+        self.crash_restart(failover=True, mid_bind=True)
+
+    def _start_pending_bind(self):
+        """Create a fresh 1-pod gang and run it through filter ONLY: an
+        assume-bind allocation whose bind write has not happened yet — the
+        state a leader holds when its lease expires mid-bind. Returns
+        (pod, node) or None when the filter waited/rejected."""
+        self.gang_seq += 1
+        name = f"g{self.seed}-{self.gang_seq}"
+        vc = self.rnd.choice(["A", "B"])
+        chips = self.rnd.choice([1, 2, 4])
+        pod = make_pod(
+            f"{name}-0", f"u-{name}-0", vc, 0,
+            self.rnd.choice(["v5e-chip", "v5p-chip"]), chips,
+            group={
+                "name": name,
+                "members": [{"podNumber": 1, "leafCellNumber": chips}],
+            },
+        )
+        self.cluster_pods[pod.uid] = pod
+        self.scheduler.add_pod(pod)
+        try:
+            result = self.scheduler.filter_routine(
+                ei.ExtenderArgs(pod=pod, node_names=self.live_nodes())
+            )
+        except api.WebServerError:
+            self.scheduler.delete_pod(pod)
+            self.cluster_pods.pop(pod.uid, None)
+            return None
+        self.gangs[name] = [pod.uid]
+        if not result.node_names:
+            return None  # waiting: nothing assume-bound to fence
+        return pod, result.node_names[0]
 
     def audit_desired_health(self) -> None:
         """Invariant 7 (health consistency, damping half): any target the
@@ -1284,7 +1488,12 @@ class ChaosHarness:
                 return "zombie-checkpoint"  # clear patch failed
         return None
 
-    def crash_restart(self, reconfigure: bool = False) -> None:
+    def crash_restart(
+        self,
+        reconfigure: bool = False,
+        failover: bool = False,
+        mid_bind: bool = False,
+    ) -> None:
         """Invariant 4: a fresh scheduler recovered from the surviving
         cluster state must be equivalent to the continuous scheduler's
         durable projection — asserted STRICTLY (full quota ledgers, free
@@ -1299,6 +1508,25 @@ class ChaosHarness:
         fidelity, and the structural invariants — and the teardown pristine
         baseline is rebased onto the new config.
 
+        ``failover`` replaces the crash with an active-standby takeover
+        (doc/fault-model.md "HA and snapshot recovery plane"): the leader's
+        lease expires (apiserver partition — it cannot renew), it must
+        SELF-DEPOSE strictly before the standby can acquire, the standby
+        wins the expired lease through the optimistic write, recovers, and
+        the deposed leader must never land a bind write afterwards —
+        ``mid_bind`` sharpens that by parking an assume-bind between
+        filter and bind when the lease is lost (the refused write is the
+        split-brain fence). All crash-restart assertions apply to the
+        takeover identically: a failover IS a recovery.
+
+        Snapshot plane (asserted on every restart/failover): when the
+        persisted snapshot validates, recovery must take the
+        snapshot+delta path AND land in exactly the state a full
+        annotation replay lands in (strict fingerprint + probe
+        equivalence); when a snapshot exists but is corrupt/stale,
+        recovery must fall back to the full replay with
+        snapshotFallbackCount incremented — and stay deterministic.
+
         A crash that lands inside a documented degraded window (stale
         ledger / stale or zombie preempt checkpoint from scripted write
         faults, or damper-held health transitions) asserts recovery
@@ -1306,6 +1534,31 @@ class ChaosHarness:
         that state is exactly what a real crash loses."""
         self.stats["restarts"] += 1
         old = self.scheduler
+        pending_bind = None
+        if failover:
+            self.stats["failovers"] += 1
+            if old.leadership is None:
+                boot = self._new_elector(f"s{self.seed}-n{self.stats['restarts']}a")
+                old.leadership = boot
+                if not boot.try_acquire_or_renew():
+                    # A previous leader CRASHED (plain restart) without
+                    # stepping down: its lease is still unexpired. Waiting
+                    # out the duration is the protocol — then acquisition
+                    # must succeed.
+                    self.ha_clock += self.LEASE_DURATION_S + 0.5
+                    assert boot.try_acquire_or_renew(), (
+                        self.seed, "bootstrap lease acquisition failed",
+                    )
+            assert old.is_leader(), (self.seed, "leader lost lease early")
+            if mid_bind:
+                pending_bind = self._start_pending_bind()
+            # The lease expires: the leader cannot reach the apiserver to
+            # renew. is_leader() must turn False from the local clock alone
+            # (self-deposal — the fencing half of the split-brain argument).
+            self.ha_clock += self.LEASE_DURATION_S + 0.5
+            assert not old.is_leader(), (
+                self.seed, "leader did not self-depose at lease expiry",
+            )
         if any(
             g.state == GroupState.PREEMPTING
             for g in old.core.affinity_groups.values()
@@ -1325,6 +1578,11 @@ class ChaosHarness:
         self.kube.state_fault_queue.clear()
         self.kube.patch_fault_queue.clear()
         state_at_crash = self.kube.state
+        snapshot_at_crash = (
+            list(self.kube.snapshot)
+            if self.kube.snapshot is not None
+            else None
+        )
         nodes_at_crash = [
             self._node_obj(n) for n in sorted(self.node_health)
         ]
@@ -1332,8 +1590,63 @@ class ChaosHarness:
             self.cluster_pods[uid] for uid in sorted(self.cluster_pods)
         ]
         new = self._new_scheduler()
-        new.recover(nodes_at_crash, pods_at_crash)
+        if failover:
+            if self.stats["restarts"] % 2 == 0:
+                # HOT standby on alternating takeovers: production's
+                # on_standby_beat pre-applies the latest snapshot into the
+                # standby's own core while waiting (prefetch_snapshot
+                # apply=True, __main__), so takeover skips the decode and
+                # restore. The contract below is asserted UNCHANGED — a
+                # pre-applied takeover must land in exactly the state a
+                # cold snapshot restore (and a full annotation replay)
+                # lands in. Keyed off the restart counter, not self.rnd:
+                # consuming an extra draw would shift every later event
+                # and invalidate the pinned sensitivity seeds.
+                if new.prefetch_snapshot(min_watermark=0, apply=True):
+                    self.stats["hot_takeovers"] += 1
+            # The standby acquires the EXPIRED lease through the optimistic
+            # resourceVersion write, then recovers (StandbyLoop ordering:
+            # on_started_leading runs recovery before readiness flips).
+            standby = self._new_elector(
+                f"s{self.seed}-n{self.stats['restarts']}b"
+            )
+            new.leadership = standby
+            assert standby.try_acquire_or_renew(), (
+                self.seed, "standby could not acquire the expired lease",
+            )
+            assert new.is_leader()
+            # Split-brain fence: the deposed leader must never write a bind
+            # — neither the parked mid-flight one nor any other.
+            binds_before = set(self.kube.bound)
+            if pending_bind is not None:
+                pod, node = pending_bind
+                try:
+                    old.bind_routine(
+                        ei.ExtenderBindingArgs(
+                            pod_name=pod.name,
+                            pod_namespace=pod.namespace,
+                            pod_uid=pod.uid,
+                            node=node,
+                        )
+                    )
+                    raise AssertionError(
+                        (self.seed, "deposed leader bind was not refused")
+                    )
+                except api.WebServerError as e:
+                    assert e.code == 503, (self.seed, e.code)
+                assert (
+                    old.metrics.snapshot()["deposedBindRefusedCount"] == 1
+                ), self.seed
+                self.stats["deposed_bind_refusals"] += 1
+            assert set(self.kube.bound) == binds_before, (
+                self.seed, "deposed leader landed a bind write",
+            )
+        new.recover(nodes_at_crash, pods_at_crash, min_watermark=0)
         assert new.is_ready(), (self.seed, "recover() must flip readiness")
+        self._assert_snapshot_recovery_contract(
+            new, snapshot_at_crash, state_at_crash,
+            nodes_at_crash, pods_at_crash,
+        )
         m = new.metrics.snapshot()
         self.stats["preempt_recovered"] += m["preemptionRecoveredCount"]
         self.stats["preempt_cancelled_on_recovery"] += (
@@ -1383,12 +1696,154 @@ class ChaosHarness:
             self._assert_restart_equivalence(old, new, expected_q)
         else:
             self._assert_degraded_recovery(
-                new, state_at_crash, nodes_at_crash, pods_at_crash
+                new, state_at_crash, nodes_at_crash, pods_at_crash,
+                snapshot_at_crash,
             )
 
         audit_invariants(new, f"seed={self.seed} post-restart")
         self.scheduler = new
         self._sync_preemptions()
+
+    def _recover_shadow(
+        self,
+        nodes_at_crash: List[Node],
+        pods_at_crash: List[Pod],
+        state_at_crash: Optional[str],
+        snapshot_at_crash: Optional[List[str]],
+    ) -> HivedScheduler:
+        """An out-of-band recovery from a copy of the crash-time inputs
+        (apiserver truth, doomed ledger, optionally the snapshot family) —
+        the comparison subject for the determinism and snapshot-vs-full
+        equivalence contracts. Its side effects land on a throwaway client,
+        never the shared apiserver truth."""
+        kube2 = ScriptedKubeClient()
+        kube2.state = state_at_crash
+        kube2.snapshot = (
+            list(snapshot_at_crash) if snapshot_at_crash is not None else None
+        )
+        shadow = HivedScheduler(
+            self._config(), force_bind_executor=lambda fn: fn()
+        )
+        shadow.kube_client = RetryingKubeClient(
+            kube2,
+            scheduler=shadow,
+            max_attempts=MAX_BIND_ATTEMPTS,
+            backoff_initial_s=0.01,
+            backoff_max_s=0.08,
+            sleep=lambda s: None,
+            jitter_rng=random.Random(self.seed ^ 0xBEEF),
+        )
+        shadow.core.preempt_rng = random.Random(self.seed ^ 0xF00D)
+        shadow.recover(nodes_at_crash, pods_at_crash, min_watermark=0)
+        return shadow
+
+    @staticmethod
+    def _snapshot_dooms_match_ledger(
+        expected: Dict, state_at_crash: Optional[str]
+    ) -> bool:
+        """Mirror of framework._snapshot_dooms_match_ledger, computed from
+        the crash-time artifacts so the harness can predict which side of
+        the doom-staleness gate a recovery must take."""
+        ledger = None
+        if state_at_crash:
+            try:
+                ledger = common.from_yaml(state_at_crash)
+            except Exception:  # noqa: BLE001
+                ledger = None
+        if not isinstance(ledger, dict):
+            ledger = {}
+        ledger_dooms = {
+            (str(vcn), str(e.get("chain")), int(e.get("level", -1)),
+             str(e.get("address")))
+            for vcn, entries in (ledger.get("vcs") or {}).items()
+            for e in entries
+        }
+        snap_dooms = {
+            (str(vcn), str(chain), int(level), str(addr))
+            for vcn, per_chain in (
+                (expected.get("core") or {}).get("vcDoomed") or {}
+            ).items()
+            for chain, levels in per_chain.items()
+            for level, addrs in levels.items()
+            for addr in addrs
+        }
+        return snap_dooms == ledger_dooms
+
+    def _assert_snapshot_recovery_contract(
+        self,
+        new: HivedScheduler,
+        snapshot_at_crash: Optional[List[str]],
+        state_at_crash: Optional[str],
+        nodes_at_crash: List[Node],
+        pods_at_crash: List[Pod],
+    ) -> None:
+        """The tentpole contract, asserted at every restart/failover:
+
+        - a VALID persisted snapshot (the decode ladder is the oracle —
+          schema, chunks, checksum, config fingerprint, watermark) must be
+          USED (recovery mode snapshot+delta) and must land in EXACTLY the
+          state a full annotation replay lands in: strict core fingerprints
+          (counters, leaf states, free sets, doomed ledgers) plus probe
+          outcomes — O(delta) recovery is an optimization, never a
+          different answer;
+        - a present-but-unusable snapshot (corrupt, truncated, stale
+          watermark, reconfigured-away fingerprint) must fall back to the
+          full replay with snapshotFallbackCount incremented."""
+        if not snapshot_at_crash:
+            return
+        expected, _reason = snapshot_mod.decode(
+            snapshot_at_crash, new._config_fingerprint, 0
+        )
+        if expected is not None and not self._snapshot_dooms_match_ledger(
+            expected, state_at_crash
+        ):
+            # The documented doom-staleness gate (framework.import_snapshot):
+            # advisory doomed bindings are history-dependent and organic
+            # doom churn is suspended during recovery, so a snapshot whose
+            # doomed set diverged from the crash ledger cannot be
+            # delta-converged — it must fall back to the full replay, which
+            # is the proven PR-3 path.
+            assert new._recovery_mode == "full", (
+                self.seed, "doom-diverged snapshot was not refused",
+                new._recovery_mode,
+            )
+            assert (
+                new.metrics.snapshot()["snapshotFallbackCount"] >= 1
+            ), (self.seed, "doom-divergence fallback not counted")
+            self.stats["snapshot_doom_fallbacks"] += 1
+            return
+        if expected is not None:
+            assert new._recovery_mode == "snapshot+delta", (
+                self.seed, "valid snapshot not used for recovery",
+                new._recovery_mode,
+            )
+            self.stats["snapshot_recoveries"] += 1
+            full = self._recover_shadow(
+                nodes_at_crash, pods_at_crash, state_at_crash, None
+            )
+            assert full._recovery_mode == "full"
+            assert core_fingerprint(full.core) == core_fingerprint(
+                new.core
+            ), (
+                self.seed,
+                "snapshot+delta recovery diverges from full replay",
+            )
+            nodes = self.live_nodes()
+            assert probe_outcomes(
+                full.core, nodes, self.seed
+            ) == probe_outcomes(new.core, nodes, self.seed), (
+                self.seed,
+                "probe outcomes diverge: snapshot+delta vs full replay",
+            )
+        else:
+            assert new._recovery_mode == "full", (
+                self.seed, "unusable snapshot did not fall back",
+                new._recovery_mode,
+            )
+            assert (
+                new.metrics.snapshot()["snapshotFallbackCount"] >= 1
+            ), (self.seed, "fallback not counted")
+            self.stats["snapshot_fallbacks"] += 1
 
     def _assert_degraded_recovery(
         self,
@@ -1396,30 +1851,18 @@ class ChaosHarness:
         state_at_crash: Optional[str],
         nodes_at_crash: List[Node],
         pods_at_crash: List[Pod],
+        snapshot_at_crash: Optional[List[str]] = None,
     ) -> None:
         """Degraded-crash contract (stale ledger / stale checkpoint /
         damper-held transitions at crash): strict equivalence against the
         continuous side is impossible by design, but recovery must still be
         DETERMINISTIC — a second recovery from the identical crash-time
-        inputs lands in the identical state. (Work preservation, quarantine
-        fidelity, and the structural invariants were already asserted
-        unconditionally by the caller.)"""
-        kube2 = ScriptedKubeClient()
-        kube2.state = state_at_crash
-        again = HivedScheduler(
-            self._config(), force_bind_executor=lambda fn: fn()
+        inputs (snapshot included) lands in the identical state. (Work
+        preservation, quarantine fidelity, and the structural invariants
+        were already asserted unconditionally by the caller.)"""
+        again = self._recover_shadow(
+            nodes_at_crash, pods_at_crash, state_at_crash, snapshot_at_crash
         )
-        again.kube_client = RetryingKubeClient(
-            kube2,
-            scheduler=again,
-            max_attempts=MAX_BIND_ATTEMPTS,
-            backoff_initial_s=0.01,
-            backoff_max_s=0.08,
-            sleep=lambda s: None,
-            jitter_rng=random.Random(self.seed ^ 0xBEEF),
-        )
-        again.core.preempt_rng = random.Random(self.seed ^ 0xF00D)
-        again.recover(nodes_at_crash, pods_at_crash)
         assert core_fingerprint(again.core) == core_fingerprint(new.core), (
             self.seed, "degraded recovery is not deterministic",
         )

@@ -54,6 +54,32 @@ def test_bench_smoke_p50_and_phase_breakdown():
     json.dumps(result)
 
 
+def test_bench_recovery_blackout_smoke():
+    """Tiny run of the HIVED_BENCH_RECOVERY stage (ISSUE 7 satellite):
+    full-replay vs snapshot+delta recovery of the same crashed fleet,
+    in-process at a 42-host config. CI machines are too noisy to gate the
+    5x speedup here (the driver bench's 432-host stage does); this guards
+    the wiring — the warm path must actually take the snapshot+delta
+    route (asserted inside the stage via _recovery_mode) and every
+    artifact key must be present and serializable."""
+    result = bench.bench_recovery_blackout(
+        cubes=2, slices=2, solos=2, n_gangs=40, reps=1,
+        flusher_reps=1, flusher_interval_s=0.2,
+    )
+    assert result["pods_recovered"] > 0
+    assert result["full_replay_ms"] > 0
+    assert result["snapshot_delta_ms"] > 0
+    assert result["snapshot_cold_ms"] > 0
+    # Wiring, not a perf gate: the snapshot path must at least not LOSE
+    # to full replay even on a tiny fleet and a noisy CI box.
+    assert result["speedup"] > 1.0, result
+    assert result["speedup_budget"] == 5.0
+    ab = result["flusher_ab"]
+    assert ab["p50_on_ms"] > 0 and ab["p50_off_ms"] > 0
+    assert "overhead_pct" in ab and "budget_pct" in ab
+    json.dumps(result)
+
+
 def test_bench_concurrent_smoke():
     """Tiny run of the HIVED_BENCH_CONCURRENT stage: two worker threads
     over two disjoint chains, sharded vs forced-global, in-process. CI

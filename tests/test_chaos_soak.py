@@ -6,7 +6,7 @@ Driven by ``hack/soak.sh``; run directly with e.g.
     HIVED_CHAOS_ROUNDS=5000 HIVED_CHAOS_START=10000 \
         python -m pytest tests/test_chaos_soak.py -m slow -q
 
-``HIVED_CHAOS_START`` defaults past the tier-1 range (0..219) so soaks
+``HIVED_CHAOS_START`` defaults past the tier-1 range (0..299) so soaks
 cover fresh seeds instead of re-running CI's. ``HIVED_CHAOS_MIX`` reweights
 the event mix (see tests/chaos.py event_weights) — e.g.
 ``HIVED_CHAOS_MIX=health:3`` triples the whole health-plane family
@@ -21,7 +21,7 @@ import pytest
 from . import chaos
 
 SOAK_ROUNDS = int(os.environ.get("HIVED_CHAOS_ROUNDS", "0")) or 2000
-SOAK_START = int(os.environ.get("HIVED_CHAOS_START", "0")) or 220
+SOAK_START = int(os.environ.get("HIVED_CHAOS_START", "0")) or 300
 
 
 @pytest.mark.slow
@@ -53,5 +53,12 @@ def test_chaos_soak():
         required.append("flap_storms")
     if weights.get("drain_toggle"):
         required.append("drains")
+    # HA / snapshot recovery plane (hack/soak.sh --failover weights it
+    # up): snapshots must flush and drive snapshot+delta recoveries, and
+    # failovers must run the takeover protocol end to end.
+    if weights.get("snapshot_flush"):
+        required += ["snapshot_flushes", "snapshot_recoveries"]
+    if weights.get("failover"):
+        required.append("failovers")
     for key in required:
         assert stats[key] > 0, (key, stats)

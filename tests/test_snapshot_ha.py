@@ -1,0 +1,711 @@
+"""HA / snapshot recovery plane acceptance tests (doc/fault-model.md "HA
+and snapshot recovery plane").
+
+Golden snapshot-schema tests pin the serialized form in BOTH directions
+(like tests/test_observability.py does for /metrics): the exported chunk
+family must carry exactly the documented meta/body keys, and a
+hand-written golden snapshot must import into a live placement — a field
+added in code without updating the schema version (or vice versa) fails
+here instead of corrupting a production recovery.
+
+The fallback ladder is exercised rung by rung — truncated, garbage,
+wrong-schema, chunk-count-mismatch, checksum-corrupt, reconfigured-away
+fingerprint, stale-watermark snapshots all degrade recovery to the full
+annotation replay with ``snapshotFallbackCount`` incremented and an END
+STATE IDENTICAL to a replay that never saw a snapshot.
+
+The Lease elector and standby loop are unit-tested against the scripted
+kube client: acquisition, renewal, non-theft of an unexpired lease,
+takeover at expiry, self-deposal without apiserver contact, the
+optimistic-write race between two standbys, and the deposed leader's
+bind fence + readiness gate.
+"""
+
+import json
+import random
+
+import pytest
+
+from hivedscheduler_tpu.api import constants, extender as ei, types as api
+from hivedscheduler_tpu.scheduler import ha as ha_mod
+from hivedscheduler_tpu.scheduler import snapshot as snapshot_mod
+from hivedscheduler_tpu.scheduler.framework import HivedScheduler
+from hivedscheduler_tpu.scheduler.kube import RetryingKubeClient
+from hivedscheduler_tpu.scheduler.types import Node, PodState
+
+from . import chaos
+from .test_core import make_pod
+from .test_placement_equivalence import random_config
+
+# The pinned snapshot schema: every key the exported form may carry, in
+# both the meta header and the body. Adding a field here REQUIRES bumping
+# snapshot_mod.SCHEMA_VERSION (old snapshots must not half-decode into the
+# new shape) — this test is the reminder.
+GOLDEN_META_KEYS = {
+    "schemaVersion", "checksum", "bytes", "chunks", "configFingerprint",
+    "watermark",
+}
+GOLDEN_BODY_KEYS = {"doomedEpoch", "health", "core", "pods"}
+GOLDEN_POD_KEYS = {
+    "name", "namespace", "uid", "node", "phase", "resourceLimits",
+    "annotations", "spec", "bindInfo", "podIndex",
+}
+# The core projection (schema v2): verbatim cell-level state restored by
+# direct field assignment at recovery. The sparse cell records are fixed-
+# arity arrays — their layout is part of the schema.
+GOLDEN_CORE_KEYS = {
+    "phys", "virt", "freeLists", "badFree", "vcDoomed", "otCells",
+    "counters", "groups",
+}
+GOLDEN_COUNTER_KEYS = {"vcFree", "allVCFree", "totalLeft", "allVCDoomed"}
+GOLDEN_GROUP_KEYS = {
+    "spec", "vc", "lazyPreemptionEnable", "priority", "state",
+    "ignoreSuggested", "lazyPreemptionStatus", "phys", "virt",
+}
+GOLDEN_PHYS_REC_ARITY = 9  # state, prio, healthy, draining, split,
+#                            usingGroup, virtualAddr, usedAtPrio, unusable
+GOLDEN_VIRT_REC_ARITY = 5  # state, prio, healthy, usedAtPrio, unusable
+
+
+def _booted(seed=7, kube=None):
+    sched = HivedScheduler(
+        random_config(random.Random(seed)),
+        force_bind_executor=lambda fn: fn(),
+    )
+    inner = kube if kube is not None else chaos.ScriptedKubeClient()
+    sched.kube_client = RetryingKubeClient(
+        inner, scheduler=sched, sleep=lambda s: None,
+        jitter_rng=random.Random(1),
+    )
+    for n in sched.core.configured_node_names():
+        sched.add_node(Node(name=n))
+    sched.mark_ready()
+    return sched, inner
+
+
+def _bind_one(sched, inner, name, uid, vc="A", chips=2):
+    pod = make_pod(
+        name, uid, vc, 0, "v5e-chip", chips,
+        group={"name": name,
+               "members": [{"podNumber": 1, "leafCellNumber": chips}]},
+    )
+    sched.add_pod(pod)
+    nodes = sorted(sched.nodes)
+    result = sched.filter_routine(ei.ExtenderArgs(pod=pod, node_names=nodes))
+    assert result.node_names, (name, result.failed_nodes)
+    sched.bind_routine(
+        ei.ExtenderBindingArgs(
+            pod_name=pod.name, pod_namespace=pod.namespace,
+            pod_uid=pod.uid, node=result.node_names[0],
+        )
+    )
+    bound = inner.bound[uid]
+    bound.phase = "Running"
+    sched.update_pod(pod, bound)
+    return bound
+
+
+# --------------------------------------------------------------------- #
+# Golden schema (both directions)
+# --------------------------------------------------------------------- #
+
+
+def test_golden_snapshot_schema_export():
+    """Forward direction: the exported chunk family carries exactly the
+    pinned meta/body/pod key sets at the pinned schema version."""
+    sched, inner = _booted()
+    _bind_one(sched, inner, "snap-0", "u-snap-0")
+    sched.note_watermark(41)
+    chunks = sched.export_snapshot()
+    assert chunks is not None and len(chunks) >= 2
+
+    meta = json.loads(chunks[0])
+    assert set(meta) == GOLDEN_META_KEYS, set(meta) ^ GOLDEN_META_KEYS
+    assert meta["schemaVersion"] == snapshot_mod.SCHEMA_VERSION == 2
+    assert meta["watermark"] == 41
+    assert meta["configFingerprint"] == sched._config_fingerprint
+    assert meta["chunks"] == len(chunks) - 1
+
+    body = json.loads("".join(chunks[1:]))
+    assert set(body) == GOLDEN_BODY_KEYS, set(body) ^ GOLDEN_BODY_KEYS
+    assert len(body["pods"]) == 1
+    pod_rec = body["pods"][0]
+    assert set(pod_rec) == GOLDEN_POD_KEYS, set(pod_rec) ^ GOLDEN_POD_KEYS
+    assert pod_rec["uid"] == "u-snap-0"
+
+    core = body["core"]
+    assert set(core) == GOLDEN_CORE_KEYS, set(core) ^ GOLDEN_CORE_KEYS
+    assert set(core["counters"]) == GOLDEN_COUNTER_KEYS
+    assert core["phys"], "a bound pod must produce sparse cell records"
+    for rec in core["phys"].values():
+        assert len(rec) == GOLDEN_PHYS_REC_ARITY
+    for rec in core["virt"].values():
+        assert len(rec) == GOLDEN_VIRT_REC_ARITY
+    assert len(core["groups"]) == 1
+    grp = core["groups"]["snap-0"]
+    assert set(grp) == GOLDEN_GROUP_KEYS, set(grp) ^ GOLDEN_GROUP_KEYS
+    assert grp["state"] == "Allocated"  # the flusher gate admits no other
+    # The embedded spec/bindInfo are the documented annotation DTO shapes.
+    assert api.PodSchedulingSpec.from_dict(pod_rec["spec"]).virtual_cluster
+    info = api.PodBindInfo.from_dict(pod_rec["bindInfo"])
+    assert info.node == pod_rec["node"]
+    assert info.leaf_cell_isolation
+    # Round-trip through decode: the export validates against itself.
+    snap, reason = snapshot_mod.decode(
+        chunks, sched._config_fingerprint, min_watermark=0
+    )
+    assert snap is not None, reason
+
+
+def test_golden_snapshot_schema_import():
+    """Reverse direction: a hand-written snapshot in the documented form
+    imports into a live, correctly-placed bound pod — the serialized form
+    is a CONTRACT, not an implementation detail."""
+    s1, inner = _booted()
+    bound = _bind_one(s1, inner, "gold-0", "u-gold-0")
+    spec = api.PodSchedulingSpec.from_dict(
+        __import__("yaml").safe_load(
+            bound.annotations[constants.ANNOTATION_POD_SCHEDULING_SPEC]
+        )
+    )
+    info = api.PodBindInfo.from_dict(
+        __import__("yaml").safe_load(
+            bound.annotations[constants.ANNOTATION_POD_BIND_INFO]
+        )
+    )
+    golden_body = {
+        "doomedEpoch": 0,
+        "health": s1.core.health_snapshot(),
+        # The core projection is machine-scale state; the hand-written
+        # contract here is the POD record and the body envelope. The core
+        # section's shape is pinned by the export-direction golden test,
+        # and its restore semantics by the equivalence suites.
+        "core": s1.core.export_projection(),
+        "pods": [
+            {
+                "name": bound.name,
+                "namespace": bound.namespace,
+                "uid": bound.uid,
+                "node": bound.node_name,
+                "phase": "Running",
+                "resourceLimits": dict(bound.resource_limits),
+                "annotations": dict(bound.annotations),
+                "spec": spec.to_dict(),
+                "bindInfo": info.to_dict(),
+                "podIndex": 0,
+            }
+        ],
+    }
+    kube2 = chaos.ScriptedKubeClient()
+    s2, _ = _booted(kube=kube2)
+    chunks = snapshot_mod.encode(
+        golden_body, s2._config_fingerprint, watermark=7
+    )
+    kube2.snapshot = chunks
+    s3, _ = _booted(kube=kube2)
+    s3._ready.clear()
+    s3.recover(
+        [Node(name=n) for n in sorted(s1.nodes)], [bound], min_watermark=0
+    )
+    assert s3._recovery_mode == "snapshot+delta"
+    st = s3.pod_schedule_statuses["u-gold-0"]
+    assert st.pod_state == PodState.BOUND
+    assert st.pod.node_name == bound.node_name
+    assert chaos.leaf_fingerprint(s3.core) == chaos.leaf_fingerprint(s1.core)
+
+
+def test_snapshot_chunking_roundtrip():
+    """Bodies past the chunk boundary split and reassemble losslessly."""
+    body = {"pods": [], "core": {}, "blob": "x" * 5000}
+    chunks = snapshot_mod.encode(body, "fp", watermark=3, chunk_bytes=512)
+    assert len(chunks) > 3  # meta + many body parts
+    snap, reason = snapshot_mod.decode(chunks, "fp", min_watermark=0)
+    assert snap is not None, reason
+    assert snap["blob"] == body["blob"]
+
+
+# --------------------------------------------------------------------- #
+# The fallback ladder
+# --------------------------------------------------------------------- #
+
+
+def _corruptions():
+    def truncate(c):
+        c[-1] = c[-1][: len(c[-1]) // 2]
+
+    def flip(c):
+        c[1] = c[1][:5] + ("X" if c[1][5] != "X" else "Y") + c[1][6:]
+
+    def garbage_meta(c):
+        c[0] = "not-json{{{"
+
+    def wrong_schema(c):
+        meta = json.loads(c[0])
+        meta["schemaVersion"] = snapshot_mod.SCHEMA_VERSION + 1
+        c[0] = json.dumps(meta)
+
+    def drop_chunk(c):
+        c.pop()
+
+    def stale_watermark(c):
+        meta = json.loads(c[0])
+        meta["watermark"] = -1
+        c[0] = json.dumps(meta)
+
+    return [truncate, flip, garbage_meta, wrong_schema, drop_chunk,
+            stale_watermark]
+
+
+@pytest.mark.parametrize(
+    "corrupt", _corruptions(), ids=lambda f: f.__name__
+)
+def test_unusable_snapshot_falls_back_to_full_replay(corrupt):
+    """Every rung of the ladder: recovery detects the unusable snapshot,
+    counts the fallback, and lands in EXACTLY the full-replay state."""
+    s1, inner = _booted()
+    b1 = _bind_one(s1, inner, "f-0", "u-f-0", vc="A")
+    b2 = _bind_one(s1, inner, "f-1", "u-f-1", vc="B")
+    s1.note_watermark(5)
+    assert s1.flush_snapshot_now()
+    corrupt(inner.snapshot)
+
+    nodes = [Node(name=n) for n in sorted(s1.nodes)]
+    s2, _ = _booted(kube=inner)
+    s2._ready.clear()
+    s2.recover(nodes, [b1, b2], min_watermark=0)
+    assert s2._recovery_mode == "full"
+    assert s2.get_metrics()["snapshotFallbackCount"] == 1
+
+    kube3 = chaos.ScriptedKubeClient()  # no snapshot at all
+    s3, _ = _booted(kube=kube3)
+    s3._ready.clear()
+    s3.recover(nodes, [b1, b2], min_watermark=0)
+    assert chaos.core_fingerprint(s2.core) == chaos.core_fingerprint(s3.core)
+    chaos.audit_invariants(s2, "fallback-recovery")
+
+
+def test_config_fingerprint_invalidates_snapshot():
+    """A reconfiguration between snapshot and recovery (different compiled
+    config) refuses the snapshot — its cell addresses may name different
+    hardware — and replays annotations, which tolerate reconfiguration."""
+    s1, inner = _booted(seed=7)
+    b1 = _bind_one(s1, inner, "rc-0", "u-rc-0")
+    assert s1.flush_snapshot_now()
+    other = HivedScheduler(random_config(random.Random(8)))
+    assert other._config_fingerprint != s1._config_fingerprint
+    snap, reason = snapshot_mod.decode(
+        inner.snapshot, other._config_fingerprint
+    )
+    assert snap is None and "fingerprint" in reason
+
+
+def test_valid_snapshot_recovery_is_delta_and_equivalent():
+    """The O(delta) happy path: a valid snapshot is imported decode-free,
+    the unchanged live pod confirms in O(1) (zero delta), and the end
+    state equals the continuous scheduler's."""
+    s1, inner = _booted()
+    b1 = _bind_one(s1, inner, "d-0", "u-d-0")
+    s1.note_watermark(3)
+    assert s1.flush_snapshot_now()
+    m1 = s1.get_metrics()
+    assert m1["snapshotPersistCount"] == 1
+
+    s2, _ = _booted(kube=inner)
+    s2._ready.clear()
+    s2.recover(
+        [Node(name=n) for n in sorted(s1.nodes)], [b1], min_watermark=0
+    )
+    assert s2._recovery_mode == "snapshot+delta"
+    m2 = s2.get_metrics()
+    assert m2["snapshotImportedPodCount"] == 1
+    assert m2["snapshotDeltaPodCount"] == 0
+    assert m2["snapshotFallbackCount"] == 0
+    assert chaos.leaf_fingerprint(s2.core) == chaos.leaf_fingerprint(s1.core)
+    assert chaos.free_set_fingerprint(s2.core) == (
+        chaos.free_set_fingerprint(s1.core)
+    )
+
+
+def test_snapshot_delta_replays_changed_and_vanished_pods():
+    """The delta paths: a pod DELETED after the snapshot is released, a
+    pod BOUND after the snapshot replays from annotations — both counted
+    as deltas."""
+    s1, inner = _booted()
+    dead = _bind_one(s1, inner, "dd-0", "u-dd-0", vc="A")
+    assert s1.flush_snapshot_now()  # snapshot holds only the doomed pod
+    late = _bind_one(s1, inner, "dl-0", "u-dl-0", vc="B")
+
+    # Crash: dd-0 was deleted while down; dl-0 (not in the snapshot)
+    # survives.
+    s2, _ = _booted(kube=inner)
+    s2._ready.clear()
+    s2.recover(
+        [Node(name=n) for n in sorted(s1.nodes)], [late], min_watermark=0
+    )
+    assert s2._recovery_mode == "snapshot+delta"
+    assert "u-dd-0" not in s2.pod_schedule_statuses
+    assert s2.pod_schedule_statuses["u-dl-0"].pod_state == PodState.BOUND
+    m = s2.get_metrics()
+    assert m["snapshotImportedPodCount"] == 1
+    assert m["snapshotDeltaPodCount"] == 2  # one released + one replayed
+    chaos.audit_invariants(s2, "delta-recovery")
+
+
+def test_hot_standby_preapply_takeover_matches_cold_restore():
+    """The hot-standby fast path (prefetch_snapshot(apply=True), wired as
+    __main__'s on_standby_beat): the standby restores the projection into
+    its own core on an idle beat, so the takeover skips decode + restore
+    and runs only the delta replay — and must land in EXACTLY the state a
+    cold snapshot restore lands in."""
+    s1, inner = _booted()
+    b1 = _bind_one(s1, inner, "h-0", "u-h-0", vc="A")
+    b2 = _bind_one(s1, inner, "h-1", "u-h-1", vc="B")
+    s1.note_watermark(3)
+    assert s1.flush_snapshot_now()
+    live_nodes = [Node(name=n) for n in sorted(s1.nodes)]
+
+    hot, _ = _booted(kube=inner)
+    hot._ready.clear()
+    assert hot.prefetch_snapshot(min_watermark=0, apply=True)
+    assert hot._preapplied_chunks == inner.snapshot
+    # A second idle beat with an unchanged chunk family is a no-op.
+    assert hot.prefetch_snapshot(min_watermark=0, apply=True)
+    hot.recover(live_nodes, [b1, b2], min_watermark=0)
+    assert hot._recovery_mode == "snapshot+delta"
+
+    cold, _ = _booted(kube=inner)
+    cold._ready.clear()
+    cold.recover(live_nodes, [b1, b2], min_watermark=0)
+    assert cold._recovery_mode == "snapshot+delta"
+
+    assert chaos.leaf_fingerprint(hot.core) == chaos.leaf_fingerprint(
+        cold.core
+    )
+    assert chaos.free_set_fingerprint(hot.core) == (
+        chaos.free_set_fingerprint(cold.core)
+    )
+    assert set(hot.pod_schedule_statuses) == set(cold.pod_schedule_statuses)
+    chaos.audit_invariants(hot, "hot-takeover")
+
+
+def test_hot_standby_reapplies_changed_snapshot():
+    """A standby beat after the leader flushed a NEWER snapshot discards
+    the pre-applied projection and restores the new one (byte-equality of
+    the chunk family is the reuse key)."""
+    s1, inner = _booted()
+    b1 = _bind_one(s1, inner, "hc-0", "u-hc-0", vc="A")
+    assert s1.flush_snapshot_now()
+    hot, _ = _booted(kube=inner)
+    hot._ready.clear()
+    assert hot.prefetch_snapshot(min_watermark=0, apply=True)
+    first_family = hot._preapplied_chunks
+
+    b2 = _bind_one(s1, inner, "hc-1", "u-hc-1", vc="B")
+    assert s1.flush_snapshot_now()
+    assert hot.prefetch_snapshot(min_watermark=0, apply=True)
+    assert hot._preapplied_chunks == inner.snapshot
+    assert hot._preapplied_chunks != first_family
+
+    hot.recover(
+        [Node(name=n) for n in sorted(s1.nodes)], [b1, b2], min_watermark=0
+    )
+    assert hot._recovery_mode == "snapshot+delta"
+    assert hot.get_metrics()["snapshotImportedPodCount"] == 2
+    assert set(hot.pod_schedule_statuses) == {"u-hc-0", "u-hc-1"}
+
+
+def test_preapplied_standby_discards_when_snapshot_unusable_at_takeover():
+    """The discard ladder: a pre-applied standby whose snapshot was
+    deleted (or corrupted) after the pre-apply must throw the pre-applied
+    projection away WHOLESALE and run the full annotation replay from a
+    virgin core — degraded recovery stays deterministic and equivalent to
+    a replay that never saw a snapshot."""
+    for wreck in ("delete", "corrupt"):
+        s1, inner = _booted()
+        b1 = _bind_one(s1, inner, "hd-0", f"u-hd-{wreck}", vc="A")
+        assert s1.flush_snapshot_now()
+        live_nodes = [Node(name=n) for n in sorted(s1.nodes)]
+
+        hot, _ = _booted(kube=inner)
+        hot._ready.clear()
+        assert hot.prefetch_snapshot(min_watermark=0, apply=True)
+        if wreck == "delete":
+            inner.snapshot = None
+        else:
+            inner.snapshot = [inner.snapshot[0], '{"garbage": true}']
+        hot.recover(live_nodes, [b1], min_watermark=0)
+        assert hot._recovery_mode == "full", wreck
+        if wreck == "corrupt":
+            assert hot.get_metrics()["snapshotFallbackCount"] >= 1
+
+        plain, _ = _booted(kube=chaos.ScriptedKubeClient())
+        plain._ready.clear()
+        plain.recover(live_nodes, [b1], min_watermark=0)
+        assert plain._recovery_mode == "full"
+        assert chaos.leaf_fingerprint(hot.core) == chaos.leaf_fingerprint(
+            plain.core
+        ), wreck
+        assert set(hot.pod_schedule_statuses) == {f"u-hd-{wreck}"}, wreck
+        chaos.audit_invariants(hot, f"discarded-preapply-{wreck}")
+
+
+def test_preapply_refused_on_a_ready_scheduler():
+    """A serving leader must never wholesale-restore under traffic:
+    apply=True on a ready scheduler still prefetches (decode cache) but
+    does not touch the live core."""
+    s1, inner = _booted()
+    _bind_one(s1, inner, "hr-0", "u-hr-0")
+    assert s1.flush_snapshot_now()
+    before = chaos.leaf_fingerprint(s1.core)
+    assert s1.prefetch_snapshot(min_watermark=0, apply=True)
+    assert s1._preapplied_chunks is None
+    assert s1._prefetched_snapshot is not None
+    assert chaos.leaf_fingerprint(s1.core) == before
+    assert set(s1.pod_schedule_statuses) == {"u-hr-0"}
+
+
+def test_flusher_skips_while_recovering_and_when_deposed():
+    """export_snapshot is None during recovery (a half-replayed view must
+    never overwrite a complete snapshot); flush_snapshot_now is a no-op on
+    a non-leader (it would clobber the new leader's snapshot stream)."""
+    sched, inner = _booted()
+    sched._ready.clear()
+    assert sched.export_snapshot() is None
+    sched.mark_ready()
+    clock = [0.0]
+    el = ha_mod.LeaderElector(
+        inner, "me", duration_s=10, renew_s=3, clock=lambda: clock[0]
+    )
+    sched.leadership = el
+    assert not sched.is_leader()
+    assert not sched.flush_snapshot_now()
+    assert inner.snapshot is None
+    assert el.try_acquire_or_renew()
+    assert sched.flush_snapshot_now()
+    assert inner.snapshot is not None
+
+
+# --------------------------------------------------------------------- #
+# Lease elector + standby loop
+# --------------------------------------------------------------------- #
+
+
+def _elector(kube, identity, clock, duration=10.0):
+    return ha_mod.LeaderElector(
+        kube, identity, duration_s=duration, renew_s=3.0,
+        clock=lambda: clock[0],
+    )
+
+
+def test_elector_acquire_renew_and_nontheft():
+    kube = chaos.ScriptedKubeClient()
+    clock = [100.0]
+    a = _elector(kube, "a", clock)
+    b = _elector(kube, "b", clock)
+    assert a.try_acquire_or_renew() and a.is_leader()
+    # An unexpired lease cannot be stolen.
+    assert not b.try_acquire_or_renew() and not b.is_leader()
+    assert b.observed_holder == "a"
+    # Renewal extends the hold.
+    clock[0] += 8.0
+    assert a.try_acquire_or_renew()
+    clock[0] += 8.0  # 16s after acquiry but only 8 after renewal
+    assert a.is_leader()
+    assert not b.try_acquire_or_renew()
+
+
+def test_elector_takeover_at_expiry_and_self_deposal():
+    kube = chaos.ScriptedKubeClient()
+    clock = [100.0]
+    a = _elector(kube, "a", clock)
+    b = _elector(kube, "b", clock)
+    assert a.try_acquire_or_renew()
+    # The leader is partitioned from the apiserver: it cannot renew. At
+    # expiry it must SELF-DEPOSE from the local clock alone — strictly
+    # before the standby can have acquired (the split-brain fence).
+    clock[0] += 10.5
+    assert not a.is_leader()
+    assert b.try_acquire_or_renew() and b.is_leader()
+    # The old leader observes the new holder and stays deposed.
+    assert not a.try_acquire_or_renew()
+    assert a.observed_holder == "b"
+
+
+def test_elector_optimistic_write_race():
+    """Two standbys race for an expired lease: the optimistic
+    resourceVersion precondition lets exactly one win."""
+    kube = chaos.ScriptedKubeClient()
+    clock = [100.0]
+    a = _elector(kube, "a", clock)
+    assert a.try_acquire_or_renew()
+    clock[0] += 10.5  # expired
+
+    b = _elector(kube, "b", clock)
+    c = _elector(kube, "c", clock)
+    # Both read the same expired lease; b writes first and wins; c's write
+    # hits the 409 precondition and must NOT claim leadership.
+    assert b.try_acquire_or_renew()
+    assert not c.try_acquire_or_renew()
+    assert not c.is_leader()
+    assert kube.lease["spec"]["holderIdentity"] == "b"
+
+
+def test_elector_step_down_is_immediate_handoff():
+    kube = chaos.ScriptedKubeClient()
+    clock = [100.0]
+    a = _elector(kube, "a", clock)
+    b = _elector(kube, "b", clock)
+    assert a.try_acquire_or_renew()
+    a.step_down()
+    assert not a.is_leader()
+    # No expiry wait: the zeroed renewTime lets the standby acquire now.
+    assert b.try_acquire_or_renew()
+
+
+def test_elector_fresh_lease_create_race_single_winner():
+    """Two standbys racing to create the very FIRST Lease (no object
+    exists): the write must be create-only, so exactly one wins — an
+    unconditional PUT would let both become leader (split brain)."""
+    kube = chaos.ScriptedKubeClient()
+    clock = [100.0]
+    a = _elector(kube, "a", clock)
+    b = _elector(kube, "b", clock)
+    # Both observe "no lease" (b's read races ahead of a's create).
+    real_read = kube.read_lease
+    kube.read_lease = lambda: None
+    assert a.try_acquire_or_renew() and a.is_leader()
+    assert not b.try_acquire_or_renew()
+    assert not b.is_leader()
+    kube.read_lease = real_read
+    assert kube.lease["spec"]["holderIdentity"] == "a"
+
+
+def test_elector_late_step_down_does_not_clobber_new_holder():
+    """A deposed leader's graceful shutdown must not blank a lease another
+    elector has since acquired — that would let a THIRD elector acquire
+    while the new holder still considers itself leader."""
+    kube = chaos.ScriptedKubeClient()
+    clock = [100.0]
+    a = _elector(kube, "a", clock)
+    b = _elector(kube, "b", clock)
+    c = _elector(kube, "c", clock)
+    assert a.try_acquire_or_renew()
+    clock[0] += 10.5  # a expires without renewing
+    assert b.try_acquire_or_renew() and b.is_leader()
+    a.step_down()  # late: b already holds the lease
+    assert kube.lease["spec"]["holderIdentity"] == "b"
+    assert not c.try_acquire_or_renew()  # b's unexpired lease stands
+    assert b.is_leader() and not c.is_leader()
+
+
+def test_elector_write_failure_keeps_local_expiry():
+    """Transport trouble on renewal must not extend OR revoke leadership:
+    the last successful renewal's local expiry stands."""
+    kube = chaos.ScriptedKubeClient()
+    clock = [100.0]
+    a = _elector(kube, "a", clock)
+    assert a.try_acquire_or_renew()
+
+    def broken_write(spec, resource_version=None):
+        raise chaos.transient_fault()
+
+    kube.write_lease = broken_write
+    clock[0] += 5.0
+    assert a.try_acquire_or_renew()  # renewal failed but lease not expired
+    clock[0] += 5.5  # past the ORIGINAL expiry
+    assert not a.try_acquire_or_renew()
+    assert not a.is_leader()
+
+
+def test_standby_loop_transitions():
+    kube = chaos.ScriptedKubeClient()
+    clock = [100.0]
+    events = []
+    a = _elector(kube, "a", clock)
+    loop_a = ha_mod.StandbyLoop(
+        a,
+        on_started_leading=lambda: events.append("a-lead"),
+        on_stopped_leading=lambda: events.append("a-stop"),
+    )
+    b = _elector(kube, "b", clock)
+    loop_b = ha_mod.StandbyLoop(
+        b,
+        on_started_leading=lambda: events.append("b-lead"),
+        on_standby_beat=lambda: events.append("b-beat"),
+    )
+    assert loop_a.step() is True
+    assert loop_b.step() is False  # standing by, prefetch beat fires
+    assert events == ["a-lead", "b-beat"]
+    assert loop_a.step() is True  # renewal: no duplicate callback
+    assert events == ["a-lead", "b-beat"]
+    clock[0] += 10.5  # a's lease expires (cannot renew in time)
+    assert loop_b.step() is True  # b takes over
+    assert loop_a.step() is False  # a observes + reports the loss
+    assert events == ["a-lead", "b-beat", "b-lead", "a-stop"]
+
+
+def test_deposed_leader_bind_is_refused():
+    """The framework half of the split-brain fence: a deposed leader's
+    bind write is refused with 503 + counted, and its queued advisory
+    writes are dropped, not flushed."""
+    sched, inner = _booted()
+    pod = make_pod(
+        "z-0", "u-z", "A", 0, "v5e-chip", 2,
+        group={"name": "z-0",
+               "members": [{"podNumber": 1, "leafCellNumber": 2}]},
+    )
+    sched.add_pod(pod)
+    nodes = sorted(sched.nodes)
+    result = sched.filter_routine(ei.ExtenderArgs(pod=pod, node_names=nodes))
+    assert result.node_names
+
+    clock = [100.0]
+    el = _elector(inner, "old-leader", clock)
+    sched.leadership = el
+    assert el.try_acquire_or_renew()
+    clock[0] += 10.5  # lease lost between filter and bind
+    assert not sched.is_leader()
+    with pytest.raises(api.WebServerError) as exc:
+        sched.bind_routine(
+            ei.ExtenderBindingArgs(
+                pod_name=pod.name, pod_namespace=pod.namespace,
+                pod_uid=pod.uid, node=result.node_names[0],
+            )
+        )
+    assert exc.value.code == 503
+    assert "u-z" not in inner.bound
+    assert sched.get_metrics()["deposedBindRefusedCount"] == 1
+    assert sched.get_metrics()["leader"] is False
+
+
+def test_readyz_gates_on_leadership_and_recovery():
+    """/readyz is 503 on a standby (not the leader) AND while recovering;
+    /v1/inspect/ha reports both axes."""
+    from hivedscheduler_tpu.webserver import server as server_mod
+
+    sched, inner = _booted()
+    handler_cls = server_mod._make_handler(sched)
+
+    class Probe(handler_cls):  # bypass HTTP plumbing, call the router
+        def __init__(self):
+            pass
+
+    probe = Probe()
+    assert probe._route_get(constants.READYZ_PATH)["status"] == "ready"
+
+    clock = [100.0]
+    el = _elector(inner, "me", clock)
+    sched.leadership = el  # installed but never acquired: a standby
+    with pytest.raises(api.WebServerError) as exc:
+        probe._route_get(constants.READYZ_PATH)
+    assert exc.value.code == 503
+    ha_payload = probe._route_get(constants.HA_PATH)
+    assert ha_payload["haEnabled"] is True
+    assert ha_payload["leader"] is False
+    assert ha_payload["identity"] == "me"
+
+    assert el.try_acquire_or_renew()
+    assert probe._route_get(constants.READYZ_PATH)["status"] == "ready"
+    sched._ready.clear()  # leader but still recovering
+    with pytest.raises(api.WebServerError):
+        probe._route_get(constants.READYZ_PATH)
