@@ -606,6 +606,256 @@ def bench_concurrent(
     }
 
 
+# ---------------------------------------------------------------------- #
+# Multi-process core stage (HIVED_BENCH_PROCS=1): per-chain-family worker
+# shards vs the in-process core (doc/hot-path.md "The multi-process
+# contract")
+# ---------------------------------------------------------------------- #
+
+
+def _family_fill_load(fam: int, rep: str, nodes, n_gangs: int):
+    """Pre-built (pods, JSON bodies) for one family's fill phase: 2-pod
+    4-chip gangs until the family is full. Bodies are built OUTSIDE the
+    measured window — the webserver receives bodies off the wire; building
+    them is the client's work, not the scheduler's."""
+    load = []
+    for g in range(n_gangs):
+        gname = f"cc{fam}-{rep}-g{g}"
+        group = {
+            "name": gname,
+            "members": [{"podNumber": 2, "leafCellNumber": 4}],
+        }
+        pods = [
+            make_pod(
+                f"{gname}-{k}", f"{gname}-u{k}", f"vc{fam}", 0,
+                f"cc{fam}-chip", 4, group,
+            )
+            for k in range(2)
+        ]
+        bodies = [
+            json.dumps(
+                ei.ExtenderArgs(pod=p, node_names=nodes).to_dict()
+            ).encode()
+            for p in pods
+        ]
+        load.append((pods, bodies))
+    return load
+
+
+def _measure_fill(filter_json, lanes) -> tuple:
+    """Run every lane's fill concurrently; returns (pods bound, wall s).
+    Two feeder lanes per family keep a pipelined shard fed back-to-back."""
+    import threading as _threading
+
+    totals = [0] * len(lanes)
+    bound: list = [[] for _ in lanes]
+    barrier = _threading.Barrier(len(lanes) + 1)
+
+    def worker(li: int) -> None:
+        barrier.wait()
+        for pods, bodies in lanes[li]:
+            for p, body in zip(pods, bodies):
+                r = json.loads(filter_json(body))
+                if r.get("NodeNames"):
+                    totals[li] += 1
+                    bound[li].append(p)
+
+    threads = [
+        _threading.Thread(target=worker, args=(li,))
+        for li in range(len(lanes))
+    ]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    return sum(totals), wall, [p for lane in bound for p in lane]
+
+
+def _procs_mode(n_shards: int, families: int, hosts_per_family: int):
+    """Build one measurement subject: (filter_json, drain, close, sched).
+    n_shards == 0 is the in-process core driven through the exact JSON
+    decode/encode work its webserver does per request — the
+    HIVED_PROC_SHARDS=0 baseline."""
+    from hivedscheduler_tpu.scheduler.shards import ShardedScheduler
+
+    cfg = build_concurrent_config(families, hosts_per_family)
+    if n_shards > 0:
+        sched = ShardedScheduler(
+            cfg, kube_client=NullKubeClient(), n_shards=n_shards,
+            transport="proc", auto_admit=True,
+        )
+        filter_json = sched.filter_raw
+        drain = sched.delete_pods
+        close = sched.close
+    else:
+        sched = HivedScheduler(
+            cfg, kube_client=NullKubeClient(), auto_admit=True
+        )
+
+        def filter_json(body: bytes) -> bytes:
+            args = ei.ExtenderArgs.from_dict(json.loads(body))
+            return json.dumps(
+                sched.filter_routine(args).to_dict()
+            ).encode()
+
+        def drain(pods) -> None:
+            for p in pods:
+                sched.delete_pod(p)
+
+        def close() -> None:
+            pass
+
+    all_nodes = sorted(
+        f"cc{i}-s{s}-w{j}"
+        for i in range(families)
+        for s in range(max(1, hosts_per_family // 4))
+        for j in range(4)
+    )
+    for n in all_nodes:
+        sched.add_node(Node(name=n))
+    fam_nodes = {
+        i: [n for n in all_nodes if n.startswith(f"cc{i}-")]
+        for i in range(families)
+    }
+    return filter_json, drain, close, fam_nodes
+
+
+def bench_procs(
+    shard_counts=(1, 2, 4),
+    families: int = 4,
+    hosts_per_family: int = 108,
+    reps: int = 5,
+    feeders_per_family: int = 2,
+) -> dict:
+    """Aggregate fill-phase filter throughput (pods/s) over disjoint
+    chain families: N worker PROCESSES vs the in-process sharded core
+    (``HIVED_PROC_SHARDS=0``), same 432-host fleet, same JSON-bytes
+    request path, same concurrent client lanes. Reps are INTERLEAVED
+    across modes (this host's background noise swings run-to-run far
+    more than rep-to-rep) and the medians reported.
+
+    The GIL ceiling is the story: in-process, N client lanes share one
+    interpreter, so filter COMPUTE serializes no matter how many chains
+    PR 5's lock sharding lets proceed concurrently; worker processes
+    compute in true parallel, bounded by cores. The speedup gate is
+    therefore core-scaled: the 2.5x acceptance number presumes >= 5
+    usable cores (4 workers + routing parent); below that the stage
+    reports the curve and the achievable ceiling (``cpu_count``) so the
+    artifact is honest about the host it ran on."""
+    modes = {0: _procs_mode(0, families, hosts_per_family)}
+    for n in shard_counts:
+        modes[n] = _procs_mode(n, families, hosts_per_family)
+    rates: dict = {n: [] for n in modes}
+    try:
+        for rep in range(reps):
+            for n, (filter_json, drain, _close, fam_nodes) in modes.items():
+                lanes = []
+                for fam in range(families):
+                    load = _family_fill_load(
+                        fam, f"m{n}r{rep}", fam_nodes[fam],
+                        max(1, hosts_per_family // 2),
+                    )
+                    for li in range(feeders_per_family):
+                        lanes.append(load[li::feeders_per_family])
+                pods, wall, bound = _measure_fill(filter_json, lanes)
+                rates[n].append(pods / wall if wall else 0.0)
+                drain(bound)
+    finally:
+        for _f, _d, close, _n in modes.values():
+            close()
+    medians = {
+        n: round(statistics.median(r), 1) for n, r in rates.items()
+    }
+    base = medians[0] or 1.0
+    curve = {
+        str(n): {
+            "pods_per_sec": medians[n],
+            "speedup_vs_inproc": round(medians[n] / base, 2),
+        }
+        for n in sorted(modes)
+    }
+    best = max(
+        (n for n in modes if n > 0),
+        key=lambda n: medians[n],
+    )
+    return {
+        "families": families,
+        "hosts_per_family": hosts_per_family,
+        "hosts": families * hosts_per_family,
+        "reps": reps,
+        "feeders_per_family": feeders_per_family,
+        "cpu_count": os.cpu_count(),
+        "inproc_pods_per_sec": medians[0],
+        "curve": curve,
+        "best_shard_count": best,
+        "best_speedup_vs_inproc": curve[str(best)]["speedup_vs_inproc"],
+    }
+
+
+def bench_fleet_sweep(
+    sizes=(108, 216, 432),
+    families: int = 4,
+    procs: int = 4,
+    reps: int = 3,
+) -> dict:
+    """Fleet-size sweep (432 -> 864 -> 1728 hosts at 4 families): the
+    in-process core's fill throughput as the fleet grows — the
+    single-process SATURATION point (where adding hosts stops adding
+    pods/s because one interpreter is compute-bound) — against the
+    ``procs``-shard frontend at the same sizes. The saturation point is
+    the instrument ROADMAP item 1 asked for: the fleet size beyond which
+    only parallel compute (more shards) raises throughput."""
+    out: dict = {"families": families, "procs": procs, "sizes": {}}
+    prev_rate = None
+    saturation = None
+    for hosts_per_family in sizes:
+        modes = {
+            0: _procs_mode(0, families, hosts_per_family),
+            procs: _procs_mode(procs, families, hosts_per_family),
+        }
+        rates: dict = {n: [] for n in modes}
+        try:
+            for rep in range(reps):
+                for n, (fj, drain, _c, fam_nodes) in modes.items():
+                    lanes = []
+                    for fam in range(families):
+                        load = _family_fill_load(
+                            fam, f"s{hosts_per_family}m{n}r{rep}",
+                            fam_nodes[fam],
+                            max(1, hosts_per_family // 2),
+                        )
+                        lanes.append(load[0::2])
+                        lanes.append(load[1::2])
+                    pods, wall, bound = _measure_fill(fj, lanes)
+                    rates[n].append(pods / wall if wall else 0.0)
+                    drain(bound)
+        finally:
+            for _f, _d, close, _n in modes.values():
+                close()
+        inproc = round(statistics.median(rates[0]), 1)
+        sharded = round(statistics.median(rates[procs]), 1)
+        total_hosts = families * hosts_per_family
+        out["sizes"][str(total_hosts)] = {
+            "inproc_pods_per_sec": inproc,
+            "procs_pods_per_sec": sharded,
+            "procs_speedup": round(inproc and sharded / inproc, 2),
+        }
+        if (
+            saturation is None
+            and prev_rate is not None
+            and inproc <= prev_rate * 1.10
+        ):
+            # Adding hosts stopped buying >10% throughput: the single
+            # process is compute-bound, not capacity-bound.
+            saturation = total_hosts
+        prev_rate = max(prev_rate or 0.0, inproc)
+    out["single_process_saturation_hosts"] = saturation
+    return out
+
+
 class _SnapshotKubeClient(NullKubeClient):
     """NullKubeClient + an in-memory snapshot ConfigMap family, for the
     recovery-blackout stage (the flusher needs somewhere to persist)."""
@@ -1017,6 +1267,32 @@ if __name__ == "__main__":
             )
         )
         sys.exit(0)
+    if os.environ.get("HIVED_BENCH_PROCS") == "1":
+        result = bench_procs()
+        result["fleet_sweep"] = bench_fleet_sweep()
+        # Core-scaled gate: the >=2.5x acceptance number presumes the
+        # 4 workers + routing parent each get a core; on smaller hosts
+        # the stage reports the measured curve and the ceiling instead
+        # of asserting a physical impossibility.
+        cores = os.cpu_count() or 1
+        target = 2.5 if cores >= 5 else None
+        result["speedup_target"] = target
+        if target is not None:
+            assert result["best_speedup_vs_inproc"] >= target, result
+        print(
+            json.dumps(
+                {
+                    "metric": "procs_filter_pods_per_sec",
+                    "value": result["curve"][
+                        str(result["best_shard_count"])
+                    ]["pods_per_sec"],
+                    "unit": "pods/s",
+                    "vs_baseline": result["best_speedup_vs_inproc"],
+                    "extra": result,
+                }
+            )
+        )
+        sys.exit(0)
     if os.environ.get("HIVED_BENCH_RECOVERY") == "1":
         # Standalone recovery-blackout gate (the default driver run
         # includes the same stage in its extra payload).
@@ -1070,6 +1346,8 @@ if __name__ == "__main__":
     recovery_blackout = bench_recovery_blackout()
     http_stats = bench_http()
     tracing_ab = bench_tracing_ab()
+    procs_stage = bench_procs()
+    procs_stage["fleet_sweep"] = bench_fleet_sweep()
     perf = model_perf()
     print(
         json.dumps(
@@ -1087,6 +1365,7 @@ if __name__ == "__main__":
                     "recovery_blackout": recovery_blackout,
                     "http": http_stats,
                     "tracing_ab": tracing_ab,
+                    "procs": procs_stage,
                     "model_perf": perf,
                 },
             }

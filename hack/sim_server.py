@@ -42,53 +42,72 @@ config = Config.from_dict({
     },
 })
 
-s = HivedScheduler(config, kube_client=NullKubeClient())
-for i in range(4):
-    s.add_node(Node(name=f"tpu-w{i}"))
+def main():
+    # HIVED_PROC_SHARDS=N serves the multi-process core (worker shards per
+    # chain family) exactly as __main__ does; 0/unset keeps the in-process
+    # scheduler (doc/hot-path.md "The multi-process contract").
+    _procs = int(__import__("os").environ.get("HIVED_PROC_SHARDS", "0") or 0)
+    if _procs > 0:
+        from hivedscheduler_tpu.scheduler.shards import ShardedScheduler
 
-# Exercise the hardware health plane (doc/fault-model.md "Hardware health
-# plane") the way the node informer would: tpu-w2 reports chip 3 bad via
-# the device-health annotation (the host still serves <=3-chip work on its
-# healthy chips), and tpu-w3 is drained for maintenance (no new
-# placements; anything already running would keep its cells). Inspect at
-# GET /v1/inspect/health.
-s.update_node(
-    Node(name="tpu-w2"),
-    Node(name="tpu-w2",
-         annotations={constants.ANNOTATION_NODE_DEVICE_HEALTH: "3"}),
-)
-s.update_node(
-    Node(name="tpu-w3"),
-    Node(name="tpu-w3",
-         annotations={constants.ANNOTATION_NODE_DRAIN: "*"}),
-)
+        s = ShardedScheduler(
+            config, kube_client=NullKubeClient(), n_shards=_procs,
+            auto_admit=False,
+        )
+        s.mark_ready()
+    else:
+        s = HivedScheduler(config, kube_client=NullKubeClient())
+    for i in range(4):
+        s.add_node(Node(name=f"tpu-w{i}"))
 
-def mk_pod(name, uid, leaf_num, group=None):
-    spec = {"virtualCluster": "vc-research", "priority": 1,
-            "leafCellType": "v5e-chip", "leafCellNumber": leaf_num}
-    if group:
-        spec["affinityGroup"] = group
-    return Pod(name=name, uid=uid,
-               annotations={constants.ANNOTATION_POD_SCHEDULING_SPEC:
-                            yaml.safe_dump(spec)},
-               resource_limits={constants.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})
+    # Exercise the hardware health plane (doc/fault-model.md "Hardware health
+    # plane") the way the node informer would: tpu-w2 reports chip 3 bad via
+    # the device-health annotation (the host still serves <=3-chip work on its
+    # healthy chips), and tpu-w3 is drained for maintenance (no new
+    # placements; anything already running would keep its cells). Inspect at
+    # GET /v1/inspect/health.
+    s.update_node(
+        Node(name="tpu-w2"),
+        Node(name="tpu-w2",
+             annotations={constants.ANNOTATION_NODE_DEVICE_HEALTH: "3"}),
+    )
+    s.update_node(
+        Node(name="tpu-w3"),
+        Node(name="tpu-w3",
+             annotations={constants.ANNOTATION_NODE_DRAIN: "*"}),
+    )
 
-# A 2-pod gang (8 chips over 2 hosts), a full-host singleton (4 chips),
-# and a 3-chip singleton that fits the chip-degraded host's healthy chips.
-gang = {"name": "bert-gang", "members": [{"podNumber": 2, "leafCellNumber": 4}]}
-for pod in [mk_pod("bert-0", "uid-bert-0", 4, gang),
-            mk_pod("bert-1", "uid-bert-1", 4, gang),
-            mk_pod("solo-0", "uid-solo-0", 4),
-            mk_pod("small-0", "uid-small-0", 3)]:
-    s.add_pod(pod)
+    def mk_pod(name, uid, leaf_num, group=None):
+        spec = {"virtualCluster": "vc-research", "priority": 1,
+                "leafCellType": "v5e-chip", "leafCellNumber": leaf_num}
+        if group:
+            spec["affinityGroup"] = group
+        return Pod(name=name, uid=uid,
+                   annotations={constants.ANNOTATION_POD_SCHEDULING_SPEC:
+                                yaml.safe_dump(spec)},
+                   resource_limits={constants.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1})
 
-# The manual node/pod seeding above IS this process's "initial replay";
-# flip /readyz the way InformerLoop.start() / recover() would.
-s.mark_ready()
+    # A 2-pod gang (8 chips over 2 hosts), a full-host singleton (4 chips),
+    # and a 3-chip singleton that fits the chip-degraded host's healthy chips.
+    gang = {"name": "bert-gang", "members": [{"podNumber": 2, "leafCellNumber": 4}]}
+    for pod in [mk_pod("bert-0", "uid-bert-0", 4, gang),
+                mk_pod("bert-1", "uid-bert-1", 4, gang),
+                mk_pod("solo-0", "uid-solo-0", 4),
+                mk_pod("small-0", "uid-small-0", 3)]:
+        s.add_pod(pod)
 
-ws = WebServer(s)
-ws.start()
-print("READY", flush=True)
-import time
-while True:
-    time.sleep(60)
+    # The manual node/pod seeding above IS this process's "initial replay";
+    # flip /readyz the way InformerLoop.start() / recover() would.
+    s.mark_ready()
+
+    ws = WebServer(s)
+    ws.start()
+    print("READY", flush=True)
+    import time
+    while True:
+        time.sleep(60)
+
+if __name__ == "__main__":
+    # Spawn-safe entry (the multi-process core starts workers with the
+    # "spawn" method, which re-imports this module in each child).
+    main()

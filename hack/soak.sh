@@ -43,6 +43,22 @@ if [[ "${1:-}" == "--failover" ]]; then
   echo "chaos soak: failover focus (HIVED_CHAOS_MIX=${HIVED_CHAOS_MIX})"
 fi
 
+if [[ "${1:-}" == "--procs" ]]; then
+  shift
+  # Multi-process soak: run the seeded schedules through the sharded
+  # frontend (scheduler.shards) with N worker shards — restarts and
+  # failovers take the partitioned recovery fan-out, and every restart
+  # asserts the cross-shape equivalence vs a single-process shadow
+  # (tests/test_chaos_soak.py::test_chaos_procs_soak).
+  if [[ $# -gt 0 && "${1:0:1}" != "-" ]]; then
+    export HIVED_CHAOS_PROCS="$1"
+    shift
+  else
+    export HIVED_CHAOS_PROCS=2
+  fi
+  echo "chaos soak: multi-process mode (HIVED_CHAOS_PROCS=${HIVED_CHAOS_PROCS})"
+fi
+
 if [[ "${1:-}" == "--keep-decisions" ]]; then
   shift
   if [[ $# -gt 0 && "${1:0:1}" != "-" ]]; then
@@ -73,4 +89,7 @@ if [[ "${HIVED_CHAOS_SWEEP:-0}" == "1" ]]; then
 fi
 
 echo "chaos soak: mix='${HIVED_CHAOS_MIX:-default}' seeds ${HIVED_CHAOS_START}..$((HIVED_CHAOS_START + HIVED_CHAOS_ROUNDS - 1))"
-exec python -m pytest tests/test_chaos_soak.py -m slow -q "$@"
+if [[ -n "${HIVED_CHAOS_PROCS:-}" ]]; then
+  exec python -m pytest tests/test_chaos_soak.py::test_chaos_procs_soak -m slow -q "$@"
+fi
+exec python -m pytest tests/test_chaos_soak.py::test_chaos_soak -m slow -q "$@"

@@ -86,13 +86,35 @@ def main(argv=None) -> int:
         return validate_config(args.config)
     common.init_logging(logging.DEBUG if args.verbose else logging.INFO)
     config = load_config(args.config)
+    # Multi-process scheduling core (doc/hot-path.md "The multi-process
+    # contract"): HIVED_PROC_SHARDS=N (or the procShards config knob)
+    # shards the core by chain family into N worker processes behind this
+    # webserver; 0 — the default — serves the in-process sharded
+    # scheduler exactly as before.
+    procs = int(
+        os.environ.get("HIVED_PROC_SHARDS", "") or config.proc_shards or 0
+    )
     # Standalone has no informer, so filter-time auto-admission stands in
     # for pod events.
-    scheduler = HivedScheduler(config, auto_admit=args.standalone)
+    if procs > 0:
+        from .scheduler.shards import ShardedScheduler
+
+        scheduler = ShardedScheduler(
+            config, n_shards=procs, auto_admit=args.standalone
+        )
+        common.log.info(
+            "multi-process core: %d shard worker(s), chain plan %s",
+            len(scheduler.shards),
+            {b.shard_id: list(b.owned_chains) for b in scheduler.shards},
+        )
+    else:
+        scheduler = HivedScheduler(config, auto_admit=args.standalone)
 
     if args.standalone:
         # The constructor already defaulted kube_client to a NullKubeClient.
-        for name in scheduler.core.configured_node_names():
+        for name in scheduler.configured_node_names() if procs > 0 else (
+            scheduler.core.configured_node_names()
+        ):
             scheduler.add_node(Node(name=name))
     else:
         from .scheduler.kube import (

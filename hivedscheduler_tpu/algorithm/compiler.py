@@ -221,6 +221,12 @@ class _VirtualBuilder:
         self._chain: CellChain = ""
         self._root: Optional[VirtualCell] = None
         self._pid: api.PinnedCellId = ""
+        # Canonical tiebreak stamp for VIRTUAL cells, mirroring the
+        # physical builder's: the packing view's total sort order
+        # (placement._NodeView.sort_key) must be a pure function of cell
+        # state for virtual anchors too, or intra-VC view order would
+        # fall back to scoring history on equal scores.
+        self._order = 0
 
     def build(self):
         for vc, spec in self.specs.items():
@@ -298,6 +304,8 @@ class _VirtualBuilder:
             cell_type=ce.cell_type,
             is_node_level=ce.has_node and not ce.is_multi_nodes,
         )
+        self._order += 1
+        cell.config_order = self._order
         if not self._pid:
             vc_lists = self.non_pinned_full[self._vc]
             vc_lists.setdefault(self._chain, ChainCellList())

@@ -610,6 +610,14 @@ class HivedCore:
         self._vc_status_cache: Dict[
             api.VirtualClusterName, Tuple[int, List[Dict]]
         ] = {}
+        # Incremental snapshot export: chain -> (epoch, section dict).
+        # The flusher's export walk re-serialized every chain each beat;
+        # keying each chain's slice of the durable projection on its
+        # mutation epoch makes a quiet chain one dict lookup
+        # (doc/hot-path.md). Cleared wholesale by restore_projection —
+        # the restore writes cell fields directly, without mutator hooks.
+        self._export_chain_memo: Dict[CellChain, Tuple[int, Dict]] = {}
+        self._export_cells_by_chain: Optional[Dict] = None
 
         # VC-safety and bad-cell bookkeeping
         # (reference: hived_algorithm.go:52-93).
@@ -1331,16 +1339,103 @@ class HivedCore:
 
         Sparse representation: only cells deviating from the pristine
         defaults get a record, so the payload scales with allocation +
-        badness + fragmentation, not fleet size."""
-        # The two cell walks below are the flusher's main lock-held cost
-        # at fleet scale (every configured cell is visited every flush):
-        # locals are hoisted and the pristine skip is ordered cheapest-
-        # fails-first so the common (pristine) cell costs a few attribute
-        # reads, not a record build.
+        badness + fragmentation, not fleet size.
+
+        Incremental: the projection is assembled from PER-CHAIN sections
+        memoized on the chain mutation epochs (PR-5's epoch refs, bumped
+        by every state/priority/health/binding/pod-slot mutator) — a
+        quiet chain's slice is one dict lookup instead of a cell walk,
+        so the flusher's lock-held cost scales with the chains that
+        actually moved since the last beat, not fleet size. The memo is
+        cleared wholesale by restore_projection (direct field writes
+        bypass the mutator hooks). tests/test_snapshot_ha.py proves the
+        memoized assembly identical to a cold rebuild differentially."""
+        sections: List[Dict] = []
+        for chain in self.full_cell_list:
+            epoch = self.chain_epoch(chain)
+            cached = self._export_chain_memo.get(chain)
+            if cached is None or cached[0] != epoch:
+                cached = self._export_chain_memo[chain] = (
+                    epoch, self._export_chain_section(chain)
+                )
+            sections.append(cached[1])
+        phys: Dict[str, List] = {}
+        virt: Dict[str, List] = {}
+        free_lists: Dict[str, Dict] = {}
+        bad_free: Dict[str, Dict] = {}
+        vc_doomed: Dict[str, Dict] = {}
+        ot_cells: Dict[str, List[str]] = {}
+        vc_free: Dict[str, Dict] = {}
+        all_vc_free: Dict[str, Dict] = {}
+        total_left: Dict[str, Dict] = {}
+        all_vc_doomed: Dict[str, Dict] = {}
+        groups: Dict[str, Dict] = {}
+        for sec in sections:
+            phys.update(sec["phys"])
+            virt.update(sec["virt"])
+            free_lists.update(sec["freeLists"])
+            bad_free.update(sec["badFree"])
+            for vcn, per_chain in sec["vcDoomed"].items():
+                vc_doomed.setdefault(vcn, {}).update(per_chain)
+            for vcn, addrs in sec["otCells"].items():
+                ot_cells.setdefault(vcn, []).extend(addrs)
+            for vcn, per_chain in sec["vcFree"].items():
+                vc_free.setdefault(vcn, {}).update(per_chain)
+            all_vc_free.update(sec["allVCFree"])
+            total_left.update(sec["totalLeft"])
+            all_vc_doomed.update(sec["allVCDoomed"])
+            groups.update(sec["groups"])
+        # Groups without a placement chain (none in a normalized export;
+        # defensive) are attributed fresh each walk.
+        for name, g in self.affinity_groups.items():
+            if name not in groups and group_chain(g) is None:
+                groups[name] = self._export_group_record(g)
+        return {
+            "phys": phys,
+            "virt": virt,
+            "freeLists": free_lists,
+            "badFree": bad_free,
+            "vcDoomed": vc_doomed,
+            "otCells": ot_cells,
+            "counters": {
+                "vcFree": vc_free,
+                "allVCFree": all_vc_free,
+                "totalLeft": total_left,
+                "allVCDoomed": all_vc_doomed,
+            },
+            "groups": groups,
+        }
+
+    def _export_cell_groups(self) -> Dict:
+        """chain -> (physical cells, virtual cells): static post-compile,
+        built once on first export."""
+        if self._export_cells_by_chain is None:
+            by_chain: Dict = {
+                chain: ([], []) for chain in self.full_cell_list
+            }
+            for c in self._phys_cell_index.values():
+                by_chain[c.chain][0].append(c)
+            for v in self._virt_cell_index.values():
+                if v.chain in by_chain:
+                    by_chain[v.chain][1].append(v)
+            self._export_cells_by_chain = by_chain
+        return self._export_cells_by_chain
+
+    def _export_chain_section(self, chain: CellChain) -> Dict:
+        """One chain's slice of the durable projection — exactly the
+        records export_projection's pre-incremental single walk built for
+        this chain's cells, listings, counters, and groups.
+
+        The cell walk below is the flusher's main lock-held cost at
+        fleet scale (every configured cell of a DIRTY chain is visited):
+        locals are hoisted and the pristine skip is ordered cheapest-
+        fails-first so the common (pristine) cell costs a few attribute
+        reads, not a record build."""
         free_state = CellState.FREE
         free_prio = FREE_PRIORITY
+        phys_cells, virt_cells = self._export_cell_groups()[chain]
         phys: Dict[str, List] = {}
-        for c in self._phys_cell_index.values():
+        for c in phys_cells:
             used = c.used_leaf_cells_at_priority
             if (
                 c.state is free_state
@@ -1368,7 +1463,7 @@ class HivedCore:
                 c.unusable_leaf_num,
             ]
         virt: Dict[str, List] = {}
-        for v in self._virt_cell_index.values():
+        for v in virt_cells:
             used = v.used_leaf_cells_at_priority
             if (
                 v.state is free_state
@@ -1393,78 +1488,82 @@ class HivedCore:
                 if len(cl)
             }
 
-        def dump_counters(d: Dict[CellChain, Dict[CellLevel, int]]) -> Dict:
-            return {
-                str(chain): {str(l): n for l, n in per.items()}
-                for chain, per in d.items()
-            }
+        def chain_counter(d: Dict[CellChain, Dict[CellLevel, int]]) -> Dict:
+            per = d.get(chain)
+            if per is None:
+                return {}
+            return {str(chain): {str(l): n for l, n in per.items()}}
 
         groups: Dict[str, Dict] = {}
         for name, g in self.affinity_groups.items():
-            groups[name] = {
-                "spec": {
-                    "name": g.name,
-                    "members": [
-                        {"podNumber": p, "leafCellNumber": n}
-                        for n, p in sorted(g.total_pod_nums.items())
-                    ],
-                },
-                "vc": str(g.vc),
-                "lazyPreemptionEnable": bool(g.lazy_preemption_enable),
-                "priority": g.priority,
-                "state": g.state.value,
-                "ignoreSuggested": bool(g.ignore_k8s_suggested_nodes),
-                "lazyPreemptionStatus": g.lazy_preemption_status,
-                "phys": {
-                    str(n): [
-                        [c.address if c is not None else None for c in row]
-                        for row in rows
-                    ]
-                    for n, rows in g.physical_placement.items()
-                },
-                "virt": None
-                if g.virtual_placement is None
-                else {
-                    str(n): [
-                        [c.address if c is not None else None for c in row]
-                        for row in rows
-                    ]
-                    for n, rows in g.virtual_placement.items()
-                },
-            }
+            if group_chain(g) == chain:
+                groups[name] = self._export_group_record(g)
+        ccl = self.free_cell_list.get(chain)
+        bad = self.bad_free_cells.get(chain)
         return {
             "phys": phys,
             "virt": virt,
-            "freeLists": {
-                str(chain): dump_ccl(ccl)
-                for chain, ccl in self.free_cell_list.items()
-            },
-            "badFree": {
-                str(chain): dump_ccl(ccl)
-                for chain, ccl in self.bad_free_cells.items()
-            },
+            "freeLists": (
+                {str(chain): dump_ccl(ccl)} if ccl is not None else {}
+            ),
+            "badFree": (
+                {str(chain): dump_ccl(bad)} if bad is not None else {}
+            ),
             "vcDoomed": {
-                str(vcn): {
-                    str(chain): dump_ccl(ccl)
-                    for chain, ccl in per_chain.items()
-                }
+                str(vcn): {str(chain): dump_ccl(per_chain[chain])}
                 for vcn, per_chain in self.vc_doomed_bad_cells.items()
+                if chain in per_chain
             },
             "otCells": {
-                str(vcn): list(cells)
+                str(vcn): kept
                 for vcn, cells in self._ot_cells.items()
-                if cells
+                if (kept := [
+                    a for a, pl in cells.items() if pl.chain == chain
+                ])
             },
-            "counters": {
-                "vcFree": {
-                    str(vcn): dump_counters(per)
-                    for vcn, per in self.vc_free_cell_num.items()
-                },
-                "allVCFree": dump_counters(self.all_vc_free_cell_num),
-                "totalLeft": dump_counters(self.total_left_cell_num),
-                "allVCDoomed": dump_counters(self.all_vc_doomed_bad_cell_num),
+            "vcFree": {
+                str(vcn): sliced
+                for vcn, per in self.vc_free_cell_num.items()
+                if (sliced := chain_counter(per))
             },
+            "allVCFree": chain_counter(self.all_vc_free_cell_num),
+            "totalLeft": chain_counter(self.total_left_cell_num),
+            "allVCDoomed": chain_counter(self.all_vc_doomed_bad_cell_num),
             "groups": groups,
+        }
+
+    @staticmethod
+    def _export_group_record(g: AffinityGroup) -> Dict:
+        return {
+            "spec": {
+                "name": g.name,
+                "members": [
+                    {"podNumber": p, "leafCellNumber": n}
+                    for n, p in sorted(g.total_pod_nums.items())
+                ],
+            },
+            "vc": str(g.vc),
+            "lazyPreemptionEnable": bool(g.lazy_preemption_enable),
+            "priority": g.priority,
+            "state": g.state.value,
+            "ignoreSuggested": bool(g.ignore_k8s_suggested_nodes),
+            "lazyPreemptionStatus": g.lazy_preemption_status,
+            "phys": {
+                str(n): [
+                    [c.address if c is not None else None for c in row]
+                    for row in rows
+                ]
+                for n, rows in g.physical_placement.items()
+            },
+            "virt": None
+            if g.virtual_placement is None
+            else {
+                str(n): [
+                    [c.address if c is not None else None for c in row]
+                    for row in rows
+                ]
+                for n, rows in g.virtual_placement.items()
+            },
         }
 
     def restore_projection(
@@ -1669,6 +1768,10 @@ class HivedCore:
             ref[0] += 1
         self._phys_status_cache.clear()
         self._vc_status_cache.clear()
+        # The export memo mirrors live cell state through the epoch refs;
+        # the direct field writes above bypass the mutator hooks, so the
+        # memo (like the status mirrors) must drop wholesale.
+        self._export_chain_memo.clear()
         for sched in self._all_topology_schedulers():
             sched.invalidate_all()
 

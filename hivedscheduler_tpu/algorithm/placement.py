@@ -21,6 +21,7 @@ differential proof against the naive rebuild.
 
 from __future__ import annotations
 
+import bisect
 import os
 import threading
 import time
@@ -107,6 +108,10 @@ class _NodeView:
         "healthy",
         "suggested",
         "node_address",
+        # The score bucket this view currently sits in (None before the
+        # first scoring pass) — the O(dirty) maintenance moves a view
+        # only when its re-scored key leaves this bucket.
+        "bucket_key",
     )
 
     def __init__(self, cell: Cell):
@@ -131,6 +136,7 @@ class _NodeView:
         self.healthy = True
         self.suggested = True
         self.node_address: api.CellAddress = ""
+        self.bucket_key: Optional[Tuple] = None
 
     def update_for_priority(self, p: CellPriority, cross_priority_pack: bool) -> None:
         """(reference: topology_aware_scheduler.go:147-156; see the comment
@@ -149,16 +155,33 @@ class _NodeView:
             if priority >= p:
                 self.free_at_priority -= num
 
-    def sort_key(self) -> Tuple:
-        """Packing sort: fully-usable first (healthy AND nothing draining —
-        partially-degraded hosts are placeable but dispreferred), suggested
-        first, more same-priority usage first, less higher-priority usage
-        first (reference: topology_aware_scheduler.go:232-253)."""
+    def score_key(self) -> Tuple:
+        """The packing score: fully-usable first (healthy AND nothing
+        draining — partially-degraded hosts are placeable but
+        dispreferred), suggested first, more same-priority usage first,
+        less higher-priority usage first (reference:
+        topology_aware_scheduler.go:232-253). A small tuple of BOUNDED
+        ints (two booleans plus two per-node chip counts) — the bucket
+        key of the O(dirty) view maintenance."""
         return (
             self.degraded,
             not self.suggested,
             -self.used_same_priority,
             self.used_higher_priority,
+        )
+
+    def sort_key(self) -> Tuple:
+        """score_key extended to a TOTAL order by the compile traversal
+        stamp: the view order is a pure function of cell state + config —
+        never of scoring history (equal-score order used to be whatever
+        the stable sort inherited from past requests, which recovery
+        cannot reconstruct; PR 4 fixed candidate ties the same way)."""
+        return (
+            self.degraded,
+            not self.suggested,
+            -self.used_same_priority,
+            self.used_higher_priority,
+            self.cell.config_order,
         )
 
 
@@ -211,6 +234,13 @@ class TopologyAwareScheduler:
         self._last_ignore: Optional[bool] = None
         self._last_suggested: Optional[Set[str]] = None
         self._never_scored = True
+        # Score buckets (doc/hot-path.md "State-pure sorted view"): key =
+        # score_key() (a small tuple of bounded ints), value = the views
+        # with that score in config order; _bucket_order keeps the keys
+        # sorted. Together they ARE the sorted view — the flat list is
+        # just their concatenation, rebuilt only when membership moves.
+        self._buckets: Dict[Tuple, List[_NodeView]] = {}
+        self._bucket_order: List[Tuple] = []
         if not self.naive:
             self._register_view()
 
@@ -279,6 +309,7 @@ class TopologyAwareScheduler:
         view = self.cluster_view
         if self.naive:
             dirty_views: List[_NodeView] = view
+            full = True
         else:
             params_changed = (
                 self._never_scored
@@ -292,7 +323,11 @@ class TopologyAwareScheduler:
                     )
                 )
             )
-            if params_changed or len(self._dirty) > len(view) * FULL_RESCORE_FRACTION:
+            full = (
+                params_changed
+                or len(self._dirty) > len(view) * FULL_RESCORE_FRACTION
+            )
+            if full:
                 dirty_views = view
             elif self._dirty:
                 by_addr = self._views_by_addr
@@ -309,16 +344,60 @@ class TopologyAwareScheduler:
                 _node_unusable_free(n.cell, p)
             )
             n.degraded = (not n.healthy) or _node_degraded(n.cell)
-        # Stable in-place sort of the persistent list: with only a few dirty
-        # nodes the list is near-sorted and Timsort's run detection makes
-        # this effectively linear.
-        view.sort(key=_NodeView.sort_key)
+        if full:
+            # Full pass: one total-key sort (score, then config order —
+            # a pure function of cell state), buckets rebuilt from the
+            # sorted run.
+            view.sort(key=_NodeView.sort_key)
+            self._rebuild_buckets_from_sorted(view)
+        else:
+            # O(dirty) reordering: a re-scored view moves between score
+            # buckets only when its (bounded-int) key changed; within a
+            # bucket, views sit in config order. The flat list is
+            # re-concatenated only when some membership moved.
+            moved = False
+            for n in dirty_views:
+                key = n.score_key()
+                if key == n.bucket_key:
+                    continue
+                moved = True
+                old = self._buckets.get(n.bucket_key)
+                if old is not None:
+                    old.remove(n)
+                    if not old:
+                        del self._buckets[n.bucket_key]
+                        self._bucket_order.remove(n.bucket_key)
+                bucket = self._buckets.get(key)
+                if bucket is None:
+                    bucket = self._buckets[key] = []
+                    bisect.insort(self._bucket_order, key)
+                bisect.insort(
+                    bucket, n, key=lambda v: v.cell.config_order
+                )
+                n.bucket_key = key
+            if moved:
+                flat: List[_NodeView] = []
+                for key in self._bucket_order:
+                    flat.extend(self._buckets[key])
+                view[:] = flat
         self._dirty.clear()
         self._never_scored = False
         self._last_priority = p
         self._last_ignore = ignore_suggested
         self._last_suggested = suggested_nodes
         self._scored_stamp = self._binding_stamp
+
+    def _rebuild_buckets_from_sorted(self, view: List[_NodeView]) -> None:
+        self._buckets = {}
+        self._bucket_order = []
+        for n in view:
+            key = n.score_key()
+            n.bucket_key = key
+            bucket = self._buckets.get(key)
+            if bucket is None:
+                bucket = self._buckets[key] = []
+                self._bucket_order.append(key)
+            bucket.append(n)
 
     def schedule(
         self,

@@ -84,6 +84,12 @@ class Config:
     snapshot_interval_seconds: float = 0.0
     lease_duration_seconds: float = 15.0
     lease_renew_seconds: float = 5.0
+    # Multi-process scheduling core (doc/hot-path.md "The multi-process
+    # contract"): > 0 shards the core by chain family into that many
+    # worker processes behind the webserver; 0 (default) serves the
+    # in-process sharded scheduler exactly as before. The
+    # HIVED_PROC_SHARDS env knob overrides at launch.
+    proc_shards: int = 0
     physical_cluster: api.PhysicalClusterSpec = field(
         default_factory=api.PhysicalClusterSpec
     )
@@ -105,6 +111,7 @@ class Config:
         snap_s = d.get("snapshotIntervalSeconds")
         lease_d = d.get("leaseDurationSeconds")
         lease_r = d.get("leaseRenewSeconds")
+        procs = d.get("procShards")
         c = Config(
             kube_apiserver_address=d.get("kubeApiServerAddress"),
             kube_config_file_path=d.get("kubeConfigFilePath"),
@@ -134,6 +141,7 @@ class Config:
                 15.0 if lease_d is None else float(lease_d)
             ),
             lease_renew_seconds=5.0 if lease_r is None else float(lease_r),
+            proc_shards=0 if procs is None else int(procs),
             physical_cluster=api.PhysicalClusterSpec.from_dict(
                 d.get("physicalCluster")
             ),

@@ -147,6 +147,21 @@ def _make_handler(scheduler: HivedScheduler):
                 kube_mod.set_request_deadline(budget)
             try:
                 if path == constants.FILTER_PATH:
+                    raw = getattr(scheduler, "filter_raw", None)
+                    if raw is not None:
+                        # Multi-process frontend (scheduler.shards): the
+                        # filter body is routed and forwarded as raw
+                        # bytes; decode/encode happen in the worker so
+                        # this thread's GIL share stays O(1) per call.
+                        data = raw(body)
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type", "application/json"
+                        )
+                        self.send_header("Content-Length", str(len(data)))
+                        self.end_headers()
+                        self.wfile.write(data)
+                        return
                     args = ei.ExtenderArgs.from_dict(self._parse_json(body))
                     # Errors inside filter must be reported in-band in the
                     # Error field so the default scheduler sees them

@@ -2054,3 +2054,752 @@ def _dump_decision_artifact(harness: "ChaosHarness", seed: int) -> str:
     with open(path, "w") as f:
         json.dump(payload, f, indent=1, default=str)
     return path
+
+
+###############################################################################
+# Multi-process chaos (scheduler.shards): restarts/failovers through the
+# per-chain-family worker-shard frontend
+###############################################################################
+
+
+def merged_shard_ledger_payload(
+    state_blob: Optional[str], plan: List[tuple]
+) -> Optional[str]:
+    """Translate a partitioned doomed-ledger envelope into the single
+    ConfigMap payload a one-process scheduler would have written: each
+    shard's slot filtered to its OWNED chains (foreign-chain dooms in a
+    slot are partial-view bootstrap artifacts), merged. Per-chain doom
+    purity makes the merge exact — it is what a shard-plan migration
+    tool would write, and what the cross-shape shadow recovers with."""
+    import json as _json
+
+    from hivedscheduler_tpu.scheduler import shards as shards_mod
+
+    if not state_blob:
+        return None
+    try:
+        env = _json.loads(state_blob)
+    except (TypeError, ValueError):
+        return None
+    ledgers = env.get("ledgers") if isinstance(env, dict) else None
+    if not isinstance(ledgers, dict):
+        return None
+    merged_vcs: Dict[str, List[Dict]] = {}
+    for sid_str, payload in ledgers.items():
+        try:
+            owned = set(plan[int(sid_str)])
+            ledger = common.from_yaml(payload) or {}
+        except Exception:  # noqa: BLE001
+            continue
+        for vcn, entries in (ledger.get("vcs") or {}).items():
+            merged_vcs.setdefault(str(vcn), []).extend(
+                e for e in entries if e.get("chain") in owned
+            )
+    for entries in merged_vcs.values():
+        entries.sort(
+            key=lambda e: (
+                str(e.get("chain")), int(e.get("level", -1)),
+                str(e.get("address")),
+            )
+        )
+    return common.to_json({"epoch": 0, "vcs": merged_vcs})
+
+
+def chain_scoped_fingerprint(core, chains, owned_node) -> Dict:
+    """core_fingerprint restricted to one shard's owned chains: the
+    cross-shape equivalence currency. Virtual-cell identity is excluded
+    by construction (the established PR-7 contract: a snapshot restore
+    preserves the continuous scheduler's virtual choices while a full
+    replay re-derives them canonically — quota accounting, physical leaf
+    states, free sets, and doom bindings must still be identical)."""
+    from hivedscheduler_tpu.algorithm.core import group_chain
+
+    cs = {str(c) for c in chains}
+
+    def fc(d):
+        return _norm_counters(
+            {c: v for c, v in d.items() if str(c) in cs}
+        )
+
+    counters = {
+        "vcFree": {
+            str(vcn): fc(per)
+            for vcn, per in sorted(core.vc_free_cell_num.items())
+        },
+        "allVCFree": fc(core.all_vc_free_cell_num),
+        "totalLeft": fc(core.total_left_cell_num),
+        "doomed": fc(core.all_vc_doomed_bad_cell_num),
+        "badFree": {
+            str(c): {l: len(cl) for l, cl in ccl.levels.items() if len(cl)}
+            for c, ccl in sorted(core.bad_free_cells.items())
+            if str(c) in cs
+        },
+        "otCells": {
+            str(vcn): kept
+            for vcn, cells in sorted(core._ot_cells.items())
+            if (kept := sorted(
+                pl.address
+                for pl in cells.values()
+                if str(pl.chain) in cs
+            ))
+        },
+        "groups": sorted(
+            (name, g.state.value)
+            for name, g in core.affinity_groups.items()
+            if str(group_chain(g)) in cs
+        ),
+        "badChips": {
+            n: sorted(c)
+            for n, c in sorted(core.bad_chips.items())
+            if c and owned_node(n)
+        },
+        "drainingChips": {
+            n: sorted(c)
+            for n, c in sorted(core.draining_chips.items())
+            if c and owned_node(n)
+        },
+    }
+    leaves = {}
+    for chain in sorted(cs):
+        ccl = core.full_cell_list.get(chain)
+        if ccl is None:
+            continue
+        for leaf in ccl[LOWEST_LEVEL]:
+            leaves[leaf.address] = (
+                leaf.state.value,
+                leaf.priority,
+                leaf.healthy,
+                leaf.draining,
+                leaf.using_group.name if leaf.using_group else None,
+                leaf.reserving_or_reserved_group.name
+                if leaf.reserving_or_reserved_group else None,
+            )
+    free_set = {
+        str(chain): {
+            l: sorted(c.address for c in cl)
+            for l, cl in ccl.levels.items() if len(cl)
+        }
+        for chain, ccl in sorted(core.free_cell_list.items())
+        if str(chain) in cs
+    }
+    return {"counters": counters, "leaves": leaves, "freeSet": free_set}
+
+
+class ProcChaosHarness:
+    """One seeded chaos schedule through the MULTI-PROCESS frontend
+    (scheduler.shards, local backends: identical routing / two-phase
+    broadcast / partitioned-store code paths with in-process visibility).
+
+    Every event ends with per-shard invariant audits plus the broadcast
+    liveness check (each shard's health clock must equal the tick count —
+    the sensor that catches a no-op'd commit phase, see
+    test_nooped_broadcast_commit_is_caught). Every restart/failover
+    asserts:
+
+    - per-shard snapshot-recovery contract: a shard whose snapshot slice
+      validates (and whose dooms match its ledger slot) recovers
+      snapshot+delta; otherwise it falls back to the full annotation
+      replay with snapshotFallbackCount bumped;
+    - work preservation: every confirmed-bound surviving pod keeps its
+      exact node + isolation;
+    - STRICT cross-shape restart equivalence: the recovered frontend's
+      merged structural view equals a SINGLE-PROCESS shadow recovered
+      from the identical crash inputs (nodes, live pods, and the
+      partitioned ledger translated to a one-process payload) — the
+      sharded-vs-global differential extended across the process
+      boundary and through every restart;
+    - zero-leak teardown to the per-shard pristine fingerprints.
+    """
+
+    LEASE_DURATION_S = 10.0
+    LEASE_RENEW_S = 3.0
+
+    def __init__(self, seed: int, n_shards: int = 2):
+        import bench as bench_mod
+
+        from hivedscheduler_tpu.scheduler.shards import ShardedScheduler
+
+        self.seed = seed
+        self.rnd = random.Random(seed ^ 0x9C0C5)
+        self.n_shards = n_shards
+        self.families = 2 + seed % 2
+        self.hosts_per_family = 8
+        self.kube = ScriptedKubeClient()
+        self.kube.on_patch = self._apply_annotation_patch
+        self.config = bench_mod.build_concurrent_config(
+            self.families, self.hosts_per_family
+        )
+        self._mk = ShardedScheduler
+        self.cluster_pods: Dict[str, Pod] = {}
+        self.gangs: Dict[str, List[str]] = {}
+        self.preempting: Dict[str, List[str]] = {}
+        self.gang_seq = 0
+        self.event_i = 0
+        self.tick_count = 0
+        self.ha_clock = 100.0
+        self.stats = {
+            "events": 0, "binds": 0, "restarts": 0, "failovers": 0,
+            "hot_takeovers": 0, "snapshot_flushes": 0,
+            "snapshot_corruptions": 0, "snapshot_recoveries": 0,
+            "snapshot_fallbacks": 0, "node_flips": 0, "ticks": 0,
+            "preempts": 0, "preempt_restarts": 0,
+            "deposed_bind_refusals": 0, "broadcasts": 0,
+        }
+        self.node_health: Dict[str, bool] = {}
+        self.front = self._new_front()
+        for n in sorted(self.front.configured_node_names()):
+            self.node_health[n] = True
+            self.front.add_node(Node(name=n))
+        self.front.mark_ready()
+        self.front.seed_preempt_rng(seed ^ 0xF00D)
+        self.pristine = [
+            core_fingerprint(b.scheduler.core) for b in self.front.shards
+        ]
+
+    # ---------------- plumbing ---------------- #
+
+    def _new_front(self):
+        return self._mk(
+            self.config, kube_client=self.kube, n_shards=self.n_shards,
+            transport="local",
+        )
+
+    def _new_elector(self, identity: str) -> ha_mod.LeaderElector:
+        return ha_mod.LeaderElector(
+            self.kube, identity,
+            duration_s=self.LEASE_DURATION_S, renew_s=self.LEASE_RENEW_S,
+            clock=lambda: self.ha_clock,
+        )
+
+    def _apply_annotation_patch(self, pod: Pod, patch: Dict) -> None:
+        cur = self.cluster_pods.get(pod.uid)
+        if cur is None:
+            return
+        annotations = dict(cur.annotations)
+        for k, v in patch.items():
+            if v is None:
+                annotations.pop(k, None)
+            else:
+                annotations[k] = v
+        self.cluster_pods[pod.uid] = Pod(
+            name=cur.name, namespace=cur.namespace, uid=cur.uid,
+            annotations=annotations, node_name=cur.node_name,
+            phase=cur.phase, resource_limits=dict(cur.resource_limits),
+        )
+
+    def _nodes(self) -> List[str]:
+        return sorted(self.node_health)
+
+    def _mk_gang(self, fam: int, prio: int, n_pods: int, chips: int):
+        self.gang_seq += 1
+        name = f"pg{self.seed}-{self.gang_seq}"
+        group = {
+            "name": name,
+            "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
+        }
+        pods = [
+            make_pod(
+                f"{name}-{i}", f"u-{name}-{i}", f"vc{fam}", prio,
+                f"cc{fam}-chip", chips, group=group,
+            )
+            for i in range(n_pods)
+        ]
+        return name, pods
+
+    # ---------------- events ---------------- #
+
+    def gang_create(self) -> None:
+        fam = self.rnd.randrange(self.families)
+        prio = self.rnd.choice([-1, 0, 0, 5])
+        n_pods = self.rnd.choice([1, 1, 2, 4])
+        chips = self.rnd.choice([1, 2, 4])
+        name, pods = self._mk_gang(fam, prio, n_pods, chips)
+        bound_uids: List[str] = []
+        for pod in pods:
+            self.front.add_pod(pod)
+            self.cluster_pods[pod.uid] = pod
+            try:
+                r = self.front.filter_routine(
+                    ei.ExtenderArgs(pod=pod, node_names=self._nodes())
+                )
+            except api.WebServerError:
+                self.front.delete_pod(pod)
+                self.cluster_pods.pop(pod.uid, None)
+                break
+            if not r.node_names:
+                continue  # waiting (stays a live unbound pod)
+            try:
+                self.front.bind_routine(
+                    ei.ExtenderBindingArgs(
+                        pod_name=pod.name, pod_namespace=pod.namespace,
+                        pod_uid=pod.uid, node=r.node_names[0],
+                    )
+                )
+            except Exception:  # noqa: BLE001
+                continue
+            bound = self.kube.bound.get(pod.uid)
+            if bound is None:
+                continue
+            bound.phase = "Running"
+            self.front.update_pod(pod, bound)
+            self.cluster_pods[pod.uid] = bound
+            bound_uids.append(pod.uid)
+            self.stats["binds"] += 1
+        uids = [p.uid for p in pods if p.uid in self.cluster_pods]
+        if uids:
+            self.gangs[name] = uids
+
+    def gang_delete(self) -> None:
+        if not self.gangs:
+            return
+        name = self.rnd.choice(sorted(self.gangs))
+        for uid in self.gangs.pop(name):
+            pod = self.cluster_pods.pop(uid, None)
+            if pod is not None:
+                self.front.delete_pod(pod)
+        self.preempting.pop(name, None)
+
+    def preempt_start(self) -> None:
+        """A high-priority gang preempts through the production verbs:
+        filter returns the preempt hint, preempt_routine commits the
+        reservation (checkpointed onto the preemptor pods through the
+        frontend's kube fence)."""
+        fam = self.rnd.randrange(self.families)
+        # Big enough that free capacity rarely covers it (preemption has
+        # to displace the lower-priority churn gangs).
+        name, pods = self._mk_gang(fam, 50, self.rnd.choice([2, 4]), 4)
+        committed = False
+        for pod in pods:
+            self.front.add_pod(pod)
+            self.cluster_pods[pod.uid] = pod
+            try:
+                r = self.front.preempt_routine(
+                    ei.ExtenderPreemptionArgs(
+                        pod=pod,
+                        node_name_to_meta_victims={
+                            n: ei.MetaVictims() for n in self._nodes()
+                        },
+                    )
+                )
+            except api.WebServerError:
+                continue
+            if r.node_name_to_meta_victims:
+                committed = True
+        self.gangs[name] = [p.uid for p in pods]
+        if committed:
+            self.preempting[name] = [p.uid for p in pods]
+            self.stats["preempts"] += 1
+        else:
+            # No reservation: drop the probe gang (it would sit WAITING).
+            self.gang_delete_named(name)
+
+    def gang_delete_named(self, name: str) -> None:
+        for uid in self.gangs.pop(name, []):
+            pod = self.cluster_pods.pop(uid, None)
+            if pod is not None:
+                self.front.delete_pod(pod)
+        self.preempting.pop(name, None)
+
+    def preempt_finish(self) -> None:
+        """Cancel a live preemption by deleting its preemptor gang (the
+        last-preemptor-deleted cancel path, cross-process)."""
+        if not self.preempting:
+            return
+        name = self.rnd.choice(sorted(self.preempting))
+        self.gang_delete_named(name)
+
+    def node_flip(self) -> None:
+        node = self.rnd.choice(self._nodes())
+        healthy = self.node_health[node]
+        self.node_health[node] = not healthy
+        self.front.update_node(
+            Node(name=node, ready=healthy),
+            Node(name=node, ready=not healthy),
+        )
+        self.stats["node_flips"] += 1
+
+    def health_tick(self) -> None:
+        self.front.health_tick()
+        self.tick_count += 1
+        self.stats["ticks"] += 1
+        self.stats["broadcasts"] += 1
+
+    def snapshot_flush(self) -> None:
+        self.front.note_watermark(self.event_i)
+        if self.front.flush_snapshot_now():
+            self.stats["snapshot_flushes"] += 1
+
+    def snapshot_corrupt(self) -> None:
+        if not self.kube.snapshot:
+            return
+        chunks = list(self.kube.snapshot)
+        idx = self.rnd.randrange(len(chunks))
+        chunks[idx] = chunks[idx][: max(1, len(chunks[idx]) // 2)] + "!"
+        self.kube.snapshot = chunks
+        self.stats["snapshot_corruptions"] += 1
+
+    # ---------------- audits ---------------- #
+
+    def audit(self, ctx: str) -> None:
+        for backend in self.front.shards:
+            audit_invariants(
+                backend.scheduler,
+                f"procs seed={self.seed} shard={backend.shard_id} {ctx}",
+            )
+            # Broadcast liveness: every shard's event clock tracks the
+            # tick count — a torn (staged-never-committed) broadcast
+            # freezes it (the no-op'd-phase-2 sensor).
+            assert backend.scheduler._health_clock == self.tick_count, (
+                self.seed, ctx, backend.shard_id,
+                backend.scheduler._health_clock, self.tick_count,
+            )
+            # Applied health for owned nodes equals the desired truth
+            # (damping is configured off here: threshold 3 flips within
+            # an 8-tick window rarely trips in these schedules, and the
+            # audit settles first).
+        self.front.settle_health_now()
+        merged = self.front.get_health()
+        desired_bad = {n for n, ok in self.node_health.items() if not ok}
+        assert set(merged["badNodes"]) == desired_bad, (
+            self.seed, ctx, merged["badNodes"], desired_bad,
+        )
+
+    def _predict_shard_recovery(self, snapshot_at_crash, state_at_crash):
+        """Per-shard expected recovery mode from the crash artifacts:
+        mirrors framework.load_valid_snapshot + the doom gate, per
+        partition slot."""
+        import json as _json
+
+        from hivedscheduler_tpu.scheduler import shards as shards_mod
+
+        plan = self.front.routing.shard_plan(self.n_shards)
+        fingerprint = self.front.routing.fingerprint(plan)
+        slices = shards_mod._split_snapshot(snapshot_at_crash, fingerprint)
+        ledgers: Dict[str, str] = {}
+        if state_at_crash:
+            try:
+                env = _json.loads(state_at_crash)
+                ledgers = dict(env.get("ledgers") or {})
+            except (TypeError, ValueError):
+                ledgers = {}
+        cfg_fp = snapshot_mod.config_fingerprint(self.config)
+        out = []
+        for sid in range(len(self.front.shards)):
+            chunks = slices.get(str(sid))
+            if not chunks:
+                out.append("full")
+                continue
+            snap, _reason = snapshot_mod.decode(chunks, cfg_fp, 0)
+            if snap is None:
+                out.append("fallback")
+                continue
+            if ChaosHarness._snapshot_dooms_match_ledger(
+                snap, ledgers.get(str(sid))
+            ):
+                out.append("snapshot+delta")
+            else:
+                out.append("fallback")
+        return out
+
+    def crash_restart(self, failover: bool = False, mid_bind: bool = False) -> None:
+        self.stats["restarts"] += 1
+        old = self.front
+        if any(self.preempting):
+            self.stats["preempt_restarts"] += 1
+        pending_bind = None
+        if failover:
+            self.stats["failovers"] += 1
+            if old.leadership is None:
+                boot = self._new_elector(
+                    f"ps{self.seed}-n{self.stats['restarts']}a"
+                )
+                old.leadership = boot
+                if not boot.try_acquire_or_renew():
+                    self.ha_clock += self.LEASE_DURATION_S + 0.5
+                    assert boot.try_acquire_or_renew(), (
+                        self.seed, "bootstrap lease acquisition failed",
+                    )
+            assert old.is_leader(), (self.seed, "leader lost lease early")
+            if mid_bind:
+                pending_bind = self._park_mid_bind()
+            self.ha_clock += self.LEASE_DURATION_S + 0.5
+            assert not old.is_leader(), (
+                self.seed, "frontend did not self-depose at lease expiry",
+            )
+        snapshot_at_crash = (
+            list(self.kube.snapshot)
+            if self.kube.snapshot is not None else None
+        )
+        state_at_crash = self.kube.state
+        nodes_at_crash = [
+            Node(name=n, ready=self.node_health[n]) for n in self._nodes()
+        ]
+        pods_at_crash = [
+            self.cluster_pods[uid] for uid in sorted(self.cluster_pods)
+        ]
+        expected_modes = self._predict_shard_recovery(
+            snapshot_at_crash, state_at_crash
+        )
+
+        new = self._new_front()
+        new.seed_preempt_rng(self.seed ^ 0xF00D)
+        if failover:
+            if self.stats["restarts"] % 2 == 0:
+                if new.prefetch_snapshot(min_watermark=0, apply=True):
+                    self.stats["hot_takeovers"] += 1
+            standby = self._new_elector(
+                f"ps{self.seed}-n{self.stats['restarts']}b"
+            )
+            new.leadership = standby
+            assert standby.try_acquire_or_renew(), (
+                self.seed, "standby could not acquire the expired lease",
+            )
+            binds_before = set(self.kube.bound)
+            if pending_bind is not None:
+                pod, node = pending_bind
+                try:
+                    old.bind_routine(
+                        ei.ExtenderBindingArgs(
+                            pod_name=pod.name, pod_namespace=pod.namespace,
+                            pod_uid=pod.uid, node=node,
+                        )
+                    )
+                    raise AssertionError(
+                        (self.seed, "deposed frontend bind not refused")
+                    )
+                except api.WebServerError as e:
+                    assert e.code == 503, (self.seed, e.code)
+                assert old._deposed_bind_refused == 1, self.seed
+                self.stats["deposed_bind_refusals"] += 1
+            assert set(self.kube.bound) == binds_before, (
+                self.seed, "deposed frontend landed a bind write",
+            )
+        new.recover(nodes_at_crash, pods_at_crash, min_watermark=0)
+        assert new.is_ready(), self.seed
+
+        # Per-shard snapshot-recovery contract.
+        for sid, backend in enumerate(new.shards):
+            mode = backend.scheduler._recovery_mode
+            expected = expected_modes[sid]
+            m = backend.call("get_metrics")
+            if expected == "snapshot+delta":
+                assert mode == "snapshot+delta", (
+                    self.seed, sid, mode, "valid shard snapshot unused",
+                )
+                self.stats["snapshot_recoveries"] += 1
+            elif expected == "fallback":
+                assert mode == "full", (
+                    self.seed, sid, mode, "unusable snapshot not refused",
+                )
+                assert m["snapshotFallbackCount"] >= 1, (self.seed, sid)
+                self.stats["snapshot_fallbacks"] += 1
+            else:
+                assert mode == "full", (self.seed, sid, mode)
+
+        # Work preservation: every confirmed-bound surviving pod keeps
+        # its placement.
+        iso = constants.ANNOTATION_POD_LEAF_CELL_ISOLATION
+        for uid, bound in self.kube.bound.items():
+            if uid not in self.cluster_pods:
+                continue
+            cur = self.cluster_pods[uid]
+            if not cur.node_name:
+                continue
+            found = new.get_status_pod(uid)
+            assert found is not None, (self.seed, uid, "bound pod lost")
+            pod, state = found
+            assert state == PodState.BOUND.value, (self.seed, uid, state)
+            assert pod.node_name == cur.node_name, (self.seed, uid)
+            assert (
+                pod.annotations.get(iso) == cur.annotations.get(iso)
+            ), (self.seed, uid, "isolation changed across restart")
+
+        # STRICT cross-shape restart equivalence: a SINGLE-PROCESS shadow
+        # recovered from identical crash inputs (nodes, live pods, the
+        # partitioned ledger translated to a one-process payload) must
+        # land in the identical durable state per owned-chain slice —
+        # chain-scoped core fingerprints plus probe outcomes, the same
+        # currency the main harness's restart equivalence uses.
+        shadow_kube = ScriptedKubeClient()
+        shadow_kube.state = merged_shard_ledger_payload(
+            state_at_crash, self.front.routing.shard_plan(self.n_shards)
+        )
+        shadow = HivedScheduler(
+            self.config, force_bind_executor=lambda fn: fn()
+        )
+        shadow.kube_client = shadow_kube
+        shadow.core.preempt_rng = random.Random(self.seed ^ 0xF00D)
+        shadow.recover(nodes_at_crash, pods_at_crash, min_watermark=0)
+        for backend in new.shards:
+            owned = backend.owned_chains
+            node_chains = new.routing.node_chains
+
+            def owned_node(name, _o=set(owned)):
+                return bool(set(node_chains.get(name, ())) & _o)
+
+            fp_shard = chain_scoped_fingerprint(
+                backend.scheduler.core, owned, owned_node
+            )
+            fp_shadow = chain_scoped_fingerprint(
+                shadow.core, owned, owned_node
+            )
+            assert fp_shard == fp_shadow, (
+                self.seed, backend.shard_id,
+                "cross-shape restart divergence",
+                {
+                    k: "differs"
+                    for k in fp_shard
+                    if fp_shard[k] != fp_shadow[k]
+                },
+            )
+        assert self._probe_classes(new) == self._probe_classes(shadow), (
+            self.seed, "cross-shape probe divergence",
+        )
+
+        old.close()
+        self.front = new
+        # Fresh shards restart the broadcast-liveness clock.
+        self.tick_count = 0
+        # Preemptions whose groups did not survive recovery are forgotten.
+        live_groups = {
+            (d.get("metadata") or {}).get("name")
+            for d in new.get_all_affinity_groups()["items"]
+        }
+        for name in list(self.preempting):
+            if name not in live_groups:
+                self.preempting.pop(name)
+
+    def _probe_classes(self, subject) -> List[tuple]:
+        """Outcome classes of a fixed filter-probe battery, shape-agnostic
+        (frontend and single scheduler both expose filter_routine). Probes
+        are never-seen single-pod groups — read-only against the core —
+        and uniquely named per restart so neither subject ever sees a
+        probe twice."""
+        outs: List[tuple] = []
+        tag = f"{self.seed}-{self.stats['restarts']}"
+        probe_i = 0
+        for fam in range(self.families):
+            for chips, prio in ((1, 0), (4, 0), (4, -1), (2, 5)):
+                probe_i += 1
+                pod = make_pod(
+                    f"probe-{tag}-{probe_i}", f"u-probe-{tag}-{probe_i}",
+                    f"vc{fam}", prio, f"cc{fam}-chip", chips,
+                    group={
+                        "name": f"probe-{tag}-{probe_i}",
+                        "members": [
+                            {"podNumber": 1, "leafCellNumber": chips}
+                        ],
+                    },
+                )
+                if hasattr(subject, "seed_preempt_rng"):
+                    subject.seed_preempt_rng(self.seed * 1000 + probe_i)
+                else:
+                    subject.core.preempt_rng = random.Random(
+                        self.seed * 1000 + probe_i
+                    )
+                try:
+                    subject.add_pod(pod)
+                    r = subject.filter_routine(
+                        ei.ExtenderArgs(pod=pod, node_names=self._nodes())
+                    )
+                except api.WebServerError:
+                    outs.append(("rejected",))
+                    subject.delete_pod(pod)
+                    continue
+                if r.node_names:
+                    outs.append(("bind",))
+                elif r.failed_nodes and set(r.failed_nodes) != {
+                    constants.COMPONENT_NAME
+                }:
+                    outs.append(("preempt",))
+                else:
+                    outs.append(("wait",))
+                subject.delete_pod(pod)
+        return outs
+
+    def _park_mid_bind(self):
+        """Assume-bind a pod but park its bind write for after deposal."""
+        for _ in range(4):
+            fam = self.rnd.randrange(self.families)
+            name, pods = self._mk_gang(fam, 0, 1, 1)
+            pod = pods[0]
+            self.front.add_pod(pod)
+            self.cluster_pods[pod.uid] = pod
+            r = self.front.filter_routine(
+                ei.ExtenderArgs(pod=pod, node_names=self._nodes())
+            )
+            if r.node_names:
+                self.gangs[name] = [pod.uid]
+                return pod, r.node_names[0]
+            self.front.delete_pod(pod)
+            self.cluster_pods.pop(pod.uid, None)
+            self.gangs.pop(name, None)
+        return None
+
+    def teardown_and_assert_no_leaks(self) -> None:
+        for name in sorted(self.gangs):
+            self.gang_delete_named(name)
+        for uid in sorted(self.cluster_pods):
+            self.front.delete_pod(self.cluster_pods.pop(uid))
+        for node, healthy in sorted(self.node_health.items()):
+            if not healthy:
+                self.node_health[node] = True
+                self.front.update_node(
+                    Node(name=node, ready=False), Node(name=node, ready=True)
+                )
+        self.front.settle_health_now()
+        for backend, pristine in zip(self.front.shards, self.pristine):
+            fp = core_fingerprint(backend.scheduler.core)
+            assert fp == pristine, (
+                self.seed, backend.shard_id,
+                "shard did not drain to pristine",
+            )
+        self.front.close()
+
+    def step(self, i: int) -> None:
+        self.event_i = i
+        self.stats["events"] += 1
+        roll = self.rnd.random()
+        if roll < 0.30:
+            self.gang_create()
+        elif roll < 0.42:
+            self.gang_delete()
+        elif roll < 0.52:
+            self.node_flip()
+        elif roll < 0.62:
+            self.health_tick()
+        elif roll < 0.72:
+            self.snapshot_flush()
+        elif roll < 0.76:
+            self.snapshot_corrupt()
+        elif roll < 0.84:
+            self.preempt_start()
+        elif roll < 0.88:
+            self.preempt_finish()
+        elif roll < 0.94:
+            self.crash_restart()
+        else:
+            self.crash_restart(
+                failover=True, mid_bind=self.rnd.random() < 0.5
+            )
+
+    def run(self, n_events: Optional[int] = None) -> Dict[str, int]:
+        n = n_events if n_events is not None else self.rnd.randint(10, 14)
+        for i in range(n):
+            self.step(i)
+            self.audit(f"step={i}")
+        self.event_i = n
+        # Every schedule restarts through the multi-process path at least
+        # once, alternating plain crash and lease failover.
+        self.crash_restart(failover=self.seed % 2 == 1)
+        self.audit("final-restart")
+        self.teardown_and_assert_no_leaks()
+        return self.stats
+
+
+def run_chaos_schedule_procs(
+    seed: int, n_events: Optional[int] = None, n_shards: int = 2
+) -> Dict[str, int]:
+    """One seeded multi-process chaos schedule (the proc-mode analog of
+    run_chaos_schedule; hack/soak.sh --procs N drives soak-scale runs)."""
+    return ProcChaosHarness(seed, n_shards=n_shards).run(n_events)

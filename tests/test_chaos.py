@@ -753,3 +753,62 @@ def test_bound_to_unbound_update_degrades_not_crashes():
     st = sched.pod_schedule_statuses["u-d"]
     assert st.pod_state == PodState.WAITING
     chaos.audit_invariants(sched, "bound-to-unbound")
+
+
+# --------------------------------------------------------------------- #
+# Multi-process chaos (scheduler.shards; doc/hot-path.md "The
+# multi-process contract")
+# --------------------------------------------------------------------- #
+
+# Coverage floor for the multi-process sweep (HIVED_CHAOS_PROCS_ROUNDS
+# overrides for soaks — hack/soak.sh --procs N drives it).
+PROC_CHAOS_ROUNDS = (
+    int(os.environ.get("HIVED_CHAOS_PROCS_ROUNDS", "0")) or 220
+)
+
+# Seeds whose schedules run a multi-target broadcast (health ticks /
+# settles span every shard) before finishing — the schedules that die
+# when phase 2 of the cross-shard broadcast is no-op'd (staged but never
+# committed: every shard's event clock freezes, which the harness's
+# broadcast-liveness audit asserts each step). Derived against the
+# proc-harness rng stream; re-derive when the event mix changes.
+PROC_BROADCAST_SEEDS = (2, 3, 5, 6, 7, 8)
+
+
+def test_chaos_procs_seed_sweep():
+    """The chaos acceptance for the multi-process core: >= 220 seeded
+    schedules through the sharded frontend, every restart and failover
+    taken through the multi-process recovery fan-out with the per-shard
+    snapshot contract, work preservation, STRICT cross-shape restart
+    equivalence (sharded recovered state == a single-process shadow
+    recovered from identical inputs, per owned-chain fingerprint slice
+    plus probe outcomes), and zero-leak teardown."""
+    stats = {}
+    for seed in range(PROC_CHAOS_ROUNDS):
+        for k, v in chaos.run_chaos_schedule_procs(seed).items():
+            stats[k] = stats.get(k, 0) + v
+    assert stats["restarts"] >= PROC_CHAOS_ROUNDS, stats
+    for key in (
+        "binds", "failovers", "hot_takeovers", "snapshot_flushes",
+        "snapshot_recoveries", "snapshot_fallbacks",
+        "snapshot_corruptions", "node_flips", "ticks", "broadcasts",
+        "preempts", "preempt_restarts", "deposed_bind_refusals",
+    ):
+        assert stats[key] > 0, (key, stats)
+
+
+def test_nooped_broadcast_commit_is_caught(monkeypatch):
+    """Sensitivity meta-test (style of test_nooped_delta_replay_is_caught):
+    with phase 2 of the two-phase broadcast no-op'd — operations staged
+    on every shard but never committed — the pinned seeds' schedules must
+    fail their broadcast-liveness audit. If this passes while commits are
+    dead, the proc chaos sweep is blind to torn broadcasts."""
+    from hivedscheduler_tpu.scheduler.shards import ShardedScheduler
+
+    monkeypatch.setattr(
+        ShardedScheduler, "_commit_phase",
+        lambda self, backend, op_id: None,
+    )
+    for seed in PROC_BROADCAST_SEEDS:
+        with pytest.raises(AssertionError):
+            chaos.run_chaos_schedule_procs(seed)

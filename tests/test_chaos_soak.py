@@ -62,3 +62,24 @@ def test_chaos_soak():
         required.append("failovers")
     for key in required:
         assert stats[key] > 0, (key, stats)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    os.environ.get("HIVED_CHAOS_PROCS", "") == "",
+    reason="proc soak only: set HIVED_CHAOS_PROCS=N (hack/soak.sh --procs)",
+)
+def test_chaos_procs_soak():
+    """Soak-scale multi-process chaos: the proc-mode sweep at
+    HIVED_CHAOS_ROUNDS scale with HIVED_CHAOS_PROCS shards
+    (hack/soak.sh --procs N)."""
+    n_shards = int(os.environ.get("HIVED_CHAOS_PROCS", "2"))
+    stats = {}
+    for seed in range(SOAK_START, SOAK_START + SOAK_ROUNDS):
+        for k, v in chaos.run_chaos_schedule_procs(
+            seed, n_shards=n_shards
+        ).items():
+            stats[k] = stats.get(k, 0) + v
+    assert stats["restarts"] >= SOAK_ROUNDS, stats
+    for key in ("binds", "failovers", "snapshot_recoveries"):
+        assert stats[key] > 0, (key, stats)

@@ -284,3 +284,74 @@ def test_incremental_view_dirty_tracking_under_churn():
             else:
                 naive.delete(name)
                 incr.delete(name)
+
+
+def test_view_order_is_state_pure():
+    """State-pure sorted view (ROADMAP PR-1/5 carry): the packing order
+    is a pure function of cell state — two schedulers at the SAME cell
+    state must present the SAME view order, regardless of how they got
+    there. Before the config-order total key, equal-score order was
+    whatever the stable sort inherited from scoring history, which a
+    restart cannot reconstruct."""
+    import random as _random
+
+    from hivedscheduler_tpu.api import extender as ei
+    from hivedscheduler_tpu.scheduler.framework import (
+        HivedScheduler,
+        NullKubeClient,
+    )
+    from hivedscheduler_tpu.scheduler.types import Node
+
+    def build():
+        sched = HivedScheduler(
+            random_config(_random.Random(7)),
+            kube_client=NullKubeClient(), auto_admit=True,
+        )
+        for n in sched.core.configured_node_names():
+            sched.add_node(Node(name=n))
+        return sched
+
+    churned, fresh = build(), build()
+    nodes = churned.core.configured_node_names()
+    # Churn one subject through placements that all get deleted again —
+    # same END state, very different scoring history.
+    rnd = _random.Random(99)
+    for i in range(12):
+        chips = rnd.choice([1, 2, 4])
+        pod = make_pod(
+            f"sp{i}-0", f"u-sp{i}", rnd.choice(["A", "B"]),
+            rnd.choice([-1, 0]), "v5e-chip", chips,
+            group={"name": f"sp{i}",
+                   "members": [{"podNumber": 1, "leafCellNumber": chips}]},
+        )
+        r = churned.filter_routine(
+            ei.ExtenderArgs(pod=pod, node_names=nodes)
+        )
+        if r.node_names:
+            churned.delete_pod(
+                churned.pod_schedule_statuses[pod.uid].pod
+            )
+    # One probe each so both views are scored at identical parameters.
+    probe = make_pod(
+        "sp-probe", "u-sp-probe", "A", 0, "v5e-chip", 1,
+        group={"name": "sp-probe",
+               "members": [{"podNumber": 1, "leafCellNumber": 1}]},
+    )
+    for sched in (churned, fresh):
+        sched.filter_routine(ei.ExtenderArgs(pod=probe, node_names=nodes))
+        sched.delete_pod(sched.pod_schedule_statuses["u-sp-probe"].pod)
+    for subject in (churned, fresh):
+        for ts in subject.core._all_topology_schedulers():
+            # Total order: the flat list must equal a full sort by the
+            # total key — and carry no equal-total-key ambiguity.
+            keys = [v.sort_key() for v in ts.cluster_view]
+            assert keys == sorted(keys), "view not in total-key order"
+            assert len(set(keys)) == len(keys), "sort key not total"
+    for ts_a, ts_b in zip(
+        churned.core._all_topology_schedulers(),
+        fresh.core._all_topology_schedulers(),
+    ):
+        assert (
+            [v.cell.address for v in ts_a.cluster_view]
+            == [v.cell.address for v in ts_b.cluster_view]
+        ), "view order depends on scoring history"

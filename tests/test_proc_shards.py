@@ -1,0 +1,694 @@
+"""Multi-process scheduling core (scheduler.shards): partition, routing,
+cross-process differential equivalence, two-phase broadcast, and the
+partitioned recovery fan-out.
+
+Four contracts (doc/hot-path.md "The multi-process contract"):
+
+1. **Partition** — chain families are the connected components of the
+   "shares a leaf SKU" relation, dealt round-robin onto shards; every
+   typed/pinned pod is single-family-routable.
+2. **Equivalence** — the sharded frontend (local AND real-process
+   backends) produces identical filter/preempt/bind outcomes, cluster
+   statuses, group listings, and doomed ledgers to a single in-process
+   scheduler over randomized scenario schedules.
+3. **Global mode** — multi-shard operations run as a two-phase
+   broadcast (stage everywhere, commit in shard order); a no-op'd
+   commit phase leaves state unapplied (what the chaos sensitivity
+   meta-test pins), and a failed stage aborts cleanly.
+4. **Recovery fan-out** — each shard restores its own ledger/snapshot
+   partition and delta-replays its own chains; a partition change
+   falls back to the full annotation replay deterministically.
+"""
+
+import json
+import logging
+import random
+
+import pytest
+
+import bench
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.api import extender as ei, types as api
+from hivedscheduler_tpu.scheduler.framework import (
+    HivedScheduler,
+    NullKubeClient,
+)
+from hivedscheduler_tpu.scheduler.shards import (
+    RoutingTable,
+    ShardedScheduler,
+)
+from hivedscheduler_tpu.scheduler.types import Node, Pod
+
+from .chaos import audit_invariants, random_config
+from .test_core import make_pod
+
+common.init_logging(logging.CRITICAL)
+
+N_DIFFERENTIAL_SCENARIOS = 12
+
+
+def _close(front):
+    front.close()
+
+
+# --------------------------------------------------------------------- #
+# 1. Partition + routing
+# --------------------------------------------------------------------- #
+
+
+def test_families_are_leaf_sharing_components():
+    cfg = bench.build_concurrent_config(3, 8)
+    rt = RoutingTable(cfg)
+    # One SKU per chain here: every chain is its own family.
+    assert rt.families == (
+        ("cc0-slice",), ("cc1-slice",), ("cc2-slice",),
+    )
+    # Round-robin plan: 2 shards get 2 + 1 families.
+    plan = rt.shard_plan(2)
+    assert plan == [("cc0-slice", "cc2-slice"), ("cc1-slice",)]
+    # More shards than families: tail shards are simply not created.
+    assert rt.shard_plan(5) == [
+        ("cc0-slice",), ("cc1-slice",), ("cc2-slice",),
+    ]
+
+
+def test_pod_chains_matches_framework_derivation():
+    """The parent's routing derivation must agree with the in-process
+    lock-chain derivation for every routable pod shape (same inputs,
+    same chains — routing to the owner shard is exactly scoping to the
+    lock set PR 5 proved sufficient)."""
+    for seed in range(6):
+        cfg_a, cfg_b = (
+            random_config(random.Random(seed)),
+            random_config(random.Random(seed)),
+        )
+        sched = HivedScheduler(cfg_a, kube_client=NullKubeClient())
+        rt = RoutingTable(cfg_b)
+        for leaf_type in (None, "v5e-chip", "v5p-chip"):
+            for prio in (-1, 0, 5):
+                pod = make_pod(
+                    f"r{seed}", f"u{seed}-{leaf_type}-{prio}", "A",
+                    prio, leaf_type, 2,
+                    group={
+                        "name": f"rg{seed}",
+                        "members": [
+                            {"podNumber": 1, "leafCellNumber": 2}
+                        ],
+                    },
+                )
+                from hivedscheduler_tpu.scheduler.types import (
+                    extract_pod_scheduling_spec,
+                )
+
+                spec = extract_pod_scheduling_spec(pod)
+                mine = rt.pod_chains(pod, spec)
+                theirs = sched._pod_lock_chains(pod, spec)
+                assert (mine is None) == (theirs is None), (
+                    seed, leaf_type, prio,
+                )
+                if mine is not None:
+                    assert set(mine) == set(theirs), (
+                        seed, leaf_type, prio, mine, theirs,
+                    )
+
+
+# --------------------------------------------------------------------- #
+# 2. Differential equivalence
+# --------------------------------------------------------------------- #
+
+
+def _drive(sched, seed: int, nodes, seed_rng):
+    """One seeded schedule of typed gang churn, node flips, and preempt
+    probes through the production verbs; returns the outcome trace. The
+    victim-pick rng is re-seeded per event on BOTH subjects (the sharded
+    frontend splits one logical stream across worker cores; per-event
+    seeding makes the pick a pure function of the event)."""
+    rnd = random.Random(seed)
+    outcomes = []
+    live = {}
+    gang_id = 0
+    for event in range(22):
+        seed_rng((seed << 8) ^ event)
+        roll = rnd.random()
+        if roll < 0.15 and live:
+            name = rnd.choice(sorted(live))
+            for bp in live.pop(name):
+                sched.delete_pod(bp)
+            outcomes.append(("del", name))
+            continue
+        if roll < 0.25:
+            node = rnd.choice(nodes)
+            bad = rnd.random() < 0.5
+            sched.update_node(
+                Node(name=node, ready=bad), Node(name=node, ready=not bad)
+            )
+            outcomes.append(("node", node, not bad))
+            continue
+        gang_id += 1
+        name = f"g{seed}-{gang_id}"
+        vc = rnd.choice(["A", "B"])
+        leaf_type = rnd.choice(["v5e-chip", "v5e-chip", "v5p-chip"])
+        priority = rnd.choice([-1, 0, 0, 5])
+        n_pods = rnd.choice([1, 1, 2, 4])
+        chips = rnd.choice([1, 2, 4])
+        group = {
+            "name": name,
+            "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
+        }
+        preempt = rnd.random() < 0.25
+        bound, ok = [], True
+        for i in range(n_pods):
+            pod = make_pod(
+                f"{name}-{i}", f"u-{name}-{i}", vc, priority, leaf_type,
+                chips, group=group,
+            )
+            if preempt:
+                try:
+                    r = sched.preempt_routine(
+                        ei.ExtenderPreemptionArgs(
+                            pod=pod,
+                            node_name_to_meta_victims={
+                                n: ei.MetaVictims() for n in nodes
+                            },
+                        )
+                    )
+                    outcomes.append(
+                        ("preempt", name, i,
+                         sorted(r.node_name_to_meta_victims or {}))
+                    )
+                except api.WebServerError as e:
+                    outcomes.append(("preempt-err", name, i, e.message))
+                sched.delete_pod(pod)
+                ok = False
+                break
+            try:
+                r = sched.filter_routine(
+                    ei.ExtenderArgs(pod=pod, node_names=nodes)
+                )
+            except api.WebServerError as e:
+                outcomes.append(("filter-err", name, i, e.message))
+                sched.delete_pod(pod)
+                ok = False
+                break
+            outcomes.append(
+                ("filter", name, i, r.node_names,
+                 sorted(r.failed_nodes or {}))
+            )
+            if r.node_names:
+                bound.append(sched.pod_schedule_statuses[pod.uid].pod)
+            else:
+                ok = False
+                break
+        if ok and bound:
+            live[name] = bound
+        else:
+            for bp in bound:
+                sched.delete_pod(bp)
+            for i in range(len(bound) + 1, n_pods):
+                sched.delete_pod(make_pod(
+                    f"{name}-{i}", f"u-{name}-{i}", vc, priority,
+                    leaf_type, chips, group=group,
+                ))
+    return outcomes
+
+
+_COUNTER_WHITELIST = (
+    "filterCount", "bindCount", "preemptCount", "waitCount",
+    "quarantineCount", "quarantinedPodCount",
+    "gangAdmissionBatchedCount", "healthTransitionCount",
+    "strandedGroupCount", "badNodeCount", "badChipCount",
+    "drainingChipCount",
+)
+
+
+def _normalized_view(sched) -> dict:
+    """The payload slice both shapes must agree on. History-ordered
+    listings are canonicalized on BOTH subjects: group items and each
+    VC's opportunistic-cell tail follow allocation order in a single
+    process but name/address order in the merged frontend."""
+    groups = sched.get_all_affinity_groups()["items"]
+    metrics = sched.get_metrics()
+    ledger = sched.get_doomed_ledger()
+    cluster = sched.get_cluster_status()
+    vcs_norm = {}
+    for vcn, statuses in cluster["virtualClusters"].items():
+        static = [
+            st for st in statuses
+            if not str(st.get("cellAddress", "")).endswith("-opp")
+        ]
+        opp = sorted(
+            (
+                st for st in statuses
+                if str(st.get("cellAddress", "")).endswith("-opp")
+            ),
+            key=lambda st: str(st.get("cellAddress")),
+        )
+        vcs_norm[vcn] = static + opp
+    return {
+        "physical": cluster["physicalCluster"],
+        "virtual": vcs_norm,
+        "groups": sorted(
+            groups, key=lambda d: (d.get("metadata") or {}).get("name", "")
+        ),
+        "ledgerVcs": ledger["vcs"],
+        "counters": {k: metrics.get(k) for k in _COUNTER_WHITELIST},
+    }
+
+
+def test_sharded_frontend_equals_single_process_local():
+    """Local-backend differential at chaos scale: identical outcomes AND
+    identical merged externally-visible state over randomized typed
+    scenarios. Local backends run the same routing/broadcast/partition
+    code as process backends — only the pipe is elided."""
+    for seed in range(N_DIFFERENTIAL_SCENARIOS):
+        front = ShardedScheduler(
+            random_config(random.Random(seed)),
+            kube_client=NullKubeClient(),
+            n_shards=2, transport="local", auto_admit=True,
+        )
+        single = HivedScheduler(
+            random_config(random.Random(seed)),
+            kube_client=NullKubeClient(), auto_admit=True,
+        )
+        nodes = single.core.configured_node_names()
+        assert front.configured_node_names() == sorted(nodes)
+        for n in nodes:
+            front.add_node(Node(name=n))
+            single.add_node(Node(name=n))
+        out_f = _drive(front, seed, nodes, front.seed_preempt_rng)
+
+        def seed_single(s):
+            single.core.preempt_rng = random.Random(s)
+
+        out_s = _drive(single, seed, nodes, seed_single)
+        assert out_f == out_s, (seed, out_f[-3:], out_s[-3:])
+        va, vb = _normalized_view(front), _normalized_view(single)
+        assert va == vb, (
+            seed, {k: "differs" for k in va if va[k] != vb[k]},
+        )
+        json.dumps(va["physical"]); json.dumps(va["virtual"])  # webserver contract
+        for backend in front.shards:
+            audit_invariants(
+                backend.scheduler, f"seed={seed} shard={backend.shard_id}"
+            )
+        _close(front)
+
+
+@pytest.fixture(scope="module")
+def proc_front():
+    """One real-process frontend shared by the proc-boundary tests
+    (worker spawn is ~1s each; the suite reuses them)."""
+    front = ShardedScheduler(
+        bench.build_concurrent_config(2, 8),
+        kube_client=NullKubeClient(),
+        n_shards=2, transport="proc", auto_admit=True,
+    )
+    yield front
+    front.close()
+
+
+def test_process_boundary_differential(proc_front):
+    """The SAME scenario through real worker processes and a single
+    in-process scheduler: identical outcomes and merged views. This is
+    the cross-process half of the PR-5 differential suite — the pipe,
+    pickling, and true parallelism must not change one answer."""
+    front = proc_front
+    single = HivedScheduler(
+        bench.build_concurrent_config(2, 8),
+        kube_client=NullKubeClient(), auto_admit=True,
+    )
+    nodes = single.core.configured_node_names()
+    for n in nodes:
+        front.add_node(Node(name=n))
+        single.add_node(Node(name=n))
+    outs = []
+    for sched in (front, single):
+        out = []
+        for fam in range(2):
+            for g in range(4):
+                gname = f"pb{fam}-g{g}"
+                group = {
+                    "name": gname,
+                    "members": [{"podNumber": 2, "leafCellNumber": 4}],
+                }
+                for i in range(2):
+                    p = make_pod(
+                        f"{gname}-{i}", f"u-{gname}-{i}", f"vc{fam}",
+                        0, f"cc{fam}-chip", 4, group=group,
+                    )
+                    r = sched.filter_routine(
+                        ei.ExtenderArgs(pod=p, node_names=nodes)
+                    )
+                    out.append((p.uid, tuple(r.node_names or ()),
+                                tuple(sorted(r.failed_nodes or {}))))
+        outs.append(out)
+    assert outs[0] == outs[1]
+    assert (
+        front.get_physical_cluster_status()
+        == single.get_physical_cluster_status()
+    )
+    assert (
+        front.get_all_virtual_clusters_status()
+        == single.get_all_virtual_clusters_status()
+    )
+    va, vb = _normalized_view(front), _normalized_view(single)
+    assert va == vb
+    # Drain the fill (shared fixture) before the raw-path checks below
+    # need free capacity again.
+    for fam in range(2):
+        for g in range(4):
+            gname = f"pb{fam}-g{g}"
+            group = {
+                "name": gname,
+                "members": [{"podNumber": 2, "leafCellNumber": 4}],
+            }
+            front.delete_pods([
+                make_pod(
+                    f"{gname}-{i}", f"u-{gname}-{i}", f"vc{fam}", 0,
+                    f"cc{fam}-chip", 4, group=group,
+                )
+                for i in range(2)
+            ])
+    # Raw-bytes filter path (what the webserver drives): same answer as
+    # the object path, decoded in the worker.
+    p = make_pod(
+        "raw-0", "u-raw-0", "vc0", 0, "cc0-chip", 4,
+        group={"name": "raw", "members": [
+            {"podNumber": 1, "leafCellNumber": 4}]},
+    )
+    body = json.dumps(
+        ei.ExtenderArgs(pod=p, node_names=nodes).to_dict()
+    ).encode()
+    r = json.loads(front.filter_raw(body))
+    assert r.get("NodeNames"), r
+    front.delete_pod(p)
+    # Error semantics cross the pipe in-band, like the webserver's.
+    bad = Pod(
+        name="bad", uid="u-bad",
+        annotations={"hivedscheduler.tpu.io/pod-scheduling-spec": "{"},
+        resource_limits={
+            "hivedscheduler.tpu.io/pod-scheduling-enable": 1
+        },
+    )
+    body = json.dumps(
+        ei.ExtenderArgs(pod=bad, node_names=nodes).to_dict()
+    ).encode()
+    r = json.loads(front.filter_raw(body))
+    assert r.get("Error"), r
+
+
+def test_process_boundary_true_parallelism(proc_front):
+    """Deterministic overlap proof across the OS process boundary: a
+    request parked inside shard 0 (FIFO block) must not delay a request
+    to shard 1 — with one GIL this needs two interpreters."""
+    import threading
+    import time as _time
+
+    front = proc_front
+    nodes = front.configured_node_names()
+    for n in nodes:
+        front.add_node(Node(name=n))
+    done = []
+
+    def slow():  # shard 0: a filter that waits (full VC -> FIFO block)
+        p = make_pod(
+            "par-slow", "u-par-slow", "vc0", 0, "cc0-chip", 4,
+            group={"name": "par-slow", "members": [
+                {"podNumber": 9999, "leafCellNumber": 4}]},
+        )
+        try:
+            front.filter_routine(
+                ei.ExtenderArgs(pod=p, node_names=nodes)
+            )
+        except api.WebServerError:
+            pass
+        done.append(("slow", _time.monotonic()))
+
+    def fast():  # shard 1: a normal bind
+        p = make_pod(
+            "par-fast", "u-par-fast", "vc1", 0, "cc1-chip", 4,
+            group={"name": "par-fast", "members": [
+                {"podNumber": 1, "leafCellNumber": 4}]},
+        )
+        r = front.filter_routine(
+            ei.ExtenderArgs(pod=p, node_names=nodes)
+        )
+        assert r.node_names or r.failed_nodes
+        done.append(("fast", _time.monotonic()))
+        front.delete_pod(p)
+
+    ts = threading.Thread(target=slow)
+    tf = threading.Thread(target=fast)
+    ts.start()
+    tf.start()
+    ts.join(timeout=30)
+    tf.join(timeout=30)
+    assert len(done) == 2, "a shard request wedged"
+    front.delete_pod(make_pod(
+        "par-slow", "u-par-slow", "vc0", 0, "cc0-chip", 4,
+        group={"name": "par-slow", "members": [
+            {"podNumber": 9999, "leafCellNumber": 4}]},
+    ))
+
+
+# --------------------------------------------------------------------- #
+# 3. Two-phase broadcast
+# --------------------------------------------------------------------- #
+
+
+def _local_front(n_families=2, n_shards=2, hosts=8):
+    return ShardedScheduler(
+        bench.build_concurrent_config(n_families, hosts),
+        kube_client=NullKubeClient(),
+        n_shards=n_shards, transport="local", auto_admit=True,
+    )
+
+
+def test_broadcast_commits_in_shard_order_after_staging():
+    front = _local_front()
+    calls = []
+    orig = ShardedScheduler._commit_phase
+
+    def spy(self, backend, op_id):
+        calls.append(backend.shard_id)
+        return orig(self, backend, op_id)
+
+    ShardedScheduler._commit_phase = spy
+    try:
+        front.health_tick()  # all-shard broadcast
+    finally:
+        ShardedScheduler._commit_phase = orig
+    assert calls == [0, 1], calls
+    _close(front)
+
+
+def test_nooped_commit_phase_leaves_state_unapplied():
+    """The torn-broadcast failure mode the chaos meta-test pins: when
+    phase 2 never runs, NO shard applies the staged operation — the
+    harness's desired-vs-applied health audit is what catches it."""
+    front = _local_front()
+    node = front.configured_node_names()[0]
+    orig = ShardedScheduler._commit_phase
+    ShardedScheduler._commit_phase = lambda self, backend, op_id: None
+    try:
+        front.health_tick()  # multi-target: stages but never commits
+    finally:
+        ShardedScheduler._commit_phase = orig
+    for backend in front.shards:
+        assert backend.scheduler._health_clock == 0, (
+            "no-op'd commit phase still applied the tick"
+        )
+        assert backend.server._staged, "nothing was staged"
+    # The staged op is still there; a later commit applies it.
+    front.health_tick()
+    for backend in front.shards:
+        assert backend.scheduler._health_clock >= 1
+    # Single-target operations degenerate to a direct call (no second
+    # phase to tear): the node event below applies even with commits
+    # no-op'd, because exactly one shard owns the node's chains.
+    ShardedScheduler._commit_phase = lambda self, backend, op_id: None
+    try:
+        front.add_node(Node(name=node))
+    finally:
+        ShardedScheduler._commit_phase = orig
+    sid = front.shard_for_chain(front.routing.node_chains[node][0])
+    assert node in front.shards[sid].scheduler.nodes
+    _close(front)
+
+
+def test_broadcast_stage_failure_aborts_cleanly():
+    front = _local_front()
+    boom = RuntimeError("stage down")
+    orig_call = type(front.shards[1]).call
+
+    def failing_call(self, method, *args):
+        if self.shard_id == 1 and method == "op_stage":
+            raise boom
+        return orig_call(self, method, *args)
+
+    type(front.shards[1]).call = failing_call
+    try:
+        with pytest.raises(RuntimeError, match="stage down"):
+            front.health_tick()
+    finally:
+        type(front.shards[1]).call = orig_call
+    # The staged half was aborted: nothing lingers, nothing applied.
+    for backend in front.shards:
+        assert not backend.server._staged
+        assert backend.scheduler._health_clock == 0
+    _close(front)
+
+
+# --------------------------------------------------------------------- #
+# 4. Partitioned recovery fan-out
+# --------------------------------------------------------------------- #
+
+
+class _StoreKubeClient(NullKubeClient):
+    """NullKubeClient + in-memory scheduler-state/snapshot blobs (the
+    parent-side store the partition envelopes multiplex onto)."""
+
+    def __init__(self):
+        super().__init__()
+        self.state = None
+        self.chunks = None
+
+    def persist_scheduler_state(self, payload):
+        self.state = payload
+
+    def load_scheduler_state(self):
+        return self.state
+
+    def persist_snapshot(self, chunks):
+        self.chunks = list(chunks)
+
+    def load_snapshot(self):
+        return list(self.chunks) if self.chunks is not None else None
+
+
+def _fill_confirmed(front, nodes):
+    """Schedule gangs and confirm every assume-bind BOUND (the informer
+    confirm in miniature), so snapshots have durable pods to carry."""
+    bound = []
+    for fam in range(2):
+        for g in range(3):
+            gname = f"rc{fam}-g{g}"
+            group = {
+                "name": gname,
+                "members": [{"podNumber": 2, "leafCellNumber": 4}],
+            }
+            for i in range(2):
+                p = make_pod(
+                    f"{gname}-{i}", f"u-{gname}-{i}", f"vc{fam}", 0,
+                    f"cc{fam}-chip", 4, group=group,
+                )
+                front.add_pod(p)
+                r = front.filter_routine(
+                    ei.ExtenderArgs(pod=p, node_names=nodes)
+                )
+                assert r.node_names, (gname, r.failed_nodes)
+                bp, _state = front.get_status_pod(p.uid)
+                confirmed = Pod(
+                    name=bp.name, namespace=bp.namespace, uid=bp.uid,
+                    annotations=dict(bp.annotations),
+                    node_name=bp.node_name, phase="Running",
+                    resource_limits=dict(bp.resource_limits),
+                )
+                front.update_pod(p, confirmed)
+                bound.append(confirmed)
+    return bound
+
+
+def _structural(view: dict) -> dict:
+    """The restart-comparable slice: counters are process history (a
+    recovered process starts them at zero); structure must round-trip."""
+    return {k: v for k, v in view.items() if k != "counters"}
+
+
+def test_recovery_fans_out_per_shard_partitions():
+    kube = _StoreKubeClient()
+    cfg = lambda: bench.build_concurrent_config(2, 8)  # noqa: E731
+    front = ShardedScheduler(
+        cfg(), kube_client=kube, n_shards=2, transport="local",
+    )
+    front.mark_ready()
+    nodes = front.configured_node_names()
+    for n in nodes:
+        front.add_node(Node(name=n))
+    bound = _fill_confirmed(front, nodes)
+    front.note_watermark(7)
+    assert front.flush_snapshot_now()
+    assert kube.chunks is not None and kube.state is not None
+    # The stored blobs are partition envelopes keyed per shard.
+    env = json.loads(kube.state)
+    assert set(env["ledgers"]) == {"0", "1"}
+    directory = json.loads(kube.chunks[0])
+    assert set(directory["shards"]) == {"0", "1"}
+    before = _structural(_normalized_view(front))
+
+    # Crash-restart: a NEW frontend recovers from the store + live lists.
+    front2 = ShardedScheduler(
+        cfg(), kube_client=kube, n_shards=2, transport="local",
+    )
+    front2.recover(
+        [Node(name=n) for n in nodes], bound, min_watermark=0,
+    )
+    assert front2.is_ready()
+    for backend in front2.shards:
+        assert backend.scheduler._recovery_mode == "snapshot+delta", (
+            backend.shard_id, backend.scheduler._recovery_mode,
+        )
+    assert _structural(_normalized_view(front2)) == before
+    # Routing maps were rebuilt from the shards: a recovered pod's
+    # delete routes without a spec derivation.
+    front2.delete_pod(bound[0])
+    assert bound[0].uid not in front2.pod_schedule_statuses
+
+    # Partition change (different shard count): the envelope mismatch
+    # must fall back to the FULL annotation replay — deterministically,
+    # landing in the same externally-visible state.
+    front3 = ShardedScheduler(
+        cfg(), kube_client=kube, n_shards=1, transport="local",
+    )
+    front3.recover(
+        [Node(name=n) for n in nodes], bound, min_watermark=0,
+    )
+    for backend in front3.shards:
+        assert backend.scheduler._recovery_mode == "full"
+    assert _structural(_normalized_view(front3)) == before
+    for f in (front, front2, front3):
+        _close(f)
+
+
+def test_process_boundary_restart(proc_front):
+    """Restart through real worker processes: flush partitioned
+    snapshots, tear the frontend down, recover a fresh one — per-shard
+    snapshot+delta recovery across the pipe, identical merged state."""
+    kube = _StoreKubeClient()
+    cfg = bench.build_concurrent_config(2, 8)
+    front = ShardedScheduler(
+        cfg, kube_client=kube, n_shards=2, transport="proc",
+    )
+    front.mark_ready()
+    nodes = front.configured_node_names()
+    for n in nodes:
+        front.add_node(Node(name=n))
+    bound = _fill_confirmed(front, nodes)
+    front.note_watermark(3)
+    assert front.flush_snapshot_now()
+    before = _structural(_normalized_view(front))
+    front.close()
+
+    front2 = ShardedScheduler(
+        cfg, kube_client=kube, n_shards=2, transport="proc",
+    )
+    front2.recover(
+        [Node(name=n) for n in nodes], bound, min_watermark=0,
+    )
+    assert front2.is_ready()
+    modes = [
+        b.call("get_metrics")["recoveryMode"] for b in front2.shards
+    ]
+    assert modes == ["snapshot+delta", "snapshot+delta"], modes
+    assert _structural(_normalized_view(front2)) == before
+    front2.close()

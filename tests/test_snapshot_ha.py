@@ -709,3 +709,64 @@ def test_readyz_gates_on_leadership_and_recovery():
     sched._ready.clear()  # leader but still recovering
     with pytest.raises(api.WebServerError):
         probe._route_get(constants.READYZ_PATH)
+
+
+def test_incremental_export_matches_cold_rebuild():
+    """Per-chain export memoization (doc/hot-path.md): over a seeded
+    churn schedule, every memoized export must equal a cold rebuild
+    (memo cleared), and a chain untouched between exports must serve the
+    SAME section object (one dict lookup, no re-walk)."""
+    import random as _random
+
+    from .chaos import random_config
+    from .test_core import make_pod
+
+    for seed in (0, 1, 2):
+        sched = HivedScheduler(
+            random_config(_random.Random(seed)), auto_admit=True
+        )
+        core = sched.core
+        nodes = core.configured_node_names()
+        for n in nodes:
+            sched.add_node(Node(name=n))
+        rnd = _random.Random(seed ^ 0xE47)
+        live = []
+        for i in range(18):
+            roll = rnd.random()
+            if roll < 0.3 and live:
+                sched.delete_pod(live.pop(rnd.randrange(len(live))))
+            elif roll < 0.45:
+                node = rnd.choice(nodes)
+                bad = rnd.random() < 0.5
+                sched.update_node(
+                    Node(name=node, ready=bad),
+                    Node(name=node, ready=not bad),
+                )
+            else:
+                chips = rnd.choice([1, 2, 4])
+                pod = make_pod(
+                    f"ie{seed}-{i}", f"u-ie{seed}-{i}",
+                    rnd.choice(["A", "B"]), rnd.choice([-1, 0]),
+                    "v5e-chip", chips,
+                    group={
+                        "name": f"ie{seed}-{i}",
+                        "members": [{"podNumber": 1,
+                                     "leafCellNumber": chips}],
+                    },
+                )
+                r = sched.filter_routine(
+                    ei.ExtenderArgs(pod=pod, node_names=nodes)
+                )
+                if r.node_names:
+                    live.append(
+                        sched.pod_schedule_statuses[pod.uid].pod
+                    )
+            memoized = core.export_projection()
+            core._export_chain_memo.clear()
+            cold = core.export_projection()
+            assert memoized == cold, (seed, i)
+        # Quiet chains reuse the memoized section object verbatim.
+        before = dict(core._export_chain_memo)
+        core.export_projection()
+        for chain, (epoch, section) in core._export_chain_memo.items():
+            assert before[chain][1] is section, chain
