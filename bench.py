@@ -132,84 +132,10 @@ def _attach_sizing(result: dict) -> dict:
     return result
 
 
-def build_config(cubes: int = 4, slices: int = 8, solos: int = 8) -> Config:
-    """The bench fleet: ``cubes`` v5p-64 cubes (16 hosts each), ``slices``
-    v5e-16 slices (4 hosts each), ``solos`` standalone v5e hosts. Defaults
-    give the 104-host default load; the 432-host fleet variant
-    (doc/hot-path.md measured tables) is cubes=16, slices=40, solos=16.
-    VC quota scales with the fleet so the gang mix always fits."""
-    cell_types = {}
-    cell_types.update(topology.v5p_cell_types(max_hosts=16))
-    cell_types.update(topology.v5e_cell_types(max_hosts=4))
-    physical = []
-    for cube in range(cubes):
-        physical.append(
-            topology.make_physical_cell(
-                "v5p-64",
-                [f"v5p-c{cube}-w{i}" for i in range(16)],
-                cell_types,
-            ).to_dict()
-        )
-    for s in range(slices):
-        physical.append(
-            topology.make_physical_cell(
-                "v5e-16", [f"v5e-s{s}-w{i}" for i in range(4)], cell_types
-            ).to_dict()
-        )
-    for h in range(solos):
-        physical.append(
-            topology.make_physical_cell(
-                "v5e-host", [f"v5e-solo-{h}"], cell_types
-            ).to_dict()
-        )
-    return Config.from_dict(
-        {
-            "physicalCluster": {
-                "cellTypes": {
-                    n: {
-                        "childCellType": s.child_cell_type,
-                        "childCellNumber": s.child_cell_number,
-                        "isNodeLevel": s.is_node_level,
-                    }
-                    for n, s in cell_types.items()
-                },
-                "physicalCells": physical,
-            },
-            "virtualClusters": {
-                "prod": {
-                    "virtualCells": [
-                        {"cellType": "v5p-64", "cellNumber": cubes // 2},
-                        {"cellType": "v5e-16", "cellNumber": slices // 2},
-                    ]
-                },
-                "research": {
-                    "virtualCells": [
-                        {"cellType": "v5p-64.v5p-16", "cellNumber": 2 * cubes},
-                        {"cellType": "v5e-16", "cellNumber": slices // 2},
-                        {"cellType": "v5e-host", "cellNumber": solos},
-                    ]
-                },
-            },
-        }
-    )
-
-
-def make_pod(name, uid, vc, priority, leaf_type, leaf_num, group):
-    import yaml
-
-    spec = {
-        "virtualCluster": vc,
-        "priority": priority,
-        "leafCellType": leaf_type,
-        "leafCellNumber": leaf_num,
-        "affinityGroup": group,
-    }
-    return Pod(
-        name=name,
-        uid=uid,
-        annotations={constants.ANNOTATION_POD_SCHEDULING_SPEC: yaml.safe_dump(spec)},
-        resource_limits={constants.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1},
-    )
+# The fleet builder and pod factory moved to the sim tier (the bench and
+# the warehouse-scale trace driver share one fleet shape); re-exported
+# here so every existing call site and test keeps working.
+from hivedscheduler_tpu.sim.fleet import build_config, make_pod  # noqa: E402
 
 
 # (vc, leaf_type, pods, chips_per_pod)
@@ -289,6 +215,18 @@ def _percentiles(lat):
     return p50, p99
 
 
+def _stage_meta(result: dict, hosts: int, t0: float) -> dict:
+    """Artifact hygiene (ISSUE 9 satellite): every stage records the fleet
+    size it ran at, the host's core count, and its own wall clock under
+    the SAME keys, so fleet-scale trend lines are comparable across bench
+    rounds without per-stage key archaeology. Call last, with the stage's
+    start time."""
+    result["hosts"] = hosts
+    result["cpu_count"] = os.cpu_count()
+    result["wall_s"] = round(time.perf_counter() - t0, 3)
+    return result
+
+
 def run(n_gangs: int = 120, config: Config | None = None,
         trace_sample: float | None = None):
     sched = HivedScheduler(
@@ -323,12 +261,13 @@ def smoke(n_gangs: int = 24) -> dict:
     instead of surfacing in the full driver bench. (The driver-grade
     tracing gate is ``bench_tracing_ab`` at the 432-host fleet; the smoke
     delta is a wiring check, not a perf claim.)"""
+    t0 = time.perf_counter()
     p50, p99, n, sched, live, pods_per_sec = run(
         n_gangs=n_gangs, trace_sample=hived_tracing.DEFAULT_SAMPLE
     )
     p50_off, *_ = run(n_gangs=n_gangs, trace_sample=0.0)
     m = sched.get_metrics()
-    return {
+    return _stage_meta({
         "gang_schedule_p50_ms": round(p50, 3),
         "gang_schedule_p99_ms": round(p99, 3),
         "gangs_scheduled": n,
@@ -343,7 +282,7 @@ def smoke(n_gangs: int = 24) -> dict:
             if p50_off
             else 0.0,
         },
-    }
+    }, 104, t0)
 
 
 def bench_tracing_ab(
@@ -358,6 +297,7 @@ def bench_tracing_ab(
     interleaved reps (shared machine noise), medians. The acceptance gate
     is overhead ≤ 3% of p50; ``within_budget`` records the verdict in the
     BENCH artifact."""
+    t0 = time.perf_counter()
     cfg = lambda: build_config(cubes, slices, solos)  # noqa: E731
     on_ms: list = []
     off_ms: list = []
@@ -373,7 +313,7 @@ def bench_tracing_ab(
     p50_on = statistics.median(on_ms)
     p50_off = statistics.median(off_ms)
     overhead_pct = (p50_on / p50_off - 1.0) * 100.0 if p50_off else 0.0
-    return {
+    return _stage_meta({
         "fleet_hosts": 16 * cubes + 4 * slices + solos,
         "gangs": n_gangs,
         "reps": reps,
@@ -383,7 +323,7 @@ def bench_tracing_ab(
         "overhead_pct": round(overhead_pct, 2),
         "budget_pct": 3.0,
         "within_budget": overhead_pct <= 3.0,
-    }
+    }, 16 * cubes + 4 * slices + solos, t0)
 
 
 def bench_preempt(sched, nodes, n_calls: int = 30) -> float:
@@ -537,6 +477,7 @@ def bench_concurrent(
     lockWait/coreSchedule split of each run (doc/hot-path.md)."""
     import threading as _threading
 
+    t0 = time.perf_counter()
     cfg_builder = lambda: build_concurrent_config(  # noqa: E731
         threads, hosts_per_family, block_ms
     )
@@ -595,7 +536,7 @@ def bench_concurrent(
         if single["pods_per_sec"]
         else 0.0
     )
-    return {
+    return _stage_meta({
         "threads": threads,
         "gangs_per_thread": gangs_per_thread,
         "hosts_per_family": hosts_per_family,
@@ -603,7 +544,7 @@ def bench_concurrent(
         "sharded": sharded,
         "global_lock": single,
         "speedup_vs_global_lock": speedup,
-    }
+    }, threads * hosts_per_family, t0)
 
 
 # ---------------------------------------------------------------------- #
@@ -745,6 +686,7 @@ def bench_procs(
     usable cores (4 workers + routing parent); below that the stage
     reports the curve and the achievable ceiling (``cpu_count``) so the
     artifact is honest about the host it ran on."""
+    t0 = time.perf_counter()
     modes = {0: _procs_mode(0, families, hosts_per_family)}
     for n in shard_counts:
         modes[n] = _procs_mode(n, families, hosts_per_family)
@@ -781,18 +723,16 @@ def bench_procs(
         (n for n in modes if n > 0),
         key=lambda n: medians[n],
     )
-    return {
+    return _stage_meta({
         "families": families,
         "hosts_per_family": hosts_per_family,
-        "hosts": families * hosts_per_family,
         "reps": reps,
         "feeders_per_family": feeders_per_family,
-        "cpu_count": os.cpu_count(),
         "inproc_pods_per_sec": medians[0],
         "curve": curve,
         "best_shard_count": best,
         "best_speedup_vs_inproc": curve[str(best)]["speedup_vs_inproc"],
-    }
+    }, families * hosts_per_family, t0)
 
 
 def bench_fleet_sweep(
@@ -808,6 +748,7 @@ def bench_fleet_sweep(
     ``procs``-shard frontend at the same sizes. The saturation point is
     the instrument ROADMAP item 1 asked for: the fleet size beyond which
     only parallel compute (more shards) raises throughput."""
+    t0 = time.perf_counter()
     out: dict = {"families": families, "procs": procs, "sizes": {}}
     prev_rate = None
     saturation = None
@@ -853,7 +794,259 @@ def bench_fleet_sweep(
             saturation = total_hosts
         prev_rate = max(prev_rate or 0.0, inproc)
     out["single_process_saturation_hosts"] = saturation
-    return out
+    return _stage_meta(out, families * max(sizes), t0)
+
+
+# ---------------------------------------------------------------------- #
+# Warehouse-scale hot-path stages (ISSUE 9): per-priority view slots A/B,
+# relist fast-path A/B, and the trace-driven fleet-size trend
+# (doc/hot-path.md "Warehouse-scale profile")
+# ---------------------------------------------------------------------- #
+
+
+def bench_view_slots_ab(
+    cubes: int = 64,
+    slices: int = 160,
+    solos: int = 64,
+    arrivals: int = 150,
+    reps: int = 3,
+) -> dict:
+    """Per-priority cached view slots A/B at the 1728-host fleet: the
+    mixed-guaranteed-priority regime — a VC packed with priority-0 work
+    while priority-5 (preempting) and priority-0 arrivals alternate — is
+    where every request used to alternate the view's parameter point
+    (each guaranteed schedule trials OPPORTUNISTIC first), forcing a full
+    fleet re-score + re-sort per request. Slots on vs off (the pre-slot
+    single-view behavior) interleaved in one process, medians of reps.
+    The differential proof that slots change no placement lives in
+    tests/test_placement_equivalence.py."""
+    from hivedscheduler_tpu.algorithm import placement
+
+    t0 = time.perf_counter()
+
+    def run_once(multi: bool) -> tuple:
+        saved = placement.MULTI_SLOTS_DEFAULT
+        placement.MULTI_SLOTS_DEFAULT = multi
+        try:
+            sched = HivedScheduler(
+                build_config(cubes, slices, solos),
+                kube_client=NullKubeClient(),
+                auto_admit=True,
+            )
+        finally:
+            placement.MULTI_SLOTS_DEFAULT = saved
+        nodes = sched.core.configured_node_names()
+        for n in nodes:
+            sched.add_node(Node(name=n))
+        # Pack the research VC's v5e quota with priority-0 singletons.
+        g = 0
+        while True:
+            g += 1
+            gname = f"fill{g}"
+            group = {
+                "name": gname,
+                "members": [{"podNumber": 1, "leafCellNumber": 4}],
+            }
+            p = make_pod(
+                f"{gname}-0", f"{gname}-u0", "research", 0,
+                "v5e-chip", 4, group,
+            )
+            r = sched.filter_routine(
+                ei.ExtenderArgs(pod=p, node_names=nodes)
+            )
+            if not r.node_names:
+                sched.delete_pod(p)
+                break
+        # Alternate priority-5 (probe + release) and priority-0 arrivals.
+        lat = []
+        t_run = time.perf_counter()
+        for k in range(arrivals):
+            for prio, tag in ((5, "hi"), (0, "lo")):
+                gname = f"{tag}{k}"
+                group = {
+                    "name": gname,
+                    "members": [{"podNumber": 1, "leafCellNumber": 4}],
+                }
+                p = make_pod(
+                    f"{gname}-0", f"{gname}-u0", "research", prio,
+                    "v5e-chip", 4, group,
+                )
+                t1 = time.perf_counter()
+                r = sched.filter_routine(
+                    ei.ExtenderArgs(pod=p, node_names=nodes)
+                )
+                lat.append((time.perf_counter() - t1) * 1e3)
+                if r.node_names:
+                    sched.delete_pod(p)
+        wall = time.perf_counter() - t_run
+        p50, p99 = _percentiles(lat)
+        return p50, p99, (2 * arrivals) / wall
+
+    on_runs, off_runs = [], []
+    for _ in range(reps):
+        off_runs.append(run_once(False))
+        on_runs.append(run_once(True))
+    med = lambda runs, i: statistics.median(r[i] for r in runs)  # noqa: E731
+    p50_on, p50_off = med(on_runs, 0), med(off_runs, 0)
+    return _stage_meta({
+        "arrivals": 2 * arrivals,
+        "reps": reps,
+        "slots_on": {
+            "p50_ms": round(p50_on, 3),
+            "p99_ms": round(med(on_runs, 1), 3),
+            "req_per_sec": round(med(on_runs, 2), 1),
+        },
+        "slots_off": {
+            "p50_ms": round(p50_off, 3),
+            "p99_ms": round(med(off_runs, 1), 3),
+            "req_per_sec": round(med(off_runs, 2), 1),
+        },
+        "p50_speedup": round(p50_off / p50_on, 2) if p50_on else 0.0,
+    }, 16 * cubes + 4 * slices + solos, t0)
+
+
+def bench_relist_ab(
+    cubes: int = 64,
+    slices: int = 160,
+    solos: int = 64,
+    relists: int = 5,
+    reps: int = 3,
+) -> dict:
+    """Node-event no-op fast-path A/B at the 1728-host fleet: the cost of
+    a no-change relist (an informer gap repair re-delivers EVERY node),
+    and the filter p50 while such relists run concurrently — each
+    no-change update used to take the global all-chains lock order,
+    stalling every in-flight filter. One scheduler, fastpath toggled per
+    rep (instance knob), interleaved."""
+    import threading as _threading
+
+    t0 = time.perf_counter()
+    sched = HivedScheduler(
+        build_config(cubes, slices, solos),
+        kube_client=NullKubeClient(),
+        auto_admit=True,
+    )
+    nodes = sched.core.configured_node_names()
+    node_objs = {n: Node(name=n) for n in nodes}
+    for n in nodes:
+        sched.add_node(node_objs[n])
+
+    def relist_once() -> float:
+        t1 = time.perf_counter()
+        for n in nodes:
+            sched.update_node(node_objs[n], node_objs[n])
+        return (time.perf_counter() - t1) * 1e3
+
+    def filter_under_relist() -> tuple:
+        # Periodic relists (a watch-cycle gap repair every 50 ms — far
+        # denser than production, sized so several land inside the
+        # measured window), not an unthrottled hot loop: the question is
+        # how much one relist STALLS concurrent filters, not how fast a
+        # spinning thread can burn the GIL.
+        stop = _threading.Event()
+
+        def storm():
+            while not stop.is_set():
+                relist_once()
+                stop.wait(0.05)
+
+        t = _threading.Thread(target=storm, daemon=True)
+        t.start()
+        try:
+            def schedule_pod(p):
+                r = sched.filter_routine(
+                    ei.ExtenderArgs(pod=p, node_names=nodes)
+                )
+                return bool(r.node_names)
+
+            lat, live, _ = _drive_gangs(
+                sched, schedule_pod, 40, prefix=f"rl{time.monotonic_ns()}"
+            )
+        finally:
+            stop.set()
+            t.join()
+        for _, old in live:
+            for q in old:
+                sched.delete_pod(q)
+        return _percentiles(lat)
+
+    relist_on, relist_off, lat_on, lat_off = [], [], [], []
+    for _ in range(reps):
+        sched.node_event_fastpath = False
+        relist_off.extend(relist_once() for _ in range(relists))
+        lat_off.append(filter_under_relist())
+        sched.node_event_fastpath = True
+        relist_on.extend(relist_once() for _ in range(relists))
+        lat_on.append(filter_under_relist())
+    noops = sched.get_metrics()["nodeEventNoopCount"]
+    r_on = statistics.median(relist_on)
+    r_off = statistics.median(relist_off)
+    med = lambda runs, i: statistics.median(r[i] for r in runs)  # noqa: E731
+    return _stage_meta({
+        "reps": reps,
+        "relists_per_rep": relists,
+        "relist_ms_fastpath_on": round(r_on, 2),
+        "relist_ms_fastpath_off": round(r_off, 2),
+        "relist_speedup": round(r_off / r_on, 2) if r_on else 0.0,
+        "filter_under_relist_on": {
+            "p50_ms": round(med(lat_on, 0), 3),
+            "p99_ms": round(med(lat_on, 1), 3),
+        },
+        "filter_under_relist_off": {
+            "p50_ms": round(med(lat_off, 0), 3),
+            "p99_ms": round(med(lat_off, 1), 3),
+        },
+        "node_event_noop_count": noops,
+    }, 16 * cubes + 4 * slices + solos, t0)
+
+
+def bench_sim(
+    sizes=(432, 864, 1728),
+    gangs_per_432: int = 120,
+    seed: int = 0,
+    duration_s: float = 1800.0,
+) -> dict:
+    """Trace-driven fleet-size trend (HIVED_BENCH_SIM=1): one seeded
+    diurnal trace per fleet size through the real scheduler (sim tier,
+    doc/hot-path.md "Warehouse-scale profile"), reporting the latency
+    tail AND the scheduling-quality metrics per size — the trend lines
+    ROADMAP new-direction 4 asked for. The 5k/10k/50k-host points run
+    via ``python -m hivedscheduler_tpu.sim`` (too heavy for the default
+    driver); this stage pins the CI-sized end of the same curves."""
+    from hivedscheduler_tpu.sim.driver import run_trace
+    from hivedscheduler_tpu.sim.trace import TraceShape, generate_trace
+
+    t0 = time.perf_counter()
+    curve: dict = {}
+    for hosts in sizes:
+        shape = TraceShape(
+            hosts=hosts,
+            gangs=max(20, int(gangs_per_432 * hosts / 432)),
+            duration_s=duration_s,
+            pattern="diurnal",
+            fault_events=max(4, hosts // 100),
+        )
+        report = run_trace(generate_trace(seed, shape), mode="inproc")
+        frag = report["fragmentation"] or {}
+        curve[str(report["hosts"])] = {
+            "gangs": shape.gangs,
+            "p50_ms": report["latency"]["p50Ms"],
+            "p99_ms": report["latency"]["p99Ms"],
+            "pods_per_sec": report["podsPerSec"],
+            "preemption_rate": report["preemption"][
+                "ratePerBoundGuaranteed"
+            ],
+            "quota_satisfaction": report["quotaSatisfaction"]["fraction"],
+            "largest_free_slice_chips": frag.get(
+                "largestFreeSliceChips", 0
+            ),
+            "wall_s": report["wallS"],
+        }
+    return _stage_meta({
+        "seed": seed,
+        "pattern": "diurnal",
+        "trend": curve,
+    }, max(int(h) for h in curve) if curve else 0, t0)
 
 
 class _SnapshotKubeClient(NullKubeClient):
@@ -932,6 +1125,7 @@ def bench_recovery_blackout(
     - ``snapshot_cold_ms``: same snapshot, no prefetch — a plain restart
       that must also JSON-decode the snapshot inside the blackout window.
     """
+    t0_stage = time.perf_counter()
     config_args = dict(cubes=cubes, slices=slices, solos=solos)
     client = _SnapshotKubeClient()
     sched = HivedScheduler(build_config(**config_args), kube_client=client)
@@ -1022,7 +1216,7 @@ def bench_recovery_blackout(
     on_med = statistics.median(on_p50s)
     off_med = statistics.median(off_p50s)
     ratio_med = statistics.median(pair_ratios) if pair_ratios else 1.0
-    return {
+    return _stage_meta({
         "fleet_hosts": 16 * cubes + 4 * slices + solos,
         "pods_recovered": len(bound),
         "full_replay_ms": round(full_med, 2),
@@ -1042,13 +1236,14 @@ def bench_recovery_blackout(
             "overhead_pct": round((ratio_med - 1.0) * 100.0, 2),
             "budget_pct": 3.0,
         },
-    }
+    }, 16 * cubes + 4 * slices + solos, t0_stage)
 
 
 def bench_recovery(sched) -> dict:
     """Full restart recovery: rebuild a fresh scheduler purely from the
     bound pods' annotations (the informer replay path), timed end-to-end —
     the reference's work-preserving restart story (SURVEY §5)."""
+    t0_stage = time.perf_counter()
     bound = [
         st.pod
         for st in sched.pod_schedule_statuses.values()
@@ -1067,11 +1262,11 @@ def bench_recovery(sched) -> dict:
         )
         fresh.add_pod(bp2)
     elapsed_ms = (time.perf_counter() - t0) * 1e3
-    return {
+    return _stage_meta({
         "replay_total_ms": round(elapsed_ms, 2),
         "pods_replayed": len(bound),
         "replay_per_pod_ms": round(elapsed_ms / max(1, len(bound)), 3),
-    }
+    }, 104, t0_stage)
 
 
 def bench_http(n_gangs: int = 60) -> dict:
@@ -1086,6 +1281,7 @@ def bench_http(n_gangs: int = 60) -> dict:
 
     from hivedscheduler_tpu.webserver.server import WebServer
 
+    t0_stage = time.perf_counter()
     sched = HivedScheduler(build_config(), kube_client=NullKubeClient())
     nodes = sched.core.configured_node_names()
     for n in nodes:
@@ -1128,11 +1324,11 @@ def bench_http(n_gangs: int = 60) -> dict:
         lat, _, _ = _drive_gangs(sched, schedule_pod, n_gangs, prefix="h")
         conn.close()
         p50, p99 = _percentiles(lat)
-        return {
+        return _stage_meta({
             "http_gang_p50_ms": round(p50, 3),
             "http_gang_p99_ms": round(p99, 3),
             "gangs_scheduled": len(lat),
-        }
+        }, 104, t0_stage)
     finally:
         ws.stop()
 
@@ -1226,6 +1422,54 @@ def model_perf() -> dict:
 
 
 if __name__ == "__main__":
+    if os.environ.get("HIVED_BENCH_SIM") == "1":
+        # Standalone fleet-size trend stage (the default driver run
+        # includes the same stage in its extra payload).
+        result = bench_sim()
+        largest = result["trend"][str(result["hosts"])]
+        print(
+            json.dumps(
+                {
+                    "metric": "sim_trace_p50_latency",
+                    "value": largest["p50_ms"],
+                    "unit": "ms",
+                    "vs_baseline": round(
+                        largest["p50_ms"] / TARGET_P50_MS, 3
+                    ),
+                    "extra": result,
+                }
+            )
+        )
+        sys.exit(0)
+    if os.environ.get("HIVED_BENCH_VIEW_SLOTS") == "1":
+        run(n_gangs=24)  # warm-up
+        result = bench_view_slots_ab()
+        print(
+            json.dumps(
+                {
+                    "metric": "view_slots_p50_speedup",
+                    "value": result["p50_speedup"],
+                    "unit": "x",
+                    "vs_baseline": result["p50_speedup"],
+                    "extra": result,
+                }
+            )
+        )
+        sys.exit(0)
+    if os.environ.get("HIVED_BENCH_RELIST") == "1":
+        result = bench_relist_ab()
+        print(
+            json.dumps(
+                {
+                    "metric": "relist_noop_speedup",
+                    "value": result["relist_speedup"],
+                    "unit": "x",
+                    "vs_baseline": result["relist_speedup"],
+                    "extra": result,
+                }
+            )
+        )
+        sys.exit(0)
     if os.environ.get("HIVED_BENCH_TRACE") == "1":
         # Standalone tracing-overhead gate (the default driver run includes
         # the same stage in its extra payload).
@@ -1348,6 +1592,9 @@ if __name__ == "__main__":
     tracing_ab = bench_tracing_ab()
     procs_stage = bench_procs()
     procs_stage["fleet_sweep"] = bench_fleet_sweep()
+    view_slots_ab = bench_view_slots_ab()
+    relist_ab = bench_relist_ab()
+    sim_stage = bench_sim()
     perf = model_perf()
     print(
         json.dumps(
@@ -1366,6 +1613,9 @@ if __name__ == "__main__":
                     "http": http_stats,
                     "tracing_ab": tracing_ab,
                     "procs": procs_stage,
+                    "view_slots_ab": view_slots_ab,
+                    "relist_ab": relist_ab,
+                    "sim": sim_stage,
                     "model_perf": perf,
                 },
             }

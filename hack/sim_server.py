@@ -1,16 +1,33 @@
-"""Launch a real hivedscheduler-tpu server over a small simulated v5e cluster.
+"""Launch a real hivedscheduler-tpu server over a simulated cluster.
+
+Default (no args): the original tiny 4-host v5e demo — node events
+injected from the config, two waiting pods pre-informed, a chip fault and
+a drain to exercise the health plane. Serves forever.
+
+Warehouse modes (ISSUE 9):
+
+  --hosts N       serve the bench-proportioned mixed v5p/v5e fleet at ~N
+                  hosts (sim.fleet) instead of the toy config
+  --trace FILE    replay a sim trace (python -m hivedscheduler_tpu.sim
+                  --write-trace) against the REAL HTTP extender path:
+                  filter and preempt verbs cross the wire to the
+                  webserver exactly as the default scheduler's extender
+                  calls do; informer-side verbs (pod deletes, node
+                  faults) are injected in-process like the informer
+                  would. Prints the JSON report and exits.
+  --shards K      serve the multi-process core (same as HIVED_PROC_SHARDS)
 
 Stands in for the informer loop: node events are injected from the config;
 pod events arrive over a tiny side endpoint is NOT implemented — instead pods
-are pre-informed here (two waiting pods), exactly what the pod informer would
-deliver before the default scheduler calls filter.
+are pre-informed here, exactly what the pod informer would deliver before
+the default scheduler calls filter.
 """
-import sys, yaml
+import argparse, json, sys, yaml
 
 sys.path.insert(0, str(__import__("pathlib").Path(__file__).resolve().parents[1]))
 
 from hivedscheduler_tpu import common
-from hivedscheduler_tpu.api import constants
+from hivedscheduler_tpu.api import constants, extender as ei
 from hivedscheduler_tpu.api.config import Config
 from hivedscheduler_tpu.scheduler.framework import HivedScheduler, NullKubeClient
 from hivedscheduler_tpu.scheduler.types import Node, Pod
@@ -42,21 +59,141 @@ config = Config.from_dict({
     },
 })
 
+class _WireExtender:
+    """The trace driver's scheduler surface with filter/preempt routed
+    over REAL HTTP to the webserver (the extender path the default
+    scheduler calls); everything else — pod deletes, node events, status
+    reads — delegates to the in-process scheduler, which is exactly the
+    informer's side of the split."""
+
+    def __init__(self, sched, port: int):
+        import http.client, socket
+
+        self._sched = sched
+
+        class _NoDelay(http.client.HTTPConnection):
+            def connect(self):
+                super().connect()
+                self.sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+                )
+
+        self._conn = _NoDelay("127.0.0.1", port)
+        self._headers = {"Content-Type": "application/json"}
+
+    def _post(self, path: str, body: dict) -> dict:
+        self._conn.request(
+            "POST", path, json.dumps(body), self._headers
+        )
+        return json.loads(self._conn.getresponse().read())
+
+    def filter_routine(self, args):
+        return ei.ExtenderFilterResult.from_dict(
+            self._post(constants.FILTER_PATH, args.to_dict())
+        )
+
+    def preempt_routine(self, args):
+        return ei.ExtenderPreemptionResult.from_dict(
+            self._post(constants.PREEMPT_PATH, args.to_dict())
+        )
+
+    def __getattr__(self, name):
+        return getattr(self._sched, name)
+
+
+def replay_trace(trace_path: str, hosts: int, procs: int) -> int:
+    """--trace mode: build the fleet, start the webserver, replay the
+    trace with filter/preempt over the wire, print the report."""
+    from hivedscheduler_tpu.sim.driver import TraceDriver, build_fleet_config
+    from hivedscheduler_tpu.sim.report import render_text
+    from hivedscheduler_tpu.sim.trace import TraceShape, load_trace
+
+    trace = load_trace(trace_path)
+    shape = TraceShape.from_dict(trace["shape"])
+    fleet_config, actual_hosts = build_fleet_config(
+        hosts or shape.hosts
+    )
+    if procs > 0:
+        from hivedscheduler_tpu.scheduler.shards import ShardedScheduler
+
+        s = ShardedScheduler(
+            fleet_config, kube_client=NullKubeClient(), n_shards=procs,
+            auto_admit=True,
+        )
+    else:
+        s = HivedScheduler(
+            fleet_config, kube_client=NullKubeClient(), auto_admit=True
+        )
+    s.mark_ready()
+    ws = WebServer(s, address="127.0.0.1:0")
+    ws.start()
+    try:
+        driver = TraceDriver(
+            fleet_config,
+            mode="http",
+            scheduler=_WireExtender(s, ws.port),
+        )
+        report = driver.run(trace)
+        report["hosts"] = actual_hosts
+        report["wire"] = "http"
+        print(render_text(report))
+        print(json.dumps(report, sort_keys=True))
+    finally:
+        ws.stop()
+        close = getattr(s, "close", None)
+        if close is not None:
+            close()
+    return 0
+
+
 def main():
-    # HIVED_PROC_SHARDS=N serves the multi-process core (worker shards per
-    # chain family) exactly as __main__ does; 0/unset keeps the in-process
-    # scheduler (doc/hot-path.md "The multi-process contract").
-    _procs = int(__import__("os").environ.get("HIVED_PROC_SHARDS", "0") or 0)
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", type=int, default=0,
+                    help="serve the bench fleet at ~N hosts instead of "
+                    "the 4-host demo config")
+    ap.add_argument("--trace", help="replay this sim trace against the "
+                    "HTTP extender path, print the report, exit")
+    ap.add_argument("--shards", type=int, default=None,
+                    help="worker shard count (default: HIVED_PROC_SHARDS)")
+    args = ap.parse_args()
+    _procs = args.shards if args.shards is not None else int(
+        __import__("os").environ.get("HIVED_PROC_SHARDS", "0") or 0
+    )
+    if args.trace:
+        sys.exit(replay_trace(args.trace, args.hosts, _procs))
+    if args.hosts:
+        from hivedscheduler_tpu.sim.driver import build_fleet_config
+
+        big_config, actual = build_fleet_config(args.hosts)
+        big_config.webserver_address = "127.0.0.1:9096"
+        serve_config = big_config
+        print(f"fleet: {actual} hosts", flush=True)
+    else:
+        serve_config = config
     if _procs > 0:
         from hivedscheduler_tpu.scheduler.shards import ShardedScheduler
 
         s = ShardedScheduler(
-            config, kube_client=NullKubeClient(), n_shards=_procs,
+            serve_config, kube_client=NullKubeClient(), n_shards=_procs,
             auto_admit=False,
         )
         s.mark_ready()
     else:
-        s = HivedScheduler(config, kube_client=NullKubeClient())
+        s = HivedScheduler(serve_config, kube_client=NullKubeClient())
+    if args.hosts:
+        # Warehouse fleet: inform every configured node healthy, skip the
+        # toy demo seeding (its pods/faults name the 4-host config).
+        for n in sorted(s.configured_node_names()
+                        if hasattr(s, "configured_node_names")
+                        else s.core.configured_node_names()):
+            s.add_node(Node(name=n))
+        s.mark_ready()
+        ws = WebServer(s)
+        ws.start()
+        print("READY", flush=True)
+        import time
+        while True:
+            time.sleep(60)
     for i in range(4):
         s.add_node(Node(name=f"tpu-w{i}"))
 

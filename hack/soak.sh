@@ -30,8 +30,29 @@
 # /v1/inspect/decisions ring + trace ring + metrics at the moment the
 # invariant fired — see doc/observability.md). DIR defaults to
 # ./chaos-artifacts; the dump path is appended to the failing assertion.
+# Trace soak: --trace generates a seeded warehouse trace (sim tier,
+# doc/hot-path.md "Warehouse-scale profile") and replays it against the
+# REAL HTTP extender path via hack/sim_server.py --trace. Knobs:
+# HIVED_SIM_HOSTS (default 1728), HIVED_SIM_SEED, HIVED_SIM_GANGS.
+#   HIVED_SIM_HOSTS=5184 hack/soak.sh --trace
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--trace" ]]; then
+  shift
+  export JAX_PLATFORMS=cpu
+  hosts="${HIVED_SIM_HOSTS:-1728}"
+  seed="${HIVED_SIM_SEED:-0}"
+  gangs="${HIVED_SIM_GANGS:-200}"
+  tmp="$(mktemp /tmp/hived-trace-XXXXXX.json)"
+  trap 'rm -f "$tmp"' EXIT
+  echo "trace soak: hosts=${hosts} seed=${seed} gangs=${gangs}"
+  python -m hivedscheduler_tpu.sim --hosts "$hosts" --seed "$seed" \
+    --gangs "$gangs" --faults "$(( gangs / 10 ))" --write-trace "$tmp"
+  # No exec: the EXIT trap must still fire to clean up the trace file.
+  python hack/sim_server.py --trace "$tmp" --hosts "$hosts" "$@"
+  exit $?
+fi
 
 if [[ "${1:-}" == "--failover" ]]; then
   shift

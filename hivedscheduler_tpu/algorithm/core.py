@@ -3682,6 +3682,26 @@ class HivedCore:
             }
         )
 
+    def free_slice_distribution(self) -> Dict[str, int]:
+        """Schedulable-slice-size distribution: how many WHOLE free cells
+        of each chip size the buddy hierarchy currently offers (the free
+        list holds maximal free cells — a fragmented fleet shows mass at
+        small sizes where a compact one shows whole cubes). Keys are chip
+        counts as strings (JSON-stable), values cell counts. The sim
+        tier's fragmentation metric (doc/hot-path.md "Warehouse-scale
+        profile") and the defrag trend input for ROADMAP new-direction 3.
+        Reads only free-list lengths; callers needing a consistent view
+        against concurrent mutators hold the global order."""
+        out: Dict[str, int] = {}
+        for chain, ccl in self.free_cell_list.items():
+            leaf_num = self.compiled.cell_level_to_leaf_num[chain]
+            for level, cells in ccl.levels.items():
+                n = len(cells)
+                if n:
+                    key = str(leaf_num[level])
+                    out[key] = out.get(key, 0) + n
+        return out
+
     # -- inspect API --------------------------------------------------------
 
     def get_all_affinity_groups(self) -> Dict:

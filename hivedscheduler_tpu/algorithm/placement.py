@@ -54,6 +54,22 @@ NAIVE_VIEW_DEFAULT = os.environ.get("HIVED_NAIVE_VIEW", "0") == "1"
 # controls when we bother computing the dirty subset at all.
 FULL_RESCORE_FRACTION = 0.5
 
+# Per-priority cached view slots (doc/hot-path.md "Per-priority view
+# slots"): distinct (priority, ignore-suggested) parameter points each keep
+# their own scored+sorted view, so alternating between them — every
+# guaranteed request trials OPPORTUNISTIC first and retries at its real
+# priority when the trial fails — costs O(dirty) instead of a full
+# re-score + re-sort of the fleet. The cap bounds memory (each slot holds
+# one _NodeView per node anchor); overflow evicts the least-recently-used
+# slot, which simply rebuilds in full if that parameter point returns.
+MAX_VIEW_SLOTS = 6
+
+# A/B escape hatch (bench_view_slots_ab, doc/hot-path.md): =0 pins every
+# scheduler built afterwards to ONE slot that fully re-scores whenever the
+# (priority, ignore-suggested) point changes — the pre-slot behavior's cost
+# profile — so the win is measurable interleaved inside one process.
+MULTI_SLOTS_DEFAULT = os.environ.get("HIVED_VIEW_SLOTS", "") != "0"
+
 
 class PhaseStats:
     """Per-phase latency accumulators for the filter hot path (lock-wait,
@@ -192,6 +208,51 @@ def _ancestor_no_higher_than_node(c: Cell) -> Cell:
     return c
 
 
+class _ViewSlot:
+    """One cached scored+sorted cluster view, pinned to a fixed
+    (priority, ignore_suggested) parameter point.
+
+    Each slot owns its _NodeView instances (the scored fields are
+    priority-dependent), its score buckets, and its dirty set — cell
+    mutations mark every live slot dirty (TopologyAwareScheduler.mark_dirty),
+    and a slot re-scores only ITS dirty backlog when its parameter point is
+    next requested. A fresh slot scores everything once (never_scored)."""
+
+    __slots__ = (
+        "priority",
+        "ignore_suggested",
+        "view",
+        "by_addr",
+        "dirty",
+        "buckets",
+        "bucket_order",
+        "scored_stamp",
+        "last_suggested",
+        "never_scored",
+        "last_used",
+    )
+
+    def __init__(
+        self,
+        priority: CellPriority,
+        ignore_suggested: bool,
+        anchors: List[Cell],
+    ):
+        self.priority = priority
+        self.ignore_suggested = ignore_suggested
+        self.view: List[_NodeView] = [_NodeView(c) for c in anchors]
+        self.by_addr: Dict[api.CellAddress, _NodeView] = {
+            v.cell.address: v for v in self.view
+        }
+        self.dirty: Set[api.CellAddress] = set()
+        self.buckets: Dict[Tuple, List[_NodeView]] = {}
+        self.bucket_order: List[Tuple] = []
+        self.scored_stamp = -1
+        self.last_suggested: Optional[Set[str]] = None
+        self.never_scored = True
+        self.last_used = 0
+
+
 class TopologyAwareScheduler:
     """Schedules a gang's pods onto the "nodes" of one chain, packing onto
     busier nodes first, then picking chips with minimal ICI spread per pod
@@ -216,38 +277,27 @@ class TopologyAwareScheduler:
         self.cross_priority_pack = cross_priority_pack
         self.phase_stats = phase_stats
         self.naive = NAIVE_VIEW_DEFAULT if naive is None else naive
+        # The ACTIVE view: in naive mode the one and only (rebuilt fully per
+        # request); in incremental mode the last-scored slot's list — kept
+        # as an attribute so inspection/tests can read the current packing
+        # order without knowing about slots.
         self.cluster_view = self._build_cluster_view(ccl)
-        self._views_by_addr: Dict[api.CellAddress, _NodeView] = {
-            v.cell.address: v for v in self.cluster_view
-        }
-        # Invalidation state: addresses of anchors whose score inputs changed
-        # since the last refresh, plus an epoch stamp for binding changes
-        # above node level (they shift the suggested-node scoring of every
-        # unbound node underneath at once).
-        self._dirty: Set[api.CellAddress] = set()
+        self._anchors: List[Cell] = [v.cell for v in self.cluster_view]
+        # Per-priority view slots (doc/hot-path.md): (priority,
+        # ignore_suggested) -> _ViewSlot. Cell mutations dirty every live
+        # slot; binding changes above node level bump the shared stamp.
+        self._slots: Dict[Tuple, _ViewSlot] = {}
+        self._slot_clock = 0
         self._binding_stamp = 0
-        self._scored_stamp = -1
-        # Request-parameter cache: scores are a pure function of
-        # (cell state, priority, cross_priority_pack, suggested set when it
-        # matters); identical parameters + clean view = skip everything.
-        self._last_priority: Optional[CellPriority] = None
-        self._last_ignore: Optional[bool] = None
-        self._last_suggested: Optional[Set[str]] = None
-        self._never_scored = True
-        # Score buckets (doc/hot-path.md "State-pure sorted view"): key =
-        # score_key() (a small tuple of bounded ints), value = the views
-        # with that score in config order; _bucket_order keeps the keys
-        # sorted. Together they ARE the sorted view — the flat list is
-        # just their concatenation, rebuilt only when membership moves.
-        self._buckets: Dict[Tuple, List[_NodeView]] = {}
-        self._bucket_order: List[Tuple] = []
+        self.multi_slots = MULTI_SLOTS_DEFAULT
         if not self.naive:
             self._register_view()
 
     # -- invalidation hooks (called from cell.py mutators) ------------------ #
 
     def mark_dirty(self, address: api.CellAddress) -> None:
-        self._dirty.add(address)
+        for slot in self._slots.values():
+            slot.dirty.add(address)
 
     def bump_binding_stamp(self) -> None:
         self._binding_stamp += 1
@@ -257,19 +307,37 @@ class TopologyAwareScheduler:
         schedule call. The snapshot restore rewrites cell state with direct
         field assignments (no mutator hooks), so the incremental dirty
         marks cannot be trusted afterwards."""
-        self._dirty.update(self._views_by_addr)
+        for slot in self._slots.values():
+            slot.dirty.update(slot.by_addr)
         self._binding_stamp += 1
 
     def _register_view(self) -> None:
         """Give every node anchor (and its ancestors) a back-pointer so cell
         mutations can invalidate exactly the views they affect."""
-        for v in self.cluster_view:
-            anchor = v.cell
+        for anchor in self._anchors:
             anchor.view_reg = (self, True)
             parent = anchor.parent
             while parent is not None and parent.view_reg is None:
                 parent.view_reg = (self, False)
                 parent = parent.parent
+
+    def _get_slot(self, p: CellPriority, ignore_suggested: bool) -> _ViewSlot:
+        """The slot for one parameter point, LRU-evicting past the cap (an
+        evicted slot that returns simply scores in full once)."""
+        key = (p, ignore_suggested)
+        slot = self._slots.get(key)
+        if slot is None:
+            if len(self._slots) >= MAX_VIEW_SLOTS:
+                lru = min(
+                    self._slots, key=lambda k: self._slots[k].last_used
+                )
+                del self._slots[lru]
+            slot = self._slots[key] = _ViewSlot(
+                p, ignore_suggested, self._anchors
+            )
+        self._slot_clock += 1
+        slot.last_used = self._slot_clock
+        return slot
 
     # -- view construction & scoring ---------------------------------------- #
 
@@ -300,40 +368,75 @@ class TopologyAwareScheduler:
         p: CellPriority,
         suggested_nodes: Optional[Set[str]],
         ignore_suggested: bool,
-    ) -> None:
-        """Re-score only what changed, then restore the packing order
-        (reference: topology_aware_scheduler.go:256-266 re-scores everything;
-        the incremental path must produce byte-identical results — the sort
-        is the same stable in-place sort over the same persistent list, so
-        equality of scores implies equality of order)."""
-        view = self.cluster_view
+    ) -> List[_NodeView]:
+        """Return the scored+sorted view for this parameter point,
+        re-scoring only what changed (reference:
+        topology_aware_scheduler.go:256-266 re-scores everything; the
+        incremental path must produce byte-identical results — the order is
+        a total key over cell state, so equality of scores implies equality
+        of order). Each (priority, ignore_suggested) point keeps its own
+        slot, so a request alternating priorities — every guaranteed
+        schedule trials OPPORTUNISTIC first — pays O(its own dirty
+        backlog), never a fleet-wide re-sort."""
         if self.naive:
-            dirty_views: List[_NodeView] = view
-            full = True
-        else:
-            params_changed = (
-                self._never_scored
-                or p != self._last_priority
-                or ignore_suggested != self._last_ignore
-                or (
-                    not ignore_suggested
-                    and (
-                        suggested_nodes != self._last_suggested
-                        or self._scored_stamp != self._binding_stamp
+            view = self.cluster_view
+            cross = self.cross_priority_pack
+            for n in view:
+                n.update_for_priority(p, cross)
+                n.healthy, n.suggested, n.node_address = (
+                    _node_health_and_suggested(
+                        n.cell, suggested_nodes, ignore_suggested
                     )
                 )
+                n.unusable_free, n.unusable_bad, n.unusable_draining = (
+                    _node_unusable_free(n.cell, p)
+                )
+                n.degraded = (not n.healthy) or _node_degraded(n.cell)
+            view.sort(key=_NodeView.sort_key)
+            return view
+        if self.multi_slots:
+            slot = self._get_slot(p, ignore_suggested)
+            point_changed = False
+        else:
+            # A/B escape hatch: one slot for every parameter point — a
+            # point change forces the pre-slot full re-score + re-sort.
+            key = ("single",)
+            slot = self._slots.get(key)
+            if slot is None:
+                slot = self._slots[key] = _ViewSlot(
+                    p, ignore_suggested, self._anchors
+                )
+            point_changed = (
+                slot.priority != p
+                or slot.ignore_suggested != ignore_suggested
             )
-            full = (
-                params_changed
-                or len(self._dirty) > len(view) * FULL_RESCORE_FRACTION
+            slot.priority = p
+            slot.ignore_suggested = ignore_suggested
+        view = slot.view
+        params_changed = (
+            slot.never_scored
+            or point_changed
+            or (
+                not ignore_suggested
+                and (
+                    suggested_nodes != slot.last_suggested
+                    or slot.scored_stamp != self._binding_stamp
+                )
             )
-            if full:
-                dirty_views = view
-            elif self._dirty:
-                by_addr = self._views_by_addr
-                dirty_views = [by_addr[a] for a in self._dirty]
-            else:
-                return  # clean view, same parameters: still scored & sorted
+        )
+        full = (
+            params_changed
+            or len(slot.dirty) > len(view) * FULL_RESCORE_FRACTION
+        )
+        if full:
+            dirty_views: List[_NodeView] = view
+        elif slot.dirty:
+            by_addr = slot.by_addr
+            dirty_views = [by_addr[a] for a in slot.dirty]
+        else:
+            # Clean slot, same parameters: still scored & sorted.
+            self.cluster_view = view
+            return view
         cross = self.cross_priority_pack
         for n in dirty_views:
             n.update_for_priority(p, cross)
@@ -349,7 +452,7 @@ class TopologyAwareScheduler:
             # a pure function of cell state), buckets rebuilt from the
             # sorted run.
             view.sort(key=_NodeView.sort_key)
-            self._rebuild_buckets_from_sorted(view)
+            self._rebuild_buckets_from_sorted(slot)
         else:
             # O(dirty) reordering: a re-scored view moves between score
             # buckets only when its (bounded-int) key changed; within a
@@ -361,42 +464,43 @@ class TopologyAwareScheduler:
                 if key == n.bucket_key:
                     continue
                 moved = True
-                old = self._buckets.get(n.bucket_key)
+                old = slot.buckets.get(n.bucket_key)
                 if old is not None:
                     old.remove(n)
                     if not old:
-                        del self._buckets[n.bucket_key]
-                        self._bucket_order.remove(n.bucket_key)
-                bucket = self._buckets.get(key)
+                        del slot.buckets[n.bucket_key]
+                        slot.bucket_order.remove(n.bucket_key)
+                bucket = slot.buckets.get(key)
                 if bucket is None:
-                    bucket = self._buckets[key] = []
-                    bisect.insort(self._bucket_order, key)
+                    bucket = slot.buckets[key] = []
+                    bisect.insort(slot.bucket_order, key)
                 bisect.insort(
                     bucket, n, key=lambda v: v.cell.config_order
                 )
                 n.bucket_key = key
             if moved:
                 flat: List[_NodeView] = []
-                for key in self._bucket_order:
-                    flat.extend(self._buckets[key])
+                for key in slot.bucket_order:
+                    flat.extend(slot.buckets[key])
                 view[:] = flat
-        self._dirty.clear()
-        self._never_scored = False
-        self._last_priority = p
-        self._last_ignore = ignore_suggested
-        self._last_suggested = suggested_nodes
-        self._scored_stamp = self._binding_stamp
+        slot.dirty.clear()
+        slot.never_scored = False
+        slot.last_suggested = suggested_nodes
+        slot.scored_stamp = self._binding_stamp
+        self.cluster_view = view
+        return view
 
-    def _rebuild_buckets_from_sorted(self, view: List[_NodeView]) -> None:
-        self._buckets = {}
-        self._bucket_order = []
-        for n in view:
+    @staticmethod
+    def _rebuild_buckets_from_sorted(slot: _ViewSlot) -> None:
+        slot.buckets = {}
+        slot.bucket_order = []
+        for n in slot.view:
             key = n.score_key()
             n.bucket_key = key
-            bucket = self._buckets.get(key)
+            bucket = slot.buckets.get(key)
             if bucket is None:
-                bucket = self._buckets[key] = []
-                self._bucket_order.append(key)
+                bucket = slot.buckets[key] = []
+                slot.bucket_order.append(key)
             bucket.append(n)
 
     def schedule(
@@ -428,19 +532,19 @@ class TopologyAwareScheduler:
         sorted_leaf_nums.sort()
 
         trial_priority = OPPORTUNISTIC_PRIORITY
-        self._update_cluster_view(
+        view = self._update_cluster_view(
             trial_priority, suggested_nodes, ignore_suggested_nodes
         )
         picked, failed_reason = _find_nodes_for_pods(
-            self.cluster_view, sorted_leaf_nums, avoid_anchors
+            view, sorted_leaf_nums, avoid_anchors
         )
         if picked is None and priority > OPPORTUNISTIC_PRIORITY:
             trial_priority = priority
-            self._update_cluster_view(
+            view = self._update_cluster_view(
                 trial_priority, suggested_nodes, ignore_suggested_nodes
             )
             picked, failed_reason = _find_nodes_for_pods(
-                self.cluster_view, sorted_leaf_nums, avoid_anchors
+                view, sorted_leaf_nums, avoid_anchors
             )
         if picked is None:
             return None, failed_reason
@@ -450,7 +554,7 @@ class TopologyAwareScheduler:
         placements: Dict[int, List[List[Cell]]] = {}
         node_available: Dict[api.CellAddress, List[Cell]] = {}
         for pod_index, leaf_num in enumerate(sorted_leaf_nums):
-            node_cell = self.cluster_view[picked[pod_index]].cell
+            node_cell = view[picked[pod_index]].cell
             chips, node_available[node_cell.address] = _find_leaf_cells_in_node(
                 node_cell,
                 leaf_num,

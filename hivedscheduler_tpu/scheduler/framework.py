@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
@@ -169,6 +170,10 @@ class SchedulerMetrics:
         self.health_transition_count = 0
         self.health_damped_count = 0
         self.health_settled_count = 0
+        # Node update events skipped by the unchanged-projection fast path
+        # (no global-lock acquisition; doc/hot-path.md "Warehouse-scale
+        # profile" — a relist at fleet scale re-delivers every node).
+        self.node_event_noop_count = 0
         self.ledger_coalesced_count = 0
         self.stranded_eviction_count = 0
         # HA / snapshot recovery plane (doc/fault-model.md "HA and snapshot
@@ -277,6 +282,10 @@ class SchedulerMetrics:
         with self._lock:
             self.health_settled_count += 1
 
+    def observe_node_event_noop(self) -> None:
+        with self._lock:
+            self.node_event_noop_count += 1
+
     def observe_ledger_coalesced(self, n: int) -> None:
         with self._lock:
             self.ledger_coalesced_count += n
@@ -336,6 +345,7 @@ class SchedulerMetrics:
                 "healthTransitionCount": self.health_transition_count,
                 "healthDampedCount": self.health_damped_count,
                 "healthSettledCount": self.health_settled_count,
+                "nodeEventNoopCount": self.node_event_noop_count,
                 "doomedLedgerCoalescedCount": self.ledger_coalesced_count,
                 "strandedEvictionCount": self.stranded_eviction_count,
                 "snapshotPersistCount": self.snapshot_persist_count,
@@ -352,6 +362,14 @@ class SchedulerMetrics:
                     "recoveryReplay": self.hist_recovery_replay.snapshot(),
                 },
             }
+
+
+# A/B escape hatch (bench_relist_ab, doc/hot-path.md "Warehouse-scale
+# profile"): =0 disables the node-event no-op fast path so every relist
+# re-delivery takes the global lock order, the pre-fast-path behavior.
+NODE_EVENT_FASTPATH_DEFAULT = (
+    os.environ.get("HIVED_NODE_EVENT_FASTPATH", "") != "0"
+)
 
 
 class HivedScheduler:
@@ -453,6 +471,14 @@ class HivedScheduler:
         # node settles instead of storming doom churn and ledger rewrites.
         # Drains apply undamped (deliberate operator actions).
         self._health_clock = 0
+        self.node_event_fastpath = NODE_EVENT_FASTPATH_DEFAULT
+        # Last-APPLIED health projection per node (written by the locked
+        # node-event paths, popped on delete): the no-op fast path
+        # compares one freshly computed projection against this cache
+        # instead of re-parsing the stored node's annotations per event —
+        # at fleet scale the relist re-delivers every node, so halving
+        # the projection work halves the whole fast-path cost.
+        self._node_projections: Dict[str, Tuple] = {}
         self._damper = health_mod.FlapDamper(
             config.health_flap_threshold,
             config.health_flap_window,
@@ -1711,6 +1737,20 @@ class HivedScheduler:
             self._exit_mutation()
 
     def update_node(self, old: Node, new: Node) -> None:
+        if self._node_event_is_noop(new):
+            # Relist fast path (doc/hot-path.md "Warehouse-scale profile"):
+            # every informer gap repair re-delivers the WHOLE node list, and
+            # at fleet scale almost none of it changed — each no-change
+            # update used to acquire the global (all-chains) lock order just
+            # to feed the damper an observation it would discard. When the
+            # node's health-relevant projection (ready-state, device-health
+            # chips, drain annotation) matches what is already applied and
+            # the damper holds nothing, skip the lock entirely. Replacing a
+            # present key is atomic under the GIL (no dict resize), so
+            # concurrent readers holding the lock never see a torn map.
+            self.nodes[new.name] = new
+            self.metrics.observe_node_event_noop()
+            return
         self._enter_mutation()
         try:
             with self._lock:
@@ -1728,6 +1768,7 @@ class HivedScheduler:
                 # core lifts its drain and marks it bad.
                 self._damper.forget_node(node.name)
                 self._chip_targets.pop(node.name, None)
+                self._node_projections.pop(node.name, None)
                 self.core.delete_node(node)
                 self.metrics.observe_health_transition()
                 self._check_stranded_locked()
@@ -1737,6 +1778,41 @@ class HivedScheduler:
     # ------------------------------------------------------------------ #
     # Health plane (doc/fault-model.md "Hardware health plane")
     # ------------------------------------------------------------------ #
+
+    @staticmethod
+    def _node_health_projection(node: Node) -> Tuple:
+        """Everything _observe_node_health reads off a node object: the
+        ready/schedulable verdict, the bad-chip set (annotation + per-chip
+        conditions), and the raw drain annotation. Two nodes with equal
+        projections are indistinguishable to the health plane."""
+        return (
+            is_node_healthy(node),
+            frozenset(health_mod.device_bad_chips(node)),
+            node.annotations.get(
+                constants.ANNOTATION_NODE_DRAIN, ""
+            ).strip(),
+        )
+
+    def _node_event_is_noop(self, new: Node) -> bool:
+        """True when an update event for a known node carries no
+        health-relevant change AND nothing is pending that the slow path
+        would progress (damper holds, eviction retries, recovery). Reads
+        are lock-free: the cached projection and the damper count are
+        GIL-atomic, and a racing real transition re-delivers through its
+        own (locked) event, so a stale skip here can never lose state the
+        cluster still wants — the projection is compared against what was
+        last APPLIED, not against the caller's old object."""
+        if (
+            not self.node_event_fastpath
+            or self._in_recovery
+            or self._eviction_retry_pending
+            or self._damper.pending_count() > 0
+        ):
+            return False
+        applied = self._node_projections.get(new.name)
+        if applied is None:
+            return False
+        return applied == self._node_health_projection(new)
 
     def _observe_node_health(self, node: Node) -> None:
         """Under the lock: feed the node's desired health (ready-state +
@@ -1770,6 +1846,12 @@ class HivedScheduler:
         if drain != self.core.draining_chips.get(node.name, set()):
             self.core.apply_drain(node.name, drain)
             applied = True
+        # The no-op fast path's baseline: the projection this (locked)
+        # observation just processed. A held transition keeps pending>0,
+        # which disables skipping until it settles.
+        self._node_projections[node.name] = (
+            self._node_health_projection(node)
+        )
         if applied and not self._in_recovery:
             # Not during recovery: the replay applies one transition per
             # node and a per-transition group scan would make recovery

@@ -1,0 +1,21 @@
+"""Warehouse-scale trace-driven simulation tier (ROADMAP new-direction 4).
+
+The instrument that turns "as fast as the hardware allows" into trend
+lines instead of spot numbers: seeded, replayable arrival traces shaped by
+BASELINE.json's config ladder (diurnal load, burst storms, mixed gang
+sizes, preemption pressure, node-fault injection reusing the chaos event
+vocabulary), driven time-compressed through the REAL scheduler — the same
+filter/preempt/delete verbs the HTTP extender serves — at 5k/10k/50k
+hosts, reporting tail latency plus scheduling-quality metrics
+(fragmentation, preemption rate, quota satisfaction) per trace.
+
+- :mod:`.trace`  — trace generation, a pure function of (seed, shape)
+- :mod:`.fleet`  — fleet config builder (shared with bench.py)
+- :mod:`.driver` — time-compressed replay through the real scheduler
+- :mod:`.report` — per-trace report assembly and rendering
+
+CLI: ``python -m hivedscheduler_tpu.sim --hosts 10368 --seed 0``.
+"""
+
+from .trace import TraceShape, generate_trace, trace_json  # noqa: F401
+from .driver import TraceDriver, build_fleet_config  # noqa: F401

@@ -1,0 +1,106 @@
+"""CLI for the warehouse-scale sim tier.
+
+Generate a seeded trace and replay it time-compressed through the real
+scheduler::
+
+    python -m hivedscheduler_tpu.sim --hosts 10368 --seed 0 --gangs 800
+
+Write the trace for later replay (bit-identical from the same seed)::
+
+    python -m hivedscheduler_tpu.sim --hosts 5184 --write-trace t.json
+    python -m hivedscheduler_tpu.sim --trace t.json --out report.json
+
+``--mode shards --shards N`` drives the multi-process frontend
+(``procShards``); ``--json`` emits the full report instead of the text
+summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from .. import common
+from .driver import run_trace
+from .report import render_text
+from .trace import TraceShape, generate_trace, load_trace, trace_json
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m hivedscheduler_tpu.sim",
+        description="Trace-driven warehouse-scale scheduler simulation",
+    )
+    ap.add_argument("--hosts", type=int, default=5184)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--gangs", type=int, default=400)
+    ap.add_argument(
+        "--pattern", choices=("diurnal", "burst", "steady"),
+        default="diurnal",
+    )
+    ap.add_argument("--duration", type=float, default=3600.0,
+                    help="trace-time span in seconds")
+    ap.add_argument("--opportunistic", type=float, default=0.3,
+                    help="fraction of arrivals at OPPORTUNISTIC priority")
+    ap.add_argument("--faults", type=int, default=30)
+    ap.add_argument("--mean-runtime", type=float, default=600.0)
+    ap.add_argument("--mode", choices=("inproc", "shards"),
+                    default="inproc")
+    ap.add_argument("--shards", type=int, default=2)
+    ap.add_argument("--transport", choices=("proc", "local"),
+                    default="proc")
+    ap.add_argument("--trace", help="replay this trace file instead of "
+                    "generating one")
+    ap.add_argument("--write-trace", help="write the generated trace "
+                    "here and exit (no replay)")
+    ap.add_argument("--out", help="write the JSON report here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full JSON report")
+    ap.add_argument("--verbose", action="store_true",
+                    help="scheduler INFO logs (quiet by default: a 10k-"
+                    "host trace logs millions of placement lines)")
+    args = ap.parse_args(argv)
+
+    common.init_logging(
+        logging.INFO if args.verbose else logging.ERROR
+    )
+    if args.trace:
+        trace = load_trace(args.trace)
+    else:
+        shape = TraceShape(
+            hosts=args.hosts,
+            gangs=args.gangs,
+            duration_s=args.duration,
+            pattern=args.pattern,
+            opportunistic_fraction=args.opportunistic,
+            fault_events=args.faults,
+            mean_runtime_s=args.mean_runtime,
+        )
+        trace = generate_trace(args.seed, shape)
+    if args.write_trace:
+        with open(args.write_trace, "wb") as f:
+            f.write(trace_json(trace))
+        print(f"trace written: {args.write_trace} "
+              f"({len(trace['events'])} events)")
+        return 0
+
+    report = run_trace(
+        trace,
+        mode=args.mode,
+        n_shards=args.shards,
+        transport=args.transport,
+    )
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+    if args.json:
+        print(json.dumps(report, sort_keys=True))
+    else:
+        print(render_text(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,447 @@
+"""Time-compressed trace replay through the REAL scheduler.
+
+No mocks: the driver speaks the verbs the HTTP extender serves —
+``filter_routine`` (with assume-bind), ``preempt_routine`` (commit +
+victim delete + re-filter, the production preemption protocol),
+``delete_pod`` (departures and victim kills), ``update_node`` (the chaos
+fault vocabulary) — against either the in-process ``HivedScheduler`` or
+the multi-process ``ShardedScheduler`` frontend (``mode="shards"``,
+doc/hot-path.md "The multi-process contract").
+
+Time compression: trace time is a logical clock. Events replay in trace
+order with zero sleeps; the *scheduler's* cost is measured in wall time
+per gang schedule, while queueing delay (submit → bound) is measured in
+TRACE time — so a 1-hour diurnal trace at 10k hosts runs in seconds yet
+reports both "how slow is the scheduler" (tail latency) and "how well
+does it schedule" (fragmentation, preemption rate, quota satisfaction).
+
+Determinism: placements are a pure function of (config, trace) — the
+preempt RNG is seeded from the trace seed, and placement itself is
+state-pure (doc/hot-path.md "State-pure sorted view") — so two runs of
+one trace produce identical binds, preemptions, and fragmentation
+(tests/test_sim_smoke.py asserts it). Wall-clock latencies are the only
+run-varying output.
+"""
+
+from __future__ import annotations
+
+import heapq
+import random
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..api import constants, extender as ei
+from ..api.config import Config
+from ..scheduler.framework import HivedScheduler, NullKubeClient
+from ..scheduler.types import Node, Pod
+from . import fleet
+from .trace import TraceShape
+
+# Waiting-queue retry budget per capacity-freeing event: bounds the
+# worst-case O(waiting * events) replay cost while keeping the FIFO
+# fairness the reference's block knob approximates.
+RETRY_BUDGET_PER_EVENT = 8
+
+
+def build_fleet_config(hosts: int) -> Tuple[Config, int]:
+    """A bench-proportioned fleet approximating ``hosts``; returns the
+    config and the exact host count."""
+    cubes, slices, solos = fleet.fleet_dims_for_hosts(hosts)
+    return (
+        fleet.build_config(cubes, slices, solos),
+        fleet.fleet_hosts(cubes, slices, solos),
+    )
+
+
+class _Gang:
+    __slots__ = (
+        "name", "vc", "leaf_type", "n_pods", "chips", "priority",
+        "runtime_s", "submit_t", "pods", "bound", "bound_t", "ladder",
+    )
+
+    def __init__(self, spec: Dict, submit_t: float):
+        self.name = spec["name"]
+        self.vc = spec["vc"]
+        self.leaf_type = spec["leafType"]
+        self.n_pods = int(spec["pods"])
+        self.chips = int(spec["chips"])
+        self.priority = int(spec["priority"])
+        self.runtime_s = float(spec["runtimeS"])
+        self.ladder = spec.get("ladder", "")
+        self.submit_t = submit_t
+        self.pods: List[Pod] = []
+        self.bound: List[Pod] = []
+        self.bound_t: Optional[float] = None
+
+    @property
+    def guaranteed(self) -> bool:
+        return self.priority >= 0
+
+    def make_pods(self) -> List[Pod]:
+        group = {
+            "name": self.name,
+            "members": [
+                {"podNumber": self.n_pods, "leafCellNumber": self.chips}
+            ],
+        }
+        self.pods = [
+            fleet.make_pod(
+                f"{self.name}-{i}", f"{self.name}-u{i}", self.vc,
+                self.priority, self.leaf_type, self.chips, group,
+            )
+            for i in range(self.n_pods)
+        ]
+        return self.pods
+
+
+def fragmentation_snapshot(core) -> Dict[str, int]:
+    """The sim tier's fragmentation metric: the core's schedulable-
+    slice-size distribution (HivedCore.free_slice_distribution)."""
+    return core.free_slice_distribution()
+
+
+class TraceDriver:
+    """Replays one trace against one scheduler instance."""
+
+    def __init__(
+        self,
+        config: Config,
+        mode: str = "inproc",
+        n_shards: int = 2,
+        transport: str = "proc",
+        frag_samples: int = 8,
+        scheduler=None,
+    ):
+        self.mode = mode
+        self.frag_samples = frag_samples
+        if scheduler is not None:
+            # Pre-built subject (hack/sim_server.py's HTTP-wire adapter):
+            # anything exposing the HivedScheduler verb surface — possibly
+            # a ShardedScheduler, which has configured_node_names() on the
+            # frontend and no single .core. Informer verbs may run
+            # in-process; filter/preempt may cross a wire.
+            self.sched = scheduler
+            self.core = getattr(scheduler, "core", None)
+            names = getattr(scheduler, "configured_node_names", None)
+            self.nodes = sorted(
+                names() if names is not None
+                else scheduler.core.configured_node_names()
+            )
+        elif mode == "shards":
+            from ..scheduler.shards import ShardedScheduler
+
+            self.sched = ShardedScheduler(
+                config,
+                kube_client=NullKubeClient(),
+                n_shards=n_shards,
+                transport=transport,
+                auto_admit=True,
+            )
+            self.core = None  # per-shard cores live behind the frontend
+            self.nodes = sorted(self.sched.configured_node_names())
+        else:
+            self.sched = HivedScheduler(
+                config, kube_client=NullKubeClient(), auto_admit=True
+            )
+            self.core = self.sched.core
+            self.nodes = sorted(self.core.configured_node_names())
+        self._node_cache: Dict[str, Node] = {}
+        for n in self.nodes:
+            node = Node(name=n)
+            self._node_cache[n] = node
+            self.sched.add_node(node)
+
+    def _bound_pod(self, uid: str) -> Pod:
+        """The assume-bound pod object for one scheduled uid, any mode
+        and transport."""
+        if self.core is not None:
+            return self.sched.pod_schedule_statuses[uid].pod
+        found = self.sched.get_status_pod(uid)
+        return found[0]
+
+    def close(self) -> None:
+        close = getattr(self.sched, "close", None)
+        if close is not None:
+            close()
+
+    # -- fault vocabulary (chaos events, resolved by node index) ------- #
+
+    def _apply_fault(self, ev: Dict) -> None:
+        name = self.nodes[ev["nodeIndex"] % len(self.nodes)]
+        old = self._node_cache[name]
+        annotations = dict(old.annotations)
+        ready = old.ready
+        kind = ev["kind"]
+        if kind == "node_flip":
+            ready = ev.get("to", "down") == "up"
+        elif kind in ("chip_fault", "chip_heal"):
+            bad: Set[str] = set(
+                x
+                for x in annotations.get(
+                    constants.ANNOTATION_NODE_DEVICE_HEALTH, ""
+                ).split(",")
+                if x
+            )
+            chip = str(ev.get("chip", 0))
+            if kind == "chip_fault":
+                bad.add(chip)
+            else:
+                bad.discard(chip)
+            if bad:
+                annotations[constants.ANNOTATION_NODE_DEVICE_HEALTH] = (
+                    ",".join(sorted(bad))
+                )
+            else:
+                annotations.pop(
+                    constants.ANNOTATION_NODE_DEVICE_HEALTH, None
+                )
+        elif kind == "drain_toggle":
+            if ev.get("on"):
+                annotations[constants.ANNOTATION_NODE_DRAIN] = "*"
+            else:
+                annotations.pop(constants.ANNOTATION_NODE_DRAIN, None)
+        new = Node(name=name, ready=ready, annotations=annotations)
+        self._node_cache[name] = new
+        self.sched.update_node(old, new)
+
+    # -- the scheduling protocol (what the extender does) -------------- #
+
+    def _filter_gang(self, gang: _Gang) -> bool:
+        """Filter every pod of the gang; on full success the gang is live
+        (assume-bound). On partial failure the placed pods are deleted —
+        the framework's partial-gang release."""
+        bound: List[Pod] = []
+        for p in gang.pods:
+            r = self.sched.filter_routine(
+                ei.ExtenderArgs(pod=p, node_names=self.nodes)
+            )
+            if not r.node_names:
+                for q in gang.pods:
+                    self.sched.delete_pod(q)
+                return False
+            bound.append(self._bound_pod(p.uid))
+        gang.bound = bound
+        return True
+
+    def _try_preempt(self, gang: _Gang, live: Dict[str, "_Gang"]) -> int:
+        """The production preemption protocol for the gang's first pod:
+        probe/commit via preempt_routine; if victims are proposed, kill
+        them (their whole gangs, as the eviction would) and report how
+        many pods died. The caller re-filters afterwards."""
+        pod = gang.pods[0]
+        result = self.sched.preempt_routine(
+            ei.ExtenderPreemptionArgs(
+                pod=pod,
+                node_name_to_meta_victims={
+                    n: ei.MetaVictims() for n in self.nodes
+                },
+            )
+        )
+        victims = {
+            mp.uid
+            for mv in result.node_name_to_meta_victims.values()
+            for mp in mv.pods
+        }
+        if not victims:
+            return 0
+        killed = 0
+        for gname in list(live):
+            g = live[gname]
+            if any(p.uid in victims for p in g.bound):
+                for p in g.bound:
+                    self.sched.delete_pod(p)
+                killed += len(g.bound)
+                del live[gname]
+        return killed
+
+    # -- replay -------------------------------------------------------- #
+
+    def run(self, trace: Dict) -> Dict:
+        shape = TraceShape.from_dict(trace["shape"])
+        # Deterministic preempt victim-node picks, keyed to the trace:
+        # the sharded frontend seeds every worker, a single-core subject
+        # (in-process or behind the wire adapter) seeds its core.
+        seed = int(trace.get("seed", 0))
+        seeder = getattr(self.sched, "seed_preempt_rng", None)
+        if seeder is not None:
+            seeder(seed)
+        elif self.core is not None:
+            self.core.preempt_rng = random.Random(seed)
+
+        live: Dict[str, _Gang] = {}
+        waiting: List[_Gang] = []
+        departures: List[Tuple[float, int, str]] = []  # (t, seq, gang)
+        dep_seq = 0
+        lat_ms: List[float] = []
+        submitted = bound_gangs = 0
+        submitted_guaranteed = bound_guaranteed = 0
+        preemption_events = preempted_pods = 0
+        pods_bound = 0
+        wait_times: List[float] = []
+        frag_series: List[Dict] = []
+        frag_at = [
+            shape.duration_s * (k + 1) / max(1, self.frag_samples)
+            for k in range(self.frag_samples)
+        ]
+        frag_i = 0
+        faults_applied = 0
+        t_wall0 = time.perf_counter()
+
+        def depart_until(t: float) -> int:
+            nonlocal pods_bound
+            freed = 0
+            while departures and departures[0][0] <= t:
+                _, _, gname = heapq.heappop(departures)
+                g = live.pop(gname, None)
+                if g is None:
+                    continue  # already preempted away
+                for p in g.bound:
+                    self.sched.delete_pod(p)
+                freed += 1
+            return freed
+
+        def try_schedule(gang: _Gang, now: float) -> bool:
+            nonlocal bound_gangs, bound_guaranteed, pods_bound
+            nonlocal preemption_events, preempted_pods, dep_seq
+            t0 = time.perf_counter()
+            ok = self._filter_gang(gang)
+            if not ok and gang.guaranteed:
+                gang.make_pods()  # fresh pods: the failed set was deleted
+                killed = self._try_preempt(gang, live)
+                if killed:
+                    preemption_events += 1
+                    preempted_pods += killed
+                    ok = self._filter_gang(gang)
+                if not ok:
+                    # Release any reservation the probe committed (the
+                    # extender's cancel: preempt with no candidates), so
+                    # a waiting gang never parks capacity it cannot use.
+                    self.sched.preempt_routine(
+                        ei.ExtenderPreemptionArgs(
+                            pod=gang.pods[0],
+                            node_name_to_meta_victims={},
+                        )
+                    )
+                    for q in gang.pods:
+                        self.sched.delete_pod(q)
+            lat_ms.append((time.perf_counter() - t0) * 1e3)
+            if not ok:
+                return False
+            gang.bound_t = now
+            live[gang.name] = gang
+            heapq.heappush(
+                departures, (now + gang.runtime_s, dep_seq, gang.name)
+            )
+            dep_seq += 1
+            bound_gangs += 1
+            pods_bound += len(gang.bound)
+            if gang.guaranteed:
+                bound_guaranteed += 1
+            wait_times.append(now - gang.submit_t)
+            return True
+
+        def retry_waiting(now: float) -> None:
+            budget = RETRY_BUDGET_PER_EVENT
+            i = 0
+            while i < len(waiting) and budget > 0:
+                gang = waiting[i]
+                gang.make_pods()
+                budget -= 1
+                if try_schedule(gang, now):
+                    waiting.pop(i)
+                else:
+                    i += 1
+
+        for ev in trace["events"]:
+            t = float(ev["t"])
+            while frag_i < len(frag_at) and frag_at[frag_i] <= t:
+                if self.core is not None:
+                    frag_series.append(
+                        {
+                            "t": frag_at[frag_i],
+                            "freeSlices": fragmentation_snapshot(
+                                self.core
+                            ),
+                        }
+                    )
+                frag_i += 1
+            if depart_until(t):
+                retry_waiting(t)
+            kind = ev["kind"]
+            if kind == "submit":
+                gang = _Gang(ev["gang"], t)
+                gang.make_pods()
+                submitted += 1
+                if gang.guaranteed:
+                    submitted_guaranteed += 1
+                if not try_schedule(gang, t):
+                    waiting.append(gang)
+            else:
+                self._apply_fault(ev)
+                faults_applied += 1
+                if kind in ("chip_heal", "node_flip", "drain_toggle"):
+                    retry_waiting(t)
+        # Trace end: drain remaining departures, give waiters one last
+        # chance at the emptying fleet (quota satisfaction is judged on
+        # the whole trace, not on a cutoff artifact).
+        end_t = shape.duration_s
+        if depart_until(end_t):
+            retry_waiting(end_t)
+        while frag_i < len(frag_at):
+            if self.core is not None:
+                frag_series.append(
+                    {
+                        "t": frag_at[frag_i],
+                        "freeSlices": fragmentation_snapshot(self.core),
+                    }
+                )
+            frag_i += 1
+        wall_s = time.perf_counter() - t_wall0
+
+        from .report import build_report
+
+        return build_report(
+            trace=trace,
+            lat_ms=lat_ms,
+            wall_s=wall_s,
+            counts={
+                "submitted": submitted,
+                "boundGangs": bound_gangs,
+                "podsBound": pods_bound,
+                "submittedGuaranteed": submitted_guaranteed,
+                "boundGuaranteed": bound_guaranteed,
+                "preemptionEvents": preemption_events,
+                "preemptedPods": preempted_pods,
+                "waitingAtEnd": len(waiting),
+                "liveAtEnd": len(live),
+                "faultsApplied": faults_applied,
+            },
+            wait_times_s=wait_times,
+            frag_series=frag_series,
+            metrics=self.sched.get_metrics(),
+            mode=self.mode,
+        )
+
+
+def run_trace(
+    trace: Dict,
+    mode: str = "inproc",
+    n_shards: int = 2,
+    transport: str = "proc",
+    hosts: Optional[int] = None,
+) -> Dict:
+    """Build the fleet the trace's shape names (or ``hosts`` override),
+    replay, and return the report."""
+    shape = TraceShape.from_dict(trace["shape"])
+    config, actual_hosts = build_fleet_config(
+        hosts if hosts is not None else shape.hosts
+    )
+    driver = TraceDriver(
+        config, mode=mode, n_shards=n_shards, transport=transport
+    )
+    try:
+        report = driver.run(trace)
+    finally:
+        driver.close()
+    report["hosts"] = actual_hosts
+    return report

@@ -1,0 +1,118 @@
+"""Bench/sim fleet builder: the mixed v5p/v5e fleet at any scale.
+
+Owned here (not in bench.py) so the sim tier and the bench driver share
+one fleet shape: ``build_config`` is the 432-host-quantum fleet every
+measured table in doc/hot-path.md uses (cubes=16/slices=40/solos=16), and
+``fleet_config_for_hosts`` scales it continuously to the 5k/10k/50k-host
+targets of the warehouse-scale trace runs. bench.py re-exports
+``build_config``/``make_pod`` for its stages and for existing callers.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..api import constants
+from ..api.config import Config
+from ..scheduler.types import Pod
+from ..tpu import topology
+
+# The 432-host reference fleet is cubes=16, slices=40, solos=16
+# (doc/hot-path.md measured tables); scaling keeps those proportions.
+BASE_HOSTS = 432
+BASE_CUBES, BASE_SLICES, BASE_SOLOS = 16, 40, 16
+
+
+def build_config(cubes: int = 4, slices: int = 8, solos: int = 8) -> Config:
+    """The bench fleet: ``cubes`` v5p-64 cubes (16 hosts each), ``slices``
+    v5e-16 slices (4 hosts each), ``solos`` standalone v5e hosts. Defaults
+    give the 104-host default load; the 432-host fleet variant
+    (doc/hot-path.md measured tables) is cubes=16, slices=40, solos=16.
+    VC quota scales with the fleet so the gang mix always fits."""
+    cell_types = {}
+    cell_types.update(topology.v5p_cell_types(max_hosts=16))
+    cell_types.update(topology.v5e_cell_types(max_hosts=4))
+    physical = []
+    for cube in range(cubes):
+        physical.append(
+            topology.make_physical_cell(
+                "v5p-64",
+                [f"v5p-c{cube}-w{i}" for i in range(16)],
+                cell_types,
+            ).to_dict()
+        )
+    for s in range(slices):
+        physical.append(
+            topology.make_physical_cell(
+                "v5e-16", [f"v5e-s{s}-w{i}" for i in range(4)], cell_types
+            ).to_dict()
+        )
+    for h in range(solos):
+        physical.append(
+            topology.make_physical_cell(
+                "v5e-host", [f"v5e-solo-{h}"], cell_types
+            ).to_dict()
+        )
+    return Config.from_dict(
+        {
+            "physicalCluster": {
+                "cellTypes": {
+                    n: {
+                        "childCellType": s.child_cell_type,
+                        "childCellNumber": s.child_cell_number,
+                        "isNodeLevel": s.is_node_level,
+                    }
+                    for n, s in cell_types.items()
+                },
+                "physicalCells": physical,
+            },
+            "virtualClusters": {
+                "prod": {
+                    "virtualCells": [
+                        {"cellType": "v5p-64", "cellNumber": cubes // 2},
+                        {"cellType": "v5e-16", "cellNumber": slices // 2},
+                    ]
+                },
+                "research": {
+                    "virtualCells": [
+                        {"cellType": "v5p-64.v5p-16", "cellNumber": 2 * cubes},
+                        {"cellType": "v5e-16", "cellNumber": slices // 2},
+                        {"cellType": "v5e-host", "cellNumber": solos},
+                    ]
+                },
+            },
+        }
+    )
+
+
+def fleet_dims_for_hosts(hosts: int) -> Tuple[int, int, int]:
+    """(cubes, slices, solos) approximating a host-count target with the
+    reference fleet's proportions. Floors keep the two VCs constructible
+    (prod needs cubes//2 >= 1 and slices//2 >= 1)."""
+    f = max(1, int(hosts)) / BASE_HOSTS
+    cubes = max(2, round(BASE_CUBES * f))
+    slices = max(2, round(BASE_SLICES * f))
+    solos = max(1, round(BASE_SOLOS * f))
+    return cubes, slices, solos
+
+
+def fleet_hosts(cubes: int, slices: int, solos: int) -> int:
+    return 16 * cubes + 4 * slices + solos
+
+
+def make_pod(name, uid, vc, priority, leaf_type, leaf_num, group) -> Pod:
+    import yaml
+
+    spec = {
+        "virtualCluster": vc,
+        "priority": priority,
+        "leafCellType": leaf_type,
+        "leafCellNumber": leaf_num,
+        "affinityGroup": group,
+    }
+    return Pod(
+        name=name,
+        uid=uid,
+        annotations={constants.ANNOTATION_POD_SCHEDULING_SPEC: yaml.safe_dump(spec)},
+        resource_limits={constants.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1},
+    )

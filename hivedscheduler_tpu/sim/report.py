@@ -1,0 +1,180 @@
+"""Per-trace report: tail latency + scheduling-quality metrics.
+
+One dict, JSON-serializable, with four metric families the warehouse
+tier exists to trend:
+
+- **latency** — wall-clock cost of the scheduler per gang schedule
+  attempt (p50/p95/p99/max) and sustained pods/s through the filter path;
+- **fragmentation** — the schedulable-slice-size distribution
+  (driver.fragmentation_snapshot) sampled across trace time, summarized
+  as the end-state distribution plus the largest schedulable slice;
+- **preemption** — preemption events and preempted pods, normalized per
+  bound guaranteed gang;
+- **quota satisfaction** — bound/submitted for guaranteed gangs, plus
+  the TRACE-time queueing delay distribution (submit -> bound).
+"""
+
+from __future__ import annotations
+
+import statistics
+from typing import Dict, List, Optional
+
+
+def _pct(sorted_vals: List[float], p: float) -> float:
+    """bench.py's `_percentiles` convention (sorted[min(n-1, int(p*n))]),
+    so the sim tier's tails are directly comparable with every bench
+    stage's in the same BENCH artifact."""
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[min(len(sorted_vals) - 1, int(p * len(sorted_vals)))]
+
+
+def latency_summary(lat_ms: List[float]) -> Dict:
+    s = sorted(lat_ms)
+    return {
+        "samples": len(s),
+        "p50Ms": round(statistics.median(s), 3) if s else 0.0,
+        "p95Ms": round(_pct(s, 0.95), 3),
+        "p99Ms": round(_pct(s, 0.99), 3),
+        "maxMs": round(s[-1], 3) if s else 0.0,
+    }
+
+
+def frag_summary(frag_series: List[Dict]) -> Optional[Dict]:
+    """End-state slice distribution + the largest schedulable slice at
+    each sample (the defrag trend metric of ROADMAP new-direction 3)."""
+    if not frag_series:
+        return None
+    largest = [
+        max((int(k) for k in s["freeSlices"]), default=0)
+        for s in frag_series
+    ]
+    end = frag_series[-1]["freeSlices"]
+    total_free = sum(int(k) * v for k, v in end.items())
+    return {
+        "samples": len(frag_series),
+        "endFreeSlices": end,
+        "endFreeChips": total_free,
+        "largestFreeSliceChips": largest[-1] if largest else 0,
+        "largestFreeSliceSeries": largest,
+        "series": frag_series,
+    }
+
+
+def build_report(
+    trace: Dict,
+    lat_ms: List[float],
+    wall_s: float,
+    counts: Dict,
+    wait_times_s: List[float],
+    frag_series: List[Dict],
+    metrics: Dict,
+    mode: str,
+) -> Dict:
+    waits = sorted(wait_times_s)
+    bound_g = counts["boundGuaranteed"]
+    sub_g = counts["submittedGuaranteed"]
+    report = {
+        "schemaVersion": 1,
+        "seed": trace.get("seed"),
+        "shape": trace.get("shape"),
+        "mode": mode,
+        "events": len(trace.get("events", [])),
+        "wallS": round(wall_s, 3),
+        "counts": counts,
+        "latency": latency_summary(lat_ms),
+        "podsPerSec": round(counts["podsBound"] / wall_s, 1)
+        if wall_s > 0
+        else 0.0,
+        "preemption": {
+            "events": counts["preemptionEvents"],
+            "preemptedPods": counts["preemptedPods"],
+            "ratePerBoundGuaranteed": round(
+                counts["preemptionEvents"] / bound_g, 4
+            )
+            if bound_g
+            else 0.0,
+        },
+        "quotaSatisfaction": {
+            "submittedGuaranteed": sub_g,
+            "boundGuaranteed": bound_g,
+            "fraction": round(bound_g / sub_g, 4) if sub_g else 1.0,
+            "queueWaitP50S": round(statistics.median(waits), 3)
+            if waits
+            else 0.0,
+            "queueWaitP99S": round(_pct(waits, 0.99), 3),
+        },
+        "fragmentation": frag_summary(frag_series),
+        # The scheduler's own counters for cross-checks (preemptCount,
+        # nodeEventNoopCount, filter histogram...).
+        "schedulerMetrics": {
+            k: metrics.get(k)
+            for k in (
+                "filterCount",
+                "bindCount",
+                "preemptCount",
+                "waitCount",
+                "healthTransitionCount",
+                "nodeEventNoopCount",
+                "filterLatencyP50Ms",
+                "filterLatencyP99Ms",
+            )
+        },
+    }
+    return report
+
+
+def placement_fingerprint(report: Dict) -> Dict:
+    """The run-invariant slice of a report: everything that must be
+    IDENTICAL when the same trace replays (wall-clock latencies excluded
+    by construction). The replay-determinism test diffs this."""
+    return {
+        "counts": report["counts"],
+        "preemption": report["preemption"],
+        "quotaSatisfaction": report["quotaSatisfaction"],
+        "fragmentation": report["fragmentation"],
+        "binds": report["schedulerMetrics"]["bindCount"],
+    }
+
+
+def render_text(report: Dict) -> str:
+    """A human-readable one-screen summary for the CLI."""
+    lines = []
+    shape = report.get("shape") or {}
+    lines.append(
+        f"trace seed={report['seed']} pattern={shape.get('pattern')} "
+        f"hosts={report.get('hosts', shape.get('hosts'))} "
+        f"gangs={shape.get('gangs')} mode={report['mode']}"
+    )
+    c = report["counts"]
+    lat = report["latency"]
+    lines.append(
+        f"  schedule latency: p50={lat['p50Ms']}ms p95={lat['p95Ms']}ms "
+        f"p99={lat['p99Ms']}ms max={lat['maxMs']}ms "
+        f"({report['podsPerSec']} pods/s, wall {report['wallS']}s)"
+    )
+    q = report["quotaSatisfaction"]
+    lines.append(
+        f"  quota satisfaction: {q['boundGuaranteed']}/"
+        f"{q['submittedGuaranteed']} guaranteed bound "
+        f"({q['fraction']:.1%}); queue wait p50={q['queueWaitP50S']}s "
+        f"p99={q['queueWaitP99S']}s"
+    )
+    p = report["preemption"]
+    lines.append(
+        f"  preemption: {p['events']} events, {p['preemptedPods']} pods "
+        f"({p['ratePerBoundGuaranteed']}/bound-guaranteed-gang)"
+    )
+    frag = report["fragmentation"]
+    if frag:
+        lines.append(
+            f"  fragmentation: end free {frag['endFreeChips']} chips, "
+            f"largest slice {frag['largestFreeSliceChips']} chips, "
+            f"distribution {frag['endFreeSlices']}"
+        )
+    lines.append(
+        f"  gangs: {c['boundGangs']}/{c['submitted']} bound, "
+        f"{c['waitingAtEnd']} waiting, {c['liveAtEnd']} live at end, "
+        f"{c['faultsApplied']} faults applied"
+    )
+    return "\n".join(lines)

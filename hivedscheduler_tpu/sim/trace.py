@@ -1,0 +1,258 @@
+"""Seeded, replayable arrival traces: a PURE function of (seed, shape).
+
+``generate_trace(seed, shape)`` reads nothing but its arguments — no env,
+no clock, no global state — so the same (seed, shape) produces the same
+trace byte for byte, on any host, under any ``HIVED_PROC_SHARDS`` setting
+(tests/test_sim_smoke.py asserts both). That is what makes a warehouse
+trace an *instrument*: a perf number at 10k hosts is only a trend point if
+the exact same load can be replayed against the next optimization.
+
+Shape vocabulary:
+
+- **Arrival pattern** — ``diurnal`` (sinusoidal day curve), ``burst``
+  (steady floor + concentrated storm windows), ``steady``.
+- **Gang ladder** — the mixed sizes of BASELINE.json's config ladder:
+  single-chip singletons, single-host jobs, v5e-16 4-pod gangs, v5p-16
+  gangs, whole v5p-64 16-pod gangs, across both VCs.
+- **Preemption pressure** — ``opportunistic_fraction`` of arrivals run at
+  OPPORTUNISTIC priority; guaranteed arrivals are split across two
+  priority tiers (0 and 5) so intra-VC preemption and the per-priority
+  view slots both get exercised.
+- **Fault injection** — the chaos event vocabulary (tests/chaos.py):
+  ``node_flip`` (unready/ready), ``chip_fault``/``chip_heal``
+  (device-health annotation), ``drain_toggle`` (maintenance drains).
+  Faults reference a node INDEX into the sorted configured node list, so
+  the trace stays fleet-agnostic until the driver resolves it.
+
+Every event carries a monotonically increasing ``seq`` so ordering is
+total even at equal timestamps; times are rounded to milliseconds so the
+JSON form is stable.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass
+from random import Random
+from typing import Dict, List
+
+SCHEMA_VERSION = 1
+
+# (vc, leaf_type, n_pods, chips, weight, label): BASELINE.json's config
+# ladder as gang shapes — from the single-chip request up to the whole
+# v5p-64 gang with intra-VC preemption (labels name the ladder rung).
+GANG_LADDER = (
+    ("research", "v5e-chip", 1, 1, 3.0, "single-chip"),
+    ("research", "v5e-chip", 1, 2, 2.0, "sub-host"),
+    ("research", "v5e-chip", 1, 4, 3.0, "single-host"),
+    ("research", "v5e-chip", 4, 4, 2.0, "v5e-16-gang"),
+    ("prod", "v5e-chip", 4, 4, 2.0, "v5e-16-gang-prod"),
+    ("research", "v5p-chip", 4, 4, 2.0, "v5p-16-gang"),
+    ("prod", "v5p-chip", 16, 4, 1.0, "v5p-64-gang"),
+)
+
+# Guaranteed arrivals split across two tiers (intra-VC preemption
+# pressure); opportunistic arrivals take OPPORTUNISTIC priority (-1).
+GUARANTEED_PRIORITIES = (0, 0, 0, 5)
+
+FAULT_EVENTS = ("node_flip", "chip_fault", "drain_toggle")
+
+
+@dataclass(frozen=True)
+class TraceShape:
+    """Everything that shapes a trace besides the seed. Immutable and
+    JSON-round-trippable: the trace embeds it, so a trace file is
+    self-describing and the (seed, shape) -> bytes purity is testable."""
+
+    hosts: int = 5184
+    gangs: int = 400
+    duration_s: float = 3600.0
+    pattern: str = "diurnal"  # diurnal | burst | steady
+    diurnal_amplitude: float = 0.8
+    burst_storms: int = 4
+    burst_fraction: float = 0.4
+    opportunistic_fraction: float = 0.3
+    mean_runtime_s: float = 600.0
+    fault_events: int = 30
+
+    def to_dict(self) -> Dict:
+        return {
+            "hosts": self.hosts,
+            "gangs": self.gangs,
+            "durationS": self.duration_s,
+            "pattern": self.pattern,
+            "diurnalAmplitude": self.diurnal_amplitude,
+            "burstStorms": self.burst_storms,
+            "burstFraction": self.burst_fraction,
+            "opportunisticFraction": self.opportunistic_fraction,
+            "meanRuntimeS": self.mean_runtime_s,
+            "faultEvents": self.fault_events,
+        }
+
+    @staticmethod
+    def from_dict(d: Dict) -> "TraceShape":
+        return TraceShape(
+            hosts=int(d.get("hosts", 5184)),
+            gangs=int(d.get("gangs", 400)),
+            duration_s=float(d.get("durationS", 3600.0)),
+            pattern=str(d.get("pattern", "diurnal")),
+            diurnal_amplitude=float(d.get("diurnalAmplitude", 0.8)),
+            burst_storms=int(d.get("burstStorms", 4)),
+            burst_fraction=float(d.get("burstFraction", 0.4)),
+            opportunistic_fraction=float(
+                d.get("opportunisticFraction", 0.3)
+            ),
+            mean_runtime_s=float(d.get("meanRuntimeS", 600.0)),
+            fault_events=int(d.get("faultEvents", 30)),
+        )
+
+
+def _arrival_times(rnd: Random, shape: TraceShape) -> List[float]:
+    """Sorted arrival times over [0, duration) under the shape's pattern.
+    Deterministic: only ``rnd`` supplies randomness."""
+    d = shape.duration_s
+    n = shape.gangs
+    times: List[float] = []
+    if shape.pattern == "diurnal":
+        # Rejection-sample against the day curve
+        # rate(t) = 1 + A*sin(2*pi*(t/d - 0.25)): trough at t=0 ("3am"),
+        # peak mid-trace. Bounded acceptance keeps this exact.
+        a = max(0.0, min(1.0, shape.diurnal_amplitude))
+        while len(times) < n:
+            t = rnd.random() * d
+            rate = 1.0 + a * math.sin(2.0 * math.pi * (t / d - 0.25))
+            if rnd.random() * (1.0 + a) <= rate:
+                times.append(t)
+    elif shape.pattern == "burst":
+        storms = max(1, shape.burst_storms)
+        storm_len = d / (storms * 10.0)  # each storm is 10% of its slot
+        n_burst = int(n * max(0.0, min(1.0, shape.burst_fraction)))
+        starts = [d * (k + 0.45) / storms for k in range(storms)]
+        for i in range(n_burst):
+            s = starts[i % storms]
+            times.append(s + rnd.random() * storm_len)
+        for _ in range(n - n_burst):
+            times.append(rnd.random() * d)
+    else:  # steady
+        for _ in range(n):
+            times.append(rnd.random() * d)
+    times.sort()
+    return times
+
+
+def _pick_weighted(rnd: Random, ladder) -> tuple:
+    total = sum(e[4] for e in ladder)
+    roll = rnd.random() * total
+    acc = 0.0
+    for entry in ladder:
+        acc += entry[4]
+        if roll <= acc:
+            return entry
+    return ladder[-1]
+
+
+def generate_trace(seed: int, shape: TraceShape) -> Dict:
+    """The trace: submit events (gang shape + priority + runtime) and
+    fault events (chaos vocabulary, node-index addressed), sorted by
+    (time, seq). Pure in (seed, shape)."""
+    rnd = Random(seed)
+    events: List[Dict] = []
+    seq = 0
+    for i, t in enumerate(_arrival_times(rnd, shape)):
+        vc, leaf_type, n_pods, chips, _w, label = _pick_weighted(
+            rnd, GANG_LADDER
+        )
+        if rnd.random() < shape.opportunistic_fraction:
+            priority = -1
+        else:
+            priority = GUARANTEED_PRIORITIES[
+                rnd.randrange(len(GUARANTEED_PRIORITIES))
+            ]
+        runtime = rnd.expovariate(1.0 / shape.mean_runtime_s)
+        # Floor: a gang that departs before its own submit processes is
+        # pure churn noise; 1% of the mean keeps the tail shaped.
+        runtime = max(shape.mean_runtime_s * 0.01, runtime)
+        events.append(
+            {
+                "t": round(t, 3),
+                "seq": seq,
+                "kind": "submit",
+                "gang": {
+                    "name": f"g{i}",
+                    "vc": vc,
+                    "leafType": leaf_type,
+                    "pods": n_pods,
+                    "chips": chips,
+                    "priority": priority,
+                    "ladder": label,
+                    "runtimeS": round(runtime, 3),
+                },
+            }
+        )
+        seq += 1
+    # Fault injection: node-index addressed so the trace needs no fleet.
+    flips: List[Dict] = []
+    for _ in range(max(0, shape.fault_events)):
+        t = rnd.random() * shape.duration_s
+        node_index = rnd.randrange(max(1, shape.hosts))
+        kind = FAULT_EVENTS[rnd.randrange(len(FAULT_EVENTS))]
+        ev: Dict = {
+            "t": round(t, 3),
+            "seq": seq,
+            "kind": kind,
+            "nodeIndex": node_index,
+        }
+        if kind == "chip_fault":
+            ev["chip"] = rnd.randrange(4)
+            # Every fault heals later in trace time (chaos vocabulary's
+            # chip_heal), so fleet capacity trends back.
+            heal_t = min(
+                shape.duration_s, t + rnd.random() * shape.duration_s / 4
+            )
+            events.append(ev)
+            seq += 1
+            ev = {
+                "t": round(heal_t, 3),
+                "seq": seq,
+                "kind": "chip_heal",
+                "nodeIndex": node_index,
+                "chip": ev["chip"],
+            }
+        elif kind == "node_flip":
+            flips.append(ev)  # "to" assigned below, in REPLAY order
+        elif kind == "drain_toggle":
+            ev["on"] = rnd.random() < 0.5
+        events.append(ev)
+        seq += 1
+    # Assign node_flip directions per node in REPLAY (time) order, not
+    # generation order: flips alternate down/up starting from down, so a
+    # node is never "healed" before it broke and any odd tail leaves at
+    # most the final down (capacity bleed is bounded to the last flip).
+    by_node: Dict[int, List[Dict]] = {}
+    for ev in flips:
+        by_node.setdefault(ev["nodeIndex"], []).append(ev)
+    for evs in by_node.values():
+        evs.sort(key=lambda e: (e["t"], e["seq"]))
+        for i, ev in enumerate(evs):
+            ev["to"] = "down" if i % 2 == 0 else "up"
+    events.sort(key=lambda e: (e["t"], e["seq"]))
+    return {
+        "version": SCHEMA_VERSION,
+        "seed": seed,
+        "shape": shape.to_dict(),
+        "events": events,
+    }
+
+
+def trace_json(trace: Dict) -> bytes:
+    """Canonical byte form (sorted keys, no whitespace): the unit of the
+    bit-identical-replay guarantee."""
+    return json.dumps(
+        trace, sort_keys=True, separators=(",", ":")
+    ).encode()
+
+
+def load_trace(path: str) -> Dict:
+    with open(path) as f:
+        return json.load(f)

@@ -46,6 +46,7 @@ COUNTERS: Dict[str, tuple] = {
     "healthTransitionCount": ("hived_health_transitions_total", "health transitions applied to the core"),
     "healthDampedCount": ("hived_health_damped_total", "health observations held by the flap damper"),
     "healthSettledCount": ("hived_health_settled_total", "held health transitions later settled"),
+    "nodeEventNoopCount": ("hived_node_event_noops_total", "node update events skipped by the unchanged-projection fast path"),
     "strandedEvictionCount": ("hived_stranded_evictions_total", "pods evicted by stranded-gang remediation"),
     "gangAdmissionBatchedCount": ("hived_gang_admissions_batched_total", "pods admitted through the decode-free gang admission path"),
     "preemptProbeIncrementalCount": ("hived_preempt_probes_incremental_total", "preempt probes served from the epoch-gated victims cache"),

@@ -19,8 +19,18 @@ SMOKE_P50_BUDGET_MS = 150.0
 SMOKE_GANGS = 16
 
 
+def assert_stage_meta(result: dict) -> None:
+    """Artifact hygiene (ISSUE 9 satellite): every stage records fleet
+    size, core count, and its own wall clock under uniform keys so the
+    fleet-scale trend lines are comparable across bench rounds."""
+    assert result["hosts"] > 0, result.get("hosts")
+    assert result["cpu_count"] >= 1
+    assert result["wall_s"] >= 0
+
+
 def test_bench_smoke_p50_and_phase_breakdown():
     result = bench.smoke(n_gangs=SMOKE_GANGS)
+    assert_stage_meta(result)
 
     assert result["gangs_scheduled"] > 0
     assert 0.0 < result["gang_schedule_p50_ms"] < SMOKE_P50_BUDGET_MS, result
@@ -66,6 +76,7 @@ def test_bench_recovery_blackout_smoke():
         cubes=2, slices=2, solos=2, n_gangs=40, reps=1,
         flusher_reps=1, flusher_interval_s=0.2,
     )
+    assert_stage_meta(result)
     assert result["pods_recovered"] > 0
     assert result["full_replay_ms"] > 0
     assert result["snapshot_delta_ms"] > 0
@@ -90,6 +101,7 @@ def test_bench_concurrent_smoke():
     result = bench.bench_concurrent(
         threads=2, gangs_per_thread=10, hosts_per_family=8, block_ms=1
     )
+    assert_stage_meta(result)
     assert result["sharded"]["pods_scheduled"] > 0
     assert (
         result["sharded"]["pods_scheduled"]
@@ -120,6 +132,7 @@ def test_bench_procs_smoke():
     result = bench.bench_procs(
         shard_counts=(2,), families=2, hosts_per_family=8, reps=2,
     )
+    assert_stage_meta(result)
     assert result["hosts"] == 16
     assert result["cpu_count"] >= 1
     assert result["inproc_pods_per_sec"] > 0
@@ -139,9 +152,66 @@ def test_bench_fleet_sweep_smoke():
     result = bench.bench_fleet_sweep(
         sizes=(4, 8), families=2, procs=2, reps=1,
     )
+    assert_stage_meta(result)
     assert set(result["sizes"]) == {"8", "16"}
     for entry in result["sizes"].values():
         assert entry["inproc_pods_per_sec"] > 0
         assert entry["procs_pods_per_sec"] > 0
     assert "single_process_saturation_hosts" in result
+    json.dumps(result)
+
+
+def test_bench_view_slots_ab_smoke():
+    """Tiny run of the HIVED_BENCH_VIEW_SLOTS stage: slots on vs off over
+    the mixed-guaranteed-priority regime. CI boxes are too noisy for a
+    speedup gate (the driver stage at 1728 hosts carries the evidence;
+    doc/hot-path.md records ~10x p50); this guards wiring and that both
+    sides process the identical arrival stream."""
+    result = bench.bench_view_slots_ab(
+        cubes=4, slices=10, solos=4, arrivals=20, reps=1
+    )
+    assert_stage_meta(result)
+    assert result["arrivals"] == 40
+    for side in ("slots_on", "slots_off"):
+        assert result[side]["p50_ms"] > 0
+        assert result[side]["req_per_sec"] > 0
+    assert result["p50_speedup"] > 0
+    json.dumps(result)
+
+
+def test_bench_relist_ab_smoke():
+    """Tiny run of the HIVED_BENCH_RELIST stage: no-change relist cost
+    with the node-event fast path on vs off, plus filter latency under
+    periodic relists. The fast path must actually skip (noop counter) and
+    both measurements must be present; the speedup gate lives in the
+    driver-stage evidence at 1728 hosts."""
+    result = bench.bench_relist_ab(
+        cubes=4, slices=10, solos=4, relists=2, reps=1
+    )
+    assert_stage_meta(result)
+    assert result["relist_ms_fastpath_on"] > 0
+    assert result["relist_ms_fastpath_off"] > 0
+    assert result["node_event_noop_count"] > 0
+    for side in ("filter_under_relist_on", "filter_under_relist_off"):
+        assert result[side]["p50_ms"] > 0
+        assert result[side]["p99_ms"] >= result[side]["p50_ms"]
+    json.dumps(result)
+
+
+def test_bench_sim_smoke():
+    """Smoke-sized variant of the HIVED_BENCH_SIM stage (ISSUE 9
+    CI/tooling satellite): the per-fleet-size trend curve must carry the
+    latency tail AND all three scheduling-quality metrics per size."""
+    result = bench.bench_sim(
+        sizes=(108, 216), gangs_per_432=60, duration_s=600.0
+    )
+    assert_stage_meta(result)
+    assert len(result["trend"]) == 2
+    for entry in result["trend"].values():
+        assert entry["p50_ms"] > 0
+        assert entry["p99_ms"] >= entry["p50_ms"]
+        assert entry["pods_per_sec"] > 0
+        assert 0.0 <= entry["quota_satisfaction"] <= 1.0
+        assert entry["preemption_rate"] >= 0
+        assert entry["largest_free_slice_chips"] > 0
     json.dumps(result)

@@ -172,6 +172,66 @@ def test_chip_badness_survives_node_heal():
 # --------------------------------------------------------------------- #
 
 
+def test_node_event_noop_fast_path():
+    """ISSUE 9: a no-change node update skips the global lock (counted by
+    nodeEventNoopCount), a CHANGED update still applies, a held damper
+    transition disables the skip, and the escape hatch restores the old
+    path exactly."""
+    sched = _booted()
+    name = sorted(sched.nodes)[0]
+    base = sched.get_metrics()
+
+    # No-change re-delivery (what every relist gap repair does): skipped.
+    sched.update_node(_node(name), _node(name))
+    m = sched.get_metrics()
+    assert m["nodeEventNoopCount"] == base["nodeEventNoopCount"] + 1
+    assert m["healthTransitionCount"] == base["healthTransitionCount"]
+
+    # A real change must take the slow path and apply.
+    sched.update_node(_node(name), _node(name, bad_chips=[0]))
+    m2 = sched.get_metrics()
+    assert m2["badChipCount"] == 1
+    assert m2["nodeEventNoopCount"] == m["nodeEventNoopCount"]
+
+    # Unchanged re-delivery of the CURRENT (bad-chip) projection: skipped
+    # again — the comparison is against what was last applied.
+    sched.update_node(_node(name, bad_chips=[0]), _node(name, bad_chips=[0]))
+    assert (
+        sched.get_metrics()["nodeEventNoopCount"]
+        == m["nodeEventNoopCount"] + 1
+    )
+
+    # Escape hatch: with the fast path off, the same no-op event walks
+    # the full (locked) path and the counter stays put.
+    sched.node_event_fastpath = False
+    sched.update_node(_node(name, bad_chips=[0]), _node(name, bad_chips=[0]))
+    assert (
+        sched.get_metrics()["nodeEventNoopCount"]
+        == m["nodeEventNoopCount"] + 1
+    )
+    sched.node_event_fastpath = True
+
+
+def test_node_event_fast_path_defers_to_damper_holds():
+    """While the damper holds ANY transition, no-op skips are disabled —
+    the slow path's settle sweep must keep running so held transitions
+    cannot be starved by a quiet fleet of no-change heartbeats."""
+    sched = _booted(
+        health_flap_threshold=2, health_flap_window=8, health_flap_hold=2
+    )
+    name = sorted(sched.nodes)[0]
+    # Two quick flips within the window: the damper holds the second.
+    sched.update_node(_node(name), _node(name, ready=False))
+    sched.update_node(_node(name, ready=False), _node(name))
+    if sched.health_pending_count() == 0:
+        # Damping knobs off in this config: nothing to assert here.
+        return
+    before = sched.get_metrics()["nodeEventNoopCount"]
+    other = sorted(sched.nodes)[1]
+    sched.update_node(_node(other), _node(other))
+    assert sched.get_metrics()["nodeEventNoopCount"] == before
+
+
 def test_single_transitions_apply_immediately():
     sched = _booted()
     sched.update_node(_node("s0-w0"), _node("s0-w0", ready=False))

@@ -1,0 +1,167 @@
+"""Tier-1 wiring of the warehouse-scale sim tier (ISSUE 9).
+
+Three contracts:
+
+1. **Trace purity** — generation is a pure function of (seed, shape):
+   byte-identical across runs, across processes, and across
+   ``HIVED_PROC_SHARDS`` settings (the env must not leak into traces).
+2. **Replay determinism** — the placement-relevant slice of a report
+   (binds, preemptions, fragmentation, quota satisfaction) is identical
+   when the same trace replays; only wall-clock latencies may vary.
+3. **End-to-end at scale** — a compressed 5k-host diurnal trace runs
+   through the REAL scheduler inside the tier-1 budget and emits every
+   metric family the tier exists for (tail latency, fragmentation,
+   preemption rate, quota satisfaction).
+"""
+
+import json
+import logging
+import os
+import subprocess
+import sys
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.sim.driver import build_fleet_config, run_trace
+from hivedscheduler_tpu.sim.report import placement_fingerprint
+from hivedscheduler_tpu.sim.trace import (
+    TraceShape,
+    generate_trace,
+    trace_json,
+)
+
+common.init_logging(logging.CRITICAL)
+
+SMALL_SHAPE = TraceShape(
+    hosts=216, gangs=40, duration_s=900.0, fault_events=8
+)
+
+
+def test_trace_generation_is_pure():
+    a = trace_json(generate_trace(7, SMALL_SHAPE))
+    b = trace_json(generate_trace(7, SMALL_SHAPE))
+    assert a == b
+    assert a != trace_json(generate_trace(8, SMALL_SHAPE))
+    assert a != trace_json(
+        generate_trace(
+            7, TraceShape(hosts=216, gangs=41, duration_s=900.0)
+        )
+    )
+    # Env must not leak into generation — HIVED_PROC_SHARDS least of all
+    # (the satellite contract: identical traces under any shard setting).
+    saved = os.environ.get("HIVED_PROC_SHARDS")
+    try:
+        os.environ["HIVED_PROC_SHARDS"] = "3"
+        assert trace_json(generate_trace(7, SMALL_SHAPE)) == a
+    finally:
+        if saved is None:
+            os.environ.pop("HIVED_PROC_SHARDS", None)
+        else:
+            os.environ["HIVED_PROC_SHARDS"] = saved
+
+
+def test_trace_bytes_identical_across_processes():
+    """Same (seed, shape) in a FRESH interpreter with HIVED_PROC_SHARDS
+    set: the bytes must match this process's — hash randomization, env,
+    and import order must all be irrelevant."""
+    local = trace_json(generate_trace(3, SMALL_SHAPE))
+    code = (
+        "from hivedscheduler_tpu.sim.trace import *;"
+        "import sys;"
+        "sys.stdout.buffer.write("
+        "trace_json(generate_trace(3, TraceShape("
+        "hosts=216, gangs=40, duration_s=900.0, fault_events=8))))"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        env={**os.environ, "HIVED_PROC_SHARDS": "2",
+             "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-500:]
+    assert proc.stdout == local
+
+
+def test_trace_events_shape():
+    trace = generate_trace(5, SMALL_SHAPE)
+    assert trace["version"] == 1
+    assert trace["shape"]["hosts"] == 216
+    kinds = {e["kind"] for e in trace["events"]}
+    assert "submit" in kinds
+    # The chaos fault vocabulary is present.
+    assert kinds & {"node_flip", "chip_fault", "drain_toggle"}
+    ts = [(e["t"], e["seq"]) for e in trace["events"]]
+    assert ts == sorted(ts), "events not in (t, seq) order"
+    gangs = [e["gang"] for e in trace["events"] if e["kind"] == "submit"]
+    assert len(gangs) == SMALL_SHAPE.gangs
+    # The ladder mixes gang sizes and both priorities classes.
+    assert len({g["ladder"] for g in gangs}) >= 3
+    assert {p for g in gangs for p in [g["priority"]]} & {-1}
+    assert {p for g in gangs for p in [g["priority"]]} & {0, 5}
+
+
+def test_replay_placement_deterministic():
+    trace = generate_trace(11, SMALL_SHAPE)
+    a = run_trace(trace, mode="inproc")
+    b = run_trace(trace, mode="inproc")
+    assert placement_fingerprint(a) == placement_fingerprint(b)
+    assert a["counts"]["boundGangs"] > 0
+
+
+def test_shards_mode_runs_the_same_trace():
+    """The procShards frontend replays the same trace with the same gang
+    admission outcome (light load, no preemption: placement-found-iff is
+    exact). Local transport keeps the smoke cheap; the proc transport is
+    covered by test_proc_shards' own differential suite."""
+    shape = TraceShape(
+        hosts=216, gangs=16, duration_s=600.0, fault_events=0,
+        opportunistic_fraction=0.0,
+    )
+    trace = generate_trace(2, shape)
+    inproc = run_trace(trace, mode="inproc")
+    shards = run_trace(
+        trace, mode="shards", n_shards=2, transport="local"
+    )
+    assert inproc["counts"]["boundGangs"] == (
+        shards["counts"]["boundGangs"]
+    )
+    assert inproc["quotaSatisfaction"]["fraction"] == (
+        shards["quotaSatisfaction"]["fraction"]
+    )
+
+
+def test_sim_5k_host_trace_end_to_end():
+    """The acceptance-shaped smoke: a compressed 5k-host diurnal trace
+    through the real scheduler, all four metric families emitted. Gang
+    count is compressed (the 10k/800-gang acceptance run is the CLI's
+    job, doc/hot-path.md 'Warehouse-scale profile'); the fleet is not."""
+    shape = TraceShape(
+        hosts=5184, gangs=60, duration_s=1200.0, fault_events=10
+    )
+    trace = generate_trace(0, shape)
+    report = run_trace(trace, mode="inproc")
+    assert report["hosts"] == 5184
+    assert report["latency"]["samples"] > 0
+    assert report["latency"]["p50Ms"] > 0
+    assert report["latency"]["p99Ms"] >= report["latency"]["p50Ms"]
+    q = report["quotaSatisfaction"]
+    assert 0.0 <= q["fraction"] <= 1.0
+    assert q["submittedGuaranteed"] > 0
+    p = report["preemption"]
+    assert p["events"] >= 0 and p["ratePerBoundGuaranteed"] >= 0
+    frag = report["fragmentation"]
+    assert frag is not None and frag["samples"] > 0
+    assert frag["endFreeChips"] > 0
+    assert frag["largestFreeSliceChips"] > 0
+    assert report["counts"]["boundGangs"] > 0
+    assert report["counts"]["faultsApplied"] > 0
+    json.dumps(report)
+
+
+def test_build_fleet_config_hits_host_targets():
+    for target, lo, hi in (
+        (432, 432, 432), (5184, 5100, 5300), (10368, 10200, 10500),
+    ):
+        _cfg, hosts = build_fleet_config(target)
+        assert lo <= hosts <= hi, (target, hosts)
