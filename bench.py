@@ -1049,6 +1049,94 @@ def bench_sim(
     }, max(int(h) for h in curve) if curve else 0, t0)
 
 
+def bench_defrag(
+    hosts: int = 120,
+    gangs: int = 500,
+    seed: int = 11,
+    duration_s: float = 3600.0,
+    frag_samples: int = 16,
+) -> dict:
+    """Defragmenter A/B (HIVED_BENCH_DEFRAG=1; ISSUE 10): replay one
+    long-running churn trace through the sim tier twice at the IDENTICAL
+    seed — defragmenter off, then on (checkpoint-coordinated migrations
+    executed at every fragmentation sample point) — and report the
+    schedulable-slice-size distribution both ways. The acceptance
+    quantity is the time-averaged largest free slice (bigger is better)
+    and the count of stranded sub-host/sub-slice fragments (fewer is
+    better); the stage asserts defrag never makes the distribution
+    worse."""
+    from hivedscheduler_tpu.sim.driver import run_trace
+    from hivedscheduler_tpu.sim.trace import TraceShape, generate_trace
+
+    t0 = time.perf_counter()
+    shape = TraceShape(
+        hosts=hosts,
+        gangs=gangs,
+        duration_s=duration_s,
+        pattern="steady",
+        mean_runtime_s=350.0,
+        fault_events=8,
+        opportunistic_fraction=0.35,
+    )
+    trace = generate_trace(seed, shape)
+    reports = {
+        tag: run_trace(
+            trace, defrag=(tag == "on"), frag_samples=frag_samples
+        )
+        for tag in ("off", "on")
+    }
+
+    def dist(report: dict) -> dict:
+        frag = report["fragmentation"] or {}
+        series = frag.get("largestFreeSliceSeries") or [0]
+        samples = frag.get("series") or []
+        sub_host = [
+            sum(v for k, v in s["freeSlices"].items() if int(k) < 4)
+            for s in samples
+        ] or [0]
+        sub_slice = [
+            sum(v for k, v in s["freeSlices"].items() if int(k) < 16)
+            for s in samples
+        ] or [0]
+        return {
+            "largest_free_slice_avg": round(sum(series) / len(series), 2),
+            "largest_free_slice_end": series[-1],
+            "sub_host_fragments_avg": round(
+                sum(sub_host) / len(sub_host), 2
+            ),
+            "sub_slice_fragments_avg": round(
+                sum(sub_slice) / len(sub_slice), 2
+            ),
+            "end_free_slices": frag.get("endFreeSlices", {}),
+            "bound_gangs": report["counts"]["boundGangs"],
+            "queue_wait_p50_s": report["quotaSatisfaction"][
+                "queueWaitP50S"
+            ],
+        }
+
+    off, on = dist(reports["off"]), dist(reports["on"])
+    gain = round(
+        on["largest_free_slice_avg"] - off["largest_free_slice_avg"], 2
+    )
+    migrations = reports["on"]["counts"]["defragMigrations"]
+    # The A/B gate: at identical seed, defrag must never shrink the
+    # schedulable-slice distribution (and improves it whenever its
+    # migrations fire — the 60-second smoke asserts structure only).
+    assert on["largest_free_slice_avg"] >= off["largest_free_slice_avg"], (
+        off, on,
+    )
+    return _stage_meta({
+        "seed": seed,
+        "gangs": gangs,
+        "pattern": "steady",
+        "off": off,
+        "on": on,
+        "largest_free_slice_gain": gain,
+        "proposals": reports["on"]["counts"]["defragProposals"],
+        "migrations": migrations,
+    }, hosts, t0)
+
+
 class _SnapshotKubeClient(NullKubeClient):
     """NullKubeClient + an in-memory snapshot ConfigMap family, for the
     recovery-blackout stage (the flusher needs somewhere to persist)."""
@@ -1441,6 +1529,20 @@ if __name__ == "__main__":
             )
         )
         sys.exit(0)
+    if os.environ.get("HIVED_BENCH_DEFRAG") == "1":
+        result = bench_defrag()
+        print(
+            json.dumps(
+                {
+                    "metric": "defrag_largest_free_slice_gain",
+                    "value": result["largest_free_slice_gain"],
+                    "unit": "chips",
+                    "vs_baseline": result["largest_free_slice_gain"],
+                    "extra": result,
+                }
+            )
+        )
+        sys.exit(0)
     if os.environ.get("HIVED_BENCH_VIEW_SLOTS") == "1":
         run(n_gangs=24)  # warm-up
         result = bench_view_slots_ab()
@@ -1595,6 +1697,7 @@ if __name__ == "__main__":
     view_slots_ab = bench_view_slots_ab()
     relist_ab = bench_relist_ab()
     sim_stage = bench_sim()
+    defrag_stage = bench_defrag()
     perf = model_perf()
     print(
         json.dumps(
@@ -1616,6 +1719,7 @@ if __name__ == "__main__":
                     "view_slots_ab": view_slots_ab,
                     "relist_ab": relist_ab,
                     "sim": sim_stage,
+                    "defrag": defrag_stage,
                     "model_perf": perf,
                 },
             }

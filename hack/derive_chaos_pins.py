@@ -1,0 +1,137 @@
+"""Re-derive the pinned chaos sensitivity seeds (tests/test_chaos.py).
+
+The pinned seed sets go stale whenever the harness event mix changes:
+every schedule's rng stream shifts, so the schedules that used to
+exercise a given fault window no longer do. This script re-runs each
+sensitivity meta-test's BROKEN variant over a seed range and prints the
+first seeds whose schedules catch the breakage — exactly the derivation
+the meta-tests pin.
+
+    JAX_PLATFORMS=cpu python hack/derive_chaos_pins.py [N_SEEDS] [PER_SET]
+"""
+
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from hivedscheduler_tpu import common
+
+common.init_logging(logging.CRITICAL)
+
+from hivedscheduler_tpu.scheduler import health  # noqa: E402
+from hivedscheduler_tpu.scheduler.framework import HivedScheduler  # noqa: E402
+from hivedscheduler_tpu.algorithm.core import HivedCore  # noqa: E402
+
+from tests import chaos  # noqa: E402
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 300
+PER_SET = int(sys.argv[2]) if len(sys.argv) > 2 else 6
+
+
+def derive(name, patches, want_exc=Exception):
+    # Save via the class __dict__ so staticmethod/classmethod wrappers
+    # restore intact (getattr would unwrap them and corrupt later runs).
+    saved = [(obj, attr, obj.__dict__[attr]) for obj, attr, _ in patches]
+    for obj, attr, value in patches:
+        setattr(obj, attr, value)
+    found = []
+    try:
+        for seed in range(N):
+            try:
+                chaos.run_chaos_schedule(seed)
+            except want_exc:
+                found.append(seed)
+                if len(found) >= PER_SET:
+                    break
+            except Exception:  # noqa: BLE001 — wrong exception class
+                pass
+    finally:
+        for obj, attr, value in saved:
+            setattr(obj, attr, value)
+    print(f"{name} = {tuple(found)}")
+    return found
+
+
+def main():
+    # 1. Re-broken recover(): raise instead of quarantining.
+    def raise_through(self, pod, error):
+        raise error
+
+    derive(
+        "CORRUPTION_RESTART_SEEDS",
+        [(HivedScheduler, "_quarantine_pod", raise_through)],
+    )
+
+    # 2. Re-broken Reserving/Reserved recovery.
+    derive(
+        "RESERVING_RECOVERY_SEEDS",
+        [(HivedScheduler, "_recover_preempting_pods",
+          lambda self, pods: None)],
+    )
+
+    # 3. Bypassed cross-chain global order (caught by require_global).
+    def bypassed_update_node(self, old, new):
+        self._enter_mutation()
+        try:
+            first_chain = self._locks.all_keys[:1]
+            with self._locks.section(first_chain):
+                self.nodes[new.name] = new
+                self._observe_node_health(new)
+        finally:
+            self._exit_mutation()
+
+    derive(
+        "GLOBAL_ORDER_SEEDS",
+        [(HivedScheduler, "update_node", bypassed_update_node)],
+        want_exc=RuntimeError,
+    )
+
+    # 4. Disabled flap damping.
+    def passthrough(self, target, desired, clock):
+        rec = self._records.get(target)
+        if rec is None:
+            self._records[target] = health._TargetRecord(desired)
+            return True
+        if desired == rec.applied:
+            rec.pending = None
+            return False
+        rec.applied = desired
+        return True
+
+    derive(
+        "DAMPING_DISABLED_SEEDS",
+        [(health.FlapDamper, "observe", passthrough)],
+    )
+
+    # 5. No-op'd snapshot delta replay.
+    def noop_drop(self):
+        self._snapshot_pending.clear()
+        self._snapshot_claims.clear()
+
+    derive(
+        "SNAPSHOT_DELTA_SEEDS",
+        [
+            (HivedScheduler, "_drop_vanished_snapshot_pods", noop_drop),
+            (HivedScheduler, "_release_pending_snapshot_imports_locked",
+             noop_drop),
+            (HivedScheduler, "_snapshot_pod_fingerprint",
+             staticmethod(lambda pod: ())),
+            (HivedScheduler, "_snapshot_claims_conflict",
+             lambda self, pod: False),
+        ],
+    )
+
+    # 6. No-op'd shrink replay (elastic gang plane, ISSUE 10): resize
+    # records are ignored — a recovered scheduler replays the stale full
+    # placement and diverges from the continuous shrunken gang.
+    derive(
+        "SHRINK_REPLAY_SEEDS",
+        [(HivedCore, "apply_resize",
+          lambda self, g, s, info, pod=None, record_event=True: [])],
+    )
+
+
+if __name__ == "__main__":
+    main()

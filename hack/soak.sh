@@ -19,6 +19,12 @@
 # custom mix can be passed directly: HIVED_CHAOS_MIX="health:3" hack/soak.sh
 # (see tests/chaos.py event_weights for the knob grammar).
 #
+# Elastic focus: --elastic weights the elastic-gang family up (gang_shrink
+# / gang_grow / defrag_migrate via the "elastic" alias, plus the health
+# events that strand gangs), so a soak hammers shrink-instead-of-evict,
+# mixed-generation crash recovery, and checkpoint-coordinated defrag
+# migrations specifically: hack/soak.sh --elastic
+#
 # Failover focus: --failover weights the HA / snapshot recovery family up
 # (snapshot flushes, snapshot corruption/staleness, lease failovers incl.
 # lease-loss-mid-bind) via the "ha" alias of HIVED_CHAOS_MIX, so a soak
@@ -52,6 +58,14 @@ if [[ "${1:-}" == "--trace" ]]; then
   # No exec: the EXIT trap must still fire to clean up the trace file.
   python hack/sim_server.py --trace "$tmp" --hosts "$hosts" "$@"
   exit $?
+fi
+
+if [[ "${1:-}" == "--elastic" ]]; then
+  shift
+  # Weight the elastic-gang family (and the stranding health events) up;
+  # the preset goes FIRST so caller-supplied entries can still override.
+  export HIVED_CHAOS_MIX="elastic:3,health:1.5${HIVED_CHAOS_MIX:+,${HIVED_CHAOS_MIX}}"
+  echo "chaos soak: elastic focus (HIVED_CHAOS_MIX=${HIVED_CHAOS_MIX})"
 fi
 
 if [[ "${1:-}" == "--failover" ]]; then

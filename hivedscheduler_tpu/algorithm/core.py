@@ -596,6 +596,16 @@ class HivedCore:
         # Guarded by _counter_lock — chains mutate them concurrently.
         self.gang_admission_batched_count = 0
         self.preempt_probe_incremental_count = 0
+        # Elastic gang plane (doc/fault-model.md "Elastic gang plane").
+        # resize_events records every applied shrink/grow (the framework
+        # drains it to bump metrics and re-sync surviving pods' stale
+        # annotations); resize_orphans collects replayed pods whose
+        # placement a NEWER generation already shrank away (the framework
+        # re-queues their eviction). Both are drained at mutator exit.
+        self.resize_events: List[Dict] = []
+        self.resize_orphans: List[Pod] = []
+        self.gang_shrink_count = 0
+        self.gang_grow_count = 0
         # Guaranteed schedules that succeeded only after retrying the
         # intra-VC placement past a failed virtual→physical mapping
         # (chip-granular dooming fix; doc/fault-model.md).
@@ -1535,13 +1545,8 @@ class HivedCore:
     @staticmethod
     def _export_group_record(g: AffinityGroup) -> Dict:
         return {
-            "spec": {
-                "name": g.name,
-                "members": [
-                    {"podNumber": p, "leafCellNumber": n}
-                    for n, p in sorted(g.total_pod_nums.items())
-                ],
-            },
+            "spec": g.spec_dict(),
+            "resizeGeneration": g.resize_generation,
             "vc": str(g.vc),
             "lazyPreemptionEnable": bool(g.lazy_preemption_enable),
             "priority": g.priority,
@@ -1631,6 +1636,7 @@ class HivedCore:
             )
             g.ignore_k8s_suggested_nodes = bool(rec["ignoreSuggested"])
             g.lazy_preemption_status = rec["lazyPreemptionStatus"]
+            g.resize_generation = int(rec.get("resizeGeneration", 0))
             g.physical_placement = {
                 int(n): [
                     [
@@ -1831,17 +1837,28 @@ class HivedCore:
         wait_reason = ""
         pod_index = 0
 
+        grow_generation: Optional[int] = None
         g = self.affinity_groups.get(s.affinity_group.name)
         if g is not None:
-            group_physical, group_virtual, victims, pod_index = (
-                self._schedule_pod_from_existing_group(g, s, suggested, phase, pod)
+            (
+                group_physical, group_virtual, victims, pod_index,
+                grow_generation,
+            ) = self._schedule_pod_from_existing_group(
+                g, s, suggested, phase, pod
             )
+        if grow_generation == -1:
+            # Elastic grow attempted but no capacity: wait, don't reject.
+            wait_reason = (
+                f"affinity group {s.affinity_group.name} is at capacity; "
+                "waiting for free cells to grow into"
+            )
+            grow_generation = None
         # The group may have been a preempting group deleted just above.
         if self.affinity_groups.get(s.affinity_group.name) is None:
             group_physical, group_virtual, victims, wait_reason = (
                 self._schedule_pod_from_new_group(s, suggested, phase, pod)
             )
-        return generate_pod_schedule_result(
+        result = generate_pod_schedule_result(
             group_physical,
             group_virtual,
             victims,
@@ -1849,11 +1866,20 @@ class HivedCore:
             self.cell_types,
             s.leaf_cell_number,
             pod_index,
-            self.affinity_groups.get(s.affinity_group.name),
+            # A grow placement is PROSPECTIVE (existing rows + the new
+            # pod's row): the group's memoized bind info must neither
+            # serve nor cache it — the group only reshapes when the bind
+            # confirm replays the generated record through apply_resize.
+            None
+            if grow_generation is not None
+            else self.affinity_groups.get(s.affinity_group.name),
             s.affinity_group.name,
             pod,
             self.preempt_rng,
         )
+        if grow_generation is not None and result.pod_bind_info is not None:
+            result.pod_bind_info.resize_generation = grow_generation
+        return result
 
     def _schedule_pod_from_existing_group(
         self,
@@ -1867,12 +1893,17 @@ class HivedCore:
         Optional[Placement],
         Optional[Dict[str, Dict[str, Pod]]],
         int,
+        Optional[int],
     ]:
-        """(reference: hived_algorithm.go:658-714)"""
+        """(reference: hived_algorithm.go:658-714; the fifth element is
+        the elastic-grow generation — non-None when the returned
+        placement is the PROSPECTIVE grown gang, doc/fault-model.md
+        "Elastic gang plane")"""
         group_physical: Optional[Placement] = None
         group_virtual: Optional[Placement] = None
         victims: Optional[Dict[str, Dict[str, Pod]]] = None
         pod_index = 0
+        grow_generation: Optional[int] = None
         bad_or_non_suggested = collect_bad_or_non_suggested_nodes(
             g.physical_placement, suggested, g.ignore_k8s_suggested_nodes
         )
@@ -1901,11 +1932,20 @@ class HivedCore:
                 g.allocated_pods.get(s.leaf_cell_number, [])
             )
             if pod_index == -1:
-                raise api.bad_request(
-                    f"Requesting more pods than the configured number for "
-                    f"{s.leaf_cell_number} leaf cells "
-                    f"({g.total_pod_nums.get(s.leaf_cell_number, 0)} pods) in "
-                    f"affinity group {s.affinity_group.name}"
+                grown = self._try_schedule_group_grow(g, s, suggested, pod)
+                if grown is None:
+                    raise api.bad_request(
+                        f"Requesting more pods than the configured number "
+                        f"for {s.leaf_cell_number} leaf cells "
+                        f"({g.total_pod_nums.get(s.leaf_cell_number, 0)} "
+                        f"pods) in affinity group {s.affinity_group.name}"
+                    )
+                if grown == "wait":
+                    # Growable, but no free capacity right now: wait (a
+                    # fixed-size gang would be a hard 400 instead).
+                    return None, None, None, 0, -1
+                group_physical, group_virtual, pod_index, grow_generation = (
+                    grown
                 )
         else:  # GroupState.PREEMPTING
             common.log.info(
@@ -1939,7 +1979,77 @@ class HivedCore:
                         "preemptor affinity group %s", g.name,
                     )
                 g.preempting_pods[pod.uid] = pod
-        return group_physical, group_virtual, victims, pod_index
+        return group_physical, group_virtual, victims, pod_index, grow_generation
+
+    def _try_schedule_group_grow(
+        self,
+        g: AffinityGroup,
+        s: api.PodSchedulingSpec,
+        suggested: Set[str],
+        pod: Pod,
+    ):
+        """Elastic grow (doc/fault-model.md "Elastic gang plane"): an
+        OPPORTUNISTIC gang with maxMembers headroom admits one more pod
+        into idle capacity on its own chain. Returns None when the group
+        is not growable (fixed size / guaranteed / at its ceiling),
+        ``"wait"`` when growable but currently out of capacity, else the
+        prospective (physical, virtual, pod_index, generation) for the
+        GROWN gang — applied only when the bind confirm replays the
+        generated record through apply_resize."""
+        max_members = max(
+            g.max_members, getattr(s.affinity_group, "max_members", 0)
+        )
+        if (
+            max_members <= g.total_pods
+            or g.state != GroupState.ALLOCATED
+            # Grow rides the opportunistic allocation path only: it must
+            # never consume guaranteed VC quota behind the safety checks.
+            or g.virtual_placement is not None
+            or s.priority >= MIN_GUARANTEED_PRIORITY
+            or s.leaf_cell_number <= 0
+        ):
+            return None
+        chain = group_chain(g)
+        if chain is None:
+            return None
+        # A gang with a LOST placement row (reconfiguration hole) cannot
+        # grow: the prospective record is generated with group=None (the
+        # memoized bind info must not serve or cache it), which has no
+        # group to recover missing placements from — fall back to the
+        # fixed-size rejection rather than a 500 mid-generate.
+        for rows in g.physical_placement.values():
+            for row in rows:
+                if any(leaf is None for leaf in row):
+                    return None
+        rec = self._decision_rec()
+        placement, failed_reason = self.opportunistic_schedulers[
+            chain
+        ].schedule(
+            {s.leaf_cell_number: 1},
+            OPPORTUNISTIC_PRIORITY,
+            suggested,
+            s.ignore_k8s_suggested_nodes,
+        )
+        if placement is None:
+            if rec is not None:
+                rec.note(
+                    f"elastic grow of {g.name} found no capacity: "
+                    f"{failed_reason}"
+                )
+            return "wait"
+        new_row = placement[s.leaf_cell_number][0]
+        group_physical: Placement = {
+            n: list(rows) for n, rows in g.physical_placement.items()
+        }
+        group_physical.setdefault(s.leaf_cell_number, []).append(new_row)
+        pod_index = len(group_physical[s.leaf_cell_number]) - 1
+        if rec is not None:
+            rec.note(
+                f"elastic grow: {g.name} {g.total_pods} -> "
+                f"{g.total_pods + 1} pods (generation "
+                f"{g.resize_generation + 1})"
+            )
+        return group_physical, None, pod_index, g.resize_generation + 1
 
     def _collect_victims_cached(
         self, g: AffinityGroup
@@ -2363,6 +2473,15 @@ class HivedCore:
         if g is not None:
             if g.state == GroupState.PREEMPTING:
                 self._allocate_preempting_affinity_group(g, pod)
+            elif (
+                g.state == GroupState.ALLOCATED
+                and info.resize_generation > g.resize_generation
+            ):
+                # The pod carries a NEWER generation of the group's bind
+                # info (elastic shrink/grow landed on its annotations, or
+                # this is a grow pod's batched admission): reshape the
+                # group to the new record before slotting the pod.
+                self.apply_resize(g, s, info, pod)
         else:
             self._create_allocated_affinity_group(s, info, pod)
         # The slot index ALWAYS comes from the pod's placement position in
@@ -2375,10 +2494,30 @@ class HivedCore:
         # call that generated the bind info selected this pod's placement
         # by exactly that index, so re-deriving it per pod is an O(gang)
         # scan that made gang admission O(gang²) in aggregate.
+        group = self.affinity_groups[s.affinity_group.name]
         if given_pod_index is not None:
             pod_index = given_pod_index
-        else:
+        elif info.resize_generation == group.resize_generation:
             pod_index = get_allocated_pod_index(info, s.leaf_cell_number)
+        else:
+            # STALE-generation replay (mid-resize crash: this pod's
+            # annotations predate a shrink/grow another pod's newer record
+            # already applied). Its own placement never moves across
+            # resizes, so locate its slot by physical coordinates instead
+            # of by position in the stale record.
+            pod_index = self._stale_generation_pod_index(group, s, info)
+            if pod_index == -1:
+                # Shrunk away: a newer generation dropped this member and
+                # released its cells — the pod was mid-eviction when we
+                # crashed. Surface it for the framework to re-evict.
+                common.log.warning(
+                    "[%s]: pod's placement was shrunk out of group %s "
+                    "(generation %d < %d); queueing for re-eviction",
+                    pod.key, group.name, info.resize_generation,
+                    group.resize_generation,
+                )
+                self.resize_orphans.append(pod)
+                return
         if pod_index == -1:
             common.log.error(
                 "[%s]: Pod placement not found in group %s: node %s, leaf "
@@ -2386,7 +2525,6 @@ class HivedCore:
                 info.leaf_cell_isolation,
             )
             return
-        group = self.affinity_groups[s.affinity_group.name]
         group.allocated_pods[s.leaf_cell_number][pod_index] = pod
         # Pod-slot change: chain-visible (the victims caches list these
         # pods) but touches no cell — bump the chain epoch explicitly.
@@ -2418,7 +2556,18 @@ class HivedCore:
                 pod.key, s.affinity_group.name,
             )
             return
-        pod_index = get_allocated_pod_index(info, s.leaf_cell_number)
+        if info.resize_generation == g.resize_generation:
+            pod_index = get_allocated_pod_index(info, s.leaf_cell_number)
+        else:
+            pod_index = self._stale_generation_pod_index(g, s, info)
+            if pod_index == -1:
+                # The pod was shrunk out of the group already (its cells
+                # are released); its delete is the eviction completing.
+                common.log.info(
+                    "[%s]: deleting a pod already shrunk out of group %s",
+                    pod.key, g.name,
+                )
+                return
         if pod_index == -1:
             common.log.error(
                 "[%s]: Pod placement not found in group %s: node %s, leaf "
@@ -2433,6 +2582,387 @@ class HivedCore:
             self.bump_chain_epoch(chain)
         if all_pods_released(g.allocated_pods):
             self._delete_allocated_affinity_group(g, pod)
+
+    # -- elastic resize (doc/fault-model.md "Elastic gang plane") -----------
+
+    @staticmethod
+    def _placement_row_key(leaf_num: int, row: List[Optional[Cell]]):
+        """Identity of one pod's placement row: (node, leaf_num, sorted
+        chip indices). None when the row carries no cells (lost
+        placements after reconfiguration never match)."""
+        leaves = [c for c in row if c is not None]
+        if not leaves:
+            return None
+        return (
+            leaves[0].nodes[0],
+            leaf_num,
+            tuple(sorted(c.leaf_cell_indices[0] for c in leaves)),
+        )
+
+    def _stale_generation_pod_index(
+        self, g: AffinityGroup, s: api.PodSchedulingSpec, info: api.PodBindInfo
+    ) -> int:
+        """Slot of a pod whose bind info is from another resize generation
+        than its group. A pod's OWN placement never moves across resizes,
+        so its physical coordinates identify its row; -1 means the row was
+        shrunk out of the group (its cells are already released)."""
+        if not info.leaf_cell_isolation:
+            return -1
+        p_leaf = find_physical_leaf_cell(
+            self.full_cell_list, info.cell_chain, info.node,
+            info.leaf_cell_isolation[0],
+        )
+        if p_leaf is None:
+            return -1
+        coords = g.find_leaf_coords(p_leaf.address)
+        if coords is None or coords[0] != s.leaf_cell_number:
+            return -1
+        return coords[1]
+
+    def export_group_bind_info(
+        self, g: AffinityGroup
+    ) -> Tuple[List[api.AffinityGroupMemberBindInfo], str]:
+        """Regenerate the group-level bind-info record from the LIVE
+        placements, as fresh objects (never the group's memoized record —
+        resize callers filter/extend the result in place)."""
+        leaf_num0 = next(iter(sorted(g.physical_placement)))
+        member_info, _node, _idx, chain = generate_affinity_group_bind_info(
+            g.physical_placement,
+            g.virtual_placement,
+            self.cell_types,
+            leaf_num0,
+            0,
+            None,
+            g.name,
+        )
+        return member_info, chain
+
+    def _release_placement_row(
+        self, g: AffinityGroup, row: List[Optional[Cell]]
+    ) -> None:
+        """Release one pod row's cells — the per-row slice of
+        _delete_allocated_affinity_group."""
+        for leaf in row:
+            if leaf is None:
+                continue
+            assert isinstance(leaf, PhysicalCell)
+            leaf.delete_using_group(g)
+            if leaf.state == CellState.USED:
+                self._release_leaf_cell(
+                    leaf, g.vc, opportunistic=g.virtual_placement is None
+                )
+                set_cell_state(leaf, CellState.FREE)
+            else:  # RESERVING: already allocated to a preemptor
+                set_cell_state(leaf, CellState.RESERVED)
+
+    def _allocate_resize_row(
+        self,
+        g: AffinityGroup,
+        s: api.PodSchedulingSpec,
+        chain: CellChain,
+        leaf_num: int,
+        node: str,
+        indices: List[int],
+        types: List[api.CellType],
+        pod: Optional[Pod],
+    ) -> Tuple[List[Optional[Cell]], List[Optional[Cell]]]:
+        """Allocate the cells of one NEW pod row (grow) — the per-row
+        slice of _create_allocated_affinity_group's replay loop."""
+        prow: List[Optional[Cell]] = [None] * leaf_num
+        vrow: List[Optional[Cell]] = [None] * leaf_num
+        ref_pod = pod if pod is not None else Pod(name=g.name, uid=g.name)
+        for leaf_index in range(leaf_num):
+            p_leaf, v_leaf, _lazy = self._find_allocated_leaf_cell(
+                leaf_index, indices, types, chain, node, False, s, g, ref_pod
+            )
+            if p_leaf is None:
+                continue
+            prow[leaf_index] = p_leaf
+            if v_leaf is not None:
+                vrow[leaf_index] = v_leaf
+            safety_ok, reason = self._allocate_leaf_cell(
+                p_leaf, v_leaf, s.priority, g.vc
+            )
+            p_leaf.add_using_group(g)
+            set_cell_state(p_leaf, CellState.USED)
+            if not safety_ok:
+                common.log.warning("[%s]: %s", ref_pod.key, reason)
+        return prow, vrow
+
+    def apply_resize(
+        self,
+        g: AffinityGroup,
+        s: api.PodSchedulingSpec,
+        info: api.PodBindInfo,
+        pod: Optional[Pod] = None,
+        record_event: bool = True,
+    ) -> List[Pod]:
+        """Reshape an ALLOCATED group to a newer-generation group-level
+        bind info: rows present in both generations carry their cells and
+        pod objects over untouched; rows only in the OLD placement are
+        released (shrink); rows only in the NEW record are allocated
+        fresh (grow). Returns the pods of dropped rows (the members the
+        shrink evicts). The one mutation path where placements move, so
+        every placement-derived cache is invalidated at the end."""
+        if g.state != GroupState.ALLOCATED:
+            common.log.error(
+                "group %s: resize requested in state %s; ignored",
+                g.name, g.state.value,
+            )
+            return []
+        try:
+            return self._apply_resize(g, s, info, pod, record_event)
+        finally:
+            # Releases may defer doomed-shortfall re-checks (same contract
+            # as add_allocated_pod's wrapper).
+            self._flush_pending_doomed_checks()
+
+    def _apply_resize(
+        self,
+        g: AffinityGroup,
+        s: api.PodSchedulingSpec,
+        info: api.PodBindInfo,
+        pod: Optional[Pod],
+        record_event: bool,
+    ) -> List[Pod]:
+        chain = info.cell_chain or group_chain(g)
+        old_total = g.total_pods
+        # Index the old rows by placement identity.
+        old_index: Dict[Tuple, Tuple[int, int]] = {}
+        for leaf_num, pod_rows in g.physical_placement.items():
+            for pi, row in enumerate(pod_rows):
+                key = self._placement_row_key(leaf_num, row)
+                if key is not None:
+                    old_index[key] = (leaf_num, pi)
+        matched: set = set()
+        new_phys: Placement = {}
+        new_virt: Optional[Placement] = (
+            {} if g.virtual_placement is not None else None
+        )
+        new_pods: Dict[int, List[Optional[Pod]]] = {}
+        for gms in info.affinity_group_bind_info:
+            if not gms.pod_placements:
+                continue
+            leaf_num = max(
+                len(pp.physical_leaf_cell_indices)
+                for pp in gms.pod_placements
+            )
+            phys_rows = new_phys.setdefault(leaf_num, [])
+            virt_rows = (
+                new_virt.setdefault(leaf_num, [])
+                if new_virt is not None
+                else None
+            )
+            pod_slots = new_pods.setdefault(leaf_num, [])
+            for pp in gms.pod_placements:
+                key = (
+                    pp.physical_node,
+                    leaf_num,
+                    tuple(sorted(pp.physical_leaf_cell_indices)),
+                )
+                coords = old_index.get(key)
+                if coords is None or coords in matched:
+                    # Relaxed match for rows with LOST placements: an old
+                    # row that dropped a leaf after reconfiguration keys
+                    # on its surviving indices only, while the regenerated
+                    # record recovers the full set from other pods'
+                    # annotations — same node + an index subset is the
+                    # same row, and re-allocating it would double-count
+                    # its still-USED cells.
+                    new_set = set(pp.physical_leaf_cell_indices)
+                    for okey, ocoords in old_index.items():
+                        if (
+                            ocoords not in matched
+                            and okey[0] == pp.physical_node
+                            and okey[1] == leaf_num
+                            and set(okey[2]) <= new_set
+                        ):
+                            coords = ocoords
+                            break
+                if coords is not None and coords not in matched:
+                    matched.add(coords)
+                    on, oi = coords
+                    phys_rows.append(g.physical_placement[on][oi])
+                    if virt_rows is not None:
+                        virt_rows.append(g.virtual_placement[on][oi])
+                    pod_slots.append(g.allocated_pods[on][oi])
+                else:
+                    prow, vrow = self._allocate_resize_row(
+                        g, s, chain, leaf_num, pp.physical_node,
+                        list(pp.physical_leaf_cell_indices),
+                        list(pp.preassigned_cell_types), pod,
+                    )
+                    phys_rows.append(prow)
+                    if virt_rows is not None:
+                        virt_rows.append(vrow)
+                    pod_slots.append(None)
+        # Release every old row the new record no longer names.
+        dropped_pods: List[Pod] = []
+        for leaf_num, pod_rows in g.physical_placement.items():
+            for pi, row in enumerate(pod_rows):
+                if (leaf_num, pi) in matched:
+                    continue
+                old_pod = g.allocated_pods.get(leaf_num, [])
+                if pi < len(old_pod) and old_pod[pi] is not None:
+                    dropped_pods.append(old_pod[pi])
+                self._release_placement_row(g, row)
+        g.physical_placement = new_phys
+        g.virtual_placement = new_virt
+        g.allocated_pods = new_pods
+        g.total_pod_nums = {n: len(rows) for n, rows in new_phys.items()}
+        g.resize_generation = info.resize_generation
+        ag = s.affinity_group
+        if ag is not None:
+            g.min_members = getattr(ag, "min_members", g.min_members)
+            g.max_members = getattr(ag, "max_members", g.max_members)
+        g.invalidate_placement_caches()
+        if chain is not None and chain in self.chain_epochs:
+            self.bump_chain_epoch(chain)
+        new_total = g.total_pods
+        kind = "shrink" if new_total < old_total else "grow"
+        with self._counter_lock:
+            if kind == "shrink":
+                self.gang_shrink_count += 1
+            else:
+                self.gang_grow_count += 1
+        if record_event:
+            self.resize_events.append(
+                {
+                    "group": g.name,
+                    "kind": kind,
+                    "generation": g.resize_generation,
+                    "fromPods": old_total,
+                    "toPods": new_total,
+                }
+            )
+            # Replay path: an attached pod whose row the newer record
+            # dropped was mid-eviction when we crashed — surface it so
+            # the framework re-evicts (the live shrink path evicts its
+            # dropped pods itself, record_event=False).
+            self.resize_orphans.extend(dropped_pods)
+        common.log.warning(
+            "group %s resized (%s): %d -> %d pods, generation %d",
+            g.name, kind, old_total, new_total, g.resize_generation,
+        )
+        if not new_phys:
+            # Degenerate record (shrunk to nothing): the group is gone.
+            del self.affinity_groups[g.name]
+        return dropped_pods
+
+    def take_resize_events(self) -> List[Dict]:
+        events, self.resize_events = self.resize_events, []
+        return events
+
+    def take_resize_orphans(self) -> List[Pod]:
+        orphans, self.resize_orphans = self.resize_orphans, []
+        return orphans
+
+    # -- defragmentation (compaction candidates) ----------------------------
+
+    def compaction_candidates(self, limit: int = 4) -> List[Dict]:
+        """Buddy-mergeable fragments: split parent cells whose free
+        children would merge back into a whole free cell if ONE resident
+        ALLOCATED gang (fully contained in the subtree) moved, with
+        enough free chips elsewhere in the chain to re-home it. Pure
+        read over the free lists + placements; callers needing a
+        consistent view hold the global order. Proposals are ordered
+        opportunistic-first then smallest-blast-radius (the migration
+        preference order, mirroring stranded remediation)."""
+        by_group: Dict[str, Dict] = {}
+        for chain in sorted(self.full_cell_list):
+            ccl = self.full_cell_list[chain]
+            leaf_num = self.compiled.cell_level_to_leaf_num[chain]
+            free_chips_total = sum(
+                len(cells) * leaf_num[level]
+                for level, cells in self.free_cell_list[chain].levels.items()
+            )
+            # Top-down: a gang fully inside a split slice is also fully
+            # inside its split host — keep only the HIGHEST-gain fragment
+            # per gang (merging the big parent implies the small one).
+            for level in range(ccl.top_level, LOWEST_LEVEL, -1):
+                for parent in ccl[level]:
+                    assert isinstance(parent, PhysicalCell)
+                    if not parent.split or not parent.healthy:
+                        continue
+                    cand = self._fragment_candidate(
+                        parent, chain, leaf_num, free_chips_total
+                    )
+                    if cand is not None and (
+                        cand["group"] not in by_group
+                        or by_group[cand["group"]]["gainChips"]
+                        < cand["gainChips"]
+                    ):
+                        by_group[cand["group"]] = cand
+        proposals = list(by_group.values())
+        proposals.sort(
+            key=lambda p: (
+                0 if p["opportunistic"] else 1,
+                p["blastPods"],
+                -p["gainChips"],
+                p["group"],
+            )
+        )
+        return proposals[:limit]
+
+    def _fragment_candidate(
+        self,
+        parent: PhysicalCell,
+        chain: CellChain,
+        leaf_num: Dict[CellLevel, int],
+        free_chips_total: int,
+    ) -> Optional[Dict]:
+        free_chips_inside = 0
+        groups: List[AffinityGroup] = []
+        stack: List[PhysicalCell] = [parent]
+        while stack:
+            c = stack.pop()
+            if in_free_cell_list(c):
+                free_chips_inside += leaf_num[c.level]
+                continue
+            if not c.children:
+                if c.state == CellState.USED and c.using_group is not None:
+                    if all(c.using_group is not g for g in groups):
+                        groups.append(c.using_group)
+                elif c.state != CellState.FREE:
+                    return None  # reservations: leave preemptors alone
+                continue
+            for child in c.children:
+                assert isinstance(child, PhysicalCell)
+                stack.append(child)
+        if len(groups) != 1 or free_chips_inside == 0:
+            return None
+        g = groups[0]
+        if g.state != GroupState.ALLOCATED:
+            return None
+        # The gang must live entirely inside the fragment — moving it out
+        # then frees the whole parent — and the rest of the chain must
+        # have room for it.
+        nodes_inside = set(parent.nodes)
+        gang_chips = 0
+        blast_pods = 0
+        for n, rows in g.physical_placement.items():
+            for row in rows:
+                for leaf in row:
+                    if leaf is None:
+                        continue
+                    if leaf.nodes[0] not in nodes_inside:
+                        return None
+                    gang_chips += 1
+            blast_pods += len(rows)
+        free_chips_outside = free_chips_total - free_chips_inside
+        if free_chips_outside < gang_chips:
+            return None
+        return {
+            "chain": str(chain),
+            "fragment": parent.address,
+            "gainChips": leaf_num[parent.level],
+            "group": g.name,
+            "vc": str(g.vc),
+            "opportunistic": g.virtual_placement is None,
+            "blastPods": blast_pods,
+            "gangChips": gang_chips,
+            "avoidNodes": sorted(nodes_inside),
+        }
 
     # -- group lifecycle ----------------------------------------------------
 
@@ -2452,11 +2982,38 @@ class HivedCore:
             s.priority,
             GroupState.ALLOCATED,
         )
+        new_group.resize_generation = info.resize_generation
         should_lazy_preempt = False
         for gms in info.affinity_group_bind_info:
             if not gms.pod_placements:
                 continue
             leaf_cell_number = len(gms.pod_placements[0].physical_leaf_cell_indices)
+            # The bind info is the durable truth of an allocated gang: a
+            # resized gang's record can carry MORE rows than a stale spec
+            # annotation declares (e.g. a grow pod whose spec re-sync
+            # never landed). Size the matrices to the record, or the fill
+            # below would crash mid-allocation and leak the placed rows.
+            extra = len(gms.pod_placements) - len(
+                new_group.physical_placement.setdefault(
+                    leaf_cell_number,
+                    [],
+                )
+            )
+            if extra > 0:
+                for target in (
+                    new_group.physical_placement,
+                    new_group.virtual_placement,
+                ):
+                    if target is not None:
+                        target.setdefault(leaf_cell_number, []).extend(
+                            [None] * leaf_cell_number for _ in range(extra)
+                        )
+                new_group.allocated_pods.setdefault(
+                    leaf_cell_number, []
+                ).extend([None] * extra)
+                new_group.total_pod_nums[leaf_cell_number] = len(
+                    gms.pod_placements
+                )
             for pod_index, pp in enumerate(gms.pod_placements):
                 node = pp.physical_node
                 for leaf_index in range(len(pp.physical_leaf_cell_indices)):

@@ -60,6 +60,14 @@ class AffinityGroup:
         # forever on victims the default scheduler will never preempt.
         self.ignore_k8s_suggested_nodes = False
         self.priority = priority
+        # Elastic gang plane (doc/fault-model.md "Elastic gang plane"):
+        # total-pod-count bounds copied off the spec (0 = inelastic /
+        # fixed), and the monotone resize generation matching the
+        # resizeGeneration of the group-level bind info the placement was
+        # built from. Bumped by every applied shrink/grow.
+        self.min_members = getattr(spec, "min_members", 0)
+        self.max_members = getattr(spec, "max_members", 0)
+        self.resize_generation = 0
         # leaf_cell_num -> pod count
         self.total_pod_nums: Dict[int, int] = {}
         for m in spec.members:
@@ -107,6 +115,46 @@ class AffinityGroup:
         # so the index only needs rebuilding when it misses an address.
         self._leaf_coords: Optional[Dict[str, Tuple[int, int, int]]] = None
 
+    @property
+    def total_pods(self) -> int:
+        return sum(self.total_pod_nums.values())
+
+    def spec_dict(
+        self, total_pod_nums: Optional[Dict[int, int]] = None
+    ) -> Dict[str, Any]:
+        """The gang's AffinityGroupSpec as a wire dict — the ONE place
+        the (name, members, elastic bounds) serialization lives: snapshot
+        group records, shrink-plan survivor patches, and resize re-syncs
+        all consume it, and they must never disagree. ``total_pod_nums``
+        overrides the member counts (a shrink plan serializes the POST-
+        shrink shape before the matrices change)."""
+        counts = (
+            total_pod_nums
+            if total_pod_nums is not None
+            else self.total_pod_nums
+        )
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "members": [
+                {"podNumber": p, "leafCellNumber": n}
+                for n, p in sorted(counts.items())
+            ],
+        }
+        if self.min_members:
+            d["minMembers"] = self.min_members
+        if self.max_members:
+            d["maxMembers"] = self.max_members
+        return d
+
+    def invalidate_placement_caches(self) -> None:
+        """Drop every cache derived from the placement matrices. Resize
+        (shrink/grow) is the one path where placements MOVE after
+        assignment, so the lazily-built coordinate index and the memoized
+        group bind info both go stale at once."""
+        self._leaf_coords = None
+        self.bind_info_cache = None
+        self.victims_cache = None
+
     def find_leaf_coords(self, address: str) -> Optional[Tuple[int, int, int]]:
         """O(1) lookup of a physical leaf's position inside the group's
         placement — the indexed replacement for the O(placement) scan the
@@ -133,6 +181,9 @@ class AffinityGroup:
                 "vc": self.vc,
                 "priority": self.priority,
                 "state": self.state.value,
+                "minMembers": self.min_members,
+                "maxMembers": self.max_members,
+                "resizeGeneration": self.resize_generation,
                 "lazyPreemptionStatus": self.lazy_preemption_status,
                 "physicalPlacement": physical_placement_to_node_indices(
                     self.physical_placement

@@ -56,6 +56,23 @@ class Config:
     # when False (default) they are only surfaced (/v1/inspect/health,
     # strandedGroupCount).
     stranded_gang_eviction: bool = False
+    # Elastic gang plane (doc/fault-model.md "Elastic gang plane"). When
+    # stranded remediation is armed (stranded_gang_eviction) and a stranded
+    # gang declares a minMembers bound, elastic_gang_shrink releases exactly
+    # the stranded members' cells (annotation rewrite + targeted eviction)
+    # instead of deleting the whole gang. True by default: shrink is
+    # strictly less destructive than the eviction it replaces, and it only
+    # ever applies to gangs that opted in via minMembers.
+    elastic_gang_shrink: bool = True
+    # Background defragmenter (off by default): every
+    # defrag_interval_ticks health ticks, scan the buddy free lists for
+    # mergeable fragments and propose checkpoint-coordinated migrations of
+    # the blocking gangs, at most defrag_max_migrations_per_cycle per
+    # cycle (the rate limit; migrations are advisory until the workload
+    # controller completes the drain handshake).
+    defrag_enable: bool = False
+    defrag_interval_ticks: int = 8
+    defrag_max_migrations_per_cycle: int = 1
     # Wall-clock settling floor for the flap damper (doc/fault-model.md
     # "Hardware health plane"): when > 0, a held transition whose target
     # stayed quiet for this many wall-clock seconds settles even without
@@ -112,6 +129,8 @@ class Config:
         lease_d = d.get("leaseDurationSeconds")
         lease_r = d.get("leaseRenewSeconds")
         procs = d.get("procShards")
+        defrag_t = d.get("defragIntervalTicks")
+        defrag_m = d.get("defragMaxMigrationsPerCycle")
         c = Config(
             kube_apiserver_address=d.get("kubeApiServerAddress"),
             kube_config_file_path=d.get("kubeConfigFilePath"),
@@ -130,6 +149,12 @@ class Config:
                 0.0 if flap_hs is None else float(flap_hs)
             ),
             stranded_gang_eviction=bool(d.get("strandedGangEviction", False)),
+            elastic_gang_shrink=bool(d.get("elasticGangShrink", True)),
+            defrag_enable=bool(d.get("defragEnable", False)),
+            defrag_interval_ticks=8 if defrag_t is None else int(defrag_t),
+            defrag_max_migrations_per_cycle=(
+                1 if defrag_m is None else int(defrag_m)
+            ),
             decision_journal_capacity=(
                 512 if dj_cap is None else int(dj_cap)
             ),

@@ -58,6 +58,16 @@ ANNOTATION_NODE_DEVICE_HEALTH = GROUP_NAME + "/device-health"
 # deleted. Never damped — drains are deliberate operator actions.
 ANNOTATION_NODE_DRAIN = GROUP_NAME + "/drain"
 
+# Pod annotation (elastic gang plane, doc/fault-model.md): the
+# defragmenter's drain handshake. Written onto every pod of a gang the
+# defragmenter proposes to migrate (JSON: proposal generation, the
+# fragment being compacted, the nodes the re-placement must avoid). The
+# workload controller checkpoints, deletes, and resubmits the gang; the
+# re-filtered placement compacts the buddy hierarchy. Cleared when a
+# proposal is cancelled. Advisory end to end — a gang that never reacts
+# simply keeps its cells.
+ANNOTATION_POD_DEFRAG_MIGRATION = GROUP_NAME + "/defrag-migration"
+
 # The scheduler-owned ConfigMap persisting the advisory doomed-bad-cell
 # ledger (which bad cell each VC's unsatisfiable quota is pinned to), so a
 # restart reconstructs the same advisory bindings instead of re-deriving

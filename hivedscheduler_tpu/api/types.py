@@ -212,22 +212,72 @@ class AffinityGroupMemberSpec:
 @dataclass
 class AffinityGroupSpec:
     """The gang: a named set of members, each ``pod_number`` pods wanting
-    ``leaf_cell_number`` chips (reference: api/types.go:90-94)."""
+    ``leaf_cell_number`` chips (reference: api/types.go:90-94).
+
+    Elastic bounds (doc/fault-model.md "Elastic gang plane"): ``minMembers``
+    is the total-pod-count floor the gang may SHRINK to when its hardware
+    degrades (0 = inelastic: the gang is evicted whole, the pre-elastic
+    behavior); ``maxMembers`` is the ceiling an opportunistic gang may GROW
+    to when idle capacity frees (0 = fixed size). Both count pods across
+    all members, and both are optional — absent keys keep the spec
+    wire-compatible with GPU-era HiveD configs."""
 
     name: str = ""
     members: List[AffinityGroupMemberSpec] = field(default_factory=list)
+    min_members: int = 0
+    max_members: int = 0
+
+    @property
+    def total_members(self) -> int:
+        return sum(m.pod_number for m in self.members)
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "AffinityGroupSpec":
-        return AffinityGroupSpec(
+        spec = AffinityGroupSpec(
             name=str(d.get("name", "") or ""),
             members=[
                 AffinityGroupMemberSpec.from_dict(m) for m in (d.get("members") or [])
             ],
+            min_members=int(d.get("minMembers", 0) or 0),
+            max_members=int(d.get("maxMembers", 0) or 0),
         )
+        spec.validate_bounds()
+        return spec
+
+    def validate_bounds(self) -> None:
+        """Reject malformed elastic bounds (user error, HTTP 400). Absent
+        (zero) bounds are always legal — the inelastic default."""
+        total = self.total_members
+        if self.min_members < 0:
+            raise bad_request(
+                f"affinityGroup {self.name}: minMembers must be >= 0 "
+                f"(0 = inelastic), got {self.min_members}"
+            )
+        if self.min_members:
+            if self.min_members > total:
+                raise bad_request(
+                    f"affinityGroup {self.name}: minMembers "
+                    f"({self.min_members}) exceeds the declared member "
+                    f"count ({total})"
+                )
+        if self.max_members:
+            if self.max_members < total:
+                raise bad_request(
+                    f"affinityGroup {self.name}: maxMembers "
+                    f"({self.max_members}) is below the declared member "
+                    f"count ({total})"
+                )
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"name": self.name, "members": [m.to_dict() for m in self.members]}
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "members": [m.to_dict() for m in self.members],
+        }
+        if self.min_members:
+            d["minMembers"] = self.min_members
+        if self.max_members:
+            d["maxMembers"] = self.max_members
+        return d
 
 
 @dataclass
@@ -343,6 +393,13 @@ class PodBindInfo:
     affinity_group_bind_info: List[AffinityGroupMemberBindInfo] = field(
         default_factory=list
     )
+    # Elastic gang plane (doc/fault-model.md): monotone per-group resize
+    # generation. Every shrink/grow rewrites the group-level record and
+    # bumps it; recovery replay reconciles pods carrying different
+    # generations of the same group deterministically (newest wins). 0 =
+    # never resized — the key is omitted on the wire, so pre-elastic bind
+    # infos round-trip untouched.
+    resize_generation: int = 0
 
     @staticmethod
     def from_dict(d: Dict[str, Any]) -> "PodBindInfo":
@@ -354,10 +411,11 @@ class PodBindInfo:
                 AffinityGroupMemberBindInfo.from_dict(m)
                 for m in (d.get("affinityGroupBindInfo") or [])
             ],
+            resize_generation=int(d.get("resizeGeneration", 0) or 0),
         )
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d: Dict[str, Any] = {
             "node": self.node,
             "leafCellIsolation": list(self.leaf_cell_isolation),
             "cellChain": self.cell_chain,
@@ -365,6 +423,9 @@ class PodBindInfo:
                 m.to_dict() for m in self.affinity_group_bind_info
             ],
         }
+        if self.resize_generation:
+            d["resizeGeneration"] = self.resize_generation
+        return d
 
 
 ###############################################################################

@@ -1,0 +1,273 @@
+"""The background defragmenter: checkpoint-coordinated buddy compaction.
+
+Long-running clusters fragment: gangs arrive and depart, and the buddy
+hierarchy is left with split parents whose free children cannot merge
+because one small resident gang squats in the subtree. HiveD resolves
+this only by chance (a squatter happens to finish). This controller
+closes the loop deliberately (ROADMAP new-direction 3;
+doc/fault-model.md "Elastic gang plane"):
+
+1. **Scan** — every ``defragIntervalTicks`` health-clock ticks (the same
+   event clock flap damping uses, so chaos schedules replay
+   deterministically), ask the core for compaction candidates: split
+   parent cells one fully-contained ALLOCATED gang away from merging,
+   with room elsewhere in the chain to re-home that gang
+   (``HivedCore.compaction_candidates``).
+2. **Re-filter probe** — before proposing, verify a compacting placement
+   actually exists: probe the opportunistic scheduler for the gang's
+   exact shape with the fragment's nodes excluded. No placement → no
+   proposal (the fragment is surfaced but nobody is disturbed).
+3. **Drain handshake** — annotate every pod of the gang with
+   ``ANNOTATION_POD_DEFRAG_MIGRATION`` (proposal generation + the nodes
+   to avoid) and queue the proposal. The workload controller (or the sim
+   tier / chaos harness standing in for it) checkpoints the job, deletes
+   the pods, and resubmits them; the scheduler then re-filters them onto
+   the compacting placement. The queued proposal is the advisory
+   reservation of the target region.
+4. **Cancel on fail** — if the re-filter after deletion finds no
+   placement, the driver reports the failure (``report_migration``) and
+   the proposal is released: annotations cleared, the gang resubmitted
+   wherever it fits, ``defragCancelCount`` bumped.
+
+Buddy fragmentation is created by GUARANTEED allocations (opportunistic
+usage allocates *through* the free lists without splitting them), so the
+gangs worth migrating are usually guaranteed — which is exactly why the
+handshake is checkpoint-coordinated and advisory: nothing is deleted by
+the scheduler; the workload controller owns the restart. Rate limits:
+at most ``defragMaxMigrationsPerCycle`` proposals per cycle, one
+in-flight proposal per gang, and the whole plane is OFF by default
+(``defragEnable``).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+from .. import common
+from ..api import constants, types as api
+from ..algorithm.cell import OPPORTUNISTIC_PRIORITY
+
+
+class DefragController:
+    """One per scheduler; every method suffixed ``_locked`` expects the
+    scheduler's global order held (they read core placements and free
+    lists). Proposal hand-off (``take_proposals``/``report_migration``)
+    is called lock-free by drivers."""
+
+    # Cycles a cancelled gang sits out before it may be re-proposed (a
+    # failed re-filter means the fleet has no room right now; immediate
+    # re-proposal would spin the handshake annotations).
+    CANCEL_COOLDOWN_CYCLES = 4
+
+    def __init__(self, sched) -> None:
+        self.sched = sched
+        self._seq = itertools.count(1)
+        self._last_cycle_tick = 0
+        self._cycle_n = 0
+        # group -> cycle number before which it must not be re-proposed.
+        self._cooldown: Dict[str, int] = {}
+        # group name -> live proposal (annotations written, migration not
+        # yet resolved). One proposal per gang, ever, until resolved.
+        self._inflight: Dict[str, Dict] = {}
+        # Proposals awaiting a driver (take_proposals drains).
+        self._pending: List[Dict] = []
+        # Annotation writes queued for the next side-effect flush:
+        # (pod, {key: value-or-None}).
+        self._pending_patches: List = []
+
+    # ------------------------------------------------------------------ #
+    # The cycle (scheduler lock held)
+    # ------------------------------------------------------------------ #
+
+    def tick_locked(self, clock: int) -> None:
+        interval = max(1, self.sched.config.defrag_interval_ticks)
+        if clock - self._last_cycle_tick < interval:
+            return
+        self._last_cycle_tick = clock
+        self.run_cycle_locked()
+
+    def run_cycle_locked(self) -> int:
+        core = self.sched.core
+        self._cycle_n += 1
+        limit = max(1, self.sched.config.defrag_max_migrations_per_cycle)
+        # Drop in-flight entries whose gang died (migrated or departed) —
+        # their annotations died with the pods — and expired cooldowns.
+        for name in [
+            n for n in self._inflight if n not in core.affinity_groups
+        ]:
+            del self._inflight[name]
+        for name in [
+            n for n, until in self._cooldown.items()
+            if until < self._cycle_n or n not in core.affinity_groups
+        ]:
+            del self._cooldown[name]
+        proposed = 0
+        for cand in core.compaction_candidates(limit=4 * limit):
+            if proposed >= limit:
+                break
+            name = cand["group"]
+            if name in self._inflight or name in self._cooldown:
+                continue
+            g = core.affinity_groups.get(name)
+            if g is None:
+                continue
+            if not self._refilter_probe_locked(g, cand):
+                continue
+            proposal = {
+                "generation": next(self._seq),
+                "group": name,
+                "vc": cand["vc"],
+                "chain": cand["chain"],
+                "fragment": cand["fragment"],
+                "gainChips": cand["gainChips"],
+                "gangChips": cand["gangChips"],
+                "avoidNodes": cand["avoidNodes"],
+                "blastPods": cand["blastPods"],
+            }
+            self._inflight[name] = proposal
+            self._pending.append(proposal)
+            proposed += 1
+            self.sched.metrics.observe_defrag_proposal()
+            self._journal(name, "defrag-propose", (
+                f"fragment {cand['fragment']} ({cand['gainChips']} chips) "
+                f"mergeable if {name} ({cand['gangChips']} chips, "
+                f"{cand['blastPods']} pod(s)) migrates; re-filter probe "
+                "found a compacting placement"
+            ))
+            value = common.to_json(
+                {
+                    "generation": proposal["generation"],
+                    "fragment": proposal["fragment"],
+                    "avoidNodes": proposal["avoidNodes"],
+                }
+            )
+            for rows in g.allocated_pods.values():
+                for p in rows:
+                    if p is not None:
+                        self._pending_patches.append(
+                            (p, {
+                                constants.ANNOTATION_POD_DEFRAG_MIGRATION:
+                                value,
+                            })
+                        )
+        return proposed
+
+    def _refilter_probe_locked(self, g, cand: Dict) -> bool:
+        """Would the gang fit OUTSIDE its fragment right now? Pure probe
+        of the opportunistic scheduler with the fragment's nodes excluded
+        from the suggested set (the 're-filter onto the compacting
+        placement', run make-before-break)."""
+        core = self.sched.core
+        chain = cand["chain"]
+        sched = core.opportunistic_schedulers.get(chain)
+        if sched is None:
+            return False
+        avoid = set(cand["avoidNodes"])
+        suggested = {
+            n for n in core.configured_node_names() if n not in avoid
+        }
+        placement, _reason = sched.schedule(
+            dict(g.total_pod_nums),
+            OPPORTUNISTIC_PRIORITY,
+            suggested,
+            False,  # honor the suggested set: that IS the compaction
+        )
+        return placement is not None
+
+    # ------------------------------------------------------------------ #
+    # Driver hand-off (no scheduler locks)
+    # ------------------------------------------------------------------ #
+
+    def take_proposals(self) -> List[Dict]:
+        pending, self._pending = self._pending, []
+        return pending
+
+    def report_migration(self, group: str, ok: bool, reason: str = "") -> None:
+        """The driver's resolution of one proposal: ``ok`` means the gang
+        was checkpointed, deleted, and re-filtered onto a compacting
+        placement; failure cancels the proposal and releases its advisory
+        reservation."""
+        proposal = self._inflight.pop(group, None)
+        if proposal is None:
+            return
+        if ok:
+            self.sched.metrics.observe_defrag_migration()
+            self._journal(group, "defrag-migrate", (
+                f"gang migrated off fragment {proposal['fragment']} "
+                f"(generation {proposal['generation']})"
+            ))
+        else:
+            self._cooldown[group] = (
+                self._cycle_n + self.CANCEL_COOLDOWN_CYCLES
+            )
+            self.sched.metrics.observe_defrag_cancel()
+            self._journal(group, "defrag-cancel", (
+                f"migration cancelled, reservation released: "
+                f"{reason or 'no compacting placement at re-filter'}"
+            ))
+            # Clear the handshake annotation on any survivor pods (a
+            # cancelled gang that was never deleted keeps running).
+            g = self.sched.core.affinity_groups.get(group)
+            if g is not None:
+                for rows in g.allocated_pods.values():
+                    for p in rows:
+                        if p is not None:
+                            self._pending_patches.append(
+                                (p, {
+                                    constants
+                                    .ANNOTATION_POD_DEFRAG_MIGRATION: None,
+                                })
+                            )
+
+    def flush_patches(self) -> None:
+        """Write the queued handshake annotations (called from the
+        scheduler's side-effect flush, outside every lock). Advisory:
+        failures log and drop — the proposal itself rides in memory and
+        the sim/chaos drivers consume it via take_proposals."""
+        patches, self._pending_patches = self._pending_patches, []
+        for pod, ann in patches:
+            try:
+                self.sched.kube_client.patch_pod_annotations(pod, ann)
+                for k, v in ann.items():
+                    if v is None:
+                        pod.annotations.pop(k, None)
+                    else:
+                        pod.annotations[k] = v
+            except Exception as e:  # noqa: BLE001
+                common.log.warning(
+                    "[%s]: defrag handshake annotation patch failed "
+                    "(advisory): %s", pod.key, e,
+                )
+
+    # ------------------------------------------------------------------ #
+    # Observability
+    # ------------------------------------------------------------------ #
+
+    def snapshot_locked(self) -> Dict:
+        return {
+            "enabled": True,
+            "intervalTicks": self.sched.config.defrag_interval_ticks,
+            "maxMigrationsPerCycle": (
+                self.sched.config.defrag_max_migrations_per_cycle
+            ),
+            "inFlight": {
+                name: {
+                    k: v for k, v in p.items() if k != "avoidNodes"
+                }
+                for name, p in sorted(self._inflight.items())
+            },
+            "pendingProposals": len(self._pending),
+        }
+
+    def _journal(self, group: str, verdict: str, note: str) -> None:
+        rec = self.sched.decisions.begin(
+            f"group/{group}", f"group:{group}", "defrag"
+        )
+        rec.group = group
+        rec.verdict = verdict
+        rec.note(note)
+        self.sched.decisions.commit(rec)
+
+
+__all__ = ["DefragController"]

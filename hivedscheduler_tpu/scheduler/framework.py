@@ -37,6 +37,7 @@ from . import health as health_mod
 from . import snapshot as snapshot_mod
 from . import tracing
 from .decisions import DecisionJournal
+from .defrag import DefragController
 from .locks import ChainShardedLock
 from .tracing import LatencyHistogram
 from .types import (
@@ -176,6 +177,17 @@ class SchedulerMetrics:
         self.node_event_noop_count = 0
         self.ledger_coalesced_count = 0
         self.stranded_eviction_count = 0
+        # Elastic gang plane (doc/fault-model.md "Elastic gang plane"):
+        # gangs shrunk in place instead of evicted, shrinks aborted
+        # (survivor annotation patch failed and was rolled back),
+        # opportunistic gangs grown into idle capacity, and the
+        # defragmenter's proposal/migration/cancel counts.
+        self.gang_shrink_count = 0
+        self.gang_shrink_abort_count = 0
+        self.gang_grow_count = 0
+        self.defrag_proposal_count = 0
+        self.defrag_migration_count = 0
+        self.defrag_cancel_count = 0
         # HA / snapshot recovery plane (doc/fault-model.md "HA and snapshot
         # recovery plane"): snapshot ConfigMap writes (and failures),
         # recoveries that fell back from a present-but-unusable snapshot to
@@ -294,6 +306,30 @@ class SchedulerMetrics:
         with self._lock:
             self.stranded_eviction_count += 1
 
+    def observe_gang_shrink(self) -> None:
+        with self._lock:
+            self.gang_shrink_count += 1
+
+    def observe_gang_shrink_abort(self) -> None:
+        with self._lock:
+            self.gang_shrink_abort_count += 1
+
+    def observe_gang_grow(self) -> None:
+        with self._lock:
+            self.gang_grow_count += 1
+
+    def observe_defrag_proposal(self) -> None:
+        with self._lock:
+            self.defrag_proposal_count += 1
+
+    def observe_defrag_migration(self) -> None:
+        with self._lock:
+            self.defrag_migration_count += 1
+
+    def observe_defrag_cancel(self) -> None:
+        with self._lock:
+            self.defrag_cancel_count += 1
+
     def observe_snapshot_persist(self, ok: bool) -> None:
         with self._lock:
             if ok:
@@ -354,6 +390,12 @@ class SchedulerMetrics:
                 ),
                 "snapshotFallbackCount": self.snapshot_fallback_count,
                 "deposedBindRefusedCount": self.deposed_bind_refused_count,
+                "gangShrinkCount": self.gang_shrink_count,
+                "gangShrinkAbortCount": self.gang_shrink_abort_count,
+                "gangGrowCount": self.gang_grow_count,
+                "defragProposalCount": self.defrag_proposal_count,
+                "defragMigrationCount": self.defrag_migration_count,
+                "defragCancelCount": self.defrag_cancel_count,
                 "phases": self.phase_stats.snapshot(),
                 "latencyHistograms": {
                     "filter": self.hist_filter.snapshot(),
@@ -497,6 +539,25 @@ class HivedScheduler:
         self._evicted_groups: set = set()
         self._evicted_pod_uids: set = set()
         self._pending_evictions: List = []
+        # Elastic gang plane (doc/fault-model.md "Elastic gang plane"):
+        # shrink plans queued by stranded remediation (flushed — survivor
+        # annotation patches first, then the core reshape, then the
+        # dropped members' evictions — at mutator exit, outside every
+        # lock), and the groups with a plan in flight (never two plans
+        # for one gang).
+        self._pending_shrinks: List[Dict] = []
+        self._shrink_in_flight: set = set()
+        # True once a resize-related annotation write FAILED (shrink
+        # rollback or stale-generation re-sync): the one window where
+        # live pods legitimately carry bind-info generations that differ
+        # from their group's (the chaos harness treats a crash inside it
+        # as degraded instead of asserting strict equivalence).
+        self._resize_write_failed = False
+        # Background defragmenter (scheduler.defrag), armed by the
+        # defragEnable knob; ticks on the health event clock.
+        self.defrag = (
+            DefragController(self) if config.defrag_enable else None
+        )
         # Set when an eviction write failed: the next mutator-exit flush
         # re-runs the stranded check so the retry does not have to wait
         # for another health transition (which may never come on a quiet
@@ -756,11 +817,24 @@ class HivedScheduler:
         just placed. Dropping is safe — every queued write is advisory."""
         if not self.is_leader():
             with self._side_effect_lock:
-                dropped = len(self._pending_annotation_clears) + len(
-                    self._pending_evictions
+                dropped = (
+                    len(self._pending_annotation_clears)
+                    + len(self._pending_evictions)
+                    + len(self._pending_shrinks)
                 )
                 self._pending_annotation_clears = []
                 self._pending_evictions = []
+                self._shrink_in_flight -= {
+                    p["group"] for p in self._pending_shrinks
+                }
+                self._pending_shrinks = []
+            # Drain (and drop) the core's resize plumbing too: a standby
+            # mirroring the leader replays every resize through
+            # apply_resize, and without a drain the event/orphan lists
+            # grow unboundedly, then fire as a burst of stale side
+            # effects at promotion.
+            self.core.take_resize_events()
+            self.core.take_resize_orphans()
             if dropped and not self._deposed_flush_logged:
                 self._deposed_flush_logged = True
                 common.log.warning(
@@ -770,14 +844,21 @@ class HivedScheduler:
             return
         self._deposed_flush_logged = False
         self._flush_annotation_clears()
+        self._flush_shrinks()
+        self._drain_resize_side_effects()
+        if self.defrag is not None:
+            self.defrag.flush_patches()
         self._flush_evictions()
         if self._eviction_retry_pending:
-            # A prior eviction write failed: re-detect and re-queue now
-            # (one retry round per flush — a still-failing write re-sets
-            # the flag for the NEXT mutator exit, so this cannot loop).
+            # A prior eviction (or shrink-patch) write failed: re-detect
+            # and re-queue now (one retry round per flush — a
+            # still-failing write re-sets the flag for the NEXT mutator
+            # exit, so this cannot loop).
             with self._lock:
                 self._eviction_retry_pending = False
                 self._check_stranded_locked()
+            self._flush_shrinks()
+            self._drain_resize_side_effects()
             self._flush_evictions()
         self._persist_doomed_ledger()
 
@@ -1901,6 +1982,11 @@ class HivedScheduler:
                 self._health_clock += 1
                 if self._apply_settled(self._health_clock):
                     self._check_stranded_locked()
+                if self.defrag is not None:
+                    # The defragmenter rides the same event clock as flap
+                    # damping: deterministic under the chaos harness, free
+                    # on quiet clusters.
+                    self.defrag.tick_locked(self._health_clock)
         finally:
             self._exit_mutation()
 
@@ -1940,6 +2026,29 @@ class HivedScheduler:
     def health_pending_count(self) -> int:
         with self._lock:
             return self._damper.pending_count()
+
+    # -------------- defragmenter verbs (scheduler.defrag) -------------- #
+
+    def run_defrag_cycle_now(self) -> int:
+        """Force one defragmentation cycle immediately (chaos/sim drivers
+        and the `/v1/inspect/health` walkthrough; production runs off the
+        health event clock). Returns the number of NEW proposals."""
+        if self.defrag is None:
+            return 0
+        self._enter_mutation()
+        try:
+            with self._lock:
+                return self.defrag.run_cycle_locked()
+        finally:
+            self._exit_mutation()
+
+    def take_defrag_proposals(self) -> List[Dict]:
+        """Drain the defragmenter's pending migration proposals (the
+        workload-controller side of the drain handshake: the sim tier and
+        chaos harness checkpoint + delete + resubmit the named gangs)."""
+        if self.defrag is None:
+            return []
+        return self.defrag.take_proposals()
 
     def _stranded_groups_locked(self) -> List[Dict]:
         """Gangs holding bad or draining cells — placed before the hardware
@@ -1987,38 +2096,57 @@ class HivedScheduler:
         }
 
     def _check_stranded_locked(self) -> None:
-        """Stranded-gang remediation under the eviction policy knob: queue
-        the pods of newly-stranded gangs for (lazy) eviction. Runs after
-        APPLIED health transitions only, so a flap held by the damper never
-        evicts anybody. Always refreshes the stranded gauge first — the
-        metrics plane reports stranded gangs whichever eviction policy is
-        configured."""
+        """Stranded-gang remediation under the eviction policy knob
+        (doc/fault-model.md "Elastic gang plane"). Runs after APPLIED
+        health transitions only, so a flap held by the damper never
+        touches anybody. Always refreshes the stranded gauge first — the
+        metrics plane reports stranded gangs whichever policy is
+        configured.
+
+        Remediation is migration-aware: actions are planned in preference
+        order — opportunistic gangs before any guaranteed gang is
+        touched, shrinkable gangs (minMembers headroom) before evictable
+        ones, smallest blast radius (affected pods) first — and every
+        action is journaled as a ``remediate`` decision record, so the
+        ordering is auditable after the fact."""
         self._refresh_stranded_locked()
         if not self.config.stranded_gang_eviction:
             return
-        # The `_evicted_*` sets and the eviction queue are shared with the
-        # concurrent flush threads; all read-modify-write maintenance runs
-        # under the (innermost) side-effect lock.
+        for action in self._remediation_plan_locked():
+            rec = self.decisions.begin(
+                f"group/{action['group']}", f"group:{action['group']}",
+                "remediate",
+            )
+            rec.group = action["group"]
+            rec.vc = action["vc"]
+            rec.priority = action["priority"]
+            rec.verdict = action["kind"]
+            rec.note(
+                f"preference order: {'opportunistic' if action['opportunistic'] else 'guaranteed'}, "
+                f"{'shrinkable' if action['kind'] == 'shrink' else 'evictable'}, "
+                f"blast radius {action['blast']} pod(s)"
+            )
+            if action["kind"] == "shrink":
+                plan = action["plan"]
+                rec.note(
+                    f"shrink {plan['from_pods']} -> {plan['to_pods']} pods "
+                    f"(minMembers {plan['min_members']}, generation "
+                    f"{plan['new_gen']}); dropping "
+                    f"{sorted(p.key for p in plan['dropped_pods'])}"
+                )
+                with self._side_effect_lock:
+                    self._shrink_in_flight.add(action["group"])
+                    self._pending_shrinks.append(plan)
+            else:
+                self._queue_group_eviction_locked(action["group"], rec)
+            self.decisions.commit(rec)
+        # Groups that completed/died release their eviction memory. The
+        # `_evicted_*` sets are shared with the concurrent flush threads;
+        # all read-modify-write maintenance runs under the (innermost)
+        # side-effect lock.
         with self._side_effect_lock:
-            for rec in self._stranded_groups_locked():
-                name = rec["name"]
-                if name in self._evicted_groups:
-                    continue
-                g = self.core.affinity_groups.get(name)
-                if g is None:
-                    continue
-                pods = [
-                    p
-                    for pods in g.allocated_pods.values()
-                    for p in pods
-                    if p is not None and p.uid not in self._evicted_pod_uids
-                ]
-                if not pods:
-                    continue
-                self._evicted_groups.add(name)
-                self._pending_evictions.extend((name, p) for p in pods)
-            # Groups that completed/died release their eviction memory.
             self._evicted_groups &= set(self.core.affinity_groups)
+            self._shrink_in_flight &= set(self.core.affinity_groups)
             live_uids = {
                 p.uid
                 for g in self.core.affinity_groups.values()
@@ -2027,6 +2155,209 @@ class HivedScheduler:
                 if p is not None
             }
             self._evicted_pod_uids &= live_uids
+
+    def _queue_group_eviction_locked(self, name: str, rec) -> None:
+        """Queue every live pod of a stranded gang for eviction (the
+        whole-gang remediation for inelastic or unshrinkable gangs)."""
+        with self._side_effect_lock:
+            if name in self._evicted_groups:
+                return
+            g = self.core.affinity_groups.get(name)
+            if g is None:
+                return
+            pods = [
+                p
+                for pods in g.allocated_pods.values()
+                for p in pods
+                if p is not None and p.uid not in self._evicted_pod_uids
+            ]
+            if not pods:
+                return
+            self._evicted_groups.add(name)
+            self._pending_evictions.extend((name, p) for p in pods)
+            if rec is not None:
+                rec.note(f"evicting {len(pods)} pod(s)")
+
+    def _remediation_plan_locked(self) -> List[Dict]:
+        """The ordered remediation actions for the currently-stranded
+        gangs: one dict per gang — kind "shrink" (with the prepared plan)
+        or "evict" — sorted by the migration-aware preference order."""
+        actions: List[Dict] = []
+        for srec in self._stranded_groups_locked():
+            name = srec["name"]
+            g = self.core.affinity_groups.get(name)
+            if g is None:
+                continue
+            with self._side_effect_lock:
+                busy = name in self._evicted_groups or (
+                    name in self._shrink_in_flight
+                )
+            if busy:
+                continue
+            opportunistic = g.virtual_placement is None
+            plan = self._plan_shrink_locked(g)
+            total = g.total_pods
+            if plan is not None:
+                actions.append(
+                    {
+                        "kind": "shrink",
+                        "group": name,
+                        "vc": str(g.vc),
+                        "priority": g.priority,
+                        "opportunistic": opportunistic,
+                        "blast": len(plan["dropped_pods"]),
+                        "plan": plan,
+                    }
+                )
+            else:
+                actions.append(
+                    {
+                        "kind": "evict",
+                        "group": name,
+                        "vc": str(g.vc),
+                        "priority": g.priority,
+                        "opportunistic": opportunistic,
+                        "blast": total,
+                    }
+                )
+        actions.sort(
+            key=lambda a: (
+                0 if a["opportunistic"] else 1,
+                0 if a["kind"] == "shrink" else 1,
+                a["blast"],
+                a["priority"],
+                a["group"],
+            )
+        )
+        return actions
+
+    def _plan_shrink_locked(self, g) -> Optional[Dict]:
+        """Prepare a shrink plan for one stranded gang, or None when the
+        gang cannot shrink (no minMembers bound, knob off, not ALLOCATED,
+        healthy remainder below the floor, or nothing left to drop). The
+        plan carries everything the flush needs: the survivors' new
+        annotation values (and the old ones, for rollback), the new
+        group-level bind info, and the dropped pods."""
+        if (
+            not self.config.elastic_gang_shrink
+            or g.min_members <= 0
+            or g.state != GroupState.ALLOCATED
+        ):
+            return None
+        drop: List[Tuple[int, int]] = []
+        keep: List[Tuple[int, int]] = []
+        for leaf_num, rows in g.physical_placement.items():
+            for pi, row in enumerate(rows):
+                stranded = any(
+                    leaf is not None and (not leaf.healthy or leaf.draining)
+                    for leaf in row
+                )
+                (drop if stranded else keep).append((leaf_num, pi))
+        if not drop or not keep or len(keep) < g.min_members:
+            return None
+        try:
+            member_info, chain = self.core.export_group_bind_info(g)
+        except api.WebServerError as e:
+            common.log.warning(
+                "group %s: cannot regenerate bind info for shrink (%s); "
+                "falling back to eviction", g.name, e.message,
+            )
+            return None
+        drop_set = set(drop)
+        new_member_info = []
+        leaf_nums = sorted(g.physical_placement)
+        for mbi_index, mbi in enumerate(member_info):
+            leaf_num = leaf_nums[mbi_index]
+            kept = [
+                pp
+                for pi, pp in enumerate(mbi.pod_placements)
+                if (leaf_num, pi) not in drop_set
+            ]
+            if kept:
+                new_member_info.append(
+                    api.AffinityGroupMemberBindInfo(pod_placements=kept)
+                )
+        new_gen = g.resize_generation + 1
+        counts: Dict[int, int] = {}
+        for leaf_num, pi in keep:
+            counts[leaf_num] = counts.get(leaf_num, 0) + 1
+        group_spec = g.spec_dict(total_pod_nums=counts)
+        survivors: List[Pod] = []
+        dropped_pods: List[Pod] = []
+        for leaf_num, rows in g.allocated_pods.items():
+            for pi, p in enumerate(rows):
+                if p is None:
+                    continue
+                ((dropped_pods if (leaf_num, pi) in drop_set else survivors)
+                 .append(p))
+        patches: List[Tuple[Pod, Dict, Dict]] = []
+        spec_obj: Optional[api.PodSchedulingSpec] = None
+        for p in survivors:
+            try:
+                s = extract_pod_scheduling_spec(p)
+                info = extract_pod_bind_info(p)
+            except api.WebServerError as e:
+                common.log.warning(
+                    "[%s]: undecodable annotations; shrink of %s falls "
+                    "back to eviction: %s", p.key, g.name, e.message,
+                )
+                return None
+            spec_dict = s.to_dict()
+            spec_dict["affinityGroup"] = group_spec
+            new_info = api.PodBindInfo(
+                node=info.node,
+                leaf_cell_isolation=list(info.leaf_cell_isolation),
+                cell_chain=chain or info.cell_chain,
+                affinity_group_bind_info=new_member_info,
+                resize_generation=new_gen,
+            )
+            new_ann = self._resize_annotations(spec_dict, new_info)
+            old_ann = {
+                k: p.annotations.get(k) for k in new_ann
+            }
+            patches.append((p, new_ann, old_ann))
+            if spec_obj is None:
+                spec_obj = api.PodSchedulingSpec.from_dict(spec_dict)
+        if spec_obj is None:
+            return None  # no survivor pods attached yet: nothing to patch
+        return {
+            "group": g.name,
+            "base_gen": g.resize_generation,
+            "new_gen": new_gen,
+            "min_members": g.min_members,
+            "from_pods": len(keep) + len(drop),
+            "to_pods": len(keep),
+            "patches": patches,
+            "spec": spec_obj,
+            "info": api.PodBindInfo(
+                cell_chain=chain,
+                affinity_group_bind_info=new_member_info,
+                resize_generation=new_gen,
+            ),
+            "dropped_pods": dropped_pods,
+        }
+
+    @staticmethod
+    def _resize_annotations(
+        spec_dict: Dict, info: api.PodBindInfo
+    ) -> Dict[str, str]:
+        """The annotation rewrite one survivor receives on a resize: the
+        reduced/extended scheduling spec, the new-generation bind info,
+        and the regenerated TPU env block (gang size changed, so the
+        jax.distributed world the env describes changed too)."""
+        from ..tpu import env as tpu_env  # late import (framework layering)
+
+        return {
+            constants.ANNOTATION_POD_SCHEDULING_SPEC: common.to_json(
+                spec_dict
+            ),
+            constants.ANNOTATION_POD_BIND_INFO: common.to_json(
+                info.to_dict()
+            ),
+            constants.ANNOTATION_POD_TPU_ENV: common.to_yaml_fast(
+                tpu_env.pod_tpu_env(info)
+            ),
+        }
 
     def _flush_evictions(self) -> None:
         with self._side_effect_lock:
@@ -2053,6 +2384,199 @@ class HivedScheduler:
                     "next flush): %s", pod.key, e,
                 )
 
+    # -------------- elastic gang plane: shrink + resize sync ----------- #
+
+    def _flush_shrinks(self) -> None:
+        with self._side_effect_lock:
+            plans, self._pending_shrinks = self._pending_shrinks, []
+        for plan in plans:
+            try:
+                self._execute_shrink(plan)
+            finally:
+                with self._side_effect_lock:
+                    self._shrink_in_flight.discard(plan["group"])
+
+    def _execute_shrink(self, plan: Dict) -> None:
+        """Patch-then-apply (doc/fault-model.md "Elastic gang plane"):
+        the survivors' annotations are rewritten FIRST — they are the
+        durable record of the shrink, and a crash after any subset of
+        the patches recovers deterministically through the
+        generation-aware replay — the core reshapes second, and the
+        dropped members are evicted last. A failed patch rolls the
+        already-patched survivors back and aborts the shrink (retried at
+        the next flush round)."""
+        name = plan["group"]
+        patched: List[Tuple[Pod, Dict]] = []
+        for pod, new_ann, old_ann in plan["patches"]:
+            try:
+                self.kube_client.patch_pod_annotations(pod, new_ann)
+            except Exception as e:  # noqa: BLE001
+                common.log.warning(
+                    "[%s]: shrink of %s aborted (survivor patch failed: "
+                    "%s); rolling back %d patch(es)",
+                    pod.key, name, e, len(patched),
+                )
+                self._rollback_patches(patched)
+                self.metrics.observe_gang_shrink_abort()
+                self._journal_resize_outcome(
+                    name, "shrink-abort", f"survivor patch failed: {e}"
+                )
+                with self._side_effect_lock:
+                    self._eviction_retry_pending = True
+                return
+            patched.append((pod, old_ann))
+        dropped: Optional[List[Pod]] = None
+        with self._lock:
+            g = self.core.affinity_groups.get(name)
+            if (
+                g is not None
+                and g.state == GroupState.ALLOCATED
+                and g.resize_generation == plan["base_gen"]
+            ):
+                dropped = self.core.apply_resize(
+                    g, plan["spec"], plan["info"], record_event=False
+                )
+        if dropped is None:
+            common.log.warning(
+                "group %s changed while its shrink was in flight; rolling "
+                "the annotation patches back", name,
+            )
+            self._rollback_patches(patched)
+            self.metrics.observe_gang_shrink_abort()
+            self._journal_resize_outcome(
+                name, "shrink-abort", "group changed mid-flight"
+            )
+            return
+        # In-memory mirrors of the patched annotations (the informer may
+        # not re-deliver these pods for a while; the scheduler's own pod
+        # objects must already read as the new generation).
+        for pod, new_ann, _old in plan["patches"]:
+            pod.annotations.update(new_ann)
+            status = self.pod_schedule_statuses.get(pod.uid)
+            if status is not None and status.pod is not pod:
+                status.pod.annotations.update(new_ann)
+        self.metrics.observe_gang_shrink()
+        with self._side_effect_lock:
+            for p in dropped:
+                if p.uid not in self._evicted_pod_uids:
+                    self._pending_evictions.append((name, p))
+        self._journal_resize_outcome(
+            name,
+            "shrink-applied",
+            f"generation {plan['new_gen']}: {plan['from_pods']} -> "
+            f"{plan['to_pods']} pods; evicting {len(dropped)} stranded "
+            "pod(s)",
+        )
+
+    def _rollback_patches(
+        self, patched: List[Tuple[Pod, Dict]]
+    ) -> None:
+        for pod, old_ann in patched:
+            try:
+                self.kube_client.patch_pod_annotations(pod, old_ann)
+            except Exception as e:  # noqa: BLE001
+                self._resize_write_failed = True
+                common.log.warning(
+                    "[%s]: shrink rollback patch failed (%s); the "
+                    "generation-aware replay reconciles the mixed "
+                    "annotations at the next recovery", pod.key, e,
+                )
+
+    def _journal_resize_outcome(
+        self, name: str, verdict: str, note: str
+    ) -> None:
+        rec = self.decisions.begin(
+            f"group/{name}", f"group:{name}", "remediate"
+        )
+        rec.group = name
+        rec.verdict = verdict
+        rec.note(note)
+        self.decisions.commit(rec)
+
+    def _drain_resize_side_effects(self) -> None:
+        """Mutator-exit drain of the core's resize plumbing: replayed
+        pods a newer generation shrank away are re-queued for eviction,
+        and replay-applied resizes (mixed-generation recovery, grow
+        confirms) bump metrics and re-sync surviving pods' stale
+        annotations."""
+        for pod in self.core.take_resize_orphans():
+            try:
+                gname = extract_pod_scheduling_spec(pod).affinity_group.name
+            except api.WebServerError:
+                gname = "unknown"
+            with self._side_effect_lock:
+                if pod.uid not in self._evicted_pod_uids:
+                    self._pending_evictions.append((f"resize:{gname}", pod))
+        events = self.core.take_resize_events()
+        if not events:
+            return
+        patches: List[Tuple[Pod, Dict]] = []
+        with self._lock:
+            for ev in events:
+                if ev["kind"] == "shrink":
+                    self.metrics.observe_gang_shrink()
+                else:
+                    self.metrics.observe_gang_grow()
+                g = self.core.affinity_groups.get(ev["group"])
+                if g is not None:
+                    patches.extend(self._resize_sync_patches_locked(g))
+        for pod, new_ann in patches:
+            try:
+                self.kube_client.patch_pod_annotations(pod, new_ann)
+                pod.annotations.update(new_ann)
+                status = self.pod_schedule_statuses.get(pod.uid)
+                if status is not None and status.pod is not pod:
+                    status.pod.annotations.update(new_ann)
+            except Exception as e:  # noqa: BLE001
+                self._resize_write_failed = True
+                common.log.warning(
+                    "[%s]: resize annotation re-sync failed (advisory — "
+                    "the generation-aware replay tolerates stale "
+                    "annotations): %s", pod.key, e,
+                )
+
+    def _resize_sync_patches_locked(self, g) -> List[Tuple[Pod, Dict]]:
+        """Annotation re-syncs for pods whose bind info predates the
+        group's current resize generation (advisory: keeps the next
+        recovery on the consistent-generation fast path)."""
+        try:
+            member_info, chain = self.core.export_group_bind_info(g)
+        except api.WebServerError:
+            return []
+        group_spec = g.spec_dict()
+        out: List[Tuple[Pod, Dict]] = []
+        for rows in g.allocated_pods.values():
+            for p in rows:
+                if p is None:
+                    continue
+                try:
+                    info = extract_pod_bind_info(p)
+                    s = extract_pod_scheduling_spec(p)
+                except api.WebServerError:
+                    continue
+                if (
+                    info.resize_generation == g.resize_generation
+                    # A grow pod's bind info is already current but its
+                    # SPEC still declares the pre-grow member count — it
+                    # must be re-synced too, or a restart that replays it
+                    # FIRST sizes the group's matrices short of the bind
+                    # info's rows.
+                    and s.affinity_group is not None
+                    and s.affinity_group.total_members == g.total_pods
+                ):
+                    continue
+                spec_dict = s.to_dict()
+                spec_dict["affinityGroup"] = group_spec
+                new_info = api.PodBindInfo(
+                    node=info.node,
+                    leaf_cell_isolation=list(info.leaf_cell_isolation),
+                    cell_chain=chain or info.cell_chain,
+                    affinity_group_bind_info=member_info,
+                    resize_generation=g.resize_generation,
+                )
+                out.append((p, self._resize_annotations(spec_dict, new_info)))
+        return out
+
     def get_health(self) -> Dict:
         """Inspect payload for /v1/inspect/health: applied badness and
         drains (core), held transitions (damper), and stranded gangs."""
@@ -2072,6 +2596,19 @@ class HivedScheduler:
             payload["evictionPolicy"] = (
                 "evict" if self.config.stranded_gang_eviction else "surface"
             )
+            # _shrink_in_flight is mutated under the side-effect lock by
+            # concurrent flushes; snapshot it under the same lock or a
+            # resolving shrink crashes the scrape mid-iteration.
+            with self._side_effect_lock:
+                shrinks_in_flight = sorted(self._shrink_in_flight)
+            payload["elastic"] = {
+                "shrinkEnabled": bool(self.config.elastic_gang_shrink),
+                "shrinksInFlight": shrinks_in_flight,
+                "shrinkCount": self.metrics.gang_shrink_count,
+                "growCount": self.metrics.gang_grow_count,
+            }
+            if self.defrag is not None:
+                payload["defrag"] = self.defrag.snapshot_locked()
         return payload
 
     # ------------------------------------------------------------------ #

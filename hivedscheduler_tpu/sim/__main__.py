@@ -48,6 +48,11 @@ def main(argv=None) -> int:
     ap.add_argument("--mean-runtime", type=float, default=600.0)
     ap.add_argument("--mode", choices=("inproc", "shards"),
                     default="inproc")
+    ap.add_argument("--defrag", action="store_true",
+                    help="arm the background defragmenter and execute "
+                    "its checkpoint-coordinated migrations during the "
+                    "replay (inproc mode; A/B against the same seed "
+                    "without the flag)")
     ap.add_argument("--shards", type=int, default=2)
     ap.add_argument("--transport", choices=("proc", "local"),
                     default="proc")
@@ -91,6 +96,7 @@ def main(argv=None) -> int:
         mode=args.mode,
         n_shards=args.shards,
         transport=args.transport,
+        defrag=args.defrag,
     )
     if args.out:
         with open(args.out, "w") as f:

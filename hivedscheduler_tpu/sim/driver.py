@@ -77,7 +77,7 @@ class _Gang:
     def guaranteed(self) -> bool:
         return self.priority >= 0
 
-    def make_pods(self) -> List[Pod]:
+    def make_pods(self, ignore_suggested: bool = True) -> List[Pod]:
         group = {
             "name": self.name,
             "members": [
@@ -88,6 +88,7 @@ class _Gang:
             fleet.make_pod(
                 f"{self.name}-{i}", f"{self.name}-u{i}", self.vc,
                 self.priority, self.leaf_type, self.chips, group,
+                ignore_suggested=ignore_suggested,
             )
             for i in range(self.n_pods)
         ]
@@ -206,14 +207,17 @@ class TraceDriver:
 
     # -- the scheduling protocol (what the extender does) -------------- #
 
-    def _filter_gang(self, gang: _Gang) -> bool:
+    def _filter_gang(
+        self, gang: _Gang, nodes: Optional[List[str]] = None
+    ) -> bool:
         """Filter every pod of the gang; on full success the gang is live
         (assume-bound). On partial failure the placed pods are deleted —
-        the framework's partial-gang release."""
+        the framework's partial-gang release. ``nodes`` narrows the
+        suggested set (the defrag migration steer)."""
         bound: List[Pod] = []
         for p in gang.pods:
             r = self.sched.filter_routine(
-                ei.ExtenderArgs(pod=p, node_names=self.nodes)
+                ei.ExtenderArgs(pod=p, node_names=nodes or self.nodes)
             )
             if not r.node_names:
                 for q in gang.pods:
@@ -222,6 +226,48 @@ class TraceDriver:
             bound.append(self._bound_pod(p.uid))
         gang.bound = bound
         return True
+
+    # -- the defragmenter's workload-controller half ------------------- #
+
+    def _defrag_pulse(self, live: Dict[str, "_Gang"]) -> Tuple[int, int]:
+        """One defrag beat (inproc mode only): advance the event clock
+        (runs a cycle when the interval allows), then play the workload
+        controller for every proposal — checkpoint (implicit), delete the
+        gang, re-filter it onto the compacting placement (suggested set
+        minus the fragment's nodes), cancel-on-fail releasing the
+        reservation. Returns (proposals, migrations)."""
+        sched = self.sched
+        if getattr(sched, "defrag", None) is None or self.core is None:
+            return 0, 0
+        sched.health_tick()
+        proposals = sched.take_defrag_proposals()
+        migrated = 0
+        for prop in proposals:
+            gang = live.get(prop["group"])
+            if gang is None:
+                sched.defrag.report_migration(
+                    prop["group"], ok=False, reason="gang departed"
+                )
+                continue
+            avoid = set(prop["avoidNodes"])
+            target = [n for n in self.nodes if n not in avoid]
+            for p in gang.bound:
+                sched.delete_pod(p)
+            gang.make_pods(ignore_suggested=False)
+            if self._filter_gang(gang, nodes=target):
+                sched.defrag.report_migration(prop["group"], ok=True)
+                migrated += 1
+                continue
+            # Cancel-on-fail: release the reservation and put the gang
+            # back wherever it fits (its original cells are still free).
+            gang.make_pods()
+            if not self._filter_gang(gang):
+                live.pop(prop["group"], None)
+            sched.defrag.report_migration(
+                prop["group"], ok=False,
+                reason="re-filter found no compacting placement",
+            )
+        return len(proposals), migrated
 
     def _try_preempt(self, gang: _Gang, live: Dict[str, "_Gang"]) -> int:
         """The production preemption protocol for the gang's first pod:
@@ -285,6 +331,7 @@ class TraceDriver:
         ]
         frag_i = 0
         faults_applied = 0
+        defrag_proposals = defrag_migrations = 0
         t_wall0 = time.perf_counter()
 
         def depart_until(t: float) -> int:
@@ -355,6 +402,13 @@ class TraceDriver:
         for ev in trace["events"]:
             t = float(ev["t"])
             while frag_i < len(frag_at) and frag_at[frag_i] <= t:
+                # Defrag beat first, so the sample reflects the compacted
+                # state this beat achieved (the A/B's measured quantity).
+                dp, dm = self._defrag_pulse(live)
+                defrag_proposals += dp
+                defrag_migrations += dm
+                if dm:
+                    retry_waiting(frag_at[frag_i])
                 if self.core is not None:
                     frag_series.append(
                         {
@@ -388,6 +442,9 @@ class TraceDriver:
         if depart_until(end_t):
             retry_waiting(end_t)
         while frag_i < len(frag_at):
+            dp, dm = self._defrag_pulse(live)
+            defrag_proposals += dp
+            defrag_migrations += dm
             if self.core is not None:
                 frag_series.append(
                     {
@@ -415,6 +472,8 @@ class TraceDriver:
                 "waitingAtEnd": len(waiting),
                 "liveAtEnd": len(live),
                 "faultsApplied": faults_applied,
+                "defragProposals": defrag_proposals,
+                "defragMigrations": defrag_migrations,
             },
             wait_times_s=wait_times,
             frag_series=frag_series,
@@ -429,15 +488,25 @@ def run_trace(
     n_shards: int = 2,
     transport: str = "proc",
     hosts: Optional[int] = None,
+    defrag: bool = False,
+    frag_samples: int = 8,
 ) -> Dict:
     """Build the fleet the trace's shape names (or ``hosts`` override),
-    replay, and return the report."""
+    replay, and return the report. ``defrag=True`` arms the background
+    defragmenter (inproc mode) and drives its checkpoint-coordinated
+    migrations at every fragmentation sample point — the A/B switch of
+    the ``HIVED_BENCH_DEFRAG`` stage."""
     shape = TraceShape.from_dict(trace["shape"])
     config, actual_hosts = build_fleet_config(
         hosts if hosts is not None else shape.hosts
     )
+    if defrag:
+        config.defrag_enable = True
+        config.defrag_interval_ticks = 1
+        config.defrag_max_migrations_per_cycle = 2
     driver = TraceDriver(
-        config, mode=mode, n_shards=n_shards, transport=transport
+        config, mode=mode, n_shards=n_shards, transport=transport,
+        frag_samples=frag_samples,
     )
     try:
         report = driver.run(trace)

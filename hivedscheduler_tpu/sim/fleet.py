@@ -100,7 +100,10 @@ def fleet_hosts(cubes: int, slices: int, solos: int) -> int:
     return 16 * cubes + 4 * slices + solos
 
 
-def make_pod(name, uid, vc, priority, leaf_type, leaf_num, group) -> Pod:
+def make_pod(
+    name, uid, vc, priority, leaf_type, leaf_num, group,
+    ignore_suggested: bool = True,
+) -> Pod:
     import yaml
 
     spec = {
@@ -110,6 +113,9 @@ def make_pod(name, uid, vc, priority, leaf_type, leaf_num, group) -> Pod:
         "leafCellNumber": leaf_num,
         "affinityGroup": group,
     }
+    if not ignore_suggested:
+        # The defrag migration re-filter steers via the suggested set.
+        spec["ignoreK8sSuggestedNodes"] = False
     return Pod(
         name=name,
         uid=uid,

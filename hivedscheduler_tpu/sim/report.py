@@ -177,4 +177,9 @@ def render_text(report: Dict) -> str:
         f"{c['waitingAtEnd']} waiting, {c['liveAtEnd']} live at end, "
         f"{c['faultsApplied']} faults applied"
     )
+    if c.get("defragProposals") or c.get("defragMigrations"):
+        lines.append(
+            f"  defrag: {c['defragProposals']} proposals, "
+            f"{c['defragMigrations']} migrations executed"
+        )
     return "\n".join(lines)

@@ -56,6 +56,12 @@ COUNTERS: Dict[str, tuple] = {
     "snapshotPersistFailureCount": ("hived_snapshot_persist_failures_total", "failed snapshot ConfigMap writes"),
     "snapshotFallbackCount": ("hived_snapshot_fallbacks_total", "recoveries that fell back from an unusable snapshot to full annotation replay"),
     "deposedBindRefusedCount": ("hived_deposed_bind_refusals_total", "bind writes refused because this process no longer holds the leader lease"),
+    "gangShrinkCount": ("hived_gang_shrinks_total", "stranded gangs shrunk in place instead of evicted (elastic gang plane)"),
+    "gangShrinkAbortCount": ("hived_gang_shrink_aborts_total", "shrinks aborted and rolled back (survivor annotation patch failed or the gang changed mid-flight)"),
+    "gangGrowCount": ("hived_gang_grows_total", "opportunistic gangs grown into idle capacity"),
+    "defragProposalCount": ("hived_defrag_proposals_total", "defragmenter migration proposals issued (drain handshake started)"),
+    "defragMigrationCount": ("hived_defrag_migrations_total", "defragmenter migrations completed (gang re-placed off its fragment)"),
+    "defragCancelCount": ("hived_defrag_cancels_total", "defragmenter proposals cancelled, their advisory reservation released"),
 }
 
 GAUGES: Dict[str, tuple] = {

@@ -82,6 +82,7 @@ from hivedscheduler_tpu.scheduler.types import (
     Pod,
     PodState,
     SchedulingPhase,
+    extract_pod_bind_info as chaos_extract_bind_info,
     extract_pod_scheduling_spec,
 )
 
@@ -126,6 +127,14 @@ DEFAULT_EVENT_WEIGHTS = (
     ("stale_snapshot", 1.5),
     ("failover", 3.0),
     ("failover_mid_bind", 2.0),
+    # Elastic gang plane (ISSUE 10; doc/fault-model.md "Elastic gang
+    # plane"): targeted chip faults under elastic gangs (shrink instead
+    # of evict), opportunistic grow submissions, and forced defragmenter
+    # cycles with checkpoint-coordinated migrations. The "elastic" alias
+    # of HIVED_CHAOS_MIX weights the family (hack/soak.sh --elastic).
+    ("gang_shrink", 4.0),
+    ("gang_grow", 3.0),
+    ("defrag_migrate", 2.0),
 )
 
 _HEALTH_FAMILY = (
@@ -138,6 +147,11 @@ _HA_FAMILY = (
     "snapshot_flush", "snapshot_corrupt", "stale_snapshot", "failover",
     "failover_mid_bind",
 )
+
+# The "elastic" alias multiplies the elastic-gang family (hack/soak.sh
+# --elastic weights it up, together with the health events that strand
+# gangs in the first place).
+_ELASTIC_FAMILY = ("gang_shrink", "gang_grow", "defrag_migrate")
 
 
 def event_weights(mix_env: Optional[str] = None) -> List:
@@ -160,6 +174,9 @@ def event_weights(mix_env: Optional[str] = None) -> List:
                 mult[ev] = mult.get(ev, 1.0) * factor
         elif name.strip() == "ha":
             for ev in _HA_FAMILY:
+                mult[ev] = mult.get(ev, 1.0) * factor
+        elif name.strip() == "elastic":
+            for ev in _ELASTIC_FAMILY:
                 mult[ev] = mult.get(ev, 1.0) * factor
         else:
             mult[name.strip()] = factor
@@ -656,8 +673,9 @@ class ChaosHarness:
     # asserts the resolution lands (invariant 6: preemption progress).
     PREEMPT_PROGRESS_BOUND = 7
 
-    def __init__(self, seed: int):
+    def __init__(self, seed: int, mix: Optional[str] = None):
         self.seed = seed
+        self.mix = mix
         self.rnd = random.Random(seed)
         # Global random is pinned for any residual consumer; the core's
         # victim-node pick itself now takes the injectable preempt_rng.
@@ -715,13 +733,31 @@ class ChaosHarness:
             "failovers": 0,
             "hot_takeovers": 0,
             "deposed_bind_refusals": 0,
+            # Elastic gang plane: shrinks/grows actually APPLIED by the
+            # live scheduler (accumulated off its metrics at each
+            # restart + teardown), shrink aborts, defrag activity, and
+            # the targeted-event counters.
+            "gang_shrinks": 0,
+            "gang_shrink_aborts": 0,
+            "gang_grows": 0,
+            "defrag_proposals": 0,
+            "defrag_migrations": 0,
+            "defrag_cancels": 0,
+            "shrink_targets": 0,
+            "grow_submits": 0,
+            "defrag_cycles": 0,
+            "evictions_folded": 0,
         }
-        self.weights = event_weights()
+        self.weights = event_weights(mix)
         self.total_weight = sum(w for _, w in self.weights)
         # The HA plane's deterministic wall clock: leases are acquired and
         # expire only when a failover event advances it, so leadership is a
         # pure function of the event schedule.
         self.ha_clock = 100.0
+        # Evicted-pod fold pointer: kube.evicted entries past this index
+        # are evictions the kubelet has not yet honored; _process_evictions
+        # (end of every step) delivers their DELETED events.
+        self._evictions_seen = 0
         self.scheduler = self._new_scheduler()
         self.node_health = {
             n: True for n in self.scheduler.core.configured_node_names()
@@ -750,6 +786,17 @@ class ChaosHarness:
             cfg.virtual_clusters["A"], cfg.virtual_clusters["B"] = (
                 cfg.virtual_clusters["B"], cfg.virtual_clusters["A"],
             )
+        # Elastic gang plane (ISSUE 10): remediation armed — stranded
+        # gangs shrink (minMembers bound) or evict, and the harness folds
+        # the resulting deletes back as the kubelet would. The
+        # defragmenter is constructed but event-driven only: automatic
+        # cycles never fire (the interval outlives any schedule); the
+        # defrag_migrate event forces cycles explicitly, keeping every
+        # migration inside one audited harness event.
+        cfg.stranded_gang_eviction = True
+        cfg.elastic_gang_shrink = True
+        cfg.defrag_enable = True
+        cfg.defrag_interval_ticks = 1 << 30
         return cfg
 
     def _new_scheduler(self) -> HivedScheduler:
@@ -782,6 +829,11 @@ class ChaosHarness:
                 annotations.pop(k, None)
             else:
                 annotations[k] = v
+        if patch.get(constants.ANNOTATION_POD_BIND_INFO):
+            # A resize rewrote the bind info the harness had corrupted:
+            # the corruption is healed, so recovery must no longer expect
+            # a quarantine for this pod.
+            self.corrupted.discard(pod.uid)
         self.cluster_pods[pod.uid] = Pod(
             name=cur.name,
             namespace=cur.namespace,
@@ -797,10 +849,11 @@ class ChaosHarness:
 
     # ---------------- events ---------------- #
 
-    def _filter_and_bind(self, pod: Pod) -> str:
+    def _filter_and_bind(self, pod: Pod, nodes: Optional[List[str]] = None) -> str:
         """Drive one pod through the production filter (+bind on success).
         Returns "bound" / "pending" / "rejected"; a rejected pod is dropped
-        from the cluster truth (K8s would loop on it)."""
+        from the cluster truth (K8s would loop on it). ``nodes`` narrows
+        the suggested set (the defrag fragment-seeding steer)."""
         try:
             group_name = extract_pod_scheduling_spec(pod).affinity_group.name
         except api.WebServerError:
@@ -812,7 +865,9 @@ class ChaosHarness:
         )
         try:
             result = self.scheduler.filter_routine(
-                ei.ExtenderArgs(pod=pod, node_names=self.live_nodes())
+                ei.ExtenderArgs(
+                    pod=pod, node_names=nodes or self.live_nodes()
+                )
             )
         except api.WebServerError:
             self.scheduler.delete_pod(pod)
@@ -869,6 +924,13 @@ class ChaosHarness:
             "name": name,
             "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
         }
+        # Elastic bounds (ISSUE 10): about half the multi-pod gangs can
+        # shrink down to a floor; opportunistic gangs sometimes carry
+        # grow headroom (gang_grow exploits it).
+        if n_pods > 1 and self.rnd.random() < 0.5:
+            group["minMembers"] = self.rnd.randint(1, n_pods - 1)
+        if priority == -1 and self.rnd.random() < 0.4:
+            group["maxMembers"] = n_pods + self.rnd.randint(1, 2)
         uids = []
         for i in range(n_pods):
             pod = make_pod(
@@ -1015,6 +1077,278 @@ class ChaosHarness:
                 self.drains[node] = {self.rnd.choice(chips)}
             self.stats["drains"] += 1
         self._deliver_node(node)
+
+    # ---------------- elastic gang plane (ISSUE 10) ---------------- #
+
+    def gang_shrink(self) -> None:
+        """Fault one chip under a SHRINKABLE gang (minMembers headroom):
+        once the transition applies, the remediation plan must shrink the
+        gang in place — release exactly the stranded member, keep the
+        healthy placement — instead of deleting it. Degrades to a plain
+        chip_fault when no shrinkable gang is live, so the event always
+        exercises the health plane."""
+        core = self.scheduler.core
+        candidates = sorted(
+            name
+            for name, g in core.affinity_groups.items()
+            if g.state == GroupState.ALLOCATED
+            and g.min_members > 0
+            and g.total_pods > g.min_members
+        )
+        if not candidates:
+            self.chip_fault()
+            return
+        g = core.affinity_groups[self.rnd.choice(candidates)]
+        targets = sorted(
+            {
+                (leaf.nodes[0], leaf.leaf_cell_indices[0])
+                for rows in g.physical_placement.values()
+                for row in rows
+                for leaf in row
+                if leaf is not None and leaf.healthy
+            }
+        )
+        targets = [
+            (n, c) for n, c in targets
+            if n in self.bad_chips and c not in self.bad_chips[n]
+        ]
+        if not targets:
+            return
+        node, chip = self.rnd.choice(targets)
+        self.bad_chips[node].add(chip)
+        self.stats["shrink_targets"] += 1
+        self.stats["chip_faults"] += 1
+        self._deliver_node(node)
+
+    def gang_grow(self) -> None:
+        """Submit one more pod for an opportunistic gang with maxMembers
+        headroom: the scheduler must grow the gang into idle capacity (or
+        put the pod on the waiting queue when the fleet is full)."""
+        core = self.scheduler.core
+        candidates = sorted(
+            name
+            for name, g in core.affinity_groups.items()
+            if g.state == GroupState.ALLOCATED
+            and g.priority < 0
+            and g.virtual_placement is None
+            and g.max_members > g.total_pods
+            and name in self.gangs
+        )
+        if not candidates:
+            return
+        name = self.rnd.choice(candidates)
+        g = core.affinity_groups[name]
+        member = next(
+            (
+                p
+                for pods in g.allocated_pods.values()
+                for p in pods
+                if p is not None
+            ),
+            None,
+        )
+        if member is None:
+            return
+        try:
+            s = extract_pod_scheduling_spec(member)
+        except api.WebServerError:
+            return
+        group = {
+            "name": name,
+            "members": [
+                {"podNumber": p, "leafCellNumber": n}
+                for n, p in sorted(g.total_pod_nums.items())
+            ],
+            "maxMembers": g.max_members,
+        }
+        if g.min_members:
+            group["minMembers"] = g.min_members
+        self.gang_seq += 1
+        pod = make_pod(
+            f"{name}-gr{self.gang_seq}", f"u-{name}-gr{self.gang_seq}",
+            str(g.vc), -1, s.leaf_cell_type, s.leaf_cell_number,
+            group=group,
+        )
+        self.cluster_pods[pod.uid] = pod
+        self.scheduler.add_pod(pod)
+        self.stats["grow_submits"] += 1
+        if self._filter_and_bind(pod) == "rejected":
+            return
+        self.gangs.setdefault(name, []).append(pod.uid)
+
+    def defrag_migrate(self) -> None:
+        """Force one defragmenter cycle and play the workload controller
+        for every proposal: checkpoint (implicit), delete the gang,
+        resubmit it, and report the migration's outcome (cancel-on-fail
+        releases the advisory reservation)."""
+        sched = self.scheduler
+        self.stats["defrag_cycles"] += 1
+        if sched.run_defrag_cycle_now() == 0:
+            # Nothing mergeable: plant a straggler fragment and re-scan
+            # (self-contained — on fleets where compaction is possible at
+            # all, one event seeds AND migrates).
+            self._seed_fragment()
+            sched.run_defrag_cycle_now()
+        for prop in sched.take_defrag_proposals():
+            name = prop["group"]
+            uids = [
+                u for u in self.gangs.get(name, ())
+                if u in self.cluster_pods
+            ]
+            if not uids:
+                sched.defrag.report_migration(
+                    name, ok=False, reason="gang vanished"
+                )
+                continue
+            old_pods = [self.cluster_pods[u] for u in uids]
+            self.delete_pods(uids, missed=False)
+            new_uids = []
+            ok = True
+            for old in old_pods:
+                spec_ann = old.annotations.get(
+                    constants.ANNOTATION_POD_SCHEDULING_SPEC, ""
+                )
+                pod = Pod(
+                    name=f"{old.name}-m",
+                    uid=f"{old.uid}-m",
+                    annotations={
+                        constants.ANNOTATION_POD_SCHEDULING_SPEC: spec_ann
+                    },
+                    resource_limits={
+                        constants.RESOURCE_NAME_POD_SCHEDULING_ENABLE: 1
+                    },
+                )
+                self.cluster_pods[pod.uid] = pod
+                self.scheduler.add_pod(pod)
+                outcome = self._filter_and_bind(pod)
+                if outcome == "rejected":
+                    ok = False
+                    continue
+                new_uids.append(pod.uid)
+                if outcome != "bound":
+                    ok = False
+            if new_uids:
+                self.gangs[name] = new_uids
+            else:
+                self.gangs.pop(name, None)
+            sched.defrag.report_migration(
+                name, ok=ok,
+                reason="" if ok else "re-filter found no compacting placement",
+            )
+
+    def _seed_fragment(self) -> None:
+        """Plant the fragmentation a later defrag_migrate event compacts:
+        a lone 1-pod guaranteed gang steered onto a WHOLE-FREE slice
+        (packing would otherwise co-locate it with existing gangs, and a
+        slice blocked by several gangs is not a migration candidate).
+        The host-granular quota binding splits that slice out of the free
+        lists — the canonical straggler fragment."""
+        core = self.scheduler.core
+        target_nodes = None
+        target_chain = None
+        live = set(self.live_nodes())
+        for chain in sorted(core.full_cell_list):
+            ccl = core.full_cell_list[chain]
+            top = ccl.top_level
+            if top <= 3:
+                continue  # single-host chains cannot fragment
+            leaf_num = core.compiled.cell_level_to_leaf_num[chain]
+            free_chips = sum(
+                len(cells) * leaf_num[level]
+                for level, cells in core.free_cell_list[chain].levels.items()
+            )
+            for cell in ccl[top]:
+                if not cell.healthy or not set(cell.nodes) <= live:
+                    continue
+                # The target slice must carry NO blocking (out-of-free-
+                # list) allocation — opportunistic users allocate through
+                # the free lists and block nothing — and keep enough free
+                # capacity that the seeded binding leaves mergeable free
+                # buddies behind, plus a migration target OUTSIDE it
+                # (1-slice chains are structurally un-defragmentable).
+                inside_free = 0
+                blocked = False
+                stack = [cell]
+                while stack:
+                    c = stack.pop()
+                    if in_free_cell_list(c):
+                        inside_free += leaf_num[c.level]
+                        continue
+                    if not c.children:
+                        if c.state != CellState.FREE:
+                            blocked = True
+                            break
+                        continue
+                    stack.extend(c.children)
+                if (
+                    blocked
+                    or inside_free < leaf_num[top] // 2
+                    or free_chips - inside_free < 1
+                ):
+                    continue
+                target_nodes = sorted(cell.nodes)
+                target_chain = chain
+                break
+            if target_nodes:
+                break
+        if target_nodes is None:
+            return
+        leaf_type = core.chain_to_leaf_type.get(target_chain, "v5e-chip")
+        top = core.full_cell_list[target_chain].top_level
+        vcs = []
+        for vc in ("A", "B"):
+            vc_sched = core.vc_schedulers.get(vc)
+            vccl = (
+                vc_sched.non_pinned_preassigned.get(target_chain)
+                if vc_sched is not None
+                else None
+            )
+            # Only SUB-slice quota fragments the slice; a top-level quota
+            # binding consumes the whole cell and leaves nothing to merge.
+            if vccl is not None and vccl.top_level < top:
+                vcs.append(vc)
+        if not vcs:
+            return
+        self.gang_seq += 1
+        name = f"fr{self.seed}-{self.gang_seq}"
+        group = {
+            "name": name,
+            "members": [{"podNumber": 1, "leafCellNumber": 1}],
+        }
+        pod = make_pod(
+            f"{name}-0", f"u-{name}-0", self.rnd.choice(vcs), 0,
+            leaf_type, 1, group=group, ignore_suggested=False,
+        )
+        self.cluster_pods[pod.uid] = pod
+        self.scheduler.add_pod(pod)
+        if self._filter_and_bind(pod, nodes=target_nodes) != "rejected":
+            self.gangs[name] = [pod.uid]
+
+    def _process_evictions(self) -> None:
+        """The kubelet honors the scheduler's evictions: deliver DELETED
+        events for newly-evicted pods still in the cluster truth (runs at
+        the end of every step, so remediation completes within the event
+        that triggered it)."""
+        new = self.kube.evicted[self._evictions_seen:]
+        self._evictions_seen = len(self.kube.evicted)
+        uids = [u for u in new if u in self.cluster_pods]
+        if uids:
+            self.stats["evictions_folded"] += len(uids)
+            self.delete_pods(uids, missed=False)
+
+    def _accumulate_elastic_metrics(self, sched: HivedScheduler) -> None:
+        """Fold a scheduler instance's elastic counters into the stats
+        (called before the instance is discarded, and at teardown)."""
+        m = sched.metrics.snapshot()
+        for stat_key, metric_key in (
+            ("gang_shrinks", "gangShrinkCount"),
+            ("gang_shrink_aborts", "gangShrinkAbortCount"),
+            ("gang_grows", "gangGrowCount"),
+            ("defrag_proposals", "defragProposalCount"),
+            ("defrag_migrations", "defragMigrationCount"),
+            ("defrag_cancels", "defragCancelCount"),
+        ):
+            self.stats[stat_key] += m[metric_key]
 
     def inject_write_faults(self) -> None:
         """Script faults into the auxiliary write paths (preempt-info
@@ -1462,6 +1796,31 @@ class ChaosHarness:
             # the node truth directly (the transition is not lost — it
             # lands immediately instead of after the hold).
             return "pending-damping"
+        # Mid-resize (elastic gang plane): a shrink abort whose rollback
+        # patch failed — or a resize re-sync that never landed — leaves
+        # pods whose bind-info generation differs from their group's.
+        # Recovery reconciles deterministically (newest generation wins),
+        # but the reconciled state is by design not the continuous one.
+        # GATED on the scheduler having actually recorded a failed resize
+        # write: a generation mismatch with healthy writes is a resize
+        # bug, and excusing it would blind the sweep to a no-op'd shrink
+        # (see test_nooped_shrink_replay_is_caught).
+        if getattr(old, "_resize_write_failed", False):
+            for uid, p in sorted(self.cluster_pods.items()):
+                if not p.node_name or uid in self.corrupted:
+                    continue
+                try:
+                    ps = extract_pod_scheduling_spec(p)
+                    info = chaos_extract_bind_info(p)
+                except api.WebServerError:
+                    continue
+                g = old.core.affinity_groups.get(ps.affinity_group.name)
+                if (
+                    g is not None
+                    and g.state == GroupState.ALLOCATED
+                    and info.resize_generation != g.resize_generation
+                ):
+                    return "mid-resize"
         pre_info = constants.ANNOTATION_POD_PREEMPT_INFO
         for name, g in old.core.affinity_groups.items():
             if g.state != GroupState.PREEMPTING:
@@ -1534,6 +1893,7 @@ class ChaosHarness:
         that state is exactly what a real crash loses."""
         self.stats["restarts"] += 1
         old = self.scheduler
+        self._accumulate_elastic_metrics(old)
         pending_bind = None
         if failover:
             self.stats["failovers"] += 1
@@ -1942,6 +2302,8 @@ class ChaosHarness:
     # ---------------- teardown (invariant 3) ---------------- #
 
     def teardown_and_assert_no_leaks(self) -> None:
+        self._process_evictions()
+        self._accumulate_elastic_metrics(self.scheduler)
         self.relist()
         self.delete_pods(list(self.cluster_pods), missed=False)
         for n in sorted(self.node_health):
@@ -1996,6 +2358,9 @@ class ChaosHarness:
         # flap transitions settle once the flapping stops.
         self.scheduler.health_tick()
         self.check_preemption_progress()
+        # The kubelet honors remediation evictions (stranded gangs and
+        # shrunk-away members) before the next event fires.
+        self._process_evictions()
 
     def run(self, n_events: Optional[int] = None) -> Dict[str, int]:
         n = n_events if n_events is not None else self.rnd.randint(10, 16)
@@ -2012,8 +2377,12 @@ class ChaosHarness:
         return self.stats
 
 
-def run_chaos_schedule(seed: int, n_events: Optional[int] = None) -> Dict[str, int]:
-    harness = ChaosHarness(seed)
+def run_chaos_schedule(
+    seed: int,
+    n_events: Optional[int] = None,
+    mix: Optional[str] = None,
+) -> Dict[str, int]:
+    harness = ChaosHarness(seed, mix=mix)
     try:
         return harness.run(n_events)
     except AssertionError as e:

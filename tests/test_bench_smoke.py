@@ -198,6 +198,29 @@ def test_bench_relist_ab_smoke():
     json.dumps(result)
 
 
+def test_bench_defrag_smoke():
+    """Smoke-sized variant of the HIVED_BENCH_DEFRAG stage (ISSUE 10
+    CI/tooling satellite): the defrag-off/on A/B at identical seed must
+    emit both schedulable-slice-size distributions with the uniform
+    _stage_meta keys, and defrag must never make the distribution worse
+    (the full-size stage additionally shows the positive gain,
+    doc/hot-path.md)."""
+    result = bench.bench_defrag(
+        hosts=110, gangs=140, duration_s=900.0, frag_samples=8
+    )
+    assert_stage_meta(result)
+    for side in ("off", "on"):
+        d = result[side]
+        assert d["largest_free_slice_avg"] >= 0
+        assert d["sub_host_fragments_avg"] >= 0
+        assert d["sub_slice_fragments_avg"] >= 0
+        assert d["bound_gangs"] > 0
+        assert isinstance(d["end_free_slices"], dict)
+    assert result["largest_free_slice_gain"] >= 0
+    assert result["proposals"] >= result["migrations"] >= 0
+    json.dumps(result)
+
+
 def test_bench_sim_smoke():
     """Smoke-sized variant of the HIVED_BENCH_SIM stage (ISSUE 9
     CI/tooling satellite): the per-fleet-size trend curve must carry the

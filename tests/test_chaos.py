@@ -48,14 +48,15 @@ CHAOS_ROUNDS = int(os.environ.get("HIVED_CHAOS_ROUNDS", "0")) or 300
 # Seeds whose schedules corrupt a surviving bound pod's bind-info BEFORE a
 # crash-restart — the schedules that die if recovery regresses from
 # quarantining to raising (see test_rebroken_recover_is_caught below).
-# (Re-derived for the PR-7 HA/snapshot event mix; the mix change shifts
-# every schedule's rng stream, so the PR-4/PR-5 pins no longer apply.)
-CORRUPTION_RESTART_SEEDS = (16, 19, 20, 27, 44, 53)
+# (Re-derived for the ISSUE-10 elastic event mix via
+# hack/derive_chaos_pins.py; the mix change shifts every schedule's rng
+# stream, so the PR-7 pins no longer apply.)
+CORRUPTION_RESTART_SEEDS = (1, 10, 11, 16, 19, 26)
 
 # Seeds whose schedules crash-restart while a PREEMPTING group holds a
 # Reserving/Reserved reservation — the schedules that die if
 # Reserving/Reserved recovery is re-broken (sensitivity meta-test below).
-RESERVING_RECOVERY_SEEDS = (128, 159, 171, 183, 231, 247)
+RESERVING_RECOVERY_SEEDS = (0, 10, 38, 191, 216, 292)
 
 # Seeds whose schedules apply a node/chip health transition on a
 # MULTI-chain fleet — the schedules that die if a cross-chain mutator
@@ -63,18 +64,24 @@ RESERVING_RECOVERY_SEEDS = (128, 159, 171, 183, 231, 247)
 # test_bypassed_global_lock_order_is_caught; doc/hot-path.md "The
 # lock-sharding contract"). Single-chain seeds can never catch
 # this — one chain's lock IS the global order there.
-GLOBAL_ORDER_SEEDS = (0, 1, 4, 5, 6, 8)
+GLOBAL_ORDER_SEEDS = (0, 1, 3, 4, 5, 6)
 
 # Seeds whose schedules run a flap storm — the schedules that die if flap
 # damping is disabled (the harness asserts the damper holds a storm to at
 # most threshold-1 applied transitions; see test_disabled_damping_is_caught).
-DAMPING_DISABLED_SEEDS = (1, 2, 5, 6, 11, 14)
+DAMPING_DISABLED_SEEDS = (0, 7, 9, 13, 15, 16)
 
 # Seeds whose schedules crash/fail over with a pod bound, changed, or
 # deleted AFTER the last snapshot flush — the schedules that die if the
 # delta replay is no-op'd (imports trusted blindly, vanished pods never
 # released; see test_nooped_delta_replay_is_caught).
-SNAPSHOT_DELTA_SEEDS = (18, 19, 27, 36, 53, 59)
+SNAPSHOT_DELTA_SEEDS = (10, 13, 25, 26, 30, 42)
+
+# Seeds whose schedules shrink a gang and then crash (or replay resized
+# annotations) — the schedules that die if the resize application is
+# no-op'd (stale full placements replayed, shrunken gangs diverging from
+# the continuous scheduler; see test_nooped_shrink_replay_is_caught).
+SHRINK_REPLAY_SEEDS = (5, 12, 23, 42, 53, 100)
 
 
 def test_chaos_seed_sweep():
@@ -106,6 +113,40 @@ def test_chaos_seed_sweep():
         "snapshot_flushes", "snapshot_recoveries", "snapshot_fallbacks",
         "snapshot_corruptions", "stale_snapshots", "failovers",
         "deposed_bind_refusals",
+        # Elastic gang plane (ISSUE 10): stranded gangs shrink in place,
+        # opportunistic gangs grow, the defragmenter proposes and
+        # completes checkpoint-coordinated migrations, and every
+        # remediation eviction is folded back as a pod delete.
+        "gang_shrinks", "gang_grows", "defrag_proposals",
+        "defrag_migrations", "evictions_folded",
+    ):
+        assert stats[key] > 0, (key, stats)
+
+
+# Coverage floor for the elastic-mix sweep (ISSUE 10 acceptance: the
+# `elastic:` mix must hold strict restart equivalence + conservation
+# across >= 220 seeds, including crashes mid-shrink and mid-migration).
+ELASTIC_CHAOS_ROUNDS = (
+    int(os.environ.get("HIVED_CHAOS_ELASTIC_ROUNDS", "0")) or 220
+)
+
+
+def test_chaos_elastic_mix_sweep():
+    """The elastic-weighted chaos sweep: gang_shrink / gang_grow /
+    defrag_migrate dominate (with the health events that strand gangs),
+    every schedule still audits conservation + strict restart
+    equivalence, and the elastic planes all fire across the seed set."""
+    stats = {}
+    for seed in range(ELASTIC_CHAOS_ROUNDS):
+        for k, v in chaos.run_chaos_schedule(
+            seed, mix="elastic:3,health:1.5"
+        ).items():
+            stats[k] = stats.get(k, 0) + v
+    assert stats["restarts"] >= ELASTIC_CHAOS_ROUNDS, stats
+    for key in (
+        "gang_shrinks", "gang_grows", "defrag_cycles",
+        "defrag_proposals", "defrag_migrations", "evictions_folded",
+        "shrink_targets", "grow_submits",
     ):
         assert stats[key] > 0, (key, stats)
 
@@ -252,6 +293,31 @@ def test_nooped_delta_replay_is_caught(monkeypatch):
             caught += 1
     assert caught == len(SNAPSHOT_DELTA_SEEDS), (
         "no-op'd snapshot delta replay escaped the pinned chaos seeds"
+    )
+
+
+def test_nooped_shrink_replay_is_caught(monkeypatch):
+    """Sensitivity meta-test for the elastic gang plane (ISSUE 10): no-op
+    the resize application — live shrinks do nothing and newer-generation
+    bind infos replay as stale full placements — and assert the pinned
+    shrink seeds fail (strict restart-equivalence divergence, leaked
+    cells at teardown, or remediation that never converges). If this
+    passes while apply_resize is dead, the sweep is blind to the shrink
+    protocol and its crash recovery."""
+    from hivedscheduler_tpu.algorithm.core import HivedCore
+
+    monkeypatch.setattr(
+        HivedCore, "apply_resize",
+        lambda self, g, s, info, pod=None, record_event=True: [],
+    )
+    caught = 0
+    for seed in SHRINK_REPLAY_SEEDS:
+        try:
+            chaos.run_chaos_schedule(seed)
+        except Exception:  # noqa: BLE001
+            caught += 1
+    assert caught == len(SHRINK_REPLAY_SEEDS), (
+        "no-op'd shrink replay escaped the pinned chaos seeds"
     )
 
 

@@ -1,0 +1,830 @@
+"""The elastic gang plane (ISSUE 10; doc/fault-model.md "Elastic gang
+plane"): shrink-instead-of-evict, migration-aware remediation ordering,
+opportunistic grow, mixed-generation crash recovery, and the
+checkpoint-coordinated defragmenter.
+
+Acceptance anchors covered here:
+  - a 4-chip host losing one chip shrinks a resident 4-pod-min-3 gang in
+    place instead of evicting it (test_shrink_instead_of_evict);
+  - stranded remediation orders opportunistic gangs before any
+    guaranteed gang, asserted via the decision journal
+    (test_remediation_ordering_journal);
+  - min/max member-count bounds round-trip and malformed bounds are
+    rejected (test_spec_bounds_*);
+  - a crash mid-shrink (mixed annotation generations) recovers
+    deterministically into the shrunken gang, re-evicting the dropped
+    member (test_mid_shrink_crash_recovers);
+  - the defragmenter proposes a checkpoint-coordinated migration that
+    merges a fragmented slice back into a whole free cell
+    (test_defrag_migration_merges_fragment).
+"""
+
+import yaml
+
+from hivedscheduler_tpu.api import constants, extender as ei, types as api
+from hivedscheduler_tpu.api.config import Config
+from hivedscheduler_tpu.scheduler.framework import HivedScheduler
+from hivedscheduler_tpu.scheduler.types import Node, Pod, PodState
+from hivedscheduler_tpu.tpu import topology
+
+from . import chaos
+from .test_core import make_pod
+
+
+def elastic_config(
+    slices=1,
+    solos=1,
+    stranded_eviction=True,
+    shrink=True,
+    defrag=False,
+    host_quota=False,
+):
+    """A deterministic little fleet: ``slices`` v5e-16 slices +
+    ``solos`` standalone v5e hosts, VC A holding everything.
+    ``host_quota`` carves the slice quota at HOST granularity
+    (``v5e-16.v5e-host``) — the shape whose preassigned-cell bindings
+    fragment the buddy hierarchy and give the defragmenter work."""
+    cell_types = topology.v5e_cell_types(max_hosts=4)
+    physical = [
+        topology.make_physical_cell(
+            "v5e-16", [f"s{i}-w{j}" for j in range(4)], cell_types
+        ).to_dict()
+        for i in range(slices)
+    ]
+    physical += [
+        topology.make_physical_cell(
+            "v5e-host", [f"solo-{h}"], cell_types
+        ).to_dict()
+        for h in range(solos)
+    ]
+    vc_a = {"virtualCells": []}
+    if slices:
+        if host_quota:
+            vc_a["virtualCells"].append(
+                {"cellType": "v5e-16.v5e-host", "cellNumber": 4 * slices}
+            )
+        else:
+            vc_a["virtualCells"].append(
+                {"cellType": "v5e-16", "cellNumber": slices}
+            )
+    if solos:
+        vc_a["virtualCells"].append(
+            {"cellType": "v5e-host", "cellNumber": solos}
+        )
+    return Config.from_dict(
+        {
+            "physicalCluster": {
+                "cellTypes": {k: v.to_dict() for k, v in cell_types.items()},
+                "physicalCells": physical,
+            },
+            "virtualClusters": {"A": vc_a},
+            "strandedGangEviction": stranded_eviction,
+            "elasticGangShrink": shrink,
+            "defragEnable": defrag,
+            "defragIntervalTicks": 1,
+        }
+    )
+
+
+def booted(config):
+    kube = chaos.ScriptedKubeClient()
+    sched = HivedScheduler(
+        config, kube_client=kube, force_bind_executor=lambda fn: fn()
+    )
+    for n in sched.core.configured_node_names():
+        sched.add_node(Node(name=n))
+    sched.mark_ready()
+    return sched, kube
+
+
+def bind_gang(sched, kube, name, vc, priority, n_pods, chips,
+              min_members=0, max_members=0, cluster=None):
+    group = {
+        "name": name,
+        "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
+    }
+    if min_members:
+        group["minMembers"] = min_members
+    if max_members:
+        group["maxMembers"] = max_members
+    nodes = sorted(sched.nodes)
+    bound = []
+    for i in range(n_pods):
+        pod = make_pod(
+            f"{name}-{i}", f"u-{name}-{i}", vc, priority, "v5e-chip",
+            chips, group=group,
+        )
+        sched.add_pod(pod)
+        r = sched.filter_routine(ei.ExtenderArgs(pod=pod, node_names=nodes))
+        assert r.node_names, (name, i, r.failed_nodes)
+        sched.bind_routine(
+            ei.ExtenderBindingArgs(
+                pod_name=pod.name, pod_namespace=pod.namespace,
+                pod_uid=pod.uid, node=r.node_names[0],
+            )
+        )
+        b = kube.bound[pod.uid]
+        b.phase = "Running"
+        sched.update_pod(pod, b)
+        if cluster is not None:
+            cluster[pod.uid] = b
+        bound.append(b)
+    return bound
+
+
+def deliver_chip_fault(sched, node, chips):
+    ann = {
+        constants.ANNOTATION_NODE_DEVICE_HEALTH: ",".join(
+            str(c) for c in sorted(chips)
+        )
+    }
+    sched.update_node(Node(name=node), Node(name=node, annotations=ann))
+
+
+# --------------------------------------------------------------------- #
+# Satellite 1: spec round-trip + malformed bounds
+# --------------------------------------------------------------------- #
+
+
+def test_spec_bounds_round_trip():
+    d = {
+        "name": "g",
+        "members": [{"podNumber": 4, "leafCellNumber": 1}],
+        "minMembers": 3,
+        "maxMembers": 6,
+    }
+    spec = api.AffinityGroupSpec.from_dict(d)
+    assert (spec.min_members, spec.max_members, spec.total_members) == (3, 6, 4)
+    assert spec.to_dict() == d
+    rt = api.AffinityGroupSpec.from_dict(spec.to_dict())
+    assert (rt.min_members, rt.max_members) == (3, 6)
+    # Absent bounds stay absent on the wire (GPU-era configs untouched).
+    bare = api.AffinityGroupSpec.from_dict(
+        {"name": "g", "members": [{"podNumber": 2, "leafCellNumber": 4}]}
+    )
+    assert "minMembers" not in bare.to_dict()
+    assert "maxMembers" not in bare.to_dict()
+    # The full pod-scheduling-spec annotation carries the bounds through.
+    ps = api.PodSchedulingSpec.from_dict(
+        {"virtualCluster": "A", "priority": 0, "leafCellType": "v5e-chip",
+         "leafCellNumber": 1, "affinityGroup": d}
+    )
+    assert ps.to_dict()["affinityGroup"]["minMembers"] == 3
+
+
+def test_spec_bounds_rejected():
+    base = {"name": "g", "members": [{"podNumber": 2, "leafCellNumber": 1}]}
+    for bad in (
+        {**base, "minMembers": 3},     # min > members
+        {**base, "minMembers": -1},    # min <= 0
+        {**base, "maxMembers": 1},     # max < members
+        {**base, "maxMembers": -2},
+    ):
+        try:
+            api.AffinityGroupSpec.from_dict(bad)
+            raise AssertionError(f"malformed bounds accepted: {bad}")
+        except api.WebServerError as e:
+            assert e.code == 400
+
+    # A malformed annotation is a 400 at the scheduling-spec layer too.
+    pod = make_pod(
+        "x-0", "u-x", "A", 0, "v5e-chip", 1,
+        group={**base, "minMembers": 9},
+    )
+    from hivedscheduler_tpu.scheduler.types import (
+        extract_pod_scheduling_spec,
+    )
+    try:
+        extract_pod_scheduling_spec(pod)
+        raise AssertionError("malformed bounds accepted via annotation")
+    except api.WebServerError as e:
+        assert e.code == 400
+
+
+# --------------------------------------------------------------------- #
+# Tentpole 1: shrink instead of evict
+# --------------------------------------------------------------------- #
+
+
+def test_shrink_instead_of_evict():
+    """A 4-chip host loses one chip: the resident 4-pod (1 chip each)
+    minMembers=3 gang SHRINKS — exactly the stranded member is evicted,
+    the healthy placement is kept, the survivors' annotations carry the
+    new generation — instead of the whole gang being deleted."""
+    sched, kube = booted(elastic_config(slices=0, solos=1))
+    pods = bind_gang(
+        sched, kube, "el", "A", 0, n_pods=4, chips=1, min_members=3
+    )
+    g = sched.core.affinity_groups["el"]
+    assert g.min_members == 3 and g.total_pods == 4
+
+    # Which pod sits on chip 0 of the solo host?
+    victim = next(
+        p for p in pods
+        if p.annotations[
+            constants.ANNOTATION_POD_LEAF_CELL_ISOLATION
+        ] == "0"
+    )
+    deliver_chip_fault(sched, "solo-0", {0})
+
+    g = sched.core.affinity_groups["el"]
+    assert g.total_pods == 3, "gang must shrink, not be evicted"
+    assert g.resize_generation == 1
+    for rows in g.physical_placement.values():
+        for row in rows:
+            for leaf in row:
+                assert leaf is not None and leaf.healthy
+    # Exactly the stranded member was evicted.
+    assert kube.evicted == [victim.uid]
+    m = sched.get_metrics()
+    assert m["gangShrinkCount"] == 1
+    assert m["strandedEvictionCount"] == 1  # the dropped pod's delete
+    # Survivors' annotations were rewritten transactionally (spec +
+    # bind info + TPU env), with the new generation.
+    patched_uids = {uid for uid, _ in kube.patches}
+    assert patched_uids == {p.uid for p in pods if p is not victim}
+    for uid, ann in kube.patches:
+        info = api.PodBindInfo.from_dict(
+            yaml.safe_load(ann[constants.ANNOTATION_POD_BIND_INFO])
+        )
+        assert info.resize_generation == 1
+        assert sum(
+            len(m.pod_placements) for m in info.affinity_group_bind_info
+        ) == 3
+        spec = yaml.safe_load(
+            ann[constants.ANNOTATION_POD_SCHEDULING_SPEC]
+        )
+        assert spec["affinityGroup"]["members"] == [
+            {"podNumber": 3, "leafCellNumber": 1}
+        ]
+        assert spec["affinityGroup"]["minMembers"] == 3
+        assert constants.ANNOTATION_POD_TPU_ENV in ann
+    # Decision journal: a remediate record with the shrink verdicts.
+    verdicts = [
+        d["verdict"] for d in sched.decisions.snapshot()
+        if d["phase"] == "remediate"
+    ]
+    assert "shrink" in verdicts and "shrink-applied" in verdicts
+    chaos.audit_invariants(sched, "post-shrink")
+
+    # The dropped pod's DELETED event is a clean no-op on the group.
+    sched.delete_pod(victim)
+    assert sched.core.affinity_groups["el"].total_pods == 3
+    chaos.audit_invariants(sched, "post-victim-delete")
+
+
+def test_shrink_below_min_falls_back_to_evict():
+    """Two chips die under a min-3 gang of 4: shrinking would leave 2 <
+    minMembers, so the whole gang is evicted (the pre-elastic path)."""
+    sched, kube = booted(elastic_config(slices=0, solos=1))
+    bind_gang(sched, kube, "el", "A", 0, n_pods=4, chips=1, min_members=3)
+    deliver_chip_fault(sched, "solo-0", {0, 1})
+    assert len(kube.evicted) == 4
+    assert sched.get_metrics()["gangShrinkCount"] == 0
+    verdicts = [
+        d["verdict"] for d in sched.decisions.snapshot()
+        if d["phase"] == "remediate"
+    ]
+    assert verdicts and "evict" in verdicts
+    chaos.audit_invariants(sched, "post-evict")
+
+
+def test_inelastic_gang_still_evicted():
+    sched, kube = booted(elastic_config(slices=0, solos=1))
+    bind_gang(sched, kube, "fx", "A", 0, n_pods=4, chips=1)  # no bounds
+    deliver_chip_fault(sched, "solo-0", {2})
+    assert len(kube.evicted) == 4
+    assert sched.get_metrics()["gangShrinkCount"] == 0
+
+
+# --------------------------------------------------------------------- #
+# Tentpole 2: migration-aware remediation ordering
+# --------------------------------------------------------------------- #
+
+
+def test_remediation_ordering_journal():
+    """A node going bad strands one OPPORTUNISTIC gang and one
+    GUARANTEED gang at once: the journal must show the opportunistic
+    gang remediated strictly before the guaranteed one."""
+    sched, kube = booted(elastic_config(slices=1, solos=0))
+    # Two 2-pod gangs on the same slice host: one opportunistic (-1),
+    # one guaranteed (0); 2 chips each fills the 4-chip host s0-w0.
+    bind_gang(sched, kube, "opp", "A", -1, n_pods=1, chips=2)
+    bind_gang(sched, kube, "gtd", "A", 0, n_pods=1, chips=2)
+    opp_node = next(
+        iter(
+            {
+                leaf.nodes[0]
+                for rows in sched.core.affinity_groups[
+                    "opp"
+                ].physical_placement.values()
+                for row in rows for leaf in row
+            }
+        )
+    )
+    gtd_nodes = {
+        leaf.nodes[0]
+        for rows in sched.core.affinity_groups[
+            "gtd"
+        ].physical_placement.values()
+        for row in rows for leaf in row
+    }
+    # Strand both gangs: their nodes all go bad in one sweep.
+    for n in sorted({opp_node} | gtd_nodes):
+        sched.update_node(Node(name=n), Node(name=n, ready=False))
+    remediate = [
+        d for d in sched.decisions.snapshot()
+        if d["phase"] == "remediate" and d["verdict"] in ("shrink", "evict")
+    ]
+    seq = {d["group"]: d["seq"] for d in remediate}
+    assert "opp" in seq and "gtd" in seq, remediate
+    assert seq["opp"] < seq["gtd"], (
+        "opportunistic gangs must be remediated before guaranteed ones",
+        remediate,
+    )
+    # And the eviction queue order followed the plan.
+    assert kube.evicted.index("u-opp-0") < kube.evicted.index("u-gtd-0")
+
+
+# --------------------------------------------------------------------- #
+# Tentpole 1b: opportunistic grow into idle capacity
+# --------------------------------------------------------------------- #
+
+
+def test_opportunistic_gang_grows():
+    sched, kube = booted(elastic_config(slices=0, solos=1))
+    bind_gang(
+        sched, kube, "gr", "A", -1, n_pods=2, chips=1, max_members=4
+    )
+    g = sched.core.affinity_groups["gr"]
+    assert g.total_pods == 2 and g.max_members == 4
+
+    group = {
+        "name": "gr",
+        "members": [{"podNumber": 2, "leafCellNumber": 1}],
+        "maxMembers": 4,
+    }
+    extra = make_pod("gr-2", "u-gr-2", "A", -1, "v5e-chip", 1, group=group)
+    sched.add_pod(extra)
+    nodes = sorted(sched.nodes)
+    r = sched.filter_routine(ei.ExtenderArgs(pod=extra, node_names=nodes))
+    assert r.node_names, r.failed_nodes
+    sched.bind_routine(
+        ei.ExtenderBindingArgs(
+            pod_name=extra.name, pod_namespace=extra.namespace,
+            pod_uid=extra.uid, node=r.node_names[0],
+        )
+    )
+    b = kube.bound["u-gr-2"]
+    b.phase = "Running"
+    sched.update_pod(extra, b)
+
+    g = sched.core.affinity_groups["gr"]
+    assert g.total_pods == 3
+    assert g.resize_generation == 1
+    assert sched.get_metrics()["gangGrowCount"] == 1
+    info = api.PodBindInfo.from_dict(
+        yaml.safe_load(b.annotations[constants.ANNOTATION_POD_BIND_INFO])
+    )
+    assert info.resize_generation == 1
+    chaos.audit_invariants(sched, "post-grow")
+
+    # A fixed-size gang at capacity still gets the hard 400.
+    fixed_group = {
+        "name": "gr2", "members": [{"podNumber": 1, "leafCellNumber": 1}],
+    }
+    bind_gang(sched, kube, "gr2", "A", -1, n_pods=1, chips=1)
+    over = make_pod(
+        "gr2-1", "u-gr2-1", "A", -1, "v5e-chip", 1, group=fixed_group
+    )
+    sched.add_pod(over)
+    try:
+        sched.filter_routine(ei.ExtenderArgs(pod=over, node_names=nodes))
+        raise AssertionError("fixed-size overflow must reject")
+    except api.WebServerError as e:
+        assert e.code == 400
+
+
+def test_grow_pod_replaying_first_rebuilds_grown_gang():
+    """Regression (review finding): a restart that replays the GROW pod
+    FIRST must rebuild the grown gang — the bind info's rows are the
+    durable truth even when a member's spec annotation is stale — and
+    the grow confirm must re-sync the grow pod's own spec annotation
+    (same generation, different member count) so the window closes."""
+    cluster = {}
+    config = elastic_config(slices=0, solos=1)
+    sched, kube = booted(config)
+
+    def on_patch(pod, patch):
+        cur = cluster.get(pod.uid)
+        if cur is None:
+            return
+        for k, v in patch.items():
+            if v is None:
+                cur.annotations.pop(k, None)
+            else:
+                cur.annotations[k] = v
+    kube.on_patch = on_patch
+    bind_gang(
+        sched, kube, "gr", "A", -1, n_pods=2, chips=1, max_members=4,
+        cluster=cluster,
+    )
+    group = {
+        "name": "gr",
+        "members": [{"podNumber": 2, "leafCellNumber": 1}],
+        "maxMembers": 4,
+    }
+    extra = make_pod("gr-2", "u-gr-2", "A", -1, "v5e-chip", 1, group=group)
+    cluster[extra.uid] = extra
+    sched.add_pod(extra)
+    r = sched.filter_routine(
+        ei.ExtenderArgs(pod=extra, node_names=sorted(sched.nodes))
+    )
+    assert r.node_names
+    sched.bind_routine(
+        ei.ExtenderBindingArgs(
+            pod_name=extra.name, pod_namespace=extra.namespace,
+            pod_uid=extra.uid, node=r.node_names[0],
+        )
+    )
+    b = kube.bound["u-gr-2"]
+    b.phase = "Running"
+    sched.update_pod(extra, b)
+    cluster[extra.uid] = b
+    continuous = chaos.core_fingerprint(sched.core)
+    # The grow re-sync patched every member — grow pod included — to the
+    # grown member count.
+    for uid, p in cluster.items():
+        spec = api.PodSchedulingSpec.from_dict(
+            yaml.safe_load(
+                p.annotations[constants.ANNOTATION_POD_SCHEDULING_SPEC]
+            )
+        )
+        assert spec.affinity_group.total_members == 3, (uid, spec)
+
+    # Replay GROW POD FIRST (reverse uid order puts u-gr-2 before
+    # u-gr-0/1 is not guaranteed — order explicitly).
+    order = ["u-gr-2", "u-gr-0", "u-gr-1"]
+    kube2 = chaos.ScriptedKubeClient()
+    kube2.state = kube.state
+    s2 = HivedScheduler(
+        config, kube_client=kube2, force_bind_executor=lambda fn: fn()
+    )
+    nodes = [Node(name=n) for n in sorted(s2.core.configured_node_names())]
+    s2.recover(nodes, [cluster[u] for u in order])
+    g2 = s2.core.affinity_groups["gr"]
+    assert g2.total_pods == 3 and g2.resize_generation == 1
+    assert not s2.quarantined_pods
+    assert chaos.core_fingerprint(s2.core) == continuous
+    chaos.audit_invariants(s2, "grow-pod-first-recovery")
+
+
+def test_grow_waits_when_no_capacity():
+    """An elastic gang with headroom but a full fleet WAITS (retried on
+    capacity-freeing events) instead of being rejected."""
+    sched, kube = booted(elastic_config(slices=0, solos=1))
+    bind_gang(
+        sched, kube, "full", "A", -1, n_pods=4, chips=1, max_members=6
+    )
+    group = {
+        "name": "full",
+        "members": [{"podNumber": 4, "leafCellNumber": 1}],
+        "maxMembers": 6,
+    }
+    extra = make_pod("full-4", "u-full-4", "A", -1, "v5e-chip", 1,
+                     group=group)
+    sched.add_pod(extra)
+    r = sched.filter_routine(
+        ei.ExtenderArgs(pod=extra, node_names=sorted(sched.nodes))
+    )
+    assert not r.node_names  # waiting, not rejected
+    assert sched.pod_schedule_statuses["u-full-4"].pod_state == (
+        PodState.WAITING
+    )
+
+
+# --------------------------------------------------------------------- #
+# Crash recovery: mixed generations replay deterministically
+# --------------------------------------------------------------------- #
+
+
+def _recover_fresh(config, kube, cluster):
+    s2 = HivedScheduler(
+        config, kube_client=kube, force_bind_executor=lambda fn: fn()
+    )
+    nodes = [Node(name=n) for n in sorted(s2.core.configured_node_names())]
+    s2.recover(nodes, [cluster[u] for u in sorted(cluster)])
+    return s2
+
+
+def test_mid_shrink_crash_recovers():
+    """Crash windows of the shrink protocol: survivors patched to the
+    new generation but the dropped member's eviction never landed. The
+    replay must rebuild the SHRUNKEN gang (whichever generation replays
+    first), re-queue the orphan's eviction, and converge to the
+    continuous scheduler's end state."""
+    cluster = {}
+    config = elastic_config(slices=0, solos=1)
+    sched, kube = booted(config)
+    pods = bind_gang(
+        sched, kube, "el", "A", 0, n_pods=4, chips=1, min_members=3,
+        cluster=cluster,
+    )
+    victim = next(
+        p for p in pods
+        if p.annotations[
+            constants.ANNOTATION_POD_LEAF_CELL_ISOLATION
+        ] == "0"
+    )
+    # Fold survivor patches into the cluster truth, as the apiserver
+    # would; the eviction is NOT folded (the crash beats the delete).
+    def on_patch(pod, patch):
+        cur = cluster.get(pod.uid)
+        if cur is None:
+            return
+        for k, v in patch.items():
+            if v is None:
+                cur.annotations.pop(k, None)
+            else:
+                cur.annotations[k] = v
+    kube.on_patch = on_patch
+    deliver_chip_fault(sched, "solo-0", {0})
+    assert sched.core.affinity_groups["el"].total_pods == 3
+    continuous = chaos.core_fingerprint(sched.core)
+
+    # Crash. The cluster still holds all 4 pods (victim's delete never
+    # landed) with MIXED generations, and the node still reports chip 0
+    # bad.
+    kube2 = chaos.ScriptedKubeClient()
+    kube2.state = kube.state  # the doomed-ledger ConfigMap survives
+    s2 = HivedScheduler(
+        config, kube_client=kube2, force_bind_executor=lambda fn: fn()
+    )
+    node_objs = []
+    for n in sorted(s2.core.configured_node_names()):
+        ann = (
+            {constants.ANNOTATION_NODE_DEVICE_HEALTH: "0"}
+            if n == "solo-0"
+            else {}
+        )
+        node_objs.append(Node(name=n, annotations=ann))
+    s2.recover(node_objs, [cluster[u] for u in sorted(cluster)])
+
+    g2 = s2.core.affinity_groups["el"]
+    assert g2.total_pods == 3 and g2.resize_generation == 1
+    assert chaos.core_fingerprint(s2.core) == continuous
+    # The orphan (shrunk-away, never-deleted member) was re-evicted.
+    assert kube2.evicted == [victim.uid]
+    # Survivors are BOUND; the orphan is tracked but holds no cells.
+    for p in pods:
+        st = s2.pod_schedule_statuses.get(p.uid)
+        assert st is not None and st.pod_state == PodState.BOUND
+    chaos.audit_invariants(s2, "mid-shrink-recovery")
+
+    # Replay-order independence: reverse the replay order (the stale
+    # victim annotation replays FIRST and creates the full group, the
+    # newer survivors then upgrade it) — same end state.
+    kube3 = chaos.ScriptedKubeClient()
+    kube3.state = kube.state
+    s3 = HivedScheduler(
+        config, kube_client=kube3, force_bind_executor=lambda fn: fn()
+    )
+    s3.recover(
+        node_objs, [cluster[u] for u in sorted(cluster, reverse=True)]
+    )
+    assert chaos.core_fingerprint(s3.core) == continuous
+    assert kube3.evicted == [victim.uid]
+    chaos.audit_invariants(s3, "mid-shrink-recovery-reversed")
+
+
+def test_shrink_patch_fault_rolls_back():
+    """A survivor annotation patch failing mid-shrink rolls the
+    already-patched survivors back and aborts; the gang stays whole (and
+    stranded) and the abort is journaled."""
+    sched, kube = booted(elastic_config(slices=0, solos=1))
+    pods = bind_gang(
+        sched, kube, "el", "A", 0, n_pods=4, chips=1, min_members=3
+    )
+    # Keep every patch write failing through the initial attempt AND the
+    # in-flush retry round (first patch succeeds so there is something
+    # to roll back; the rollback itself must also survive a fault-free
+    # slot, hence the explicit None).
+    kube.patch_fault_queue.extend(
+        [None, chaos.transient_fault(), None]
+        + [chaos.transient_fault()] * 8
+    )
+    deliver_chip_fault(sched, "solo-0", {0})
+    g = sched.core.affinity_groups.get("el")
+    assert g is not None and g.total_pods == 4
+    assert g.resize_generation == 0
+    m = sched.get_metrics()
+    assert m["gangShrinkAbortCount"] >= 1
+    assert m["gangShrinkCount"] == 0
+    verdicts = [
+        d["verdict"] for d in sched.decisions.snapshot()
+        if d["phase"] == "remediate"
+    ]
+    assert "shrink-abort" in verdicts
+    # Every survivor's LIVE annotations still decode at generation 0
+    # (the rollback undid the one patch that landed).
+    for p in pods:
+        info = api.PodBindInfo.from_dict(
+            yaml.safe_load(
+                p.annotations[constants.ANNOTATION_POD_BIND_INFO]
+            )
+        )
+        assert info.resize_generation == 0
+    chaos.audit_invariants(sched, "post-abort")
+
+    # Once the write path heals, the next flush round retries the shrink
+    # to completion (the retry-pending flag re-arms it).
+    kube.patch_fault_queue.clear()
+    sched.health_tick()
+    assert sched.core.affinity_groups["el"].total_pods == 3
+    assert sched.get_metrics()["gangShrinkCount"] == 1
+    chaos.audit_invariants(sched, "post-retry")
+
+
+def test_snapshot_restore_carries_resize_state():
+    """The durable projection replays a shrink: export after shrinking,
+    restore into a fresh core, and the group must come back at the
+    shrunken shape and generation."""
+    sched, kube = booted(elastic_config(slices=0, solos=1))
+    bind_gang(sched, kube, "el", "A", 0, n_pods=4, chips=1, min_members=3)
+    deliver_chip_fault(sched, "solo-0", {0})
+    g = sched.core.affinity_groups["el"]
+    assert g.total_pods == 3 and g.resize_generation == 1
+
+    chunks = sched.export_snapshot()
+    assert chunks is not None
+    s2, _ = booted(elastic_config(slices=0, solos=1))
+    import hivedscheduler_tpu.scheduler.snapshot as snapshot_mod
+    decoded, reason = snapshot_mod.decode(
+        chunks, expected_fingerprint=s2._config_fingerprint
+    )
+    assert decoded is not None, reason
+    nodes = [
+        Node(
+            name=n,
+            annotations=(
+                {constants.ANNOTATION_NODE_DEVICE_HEALTH: "0"}
+                if n == "solo-0" else {}
+            ),
+        )
+        for n in sorted(s2.core.configured_node_names())
+    ]
+    assert s2.import_snapshot(decoded, nodes)
+    g2 = s2.core.affinity_groups["el"]
+    assert g2.total_pods == 3
+    assert g2.resize_generation == 1
+    assert g2.min_members == 3
+    assert chaos.leaf_fingerprint(s2.core) == chaos.leaf_fingerprint(
+        sched.core
+    )
+
+
+# --------------------------------------------------------------------- #
+# Tentpole 3: the defragmenter
+# --------------------------------------------------------------------- #
+
+
+def _bind_steered(sched, kube, name, uid, nodes):
+    """Bind a 1-pod 1-chip guaranteed gang onto a restricted node set
+    (suggested-node steering, ignoreK8sSuggestedNodes=False)."""
+    group = {"name": name, "members": [{"podNumber": 1, "leafCellNumber": 1}]}
+    pod = make_pod(
+        f"{name}-0", uid, "A", 0, "v5e-chip", 1, group=group,
+        ignore_suggested=False,
+    )
+    sched.add_pod(pod)
+    r = sched.filter_routine(ei.ExtenderArgs(pod=pod, node_names=nodes))
+    assert r.node_names, (name, r.failed_nodes)
+    sched.bind_routine(
+        ei.ExtenderBindingArgs(
+            pod_name=pod.name, pod_namespace=pod.namespace,
+            pod_uid=pod.uid, node=r.node_names[0],
+        )
+    )
+    b = kube.bound[uid]
+    b.phase = "Running"
+    sched.update_pod(pod, b)
+    return b
+
+
+def test_defrag_migration_merges_fragment():
+    """Two v5e-16 slices each fragmented by one 1-chip guaranteed
+    squatter (host-granular quota: each binds a whole host out of the
+    free lists, splitting its slice): the defragmenter proposes a
+    checkpoint-coordinated migration (drain-annotation handshake,
+    re-filter probe off the fragment), the driver executes it, and the
+    vacated slice's buddies merge back into a whole free 16-chip
+    cell."""
+    config = elastic_config(slices=2, solos=0, defrag=True, host_quota=True)
+    sched, kube = booted(config)
+    bind_gang(sched, kube, "sq-a", "A", 0, n_pods=1, chips=1)
+    # Packing would co-locate the second squatter next to the first;
+    # steer it onto the second slice so BOTH slices are fragmented.
+    _bind_steered(
+        sched, kube, "sq-b", "u-sq-b-0",
+        [n for n in sorted(sched.nodes) if n.startswith("s1-")],
+    )
+    before = sched.core.free_slice_distribution()
+    assert "16" not in before, before  # both slices fragmented
+
+    n_proposed = sched.run_defrag_cycle_now()
+    assert n_proposed == 1  # rate limit: one migration per cycle
+    proposals = sched.take_defrag_proposals()
+    assert len(proposals) == 1
+    prop = proposals[0]
+    assert prop["group"] in ("sq-a", "sq-b")
+    assert prop["avoidNodes"], prop
+    m = sched.get_metrics()
+    assert m["defragProposalCount"] == 1
+    # The drain handshake annotation landed on the gang's pod.
+    g = sched.core.affinity_groups[prop["group"]]
+    pod = next(
+        p for rows in g.allocated_pods.values() for p in rows
+        if p is not None
+    )
+    assert constants.ANNOTATION_POD_DEFRAG_MIGRATION in pod.annotations
+
+    # The workload controller checkpoints + deletes + resubmits (the sim
+    # tier's migration verbs, in miniature).
+    victim_pods = [
+        p for rows in g.allocated_pods.values() for p in rows
+        if p is not None
+    ]
+    for p in victim_pods:
+        sched.delete_pod(p)
+    avoid = set(prop["avoidNodes"])
+    refilter_nodes = [n for n in sorted(sched.nodes) if n not in avoid]
+    group = {
+        "name": prop["group"],
+        "members": [{"podNumber": 1, "leafCellNumber": 1}],
+    }
+    moved = make_pod(
+        f"{prop['group']}-m0", f"u-{prop['group']}-m0", "A", 0,
+        "v5e-chip", 1, group=group, ignore_suggested=False,
+    )
+    sched.add_pod(moved)
+    r = sched.filter_routine(
+        ei.ExtenderArgs(pod=moved, node_names=refilter_nodes)
+    )
+    assert r.node_names and r.node_names[0] not in avoid
+    sched.bind_routine(
+        ei.ExtenderBindingArgs(
+            pod_name=moved.name, pod_namespace=moved.namespace,
+            pod_uid=moved.uid, node=r.node_names[0],
+        )
+    )
+    sched.defrag.report_migration(prop["group"], ok=True)
+
+    after = sched.core.free_slice_distribution()
+    assert after.get("16", 0) >= 1, (before, after)
+    assert sched.get_metrics()["defragMigrationCount"] == 1
+    verdicts = [
+        d["verdict"] for d in sched.decisions.snapshot()
+        if d["phase"] == "defrag"
+    ]
+    assert "defrag-propose" in verdicts and "defrag-migrate" in verdicts
+    chaos.audit_invariants(sched, "post-defrag")
+
+
+def test_defrag_cancel_releases_reservation():
+    """A migration whose re-filter fails is cancelled: the handshake
+    annotation is cleared and the cancel is counted + journaled."""
+    config = elastic_config(slices=2, solos=0, defrag=True, host_quota=True)
+    sched, kube = booted(config)
+    bind_gang(sched, kube, "sq-a", "A", 0, n_pods=1, chips=1)
+    _bind_steered(
+        sched, kube, "sq-b", "u-sq-b-0",
+        [n for n in sorted(sched.nodes) if n.startswith("s1-")],
+    )
+    assert sched.run_defrag_cycle_now() == 1
+    prop = sched.take_defrag_proposals()[0]
+    g = sched.core.affinity_groups[prop["group"]]
+    pod = next(
+        p for rows in g.allocated_pods.values() for p in rows
+        if p is not None
+    )
+    assert constants.ANNOTATION_POD_DEFRAG_MIGRATION in pod.annotations
+    sched.defrag.report_migration(
+        prop["group"], ok=False, reason="no compacting placement"
+    )
+    sched.health_tick()  # flush the annotation clear
+    assert constants.ANNOTATION_POD_DEFRAG_MIGRATION not in pod.annotations
+    assert sched.get_metrics()["defragCancelCount"] == 1
+    verdicts = [
+        d["verdict"] for d in sched.decisions.snapshot()
+        if d["phase"] == "defrag"
+    ]
+    assert "defrag-cancel" in verdicts
+
+
+def test_defrag_off_by_default():
+    sched, kube = booted(
+        elastic_config(slices=2, solos=0, defrag=False, host_quota=True)
+    )
+    assert sched.defrag is None
+    assert sched.run_defrag_cycle_now() == 0
+    assert sched.take_defrag_proposals() == []

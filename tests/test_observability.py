@@ -654,7 +654,9 @@ def test_chaos_invariant_failure_dumps_decision_artifact(tmp_path, monkeypatch):
         raise AssertionError("synthetic invariant failure")
 
     monkeypatch.setattr(chaos.ChaosHarness, "run", exploding_run)
-    monkeypatch.setattr(chaos, "ChaosHarness", lambda seed: harness)
+    monkeypatch.setattr(
+        chaos, "ChaosHarness", lambda seed, **kw: harness
+    )
     with pytest.raises(AssertionError) as exc:
         chaos.run_chaos_schedule(3)
     dump = tmp_path / "chaos-seed3-decisions.json"

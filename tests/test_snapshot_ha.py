@@ -61,6 +61,9 @@ GOLDEN_COUNTER_KEYS = {"vcFree", "allVCFree", "totalLeft", "allVCDoomed"}
 GOLDEN_GROUP_KEYS = {
     "spec", "vc", "lazyPreemptionEnable", "priority", "state",
     "ignoreSuggested", "lazyPreemptionStatus", "phys", "virt",
+    # Elastic gang plane (ISSUE 10): the resize generation must survive
+    # snapshot restore or a mid-shrink crash replays stale placements.
+    "resizeGeneration",
 }
 GOLDEN_PHYS_REC_ARITY = 9  # state, prio, healthy, draining, split,
 #                            usingGroup, virtualAddr, usedAtPrio, unusable
