@@ -548,6 +548,232 @@ def bench_concurrent(
 
 
 # ---------------------------------------------------------------------- #
+# Boot stage (HIVED_BENCH_BOOT=1): the 50k-host boot ladder
+# (doc/hot-path.md "Boot and transport plane")
+# ---------------------------------------------------------------------- #
+
+# First-boot wall budget at 50k synthetic hosts (compile + health-init +
+# node-add + fingerprint; recovery replay excluded — it scales with BOUND
+# PODS, not hosts). The ladder extrapolates linearly (every phase is
+# O(fleet)) and the artifact records both the fit and, when
+# HIVED_BENCH_BOOT_50K=1 (hack/soak.sh --boot-profile), the real rung.
+BOOT_BUDGET_50K_S = 30.0
+
+
+def _measure_boot(hosts: int, new_path: bool) -> dict:
+    """One cold boot at ``hosts`` synthetic hosts through the production
+    constructor + informer-shaped node replay. ``new_path=False`` pins
+    every escape hatch to the pre-PR behavior (eager all-VC compile,
+    per-leaf health bootstrap, per-node informer adds) — the A/B baseline
+    measured on THIS host, not the ledger's recorded numbers."""
+    from hivedscheduler_tpu.sim.fleet import fleet_dims_for_hosts
+
+    env = {
+        "HIVED_LAZY_VC": "1" if new_path else "0",
+        "HIVED_BOOT_FOLD": "1" if new_path else "0",
+    }
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        cfg = build_config(*fleet_dims_for_hosts(hosts))
+        gc.collect()
+        t0 = time.perf_counter()
+        sched = HivedScheduler(cfg, kube_client=NullKubeClient())
+        ctor_s = time.perf_counter() - t0
+        nodes = [
+            Node(name=n) for n in sched.core.configured_node_names()
+        ]
+        t1 = time.perf_counter()
+        if new_path:
+            sched.add_nodes(nodes)
+        else:
+            for n in nodes:
+                sched.add_node(n)
+        node_s = time.perf_counter() - t1
+        sched.mark_ready()
+        phases = {
+            k: round(v, 4)
+            for k, v in sched.core.boot_phase_seconds.items()
+        }
+        return {
+            "hosts": hosts,
+            "nodes": len(nodes),
+            "constructor_s": round(ctor_s, 3),
+            "node_add_s": round(node_s, 3),
+            "total_s": round(ctor_s + node_s, 3),
+            "phases": phases,
+            "vcs_compiled": len(
+                sched.core.vc_schedulers._compiled
+            ),
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def bench_boot(
+    ladder=(10368, 25920), reps: int = 3, include_50k: bool = False
+) -> dict:
+    """Boot ladder A/B (HIVED_BENCH_BOOT=1): cold-boot wall (compile +
+    health-init + node-add + fingerprint) at 10k/25k synthetic hosts,
+    new defaults vs the escape-hatched pre-PR path, interleaved, medians
+    of ``reps`` at the first rung. The acceptance gate is >= 2.5x at the
+    10k rung; the boot win is single-core (lazy VC compile + folded
+    health bootstrap + batched adds + streamed fingerprint), so unlike
+    bench_procs the gate does not presume spare cores — cpu_count is
+    still stamped for honesty. The 50k rung runs only under
+    HIVED_BENCH_BOOT_50K=1 (hack/soak.sh --boot-profile); otherwise the
+    artifact extrapolates linearly from the ladder (every phase is
+    O(fleet)) against BOOT_BUDGET_50K_S."""
+    t0 = time.perf_counter()
+    rungs: dict = {}
+    for i, hosts in enumerate(ladder):
+        n = reps if i == 0 else 1
+        olds, news = [], []
+        for _ in range(n):
+            olds.append(_measure_boot(hosts, new_path=False))
+            news.append(_measure_boot(hosts, new_path=True))
+        old_s = statistics.median(r["total_s"] for r in olds)
+        new_s = statistics.median(r["total_s"] for r in news)
+        rungs[str(hosts)] = {
+            "old_total_s": round(old_s, 3),
+            "new_total_s": round(new_s, 3),
+            "speedup": round(old_s / new_s, 2) if new_s else 0.0,
+            "new_phases": news[-1]["phases"],
+            "old_phases": olds[-1]["phases"],
+            "vcs_compiled_new": news[-1]["vcs_compiled"],
+        }
+    top = str(ladder[-1])
+    per_host = rungs[top]["new_total_s"] / float(top)
+    extrapolated = round(per_host * 50_000, 2)
+    out = {
+        "ladder": rungs,
+        "gate_rung_hosts": ladder[0],
+        "speedup_10k": rungs[str(ladder[0])]["speedup"],
+        "speedup_gate": 2.5,
+        "gate_passed": rungs[str(ladder[0])]["speedup"] >= 2.5,
+        "extrapolated_50k_s": extrapolated,
+        "boot_budget_50k_s": BOOT_BUDGET_50K_S,
+        "budget_met": extrapolated <= BOOT_BUDGET_50K_S,
+    }
+    if include_50k or os.environ.get("HIVED_BENCH_BOOT_50K") == "1":
+        r50 = _measure_boot(50_000, new_path=True)
+        out["measured_50k"] = r50
+        out["budget_met"] = r50["total_s"] <= BOOT_BUDGET_50K_S
+    return _stage_meta(out, max(ladder), t0)
+
+
+# ---------------------------------------------------------------------- #
+# Shard-ring A/B (HIVED_BENCH_RING=1): shared-memory filter payload ring
+# vs pipe payloads at the 1728-host fleet (doc/hot-path.md "Boot and
+# transport plane")
+# ---------------------------------------------------------------------- #
+
+
+def bench_ring_ab(
+    families: int = 4,
+    hosts_per_family: int = 432,
+    n_shards: int = 2,
+    reps: int = 5,
+    calls: int = 120,
+) -> dict:
+    """filter_raw p50/p99 through the proc-shards frontend, shared-memory
+    ring ON vs OFF (HIVED_SHARD_RING), same 1728-host fleet, identical
+    pre-built JSON bodies, reps interleaved across the two live frontends
+    and medians reported. Each rep schedules ``calls`` single-pod gangs
+    measuring per-call wall, then drains them, so every rep sees the same
+    state."""
+    from hivedscheduler_tpu.scheduler.shards import ShardedScheduler
+
+    t0 = time.perf_counter()
+    modes = {}
+    saved_ring = os.environ.get("HIVED_SHARD_RING")
+    try:
+        for label, ring in (("ring", "1"), ("pipe", "0")):
+            os.environ["HIVED_SHARD_RING"] = ring
+            cfg = build_concurrent_config(families, hosts_per_family)
+            sched = ShardedScheduler(
+                cfg, kube_client=NullKubeClient(), n_shards=n_shards,
+                transport="proc", auto_admit=True,
+            )
+            nodes = sorted(
+                f"cc{i}-s{s}-w{j}"
+                for i in range(families)
+                for s in range(max(1, hosts_per_family // 4))
+                for j in range(4)
+            )
+            for n in nodes:
+                sched.add_node(Node(name=n))
+            modes[label] = (sched, nodes)
+
+        lats: dict = {"ring": [], "pipe": []}
+        for rep in range(reps):
+            for label, (sched, nodes) in modes.items():
+                bound = []
+                per_call = []
+                for i in range(calls):
+                    fam = i % families
+                    gname = f"{label}-r{rep}-g{i}"
+                    group = {
+                        "name": gname,
+                        "members": [
+                            {"podNumber": 1, "leafCellNumber": 4}
+                        ],
+                    }
+                    pod = make_pod(
+                        f"{gname}-0", f"{gname}-u0", f"vc{fam}", 0,
+                        f"cc{fam}-chip", 4, group,
+                    )
+                    body = json.dumps(
+                        ei.ExtenderArgs(
+                            pod=pod, node_names=nodes
+                        ).to_dict()
+                    ).encode()
+                    sched.add_pod(pod)
+                    t1 = time.perf_counter()
+                    r = json.loads(sched.filter_raw(body))
+                    per_call.append(
+                        (time.perf_counter() - t1) * 1e3
+                    )
+                    if r.get("NodeNames"):
+                        bound.append(pod)
+                sched.delete_pods(bound)
+                lats[label].append(per_call)
+    finally:
+        if saved_ring is None:
+            os.environ.pop("HIVED_SHARD_RING", None)
+        else:
+            os.environ["HIVED_SHARD_RING"] = saved_ring
+        for sched, _ in modes.values():
+            sched.close()
+
+    def agg(all_reps):
+        flat = [x for rep in all_reps for x in rep]
+        p50, p99 = _percentiles(flat)
+        return round(p50, 3), round(p99, 3)
+
+    ring_p50, ring_p99 = agg(lats["ring"])
+    pipe_p50, pipe_p99 = agg(lats["pipe"])
+    return _stage_meta({
+        "families": families,
+        "hosts_per_family": hosts_per_family,
+        "n_shards": n_shards,
+        "reps": reps,
+        "calls_per_rep": calls,
+        "ring_p50_ms": ring_p50,
+        "ring_p99_ms": ring_p99,
+        "pipe_p50_ms": pipe_p50,
+        "pipe_p99_ms": pipe_p99,
+        "p50_improvement_pct": round(
+            (1.0 - ring_p50 / pipe_p50) * 100.0, 1
+        ) if pipe_p50 else 0.0,
+    }, families * hosts_per_family, t0)
+
+
+# ---------------------------------------------------------------------- #
 # Multi-process core stage (HIVED_BENCH_PROCS=1): per-chain-family worker
 # shards vs the in-process core (doc/hot-path.md "The multi-process
 # contract")
@@ -1510,6 +1736,47 @@ def model_perf() -> dict:
 
 
 if __name__ == "__main__":
+    if os.environ.get("HIVED_BENCH_BOOT") == "1":
+        # Boot ladder A/B (doc/hot-path.md "Boot and transport plane");
+        # HIVED_BENCH_BOOT_50K=1 adds the measured 50k rung
+        # (hack/soak.sh --boot-profile). Smoke sizing for CI:
+        # HIVED_BENCH_BOOT_SMOKE=1 runs one small rung, no reps.
+        if os.environ.get("HIVED_BENCH_BOOT_SMOKE") == "1":
+            result = bench_boot(ladder=(432, 864), reps=1)
+        else:
+            result = bench_boot()
+        print(
+            json.dumps(
+                {
+                    "metric": "boot_speedup_10k",
+                    "value": result["speedup_10k"],
+                    "unit": "x",
+                    "vs_baseline": round(
+                        result["speedup_10k"] / result["speedup_gate"], 3
+                    ),
+                    "extra": result,
+                }
+            )
+        )
+        sys.exit(0)
+    if os.environ.get("HIVED_BENCH_RING") == "1":
+        result = bench_ring_ab()
+        print(
+            json.dumps(
+                {
+                    "metric": "shard_ring_filter_p50",
+                    "value": result["ring_p50_ms"],
+                    "unit": "ms",
+                    "vs_baseline": round(
+                        result["ring_p50_ms"] / max(
+                            result["pipe_p50_ms"], 1e-9
+                        ), 3
+                    ),
+                    "extra": result,
+                }
+            )
+        )
+        sys.exit(0)
     if os.environ.get("HIVED_BENCH_SIM") == "1":
         # Standalone fleet-size trend stage (the default driver run
         # includes the same stage in its extra payload).
@@ -1698,6 +1965,8 @@ if __name__ == "__main__":
     relist_ab = bench_relist_ab()
     sim_stage = bench_sim()
     defrag_stage = bench_defrag()
+    boot_stage = bench_boot()
+    ring_ab = bench_ring_ab()
     perf = model_perf()
     print(
         json.dumps(
@@ -1720,6 +1989,8 @@ if __name__ == "__main__":
                     "relist_ab": relist_ab,
                     "sim": sim_stage,
                     "defrag": defrag_stage,
+                    "boot": boot_stage,
+                    "ring_ab": ring_ab,
                     "model_perf": perf,
                 },
             }

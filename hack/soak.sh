@@ -60,6 +60,20 @@ if [[ "${1:-}" == "--trace" ]]; then
   exit $?
 fi
 
+if [[ "${1:-}" == "--boot-profile" ]]; then
+  shift
+  # 50k-host boot + soak profile (doc/hot-path.md "Boot and transport
+  # plane"): the boot ladder A/B with the MEASURED 50k rung (not just
+  # the extrapolation), then the slow-marked 50k trace soak through the
+  # real scheduler. Artifact: one JSON line from the bench stage.
+  export JAX_PLATFORMS=cpu
+  echo "boot profile: 10k/25k ladder + measured 50k rung"
+  HIVED_BENCH_BOOT=1 HIVED_BENCH_BOOT_50K=1 python bench.py
+  echo "boot profile: 50k-host trace soak (slow tier)"
+  exec python -m pytest tests/test_sim_smoke.py::test_soak_profile_50k \
+    -q -m slow -p no:cacheprovider "$@"
+fi
+
 if [[ "${1:-}" == "--elastic" ]]; then
   shift
   # Weight the elastic-gang family (and the stranding health events) up;

@@ -16,8 +16,10 @@ preset chain generators.
 
 from __future__ import annotations
 
+import multiprocessing
+import os
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..api import types as api
 from ..api.config import Config
@@ -30,6 +32,22 @@ from .cell import (
     PhysicalCell,
     VirtualCell,
 )
+
+# Parallel physical compile (doc/hot-path.md "Boot and transport plane"):
+# "0" forces the serial builder (today's path exactly), a positive integer
+# forces that worker count, unset auto-enables for fleets past
+# _PARALLEL_MIN_CELLS on multi-core hosts. Start method defaults to fork
+# (the compile runs at boot, before accelerator threads exist; workers
+# only build cells and pickle them back).
+PARALLEL_COMPILE_ENV = "HIVED_PARALLEL_COMPILE"
+PARALLEL_START_ENV = "HIVED_PARALLEL_COMPILE_START"
+# Lazy per-VC virtual compile: "0" restores the eager all-VC compile.
+LAZY_VC_ENV = "HIVED_LAZY_VC"
+
+# Auto-enable floor: below this many physical cells the pool startup
+# costs more than the build (432-host fleet is ~2.6k cells; 10k hosts is
+# ~60k+). Tests and small configs stay on the serial path.
+_PARALLEL_MIN_CELLS = 20_000
 
 
 @dataclass
@@ -89,6 +107,86 @@ def build_cell_chains(
     return elements
 
 
+def spec_cell_count(spec: api.PhysicalCellSpec) -> int:
+    """Number of cells a physical spec subtree compiles to (== the number
+    of spec nodes: ``_build_cell`` creates exactly one cell per node).
+    This is what makes the parallel compile's ``config_order`` stamps
+    precomputable: a spec's stamp range is [base+1, base+count] where
+    base is the total count of all earlier specs, independent of which
+    worker builds it."""
+    count = 0
+    stack = [spec]
+    while stack:
+        s = stack.pop()
+        count += 1
+        if s.cell_children:
+            stack.extend(s.cell_children)
+    return count
+
+
+def type_cell_count(
+    elements: Dict[api.CellType, ChainElement], ct: api.CellType
+) -> int:
+    """Cells in a full subtree of type ``ct`` (type-determined: the
+    VIRTUAL builder always constructs exactly child_number children per
+    cell, so per-VC ``config_order`` offsets are computable without
+    building anything)."""
+    memo: Dict[api.CellType, int] = {}
+
+    def size(t: api.CellType) -> int:
+        cached = memo.get(t)
+        if cached is not None:
+            return cached
+        ce = elements[t]
+        n = 1 if ce.level == LOWEST_LEVEL else (
+            1 + ce.child_number * size(ce.child_cell_type)
+        )
+        memo[t] = n
+        return n
+
+    return size(ct)
+
+
+def chain_families(
+    cell_types: Dict[api.CellType, api.CellTypeSpec],
+    physical_cells: Sequence[api.PhysicalCellSpec],
+) -> Tuple[Tuple[CellChain, ...], ...]:
+    """Connected components of the "shares a leaf SKU" relation over the
+    configured chains — the PR-8 RoutingTable partition, lifted into the
+    compiler so both the shards frontend and the parallel physical build
+    derive it without instantiating a throwaway core. Chains in one
+    family may be probed by the same typed pod (and must co-reside on a
+    shard); chains in DIFFERENT families share no cell type, so their
+    trees compile independently. Families and their members are sorted
+    (the RoutingTable contract)."""
+    elements = build_cell_chains(cell_types)
+    chains = sorted({
+        str(spec.cell_type)
+        for spec in physical_cells
+        if spec.cell_type in elements
+    })
+    leaf_to_chains: Dict[str, List[str]] = {}
+    for chain in chains:
+        leaf_to_chains.setdefault(
+            elements[api.CellType(chain)].leaf_cell_type, []
+        ).append(chain)
+    parent: Dict[str, str] = {c: c for c in chains}
+
+    def find(c: str) -> str:
+        while parent[c] != c:
+            parent[c] = parent[parent[c]]
+            c = parent[c]
+        return c
+
+    for group in leaf_to_chains.values():
+        for c in group[1:]:
+            parent[find(group[0])] = find(c)
+    groups: Dict[str, List[str]] = {}
+    for c in chains:
+        groups.setdefault(find(c), []).append(c)
+    return tuple(sorted(tuple(sorted(g)) for g in groups.values()))
+
+
 class _PhysicalBuilder:
     """Instantiate physical cell trees from specs
     (reference: config.go:110-235)."""
@@ -106,6 +204,30 @@ class _PhysicalBuilder:
         self._chain: CellChain = ""
         self._order = 0
 
+    def build_top(
+        self, spec: api.PhysicalCellSpec, order_base: Optional[int] = None
+    ) -> None:
+        """Compile one top-level spec. ``order_base`` pins the first
+        ``config_order`` stamp of this subtree (parallel compile: each
+        spec's range is precomputed from spec_cell_count so any partition
+        of the spec list yields the serial stamps bit-identically)."""
+        if order_base is not None:
+            self._order = order_base
+        self._chain = spec.cell_type
+        element = self.elements.get(spec.cell_type)
+        if element is None:
+            raise api.bad_request(
+                f"cellType {spec.cell_type} in physicalCells is not found "
+                "in cell types definition"
+            )
+        if not element.has_node:
+            raise api.bad_request(
+                f"top cell must be node-level or above: {spec.cell_type}"
+            )
+        root = self._build_cell(spec, spec.cell_type, "")
+        self.free_list.setdefault(root.chain, ChainCellList(root.level))
+        self.free_list[root.chain][root.level].append(root)
+
     def build(
         self,
     ) -> Tuple[
@@ -114,20 +236,7 @@ class _PhysicalBuilder:
         Dict[api.PinnedCellId, PhysicalCell],
     ]:
         for spec in self.specs:
-            self._chain = spec.cell_type
-            element = self.elements.get(spec.cell_type)
-            if element is None:
-                raise api.bad_request(
-                    f"cellType {spec.cell_type} in physicalCells is not found "
-                    "in cell types definition"
-                )
-            if not element.has_node:
-                raise api.bad_request(
-                    f"top cell must be node-level or above: {spec.cell_type}"
-                )
-            root = self._build_cell(spec, spec.cell_type, "")
-            self.free_list.setdefault(root.chain, ChainCellList(root.level))
-            self.free_list[root.chain][root.level].append(root)
+            self.build_top(spec)
         return self.full_list, self.free_list, self.pinned_cells
 
     def _build_cell(
@@ -188,6 +297,174 @@ class _PhysicalBuilder:
         return cell
 
 
+def _compile_spec_batch(
+    cell_types: Dict[api.CellType, api.CellTypeSpec],
+    batch: List[Tuple[api.PhysicalCellSpec, int]],
+):
+    """Worker entry for the parallel physical compile: build a batch of
+    (top spec, config_order base) pairs and return the partial listings.
+    Each batch holds specs of ONE chain family in original spec order, so
+    the parent's merge is a per-chain concatenation."""
+    elements = build_cell_chains(cell_types)
+    builder = _PhysicalBuilder(elements, [])
+    for spec, base in batch:
+        builder.build_top(spec, order_base=base)
+    return builder.full_list, builder.free_list, builder.pinned_cells
+
+
+def _parallel_worker_count(total_cells: int) -> int:
+    """Workers for the parallel physical compile; 0 = serial. Env
+    HIVED_PARALLEL_COMPILE: "0"/unset = serial (the default), N = N
+    workers, "auto" = one per core past the cell floor.
+
+    Default-off is a MEASURED honest null, not caution (doc/hot-path.md
+    "Boot and transport plane"): the per-family build is embarrassingly
+    parallel and bit-identical (the differential compile test), but the
+    results cross the process boundary by pickle, and at 75k cells the
+    parent-side unpickle alone (~1.6 s) exceeds the serial build
+    (~1.1 s) — so pickle-back parallelism loses at every worker count.
+    The lazy-VC and boot-fold planes carry the boot budget instead; the
+    env stays for hosts where a cheaper transport (or a faster pickle)
+    changes the arithmetic."""
+    env = os.environ.get(PARALLEL_COMPILE_ENV, "").strip()
+    if not env or env == "0":
+        return 0
+    cpu = os.cpu_count() or 1
+    try:
+        if multiprocessing.current_process().daemon:
+            return 0  # a daemonic shard worker cannot fork children
+    except Exception:  # noqa: BLE001
+        return 0
+    if env == "auto":
+        if cpu < 2 or total_cells < _PARALLEL_MIN_CELLS:
+            return 0
+        return min(cpu, 16)
+    try:
+        return max(0, int(env))
+    except ValueError:
+        return 0
+
+
+def _build_physical_parallel(
+    config: Config,
+    elements: Dict[api.CellType, ChainElement],
+    workers: int,
+) -> Tuple[
+    Dict[CellChain, ChainCellList],
+    Dict[CellChain, ChainCellList],
+    Dict[api.PinnedCellId, PhysicalCell],
+]:
+    """Family-partitioned parallel physical compile. Determinism argument
+    (doc/hot-path.md "Boot and transport plane"): (1) config_order stamps
+    are precomputed per top spec from spec_cell_count, so a subtree's
+    stamps do not depend on which worker builds it or when; (2) chains in
+    different families share no cell type, and specs of one chain are
+    batched in original relative order, so per-chain cell-list order is
+    the serial order; (3) the merge rebuilds every dict in the serial
+    insertion order (chain first-occurrence; pinned ids by config_order).
+    The differential compile test walks the full tree asserting exactly
+    this."""
+    from concurrent import futures
+
+    pc = config.physical_cluster
+    specs = list(pc.physical_cells)
+    counts = [spec_cell_count(s) for s in specs]
+    bases: List[int] = []
+    total = 0
+    for n in counts:
+        bases.append(total)
+        total += n
+
+    families = chain_families(pc.cell_types, specs)
+    family_of: Dict[str, int] = {
+        c: i for i, fam in enumerate(families) for c in fam
+    }
+    per_family: Dict[int, List[int]] = {}
+    for i, spec in enumerate(specs):
+        fam = family_of.get(str(spec.cell_type))
+        if fam is None:
+            # Unknown chain: let the serial builder raise its user error.
+            raise api.bad_request(
+                f"cellType {spec.cell_type} in physicalCells is not found "
+                "in cell types definition"
+            )
+        per_family.setdefault(fam, []).append(i)
+
+    # Family-major batches, each family split into contiguous chunks of
+    # roughly total/(2*workers) cells for load balance.
+    target = max(1, total // max(1, 2 * workers))
+    batches: List[List[Tuple[api.PhysicalCellSpec, int]]] = []
+    for fam in sorted(per_family):
+        chunk: List[Tuple[api.PhysicalCellSpec, int]] = []
+        chunk_cells = 0
+        for i in per_family[fam]:
+            chunk.append((specs[i], bases[i]))
+            chunk_cells += counts[i]
+            if chunk_cells >= target:
+                batches.append(chunk)
+                chunk, chunk_cells = [], 0
+        if chunk:
+            batches.append(chunk)
+
+    start = os.environ.get(PARALLEL_START_ENV) or "fork"
+    try:
+        ctx = multiprocessing.get_context(start)
+    except ValueError:
+        ctx = multiprocessing.get_context()
+    with futures.ProcessPoolExecutor(
+        max_workers=min(workers, max(1, len(batches))), mp_context=ctx
+    ) as pool:
+        results = list(pool.map(
+            _compile_spec_batch,
+            [pc.cell_types] * len(batches),
+            batches,
+        ))
+
+    # Merge in the serial insertion orders.
+    chain_order: List[CellChain] = []
+    seen = set()
+    for spec in specs:
+        c = str(spec.cell_type)
+        if c not in seen:
+            seen.add(c)
+            chain_order.append(c)
+    full: Dict[CellChain, ChainCellList] = {}
+    free: Dict[CellChain, ChainCellList] = {}
+    pinned_cells: List[PhysicalCell] = []
+    pinned_ids: Dict[int, api.PinnedCellId] = {}
+    by_chain_full: Dict[CellChain, List[ChainCellList]] = {}
+    by_chain_free: Dict[CellChain, List[ChainCellList]] = {}
+    for part_full, part_free, part_pinned in results:
+        for chain, ccl in part_full.items():
+            by_chain_full.setdefault(chain, []).append(ccl)
+        for chain, ccl in part_free.items():
+            by_chain_free.setdefault(chain, []).append(ccl)
+        for pid, cell in part_pinned.items():
+            pinned_cells.append(cell)
+            pinned_ids[cell.config_order] = pid
+    for chain in chain_order:
+        parts = by_chain_full.get(chain, [])
+        if not parts:
+            continue
+        merged = parts[0]
+        for extra in parts[1:]:
+            for level, cl in extra.levels.items():
+                merged[level].extend(cl)
+        full[chain] = merged
+        fparts = by_chain_free.get(chain, [])
+        fmerged = fparts[0]
+        for extra in fparts[1:]:
+            for level, cl in extra.levels.items():
+                fmerged[level].extend(cl)
+        free[chain] = fmerged
+    # Serial pinned-dict order is the compile traversal order, which the
+    # config_order stamp records exactly.
+    pinned: Dict[api.PinnedCellId, PhysicalCell] = {}
+    for cell in sorted(pinned_cells, key=lambda c: c.config_order):
+        pinned[pinned_ids[cell.config_order]] = cell
+    return full, free, pinned
+
+
 class _VirtualBuilder:
     """Instantiate per-VC virtual cell trees
     (reference: config.go:237-413)."""
@@ -229,60 +506,8 @@ class _VirtualBuilder:
         self._order = 0
 
     def build(self):
-        for vc, spec in self.specs.items():
-            self.vc_free_cell_num[vc] = {}
-            self.non_pinned_full[vc] = {}
-            self.non_pinned_free[vc] = {}
-            self.pinned[vc] = {}
-            self.pinned_physical[vc] = {}
-
-            num_cells = 0
-            for vcell in spec.virtual_cells:
-                # Fully-qualified dotted type: chain.segment...segment; the
-                # first segment is the chain, the last is the preassigned
-                # cell's own type (reference: config.go:367-373).
-                parts = vcell.cell_type.split(".")
-                chain: CellChain = parts[0]
-                root_type: api.CellType = parts[-1]
-                if root_type not in self.elements:
-                    raise api.bad_request(
-                        f"cellType {root_type} in virtualCells is not found in "
-                        "cell types definition"
-                    )
-                root_level = self.elements[root_type].level
-                self.vc_free_cell_num[vc].setdefault(chain, {})
-                self.vc_free_cell_num[vc][chain][root_level] = (
-                    self.vc_free_cell_num[vc][chain].get(root_level, 0)
-                    + vcell.cell_number
-                )
-                for _ in range(vcell.cell_number):
-                    self._vc, self._chain, self._root, self._pid = vc, chain, None, ""
-                    root = self._build_cell(root_type, f"{vc}/{num_cells}")
-                    self.non_pinned_free[vc].setdefault(chain, ChainCellList())
-                    self.non_pinned_free[vc][chain][root.level].append(root)
-                    num_cells += 1
-
-            for pcell in spec.pinned_cells:
-                pid = pcell.pinned_cell_id
-                pc = self.raw_pinned.get(pid)
-                if pc is None:
-                    raise api.bad_request(
-                        f"pinned cell not found in physicalCells: VC: {vc}, ID: {pid}"
-                    )
-                self.pinned_physical[vc][pid] = pc
-                # Find the cell type at the pinned cell's level by walking
-                # down the chain (reference: config.go:394-398).
-                child_type = api.CellType(pc.chain)
-                while self.elements[child_type].level > pc.level:
-                    child_type = self.elements[child_type].child_cell_type
-                self.vc_free_cell_num[vc].setdefault(pc.chain, {})
-                self.vc_free_cell_num[vc][pc.chain][pc.level] = (
-                    self.vc_free_cell_num[vc][pc.chain].get(pc.level, 0) + 1
-                )
-                self._vc, self._chain, self._root, self._pid = vc, pc.chain, None, pid
-                self._build_cell(child_type, f"{vc}/{num_cells}")
-                num_cells += 1
-
+        for vc in self.specs:
+            self.build_vc(vc)
         return (
             self.vc_free_cell_num,
             self.non_pinned_full,
@@ -290,6 +515,69 @@ class _VirtualBuilder:
             self.pinned,
             self.pinned_physical,
         )
+
+    def build_vc(self, vc: api.VirtualClusterName,
+                 order_base: Optional[int] = None):
+        """Compile ONE VC's virtual cell trees. ``order_base`` pins the
+        VC's first config_order stamp (lazy per-VC compile: offsets are
+        precomputed from type_cell_count so a VC compiled on first touch
+        carries the same stamps the eager all-VC compile would have
+        given it)."""
+        if order_base is not None:
+            self._order = order_base
+        spec = self.specs[vc]
+        self.vc_free_cell_num[vc] = {}
+        self.non_pinned_full[vc] = {}
+        self.non_pinned_free[vc] = {}
+        self.pinned[vc] = {}
+        self.pinned_physical[vc] = {}
+
+        num_cells = 0
+        for vcell in spec.virtual_cells:
+            # Fully-qualified dotted type: chain.segment...segment; the
+            # first segment is the chain, the last is the preassigned
+            # cell's own type (reference: config.go:367-373).
+            parts = vcell.cell_type.split(".")
+            chain: CellChain = parts[0]
+            root_type: api.CellType = parts[-1]
+            if root_type not in self.elements:
+                raise api.bad_request(
+                    f"cellType {root_type} in virtualCells is not found in "
+                    "cell types definition"
+                )
+            root_level = self.elements[root_type].level
+            self.vc_free_cell_num[vc].setdefault(chain, {})
+            self.vc_free_cell_num[vc][chain][root_level] = (
+                self.vc_free_cell_num[vc][chain].get(root_level, 0)
+                + vcell.cell_number
+            )
+            for _ in range(vcell.cell_number):
+                self._vc, self._chain, self._root, self._pid = vc, chain, None, ""
+                root = self._build_cell(root_type, f"{vc}/{num_cells}")
+                self.non_pinned_free[vc].setdefault(chain, ChainCellList())
+                self.non_pinned_free[vc][chain][root.level].append(root)
+                num_cells += 1
+
+        for pcell in spec.pinned_cells:
+            pid = pcell.pinned_cell_id
+            pc = self.raw_pinned.get(pid)
+            if pc is None:
+                raise api.bad_request(
+                    f"pinned cell not found in physicalCells: VC: {vc}, ID: {pid}"
+                )
+            self.pinned_physical[vc][pid] = pc
+            # Find the cell type at the pinned cell's level by walking
+            # down the chain (reference: config.go:394-398).
+            child_type = api.CellType(pc.chain)
+            while self.elements[child_type].level > pc.level:
+                child_type = self.elements[child_type].child_cell_type
+            self.vc_free_cell_num[vc].setdefault(pc.chain, {})
+            self.vc_free_cell_num[vc][pc.chain][pc.level] = (
+                self.vc_free_cell_num[vc][pc.chain].get(pc.level, 0) + 1
+            )
+            self._vc, self._chain, self._root, self._pid = vc, pc.chain, None, pid
+            self._build_cell(child_type, f"{vc}/{num_cells}")
+            num_cells += 1
 
     def _build_cell(self, ct: api.CellType, address: api.CellAddress) -> VirtualCell:
         """(reference: config.go:316-340 ``buildChildCell``)"""
@@ -374,31 +662,224 @@ class CompiledConfig:
     leaf_cell_type_to_chain: Dict[str, List[CellChain]] = field(default_factory=dict)
     # chain -> leaf cell type
     chain_to_leaf_type: Dict[CellChain, str] = field(default_factory=dict)
+    # Configured VC names in spec order (iterable without forcing any
+    # compile) and, per VC, the chains it holds NON-PINNED quota in
+    # (first-occurrence order of spec.virtualCells — what the compiled
+    # IntraVCScheduler's non_pinned_preassigned keys would be). Both are
+    # derived from the spec scan, so lock-chain derivation and shard
+    # routing never force a VC compile.
+    vc_names: List[api.VirtualClusterName] = field(default_factory=list)
+    vc_nonpinned_chains: Dict[api.VirtualClusterName, List[CellChain]] = field(
+        default_factory=dict
+    )
+    # Chain families (shares-a-leaf-SKU connected components): the
+    # parallel-compile / shard-routing partition.
+    families: Tuple[Tuple[CellChain, ...], ...] = ()
+    # Lazy per-VC virtual compile (doc/hot-path.md "Boot and transport
+    # plane"): quota counters and validation are eager (above); cell-tree
+    # construction happens on first compile_vc(vc). False = everything
+    # compiled already (HIVED_LAZY_VC=0 or legacy callers).
+    lazy_vc: bool = False
+    # internal: the memoizing virtual builder + per-VC config_order bases
+    _virtual_builder: Optional[_VirtualBuilder] = None
+    _vc_order_offsets: Dict[api.VirtualClusterName, int] = field(
+        default_factory=dict
+    )
+
+    def vc_compiled(self, vc: api.VirtualClusterName) -> bool:
+        return vc in self.virtual_non_pinned_full
+
+    def compile_vc(self, vc: api.VirtualClusterName) -> None:
+        """Compile one VC's virtual cell trees on first touch (memoized;
+        a no-op for compiled VCs). config_order stamps come from the
+        precomputed per-VC offsets, so a lazily compiled VC is
+        bit-identical to its eager twin. NOT thread-safe by itself —
+        HivedCore.ensure_vc serializes callers."""
+        if vc in self.virtual_non_pinned_full:
+            return
+        vb = self._virtual_builder
+        if vb is None or vc not in vb.specs:
+            raise api.bad_request(f"VC {vc} does not exists!")
+        vb.build_vc(vc, order_base=self._vc_order_offsets.get(vc))
+
+    def compile_all_vcs(self) -> None:
+        for vc in self.vc_names:
+            self.compile_vc(vc)
 
 
-def parse_config(config: Config) -> CompiledConfig:
-    """(reference: config.go:442-477 ``ParseConfig``)"""
+def physical_spec_metadata(config: Config):
+    """Routing metadata from a spec WALK — no cell instantiation: the
+    shards frontend's RoutingTable used to pay a full throwaway core
+    compile (plus its all-bad bootstrap) just to learn these maps, which
+    at 50k hosts is its own boot wall. Returns
+    ``(chains, node_chains, pinned_chain)``:
+
+    - chains: sorted tuple of configured chain names;
+    - node_chains: node name -> sorted tuple of chains with leaves on it;
+    - pinned_chain: pinned cell id -> its chain.
+    """
+    pc = config.physical_cluster
+    elements = build_cell_chains(pc.cell_types)
+    chains: Set[str] = set()
+    node_chains: Dict[str, Set[str]] = {}
+    pinned_chain: Dict[str, str] = {}
+    for top in pc.physical_cells:
+        chain = str(top.cell_type)
+        if top.cell_type not in elements:
+            continue  # parse_config raises the user error; routing skips
+        chains.add(chain)
+        stack: List[Tuple[api.PhysicalCellSpec, api.CellType]] = [
+            (top, top.cell_type)
+        ]
+        while stack:
+            spec, ct = stack.pop()
+            ce = elements[ct]
+            if spec.pinned_cell_id:
+                pinned_chain[str(spec.pinned_cell_id)] = chain
+            if ce.has_node and not ce.is_multi_nodes:
+                node = spec.cell_address.rsplit("/", 1)[-1]
+                node_chains.setdefault(node, set()).add(chain)
+            # Keep descending below node level: no new node names there
+            # (child elements have has_node False), but pinned_cell_id
+            # is legal at ANY depth and the routing table must know
+            # every pinned cell's chain.
+            for child in spec.cell_children or ():
+                stack.append((child, ce.child_cell_type))
+    return (
+        tuple(sorted(chains)),
+        {n: tuple(sorted(cs)) for n, cs in sorted(node_chains.items())},
+        pinned_chain,
+    )
+
+
+def _vc_quota_scan(
+    elements: Dict[api.CellType, ChainElement],
+    vc_specs: Dict[api.VirtualClusterName, api.VirtualClusterSpec],
+    raw_pinned: Dict[api.PinnedCellId, PhysicalCell],
+):
+    """Eager spec scan of the virtual clusters: quota counters, non-pinned
+    chain lists, pinned physical cells, and per-VC config_order offsets —
+    everything the core's boot accounting and validation need, WITHOUT
+    constructing a single virtual cell. Raises exactly the user errors the
+    cell builder would, so a bad config still fails at parse time even
+    when every VC compiles lazily."""
+    vc_free: Dict[
+        api.VirtualClusterName, Dict[CellChain, Dict[CellLevel, int]]
+    ] = {}
+    pinned_physical: Dict[
+        api.VirtualClusterName, Dict[api.PinnedCellId, PhysicalCell]
+    ] = {}
+    nonpinned_chains: Dict[api.VirtualClusterName, List[CellChain]] = {}
+    offsets: Dict[api.VirtualClusterName, int] = {}
+    base = 0
+    for vc, spec in vc_specs.items():
+        offsets[vc] = base
+        vc_free[vc] = {}
+        pinned_physical[vc] = {}
+        nonpinned_chains[vc] = []
+        for vcell in spec.virtual_cells:
+            parts = vcell.cell_type.split(".")
+            chain: CellChain = parts[0]
+            root_type: api.CellType = parts[-1]
+            if root_type not in elements:
+                raise api.bad_request(
+                    f"cellType {root_type} in virtualCells is not found in "
+                    "cell types definition"
+                )
+            root_level = elements[root_type].level
+            vc_free[vc].setdefault(chain, {})
+            vc_free[vc][chain][root_level] = (
+                vc_free[vc][chain].get(root_level, 0) + vcell.cell_number
+            )
+            if vcell.cell_number > 0 and chain not in nonpinned_chains[vc]:
+                # Zero-count entries leave counters (matching the
+                # builder's setdefault) but compile no cells, so the
+                # chain never appears in non_pinned_preassigned.
+                nonpinned_chains[vc].append(chain)
+            base += vcell.cell_number * type_cell_count(elements, root_type)
+        for pcell in spec.pinned_cells:
+            pid = pcell.pinned_cell_id
+            pc = raw_pinned.get(pid)
+            if pc is None:
+                raise api.bad_request(
+                    f"pinned cell not found in physicalCells: VC: {vc}, ID: {pid}"
+                )
+            pinned_physical[vc][pid] = pc
+            child_type = api.CellType(pc.chain)
+            while elements[child_type].level > pc.level:
+                child_type = elements[child_type].child_cell_type
+            vc_free[vc].setdefault(pc.chain, {})
+            vc_free[vc][pc.chain][pc.level] = (
+                vc_free[vc][pc.chain].get(pc.level, 0) + 1
+            )
+            base += type_cell_count(elements, child_type)
+    return vc_free, pinned_physical, nonpinned_chains, offsets
+
+
+def parse_config(config: Config, lazy_vc: Optional[bool] = None) -> CompiledConfig:
+    """(reference: config.go:442-477 ``ParseConfig``; boot plane:
+    doc/hot-path.md "Boot and transport plane")
+
+    The physical compile parallelizes by chain family when the fleet is
+    large (HIVED_PARALLEL_COMPILE; bit-identical to serial by the offset
+    argument in _build_physical_parallel). The virtual compile is LAZY
+    per VC by default (HIVED_LAZY_VC=0 restores the eager build):
+    validation and quota counters are computed here, cell trees on first
+    compile_vc."""
     elements = build_cell_chains(config.physical_cluster.cell_types)
-    full, free, raw_pinned = _PhysicalBuilder(
-        elements, config.physical_cluster.physical_cells
-    ).build()
+    specs = config.physical_cluster.physical_cells
+    est_cells = 0
+    for spec in specs:
+        if spec.cell_type in elements:
+            est_cells += type_cell_count(elements, spec.cell_type)
+    workers = _parallel_worker_count(est_cells)
+    full = free = raw_pinned = None
+    if workers >= 1 and len(specs) > 1:
+        try:
+            full, free, raw_pinned = _build_physical_parallel(
+                config, elements, workers
+            )
+        except api.WebServerError:
+            raise
+        except Exception as e:  # noqa: BLE001 — pool failure: build serially
+            import logging
+
+            logging.getLogger("hivedscheduler").warning(
+                "parallel compile unavailable (%s); building serially", e
+            )
+            full = None
+    if full is None:
+        full, free, raw_pinned = _PhysicalBuilder(elements, specs).build()
+
+    if lazy_vc is None:
+        lazy_vc = os.environ.get(LAZY_VC_ENV, "1").strip() != "0"
     (
         vc_free_cell_num,
-        non_pinned_full,
-        non_pinned_free,
-        pinned,
         pinned_physical,
-    ) = _VirtualBuilder(elements, config.virtual_clusters, raw_pinned).build()
+        nonpinned_chains,
+        offsets,
+    ) = _vc_quota_scan(elements, config.virtual_clusters, raw_pinned)
+    vb = _VirtualBuilder(elements, config.virtual_clusters, raw_pinned)
 
     cc = CompiledConfig(
         physical_full_list=full,
         physical_free_list=free,
         vc_free_cell_num=vc_free_cell_num,
-        virtual_non_pinned_full=non_pinned_full,
-        virtual_non_pinned_free=non_pinned_free,
-        virtual_pinned=pinned,
+        virtual_non_pinned_full=vb.non_pinned_full,
+        virtual_non_pinned_free=vb.non_pinned_free,
+        virtual_pinned=vb.pinned,
         physical_pinned=pinned_physical,
+        vc_names=list(config.virtual_clusters),
+        vc_nonpinned_chains=nonpinned_chains,
+        families=chain_families(
+            config.physical_cluster.cell_types, specs
+        ),
+        lazy_vc=lazy_vc,
+        _virtual_builder=vb,
+        _vc_order_offsets=offsets,
     )
+    if not lazy_vc:
+        cc.compile_all_vcs()
     # Chain metadata (reference: config.go:415-440 ``parseCellChainInfo``).
     for chain in full:
         ce = elements[api.CellType(chain)]

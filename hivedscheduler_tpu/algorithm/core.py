@@ -20,6 +20,7 @@ Responsibilities:
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -496,6 +497,53 @@ def generate_pod_schedule_result(
 ###############################################################################
 
 
+class _LazyVCSchedulers:
+    """Mapping facade over the per-VC intra-VC schedulers (lazy compile,
+    doc/hot-path.md "Boot and transport plane").
+
+    Name iteration, membership, and length are free (the configured VC
+    name list); ``[vc]`` / ``get`` compile the VC on first touch via
+    HivedCore.ensure_vc; ``values()`` / ``items()`` force EVERY VC (the
+    inspect-all surface — a deliberate, documented force point). Callers
+    that must not force use ``compiled_values()``."""
+
+    def __init__(self, core: "HivedCore"):
+        self._core = core
+        self._compiled: Dict[api.VirtualClusterName, IntraVCScheduler] = {}
+
+    def __contains__(self, vc) -> bool:
+        return vc in self._core._vc_name_set
+
+    def __iter__(self):
+        return iter(self._core.compiled.vc_names)
+
+    def __len__(self) -> int:
+        return len(self._core.compiled.vc_names)
+
+    def keys(self):
+        return list(self._core.compiled.vc_names)
+
+    def __getitem__(self, vc) -> IntraVCScheduler:
+        vcs = self._compiled.get(vc)
+        if vcs is not None:
+            return vcs
+        return self._core.ensure_vc(vc)
+
+    def get(self, vc, default=None):
+        if vc not in self:
+            return default
+        return self[vc]
+
+    def values(self):
+        return [self[vc] for vc in self]
+
+    def items(self):
+        return [(vc, self[vc]) for vc in self]
+
+    def compiled_values(self) -> List[IntraVCScheduler]:
+        return list(self._compiled.values())
+
+
 class HivedCore:
     """The scheduling algorithm (reference: hived_algorithm.go:40-105).
 
@@ -504,7 +552,14 @@ class HivedCore:
     """
 
     def __init__(self, config: Config):
+        _boot_t0 = time.monotonic()
         cc = compiler.parse_config(config)
+        # Boot-phase ledger (doc/hot-path.md "Boot and transport plane"):
+        # wall seconds per boot phase, surfaced by the framework as
+        # bootPhaseSeconds / hived_boot_phase_seconds{phase=...}.
+        self.boot_phase_seconds: Dict[str, float] = {
+            "compile": time.monotonic() - _boot_t0
+        }
         self.compiled = cc
         self.full_cell_list = cc.physical_full_list
         self.free_cell_list = cc.physical_free_list
@@ -532,16 +587,14 @@ class HivedCore:
         # core-schedule); surfaced via framework.get_metrics().
         self.phase_stats = PhaseStats()
 
-        self.vc_schedulers: Dict[api.VirtualClusterName, IntraVCScheduler] = {
-            vc: IntraVCScheduler(
-                cc.virtual_non_pinned_full[vc],
-                cc.virtual_non_pinned_free[vc],
-                cc.virtual_pinned[vc],
-                cc.cell_level_to_leaf_num,
-                phase_stats=self.phase_stats,
-            )
-            for vc in cc.virtual_non_pinned_full
-        }
+        # Lazy per-VC virtual compile (doc/hot-path.md "Boot and
+        # transport plane"): vc_schedulers is a mapping FACADE — name
+        # iteration and membership are free, item access compiles the
+        # VC's cell trees on first touch (ensure_vc). Under HIVED_LAZY_VC=0
+        # every VC compiles right here, restoring the eager constructor.
+        self._vc_name_set = set(cc.vc_names)
+        self._vc_compile_lock = threading.RLock()
+        self.vc_schedulers = _LazyVCSchedulers(self)
         self.opportunistic_schedulers: Dict[CellChain, TopologyAwareScheduler] = {
             chain: TopologyAwareScheduler(
                 ccl,
@@ -574,16 +627,9 @@ class HivedCore:
             for cl in ccl.levels.values()
             for c in cl
         }
+        # Virtual cells join the index per VC at ensure_vc time (lazy
+        # compile); eager mode fills it below via the forced compiles.
         self._virt_cell_index: Dict[api.CellAddress, VirtualCell] = {}
-        for vcs in self.vc_schedulers.values():
-            for ccl in vcs.non_pinned_full.values():
-                for cl in ccl.levels.values():
-                    for c in cl:
-                        self._virt_cell_index[c.address] = c
-            for ccl in vcs.pinned_cells.values():
-                for cl in ccl.levels.values():
-                    for c in cl:
-                        self._virt_cell_index[c.address] = c
         # Lock-sharding contract hook (scheduler.locks): the framework
         # installs ChainShardedLock.require_global here so the cross-chain
         # mutators below (node/chip health, drains, node deletes) ASSERT
@@ -701,6 +747,13 @@ class HivedCore:
         self.preferred_doomed: Dict[
             Tuple[api.VirtualClusterName, CellChain, CellLevel], Set[str]
         ] = {}
+        # (vcn, chain, level, physical address) -> the virtual address
+        # the pre-crash scheduler had the doom bound to (the ledger's
+        # virtualAddress field): recovery rebinds the exact pairing so
+        # annotation replay converges with snapshot restore and the live
+        # timeline (the lazy-VC plane removed the boot-churn list
+        # rotation that used to make first-unbound coincide with it).
+        self.preferred_doomed_virtual: Dict[Tuple, str] = {}
         # While True (recovery with a loaded ledger), the persisted ledger
         # is AUTHORITATIVE: organic doom bind/retire is suspended and
         # rebuild_doomed_from_ledger is the only creator. Recovery replays
@@ -722,10 +775,86 @@ class HivedCore:
         self.decisions = None
 
         self._init_cell_nums()
+        if not cc.lazy_vc:
+            # Eager mode: the all-VC virtual compile is boot compile
+            # work — account it where the lazy path's deferral shows.
+            _t_vc = time.monotonic()
+            for vc in cc.vc_names:
+                self.ensure_vc(vc)
+            self.boot_phase_seconds["compile"] += (
+                time.monotonic() - _t_vc
+            )
+        else:
+            # A VC holding pinned cells compiles eagerly even in lazy
+            # mode: _init_pinned_cells binds into its virtual tree, and
+            # badness under an allocated top hangs advisory bindings off
+            # that tree — both need the cells to exist. Pinned VCs are
+            # rare and small; the 37-idle-VC win is untouched.
+            for vc in cc.vc_names:
+                if cc.physical_pinned.get(vc):
+                    self.ensure_vc(vc)
         self._init_pinned_cells(cc.physical_pinned)
+        _t_health = time.monotonic()
         self._init_bad_nodes()
+        self.boot_phase_seconds["healthInit"] = time.monotonic() - _t_health
 
     # -- init ---------------------------------------------------------------
+
+    def vc_compiled(self, vc: api.VirtualClusterName) -> bool:
+        """True when the VC's virtual cell trees exist (lazy compile has
+        run, or eager mode). Lock-free dict read."""
+        return vc in self.vc_schedulers._compiled
+
+    def ensure_vc(self, vc: api.VirtualClusterName) -> IntraVCScheduler:
+        """Force one VC's virtual compile (memoized). Every VC access
+        path funnels here via the vc_schedulers facade: schedule,
+        inspect, snapshot restore (pre-forced per projection), and the
+        doomed-ledger rebuild. Raises KeyError for unknown VCs (dict
+        semantics — callers gate with ``in``).
+
+        The force is a PURE COMPILE: fresh cells (all free/healthy),
+        index and epoch-ref installs, cache invalidations — no
+        placement-visible state changes, so forcing from any path
+        (including the chaos probe battery) is order-independent and
+        restart-equivalent. Advisory doomed-bad bindings the VC's quota
+        shortfall demands appear at the NEXT organic trigger
+        (_try_bind_doomed_bad_cell fires on every bad-free/allocation
+        transition), exactly when a restarted scheduler's would — never
+        at force time, where the two timelines' trigger histories
+        differ."""
+        vcs = self.vc_schedulers._compiled.get(vc)
+        if vcs is not None:
+            return vcs
+        with self._vc_compile_lock:
+            vcs = self.vc_schedulers._compiled.get(vc)
+            if vcs is not None:
+                return vcs
+            if vc not in self._vc_name_set:
+                raise KeyError(vc)
+            cc = self.compiled
+            cc.compile_vc(vc)
+            vcs = IntraVCScheduler(
+                cc.virtual_non_pinned_full[vc],
+                cc.virtual_non_pinned_free[vc],
+                cc.virtual_pinned[vc],
+                cc.cell_level_to_leaf_num,
+                phase_stats=self.phase_stats,
+            )
+            self._install_vc_epoch_refs(vcs)
+            for ccl in vcs.non_pinned_full.values():
+                for cl in ccl.levels.values():
+                    for c in cl:
+                        self._virt_cell_index[c.address] = c
+            for ccl in vcs.pinned_cells.values():
+                for cl in ccl.levels.values():
+                    for c in cl:
+                        self._virt_cell_index[c.address] = c
+            # Static export caches were built without this VC's cells.
+            self._export_cells_by_chain = None
+            self._export_chain_memo.clear()
+            self._vc_status_cache.pop(vc, None)
+            self.vc_schedulers._compiled[vc] = vcs
+        return vcs
 
     def _init_cell_nums(self) -> None:
         """Aggregate VC quotas, compute total capacity per level, and
@@ -783,39 +912,92 @@ class HivedCore:
 
     def _init_bad_nodes(self) -> None:
         """All nodes are bad until the informer says otherwise
-        (reference: hived_algorithm.go:453-465)."""
+        (reference: hived_algorithm.go:453-465).
+
+        Boot fold (doc/hot-path.md "Boot and transport plane"): on the
+        pristine constructor state WITH NO COMPILED VC (the lazy-compile
+        default — advisory dooming is wholly deferred, so nothing can
+        observe intermediate flag state), each free top cell is marked
+        bad by one direct flag pass emitting the subtree in pre-order —
+        exactly the per-leaf recursion's bad-free append order — instead
+        of O(leaves) recursive _set_bad_cell walks. End state is
+        identical: every cell unhealthy, unusable == its leaf count,
+        bad_free holding the whole subtree per level in first-touch
+        order. Any compiled VC (pinned VCs, or HIVED_LAZY_VC=0), a
+        non-free top, or a node shared across tops falls back to the
+        per-node slow path wholesale — dooms then interleave with
+        partially-flagged subtrees exactly as they always did.
+        HIVED_BOOT_FOLD=0 forces the slow path (the differential boot
+        test proves state equality both ways)."""
+        fold = (
+            os.environ.get("HIVED_BOOT_FOLD", "1").strip() != "0"
+            and not self.vc_schedulers._compiled
+        )
+        if fold:
+            # A node whose leaves span top cells breaks the
+            # one-top-per-node ordering argument; take the slow path.
+            tops_of_node: Dict[str, int] = {}
+            for ccl in self.full_cell_list.values():
+                for c in ccl[ccl.top_level]:
+                    for n in set(c.nodes):
+                        tops_of_node[n] = tops_of_node.get(n, 0) + 1
+            fold = all(v == 1 for v in tops_of_node.values())
         for ccl in self.full_cell_list.values():
             for c in ccl[ccl.top_level]:
                 assert isinstance(c, PhysicalCell)
-                for n in c.nodes:
-                    self.set_bad_node(n)
+                if fold and in_free_cell_list(c):
+                    self.bad_nodes.update(c.nodes)
+                    self._bootstrap_bad_subtree(c)
+                else:
+                    for n in c.nodes:
+                        self.set_bad_node(n)
+
+    def _bootstrap_bad_subtree(self, top: PhysicalCell) -> None:
+        """Pristine-state bulk badness: flip health flags and unusable
+        counters directly and append each cell to the bad-free list in
+        pre-order (== the recursion's first-touch order). Valid ONLY from
+        the constructor with no compiled VCs (no bindings, no drains, no
+        prior badness, no live view slots, dooming deferred)."""
+        stack: List[Cell] = [top]
+        while stack:
+            cell = stack.pop()
+            cell.healthy = False
+            cell.unusable_leaf_num = cell.total_leaf_cell_num
+            if cell.children:
+                stack.extend(reversed(cell.children))
+            assert isinstance(cell, PhysicalCell)
+            self._add_bad_free_cell(cell)
+        self.bump_chain_epoch(top.chain)
 
     def _install_epoch_refs(self) -> None:
-        """Give every cell (physical and virtual, pinned included) of a
-        chain the chain's shared mutation-epoch counter. Cell membership is
-        fixed at config-compile time, so this runs once."""
-
-        def ref(chain: CellChain) -> List[int]:
-            r = self.chain_epochs.get(chain)
-            if r is None:
-                r = self.chain_epochs[chain] = [0]
-            return r
-
-        def install(ccl, r: Optional[List[int]] = None) -> None:
-            for cl in ccl.levels.values():
-                for c in cl:
-                    c.epoch_ref = r if r is not None else ref(c.chain)
-
+        """Give every PHYSICAL cell of a chain the chain's shared
+        mutation-epoch counter (virtual cells join per VC at ensure_vc —
+        cell membership is fixed once a VC compiles)."""
         for chain, ccl in self.full_cell_list.items():
-            install(ccl, ref(chain))
+            r = self._epoch_ref(chain)
             for cl in ccl.levels.values():
                 for c in cl:
+                    c.epoch_ref = r
                     c.binding_reg = self.bound_physical
-        for vcs in self.vc_schedulers.values():
-            for chain, ccl in vcs.non_pinned_full.items():
-                install(ccl, ref(chain))
-            for ccl in vcs.pinned_cells.values():
-                install(ccl)
+
+    def _epoch_ref(self, chain: CellChain) -> List[int]:
+        r = self.chain_epochs.get(chain)
+        if r is None:
+            r = self.chain_epochs[chain] = [0]
+        return r
+
+    def _install_vc_epoch_refs(self, vcs: IntraVCScheduler) -> None:
+        """The per-VC half of _install_epoch_refs, run at compile-force
+        time (pinned cells key off their own chain, as before)."""
+        for chain, ccl in vcs.non_pinned_full.items():
+            r = self._epoch_ref(chain)
+            for cl in ccl.levels.values():
+                for c in cl:
+                    c.epoch_ref = r
+        for ccl in vcs.pinned_cells.values():
+            for cl in ccl.levels.values():
+                for c in cl:
+                    c.epoch_ref = self._epoch_ref(c.chain)
 
     def chain_epoch(self, chain: CellChain) -> int:
         r = self.chain_epochs.get(chain)
@@ -861,11 +1043,11 @@ class HivedCore:
         a GUARANTEED pod without a leafCellType can probe
         (_schedule_group_for_leaf_type gates every chain on membership in
         the VC's non_pinned_preassigned). Compile-time constant per config;
-        the framework narrows untyped pods' lock sections to it."""
-        vcs = self.vc_schedulers.get(vc)
-        if vcs is None:
-            return []
-        return list(vcs.non_pinned_preassigned)
+        the framework narrows untyped pods' lock sections to it. Served
+        from the eager spec scan — this must never force a lazy VC
+        compile (lock-chain derivation and shard routing call it
+        lock-free)."""
+        return list(self.compiled.vc_nonpinned_chains.get(vc, []))
 
     # -- node events --------------------------------------------------------
 
@@ -1105,35 +1287,51 @@ class HivedCore:
         for vc_name, vc_free in self.vc_free_cell_num.items():
             if chain not in vc_free:
                 continue
-            while vc_free[chain].get(level, 0) > (
-                self.total_left_cell_num[chain][level]
-                - len(self.bad_free_cells[chain][level])
-            ):
-                if len(self.bad_free_cells[chain][level]) == 0:
-                    # Shortfall with no bad free cell to bind (possible when
-                    # a deferred re-check runs after the last bad cell was
-                    # claimed): nothing to doom until one appears.
-                    break
-                pc = self.bad_free_cells[chain][level][0]
-                assert isinstance(pc, PhysicalCell)
-                preassigned = self.vc_schedulers[vc_name].non_pinned_preassigned
-                if chain not in preassigned:
-                    break  # pinned-only quota in this chain: nothing to doom
-                vc = allocation.get_unbound_virtual_cell(preassigned[chain][level])
-                if vc is None:
-                    break
-                pc.set_virtual_cell(vc)
-                vc.set_physical_cell(pc)
-                common.log.warning(
-                    "Cell %s is doomed to be bad and bound to %s (VC %s)",
-                    vc.address, pc.address, vc_name,
-                )
-                self.vc_doomed_bad_cells[vc_name][chain][level].append(pc)
-                self.all_vc_doomed_bad_cell_num[chain][level] = (
-                    self.all_vc_doomed_bad_cell_num[chain].get(level, 0) + 1
-                )
-                self._bump_doomed_epoch()
-                self._allocate_preassigned_cell(pc, vc_name, True)
+            if not self.vc_compiled(vc_name):
+                # Lazy VC: no virtual cells to bind yet. Its organic
+                # dooms appear at the first trigger AFTER it compiles (a
+                # boot-scale saving: the all-bad bootstrap no longer
+                # dooms 40 idle VCs' entire quota — and a deliberate
+                # equivalence property: force time never binds state).
+                continue
+            self._bind_vc_dooms(vc_name, chain, level)
+
+    def _bind_vc_dooms(
+        self, vc_name: api.VirtualClusterName, chain: CellChain,
+        level: CellLevel,
+    ) -> None:
+        """One VC's organic shortfall loop (the body _try_bind_doomed_
+        bad_cell runs per VC; also the lazy-compile doom replay unit)."""
+        vc_free = self.vc_free_cell_num[vc_name]
+        while vc_free[chain].get(level, 0) > (
+            self.total_left_cell_num[chain][level]
+            - len(self.bad_free_cells[chain][level])
+        ):
+            if len(self.bad_free_cells[chain][level]) == 0:
+                # Shortfall with no bad free cell to bind (possible when
+                # a deferred re-check runs after the last bad cell was
+                # claimed): nothing to doom until one appears.
+                break
+            pc = self.bad_free_cells[chain][level][0]
+            assert isinstance(pc, PhysicalCell)
+            preassigned = self.vc_schedulers[vc_name].non_pinned_preassigned
+            if chain not in preassigned:
+                break  # pinned-only quota in this chain: nothing to doom
+            vc = allocation.get_unbound_virtual_cell(preassigned[chain][level])
+            if vc is None:
+                break
+            pc.set_virtual_cell(vc)
+            vc.set_physical_cell(pc)
+            common.log.warning(
+                "Cell %s is doomed to be bad and bound to %s (VC %s)",
+                vc.address, pc.address, vc_name,
+            )
+            self.vc_doomed_bad_cells[vc_name][chain][level].append(pc)
+            self.all_vc_doomed_bad_cell_num[chain][level] = (
+                self.all_vc_doomed_bad_cell_num[chain].get(level, 0) + 1
+            )
+            self._bump_doomed_epoch()
+            self._allocate_preassigned_cell(pc, vc_name, True)
 
     def _try_unbind_doomed_bad_cell(self, chain: CellChain, level: CellLevel) -> None:
         """(reference: hived_algorithm.go:632-653, with one deliberate fix:
@@ -1150,6 +1348,8 @@ class HivedCore:
         for vc_name, vc_free in self.vc_free_cell_num.items():
             if chain not in vc_free:
                 continue
+            if not self.vc_compiled(vc_name):
+                continue  # lazy VC: provably no dooms to retire
             while vc_free[chain].get(level, 0) < (
                 self.total_left_cell_num[chain][level]
                 - len(self.bad_free_cells[chain][level])
@@ -1198,13 +1398,22 @@ class HivedCore:
             for chain, ccl in sorted(per_chain.items()):
                 for level, cl in sorted(ccl.levels.items()):
                     for c in cl:
-                        entries.append(
-                            {
-                                "chain": str(chain),
-                                "level": int(level),
-                                "address": c.address,
-                            }
-                        )
+                        entry = {
+                            "chain": str(chain),
+                            "level": int(level),
+                            "address": c.address,
+                        }
+                        # The VIRTUAL side of the pairing: recovery
+                        # rebinds the doom to exactly this preassigned
+                        # cell, so annotation-replay recovery converges
+                        # with the live timeline's (and the snapshot
+                        # restore's) virtual pairing — with lazy VC
+                        # compile the live free-list order is pristine
+                        # and the old first-unbound rule no longer
+                        # coincides with it.
+                        if c.virtual_cell is not None:  # type: ignore[union-attr]
+                            entry["virtualAddress"] = c.virtual_cell.address
+                        entries.append(entry)
             if entries:
                 entries.sort(key=lambda e: (e["chain"], e["level"], e["address"]))
                 vcs[str(vcn)] = entries
@@ -1219,6 +1428,7 @@ class HivedCore:
         current config are ignored — a reconfiguration between restarts
         legitimately invalidates them."""
         self.preferred_doomed = {}
+        self.preferred_doomed_virtual = {}
         self.doomed_ledger_mode = isinstance(ledger, dict)
         if not ledger:
             return
@@ -1234,6 +1444,14 @@ class HivedCore:
                 if key[1] not in self.full_cell_list:
                     continue
                 self.preferred_doomed.setdefault(key, set()).add(address)
+                virt = e.get("virtualAddress")
+                if virt:
+                    # The recorded virtual half of the pairing (absent in
+                    # pre-upgrade ledgers: rebuild falls back to
+                    # first-unbound, the old behavior).
+                    self.preferred_doomed_virtual[key + (address,)] = str(
+                        virt
+                    )
 
     def clear_preferred_doomed(self) -> None:
         """Recovery done: steady-state doom choices revert to the organic
@@ -1260,6 +1478,7 @@ class HivedCore:
                                 )
                                 self._unbind_doomed_cell(c)
         self.preferred_doomed = {}
+        self.preferred_doomed_virtual = {}
         self.doomed_ledger_mode = False
 
     def rebuild_doomed_from_ledger(self) -> None:
@@ -1306,9 +1525,28 @@ class HivedCore:
                     )
                     continue
                 assert isinstance(pc, PhysicalCell)
-                vc = allocation.get_unbound_virtual_cell(
-                    preassigned[chain][level]
+                vc = None
+                want = self.preferred_doomed_virtual.get(
+                    (vcn, chain, level, address)
                 )
+                if want is not None:
+                    cand = self._virt_cell_index.get(want)
+                    if (
+                        cand is not None
+                        and cand.physical_cell is None
+                        and cand.vc == vcn
+                        and cand.chain == chain
+                        and cand.level == level
+                        and cand.parent is None
+                    ):
+                        # Rebind the exact pre-crash pairing (the
+                        # ledger's virtualAddress); a stale/invalid name
+                        # (reconfiguration) falls back to first-unbound.
+                        vc = cand
+                if vc is None:
+                    vc = allocation.get_unbound_virtual_cell(
+                        preassigned[chain][level]
+                    )
                 if vc is None:
                     continue
                 pc.set_virtual_cell(vc)
@@ -1592,6 +1830,14 @@ class HivedCore:
         The caller (framework.import_snapshot) wraps any failure here in a
         wholesale reset + full annotation replay — a half-restored core is
         never served."""
+        # Lazy plane: pre-force every VC the projection names (virtual
+        # records, group owners, dooms, opportunistic charges) so the
+        # address->cell resolution below finds their cells. VCs the
+        # snapshot does not touch stay uncompiled — their state is
+        # vacuously pristine, exactly what the reset would produce.
+        for vcn in self._projection_vc_names(core_body):
+            if vcn in self._vc_name_set:
+                self.ensure_vc(vcn)
         phys_recs = core_body.get("phys") or {}
         virt_recs = core_body.get("virt") or {}
         free = CellState.FREE
@@ -1793,10 +2039,44 @@ class HivedCore:
         out: List[TopologyAwareScheduler] = list(
             self.opportunistic_schedulers.values()
         )
-        for vcs in self.vc_schedulers.values():
+        # Compiled VCs only: an uncompiled VC has no views to invalidate,
+        # and forcing 37 idle VCs' compiles from a restore would defeat
+        # the lazy plane.
+        for vcs in self.vc_schedulers.compiled_values():
             out.extend(vcs._chain_schedulers.values())
             out.extend(vcs._pinned_schedulers.values())
         return out
+
+    @staticmethod
+    def _projection_vc_names(core_body: Dict) -> Set[str]:
+        """Every VC name an exported projection touches: virtual-record
+        and physical-binding addresses are '{vc}/...'-prefixed, group
+        records carry their VC, and the doomed / opportunistic sections
+        are VC-keyed. The restore pre-forces exactly these compiles."""
+        names: Set[str] = set()
+        for addr in (core_body.get("virt") or {}):
+            names.add(str(addr).split("/", 1)[0])
+        for rec in (core_body.get("groups") or {}).values():
+            vc = rec.get("vc")
+            if vc:
+                names.add(str(vc))
+        for rec in (core_body.get("phys") or {}).values():
+            # rec[6] is the bound virtual cell's address, if any.
+            if len(rec) > 6 and rec[6]:
+                names.add(str(rec[6]).split("/", 1)[0])
+        for vcn, per_chain in (core_body.get("vcDoomed") or {}).items():
+            # The export lists every VC key; only non-empty doom
+            # listings make the VC part of the projection.
+            if any(
+                addrs
+                for levels in per_chain.values()
+                for addrs in levels.values()
+            ):
+                names.add(str(vcn))
+        for vcn, addrs in (core_body.get("otCells") or {}).items():
+            if addrs:
+                names.add(str(vcn))
+        return names
 
     def attach_restored_pod(
         self, group_name: str, leaf_cell_number: int, pod_index: int, pod: Pod

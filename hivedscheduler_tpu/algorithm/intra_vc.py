@@ -4,6 +4,16 @@ Python equivalent of the reference's ``pkg/algorithm/intra_vc_scheduler.go``:
 routes a request to the topology-aware scheduler of the target chain or
 pinned cell, with cross-priority packing enabled (high priority avoids
 preemption globally inside a VC).
+
+Lazy-compile contract (doc/hot-path.md "Boot and transport plane"): an
+IntraVCScheduler is constructed ON FIRST TOUCH of its VC by
+``HivedCore.ensure_vc`` — never eagerly at boot — from the memoized
+``CompiledConfig.compile_vc`` output. Construction must therefore stay a
+pure function of that compiled output (cell lists + leaf counts): it
+registers placement views over the freshly built virtual trees and reads
+nothing from live scheduling state, so forcing a VC mid-traffic from any
+access path (filter, inspect, snapshot restore, doomed-ledger rebuild)
+is safe and order-independent.
 """
 
 from __future__ import annotations

@@ -579,7 +579,11 @@ class HivedScheduler:
         # as the live list confirms it, and finish_recovery releases the
         # leftovers (pods deleted while we were down). Always empty in
         # steady state.
+        _t_fp = time.monotonic()
         self._config_fingerprint = snapshot_mod.config_fingerprint(config)
+        self.core.boot_phase_seconds["fingerprint"] = (
+            time.monotonic() - _t_fp
+        )
         self._watermark = 0
         self._recovery_ledger: Optional[Dict] = None
         self._snapshot_pending: Dict[str, Tuple] = {}
@@ -662,15 +666,15 @@ class HivedScheduler:
         core = self.core
         chains: Optional[List[str]] = None
         if spec.pinned_cell_id:
-            vcs = core.vc_schedulers.get(spec.virtual_cluster)
-            pinned = (
-                vcs.pinned_cells.get(spec.pinned_cell_id)
-                if vcs is not None
-                else None
-            )
+            # Compile-metadata lookup (never forces a lazy VC compile —
+            # this derivation runs lock-free): the pinned cell's chain is
+            # its physical cell's.
+            pinned = core.compiled.physical_pinned.get(
+                spec.virtual_cluster, {}
+            ).get(spec.pinned_cell_id)
             if pinned is None:
                 return None  # unknown pinned cell: validation rejects inside
-            chains = [pinned[pinned.top_level][0].chain]
+            chains = [pinned.chain]
         elif spec.leaf_cell_type:
             typed = core.cell_chains.get(spec.leaf_cell_type)
             if not typed:
@@ -1061,6 +1065,7 @@ class HivedScheduler:
         that still need it (fallbacks)."""
         self._enter_mutation()
         self._in_recovery = True
+        self._recovery_t0 = time.monotonic()
         ledger = None
         if ledger_payload:
             try:
@@ -1095,6 +1100,11 @@ class HivedScheduler:
         finally:
             self.core.clear_preferred_doomed()
             self._in_recovery = False
+            t0 = getattr(self, "_recovery_t0", None)
+            if t0 is not None:
+                self.core.boot_phase_seconds["recovery"] = (
+                    time.monotonic() - t0
+                )
             # Replayed gangs may sit on hardware that broke while we were
             # down: seed the stranded-gang gauge before serving scrapes.
             with self._lock:
@@ -1811,11 +1821,41 @@ class HivedScheduler:
     def add_node(self, node: Node) -> None:
         self._enter_mutation()
         try:
+            t0 = time.monotonic()
             with self._lock:
                 self.nodes[node.name] = node
                 self._observe_node_health(node)
+            self._note_boot_node_add(time.monotonic() - t0)
         finally:
             self._exit_mutation()
+
+    def add_nodes(self, nodes: List[Node]) -> None:
+        """Batched node adds (informer boot; doc/hot-path.md "Boot and
+        transport plane"): one mutation bracket and ONE global-mode lock
+        acquisition for the whole initial node list, instead of a
+        per-node acquire/release churn — at 10k+ hosts the per-event
+        overhead was a visible slice of the nodeAdd boot phase. Semantics
+        per node are exactly add_node's."""
+        if not nodes:
+            return
+        self._enter_mutation()
+        try:
+            t0 = time.monotonic()
+            with self._lock:
+                for node in nodes:
+                    self.nodes[node.name] = node
+                    self._observe_node_health(node)
+            self._note_boot_node_add(time.monotonic() - t0)
+        finally:
+            self._exit_mutation()
+
+    def _note_boot_node_add(self, seconds: float) -> None:
+        """Accumulate node-add wall time into the boot-phase ledger until
+        the scheduler turns ready (after that, node events are steady-
+        state traffic, not boot)."""
+        if not self._ready.is_set():
+            phases = self.core.boot_phase_seconds
+            phases["nodeAdd"] = phases.get("nodeAdd", 0.0) + seconds
 
     def update_node(self, old: Node, new: Node) -> None:
         if self._node_event_is_noop(new):
@@ -3518,6 +3558,12 @@ class HivedScheduler:
         )
         snap["healthPendingCount"] = self._damper.pending_count()
         snap["ready"] = self.is_ready()
+        # Boot-phase breakdown (doc/observability.md): wall seconds per
+        # boot phase — compile / healthInit / nodeAdd / fingerprint /
+        # recovery — so a standby cold-start is observable, not inferred.
+        snap["bootPhaseSeconds"] = {
+            k: round(v, 6) for k, v in core.boot_phase_seconds.items()
+        }
         return snap
 
     def is_leader(self) -> bool:

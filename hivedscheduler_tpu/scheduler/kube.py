@@ -851,9 +851,11 @@ class InformerLoop:
                         snap, list(fresh_nodes.values())
                     )
             with tr.span("nodeReplay"):
-                for name, node in fresh_nodes.items():
-                    self._known_nodes[name] = node
-                    self.scheduler.add_node(node)
+                # Batched boot adds (doc/hot-path.md "Boot and transport
+                # plane"): one global-mode acquisition for the whole
+                # initial list instead of per-node lock churn.
+                self._known_nodes.update(fresh_nodes)
+                self.scheduler.add_nodes(list(fresh_nodes.values()))
             with tr.span("podReplay"):
                 pods_rv = self._relist_pods(initial=True)
         except BaseException:

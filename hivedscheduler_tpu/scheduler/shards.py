@@ -68,6 +68,7 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import pickle
 import threading
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
@@ -83,6 +84,47 @@ from .types import (
 )
 
 PROC_SHARDS_ENV = "HIVED_PROC_SHARDS"
+
+# Shared-memory filter ring (doc/hot-path.md "Boot and transport
+# plane"): the bulk payloads of the filter hot path ride a per-shard
+# shared-memory ring instead of the pipe; the pipe keeps carrying the
+# (tiny) control frames, ordering, and wakeups. "0" restores the
+# pipe-payload path byte-for-byte; HIVED_SHARD_RING_BYTES sizes each
+# direction's ring (default 4 MiB).
+SHARD_RING_ENV = "HIVED_SHARD_RING"
+SHARD_RING_BYTES_ENV = "HIVED_SHARD_RING_BYTES"
+_RING_DEFAULT_BYTES = 4 << 20
+# Payloads below this ride the pipe even with the ring enabled: the
+# PR-8 filter_fast memo already keeps the steady-state per-RPC payload
+# at pod-dict scale (~1-2 KB), where the ring's extra explicit pickle +
+# copy measurably LOSES to the pipe's one kernel copy (the honest-null
+# arithmetic in doc/hot-path.md "Boot and transport plane"). The ring
+# earns its keep on the large frames — first-send suggested-node lists,
+# oversized bodies/results — that otherwise stall the pipe at p99.
+_RING_MIN_BYTES = 8 << 10
+# Methods whose args/result ride the ring: the dominant per-RPC payloads
+# (filter body / pod dict on the way in, the suggested-node-scale result
+# on the way out). Everything else — control ops, node events, recovery
+# — keeps the plain pipe.
+_RING_METHODS = frozenset({"filter_routine_raw", "filter_fast"})
+_RING_MARK = "__hivedRing__"
+
+
+def _ring_candidate_args(method: str, args: tuple) -> bool:
+    """O(1) pre-pickle size hint for REQUEST frames: steady-state small
+    frames (the overwhelmingly common case under the filter_fast memo)
+    must not pay a speculative pickle just to learn they are under the
+    floor. filter_routine_raw's body length bounds its pickle within a
+    few bytes; filter_fast is large only when the suggested-node list is
+    actually being sent (args[2] is not None)."""
+    if method == "filter_routine_raw":
+        body = args[0] if args else b""
+        return isinstance(body, (bytes, bytearray)) and (
+            len(body) >= _RING_MIN_BYTES
+        )
+    if method == "filter_fast":
+        return len(args) > 2 and args[2] is not None
+    return True
 
 # Multiprocessing start method for proc backends. "spawn" is the default:
 # the parent may carry JAX/XLA (or webserver) threads whose locks a fork
@@ -100,8 +142,12 @@ _ENVELOPE_KEY = "hivedShardPartition"
 
 
 class RoutingTable:
-    """The compile-time maps the parent routes by — a plain-data extract
-    of one throwaway compiled core (picklable, shareable, no cell trees).
+    """The compile-time maps the parent routes by — plain data, built
+    from the compiler's SPEC SCAN (compiler.physical_spec_metadata /
+    chain_families), not from a throwaway compiled core: the routing
+    facts are pure functions of the config, and at 50k hosts the old
+    bootstrap compile (plus its all-nodes-bad init) was its own boot
+    wall (doc/hot-path.md "Boot and transport plane").
 
     The family computation is the union of the per-leaf-SKU chain sets:
     two chains are in one family iff some leaf type reaches both. This is
@@ -109,43 +155,37 @@ class RoutingTable:
     the routable unit the per-chain lock partition coarsens to."""
 
     def __init__(self, config: Config):
-        core = HivedScheduler(config).core
-        self.chains: Tuple[str, ...] = tuple(sorted(core.full_cell_list))
+        from ..algorithm import compiler
+
+        pc = config.physical_cluster
+        chains, node_chains, pinned_of_id = (
+            compiler.physical_spec_metadata(config)
+        )
+        self.chains: Tuple[str, ...] = chains
+        elements = compiler.build_cell_chains(pc.cell_types)
+        leaf_chains: Dict[str, List[str]] = {}
+        for chain in self.chains:
+            leaf = elements[chain].leaf_cell_type
+            leaf_chains.setdefault(str(leaf), []).append(chain)
         self.leaf_chains: Dict[str, Tuple[str, ...]] = {
-            str(t): tuple(chains) for t, chains in core.cell_chains.items()
+            t: tuple(cs) for t, cs in leaf_chains.items()
         }
-        self.quota_chains: Dict[str, Tuple[str, ...]] = {
-            str(vc): tuple(core.vc_quota_chains(vc))
-            for vc in core.vc_schedulers
-        }
+        self.quota_chains: Dict[str, Tuple[str, ...]] = {}
         self.pinned_chain: Dict[Tuple[str, str], str] = {}
-        for vcn, vcs in core.vc_schedulers.items():
-            for pid, ccl in vcs.pinned_cells.items():
-                self.pinned_chain[(str(vcn), str(pid))] = str(
-                    ccl[ccl.top_level][0].chain
-                )
-        self.node_chains: Dict[str, Tuple[str, ...]] = {}
-        for node in core.configured_node_names():
-            self.node_chains[node] = tuple(
-                sorted({leaf.chain for leaf in core._node_leaf_index[node]})
-            )
-        # Families: union-find over chains sharing a leaf type.
-        parent: Dict[str, str] = {c: c for c in self.chains}
-
-        def find(c: str) -> str:
-            while parent[c] != c:
-                parent[c] = parent[parent[c]]
-                c = parent[c]
-            return c
-
-        for chains in self.leaf_chains.values():
-            for c in chains[1:]:
-                parent[find(chains[0])] = find(c)
-        groups: Dict[str, List[str]] = {}
-        for c in self.chains:
-            groups.setdefault(find(c), []).append(c)
-        self.families: Tuple[Tuple[str, ...], ...] = tuple(
-            sorted(tuple(sorted(g)) for g in groups.values())
+        for vcn, spec in config.virtual_clusters.items():
+            quota: List[str] = []
+            for vcell in spec.virtual_cells:
+                chain = vcell.cell_type.split(".")[0]
+                if vcell.cell_number > 0 and chain not in quota:
+                    quota.append(chain)
+            self.quota_chains[str(vcn)] = tuple(quota)
+            for pcell in spec.pinned_cells:
+                pid = str(pcell.pinned_cell_id)
+                if pid in pinned_of_id:
+                    self.pinned_chain[(str(vcn), pid)] = pinned_of_id[pid]
+        self.node_chains: Dict[str, Tuple[str, ...]] = dict(node_chains)
+        self.families: Tuple[Tuple[str, ...], ...] = (
+            compiler.chain_families(pc.cell_types, pc.physical_cells)
         )
         self.family_of_chain: Dict[str, int] = {
             c: i for i, fam in enumerate(self.families) for c in fam
@@ -202,6 +242,109 @@ class RoutingTable:
         another plan's partitions — each slot is one shard's whole-core
         projection and only its owned chains are authoritative."""
         return common.to_json({"plan": [list(p) for p in plan]})
+
+
+# --------------------------------------------------------------------- #
+# Shared-memory payload ring (proc transport)
+# --------------------------------------------------------------------- #
+
+
+class ShmRing:
+    """Single-producer single-consumer byte ring over shared memory.
+
+    Carries only PAYLOAD bytes; framing, ordering, and wakeup stay on
+    the pipe: a control frame referencing a ring payload is sent AFTER
+    the payload lands, and every consumer resolves ring frames in strict
+    pipe-arrival order, so the head/tail counters are the only shared
+    state (8-byte aligned little-endian slots; each update is a single
+    memcpy under the GIL on either side). A payload that does not fit
+    (ring full, or bigger than the ring) falls back to the pipe inline —
+    per-frame, lossless, and invisible to the caller."""
+
+    HDR = 16  # head u64 @0 (producer-owned), tail u64 @8 (consumer-owned)
+
+    def __init__(self, name: Optional[str] = None,
+                 size: int = _RING_DEFAULT_BYTES):
+        from multiprocessing import shared_memory
+
+        if name is None:
+            self._shm = shared_memory.SharedMemory(
+                create=True, size=self.HDR + size
+            )
+            self.owner = True
+            self._shm.buf[: self.HDR] = b"\0" * self.HDR
+        else:
+            # Worker-side attach. The parent owns the segment lifecycle
+            # (close() unlinks); spawned/forked workers share the
+            # parent's resource-tracker process, so the attach-side
+            # register is a set no-op and needs no counter-unregister —
+            # an explicit unregister here would double-free against the
+            # parent's unlink.
+            self._shm = shared_memory.SharedMemory(name=name)
+            self.owner = False
+        self.size = self._shm.size - self.HDR
+        self.name = self._shm.name
+
+    def _counter(self, off: int) -> int:
+        return int.from_bytes(bytes(self._shm.buf[off: off + 8]), "little")
+
+    def _set_counter(self, off: int, v: int) -> None:
+        self._shm.buf[off: off + 8] = v.to_bytes(8, "little")
+
+    def try_write(self, payload: bytes) -> bool:
+        """Producer side: append the payload if it fits, else False (the
+        caller sends it inline on the pipe)."""
+        n = len(payload)
+        head = self._counter(0)
+        tail = self._counter(8)
+        if n > self.size - (head - tail):
+            return False
+        pos = head % self.size
+        first = min(n, self.size - pos)
+        buf = self._shm.buf
+        buf[self.HDR + pos: self.HDR + pos + first] = payload[:first]
+        if first < n:
+            buf[self.HDR: self.HDR + (n - first)] = payload[first:]
+        self._set_counter(0, head + n)
+        return True
+
+    def read(self, n: int) -> bytes:
+        """Consumer side: pop exactly the next ``n`` bytes (ring frames
+        are consumed in pipe order, so no offsets are needed)."""
+        tail = self._counter(8)
+        pos = tail % self.size
+        first = min(n, self.size - pos)
+        buf = self._shm.buf
+        out = bytes(buf[self.HDR + pos: self.HDR + pos + first])
+        if first < n:
+            out += bytes(buf[self.HDR: self.HDR + (n - first)])
+        self._set_counter(8, tail + n)
+        return out
+
+    def close(self) -> None:
+        try:
+            self._shm.close()
+        except Exception:  # noqa: BLE001
+            pass
+        if self.owner:
+            try:
+                self._shm.unlink()
+            except Exception:  # noqa: BLE001
+                pass
+
+
+def _ring_enabled() -> bool:
+    return os.environ.get(SHARD_RING_ENV, "1").strip() != "0"
+
+
+def _ring_bytes() -> int:
+    try:
+        return max(
+            64 * 1024,
+            int(os.environ.get(SHARD_RING_BYTES_ENV, _RING_DEFAULT_BYTES)),
+        )
+    except ValueError:
+        return _RING_DEFAULT_BYTES
 
 
 # --------------------------------------------------------------------- #
@@ -562,7 +705,8 @@ class ShardServer:
 def _proc_worker_main(conn, config: Config, shard_id: int,
                       owned_chains: Tuple[str, ...], auto_admit: bool,
                       log_level: int,
-                      plan: Optional[List[Tuple[str, ...]]] = None) -> None:
+                      plan: Optional[List[Tuple[str, ...]]] = None,
+                      ring_names: Optional[Tuple[str, str]] = None) -> None:
     """Entry point of a shard worker process: serve requests until the
     pipe closes. The protocol is PIPELINED — the parent may queue many
     requests before reading a reply, so the worker never idles waiting
@@ -577,6 +721,23 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
     common.init_logging(log_level)
     pending: collections.deque = collections.deque()
     closed = [False]
+    req_ring = ShmRing(name=ring_names[0]) if ring_names else None
+    resp_ring = ShmRing(name=ring_names[1]) if ring_names else None
+
+    def resolve(msg):
+        # Ring frames MUST be consumed at pipe-arrival time (even when
+        # the request is only buffered behind a nested kube call): the
+        # ring carries payloads in pipe order, nothing else.
+        if (
+            req_ring is not None
+            and isinstance(msg, tuple)
+            and len(msg) == 3
+            and isinstance(msg[2], tuple)
+            and len(msg[2]) == 2
+            and msg[2][0] == _RING_MARK
+        ):
+            return (msg[0], msg[1], pickle.loads(req_ring.read(msg[2][1])))
+        return msg
 
     def recv_kube_reply():
         # Drain queued requests into the local buffer until the kube
@@ -590,7 +751,7 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
                 "kube_ok", "kube_err"
             ):
                 return msg
-            pending.append(msg)
+            pending.append(resolve(msg))
 
     kube = _ForwardingKubeClient(conn.send, recv_kube_reply)
     server = ShardServer(
@@ -602,7 +763,7 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
             msg = pending.popleft()
         else:
             try:
-                msg = conn.recv()
+                msg = resolve(conn.recv())
             except (EOFError, OSError):
                 return
         if msg is None:
@@ -613,13 +774,37 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
         except BaseException as e:  # noqa: BLE001
             conn.send(("err", req_id, _exc_to_wire(e)))
         else:
-            try:
-                conn.send(("ok", req_id, result))
-            except Exception:  # noqa: BLE001 — unpicklable result
-                conn.send(("err", req_id, (
-                    "exc", "TypeError",
-                    f"unpicklable result from {method}",
-                )))
+            sent = False
+            if (
+                resp_ring is not None
+                and method in _RING_METHODS
+                # O(1) size hint before the speculative pickle: only
+                # byte/str results can be cheaply sized, and they are
+                # exactly the potentially-large replies
+                # (filter_routine_raw's encoded body); filter_fast's
+                # small result dicts keep the pipe.
+                and isinstance(result, (bytes, bytearray, str))
+                and len(result) >= _RING_MIN_BYTES
+            ):
+                try:
+                    payload = pickle.dumps(result)
+                except Exception:  # noqa: BLE001 — fall through to pipe
+                    payload = None
+                if (
+                    payload is not None
+                    and len(payload) >= _RING_MIN_BYTES
+                    and resp_ring.try_write(payload)
+                ):
+                    conn.send(("ok", req_id, (_RING_MARK, len(payload))))
+                    sent = True
+            if not sent:
+                try:
+                    conn.send(("ok", req_id, result))
+                except Exception:  # noqa: BLE001 — unpicklable result
+                    conn.send(("err", req_id, (
+                        "exc", "TypeError",
+                        f"unpicklable result from {method}",
+                    )))
 
 
 # --------------------------------------------------------------------- #
@@ -671,6 +856,7 @@ class ProcShardBackend:
         kube_handler: Callable[[str, tuple], object],
         auto_admit: bool,
         plan: Optional[List[Tuple[str, ...]]] = None,
+        use_ring: Optional[bool] = None,
     ):
         import multiprocessing as mp
 
@@ -680,6 +866,21 @@ class ProcShardBackend:
         self.owned_chains = tuple(owned_chains)
         self._kube_handler = kube_handler
         self._send_lock = threading.Lock()
+        # Shared-memory filter ring (one per direction; see ShmRing).
+        if use_ring is None:
+            use_ring = _ring_enabled()
+        self._req_ring: Optional[ShmRing] = None
+        self._resp_ring: Optional[ShmRing] = None
+        if use_ring:
+            try:
+                self._req_ring = ShmRing(size=_ring_bytes())
+                self._resp_ring = ShmRing(size=_ring_bytes())
+            except Exception:  # noqa: BLE001 — no shm: pipe payloads
+                if self._req_ring is not None:
+                    self._req_ring.close()
+                self._req_ring = self._resp_ring = None
+        self.ring_frames = 0
+        self.ring_fallbacks = 0
         # Leader/follower receive: exactly one in-flight caller (the
         # "leader") blocks in conn.recv and dispatches whatever arrives
         # — its own reply, another caller's (delivered to that caller's
@@ -695,11 +896,16 @@ class ProcShardBackend:
         self._closing = False
         self._dead = False
         self._conn, child = ctx.Pipe(duplex=True)
+        ring_names = (
+            (self._req_ring.name, self._resp_ring.name)
+            if self._req_ring is not None
+            else None
+        )
         self._proc = ctx.Process(
             target=_proc_worker_main,
             args=(
                 child, config, shard_id, self.owned_chains, auto_admit,
-                common.log.getEffectiveLevel(), plan,
+                common.log.getEffectiveLevel(), plan, ring_names,
             ),
             name=f"hived-shard-{shard_id}",
             daemon=True,
@@ -721,6 +927,17 @@ class ProcShardBackend:
                 self._conn.send(reply)
             return
         kind, rid, payload = msg
+        if (
+            kind == "ok"
+            and self._resp_ring is not None
+            and isinstance(payload, tuple)
+            and len(payload) == 2
+            and payload[0] == _RING_MARK
+        ):
+            # Resolve ring payloads at pipe-arrival time UNCONDITIONALLY
+            # (even for a vanished caller): the ring is ordered by pipe
+            # order, so the bytes must be consumed here or never.
+            payload = pickle.loads(self._resp_ring.read(payload[1]))
         with self._io_lock:
             slot = self._pending.pop(rid, None)
         if slot is not None:
@@ -753,7 +970,23 @@ class ProcShardBackend:
             self._pending[req_id] = slot
         try:
             with self._send_lock:
-                self._conn.send((req_id, method, args))
+                # Ring write + control send under ONE lock hold: pipe
+                # order must equal ring order across caller threads.
+                wire_args = args
+                if (
+                    self._req_ring is not None
+                    and method in _RING_METHODS
+                    and _ring_candidate_args(method, args)
+                ):
+                    payload = pickle.dumps(args)
+                    if len(payload) < _RING_MIN_BYTES:
+                        pass  # small frame: the pipe's one copy is cheaper
+                    elif self._req_ring.try_write(payload):
+                        wire_args = (_RING_MARK, len(payload))
+                        self.ring_frames += 1
+                    else:
+                        self.ring_fallbacks += 1
+                self._conn.send((req_id, method, wire_args))
         except (OSError, ValueError) as e:
             with self._io_lock:
                 self._pending.pop(req_id, None)
@@ -818,6 +1051,10 @@ class ProcShardBackend:
             self._conn.close()
         except OSError:
             pass
+        for ring in (self._req_ring, self._resp_ring):
+            if ring is not None:
+                ring.close()
+        self._req_ring = self._resp_ring = None
 
 
 # --------------------------------------------------------------------- #
@@ -1499,6 +1736,20 @@ class ShardedScheduler:
             return
         self._broadcast("add_node", (node,), self._node_targets(node.name))
 
+    def add_nodes(self, nodes: List[Node]) -> None:
+        """Batched boot adds (the informer's initial list). During the
+        boot capture they buffer like add_node; live, they group per
+        shard-target set so each target shard sees one batched call."""
+        if self._informer_capture is not None:
+            self._informer_capture["nodes"].extend(nodes)
+            return
+        per_targets: Dict[Tuple[int, ...], List[Node]] = {}
+        for node in nodes:
+            key = tuple(self._node_targets(node.name))
+            per_targets.setdefault(key, []).append(node)
+        for targets, group in per_targets.items():
+            self._broadcast("add_nodes", (group,), list(targets))
+
     def update_node(self, old: Node, new: Node) -> None:
         if self._informer_capture is not None:
             self._informer_capture["nodes"].append(new)
@@ -1720,6 +1971,20 @@ class ShardedScheduler:
         merged["procShards"] = len(self.shards)
         merged["shardChains"] = {
             str(b.shard_id): list(b.owned_chains) for b in self.shards
+        }
+        # Shared-memory filter ring (proc transport): per-frontend frame
+        # counters; JSON-only (doc/observability.md).
+        merged["shardRing"] = {
+            "enabled": any(
+                getattr(b, "_req_ring", None) is not None
+                for b in self.shards
+            ),
+            "frames": sum(
+                getattr(b, "ring_frames", 0) for b in self.shards
+            ),
+            "fallbacks": sum(
+                getattr(b, "ring_fallbacks", 0) for b in self.shards
+            ),
         }
         merged["lockSharding"] = f"procs:{len(self.shards)}"
         merged["leader"] = self.is_leader()

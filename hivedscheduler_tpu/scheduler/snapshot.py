@@ -61,33 +61,62 @@ def config_fingerprint(config: Config) -> str:
     recovery replays annotations (which tolerate reconfiguration
     per-placement). Webserver knobs deliberately excluded: retuning a
     deadline must not invalidate snapshots."""
+    # STREAMED hashing (doc/hot-path.md "Boot and transport plane"): the
+    # digest is fed the exact byte sequence
+    # ``json.dumps(canonical, sort_keys=True, separators=(",", ":"))``
+    # of the historical canonical dict WITHOUT materializing that dict or
+    # its text — at 50k hosts the full form is hundreds of MB of
+    # transient strings on every boot. Byte-compatibility invariants the
+    # golden test pins: top-level keys are already alphabetical
+    # (cellTypes < physicalCells < virtualClusters); per-entry sections
+    # are emitted in sorted-key order and each small entry is dumped with
+    # the same sort_keys/separators, so the concatenation is identical to
+    # the one-shot dumps. Changing ANY byte here invalidates every live
+    # snapshot — treat this function as a serialization format.
     pc = config.physical_cluster
-    canonical = {
-        "cellTypes": {
-            str(name): {
-                "childCellType": str(ct.child_cell_type),
-                "childCellNumber": int(ct.child_cell_number),
-                "isNodeLevel": bool(ct.is_node_level),
-            }
-            for name, ct in sorted(pc.cell_types.items())
-        },
-        "physicalCells": [spec.to_dict() for spec in pc.physical_cells],
-        "virtualClusters": {
-            str(vcn): {
-                "virtualCells": [
-                    {"cellType": str(v.cell_type), "cellNumber": int(v.cell_number)}
-                    for v in spec.virtual_cells
-                ],
-                "pinnedCells": [
-                    {"pinnedCellId": str(p.pinned_cell_id)}
-                    for p in spec.pinned_cells
-                ],
-            }
-            for vcn, spec in sorted(config.virtual_clusters.items())
-        },
-    }
-    text = json.dumps(canonical, sort_keys=True, separators=(",", ":"))
-    return hashlib.sha256(text.encode()).hexdigest()
+    h = hashlib.sha256()
+
+    def dumps(obj) -> bytes:
+        return json.dumps(
+            obj, sort_keys=True, separators=(",", ":")
+        ).encode()
+
+    h.update(b'{"cellTypes":{')
+    first = True
+    for name in sorted(str(n) for n in pc.cell_types):
+        ct = pc.cell_types[name]
+        if not first:
+            h.update(b",")
+        first = False
+        h.update(dumps(name) + b":" + dumps({
+            "childCellType": str(ct.child_cell_type),
+            "childCellNumber": int(ct.child_cell_number),
+            "isNodeLevel": bool(ct.is_node_level),
+        }))
+    h.update(b'},"physicalCells":[')
+    for i, spec in enumerate(pc.physical_cells):
+        if i:
+            h.update(b",")
+        h.update(dumps(spec.to_dict()))
+    h.update(b'],"virtualClusters":{')
+    first = True
+    for vcn in sorted(str(v) for v in config.virtual_clusters):
+        spec = config.virtual_clusters[vcn]
+        if not first:
+            h.update(b",")
+        first = False
+        h.update(dumps(vcn) + b":" + dumps({
+            "virtualCells": [
+                {"cellType": str(v.cell_type), "cellNumber": int(v.cell_number)}
+                for v in spec.virtual_cells
+            ],
+            "pinnedCells": [
+                {"pinnedCellId": str(p.pinned_cell_id)}
+                for p in spec.pinned_cells
+            ],
+        }))
+    h.update(b"}}")
+    return h.hexdigest()
 
 
 def encode(

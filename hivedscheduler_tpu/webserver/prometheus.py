@@ -91,6 +91,7 @@ LABELED: Dict[str, str] = {
     "hived_lock_acquisitions_total": "per-chain lock acquisitions (chain label)",
     "hived_phase_seconds_total": "per-phase accumulated time (phase label: lockWait, coreSchedule, leafCellSearch)",
     "hived_phase_ops_total": "per-phase operation count (phase label)",
+    "hived_boot_phase_seconds": "boot wall seconds per phase (phase label: compile, healthInit, nodeAdd, fingerprint, recovery) — a gauge of the LAST boot, so standby cold-start is observable, not inferred",
 }
 
 # JSON-snapshot keys that are deliberately NOT exported to Prometheus:
@@ -105,6 +106,7 @@ EXCLUDED_KEYS = {
     "latencyHistograms",    # rendered as hived_*_latency_seconds
     "lockSharding",         # string mode flag ("chains"/"global")
     "recoveryMode",         # string mode flag ("none"/"full"/"snapshot+delta")
+    "bootPhaseSeconds",     # rendered as the hived_boot_phase_seconds gauge
 }
 
 
@@ -187,6 +189,17 @@ def render(snapshot: Dict) -> str:
         lines.append(
             'hived_lock_acquisitions_total{chain="%s"} %s'
             % (_escape_label(chain), _fmt(entry["count"]))
+        )
+
+    boot = snapshot.get("bootPhaseSeconds", {})
+    header(
+        "hived_boot_phase_seconds", "gauge",
+        LABELED["hived_boot_phase_seconds"],
+    )
+    for phase, seconds in sorted(boot.items()):
+        lines.append(
+            'hived_boot_phase_seconds{phase="%s"} %s'
+            % (_escape_label(phase), _fmt(float(seconds)))
         )
 
     phases = snapshot.get("phases", {})

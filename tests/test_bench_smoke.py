@@ -221,6 +221,48 @@ def test_bench_defrag_smoke():
     json.dumps(result)
 
 
+def test_bench_boot_smoke():
+    """Smoke-sized variant of the HIVED_BENCH_BOOT stage (ISSUE 12
+    CI/tooling satellite): the boot ladder A/B runs end to end at a CI
+    fleet, each rung carries both paths' phase breakdowns, and the
+    artifact carries the 50k extrapolation against the stated budget.
+    The 2.5x gate itself is the driver stage's at the 10k rung — a
+    432-host boot is constant-dominated, so no speedup assertion here."""
+    result = bench.bench_boot(ladder=(104, 432), reps=1)
+    assert_stage_meta(result)
+    assert set(result["ladder"]) == {"104", "432"}
+    for rung in result["ladder"].values():
+        assert rung["old_total_s"] > 0 and rung["new_total_s"] > 0
+        assert rung["speedup"] > 0
+        for side in ("new_phases", "old_phases"):
+            phases = rung[side]
+            for phase in ("compile", "healthInit", "fingerprint",
+                          "nodeAdd"):
+                assert phases[phase] >= 0, (side, phase)
+        # The lazy plane's whole point: no VC compiles at boot.
+        assert rung["vcs_compiled_new"] == 0
+    assert result["boot_budget_50k_s"] > 0
+    assert result["extrapolated_50k_s"] > 0
+    assert "budget_met" in result and "gate_passed" in result
+    json.dumps(result)
+
+
+def test_bench_ring_ab_smoke():
+    """Smoke-sized variant of the HIVED_BENCH_RING stage: the shared-
+    memory ring A/B runs end to end through real proc shards and carries
+    both modes' percentiles (the improvement claim — or its honest null
+    — is the driver stage's at 1728 hosts)."""
+    result = bench.bench_ring_ab(
+        families=2, hosts_per_family=16, n_shards=2, reps=1, calls=8
+    )
+    assert_stage_meta(result)
+    for key in ("ring_p50_ms", "pipe_p50_ms", "ring_p99_ms",
+                "pipe_p99_ms"):
+        assert result[key] > 0, key
+    assert "p50_improvement_pct" in result
+    json.dumps(result)
+
+
 def test_bench_sim_smoke():
     """Smoke-sized variant of the HIVED_BENCH_SIM stage (ISSUE 9
     CI/tooling satellite): the per-fleet-size trend curve must carry the

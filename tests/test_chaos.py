@@ -550,6 +550,10 @@ def _sched_on(kube, seed=7):
 
 
 def _boot(sched):
+    # The doomed-ledger suites assert per-VC doom visibility without any
+    # scheduling traffic; force the lazy VC compiles so health events
+    # trigger organic dooming for every VC (the eager contract).
+    sched.core.vc_schedulers.values()
     for n in sched.core.configured_node_names():
         sched.add_node(Node(name=n))
     sched.mark_ready()

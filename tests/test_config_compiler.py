@@ -206,7 +206,10 @@ def test_physical_compilation_placements():
 
 
 def test_virtual_compilation():
+    # Quotas are computed EAGERLY even in lazy mode; the cell-tree
+    # assertions below need the compiled trees, so force them.
     cc = compiler.parse_config(tpu_design_config())
+    cc.compile_all_vcs()
     # Quotas: VC1 has 2x level-4 v5p-16 cells plus the pinned one.
     assert cc.vc_free_cell_num["VC1"]["v5p-64"][4] == 3
     assert cc.vc_free_cell_num["VC1"]["v5e-16"][4] == 1

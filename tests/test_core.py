@@ -69,6 +69,11 @@ class Sim:
 
     def __init__(self, config=None):
         self.core = HivedCore(config or tpu_design_config())
+        # These semantic suites exercise per-VC doom visibility across
+        # EVERY VC (the reference's eager behavior); force the lazy
+        # compiles up front — which itself exercises ensure_vc's doom
+        # replay against the all-bad bootstrap.
+        self.core.vc_schedulers.values()
         self.all_nodes = sorted(
             {
                 n

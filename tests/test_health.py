@@ -45,6 +45,9 @@ def _booted(seed=7, **config_overrides):
         kube_client=chaos.ScriptedKubeClient(),
         force_bind_executor=lambda fn: fn(),
     )
+    # The health suites assert per-VC doom visibility across every VC
+    # (the eager contract); force the lazy compiles up front.
+    sched.core.vc_schedulers.values()
     for n in sched.core.configured_node_names():
         sched.add_node(_node(n))
     sched.mark_ready()

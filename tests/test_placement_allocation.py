@@ -16,7 +16,8 @@ from .test_config_compiler import tpu_design_config
 
 @pytest.fixture()
 def compiled():
-    return compiler.parse_config(tpu_design_config())
+    # These unit suites poke virtual trees directly: compile eagerly.
+    return compiler.parse_config(tpu_design_config(), lazy_vc=False)
 
 
 def mark_used(leaf, priority):

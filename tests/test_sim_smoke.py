@@ -20,6 +20,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 from hivedscheduler_tpu import common
 from hivedscheduler_tpu.sim.driver import build_fleet_config, run_trace
 from hivedscheduler_tpu.sim.report import placement_fingerprint
@@ -156,6 +158,36 @@ def test_sim_5k_host_trace_end_to_end():
     assert frag["largestFreeSliceChips"] > 0
     assert report["counts"]["boundGangs"] > 0
     assert report["counts"]["faultsApplied"] > 0
+    json.dumps(report)
+
+
+@pytest.mark.slow
+def test_soak_profile_50k():
+    """The PR-9-deferred 50k-host soak profile, now a standing stage
+    (ISSUE 12; hack/soak.sh --boot-profile runs it alongside the boot
+    ladder): a seeded diurnal trace at ~50k hosts replays through the
+    real scheduler with every metric family emitted, and the 50k cold
+    boot itself fits the stated budget (doc/hot-path.md "Boot and
+    transport plane")."""
+    import bench
+
+    boot = bench._measure_boot(50_000, new_path=True)
+    assert boot["total_s"] <= bench.BOOT_BUDGET_50K_S, boot
+    assert boot["vcs_compiled"] == 0
+
+    shape = TraceShape(
+        hosts=50_000, gangs=900, duration_s=43_200.0, fault_events=80
+    )
+    trace = generate_trace(0, shape)
+    report = run_trace(trace, mode="inproc")
+    assert report["hosts"] >= 49_000
+    assert report["latency"]["samples"] > 0
+    q = report["quotaSatisfaction"]
+    assert 0.0 <= q["fraction"] <= 1.0 and q["submittedGuaranteed"] > 0
+    assert report["preemption"]["events"] >= 0
+    frag = report["fragmentation"]
+    assert frag is not None and frag["samples"] > 0
+    assert report["counts"]["boundGangs"] > 0
     json.dumps(report)
 
 
