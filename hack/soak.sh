@@ -36,6 +36,11 @@
 # /v1/inspect/decisions ring + trace ring + metrics at the moment the
 # invariant fired — see doc/observability.md). DIR defaults to
 # ./chaos-artifacts; the dump path is appended to the failing assertion.
+# Pending-plane A/B: --pending runs the deep-pending-queue saturated
+# trace (HIVED_BENCH_PENDING=1; >=200 waiting gangs) — indexed wake vs
+# FIFO-rescan-with-cache vs cache-off at identical seed, fingerprints
+# asserted bit-identical, the retry-storm >=2x gate recorded
+# (doc/hot-path.md "Pending-pod plane"): hack/soak.sh --pending
 # Trace soak: --trace generates a seeded warehouse trace (sim tier,
 # doc/hot-path.md "Warehouse-scale profile") and replays it against the
 # REAL HTTP extender path via hack/sim_server.py --trace. Knobs:
@@ -58,6 +63,13 @@ if [[ "${1:-}" == "--trace" ]]; then
   # No exec: the EXIT trap must still fire to clean up the trace file.
   python hack/sim_server.py --trace "$tmp" --hosts "$hosts" "$@"
   exit $?
+fi
+
+if [[ "${1:-}" == "--pending" ]]; then
+  shift
+  export JAX_PLATFORMS=cpu
+  echo "pending-plane A/B: deep-pending-queue saturated trace (3 modes)"
+  exec env HIVED_BENCH_PENDING=1 python bench.py "$@"
 fi
 
 if [[ "${1:-}" == "--boot-profile" ]]; then

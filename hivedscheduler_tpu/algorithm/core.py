@@ -1049,6 +1049,87 @@ class HivedCore:
         lock-free)."""
         return list(self.compiled.vc_nonpinned_chains.get(vc, []))
 
+    # -- pending-pod plane (doc/hot-path.md "Pending-pod plane") ------------
+
+    def quota_token(
+        self, vc: api.VirtualClusterName, chains
+    ) -> Tuple[Tuple[int, int, int], ...]:
+        """Compact digest of the quota counters a schedule attempt for
+        ``vc`` can read over ``chains``: per chain, the VC's own free-cell
+        quota, the all-VC free total, and the all-VC doomed-bad total
+        (level-summed — any counter movement changes a sum, counters are
+        non-negative, and every movement rides a mutation that also bumps
+        a monotonic epoch, so the composed version vector cannot ABA).
+        Defense-in-depth alongside the chain epochs: the rejection
+        certificate's vector stays valid only while the quota arithmetic
+        the safety checks read is byte-for-byte what the WAIT saw."""
+        vc_free = self.vc_free_cell_num.get(vc, {})
+        return tuple(
+            (
+                sum(vc_free.get(chain, {}).values()),
+                sum(self.all_vc_free_cell_num.get(chain, {}).values()),
+                sum(self.all_vc_doomed_bad_cell_num.get(chain, {}).values()),
+            )
+            for chain in chains
+        )
+
+    def rejection_certificate(
+        self,
+        spec: api.PodSchedulingSpec,
+        wait_reason: str,
+        chains,
+        suggested_token,
+    ) -> Dict:
+        """The compact certificate a WAIT verdict carries: the gate that
+        failed plus the version vector the placement descent read — the
+        mutation epochs of every chain the attempt's lock section covered,
+        the doomed-ledger epoch, the VC quota counters, and the
+        suggested-set token (None when the spec ignores suggested nodes).
+        ``certificate_current`` answering True certifies a re-run of
+        ``schedule()`` for the identical spec would return the identical
+        WAIT: every input the descent reads lives in the covered chains'
+        cell state (the lock-sharding contract, doc/hot-path.md), and any
+        completed mutation of that state bumps at least one monotonic
+        component of the vector."""
+        from ..scheduler.decisions import classify_reason
+
+        chains = tuple(str(c) for c in chains)
+        return {
+            "gate": classify_reason(wait_reason),
+            "vc": str(spec.virtual_cluster),
+            "chainEpochs": {c: self.chain_epoch(c) for c in chains},
+            "doomedEpoch": self.doomed_epoch,
+            "quota": self.quota_token(spec.virtual_cluster, chains),
+            "suggested": suggested_token,
+        }
+
+    def certificate_current(self, cert: Dict) -> bool:
+        """One version-vector compare, lock-free: the epoch and doomed-
+        epoch reads are GIL-atomic ints and monotonic, and quota
+        movements always accompany an epoch bump — so equality means no
+        mutation covered by the certificate completed before the epoch
+        reads (an in-flight mutation still holds its chain locks and is
+        linearized after this answer). Any mismatch — including a
+        concurrent mutator resizing a quota dict mid-iteration (the
+        quota sums walk shared nested dicts a lock-holder may insert a
+        new level key into) — sends the caller to the full filter pass;
+        the compare can only ever be conservative."""
+        epochs = cert["chainEpochs"]
+        for chain, epoch in epochs.items():
+            if self.chain_epoch(chain) != epoch:
+                return False
+        if self.doomed_epoch != cert["doomedEpoch"]:
+            return False
+        try:
+            return (
+                self.quota_token(cert["vc"], tuple(epochs))
+                == cert["quota"]
+            )
+        except RuntimeError:
+            # "dictionary changed size during iteration": a mutation is
+            # in flight — the vector is moving, treat as stale.
+            return False
+
     # -- node events --------------------------------------------------------
 
     def add_node(self, node: Node) -> None:

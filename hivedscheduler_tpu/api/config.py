@@ -88,6 +88,13 @@ class Config:
     # live process without a config rollout).
     decision_journal_capacity: int = 512
     trace_ring_capacity: int = 256
+    # Pending-pod plane (doc/hot-path.md "Pending-pod plane"): bound on
+    # the negative-filter (WAIT) cache — distinct waiting spec identities
+    # whose rejection certificates are kept so an unchanged re-filter is
+    # answered by one version-vector compare instead of a placement
+    # descent. 0 disables the cache (as does the HIVED_WAIT_CACHE=0 env
+    # hatch, which needs no config rollout).
+    wait_cache_capacity: int = 4096
     # HA / snapshot recovery plane (doc/fault-model.md "HA and snapshot
     # recovery plane"). snapshot_interval_seconds > 0 arms the background
     # snapshot flusher (HivedScheduler.start_snapshot_flusher) that
@@ -125,6 +132,7 @@ class Config:
         flap_hs = d.get("healthFlapHoldSeconds")
         dj_cap = d.get("decisionJournalCapacity")
         tr_cap = d.get("traceRingCapacity")
+        wc_cap = d.get("waitCacheCapacity")
         snap_s = d.get("snapshotIntervalSeconds")
         lease_d = d.get("leaseDurationSeconds")
         lease_r = d.get("leaseRenewSeconds")
@@ -159,6 +167,7 @@ class Config:
                 512 if dj_cap is None else int(dj_cap)
             ),
             trace_ring_capacity=256 if tr_cap is None else int(tr_cap),
+            wait_cache_capacity=4096 if wc_cap is None else int(wc_cap),
             snapshot_interval_seconds=(
                 0.0 if snap_s is None else float(snap_s)
             ),

@@ -75,7 +75,8 @@ class DecisionRecord:
         "seq", "trace_id", "pod_key", "pod_uid", "group", "vc", "priority",
         "leaf_cell_type", "leaf_cell_number", "phase", "lock_chains",
         "chains_considered", "attempts", "verdict", "node", "leaf_cells",
-        "victims", "wait_reason", "error", "notes", "wall_time",
+        "victims", "wait_reason", "certificate", "error", "notes",
+        "wall_time",
     )
 
     def __init__(self, seq: int, pod_key: str, pod_uid: str, phase: str,
@@ -98,6 +99,7 @@ class DecisionRecord:
         self.leaf_cells: List[int] = []
         self.victims: List[Dict] = []
         self.wait_reason = ""
+        self.certificate: Optional[Dict] = None
         self.error = ""
         self.notes: List[str] = []
         self.wall_time = time.time()
@@ -152,9 +154,17 @@ class DecisionRecord:
             for v in victim_pods
         ]
 
-    def verdict_wait(self, reason: str) -> None:
+    def verdict_wait(
+        self, reason: str, certificate: Optional[Dict] = None
+    ) -> None:
+        """A WAIT verdict, optionally carrying its rejection certificate
+        (the failed gate + the version vector the attempt read —
+        doc/hot-path.md "Pending-pod plane"): the "what must change for
+        this pod to schedule" record the what-if plane consumes, and the
+        key the negative-filter cache revalidates re-filters against."""
         self.verdict = "wait"
         self.wait_reason = reason
+        self.certificate = certificate
 
     def verdict_error(self, message: str) -> None:
         self.verdict = "error"
@@ -187,6 +197,8 @@ class DecisionRecord:
             d["victims"] = self.victims
         if self.wait_reason:
             d["waitReason"] = self.wait_reason
+        if self.certificate is not None:
+            d["certificate"] = self.certificate
         if self.error:
             d["error"] = self.error
         if self.notes:

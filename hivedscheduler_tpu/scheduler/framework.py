@@ -175,6 +175,11 @@ class SchedulerMetrics:
         # (no global-lock acquisition; doc/hot-path.md "Warehouse-scale
         # profile" — a relist at fleet scale re-delivers every node).
         self.node_event_noop_count = 0
+        # Pending-pod plane (doc/hot-path.md "Pending-pod plane"): filter
+        # calls answered from the negative-filter cache — a repeated WAIT
+        # whose rejection certificate's version vector was unchanged, so
+        # no lock section or placement descent ran.
+        self.fast_wait_count = 0
         self.ledger_coalesced_count = 0
         self.stranded_eviction_count = 0
         # Elastic gang plane (doc/fault-model.md "Elastic gang plane"):
@@ -298,6 +303,10 @@ class SchedulerMetrics:
         with self._lock:
             self.node_event_noop_count += 1
 
+    def observe_fast_wait(self) -> None:
+        with self._lock:
+            self.fast_wait_count += 1
+
     def observe_ledger_coalesced(self, n: int) -> None:
         with self._lock:
             self.ledger_coalesced_count += n
@@ -382,6 +391,7 @@ class SchedulerMetrics:
                 "healthDampedCount": self.health_damped_count,
                 "healthSettledCount": self.health_settled_count,
                 "nodeEventNoopCount": self.node_event_noop_count,
+                "fastWaitCount": self.fast_wait_count,
                 "doomedLedgerCoalescedCount": self.ledger_coalesced_count,
                 "strandedEvictionCount": self.stranded_eviction_count,
                 "snapshotPersistCount": self.snapshot_persist_count,
@@ -412,6 +422,14 @@ class SchedulerMetrics:
 NODE_EVENT_FASTPATH_DEFAULT = (
     os.environ.get("HIVED_NODE_EVENT_FASTPATH", "") != "0"
 )
+
+# Pending-pod plane escape hatch (doc/hot-path.md "Pending-pod plane"):
+# HIVED_WAIT_CACHE=0 disables the negative-filter cache so every
+# re-filter of a waiting pod runs the full pass — the differential
+# reference for the cached ≡ recomputed proof (tests/test_wait_cache.py).
+# Read at construction (not import) so bench A/Bs can flip it per
+# scheduler instance.
+WAIT_CACHE_ENV = "HIVED_WAIT_CACHE"
 
 
 class HivedScheduler:
@@ -452,6 +470,33 @@ class HivedScheduler:
             capacity=config.decision_journal_capacity
         )
         self.core.decisions = self.decisions
+        # Pending-pod plane (doc/hot-path.md "Pending-pod plane"): the
+        # negative-filter cache. Keyed by spec identity (the raw
+        # scheduling-spec annotation text — every pod of a gang shares
+        # it), each entry memoizes a WAIT verdict plus its rejection
+        # certificate; a re-filter whose version vector is unchanged is
+        # answered without a lock section or placement descent
+        # (_try_fast_wait). Bounded FIFO (wait_cache_capacity); reads are
+        # lock-free GIL-atomic dict gets, writes take the micro-lock
+        # below (never held while acquiring anything else). Cleared
+        # wholesale around snapshot restores — restore_projection writes
+        # cell fields directly, without the epoch-bumping mutator hooks
+        # the certificates rely on.
+        self.wait_cache_enabled = (
+            os.environ.get(WAIT_CACHE_ENV, "1").strip() != "0"
+            and config.wait_cache_capacity > 0
+        )
+        self._wait_cache: Dict[str, Dict] = {}
+        self._wait_cache_lock = threading.Lock()
+        # Single-slot suggested-set token memo, validated by list-object
+        # IDENTITY (the entry holds a strong reference, so the id cannot
+        # recycle). Callers reusing one node-name list across filter
+        # calls (the sim driver, the shards filter_fast memo) tokenize in
+        # O(1); callers building a fresh list per request (the webserver)
+        # pay one O(n) hash — still far below the set build they already
+        # do. Contract: node-name lists handed to filter_routine are
+        # never mutated in place (true for every caller today).
+        self._suggested_token_memo: Optional[Tuple] = None
         # Scheduling serializes per cell chain (scheduler.locks): filter /
         # bind / preempt acquire only the chains their pod's spec can touch,
         # whole-cluster mutators (node/pod events, health, recovery,
@@ -1066,6 +1111,10 @@ class HivedScheduler:
         self._enter_mutation()
         self._in_recovery = True
         self._recovery_t0 = time.monotonic()
+        # The replay (and any snapshot import inside it) rewrites cell
+        # state through paths that bypass the epoch-bumping mutators;
+        # every memoized WAIT certificate is void.
+        self._wait_cache_clear()
         ledger = None
         if ledger_payload:
             try:
@@ -1460,6 +1509,7 @@ class HivedScheduler:
             self._chip_targets.clear()
             self._damper.reset()
             self._preapplied_chunks = None
+        self._wait_cache_clear()
 
     def load_valid_snapshot(self, min_watermark=None) -> Optional[Dict]:
         """Load + validate the persisted snapshot. None (with
@@ -1685,6 +1735,9 @@ class HivedScheduler:
         core.preemption_observer = self._on_preemption_event
         core.preempt_rng = old.preempt_rng
         self.core = core
+        # The fresh core's epochs restart at 0: a certificate issued
+        # against the old core could compare equal by coincidence.
+        self._wait_cache_clear()
         self.pod_schedule_statuses.clear()
         self.quarantined_pods.clear()
         self._snapshot_pending.clear()
@@ -2971,6 +3024,140 @@ class HivedScheduler:
             )
 
     # ------------------------------------------------------------------ #
+    # Pending-pod plane: the negative-filter (WAIT) cache
+    # (doc/hot-path.md "Pending-pod plane")
+    # ------------------------------------------------------------------ #
+
+    def _suggested_token(self, node_names: List[str]) -> Tuple[int, int]:
+        """O(1) token for a reused node-name list (object-identity memo),
+        O(n) tuple hash for a fresh one. Two calls with the same list
+        CONTENTS in the same order produce the same token; a reordered or
+        changed set produces a different one — the compare direction is
+        conservative (a spurious mismatch just runs the full filter)."""
+        memo = self._suggested_token_memo
+        if (
+            memo is not None
+            and memo[0] is node_names
+            and memo[1] == len(node_names)
+        ):
+            return memo[2]
+        token = (len(node_names), hash(tuple(node_names)))
+        self._suggested_token_memo = (node_names, len(node_names), token)
+        return token
+
+    def _wait_cache_store(
+        self, key: str, spec, cert: Dict, wait_reason: str
+    ) -> None:
+        """Memoize a WAIT verdict (called inside the filter's chain
+        section, AFTER schedule() returned — the certificate's vector
+        reflects exactly the state the descent read)."""
+        entry = {
+            "cert": cert,
+            "waitReason": wait_reason,
+            "vc": str(spec.virtual_cluster),
+            "priority": spec.priority,
+            "leafCellType": str(spec.leaf_cell_type or ""),
+            "leafCellNumber": spec.leaf_cell_number,
+            "group": (
+                spec.affinity_group.name
+                if spec.affinity_group is not None
+                else ""
+            ),
+        }
+        with self._wait_cache_lock:
+            cache = self._wait_cache
+            if key not in cache and len(cache) >= (
+                self.config.wait_cache_capacity
+            ):
+                # Bounded FIFO eviction (no LRU reordering: hits must
+                # stay lock-free dict reads).
+                cache.pop(next(iter(cache)), None)
+            cache[key] = entry
+
+    def _wait_cache_drop(self, key: str) -> None:
+        if key and self._wait_cache:
+            with self._wait_cache_lock:
+                self._wait_cache.pop(key, None)
+
+    def _wait_cache_clear(self) -> None:
+        """Wholesale invalidation for state restores that bypass the
+        epoch-bumping cell mutators (snapshot import / pre-apply discard
+        / recovery replay)."""
+        if self._wait_cache:
+            with self._wait_cache_lock:
+                self._wait_cache.clear()
+
+    def _try_fast_wait(
+        self, args: ei.ExtenderArgs
+    ) -> Optional[ei.ExtenderFilterResult]:
+        """The repeated-rejection fast path: when this spec identity's
+        last verdict was WAIT and its rejection certificate's version
+        vector is unchanged, answer WAIT with one vector compare — no
+        spec decode, no suggested-set build, no lock section, no
+        placement descent. None means: take the full path (cache miss,
+        vector moved, or the pod is not plainly WAITING — BINDING pods
+        must insist on their bind, unknown pods must see the admission
+        check). The decision journal still records the attempt (with the
+        certificate), so explainability survives the shortcut."""
+        pod = args.pod
+        key = pod.annotations.get(
+            constants.ANNOTATION_POD_SCHEDULING_SPEC, ""
+        )
+        if not key:
+            return None
+        entry = self._wait_cache.get(key)
+        if entry is None:
+            return None
+        status = self.pod_schedule_statuses.get(pod.uid)
+        if status is None:
+            if not self.auto_admit:
+                return None  # the admission check must reject it
+        elif status.pod_state != PodState.WAITING:
+            return None
+        cert = entry["cert"]
+        if status is None:
+            # Auto-admit (sims/benches): register the pod WAITING like
+            # the full path's admission check would, so the status map
+            # is identical cache-on and cache-off. Single dict insert +
+            # a no-op core call — safe without the chain section (the
+            # full path's own WAIT status write is the same GIL-atomic
+            # insert; auto-admit callers drive each pod from one
+            # thread). The status carries no pod_schedule_result —
+            # nothing reads that field for WAITING pods.
+            self._admit_unbound(pod)
+        if cert["suggested"] is not None and cert["suggested"] != (
+            self._suggested_token(args.node_names)
+        ):
+            return None
+        if not self.core.certificate_current(cert):
+            return None
+        wait_reason = entry["waitReason"]
+        tr = self.tracer.trace("filter", pod=pod.key)
+        rec = self.decisions.begin(
+            pod.key, pod.uid, "filter",
+            trace_id=tr.trace_id if tr else None,
+        )
+        rec.lock_chains = "waitCache"
+        rec.vc = entry["vc"]
+        rec.priority = entry["priority"]
+        rec.leaf_cell_type = entry["leafCellType"]
+        rec.leaf_cell_number = entry["leafCellNumber"]
+        rec.group = entry["group"]
+        rec.note("served from the wait cache (certificate unchanged)")
+        rec.verdict_wait(wait_reason, certificate=cert)
+        self.decisions.commit(rec)
+        if tr:
+            tr.add_span("waitCache", 0.0)
+            tr.finish(outcome="wait")
+        if self.config.waiting_pod_scheduling_block_ms > 0:
+            # The FIFO-approximation knob blocks WAIT responses; a cached
+            # WAIT is still a WAIT response.
+            time.sleep(self.config.waiting_pod_scheduling_block_ms / 1e3)
+        return ei.ExtenderFilterResult(
+            failed_nodes={constants.COMPONENT_NAME: wait_reason}
+        )
+
+    # ------------------------------------------------------------------ #
     # Filter (reference: scheduler.go:485-587)
     # ------------------------------------------------------------------ #
 
@@ -2984,6 +3171,14 @@ class HivedScheduler:
     def _filter_routine(self, args: ei.ExtenderArgs) -> ei.ExtenderFilterResult:
         start = time.monotonic()
         pod = args.pod
+        if self.wait_cache_enabled:
+            fast = self._try_fast_wait(args)
+            if fast is not None:
+                self.metrics.observe_fast_wait()
+                self.metrics.observe_filter(
+                    time.monotonic() - start, "wait", 0.0, None
+                )
+                return fast
         # Observability plane: a (sampled) span trace for the whole verb,
         # and an (always-on) decision record begun inside the section —
         # where the acquired lock scope is known (doc/observability.md).
@@ -3003,6 +3198,14 @@ class HivedScheduler:
         except api.WebServerError as e:
             spec_error = e
         suggested_set = set(args.node_names)
+        # The certificate's suggested-set token is pure request data too:
+        # hash it here, not under the chain locks (for fresh per-request
+        # lists — the webserver — it is O(fleet) like the set build).
+        suggested_token = (
+            None
+            if spec is None or spec.ignore_k8s_suggested_nodes
+            else self._suggested_token(args.node_names)
+        )
 
         # Chain-scoped critical section: filters for disjoint chains run
         # concurrently (spec parse above and result serialization in the
@@ -3020,7 +3223,8 @@ class HivedScheduler:
             rec.lock_chains = self._lock_scope(sec)
             try:
                 return self._filter_locked(
-                    args, spec, spec_error, suggested_set
+                    args, spec, spec_error, suggested_set, sec,
+                    suggested_token,
                 )
             except api.WebServerError as e:
                 rec.verdict_error(e.message)
@@ -3062,10 +3266,14 @@ class HivedScheduler:
             else [str(k) for k in sec.keys]
         )
 
-    def _filter_locked(self, args, spec, spec_error, suggested_set):
+    def _filter_locked(self, args, spec, spec_error, suggested_set,
+                       sec=None, suggested_token=None):
         pod = args.pod
         suggested_nodes = args.node_names
         rec = self.decisions.current()
+        spec_key = pod.annotations.get(
+            constants.ANNOTATION_POD_SCHEDULING_SPEC, ""
+        )
 
         status = self._admission_check(pod.uid, pod)
         if status.pod_state == PodState.BINDING:
@@ -3126,6 +3334,10 @@ class HivedScheduler:
                     binding_pod.node_name,
                     result.pod_bind_info.leaf_cell_isolation,
                 )
+            if self.wait_cache_enabled:
+                # The spec schedules now; a memoized WAIT is moot (its
+                # vector is stale anyway — the bind bumped the epochs).
+                self._wait_cache_drop(spec_key)
             return (
                 ei.ExtenderFilterResult(node_names=[binding_pod.node_name]),
                 "bind",
@@ -3149,6 +3361,8 @@ class HivedScheduler:
             )
             if rec is not None:
                 rec.verdict_preempt(result.pod_preempt_info.victim_pods)
+            if self.wait_cache_enabled:
+                self._wait_cache_drop(spec_key)
             return (
                 ei.ExtenderFilterResult(failed_nodes=failed_nodes),
                 "preempt",
@@ -3165,8 +3379,32 @@ class HivedScheduler:
         if result.pod_wait_info is not None and result.pod_wait_info.reason:
             wait_reason += ": " + result.pod_wait_info.reason
         common.log.info("[%s]: %s", pod.key, wait_reason)
+        # Pending-pod plane: emit the rejection certificate — the failed
+        # gate plus the version vector this attempt read, captured HERE,
+        # inside the section, after schedule() returned (the descent's own
+        # mutations, e.g. a reverted lazy preempt, already bumped the
+        # epochs the vector records). The certificate rides the decision
+        # record (the what-if plane's input) and keys the wait cache.
+        cert = None
+        if spec is not None:
+            chains = (
+                sec.keys if sec is not None
+                else tuple(self.core.chain_epochs)
+            )
+            cert = self.core.rejection_certificate(
+                spec,
+                result.pod_wait_info.reason
+                if result.pod_wait_info is not None
+                else "",
+                chains,
+                # Hashed pre-lock in _filter_routine (None when the spec
+                # ignores suggested nodes).
+                suggested_token,
+            )
         if rec is not None:
-            rec.verdict_wait(wait_reason)
+            rec.verdict_wait(wait_reason, certificate=cert)
+        if cert is not None and self.wait_cache_enabled and spec_key:
+            self._wait_cache_store(spec_key, spec, cert, wait_reason)
         # Fake FailedNodes expose the wait reason alongside the default
         # scheduler's own reasons (reference: scheduler.go:573-585).
         return (

@@ -508,7 +508,7 @@ class ShardServer:
         if nodes is not None:
             if len(self._nodes_cache) > 64:
                 self._nodes_cache.clear()
-            self._nodes_cache[nodes_key] = list(nodes)
+            nodes = self._nodes_cache[nodes_key] = list(nodes)
         else:
             nodes = self._nodes_cache.get(nodes_key)
             if nodes is None:
@@ -516,8 +516,14 @@ class ShardServer:
                 # with the full list.
                 return {"__needNodes": True}
         try:
+            # The MEMOIZED list object itself is handed to the filter
+            # (not a per-call copy): filter_routine treats node_names as
+            # read-only, and the stable identity lets the wait cache's
+            # suggested-set token memo answer in O(1) per re-filter
+            # instead of re-hashing the fleet-sized list (doc/hot-path.md
+            # "Pending-pod plane" — the set id IS the object).
             args = ei.ExtenderArgs(
-                pod=ei.pod_from_k8s(pod_dict), node_names=list(nodes)
+                pod=ei.pod_from_k8s(pod_dict), node_names=nodes
             )
             result = self.scheduler.filter_routine(args)
         except api.WebServerError as e:

@@ -26,10 +26,14 @@ run-varying output.
 from __future__ import annotations
 
 import heapq
+import os
 import random
 import time
-from typing import Dict, List, Optional, Set, Tuple
+from collections import OrderedDict
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
 
+from .. import common
+from ..algorithm import compiler
 from ..api import constants, extender as ei
 from ..api.config import Config
 from ..scheduler.framework import HivedScheduler, NullKubeClient
@@ -37,10 +41,131 @@ from ..scheduler.types import Node, Pod
 from . import fleet
 from .trace import TraceShape
 
-# Waiting-queue retry budget per capacity-freeing event: bounds the
-# worst-case O(waiting * events) replay cost while keeping the FIFO
-# fairness the reference's block knob approximates.
-RETRY_BUDGET_PER_EVENT = 8
+# Pending-pod plane (doc/hot-path.md "Pending-pod plane"): the waiting
+# queue is ELIGIBILITY-INDEXED — waiters are grouped by chain family and
+# a retry wake attempts, in FIFO order, only the waiters whose family's
+# state may have CHANGED since their last attempt. Change tracking is a
+# dirty-family set fed by every state-changing action the driver
+# performs or triggers — departures and preemption kills (family-
+# scoped), binds (a fresh bind is a fresh victim for a waiting
+# preemptor; family-scoped), and faults of ANY kind plus defrag health
+# ticks (ALL families: a capacity removal can shift a placement onto
+# occupied cells and surface victims, and the scheduler's flap-damper
+# settle sweep piggybacks on any node observation and may apply a HELD
+# transition for an unrelated node) — drained at each wake. Chains in different families share no cells, so a waiter
+# whose family is clean would re-read exactly the state its last failed
+# attempt read and fail identically, with no side effects and no RNG
+# draw; skipping it is a deletion of a provable no-op from the FIFO
+# rescan's attempt sequence (the admission-equivalence argument
+# tests/test_sim_smoke.py proves differentially at identical seeds).
+# Over-waking is always safe — the FIFO reference attempts everyone —
+# so every unknown degrades to "wake all", never to a missed wake.
+# This retires the old RETRY_BUDGET_PER_EVENT=8 stopgap and its
+# starvation caveat: no waiter is ever dropped from a wake it is
+# eligible for. HIVED_SIM_FIFO_RETRY=1 restores the budget-free FIFO
+# rescan of EVERY waiter on every capacity-freeing event — the
+# differential's reference mode, and the regime where the
+# scheduler-side wait cache does the same pruning one layer down (each
+# unchanged re-filter answers from its certificate).
+FIFO_RETRY_ENV = "HIVED_SIM_FIFO_RETRY"
+
+# Sentinel family meaning "unknown — treat as every family".
+ALL_FAMILIES = -1
+
+
+def _leaf_family_map(config: Config) -> Dict[str, int]:
+    """Leaf type -> chain-family index from the compiled spec metadata —
+    the same connected-components partition the shards RoutingTable uses
+    (compiler.chain_families; one leaf SKU never spans two families by
+    construction). Derivation failure degrades to an empty map = every
+    wake is global (the FIFO behavior), never an error — but logged, so
+    a silently-disabled index is diagnosable."""
+    pc = config.physical_cluster
+    try:
+        fams = compiler.chain_families(pc.cell_types, pc.physical_cells)
+        elements = compiler.build_cell_chains(pc.cell_types)
+        leaf_family: Dict[str, int] = {}
+        for i, fam in enumerate(fams):
+            for chain in fam:
+                ce = elements.get(chain)
+                if ce is not None:
+                    leaf_family.setdefault(str(ce.leaf_cell_type), i)
+        return leaf_family
+    except Exception as e:  # noqa: BLE001 — degrade to global wakes
+        common.log.warning(
+            "chain-family derivation failed; retry wakes degrade to "
+            "global (eligibility index off): %s", e,
+        )
+        return {}
+
+
+class _WaitQueue:
+    """FIFO-ordered waiting gangs with the eligibility index. ``eligible``
+    preserves global FIFO order within any wake, so the indexed mode's
+    attempt sequence is the FIFO rescan's with provably-no-op attempts
+    deleted."""
+
+    def __init__(self, leaf_family: Dict[str, int], fifo: bool):
+        self.fifo = fifo
+        self._leaf_family = leaf_family
+        self._order: "OrderedDict[str, _Gang]" = OrderedDict()
+        self.waiting_max = 0
+        self.wake_events = 0
+        self.wake_attempts = 0
+        self.wake_skipped = 0
+
+    def __len__(self) -> int:
+        return len(self._order)
+
+    def family(self, gang: "_Gang") -> int:
+        """The gang's chain-family index; -1 = unknown leaf type (always
+        eligible — conservative)."""
+        return self._leaf_family.get(gang.leaf_type, -1)
+
+    def key(self, gang: "_Gang") -> Tuple[int, int, str]:
+        """The waiter's index key: (chain family, gang chips, VC). Only
+        the FAMILY component gates eligibility — a gang-size gate
+        ("enough free chips in the family") is unsound for guaranteed
+        waiters (preemption can succeed with zero free capacity), and a
+        VC gate is unsound because physical capacity is shared across
+        VCs; either would break admission equivalence. Size and VC make
+        the queue's composition observable (key_counts)."""
+        return (self.family(gang), gang.n_pods * gang.chips, gang.vc)
+
+    def key_counts(self) -> Dict[str, int]:
+        """Waiting-queue composition by index key, for the report."""
+        out: Dict[str, int] = {}
+        for g in self._order.values():
+            fam, chips, vc = self.key(g)
+            k = f"family{fam}:{chips}ch:{vc}"
+            out[k] = out.get(k, 0) + 1
+        return out
+
+    def add(self, gang: "_Gang") -> None:
+        self._order[gang.name] = gang
+        if len(self._order) > self.waiting_max:
+            self.waiting_max = len(self._order)
+
+    def remove(self, name: str) -> None:
+        self._order.pop(name, None)
+
+    def eligible(
+        self, families: Optional[FrozenSet[int]]
+    ) -> List["_Gang"]:
+        """Waiters to attempt for one wake, FIFO order. ``families=None``
+        (or the FIFO hatch) wakes everyone; otherwise only waiters whose
+        chain family the event touched (plus unknown-family waiters)."""
+        gangs = list(self._order.values())
+        if self.fifo or families is None:
+            return gangs
+        out = []
+        for g in gangs:
+            f = self.family(g)
+            if f < 0 or f in families:
+                out.append(g)
+            else:
+                self.wake_skipped += 1
+        return out
 
 
 def build_fleet_config(hosts: int) -> Tuple[Config, int]:
@@ -112,9 +237,23 @@ class TraceDriver:
         transport: str = "proc",
         frag_samples: int = 8,
         scheduler=None,
+        fifo_retry: Optional[bool] = None,
     ):
         self.mode = mode
         self.frag_samples = frag_samples
+        # Retry-wake mode (doc/hot-path.md "Pending-pod plane"): indexed
+        # by default; True (or HIVED_SIM_FIFO_RETRY=1) restores the FIFO
+        # rescan of every waiter per capacity-freeing event.
+        self.fifo_retry = (
+            os.environ.get(FIFO_RETRY_ENV, "").strip() == "1"
+            if fifo_retry is None
+            else bool(fifo_retry)
+        )
+        self._leaf_family = _leaf_family_map(config)
+        # Families whose state may have changed since the last retry
+        # wake (reset per run; fed by every state-changing driver
+        # action, drained by retry_waiting).
+        self._dirty_families: Set[int] = set()
         if scheduler is not None:
             # Pre-built subject (hack/sim_server.py's HTTP-wire adapter):
             # anything exposing the HivedScheduler verb surface — possibly
@@ -165,10 +304,26 @@ class TraceDriver:
         if close is not None:
             close()
 
+    def _mark_dirty_gang(self, gang: "_Gang") -> None:
+        self._dirty_families.add(
+            self._leaf_family.get(gang.leaf_type, ALL_FAMILIES)
+        )
+
     # -- fault vocabulary (chaos events, resolved by node index) ------- #
 
     def _apply_fault(self, ev: Dict) -> None:
         name = self.nodes[ev["nodeIndex"] % len(self.nodes)]
+        # EVERY fault kind dirties EVERY family: (a) a capacity REMOVAL
+        # can also change a waiter's next attempt (a shifted placement
+        # can surface preemption victims), so removals mark even though
+        # they never trigger a wake; (b) the node event below runs the
+        # scheduler's flap-damper settle sweep, which can apply a HELD
+        # transition for any OTHER node — including one in a family this
+        # fault never touched — so node-scoped marking would under-wake
+        # and break the FIFO admission equivalence. Fault events are
+        # rare next to departures (which stay family-scoped), so the
+        # index keeps its selectivity where the volume is.
+        self._dirty_families.add(ALL_FAMILIES)
         old = self._node_cache[name]
         annotations = dict(old.annotations)
         ready = old.ready
@@ -239,6 +394,11 @@ class TraceDriver:
         sched = self.sched
         if getattr(sched, "defrag", None) is None or self.core is None:
             return 0, 0
+        # health_tick runs the damper's settle sweep (held transitions
+        # for ANY node may apply) and defrag churn deletes/re-places
+        # whole gangs: both touch arbitrary families — mark them all
+        # (defrag pulses are per frag-sample, rare; over-waking is safe).
+        self._dirty_families.add(ALL_FAMILIES)
         sched.health_tick()
         proposals = sched.take_defrag_proposals()
         migrated = 0
@@ -298,7 +458,83 @@ class TraceDriver:
                     self.sched.delete_pod(p)
                 killed += len(g.bound)
                 del live[gname]
+                self._mark_dirty_gang(g)
         return killed
+
+    def retry_storm(self, rounds: int = 3) -> Dict:
+        """Extender-style pending retries over the end-of-trace waiting
+        queue (call after ``run``): the K8s default scheduler re-filters
+        every pending pod on its backoff REGARDLESS of cluster events —
+        the exact repeated-rejection regime the negative-filter cache
+        exists for (doc/hot-path.md "Pending-pod plane"). Sweeps the
+        still-waiting gangs ``rounds`` times with nothing changed and
+        reports the re-filter cost. An UNMEASURED quiesce pre-pass first
+        removes (and releases) any waiter the trace's final wake left
+        schedulable, so every measured call is a true repeated
+        rejection — bind handling and teardown never pollute the
+        recorded throughput or percentiles."""
+        gangs = list(getattr(self, "last_waiting", []) or [])
+        # One pod object per gang for the whole storm (the default
+        # scheduler retries the same pod object too); building pods is
+        # driver bookkeeping, not re-filter cost — keep it out of the
+        # measured region.
+        probes = {g.name: g.make_pods()[0] for g in gangs}
+        for gang in list(gangs):  # quiesce (unmeasured)
+            pod = probes[gang.name]
+            r = self.sched.filter_routine(
+                ei.ExtenderArgs(pod=pod, node_names=self.nodes)
+            )
+            if r.node_names:
+                self.sched.delete_pod(pod)
+                gangs.remove(gang)
+        n_waiters = len(gangs)
+        lat_ms: List[float] = []
+        steady_ms: List[float] = []  # rounds 2+: the repeated rejections
+        attempts = 0
+        t0 = time.perf_counter()
+        for rnd in range(max(0, rounds)):
+            for gang in list(gangs):
+                pod = probes[gang.name]
+                t1 = time.perf_counter()
+                r = self.sched.filter_routine(
+                    ei.ExtenderArgs(pod=pod, node_names=self.nodes)
+                )
+                dt = (time.perf_counter() - t1) * 1e3
+                if r.node_names:
+                    # Cannot happen post-quiesce (measured WAITs mutate
+                    # nothing), but never let an assume-bind leak into
+                    # the stats or the state if it somehow does.
+                    self.sched.delete_pod(pod)
+                    gangs.remove(gang)
+                    continue
+                lat_ms.append(dt)
+                if rnd > 0:
+                    steady_ms.append(dt)
+                attempts += 1
+        wall_s = time.perf_counter() - t0
+        lat_ms.sort()
+        steady_ms.sort()
+        # report._pct: the one percentile convention every stage of a
+        # BENCH artifact shares.
+        from .report import _pct
+
+        return {
+            "rounds": rounds,
+            "waiters": n_waiters,
+            "attempts": attempts,
+            "wallS": round(wall_s, 4),
+            "refilterPerSec": round(attempts / wall_s, 1)
+            if wall_s > 0
+            else 0.0,
+            "p50Ms": round(_pct(lat_ms, 0.50), 4),
+            "p99Ms": round(_pct(lat_ms, 0.99), 4),
+            # Rounds 2+ only — each waiter's first sweep attempt may be
+            # a legitimate cold re-filter (the trace's final events
+            # changed its chains); the steady tail is the
+            # repeated-rejection cost the plane exists to cut.
+            "steadyP50Ms": round(_pct(steady_ms, 0.50), 4),
+            "steadyP99Ms": round(_pct(steady_ms, 0.99), 4),
+        }
 
     # -- replay -------------------------------------------------------- #
 
@@ -315,7 +551,9 @@ class TraceDriver:
             self.core.preempt_rng = random.Random(seed)
 
         live: Dict[str, _Gang] = {}
-        waiting: List[_Gang] = []
+        waiting = _WaitQueue(self._leaf_family, self.fifo_retry)
+        self._dirty_families = set()
+        wake_wall_s = 0.0
         departures: List[Tuple[float, int, str]] = []  # (t, seq, gang)
         dep_seq = 0
         lat_ms: List[float] = []
@@ -335,6 +573,9 @@ class TraceDriver:
         t_wall0 = time.perf_counter()
 
         def depart_until(t: float) -> int:
+            """Process departures through trace time ``t``, dirtying each
+            departed gang's family; returns how many gangs freed (the
+            wake trigger)."""
             nonlocal pods_bound
             freed = 0
             while departures and departures[0][0] <= t:
@@ -344,6 +585,7 @@ class TraceDriver:
                     continue  # already preempted away
                 for p in g.bound:
                     self.sched.delete_pod(p)
+                self._mark_dirty_gang(g)
                 freed += 1
             return freed
 
@@ -375,6 +617,10 @@ class TraceDriver:
             if not ok:
                 return False
             gang.bound_t = now
+            # A fresh bind is a fresh potential preemption victim: dirty
+            # the family so earlier-FIFO guaranteed waiters re-attempt at
+            # the next wake (exactly what the FIFO rescan gives them).
+            self._mark_dirty_gang(gang)
             live[gang.name] = gang
             heapq.heappush(
                 departures, (now + gang.runtime_s, dep_seq, gang.name)
@@ -388,16 +634,31 @@ class TraceDriver:
             return True
 
         def retry_waiting(now: float) -> None:
-            budget = RETRY_BUDGET_PER_EVENT
-            i = 0
-            while i < len(waiting) and budget > 0:
-                gang = waiting[i]
+            """One retry wake: drain the dirty-family set and attempt the
+            eligible waiters in FIFO order (the FIFO hatch attempts
+            everyone; an ALL_FAMILIES mark means the same). Marks
+            generated DURING the wake — binds, preemption kills — stay
+            for the NEXT wake, which is when the FIFO rescan's
+            position-earlier waiters get to react to them too. No budget:
+            the budget stopgap is retired; the eligibility index (and,
+            one layer down, the scheduler's wait cache) is what bounds
+            the cost now."""
+            nonlocal wake_wall_s
+            if not len(waiting):
+                return
+            fams = self._dirty_families
+            self._dirty_families = set()
+            families = (
+                None if ALL_FAMILIES in fams else frozenset(fams)
+            )
+            waiting.wake_events += 1
+            t0 = time.perf_counter()
+            for gang in waiting.eligible(families):
+                waiting.wake_attempts += 1
                 gang.make_pods()
-                budget -= 1
                 if try_schedule(gang, now):
-                    waiting.pop(i)
-                else:
-                    i += 1
+                    waiting.remove(gang.name)
+            wake_wall_s += time.perf_counter() - t0
 
         for ev in trace["events"]:
             t = float(ev["t"])
@@ -408,6 +669,8 @@ class TraceDriver:
                 defrag_proposals += dp
                 defrag_migrations += dm
                 if dm:
+                    # Defrag migrations re-place whole gangs: global wake
+                    # (identical in both retry modes by construction).
                     retry_waiting(frag_at[frag_i])
                 if self.core is not None:
                     frag_series.append(
@@ -429,10 +692,14 @@ class TraceDriver:
                 if gang.guaranteed:
                     submitted_guaranteed += 1
                 if not try_schedule(gang, t):
-                    waiting.append(gang)
+                    waiting.add(gang)
             else:
                 self._apply_fault(ev)
                 faults_applied += 1
+                # Same wake TRIGGERS as ever (capacity-freeing kinds);
+                # the fault itself already dirtied its node's families,
+                # capacity-removing kinds included — those are drained
+                # by whichever wake comes next.
                 if kind in ("chip_heal", "node_flip", "drain_toggle"):
                     retry_waiting(t)
         # Trace end: drain remaining departures, give waiters one last
@@ -454,6 +721,32 @@ class TraceDriver:
                 )
             frag_i += 1
         wall_s = time.perf_counter() - t_wall0
+        # Kept for retry_storm (the extender-style pending-retry sweep
+        # bench_pending drives after the replay).
+        self.last_waiting: List[_Gang] = list(waiting._order.values())
+        metrics = self.sched.get_metrics()
+        fast_waits = int(metrics.get("fastWaitCount", 0) or 0)
+        wait_calls = int(metrics.get("waitCount", 0) or 0)
+        # Pending-pod plane observability (doc/hot-path.md): wake-side
+        # costs and the wait-cache hit ratio. Deliberately OUTSIDE the
+        # counts dict — wake attempt totals are a property of the retry
+        # MODE, and the placement fingerprint (report.py) must stay
+        # bit-identical across indexed / FIFO / cache-off replays of one
+        # trace (the admission-equivalence contract).
+        pending = {
+            "retryMode": "fifo" if self.fifo_retry else "indexed",
+            "waitingMax": waiting.waiting_max,
+            "waitingAtEnd": len(waiting),
+            "waitingByKey": waiting.key_counts(),
+            "wakeEvents": waiting.wake_events,
+            "wakeAttempts": waiting.wake_attempts,
+            "wakeSkipped": waiting.wake_skipped,
+            "wakeWallS": round(wake_wall_s, 3),
+            "fastWaitCount": fast_waits,
+            "waitCacheHitRatio": (
+                round(fast_waits / wait_calls, 4) if wait_calls else 0.0
+            ),
+        }
 
         from .report import build_report
 
@@ -477,8 +770,9 @@ class TraceDriver:
             },
             wait_times_s=wait_times,
             frag_series=frag_series,
-            metrics=self.sched.get_metrics(),
+            metrics=metrics,
             mode=self.mode,
+            pending=pending,
         )
 
 
@@ -490,12 +784,20 @@ def run_trace(
     hosts: Optional[int] = None,
     defrag: bool = False,
     frag_samples: int = 8,
+    fifo_retry: Optional[bool] = None,
+    wait_cache: Optional[bool] = None,
+    retry_storm_rounds: int = 0,
 ) -> Dict:
     """Build the fleet the trace's shape names (or ``hosts`` override),
     replay, and return the report. ``defrag=True`` arms the background
     defragmenter (inproc mode) and drives its checkpoint-coordinated
     migrations at every fragmentation sample point — the A/B switch of
-    the ``HIVED_BENCH_DEFRAG`` stage."""
+    the ``HIVED_BENCH_DEFRAG`` stage. ``fifo_retry``/``wait_cache`` are
+    the pending-pod-plane A/B switches (HIVED_BENCH_PENDING,
+    doc/hot-path.md "Pending-pod plane"): FIFO-rescan retry wakes instead
+    of the eligibility index, and the scheduler-side negative-filter
+    cache off (wait_cache=False travels via the config knob, so it
+    reaches shard workers too)."""
     shape = TraceShape.from_dict(trace["shape"])
     config, actual_hosts = build_fleet_config(
         hosts if hosts is not None else shape.hosts
@@ -504,12 +806,21 @@ def run_trace(
         config.defrag_enable = True
         config.defrag_interval_ticks = 1
         config.defrag_max_migrations_per_cycle = 2
+    if wait_cache is not None and not wait_cache:
+        config.wait_cache_capacity = 0
     driver = TraceDriver(
         config, mode=mode, n_shards=n_shards, transport=transport,
-        frag_samples=frag_samples,
+        frag_samples=frag_samples, fifo_retry=fifo_retry,
     )
     try:
         report = driver.run(trace)
+        if retry_storm_rounds > 0:
+            # Attached OUTSIDE the placement fingerprint (pendingPlane
+            # is excluded from it): the storm is a measurement sweep,
+            # not part of the replayed trace.
+            report["pendingPlane"]["retryStorm"] = driver.retry_storm(
+                rounds=retry_storm_rounds
+            )
     finally:
         driver.close()
     report["hosts"] = actual_hosts

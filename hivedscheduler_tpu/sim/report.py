@@ -70,6 +70,7 @@ def build_report(
     frag_series: List[Dict],
     metrics: Dict,
     mode: str,
+    pending: Optional[Dict] = None,
 ) -> Dict:
     waits = sorted(wait_times_s)
     bound_g = counts["boundGuaranteed"]
@@ -105,6 +106,13 @@ def build_report(
             "queueWaitP99S": round(_pct(waits, 0.99), 3),
         },
         "fragmentation": frag_summary(frag_series),
+        # Pending-pod plane (doc/hot-path.md "Pending-pod plane"): the
+        # waiting-queue depth trend (max + end-of-trace), retry-wake
+        # costs, and the wait-cache hit ratio. NOT part of the placement
+        # fingerprint: wake attempt totals are a property of the retry
+        # mode, and the fingerprint must be bit-identical across
+        # indexed / FIFO-hatch / cache-off replays of one trace.
+        "pendingPlane": pending or {},
         # The scheduler's own counters for cross-checks (preemptCount,
         # nodeEventNoopCount, filter histogram...).
         "schedulerMetrics": {
@@ -116,6 +124,7 @@ def build_report(
                 "waitCount",
                 "healthTransitionCount",
                 "nodeEventNoopCount",
+                "fastWaitCount",
                 "filterLatencyP50Ms",
                 "filterLatencyP99Ms",
             )
@@ -177,6 +186,15 @@ def render_text(report: Dict) -> str:
         f"{c['waitingAtEnd']} waiting, {c['liveAtEnd']} live at end, "
         f"{c['faultsApplied']} faults applied"
     )
+    pend = report.get("pendingPlane") or {}
+    if pend.get("wakeEvents"):
+        lines.append(
+            f"  pending plane ({pend.get('retryMode')}): waiting max "
+            f"{pend.get('waitingMax')}, {pend.get('wakeEvents')} wakes, "
+            f"{pend.get('wakeAttempts')} attempts "
+            f"({pend.get('wakeSkipped')} skipped by the index), "
+            f"wait-cache hit ratio {pend.get('waitCacheHitRatio')}"
+        )
     if c.get("defragProposals") or c.get("defragMigrations"):
         lines.append(
             f"  defrag: {c['defragProposals']} proposals, "

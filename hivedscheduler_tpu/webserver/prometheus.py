@@ -33,6 +33,7 @@ COUNTERS: Dict[str, tuple] = {
     "bindCount": ("hived_filter_bind_total", "filter calls ending in an assume-bind"),
     "preemptCount": ("hived_filter_preempt_total", "filter calls proposing preemption"),
     "waitCount": ("hived_filter_wait_total", "filter calls ending in a wait"),
+    "fastWaitCount": ("hived_filter_fast_waits_total", "filter calls answered from the negative-filter (WAIT) cache with one version-vector compare"),
     "bindRetryCount": ("hived_bind_retries_total", "bind kube-write retries"),
     "bindGiveUpCount": ("hived_bind_give_ups_total", "bind writes that exhausted retries"),
     "bindTerminalFailureCount": ("hived_bind_terminal_failures_total", "bind writes failed terminally (404/409)"),

@@ -266,7 +266,9 @@ def test_bench_ring_ab_smoke():
 def test_bench_sim_smoke():
     """Smoke-sized variant of the HIVED_BENCH_SIM stage (ISSUE 9
     CI/tooling satellite): the per-fleet-size trend curve must carry the
-    latency tail AND all three scheduling-quality metrics per size."""
+    latency tail AND all three scheduling-quality metrics per size, plus
+    the pending-plane artifact-hygiene fields (ISSUE 13: waiting-queue
+    depth trend — max AND end of trace — and the wait-cache hit ratio)."""
     result = bench.bench_sim(
         sizes=(108, 216), gangs_per_432=60, duration_s=600.0
     )
@@ -279,4 +281,46 @@ def test_bench_sim_smoke():
         assert 0.0 <= entry["quota_satisfaction"] <= 1.0
         assert entry["preemption_rate"] >= 0
         assert entry["largest_free_slice_chips"] > 0
+        assert entry["waiting_max"] >= entry["waiting_at_end"] >= 0
+        assert 0.0 <= entry["wait_cache_hit_ratio"] <= 1.0
+    json.dumps(result)
+
+
+def test_bench_pending_smoke():
+    """Smoke-sized variant of the HIVED_BENCH_PENDING stage (ISSUE 13
+    CI/tooling satellite): the three-mode identical-seed A/B — indexed,
+    FIFO-rescan + cache, FIFO-rescan cache-off — must emit every
+    artifact key with the uniform _stage_meta stamps, the placement
+    fingerprints must be bit-identical across modes (asserted inside the
+    stage), and the retry-storm sweep must run on a real waiting queue.
+    The >=2x throughput gate is the driver stage's at the 216-host
+    deep-queue trace (waiting >= 200); CI boxes only guard wiring."""
+    result = bench.bench_pending(
+        hosts=104, gangs=200, duration_s=1800.0,
+        mean_runtime_s=700.0, min_waiting=8, storm_rounds=6,
+    )
+    assert_stage_meta(result)
+    assert result["fingerprints_identical"] is True
+    assert result["deep_queue"] is True
+    for side in ("indexed", "cache", "baseline"):
+        s = result[side]
+        assert s["waiting_max"] >= 8
+        assert s["wake_events"] > 0 and s["wake_attempts"] > 0
+        assert s["wake_wall_s"] > 0
+        storm = s["storm"]
+        assert storm["rounds"] == 6
+        assert storm["attempts"] >= storm["waiters"] > 0
+        assert storm["refilterPerSec"] > 0
+        assert storm["steadyP99Ms"] >= storm["steadyP50Ms"] >= 0
+    # The waiting-queue composition surfaces under the index's
+    # (family, chips, VC) key.
+    pend_keys = result["indexed"]["waiting_by_key"]
+    assert pend_keys and all(v > 0 for v in pend_keys.values())
+    # The modes really differed where they must: the index skipped
+    # attempts, the cache hit, the baseline did neither.
+    assert result["indexed"]["wake_skipped"] > 0
+    assert result["cache"]["fast_wait_count"] > 0
+    assert result["baseline"]["fast_wait_count"] == 0
+    assert result["cache"]["wake_skipped"] == 0
+    assert "refilter_speedup" in result and "gate_met" in result
     json.dumps(result)

@@ -111,6 +111,35 @@ def test_replay_placement_deterministic():
     assert a["counts"]["boundGangs"] > 0
 
 
+def test_indexed_wake_equals_fifo_replay():
+    """Pending-pod plane (ISSUE 13): the eligibility-indexed retry wake
+    is ADMISSION-EQUIVALENT to the budget-free FIFO rescan — identical
+    placement fingerprints at identical seeds on a saturated trace (deep
+    waiting queue, real skips), with and without the wait cache. The
+    HIVED_SIM_FIFO_RETRY hatch is the reference mode."""
+    shape = TraceShape(
+        hosts=104, gangs=220, duration_s=1800.0, pattern="burst",
+        burst_fraction=0.7, mean_runtime_s=700.0,
+        opportunistic_fraction=0.3, fault_events=10,
+    )
+    for seed in (0, 5):
+        trace = generate_trace(seed, shape)
+        indexed = run_trace(trace, fifo_retry=False)
+        fifo = run_trace(trace, fifo_retry=True)
+        off = run_trace(trace, fifo_retry=True, wait_cache=False)
+        fps = [
+            placement_fingerprint(r) for r in (indexed, fifo, off)
+        ]
+        assert fps[0] == fps[1] == fps[2], seed
+        pend = indexed["pendingPlane"]
+        assert pend["retryMode"] == "indexed"
+        assert pend["wakeSkipped"] > 0, seed  # the index really pruned
+        assert pend["waitingMax"] >= pend["waitingAtEnd"]
+        assert fifo["pendingPlane"]["wakeAttempts"] >= (
+            pend["wakeAttempts"]
+        )
+
+
 def test_shards_mode_runs_the_same_trace():
     """The procShards frontend replays the same trace with the same gang
     admission outcome (light load, no preemption: placement-found-iff is

@@ -1,0 +1,519 @@
+"""Pending-pod plane: the negative-filter (WAIT) cache (ISSUE 13).
+
+Covers the tentpole's correctness surface (doc/hot-path.md "Pending-pod
+plane"):
+
+- a repeated re-filter of an unchanged WAIT is answered from the cache
+  (one version-vector compare; `fastWaitCount`), and the decision
+  journal still records the attempt with its rejection certificate;
+- certificate invalidation per gate: a quota-freeing delete, a chip
+  heal, a drain lift, a suggested-set change, and a doomed-epoch bump
+  each flip exactly their own cached verdicts (a foreign chain's cached
+  WAIT keeps serving);
+- shards ≡ in-process: the per-shard caches produce the same verdict
+  sequence and the merged `fastWaitCount` matches;
+- differential proof cached ≡ recomputed: chaos schedules and saturated
+  sim traces replay placement-identically with `HIVED_WAIT_CACHE=0`,
+  plus the sensitivity meta-test — a no-op'd certificate invalidation
+  is CAUGHT by that differential on pinned seeds.
+"""
+
+import logging
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.api import constants, extender as ei
+from hivedscheduler_tpu.api.config import Config
+from hivedscheduler_tpu.scheduler.framework import (
+    HivedScheduler,
+    NullKubeClient,
+)
+from hivedscheduler_tpu.scheduler.types import Node
+from hivedscheduler_tpu.sim import fleet
+from hivedscheduler_tpu.sim.driver import run_trace
+from hivedscheduler_tpu.sim.report import placement_fingerprint
+from hivedscheduler_tpu.sim.trace import TraceShape, generate_trace
+
+from .test_core import make_pod
+
+common.init_logging(logging.CRITICAL)
+
+# Saturated small trace for the differential proofs: arrivals far outrun
+# the 104-host fleet, so waiters retry across many capacity events.
+SATURATED = TraceShape(
+    hosts=104, gangs=220, duration_s=1800.0, pattern="burst",
+    burst_fraction=0.7, mean_runtime_s=700.0,
+    opportunistic_fraction=0.3, fault_events=10,
+)
+
+# Pinned seeds for the sensitivity meta-test: each produces wait-cache
+# HITS under the FIFO rescan, so a no-op'd invalidation visibly diverges
+# (verified at pin time: all three also replay fingerprint-identically
+# cache-on vs cache-off when invalidation works).
+SENSITIVITY_SEEDS = (0, 5, 11)
+
+
+def four_host_config() -> Config:
+    """Four standalone 4-chip v5e hosts; VC A holds two, VC B two."""
+    return Config.from_dict(
+        {
+            "physicalCluster": {
+                "cellTypes": {
+                    "v5e-host": {
+                        "childCellType": "v5e-chip",
+                        "childCellNumber": 4,
+                        "isNodeLevel": True,
+                    },
+                },
+                "physicalCells": [
+                    {"cellType": "v5e-host", "cellAddress": f"host-{i}"}
+                    for i in range(4)
+                ],
+            },
+            "virtualClusters": {
+                "A": {"virtualCells": [{"cellType": "v5e-host", "cellNumber": 2}]},
+                "B": {"virtualCells": [{"cellType": "v5e-host", "cellNumber": 2}]},
+            },
+        }
+    )
+
+
+def new_scheduler(config=None, **kw) -> HivedScheduler:
+    sched = HivedScheduler(
+        config if config is not None else four_host_config(),
+        kube_client=NullKubeClient(),
+        trace_sample=0.0,
+        auto_admit=True,
+        **kw,
+    )
+    for name in sched.core.configured_node_names():
+        sched.add_node(Node(name=name))
+    return sched
+
+
+def gang(name, n_pods, chips):
+    return {
+        "name": name,
+        "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
+    }
+
+
+def filter_pod(sched, pod, nodes=None):
+    return sched.filter_routine(
+        ei.ExtenderArgs(
+            pod=pod,
+            node_names=sorted(sched.nodes) if nodes is None else nodes,
+        )
+    )
+
+
+def fast_waits(sched) -> int:
+    return sched.get_metrics()["fastWaitCount"]
+
+
+def bind_gang(sched, name, vc, n_pods=1, chips=4, priority=0):
+    pods = [
+        make_pod(
+            f"{name}-{i}", f"u-{name}-{i}", vc, priority, "v5e-chip",
+            chips, group=gang(name, n_pods, chips),
+        )
+        for i in range(n_pods)
+    ]
+    for p in pods:
+        r = filter_pod(sched, p)
+        assert r.node_names, (name, r.failed_nodes)
+    return pods
+
+
+# --------------------------------------------------------------------- #
+# Cache hit + certificate shape
+# --------------------------------------------------------------------- #
+
+
+def test_repeated_wait_served_from_cache_with_certificate():
+    sched = new_scheduler()
+    bind_gang(sched, "fill", "A", n_pods=2)
+    waiter = make_pod(
+        "w-0", "u-w0", "A", 0, "v5e-chip", 4, group=gang("gw", 1, 4)
+    )
+    r1 = filter_pod(sched, waiter)
+    assert not r1.node_names
+    assert fast_waits(sched) == 0
+    rec1 = sched.get_decision("u-w0")
+    assert rec1["verdict"] == "wait"
+    cert = rec1["certificate"]
+    assert cert["gate"] == "vcQuota"
+    assert cert["vc"] == "A"
+    assert "v5e-host" in cert["chainEpochs"]
+    assert cert["suggested"] is None  # spec ignores suggested nodes
+
+    r2 = filter_pod(sched, waiter)
+    assert not r2.node_names
+    assert r2.failed_nodes == r1.failed_nodes  # same wait reason verbatim
+    assert fast_waits(sched) == 1
+    rec2 = sched.get_decision("u-w0")
+    assert rec2["verdict"] == "wait"
+    assert rec2["lockChains"] == "waitCache"
+    assert rec2["certificate"] == cert
+    # The journal's identity fields survive the shortcut.
+    assert rec2["vc"] == "A" and rec2["leafCellNumber"] == 4
+    assert rec2["group"] == "gw"
+
+
+def test_gang_members_share_one_certificate():
+    """Every pod of a gang carries the identical spec annotation — one
+    WAIT certificate answers all of them."""
+    sched = new_scheduler()
+    bind_gang(sched, "fill", "A", n_pods=2)
+    members = [
+        make_pod(
+            f"m-{i}", f"u-m{i}", "A", 0, "v5e-chip", 4,
+            group=gang("gm", 2, 4),
+        )
+        for i in range(2)
+    ]
+    assert not filter_pod(sched, members[0]).node_names
+    assert fast_waits(sched) == 0
+    assert not filter_pod(sched, members[1]).node_names
+    assert fast_waits(sched) == 1
+
+
+def test_hatch_disables_cache(monkeypatch):
+    monkeypatch.setenv("HIVED_WAIT_CACHE", "0")
+    sched = new_scheduler()
+    bind_gang(sched, "fill", "A", n_pods=2)
+    waiter = make_pod(
+        "w-0", "u-w0", "A", 0, "v5e-chip", 4, group=gang("gw", 1, 4)
+    )
+    assert not filter_pod(sched, waiter).node_names
+    assert not filter_pod(sched, waiter).node_names
+    assert fast_waits(sched) == 0
+    assert not sched._wait_cache
+
+
+def test_capacity_zero_disables_cache():
+    cfg = four_host_config()
+    cfg.wait_cache_capacity = 0
+    sched = new_scheduler(cfg)
+    bind_gang(sched, "fill", "A", n_pods=2)
+    waiter = make_pod(
+        "w-0", "u-w0", "A", 0, "v5e-chip", 4, group=gang("gw", 1, 4)
+    )
+    assert not filter_pod(sched, waiter).node_names
+    assert not filter_pod(sched, waiter).node_names
+    assert fast_waits(sched) == 0
+
+
+# --------------------------------------------------------------------- #
+# Per-gate certificate invalidation
+# --------------------------------------------------------------------- #
+
+
+def test_quota_gate_invalidated_by_capacity_free():
+    sched = new_scheduler()
+    fill = bind_gang(sched, "fill", "A", n_pods=2)
+    waiter = make_pod(
+        "w-0", "u-w0", "A", 0, "v5e-chip", 4, group=gang("gw", 1, 4)
+    )
+    assert not filter_pod(sched, waiter).node_names
+    assert not filter_pod(sched, waiter).node_names
+    assert fast_waits(sched) == 1
+    # Quota frees (the fill gang dies): the certificate's chain epoch
+    # moved — full pass, bind.
+    for p in fill:
+        sched.delete_pod(sched.pod_schedule_statuses[p.uid].pod)
+    r = filter_pod(sched, waiter)
+    assert r.node_names, r.failed_nodes
+    assert fast_waits(sched) == 1  # no stale hit
+
+
+def test_chip_health_gate_invalidated_by_heal():
+    sched = new_scheduler()
+    for name in list(sched.nodes):
+        sched.update_node(
+            sched.nodes[name],
+            Node(
+                name=name,
+                annotations={
+                    constants.ANNOTATION_NODE_DEVICE_HEALTH: "0,1,2,3"
+                },
+            ),
+        )
+    waiter = make_pod(
+        "w-0", "u-w0", "A", -1, "v5e-chip", 4, group=gang("gw", 1, 4)
+    )
+    assert not filter_pod(sched, waiter).node_names
+    cert = sched.get_decision("u-w0")["certificate"]
+    assert cert["gate"] == "chipHealth"
+    assert not filter_pod(sched, waiter).node_names
+    assert fast_waits(sched) == 1
+    # Heal one host's chips: full pass, bind.
+    name = sorted(sched.nodes)[0]
+    sched.update_node(sched.nodes[name], Node(name=name))
+    assert filter_pod(sched, waiter).node_names
+    assert fast_waits(sched) == 1
+
+
+def test_draining_gate_invalidated_by_drain_lift():
+    sched = new_scheduler()
+    for name in list(sched.nodes):
+        sched.update_node(
+            sched.nodes[name],
+            Node(
+                name=name,
+                annotations={constants.ANNOTATION_NODE_DRAIN: "*"},
+            ),
+        )
+    waiter = make_pod(
+        "w-0", "u-w0", "A", -1, "v5e-chip", 4, group=gang("gw", 1, 4)
+    )
+    assert not filter_pod(sched, waiter).node_names
+    assert sched.get_decision("u-w0")["certificate"]["gate"] == "draining"
+    assert not filter_pod(sched, waiter).node_names
+    assert fast_waits(sched) == 1
+    name = sorted(sched.nodes)[0]
+    sched.update_node(sched.nodes[name], Node(name=name))
+    assert filter_pod(sched, waiter).node_names
+    assert fast_waits(sched) == 1
+
+
+def test_suggested_set_change_misses_the_cache():
+    sched = new_scheduler()
+    waiter = make_pod(
+        "w-0", "u-w0", "A", 0, "v5e-chip", 4, group=gang("gw", 1, 4),
+        ignore_suggested=False,
+    )
+    # No suggested nodes: the virtual placement cannot map anywhere.
+    assert not filter_pod(sched, waiter, nodes=[]).node_names
+    cert = sched.get_decision("u-w0")["certificate"]
+    assert cert["suggested"] is not None
+    # Identical (empty) suggested set: cache hit.
+    assert not filter_pod(sched, waiter, nodes=[]).node_names
+    assert fast_waits(sched) == 1
+    # Different suggested set: token mismatch, full pass, bind.
+    assert filter_pod(sched, waiter).node_names
+    assert fast_waits(sched) == 1
+
+
+def test_doomed_epoch_bump_invalidates_certificate():
+    sched = new_scheduler()
+    bind_gang(sched, "fill", "A", n_pods=2)
+    waiter = make_pod(
+        "w-0", "u-w0", "A", 0, "v5e-chip", 4, group=gang("gw", 1, 4)
+    )
+    assert not filter_pod(sched, waiter).node_names
+    (entry,) = sched._wait_cache.values()
+    assert sched.core.certificate_current(entry["cert"])
+    sched.core._bump_doomed_epoch()
+    assert not sched.core.certificate_current(entry["cert"])
+    # The next re-filter takes the full pass (same WAIT, re-certified).
+    assert not filter_pod(sched, waiter).node_names
+    assert fast_waits(sched) == 0
+
+
+def test_foreign_chain_event_flips_only_its_own_verdict():
+    """Two cached WAITs on different chains: freeing capacity on one
+    chain invalidates exactly that chain's certificate — the other keeps
+    serving from the cache."""
+    sched = new_scheduler(fleet.build_config(cubes=2, slices=2, solos=1))
+    # Fill research's v5e quota (one v5e-16 slice = 4 hosts + 1 solo).
+    bind_gang(sched, "fe0", "research", n_pods=4, chips=4)
+    bind_gang(sched, "fe1", "research", n_pods=1, chips=4)
+    # Fill research's v5p quota (4 v5p-16 groups = 16 hosts).
+    fill_p = [
+        make_pod(
+            f"fp-{i}", f"u-fp{i}", "research", 0, "v5p-chip", 4,
+            group=gang("gfp", 16, 4),
+        )
+        for i in range(16)
+    ]
+    for p in fill_p:
+        assert filter_pod(sched, p).node_names, p.name
+    wait_e = make_pod(
+        "we-0", "u-we0", "research", 0, "v5e-chip", 4,
+        group=gang("gwe", 1, 4),
+    )
+    wait_p = make_pod(
+        "wp-0", "u-wp0", "research", 0, "v5p-chip", 4,
+        group=gang("gwp", 1, 4),
+    )
+    assert not filter_pod(sched, wait_e).node_names
+    assert not filter_pod(sched, wait_p).node_names
+    assert not filter_pod(sched, wait_e).node_names
+    assert not filter_pod(sched, wait_p).node_names
+    assert fast_waits(sched) == 2
+    # Free a v5p gang: only the v5p waiter's certificate is void.
+    for p in fill_p:
+        sched.delete_pod(sched.pod_schedule_statuses[p.uid].pod)
+    assert filter_pod(sched, wait_p).node_names
+    assert not filter_pod(sched, wait_e).node_names
+    assert fast_waits(sched) == 3  # the v5e waiter still hits
+
+
+# --------------------------------------------------------------------- #
+# Shards ≡ in-process
+# --------------------------------------------------------------------- #
+
+
+def _shard_scenario(sched, nodes, get_status):
+    """Fill the research VC's v5e capacity (one v5e-16 slice + one
+    solo), wait, re-filter (hit), free a whole fill gang, re-filter
+    (bind). Returns the verdict sequence."""
+    def flt(pod):
+        return bool(
+            sched.filter_routine(
+                ei.ExtenderArgs(pod=pod, node_names=nodes)
+            ).node_names
+        )
+
+    out = []
+    fe0 = []
+    for gname, n_pods in (("fe0", 4), ("fe1", 1)):
+        pods = [
+            make_pod(
+                f"{gname}-{i}", f"u-{gname}-{i}", "research", 0,
+                "v5e-chip", 4, group=gang(gname, n_pods, 4),
+            )
+            for i in range(n_pods)
+        ]
+        for p in pods:
+            assert flt(p), (gname, p.name)
+        if gname == "fe0":
+            fe0 = pods
+    waiter = make_pod(
+        "w-0", "u-w0", "research", 0, "v5e-chip", 4,
+        group=gang("gw", 1, 4),
+    )
+    for _ in range(3):
+        out.append(flt(waiter))
+    for p in fe0:
+        sched.delete_pod(get_status(p.uid))
+    out.append(flt(waiter))
+    return out
+
+
+def test_shards_cache_equivalent_to_inproc():
+    from hivedscheduler_tpu.scheduler.shards import ShardedScheduler
+
+    config = fleet.build_config(cubes=2, slices=2, solos=1)
+    inproc = new_scheduler(config)
+    nodes = sorted(inproc.core.configured_node_names())
+    seq_in = _shard_scenario(
+        inproc, nodes,
+        lambda uid: inproc.pod_schedule_statuses[uid].pod,
+    )
+
+    sharded = ShardedScheduler(
+        fleet.build_config(cubes=2, slices=2, solos=1),
+        kube_client=NullKubeClient(),
+        n_shards=2,
+        transport="local",
+        auto_admit=True,
+    )
+    try:
+        for name in sharded.configured_node_names():
+            sharded.add_node(Node(name=name))
+        seq_sh = _shard_scenario(
+            sharded, nodes,
+            lambda uid: sharded.get_status_pod(uid)[0],
+        )
+        assert seq_in == seq_sh == [False, False, False, True]
+        m_in = inproc.get_metrics()
+        m_sh = sharded.get_metrics()
+        assert m_in["fastWaitCount"] == 2
+        # The merged counter sums the per-shard caches' hits.
+        assert m_sh["fastWaitCount"] == m_in["fastWaitCount"]
+    finally:
+        sharded.close()
+
+
+# --------------------------------------------------------------------- #
+# Differential proofs: cached ≡ recomputed
+# --------------------------------------------------------------------- #
+
+
+def test_sim_placement_identical_cache_on_off():
+    """The saturated trace replays placement-BIT-identically with the
+    cache on and off (FIFO rescan mode on both sides so the cache
+    actually serves hits), and the cache-on run really did hit."""
+    for seed in SENSITIVITY_SEEDS:
+        trace = generate_trace(seed, SATURATED)
+        on = run_trace(trace, fifo_retry=True)
+        off = run_trace(trace, fifo_retry=True, wait_cache=False)
+        assert placement_fingerprint(on) == placement_fingerprint(off), (
+            seed
+        )
+        assert on["pendingPlane"]["fastWaitCount"] > 0, seed
+        assert off["pendingPlane"]["fastWaitCount"] == 0, seed
+
+
+def test_nooped_invalidation_is_caught(monkeypatch):
+    """Sensitivity meta-test: if certificate invalidation is broken (the
+    vector compare always answers 'unchanged'), the cache-on/off
+    differential above MUST fail on the pinned seeds — waiting gangs
+    would be stuck behind stale WAITs forever. Proves the differential
+    has teeth."""
+    from hivedscheduler_tpu.algorithm.core import HivedCore
+
+    monkeypatch.setattr(
+        HivedCore, "certificate_current", lambda self, cert: True
+    )
+    caught = 0
+    for seed in SENSITIVITY_SEEDS:
+        trace = generate_trace(seed, SATURATED)
+        on = run_trace(trace, fifo_retry=True)
+        off = run_trace(trace, fifo_retry=True, wait_cache=False)
+        if placement_fingerprint(on) != placement_fingerprint(off):
+            caught += 1
+    assert caught == len(SENSITIVITY_SEEDS), caught
+
+
+def test_chaos_schedules_identical_cache_on_off(monkeypatch):
+    """Chaos schedules (the full fault vocabulary: churn, faults,
+    drains, preemptions, restarts, failovers) produce identical stats
+    with the cache disabled — the hatch-based cached ≡ recomputed proof
+    over the chaos event mix. (The harness's own invariants — restart
+    equivalence, zero leaks — run with the cache ON in the tier-1
+    220-seed sweep.)"""
+    from tests import chaos
+
+    on = {}
+    for seed in range(6):
+        on[seed] = chaos.run_chaos_schedule(seed)
+    monkeypatch.setenv("HIVED_WAIT_CACHE", "0")
+    for seed in range(6):
+        assert chaos.run_chaos_schedule(seed) == on[seed], seed
+
+
+# --------------------------------------------------------------------- #
+# Retry-wake admission equivalence lives in tests/test_sim_smoke.py
+# (test_indexed_wake_equals_fifo_replay); the storm/bench wiring in
+# tests/test_bench_smoke.py (test_bench_pending_smoke).
+# --------------------------------------------------------------------- #
+
+
+def test_wait_cache_bounded():
+    cfg = four_host_config()
+    cfg.wait_cache_capacity = 3
+    sched = new_scheduler(cfg)
+    bind_gang(sched, "fill", "A", n_pods=2)
+    bind_gang(sched, "fillb", "B", n_pods=2)
+    for i in range(6):
+        w = make_pod(
+            f"w-{i}", f"u-w{i}", "A", 0, "v5e-chip", 4,
+            group=gang(f"gw{i}", 1, 4),
+        )
+        assert not filter_pod(sched, w).node_names
+    assert len(sched._wait_cache) == 3
+
+
+def test_recovery_clears_wait_cache():
+    sched = new_scheduler()
+    bind_gang(sched, "fill", "A", n_pods=2)
+    waiter = make_pod(
+        "w-0", "u-w0", "A", 0, "v5e-chip", 4, group=gang("gw", 1, 4)
+    )
+    assert not filter_pod(sched, waiter).node_names
+    assert sched._wait_cache
+    sched.begin_recovery(None)
+    assert not sched._wait_cache
+    sched.finish_recovery([])
