@@ -72,6 +72,18 @@ if [[ "${1:-}" == "--pending" ]]; then
   exec env HIVED_BENCH_PENDING=1 python bench.py "$@"
 fi
 
+if [[ "${1:-}" == "--whatif" ]]; then
+  # Shadow what-if plane acceptance (doc/hot-path.md "Shadow what-if
+  # plane"): 432-host saturated trace, mid-trace queue forecast on a
+  # snapshot fork — determinism, no-live-mutation fingerprint equality,
+  # and the read-only audit asserted in-stage; forecast-vs-actual wait
+  # error + capacity-planning SLO risk recorded in the artifact.
+  shift
+  export JAX_PLATFORMS=cpu
+  echo "what-if plane: snapshot-forked queue forecast vs actual waits"
+  exec env HIVED_BENCH_WHATIF=1 python bench.py "$@"
+fi
+
 if [[ "${1:-}" == "--boot-profile" ]]; then
   shift
   # 50k-host boot + soak profile (doc/hot-path.md "Boot and transport

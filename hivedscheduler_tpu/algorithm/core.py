@@ -636,6 +636,15 @@ class HivedCore:
         # they run under the total-order global mode. None for bare cores
         # (tests, benches driving the core directly, single-threaded).
         self.lock_validator: Optional[Callable[[], None]] = None
+        # Shadow what-if audit hook (scheduler.whatif): installed on the
+        # LIVE core only, called before every state-changing entry point
+        # (schedule, pod add/delete, resize, epoch bumps, and the
+        # cross-chain mutators via _require_global). A shadow-forecast
+        # thread reaching a live mutator raises instead of corrupting
+        # served state — the read-only-fork contract's runtime teeth,
+        # mirroring lock_validator's. None for shadow cores and ordinary
+        # schedulers (zero overhead beyond one None check).
+        self.write_guard: Optional[Callable[[], None]] = None
         # Hot-path counters (surfaced via framework.get_metrics): pods
         # admitted through the batched (decode-free) gang admission path,
         # and preempt probes served from the epoch-gated victims cache.
@@ -1007,9 +1016,16 @@ class HivedCore:
         """Explicit bump for mutations that change chain-visible state
         WITHOUT touching a cell: pod-slot assignments in a group's
         allocated_pods (the victims caches list those pods)."""
+        self._audit_write()
         r = self.chain_epochs.get(chain)
         if r is not None:
             r[0] += 1
+
+    def _audit_write(self) -> None:
+        """Shadow what-if read-only audit (see write_guard): raises when
+        a shadow-forecast thread reaches a live-core mutator."""
+        if self.write_guard is not None:
+            self.write_guard()
 
     def epoch_total(self) -> int:
         """Monotonic sum over all chain epochs (epochs only grow, so equal
@@ -1017,12 +1033,14 @@ class HivedCore:
         return sum(r[0] for r in self.chain_epochs.values())
 
     def _bump_doomed_epoch(self) -> None:
+        self._audit_write()
         with self._doomed_epoch_lock:
             self.doomed_epoch += 1
 
     def _require_global(self) -> None:
         """Assert the calling thread holds the global lock order before a
         cross-chain mutation (no-op on bare cores; see lock_validator)."""
+        self._audit_write()
         if self.lock_validator is not None:
             self.lock_validator()
 
@@ -2180,12 +2198,24 @@ class HivedCore:
         phase: SchedulingPhase,
         spec: Optional[api.PodSchedulingSpec] = None,
         suggested_set: Optional[Set[str]] = None,
+        leaf_types: Optional[Tuple[str, ...]] = None,
     ) -> PodScheduleResult:
         """(reference: hived_algorithm.go:180-224)
 
         ``spec``/``suggested_set`` let the framework parse the annotation and
         build the node set OUTSIDE its lock (framework.filter_routine); when
-        omitted they are derived here, preserving the old call contract."""
+        omitted they are derived here, preserving the old call contract.
+
+        ``leaf_types`` restricts an UNTYPED, unpinned pod's any-leaf-type
+        scan to the named SKUs (the shards frontend's leaf-type-granular
+        sweep, doc/hot-path.md "The multi-process contract"): the union
+        of a sweep's restrictions is the full sorted scan, so placement-
+        found-iff is preserved chunk by chunk. Typed/pinned specs ignore
+        it."""
+        # A schedule can mutate (lazy preemption, preempting-group
+        # bookkeeping): the shadow what-if audit fences it like every
+        # other mutator entry point.
+        self._audit_write()
         common.log.info("[%s]: Scheduling pod in %s phase...", pod.key, phase.value)
         s = spec if spec is not None else extract_pod_scheduling_spec(pod)
         rec = self._decision_rec()
@@ -2217,7 +2247,9 @@ class HivedCore:
         # The group may have been a preempting group deleted just above.
         if self.affinity_groups.get(s.affinity_group.name) is None:
             group_physical, group_virtual, victims, wait_reason = (
-                self._schedule_pod_from_new_group(s, suggested, phase, pod)
+                self._schedule_pod_from_new_group(
+                    s, suggested, phase, pod, leaf_types
+                )
             )
         result = generate_pod_schedule_result(
             group_physical,
@@ -2349,10 +2381,13 @@ class HivedCore:
         suggested: Set[str],
         pod: Pod,
     ):
-        """Elastic grow (doc/fault-model.md "Elastic gang plane"): an
-        OPPORTUNISTIC gang with maxMembers headroom admits one more pod
-        into idle capacity on its own chain. Returns None when the group
-        is not growable (fixed size / guaranteed / at its ceiling),
+        """Elastic grow (doc/fault-model.md "Elastic gang plane"): a gang
+        with maxMembers headroom admits one more pod into idle capacity
+        on its own chain. An OPPORTUNISTIC gang grows through the
+        opportunistic scheduler; a GUARANTEED gang grows through the
+        quota-gated intra-VC path (_try_grow_guaranteed) — both ride the
+        same prospective-record protocol. Returns None when the group is
+        not growable (fixed size / at its ceiling / placement holes),
         ``"wait"`` when growable but currently out of capacity, else the
         prospective (physical, virtual, pod_index, generation) for the
         GROWN gang — applied only when the bind confirm replays the
@@ -2360,13 +2395,15 @@ class HivedCore:
         max_members = max(
             g.max_members, getattr(s.affinity_group, "max_members", 0)
         )
+        guaranteed = s.priority >= MIN_GUARANTEED_PRIORITY
         if (
             max_members <= g.total_pods
             or g.state != GroupState.ALLOCATED
-            # Grow rides the opportunistic allocation path only: it must
-            # never consume guaranteed VC quota behind the safety checks.
-            or g.virtual_placement is not None
-            or s.priority >= MIN_GUARANTEED_PRIORITY
+            # A grow member must ride the same allocation plane as its
+            # gang: opportunistic rows have no virtual placement to
+            # extend, guaranteed rows must extend one (the new row
+            # consumes VC quota IN FRONT of the safety checks).
+            or guaranteed != (g.virtual_placement is not None)
             or s.leaf_cell_number <= 0
         ):
             return None
@@ -2382,6 +2419,12 @@ class HivedCore:
             for row in rows:
                 if any(leaf is None for leaf in row):
                     return None
+        if guaranteed:
+            for rows in g.virtual_placement.values():
+                for row in rows:
+                    if any(leaf is None for leaf in row):
+                        return None
+            return self._try_grow_guaranteed(g, s, suggested, chain)
         rec = self._decision_rec()
         placement, failed_reason = self.opportunistic_schedulers[
             chain
@@ -2411,6 +2454,142 @@ class HivedCore:
                 f"{g.resize_generation + 1})"
             )
         return group_physical, None, pod_index, g.resize_generation + 1
+
+    def _try_grow_guaranteed(
+        self,
+        g: AffinityGroup,
+        s: api.PodSchedulingSpec,
+        suggested: Set[str],
+        chain: CellChain,
+    ):
+        """Guaranteed-gang grow (the PR-10 recorded follow-on): a bounded
+        gang at guaranteed priority grows into its VC's QUOTA HEADROOM —
+        one more member placed through the intra-VC scheduler plus the
+        standard buddy mapping, so the new row consumes VC quota in
+        front of the safety checks like any new guaranteed row.
+
+        The quota gate is layered: (1) config level — the VC must hold
+        non-pinned quota on the gang's chain at all; (2) the intra-VC
+        schedule itself — the row must fit the VC's free virtual cells;
+        (3) headroom only — a virtual leaf whose physical twin is not
+        FREE is skipped (retried around via anchor avoidance), so a grow
+        NEVER preempts, lazily or otherwise (matching the opportunistic
+        grow's free-capacity-only contract) and the probe is mutation-
+        free: a "wait" answer leaves no lazy-preempt residue behind a
+        prospective record that was never applied."""
+        rec = self._decision_rec()
+        vcs = self.vc_schedulers.get(g.vc)
+        if vcs is None:
+            return None
+        # Quota gate, config level — in the gang's OWN quota plane: a
+        # pinned gang grows inside its pinned cell (anything else would
+        # break the operator's pinning isolation), an unpinned gang
+        # needs non-pinned quota on its chain.
+        if s.pinned_cell_id:
+            if s.pinned_cell_id not in vcs.pinned_cells:
+                if rec is not None:
+                    rec.note(
+                        f"guaranteed grow of {g.name} refused: VC "
+                        f"{g.vc} has no pinned cell {s.pinned_cell_id}"
+                    )
+                return None
+        elif chain not in vcs.non_pinned_preassigned:
+            if rec is not None:
+                rec.note(
+                    f"guaranteed grow of {g.name} refused: VC {g.vc} "
+                    f"holds no non-pinned quota on chain {chain}"
+                )
+            return None
+        sr = SchedulingRequest(
+            vc=g.vc,
+            pinned_cell_id=s.pinned_cell_id,
+            priority=s.priority,
+            affinity_group_name=g.name,
+            affinity_group_pod_nums={s.leaf_cell_number: 1},
+            suggested_nodes=suggested,
+            ignore_suggested_nodes=s.ignore_k8s_suggested_nodes,
+            chain=chain,
+        )
+        leaf_cell_nums = [s.leaf_cell_number]
+        avoid: Set[api.CellAddress] = set()
+        physical: Optional[Placement] = None
+        virtual: Optional[Placement] = None
+        for _attempt in range(self.MAPPING_RETRY_LIMIT):
+            virtual, vc_failed_reason = vcs.schedule(
+                sr, avoid_anchors=avoid or None
+            )
+            if virtual is None:
+                if rec is not None:
+                    rec.note(
+                        f"guaranteed grow of {g.name} found no quota "
+                        f"headroom: {vc_failed_reason}"
+                    )
+                return "wait"
+            candidate: Optional[Placement] = None
+            bindings: Dict[api.CellAddress, PhysicalCell] = {}
+            preassigned, non_preassigned = build_binding_paths(
+                virtual, leaf_cell_nums, bindings
+            )
+            free_cell_num_copy = dict(
+                self.all_vc_free_cell_num.get(chain, {})
+            )
+            if allocation.map_virtual_placement_to_physical(
+                preassigned,
+                non_preassigned,
+                self.free_cell_list[chain].shallow_copy(),
+                free_cell_num_copy,
+                sr.suggested_nodes,
+                sr.ignore_suggested_nodes,
+                bindings,
+            ):
+                candidate = virtual_to_physical_placement(
+                    virtual, bindings, leaf_cell_nums
+                )
+            if candidate is not None and all(
+                # The FREE-ROW gate: the mapping may legitimately land on
+                # cells USED by opportunistic pods inside the VC's bound
+                # quota cells (that is how a NEW gang's preemption
+                # victims arise) — a grow row must not: it is applied as
+                # a resize with no victim protocol, so only a genuinely
+                # free row may pass.
+                leaf is not None
+                and leaf.state == CellState.FREE
+                and leaf.using_group is None
+                for leaf in candidate[s.leaf_cell_number][0]
+            ):
+                physical = candidate
+                break
+            new_anchors = _placement_node_anchors(virtual)
+            if not new_anchors - avoid:
+                break  # no new exclusion possible: a retry would loop
+            avoid |= new_anchors
+        if physical is None:
+            if rec is not None:
+                rec.note(
+                    f"guaranteed grow of {g.name}: no mapping onto free "
+                    "capacity within quota (grow never preempts)"
+                )
+            return "wait"
+        new_prow = physical[s.leaf_cell_number][0]
+        new_vrow = virtual[s.leaf_cell_number][0]
+        group_physical: Placement = {
+            n: list(rows) for n, rows in g.physical_placement.items()
+        }
+        group_physical.setdefault(s.leaf_cell_number, []).append(new_prow)
+        group_virtual: Placement = {
+            n: list(rows) for n, rows in g.virtual_placement.items()
+        }
+        group_virtual.setdefault(s.leaf_cell_number, []).append(new_vrow)
+        pod_index = len(group_physical[s.leaf_cell_number]) - 1
+        if rec is not None:
+            rec.note(
+                f"guaranteed elastic grow: {g.name} {g.total_pods} -> "
+                f"{g.total_pods + 1} pods (generation "
+                f"{g.resize_generation + 1})"
+            )
+        return group_physical, group_virtual, pod_index, (
+            g.resize_generation + 1
+        )
 
     def _collect_victims_cached(
         self, g: AffinityGroup
@@ -2444,6 +2623,7 @@ class HivedCore:
         suggested: Set[str],
         phase: SchedulingPhase,
         pod: Pod,
+        leaf_types: Optional[Tuple[str, ...]] = None,
     ) -> Tuple[
         Optional[Placement],
         Optional[Placement],
@@ -2452,7 +2632,7 @@ class HivedCore:
     ]:
         """(reference: hived_algorithm.go:716-754)"""
         group_physical, group_virtual, wait_reason = self._schedule_new_group(
-            pod, s, suggested
+            pod, s, suggested, leaf_types
         )
         if group_physical is None:
             return None, None, None, wait_reason
@@ -2484,6 +2664,7 @@ class HivedCore:
         pod: Pod,
         s: api.PodSchedulingSpec,
         suggested: Set[str],
+        leaf_types: Optional[Tuple[str, ...]] = None,
     ) -> Tuple[Optional[Placement], Optional[Placement], str]:
         """(reference: hived_algorithm.go:756-821)"""
         common.log.info(
@@ -2520,7 +2701,7 @@ class HivedCore:
             return self._schedule_group_for_leaf_type(
                 sr, s.leaf_cell_type, pod, True
             )
-        return self._schedule_group_for_any_leaf_type(sr, pod)
+        return self._schedule_group_for_any_leaf_type(sr, pod, leaf_types)
 
     def _schedule_group_for_leaf_type(
         self,
@@ -2565,11 +2746,17 @@ class HivedCore:
         return None, None, failed_reason
 
     def _schedule_group_for_any_leaf_type(
-        self, sr: SchedulingRequest, pod: Pod
+        self,
+        sr: SchedulingRequest,
+        pod: Pod,
+        leaf_types: Optional[Tuple[str, ...]] = None,
     ) -> Tuple[Optional[Placement], Optional[Placement], str]:
-        """(reference: hived_algorithm.go:857-877)"""
+        """(reference: hived_algorithm.go:857-877) ``leaf_types``
+        restricts the sorted scan to a sweep chunk (see schedule)."""
         failed_reason = ""
         for leaf_cell_type in sorted(self.cell_chains):
+            if leaf_types is not None and leaf_cell_type not in leaf_types:
+                continue
             physical, virtual, type_failed_reason = (
                 self._schedule_group_for_leaf_type(sr, leaf_cell_type, pod, False)
             )
@@ -2801,6 +2988,7 @@ class HivedCore:
         annotations it serialized — once per pod of the gang — is pure
         waste. Recovery replay omits them and decodes from the annotations
         as before (there, the annotations are the only source of truth)."""
+        self._audit_write()
         try:
             self._add_allocated_pod(pod, spec, bind_info, pod_index)
         finally:
@@ -2904,6 +3092,7 @@ class HivedCore:
 
     def delete_allocated_pod(self, pod: Pod) -> None:
         """(reference: hived_algorithm.go:272-296)"""
+        self._audit_write()
         s = extract_pod_scheduling_spec(pod)
         info = extract_pod_bind_info(pod)
         common.log.info(
@@ -3065,6 +3254,7 @@ class HivedCore:
         fresh (grow). Returns the pods of dropped rows (the members the
         shrink evicts). The one mutation path where placements move, so
         every placement-derived cache is invalidated at the end."""
+        self._audit_write()
         if g.state != GroupState.ALLOCATED:
             common.log.error(
                 "group %s: resize requested in state %s; ignored",

@@ -137,6 +137,13 @@ DECISIONS_PATH = INSPECT_PATH + "/decisions"
 # schedule -> placement descent -> bind write -> recovery cycles).
 TRACES_PATH = INSPECT_PATH + "/traces"
 
+# The shadow what-if plane (scheduler.whatif, doc/user-manual.md "When
+# will my pod schedule?"): POST a gang spec (or queue: true for the whole
+# waiting queue, or capacityTrace for capacity planning) and get a
+# structured forecast — predicted wait, victim set, blocking gate,
+# confidence horizon — computed on a snapshot-forked shadow core.
+WHATIF_PATH = INSPECT_PATH + "/whatif"
+
 # The HA / snapshot recovery plane: leadership (identity, leader state,
 # lease holder), the last recovery's mode (snapshot+delta vs full replay)
 # and delta counts, and snapshot persistence state. See doc/fault-model.md

@@ -258,6 +258,56 @@ class DecisionJournal:
             items = items[-n:] if n > 0 else []
         return items
 
+    def stamp_predicted_wait(
+        self,
+        uid: str,
+        predicted_wait_s: Optional[float],
+        horizon_s: Optional[float] = None,
+    ) -> bool:
+        """Stamp a what-if forecast onto a pod's latest WAIT record
+        (scheduler.whatif, doc/observability.md "Decision records"):
+        ``predictedWaitS`` is the promised ETA in seconds (None = blocked
+        beyond the forecast's confidence horizon, carried alongside as
+        ``predictedWaitHorizonS``). Only WAIT verdicts are stamped — a
+        pod that bound since the forecast keeps its bind record clean.
+        The mutation is visible through every shared read of the record
+        (ring snapshots share the dicts), which is the point: the
+        journal's WAIT answer now carries its ETA."""
+        with self._lock:
+            rec = self._by_uid.get(uid)
+            if rec is None or rec.get("verdict") != "wait":
+                return False
+            rec["predictedWaitS"] = predicted_wait_s
+            if horizon_s is not None:
+                rec["predictedWaitHorizonS"] = round(horizon_s, 3)
+            return True
+
+    def stamp_predicted_wait_groups(
+        self,
+        by_group: Dict[str, Optional[float]],
+        horizon_s: Optional[float] = None,
+    ) -> int:
+        """Gang-wide batch stamp: every pod whose LATEST record is a
+        WAIT for a group in ``by_group`` gets its forecast. ONE journal
+        scan for the whole batch — the sharded frontend stamps its
+        MERGED queue forecast into each shard's journal with this (a
+        sweep-registered gang's shard-local verdict can contradict the
+        merged one, so shards never stamp their own queue-mode answers),
+        and a deep queue must not turn that into gangs × journal scans
+        under the lock."""
+        if not by_group:
+            return 0
+        n = 0
+        with self._lock:
+            for rec in self._by_uid.values():
+                group = rec.get("group")
+                if group in by_group and rec.get("verdict") == "wait":
+                    rec["predictedWaitS"] = by_group[group]
+                    if horizon_s is not None:
+                        rec["predictedWaitHorizonS"] = round(horizon_s, 3)
+                    n += 1
+        return n
+
     def lookup(self, key: str) -> Optional[Dict]:
         """Latest decision for a pod, by uid or by pod key
         (``namespace/name``)."""

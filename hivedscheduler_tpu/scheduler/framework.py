@@ -431,6 +431,21 @@ NODE_EVENT_FASTPATH_DEFAULT = (
 # scheduler instance.
 WAIT_CACHE_ENV = "HIVED_WAIT_CACHE"
 
+# Shadow what-if plane metric keys (doc/observability.md): always present
+# in get_metrics so the golden metrics schema holds before the plane's
+# lazy construction. WhatIfPlane.metrics_snapshot emits the same keys;
+# whatifForkAgeSeconds is -1 until a fork has been built (a staleness
+# gauge must not read as "perfectly fresh" when no fork exists).
+WHATIF_EMPTY_METRICS = {
+    "whatifForecastCount": 0,
+    "whatifForecastGangCount": 0,
+    "whatifForkCount": 0,
+    "whatifAuditViolationCount": 0,
+    "whatifForkPodCount": 0,
+    "whatifForkAgeSeconds": -1.0,
+    "whatifForecastSeconds": 0.0,
+}
+
 
 class HivedScheduler:
     """(reference: pkg/scheduler/scheduler.go:53-120)"""
@@ -678,6 +693,17 @@ class HivedScheduler:
         # the leader (single-scheduler deployments, tests, simulators).
         self.leadership = None
         self._deposed_flush_logged = False
+        # Shadow what-if plane (scheduler.whatif): constructed lazily by
+        # the first whatif_routine call (or by the bench's sim sampler),
+        # under _whatif_init_lock — two racing first POSTs on the
+        # threading webserver must not build two planes (separate
+        # serialization locks, and each re-arms the audit to ITS
+        # thread-locals, silently disarming the other's). _mutation_guard
+        # is the framework half of the read-only-fork audit — armed by
+        # the plane, None (zero overhead) otherwise.
+        self._whatif = None
+        self._whatif_init_lock = threading.Lock()
+        self._mutation_guard: Optional[Callable[[], None]] = None
 
     @staticmethod
     def _default_executor(fn: Callable[[], None]) -> None:
@@ -836,6 +862,13 @@ class HivedScheduler:
     # ------------------------------------------------------------------ #
 
     def _enter_mutation(self) -> None:
+        # Shadow what-if audit (scheduler.whatif): every framework verb
+        # passes through here, so a shadow-forecast thread driving LIVE
+        # verbs by mistake raises before any state moves (the core-level
+        # write_guard fences direct core mutations the same way).
+        guard = self._mutation_guard
+        if guard is not None:
+            guard()
         self._mutation_depth.d = getattr(self._mutation_depth, "d", 0) + 1
 
     def _exit_mutation(self) -> None:
@@ -1249,8 +1282,28 @@ class HivedScheduler:
             body, self._config_fingerprint, watermark, pods_json=pods_json
         )
 
+    def export_fork_body(self) -> Optional[Dict]:
+        """The durable projection as a plain body dict for a SHADOW FORK
+        (scheduler.whatif) — the snapshot walk without the ConfigMap
+        round-trip (no chunk encode, no checksum, no persistence). Two
+        relaxations vs the flusher's export, both forecast-correct:
+        BINDING pods (assume-bound, informer confirm still in flight)
+        are included — the fork wants the ASSUMED state the next filter
+        call would schedule against — and the confirmed-BOUND durability
+        gate does not apply. A PREEMPTING group in flight still returns
+        None (reservations have no projection section); the window is
+        one preemption resolving, and the caller retries or serves the
+        previous fork with an honest staleness stamp."""
+        with self._lock:
+            exported = self._export_body_locked(for_fork=True)
+            if exported is None:
+                return None
+            body, _pods_json = exported
+        return body
+
     def _export_body_locked(
         self,
+        for_fork: bool = False,
     ) -> Optional[Tuple[Dict, List[str]]]:
         """The durable projection, exactly the state the chaos harness
         proves restart-equivalent: the core's verbatim cell-level
@@ -1269,6 +1322,13 @@ class HivedScheduler:
         windows are short (a preemption resolving, an informer confirm in
         flight); the flusher simply lands the snapshot on its next beat."""
         statuses = self.pod_schedule_statuses
+        # Fork exports (scheduler.whatif) accept the ASSUMED state —
+        # BINDING counts as confirmed and is exported below.
+        durable_states = (
+            (PodState.BOUND, PodState.BINDING)
+            if for_fork
+            else (PodState.BOUND,)
+        )
         for g in self.core.affinity_groups.values():
             if g.state != GroupState.ALLOCATED:
                 return None
@@ -1278,7 +1338,7 @@ class HivedScheduler:
                     if p is None:
                         continue
                     st = statuses.get(p.uid)
-                    if st is not None and st.pod_state == PodState.BOUND:
+                    if st is not None and st.pod_state in durable_states:
                         confirmed = True
                         break
                 if confirmed:
@@ -1288,11 +1348,16 @@ class HivedScheduler:
         iso = constants.ANNOTATION_POD_LEAF_CELL_ISOLATION
         pods_out: List[Dict] = []
         pods_json: List[str] = []
-        record_cache = self._snapshot_pod_export_cache
+        # The per-pod export memo is the FLUSHER's: fork exports bypass it
+        # both ways (a BINDING pod's record must never seed the durable
+        # flusher cache, and fork walks are rare next to flushes).
+        record_cache = (
+            {} if for_fork else self._snapshot_pod_export_cache
+        )
         new_cache: Dict[str, Tuple[Pod, Dict, str]] = {}
         for uid in sorted(self.pod_schedule_statuses):
             status = self.pod_schedule_statuses[uid]
-            if status.pod_state != PodState.BOUND:
+            if status.pod_state not in durable_states:
                 continue
             pod = status.pod
             cached = record_cache.get(uid)
@@ -1338,11 +1403,17 @@ class HivedScheduler:
                     info, spec.leaf_cell_number
                 ),
             }
-            record_text = json.dumps(record, separators=(",", ":"))
-            new_cache[uid] = (pod, record, record_text)
             pods_out.append(record)
-            pods_json.append(record_text)
-        self._snapshot_pod_export_cache = new_cache
+            if not for_fork:
+                # The serialized text exists for the encoder's section
+                # assembly; a fork consumes the record DICTS directly, so
+                # serializing (under the lock, per fork, at fleet scale)
+                # would be pure waste.
+                record_text = json.dumps(record, separators=(",", ":"))
+                new_cache[uid] = (pod, record, record_text)
+                pods_json.append(record_text)
+        if not for_fork:
+            self._snapshot_pod_export_cache = new_cache
         # No "preempting" section: import never reads one (preempting
         # groups always replay from live preempt-info annotations — they
         # are deltas by nature), and the ALLOCATED-only gate above means
@@ -3087,8 +3158,20 @@ class HivedScheduler:
             with self._wait_cache_lock:
                 self._wait_cache.clear()
 
+    @staticmethod
+    def _spec_cache_key(spec_text: str, leaf_types) -> str:
+        """Wait-cache key: the spec identity, plus the sweep-chunk
+        restriction when one applies — a chunk's WAIT certificate
+        answers only its own restricted scan, and one spec can be probed
+        under several different chunks of the shards frontend's
+        leaf-type-granular sweep (one cache entry per chunk keeps the
+        O(1) repeated-rejection answer the cache exists for)."""
+        if not spec_text or leaf_types is None:
+            return spec_text
+        return spec_text + "\x00" + "\x1f".join(leaf_types)
+
     def _try_fast_wait(
-        self, args: ei.ExtenderArgs
+        self, args: ei.ExtenderArgs, leaf_types=None
     ) -> Optional[ei.ExtenderFilterResult]:
         """The repeated-rejection fast path: when this spec identity's
         last verdict was WAIT and its rejection certificate's version
@@ -3100,8 +3183,11 @@ class HivedScheduler:
         check). The decision journal still records the attempt (with the
         certificate), so explainability survives the shortcut."""
         pod = args.pod
-        key = pod.annotations.get(
-            constants.ANNOTATION_POD_SCHEDULING_SPEC, ""
+        key = self._spec_cache_key(
+            pod.annotations.get(
+                constants.ANNOTATION_POD_SCHEDULING_SPEC, ""
+            ),
+            leaf_types,
         )
         if not key:
             return None
@@ -3161,18 +3247,32 @@ class HivedScheduler:
     # Filter (reference: scheduler.go:485-587)
     # ------------------------------------------------------------------ #
 
-    def filter_routine(self, args: ei.ExtenderArgs) -> ei.ExtenderFilterResult:
+    def filter_routine(
+        self,
+        args: ei.ExtenderArgs,
+        leaf_types: Optional[Tuple[str, ...]] = None,
+    ) -> ei.ExtenderFilterResult:
+        """``leaf_types`` restricts an untyped pod's any-leaf-type scan to
+        a sweep chunk (the shards frontend's leaf-type-granular sweep;
+        see core.schedule). Restricted probes use the wait cache under a
+        CHUNK-QUALIFIED key (_spec_cache_key): a chunk's certificate
+        covers only its own restricted scan, and one spec can carry
+        several chunks."""
         self._enter_mutation()
         try:
-            return self._filter_routine(args)
+            return self._filter_routine(args, leaf_types)
         finally:
             self._exit_mutation()
 
-    def _filter_routine(self, args: ei.ExtenderArgs) -> ei.ExtenderFilterResult:
+    def _filter_routine(
+        self,
+        args: ei.ExtenderArgs,
+        leaf_types: Optional[Tuple[str, ...]] = None,
+    ) -> ei.ExtenderFilterResult:
         start = time.monotonic()
         pod = args.pod
         if self.wait_cache_enabled:
-            fast = self._try_fast_wait(args)
+            fast = self._try_fast_wait(args, leaf_types)
             if fast is not None:
                 self.metrics.observe_fast_wait()
                 self.metrics.observe_filter(
@@ -3224,7 +3324,7 @@ class HivedScheduler:
             try:
                 return self._filter_locked(
                     args, spec, spec_error, suggested_set, sec,
-                    suggested_token,
+                    suggested_token, leaf_types,
                 )
             except api.WebServerError as e:
                 rec.verdict_error(e.message)
@@ -3267,12 +3367,15 @@ class HivedScheduler:
         )
 
     def _filter_locked(self, args, spec, spec_error, suggested_set,
-                       sec=None, suggested_token=None):
+                       sec=None, suggested_token=None, leaf_types=None):
         pod = args.pod
         suggested_nodes = args.node_names
         rec = self.decisions.current()
-        spec_key = pod.annotations.get(
-            constants.ANNOTATION_POD_SCHEDULING_SPEC, ""
+        spec_key = self._spec_cache_key(
+            pod.annotations.get(
+                constants.ANNOTATION_POD_SCHEDULING_SPEC, ""
+            ),
+            leaf_types,
         )
 
         status = self._admission_check(pod.uid, pod)
@@ -3302,6 +3405,7 @@ class HivedScheduler:
             SchedulingPhase.FILTERING,
             spec=spec,
             suggested_set=suggested_set,
+            leaf_types=leaf_types,
         )
         core_s = time.monotonic() - core_t0
 
@@ -3404,6 +3508,8 @@ class HivedScheduler:
         if rec is not None:
             rec.verdict_wait(wait_reason, certificate=cert)
         if cert is not None and self.wait_cache_enabled and spec_key:
+            # Restricted (sweep-chunk) probes store under their chunk-
+            # qualified key — see _spec_cache_key.
             self._wait_cache_store(spec_key, spec, cert, wait_reason)
         # Fake FailedNodes expose the wait reason alongside the default
         # scheduler's own reasons (reference: scheduler.go:573-585).
@@ -3802,6 +3908,15 @@ class HivedScheduler:
         snap["bootPhaseSeconds"] = {
             k: round(v, 6) for k, v in core.boot_phase_seconds.items()
         }
+        # Shadow what-if plane (doc/observability.md): forecast counters
+        # and fork staleness. The keys are always present (golden metrics
+        # schema); zeros/-1 until the plane's lazy construction.
+        plane = self._whatif
+        snap.update(
+            plane.metrics_snapshot()
+            if plane is not None
+            else dict(WHATIF_EMPTY_METRICS)
+        )
         return snap
 
     def is_leader(self) -> bool:
@@ -3839,6 +3954,30 @@ class HivedScheduler:
                 lead, "transition_count", 0
             )
         return payload
+
+    def whatif_routine(self, payload: Dict) -> Dict:
+        """POST /v1/inspect/whatif — the shadow what-if plane
+        (scheduler.whatif, doc/user-manual.md "When will my pod
+        schedule?"): snapshot-forked admission forecasts with promised
+        ETAs. The plane is constructed lazily on first use; its
+        construction arms the read-only-fork audit on this scheduler."""
+        return self.whatif.serve(payload)
+
+    @property
+    def whatif(self):
+        """The lazily-constructed what-if plane (benches and the sim
+        driver reach it directly; HTTP goes through whatif_routine).
+        Double-checked under _whatif_init_lock: exactly one plane per
+        scheduler, ever."""
+        plane = self._whatif
+        if plane is None:
+            from . import whatif as whatif_mod
+
+            with self._whatif_init_lock:
+                plane = self._whatif
+                if plane is None:
+                    plane = self._whatif = whatif_mod.WhatIfPlane(self)
+        return plane
 
     def get_decisions(self, n: Optional[int] = None) -> Dict:
         """Inspect payload for /v1/inspect/decisions: the latest-N ring."""

@@ -32,11 +32,16 @@ Architecture (doc/hot-path.md "The multi-process contract"):
   node -> node's chains) and maps them to families. A single-family pod
   goes straight to the owning shard (the hot path — every typed or
   pinned pod). A pod whose chains span families (only possible for
-  untyped pods) degrades to the *sweep*: the verb runs against each
-  shard in deterministic shard order and the first non-wait outcome
-  wins — the cross-family analog of the in-process any-leaf-type chain
-  scan (probe order is shard-major rather than leaf-type-major; a
-  placement is found iff the single process finds one).
+  untyped pods) degrades to the *sweep*: the filter runs as a
+  LEAF-TYPE-GRANULAR scan — the global sorted leaf-type order, chunked
+  into maximal consecutive same-shard runs, each chunk probed on its
+  owning shard with the scan restricted to exactly its leaf types
+  (``filter_routine(leaf_types=...)``) — so the probe order, and
+  therefore the placement found, is byte-identical to the in-process
+  any-leaf-type chain scan (the PR-8 shard-major deviation is retired;
+  placement-found-iff holds chunk by chunk since the chunks partition
+  the full scan). The rarely-swept preempt verb keeps the shard-major
+  order (first non-empty victim set wins).
 - **Global mode.** Operations spanning shards (multi-shard node/health
   events, clock ticks, recovery bracket work) run as a TWO-PHASE
   broadcast: phase 1 stages the operation on every target shard, phase 2
@@ -498,6 +503,28 @@ class ShardServer:
             result = ei.ExtenderFilterResult(error=e.message)
         return json.dumps(result.to_dict()).encode()
 
+    def filter_sweep(
+        self, args: ei.ExtenderArgs, leaf_types
+    ) -> ei.ExtenderFilterResult:
+        """One chunk of the frontend's leaf-type-granular sweep: the
+        any-leaf-type scan restricted to this shard's consecutive run of
+        the global sorted leaf-type order (see the module docstring)."""
+        return self.scheduler.filter_routine(
+            args, leaf_types=tuple(leaf_types)
+        )
+
+    def filter_sweep_raw(self, body: bytes, leaf_types) -> bytes:
+        """filter_sweep over the raw-bytes wire path (decode/encode in
+        the worker, like filter_routine_raw)."""
+        try:
+            args = ei.ExtenderArgs.from_dict(json.loads(body))
+            result = self.scheduler.filter_routine(
+                args, leaf_types=tuple(leaf_types)
+            )
+        except api.WebServerError as e:
+            result = ei.ExtenderFilterResult(error=e.message)
+        return json.dumps(result.to_dict()).encode()
+
     def filter_fast(self, pod_dict: Dict, nodes_key, nodes) -> Dict:
         """Node-list-memoized filter: the suggested-node list is by far
         the largest slice of every filter payload and is near-constant
@@ -529,6 +556,15 @@ class ShardServer:
         except api.WebServerError as e:
             result = ei.ExtenderFilterResult(error=e.message)
         return result.to_dict()
+
+    def whatif_stamp(self, items, horizon_s) -> int:
+        """Stamp the frontend's MERGED queue forecast onto this shard's
+        decision journal in one scan (shards never stamp their own
+        queue-mode verdicts — see ShardedScheduler.whatif_routine)."""
+        return self.scheduler.decisions.stamp_predicted_wait_groups(
+            {gang_name: predicted for gang_name, predicted in items},
+            horizon_s,
+        )
 
     def delete_pod_meta(self, pod: Pod) -> Dict:
         """delete_pod + the group-liveness bit the parent's pin map
@@ -1274,6 +1310,21 @@ class ShardedScheduler:
         for sid, backend in enumerate(self.shards):
             for c in backend.owned_chains:
                 self._shard_of_chain[c] = sid
+        # Leaf-type-granular sweep chunks (module docstring): the global
+        # sorted leaf-type order, chunked into maximal consecutive runs
+        # owned by one shard. The chunks partition the in-process scan,
+        # so probing them in order IS the in-process probe order.
+        self._sweep_chunks: List[Tuple[int, Tuple[str, ...]]] = []
+        for leaf in sorted(self.routing.leaf_chains):
+            chains = self.routing.leaf_chains[leaf]
+            sid = self._shard_of_chain.get(chains[0])
+            if sid is None:
+                continue
+            if self._sweep_chunks and self._sweep_chunks[-1][0] == sid:
+                prev_sid, prev = self._sweep_chunks[-1]
+                self._sweep_chunks[-1] = (prev_sid, prev + (leaf,))
+            else:
+                self._sweep_chunks.append((sid, (leaf,)))
         # Routing memory: group name -> shard (pinned at first route so a
         # mixed-SKU gang stays on the shard its group registered in), and
         # pod uid -> shard (bind/delete args may carry no routable spec).
@@ -1450,12 +1501,16 @@ class ShardedScheduler:
             result = self.shards[sid].call("filter_routine", args)
             self._note_routed(pod, sid)
             return result
-        # Sweep: deterministic shard order, first non-wait outcome wins
-        # (the cross-family analog of the in-process chain scan; see the
-        # module docstring for the probe-order caveat).
+        # Sweep (cross-family untyped pod): leaf-type-granular, in the
+        # global sorted leaf-type order — each chunk is a consecutive
+        # same-shard run probed with the scan restricted to exactly its
+        # leaf types, so the first non-wait outcome is the one the
+        # single process's any-leaf-type scan finds (module docstring).
         result = None
-        for sid, backend in enumerate(self.shards):
-            result = backend.call("filter_routine", args)
+        for sid, leaf_types in self._sweep_chunks:
+            result = self.shards[sid].call(
+                "filter_sweep", args, leaf_types
+            )
             if result.node_names or (
                 result.failed_nodes
                 and set(result.failed_nodes) != {constants.COMPONENT_NAME}
@@ -1532,11 +1587,14 @@ class ShardedScheduler:
                 if cached[1]:
                     self._group_shard[cached[1]] = sid
             return json.dumps(out).encode()
-        # Sweep (cross-family untyped pod): shard order, first non-wait
-        # outcome wins.
+        # Sweep (cross-family untyped pod): leaf-type-granular chunks in
+        # the global sorted leaf-type order, first non-wait outcome wins
+        # (identical probe order to the in-process scan).
         out = None
-        for sid, backend in enumerate(self.shards):
-            out = backend.call("filter_routine_raw", body)
+        for sid, leaf_types in self._sweep_chunks:
+            out = self.shards[sid].call(
+                "filter_sweep_raw", body, leaf_types
+            )
             r = json.loads(out)
             if r.get("NodeNames") or r.get("Error") or (
                 r.get("FailedNodes")
@@ -1673,6 +1731,185 @@ class ShardedScheduler:
                         m.get("groupLive") for m in per_pod
                     ),
                 })
+
+    # -- shadow what-if plane (aggregated) ----------------------------- #
+
+    def whatif_routine(self, payload: Dict) -> Dict:
+        """POST /v1/inspect/whatif across the shard fleet. Each shard
+        forks its OWN core (its owned chains are the only authoritative
+        state it holds) and forecasts its own slice of the waiting
+        queue; the frontend merges. A gang a sweep registered in several
+        shards keeps its BEST forecast — earliest ETA, blocked sorts
+        last — because the gang schedules the moment ANY shard can place
+        it (placement-found-iff, the sweep's own contract). Known
+        artifact (doc/hot-path.md "Shadow what-if plane" honest nulls):
+        such a cross-family gang occupies EVERY probed shard's fork, so
+        other gangs sharing a non-winning shard see phantom occupancy
+        and forecast pessimistic — safe-direction skew (promises err
+        late, never early). A single-spec forecast routes by its leaf
+        type like a filter; a capacity plan fans out over per-shard
+        trace slices and sums."""
+        if not isinstance(payload, dict):
+            raise api.bad_request("whatif payload must be a JSON object")
+        if payload.get("spec") is not None:
+            if not isinstance(payload["spec"], dict):
+                # Mirror the single-process 400 (a bare string spec must
+                # not 500 out of the leafType peek below).
+                raise api.bad_request(
+                    "whatif spec must be an object with "
+                    "name/vc/leafType/pods/chips/priority"
+                )
+            leaf = str(payload["spec"].get("leafType") or "")
+            chains = self.routing.leaf_chains.get(leaf)
+            sid = (
+                self._shard_of_chain.get(chains[0]) if chains else None
+            )
+            if sid is None:
+                raise api.bad_request(
+                    f"whatif spec names leaf cell type {leaf!r} which "
+                    "the cluster does not have"
+                )
+            return self.shards[sid].call("whatif_routine", payload)
+        if payload.get("capacityTrace") is not None:
+            return self._whatif_capacity(payload)
+        # Queue mode: shards must NOT stamp their LOCAL verdicts — a
+        # sweep-registered gang's shard-local forecast (blocked on the
+        # families that shard owns) can contradict the merged answer.
+        # The frontend stamps the MERGED forecast into every shard's
+        # journal afterwards.
+        fan_payload = dict(payload)
+        stamp = bool(fan_payload.get("stamp", True))
+        fan_payload["stamp"] = False
+        replies = self._whatif_fan_out("whatif_routine", fan_payload)
+        merged: Dict[str, Dict] = {}
+        order: List[str] = []
+
+        def better(a: Dict, b: Dict) -> bool:
+            ka = (a["predictedWaitS"] is None, a["predictedWaitS"] or 0.0)
+            kb = (b["predictedWaitS"] is None, b["predictedWaitS"] or 0.0)
+            return ka < kb
+
+        for reply in replies:
+            for f in reply.get("forecasts") or []:
+                cur = merged.get(f["gang"])
+                if cur is None:
+                    merged[f["gang"]] = f
+                    order.append(f["gang"])
+                elif better(f, cur):
+                    merged[f["gang"]] = f
+        if stamp and merged:
+            # The horizon the stamps are conditioned on: every shard
+            # already derived (and validated) it — read it back from a
+            # reply's meta instead of re-deriving a second copy here.
+            duration = next(
+                (
+                    m["confidenceHorizonS"]
+                    for m in (r.get("meta") or {} for r in replies)
+                    if "confidenceHorizonS" in m
+                ),
+                0.0,
+            )
+            items = [(g, merged[g]["predictedWaitS"]) for g in order]
+            for backend in self.shards:
+                backend.call("whatif_stamp", items, duration)
+        return {
+            "mode": "queue",
+            "forecasts": [merged[g] for g in order],
+            "meta": {
+                "shards": len(self.shards),
+                "perShard": [r.get("meta") for r in replies],
+            },
+        }
+
+    def _whatif_fan_out(
+        self, method: str, payloads
+    ) -> List[Dict]:
+        """Per-shard whatif calls, in parallel for process backends
+        (each is a full fork build + horizon replay — wall time must be
+        the max of the shards, not the sum; the recover() fan-out
+        pattern). ``payloads`` is one shared payload dict, or a list
+        with one payload per shard."""
+        per_shard = (
+            payloads
+            if isinstance(payloads, list)
+            else [payloads] * len(self.shards)
+        )
+        results: List[Optional[Dict]] = [None] * len(self.shards)
+        errors: List[BaseException] = []
+
+        def run(sid: int) -> None:
+            try:
+                results[sid] = self.shards[sid].call(
+                    method, per_shard[sid]
+                )
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        if self.transport == "proc" and len(self.shards) > 1:
+            threads = [
+                threading.Thread(target=run, args=(sid,))
+                for sid in range(len(self.shards))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        else:
+            for sid in range(len(self.shards)):
+                run(sid)
+        if errors:
+            raise errors[0]
+        return results  # type: ignore[return-value]
+
+    def _whatif_capacity(self, payload: Dict) -> Dict:
+        """Capacity planning across shards: each shard's fork holds only
+        its owned chains' state, so the trace is SLICED — every submit
+        goes to the one shard owning its leaf type (replaying the full
+        trace everywhere would count each foreign-SKU gang as unbound N-1
+        times and tell operators to buy capacity they have). Fault/other
+        events broadcast, like live node events do. Per-shard risks then
+        sum correctly because the submits partition."""
+        trace = payload["capacityTrace"] or {}
+        slices: List[List[Dict]] = [[] for _ in self.shards]
+        for ev in trace.get("events") or []:
+            if ev.get("kind") == "submit":
+                leaf = str((ev.get("gang") or {}).get("leafType") or "")
+                chains = self.routing.leaf_chains.get(leaf)
+                sid = (
+                    self._shard_of_chain.get(chains[0])
+                    if chains
+                    else None
+                )
+                slices[sid if sid is not None else 0].append(ev)
+            else:
+                for s in slices:
+                    s.append(ev)
+        per_shard = []
+        for sid in range(len(self.shards)):
+            sub = dict(payload)
+            sub["capacityTrace"] = dict(trace, events=slices[sid])
+            per_shard.append(sub)
+        replies = self._whatif_fan_out("whatif_routine", per_shard)
+        sub_g = sum(
+            r["counts"]["submittedGuaranteed"] for r in replies
+        )
+        bound_g = sum(r["counts"]["boundGuaranteed"] for r in replies)
+        return {
+            "mode": "capacity",
+            "perShard": replies,
+            "sloRisk": {
+                "unboundGuaranteed": sub_g - bound_g,
+                "quotaSatisfaction": (
+                    round(bound_g / sub_g, 4) if sub_g else 1.0
+                ),
+                "waitingAtEnd": sum(
+                    r["sloRisk"]["waitingAtEnd"] for r in replies
+                ),
+                "p99OverSlo": any(
+                    r["sloRisk"]["p99OverSlo"] for r in replies
+                ),
+            },
+        }
 
     # -- node / health events (global mode) --------------------------- #
 
@@ -1993,6 +2230,15 @@ class ShardedScheduler:
             ),
         }
         merged["lockSharding"] = f"procs:{len(self.shards)}"
+        # Fork staleness is a per-shard gauge: the merged value is the
+        # OLDEST fork still being served (summing ages is meaningless).
+        merged["whatifForkAgeSeconds"] = max(
+            (
+                s.get("whatifForkAgeSeconds", -1.0)
+                for s in per_shard
+            ),
+            default=-1.0,
+        )
         merged["leader"] = self.is_leader()
         merged["ready"] = self.is_ready()
         merged["deposedBindRefusedCount"] += self._deposed_bind_refused

@@ -84,6 +84,47 @@ def is_node_healthy(node: Node) -> bool:
     return not node.unschedulable and node.ready
 
 
+def apply_node_fault_event(old: Node, ev: Dict) -> Optional[Node]:
+    """The chaos/sim/what-if fault vocabulary applied to one Node: the
+    NEW Node object the informer would deliver for ``node_flip``,
+    ``chip_fault``/``chip_heal``, or ``drain_toggle`` (None for unknown
+    kinds). The ONE implementation shared by the sim driver
+    (index-resolved nodes) and the what-if plane's horizon replay
+    (name-resolved), so the vocabulary cannot drift between them."""
+    annotations = dict(old.annotations)
+    ready = old.ready
+    kind = str(ev.get("kind") or "")
+    if kind == "node_flip":
+        ready = ev.get("to", "down") == "up"
+    elif kind in ("chip_fault", "chip_heal"):
+        bad = set(
+            x
+            for x in annotations.get(
+                constants.ANNOTATION_NODE_DEVICE_HEALTH, ""
+            ).split(",")
+            if x
+        )
+        chip = str(ev.get("chip", 0))
+        if kind == "chip_fault":
+            bad.add(chip)
+        else:
+            bad.discard(chip)
+        if bad:
+            annotations[constants.ANNOTATION_NODE_DEVICE_HEALTH] = (
+                ",".join(sorted(bad))
+            )
+        else:
+            annotations.pop(constants.ANNOTATION_NODE_DEVICE_HEALTH, None)
+    elif kind == "drain_toggle":
+        if ev.get("on"):
+            annotations[constants.ANNOTATION_NODE_DRAIN] = "*"
+        else:
+            annotations.pop(constants.ANNOTATION_NODE_DRAIN, None)
+    else:
+        return None
+    return Node(name=old.name, ready=ready, annotations=annotations)
+
+
 class SchedulingPhase(str, enum.Enum):
     """(reference: internal/types.go:102-114)"""
 

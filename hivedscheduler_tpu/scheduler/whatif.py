@@ -1,0 +1,832 @@
+"""Shadow what-if plane: snapshot-forked admission forecasts with ETAs.
+
+HiveD guarantees *where* a gang lands but a WAIT verdict says nothing
+about *when* — a silent queue. This module answers, per pending or
+hypothetical gang, "when will this schedule, what would it preempt, and
+which gate blocks it until then", by composing three planes that already
+exist:
+
+- **Fork.** A shadow :class:`~.framework.HivedScheduler` is built from
+  the live scheduler's durable projection (``export_fork_body`` — the
+  snapshot walk of PR 7 without the ConfigMap round-trip) through the HA
+  standby's pre-apply path (``_import_snapshot_state``): the fork's core
+  is the exact assumed state the next live filter call would schedule
+  against, including in-flight assume-binds.
+- **Replay.** The caller-supplied horizon (departures, drains, chip
+  faults) replays against the fork through the REAL scheduling verbs —
+  the same filter/preempt/delete protocol the sim tier's TraceDriver
+  speaks (PR 9). After each horizon step the still-waiting gangs are
+  re-probed in FIFO order; the first step at which a gang places is its
+  promised ETA, and a guaranteed gang's probe runs the full preemption
+  protocol on the fork, so "what would it preempt" is the actual victim
+  set, not a heuristic.
+- **Certificates.** Every WAIT verdict already carries a rejection
+  certificate (failed gate + the version vector the attempt read, PR
+  12). The live certificate seeds the forecast's blocking gate, and the
+  FORK's own certificates gate the replay: a waiting gang is re-probed
+  only when the fork's version vector moved for it — the same
+  no-op-deletion argument as the negative-filter cache, so a forecast
+  over a deep queue costs O(changes), not O(queue x events).
+
+**The read-only contract, with teeth.** A forecast must never mutate
+live state. The fork is a separate object graph by construction, but
+construction is not a proof — so the plane arms a ``lock_validator``-
+style audit on the LIVE scheduler: while a forecast thread is inside its
+shadow section, any live-core mutator entry (``core.write_guard``) or
+live framework verb (``framework._mutation_guard``) raises
+:class:`ShadowWriteError` instead of corrupting served state. The
+sensitivity meta-test (tests/test_whatif.py) proves a fork wired to the
+live scheduler is caught.
+
+Serving: ``POST /v1/inspect/whatif`` (webserver) with three modes —
+``spec`` (one hypothetical gang), ``queue: true`` (score the whole live
+waiting queue FIFO, stamping ``predictedWaitS`` onto each gang's
+decision-journal WAIT record), and ``capacityTrace`` (capacity
+planning: replay tomorrow's trace against today's snapshot on the fork
+via TraceDriver and report SLO risk). The ``forecasts`` section of a
+reply is deterministic — same snapshot + same horizon => bit-identical
+(tests assert it); wall-clock costs live only under ``meta``.
+
+Metrics: ``hived_whatif_*`` (doc/observability.md) — forecast counters,
+fork pod count, and fork staleness (age of the last fork; -1 before the
+first).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from .. import common
+from ..api import extender as ei, types as api
+from .framework import (
+    HivedScheduler,
+    NullKubeClient,
+    PodState,
+    WHATIF_EMPTY_METRICS,
+)
+from .types import (
+    Node,
+    Pod,
+    apply_node_fault_event,
+    extract_pod_scheduling_spec,
+)
+
+# Forecast verdicts.
+VERDICT_SCHEDULE = "schedule"   # places at predictedWaitS (0 = now)
+VERDICT_BLOCKED = "blocked"     # not within the confidence horizon
+
+
+class ShadowWriteError(RuntimeError):
+    """A shadow-forecast thread reached a LIVE-scheduler mutator: the
+    read-only-fork audit's teeth (see module docstring)."""
+
+
+def restored_node_baseline(core, name: str) -> Node:
+    """The Node object a restored core's health state corresponds to —
+    the baseline horizon fault events apply OVER. A fresh healthy Node
+    here would be wrong twice: the first update_node would heal restored
+    node badness ("first observation always applies" in the damper), and
+    an empty drain annotation would lift restored drains — phantom
+    capacity, optimistic promises. Reconstructs ready from bad_nodes and
+    the device-health / drain annotations from the restored chip
+    records (the inverse of scheduler.health's parse)."""
+    from ..api import constants as const
+
+    annotations: Dict[str, str] = {}
+    bad = core.bad_chips.get(name)
+    if bad:
+        annotations[const.ANNOTATION_NODE_DEVICE_HEALTH] = ",".join(
+            sorted(str(c) for c in bad)
+        )
+    draining = core.draining_chips.get(name)
+    if draining:
+        all_chips = core.node_chip_indices(name)
+        annotations[const.ANNOTATION_NODE_DRAIN] = (
+            "*"
+            if all_chips and {int(c) for c in draining} >= set(all_chips)
+            else ",".join(sorted(str(c) for c in draining))
+        )
+    return Node(
+        name=name,
+        ready=name not in core.bad_nodes,
+        annotations=annotations,
+    )
+
+
+class ShadowFork:
+    """One forked shadow scheduler plus the group bookkeeping the horizon
+    replay needs (group name -> restored pods, uid -> group)."""
+
+    def __init__(self, sched: HivedScheduler, body: Dict):
+        self.sched = sched
+        self.nodes: List[str] = sorted(sched.core.configured_node_names())
+        self.groups: "OrderedDict[str, List[Pod]]" = OrderedDict()
+        self.uid_group: Dict[str, str] = {}
+        for rec in body.get("pods") or []:
+            gname = str(rec["spec"]["affinityGroup"]["name"])
+            status = sched.pod_schedule_statuses.get(rec["uid"])
+            if status is None:
+                continue
+            self.groups.setdefault(gname, []).append(status.pod)
+            self.uid_group[rec["uid"]] = gname
+        self.pod_count = sum(len(p) for p in self.groups.values())
+        # Node objects for the horizon's fault vocabulary, seeded from
+        # the RESTORED health state (restored_node_baseline) so a
+        # horizon event is a delta on today's truth, never a heal.
+        self._node_cache: Dict[str, Node] = {}
+
+    def node(self, name: str) -> Node:
+        n = self._node_cache.get(name)
+        if n is None:
+            n = self._node_cache[name] = restored_node_baseline(
+                self.sched.core, name
+            )
+        return n
+
+    def kill_group(self, gname: str) -> int:
+        """Delete a restored gang from the fork (departure or preemption
+        victim); idempotent."""
+        pods = self.groups.pop(gname, None) or []
+        for p in pods:
+            self.sched.delete_pod(p)
+            self.uid_group.pop(p.uid, None)
+        return len(pods)
+
+    def register(self, gname: str, pods: List[Pod]) -> None:
+        """Index a gang the FORECAST placed on the fork, so a later
+        forecast gang's preemption can name (and kill) it exactly like a
+        restored gang — without this, victims with synthetic probe uids
+        would be unmapped and the preemptor falsely 'blocked'."""
+        self.groups[gname] = list(pods)
+        for p in pods:
+            self.uid_group[p.uid] = gname
+
+
+class _ForecastGang:
+    """One waiting (or hypothetical) gang being forecast."""
+
+    __slots__ = (
+        "name", "vc", "priority", "pods", "uids", "live_gate", "cert",
+        "gate", "detail",
+    )
+
+    def __init__(self, name, vc, priority, pods, uids=None, live_gate=None):
+        self.name = name
+        self.vc = vc
+        self.priority = priority
+        self.pods: List[Pod] = pods
+        # The LIVE pods' uids (queue mode): predictedWaitS is stamped
+        # onto their decision-journal WAIT records.
+        self.uids: List[str] = uids or []
+        self.live_gate = live_gate  # gate from the live rejection cert
+        self.cert: Optional[Dict] = None  # the FORK's latest certificate
+        self.gate: Optional[str] = live_gate
+        self.detail: Optional[Dict] = None
+
+    @property
+    def guaranteed(self) -> bool:
+        return self.priority is not None and self.priority >= 0
+
+
+class WhatIfPlane:
+    """The what-if engine attached to one live scheduler."""
+
+    def __init__(self, sched: HivedScheduler):
+        self.sched = sched
+        self._tls = threading.local()
+        self._lock = threading.Lock()  # serializes forecasts
+        # Counters (metrics_snapshot; doc/observability.md).
+        self.forecast_count = 0
+        self.forecast_gang_count = 0
+        self.fork_count = 0
+        self.audit_violations = 0
+        self.last_fork_pods = 0
+        self.last_fork_at: Optional[float] = None
+        self.last_forecast_s = 0.0
+        self._arm_audit()
+
+    # ---------------- the read-only-fork audit ---------------- #
+
+    def _arm_audit(self) -> None:
+        """Install the teeth on the LIVE scheduler. Idempotent, and
+        re-run before every forecast: recovery paths replace the core
+        object (_reset_for_full_replay), which would silently shed the
+        guard."""
+        self.sched._mutation_guard = self._audit
+        self.sched.core.write_guard = self._audit
+
+    def _audit(self) -> None:
+        if getattr(self._tls, "shadow", 0):
+            self.audit_violations += 1
+            raise ShadowWriteError(
+                "shadow what-if forecast attempted to mutate LIVE "
+                "scheduler state (the fork must be the only subject a "
+                "forecast drives)"
+            )
+
+    class _ShadowSection:
+        def __init__(self, plane: "WhatIfPlane"):
+            self.plane = plane
+
+        def __enter__(self):
+            tls = self.plane._tls
+            tls.shadow = getattr(tls, "shadow", 0) + 1
+            return self
+
+        def __exit__(self, *exc):
+            self.plane._tls.shadow -= 1
+            return False
+
+    def shadow_section(self) -> "WhatIfPlane._ShadowSection":
+        """While entered, the calling thread may only drive forks — any
+        live-scheduler mutation raises ShadowWriteError."""
+        return self._ShadowSection(self)
+
+    # ---------------- fork construction ---------------- #
+
+    def build_fork(self, seed: int = 0) -> ShadowFork:
+        """Fork the shadow scheduler from the live durable projection —
+        the HA standby's pre-apply path, minus the ConfigMap round-trip.
+        Raises 503 while the projection is transient (a preemption
+        resolving or a gang mid-admission); the window is one scheduling
+        event, callers simply retry."""
+        self._arm_audit()
+        body = self.sched.export_fork_body()
+        if body is None:
+            raise api.WebServerError(
+                503,
+                "live projection is transient (preemption or gang "
+                "admission in flight); retry the what-if call",
+            )
+        fork = HivedScheduler(
+            self.sched.config,
+            kube_client=NullKubeClient(),
+            auto_admit=True,
+            global_lock=True,
+            trace_sample=0.0,
+            # Force binds are live-cluster side effects; on a fork they
+            # would also be BACKGROUND fork mutations racing the replay
+            # (non-deterministic forecasts). The assume-bind state is all
+            # a forecast reads — drop them.
+            force_bind_executor=lambda fn: None,
+        )
+        fork._import_snapshot_state(body, live_names=None)
+        with fork._lock:
+            # Recovery-only trackers; the fork serves immediately.
+            fork._snapshot_pending.clear()
+            fork._snapshot_claims.clear()
+        # Deterministic preempt victim-node picks per forecast seed, so
+        # repeated forecasts at one snapshot epoch are bit-identical.
+        fork.core.preempt_rng = random.Random(seed)
+        shadow = ShadowFork(fork, body)
+        self.fork_count += 1
+        self.last_fork_pods = shadow.pod_count
+        self.last_fork_at = time.monotonic()
+        return shadow
+
+    # ---------------- the forecast engine ---------------- #
+
+    def _attempt(
+        self, fork: ShadowFork, gang: _ForecastGang
+    ) -> Tuple[bool, Optional[Dict]]:
+        """One scheduling attempt for the gang on the fork — the same
+        protocol the extender (and the sim driver) speaks: filter every
+        member; on failure a guaranteed gang runs the preemption probe,
+        kills its victims ON THE FORK, and re-filters. Returns
+        (placed, preemption detail)."""
+        sched = fork.sched
+        if self._filter_all(fork, gang.pods):
+            fork.register(gang.name, gang.pods)
+            return True, None
+        if not gang.guaranteed:
+            return False, None
+        result = sched.preempt_routine(
+            ei.ExtenderPreemptionArgs(
+                pod=gang.pods[0],
+                node_name_to_meta_victims={
+                    n: ei.MetaVictims() for n in fork.nodes
+                },
+            )
+        )
+        victim_uids = {
+            mp.uid
+            for mv in result.node_name_to_meta_victims.values()
+            for mp in mv.pods
+        }
+        if not victim_uids:
+            return False, None
+        victims: List[Dict] = []
+        for gname in sorted(
+            {fork.uid_group.get(u, "") for u in victim_uids} - {""}
+        ):
+            for p in fork.groups.get(gname, []):
+                victims.append(
+                    {
+                        "pod": p.key,
+                        "uid": p.uid,
+                        "node": p.node_name,
+                        "group": gname,
+                    }
+                )
+            fork.kill_group(gname)
+        if self._filter_all(fork, gang.pods):
+            fork.register(gang.name, gang.pods)
+            return True, {
+                "victimPods": len(victims),
+                "victims": victims,
+            }
+        # Cancel: release the fork-side reservation so a blocked gang
+        # never parks shadow capacity it cannot use (the extender's
+        # cancel shape — preempt with no candidates).
+        sched.preempt_routine(
+            ei.ExtenderPreemptionArgs(
+                pod=gang.pods[0], node_name_to_meta_victims={}
+            )
+        )
+        for p in gang.pods:
+            sched.delete_pod(p)
+        return False, None
+
+    def _filter_all(self, fork: ShadowFork, pods: List[Pod]) -> bool:
+        """Filter every member; partial failure releases the placed
+        prefix (the framework's partial-gang release)."""
+        for p in pods:
+            r = fork.sched.filter_routine(
+                ei.ExtenderArgs(pod=p, node_names=fork.nodes)
+            )
+            if not r.node_names:
+                for q in pods:
+                    fork.sched.delete_pod(q)
+                return False
+        return True
+
+    def _refresh_cert(self, fork: ShadowFork, gang: _ForecastGang) -> None:
+        rec = fork.sched.decisions.lookup(gang.pods[0].uid)
+        cert = (rec or {}).get("certificate")
+        gang.cert = cert
+        if cert is not None and cert.get("gate"):
+            gang.gate = cert["gate"]
+
+    def _apply_event(self, fork: ShadowFork, ev: Dict) -> None:
+        """One horizon event on the fork: a departure, or a fault in the
+        sim driver's node vocabulary keyed by node NAME (the shared
+        scheduler.types.apply_node_fault_event implementation — the two
+        replay engines cannot drift)."""
+        kind = str(ev.get("kind") or "")
+        if kind == "depart":
+            fork.kill_group(str(ev.get("group") or ""))
+            return
+        name = str(ev.get("node") or "")
+        if not name:
+            return
+        old = fork.node(name)
+        new = apply_node_fault_event(old, ev)
+        if new is None:
+            return  # unknown kinds are ignored, not errors
+        fork._node_cache[name] = new
+        fork.sched.update_node(old, new)
+
+    def run_forecast(
+        self,
+        fork: ShadowFork,
+        gangs: List[_ForecastGang],
+        events: List[Dict],
+        duration_s: float,
+    ) -> List[Dict]:
+        """Replay the horizon on the fork, re-probing the waiting gangs
+        in FIFO order after each step. Certificate-gated: a gang whose
+        FORK certificate's version vector is unchanged is provably
+        blocked identically and is skipped (the wait-cache argument, one
+        layer up). Runs inside the shadow section — live mutations
+        raise."""
+        pending = list(gangs)
+        done: Dict[str, Dict] = {}
+
+        def probe_round(t: float) -> None:
+            for gang in list(pending):
+                if gang.cert is not None and fork.sched.core.certificate_current(
+                    gang.cert
+                ):
+                    continue  # provably the same WAIT: skip the probe
+                placed, preempt_detail = self._attempt(fork, gang)
+                if placed:
+                    done[gang.name] = {
+                        "gang": gang.name,
+                        "vc": gang.vc,
+                        "priority": gang.priority,
+                        "members": len(gang.pods),
+                        "verdict": VERDICT_SCHEDULE,
+                        "predictedWaitS": round(t, 3),
+                        "blockingGate": gang.gate if t > 0 else None,
+                        "preemption": preempt_detail,
+                    }
+                    pending.remove(gang)
+                else:
+                    self._refresh_cert(fork, gang)
+
+        def event_key(e: Dict):
+            # The seq tiebreak (sim_sample attaches the driver's heap
+            # seq) keeps same-instant departures in the caller's own
+            # deterministic order; events without one sort after, by
+            # kind then full content.
+            seq = e.get("seq")
+            return (
+                float(e.get("t", 0.0)),
+                float(seq) if isinstance(seq, (int, float)) else float("inf"),
+                str(e.get("kind", "")),
+                str(e),
+            )
+
+        with self.shadow_section():
+            probe_round(0.0)
+            for ev in sorted(events, key=event_key):
+                if not pending:
+                    break
+                t = float(ev.get("t", 0.0))
+                if t > duration_s:
+                    break
+                self._apply_event(fork, ev)
+                probe_round(max(t, 0.0))
+        for gang in pending:
+            done[gang.name] = {
+                "gang": gang.name,
+                "vc": gang.vc,
+                "priority": gang.priority,
+                "members": len(gang.pods),
+                "verdict": VERDICT_BLOCKED,
+                "predictedWaitS": None,
+                "blockingGate": gang.gate,
+                "preemption": None,
+            }
+        # FIFO order of the input queue, preserved in the reply.
+        return [done[g.name] for g in gangs]
+
+    # ---------------- gang construction ---------------- #
+
+    def _gang_from_spec(self, spec: Dict) -> _ForecastGang:
+        """A hypothetical gang from the sim trace vocabulary:
+        name/vc/leafType/pods/chips/priority."""
+        from ..sim import fleet
+
+        try:
+            name = str(spec["name"])
+            vc = str(spec["vc"])
+            leaf_type = str(spec["leafType"])
+            n_pods = int(spec["pods"])
+            chips = int(spec["chips"])
+            priority = int(spec["priority"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise api.bad_request(
+                f"whatif spec needs name/vc/leafType/pods/chips/priority: {e}"
+            )
+        group = {
+            "name": name,
+            "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
+        }
+        pods = [
+            fleet.make_pod(
+                f"{name}-wf{i}", f"{name}-wfu{i}", vc, priority,
+                leaf_type, chips, group,
+            )
+            for i in range(n_pods)
+        ]
+        return _ForecastGang(name, vc, priority, pods)
+
+    def waiting_gangs(self) -> List[_ForecastGang]:
+        """The LIVE waiting queue as forecast gangs, FIFO by first-filter
+        order (pod_schedule_statuses preserves insertion order). Probe
+        pods are synthesized to the gang's FULL member count from a
+        representative waiting pod's annotations, so the fork probe
+        places the whole gang even when only some members have filtered
+        yet. Each gang carries its live rejection certificate's gate —
+        the forecast starts at the exact blocking gate the live WAIT
+        recorded."""
+        out: "OrderedDict[str, Dict]" = OrderedDict()
+        for uid, st in list(self.sched.pod_schedule_statuses.items()):
+            if st.pod_state != PodState.WAITING:
+                continue
+            pod = st.pod
+            try:
+                spec = extract_pod_scheduling_spec(pod)
+            except api.WebServerError:
+                continue
+            gname = (
+                spec.affinity_group.name
+                if spec.affinity_group is not None
+                else pod.name
+            )
+            entry = out.get(gname)
+            if entry is None:
+                members = (
+                    [
+                        (int(m.pod_number), int(m.leaf_cell_number))
+                        for m in spec.affinity_group.members
+                    ]
+                    if spec.affinity_group is not None
+                    else [(1, int(spec.leaf_cell_number))]
+                )
+                rec = self.sched.decisions.lookup(uid) or {}
+                cert = rec.get("certificate") or {}
+                entry = out[gname] = {
+                    "vc": str(spec.virtual_cluster),
+                    "priority": spec.priority,
+                    "members": members,
+                    "rep": pod,
+                    "uids": [],
+                    "gate": cert.get("gate"),
+                }
+            entry["uids"].append(uid)
+        gangs: List[_ForecastGang] = []
+        for gname, e in out.items():
+            gangs.append(
+                _ForecastGang(
+                    gname, e["vc"], e["priority"],
+                    self._member_probe_pods(gname, e["rep"], e["members"]),
+                    uids=e["uids"], live_gate=e["gate"],
+                )
+            )
+        return gangs
+
+    @staticmethod
+    def _member_probe_pods(gname, rep: Pod, members) -> List[Pod]:
+        """Probe pods for the gang's FULL member list, cloned from a
+        representative waiting pod. A heterogeneous gang's member
+        entries differ in leafCellNumber, and a pod's own spec must name
+        ITS member's leaf count — one rewritten spec annotation per
+        distinct entry (yaml.safe_dump sorts keys: deterministic)."""
+        import yaml
+
+        from ..api import constants as const
+
+        spec_text = rep.annotations.get(
+            const.ANNOTATION_POD_SCHEDULING_SPEC, ""
+        )
+        try:
+            spec_d = yaml.safe_load(spec_text)
+        except yaml.YAMLError:
+            spec_d = None
+        if not isinstance(spec_d, dict):
+            spec_d = None
+        pods: List[Pod] = []
+        i = 0
+        for pod_number, leaf_num in members:
+            annotations = dict(rep.annotations)
+            if spec_d is not None and spec_d.get("leafCellNumber") != leaf_num:
+                rewritten = dict(spec_d)
+                rewritten["leafCellNumber"] = leaf_num
+                annotations[const.ANNOTATION_POD_SCHEDULING_SPEC] = (
+                    yaml.safe_dump(rewritten)
+                )
+            for _ in range(max(1, pod_number)):
+                pods.append(
+                    Pod(
+                        name=f"{gname}-wf{i}",
+                        uid=f"{gname}-wfu{i}",
+                        annotations=annotations,
+                        resource_limits=dict(rep.resource_limits),
+                    )
+                )
+                i += 1
+        return pods
+
+    # ---------------- serving ---------------- #
+
+    def serve(self, payload: Dict) -> Dict:
+        """One POST /v1/inspect/whatif request (see module docstring for
+        the modes). Serialized per plane: forecasts are CPU-bound fork
+        replays; two concurrent ones would just thrash."""
+        if not isinstance(payload, dict):
+            raise api.bad_request("whatif payload must be a JSON object")
+        with self._lock:
+            return self._serve_locked(payload)
+
+    def _serve_locked(self, payload: Dict) -> Dict:
+        horizon = payload.get("horizon") or {}
+        events = list(horizon.get("events") or [])
+        try:
+            duration_s = float(
+                horizon.get("durationS")
+                or max(
+                    [float(e.get("t", 0.0)) for e in events], default=0.0
+                )
+            )
+        except (TypeError, ValueError):
+            raise api.bad_request("horizon.durationS must be a number")
+        seed = int(payload.get("seed") or 0)
+        t0 = time.perf_counter()
+        if payload.get("capacityTrace") is not None:
+            return self._serve_capacity(payload, seed, t0)
+        if payload.get("spec") is not None:
+            mode = "spec"
+            gangs = [self._gang_from_spec(payload["spec"])]
+        elif payload.get("queue"):
+            mode = "queue"
+            gangs = self.waiting_gangs()
+        else:
+            raise api.bad_request(
+                "whatif payload needs one of: spec, queue: true, "
+                "capacityTrace"
+            )
+        fork = self.build_fork(seed)
+        fork_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        forecasts = self.run_forecast(fork, gangs, events, duration_s)
+        forecast_s = time.perf_counter() - t1
+        if mode == "queue" and payload.get("stamp", True):
+            by_name = {f["gang"]: f for f in forecasts}
+            for gang in gangs:
+                f = by_name[gang.name]
+                for uid in gang.uids:
+                    self.sched.decisions.stamp_predicted_wait(
+                        uid, f["predictedWaitS"], horizon_s=duration_s
+                    )
+        self.forecast_count += 1
+        self.forecast_gang_count += len(forecasts)
+        self.last_forecast_s = fork_s + forecast_s
+        return {
+            "mode": mode,
+            # Deterministic: same snapshot + same horizon => identical.
+            "forecasts": forecasts,
+            "meta": self._meta(
+                fork, len(events), duration_s, fork_s, forecast_s
+            ),
+        }
+
+    def _serve_capacity(self, payload: Dict, seed: int, t0: float) -> Dict:
+        """Capacity planning: replay a whole trace (tomorrow's diurnal
+        load) against today's snapshot on the fork, through the sim
+        tier's TraceDriver, and report SLO risk. Today's restored gangs
+        stay resident for the whole replay (conservative: current load
+        never departs), so the answer is "can tomorrow's load land ON
+        TOP of today's"."""
+        from ..sim.driver import TraceDriver
+
+        trace = dict(payload["capacityTrace"])
+        # Namespace the trace's gang names away from today's restored
+        # gangs: trace generators reuse g0..gN, and a submit whose uid
+        # collides with a restored BOUND pod is an admission error, not
+        # tomorrow's load.
+        events = []
+        for ev in trace.get("events") or []:
+            if ev.get("kind") == "submit":
+                gang = dict(ev.get("gang") or {})
+                gang["name"] = f"wfcap-{gang.get('name')}"
+                ev = dict(ev, gang=gang)
+            events.append(ev)
+        trace["events"] = events
+        slo_wait_s = float(payload.get("sloWaitS") or 600.0)
+        fork = self.build_fork(seed)
+        fork_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        driver = TraceDriver(
+            self.sched.config, scheduler=fork.sched, prepare_nodes=False
+        )
+        with self.shadow_section():
+            report = driver.run(trace)
+        forecast_s = time.perf_counter() - t1
+        q = report["quotaSatisfaction"]
+        counts = report["counts"]
+        self.forecast_count += 1
+        self.last_forecast_s = fork_s + forecast_s
+        return {
+            "mode": "capacity",
+            "sloWaitS": slo_wait_s,
+            "sloRisk": {
+                # Guaranteed demand that missed entirely, plus demand
+                # that landed but waited past the SLO.
+                "unboundGuaranteed": (
+                    q["submittedGuaranteed"] - q["boundGuaranteed"]
+                ),
+                "quotaSatisfaction": q["fraction"],
+                "queueWaitP99S": q["queueWaitP99S"],
+                "p99OverSlo": q["queueWaitP99S"] > slo_wait_s,
+                "waitingAtEnd": counts["waitingAtEnd"],
+            },
+            "counts": counts,
+            "preemption": report["preemption"],
+            "fragmentation": (report.get("fragmentation") or {}).get(
+                "endFreeSlices"
+            ),
+            "meta": self._meta(
+                fork, len(trace.get("events") or []),
+                float(trace.get("shape", {}).get("durationS") or 0.0),
+                fork_s, forecast_s,
+            ),
+        }
+
+    def _meta(self, fork, n_events, duration_s, fork_s, forecast_s) -> Dict:
+        """The run-varying section of a reply (wall costs, staleness) —
+        everything DELIBERATELY excluded from the deterministic
+        forecasts list."""
+        return {
+            "epochTotal": self.sched.core.epoch_total(),
+            "forkPods": fork.pod_count,
+            "horizonEvents": n_events,
+            "confidenceHorizonS": round(duration_s, 3),
+            "forkMs": round(fork_s * 1e3, 3),
+            "forecastMs": round(forecast_s * 1e3, 3),
+            # How stale the ANSWER is by the time the caller reads it:
+            # the age of the fork the forecast ran against (live state
+            # kept moving while the shadow replayed).
+            "stalenessS": (
+                round(time.monotonic() - self.last_fork_at, 3)
+                if self.last_fork_at is not None
+                else 0.0
+            ),
+        }
+
+    def metrics_snapshot(self) -> Dict:
+        out = dict(WHATIF_EMPTY_METRICS)
+        out.update(
+            {
+                "whatifForecastCount": self.forecast_count,
+                "whatifForecastGangCount": self.forecast_gang_count,
+                "whatifForkCount": self.fork_count,
+                "whatifAuditViolationCount": self.audit_violations,
+                "whatifForkPodCount": self.last_fork_pods,
+                "whatifForkAgeSeconds": (
+                    round(time.monotonic() - self.last_fork_at, 3)
+                    if self.last_fork_at is not None
+                    else -1.0
+                ),
+                "whatifForecastSeconds": round(self.last_forecast_s, 6),
+            }
+        )
+        return out
+
+
+# ------------------------------------------------------------------ #
+# Sim-tier integration (TraceDriver's mid-trace forecast sample)
+# ------------------------------------------------------------------ #
+
+
+def sim_sample(
+    driver,
+    now: float,
+    departures: List[Tuple[float, int, str]],
+    waiting_gangs,
+    verify_deterministic: bool = False,
+) -> Dict:
+    """Forecast the sim driver's CURRENT waiting queue against the known
+    departure horizon — the bench's forecast-vs-actual instrument
+    (HIVED_BENCH_WHATIF). ``departures`` is the driver's future-departure
+    heap (absolute trace times); the horizon replayed on the fork is
+    exactly those departures, shifted to be relative to ``now`` — future
+    SUBMITS are deliberately excluded (the scheduler cannot know them;
+    doc/hot-path.md records the resulting error as the honest null).
+
+    Returns {"t", "forecasts", "meta", "deterministic"}; with
+    ``verify_deterministic`` the whole forecast runs twice on two
+    independent forks and the forecast lists are asserted identical."""
+    plane = driver.sched.whatif
+    events = [
+        {
+            "t": max(0.0, t - now),
+            "kind": "depart",
+            "group": gname,
+            # The seq tiebreak keeps same-instant departures in the
+            # driver's own deterministic pop order.
+            "seq": seq,
+        }
+        for t, seq, gname in sorted(departures)
+    ]
+    duration_s = max([e["t"] for e in events], default=0.0)
+
+    def once() -> Tuple[List[Dict], Dict]:
+        t_fork = time.perf_counter()
+        fork = plane.build_fork(seed=0)
+        fork_s = time.perf_counter() - t_fork
+        gangs = []
+        for g in waiting_gangs:
+            pods = g.make_pods()
+            gangs.append(
+                _ForecastGang(g.name, g.vc, g.priority, pods)
+            )
+        t0 = time.perf_counter()
+        forecasts = plane.run_forecast(fork, gangs, events, duration_s)
+        dt = time.perf_counter() - t0
+        meta = plane._meta(fork, len(events), duration_s, fork_s, dt)
+        return forecasts, meta
+
+    forecasts, meta = once()
+    deterministic = None
+    if verify_deterministic:
+        again, _ = once()
+        deterministic = again == forecasts
+        if not deterministic:
+            common.log.error(
+                "whatif forecast NOT deterministic across repeated forks "
+                "at one snapshot epoch"
+            )
+    plane.forecast_count += 1
+    plane.forecast_gang_count += len(forecasts)
+    return {
+        "t": now,
+        "forecasts": forecasts,
+        "meta": meta,
+        "deterministic": deterministic,
+    }

@@ -37,7 +37,7 @@ from ..algorithm import compiler
 from ..api import constants, extender as ei
 from ..api.config import Config
 from ..scheduler.framework import HivedScheduler, NullKubeClient
-from ..scheduler.types import Node, Pod
+from ..scheduler.types import Node, Pod, apply_node_fault_event
 from . import fleet
 from .trace import TraceShape
 
@@ -238,9 +238,24 @@ class TraceDriver:
         frag_samples: int = 8,
         scheduler=None,
         fifo_retry: Optional[bool] = None,
+        prepare_nodes: bool = True,
+        whatif_at: Optional[float] = None,
+        whatif_verify: bool = False,
     ):
         self.mode = mode
         self.frag_samples = frag_samples
+        # Shadow what-if plane (scheduler.whatif, HIVED_BENCH_WHATIF):
+        # whatif_at is a trace-time FRACTION; when the replay clock
+        # crosses it, the current waiting queue is forecast against the
+        # known departure horizon on a snapshot fork and the result kept
+        # in self.whatif_sample (forecast-vs-actual is scored after the
+        # run from gang_bound_t). whatif_verify additionally runs the
+        # forecast twice on independent forks and records equality.
+        self._whatif_at = whatif_at
+        self._whatif_verify = whatif_verify
+        self.whatif_sample: Optional[Dict] = None
+        # gang name -> trace time it bound (the forecast's ground truth).
+        self.gang_bound_t: Dict[str, float] = {}
         # Retry-wake mode (doc/hot-path.md "Pending-pod plane"): indexed
         # by default; True (or HIVED_SIM_FIFO_RETRY=1) restores the FIFO
         # rescan of every waiter per capacity-freeing event.
@@ -286,10 +301,26 @@ class TraceDriver:
             self.core = self.sched.core
             self.nodes = sorted(self.core.configured_node_names())
         self._node_cache: Dict[str, Node] = {}
-        for n in self.nodes:
-            node = Node(name=n)
-            self._node_cache[n] = node
-            self.sched.add_node(node)
+        if prepare_nodes:
+            for n in self.nodes:
+                node = Node(name=n)
+                self._node_cache[n] = node
+                self.sched.add_node(node)
+        else:
+            # RESTORED-subject mode (a what-if shadow fork): the
+            # projection restore already carries the exact health state
+            # — re-adding every node as healthy would wipe it, and the
+            # fault verbs' node cache must mirror the restored health
+            # (a fresh-healthy baseline would HEAL restored badness on
+            # the first fault event; scheduler.whatif).
+            from ..scheduler.whatif import restored_node_baseline
+
+            for n in self.nodes:
+                self._node_cache[n] = (
+                    restored_node_baseline(self.core, n)
+                    if self.core is not None
+                    else Node(name=n)
+                )
 
     def _bound_pod(self, uid: str) -> Pod:
         """The assume-bound pod object for one scheduled uid, any mode
@@ -325,38 +356,11 @@ class TraceDriver:
         # index keeps its selectivity where the volume is.
         self._dirty_families.add(ALL_FAMILIES)
         old = self._node_cache[name]
-        annotations = dict(old.annotations)
-        ready = old.ready
-        kind = ev["kind"]
-        if kind == "node_flip":
-            ready = ev.get("to", "down") == "up"
-        elif kind in ("chip_fault", "chip_heal"):
-            bad: Set[str] = set(
-                x
-                for x in annotations.get(
-                    constants.ANNOTATION_NODE_DEVICE_HEALTH, ""
-                ).split(",")
-                if x
-            )
-            chip = str(ev.get("chip", 0))
-            if kind == "chip_fault":
-                bad.add(chip)
-            else:
-                bad.discard(chip)
-            if bad:
-                annotations[constants.ANNOTATION_NODE_DEVICE_HEALTH] = (
-                    ",".join(sorted(bad))
-                )
-            else:
-                annotations.pop(
-                    constants.ANNOTATION_NODE_DEVICE_HEALTH, None
-                )
-        elif kind == "drain_toggle":
-            if ev.get("on"):
-                annotations[constants.ANNOTATION_NODE_DRAIN] = "*"
-            else:
-                annotations.pop(constants.ANNOTATION_NODE_DRAIN, None)
-        new = Node(name=name, ready=ready, annotations=annotations)
+        # One shared fault vocabulary with the what-if horizon replay
+        # (scheduler.types.apply_node_fault_event).
+        new = apply_node_fault_event(old, ev)
+        if new is None:
+            return
         self._node_cache[name] = new
         self.sched.update_node(old, new)
 
@@ -460,6 +464,22 @@ class TraceDriver:
                 del live[gname]
                 self._mark_dirty_gang(g)
         return killed
+
+    def _take_whatif_sample(self, now: float, departures, waiting) -> None:
+        """Mid-trace what-if forecast of the whole waiting queue (inproc
+        subjects only — the plane forks the in-process scheduler)."""
+        if self.core is None:
+            return
+        from ..scheduler import whatif as whatif_mod
+
+        self.whatif_sample = whatif_mod.sim_sample(
+            self,
+            now,
+            list(departures),
+            list(waiting._order.values()),
+            verify_deterministic=self._whatif_verify,
+        )
+        self.whatif_sample["waitingCount"] = len(waiting)
 
     def retry_storm(self, rounds: int = 3) -> Dict:
         """Extender-style pending retries over the end-of-trace waiting
@@ -617,6 +637,7 @@ class TraceDriver:
             if not ok:
                 return False
             gang.bound_t = now
+            self.gang_bound_t[gang.name] = now
             # A fresh bind is a fresh potential preemption victim: dirty
             # the family so earlier-FIFO guaranteed waiters re-attempt at
             # the next wake (exactly what the FIFO rescan gives them).
@@ -660,8 +681,26 @@ class TraceDriver:
                     waiting.remove(gang.name)
             wake_wall_s += time.perf_counter() - t0
 
+        whatif_t = (
+            shape.duration_s * self._whatif_at
+            if self._whatif_at is not None
+            else None
+        )
         for ev in trace["events"]:
             t = float(ev["t"])
+            if (
+                whatif_t is not None
+                and self.whatif_sample is None
+                and t >= whatif_t
+            ):
+                # Sample BEFORE this event applies, with the departure
+                # heap untouched: unprocessed departures at t <= now
+                # replay on the fork at relative t=0, so the fork sees
+                # exactly the state+horizon the live replay will. The
+                # sample never mutates live state (audit-enforced) and
+                # never triggers wakes — the A/B fingerprint equality
+                # with a whatif-free replay is asserted by the bench.
+                self._take_whatif_sample(whatif_t, departures, waiting)
             while frag_i < len(frag_at) and frag_at[frag_i] <= t:
                 # Defrag beat first, so the sample reflects the compacted
                 # state this beat achieved (the A/B's measured quantity).

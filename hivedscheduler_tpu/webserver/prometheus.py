@@ -63,6 +63,10 @@ COUNTERS: Dict[str, tuple] = {
     "defragProposalCount": ("hived_defrag_proposals_total", "defragmenter migration proposals issued (drain handshake started)"),
     "defragMigrationCount": ("hived_defrag_migrations_total", "defragmenter migrations completed (gang re-placed off its fragment)"),
     "defragCancelCount": ("hived_defrag_cancels_total", "defragmenter proposals cancelled, their advisory reservation released"),
+    "whatifForecastCount": ("hived_whatif_forecasts_total", "what-if forecast requests served (shadow what-if plane)"),
+    "whatifForecastGangCount": ("hived_whatif_forecast_gangs_total", "per-gang forecasts produced across all what-if requests"),
+    "whatifForkCount": ("hived_whatif_forks_total", "shadow scheduler forks built from the live projection"),
+    "whatifAuditViolationCount": ("hived_whatif_audit_violations_total", "shadow-forecast threads caught attempting a LIVE-state mutation by the read-only-fork audit (should stay 0)"),
 }
 
 GAUGES: Dict[str, tuple] = {
@@ -76,6 +80,9 @@ GAUGES: Dict[str, tuple] = {
     "leader": ("hived_leader", "1 while this process holds (or needs no) leader lease, else 0"),
     "snapshotImportedPodCount": ("hived_snapshot_imported_pods", "bound pods bulk-imported from the snapshot at the last recovery"),
     "snapshotDeltaPodCount": ("hived_snapshot_delta_pods", "pods replayed or released as deltas past the snapshot at the last recovery"),
+    "whatifForkPodCount": ("hived_whatif_fork_pods", "pods restored into the most recent shadow fork"),
+    "whatifForkAgeSeconds": ("hived_whatif_fork_age_seconds", "seconds since the most recent shadow fork was built (forecast staleness; -1 before the first fork)"),
+    "whatifForecastSeconds": ("hived_whatif_forecast_seconds", "wall seconds of the most recent what-if forecast (fork + replay)"),
 }
 
 # get_metrics keys -> histogram family names.

@@ -189,6 +189,15 @@ def _make_handler(scheduler: HivedScheduler):
                     # to HTTP status codes.
                     result3 = scheduler.preempt_routine(args3)
                     self._reply(200, result3.to_dict())
+                elif path == constants.WHATIF_PATH:
+                    # Shadow what-if plane (scheduler.whatif): forecasts
+                    # run on a snapshot fork, never on live state (the
+                    # read-only audit raises otherwise); a transient
+                    # projection maps to 503 — retry.
+                    payload = scheduler.whatif_routine(
+                        self._parse_json(body)
+                    )
+                    self._reply(200, payload)
                 else:
                     raise api.not_found(f"Cannot found resource: {self.path}")
             except Exception as e:  # noqa: BLE001

@@ -324,3 +324,33 @@ def test_bench_pending_smoke():
     assert result["cache"]["wake_skipped"] == 0
     assert "refilter_speedup" in result and "gate_met" in result
     json.dumps(result)
+
+
+def test_bench_whatif_smoke():
+    """Smoke-sized variant of the HIVED_BENCH_WHATIF stage (ISSUE 14
+    CI/tooling satellite): the mid-trace what-if sample must forecast
+    EVERY waiting gang, deterministically across two independent forks,
+    without perturbing the live replay (placement fingerprints asserted
+    identical inside the stage) and with the read-only audit proven to
+    fence a live mutator. The forecast-vs-actual error quantities are
+    the 432-host driver stage's; CI boxes guard wiring + the asserts."""
+    result = bench.bench_whatif(
+        hosts=104, gangs=160, duration_s=1800.0,
+        mean_runtime_s=700.0, min_waiting=2, capacity_gangs=24,
+    )
+    assert_stage_meta(result)
+    assert result["fingerprints_identical"] is True
+    assert result["deterministic"] is True
+    assert result["audit_caught"] is True
+    assert result["deep_queue"] is True
+    assert result["forecasts"] == result["waiting_at_sample"] > 0
+    assert result["fork_pods"] > 0
+    assert result["fork_ms"] > 0 and result["forecast_ms"] > 0
+    # Forecast-vs-actual matched at least one gang at smoke scale, and
+    # the error is a finite non-negative number when it exists.
+    if result["matched"]:
+        assert result["median_abs_error_s"] >= 0.0
+    # The capacity-planning ride-along produced an SLO verdict.
+    risk = result["capacity"]["slo_risk"]
+    assert {"unboundGuaranteed", "p99OverSlo", "waitingAtEnd"} <= set(risk)
+    json.dumps(result)

@@ -405,6 +405,150 @@ def test_opportunistic_gang_grows():
         assert e.code == 400
 
 
+def test_guaranteed_gang_grows_into_quota_headroom():
+    """ISSUE 14 satellite (PR-10 recorded follow-on): a bounded gang at
+    GUARANTEED priority grows through the quota-gated intra-VC path —
+    the new member consumes VC quota in front of the safety checks and
+    extends the gang's virtual placement."""
+    sched, kube = booted(elastic_config(slices=1, solos=0))
+    bind_gang(
+        sched, kube, "gg", "A", 1, n_pods=2, chips=4, max_members=4
+    )
+    g = sched.core.affinity_groups["gg"]
+    assert g.virtual_placement is not None and g.total_pods == 2
+
+    group = {
+        "name": "gg",
+        "members": [{"podNumber": 2, "leafCellNumber": 4}],
+        "maxMembers": 4,
+    }
+    extra = make_pod("gg-2", "u-gg-2", "A", 1, "v5e-chip", 4, group=group)
+    sched.add_pod(extra)
+    nodes = sorted(sched.nodes)
+    r = sched.filter_routine(ei.ExtenderArgs(pod=extra, node_names=nodes))
+    assert r.node_names, r.failed_nodes
+    sched.bind_routine(
+        ei.ExtenderBindingArgs(
+            pod_name=extra.name, pod_namespace=extra.namespace,
+            pod_uid=extra.uid, node=r.node_names[0],
+        )
+    )
+    b = kube.bound["u-gg-2"]
+    b.phase = "Running"
+    sched.update_pod(extra, b)
+
+    g = sched.core.affinity_groups["gg"]
+    assert g.total_pods == 3
+    assert g.resize_generation == 1
+    # The grown row is GUARANTEED: it carries virtual cells (quota
+    # consumed in front of the safety checks), not an opportunistic row.
+    assert g.virtual_placement is not None
+    rows = g.virtual_placement[4]
+    assert len(rows) == 3
+    assert all(leaf is not None for leaf in rows[2])
+    assert sched.get_metrics()["gangGrowCount"] == 1
+    chaos.audit_invariants(sched, "post-guaranteed-grow")
+
+
+def test_pinned_gang_grows_inside_its_pinned_cell():
+    """A pinned guaranteed gang grows through its OWN pinned-cell
+    scheduler: the new member lands inside the pinned cell (operator
+    isolation), never in the VC's shared non-pinned quota."""
+    from hivedscheduler_tpu.scheduler.framework import (
+        HivedScheduler, NullKubeClient,
+    )
+    from .test_config_compiler import tpu_design_config
+
+    sched = HivedScheduler(
+        tpu_design_config(), kube_client=NullKubeClient(),
+        auto_admit=True, trace_sample=0.0,
+    )
+    for n in sched.core.configured_node_names():
+        sched.add_node(Node(name=n))
+    nodes = sorted(sched.nodes)
+    pinned_hosts = {f"v5p64-w{i}" for i in range(4)}
+    group = {
+        "name": "pg",
+        "members": [{"podNumber": 2, "leafCellNumber": 4}],
+        "maxMembers": 4,
+    }
+    for i in range(2):
+        p = make_pod(
+            f"pg-{i}", f"u-pg-{i}", "VC1", 1, "v5p-chip", 4,
+            group=group, pinned_cell_id="VC1-PIN-V5P16",
+        )
+        r = sched.filter_routine(ei.ExtenderArgs(pod=p, node_names=nodes))
+        assert r.node_names and r.node_names[0] in pinned_hosts, r
+    extra = make_pod(
+        "pg-2", "u-pg-2", "VC1", 1, "v5p-chip", 4,
+        group=group, pinned_cell_id="VC1-PIN-V5P16",
+    )
+    r = sched.filter_routine(ei.ExtenderArgs(pod=extra, node_names=nodes))
+    assert r.node_names, r.failed_nodes
+    assert r.node_names[0] in pinned_hosts, r.node_names
+    g = sched.core.affinity_groups["pg"]
+    assert g.total_pods == 3 and g.resize_generation == 1
+    assert all(
+        leaf is not None for row in g.virtual_placement[4] for leaf in row
+    )
+
+
+def test_guaranteed_grow_waits_when_quota_exhausted():
+    """Out of quota headroom => WAIT (a fixed-size gang would 400)."""
+    sched, kube = booted(elastic_config(slices=1, solos=0))
+    bind_gang(
+        sched, kube, "gg", "A", 1, n_pods=2, chips=4, max_members=4
+    )
+    bind_gang(sched, kube, "fill", "A", 1, n_pods=2, chips=4)
+    group = {
+        "name": "gg",
+        "members": [{"podNumber": 2, "leafCellNumber": 4}],
+        "maxMembers": 4,
+    }
+    extra = make_pod("gg-2", "u-gg-2", "A", 1, "v5e-chip", 4, group=group)
+    sched.add_pod(extra)
+    r = sched.filter_routine(
+        ei.ExtenderArgs(pod=extra, node_names=sorted(sched.nodes))
+    )
+    assert not r.node_names
+    assert constants.COMPONENT_NAME in (r.failed_nodes or {})
+    rec = sched.get_decision("u-gg-2")
+    assert rec["verdict"] == "wait"
+    chaos.audit_invariants(sched, "post-guaranteed-grow-wait")
+
+
+def test_guaranteed_grow_never_preempts():
+    """Quota headroom exists virtually, but the free physical capacity
+    is occupied by an opportunistic gang: the grow WAITS (free-capacity-
+    only, like the opportunistic grow) — it neither lazy-preempts nor
+    proposes victims, and the probe leaves no lazy-preempt residue."""
+    sched, kube = booted(elastic_config(slices=1, solos=0))
+    bind_gang(
+        sched, kube, "gg", "A", 1, n_pods=2, chips=4, max_members=4
+    )
+    # Opportunistic occupant of the remaining 2 hosts.
+    bind_gang(sched, kube, "opp", "A", -1, n_pods=2, chips=4)
+    group = {
+        "name": "gg",
+        "members": [{"podNumber": 2, "leafCellNumber": 4}],
+        "maxMembers": 4,
+    }
+    extra = make_pod("gg-2", "u-gg-2", "A", 1, "v5e-chip", 4, group=group)
+    sched.add_pod(extra)
+    r = sched.filter_routine(
+        ei.ExtenderArgs(pod=extra, node_names=sorted(sched.nodes))
+    )
+    assert not r.node_names, r.node_names
+    # WAIT (component-only failed nodes), not a preemption proposal.
+    assert set(r.failed_nodes or {}) == {constants.COMPONENT_NAME}
+    opp = sched.core.affinity_groups["opp"]
+    assert opp.total_pods == 2
+    # No lazy-preempt residue: the occupant keeps its (absent) virtual
+    # placement and its cells stay USED by it.
+    assert opp.virtual_placement is None
+    chaos.audit_invariants(sched, "post-guaranteed-grow-no-preempt")
+
+
 def test_grow_pod_replaying_first_rebuilds_grown_gang():
     """Regression (review finding): a restart that replays the GROW pod
     FIRST must rebuild the grown gang — the bind info's rows are the

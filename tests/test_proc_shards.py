@@ -294,6 +294,129 @@ def test_sharded_frontend_equals_single_process_local():
         _close(front)
 
 
+def test_untyped_sweep_probes_in_leaf_type_order():
+    """ISSUE 14 satellite (PR-8 recorded follow-on): the cross-family
+    untyped sweep is LEAF-TYPE-GRANULAR — the probe order is the global
+    sorted leaf-type order, not shard-major. 3 families on 2 shards
+    interleave (shard0: cc0,cc2; shard1: cc1); with cc0 full, the
+    in-process scan places on cc1, which the old shard-major sweep
+    would have skipped in favor of shard0's cc2."""
+    front = ShardedScheduler(
+        bench.build_concurrent_config(3, 4),
+        kube_client=NullKubeClient(),
+        n_shards=2, transport="local", auto_admit=True,
+    )
+    single = HivedScheduler(
+        bench.build_concurrent_config(3, 4),
+        kube_client=NullKubeClient(), auto_admit=True,
+    )
+    try:
+        # The chunking interleaves shards: the deviation scenario.
+        assert front._sweep_chunks == [
+            (0, ("cc0-chip",)), (1, ("cc1-chip",)), (0, ("cc2-chip",)),
+        ]
+        nodes = sorted(single.core.configured_node_names())
+        for n in nodes:
+            front.add_node(Node(name=n))
+            single.add_node(Node(name=n))
+
+        def fill_cc0(sched):
+            for j in range(4):
+                pod = make_pod(
+                    f"f{j}", f"uf{j}", "vc0", -1, "cc0-chip", 4,
+                    group={"name": f"fg{j}", "members": [
+                        {"podNumber": 1, "leafCellNumber": 4}]},
+                )
+                r = sched.filter_routine(
+                    ei.ExtenderArgs(pod=pod, node_names=nodes)
+                )
+                assert r.node_names
+
+        fill_cc0(front)
+        fill_cc0(single)
+        # Untyped opportunistic pods: the cross-family sweep. Every
+        # placement must match the in-process scan's, which probes
+        # cc1-chip (shard1) BEFORE cc2-chip (shard0).
+        placements = []
+        for j in range(6):
+            pod_f = make_pod(
+                f"u{j}", f"uu{j}", "vc0", -1, None, 4,
+                group={"name": f"ug{j}", "members": [
+                    {"podNumber": 1, "leafCellNumber": 4}]},
+            )
+            pod_s = make_pod(
+                f"u{j}", f"uu{j}", "vc0", -1, None, 4,
+                group={"name": f"ug{j}", "members": [
+                    {"podNumber": 1, "leafCellNumber": 4}]},
+            )
+            rf = front.filter_routine(
+                ei.ExtenderArgs(pod=pod_f, node_names=nodes)
+            )
+            rs = single.filter_routine(
+                ei.ExtenderArgs(pod=pod_s, node_names=nodes)
+            )
+            assert rf.node_names == rs.node_names, (j, rf, rs)
+            placements.append(rf.node_names[0])
+        # cc1 (leaf-type order) fills BEFORE cc2 — the placements the
+        # old shard-major order would have put on cc2 first.
+        assert all(n.startswith("cc1-") for n in placements[:4]), placements
+        assert all(n.startswith("cc2-") for n in placements[4:]), placements
+    finally:
+        _close(front)
+
+
+def test_untyped_sweep_all_wait_matches_single_process():
+    """Every family full: the sweep's WAIT verdict (and that it remains
+    a wait, not an error) matches the in-process scan."""
+    front = ShardedScheduler(
+        bench.build_concurrent_config(2, 4),
+        kube_client=NullKubeClient(),
+        n_shards=2, transport="local", auto_admit=True,
+    )
+    single = HivedScheduler(
+        bench.build_concurrent_config(2, 4),
+        kube_client=NullKubeClient(), auto_admit=True,
+    )
+    try:
+        nodes = sorted(single.core.configured_node_names())
+        for n in nodes:
+            front.add_node(Node(name=n))
+            single.add_node(Node(name=n))
+        for fam in range(2):
+            for j in range(4):
+                for sched in (front, single):
+                    pod = make_pod(
+                        f"f{fam}-{j}", f"uf{fam}-{j}", f"vc{fam}", -1,
+                        f"cc{fam}-chip", 4,
+                        group={"name": f"fg{fam}-{j}", "members": [
+                            {"podNumber": 1, "leafCellNumber": 4}]},
+                    )
+                    r = sched.filter_routine(
+                        ei.ExtenderArgs(pod=pod, node_names=nodes)
+                    )
+                    assert r.node_names
+        w_f = make_pod(
+            "w", "uw", "vc0", -1, None, 4,
+            group={"name": "wg", "members": [
+                {"podNumber": 1, "leafCellNumber": 4}]},
+        )
+        w_s = make_pod(
+            "w", "uw", "vc0", -1, None, 4,
+            group={"name": "wg", "members": [
+                {"podNumber": 1, "leafCellNumber": 4}]},
+        )
+        rf = front.filter_routine(
+            ei.ExtenderArgs(pod=w_f, node_names=nodes)
+        )
+        rs = single.filter_routine(
+            ei.ExtenderArgs(pod=w_s, node_names=nodes)
+        )
+        assert not rf.node_names and not rs.node_names
+        assert set(rf.failed_nodes) == set(rs.failed_nodes)
+    finally:
+        _close(front)
+
+
 @pytest.fixture(scope="module")
 def proc_front():
     """One real-process frontend shared by the proc-boundary tests

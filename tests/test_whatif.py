@@ -1,0 +1,554 @@
+"""Shadow what-if plane (ISSUE 14; scheduler.whatif): snapshot-forked
+admission forecasts with promised ETAs.
+
+Covers the tentpole's correctness surface:
+
+- **Determinism** — same snapshot + same horizon trace => bit-identical
+  forecasts across repeated calls (independent forks each time);
+- **Read-only audit, with teeth** — a shadow fork wired (by deliberate
+  fault injection) to the LIVE scheduler is CAUGHT: the forecast raises
+  ShadowWriteError instead of mutating served state, and the violation
+  is counted (the sensitivity meta-test of the read-only contract);
+- **ETAs** — a quota-blocked gang is promised exactly the horizon step
+  that frees its quota; no such step => verdict "blocked" carrying the
+  blocking gate from its rejection certificate;
+- **Victim sets** — a guaranteed gang that would preempt reports the
+  real victim pods (the fork runs the production preemption protocol),
+  while the live opportunistic victim stays untouched;
+- **predictedWaitS stamping** — queue mode stamps the forecast onto
+  each gang's decision-journal WAIT record;
+- **Fork relaxation** — the flusher's durability gate (confirmed-BOUND)
+  does not block a fork: assume-bound (BINDING) state is exported;
+- **Serving** — POST /v1/inspect/whatif end to end, and the procShards
+  frontend's aggregated queue forecast (each gang exactly once).
+"""
+
+import json
+import logging
+import urllib.request
+
+import pytest
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.api import constants, extender as ei
+from hivedscheduler_tpu.scheduler import whatif as whatif_mod
+from hivedscheduler_tpu.scheduler.framework import (
+    HivedScheduler,
+    NullKubeClient,
+)
+from hivedscheduler_tpu.scheduler.shards import ShardedScheduler
+from hivedscheduler_tpu.scheduler.types import Node
+from hivedscheduler_tpu.sim import fleet
+from hivedscheduler_tpu.webserver.server import WebServer
+
+from .test_core import make_pod
+
+common.init_logging(logging.CRITICAL)
+
+
+def small_config():
+    """2 v5p cubes + 2 v5e slices + 2 solos; prod holds 1 cube + 1
+    slice, research holds sub-cubes + slices + solos."""
+    return fleet.build_config(cubes=2, slices=2, solos=2)
+
+
+def new_scheduler(config=None) -> HivedScheduler:
+    sched = HivedScheduler(
+        config if config is not None else small_config(),
+        kube_client=NullKubeClient(),
+        trace_sample=0.0,
+        auto_admit=True,
+    )
+    for name in sched.core.configured_node_names():
+        sched.add_node(Node(name=name))
+    return sched
+
+
+def gang(name, n_pods, chips):
+    return {
+        "name": name,
+        "members": [{"podNumber": n_pods, "leafCellNumber": chips}],
+    }
+
+
+def place_gang(sched, name, vc, n_pods, chips, priority=0,
+               leaf="v5p-chip", lazy_preemption=False):
+    pods = [
+        make_pod(
+            f"{name}-{i}", f"{name}-u{i}", vc, priority, leaf, chips,
+            group=gang(name, n_pods, chips),
+            lazy_preemption=lazy_preemption,
+        )
+        for i in range(n_pods)
+    ]
+    for p in pods:
+        r = sched.filter_routine(
+            ei.ExtenderArgs(pod=p, node_names=sorted(sched.nodes))
+        )
+        assert r.node_names, (name, r.failed_nodes, r.error)
+    return pods
+
+
+def wait_gang(sched, name, vc, n_pods, chips, priority=0, leaf="v5p-chip"):
+    """Submit a gang expected to WAIT; returns its pods."""
+    pods = [
+        make_pod(
+            f"{name}-{i}", f"{name}-u{i}", vc, priority, leaf, chips,
+            group=gang(name, n_pods, chips),
+        )
+        for i in range(n_pods)
+    ]
+    r = sched.filter_routine(
+        ei.ExtenderArgs(pod=pods[0], node_names=sorted(sched.nodes))
+    )
+    assert not r.node_names, (name, r.node_names)
+    return pods
+
+
+def quota_blocked_scene():
+    """prod's whole v5p quota (1 cube) used by g1; g2 (same shape)
+    waits on vcQuota."""
+    sched = new_scheduler()
+    place_gang(sched, "g1", "prod", 16, 4)
+    wait_gang(sched, "g2", "prod", 16, 4)
+    return sched
+
+
+DEPART_G1 = {
+    "events": [{"t": 120.0, "kind": "depart", "group": "g1"}],
+    "durationS": 600.0,
+}
+
+
+# --------------------------------------------------------------------- #
+# 1. Forecast semantics: ETAs, gates, victims
+# --------------------------------------------------------------------- #
+
+
+def test_quota_blocked_gang_promised_departure_eta():
+    sched = quota_blocked_scene()
+    out = sched.whatif_routine({"queue": True, "horizon": DEPART_G1})
+    assert out["mode"] == "queue"
+    (f,) = out["forecasts"]
+    assert f["gang"] == "g2"
+    assert f["verdict"] == whatif_mod.VERDICT_SCHEDULE
+    assert f["predictedWaitS"] == 120.0
+    assert f["blockingGate"] == "vcQuota"
+    assert f["preemption"] is None
+    assert out["meta"]["forkPods"] == 16
+    # The live scheduler still has g1 placed and g2 waiting: the whole
+    # forecast ran on the fork.
+    assert "g1" in sched.core.affinity_groups
+    assert "g2" not in sched.core.affinity_groups
+
+
+def test_blocked_beyond_horizon_carries_gate():
+    sched = quota_blocked_scene()
+    out = sched.whatif_routine(
+        {"queue": True, "horizon": {"events": [], "durationS": 300.0}}
+    )
+    (f,) = out["forecasts"]
+    assert f["verdict"] == whatif_mod.VERDICT_BLOCKED
+    assert f["predictedWaitS"] is None
+    assert f["blockingGate"] == "vcQuota"
+
+
+def test_spec_mode_hypothetical_gang():
+    sched = new_scheduler()
+    # Fits now: empty fleet.
+    out = sched.whatif_routine(
+        {"spec": {"name": "hyp", "vc": "prod", "leafType": "v5p-chip",
+                  "pods": 4, "chips": 4, "priority": 0}}
+    )
+    (f,) = out["forecasts"]
+    assert f["verdict"] == whatif_mod.VERDICT_SCHEDULE
+    assert f["predictedWaitS"] == 0.0
+    assert f["blockingGate"] is None
+    # Oversized for prod's quota: blocked, and the live scheduler never
+    # saw the hypothetical pods.
+    out2 = sched.whatif_routine(
+        {"spec": {"name": "hyp2", "vc": "prod", "leafType": "v5p-chip",
+                  "pods": 32, "chips": 4, "priority": 0}}
+    )
+    (f2,) = out2["forecasts"]
+    assert f2["verdict"] == whatif_mod.VERDICT_BLOCKED
+    assert not [
+        u for u in sched.pod_schedule_statuses if u.startswith("hyp")
+    ]
+
+
+def test_guaranteed_forecast_reports_real_victims():
+    sched = new_scheduler()
+    # An opportunistic gang occupies physical capacity in prod's quota
+    # space; a guaranteed gang of the same shape must preempt it.
+    place_gang(
+        sched, "opp", "prod", 16, 4, priority=-1, lazy_preemption=True,
+    )
+    # Fill the rest of the v5p chain so a victim-free placement cannot
+    # exist: the second cube goes to research sub-cubes.
+    place_gang(sched, "res", "research", 16, 4, leaf="v5p-chip")
+    wait_gang(sched, "want", "prod", 16, 4, priority=5)
+    out = sched.whatif_routine({"queue": True})
+    (f,) = out["forecasts"]
+    assert f["gang"] == "want"
+    assert f["verdict"] == whatif_mod.VERDICT_SCHEDULE
+    assert f["predictedWaitS"] == 0.0
+    assert f["preemption"] is not None
+    victim_groups = {v["group"] for v in f["preemption"]["victims"]}
+    assert victim_groups == {"opp"}
+    assert f["preemption"]["victimPods"] == 16
+    # Live state untouched: opp is still allocated, want still waiting.
+    assert "opp" in sched.core.affinity_groups
+    assert "want" not in sched.core.affinity_groups
+
+
+def test_queue_mode_stamps_predicted_wait_on_decisions():
+    sched = quota_blocked_scene()
+    sched.whatif_routine({"queue": True, "horizon": DEPART_G1})
+    rec = sched.get_decision("g2-u0")
+    assert rec["verdict"] == "wait"
+    assert rec["predictedWaitS"] == 120.0
+    assert rec["predictedWaitHorizonS"] == 600.0
+    # Blocked stamps None (beyond horizon), not a number.
+    sched2 = quota_blocked_scene()
+    sched2.whatif_routine(
+        {"queue": True, "horizon": {"events": [], "durationS": 60.0}}
+    )
+    rec2 = sched2.get_decision("g2-u0")
+    assert rec2["predictedWaitS"] is None
+    assert rec2["predictedWaitHorizonS"] == 60.0
+
+
+def test_drain_horizon_blocks_forecast():
+    """A horizon that drains every v5p host keeps the waiter blocked
+    even after its quota frees: horizon faults flow through the real
+    node-update verbs (the buddy mapping cannot land on drained
+    chips)."""
+    sched = quota_blocked_scene()
+    v5p_nodes = sorted(
+        n for n in sched.core.configured_node_names()
+        if n.startswith("v5p-")
+    )
+    events = [
+        {"t": 60.0, "kind": "drain_toggle", "node": n, "on": True}
+        for n in v5p_nodes
+    ] + [{"t": 120.0, "kind": "depart", "group": "g1"}]
+    out = sched.whatif_routine(
+        {"queue": True, "horizon": {"events": events, "durationS": 600.0}}
+    )
+    (f,) = out["forecasts"]
+    assert f["verdict"] == whatif_mod.VERDICT_BLOCKED, f
+
+
+def test_horizon_fault_applies_over_restored_health_not_fresh_nodes():
+    """A horizon fault event on a node with RESTORED health state (live
+    drains here) must apply as a delta over that state — a fresh-healthy
+    node baseline would silently lift the drain and promise phantom
+    capacity (optimistic forecasts, the forbidden direction)."""
+    sched = quota_blocked_scene()
+    v5p_nodes = sorted(
+        n for n in sched.core.configured_node_names()
+        if n.startswith("v5p-")
+    )
+    for n in v5p_nodes:
+        sched.update_node(
+            Node(name=n),
+            Node(
+                name=n,
+                annotations={constants.ANNOTATION_NODE_DRAIN: "*"},
+            ),
+        )
+    events = [{"t": 60.0, "kind": "depart", "group": "g1"}] + [
+        # Chip heals are no-op deltas here — but on a fresh baseline
+        # they would REBUILD each node without its drain annotation.
+        {"t": 90.0, "kind": "chip_heal", "node": n, "chip": 0}
+        for n in v5p_nodes
+    ]
+    out = sched.whatif_routine(
+        {"queue": True, "horizon": {"events": events, "durationS": 300.0}}
+    )
+    (f,) = out["forecasts"]
+    assert f["verdict"] == whatif_mod.VERDICT_BLOCKED, f
+
+
+def test_forecast_placed_gang_is_preemptible_by_later_forecast_gang():
+    """A gang the FORECAST itself placed on the fork must be killable by
+    a later forecast gang's preemption — probe pods carry synthetic
+    uids, so placed gangs are registered in the fork's group index; an
+    unregistered victim would leave the guaranteed gang falsely
+    'blocked'."""
+    sched = new_scheduler()
+    place_gang(sched, "g1", "prod", 16, 4)                     # cube A
+    place_gang(sched, "r1", "research", 16, 4, leaf="v5p-chip")  # cube B
+    # FIFO queue: an opportunistic waiter first, then a guaranteed one
+    # at g1's OWN priority (so it cannot just preempt g1 at t=0 — its
+    # only victims will be whatever the forecast places before it).
+    wait_gang(sched, "oppw", "prod", 16, 4, priority=-1)
+    wait_gang(sched, "gw", "prod", 16, 4, priority=0)
+    out = sched.whatif_routine(
+        {
+            "queue": True,
+            "horizon": {
+                "events": [
+                    {"t": 100.0, "kind": "depart", "group": "g1"}
+                ],
+                "durationS": 600.0,
+            },
+        }
+    )
+    by_name = {f["gang"]: f for f in out["forecasts"]}
+    # oppw places first (FIFO) into the freed cube; gw then preempts it.
+    assert by_name["oppw"]["verdict"] == whatif_mod.VERDICT_SCHEDULE
+    gw = by_name["gw"]
+    assert gw["verdict"] == whatif_mod.VERDICT_SCHEDULE, gw
+    assert gw["predictedWaitS"] == 100.0
+    assert gw["preemption"] is not None
+    assert {v["group"] for v in gw["preemption"]["victims"]} == {"oppw"}
+
+
+def test_heterogeneous_gang_probed_per_member():
+    """A gang whose member entries differ in leafCellNumber must be
+    probed with per-member probe pods (one rewritten spec per entry),
+    not N clones of one representative — the clone approach trips the
+    over-configured-size 400 on the fork."""
+    sched = new_scheduler()
+    place_gang(sched, "block", "prod", 4, 4, leaf="v5e-chip")
+    hetero = {
+        "name": "het",
+        "members": [
+            {"podNumber": 2, "leafCellNumber": 4},
+            {"podNumber": 1, "leafCellNumber": 2},
+        ],
+    }
+    p0 = make_pod(
+        "het-0", "het-u0", "prod", 0, "v5e-chip", 4, group=hetero
+    )
+    r = sched.filter_routine(
+        ei.ExtenderArgs(pod=p0, node_names=sorted(sched.nodes))
+    )
+    assert not r.node_names
+    out = sched.whatif_routine(
+        {
+            "queue": True,
+            "horizon": {
+                "events": [
+                    {"t": 90.0, "kind": "depart", "group": "block"}
+                ],
+                "durationS": 300.0,
+            },
+        }
+    )
+    (f,) = out["forecasts"]
+    assert f["gang"] == "het"
+    assert f["members"] == 3
+    assert f["verdict"] == whatif_mod.VERDICT_SCHEDULE
+    assert f["predictedWaitS"] == 90.0
+
+
+# --------------------------------------------------------------------- #
+# 2. Determinism
+# --------------------------------------------------------------------- #
+
+
+def test_forecast_deterministic_across_repeated_calls():
+    """Same snapshot epoch + same horizon => bit-identical forecasts,
+    each call on an independent fork."""
+    sched = new_scheduler()
+    place_gang(sched, "g1", "prod", 16, 4)
+    place_gang(sched, "o1", "research", 4, 4, leaf="v5e-chip")
+    wait_gang(sched, "g2", "prod", 16, 4)
+    wait_gang(sched, "g3", "prod", 16, 4, priority=3)
+    horizon = {
+        "events": [
+            {"t": 50.0, "kind": "depart", "group": "o1"},
+            {"t": 120.0, "kind": "depart", "group": "g1"},
+        ],
+        "durationS": 600.0,
+    }
+    outs = [
+        sched.whatif_routine({"queue": True, "horizon": horizon})
+        for _ in range(3)
+    ]
+    assert outs[0]["forecasts"] == outs[1]["forecasts"] == outs[2]["forecasts"]
+    # JSON-serializable (the webserver contract) and fully ordered.
+    json.dumps(outs[0]["forecasts"])
+
+
+# --------------------------------------------------------------------- #
+# 3. The read-only audit (sensitivity meta-test)
+# --------------------------------------------------------------------- #
+
+
+def test_shadow_fork_mutating_live_state_is_caught(monkeypatch):
+    """Deliberate fault injection: wire the 'fork' to the LIVE scheduler
+    and prove the audit catches the first mutation attempt instead of
+    letting the forecast corrupt served state."""
+    sched = quota_blocked_scene()
+    plane = sched.whatif
+    evil = whatif_mod.ShadowFork(sched, {"pods": []})
+    monkeypatch.setattr(plane, "build_fork", lambda seed=0: evil)
+    groups_before = set(sched.core.affinity_groups)
+    with pytest.raises(whatif_mod.ShadowWriteError):
+        plane.serve({"queue": True, "horizon": DEPART_G1})
+    assert set(sched.core.affinity_groups) == groups_before
+    assert plane.metrics_snapshot()["whatifAuditViolationCount"] >= 1
+
+
+def test_audit_guard_survives_core_replacement():
+    """Recovery paths replace the core object; the plane re-arms the
+    guard on every forecast, so the teeth survive."""
+    sched = quota_blocked_scene()
+    plane = sched.whatif
+    # Simulate what _reset_for_full_replay does: a fresh core object.
+    sched.core.write_guard = None
+    plane.build_fork()  # any forecast entry re-arms
+    assert sched.core.write_guard is not None
+    with pytest.raises(whatif_mod.ShadowWriteError):
+        with plane.shadow_section():
+            sched.health_tick()
+
+
+def test_direct_core_mutation_from_shadow_section_is_caught():
+    sched = quota_blocked_scene()
+    plane = sched.whatif
+    plane.build_fork()
+    with pytest.raises(whatif_mod.ShadowWriteError):
+        with plane.shadow_section():
+            sched.core.bump_chain_epoch(
+                next(iter(sched.core.chain_epochs))
+            )
+
+
+# --------------------------------------------------------------------- #
+# 4. Fork construction (the relaxed snapshot walk)
+# --------------------------------------------------------------------- #
+
+
+def test_fork_body_exports_assume_bound_state():
+    """Sim-mode pods never confirm BOUND, so the flusher's durable
+    export refuses — but the fork export accepts BINDING state."""
+    sched = new_scheduler()
+    place_gang(sched, "g1", "prod", 16, 4)
+    assert sched.export_snapshot() is None  # durability gate holds
+    body = sched.export_fork_body()
+    assert body is not None
+    assert len(body["pods"]) == 16
+    # And the flusher's per-pod export memo was not seeded by the fork.
+    assert sched._snapshot_pod_export_cache == {}
+
+
+def test_fork_restores_projection_without_node_adds():
+    sched = quota_blocked_scene()
+    fork = sched.whatif.build_fork()
+    assert fork.pod_count == 16
+    assert "g1" in fork.sched.core.affinity_groups
+    # The fork's free capacity equals the live free capacity.
+    assert (
+        fork.sched.core.free_slice_distribution()
+        == sched.core.free_slice_distribution()
+    )
+
+
+def test_whatif_metrics_keys_always_present():
+    sched = new_scheduler()
+    m = sched.get_metrics()
+    assert m["whatifForecastCount"] == 0
+    assert m["whatifForkAgeSeconds"] == -1.0
+    sched.whatif_routine({"queue": True})
+    m2 = sched.get_metrics()
+    assert m2["whatifForecastCount"] == 1
+    assert m2["whatifForkCount"] == 1
+    assert m2["whatifForkAgeSeconds"] >= 0.0
+
+
+# --------------------------------------------------------------------- #
+# 5. Serving: HTTP endpoint + shards aggregation
+# --------------------------------------------------------------------- #
+
+
+def _post(server, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_whatif_http_endpoint():
+    sched = quota_blocked_scene()
+    server = WebServer(sched, address="127.0.0.1:0")
+    server.start()
+    try:
+        code, out = _post(
+            server, constants.WHATIF_PATH,
+            {"queue": True, "horizon": DEPART_G1},
+        )
+        assert code == 200
+        assert out["forecasts"][0]["predictedWaitS"] == 120.0
+        with pytest.raises(urllib.request.HTTPError):
+            _post(server, constants.WHATIF_PATH, {"nonsense": 1})
+    finally:
+        server.stop()
+
+
+def test_sharded_whatif_aggregates_each_gang_once():
+    front = ShardedScheduler(
+        small_config(),
+        kube_client=NullKubeClient(),
+        n_shards=2,
+        transport="local",
+        auto_admit=True,
+    )
+    try:
+        nodes = front.configured_node_names()
+        for n in nodes:
+            front.add_node(Node(name=n))
+        # Fill prod's quota in both chain families (v5p and v5e live in
+        # different families => different shards), then add one waiting
+        # gang per family.
+        assert place_gang_front(front, "p0", "prod", 16, 4, "v5p-chip")
+        assert place_gang_front(front, "e0", "prod", 4, 4, "v5e-chip")
+        wp = make_pod(
+            "wp-0", "wp-u0", "prod", 0, "v5p-chip", 4,
+            group=gang("wp", 16, 4),
+        )
+        we = make_pod(
+            "we-0", "we-u0", "prod", 0, "v5e-chip", 4,
+            group=gang("we", 4, 4),
+        )
+        for pod in (wp, we):
+            r = front.filter_routine(
+                ei.ExtenderArgs(pod=pod, node_names=sorted(nodes))
+            )
+            assert not r.node_names
+        out = front.whatif_routine({"queue": True})
+        names = [f["gang"] for f in out["forecasts"]]
+        assert sorted(names) == ["we", "wp"]
+        assert len(names) == len(set(names))
+        assert out["meta"]["shards"] == 2
+        # The MERGED forecast (not any shard-local verdict) is what the
+        # journal carries: both waiting gangs' WAIT records are stamped.
+        by_name = {f["gang"]: f for f in out["forecasts"]}
+        for uid, gname in (("wp-u0", "wp"), ("we-u0", "we")):
+            rec = front.get_decision(uid)
+            assert rec["verdict"] == "wait"
+            assert "predictedWaitS" in rec
+            assert rec["predictedWaitS"] == by_name[gname]["predictedWaitS"]
+    finally:
+        front.close()
+
+
+def place_gang_front(front, name, vc, n_pods, chips, leaf):
+    nodes = sorted(front.configured_node_names())
+    for i in range(n_pods):
+        p = make_pod(
+            f"{name}-{i}", f"{name}-u{i}", vc, 0, leaf, chips,
+            group=gang(name, n_pods, chips),
+        )
+        r = front.filter_routine(ei.ExtenderArgs(pod=p, node_names=nodes))
+        if not r.node_names:
+            return False
+    return True
