@@ -326,6 +326,164 @@ def bench_tracing_ab(
     }, 16 * cubes + 4 * slices + solos, t0)
 
 
+def bench_audit(
+    cubes: int = 16,
+    slices: int = 40,
+    solos: int = 16,
+    n_gangs: int = 240,
+    reps: int = 3,
+    replay_hosts: int = 432,
+    replay_gangs: int = 140,
+    replay_seed: int = 3,
+) -> dict:
+    """Black-box plane acceptance stage (HIVED_BENCH_AUDIT=1;
+    doc/hot-path.md "Black-box plane"): two parts.
+
+    **Overhead A/B** — gang-schedule p50 at the 432-host fleet with the
+    live invariant auditor and flight recorder at DEFAULT cadence vs both
+    off, interleaved reps (shared-machine noise), medians, gated against
+    the PR-6 ≤3% budget; auditor-only and recorder-only sides isolate
+    each mechanism's share.
+
+    **Capture→replay** (asserted, not just recorded) — a seeded burst
+    trace with faults and preemption pressure runs through TraceDriver
+    with the recorder armed; the captured window must contain at least
+    one preemption and REPLAY FINGERPRINT-IDENTICALLY through the
+    what-if-fork restore path (`--replay-recording`'s engine). This is
+    the "a captured incident is a deterministic repro" acceptance."""
+    from hivedscheduler_tpu.scheduler.recorder import (
+        recording_fingerprint, replay_recording,
+    )
+    from hivedscheduler_tpu.sim.driver import (
+        TraceDriver, build_fleet_config,
+    )
+    from hivedscheduler_tpu.sim.trace import TraceShape, generate_trace
+
+    t0 = time.perf_counter()
+    # The stage A/Bs the mechanisms via CONFIG knobs; ambient env
+    # hatches (HIVED_FLIGHT_RECORDER=0 / HIVED_LIVE_AUDIT=0 /
+    # HIVED_AUDIT_INTERVAL_TICKS) would silently blank a side — or
+    # crash the capture below on a None recorder — so pin them for the
+    # stage's duration and restore after.
+    _saved_env = {
+        k: os.environ.pop(k, None)
+        for k in ("HIVED_FLIGHT_RECORDER", "HIVED_LIVE_AUDIT",
+                  "HIVED_AUDIT_INTERVAL_TICKS")
+    }
+    try:
+        return _bench_audit_inner(
+            cubes, slices, solos, n_gangs, reps,
+            replay_hosts, replay_gangs, replay_seed, t0,
+            TraceDriver, build_fleet_config, TraceShape, generate_trace,
+            recording_fingerprint, replay_recording,
+        )
+    finally:
+        for k, v in _saved_env.items():
+            if v is not None:
+                os.environ[k] = v
+
+
+def _bench_audit_inner(
+    cubes, slices, solos, n_gangs, reps,
+    replay_hosts, replay_gangs, replay_seed, t0,
+    TraceDriver, build_fleet_config, TraceShape, generate_trace,
+    recording_fingerprint, replay_recording,
+) -> dict:
+
+    def cfg(audit: bool, recorder: bool) -> Config:
+        c = build_config(cubes, slices, solos)
+        if not audit:
+            c.audit_interval_ticks = 0
+        if not recorder:
+            c.flight_recorder_capacity = 0
+        return c
+
+    sides = {
+        "off": (False, False),
+        "audit_only": (True, False),
+        "recorder_only": (False, True),
+        "on": (True, True),
+    }
+    p50s: dict = {k: [] for k in sides}
+    last_on_sched = None
+    for _ in range(reps):
+        for name, (audit, recorder) in sides.items():
+            p50, _p99, _n, sched, _live, _pps = run(
+                n_gangs=n_gangs, config=cfg(audit, recorder),
+                trace_sample=0.0,
+            )
+            p50s[name].append(p50)
+            if name == "on":
+                last_on_sched = sched
+    med = {k: statistics.median(v) for k, v in p50s.items()}
+    overhead_pct = (
+        (med["on"] / med["off"] - 1.0) * 100.0 if med["off"] else 0.0
+    )
+    on_metrics = (
+        last_on_sched.get_metrics() if last_on_sched is not None else {}
+    )
+
+    # -- capture -> replay (asserted) --------------------------------- #
+    shape = TraceShape(
+        hosts=replay_hosts,
+        gangs=replay_gangs,
+        duration_s=1800.0,
+        pattern="burst",
+        burst_fraction=0.6,
+        opportunistic_fraction=0.4,
+        mean_runtime_s=700.0,
+        fault_events=12,
+    )
+    trace = generate_trace(replay_seed, shape)
+    config, actual_hosts = build_fleet_config(replay_hosts)
+    config.flight_recorder_capacity = 1 << 18  # one window, whole run
+    driver = TraceDriver(config)
+    driver.sched.recorder.hosts = actual_hosts
+    live_report = driver.run(trace)
+    recording = driver.sched.recorder.recording()
+    driver.close()
+    assert live_report["counts"]["preemptionEvents"] >= 1, (
+        "replay-acceptance trace produced no preemption; the repro "
+        "claim would be untested", live_report["counts"],
+    )
+    assert live_report["counts"]["faultsApplied"] >= 1
+    replay = replay_recording(recording, build_fleet_config(replay_hosts)[0])
+    assert replay["identical"], (
+        "flight recording did NOT replay fingerprint-identically",
+        replay["liveFingerprint"], replay["replayFingerprint"],
+    )
+
+    return _stage_meta({
+        "gangs": n_gangs,
+        "reps": reps,
+        "p50_off_ms": round(med["off"], 3),
+        "p50_audit_only_ms": round(med["audit_only"], 3),
+        "p50_recorder_only_ms": round(med["recorder_only"], 3),
+        "p50_on_ms": round(med["on"], 3),
+        "overhead_pct": round(overhead_pct, 2),
+        "budget_pct": 3.0,
+        "within_budget": overhead_pct <= 3.0,
+        "audit_interval_ticks": 256,
+        "audit_runs_on_side": on_metrics.get("auditRunCount", 0),
+        "audit_violations": on_metrics.get("auditViolationCount", 0),
+        "recorder_events_on_side": on_metrics.get(
+            "flightRecorderEventCount", 0
+        ),
+        "replay": {
+            "hosts": actual_hosts,
+            "seed": replay_seed,
+            "bound_gangs": live_report["counts"]["boundGangs"],
+            "preemption_events": (
+                live_report["counts"]["preemptionEvents"]
+            ),
+            "faults_applied": live_report["counts"]["faultsApplied"],
+            "window_events": recording["meta"]["windowEvents"],
+            "fingerprint": recording_fingerprint(recording),
+            "identical": True,  # asserted above
+        },
+    }, 16 * cubes + 4 * slices + solos, t0)
+
+
 def bench_preempt(sched, nodes, n_calls: int = 30) -> float:
     """p50 latency of the production preempt verb on the loaded cluster:
     a high-priority gang preempts, is re-probed (the extender re-enters
@@ -2289,6 +2447,27 @@ if __name__ == "__main__":
             )
         )
         sys.exit(0)
+    if os.environ.get("HIVED_BENCH_AUDIT") == "1":
+        # Black-box plane A/B (doc/hot-path.md "Black-box plane"):
+        # auditor/recorder overhead at the 432-host fleet vs the ≤3%
+        # budget + the capture→replay fingerprint assertion. Smoke
+        # sizing: HIVED_BENCH_AUDIT_SMOKE=1.
+        if os.environ.get("HIVED_BENCH_AUDIT_SMOKE") == "1":
+            result = bench_audit(
+                cubes=4, slices=10, solos=4, n_gangs=60, reps=1,
+                replay_hosts=104, replay_gangs=100,
+            )
+        else:
+            result = bench_audit()
+        print(json.dumps({
+            "metric": "blackbox_overhead_pct",
+            "value": result["overhead_pct"],
+            "unit": "%",
+            "vs_baseline": result["overhead_pct"] / 3.0
+            if result["overhead_pct"] > 0 else 0.0,
+            "extra": result,
+        }))
+        sys.exit(0)
     if os.environ.get("HIVED_BENCH_SMOKE") == "1":
         try:
             smoke_gangs = int(os.environ.get("HIVED_BENCH_SMOKE_GANGS", "24"))
@@ -2332,6 +2511,7 @@ if __name__ == "__main__":
     defrag_stage = bench_defrag()
     boot_stage = bench_boot()
     ring_ab = bench_ring_ab()
+    audit_stage = bench_audit()
     perf = model_perf()
     print(
         json.dumps(
@@ -2357,6 +2537,7 @@ if __name__ == "__main__":
                     "defrag": defrag_stage,
                     "boot": boot_stage,
                     "ring_ab": ring_ab,
+                    "audit_ab": audit_stage,
                     "model_perf": perf,
                 },
             }

@@ -25,6 +25,12 @@
 # mixed-generation crash recovery, and checkpoint-coordinated defrag
 # migrations specifically: hack/soak.sh --elastic
 #
+# Black-box double-audit: --audit runs the sweep with the PRODUCTION
+# live auditor auditing every mutating verb (HIVED_AUDIT_INTERVAL_TICKS=1)
+# alongside the harness's per-event audit; the harness asserts the two
+# paths agree on every seed (doc/observability.md "The black-box plane"):
+# hack/soak.sh --audit
+#
 # Failover focus: --failover weights the HA / snapshot recovery family up
 # (snapshot flushes, snapshot corruption/staleness, lease failovers incl.
 # lease-loss-mid-bind) via the "ha" alias of HIVED_CHAOS_MIX, so a soak
@@ -82,6 +88,20 @@ if [[ "${1:-}" == "--whatif" ]]; then
   export JAX_PLATFORMS=cpu
   echo "what-if plane: snapshot-forked queue forecast vs actual waits"
   exec env HIVED_BENCH_WHATIF=1 python bench.py "$@"
+fi
+
+if [[ "${1:-}" == "--audit" ]]; then
+  shift
+  # Black-box double-audit (doc/observability.md "The black-box plane"):
+  # run the chaos sweep with the PRODUCTION live auditor auditing every
+  # mutating verb (HIVED_AUDIT_INTERVAL_TICKS=1) alongside the harness's
+  # per-event audit. The harness asserts agreement at every scheduler
+  # teardown: a production-path violation the harness never raised fails
+  # the seed (they share ONE audit_invariants implementation, so this
+  # must hold). Composes with --keep-decisions / HIVED_CHAOS_MIX.
+  export HIVED_LIVE_AUDIT=1
+  export HIVED_AUDIT_INTERVAL_TICKS=1
+  echo "chaos soak: black-box double-audit (live auditor every verb)"
 fi
 
 if [[ "${1:-}" == "--boot-profile" ]]; then

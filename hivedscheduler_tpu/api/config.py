@@ -95,6 +95,15 @@ class Config:
     # descent. 0 disables the cache (as does the HIVED_WAIT_CACHE=0 env
     # hatch, which needs no config rollout).
     wait_cache_capacity: int = 4096
+    # Black-box plane (doc/observability.md "The black-box plane"): the
+    # live invariant auditor's event-clock cadence — every N mutating
+    # verbs the chaos invariants run over the live core under a brief
+    # global section (0 disables; HIVED_LIVE_AUDIT=0 and
+    # HIVED_AUDIT_INTERVAL_TICKS are the no-rollout env hatches) — and
+    # the flight recorder's bounded verb-ring capacity per window
+    # (0 disables; HIVED_FLIGHT_RECORDER=0 likewise).
+    audit_interval_ticks: int = 256
+    flight_recorder_capacity: int = 2048
     # HA / snapshot recovery plane (doc/fault-model.md "HA and snapshot
     # recovery plane"). snapshot_interval_seconds > 0 arms the background
     # snapshot flusher (HivedScheduler.start_snapshot_flusher) that
@@ -139,6 +148,8 @@ class Config:
         procs = d.get("procShards")
         defrag_t = d.get("defragIntervalTicks")
         defrag_m = d.get("defragMaxMigrationsPerCycle")
+        audit_t = d.get("auditIntervalTicks")
+        fr_cap = d.get("flightRecorderCapacity")
         c = Config(
             kube_apiserver_address=d.get("kubeApiServerAddress"),
             kube_config_file_path=d.get("kubeConfigFilePath"),
@@ -168,6 +179,10 @@ class Config:
             ),
             trace_ring_capacity=256 if tr_cap is None else int(tr_cap),
             wait_cache_capacity=4096 if wc_cap is None else int(wc_cap),
+            audit_interval_ticks=256 if audit_t is None else int(audit_t),
+            flight_recorder_capacity=(
+                2048 if fr_cap is None else int(fr_cap)
+            ),
             snapshot_interval_seconds=(
                 0.0 if snap_s is None else float(snap_s)
             ),

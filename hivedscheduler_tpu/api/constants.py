@@ -137,6 +137,14 @@ DECISIONS_PATH = INSPECT_PATH + "/decisions"
 # schedule -> placement descent -> bind write -> recovery cycles).
 TRACES_PATH = INSPECT_PATH + "/traces"
 
+# The black-box plane's flight recorder (scheduler.recorder,
+# doc/observability.md "The black-box plane"): the current recording
+# window — every mutating verb in the sim trace vocabulary, anchored on
+# a snapshot export. ?full=1 serves the whole dumpable recording, which
+# `python -m hivedscheduler_tpu.sim --replay-recording FILE` replays
+# into a deterministic incident repro.
+FLIGHTRECORDER_PATH = INSPECT_PATH + "/flightrecorder"
+
 # The shadow what-if plane (scheduler.whatif, doc/user-manual.md "When
 # will my pod schedule?"): POST a gang spec (or queue: true for the whole
 # waiting queue, or capacityTrace for capacity planning) and get a

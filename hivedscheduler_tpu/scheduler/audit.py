@@ -1,0 +1,376 @@
+"""Live invariant auditor: the chaos harness's structural invariants with
+production teeth (the black-box plane, doc/observability.md).
+
+``audit_invariants`` is THE implementation of invariants 1-7 over a live
+core — lifted out of ``tests/chaos.py`` (which imports it back, so the
+harness and the production path can never drift). The chaos harness runs
+it after every seeded event and *asserts*; production cannot afford an
+assert, so :class:`LiveAuditor` runs the same function event-clocked at a
+knob'd cadence (``auditIntervalTicks``; ``HIVED_LIVE_AUDIT=0`` hatch)
+under a brief global section and **degrades gracefully**: a violation is
+counted (``hived_audit_violations_total``), journaled into the decision
+journal, and answered by an auto-dump of the whole black-box bundle —
+flight-recorder window + decisions + traces + metrics — to
+``HIVED_AUDIT_ARTIFACT_DIR``, while the scheduler keeps serving. The
+sensitivity meta-test (tests/test_flight_recorder.py) proves injected
+corruption is caught within one cadence and that a no-op'd auditor is
+itself caught.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Iterator, Set
+
+from .. import common
+from ..algorithm.cell import (
+    Cell,
+    CellState,
+    FREE_PRIORITY,
+    LOWEST_LEVEL,
+    MIN_GUARANTEED_PRIORITY,
+    PhysicalCell,
+)
+from ..algorithm.core import in_free_cell_list
+from ..algorithm.group import GroupState
+
+# Event-clock cadence hatches. HIVED_LIVE_AUDIT=0 disables the live
+# auditor entirely; HIVED_AUDIT_INTERVAL_TICKS overrides the config
+# cadence (hack/soak.sh --audit sets =1 so every chaos verb is audited
+# by BOTH the harness and the production path — the double-audit).
+LIVE_AUDIT_ENV = "HIVED_LIVE_AUDIT"
+AUDIT_INTERVAL_ENV = "HIVED_AUDIT_INTERVAL_TICKS"
+AUDIT_ARTIFACT_DIR_ENV = "HIVED_AUDIT_ARTIFACT_DIR"
+
+
+def _leaves(c: Cell) -> Iterator[PhysicalCell]:
+    if not c.children:
+        assert isinstance(c, PhysicalCell)
+        yield c
+        return
+    for child in c.children:
+        yield from _leaves(child)
+
+
+def _count_at_level(c: Cell, level: int) -> int:
+    if c.level == level:
+        return 1
+    if c.level < level or not c.children:
+        return 0
+    return sum(_count_at_level(child, level) for child in c.children)
+
+
+def audit_invariants(sched, ctx: str = "") -> None:
+    """Structural invariants over the live core; raises AssertionError with
+    ``ctx`` on any violation. Cheap enough to run after every chaos event
+    (the harness) and at the live cadence (LiveAuditor)."""
+    core = sched.core
+    for chain, ccl in core.full_cell_list.items():
+        top = ccl.top_level
+        # --- invariant 1a: the free list partitions the chain ------------- #
+        derived = {l: 0 for l in range(LOWEST_LEVEL, top + 1)}
+        covered: Set[str] = set()
+        for level in range(LOWEST_LEVEL, top + 1):
+            for c in core.free_cell_list[chain][level]:
+                assert c.level == level, (ctx, chain, level, c.address)
+                for l in range(LOWEST_LEVEL, level + 1):
+                    derived[l] += _count_at_level(c, l)
+                for leaf in _leaves(c):
+                    assert leaf.address not in covered, (
+                        ctx, chain, "free lists overlap", leaf.address,
+                    )
+                    covered.add(leaf.address)
+                    # Invariant 5 (reservation conservation, half 1): no
+                    # cell is both in the free lists and Reserved/Reserving
+                    # — a reservation always allocates its preassigned cell
+                    # out of the free lists. A free-covered USED leaf is
+                    # legal only for opportunistic occupancy (that is how
+                    # preemption victims arise).
+                    assert leaf.state not in (
+                        CellState.RESERVING, CellState.RESERVED,
+                    ), (ctx, chain, "reserved cell in free list", leaf.address)
+                    if leaf.state == CellState.USED:
+                        assert leaf.priority < MIN_GUARANTEED_PRIORITY, (
+                            ctx, chain, "guaranteed allocation in free list",
+                            leaf.address, leaf.priority,
+                        )
+        for l in range(LOWEST_LEVEL, top + 1):
+            assert core.total_left_cell_num[chain].get(l, 0) == derived[l], (
+                ctx, chain, l, "totalLeft != cells derivable from free list",
+                core.total_left_cell_num[chain].get(l, 0), derived[l],
+            )
+        # --- invariant 1b: per-leaf state machine ------------------------- #
+        # --- + invariant 5 (reservation conservation, half 2): the leaf    #
+        #     reservation pointers and the Reserving/Reserved states agree  #
+        for leaf in ccl[LOWEST_LEVEL]:
+            assert isinstance(leaf, PhysicalCell)
+            if leaf.state == CellState.USED:
+                assert leaf.using_group is not None, (ctx, leaf.address)
+            if leaf.using_group is not None:
+                assert leaf.state in (CellState.USED, CellState.RESERVING), (
+                    ctx, leaf.address, leaf.state,
+                )
+            if leaf.state == CellState.FREE:
+                assert leaf.using_group is None, (ctx, leaf.address)
+                assert leaf.priority == FREE_PRIORITY, (
+                    ctx, leaf.address, leaf.priority,
+                )
+            reserved = leaf.state in (CellState.RESERVING, CellState.RESERVED)
+            assert reserved == (leaf.reserving_or_reserved_group is not None), (
+                ctx, leaf.address, leaf.state,
+                "reservation pointer and state disagree",
+            )
+            if leaf.state == CellState.RESERVED:
+                assert leaf.using_group is None, (ctx, leaf.address)
+            if leaf.state == CellState.RESERVING:
+                assert leaf.using_group is not None, (ctx, leaf.address)
+            if reserved:
+                g = leaf.reserving_or_reserved_group
+                assert g.state == GroupState.PREEMPTING, (
+                    ctx, leaf.address, g.name, g.state,
+                )
+                assert any(
+                    leaf is pl
+                    for rows in g.physical_placement.values()
+                    for row in rows
+                    for pl in row
+                ), (ctx, leaf.address, g.name,
+                    "reserved leaf not in its preemptor's placement")
+        # --- bad-free entries are actually bad and actually free ---------- #
+        for level in range(LOWEST_LEVEL, top + 1):
+            for c in core.bad_free_cells[chain][level]:
+                assert isinstance(c, PhysicalCell)
+                assert not c.healthy, (ctx, chain, level, c.address)
+                assert in_free_cell_list(c), (ctx, chain, level, c.address)
+
+    # --- invariant 2: doomed-bad-cell counter consistency ----------------- #
+    doomed_sum: Dict[str, Dict[int, int]] = {}
+    for vcn, per_chain in core.vc_doomed_bad_cells.items():
+        for chain, ccl in per_chain.items():
+            for level, cl in ccl.levels.items():
+                if len(cl) == 0:
+                    continue
+                doomed_sum.setdefault(chain, {})
+                doomed_sum[chain][level] = doomed_sum[chain].get(level, 0) + len(cl)
+                for c in cl:
+                    assert isinstance(c, PhysicalCell)
+                    assert c.virtual_cell is not None, (ctx, vcn, c.address)
+                    assert c.virtual_cell.vc == vcn, (ctx, vcn, c.address)
+    for chain, per_level in core.all_vc_doomed_bad_cell_num.items():
+        for level, n in per_level.items():
+            assert n >= 0, (ctx, chain, level, n)
+            assert doomed_sum.get(chain, {}).get(level, 0) == n, (
+                ctx, chain, level, "doomed counter mismatch",
+                doomed_sum.get(chain, {}).get(level, 0), n,
+            )
+
+    # --- VC free-quota ledgers sum to the global ledger ------------------- #
+    vc_sum: Dict[str, Dict[int, int]] = {}
+    for vcn, per_chain in core.vc_free_cell_num.items():
+        for chain, per_level in per_chain.items():
+            for level, n in per_level.items():
+                vc_sum.setdefault(chain, {})
+                vc_sum[chain][level] = vc_sum[chain].get(level, 0) + n
+    for chain in set(vc_sum) | set(core.all_vc_free_cell_num):
+        levels = set(vc_sum.get(chain, {})) | set(
+            core.all_vc_free_cell_num.get(chain, {})
+        )
+        for level in levels:
+            assert vc_sum.get(chain, {}).get(level, 0) == (
+                core.all_vc_free_cell_num.get(chain, {}).get(level, 0)
+            ), (ctx, chain, level, "vcFree sum != allVCFree")
+
+    # --- invariant 7 (health consistency, structural half): leaf badness   #
+    #     and drains match the core's applied records, badness propagates   #
+    #     up the cell tree exactly (a cell is healthy iff all children      #
+    #     are), bound virtual mirrors agree, and the incremental            #
+    #     unusable-leaf counters equal the subtree truth                    #
+    for chain, ccl in core.full_cell_list.items():
+        top = ccl.top_level
+        for leaf in ccl[LOWEST_LEVEL]:
+            assert isinstance(leaf, PhysicalCell)
+            node = leaf.nodes[0]
+            expect_bad = node in core.bad_nodes or any(
+                i in core.bad_chips.get(node, ())
+                for i in leaf.leaf_cell_indices
+            )
+            assert leaf.healthy == (not expect_bad), (
+                ctx, leaf.address, "leaf health != applied bad records",
+            )
+            expect_drain = any(
+                i in core.draining_chips.get(node, ())
+                for i in leaf.leaf_cell_indices
+            )
+            assert leaf.draining == expect_drain, (
+                ctx, leaf.address, "leaf drain != applied drain records",
+            )
+        for level in range(LOWEST_LEVEL, top + 1):
+            for c in ccl[level]:
+                assert isinstance(c, PhysicalCell)
+                if c.children:
+                    assert c.healthy == all(
+                        ch.healthy for ch in c.children
+                    ), (ctx, c.address, "tree health propagation broken")
+                derived_unusable = sum(
+                    1
+                    for leaf in _leaves(c)
+                    if (not leaf.healthy) or leaf.draining
+                )
+                assert c.unusable_leaf_num == derived_unusable, (
+                    ctx, c.address, "unusable-leaf counter drift",
+                    c.unusable_leaf_num, derived_unusable,
+                )
+                if c.virtual_cell is not None:
+                    assert c.virtual_cell.healthy == c.healthy, (
+                        ctx, c.address, "bound virtual health mirror broken",
+                    )
+
+    # --- allocated groups reference live, non-free cells ------------------ #
+    # --- + invariant 5 (reservation conservation, group side): a           #
+    #     PREEMPTING group's cells are exactly Reserving/Reserved and point #
+    #     back at it; a BeingPreempted group's cells are Used or Reserving  #
+    for g in core.affinity_groups.values():
+        for rows in g.physical_placement.values():
+            for row in rows:
+                for leaf in row:
+                    if leaf is None:
+                        continue
+                    assert isinstance(leaf, PhysicalCell)
+                    assert leaf.state != CellState.FREE, (
+                        ctx, g.name, leaf.address,
+                    )
+                    if g.state == GroupState.PREEMPTING:
+                        assert leaf.state in (
+                            CellState.RESERVING, CellState.RESERVED,
+                        ), (ctx, g.name, leaf.address, leaf.state)
+                        assert leaf.reserving_or_reserved_group is g, (
+                            ctx, g.name, leaf.address,
+                        )
+                    elif g.state == GroupState.BEING_PREEMPTED:
+                        assert leaf.state in (
+                            CellState.USED, CellState.RESERVING,
+                        ), (ctx, g.name, leaf.address, leaf.state)
+
+
+class LiveAuditor:
+    """The always-on production half: ticks on the scheduler's mutating
+    verbs, runs :func:`audit_invariants` every ``interval_ticks`` under a
+    brief global section, and degrades gracefully on violation (count +
+    journal + artifact dump — NEVER an assert into the serving path).
+
+    Thread-safety: ``tick`` is called at verb exit from request threads;
+    the counter increment rides the GIL and the audit itself serializes
+    on the scheduler's global guard. A lost tick under a race only delays
+    one audit by one event — acceptable for a cadence knob."""
+
+    def __init__(self, sched, interval_ticks: int):
+        self.sched = sched
+        env = os.environ.get(AUDIT_INTERVAL_ENV, "").strip()
+        if env:
+            try:
+                interval_ticks = int(env)
+            except ValueError:
+                pass
+        self.interval_ticks = max(1, int(interval_ticks))
+        self.ticks = 0
+        self.audit_runs = 0
+        self.violation_count = 0
+        self.last_violation: str = ""
+        self.last_artifact: str = ""
+
+    # -- the event clock ------------------------------------------------ #
+
+    def tick(self) -> None:
+        """One mutating verb completed (called OUTSIDE every lock, from
+        the framework's top-level verb exits only — never from paths that
+        may hold a chain section, see framework._blackbox_exit)."""
+        self.ticks += 1
+        if self.ticks % self.interval_ticks == 0:
+            self.run_audit(f"cadence tick={self.ticks}")
+
+    def run_audit(self, ctx: str = "manual") -> bool:
+        """One audit pass under the global section. Returns True when the
+        invariants held. A violation is counted, journaled, and answered
+        by the artifact dump; any OTHER failure (an audit crash on a
+        half-built core) logs and counts as a run, never a violation —
+        the auditor must not invent corruption."""
+        sched = self.sched
+        if getattr(sched, "_in_recovery", False):
+            return True  # a half-replayed view is not auditable state
+        self.audit_runs += 1
+        try:
+            with sched._lock:
+                audit_invariants(sched, f"live-audit {ctx}")
+            return True
+        except AssertionError as e:
+            self.violation_count += 1
+            detail = str(e.args[0] if len(e.args) == 1 else e.args)
+            self.last_violation = detail[:2000]
+            common.log.error(
+                "LIVE AUDIT VIOLATION #%d (%s): %s — scheduler keeps "
+                "serving; black-box bundle dumping",
+                self.violation_count, ctx, self.last_violation,
+            )
+            self._journal(ctx, detail)
+            try:
+                self.last_artifact = self.dump_artifact(ctx, detail)
+            except Exception:  # noqa: BLE001 — the dump must never raise
+                common.log.exception("audit artifact dump failed")
+            return False
+        except Exception as e:  # noqa: BLE001
+            common.log.warning("live audit pass crashed (not counted as a "
+                               "violation): %s", e)
+            return True
+
+    def _journal(self, ctx: str, detail: str) -> None:
+        """A violation is a decision too: one journal record under the
+        synthetic pod key ``_audit`` so ``/v1/inspect/decisions`` shows
+        it inline with the attempts that led up to it."""
+        try:
+            rec = self.sched.decisions.begin("_audit", "_audit", "audit")
+            rec.verdict_error(f"invariant violation ({ctx}): {detail[:500]}")
+            self.sched.decisions.commit(rec)
+        except Exception:  # noqa: BLE001 — diagnostics must never raise
+            pass
+
+    def dump_artifact(self, ctx: str, detail: str) -> str:
+        """The black-box bundle: flight-recorder window + decision
+        journal + trace ring + metrics, one JSON file per violation
+        under HIVED_AUDIT_ARTIFACT_DIR (default $TMPDIR/hived-audit)."""
+        import tempfile
+
+        out_dir = os.environ.get(AUDIT_ARTIFACT_DIR_ENV) or os.path.join(
+            tempfile.gettempdir(), "hived-audit"
+        )
+        os.makedirs(out_dir, exist_ok=True)
+        sched = self.sched
+        recorder = getattr(sched, "recorder", None)
+        payload = {
+            "context": ctx,
+            "violation": detail,
+            "violationCount": self.violation_count,
+            "auditRuns": self.audit_runs,
+            "wallTime": time.time(),
+            "decisions": sched.decisions.snapshot(),
+            "traces": sched.tracer.snapshot(),
+            "metrics": sched.get_metrics(),
+            "flightRecording": (
+                recorder.recording() if recorder is not None else None
+            ),
+        }
+        path = os.path.join(
+            out_dir,
+            f"audit-violation-{self.violation_count}-{os.getpid()}.json",
+        )
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1, default=str)
+        common.log.error("black-box bundle dumped to %s", path)
+        return path
+
+    def metrics_snapshot(self) -> Dict:
+        return {
+            "auditRunCount": self.audit_runs,
+            "auditViolationCount": self.violation_count,
+        }

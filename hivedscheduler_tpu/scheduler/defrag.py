@@ -188,6 +188,15 @@ class DefragController:
         was checkpointed, deleted, and re-filtered onto a compacting
         placement; failure cancels the proposal and releases its advisory
         reservation."""
+        if self.sched._blackbox_top():
+            # Black-box plane: the controller verb is part of the window
+            # (replay re-reports so cooldown/metrics state tracks; the
+            # controller's own in-flight maps are not anchored — defrag
+            # replay is best-effort, doc/observability.md).
+            self.sched._blackbox_record(
+                "record_marker", "defrag_report",
+                group=group, ok=bool(ok), reason=reason,
+            )
         proposal = self._inflight.pop(group, None)
         if proposal is None:
             return

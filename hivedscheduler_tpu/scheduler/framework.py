@@ -33,7 +33,9 @@ from ..api.config import Config
 from ..algorithm.core import HivedCore, get_allocated_pod_index, group_chain
 from ..algorithm.group import GroupState
 from ..algorithm.placement import PhaseStats
+from . import audit as audit_mod
 from . import health as health_mod
+from . import recorder as recorder_mod
 from . import snapshot as snapshot_mod
 from . import tracing
 from .decisions import DecisionJournal
@@ -446,6 +448,16 @@ WHATIF_EMPTY_METRICS = {
     "whatifForecastSeconds": 0.0,
 }
 
+# Black-box plane metric keys (doc/observability.md): always present in
+# get_metrics so the golden metrics schema holds with the auditor or
+# recorder disabled.
+BLACKBOX_EMPTY_METRICS = {
+    "auditRunCount": 0,
+    "auditViolationCount": 0,
+    "flightRecorderEventCount": 0,
+    "flightRecorderReanchorCount": 0,
+}
+
 
 class HivedScheduler:
     """(reference: pkg/scheduler/scheduler.go:53-120)"""
@@ -470,6 +482,12 @@ class HivedScheduler:
         # Tracing sample-rate override; None reads HIVED_TRACE_SAMPLE
         # (default 0.01). The bench A/B passes explicit values.
         trace_sample: Optional[float] = None,
+        # Black-box plane overrides (doc/observability.md): False forces
+        # the flight recorder / live auditor OFF regardless of config and
+        # env — shadow forks and replay subjects must not record or audit
+        # themselves recording. None reads config + env (the default).
+        flight_recorder: Optional[bool] = None,
+        live_audit: Optional[bool] = None,
     ) -> None:
         self.config = config
         self.kube_client = kube_client or NullKubeClient()
@@ -704,6 +722,121 @@ class HivedScheduler:
         self._whatif = None
         self._whatif_init_lock = threading.Lock()
         self._mutation_guard: Optional[Callable[[], None]] = None
+        # Black-box plane (doc/observability.md "The black-box plane"):
+        # the production flight recorder (bounded verb ring, anchored on
+        # the fork-body snapshot export + preempt-RNG state, replayable
+        # via `python -m hivedscheduler_tpu.sim --replay-recording`) and
+        # the live invariant auditor (tests/chaos.py's audit_invariants,
+        # one implementation, run event-clocked under a brief global
+        # section — violations count + journal + dump, never assert).
+        self.recorder: Optional[recorder_mod.FlightRecorder] = None
+        if (
+            flight_recorder is not False
+            and config.flight_recorder_capacity > 0
+            and os.environ.get(
+                recorder_mod.FLIGHT_RECORDER_ENV, "1"
+            ).strip() != "0"
+        ):
+            self.recorder = recorder_mod.FlightRecorder(
+                capacity=config.flight_recorder_capacity,
+                exporter=self.export_fork_body,
+                rng_state_fn=lambda: (
+                    self.core.preempt_rng.getstate()
+                    if self.core.preempt_rng is not None
+                    else None
+                ),
+                config_fingerprint=self._config_fingerprint,
+                granularity="framework",
+            )
+            self.recorder.set_node_universe(
+                self.core.configured_node_names()
+            )
+        self.live_auditor: Optional[audit_mod.LiveAuditor] = None
+        if (
+            live_audit is not False
+            and config.audit_interval_ticks > 0
+            and os.environ.get(
+                audit_mod.LIVE_AUDIT_ENV, "1"
+            ).strip() != "0"
+        ):
+            if not __debug__:
+                # audit_invariants is assert-built (one implementation
+                # shared with the chaos harness); python -O strips
+                # asserts, which would leave an auditor that "runs" and
+                # catches nothing. Refuse to arm instead — buildInfo
+                # then honestly reports liveAudit=off and the run
+                # counter stays 0, rather than climbing while blind.
+                common.log.warning(
+                    "live invariant auditor DISABLED: running under "
+                    "python -O strips the audit asserts; re-run without "
+                    "optimization to arm the black-box auditor"
+                )
+            else:
+                self.live_auditor = audit_mod.LiveAuditor(
+                    self, config.audit_interval_ticks
+                )
+
+    # ------------------------------------------------------------------ #
+    # Black-box plane helpers (recorder hooks + auditor event clock)
+    # ------------------------------------------------------------------ #
+
+    def _blackbox_top(self) -> bool:
+        """True when the CURRENT verb entry is top-level (not nested in
+        another mutator): only top-level verbs are recorded — a nested
+        delete+add inside update_pod is the update's implementation, and
+        recording both would double-apply on replay."""
+        return getattr(self._mutation_depth, "d", 0) == 0
+
+    def _blackbox_tick(self) -> None:
+        """The auditor's event clock: called OUTSIDE every lock at
+        top-level verb exit (never from paths that may hold a chain
+        section, e.g. the sync force-bind re-entry)."""
+        aud = self.live_auditor
+        if aud is not None:
+            aud.tick()
+
+    def _blackbox_record_preempt(self, args, result) -> None:
+        """The shared preempt-verb capture (recorder.record_preempt_result
+        — one classification for both frontends), never raising."""
+        rec = self.recorder
+        if rec is None:
+            return
+        try:
+            recorder_mod.record_preempt_result(rec, args.pod, args, result)
+        except Exception:  # noqa: BLE001
+            common.log.exception("flight-recorder hook failed")
+
+    def _blackbox_record(self, method: str, *args, **kwargs) -> None:
+        """One recorder hook, always AFTER the verb executed (so a
+        re-anchor triggered by the append captures state that already
+        subsumes the event — dropping it from the fresh window is exact)
+        and never raising into the serving path."""
+        rec = self.recorder
+        if rec is None:
+            return
+        try:
+            getattr(rec, method)(*args, **kwargs)
+        except Exception:  # noqa: BLE001
+            common.log.exception("flight-recorder hook failed")
+
+    @staticmethod
+    def _fault_kind_from_projections(prev, cur) -> str:
+        """The chaos-vocabulary fault kind a node-event projection diff
+        corresponds to (recorded on node_state events as diagnostic
+        context for the sim tier's wake semantics)."""
+        if prev is None or cur is None:
+            return ""
+        pready, pbad, pdrain = prev
+        cready, cbad, cdrain = cur
+        if pready != cready:
+            return "node_flip"
+        if cbad - pbad:
+            return "chip_fault"
+        if pbad - cbad:
+            return "chip_heal"
+        if pdrain != cdrain:
+            return "drain_toggle"
+        return ""
 
     @staticmethod
     def _default_executor(fn: Callable[[], None]) -> None:
@@ -1193,6 +1326,11 @@ class HivedScheduler:
                 self._refresh_stranded_locked()
             self.mark_ready()
             self._exit_mutation()
+            if self.recorder is not None:
+                # The replay rewrote state outside the recorded verb
+                # stream: the current window no longer replays — the next
+                # recorded verb re-anchors on the recovered projection.
+                self.recorder.force_reanchor()
 
     def _abort_recovery(self) -> None:
         """The replay between begin_recovery and finish_recovery raised:
@@ -1565,6 +1703,8 @@ class HivedScheduler:
         core.preemption_observer = self._on_preemption_event
         core.preempt_rng = old_core.preempt_rng
         self.core = core
+        if self.recorder is not None:
+            self.recorder.force_reanchor()
 
     def _clear_imported_state(self) -> None:
         """Drop everything a snapshot import populated at the framework
@@ -1781,6 +1921,10 @@ class HivedScheduler:
                 imported += 1
         self._snapshot_imported_count = imported
         self._snapshot_delta_count = 0
+        if self.recorder is not None:
+            # restore_projection writes cell fields directly: the current
+            # recording window's anchor no longer describes this state.
+            self.recorder.force_reanchor()
 
     @staticmethod
     def _snapshot_pod_fingerprint(pod: Pod) -> Tuple:
@@ -1943,6 +2087,7 @@ class HivedScheduler:
     # ------------------------------------------------------------------ #
 
     def add_node(self, node: Node) -> None:
+        top = self._blackbox_top()
         self._enter_mutation()
         try:
             t0 = time.monotonic()
@@ -1952,6 +2097,9 @@ class HivedScheduler:
             self._note_boot_node_add(time.monotonic() - t0)
         finally:
             self._exit_mutation()
+            if top:
+                self._blackbox_record("record_node_event", "node_add", node)
+                self._blackbox_tick()
 
     def add_nodes(self, nodes: List[Node]) -> None:
         """Batched node adds (informer boot; doc/hot-path.md "Boot and
@@ -1962,6 +2110,7 @@ class HivedScheduler:
         per node are exactly add_node's."""
         if not nodes:
             return
+        top = self._blackbox_top()
         self._enter_mutation()
         try:
             t0 = time.monotonic()
@@ -1972,6 +2121,12 @@ class HivedScheduler:
             self._note_boot_node_add(time.monotonic() - t0)
         finally:
             self._exit_mutation()
+            if top:
+                for node in nodes:
+                    self._blackbox_record(
+                        "record_node_event", "node_add", node
+                    )
+                self._blackbox_tick()
 
     def _note_boot_node_add(self, seconds: float) -> None:
         """Accumulate node-add wall time into the boot-phase ledger until
@@ -1996,6 +2151,14 @@ class HivedScheduler:
             self.nodes[new.name] = new
             self.metrics.observe_node_event_noop()
             return
+        top = self._blackbox_top()
+        # Captured BEFORE the verb (the projection cache moves inside);
+        # the event itself records after, like every black-box hook.
+        prev_proj = (
+            self._node_projections.get(new.name)
+            if top and self.recorder is not None
+            else None
+        )
         self._enter_mutation()
         try:
             with self._lock:
@@ -2003,8 +2166,17 @@ class HivedScheduler:
                 self._observe_node_health(new)
         finally:
             self._exit_mutation()
+            if top:
+                self._blackbox_record(
+                    "record_node_event", "node_state", new,
+                    self._fault_kind_from_projections(
+                        prev_proj, self._node_health_projection(new)
+                    ),
+                )
+                self._blackbox_tick()
 
     def delete_node(self, node: Node) -> None:
+        top = self._blackbox_top()
         self._enter_mutation()
         try:
             with self._lock:
@@ -2019,6 +2191,11 @@ class HivedScheduler:
                 self._check_stranded_locked()
         finally:
             self._exit_mutation()
+            if top:
+                self._blackbox_record(
+                    "record_node_event", "node_delete", node
+                )
+                self._blackbox_tick()
 
     # ------------------------------------------------------------------ #
     # Health plane (doc/fault-model.md "Hardware health plane")
@@ -2140,6 +2317,7 @@ class HivedScheduler:
         """Advance the event clock without a node observation, settling any
         quiet held transitions. Called by the informer on relists (and by
         harnesses each event) so a flap that simply stops still settles."""
+        top = self._blackbox_top()
         self._enter_mutation()
         try:
             with self._lock:
@@ -2153,6 +2331,9 @@ class HivedScheduler:
                     self.defrag.tick_locked(self._health_clock)
         finally:
             self._exit_mutation()
+            if top:
+                self._blackbox_record("record_marker", "health_tick")
+                self._blackbox_tick()
 
     def settle_health_wall(self) -> None:
         """Apply damper holds whose WALL-CLOCK floor expired (no event tick
@@ -2163,6 +2344,7 @@ class HivedScheduler:
         clock stays exclusively authoritative)."""
         if self._damper.hold_seconds <= 0:
             return
+        top = self._blackbox_top()
         self._enter_mutation()
         try:
             with self._lock:
@@ -2170,10 +2352,17 @@ class HivedScheduler:
                     self._check_stranded_locked()
         finally:
             self._exit_mutation()
+            if top:
+                # Wall-clock-driven settles are inherently time-coupled;
+                # recording the verb at its stream position preserves the
+                # ORDER a replay needs (scheduler.recorder).
+                self._blackbox_record("record_marker", "settle_health_wall")
+                self._blackbox_tick()
 
     def settle_health_now(self) -> None:
         """Force-apply every held transition immediately (teardown and
         restart-projection paths that need the damper drained)."""
+        top = self._blackbox_top()
         self._enter_mutation()
         try:
             with self._lock:
@@ -2186,6 +2375,9 @@ class HivedScheduler:
                     self._check_stranded_locked()
         finally:
             self._exit_mutation()
+            if top:
+                self._blackbox_record("record_marker", "settle_health")
+                self._blackbox_tick()
 
     def health_pending_count(self) -> int:
         with self._lock:
@@ -2199,12 +2391,16 @@ class HivedScheduler:
         health event clock). Returns the number of NEW proposals."""
         if self.defrag is None:
             return 0
+        top = self._blackbox_top()
         self._enter_mutation()
         try:
             with self._lock:
                 return self.defrag.run_cycle_locked()
         finally:
             self._exit_mutation()
+            if top:
+                self._blackbox_record("record_marker", "defrag_cycle")
+                self._blackbox_tick()
 
     def take_defrag_proposals(self) -> List[Dict]:
         """Drain the defragmenter's pending migration proposals (the
@@ -2212,7 +2408,10 @@ class HivedScheduler:
         chaos harness checkpoint + delete + resubmit the named gangs)."""
         if self.defrag is None:
             return []
-        return self.defrag.take_proposals()
+        proposals = self.defrag.take_proposals()
+        if self._blackbox_top():
+            self._blackbox_record("record_marker", "defrag_take")
+        return proposals
 
     def _stranded_groups_locked(self) -> List[Dict]:
         """Gangs holding bad or draining cells — placed before the hardware
@@ -2787,6 +2986,7 @@ class HivedScheduler:
         # into the recovery-replay histogram.
         replaying = is_bound(pod) and not self._ready.is_set()
         t0 = time.monotonic() if replaying else 0.0
+        top = self._blackbox_top()
         self._enter_mutation()
         try:
             # Chain-scoped like filter: a pod event touches only its own
@@ -2814,13 +3014,24 @@ class HivedScheduler:
             self._exit_mutation()
             if replaying:
                 self.metrics.observe_recovery_replay(time.monotonic() - t0)
+            if top:
+                # Recorded AFTER the verb (all black-box hooks are): a
+                # re-anchor triggered at this event captures state that
+                # already INCLUDES it, so dropping the event from the
+                # fresh window is exact, never lossy.
+                self._blackbox_record("record_pod_event", "pod_add", pod)
+                self._blackbox_tick()
 
     def update_pod(self, old: Pod, new: Pod) -> None:
+        top = self._blackbox_top()
         self._enter_mutation()
         try:
             self._update_pod(old, new)
         finally:
             self._exit_mutation()
+            if top:
+                self._blackbox_record("record_pod_update", old, new)
+                self._blackbox_tick()
 
     def _update_pod(self, old: Pod, new: Pod) -> None:
         # An informer may deliver an Update with UID changed when a delete is
@@ -2861,6 +3072,7 @@ class HivedScheduler:
             self.add_pod(new)
 
     def delete_pod(self, pod: Pod) -> None:
+        top = self._blackbox_top()
         self._enter_mutation()
         try:
             # Chain-scoped (see add_pod): releasing a pod touches only its
@@ -2870,6 +3082,9 @@ class HivedScheduler:
             )
         finally:
             self._exit_mutation()
+            if top:
+                self._blackbox_record("record_pod_event", "pod_delete", pod)
+                self._blackbox_tick()
 
     def _delete_pod_locked(self, pod: Pod) -> None:
         """Body of delete_pod; the caller holds a section covering the
@@ -3251,23 +3466,66 @@ class HivedScheduler:
         self,
         args: ei.ExtenderArgs,
         leaf_types: Optional[Tuple[str, ...]] = None,
+        trace_parent: Optional[int] = None,
     ) -> ei.ExtenderFilterResult:
         """``leaf_types`` restricts an untyped pod's any-leaf-type scan to
         a sweep chunk (the shards frontend's leaf-type-granular sweep;
         see core.schedule). Restricted probes use the wait cache under a
         CHUNK-QUALIFIED key (_spec_cache_key): a chunk's certificate
         covers only its own restricted scan, and one spec can carry
-        several chunks."""
+        several chunks. ``trace_parent`` is the frontend's trace id when
+        this call was routed over the shard pipe protocol — the local
+        trace commits as its child (causal cross-shard stitching)."""
+        top = self._blackbox_top()
         self._enter_mutation()
+        result: Optional[ei.ExtenderFilterResult] = None
+        err = ""
         try:
-            return self._filter_routine(args, leaf_types)
+            result = self._filter_routine(args, leaf_types, trace_parent)
+            return result
+        except api.WebServerError as e:
+            err = e.message
+            raise
         finally:
             self._exit_mutation()
+            if top:
+                rec = self.recorder
+                if rec is not None:
+                    try:
+                        self._record_filter_outcome(rec, args, result, err)
+                    except Exception:  # noqa: BLE001 — never raise
+                        common.log.exception("flight-recorder hook failed")
+                self._blackbox_tick()
+
+    def _record_filter_outcome(self, rec, args, result, err: str) -> None:
+        """Record the verb with the SHARED outcome classification
+        (recorder.filter_outcome — one implementation for both
+        frontends), plus the framework-only extras: the error message
+        and, on binds, the raw isolation annotation (recorded verbatim;
+        the fingerprint compares it as an opaque token, so the hot path
+        never parses it)."""
+        pod = args.pod
+        outcome = recorder_mod.filter_outcome(result)
+        node = ""
+        leaf_cells = None
+        if outcome == "bind":
+            node = result.node_names[0]
+            status = self.pod_schedule_statuses.get(pod.uid)
+            if status is not None and status.pod is not None:
+                leaf_cells = status.pod.annotations.get(
+                    constants.ANNOTATION_POD_LEAF_CELL_ISOLATION
+                )
+        rec.record_filter(
+            pod, args.node_names, outcome, node=node,
+            leaf_cells=leaf_cells,
+            error=err if outcome == "error" else "",
+        )
 
     def _filter_routine(
         self,
         args: ei.ExtenderArgs,
         leaf_types: Optional[Tuple[str, ...]] = None,
+        trace_parent: Optional[int] = None,
     ) -> ei.ExtenderFilterResult:
         start = time.monotonic()
         pod = args.pod
@@ -3282,7 +3540,9 @@ class HivedScheduler:
         # Observability plane: a (sampled) span trace for the whole verb,
         # and an (always-on) decision record begun inside the section —
         # where the acquired lock scope is known (doc/observability.md).
-        tr = self.tracer.trace("filter", pod=pod.key)
+        # A routed call carries the frontend's trace id as the parent so
+        # the merged multi-shard ring stitches causally.
+        tr = self.tracer.trace("filter", pod=pod.key, parent=trace_parent)
         # Outside the lock: everything that is a pure function of the request
         # — the YAML spec decode+validation and the suggested-node set build
         # are per-request O(spec) / O(cluster) work that previously sat inside
@@ -3525,9 +3785,43 @@ class HivedScheduler:
     # Bind (reference: scheduler.go:589-627)
     # ------------------------------------------------------------------ #
 
-    def bind_routine(self, args: ei.ExtenderBindingArgs) -> ei.ExtenderBindingResult:
+    def bind_routine(
+        self,
+        args: ei.ExtenderBindingArgs,
+        trace_parent: Optional[int] = None,
+    ) -> ei.ExtenderBindingResult:
         """Idempotent: may be called multiple times for the same pod; once a
         pod is allocated its placement never changes."""
+        # top distinguishes an extender-driven bind from the sync
+        # force-bind re-entry (which runs INSIDE a filter's mutation
+        # bracket, possibly holding a chain section — the auditor's
+        # global acquisition must never run there).
+        top = self._blackbox_top()
+        ok = False
+        try:
+            result = self._bind_routine(args, trace_parent)
+            ok = True
+            return result
+        finally:
+            if top:
+                rec = self.recorder
+                if rec is not None:
+                    try:
+                        rec.record_bind(
+                            args.pod_name, args.pod_namespace,
+                            args.pod_uid, args.node, ok,
+                        )
+                    except Exception:  # noqa: BLE001
+                        common.log.exception(
+                            "flight-recorder hook failed"
+                        )
+                self._blackbox_tick()
+
+    def _bind_routine(
+        self,
+        args: ei.ExtenderBindingArgs,
+        trace_parent: Optional[int] = None,
+    ) -> ei.ExtenderBindingResult:
         # Validate under the lock, but perform the apiserver write outside
         # it: a bind is a full network RTT, and holding the exclusive lock
         # through it would serialize gang binds and stall all filtering
@@ -3571,7 +3865,9 @@ class HivedScheduler:
                 "not the leader: bind refused (lease lost or standby); "
                 "the active leader will re-schedule this pod",
             )
-        tr = self.tracer.trace("bind", pod=binding_pod.key)
+        tr = self.tracer.trace(
+            "bind", pod=binding_pod.key, parent=trace_parent
+        )
         t0 = time.monotonic()
         try:
             self.kube_client.bind_pod(binding_pod)
@@ -3595,6 +3891,8 @@ class HivedScheduler:
         the sync force-bind test path, which re-enters holding the pod's
         chain section; the section here is the same set, so it must NOT be
         the global guard or it would widen)."""
+        top = self._blackbox_top()
+        released = False
         self._enter_mutation()
         try:
             with self._locks.section(self._pod_lock_chains(binding_pod)):
@@ -3608,20 +3906,41 @@ class HivedScheduler:
                     "(node %s)", binding_pod.key, binding_pod.node_name,
                 )
                 self._delete_pod_locked(status.pod)
+                released = True
         finally:
             self._exit_mutation()
+            if released and self.recorder is not None:
+                # The release is driven by a kube-write FAILURE the replay
+                # cannot reproduce (its kube client never fails): record
+                # it as the pod delete it is, so the replayed state
+                # converges. The nested (sync force-bind) re-entry cannot
+                # record mid-verb — re-anchor instead of silently leaving
+                # a window whose replay would keep the allocation.
+                if top:
+                    self._blackbox_record(
+                        "record_pod_event", "pod_delete", binding_pod
+                    )
+                    self._blackbox_tick()
+                else:
+                    self.recorder.force_reanchor()
 
     # ------------------------------------------------------------------ #
     # Preempt (reference: scheduler.go:629-721)
     # ------------------------------------------------------------------ #
 
     def preempt_routine(
-        self, args: ei.ExtenderPreemptionArgs
+        self,
+        args: ei.ExtenderPreemptionArgs,
+        trace_parent: Optional[int] = None,
     ) -> ei.ExtenderPreemptionResult:
+        top = self._blackbox_top()
         self._enter_mutation()
         start = time.monotonic()
-        tr = self.tracer.trace("preempt", pod=args.pod.key)
+        tr = self.tracer.trace(
+            "preempt", pod=args.pod.key, parent=trace_parent
+        )
         sections: List = []
+        preempt_result: Optional[ei.ExtenderPreemptionResult] = None
         try:
             # Chain-scoped like filter: preempt probes and commits touch
             # only the pod's spec-derived chains (victims overlap the
@@ -3673,6 +3992,7 @@ class HivedScheduler:
                         "reservation will not survive a crash): %s",
                         pod.key, e,
                     )
+            preempt_result = result
             return result
         finally:
             if tr:
@@ -3683,6 +4003,9 @@ class HivedScheduler:
                 tr.finish()
             self.metrics.observe_preempt_routine(time.monotonic() - start)
             self._exit_mutation()
+            if top:
+                self._blackbox_record_preempt(args, preempt_result)
+                self._blackbox_tick()
 
     def _preempt_annotation_patch(self, pod: Pod):
         """Under the lock: decide whether the pod needs its preempt-info
@@ -3917,6 +4240,36 @@ class HivedScheduler:
             if plane is not None
             else dict(WHATIF_EMPTY_METRICS)
         )
+        # Black-box plane (doc/observability.md): live-audit runs and
+        # violations, flight-recorder volume. Keys always present
+        # (golden metrics schema); zeros while disabled.
+        snap.update(dict(BLACKBOX_EMPTY_METRICS))
+        aud = self.live_auditor
+        if aud is not None:
+            snap.update(aud.metrics_snapshot())
+        recd = self.recorder
+        if recd is not None:
+            snap.update(recd.metrics_snapshot())
+        # hived_build_info labels (rendered as a constant-1 gauge): the
+        # deploy-identity facts an operator cross-checks first in any
+        # incident — snapshot schema, config fingerprint prefix, shard
+        # count, and the hatch states that change scheduling behavior.
+        snap["buildInfo"] = {
+            "snapshotSchema": str(snapshot_mod.SCHEMA_VERSION),
+            "configFingerprint": (self._config_fingerprint or "")[:12],
+            "shards": "0",
+            "lazyVc": (
+                "on"
+                if os.environ.get("HIVED_LAZY_VC", "1").strip() != "0"
+                else "off"
+            ),
+            "waitCache": "on" if self.wait_cache_enabled else "off",
+            "nodeEventFastpath": (
+                "on" if self.node_event_fastpath else "off"
+            ),
+            "liveAudit": "on" if aud is not None else "off",
+            "flightRecorder": "on" if recd is not None else "off",
+        }
         return snap
 
     def is_leader(self) -> bool:
@@ -3979,9 +4332,39 @@ class HivedScheduler:
                     plane = self._whatif = whatif_mod.WhatIfPlane(self)
         return plane
 
-    def get_decisions(self, n: Optional[int] = None) -> Dict:
-        """Inspect payload for /v1/inspect/decisions: the latest-N ring."""
-        return {"items": self.decisions.snapshot(n)}
+    def get_decisions(
+        self,
+        n: Optional[int] = None,
+        verdict: Optional[str] = None,
+        gate: Optional[str] = None,
+    ) -> Dict:
+        """Inspect payload for /v1/inspect/decisions: the latest-N ring.
+        ``verdict`` / ``gate`` slice the journal server-side
+        (?verdict=wait&gate=vcQuota — doc/observability.md) so operators
+        can ask "every WAIT blocked on quota" without dumping the ring;
+        filters apply BEFORE the latest-N cut, so ?n= bounds the matches,
+        not the scan window."""
+        if verdict is None and gate is None:
+            return {"items": self.decisions.snapshot(n)}
+        items = [
+            d
+            for d in self.decisions.snapshot()
+            if _decision_matches(d, verdict, gate)
+        ]
+        if n is not None and n >= 0:
+            items = items[-n:] if n > 0 else []
+        return {"items": items}
+
+    def get_flightrecorder(self, full: bool = False) -> Dict:
+        """Inspect payload for /v1/inspect/flightrecorder: the window
+        summary, or (?full=1) the whole dumpable recording — the unit
+        `python -m hivedscheduler_tpu.sim --replay-recording` consumes."""
+        rec = self.recorder
+        if rec is None:
+            return {"enabled": False}
+        payload = rec.recording() if full else rec.summary()
+        payload["enabled"] = True
+        return payload
 
     def get_decision(self, key: str) -> Dict:
         """Per-pod lookup (uid or namespace/name) of the latest decision."""
@@ -3999,3 +4382,21 @@ class HivedScheduler:
             "sample": self.tracer.sample,
             "items": self.tracer.snapshot(n),
         }
+
+
+def _decision_matches(
+    d: Dict, verdict: Optional[str], gate: Optional[str]
+) -> bool:
+    """The ?verdict= / ?gate= journal slice: verdict is an exact match;
+    gate matches any per-chain rejection's gate OR a WAIT certificate's
+    blocking gate."""
+    if verdict is not None and d.get("verdict") != verdict:
+        return False
+    if gate is not None:
+        in_rejections = any(
+            a.get("gate") == gate for a in d.get("rejections") or []
+        )
+        cert = d.get("certificate") or {}
+        if not in_rejections and cert.get("gate") != gate:
+            return False
+    return True

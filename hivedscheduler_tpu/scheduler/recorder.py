@@ -1,0 +1,681 @@
+"""Production flight recorder: a bounded verb ring with deterministic
+incident replay (the black-box plane, doc/observability.md).
+
+The recorder captures every MUTATING verb the scheduler serves — filter
+(with its bind/preempt/wait outcome), the preempt lifecycle, bind writes,
+pod add/update/delete, node add/state/delete (health and drain events),
+health-clock ticks, and the defragmenter's controller verbs — as events
+in the sim trace vocabulary (``{t, seq, kind, ...}``; node events carry
+the trace tier's ``nodeIndex`` addressing alongside the name). Each
+recording **window** is anchored on a PR-7 snapshot export
+(``export_fork_body`` — the same walk the what-if plane forks from) plus
+the preempt-RNG state, so the window is self-contained: *anchor state +
+recorded verbs = a deterministic repro*.
+
+Replay (``python -m hivedscheduler_tpu.sim --replay-recording FILE``)
+restores the anchor through the what-if fork path
+(``_import_snapshot_state`` on a fresh scheduler, exactly like
+``whatif.build_fork``) and re-drives the window's verbs through
+:class:`~..sim.driver.TraceDriver` — placement is a pure function of
+(state, verb order, preempt RNG), so the replay's bind stream is
+fingerprint-identical to the live run's (tests/test_flight_recorder.py
+asserts it at the 432-host bench fleet).
+
+Window management: when the ring reaches capacity the recorder
+**re-anchors** — takes a fresh snapshot export (whose state subsumes every
+recorded event) and starts an empty window. A transient projection
+(preemption in flight — ``export_fork_body`` returns None) defers the
+re-anchor; past a 2x hard cap the oldest events are dropped and the
+window is marked ``truncated`` (served for diagnosis, refused for
+replay). Under ``procShards`` the recorder captures at the FRONTEND
+(pre-routing), so one stream covers all shards; frontend windows anchor
+only at boot (``pristine``) — a merged mid-run anchor across shard
+projections is a recorded follow-on.
+
+Overhead: one dict build + list append per verb, no locks shared with
+the scheduling path beyond the GIL; gated by the interleaved bench A/B
+(``HIVED_BENCH_AUDIT=1``) against the PR-6 <=3% filter-p50 budget.
+``HIVED_FLIGHT_RECORDER=0`` (or ``flightRecorderCapacity: 0``) disables.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import common
+from ..api import constants
+from .types import Node, Pod
+
+FLIGHT_RECORDER_ENV = "HIVED_FLIGHT_RECORDER"
+
+
+def filter_outcome(result) -> str:
+    """THE wire-visible outcome classification of an
+    ExtenderFilterResult — the framework recorder, the shards frontend's
+    recorder, and its trace attrs all share this one implementation
+    (taxonomy changes happen here, once)."""
+    if result is None:
+        return "error"
+    if result.node_names:
+        return "bind"
+    if result.failed_nodes and set(result.failed_nodes) != {
+        constants.COMPONENT_NAME
+    }:
+        return "preempt"
+    return "wait"
+
+
+def record_preempt_result(rec, pod: Pod, args, result) -> None:
+    """THE preempt-verb capture both frontends share: victim uids off
+    the result, outcome = preempt / none (probe found nothing — the
+    free-resource and wait shapes are indistinguishable on the wire) /
+    error (the verb raised)."""
+    victims = (
+        [
+            mp.uid
+            for mv in result.node_name_to_meta_victims.values()
+            for mp in mv.pods
+        ]
+        if result is not None
+        else None
+    )
+    rec.record_preempt(
+        pod,
+        list(args.node_name_to_meta_victims.keys()),
+        "preempt" if victims else (
+            "none" if result is not None else "error"
+        ),
+        victims=victims,
+    )
+
+RECORDING_VERSION = 1
+
+# Fault kinds whose capacity effect the sim tier treats as a retry-wake
+# trigger; recorded on node_state events purely as diagnostic context
+# (verb-level replay re-derives behavior from the verbs themselves).
+_WAKE_KINDS = ("chip_heal", "node_flip", "drain_toggle")
+
+
+def _json_rng_state(state) -> Optional[List]:
+    """random.Random.getstate() -> a JSON-stable [version, [ints], gauss]
+    triple (and back via _rng_state_from_json)."""
+    if state is None:
+        return None
+    try:
+        version, internal, gauss = state
+        return [int(version), [int(x) for x in internal], gauss]
+    except (TypeError, ValueError):
+        return None
+
+
+def _rng_state_from_json(data) -> Optional[Tuple]:
+    if not data:
+        return None
+    try:
+        version, internal, gauss = data
+        return (int(version), tuple(int(x) for x in internal), gauss)
+    except (TypeError, ValueError):
+        return None
+
+
+def _pod_payload(pod: Pod) -> Dict:
+    # The annotation/limit dicts are SHARED, not copied: pod objects are
+    # replaced (never mutated) on every lifecycle change, so the payload
+    # stays a faithful call-time snapshot without two dict copies per
+    # recorded pod on the filter hot path. The one in-place mutation in
+    # the codebase — the preempt-info checkpoint stamped onto a
+    # preemptor pod — touches an annotation the filter replay never
+    # reads (it only matters to recovery), so sharing is repro-safe.
+    return {
+        "name": pod.name,
+        "namespace": pod.namespace,
+        "uid": pod.uid,
+        "annotations": pod.annotations,
+        "resourceLimits": pod.resource_limits,
+        "node": pod.node_name or "",
+        "phase": pod.phase or "",
+    }
+
+
+def _pod_from_payload(payload: Dict) -> Pod:
+    return Pod(
+        name=payload["name"],
+        namespace=payload.get("namespace") or "default",
+        uid=payload["uid"],
+        annotations=dict(payload.get("annotations") or {}),
+        node_name=payload.get("node") or None,
+        phase=payload.get("phase") or "Pending",
+        resource_limits={
+            str(k): int(v)
+            for k, v in (payload.get("resourceLimits") or {}).items()
+        },
+    )
+
+
+class FlightRecorder:
+    """One scheduler's black box. ``exporter`` is the anchor source
+    (``export_fork_body``; None = frontend capture, pristine anchors
+    only); ``rng_state_fn`` snapshots the preempt RNG at (re)anchor."""
+
+    def __init__(
+        self,
+        capacity: int = 2048,
+        exporter: Optional[Callable[[], Optional[Dict]]] = None,
+        rng_state_fn: Optional[Callable[[], object]] = None,
+        config_fingerprint: str = "",
+        granularity: str = "framework",
+        hosts: Optional[int] = None,
+    ):
+        self.capacity = max(16, int(capacity))
+        self.exporter = exporter
+        self.rng_state_fn = rng_state_fn
+        self.config_fingerprint = config_fingerprint
+        self.granularity = granularity
+        self.hosts = hosts
+        self.events: List[Dict] = []
+        self._seq = 0
+        self.total_events = 0
+        self.dropped_events = 0
+        self.reanchor_count = 0
+        self.truncated = False
+        self._need_reanchor = False
+        # Anchor of the CURRENT window. Pristine = "replay from a fresh
+        # scheduler" (valid until the first re-anchor).
+        self.anchor: Dict = {"pristine": True, "body": None,
+                             "rngState": None, "seq": 0}
+        if rng_state_fn is not None:
+            try:
+                self.anchor["rngState"] = _json_rng_state(rng_state_fn())
+            except Exception:  # noqa: BLE001
+                pass
+        # Pod payload registry: events reference payloads by ref so a
+        # gang's spec annotation is stored once per distinct content, not
+        # per re-filter. uid -> (last pod object, last payload, ref) for
+        # the identity/equality fast path.
+        self._pods: Dict[int, Dict] = {}
+        self._pod_memo: Dict[str, Tuple[object, Dict, int]] = {}
+        self._pod_ref_seq = 0
+        # Suggested-node-list registry: identity-memoized first (callers
+        # reusing one list object — the sim driver, filter_fast's memo —
+        # hit in O(1)), content-keyed second (fresh per-request lists pay
+        # one tuple hash). BOTH memos are bounded and clear wholesale:
+        # refs are monotonic and never reused, so forgetting dedup state
+        # only costs a re-registration, never a wrong reference. Each
+        # identity entry holds a strong ref to its list (the id cannot
+        # recycle while the entry lives), capped at a handful.
+        self._node_lists: Dict[int, List[str]] = {}
+        self._nodes_by_id: Dict[int, Tuple[object, int]] = {}
+        self._nodes_by_key: Dict[Tuple, int] = {}
+        self._nodes_ref_seq = 0
+        # Node-index addressing (the sim trace vocabulary): lazily built
+        # from the first node event's scheduler-provided sorted list.
+        self._node_index: Dict[str, int] = {}
+
+    # ------------------------------------------------------------------ #
+    # registries
+    # ------------------------------------------------------------------ #
+
+    def _pod_ref(self, pod: Pod) -> int:
+        memo = self._pod_memo.get(pod.uid)
+        if memo is not None:
+            obj, payload, ref = memo
+            if obj is pod:
+                return ref
+            fresh = _pod_payload(pod)
+            if fresh == payload:
+                self._pod_memo[pod.uid] = (pod, payload, ref)
+                return ref
+            self._pod_ref_seq += 1
+            ref = self._pod_ref_seq
+            self._pods[ref] = fresh
+            self._pod_memo[pod.uid] = (pod, fresh, ref)
+            self._prune_pods()
+            return ref
+        payload = _pod_payload(pod)
+        self._pod_ref_seq += 1
+        ref = self._pod_ref_seq
+        self._pods[ref] = payload
+        self._pod_memo[pod.uid] = (pod, payload, ref)
+        self._prune_pods()
+        return ref
+
+    def _prune_pods(self) -> None:
+        """Drop pod payloads (and memo pins) no live window event
+        references. The re-anchor path clears these wholesale, but a
+        frontend recorder (exporter=None) never re-anchors — a
+        long-lived frontend must not accrete one payload per pod
+        lifetime forever (the same discipline as _prune_node_lists)."""
+        if len(self._pods) <= max(4096, 2 * self.capacity):
+            return
+        live = {ev.get("pod") for ev in self.events}
+        live |= {ev.get("old") for ev in self.events}
+        self._pods = {r: p for r, p in self._pods.items() if r in live}
+        self._pod_memo = {
+            uid: entry
+            for uid, entry in self._pod_memo.items()
+            if entry[2] in self._pods
+        }
+
+    def _nodes_ref(self, node_names) -> int:
+        hit = self._nodes_by_id.get(id(node_names))
+        if hit is not None and hit[0] is node_names:
+            return hit[1]
+        key = tuple(node_names)
+        ref = self._nodes_by_key.get(key)
+        if ref is None:
+            if len(self._nodes_by_key) > 64:
+                self._nodes_by_key.clear()
+            self._nodes_ref_seq += 1
+            ref = self._nodes_ref_seq
+            self._node_lists[ref] = [str(n) for n in key]
+            self._nodes_by_key[key] = ref
+            self._prune_node_lists()
+        if len(self._nodes_by_id) > 8:
+            self._nodes_by_id.clear()
+        self._nodes_by_id[id(node_names)] = (node_names, ref)
+        return ref
+
+    def _prune_node_lists(self) -> None:
+        """Drop list payloads no live window event references (distinct
+        content is rare — the filter_fast premise — but a long-lived
+        frontend must not accrete payloads forever)."""
+        if len(self._node_lists) <= 4096:
+            return
+        live = {ev.get("nodes") for ev in self.events}
+        self._node_lists = {
+            r: v for r, v in self._node_lists.items() if r in live
+        }
+        self._nodes_by_key.clear()
+        self._nodes_by_id.clear()
+
+    def set_node_universe(self, names) -> None:
+        """The sorted configured node list, for trace-vocabulary
+        nodeIndex addressing on node events."""
+        self._node_index = {str(n): i for i, n in enumerate(sorted(names))}
+
+    # ------------------------------------------------------------------ #
+    # window management
+    # ------------------------------------------------------------------ #
+
+    def force_reanchor(self) -> None:
+        """State was rewritten outside the verb stream (recovery,
+        snapshot restore): the current window no longer replays. The next
+        recorded verb re-anchors instead of appending."""
+        self._need_reanchor = True
+
+    def note_rng_state(self, rng) -> None:
+        """The preempt RNG was (re)seeded (the sim driver / shard seeding
+        path). Pre-window it updates the anchor; mid-window it records a
+        seed event the replay re-applies."""
+        state = _json_rng_state(rng.getstate())
+        if not self.events and not self._need_reanchor:
+            self.anchor["rngState"] = state
+        else:
+            self._append({"kind": "seed_rng", "state": state})
+
+    def _try_anchor(self) -> bool:
+        if self.exporter is None:
+            return False
+        try:
+            body = self.exporter()
+        except Exception:  # noqa: BLE001 — recording must never raise
+            common.log.exception("flight-recorder anchor export failed")
+            return False
+        if body is None:
+            return False  # transient projection: defer
+        rng_state = None
+        if self.rng_state_fn is not None:
+            try:
+                rng_state = _json_rng_state(self.rng_state_fn())
+            except Exception:  # noqa: BLE001
+                pass
+        self.anchor = {
+            "pristine": False,
+            "body": body,
+            "rngState": rng_state,
+            "seq": self._seq,
+        }
+        self.events = []
+        self._pods = {}
+        self._pod_memo = {}
+        self._node_lists = {}
+        self._nodes_by_key.clear()
+        self._nodes_by_id.clear()
+        self.truncated = False
+        self.reanchor_count += 1
+        return True
+
+    def _append(self, ev: Dict) -> None:
+        if self._need_reanchor:
+            if self._try_anchor():
+                self._need_reanchor = False
+                # The triggering verb's effects are inside the fresh
+                # anchor — appending it too would double-apply on replay.
+                return
+            # Cannot anchor (frontend, or transient): the window is torn
+            # until an anchor lands; keep the tail for diagnosis.
+            self.truncated = True
+        self._seq += 1
+        self.total_events += 1
+        ev["seq"] = self._seq
+        ev["t"] = float(self._seq)
+        self.events.append(ev)
+        if len(self.events) >= self.capacity:
+            if not self._try_anchor() and len(self.events) >= 2 * self.capacity:
+                drop = len(self.events) - 2 * self.capacity + 1
+                del self.events[:drop]
+                self.dropped_events += drop
+                self.truncated = True
+
+    # ------------------------------------------------------------------ #
+    # verb hooks (called by the framework / frontend, outside locks)
+    # ------------------------------------------------------------------ #
+
+    def record_filter(self, pod: Pod, node_names, outcome: str,
+                      node: str = "", leaf_cells=None,
+                      error: str = "") -> None:
+        ev: Dict = {
+            "kind": "filter",
+            "pod": self._pod_ref(pod),
+            "uid": pod.uid,
+            "nodes": self._nodes_ref(node_names),
+            "outcome": outcome,
+        }
+        if node:
+            ev["node"] = node
+        if leaf_cells:
+            # The raw isolation annotation string (framework capture) or
+            # a list (tests); the fingerprint treats it as opaque.
+            ev["leafCells"] = leaf_cells
+        if error:
+            ev["error"] = error[:200]
+        self._append(ev)
+
+    def record_filter_wire(self, request: Dict, outcome: str,
+                           node: str = "") -> None:
+        """filter_raw capture from the already-decoded request dict —
+        the raw hot path must not rebuild dataclasses per call. The memo
+        is keyed by uid + annotation-dict equality, so a re-filtered pod
+        (the retry-storm regime) costs one small dict compare; full pod
+        construction runs only on first sight or a changed spec."""
+        pod_d = request.get("Pod") or {}
+        md = pod_d.get("metadata") or {}
+        uid = str(md.get("uid") or "")
+        ann = md.get("annotations") or {}
+        memo = self._pod_memo.get(uid)
+        if memo is not None and memo[1].get("annotations") == ann:
+            ref = memo[2]
+        else:
+            from ..api import extender as ei
+
+            ref = self._pod_ref(ei.pod_from_k8s(pod_d))
+        ev: Dict = {
+            "kind": "filter",
+            "pod": ref,
+            "uid": uid,
+            "nodes": self._nodes_ref(request.get("NodeNames") or []),
+            "outcome": outcome,
+        }
+        if node:
+            ev["node"] = node
+        self._append(ev)
+
+    def record_preempt(self, pod: Pod, candidate_nodes, outcome: str,
+                       victims=None) -> None:
+        ev: Dict = {
+            "kind": "preempt",
+            "pod": self._pod_ref(pod),
+            "uid": pod.uid,
+            "nodes": self._nodes_ref(list(candidate_nodes)),
+            "outcome": outcome,
+        }
+        if victims:
+            ev["victims"] = sorted(victims)
+        self._append(ev)
+
+    def record_bind(self, pod_name: str, namespace: str, uid: str,
+                    node: str, ok: bool) -> None:
+        self._append({
+            "kind": "bind", "uid": uid, "podName": pod_name,
+            "namespace": namespace, "node": node, "ok": bool(ok),
+        })
+
+    def record_pod_event(self, kind: str, pod: Pod) -> None:
+        """kind in pod_add / pod_delete."""
+        ev: Dict = {"kind": kind, "uid": pod.uid}
+        if kind != "pod_delete":
+            ev["pod"] = self._pod_ref(pod)
+        self._append(ev)
+
+    def record_pod_update(self, old: Pod, new: Pod) -> None:
+        """One event carrying both sides (replay re-issues
+        update_pod(old, new) — the framework's uid-change and
+        bound-transition semantics re-derive from the pair)."""
+        self._append({
+            "kind": "pod_update",
+            "uid": new.uid,
+            "old": self._pod_ref(old),
+            "pod": self._pod_ref(new),
+        })
+
+    def record_node_event(self, kind: str, node: Node,
+                          fault: str = "") -> None:
+        """kind in node_add / node_state / node_delete; ``fault`` is the
+        chaos-vocabulary kind derived from the projection diff
+        (node_flip / chip_fault / chip_heal / drain_toggle)."""
+        ev: Dict = {
+            "kind": kind,
+            "node": node.name,
+            "nodeIndex": self._node_index.get(node.name, -1),
+        }
+        if kind != "node_delete":
+            ev["ready"] = bool(node.ready)
+            if node.annotations:
+                ev["annotations"] = dict(node.annotations)
+        if fault:
+            ev["fault"] = fault
+            ev["wake"] = fault in _WAKE_KINDS
+        self._append(ev)
+
+    def record_marker(self, kind: str, **fields) -> None:
+        """Clock/defrag verbs: health_tick, settle_health, defrag_cycle,
+        defrag_take, defrag_report."""
+        ev = {"kind": kind}
+        ev.update(fields)
+        self._append(ev)
+
+    # ------------------------------------------------------------------ #
+    # serving / dumping
+    # ------------------------------------------------------------------ #
+
+    def recording(self) -> Dict:
+        """The full dumpable window (the unit --replay-recording
+        consumes)."""
+        return {
+            "version": RECORDING_VERSION,
+            "kind": "flightRecording",
+            "configFingerprint": self.config_fingerprint,
+            "granularity": self.granularity,
+            "hosts": self.hosts,
+            "truncated": self.truncated,
+            "anchor": self.anchor,
+            "events": list(self.events),
+            "pods": {str(ref): p for ref, p in self._pods.items()},
+            "nodeLists": {
+                str(ref): names for ref, names in self._node_lists.items()
+            },
+            "meta": {
+                "capacity": self.capacity,
+                "windowEvents": len(self.events),
+                "totalEvents": self.total_events,
+                "droppedEvents": self.dropped_events,
+                "reanchors": self.reanchor_count,
+            },
+        }
+
+    def summary(self) -> Dict:
+        """The cheap inspect payload (?full=1 serves the recording)."""
+        kinds: Dict[str, int] = {}
+        for ev in self.events:
+            kinds[ev["kind"]] = kinds.get(ev["kind"], 0) + 1
+        return {
+            "granularity": self.granularity,
+            "truncated": self.truncated,
+            "anchorPristine": bool(self.anchor.get("pristine")),
+            "anchorSeq": self.anchor.get("seq", 0),
+            "windowEvents": len(self.events),
+            "totalEvents": self.total_events,
+            "droppedEvents": self.dropped_events,
+            "reanchors": self.reanchor_count,
+            "capacity": self.capacity,
+            "eventKinds": kinds,
+            "fingerprint": events_fingerprint(
+                self.events, self.granularity
+            ),
+        }
+
+    def dump(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.recording(), f, separators=(",", ":"))
+        return path
+
+    def metrics_snapshot(self) -> Dict:
+        return {
+            "flightRecorderEventCount": self.total_events,
+            "flightRecorderReanchorCount": self.reanchor_count,
+        }
+
+
+# --------------------------------------------------------------------- #
+# Replay: anchor restore (the what-if fork path) + verb re-drive
+# --------------------------------------------------------------------- #
+
+
+def recording_fingerprint(recording: Dict,
+                          granularity: Optional[str] = None) -> str:
+    """The placement fingerprint of a recording window: the ordered
+    stream of scheduling OUTCOMES — every filter bind (pod -> node, plus
+    chip isolation when the capture layer had it) and every preempt
+    victim set. Two windows with equal fingerprints placed identically in
+    the same order. ``granularity`` lets a replay (which always captures
+    at the framework layer, chips included) fingerprint itself at a
+    frontend-captured recording's coarser (pod, node) granularity."""
+    return events_fingerprint(
+        recording.get("events") or [],
+        granularity or recording.get("granularity") or "framework",
+    )
+
+
+def events_fingerprint(events: List[Dict], gran: str) -> str:
+    """recording_fingerprint over a live event list (the summary path
+    must not copy the whole window just to hash its bind stream)."""
+    items: List = []
+    for ev in events:
+        kind = ev.get("kind")
+        if kind == "filter" and ev.get("outcome") == "bind":
+            item = ["bind", ev.get("uid"), ev.get("node")]
+            if gran == "framework":
+                # Opaque isolation token (the raw annotation string, or
+                # a list from test-built events) — normalized to str so
+                # both shapes compare stably.
+                iso = ev.get("leafCells")
+                item.append(
+                    ",".join(str(x) for x in iso)
+                    if isinstance(iso, (list, tuple))
+                    else str(iso or "")
+                )
+            items.append(item)
+        elif kind == "preempt" and ev.get("victims"):
+            items.append(["preempt", ev.get("uid"),
+                          list(ev.get("victims"))])
+    blob = json.dumps(items, separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def build_replay_subject(recording: Dict, config):
+    """A scheduler restored to the recording's anchor, through the
+    what-if fork path (whatif.build_fork minus the live scheduler):
+    fresh instance, ``_import_snapshot_state`` of the anchor body, RNG
+    state reinstated. The subject carries its OWN fresh flight recorder
+    (capacity = the window) so the replay's bind stream fingerprints."""
+    from .framework import HivedScheduler, NullKubeClient
+
+    if recording.get("truncated"):
+        raise ValueError(
+            "recording window is truncated (events were dropped while the "
+            "projection stayed transient); it documents the incident but "
+            "cannot replay deterministically"
+        )
+    sched = HivedScheduler(
+        config,
+        kube_client=NullKubeClient(),
+        auto_admit=True,
+        global_lock=True,
+        trace_sample=0.0,
+        force_bind_executor=lambda fn: None,
+        flight_recorder=False,
+        live_audit=False,
+    )
+    fp = getattr(sched, "_config_fingerprint", "")
+    want = recording.get("configFingerprint") or ""
+    if want and fp and want != fp:
+        raise ValueError(
+            f"recording was captured under config fingerprint "
+            f"{want[:12]}..., replay config is {fp[:12]}... — placements "
+            f"would not be comparable"
+        )
+    anchor = recording.get("anchor") or {}
+    if not anchor.get("pristine"):
+        body = anchor.get("body")
+        if body is None:
+            raise ValueError("recording anchor carries no snapshot body")
+        sched._import_snapshot_state(body, live_names=None)
+        with sched._lock:
+            sched._snapshot_pending.clear()
+            sched._snapshot_claims.clear()
+    state = _rng_state_from_json(anchor.get("rngState"))
+    if state is not None:
+        import random as _random
+
+        if sched.core.preempt_rng is None:
+            sched.core.preempt_rng = _random.Random()
+        sched.core.preempt_rng.setstate(state)
+    # The replay's own black box: same capacity, framework granularity.
+    replay_rec = FlightRecorder(
+        capacity=max(64, len(recording.get("events") or []) + 16),
+        exporter=None,
+        config_fingerprint=fp,
+        granularity="framework",
+    )
+    replay_rec.set_node_universe(sched.core.configured_node_names())
+    sched.recorder = replay_rec
+    return sched
+
+
+def replay_recording(recording: Dict, config) -> Dict:
+    """Restore the anchor and replay the window through TraceDriver
+    (``TraceDriver.replay_recording``); returns the comparison report:
+    live vs replayed fingerprints, per-kind counts, divergence flag."""
+    from ..sim.driver import TraceDriver
+
+    subject = build_replay_subject(recording, config)
+    driver = TraceDriver(config, scheduler=subject, prepare_nodes=False)
+    counts = driver.replay_recording(recording)
+    live_fp = recording_fingerprint(recording)
+    gran = recording.get("granularity") or "framework"
+    replay_fp = recording_fingerprint(
+        subject.recorder.recording(), granularity=gran
+    )
+    return {
+        "liveFingerprint": live_fp,
+        "replayFingerprint": replay_fp,
+        "identical": live_fp == replay_fp,
+        "granularity": gran,
+        "anchorPristine": bool(
+            (recording.get("anchor") or {}).get("pristine")
+        ),
+        "events": counts,
+    }

@@ -80,6 +80,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 from .. import common
 from ..api import constants, extender as ei, types as api
 from ..api.config import Config
+from . import recorder as recorder_pkg
 from .framework import HivedScheduler, KubeClient, NullKubeClient
 from .types import (
     Node,
@@ -455,11 +456,16 @@ class ShardServer:
         # Synchronous force-bind executor: a worker serves one request at
         # a time, so the bind re-entry must complete within the turn (the
         # async default would race the request loop on the pipe).
+        # flight_recorder=False: under procShards the black-box recorder
+        # captures at the FRONTEND (pre-routing) so one stream covers all
+        # shards; the live auditor stays per-shard (each worker audits
+        # its own core at the cadence).
         self.scheduler = HivedScheduler(
             config,
             kube_client=kube_client,
             force_bind_executor=lambda fn: fn(),
             auto_admit=auto_admit,
+            flight_recorder=False,
         )
         self._staged: Dict[int, Tuple[str, tuple]] = {}
         # filter_fast's memoized suggested-node lists, keyed by the
@@ -489,43 +495,50 @@ class ShardServer:
 
         self.scheduler.core.preempt_rng = random.Random(seed)
 
-    def filter_routine_raw(self, body: bytes) -> bytes:
+    def filter_routine_raw(self, body: bytes, trace_parent=None) -> bytes:
         """The raw-bytes filter hot path: JSON decode/encode happens HERE,
         in the worker, so the parent's per-call GIL work is a route-cache
         hit and a pipe write — the parent must never become the serial
         bottleneck the GIL was (doc/hot-path.md "The multi-process
         contract"). Error semantics mirror the webserver's filter handler:
-        protocol errors return in-band."""
-        try:
-            args = ei.ExtenderArgs.from_dict(json.loads(body))
-            result = self.scheduler.filter_routine(args)
-        except api.WebServerError as e:
-            result = ei.ExtenderFilterResult(error=e.message)
-        return json.dumps(result.to_dict()).encode()
-
-    def filter_sweep(
-        self, args: ei.ExtenderArgs, leaf_types
-    ) -> ei.ExtenderFilterResult:
-        """One chunk of the frontend's leaf-type-granular sweep: the
-        any-leaf-type scan restricted to this shard's consecutive run of
-        the global sorted leaf-type order (see the module docstring)."""
-        return self.scheduler.filter_routine(
-            args, leaf_types=tuple(leaf_types)
-        )
-
-    def filter_sweep_raw(self, body: bytes, leaf_types) -> bytes:
-        """filter_sweep over the raw-bytes wire path (decode/encode in
-        the worker, like filter_routine_raw)."""
+        protocol errors return in-band. ``trace_parent`` is the frontend
+        trace id when the frontend sampled this request — the worker's
+        trace commits as its child (causal cross-shard stitching)."""
         try:
             args = ei.ExtenderArgs.from_dict(json.loads(body))
             result = self.scheduler.filter_routine(
-                args, leaf_types=tuple(leaf_types)
+                args, trace_parent=trace_parent
             )
         except api.WebServerError as e:
             result = ei.ExtenderFilterResult(error=e.message)
         return json.dumps(result.to_dict()).encode()
 
-    def filter_fast(self, pod_dict: Dict, nodes_key, nodes) -> Dict:
+    def filter_sweep(
+        self, args: ei.ExtenderArgs, leaf_types, trace_parent=None
+    ) -> ei.ExtenderFilterResult:
+        """One chunk of the frontend's leaf-type-granular sweep: the
+        any-leaf-type scan restricted to this shard's consecutive run of
+        the global sorted leaf-type order (see the module docstring)."""
+        return self.scheduler.filter_routine(
+            args, leaf_types=tuple(leaf_types), trace_parent=trace_parent
+        )
+
+    def filter_sweep_raw(self, body: bytes, leaf_types,
+                         trace_parent=None) -> bytes:
+        """filter_sweep over the raw-bytes wire path (decode/encode in
+        the worker, like filter_routine_raw)."""
+        try:
+            args = ei.ExtenderArgs.from_dict(json.loads(body))
+            result = self.scheduler.filter_routine(
+                args, leaf_types=tuple(leaf_types),
+                trace_parent=trace_parent,
+            )
+        except api.WebServerError as e:
+            result = ei.ExtenderFilterResult(error=e.message)
+        return json.dumps(result.to_dict()).encode()
+
+    def filter_fast(self, pod_dict: Dict, nodes_key, nodes,
+                    trace_parent=None) -> Dict:
         """Node-list-memoized filter: the suggested-node list is by far
         the largest slice of every filter payload and is near-constant
         across calls (the default scheduler sends the same candidate set
@@ -552,7 +565,9 @@ class ShardServer:
             args = ei.ExtenderArgs(
                 pod=ei.pod_from_k8s(pod_dict), node_names=nodes
             )
-            result = self.scheduler.filter_routine(args)
+            result = self.scheduler.filter_routine(
+                args, trace_parent=trace_parent
+            )
         except api.WebServerError as e:
             result = ei.ExtenderFilterResult(error=e.message)
         return result.to_dict()
@@ -1365,12 +1380,42 @@ class ShardedScheduler:
         # whole replay fans out at finish_recovery.
         self._informer_capture: Optional[Dict] = None
         # The informer forces recovery traces; the frontend's own ring
-        # carries them (workers keep their own per-shard rings).
+        # carries them (workers keep their own per-shard rings), and its
+        # FILTER traces are the causal parents worker traces stitch under
+        # in the merged /v1/inspect/traces.
         from . import tracing as tracing_mod
 
         self.tracer = tracing_mod.Tracer(
             sample=None, capacity=config.trace_ring_capacity
         )
+        # Black-box flight recorder, FRONTEND capture (pre-routing): one
+        # stream covers all shards. Frontend windows anchor only at boot
+        # (pristine) — merging mid-run anchors across shard projections
+        # is a recorded follow-on (scheduler.recorder module docstring).
+        from . import recorder as recorder_mod
+        from . import snapshot as snapshot_mod
+
+        self.recorder = None
+        if (
+            config.flight_recorder_capacity > 0
+            and os.environ.get(
+                recorder_mod.FLIGHT_RECORDER_ENV, "1"
+            ).strip() != "0"
+        ):
+            self.recorder = recorder_mod.FlightRecorder(
+                capacity=config.flight_recorder_capacity,
+                exporter=None,
+                config_fingerprint=snapshot_mod.config_fingerprint(
+                    config
+                ),
+                granularity="frontend",
+            )
+            self.recorder.set_node_universe(
+                self.configured_node_names()
+            )
+        # Nested-verb guard for the recorder (update_pod's delete+add
+        # degrade must not double-record).
+        self._rec_nested = threading.local()
 
     # -- kube brokering (parent side) -------------------------------- #
 
@@ -1496,10 +1541,38 @@ class ShardedScheduler:
 
     def filter_routine(self, args: ei.ExtenderArgs) -> ei.ExtenderFilterResult:
         pod = args.pod
+        # Causal cross-shard tracing: the frontend's (sampled) trace id
+        # travels over the pipe protocol as the worker trace's parent, so
+        # the merged /v1/inspect/traces stitches worker spans under the
+        # frontend span instead of interleaving unrelated rings.
+        tr = self.tracer.trace("filter", pod=pod.key)
+        parent = tr.trace_id if tr else None
+        result: Optional[ei.ExtenderFilterResult] = None
+        try:
+            result = self._filter_routine_traced(args, tr, parent)
+            return result
+        finally:
+            rec = self.recorder
+            if rec is not None:
+                try:
+                    self._record_frontend_filter(
+                        rec, pod, args.node_names, result
+                    )
+                except Exception:  # noqa: BLE001
+                    common.log.exception("flight-recorder hook failed")
+
+    def _filter_routine_traced(
+        self, args: ei.ExtenderArgs, tr, parent
+    ) -> ei.ExtenderFilterResult:
+        pod = args.pod
         sid = self._route(pod)
         if sid is not None:
-            result = self.shards[sid].call("filter_routine", args)
+            with tr.span("shardCall", shard=sid):
+                result = self.shards[sid].call(
+                    "filter_routine", args, None, parent
+                )
             self._note_routed(pod, sid)
+            tr.finish(outcome=_frontend_outcome(result), shard=sid)
             return result
         # Sweep (cross-family untyped pod): leaf-type-granular, in the
         # global sorted leaf-type order — each chunk is a consecutive
@@ -1508,19 +1581,34 @@ class ShardedScheduler:
         # single process's any-leaf-type scan finds (module docstring).
         result = None
         for sid, leaf_types in self._sweep_chunks:
-            result = self.shards[sid].call(
-                "filter_sweep", args, leaf_types
-            )
+            with tr.span("shardCall", shard=sid, sweep=True):
+                result = self.shards[sid].call(
+                    "filter_sweep", args, leaf_types, parent
+                )
             if result.node_names or (
                 result.failed_nodes
                 and set(result.failed_nodes) != {constants.COMPONENT_NAME}
             ):
                 self._note_routed(pod, sid)
+                tr.finish(outcome=_frontend_outcome(result), shard=sid)
                 return result
+        tr.finish(outcome="wait", sweep=True)
         return result if result is not None else ei.ExtenderFilterResult(
             failed_nodes={
                 constants.COMPONENT_NAME: "no shard can serve this pod"
             }
+        )
+
+    def _record_frontend_filter(self, rec, pod, node_names, result):
+        """Frontend (pre-routing) capture: one stream covers all shards.
+        (pod, node) granularity — chip isolation lives shard-side."""
+        rec.record_filter(
+            pod, node_names, _frontend_outcome(result),
+            node=(
+                result.node_names[0]
+                if result is not None and result.node_names
+                else ""
+            ),
         )
 
     def filter_raw(self, body: bytes) -> bytes:
@@ -1530,13 +1618,37 @@ class ShardedScheduler:
         builds the dataclasses or re-encodes — its per-call cost is one
         C-level json.loads of the body (~50us at 432 hosts) plus a
         route-cache hit, with the decoded node list reused for the
-        filter_fast memo key."""
+        filter_fast memo key. A sampled frontend trace id rides the pipe
+        as the worker trace's parent; the (frontend-level) flight
+        recorder classifies the encoded reply without re-decoding more
+        than the outcome fields."""
         try:
             d = json.loads(body)
         except (ValueError, TypeError) as e:
             return json.dumps(ei.ExtenderFilterResult(
                 error=f"Failed to unmarshal request body: {e}"
             ).to_dict()).encode()
+        out_bytes, outcome, node = self._filter_raw_routed(d, body)
+        rec = self.recorder
+        if rec is not None:
+            try:
+                # Outcome classified from the ALREADY-decoded worker
+                # reply inside the routed path, pod memoized from the
+                # decoded request — the recorder costs the raw hot path
+                # no reply re-decode and no per-call dataclass rebuild.
+                rec.record_filter_wire(d, outcome, node=node)
+            except Exception:  # noqa: BLE001
+                common.log.exception("flight-recorder hook failed")
+        return out_bytes
+
+    def _filter_raw_routed(
+        self, d: Dict, body: bytes
+    ) -> Tuple[bytes, str, str]:
+        """Returns (encoded reply, outcome class, bound node or "") —
+        the outcome rides along from wherever the reply was already a
+        decoded dict, so the recorder never re-parses the bytes."""
+        tr = self.tracer.trace("filter")
+        parent = tr.trace_id if tr else None
         pod_d = d.get("Pod") or {}
         md = pod_d.get("metadata") or {}
         ann = str((md.get("annotations") or {}).get(
@@ -1574,27 +1686,33 @@ class ShardedScheduler:
                         self._nodes_id_seq
                     )
                 send_full = nid not in self._nodes_sent[sid]
-            out = self.shards[sid].call(
-                "filter_fast", pod_d, nid, nodes if send_full else None
-            )
-            if out.get("__needNodes"):
+            with tr.span("shardCall", shard=sid):
                 out = self.shards[sid].call(
-                    "filter_fast", pod_d, nid, nodes
+                    "filter_fast", pod_d, nid,
+                    nodes if send_full else None, parent,
                 )
+                if out.get("__needNodes"):
+                    out = self.shards[sid].call(
+                        "filter_fast", pod_d, nid, nodes, parent
+                    )
             with self._maps_lock:
                 self._nodes_sent[sid].add(nid)
                 self._uid_shard[uid] = sid
                 if cached[1]:
                     self._group_shard[cached[1]] = sid
-            return json.dumps(out).encode()
+            tr.finish(pod=uid, shard=sid)
+            outcome, bound = _raw_outcome(out)
+            return json.dumps(out).encode(), outcome, bound
         # Sweep (cross-family untyped pod): leaf-type-granular chunks in
         # the global sorted leaf-type order, first non-wait outcome wins
         # (identical probe order to the in-process scan).
         out = None
+        r = None
         for sid, leaf_types in self._sweep_chunks:
-            out = self.shards[sid].call(
-                "filter_sweep_raw", body, leaf_types
-            )
+            with tr.span("shardCall", shard=sid, sweep=True):
+                out = self.shards[sid].call(
+                    "filter_sweep_raw", body, leaf_types, parent
+                )
             r = json.loads(out)
             if r.get("NodeNames") or r.get("Error") or (
                 r.get("FailedNodes")
@@ -1604,47 +1722,102 @@ class ShardedScheduler:
                     self._uid_shard[uid] = sid
                     if cached is not None and cached[1]:
                         self._group_shard[cached[1]] = sid
-                return out
-        return out if out is not None else json.dumps(
+                tr.finish(pod=uid, shard=sid, sweep=True)
+                outcome, bound = _raw_outcome(r)
+                return out, outcome, bound
+        tr.finish(pod=uid, sweep=True)
+        if out is not None:
+            outcome, bound = _raw_outcome(r)
+            return out, outcome, bound
+        return json.dumps(
             ei.ExtenderFilterResult(failed_nodes={
                 constants.COMPONENT_NAME: "no shard can serve this pod"
             }).to_dict()
-        ).encode()
+        ).encode(), "wait", ""
 
     def preempt_routine(
         self, args: ei.ExtenderPreemptionArgs
     ) -> ei.ExtenderPreemptionResult:
         pod = args.pod
-        sid = self._route(pod)
-        if sid is not None:
-            result = self.shards[sid].call("preempt_routine", args)
-            self._note_routed(pod, sid)
-            return result
-        result = None
-        for sid, backend in enumerate(self.shards):
-            result = backend.call("preempt_routine", args)
-            if result.node_name_to_meta_victims:
+        tr = self.tracer.trace("preempt", pod=pod.key)
+        parent = tr.trace_id if tr else None
+        result: Optional[ei.ExtenderPreemptionResult] = None
+        try:
+            sid = self._route(pod)
+            if sid is not None:
+                with tr.span("shardCall", shard=sid):
+                    result = self.shards[sid].call(
+                        "preempt_routine", args, parent
+                    )
                 self._note_routed(pod, sid)
+                tr.finish(shard=sid)
                 return result
-        return result if result is not None else (
-            ei.ExtenderPreemptionResult()
-        )
+            for sid, backend in enumerate(self.shards):
+                with tr.span("shardCall", shard=sid):
+                    result = backend.call("preempt_routine", args, parent)
+                if result.node_name_to_meta_victims:
+                    self._note_routed(pod, sid)
+                    tr.finish(shard=sid)
+                    return result
+            tr.finish()
+            return result if result is not None else (
+                ei.ExtenderPreemptionResult()
+            )
+        finally:
+            rec = self.recorder
+            if rec is not None:
+                try:
+                    recorder_pkg.record_preempt_result(
+                        rec, pod, args, result
+                    )
+                except Exception:  # noqa: BLE001
+                    common.log.exception("flight-recorder hook failed")
 
     def bind_routine(
         self, args: ei.ExtenderBindingArgs
     ) -> ei.ExtenderBindingResult:
+        tr = self.tracer.trace("bind", pod=args.pod_uid)
+        parent = tr.trace_id if tr else None
+        ok = False
+        try:
+            result = self._bind_routine_routed(args, tr, parent)
+            ok = True
+            return result
+        finally:
+            rec = self.recorder
+            if rec is not None:
+                try:
+                    rec.record_bind(
+                        args.pod_name, args.pod_namespace, args.pod_uid,
+                        args.node, ok,
+                    )
+                except Exception:  # noqa: BLE001
+                    common.log.exception("flight-recorder hook failed")
+
+    def _bind_routine_routed(
+        self, args: ei.ExtenderBindingArgs, tr, parent
+    ) -> ei.ExtenderBindingResult:
         with self._maps_lock:
             sid = self._uid_shard.get(args.pod_uid)
         if sid is not None:
-            return self.shards[sid].call("bind_routine", args)
+            with tr.span("shardCall", shard=sid):
+                result = self.shards[sid].call(
+                    "bind_routine", args, parent
+                )
+            tr.finish(shard=sid)
+            return result
         # Unknown uid (e.g. a bind racing recovery): ask each shard; the
         # non-owners reject with the admission protocol error.
         last: Optional[api.WebServerError] = None
         for backend in self.shards:
             try:
-                return backend.call("bind_routine", args)
+                with tr.span("shardCall", shard=backend.shard_id):
+                    result = backend.call("bind_routine", args, parent)
+                tr.finish(shard=backend.shard_id)
+                return result
             except api.WebServerError as e:
                 last = e
+        tr.finish(outcome="error")
         raise last if last is not None else api.bad_request(
             "Pod does not exist, completed or has not been informed to "
             "the scheduler"
@@ -1658,11 +1831,25 @@ class ShardedScheduler:
 
     # -- pod lifecycle events ----------------------------------------- #
 
+    def _record(self, method: str, *args) -> None:
+        """Frontend flight-recorder capture for the informer verbs (the
+        extender verbs record inline where the outcome is known). Nested
+        verbs (update_pod's delete+add degrade) are not re-recorded —
+        the outer event replays them through the same degrade path."""
+        rec = self.recorder
+        if rec is None or getattr(self._rec_nested, "d", 0):
+            return
+        try:
+            getattr(rec, method)(*args)
+        except Exception:  # noqa: BLE001 — recording must never raise
+            common.log.exception("flight-recorder hook failed")
+
     def add_pod(self, pod: Pod) -> None:
         if self._informer_capture is not None:
             # Informer boot replay: finish_recovery's authoritative pod
             # list carries this pod into the fan-out.
             return
+        self._record("record_pod_event", "pod_add", pod)
         sid = self._route(pod)
         if sid is not None:
             self.shards[sid].call("add_pod", pod)
@@ -1675,6 +1862,7 @@ class ShardedScheduler:
             backend.call("add_pod", pod)
 
     def update_pod(self, old: Pod, new: Pod) -> None:
+        self._record("record_pod_update", old, new)
         sid_old, sid_new = self._route(old), self._route(new)
         if sid_old == sid_new and sid_new is not None:
             self.shards[sid_new].call("update_pod", old, new)
@@ -1685,11 +1873,17 @@ class ShardedScheduler:
                 backend.call("update_pod", old, new)
             return
         # Routing moved (uid change across SKUs, or one side unroutable):
-        # degrade to delete+add, the framework's own fallback shape.
-        self.delete_pod(old)
-        self.add_pod(new)
+        # degrade to delete+add, the framework's own fallback shape (the
+        # nested pair is NOT re-recorded — the update event replays it).
+        self._rec_nested.d = getattr(self._rec_nested, "d", 0) + 1
+        try:
+            self.delete_pod(old)
+            self.add_pod(new)
+        finally:
+            self._rec_nested.d -= 1
 
     def delete_pod(self, pod: Pod) -> None:
+        self._record("record_pod_event", "pod_delete", pod)
         sid = self._route(pod)
         if sid is not None:
             meta = self.shards[sid].call("delete_pod_meta", pod)
@@ -1712,6 +1906,8 @@ class ShardedScheduler:
         NO shard still holds the group (any shard's live group keeps the
         pin — judging liveness by one arbitrary shard could unpin a gang
         that is still placed elsewhere)."""
+        for pod in pods:
+            self._record("record_pod_event", "pod_delete", pod)
         per_shard: Dict[Optional[int], List[Pod]] = {}
         for pod in pods:
             per_shard.setdefault(self._route(pod), []).append(pod)
@@ -1977,6 +2173,7 @@ class ShardedScheduler:
         if self._informer_capture is not None:
             self._informer_capture["nodes"].append(node)
             return
+        self._record("record_node_event", "node_add", node)
         self._broadcast("add_node", (node,), self._node_targets(node.name))
 
     def add_nodes(self, nodes: List[Node]) -> None:
@@ -1986,6 +2183,8 @@ class ShardedScheduler:
         if self._informer_capture is not None:
             self._informer_capture["nodes"].extend(nodes)
             return
+        for node in nodes:
+            self._record("record_node_event", "node_add", node)
         per_targets: Dict[Tuple[int, ...], List[Node]] = {}
         for node in nodes:
             key = tuple(self._node_targets(node.name))
@@ -1997,22 +2196,27 @@ class ShardedScheduler:
         if self._informer_capture is not None:
             self._informer_capture["nodes"].append(new)
             return
+        self._record("record_node_event", "node_state", new)
         self._broadcast(
             "update_node", (old, new), self._node_targets(new.name)
         )
 
     def delete_node(self, node: Node) -> None:
+        self._record("record_node_event", "node_delete", node)
         self._broadcast(
             "delete_node", (node,), self._node_targets(node.name)
         )
 
     def health_tick(self) -> None:
+        self._record("record_marker", "health_tick")
         self._broadcast("health_tick", ())
 
     def settle_health_now(self) -> None:
+        self._record("record_marker", "settle_health")
         self._broadcast("settle_health_now", ())
 
     def settle_health_wall(self) -> None:
+        self._record("record_marker", "settle_health_wall")
         self._broadcast("settle_health_wall", ())
 
     def health_pending_count(self) -> int:
@@ -2242,6 +2446,17 @@ class ShardedScheduler:
         merged["leader"] = self.is_leader()
         merged["ready"] = self.is_ready()
         merged["deposedBindRefusedCount"] += self._deposed_bind_refused
+        # Black-box plane: shard-side audit counters already summed by
+        # _merge_metrics; the recorder captures at the FRONTEND (workers
+        # run with theirs off), so its counters are the frontend's.
+        rec = self.recorder
+        if rec is not None:
+            for k, v in rec.metrics_snapshot().items():
+                merged[k] = merged.get(k, 0) + v
+        build = dict(merged.get("buildInfo") or {})
+        build["shards"] = str(len(self.shards))
+        build["flightRecorder"] = "on" if rec is not None else "off"
+        merged["buildInfo"] = build
         return merged
 
     def get_physical_cluster_status(self) -> List[Dict]:
@@ -2328,16 +2543,35 @@ class ShardedScheduler:
             ))
         return merged
 
-    def get_decisions(self, n: Optional[int] = None) -> Dict:
+    def get_decisions(
+        self,
+        n: Optional[int] = None,
+        verdict: Optional[str] = None,
+        gate: Optional[str] = None,
+    ) -> Dict:
         items: List[Dict] = []
         for backend in self.shards:
-            items.extend(backend.call("get_decisions", n).get("items", []))
+            items.extend(
+                backend.call(
+                    "get_decisions", n, verdict, gate
+                ).get("items", [])
+            )
         # Per-shard seq counters are independent; wall time is the only
         # cross-shard recency order. Without the sort, ?n= would keep the
         # highest-numbered shard's tail and drop newer decisions from
         # earlier shards.
         items.sort(key=lambda d: d.get("wallTime", 0.0))
         return {"items": items[-n:] if n else items}
+
+    def get_flightrecorder(self, full: bool = False) -> Dict:
+        """The frontend's (pre-routing) flight recorder: one stream
+        covers all shards."""
+        rec = self.recorder
+        if rec is None:
+            return {"enabled": False}
+        payload = rec.recording() if full else rec.summary()
+        payload["enabled"] = True
+        return payload
 
     def get_decision(self, key: str) -> Dict:
         last: Optional[api.WebServerError] = None
@@ -2351,28 +2585,46 @@ class ShardedScheduler:
         )
 
     def get_traces(self, n: Optional[int] = None) -> Dict:
-        """Trace stamps are per-process monotonic clocks, so cross-shard
-        recency cannot be reconstructed; the merged ring interleaves the
-        shards' tails round-robin (newest last, like each shard's own
-        ring) with per-item shard attribution instead of pretending a
-        total order."""
-        per_shard: List[List[Dict]] = []
+        """Causally-stitched merged ring: worker traces carry the
+        frontend trace id that routed them (``parentTraceId``, propagated
+        over the pipe protocol), so shard spans nest as ``children`` of
+        their frontend span; everything else orders by the wall stamp
+        every trace now commits with — the same cross-process recency
+        order the decision-journal merge uses. This retires PR 8's
+        round-robin-interleave deviation (doc/hot-path.md)."""
         sample = None
+        frontend_items = [
+            {**item, "shard": "frontend"}
+            for item in self.tracer.snapshot(n)
+        ]
+        shard_items: List[Dict] = []
         for backend in self.shards:
             p = backend.call("get_traces", n)
             sample = p.get("sample") if sample is None else sample
-            items = [
+            shard_items.extend(
                 {**item, "shard": backend.shard_id}
                 for item in p.get("items", [])
-            ]
-            per_shard.append(items)
-        merged: List[Dict] = []
-        while any(per_shard) and (n is None or len(merged) < n):
-            for items in per_shard:
-                if items:
-                    merged.append(items.pop())
-        merged.reverse()
-        return {"sample": sample, "items": merged}
+            )
+        # Stitch: a worker trace with a parent nests under the frontend
+        # trace that spawned it; orphans (worker-sampled without a
+        # frontend parent, e.g. informer verbs) stay top-level.
+        by_id = {t["traceId"]: t for t in frontend_items}
+        top: List[Dict] = list(frontend_items)
+        for item in shard_items:
+            parent = by_id.get(item.get("parentTraceId"))
+            if parent is not None:
+                parent.setdefault("children", []).append(item)
+            else:
+                top.append(item)
+        for t in frontend_items:
+            if "children" in t:
+                t["children"].sort(
+                    key=lambda d: d.get("wallTime", 0.0)
+                )
+        top.sort(key=lambda d: d.get("wallTime", 0.0))
+        if n is not None and n > 0:
+            top = top[-n:]
+        return {"sample": sample, "items": top}
 
     def get_ha(self) -> Dict:
         lead = self.leadership
@@ -2446,6 +2698,27 @@ class ShardedScheduler:
 # --------------------------------------------------------------------- #
 # Merge helpers
 # --------------------------------------------------------------------- #
+
+
+# The one outcome classification (scheduler.recorder): trace attrs and
+# both frontends' recorders share it.
+_frontend_outcome = recorder_pkg.filter_outcome
+
+
+def _raw_outcome(reply: Dict) -> Tuple[str, str]:
+    """_frontend_outcome over an already-decoded raw-path reply DICT
+    (wire keys); returns (outcome, bound node or "")."""
+    if reply is None:
+        return "error", ""
+    if reply.get("NodeNames"):
+        return "bind", str(reply["NodeNames"][0])
+    if reply.get("Error"):
+        return "error", ""
+    if reply.get("FailedNodes") and set(reply["FailedNodes"]) != {
+        constants.COMPONENT_NAME
+    }:
+        return "preempt", ""
+    return "wait", ""
 
 
 def _merge_metrics(per_shard: List[Dict]) -> Dict:

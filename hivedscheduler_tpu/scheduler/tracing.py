@@ -76,17 +76,21 @@ def _env_sample() -> float:
 class Trace:
     """One sampled request: an id, a start stamp, and a span list. Owned by
     the request thread until :meth:`finish` hands it to the ring; never
-    mutated after that."""
+    mutated after that. ``parent`` is a causal parent trace id from
+    ANOTHER process (the shards frontend propagates its filter trace id
+    over the pipe protocol so worker-side spans stitch as children of the
+    frontend span in the merged ``/v1/inspect/traces``)."""
 
     __slots__ = ("tracer", "trace_id", "name", "attrs", "t0", "spans",
-                 "_finished")
+                 "parent", "_finished")
 
     def __init__(self, tracer: "Tracer", trace_id: int, name: str,
-                 attrs: Dict):
+                 attrs: Dict, parent: Optional[int] = None):
         self.tracer = tracer
         self.trace_id = trace_id
         self.name = name
         self.attrs = attrs
+        self.parent = parent
         self.t0 = time.perf_counter()
         self.spans: List[Dict] = []
         self._finished = False
@@ -199,12 +203,15 @@ class Tracer:
         self.sampled_count = 0
         self._count_lock = threading.Lock()
 
-    def trace(self, name: str, force: bool = False, **attrs):
+    def trace(self, name: str, force: bool = False,
+              parent: Optional[int] = None, **attrs):
         """Start a trace, or hand back :data:`NULL_TRACE` when the request
         is not sampled. ``force=True`` bypasses sampling for rare,
         high-value cycles (recovery, informer relists) whose cost is
-        negligible next to the work they wrap."""
-        if not force:
+        negligible next to the work they wrap. A non-None ``parent``
+        (a cross-process parent trace id) also forces: the parent was
+        sampled upstream, so the child must exist for the stitch."""
+        if not force and parent is None:
             s = self.sample
             if s <= 0.0:
                 return NULL_TRACE
@@ -212,20 +219,26 @@ class Tracer:
                 return NULL_TRACE
         with self._count_lock:
             self.sampled_count += 1
-        return Trace(self, next(self._seq), name, dict(attrs))
+        return Trace(self, next(self._seq), name, dict(attrs), parent)
 
     def _commit(self, trace: Trace) -> None:
-        self._ring.append(
-            {
-                "traceId": trace.trace_id,
-                "name": trace.name,
-                "attrs": trace.attrs,
-                "totalMs": round(
-                    (time.perf_counter() - trace.t0) * 1e3, 4
-                ),
-                "spans": trace.spans,
-            }
-        )
+        d = {
+            "traceId": trace.trace_id,
+            "name": trace.name,
+            "attrs": trace.attrs,
+            # Wall stamp: per-process perf_counter bases are not
+            # comparable, but wall time is — the merged multi-shard ring
+            # sorts on it (the same cross-process recency order the
+            # decision journal merge uses).
+            "wallTime": round(time.time(), 6),
+            "totalMs": round(
+                (time.perf_counter() - trace.t0) * 1e3, 4
+            ),
+            "spans": trace.spans,
+        }
+        if trace.parent is not None:
+            d["parentTraceId"] = trace.parent
+        self._ring.append(d)
 
     def snapshot(self, n: Optional[int] = None) -> List[Dict]:
         """Most-recent-last list of finished traces. ``list(deque)`` is

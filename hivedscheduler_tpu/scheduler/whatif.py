@@ -62,6 +62,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .. import common
 from ..api import extender as ei, types as api
+from . import tracing
 from .framework import (
     HivedScheduler,
     NullKubeClient,
@@ -273,6 +274,12 @@ class WhatIfPlane:
             # (non-deterministic forecasts). The assume-bind state is all
             # a forecast reads — drop them.
             force_bind_executor=lambda fn: None,
+            # The fork is a forecast instrument, not a deployment: it
+            # must neither record its shadow verbs into a black box nor
+            # burn forecast latency auditing itself (the LIVE scheduler's
+            # auditor covers the state forecasts are derived from).
+            flight_recorder=False,
+            live_audit=False,
         )
         fork._import_snapshot_state(body, live_names=None)
         with fork._lock:
@@ -407,11 +414,14 @@ class WhatIfPlane:
         done: Dict[str, Dict] = {}
 
         def probe_round(t: float) -> None:
+            t0 = time.perf_counter()
+            probed = 0
             for gang in list(pending):
                 if gang.cert is not None and fork.sched.core.certificate_current(
                     gang.cert
                 ):
                     continue  # provably the same WAIT: skip the probe
+                probed += 1
                 placed, preempt_detail = self._attempt(fork, gang)
                 if placed:
                     done[gang.name] = {
@@ -427,6 +437,15 @@ class WhatIfPlane:
                     pending.remove(gang)
                 else:
                     self._refresh_cert(fork, gang)
+            # Forecast observability (doc/observability.md): each re-probe
+            # round is a child span on the live trace ring, so forecast
+            # cost shows up in /v1/inspect/traces alongside filter and
+            # preempt instead of being invisible.
+            tracing.add_span(
+                "queueReprobe", time.perf_counter() - t0,
+                horizonT=round(t, 3), probed=probed,
+                pending=len(pending),
+            )
 
         def event_key(e: Dict):
             # The seq tiebreak (sim_sample attaches the driver's heap
@@ -631,11 +650,21 @@ class WhatIfPlane:
                 "whatif payload needs one of: spec, queue: true, "
                 "capacityTrace"
             )
-        fork = self.build_fork(seed)
+        # Forecast cost belongs in the trace ring next to filter/preempt
+        # (doc/observability.md): force-traced like recovery — rare,
+        # high-value, and the whole point is visibility.
+        tr = self.sched.tracer.trace("whatif", force=True, mode=mode)
+        with tr.span("forkBuild"):
+            fork = self.build_fork(seed)
         fork_s = time.perf_counter() - t0
         t1 = time.perf_counter()
-        forecasts = self.run_forecast(fork, gangs, events, duration_s)
+        with tracing.use(tr):
+            with tr.span("horizonReplay", events=len(events)):
+                forecasts = self.run_forecast(
+                    fork, gangs, events, duration_s
+                )
         forecast_s = time.perf_counter() - t1
+        tr.finish(gangs=len(forecasts))
         if mode == "queue" and payload.get("stamp", True):
             by_name = {f["gang"]: f for f in forecasts}
             for gang in gangs:
@@ -679,15 +708,21 @@ class WhatIfPlane:
             events.append(ev)
         trace["events"] = events
         slo_wait_s = float(payload.get("sloWaitS") or 600.0)
-        fork = self.build_fork(seed)
+        tr = self.sched.tracer.trace("whatif", force=True, mode="capacity")
+        with tr.span("forkBuild"):
+            fork = self.build_fork(seed)
         fork_s = time.perf_counter() - t0
         t1 = time.perf_counter()
         driver = TraceDriver(
             self.sched.config, scheduler=fork.sched, prepare_nodes=False
         )
         with self.shadow_section():
-            report = driver.run(trace)
+            with tr.span(
+                "horizonReplay", events=len(trace.get("events") or [])
+            ):
+                report = driver.run(trace)
         forecast_s = time.perf_counter() - t1
+        tr.finish()
         q = report["quotaSatisfaction"]
         counts = report["counts"]
         self.forecast_count += 1
@@ -797,8 +832,10 @@ def sim_sample(
     duration_s = max([e["t"] for e in events], default=0.0)
 
     def once() -> Tuple[List[Dict], Dict]:
+        tr = plane.sched.tracer.trace("whatif", force=True, mode="sim")
         t_fork = time.perf_counter()
-        fork = plane.build_fork(seed=0)
+        with tr.span("forkBuild"):
+            fork = plane.build_fork(seed=0)
         fork_s = time.perf_counter() - t_fork
         gangs = []
         for g in waiting_gangs:
@@ -807,9 +844,14 @@ def sim_sample(
                 _ForecastGang(g.name, g.vc, g.priority, pods)
             )
         t0 = time.perf_counter()
-        forecasts = plane.run_forecast(fork, gangs, events, duration_s)
+        with tracing.use(tr):
+            with tr.span("horizonReplay", events=len(events)):
+                forecasts = plane.run_forecast(
+                    fork, gangs, events, duration_s
+                )
         dt = time.perf_counter() - t0
         meta = plane._meta(fork, len(events), duration_s, fork_s, dt)
+        tr.finish(gangs=len(forecasts))
         return forecasts, meta
 
     forecasts, meta = once()

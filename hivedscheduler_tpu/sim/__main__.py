@@ -33,7 +33,9 @@ def main(argv=None) -> int:
         prog="python -m hivedscheduler_tpu.sim",
         description="Trace-driven warehouse-scale scheduler simulation",
     )
-    ap.add_argument("--hosts", type=int, default=5184)
+    # Default resolved per mode: trace generation uses 5184; recording
+    # replay distinguishes "flag given" from "use the recording's stamp".
+    ap.add_argument("--hosts", type=int, default=None)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--gangs", type=int, default=400)
     ap.add_argument(
@@ -58,6 +60,15 @@ def main(argv=None) -> int:
                     default="proc")
     ap.add_argument("--trace", help="replay this trace file instead of "
                     "generating one")
+    ap.add_argument("--replay-recording", metavar="FILE",
+                    help="deterministic incident replay (black-box "
+                    "plane): restore a flight recording's anchor "
+                    "through the what-if fork path and re-drive its "
+                    "verb window through TraceDriver, comparing the "
+                    "replayed placement fingerprint against the live "
+                    "run's (exit 1 on divergence). The fleet config is "
+                    "rebuilt from the recording's host stamp (--hosts "
+                    "overrides)")
     ap.add_argument("--write-trace", help="write the generated trace "
                     "here and exit (no replay)")
     ap.add_argument("--out", help="write the JSON report here")
@@ -71,11 +82,13 @@ def main(argv=None) -> int:
     common.init_logging(
         logging.INFO if args.verbose else logging.ERROR
     )
+    if args.replay_recording:
+        return _replay_recording_main(args)
     if args.trace:
         trace = load_trace(args.trace)
     else:
         shape = TraceShape(
-            hosts=args.hosts,
+            hosts=args.hosts if args.hosts is not None else 5184,
             gangs=args.gangs,
             duration_s=args.duration,
             pattern=args.pattern,
@@ -106,6 +119,53 @@ def main(argv=None) -> int:
     else:
         print(render_text(report))
     return 0
+
+
+def _replay_recording_main(args) -> int:
+    """--replay-recording: capture -> dump -> replay -> fingerprint
+    compare (doc/user-manual.md "Reproducing a production incident from
+    a flight recording")."""
+    from ..scheduler.recorder import replay_recording
+    from .driver import build_fleet_config
+
+    with open(args.replay_recording) as f:
+        recording = json.load(f)
+    if recording.get("kind") != "flightRecording":
+        print("not a flight recording (expected kind=flightRecording)",
+              file=sys.stderr)
+        return 2
+    # An explicitly-passed --hosts OVERRIDES the recording's stamp (the
+    # flag's contract); otherwise the stamp wins, and a stamp-less
+    # recording (frontend capture) requires the flag rather than
+    # silently replaying against the default fleet and failing the
+    # config-fingerprint gate with a confusing mismatch.
+    if args.hosts is not None:
+        hosts = args.hosts
+    elif recording.get("hosts"):
+        hosts = recording["hosts"]
+    else:
+        print("recording carries no host stamp; pass --hosts N matching "
+              "the capturing fleet", file=sys.stderr)
+        return 2
+    config, actual_hosts = build_fleet_config(int(hosts))
+    result = replay_recording(recording, config)
+    payload = dict(result, hosts=actual_hosts)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+    if args.json:
+        print(json.dumps(payload, sort_keys=True))
+    else:
+        ev = result["events"]
+        print(f"replayed {sum(v for k, v in ev.items() if not k.startswith('_'))} "
+              f"events ({ev.get('_skipped', 0)} skipped, "
+              f"{ev.get('_errors', 0)} protocol errors) at {actual_hosts} hosts")
+        print(f"live    fingerprint: {result['liveFingerprint']}")
+        print(f"replay  fingerprint: {result['replayFingerprint']}")
+        print("IDENTICAL — deterministic repro"
+              if result["identical"]
+              else "DIVERGED — anchor/config mismatch or nondeterminism")
+    return 0 if result["identical"] else 1
 
 
 if __name__ == "__main__":

@@ -556,6 +556,151 @@ class TraceDriver:
             "steadyP99Ms": round(_pct(steady_ms, 0.99), 4),
         }
 
+    # -- flight-recording replay (the black-box plane) ----------------- #
+
+    def replay_recording(self, recording: Dict) -> Dict:
+        """Re-drive a flight-recorder window's verbs against this
+        driver's subject (scheduler.recorder: the subject was restored to
+        the window's anchor through the what-if fork path). Placement is
+        a pure function of (state, verb order, preempt RNG), so the
+        subject's own recorder captures a bind stream that fingerprints
+        identically to the live window's — the deterministic incident
+        repro. Returns per-kind event counts plus ``_skipped`` (events
+        the replay had no target for) and ``_errors`` (verbs that raised
+        the same protocol errors the live run saw)."""
+        from ..scheduler.recorder import (
+            _pod_from_payload,
+            _rng_state_from_json,
+        )
+
+        sched = self.sched
+        pods = {
+            int(k): v for k, v in (recording.get("pods") or {}).items()
+        }
+        node_lists = {
+            int(k): [str(n) for n in v]
+            for k, v in (recording.get("nodeLists") or {}).items()
+        }
+        counts: Dict[str, int] = {}
+        skipped = errors = 0
+        for ev in recording.get("events") or []:
+            kind = str(ev.get("kind") or "")
+            counts[kind] = counts.get(kind, 0) + 1
+            try:
+                if kind == "filter":
+                    pod = _pod_from_payload(pods[ev["pod"]])
+                    # Key-presence, not truthiness: a recorded EMPTY
+                    # suggested set is a real input (the buddy-fit
+                    # rejection scenario) and must not replay as the
+                    # whole fleet.
+                    ref = ev.get("nodes")
+                    nodes = (
+                        node_lists[ref] if ref in node_lists
+                        else self.nodes
+                    )
+                    sched.filter_routine(
+                        ei.ExtenderArgs(pod=pod, node_names=nodes)
+                    )
+                elif kind == "preempt":
+                    pod = _pod_from_payload(pods[ev["pod"]])
+                    cand = node_lists.get(ev.get("nodes")) or []
+                    sched.preempt_routine(
+                        ei.ExtenderPreemptionArgs(
+                            pod=pod,
+                            node_name_to_meta_victims={
+                                n: ei.MetaVictims() for n in cand
+                            },
+                        )
+                    )
+                elif kind == "bind":
+                    sched.bind_routine(
+                        ei.ExtenderBindingArgs(
+                            pod_name=ev["podName"],
+                            pod_namespace=(
+                                ev.get("namespace") or "default"
+                            ),
+                            pod_uid=ev["uid"],
+                            node=ev["node"],
+                        )
+                    )
+                elif kind == "pod_add":
+                    sched.add_pod(_pod_from_payload(pods[ev["pod"]]))
+                elif kind == "pod_update":
+                    sched.update_pod(
+                        _pod_from_payload(pods[ev["old"]]),
+                        _pod_from_payload(pods[ev["pod"]]),
+                    )
+                elif kind == "pod_delete":
+                    status = sched.pod_schedule_statuses.get(ev["uid"])
+                    if status is not None:
+                        sched.delete_pod(status.pod)
+                    else:
+                        skipped += 1
+                elif kind == "node_add":
+                    sched.add_node(Node(
+                        name=ev["node"],
+                        ready=bool(ev.get("ready", True)),
+                        annotations=dict(ev.get("annotations") or {}),
+                    ))
+                elif kind == "node_state":
+                    new = Node(
+                        name=ev["node"],
+                        ready=bool(ev.get("ready", True)),
+                        annotations=dict(ev.get("annotations") or {}),
+                    )
+                    old = sched.nodes.get(ev["node"]) or Node(
+                        name=ev["node"]
+                    )
+                    sched.update_node(old, new)
+                elif kind == "node_delete":
+                    node = sched.nodes.get(ev["node"])
+                    if node is not None:
+                        sched.delete_node(node)
+                    else:
+                        skipped += 1
+                elif kind == "health_tick":
+                    sched.health_tick()
+                elif kind == "settle_health":
+                    sched.settle_health_now()
+                elif kind == "settle_health_wall":
+                    # Wall-floor settles replay as force-settles: the
+                    # recorded position IS the time the floor expired.
+                    sched.settle_health_now()
+                elif kind == "defrag_cycle":
+                    sched.run_defrag_cycle_now()
+                elif kind == "defrag_take":
+                    sched.take_defrag_proposals()
+                elif kind == "defrag_report":
+                    if getattr(sched, "defrag", None) is not None:
+                        sched.defrag.report_migration(
+                            str(ev.get("group") or ""),
+                            ok=bool(ev.get("ok")),
+                            reason=str(ev.get("reason") or ""),
+                        )
+                elif kind == "seed_rng":
+                    state = _rng_state_from_json(ev.get("state"))
+                    if state is not None and self.core is not None:
+                        rng = self.core.preempt_rng
+                        if rng is None:
+                            # A fresh core carries no RNG until seeded;
+                            # the recorded state IS the seeding.
+                            rng = self.core.preempt_rng = random.Random()
+                        rng.setstate(state)
+                else:
+                    skipped += 1
+            except Exception as e:  # noqa: BLE001
+                # Protocol errors replay as protocol errors (the live run
+                # recorded them too); anything else is counted, logged,
+                # and must not abort the repro mid-window.
+                errors += 1
+                common.log.debug(
+                    "replay verb %s raised (recorded outcome stands): %s",
+                    kind, e,
+                )
+        counts["_skipped"] = skipped
+        counts["_errors"] = errors
+        return counts
+
     # -- replay -------------------------------------------------------- #
 
     def run(self, trace: Dict) -> Dict:
@@ -569,6 +714,12 @@ class TraceDriver:
             seeder(seed)
         elif self.core is not None:
             self.core.preempt_rng = random.Random(seed)
+            recorder = getattr(self.sched, "recorder", None)
+            if recorder is not None:
+                # The flight recorder anchors on the preempt-RNG state:
+                # reseeding bypasses the verb stream, so tell it (replay
+                # reinstates the exact state; scheduler.recorder).
+                recorder.note_rng_state(self.core.preempt_rng)
 
         live: Dict[str, _Gang] = {}
         waiting = _WaitQueue(self._leaf_family, self.fifo_retry)
@@ -851,6 +1002,11 @@ def run_trace(
         config, mode=mode, n_shards=n_shards, transport=transport,
         frag_samples=frag_samples, fifo_retry=fifo_retry,
     )
+    recorder = getattr(driver.sched, "recorder", None)
+    if recorder is not None:
+        # Stamp the fleet size so --replay-recording can rebuild the
+        # identical bench config without a flag (scheduler.recorder).
+        recorder.hosts = actual_hosts
     try:
         report = driver.run(trace)
         if retry_storm_rounds > 0:

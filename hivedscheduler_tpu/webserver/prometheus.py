@@ -67,6 +67,10 @@ COUNTERS: Dict[str, tuple] = {
     "whatifForecastGangCount": ("hived_whatif_forecast_gangs_total", "per-gang forecasts produced across all what-if requests"),
     "whatifForkCount": ("hived_whatif_forks_total", "shadow scheduler forks built from the live projection"),
     "whatifAuditViolationCount": ("hived_whatif_audit_violations_total", "shadow-forecast threads caught attempting a LIVE-state mutation by the read-only-fork audit (should stay 0)"),
+    "auditRunCount": ("hived_audit_runs_total", "live invariant-auditor passes over the live core (black-box plane, event-clocked at auditIntervalTicks)"),
+    "auditViolationCount": ("hived_audit_violations_total", "live-audit invariant violations (counted + journaled + black-box bundle dumped; the scheduler keeps serving — should stay 0)"),
+    "flightRecorderEventCount": ("hived_flightrecorder_events_total", "mutating verbs captured by the flight recorder since process start"),
+    "flightRecorderReanchorCount": ("hived_flightrecorder_reanchors_total", "flight-recorder windows re-anchored on a fresh snapshot export (ring wrap or post-recovery)"),
 }
 
 GAUGES: Dict[str, tuple] = {
@@ -100,6 +104,7 @@ LABELED: Dict[str, str] = {
     "hived_phase_seconds_total": "per-phase accumulated time (phase label: lockWait, coreSchedule, leafCellSearch)",
     "hived_phase_ops_total": "per-phase operation count (phase label)",
     "hived_boot_phase_seconds": "boot wall seconds per phase (phase label: compile, healthInit, nodeAdd, fingerprint, recovery) — a gauge of the LAST boot, so standby cold-start is observable, not inferred",
+    "hived_build_info": "constant-1 gauge whose labels identify the running deploy: snapshotSchema, configFingerprint (12-hex prefix), shards, and the hatch states (lazyVc, waitCache, nodeEventFastpath, liveAudit, flightRecorder)",
 }
 
 # JSON-snapshot keys that are deliberately NOT exported to Prometheus:
@@ -115,6 +120,7 @@ EXCLUDED_KEYS = {
     "lockSharding",         # string mode flag ("chains"/"global")
     "recoveryMode",         # string mode flag ("none"/"full"/"snapshot+delta")
     "bootPhaseSeconds",     # rendered as the hived_boot_phase_seconds gauge
+    "buildInfo",            # rendered as the hived_build_info labeled gauge
 }
 
 
@@ -198,6 +204,14 @@ def render(snapshot: Dict) -> str:
             'hived_lock_acquisitions_total{chain="%s"} %s'
             % (_escape_label(chain), _fmt(entry["count"]))
         )
+
+    build = snapshot.get("buildInfo")
+    if build:
+        header("hived_build_info", "gauge", LABELED["hived_build_info"])
+        labels = ",".join(
+            f'{k}="{_escape_label(v)}"' for k, v in sorted(build.items())
+        )
+        lines.append("hived_build_info{%s} 1" % labels)
 
     boot = snapshot.get("bootPhaseSeconds", {})
     header(

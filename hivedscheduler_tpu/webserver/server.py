@@ -264,12 +264,24 @@ def _make_handler(scheduler: HivedScheduler):
             if path == constants.QUARANTINE_PATH:
                 return scheduler.get_quarantine()
             if path == dcp or path == dcp + "/":
-                return scheduler.get_decisions(_query_n(query))
+                # ?verdict= / ?gate= slice the journal server-side
+                # (?verdict=wait&gate=vcQuota), composing with ?n=.
+                return scheduler.get_decisions(
+                    _query_n(query),
+                    _query_str(query, "verdict"),
+                    _query_str(query, "gate"),
+                )
             if path.startswith(dcp + "/"):
                 # Per-pod lookup: uid, or namespace/name (may contain "/").
                 return scheduler.get_decision(path[len(dcp) + 1:])
             if path == constants.TRACES_PATH:
                 return scheduler.get_traces(_query_n(query))
+            if path == constants.FLIGHTRECORDER_PATH:
+                # The black-box flight recorder: summary by default,
+                # ?full=1 for the whole replayable recording.
+                return scheduler.get_flightrecorder(
+                    _query_str(query, "full") == "1"
+                )
             if path == constants.DOOMED_LEDGER_PATH:
                 return scheduler.get_doomed_ledger()
             if path == constants.HEALTH_PATH:
@@ -298,6 +310,17 @@ def _make_handler(scheduler: HivedScheduler):
             raise api.not_found(f"Cannot found resource: {path}")
 
     return Handler
+
+
+def _query_str(query: str, key: str) -> Optional[str]:
+    """One string query parameter (the ?verdict= / ?gate= / ?full=
+    knobs); absent or malformed degrades to None — a diagnostic read
+    never errors on its own query string."""
+    try:
+        values = urllib.parse.parse_qs(query or "").get(key)
+        return str(values[0]) if values else None
+    except (ValueError, TypeError, IndexError):
+        return None
 
 
 def _query_n(query: str) -> Optional[int]:

@@ -312,216 +312,17 @@ class ScriptedKubeClient(KubeClient):
 
 
 ###############################################################################
-# Invariant auditing
+# Invariant auditing — ONE implementation, owned by the package
+# (hivedscheduler_tpu.scheduler.audit, the black-box plane's live
+# auditor) and imported back here so the harness and the production
+# path can never drift. Re-exported under the historical names.
 ###############################################################################
 
-
-def _leaves(c: Cell) -> Iterator[PhysicalCell]:
-    if not c.children:
-        assert isinstance(c, PhysicalCell)
-        yield c
-        return
-    for child in c.children:
-        yield from _leaves(child)
-
-
-def _count_at_level(c: Cell, level: int) -> int:
-    if c.level == level:
-        return 1
-    if c.level < level or not c.children:
-        return 0
-    return sum(_count_at_level(child, level) for child in c.children)
-
-
-def audit_invariants(sched: HivedScheduler, ctx: str = "") -> None:
-    """Structural invariants over the live core; raises AssertionError with
-    ``ctx`` on any violation. Cheap enough to run after every chaos event."""
-    core = sched.core
-    for chain, ccl in core.full_cell_list.items():
-        top = ccl.top_level
-        # --- invariant 1a: the free list partitions the chain ------------- #
-        derived = {l: 0 for l in range(LOWEST_LEVEL, top + 1)}
-        covered: Set[str] = set()
-        for level in range(LOWEST_LEVEL, top + 1):
-            for c in core.free_cell_list[chain][level]:
-                assert c.level == level, (ctx, chain, level, c.address)
-                for l in range(LOWEST_LEVEL, level + 1):
-                    derived[l] += _count_at_level(c, l)
-                for leaf in _leaves(c):
-                    assert leaf.address not in covered, (
-                        ctx, chain, "free lists overlap", leaf.address,
-                    )
-                    covered.add(leaf.address)
-                    # Invariant 5 (reservation conservation, half 1): no
-                    # cell is both in the free lists and Reserved/Reserving
-                    # — a reservation always allocates its preassigned cell
-                    # out of the free lists. A free-covered USED leaf is
-                    # legal only for opportunistic occupancy (that is how
-                    # preemption victims arise).
-                    assert leaf.state not in (
-                        CellState.RESERVING, CellState.RESERVED,
-                    ), (ctx, chain, "reserved cell in free list", leaf.address)
-                    if leaf.state == CellState.USED:
-                        assert leaf.priority < MIN_GUARANTEED_PRIORITY, (
-                            ctx, chain, "guaranteed allocation in free list",
-                            leaf.address, leaf.priority,
-                        )
-        for l in range(LOWEST_LEVEL, top + 1):
-            assert core.total_left_cell_num[chain].get(l, 0) == derived[l], (
-                ctx, chain, l, "totalLeft != cells derivable from free list",
-                core.total_left_cell_num[chain].get(l, 0), derived[l],
-            )
-        # --- invariant 1b: per-leaf state machine ------------------------- #
-        # --- + invariant 5 (reservation conservation, half 2): the leaf    #
-        #     reservation pointers and the Reserving/Reserved states agree  #
-        for leaf in ccl[LOWEST_LEVEL]:
-            assert isinstance(leaf, PhysicalCell)
-            if leaf.state == CellState.USED:
-                assert leaf.using_group is not None, (ctx, leaf.address)
-            if leaf.using_group is not None:
-                assert leaf.state in (CellState.USED, CellState.RESERVING), (
-                    ctx, leaf.address, leaf.state,
-                )
-            if leaf.state == CellState.FREE:
-                assert leaf.using_group is None, (ctx, leaf.address)
-                assert leaf.priority == FREE_PRIORITY, (
-                    ctx, leaf.address, leaf.priority,
-                )
-            reserved = leaf.state in (CellState.RESERVING, CellState.RESERVED)
-            assert reserved == (leaf.reserving_or_reserved_group is not None), (
-                ctx, leaf.address, leaf.state,
-                "reservation pointer and state disagree",
-            )
-            if leaf.state == CellState.RESERVED:
-                assert leaf.using_group is None, (ctx, leaf.address)
-            if leaf.state == CellState.RESERVING:
-                assert leaf.using_group is not None, (ctx, leaf.address)
-            if reserved:
-                g = leaf.reserving_or_reserved_group
-                assert g.state == GroupState.PREEMPTING, (
-                    ctx, leaf.address, g.name, g.state,
-                )
-                assert any(
-                    leaf is pl
-                    for rows in g.physical_placement.values()
-                    for row in rows
-                    for pl in row
-                ), (ctx, leaf.address, g.name,
-                    "reserved leaf not in its preemptor's placement")
-        # --- bad-free entries are actually bad and actually free ---------- #
-        for level in range(LOWEST_LEVEL, top + 1):
-            for c in core.bad_free_cells[chain][level]:
-                assert isinstance(c, PhysicalCell)
-                assert not c.healthy, (ctx, chain, level, c.address)
-                assert in_free_cell_list(c), (ctx, chain, level, c.address)
-
-    # --- invariant 2: doomed-bad-cell counter consistency ----------------- #
-    doomed_sum: Dict[str, Dict[int, int]] = {}
-    for vcn, per_chain in core.vc_doomed_bad_cells.items():
-        for chain, ccl in per_chain.items():
-            for level, cl in ccl.levels.items():
-                if len(cl) == 0:
-                    continue
-                doomed_sum.setdefault(chain, {})
-                doomed_sum[chain][level] = doomed_sum[chain].get(level, 0) + len(cl)
-                for c in cl:
-                    assert isinstance(c, PhysicalCell)
-                    assert c.virtual_cell is not None, (ctx, vcn, c.address)
-                    assert c.virtual_cell.vc == vcn, (ctx, vcn, c.address)
-    for chain, per_level in core.all_vc_doomed_bad_cell_num.items():
-        for level, n in per_level.items():
-            assert n >= 0, (ctx, chain, level, n)
-            assert doomed_sum.get(chain, {}).get(level, 0) == n, (
-                ctx, chain, level, "doomed counter mismatch",
-                doomed_sum.get(chain, {}).get(level, 0), n,
-            )
-
-    # --- VC free-quota ledgers sum to the global ledger ------------------- #
-    vc_sum: Dict[str, Dict[int, int]] = {}
-    for vcn, per_chain in core.vc_free_cell_num.items():
-        for chain, per_level in per_chain.items():
-            for level, n in per_level.items():
-                vc_sum.setdefault(chain, {})
-                vc_sum[chain][level] = vc_sum[chain].get(level, 0) + n
-    for chain in set(vc_sum) | set(core.all_vc_free_cell_num):
-        levels = set(vc_sum.get(chain, {})) | set(
-            core.all_vc_free_cell_num.get(chain, {})
-        )
-        for level in levels:
-            assert vc_sum.get(chain, {}).get(level, 0) == (
-                core.all_vc_free_cell_num.get(chain, {}).get(level, 0)
-            ), (ctx, chain, level, "vcFree sum != allVCFree")
-
-    # --- invariant 7 (health consistency, structural half): leaf badness   #
-    #     and drains match the core's applied records, badness propagates   #
-    #     up the cell tree exactly (a cell is healthy iff all children      #
-    #     are), bound virtual mirrors agree, and the incremental            #
-    #     unusable-leaf counters equal the subtree truth                    #
-    for chain, ccl in core.full_cell_list.items():
-        top = ccl.top_level
-        for leaf in ccl[LOWEST_LEVEL]:
-            assert isinstance(leaf, PhysicalCell)
-            node = leaf.nodes[0]
-            expect_bad = node in core.bad_nodes or any(
-                i in core.bad_chips.get(node, ())
-                for i in leaf.leaf_cell_indices
-            )
-            assert leaf.healthy == (not expect_bad), (
-                ctx, leaf.address, "leaf health != applied bad records",
-            )
-            expect_drain = any(
-                i in core.draining_chips.get(node, ())
-                for i in leaf.leaf_cell_indices
-            )
-            assert leaf.draining == expect_drain, (
-                ctx, leaf.address, "leaf drain != applied drain records",
-            )
-        for level in range(LOWEST_LEVEL, top + 1):
-            for c in ccl[level]:
-                assert isinstance(c, PhysicalCell)
-                if c.children:
-                    assert c.healthy == all(
-                        ch.healthy for ch in c.children
-                    ), (ctx, c.address, "tree health propagation broken")
-                derived_unusable = sum(
-                    1
-                    for leaf in _leaves(c)
-                    if (not leaf.healthy) or leaf.draining
-                )
-                assert c.unusable_leaf_num == derived_unusable, (
-                    ctx, c.address, "unusable-leaf counter drift",
-                    c.unusable_leaf_num, derived_unusable,
-                )
-                if c.virtual_cell is not None:
-                    assert c.virtual_cell.healthy == c.healthy, (
-                        ctx, c.address, "bound virtual health mirror broken",
-                    )
-
-    # --- allocated groups reference live, non-free cells ------------------ #
-    # --- + invariant 5 (reservation conservation, group side): a           #
-    #     PREEMPTING group's cells are exactly Reserving/Reserved and point #
-    #     back at it; a BeingPreempted group's cells are Used or Reserving  #
-    for g in core.affinity_groups.values():
-        for rows in g.physical_placement.values():
-            for row in rows:
-                for leaf in row:
-                    if leaf is None:
-                        continue
-                    assert isinstance(leaf, PhysicalCell)
-                    assert leaf.state != CellState.FREE, (
-                        ctx, g.name, leaf.address,
-                    )
-                    if g.state == GroupState.PREEMPTING:
-                        assert leaf.state in (
-                            CellState.RESERVING, CellState.RESERVED,
-                        ), (ctx, g.name, leaf.address, leaf.state)
-                        assert leaf.reserving_or_reserved_group is g, (
-                            ctx, g.name, leaf.address,
-                        )
-                    elif g.state == GroupState.BEING_PREEMPTED:
-                        assert leaf.state in (
-                            CellState.USED, CellState.RESERVING,
-                        ), (ctx, g.name, leaf.address, leaf.state)
+from hivedscheduler_tpu.scheduler.audit import (  # noqa: E402
+    _count_at_level,
+    _leaves,
+    audit_invariants,
+)
 
 
 ###############################################################################
@@ -747,6 +548,10 @@ class ChaosHarness:
             "grow_submits": 0,
             "defrag_cycles": 0,
             "evictions_folded": 0,
+            # Black-box plane: production live-audit passes folded from
+            # each scheduler instance (agreement asserted — see
+            # _accumulate_elastic_metrics).
+            "live_audit_runs": 0,
         }
         self.weights = event_weights(mix)
         self.total_weight = sum(w for _, w in self.weights)
@@ -1349,6 +1154,21 @@ class ChaosHarness:
             ("defrag_cancels", "defragCancelCount"),
         ):
             self.stats[stat_key] += m[metric_key]
+        # Double-audit agreement (black-box plane, hack/soak.sh --audit):
+        # the PRODUCTION live auditor ran the same audit_invariants at
+        # its cadence while the harness audited after every event — a
+        # production-path violation the harness never raised would mean
+        # the two paths drifted (they share one implementation, so this
+        # must hold).
+        aud = sched.live_auditor
+        if aud is not None:
+            self.stats["live_audit_runs"] += aud.audit_runs
+            assert aud.violation_count == 0, (
+                self.seed,
+                "live auditor found a violation the harness audit "
+                "did not raise",
+                aud.last_violation,
+            )
 
     def inject_write_faults(self) -> None:
         """Script faults into the auxiliary write paths (preempt-info

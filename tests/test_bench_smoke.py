@@ -326,6 +326,37 @@ def test_bench_pending_smoke():
     json.dumps(result)
 
 
+def test_bench_audit_smoke():
+    """Smoke-sized variant of the HIVED_BENCH_AUDIT stage (ISSUE 15
+    CI/tooling satellite): the black-box overhead A/B (auditor on/off x
+    recorder on/off, interleaved at identical gang mix) must emit all
+    four sides with the uniform _stage_meta keys, the on-side must have
+    actually audited and recorded, and the capture→replay ride-along
+    must reproduce the live run's placement fingerprint (asserted inside
+    the stage — with gang churn, faults, and at least one preemption in
+    the captured window). The ≤3% overhead gate is the 432-host driver
+    stage's; CI boxes guard wiring + the replay assertion."""
+    result = bench.bench_audit(
+        cubes=4, slices=10, solos=4, n_gangs=60, reps=1,
+        replay_hosts=104, replay_gangs=100,
+    )
+    assert_stage_meta(result)
+    for side in ("p50_off_ms", "p50_audit_only_ms",
+                 "p50_recorder_only_ms", "p50_on_ms"):
+        assert result[side] > 0, side
+    assert "overhead_pct" in result and result["budget_pct"] == 3.0
+    assert result["audit_runs_on_side"] > 0
+    assert result["audit_violations"] == 0
+    assert result["recorder_events_on_side"] > 0
+    replay = result["replay"]
+    assert replay["identical"] is True
+    assert replay["preemption_events"] >= 1
+    assert replay["faults_applied"] >= 1
+    assert replay["window_events"] > 0
+    assert len(replay["fingerprint"]) == 64
+    json.dumps(result)
+
+
 def test_bench_whatif_smoke():
     """Smoke-sized variant of the HIVED_BENCH_WHATIF stage (ISSUE 14
     CI/tooling satellite): the mid-trace what-if sample must forecast

@@ -1,0 +1,401 @@
+"""The black-box plane (ISSUE 15; doc/observability.md "The black-box
+plane"): production flight recorder + deterministic incident replay +
+always-on live invariant auditor.
+
+Covers the acceptance surface:
+
+- a recording captured from a LIVE 432-host bench run (gang churn,
+  faults, at least one preemption) replays through the what-if-fork
+  restore + ``TraceDriver.replay_recording`` with a placement
+  fingerprint IDENTICAL to the live run's;
+- the sensitivity meta-test: injected free-list and doomed-counter
+  corruption is caught by the LIVE auditor within one cadence, counted,
+  journaled, and answered by the black-box artifact bundle — while the
+  scheduler keeps serving; and a NO-OP'd auditor is itself caught
+  (mirroring the ``test_nooped_*`` precedent: the test's teeth are
+  themselves tested);
+- the ``/v1/inspect/flightrecorder`` endpoint and the window re-anchor
+  discipline (bounded ring, fresh snapshot anchor, replay still
+  identical);
+- causal cross-shard trace stitching: worker filter traces commit with
+  the frontend's trace id as ``parentTraceId`` and the merged
+  ``/v1/inspect/traces`` nests them as children, wall-time ordered —
+  the PR-8 round-robin-interleave deviation is retired.
+"""
+
+import json
+import logging
+import os
+import urllib.request
+
+import pytest
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.algorithm.cell import LOWEST_LEVEL
+from hivedscheduler_tpu.api import constants, extender as ei
+from hivedscheduler_tpu.scheduler import audit as audit_mod
+from hivedscheduler_tpu.scheduler import recorder as recorder_mod
+from hivedscheduler_tpu.scheduler.framework import (
+    HivedScheduler,
+    NullKubeClient,
+)
+from hivedscheduler_tpu.scheduler.types import Node
+from hivedscheduler_tpu.sim.driver import TraceDriver, build_fleet_config
+from hivedscheduler_tpu.sim.trace import TraceShape, generate_trace
+
+from .test_core import make_pod
+from .test_observability import gang, two_host_config
+
+common.init_logging(logging.ERROR)
+
+
+# --------------------------------------------------------------------- #
+# Deterministic incident replay (the 432-host acceptance)
+# --------------------------------------------------------------------- #
+
+
+def _bench_recording(hosts=432, gangs=140, seed=3, capacity=1 << 18):
+    """A live bench-fleet run with the recorder armed: burst load, fault
+    injection, preemption pressure — the acceptance workload. The env
+    hatch is pinned so an ambient HIVED_FLIGHT_RECORDER=0 cannot blank
+    the capture mid-suite."""
+    saved = os.environ.pop(recorder_mod.FLIGHT_RECORDER_ENV, None)
+    try:
+        return _bench_recording_inner(hosts, gangs, seed, capacity)
+    finally:
+        if saved is not None:
+            os.environ[recorder_mod.FLIGHT_RECORDER_ENV] = saved
+
+
+def _bench_recording_inner(hosts, gangs, seed, capacity):
+    shape = TraceShape(
+        hosts=hosts,
+        gangs=gangs,
+        duration_s=1800.0,
+        pattern="burst",
+        burst_fraction=0.6,
+        opportunistic_fraction=0.4,
+        mean_runtime_s=700.0,
+        fault_events=12,
+    )
+    trace = generate_trace(seed, shape)
+    config, actual_hosts = build_fleet_config(hosts)
+    config.flight_recorder_capacity = capacity
+    driver = TraceDriver(config)
+    driver.sched.recorder.hosts = actual_hosts
+    report = driver.run(trace)
+    report["hosts"] = actual_hosts
+    recording = driver.sched.recorder.recording()
+    driver.close()
+    return report, recording
+
+
+def test_recording_replays_fingerprint_identical_at_432_hosts():
+    """ISSUE 15 acceptance: capture from a live 432-host bench run (gang
+    churn + faults + >= 1 preemption), replay through
+    --replay-recording's engine, assert the placement fingerprints are
+    identical."""
+    report, recording = _bench_recording()
+    counts = report["counts"]
+    assert counts["preemptionEvents"] >= 1, counts
+    assert counts["faultsApplied"] >= 1, counts
+    assert counts["boundGangs"] > 0
+    assert recording["truncated"] is False
+    assert recording["hosts"] == report["hosts"]
+
+    result = recorder_mod.replay_recording(
+        recording, build_fleet_config(432)[0]
+    )
+    assert result["identical"] is True, (
+        result["liveFingerprint"], result["replayFingerprint"],
+    )
+    assert result["events"]["_errors"] == 0
+    assert result["events"].get("filter", 0) > 0
+    assert result["events"].get("preempt", 0) >= 1
+
+
+def test_reanchored_window_still_replays_identically():
+    """A bounded ring that wrapped mid-run re-anchors on a fresh
+    snapshot export; the (non-pristine) window must restore through the
+    what-if fork path and still replay fingerprint-identically."""
+    report, recording = _bench_recording(
+        hosts=104, gangs=110, seed=5, capacity=300
+    )
+    assert recording["meta"]["reanchors"] >= 1, recording["meta"]
+    assert recording["anchor"]["pristine"] is False
+    assert recording["truncated"] is False
+    result = recorder_mod.replay_recording(
+        recording, build_fleet_config(104)[0]
+    )
+    assert result["identical"] is True, (
+        result["liveFingerprint"], result["replayFingerprint"],
+    )
+
+
+def test_truncated_recording_is_refused_for_replay():
+    rec = {
+        "kind": "flightRecording", "truncated": True,
+        "anchor": {"pristine": True}, "events": [],
+    }
+    with pytest.raises(ValueError):
+        recorder_mod.build_replay_subject(
+            rec, build_fleet_config(104)[0]
+        )
+
+
+def test_config_fingerprint_mismatch_is_refused():
+    _report, recording = _bench_recording(hosts=104, gangs=20, seed=1)
+    recording["configFingerprint"] = "deadbeef" * 8
+    with pytest.raises(ValueError):
+        recorder_mod.build_replay_subject(
+            recording, build_fleet_config(104)[0]
+        )
+
+
+# --------------------------------------------------------------------- #
+# Live invariant auditor: sensitivity meta-test
+# --------------------------------------------------------------------- #
+
+
+def _audited_scheduler(tmp_path, monkeypatch):
+    monkeypatch.setenv(audit_mod.AUDIT_ARTIFACT_DIR_ENV, str(tmp_path))
+    cfg = two_host_config()
+    cfg.audit_interval_ticks = 1  # every mutating verb audits
+    sched = HivedScheduler(
+        cfg, kube_client=NullKubeClient(), trace_sample=0.0
+    )
+    for name in sched.core.configured_node_names():
+        sched.add_node(Node(name=name))
+    assert sched.live_auditor is not None
+    assert sched.live_auditor.violation_count == 0
+    return sched
+
+
+def _drive_one_verb(sched, tag):
+    """One harmless mutating verb — the cadence clock the auditor rides."""
+    sched.health_tick()
+
+
+def test_live_auditor_catches_free_list_corruption(tmp_path, monkeypatch):
+    """Corrupt a free list under the test hook: the LIVE auditor must
+    catch it within one cadence, increment the violation counter, dump
+    the artifact bundle — and the scheduler must keep serving."""
+    sched = _audited_scheduler(tmp_path, monkeypatch)
+    core = sched.core
+    chain = sorted(core.free_cell_list)[0]
+    ccl = core.free_cell_list[chain]
+    top = ccl.top_level
+    cell = ccl[top][0]
+    ccl.remove(cell, top)  # the corruption: a free cell vanishes
+    _drive_one_verb(sched, "after-corruption")
+    aud = sched.live_auditor
+    assert aud.violation_count >= 1, "auditor missed free-list corruption"
+    assert sched.get_metrics()["auditViolationCount"] >= 1
+    # The bundle landed, with the black-box contents.
+    assert aud.last_artifact and os.path.exists(aud.last_artifact)
+    payload = json.loads(open(aud.last_artifact).read())
+    assert "decisions" in payload and "metrics" in payload
+    assert "flightRecording" in payload and "traces" in payload
+    # Journaled under the synthetic _audit pod key.
+    journal = [
+        d for d in sched.get_decisions()["items"]
+        if d.get("pod") == "_audit"
+    ]
+    assert journal and journal[-1]["verdict"] == "error"
+    # Degrade gracefully: the scheduler still serves (un-corrupt first so
+    # placement is sane, then filter must succeed).
+    core.free_cell_list[chain][top].append(cell)
+    pod = make_pod("a0-0", "ua0", "A", -1, "v5e-chip", 1,
+                   group=gang("ga", 1, 1))
+    sched.add_pod(pod)
+    r = sched.filter_routine(
+        ei.ExtenderArgs(pod=pod, node_names=sorted(sched.nodes))
+    )
+    assert r.node_names or r.failed_nodes  # served, not crashed
+
+
+def test_live_auditor_catches_doomed_counter_corruption(
+    tmp_path, monkeypatch
+):
+    sched = _audited_scheduler(tmp_path, monkeypatch)
+    core = sched.core
+    chain = sorted(core.full_cell_list)[0]
+    # The corruption: a phantom doomed-bad cell count with no doomed list
+    # entry behind it (invariant 2).
+    core.all_vc_doomed_bad_cell_num.setdefault(chain, {})
+    core.all_vc_doomed_bad_cell_num[chain][LOWEST_LEVEL] = (
+        core.all_vc_doomed_bad_cell_num[chain].get(LOWEST_LEVEL, 0) + 1
+    )
+    before = sched.live_auditor.violation_count
+    _drive_one_verb(sched, "after-doom-corruption")
+    assert sched.live_auditor.violation_count > before, (
+        "auditor missed doomed-counter corruption"
+    )
+
+
+def test_nooped_live_auditor_is_caught(tmp_path, monkeypatch):
+    """The meta-test's teeth: with audit_invariants no-op'd, the SAME
+    corruption goes uncaught — proving the catch above is the auditor's
+    doing, not an accident of some other assertion (the test_nooped_*
+    precedent)."""
+    sched = _audited_scheduler(tmp_path, monkeypatch)
+    monkeypatch.setattr(
+        audit_mod, "audit_invariants", lambda s, ctx="": None
+    )
+    core = sched.core
+    chain = sorted(core.free_cell_list)[0]
+    ccl = core.free_cell_list[chain]
+    top = ccl.top_level
+    ccl.remove(ccl[top][0], top)
+    _drive_one_verb(sched, "after-corruption-nooped")
+    assert sched.live_auditor.violation_count == 0, (
+        "no-op'd auditor still reported a violation — the sensitivity "
+        "test is not actually exercising audit_invariants"
+    )
+
+
+def test_auditor_hatch_and_cadence_knobs(monkeypatch):
+    monkeypatch.setenv(audit_mod.LIVE_AUDIT_ENV, "0")
+    sched = HivedScheduler(
+        two_host_config(), kube_client=NullKubeClient(), trace_sample=0.0
+    )
+    assert sched.live_auditor is None
+    monkeypatch.delenv(audit_mod.LIVE_AUDIT_ENV)
+    monkeypatch.setenv(audit_mod.AUDIT_INTERVAL_ENV, "7")
+    sched2 = HivedScheduler(
+        two_host_config(), kube_client=NullKubeClient(), trace_sample=0.0
+    )
+    assert sched2.live_auditor is not None
+    assert sched2.live_auditor.interval_ticks == 7
+    monkeypatch.setenv(recorder_mod.FLIGHT_RECORDER_ENV, "0")
+    sched3 = HivedScheduler(
+        two_host_config(), kube_client=NullKubeClient(), trace_sample=0.0
+    )
+    assert sched3.recorder is None
+    # Golden metrics keys stay present while disabled.
+    m = sched3.get_metrics()
+    assert m["flightRecorderEventCount"] == 0
+    assert m["auditViolationCount"] == 0
+
+
+# --------------------------------------------------------------------- #
+# /v1/inspect/flightrecorder + decision filters over HTTP
+# --------------------------------------------------------------------- #
+
+
+def test_flightrecorder_endpoint_and_decision_filters():
+    from hivedscheduler_tpu.webserver.server import WebServer
+
+    sched = HivedScheduler(
+        two_host_config(), kube_client=NullKubeClient(), trace_sample=0.0
+    )
+    for name in sched.core.configured_node_names():
+        sched.add_node(Node(name=name))
+    ws = WebServer(sched, address="127.0.0.1:0")
+    ws.start()
+    try:
+        pod = make_pod("f0-0", "uf0", "A", 0, "v5e-chip", 4,
+                       group=gang("gf", 1, 4))
+        sched.add_pod(pod)
+        assert sched.filter_routine(
+            ei.ExtenderArgs(pod=pod, node_names=sorted(sched.nodes))
+        ).node_names
+        # A quota-blocked waiter for the ?verdict=wait&gate=vcQuota slice.
+        waiter = make_pod("f1-0", "uf1", "A", 0, "v5e-chip", 4,
+                          group=gang("gw", 2, 4))
+        sched.add_pod(waiter)
+        sched.filter_routine(
+            ei.ExtenderArgs(pod=waiter, node_names=sorted(sched.nodes))
+        )
+
+        def get(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{ws.port}{path}", timeout=10
+            ) as r:
+                return json.loads(r.read())
+
+        fr = get(constants.FLIGHTRECORDER_PATH)
+        assert fr["enabled"] is True
+        assert fr["windowEvents"] > 0
+        assert "eventKinds" in fr and fr["eventKinds"].get("filter")
+        full = get(constants.FLIGHTRECORDER_PATH + "?full=1")
+        assert full["kind"] == "flightRecording"
+        assert full["events"] and full["pods"]
+        # ?verdict= / ?gate= slice the journal server-side.
+        binds = get(constants.DECISIONS_PATH + "?verdict=bind")["items"]
+        assert binds and all(d["verdict"] == "bind" for d in binds)
+        waits = get(
+            constants.DECISIONS_PATH + "?verdict=wait&gate=vcQuota"
+        )["items"]
+        assert waits and all(d["verdict"] == "wait" for d in waits)
+        assert get(
+            constants.DECISIONS_PATH + "?verdict=preempt"
+        )["items"] == []
+        assert len(get(
+            constants.DECISIONS_PATH + "?verdict=bind&n=1"
+        )["items"]) == 1
+    finally:
+        ws.stop()
+
+
+# --------------------------------------------------------------------- #
+# Causal cross-shard trace stitching
+# --------------------------------------------------------------------- #
+
+
+def test_sharded_traces_are_causally_stitched(monkeypatch):
+    """Worker filter traces must commit as children of the frontend's
+    trace (parentTraceId over the pipe protocol) and the merged ring
+    must nest them — retiring the PR-8 round-robin interleave."""
+    monkeypatch.setenv("HIVED_TRACE_SAMPLE", "1")
+    import bench
+    from hivedscheduler_tpu.scheduler.shards import ShardedScheduler
+
+    front = ShardedScheduler(
+        bench.build_concurrent_config(2, 8),
+        kube_client=NullKubeClient(),
+        n_shards=2,
+        transport="local",
+        auto_admit=True,
+    )
+    try:
+        nodes = front.configured_node_names()
+        for n in nodes:
+            front.add_node(Node(name=n))
+        pod = make_pod(
+            "st0-0", "ust0", "vc0", 0, "cc0-chip", 1,
+            group=gang("gst", 1, 1),
+        )
+        front.add_pod(pod)
+        r = front.filter_routine(
+            ei.ExtenderArgs(pod=pod, node_names=nodes)
+        )
+        assert r.node_names
+        merged = front.get_traces()
+        items = merged["items"]
+        fronts = [
+            t for t in items
+            if t.get("shard") == "frontend" and t["name"] == "filter"
+        ]
+        assert fronts, items
+        parent = fronts[-1]
+        children = parent.get("children") or []
+        assert children, "worker trace did not stitch under the frontend"
+        for child in children:
+            assert child["parentTraceId"] == parent["traceId"]
+            assert child["shard"] != "frontend"
+            assert child["name"] == "filter"
+        # Every top-level item carries the cross-process wall stamp and
+        # the list is recency-ordered on it.
+        stamps = [t.get("wallTime") for t in items]
+        assert all(s is not None for s in stamps)
+        assert stamps == sorted(stamps)
+        # No stitched child is ALSO duplicated at top level.
+        child_ids = {
+            (c["shard"], c["traceId"])
+            for t in items for c in (t.get("children") or [])
+        }
+        top_ids = {(t.get("shard"), t["traceId"]) for t in items}
+        assert not (child_ids & top_ids)
+    finally:
+        front.close()

@@ -142,6 +142,33 @@ def test_quota_blocked_gang_promised_departure_eta():
     assert "g2" not in sched.core.affinity_groups
 
 
+def test_forecast_is_traced_with_fork_and_reprobe_spans():
+    """ISSUE 15 satellite: a what-if forecast lands in the live trace
+    ring (force-traced, like recovery) with forkBuild / horizonReplay /
+    queueReprobe child spans — forecast cost is visible in
+    /v1/inspect/traces alongside filter and preempt, instead of
+    run_forecast being invisible to the tracing plane."""
+    sched = quota_blocked_scene()
+    out = sched.whatif_routine({"queue": True, "horizon": DEPART_G1})
+    assert out["forecasts"]
+    traces = [
+        t for t in sched.get_traces()["items"] if t["name"] == "whatif"
+    ]
+    assert traces, "forecast left no trace in the ring"
+    tr = traces[-1]
+    assert tr["attrs"]["mode"] == "queue"
+    spans = [s["name"] for s in tr["spans"]]
+    assert "forkBuild" in spans
+    assert "horizonReplay" in spans
+    # At least the t=0 probe round and the post-departure round.
+    reprobes = [s for s in tr["spans"] if s["name"] == "queueReprobe"]
+    assert len(reprobes) >= 2
+    assert all(s["durMs"] >= 0 for s in tr["spans"])
+    # The horizonReplay span wraps the reprobe children.
+    hr = next(s for s in tr["spans"] if s["name"] == "horizonReplay")
+    assert hr["events"] == 1
+
+
 def test_blocked_beyond_horizon_carries_gate():
     sched = quota_blocked_scene()
     out = sched.whatif_routine(
