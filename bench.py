@@ -900,6 +900,10 @@ def bench_ring_ab(
                         bound.append(pod)
                 sched.delete_pods(bound)
                 lats[label].append(per_call)
+        wire_meta = {
+            label: _wire_meta(sched)
+            for label, (sched, _nodes) in modes.items()
+        }
     finally:
         if saved_ring is None:
             os.environ.pop("HIVED_SHARD_RING", None)
@@ -928,6 +932,207 @@ def bench_ring_ab(
         "p50_improvement_pct": round(
             (1.0 - ring_p50 / pipe_p50) * 100.0, 1
         ) if pipe_p50 else 0.0,
+        # Codec split + bytes-per-frame histogram (ISSUE 16 satellite):
+        # the transport win is auditable in the artifact, not just the
+        # throughput delta.
+        "wire": wire_meta,
+    }, families * hosts_per_family, t0)
+
+
+# ---------------------------------------------------------------------- #
+# One-wire A/B (HIVED_BENCH_WIRE=1): binary pipe/ring frames + delta
+# suggested sets vs the legacy pickle path (doc/hot-path.md "One wire")
+# ---------------------------------------------------------------------- #
+
+
+def _wire_meta(sched) -> dict:
+    """Codec split + per-codec power-of-two frame-size histogram from one
+    scheduler's metrics snapshot (zeros for the in-process core, which
+    has no internal transport)."""
+    m = sched.get_metrics()
+    return {
+        "bytes_by_codec": dict(m.get("wireBytesTotal") or {}),
+        "frame_hist": (
+            (m.get("shardWire") or {}).get("frameHistogram") or {}
+        ),
+        "delta_resyncs": int(m.get("deltaSuggestedResyncCount", 0) or 0),
+    }
+
+
+def _pipe_codec_bytes(sched) -> dict:
+    """Per-codec TRANSPORT bytes only (pipe + ring frames across all
+    backends), excluding the frontend HTTP envelope — the bytes-on-wire
+    number the churn gate measures."""
+    total = {"binary": 0, "pickle": 0}
+    for b in getattr(sched, "shards", ()):
+        lock = getattr(b, "_stats_lock", None)
+        if lock is None:
+            continue
+        with lock:
+            for codec, n in b.wire_bytes.items():
+                total[codec] = total.get(codec, 0) + n
+    return total
+
+
+def bench_wire_ab(
+    families: int = 4,
+    hosts_per_family: int = 432,
+    n_shards: int = 2,
+    reps: int = 5,
+    calls: int = 120,
+    churn_calls: int = 40,
+) -> dict:
+    """One-wire A/B (ISSUE 16): binary frames (``HIVED_WIRE=1``) vs the
+    legacy pickle path (``HIVED_WIRE=0``) through the SAME proc-shards
+    ``filter_raw`` entry at the 1728-host fleet, identical pre-built JSON
+    bodies, reps interleaved across the two live frontends. Two regimes
+    per rep:
+
+    - **steady**: one fixed suggested list every call — after the first
+      call the PR-12 token replaces the list in BOTH modes, so the frames
+      are pod-dict-sized and the A/B isolates the per-frame codec;
+    - **churn**: the node list changes by one host per call — the legacy
+      path re-sends the full O(fleet) list every call, the binary path
+      ships a delta edit script against the shard's last acked set. The
+      per-codec transport-byte counters give bytes-on-wire for each.
+
+    Gates are RECORDED, not asserted (the test asserts wiring, the doc
+    adjudicates): steady-state p50 ratio against the 1.3x acceptance
+    gate, churn bytes ratio against the 10x delta gate."""
+    from hivedscheduler_tpu.scheduler.shards import ShardedScheduler
+
+    t0 = time.perf_counter()
+    modes: dict = {}
+    saved_wire = os.environ.get("HIVED_WIRE")
+    try:
+        for label, wire_env in (("binary", "1"), ("legacy", "0")):
+            os.environ["HIVED_WIRE"] = wire_env
+            cfg = build_concurrent_config(families, hosts_per_family)
+            sched = ShardedScheduler(
+                cfg, kube_client=NullKubeClient(), n_shards=n_shards,
+                transport="proc", auto_admit=True,
+            )
+            nodes = sorted(
+                f"cc{i}-s{s}-w{j}"
+                for i in range(families)
+                for s in range(max(1, hosts_per_family // 4))
+                for j in range(4)
+            )
+            for n in nodes:
+                sched.add_node(Node(name=n))
+            modes[label] = (sched, nodes)
+
+        def one_call(sched, nodes, pod):
+            body = json.dumps(
+                ei.ExtenderArgs(pod=pod, node_names=nodes).to_dict()
+            ).encode()
+            sched.add_pod(pod)
+            t1 = time.perf_counter()
+            r = json.loads(sched.filter_raw(body))
+            ms = (time.perf_counter() - t1) * 1e3
+            return ms, (pod if r.get("NodeNames") else None)
+
+        steady: dict = {"binary": [], "legacy": []}
+        churn: dict = {"binary": [], "legacy": []}
+        churn_bytes = {"binary": 0, "legacy": 0}
+        for rep in range(reps):
+            for label, (sched, nodes) in modes.items():
+                bound = []
+                for i in range(calls):
+                    fam = i % families
+                    gname = f"{label}-r{rep}-g{i}"
+                    group = {
+                        "name": gname,
+                        "members": [
+                            {"podNumber": 1, "leafCellNumber": 4}
+                        ],
+                    }
+                    pod = make_pod(
+                        f"{gname}-0", f"{gname}-u0", f"vc{fam}", 0,
+                        f"cc{fam}-chip", 4, group,
+                    )
+                    ms, b = one_call(sched, nodes, pod)
+                    steady[label].append(ms)
+                    if b is not None:
+                        bound.append(b)
+                before = _pipe_codec_bytes(sched)
+                for i in range(churn_calls):
+                    # One-host churn per call: the suggested list loses a
+                    # rotating host (and regains the previous one) — a
+                    # 2-op delta for the binary path, a full O(fleet)
+                    # re-send for the legacy path. The rotation index
+                    # advances ACROSS reps so every churned set is new
+                    # to the frontend (a repeated set would ride the
+                    # PR-12 token in both modes and measure nothing).
+                    k = (rep * churn_calls + i) % len(nodes)
+                    churned = nodes[:k] + nodes[k + 1:]
+                    fam = i % families
+                    gname = f"{label}-r{rep}-c{i}"
+                    group = {
+                        "name": gname,
+                        "members": [
+                            {"podNumber": 1, "leafCellNumber": 4}
+                        ],
+                    }
+                    pod = make_pod(
+                        f"{gname}-0", f"{gname}-u0", f"vc{fam}", 0,
+                        f"cc{fam}-chip", 4, group,
+                    )
+                    ms, b = one_call(sched, churned, pod)
+                    churn[label].append(ms)
+                    if b is not None:
+                        bound.append(b)
+                after = _pipe_codec_bytes(sched)
+                churn_bytes[label] += sum(after.values()) - sum(
+                    before.values()
+                )
+                sched.delete_pods(bound)
+        wire_meta = {
+            label: _wire_meta(sched)
+            for label, (sched, _nodes) in modes.items()
+        }
+    finally:
+        if saved_wire is None:
+            os.environ.pop("HIVED_WIRE", None)
+        else:
+            os.environ["HIVED_WIRE"] = saved_wire
+        for sched, _ in modes.values():
+            sched.close()
+
+    s_bin, s_bin99 = _percentiles(steady["binary"])
+    s_leg, s_leg99 = _percentiles(steady["legacy"])
+    c_bin, _ = _percentiles(churn["binary"])
+    c_leg, _ = _percentiles(churn["legacy"])
+    bytes_ratio = (
+        churn_bytes["legacy"] / churn_bytes["binary"]
+        if churn_bytes["binary"] else 0.0
+    )
+    return _stage_meta({
+        "families": families,
+        "hosts_per_family": hosts_per_family,
+        "n_shards": n_shards,
+        "reps": reps,
+        "calls_per_rep": calls,
+        "churn_calls_per_rep": churn_calls,
+        "steady_binary_p50_ms": round(s_bin, 3),
+        "steady_binary_p99_ms": round(s_bin99, 3),
+        "steady_legacy_p50_ms": round(s_leg, 3),
+        "steady_legacy_p99_ms": round(s_leg99, 3),
+        "steady_p50_ratio": round(s_leg / s_bin, 3) if s_bin else 0.0,
+        "churn_binary_p50_ms": round(c_bin, 3),
+        "churn_legacy_p50_ms": round(c_leg, 3),
+        "churn_bytes_binary": churn_bytes["binary"],
+        "churn_bytes_legacy": churn_bytes["legacy"],
+        "churn_bytes_ratio": round(bytes_ratio, 1),
+        "gates": {
+            "steady_p50_ratio_min": 1.3,
+            "steady_gate_met": bool(
+                s_bin and s_leg / s_bin >= 1.3
+            ),
+            "churn_bytes_ratio_min": 10.0,
+            "churn_gate_met": bool(bytes_ratio >= 10.0),
+        },
+        "wire": wire_meta,
     }, families * hosts_per_family, t0)
 
 
@@ -1000,7 +1205,8 @@ def _measure_fill(filter_json, lanes) -> tuple:
 
 
 def _procs_mode(n_shards: int, families: int, hosts_per_family: int):
-    """Build one measurement subject: (filter_json, drain, close, sched).
+    """Build one measurement subject:
+    (filter_json, drain, close, fam_nodes, sched).
     n_shards == 0 is the in-process core driven through the exact JSON
     decode/encode work its webserver does per request — the
     HIVED_PROC_SHARDS=0 baseline."""
@@ -1045,7 +1251,7 @@ def _procs_mode(n_shards: int, families: int, hosts_per_family: int):
         i: [n for n in all_nodes if n.startswith(f"cc{i}-")]
         for i in range(families)
     }
-    return filter_json, drain, close, fam_nodes
+    return filter_json, drain, close, fam_nodes, sched
 
 
 def bench_procs(
@@ -1075,9 +1281,12 @@ def bench_procs(
     for n in shard_counts:
         modes[n] = _procs_mode(n, families, hosts_per_family)
     rates: dict = {n: [] for n in modes}
+    wire_meta: dict = {}
     try:
         for rep in range(reps):
-            for n, (filter_json, drain, _close, fam_nodes) in modes.items():
+            for n, (filter_json, drain, _close, fam_nodes, _s) in (
+                modes.items()
+            ):
                 lanes = []
                 for fam in range(families):
                     load = _family_fill_load(
@@ -1089,8 +1298,11 @@ def bench_procs(
                 pods, wall, bound = _measure_fill(filter_json, lanes)
                 rates[n].append(pods / wall if wall else 0.0)
                 drain(bound)
+        wire_meta = {
+            str(n): _wire_meta(mode[4]) for n, mode in modes.items()
+        }
     finally:
-        for _f, _d, close, _n in modes.values():
+        for _f, _d, close, _n, _s in modes.values():
             close()
     medians = {
         n: round(statistics.median(r), 1) for n, r in rates.items()
@@ -1116,6 +1328,9 @@ def bench_procs(
         "curve": curve,
         "best_shard_count": best,
         "best_speedup_vs_inproc": curve[str(best)]["speedup_vs_inproc"],
+        # Per-mode codec split + frame-size histogram (ISSUE 16
+        # satellite; zeros for the in-process "0" mode).
+        "wire": wire_meta,
     }, families * hosts_per_family, t0)
 
 
@@ -1144,7 +1359,7 @@ def bench_fleet_sweep(
         rates: dict = {n: [] for n in modes}
         try:
             for rep in range(reps):
-                for n, (fj, drain, _c, fam_nodes) in modes.items():
+                for n, (fj, drain, _c, fam_nodes, _s) in modes.items():
                     lanes = []
                     for fam in range(families):
                         load = _family_fill_load(
@@ -1158,7 +1373,7 @@ def bench_fleet_sweep(
                     rates[n].append(pods / wall if wall else 0.0)
                     drain(bound)
         finally:
-            for _f, _d, close, _n in modes.values():
+            for _f, _d, close, _n, _s in modes.values():
                 close()
         inproc = round(statistics.median(rates[0]), 1)
         sharded = round(statistics.median(rates[procs]), 1)
@@ -2246,6 +2461,28 @@ if __name__ == "__main__":
                             result["pipe_p50_ms"], 1e-9
                         ), 3
                     ),
+                    "extra": result,
+                }
+            )
+        )
+        sys.exit(0)
+    if os.environ.get("HIVED_BENCH_WIRE") == "1":
+        # One-wire A/B (doc/hot-path.md "One wire"): binary frames +
+        # delta suggested sets vs the HIVED_WIRE=0 legacy pickle path.
+        # Smoke sizing for CI: HIVED_BENCH_WIRE_SMOKE=1 (432-host fleet).
+        if os.environ.get("HIVED_BENCH_WIRE_SMOKE") == "1":
+            result = bench_wire_ab(
+                hosts_per_family=108, reps=2, calls=24, churn_calls=12
+            )
+        else:
+            result = bench_wire_ab()
+        print(
+            json.dumps(
+                {
+                    "metric": "wire_churn_bytes_ratio",
+                    "value": result["churn_bytes_ratio"],
+                    "unit": "x",
+                    "vs_baseline": result["steady_p50_ratio"],
                     "extra": result,
                 }
             )

@@ -64,12 +64,23 @@ class _WireExtender:
     over REAL HTTP to the webserver (the extender path the default
     scheduler calls); everything else — pod deletes, node events, status
     reads — delegates to the in-process scheduler, which is exactly the
-    informer's side of the split."""
+    informer's side of the split.
+
+    Filter rides the binary wire codec (scheduler.wire) when HIVED_WIRE
+    is on: the request is one KIND_OBJ frame, the reply a frame wrapping
+    the raw JSON result bytes. A server that refuses the frame version
+    replies HTTP 415; this client then re-sends the same call as legacy
+    JSON and LATCHES wire off for the connection — the lossless
+    cross-version fallback the golden wire test pins."""
 
     def __init__(self, sched, port: int):
         import http.client, socket
 
+        from hivedscheduler_tpu.scheduler import wire as wire_mod
+
         self._sched = sched
+        self._wire_mod = wire_mod
+        self._wire = wire_mod.enabled()
 
         class _NoDelay(http.client.HTTPConnection):
             def connect(self):
@@ -87,9 +98,37 @@ class _WireExtender:
         )
         return json.loads(self._conn.getresponse().read())
 
+    def _post_filter(self, body: dict) -> dict:
+        wire_mod = self._wire_mod
+        if not self._wire:
+            return self._post(constants.FILTER_PATH, body)
+        self._conn.request(
+            "POST",
+            constants.FILTER_PATH,
+            wire_mod.dumps(body),
+            {"Content-Type": wire_mod.CONTENT_TYPE},
+        )
+        resp = self._conn.getresponse()
+        raw = resp.read()
+        if resp.status == 415:
+            # Version refusal: this build's frames are foreign to the
+            # server. Fall back to legacy JSON and stop producing frames.
+            self._wire = False
+            return self._post(constants.FILTER_PATH, body)
+        if wire_mod.is_wire(raw):
+            # Zero-copy when the reply payload is one JSON blob; frames
+            # wrapping raw reply bytes (the sharded frontend) decode to
+            # the bytes themselves.
+            passthrough = wire_mod.json_passthrough(raw)
+            raw = (
+                passthrough if passthrough is not None
+                else wire_mod.loads(raw)
+            )
+        return json.loads(raw)
+
     def filter_routine(self, args):
         return ei.ExtenderFilterResult.from_dict(
-            self._post(constants.FILTER_PATH, args.to_dict())
+            self._post_filter(args.to_dict())
         )
 
     def preempt_routine(self, args):

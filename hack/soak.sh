@@ -78,6 +78,18 @@ if [[ "${1:-}" == "--pending" ]]; then
   exec env HIVED_BENCH_PENDING=1 python bench.py "$@"
 fi
 
+if [[ "${1:-}" == "--wire" ]]; then
+  shift
+  # One-wire A/B (doc/hot-path.md "One wire"): interleaved identical-seed
+  # binary vs HIVED_WIRE=0 legacy-pickle runs through real proc shards at
+  # the 1728-host fleet — steady-state filter percentiles plus the
+  # churning suggested-set byte ratio (delta-encoded sets), with the
+  # per-codec byte split and bytes-per-frame histogram in the artifact.
+  export JAX_PLATFORMS=cpu
+  echo "one-wire A/B: binary frames vs legacy pickle (HIVED_WIRE=0)"
+  exec env HIVED_BENCH_WIRE=1 python bench.py "$@"
+fi
+
 if [[ "${1:-}" == "--whatif" ]]; then
   # Shadow what-if plane acceptance (doc/hot-path.md "Shadow what-if
   # plane"): 432-host saturated trace, mid-trace queue forecast on a

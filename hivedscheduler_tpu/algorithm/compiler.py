@@ -312,6 +312,197 @@ def _compile_spec_batch(
     return builder.full_list, builder.free_list, builder.pinned_cells
 
 
+def _compile_spec_batch_wire(
+    cell_types: Dict[api.CellType, api.CellTypeSpec],
+    batch: List[Tuple[api.PhysicalCellSpec, int]],
+):
+    """_compile_spec_batch, handed back as ONE columnar wire frame
+    (bytes) instead of a pickled object graph: PR 11 measured the
+    parent-side unpickle of ~75k PhysicalCell objects at ~1.6 s —
+    slower than just building serially — because pickle walks and
+    reconstructs every object, parent pointer, and per-cell dict.
+    The frame ships five struct-packed columns plus two interned
+    string blobs; the parent rebuilds the trees in one tight loop
+    (doc/hot-path.md "One wire"). Falls back to the legacy triple on
+    any encode surprise — the parent accepts either shape."""
+    res = _compile_spec_batch(cell_types, batch)
+    try:
+        return _encode_cell_batch(*res)
+    except Exception:  # noqa: BLE001 — fall back to the pickled triple
+        return res
+
+
+def _encode_cell_batch(full_list, free_list, pinned_cells) -> bytes:
+    """Columnar encode of one batch's build results. Preorder records
+    per tree, trees grouped by chain in free-list order (the merge is
+    per-chain, so cross-chain interleaving inside a batch need not be
+    preserved); everything else the constructor needs is either a
+    packed column or derivable (config_order from the tree's base,
+    nodes/leaf indices from addresses + levels, exactly the way
+    _build_cell derives them)."""
+    from array import array
+
+    from ..scheduler import wire
+
+    type_table: Dict[str, int] = {}
+    addrs: List[str] = []
+    levels = array("H")
+    nchild = array("I")
+    typeids = array("H")
+    leafnums = array("I")
+    flags = array("B")
+    trees: List[Tuple[str, int, int]] = []
+    pinned_pairs: List[Tuple[int, str]] = []
+    pinned_by_id = {id(c): pid for pid, c in pinned_cells.items()}
+    idx = 0
+    for chain, ccl in free_list.items():
+        top = ccl.top_level
+        for root in ccl[top]:
+            n0 = idx
+            stack = [root]
+            while stack:
+                cell = stack.pop()
+                levels.append(cell.level)
+                nchild.append(len(cell.children))
+                tid = type_table.setdefault(
+                    str(cell.cell_type), len(type_table)
+                )
+                typeids.append(tid)
+                leafnums.append(cell.total_leaf_cell_num)
+                flags.append(
+                    (1 if cell.at_or_higher_than_node else 0)
+                    | (2 if cell.is_node_level else 0)
+                    | (4 if cell.pinned else 0)
+                )
+                addrs.append(str(cell.address))
+                if cell.pinned:
+                    pinned_pairs.append((idx, pinned_by_id[id(cell)]))
+                idx += 1
+                stack.extend(reversed(cell.children))
+            # config_order stamps are base+1..base+n in preorder, so
+            # the root's stamp recovers the whole tree's range.
+            trees.append((str(chain), idx - n0, root.config_order - 1))
+    payload = (
+        tuple(type_table),  # insertion order == id order
+        addrs,
+        levels.tobytes(),
+        nchild.tobytes(),
+        typeids.tobytes(),
+        leafnums.tobytes(),
+        flags.tobytes(),
+        tuple(trees),
+        tuple(pinned_pairs),
+    )
+    return wire.dumps(payload, kind=wire.KIND_CELLS)
+
+
+def _decode_cell_batch(buf: bytes):
+    """Rebuild (full_list, free_list, pinned_cells) from one columnar
+    frame: one tight preorder loop over packed columns. The bookkeeping
+    mirrors _build_cell/_build_top exactly — full-list append at visit
+    time (preorder == the serial append order per level), free-list
+    holds only roots, nodes/leaf indices derived from the node-level
+    address segments the same way the builder derives them — which is
+    what lets the differential compile test assert bit-identity."""
+    from array import array
+
+    from ..scheduler import wire
+
+    (
+        type_table, addrs, levels_b, nchild_b, typeids_b, leafnums_b,
+        flags_b, trees, pinned_pairs,
+    ) = wire.loads(buf, kind=wire.KIND_CELLS)
+    levels = array("H")
+    levels.frombytes(levels_b)
+    nchild = array("I")
+    nchild.frombytes(nchild_b)
+    typeids = array("H")
+    typeids.frombytes(typeids_b)
+    leafnums = array("I")
+    leafnums.frombytes(leafnums_b)
+    flags = array("B")
+    flags.frombytes(flags_b)
+    pinned_of = dict(pinned_pairs)
+
+    full: Dict[CellChain, ChainCellList] = {}
+    free: Dict[CellChain, ChainCellList] = {}
+    pinned: Dict[api.PinnedCellId, PhysicalCell] = {}
+
+    def finalize(cell: PhysicalCell, cur_node: str) -> None:
+        # Mirrors _build_cell's resource derivation at subtree
+        # completion time.
+        if cell.level == LOWEST_LEVEL:
+            last = cell.address.rsplit("/", 1)[-1]
+            cell.set_physical_resources([cur_node], [int(last)])
+        elif cell.at_or_higher_than_node and not cell.is_node_level:
+            nodes: List[str] = []
+            for ch in cell.children:
+                nodes.extend(ch.nodes)
+            cell.set_physical_resources(nodes, [-1])
+        else:
+            indices: List[int] = []
+            for ch in cell.children:
+                indices.extend(ch.leaf_cell_indices)
+            cell.set_physical_resources([cur_node], indices)
+
+    idx = 0
+    for chain, n_cells, base in trees:
+        ccl = full.get(chain)
+        if ccl is None:
+            ccl = full[chain] = ChainCellList()
+        # stack entries: [cell, children remaining, its current_node]
+        stack: List[List] = []
+        tree_root: Optional[PhysicalCell] = None
+        for k in range(n_cells):
+            lvl = levels[idx]
+            f = flags[idx]
+            address = addrs[idx]
+            cell = PhysicalCell(
+                chain,
+                lvl,
+                address,
+                bool(f & 1),
+                leafnums[idx],
+                cell_type=type_table[typeids[idx]],
+                is_node_level=bool(f & 2),
+            )
+            cell.config_order = base + k + 1
+            ccl[lvl].append(cell)
+            if f & 4:
+                cell.pinned = True
+                pinned[pinned_of[idx]] = cell
+            cur_node = stack[-1][2] if stack else ""
+            if f & 2:
+                cur_node = address.rsplit("/", 1)[-1]
+            if stack:
+                cell.parent = stack[-1][0]
+                stack[-1][0].children.append(cell)
+            else:
+                tree_root = cell
+            n = nchild[idx]
+            idx += 1
+            if n:
+                stack.append([cell, n, cur_node])
+                continue
+            finalize(cell, cur_node)
+            while stack:
+                stack[-1][1] -= 1
+                if stack[-1][1]:
+                    break
+                done, _, done_node = stack.pop()
+                finalize(done, done_node)
+        if stack:
+            # A malformed frame would desync the tree walk; the wire
+            # length/crc layers should make this unreachable.
+            raise ValueError("cell frame tree walk desynced")
+        if tree_root is not None:
+            fccl = free.get(chain)
+            if fccl is None:
+                fccl = free[chain] = ChainCellList(tree_root.level)
+            fccl[tree_root.level].append(tree_root)
+    return full, free, pinned
+
+
 def _parallel_worker_count(total_cells: int) -> int:
     """Workers for the parallel physical compile; 0 = serial. Env
     HIVED_PARALLEL_COMPILE: "0"/unset = serial (the default), N = N
@@ -411,14 +602,27 @@ def _build_physical_parallel(
         ctx = multiprocessing.get_context(start)
     except ValueError:
         ctx = multiprocessing.get_context()
+    # One wire (doc/hot-path.md "One wire"): the hand-back crosses the
+    # pool boundary as a columnar frame unless HIVED_WIRE=0 — the
+    # pickled-object-graph hand-back is the measured reason parallel
+    # compile used to lose to the serial build.
+    from ..scheduler import wire as wire_mod
+
+    worker_fn = (
+        _compile_spec_batch_wire if wire_mod.enabled()
+        else _compile_spec_batch
+    )
     with futures.ProcessPoolExecutor(
         max_workers=min(workers, max(1, len(batches))), mp_context=ctx
     ) as pool:
-        results = list(pool.map(
-            _compile_spec_batch,
-            [pc.cell_types] * len(batches),
-            batches,
-        ))
+        results = [
+            _decode_cell_batch(r) if isinstance(r, bytes) else r
+            for r in pool.map(
+                worker_fn,
+                [pc.cell_types] * len(batches),
+                batches,
+            )
+        ]
 
     # Merge in the serial insertion orders.
     chain_order: List[CellChain] = []

@@ -4250,6 +4250,13 @@ class HivedScheduler:
         recd = self.recorder
         if recd is not None:
             snap.update(recd.metrics_snapshot())
+        # One wire (scheduler.wire): per-codec transport bytes and
+        # delta-suggested-set resyncs are TRANSPORT-plane counters — the
+        # single-process core has no internal transport, so the keys are
+        # schema-stable zeros here; the sharded frontend
+        # (shards.ShardedScheduler.get_metrics) overlays the real values.
+        snap["wireBytesTotal"] = {"binary": 0, "pickle": 0, "json": 0}
+        snap["deltaSuggestedResyncCount"] = 0
         # hived_build_info labels (rendered as a constant-1 gauge): the
         # deploy-identity facts an operator cross-checks first in any
         # incident — snapshot schema, config fingerprint prefix, shard

@@ -47,6 +47,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import common
 from ..api import constants
+from . import snapshot as snapshot_mod, wire as wire_mod
 from .types import Node, Pod
 
 FLIGHT_RECORDER_ENV = "HIVED_FLIGHT_RECORDER"
@@ -338,6 +339,22 @@ class FlightRecorder:
             "rngState": rng_state,
             "seq": self._seq,
         }
+        # Anchor-at-rest compression (scheduler.wire): the window holds
+        # its anchor for the whole recording lifetime, and the packed
+        # KIND_SNAPSHOT frame is ~4.7x smaller than the live body dict's
+        # JSON (measured at 91k cells). Stored alongside the frame, the
+        # fingerprint lets recording() run the same validation ladder the
+        # HA pre-apply uses. Pack failure keeps the dict — recording must
+        # never lose an anchor to a codec edge.
+        if wire_mod.enabled():
+            try:
+                self.anchor["bodyWire"] = snapshot_mod.encode_body_wire(
+                    body, str(self.config_fingerprint), 0
+                )
+                self.anchor["body"] = None
+            except Exception:  # noqa: BLE001
+                self.anchor.pop("bodyWire", None)
+                self.anchor["body"] = body
         self.events = []
         self._pods = {}
         self._pod_memo = {}
@@ -491,6 +508,25 @@ class FlightRecorder:
     # serving / dumping
     # ------------------------------------------------------------------ #
 
+    def _anchor_for_dump(self) -> Dict:
+        """The anchor in its EXTERNAL shape (a plain ``body`` dict): the
+        recording/dump format predates the wire codec and stays
+        byte-compatible, so a wire-packed anchor-at-rest is unpacked here
+        through the same validation ladder the HA pre-apply uses. An
+        undecodable frame (impossible same-process, but recording must
+        never raise) dumps as a torn anchor with the refusal reason."""
+        buf = self.anchor.get("bodyWire")
+        if buf is None:
+            return self.anchor
+        anchor = {k: v for k, v in self.anchor.items() if k != "bodyWire"}
+        body, reason = snapshot_mod.decode_body_wire(
+            buf, str(self.config_fingerprint)
+        )
+        if body is None:
+            anchor["bodyError"] = reason
+        anchor["body"] = body
+        return anchor
+
     def recording(self) -> Dict:
         """The full dumpable window (the unit --replay-recording
         consumes)."""
@@ -501,7 +537,7 @@ class FlightRecorder:
             "granularity": self.granularity,
             "hosts": self.hosts,
             "truncated": self.truncated,
-            "anchor": self.anchor,
+            "anchor": self._anchor_for_dump(),
             "events": list(self.events),
             "pods": {str(ref): p for ref, p in self._pods.items()},
             "nodeLists": {

@@ -75,12 +75,14 @@ import json
 import os
 import pickle
 import threading
+import zlib
 from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
 
 from .. import common
 from ..api import constants, extender as ei, types as api
 from ..api.config import Config
 from . import recorder as recorder_pkg
+from . import wire as wire_mod
 from .framework import HivedScheduler, KubeClient, NullKubeClient
 from .types import (
     Node,
@@ -131,6 +133,123 @@ def _ring_candidate_args(method: str, args: tuple) -> bool:
     if method == "filter_fast":
         return len(args) > 2 and args[2] is not None
     return True
+
+
+# ------------------------------------------------------------------ #
+# One wire (doc/hot-path.md "One wire"): every pipe/ring frame is
+# packed by _pack_frame and sniffed open by _unpack_frame. With
+# HIVED_WIRE on (the default) frames go out in scheduler/wire.py's
+# binary format, falling back to pickle PER FRAME when a payload is
+# not wire-expressible (both codecs' first bytes are disjoint, so the
+# receive side never guesses); HIVED_WIRE=0 is the legacy hatch —
+# every frame goes out as pickle, which over send_bytes/recv_bytes is
+# byte-identical to the Connection.send/recv the pre-wire code used.
+# ------------------------------------------------------------------ #
+
+
+def _wire_enabled() -> bool:
+    return wire_mod.enabled()
+
+
+def _pack_frame(obj, wire_on: bool) -> Tuple[bytes, str]:
+    """Encode one pipe/ring frame; returns (bytes, codec name)."""
+    if wire_on:
+        try:
+            return wire_mod.dumps(obj), "binary"
+        except wire_mod.WireEncodeError:
+            pass
+    return pickle.dumps(obj), "pickle"
+
+
+def _unpack_frame(buf):
+    """Sniff + decode one frame. A WireVersionError propagates — both
+    pipe ends run the same build, so a version mismatch here is
+    corruption, not negotiation (the HTTP extender path is where a
+    version mismatch falls back losslessly; see webserver/server.py)."""
+    if wire_mod.is_wire(buf):
+        return wire_mod.loads(buf)
+    return pickle.loads(buf)
+
+
+# Delta-encoded suggested sets: when the suggested-node list churns,
+# the frontend ships (set id, base set id, removes, adds, crc, len)
+# against a set the worker already caches instead of the full O(fleet)
+# list. The set id IS the PR-12 suggested-set token (len, hash) — one
+# memo (the frontend's _nodes_ids map) serves both the transport and
+# the wait cache. The crc (zlib.crc32 — stable across processes,
+# unlike hash()) plus the length make a corrupted or stale base a
+# mechanical resync (__needNodes -> full list), never a wrong filter.
+_DELTA_MARK = "__hivedDelta__"
+# A delta only pays while it is small; past a quarter of the new list
+# the full STRLIST send is both simpler and about as cheap.
+_DELTA_MAX_FRACTION = 4
+
+
+def _suggested_crc(names) -> int:
+    return zlib.crc32("\x00".join(names).encode())
+
+
+def _suggested_delta(base, new, base_id):
+    """Exact edit script from tuple ``base`` to tuple ``new``: remove
+    ``removes`` (base indices, ascending), then insert ``adds`` as
+    (final index, name) in ascending order. Returns the wire marker
+    tuple, or None when the script is too large or the surviving names
+    were REORDERED (order matters — filter results may depend on it, so
+    reorders resync with the full list rather than approximate)."""
+    new_set = set(new)
+    budget = len(new) // _DELTA_MAX_FRACTION + 1
+    removes = []
+    kept = []
+    for i, b in enumerate(base):
+        if b in new_set:
+            kept.append(b)
+        else:
+            removes.append(i)
+    if len(removes) > budget:
+        return None
+    adds = []
+    j = 0
+    kl = len(kept)
+    for i, n in enumerate(new):
+        if j < kl and kept[j] == n:
+            j += 1
+        else:
+            adds.append((i, n))
+            if len(adds) > budget:
+                return None
+    if j != kl:
+        return None
+    return (
+        _DELTA_MARK, base_id, tuple(removes), tuple(adds),
+        _suggested_crc(new), len(new),
+    )
+
+
+def _apply_suggested_delta(base, marker):
+    """Worker-side delta apply + verify. Returns the rebuilt list, or
+    None when the result fails the length/crc check (stale base,
+    corrupted frame) — the caller answers __needNodes and the frontend
+    resyncs with the full list."""
+    _mark, _base_id, removes, adds, crc, length = marker
+    if removes:
+        rset = set(removes)
+        out = [b for i, b in enumerate(base) if i not in rset]
+    else:
+        out = list(base)
+    for i, n in adds:
+        out.insert(i, n)
+    if len(out) != length or _suggested_crc(out) != crc:
+        return None
+    return out
+
+
+def _is_delta_marker(nodes) -> bool:
+    return (
+        type(nodes) is tuple
+        and len(nodes) == 6
+        and nodes[0] == _DELTA_MARK
+    )
+
 
 # Multiprocessing start method for proc backends. "spawn" is the default:
 # the parent may carry JAX/XLA (or webserver) threads whose locks a fork
@@ -543,9 +662,25 @@ class ShardServer:
         the largest slice of every filter payload and is near-constant
         across calls (the default scheduler sends the same candidate set
         while the fleet is stable) — the parent sends it once per
-        distinct set, then refers to it by key. Returns the result DICT
-        (pickled small); the parent re-encodes for the HTTP reply."""
-        if nodes is not None:
+        distinct set, then refers to it by key; a churned set arrives
+        as a delta against a cached base (doc/hot-path.md "One wire").
+        Returns the result DICT (packed small); the parent re-encodes
+        for the HTTP reply."""
+        if _is_delta_marker(nodes):
+            base = self._nodes_cache.get(nodes[1])
+            rebuilt = (
+                _apply_suggested_delta(base, nodes)
+                if base is not None else None
+            )
+            if rebuilt is None:
+                # Base evicted, stale, or the frame failed its crc:
+                # answer __needNodes and let the parent resync with the
+                # full list — never filter against a guessed set.
+                return {"__needNodes": True}
+            if len(self._nodes_cache) > 64:
+                self._nodes_cache.clear()
+            nodes = self._nodes_cache[nodes_key] = rebuilt
+        elif nodes is not None:
             if len(self._nodes_cache) > 64:
                 self._nodes_cache.clear()
             nodes = self._nodes_cache[nodes_key] = list(nodes)
@@ -555,6 +690,17 @@ class ShardServer:
                 # Evicted (or a restarted worker): the parent retries
                 # with the full list.
                 return {"__needNodes": True}
+        if type(nodes_key) is tuple and len(nodes_key) == 2:
+            # The parent's set id IS the PR-12 suggested-set token
+            # (len, hash of the name tuple): seed the wait cache's
+            # single-slot memo so the first token lookup for this list
+            # object is O(1) instead of re-hashing the fleet. Parent
+            # and worker hash() seeds differ, but tokens are opaque
+            # equality values compared only inside this worker, and
+            # this seeding keeps them consistent per list object.
+            self.scheduler._suggested_token_memo = (
+                nodes, len(nodes), nodes_key
+            )
         try:
             # The MEMOIZED list object itself is handed to the filter
             # (not a per-call copy): filter_routine treats node_names as
@@ -763,7 +909,8 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
                       owned_chains: Tuple[str, ...], auto_admit: bool,
                       log_level: int,
                       plan: Optional[List[Tuple[str, ...]]] = None,
-                      ring_names: Optional[Tuple[str, str]] = None) -> None:
+                      ring_names: Optional[Tuple[str, str]] = None,
+                      wire_on: bool = True) -> None:
     """Entry point of a shard worker process: serve requests until the
     pipe closes. The protocol is PIPELINED — the parent may queue many
     requests before reading a reply, so the worker never idles waiting
@@ -781,6 +928,19 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
     req_ring = ShmRing(name=ring_names[0]) if ring_names else None
     resp_ring = ShmRing(name=ring_names[1]) if ring_names else None
 
+    # One wire: both directions ride send_bytes/recv_bytes with the
+    # frame packed by _pack_frame (binary, pickle fallback per frame)
+    # and sniffed open by _unpack_frame. With wire_on=False every frame
+    # is pickle — over send_bytes that is byte-identical to the
+    # Connection.send/recv protocol the pre-wire code used, which is
+    # what makes the HIVED_WIRE=0 A/B honest.
+    def send(obj) -> None:
+        buf, _codec = _pack_frame(obj, wire_on)
+        conn.send_bytes(buf)
+
+    def recv():
+        return _unpack_frame(conn.recv_bytes())
+
     def resolve(msg):
         # Ring frames MUST be consumed at pipe-arrival time (even when
         # the request is only buffered behind a nested kube call): the
@@ -793,14 +953,14 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
             and len(msg[2]) == 2
             and msg[2][0] == _RING_MARK
         ):
-            return (msg[0], msg[1], pickle.loads(req_ring.read(msg[2][1])))
+            return (msg[0], msg[1], _unpack_frame(req_ring.read(msg[2][1])))
         return msg
 
     def recv_kube_reply():
         # Drain queued requests into the local buffer until the kube
         # reply (a 2-tuple tagged kube_ok/kube_err) arrives.
         while True:
-            msg = conn.recv()
+            msg = recv()
             if msg is None:
                 closed[0] = True
                 raise EOFError("parent closed mid kube call")
@@ -810,7 +970,7 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
                 return msg
             pending.append(resolve(msg))
 
-    kube = _ForwardingKubeClient(conn.send, recv_kube_reply)
+    kube = _ForwardingKubeClient(send, recv_kube_reply)
     server = ShardServer(
         config, shard_id, owned_chains, kube, auto_admit=auto_admit,
         plan=plan,
@@ -820,7 +980,7 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
             msg = pending.popleft()
         else:
             try:
-                msg = resolve(conn.recv())
+                msg = resolve(recv())
             except (EOFError, OSError):
                 return
         if msg is None:
@@ -829,13 +989,20 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
         try:
             result = server.dispatch(method, args)
         except BaseException as e:  # noqa: BLE001
-            conn.send(("err", req_id, _exc_to_wire(e)))
+            send(("err", req_id, _exc_to_wire(e)))
         else:
+            if wire_on and method == "filter_fast" and type(result) is dict:
+                # The filter reply is JSON-born (ExtenderFilterResult
+                # .to_dict), so the frame may ship it as one C-speed
+                # json blob instead of an element walk. Method-gated:
+                # an arbitrary result dict could carry int keys, which
+                # Json would silently stringify.
+                result = wire_mod.Json(result)
             sent = False
             if (
                 resp_ring is not None
                 and method in _RING_METHODS
-                # O(1) size hint before the speculative pickle: only
+                # O(1) size hint before the speculative encode: only
                 # byte/str results can be cheaply sized, and they are
                 # exactly the potentially-large replies
                 # (filter_routine_raw's encoded body); filter_fast's
@@ -844,7 +1011,7 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
                 and len(result) >= _RING_MIN_BYTES
             ):
                 try:
-                    payload = pickle.dumps(result)
+                    payload, _codec = _pack_frame(result, wire_on)
                 except Exception:  # noqa: BLE001 — fall through to pipe
                     payload = None
                 if (
@@ -852,15 +1019,15 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
                     and len(payload) >= _RING_MIN_BYTES
                     and resp_ring.try_write(payload)
                 ):
-                    conn.send(("ok", req_id, (_RING_MARK, len(payload))))
+                    send(("ok", req_id, (_RING_MARK, len(payload))))
                     sent = True
             if not sent:
                 try:
-                    conn.send(("ok", req_id, result))
-                except Exception:  # noqa: BLE001 — unpicklable result
-                    conn.send(("err", req_id, (
+                    send(("ok", req_id, result))
+                except Exception:  # noqa: BLE001 — unencodable result
+                    send(("err", req_id, (
                         "exc", "TypeError",
-                        f"unpicklable result from {method}",
+                        f"unencodable result from {method}",
                     )))
 
 
@@ -914,6 +1081,7 @@ class ProcShardBackend:
         auto_admit: bool,
         plan: Optional[List[Tuple[str, ...]]] = None,
         use_ring: Optional[bool] = None,
+        use_wire: Optional[bool] = None,
     ):
         import multiprocessing as mp
 
@@ -923,6 +1091,14 @@ class ProcShardBackend:
         self.owned_chains = tuple(owned_chains)
         self._kube_handler = kube_handler
         self._send_lock = threading.Lock()
+        self._wire_on = _wire_enabled() if use_wire is None else use_wire
+        # Per-codec transport telemetry (both directions, pipe + ring),
+        # merged into wireBytesTotal / shardWire by the frontend. Sends
+        # are counted under _send_lock and receives by the (single)
+        # leader; _stats_lock covers the cross-thread dict updates.
+        self._stats_lock = threading.Lock()
+        self.wire_bytes: Dict[str, int] = {"binary": 0, "pickle": 0}
+        self.frame_hist: Dict[str, Dict[int, int]] = {}
         # Shared-memory filter ring (one per direction; see ShmRing).
         if use_ring is None:
             use_ring = _ring_enabled()
@@ -963,6 +1139,7 @@ class ProcShardBackend:
             args=(
                 child, config, shard_id, self.owned_chains, auto_admit,
                 common.log.getEffectiveLevel(), plan, ring_names,
+                self._wire_on,
             ),
             name=f"hived-shard-{shard_id}",
             daemon=True,
@@ -970,6 +1147,32 @@ class ProcShardBackend:
         self._proc.start()
         child.close()
         self._req_seq = itertools.count()
+
+    def _note_frame(self, codec: str, nbytes: int) -> None:
+        with self._stats_lock:
+            self.wire_bytes[codec] = (
+                self.wire_bytes.get(codec, 0) + nbytes
+            )
+            h = self.frame_hist.setdefault(codec, {})
+            b = nbytes.bit_length()
+            h[b] = h.get(b, 0) + 1
+
+    def _send_frame(self, obj) -> None:
+        """Pack + send one control frame under _send_lock, counting its
+        codec and size."""
+        buf, codec = _pack_frame(obj, self._wire_on)
+        with self._send_lock:
+            self._conn.send_bytes(buf)
+        self._note_frame(codec, len(buf))
+
+    def _recv_frame(self):
+        """Leader-side receive: one frame off the pipe, sniffed,
+        counted, decoded."""
+        buf = self._conn.recv_bytes()
+        self._note_frame(
+            "binary" if wire_mod.is_wire(buf) else "pickle", len(buf)
+        )
+        return _unpack_frame(buf)
 
     def _dispatch_msg(self, msg) -> None:
         if msg[0] == "kube":
@@ -980,8 +1183,7 @@ class ProcShardBackend:
                 reply = ("kube_err", _exc_to_wire(e))
             else:
                 reply = ("kube_ok", result)
-            with self._send_lock:
-                self._conn.send(reply)
+            self._send_frame(reply)
             return
         kind, rid, payload = msg
         if (
@@ -994,7 +1196,11 @@ class ProcShardBackend:
             # Resolve ring payloads at pipe-arrival time UNCONDITIONALLY
             # (even for a vanished caller): the ring is ordered by pipe
             # order, so the bytes must be consumed here or never.
-            payload = pickle.loads(self._resp_ring.read(payload[1]))
+            raw = self._resp_ring.read(payload[1])
+            self._note_frame(
+                "binary" if wire_mod.is_wire(raw) else "pickle", len(raw)
+            )
+            payload = _unpack_frame(raw)
         with self._io_lock:
             slot = self._pending.pop(rid, None)
         if slot is not None:
@@ -1026,6 +1232,7 @@ class ProcShardBackend:
                 )
             self._pending[req_id] = slot
         try:
+            ring_note = None
             with self._send_lock:
                 # Ring write + control send under ONE lock hold: pipe
                 # order must equal ring order across caller threads.
@@ -1035,15 +1242,22 @@ class ProcShardBackend:
                     and method in _RING_METHODS
                     and _ring_candidate_args(method, args)
                 ):
-                    payload = pickle.dumps(args)
+                    payload, pcodec = _pack_frame(args, self._wire_on)
                     if len(payload) < _RING_MIN_BYTES:
                         pass  # small frame: the pipe's one copy is cheaper
                     elif self._req_ring.try_write(payload):
                         wire_args = (_RING_MARK, len(payload))
                         self.ring_frames += 1
+                        ring_note = (pcodec, len(payload))
                     else:
                         self.ring_fallbacks += 1
-                self._conn.send((req_id, method, wire_args))
+                buf, codec = _pack_frame(
+                    (req_id, method, wire_args), self._wire_on
+                )
+                self._conn.send_bytes(buf)
+            self._note_frame(codec, len(buf))
+            if ring_note is not None:
+                self._note_frame(*ring_note)
         except (OSError, ValueError) as e:
             with self._io_lock:
                 self._pending.pop(req_id, None)
@@ -1068,7 +1282,7 @@ class ProcShardBackend:
             # Leader: read + dispatch one message, keep leading until my
             # own reply arrives, then hand off to one waiter.
             try:
-                msg = self._conn.recv()
+                msg = self._recv_frame()
             except (EOFError, OSError):
                 with self._io_lock:
                     self._reader_busy = False
@@ -1358,12 +1572,27 @@ class ShardedScheduler:
         # filter_fast node-list memo bookkeeping: distinct suggested-node
         # sets get a parent-assigned id; each shard is sent the full list
         # once per id and refers to it by id afterwards (the node list is
-        # the dominant slice of a filter payload at fleet scale).
-        self._nodes_ids: Dict[Tuple[str, ...], int] = {}
-        self._nodes_id_seq = itertools.count()
-        self._nodes_sent: List[Set[int]] = [
+        # the dominant slice of a filter payload at fleet scale). The id
+        # is the PR-12 suggested-set token (len, hash) — one memo serves
+        # the transport, the delta base reference, and the worker-side
+        # wait-cache token seed (doc/hot-path.md "One wire").
+        self._nodes_ids: Dict[Tuple[str, ...], Tuple[int, int]] = {}
+        self._nodes_sent: List[Set[Tuple[int, int]]] = [
             set() for _ in range(len(self.shards))
         ]
+        # Delta-encoded suggested sets: per-shard last fully-held set
+        # (id, tuple) to diff against, a single-slot transition memo
+        # (every shard sees the same fleet transition, so the O(fleet)
+        # edit script is computed once), and the resync counter.
+        self._wire_on = _wire_enabled()
+        self._nodes_acked: List[Optional[Tuple]] = [
+            None for _ in range(len(self.shards))
+        ]
+        self._delta_memo: Optional[Tuple] = None
+        self._delta_resyncs = 0
+        # HTTP envelope bytes by codec (the pipe/ring frame bytes are
+        # counted per backend; this is the frontend's own wire).
+        self._wire_env_bytes = {"json": 0, "binary": 0}
         self._op_seq = itertools.count(1)
         self._op_lock = threading.Lock()
         self._watermark = 0
@@ -1622,13 +1851,32 @@ class ShardedScheduler:
         as the worker trace's parent; the (frontend-level) flight
         recorder classifies the encoded reply without re-decoding more
         than the outcome fields."""
-        try:
-            d = json.loads(body)
-        except (ValueError, TypeError) as e:
-            return json.dumps(ei.ExtenderFilterResult(
-                error=f"Failed to unmarshal request body: {e}"
-            ).to_dict()).encode()
+        wire_body = wire_mod.is_wire(body)
+        in_len = len(body)
+        if wire_body:
+            # Binary extender frame (hack/sim_server.py): the envelope
+            # is a frame whose payload is the args dict; the reply goes
+            # back as a frame wrapping the encoded JSON reply bytes. A
+            # WireVersionError propagates — the webserver answers 415
+            # and the client re-sends legacy JSON (lossless fallback).
+            d = wire_mod.loads(body)
+            body = None
+        else:
+            try:
+                d = json.loads(body)
+            except (ValueError, TypeError) as e:
+                return json.dumps(ei.ExtenderFilterResult(
+                    error=f"Failed to unmarshal request body: {e}"
+                ).to_dict()).encode()
         out_bytes, outcome, node = self._filter_raw_routed(d, body)
+        if wire_body:
+            out_bytes = wire_mod.dumps(out_bytes)
+        # The HTTP envelope codec split: bytes in and out of the
+        # frontend (doc/observability.md wireBytesTotal).
+        with self._maps_lock:
+            self._wire_env_bytes[
+                "binary" if wire_body else "json"
+            ] += in_len + len(out_bytes)
         rec = self.recorder
         if rec is not None:
             try:
@@ -1677,26 +1925,67 @@ class ShardedScheduler:
                 nid = self._nodes_ids.get(nodes_key)
                 if nid is None:
                     if len(self._nodes_ids) > 4096:
-                        # Ids are never reused (monotonic counter), so a
-                        # forgotten mapping only costs one full re-send.
+                        # A forgotten mapping only costs one full
+                        # re-send; the delta bases die with the ids.
                         self._nodes_ids.clear()
                         for s in self._nodes_sent:
                             s.clear()
-                    nid = self._nodes_ids[nodes_key] = next(
-                        self._nodes_id_seq
+                        self._nodes_acked = [
+                            None for _ in self._nodes_acked
+                        ]
+                    # The set id IS the PR-12 token: hashed once here,
+                    # reused as the worker cache key, the delta base
+                    # reference, and the wait-cache memo seed.
+                    nid = self._nodes_ids[nodes_key] = (
+                        len(nodes_key), hash(nodes_key)
                     )
                 send_full = nid not in self._nodes_sent[sid]
+                payload = nodes if send_full else None
+                if send_full and self._wire_on:
+                    # Churned set: ship an edit script against a set
+                    # this shard already holds instead of the full
+                    # O(fleet) list. The (base, new) transition memo is
+                    # single-slot because every shard crosses the same
+                    # fleet transitions one after another.
+                    acked = self._nodes_acked[sid]
+                    if acked is not None:
+                        base_id, base_key = acked
+                        memo = self._delta_memo
+                        if (
+                            memo is not None
+                            and memo[0] is base_key
+                            and memo[1] is nodes_key
+                        ):
+                            delta = memo[2]
+                        else:
+                            delta = _suggested_delta(
+                                base_key, nodes_key, base_id
+                            )
+                            self._delta_memo = (
+                                base_key, nodes_key, delta
+                            )
+                        if delta is not None:
+                            payload = delta
+            # The pod dict is JSON-born (decoded straight from the
+            # request body), so the wire codec may ship it as one
+            # C-speed json blob instead of an element walk.
+            pod_w = wire_mod.Json(pod_d) if self._wire_on else pod_d
             with tr.span("shardCall", shard=sid):
                 out = self.shards[sid].call(
-                    "filter_fast", pod_d, nid,
-                    nodes if send_full else None, parent,
+                    "filter_fast", pod_w, nid, payload, parent,
                 )
                 if out.get("__needNodes"):
+                    if _is_delta_marker(payload):
+                        # Delta base miss/mismatch: the resync path —
+                        # counted, then the full list goes out.
+                        with self._maps_lock:
+                            self._delta_resyncs += 1
                     out = self.shards[sid].call(
-                        "filter_fast", pod_d, nid, nodes, parent
+                        "filter_fast", pod_w, nid, nodes, parent
                     )
             with self._maps_lock:
                 self._nodes_sent[sid].add(nid)
+                self._nodes_acked[sid] = (nid, nodes_key)
                 self._uid_shard[uid] = sid
                 if cached[1]:
                     self._group_shard[cached[1]] = sid
@@ -1708,6 +1997,11 @@ class ShardedScheduler:
         # (identical probe order to the in-process scan).
         out = None
         r = None
+        if body is None:
+            # Wire-framed request (no JSON envelope to forward): the
+            # sweep workers decode JSON, so re-encode once. Rare path —
+            # sweeps are cross-family untyped pods only.
+            body = json.dumps(d).encode()
         for sid, leaf_types in self._sweep_chunks:
             with tr.span("shardCall", shard=sid, sweep=True):
                 out = self.shards[sid].call(
@@ -2432,6 +2726,38 @@ class ShardedScheduler:
             "fallbacks": sum(
                 getattr(b, "ring_fallbacks", 0) for b in self.shards
             ),
+        }
+        # One wire: per-codec transport bytes (pipe + ring frames from
+        # every backend, plus the frontend's own HTTP envelope) and the
+        # per-codec power-of-two frame-size histogram (JSON-only, like
+        # shardRing; doc/observability.md).
+        wire_bytes = {"binary": 0, "pickle": 0, "json": 0}
+        frame_hist: Dict[str, Dict[str, int]] = {}
+        with self._maps_lock:
+            for codec, n in self._wire_env_bytes.items():
+                wire_bytes[codec] = wire_bytes.get(codec, 0) + n
+            resyncs = self._delta_resyncs
+        for b in self.shards:
+            stats_lock = getattr(b, "_stats_lock", None)
+            if stats_lock is None:
+                continue
+            with stats_lock:
+                b_bytes = dict(b.wire_bytes)
+                b_hist = {c: dict(h) for c, h in b.frame_hist.items()}
+            for codec, n in b_bytes.items():
+                wire_bytes[codec] = wire_bytes.get(codec, 0) + n
+            for codec, h in b_hist.items():
+                agg = frame_hist.setdefault(codec, {})
+                for bucket, count in h.items():
+                    key = str(bucket)
+                    agg[key] = agg.get(key, 0) + count
+        merged["wireBytesTotal"] = wire_bytes
+        merged["deltaSuggestedResyncCount"] = (
+            merged.get("deltaSuggestedResyncCount", 0) + resyncs
+        )
+        merged["shardWire"] = {
+            "enabled": self._wire_on,
+            "frameHistogram": frame_hist,
         }
         merged["lockSharding"] = f"procs:{len(self.shards)}"
         # Fork staleness is a per-shard gauge: the merged value is the

@@ -40,6 +40,7 @@ import json
 from typing import Dict, List, Optional, Tuple
 
 from ..api.config import Config
+from . import wire
 
 # Bump when the body schema changes shape; decode refuses other versions
 # (rung 1 of the fallback ladder). The golden schema test pins the
@@ -165,6 +166,65 @@ def encode(
         "watermark": watermark,
     }
     return [json.dumps(meta, separators=(",", ":"))] + chunks
+
+
+def encode_body_wire(
+    body: Dict,
+    fingerprint: str,
+    watermark,
+    schema_version: int = SCHEMA_VERSION,
+) -> bytes:
+    """Pack a snapshot body into one binary KIND_SNAPSHOT frame for the
+    hops that never touch the apiserver (HA pre-apply, what-if fork,
+    flight-recorder anchor). The durable ConfigMap format stays the JSON
+    chunk envelope of ``encode`` — this frame is an IN-PROCESS transport:
+    no chunking, no SHA-256 (the wire header's magic/version/length
+    framing plus the fingerprint rung below carry the same refusals), and
+    the body rides as one C-speed JSON blob inside the frame."""
+    return wire.dumps(
+        (int(schema_version), str(fingerprint), watermark, wire.Json(body)),
+        kind=wire.KIND_SNAPSHOT,
+    )
+
+
+def decode_body_wire(
+    buf: bytes,
+    expected_fingerprint: str,
+    min_watermark=None,
+) -> Tuple[Optional[Dict], str]:
+    """Validation ladder for ``encode_body_wire`` frames — same contract
+    as ``decode``: ``(body, "")`` or ``(None, reason)``, never raises.
+    Rungs mirror the JSON envelope's: frame decodes at this build's wire
+    version, schema version matches, fingerprint matches, watermark not
+    older than the delta floor, body snapshot-shaped."""
+    try:
+        payload = wire.loads(buf, kind=wire.KIND_SNAPSHOT)
+    except wire.WireError as e:
+        return None, f"wire frame undecodable: {e}"
+    if not (isinstance(payload, tuple) and len(payload) == 4):
+        return None, "wire frame is not snapshot-shaped"
+    schema_version, fingerprint, watermark, body = payload
+    if schema_version != SCHEMA_VERSION:
+        return None, (
+            f"schema version mismatch: snapshot {schema_version}, "
+            f"running {SCHEMA_VERSION}"
+        )
+    if fingerprint != expected_fingerprint:
+        return None, (
+            "config fingerprint mismatch (reconfigured since the snapshot)"
+        )
+    if min_watermark is not None and _watermark_older(
+        watermark, min_watermark
+    ):
+        return None, (
+            f"stale watermark: snapshot at {watermark!r}, delta "
+            f"floor {min_watermark!r}"
+        )
+    if not isinstance(body, dict) or not isinstance(body.get("pods"), list):
+        return None, "body is not snapshot-shaped (missing pods list)"
+    if not isinstance(body.get("core"), dict):
+        return None, "body is not snapshot-shaped (missing core projection)"
+    return body, ""
 
 
 def _watermark_older(watermark, floor) -> bool:

@@ -62,7 +62,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .. import common
 from ..api import extender as ei, types as api
-from . import tracing
+from . import snapshot as snapshot_mod, tracing, wire as wire_mod
 from .framework import (
     HivedScheduler,
     NullKubeClient,
@@ -263,6 +263,31 @@ class WhatIfPlane:
                 "live projection is transient (preemption or gang "
                 "admission in flight); retry the what-if call",
             )
+        # The fork hop rides the snapshot wire codec (scheduler.wire):
+        # pack + unpack gives the fork a codec-fresh body that shares NO
+        # mutable sub-object with the live export — the same isolation
+        # the ConfigMap round-trip used to imply, at C-speed JSON cost —
+        # and keeps this hop differential-testable against the HA
+        # restore path (same frame, same validation ladder). A refusal
+        # here is a codec bug, not a staleness condition: fall back to
+        # the direct dict and log, never fail the forecast.
+        if wire_mod.enabled():
+            fp = str(getattr(self.sched, "_config_fingerprint", "") or "")
+            try:
+                packed = snapshot_mod.encode_body_wire(
+                    body, fp, getattr(self.sched, "_watermark", 0)
+                )
+                unpacked, reason = snapshot_mod.decode_body_wire(packed, fp)
+            except Exception:  # noqa: BLE001
+                common.log.exception("what-if fork wire hop failed")
+                unpacked, reason = None, "encode raised"
+            if unpacked is not None:
+                body = unpacked
+            else:
+                common.log.warning(
+                    "what-if fork wire hop refused (%s); forking from "
+                    "the direct export", reason,
+                )
         fork = HivedScheduler(
             self.sched.config,
             kube_client=NullKubeClient(),

@@ -1,0 +1,419 @@
+"""One wire for everything: the versioned compact binary frame format
+every internal hop rides (doc/hot-path.md "One wire").
+
+Three measured ledger rows pointed at the same bottleneck — serialization
+(parallel-compile pickle-back, pod-dict-sized ring pickles, O(fleet) JSON
+suggested-node lists) — so this module is the single codec those hops now
+share: the shards duplex pipe + ShmRing frames, the parallel-compile
+hand-back, the snapshot fork/anchor hops, and the sim server's HTTP wire.
+
+Frame layout (golden-pinned by tests/test_wire.py):
+
+    MAGIC(1) VERSION(1) KIND(1) VARINT(payload bytes) PAYLOAD
+
+``MAGIC`` (0xA7) collides with neither pickle (protocol >= 2 starts with
+0x80) nor JSON (``{``/``[``/whitespace), so every receiving hop sniffs the
+first byte and falls back to its legacy codec losslessly — the
+``HIVED_WIRE=0`` hatch simply stops producing frames, and mixed traffic
+decodes fine during the transition. A version-byte mismatch raises
+``WireVersionError`` (the caller re-sends legacy or refuses), and the
+payload-length varint makes truncation a mechanical ``WireTruncatedError``
+instead of a misdecode.
+
+The PAYLOAD is one tagged value. Scalars are struct-packed (zigzag-free
+dual-tag varints for ints, big-endian f64 for floats); strings are
+interned per frame (first occurrence carries the bytes, repeats are a
+varint back-reference — node/chain/VC names repeat heavily in cell and
+snapshot frames); two bulk fast paths keep the hot frames at C speed:
+
+- ``STRLIST``: an all-string list (the suggested-node list) is one
+  NUL-joined blob — ``str.join``/``str.split`` instead of per-element
+  tag dispatch;
+- ``JSON``: a dict wrapped in ``wire.Json`` (caller-asserted JSON-safe:
+  string keys all the way down, JSON value types only — true for every
+  k8s-born pod dict and every ``to_dict()`` result) is one ``json.dumps``
+  blob — the C encoder does the element walk.
+
+Anything the tagged model cannot express raises ``WireEncodeError`` and
+the transport falls back to pickle for that frame (counted per codec in
+``wireBytesTotal``); decode correctness never depends on the fallback
+being rare.
+
+Pure data transformation — no locks, no I/O, no imports from the rest of
+the package — so both the scheduler layer and the algorithm layer (the
+compile hand-back) can use it without an import cycle.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Dict, Optional
+
+MAGIC = 0xA7
+VERSION = 1
+
+# Frame kinds: caller semantics, pinned by the golden wire-format test.
+KIND_OBJ = 1       # generic scheduler object (pipe / ring frames)
+KIND_SNAPSHOT = 2  # snapshot-body envelope (fork / anchor hops)
+KIND_CELLS = 3     # struct-packed compile hand-back (columnar cells)
+KIND_DELTA = 4     # delta-encoded suggested set
+
+# HTTP content type for binary extender frames (hack/sim_server.py).
+CONTENT_TYPE = "application/x-hived-wire"
+
+# The one knob: HIVED_WIRE=0 stops every hop from PRODUCING frames
+# (receivers still sniff, so mixed traffic during a rollout decodes).
+WIRE_ENV = "HIVED_WIRE"
+
+
+def enabled() -> bool:
+    """The legacy hatch, read fresh per call site so tests and the A/B
+    bench can flip it per stage: HIVED_WIRE=0 reverts every producer to
+    its legacy codec; receivers keep sniffing either way."""
+    return os.environ.get(WIRE_ENV, "1").strip() != "0"
+
+
+class WireError(Exception):
+    """Base for every wire codec error."""
+
+
+class WireEncodeError(WireError):
+    """Value not expressible in the tagged model — fall back to pickle."""
+
+
+class WireDecodeError(WireError):
+    """Frame is not decodable as the running wire format."""
+
+
+class WireVersionError(WireDecodeError):
+    """Frame carries a different format version — refuse, never guess."""
+
+
+class WireTruncatedError(WireDecodeError):
+    """Frame shorter than its own length header (cut mid-transport)."""
+
+
+class Json(dict):
+    """Marker subclass: this dict is JSON-born (string keys all the way
+    down, JSON value types only), so the encoder may serialize it as one
+    C-speed ``json.dumps`` blob instead of element-wise. The contract is
+    caller-asserted; a dict that turns out not to be JSON-encodable is
+    transparently re-encoded element-wise."""
+
+    __slots__ = ()
+
+
+# --------------------------------------------------------------------- #
+# Value tags (pinned by the golden fixtures — renumbering is a VERSION
+# bump, not an edit)
+# --------------------------------------------------------------------- #
+
+_T_NONE = 0
+_T_TRUE = 1
+_T_FALSE = 2
+_T_POSINT = 3   # varint n
+_T_NEGINT = 4   # varint (-1 - n)
+_T_FLOAT = 5    # 8-byte big-endian double
+_T_STR = 6      # varint len + utf8; registers the next intern index
+_T_REF = 7      # varint intern index (string back-reference)
+_T_BYTES = 8    # varint len + raw
+_T_LIST = 9     # varint n + values
+_T_TUPLE = 10   # varint n + values
+_T_DICT = 11    # varint n + (key value) pairs
+_T_JSON = 12    # varint len + json utf8 (decodes to a plain dict)
+_T_STRLIST = 13  # varint n + varint len + NUL-joined utf8
+
+_pack_f64 = struct.Struct(">d").pack
+_unpack_f64 = struct.Struct(">d").unpack_from
+
+
+def _w_varint(out: bytearray, n: int) -> None:
+    while n > 0x7F:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+
+
+def _r_varint(buf: bytes, pos: int):
+    shift = 0
+    n = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return n, pos
+        shift += 7
+
+
+def _w_value(obj: Any, out: bytearray, interns: Dict[str, int]) -> None:
+    t = type(obj)
+    if t is str:
+        idx = interns.get(obj)
+        if idx is not None:
+            out.append(_T_REF)
+            _w_varint(out, idx)
+        else:
+            interns[obj] = len(interns)
+            b = obj.encode()
+            out.append(_T_STR)
+            _w_varint(out, len(b))
+            out += b
+    elif t is int:
+        if obj >= 0:
+            out.append(_T_POSINT)
+            _w_varint(out, obj)
+        else:
+            out.append(_T_NEGINT)
+            _w_varint(out, -1 - obj)
+    elif obj is None:
+        out.append(_T_NONE)
+    elif t is bool:
+        out.append(_T_TRUE if obj else _T_FALSE)
+    elif t is float:
+        out.append(_T_FLOAT)
+        out += _pack_f64(obj)
+    elif t is Json:
+        try:
+            b = json.dumps(obj, separators=(",", ":")).encode()
+        except (TypeError, ValueError):
+            # Caller over-promised; the element-wise path is always safe.
+            out.append(_T_DICT)
+            _w_varint(out, len(obj))
+            for k, v in obj.items():
+                _w_value(k, out, interns)
+                _w_value(v, out, interns)
+        else:
+            out.append(_T_JSON)
+            _w_varint(out, len(b))
+            out += b
+    elif t is dict:
+        out.append(_T_DICT)
+        _w_varint(out, len(obj))
+        for k, v in obj.items():
+            _w_value(k, out, interns)
+            _w_value(v, out, interns)
+    elif t is list:
+        if obj and all(
+            type(x) is str and "\x00" not in x for x in obj
+        ):
+            # Suggested-node-list fast path: one C-level join; decode is
+            # one C-level split. No interning — the names are unique.
+            b = "\x00".join(obj).encode()
+            out.append(_T_STRLIST)
+            _w_varint(out, len(obj))
+            _w_varint(out, len(b))
+            out += b
+        else:
+            out.append(_T_LIST)
+            _w_varint(out, len(obj))
+            for v in obj:
+                _w_value(v, out, interns)
+    elif t is tuple:
+        out.append(_T_TUPLE)
+        _w_varint(out, len(obj))
+        for v in obj:
+            _w_value(v, out, interns)
+    elif t is bytes:
+        out.append(_T_BYTES)
+        _w_varint(out, len(obj))
+        out += obj
+    elif t is bytearray or t is memoryview:
+        b = bytes(obj)
+        out.append(_T_BYTES)
+        _w_varint(out, len(b))
+        out += b
+    else:
+        # Subclasses land here on purpose: round-tripping them as their
+        # base type would silently change the object's type.
+        raise WireEncodeError(
+            f"type {t.__module__}.{t.__name__} is not wire-encodable"
+        )
+
+
+def _r_value(buf: bytes, pos: int, strings: list):
+    tag = buf[pos]
+    pos += 1
+    if tag == _T_STR:
+        n, pos = _r_varint(buf, pos)
+        end = pos + n
+        if end > len(buf):
+            raise WireTruncatedError("string runs past frame end")
+        s = buf[pos:end].decode()
+        strings.append(s)
+        return s, end
+    if tag == _T_REF:
+        n, pos = _r_varint(buf, pos)
+        try:
+            return strings[n], pos
+        except IndexError:
+            raise WireDecodeError(f"intern reference {n} out of range")
+    if tag == _T_POSINT:
+        return _r_varint(buf, pos)
+    if tag == _T_NEGINT:
+        n, pos = _r_varint(buf, pos)
+        return -1 - n, pos
+    if tag == _T_NONE:
+        return None, pos
+    if tag == _T_TRUE:
+        return True, pos
+    if tag == _T_FALSE:
+        return False, pos
+    if tag == _T_FLOAT:
+        if pos + 8 > len(buf):
+            raise WireTruncatedError("float runs past frame end")
+        return _unpack_f64(buf, pos)[0], pos + 8
+    if tag == _T_JSON:
+        n, pos = _r_varint(buf, pos)
+        end = pos + n
+        if end > len(buf):
+            raise WireTruncatedError("json blob runs past frame end")
+        try:
+            return json.loads(buf[pos:end]), end
+        except ValueError as e:
+            raise WireDecodeError(f"json blob undecodable: {e}")
+    if tag == _T_DICT:
+        n, pos = _r_varint(buf, pos)
+        d = {}
+        for _ in range(n):
+            k, pos = _r_value(buf, pos, strings)
+            v, pos = _r_value(buf, pos, strings)
+            d[k] = v
+        return d, pos
+    if tag == _T_LIST:
+        n, pos = _r_varint(buf, pos)
+        lst = []
+        append = lst.append
+        for _ in range(n):
+            v, pos = _r_value(buf, pos, strings)
+            append(v)
+        return lst, pos
+    if tag == _T_STRLIST:
+        n, pos = _r_varint(buf, pos)
+        blen, pos = _r_varint(buf, pos)
+        end = pos + blen
+        if end > len(buf):
+            raise WireTruncatedError("string list runs past frame end")
+        lst = buf[pos:end].decode().split("\x00")
+        if len(lst) != n:
+            raise WireDecodeError(
+                f"string list count mismatch: header {n}, got {len(lst)}"
+            )
+        return lst, end
+    if tag == _T_TUPLE:
+        n, pos = _r_varint(buf, pos)
+        items = []
+        append = items.append
+        for _ in range(n):
+            v, pos = _r_value(buf, pos, strings)
+            append(v)
+        return tuple(items), pos
+    if tag == _T_BYTES:
+        n, pos = _r_varint(buf, pos)
+        end = pos + n
+        if end > len(buf):
+            raise WireTruncatedError("bytes run past frame end")
+        return buf[pos:end], end
+    raise WireDecodeError(f"unknown value tag {tag}")
+
+
+# --------------------------------------------------------------------- #
+# Frames
+# --------------------------------------------------------------------- #
+
+
+def dumps(obj: Any, kind: int = KIND_OBJ) -> bytes:
+    """Encode one value into a self-delimiting wire frame. Raises
+    ``WireEncodeError`` (and produces nothing) when the value is not
+    expressible — callers fall back to their legacy codec per frame."""
+    out = bytearray()
+    _w_value(obj, out, {})
+    head = bytearray((MAGIC, VERSION, kind))
+    _w_varint(head, len(out))
+    head += out
+    return bytes(head)
+
+
+def is_wire(buf) -> bool:
+    """First-byte sniff: True when ``buf`` can only be a wire frame (of
+    ANY version — version errors must surface, not fall back)."""
+    return len(buf) >= 4 and buf[0] == MAGIC
+
+
+def frame_kind(buf) -> int:
+    """The KIND byte of a validated frame header."""
+    if not is_wire(buf):
+        raise WireDecodeError("not a wire frame")
+    return buf[2]
+
+
+def loads(buf, kind: Optional[int] = None) -> Any:
+    """Decode one frame. The validation ladder is mechanical: magic,
+    version (refusal, not fallback), optional kind pin, payload length
+    (truncation), then the tagged payload with no trailing bytes."""
+    if isinstance(buf, (bytearray, memoryview)):
+        buf = bytes(buf)
+    if not isinstance(buf, bytes) or len(buf) < 4 or buf[0] != MAGIC:
+        raise WireDecodeError("not a wire frame")
+    if buf[1] != VERSION:
+        raise WireVersionError(
+            f"wire version {buf[1]}, running {VERSION}"
+        )
+    if kind is not None and buf[2] != kind:
+        raise WireDecodeError(
+            f"frame kind {buf[2]}, expected {kind}"
+        )
+    try:
+        paylen, pos = _r_varint(buf, 3)
+    except IndexError:
+        raise WireTruncatedError("frame cut inside the length header")
+    if len(buf) - pos != paylen:
+        raise WireTruncatedError(
+            f"payload length mismatch: header says {paylen} bytes, "
+            f"got {len(buf) - pos}"
+        )
+    try:
+        val, end = _r_value(buf, pos, [])
+    except (IndexError, struct.error):
+        raise WireTruncatedError("frame cut inside the payload")
+    if end != len(buf):
+        raise WireDecodeError(f"{len(buf) - end} trailing bytes")
+    return val
+
+
+def json_passthrough(buf) -> Optional[bytes]:
+    """Zero-copy reply path: when a frame's payload is exactly one JSON
+    blob (a ``wire.Json`` reply), return the raw JSON bytes — the HTTP
+    layer can write them verbatim, skipping the decode + ``json.dumps``
+    re-encode the legacy pickle path pays. Returns None for any other
+    shape (caller falls back to ``loads``)."""
+    if isinstance(buf, (bytearray, memoryview)):
+        buf = bytes(buf)
+    if (
+        not isinstance(buf, bytes)
+        or len(buf) < 5
+        or buf[0] != MAGIC
+        or buf[1] != VERSION
+    ):
+        return None
+    try:
+        paylen, pos = _r_varint(buf, 3)
+    except IndexError:
+        return None
+    if len(buf) - pos != paylen or buf[pos] != _T_JSON:
+        return None
+    try:
+        blen, bpos = _r_varint(buf, pos + 1)
+    except IndexError:
+        return None
+    if bpos + blen != len(buf):
+        return None
+    return buf[bpos:]
+
+
+def frame_size_bucket(n: int) -> int:
+    """Power-of-two size bucket for the bytes-per-frame histogram the
+    bench stages record (bucket k covers [2^(k-1), 2^k) bytes)."""
+    return n.bit_length()

@@ -71,6 +71,7 @@ COUNTERS: Dict[str, tuple] = {
     "auditViolationCount": ("hived_audit_violations_total", "live-audit invariant violations (counted + journaled + black-box bundle dumped; the scheduler keeps serving — should stay 0)"),
     "flightRecorderEventCount": ("hived_flightrecorder_events_total", "mutating verbs captured by the flight recorder since process start"),
     "flightRecorderReanchorCount": ("hived_flightrecorder_reanchors_total", "flight-recorder windows re-anchored on a fresh snapshot export (ring wrap or post-recovery)"),
+    "deltaSuggestedResyncCount": ("hived_delta_suggested_resyncs_total", "delta-encoded suggested-set frames a worker refused (base mismatch or integrity check) and the frontend resynced with a full list (one wire plane; should stay near 0)"),
 }
 
 GAUGES: Dict[str, tuple] = {
@@ -105,6 +106,7 @@ LABELED: Dict[str, str] = {
     "hived_phase_ops_total": "per-phase operation count (phase label)",
     "hived_boot_phase_seconds": "boot wall seconds per phase (phase label: compile, healthInit, nodeAdd, fingerprint, recovery) — a gauge of the LAST boot, so standby cold-start is observable, not inferred",
     "hived_build_info": "constant-1 gauge whose labels identify the running deploy: snapshotSchema, configFingerprint (12-hex prefix), shards, and the hatch states (lazyVc, waitCache, nodeEventFastpath, liveAudit, flightRecorder)",
+    "hived_wire_bytes_total": "per-codec internal-transport bytes (codec label: binary, pickle, json) — shard pipe/ring frames plus the frontend's HTTP filter envelope; zeros in a single-process deploy (one wire plane)",
 }
 
 # JSON-snapshot keys that are deliberately NOT exported to Prometheus:
@@ -121,6 +123,8 @@ EXCLUDED_KEYS = {
     "recoveryMode",         # string mode flag ("none"/"full"/"snapshot+delta")
     "bootPhaseSeconds",     # rendered as the hived_boot_phase_seconds gauge
     "buildInfo",            # rendered as the hived_build_info labeled gauge
+    "wireBytesTotal",       # rendered as the hived_wire_bytes_total labeled counter
+    "shardWire",            # JSON-only transport detail (frame histogram)
 }
 
 
@@ -204,6 +208,18 @@ def render(snapshot: Dict) -> str:
             'hived_lock_acquisitions_total{chain="%s"} %s'
             % (_escape_label(chain), _fmt(entry["count"]))
         )
+
+    wire = snapshot.get("wireBytesTotal")
+    if wire is not None:
+        header(
+            "hived_wire_bytes_total", "counter",
+            LABELED["hived_wire_bytes_total"],
+        )
+        for codec, total in sorted(wire.items()):
+            lines.append(
+                'hived_wire_bytes_total{codec="%s"} %s'
+                % (_escape_label(codec), _fmt(int(total)))
+            )
 
     build = snapshot.get("buildInfo")
     if build:

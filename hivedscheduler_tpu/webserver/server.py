@@ -21,7 +21,7 @@ from typing import Callable, Dict, Optional, Tuple
 
 from .. import common
 from ..api import constants, extender as ei, types as api
-from ..scheduler import kube as kube_mod
+from ..scheduler import kube as kube_mod, wire as wire_mod
 from ..scheduler.framework import HivedScheduler
 from . import prometheus
 
@@ -122,6 +122,21 @@ def _make_handler(scheduler: HivedScheduler):
             self.end_headers()
             self.wfile.write(data)
 
+        def _reply_raw(self, data: bytes) -> None:
+            """200 with pre-encoded filter bytes: JSON from the legacy
+            path, a wire frame when the request was one (the content
+            type tells the client which decoder to reach for)."""
+            self.send_response(200)
+            self.send_header(
+                "Content-Type",
+                wire_mod.CONTENT_TYPE
+                if wire_mod.is_wire(data)
+                else "application/json",
+            )
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def _reply_error(self, e: Exception) -> None:
             """(reference: webserver.go:136-165 panic→HTTP mapping)"""
             if isinstance(e, api.WebServerError):
@@ -147,22 +162,45 @@ def _make_handler(scheduler: HivedScheduler):
                 kube_mod.set_request_deadline(budget)
             try:
                 if path == constants.FILTER_PATH:
+                    # Binary extender frames (scheduler.wire): a client
+                    # that sent a wire frame gets a wire-framed reply (the
+                    # raw JSON result bytes as one BYTES payload); a
+                    # version-byte mismatch maps to HTTP 415 so the client
+                    # re-sends legacy JSON and latches wire off — the
+                    # lossless cross-version fallback.
+                    wire_body = wire_mod.is_wire(body)
                     raw = getattr(scheduler, "filter_raw", None)
                     if raw is not None:
                         # Multi-process frontend (scheduler.shards): the
                         # filter body is routed and forwarded as raw
                         # bytes; decode/encode happen in the worker so
                         # this thread's GIL share stays O(1) per call.
-                        data = raw(body)
-                        self.send_response(200)
-                        self.send_header(
-                            "Content-Type", "application/json"
-                        )
-                        self.send_header("Content-Length", str(len(data)))
-                        self.end_headers()
-                        self.wfile.write(data)
+                        try:
+                            data = raw(body)
+                        except wire_mod.WireVersionError as e:
+                            raise api.WebServerError(
+                                415, f"wire version mismatch: {e}"
+                            )
+                        self._reply_raw(data)
                         return
-                    args = ei.ExtenderArgs.from_dict(self._parse_json(body))
+                    if wire_body:
+                        try:
+                            d = wire_mod.loads(
+                                body, kind=wire_mod.KIND_OBJ
+                            )
+                        except wire_mod.WireVersionError as e:
+                            raise api.WebServerError(
+                                415, f"wire version mismatch: {e}"
+                            )
+                        except wire_mod.WireError as e:
+                            raise api.bad_request(
+                                f"Failed to unmarshal wire frame: {e}"
+                            )
+                        args = ei.ExtenderArgs.from_dict(d)
+                    else:
+                        args = ei.ExtenderArgs.from_dict(
+                            self._parse_json(body)
+                        )
                     # Errors inside filter must be reported in-band in the
                     # Error field so the default scheduler sees them
                     # (reference: serveFilterPath recovers to
@@ -171,7 +209,16 @@ def _make_handler(scheduler: HivedScheduler):
                         result = scheduler.filter_routine(args)
                     except api.WebServerError as e:
                         result = ei.ExtenderFilterResult(error=e.message)
-                    self._reply(200, result.to_dict())
+                    if wire_body:
+                        # One TAG_JSON payload: the encoder json.dumps's
+                        # it at C speed and the client's json_passthrough
+                        # slices the JSON bytes back out without a frame
+                        # walk.
+                        self._reply_raw(wire_mod.dumps(
+                            wire_mod.Json(result.to_dict())
+                        ))
+                    else:
+                        self._reply(200, result.to_dict())
                 elif path == constants.BIND_PATH:
                     args2 = ei.ExtenderBindingArgs.from_dict(
                         self._parse_json(body)

@@ -263,6 +263,42 @@ def test_bench_ring_ab_smoke():
     json.dumps(result)
 
 
+def test_bench_wire_ab_smoke():
+    """Smoke-sized variant of the HIVED_BENCH_WIRE stage (ISSUE 16
+    CI/tooling satellite): the one-wire A/B — binary frames vs
+    HIVED_WIRE=0 legacy pickle through real proc shards at identical
+    seed — must emit both modes' steady/churn percentiles, the per-codec
+    byte split, the bytes-per-frame histogram, and the delta plane's
+    counters. The >=1.3x steady p50 and >=10x churn-bytes gates are the
+    1728-host driver stage's (hack/soak.sh --wire); CI boxes guard
+    wiring plus the mechanical facts: binary mode actually produced
+    binary frames, legacy mode produced none, the delta path shrank the
+    churn bytes, and no delta ever resynced (clean bases)."""
+    result = bench.bench_wire_ab(
+        families=2, hosts_per_family=24, n_shards=2, reps=1,
+        calls=12, churn_calls=8,
+    )
+    assert_stage_meta(result)
+    for key in ("steady_binary_p50_ms", "steady_legacy_p50_ms",
+                "churn_binary_p50_ms", "churn_legacy_p50_ms"):
+        assert result[key] > 0, key
+    assert result["steady_p50_ratio"] > 0
+    assert result["churn_bytes_binary"] > 0
+    assert result["churn_bytes_legacy"] > result["churn_bytes_binary"]
+    assert result["churn_bytes_ratio"] > 1.0
+    gates = result["gates"]
+    assert gates["steady_p50_ratio_min"] == 1.3
+    assert gates["churn_bytes_ratio_min"] == 10.0
+    wire_meta = result["wire"]
+    assert wire_meta["binary"]["bytes_by_codec"]["binary"] > 0
+    assert wire_meta["binary"]["frame_hist"].get("binary")
+    assert wire_meta["legacy"]["bytes_by_codec"]["binary"] == 0
+    assert "binary" not in wire_meta["legacy"]["frame_hist"]
+    for side in ("binary", "legacy"):
+        assert wire_meta[side]["delta_resyncs"] == 0
+    json.dumps(result)
+
+
 def test_bench_sim_smoke():
     """Smoke-sized variant of the HIVED_BENCH_SIM stage (ISSUE 9
     CI/tooling satellite): the per-fleet-size trend curve must carry the

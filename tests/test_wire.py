@@ -1,0 +1,510 @@
+"""One wire (ISSUE 16; doc/hot-path.md "One wire").
+
+Golden pins and differential proofs for the binary frame format every
+internal hop rides:
+
+1. **Golden frames** — one frozen hex fixture per frame kind. These
+   bytes are the format: a codec edit that changes them is a VERSION
+   bump, not a refactor.
+2. **Refusal ladder** — cross-version frames refuse (never fall back),
+   truncation is a mechanical error, kind pins hold, and the first-byte
+   sniff is disjoint from both pickle and JSON.
+3. **Transport differential** — the pipe/ring frame codec decodes to
+   the same object with wire on and off (pickle fallback included), the
+   compile hand-back re-encodes bit-identically, and the snapshot body
+   codec inverts exactly.
+4. **Delta suggested sets** — the edit script is exact under churn,
+   refuses reorders, and a corrupted/stale base resyncs with the full
+   list through a REAL proc-shards frontend (sensitivity meta-test:
+   the resync counter moves and the filter outcome does not).
+5. **HTTP negotiation** — a foreign-version frame gets HTTP 415 and the
+   sim client latches back to legacy JSON, losslessly.
+"""
+
+import json
+import logging
+import os
+import pickle
+import random
+
+import pytest
+
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.algorithm import compiler
+from hivedscheduler_tpu.api import constants, extender as ei
+from hivedscheduler_tpu.scheduler import (
+    shards as shards_mod,
+    snapshot as snapshot_mod,
+    wire,
+)
+from hivedscheduler_tpu.scheduler.framework import (
+    HivedScheduler,
+    NullKubeClient,
+)
+from hivedscheduler_tpu.scheduler.shards import ShardedScheduler
+from hivedscheduler_tpu.scheduler.types import Node
+from hivedscheduler_tpu.sim.fleet import build_config, make_pod
+from hivedscheduler_tpu.webserver.server import WebServer
+
+from .test_config_compiler import tpu_design_config
+
+common.init_logging(logging.CRITICAL)
+
+
+def _gang(i, vc="prod", leaf="v5e-chip", chips=4):
+    group = {
+        "name": f"wz{i}",
+        "members": [{"podNumber": 1, "leafCellNumber": chips}],
+    }
+    return make_pod(f"wz{i}-0", f"wz{i}-u0", vc, 0, leaf, chips, group)
+
+
+def _env(key, value):
+    saved = os.environ.get(key)
+    if value is None:
+        os.environ.pop(key, None)
+    else:
+        os.environ[key] = value
+
+    def restore():
+        if saved is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = saved
+
+    return restore
+
+
+# --------------------------------------------------------------------- #
+# 1. Golden frames
+# --------------------------------------------------------------------- #
+
+# One fixture per frame kind. The VALUES are arbitrary; the BYTES are
+# not — they pin tag numbers, varint layout, intern indexing, and the
+# header. Regenerating them because the encoder changed is the wrong
+# fix: bump wire.VERSION instead.
+_GOLDEN = [
+    ("none_obj", None, wire.KIND_OBJ, "a701010100"),
+    (
+        "interned_dict_obj",
+        {"m": "filter", "args": ["n0", "n1"]},
+        wire.KIND_OBJ,
+        "a701011b0b0206016d060666696c7465720604617267730d02056e30006e31",
+    ),
+    (
+        "json_snapshot",
+        wire.Json({"ok": True}),
+        wire.KIND_SNAPSHOT,
+        "a701020d0c0b7b226f6b223a747275657d",
+    ),
+    (
+        "columnar_cells",
+        (b"\x01\x02", ("c1",), [3]),
+        wire.KIND_CELLS,
+        "a70103100a03080201020a010602633109010303",
+    ),
+    (
+        "delta_suggested",
+        (
+            shards_mod._DELTA_MARK, (4, 77), (1,), ((3, "n3"),),
+            348442912, 4,
+        ),
+        wire.KIND_DELTA,
+        "a701042e0a06060e5f5f686976656444656c74615f5f0a020304034d0a01"
+        "03010a010a02030306026e3303a0a293a6010304",
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "value,kind,hexpect",
+    [g[1:] for g in _GOLDEN],
+    ids=[g[0] for g in _GOLDEN],
+)
+def test_golden_frame_bytes(value, kind, hexpect):
+    buf = wire.dumps(value, kind=kind)
+    assert buf.hex() == hexpect
+    out = wire.loads(buf, kind=kind)
+    if isinstance(value, wire.Json):
+        # The Json marker is an encoder hint; it decodes to a plain dict.
+        assert type(out) is dict and out == dict(value)
+    else:
+        assert out == value
+    assert wire.frame_kind(buf) == kind
+
+
+def test_round_trip_value_model():
+    values = [
+        None, True, False, 0, 1, -1, 2**40, -(2**40), 0.5, -1e300,
+        "", "node", b"", b"\x00\xff", [], (), {},
+        ["n0", "n1", "n2"],                      # STRLIST fast path
+        ["n0", "with\x00nul"],                   # NUL forces LIST path
+        {"a": [1, {"b": (None, 2.5)}], "c": b"x"},
+        ("chain", ("chain", "chain"), ["chain"]),  # interning repeats
+    ]
+    for v in values:
+        assert wire.loads(wire.dumps(v)) == v
+    # Interning: the second occurrence of a long name is a short REF.
+    once = wire.dumps(["x" * 64])
+    twice = wire.dumps(("x" * 64, "x" * 64))
+    assert len(twice) < 2 * len(once) - 32
+
+
+def test_version_refusal_and_truncation():
+    buf = bytearray(wire.dumps({"m": "filter", "args": ["n0", "n1"]}))
+    bad = bytes([buf[0], 2]) + bytes(buf[2:])
+    assert wire.is_wire(bad)  # sniff is version-blind on purpose
+    with pytest.raises(wire.WireVersionError):
+        wire.loads(bad)
+    # Truncation at every boundary is a WireTruncatedError subclass of
+    # WireDecodeError — never a misdecode, never a foreign exception.
+    whole = bytes(buf)
+    for cut in range(4, len(whole)):
+        with pytest.raises(wire.WireDecodeError):
+            wire.loads(whole[:cut])
+    with pytest.raises(wire.WireTruncatedError):
+        wire.loads(whole[:-1])
+    # Trailing garbage is refused too.
+    with pytest.raises(wire.WireDecodeError):
+        wire.loads(whole + b"\x00")
+
+
+def test_kind_pin_and_sniff_disjointness():
+    frame = wire.dumps(("c1",), kind=wire.KIND_CELLS)
+    assert wire.loads(frame, kind=wire.KIND_CELLS) == ("c1",)
+    with pytest.raises(wire.WireDecodeError):
+        wire.loads(frame, kind=wire.KIND_OBJ)
+    # First-byte disjointness is what makes per-frame fallback lossless.
+    for obj in (None, {"a": 1}, ["n"] * 5, ei.ExtenderFilterResult()):
+        for proto in range(2, pickle.HIGHEST_PROTOCOL + 1):
+            assert not wire.is_wire(pickle.dumps(obj, protocol=proto))
+    assert not wire.is_wire(json.dumps({"a": 1}).encode())
+    assert not wire.is_wire(b"  {\"a\": 1}")
+    assert wire.is_wire(frame)
+
+
+def test_encode_refusal_and_pickle_fallback():
+    # Types outside the tagged model refuse loudly...
+    for v in ({1, 2}, Node(name="n"), object()):
+        with pytest.raises(wire.WireEncodeError):
+            wire.dumps(v)
+
+    # ...including dict/tuple SUBCLASSES other than Json (round-tripping
+    # them as their base type would silently change the object's type).
+    class D(dict):
+        pass
+
+    with pytest.raises(wire.WireEncodeError):
+        wire.dumps(D(a=1))
+    # The transport's per-frame fallback then ships pickle, and the
+    # sniffing receiver returns the identical object either way.
+    for v in (Node(name="n"), {"m": "add_node"}):
+        for wire_on in (True, False):
+            buf, codec = shards_mod._pack_frame(v, wire_on)
+            assert shards_mod._unpack_frame(buf) == v
+            if isinstance(v, Node):
+                assert codec == "pickle"
+            else:
+                assert codec == ("binary" if wire_on else "pickle")
+
+
+def test_json_marker_paths():
+    # JSON-born dict: payload is one C-speed blob, passthrough slices it.
+    d = wire.Json({"NodeNames": ["n0"], "Error": ""})
+    frame = wire.dumps(d)
+    raw = wire.json_passthrough(frame)
+    assert raw is not None and json.loads(raw) == dict(d)
+    # Over-promised Json (bytes value): element-wise fallback, bytes
+    # survive — the marker may never lose data.
+    d2 = wire.Json({"blob": b"\x00\x01"})
+    assert wire.loads(wire.dumps(d2)) == {"blob": b"\x00\x01"}
+    # The documented caller contract: an int key WOULD stringify through
+    # the json path — which is exactly why only known JSON-born dicts
+    # are ever marked (pinned here so the hazard stays visible).
+    assert wire.loads(wire.dumps(wire.Json({1: "a"}))) == {"1": "a"}
+    # Passthrough answers None for every other payload shape.
+    assert wire.json_passthrough(wire.dumps({"a": 1})) is None
+    assert wire.json_passthrough(wire.dumps(b"{}")) is None
+    assert wire.json_passthrough(pickle.dumps({})) is None
+
+
+def test_wire_env_hatch():
+    restore = _env(wire.WIRE_ENV, "0")
+    try:
+        assert not wire.enabled()
+        buf, codec = shards_mod._pack_frame({"m": "x"}, wire.enabled())
+        assert codec == "pickle" and not wire.is_wire(buf)
+    finally:
+        restore()
+    assert wire.enabled()
+
+
+# --------------------------------------------------------------------- #
+# 3. Transport differentials
+# --------------------------------------------------------------------- #
+
+
+def test_compile_handback_reencodes_bit_identically():
+    """encode -> decode -> encode is a fixed point: the columnar frame
+    carries exactly the tree (no hidden state), so the rebuilt cells
+    re-encode to the same bytes. The full parallel==serial walk lives in
+    test_boot_transport; this pins the wire hop itself."""
+    for cfg in (tpu_design_config(), build_config(cubes=1, slices=2)):
+        pc = cfg.physical_cluster
+        batch = []
+        base = 0
+        for spec in pc.physical_cells:
+            batch.append((spec, base))
+            base += compiler.spec_cell_count(spec)
+        frame = compiler._compile_spec_batch_wire(pc.cell_types, batch)
+        assert isinstance(frame, bytes)  # encodable, did not fall back
+        assert wire.frame_kind(frame) == wire.KIND_CELLS
+        rebuilt = compiler._decode_cell_batch(frame)
+        assert compiler._encode_cell_batch(*rebuilt) == frame
+
+
+def test_snapshot_body_codec_ladder():
+    body = {"pods": [{"uid": "u1"}], "core": {"chains": {}}}
+    fp = "fp-1"
+    buf = snapshot_mod.encode_body_wire(body, fp, 7)
+    assert wire.frame_kind(buf) == wire.KIND_SNAPSHOT
+    out, reason = snapshot_mod.decode_body_wire(buf, fp)
+    assert reason == "" and out == body
+    # Each rung refuses with a reason, never raises.
+    cases = [
+        (b"\x80\x04junk", fp, None, "undecodable"),
+        (buf, "other-fp", None, "fingerprint"),
+        (buf, fp, 8, "stale watermark"),
+        (
+            snapshot_mod.encode_body_wire(body, fp, 7, schema_version=99),
+            fp, None, "schema version",
+        ),
+        (
+            snapshot_mod.encode_body_wire({"pods": []}, fp, 7),
+            fp, None, "core projection",
+        ),
+        (
+            snapshot_mod.encode_body_wire({"core": {}}, fp, 7),
+            fp, None, "pods list",
+        ),
+    ]
+    for raw, want_fp, floor, needle in cases:
+        out, reason = snapshot_mod.decode_body_wire(
+            raw, want_fp, min_watermark=floor
+        )
+        assert out is None and needle in reason, (needle, reason)
+    # Watermark at/after the floor passes.
+    out, reason = snapshot_mod.decode_body_wire(buf, fp, min_watermark=7)
+    assert reason == "" and out == body
+
+
+# --------------------------------------------------------------------- #
+# 4. Delta-encoded suggested sets
+# --------------------------------------------------------------------- #
+
+
+def test_suggested_delta_exact_under_random_churn():
+    rng = random.Random(16)
+    names = [f"host-{i:04d}" for i in range(300)]
+    base = tuple(names)
+    for _ in range(60):
+        new = list(base)
+        for _ in range(rng.randrange(1, 12)):
+            if rng.random() < 0.5 and new:
+                new.pop(rng.randrange(len(new)))
+            else:
+                new.insert(
+                    rng.randrange(len(new) + 1),
+                    f"host-new-{rng.randrange(10_000)}",
+                )
+        marker = shards_mod._suggested_delta(base, tuple(new), (1, 2))
+        if marker is None:
+            continue
+        assert shards_mod._is_delta_marker(marker)
+        # The marker survives its own wire frame and applies exactly.
+        shipped = wire.loads(wire.dumps(marker, kind=wire.KIND_DELTA))
+        assert shards_mod._apply_suggested_delta(base, shipped) == new
+        base = tuple(new)
+
+
+def test_suggested_delta_refusals():
+    base = ("n0", "n1", "n2", "n3")
+    # Reorder of survivors: refuse (order can matter to the filter).
+    assert shards_mod._suggested_delta(
+        base, ("n1", "n0", "n2", "n3"), (4, 1)
+    ) is None
+    # Edit script beyond the budget: the full list is cheaper.
+    assert shards_mod._suggested_delta(
+        base, ("x0", "x1", "x2", "x3"), (4, 1)
+    ) is None
+    # Corrupted frame (bad crc) and stale base: apply answers None and
+    # the caller resyncs; it never returns a guessed list.
+    marker = shards_mod._suggested_delta(
+        base, ("n0", "n2", "n3", "n9"), (4, 1)
+    )
+    assert marker is not None
+    bad_crc = marker[:4] + (marker[4] ^ 1, marker[5])
+    assert shards_mod._apply_suggested_delta(base, bad_crc) is None
+    assert shards_mod._apply_suggested_delta(base[:2], marker) is None
+
+
+def _filter_once(front, pod, nodes):
+    body = json.dumps(
+        ei.ExtenderArgs(pod=pod, node_names=nodes).to_dict()
+    ).encode()
+    return json.loads(front.filter_raw(body))
+
+
+@pytest.mark.slow
+def test_corrupted_delta_base_resyncs_not_misfilters():
+    """Sensitivity meta-test for the delta plane: poison the frontend's
+    acked-base memo so it ships a delta against a base the worker never
+    cached — the resync counter must move and the filter outcome must be
+    identical to the clean run. If a code change ever makes the worker
+    guess instead of refusing, the outcome assertion catches it."""
+    front = ShardedScheduler(
+        build_config(cubes=1, slices=2, solos=1),
+        kube_client=NullKubeClient(),
+        n_shards=2,
+        transport="proc",
+        auto_admit=True,
+    )
+    try:
+        nodes = sorted(front.configured_node_names())
+        for n in nodes:
+            front.add_node(Node(name=n))
+        pod = _gang(1)
+        front.add_pod(pod)
+        clean = _filter_once(front, pod, nodes)
+        assert clean.get("NodeNames")
+        base_resyncs = front.get_metrics()["deltaSuggestedResyncCount"]
+
+        # Poison: forget that the workers hold this set (so the next
+        # call ships it again) and claim every shard acked a ghost base
+        # none of them has ever cached — the delta goes out against it.
+        ghost = ("ghost-node",) + tuple(nodes)
+        with front._maps_lock:
+            nid = front._nodes_ids[tuple(nodes)]
+            for sent in front._nodes_sent:
+                sent.discard(nid)
+            gid = front._nodes_ids[ghost] = (len(ghost), hash(ghost))
+            front._nodes_acked = [
+                (gid, ghost) for _ in front._nodes_acked
+            ]
+            front._delta_memo = None
+        poisoned = _filter_once(front, pod, nodes)
+        assert poisoned == clean, "resync changed the filter outcome"
+        after = front.get_metrics()["deltaSuggestedResyncCount"]
+        assert after > base_resyncs, "poisoned base did not resync"
+    finally:
+        front.close()
+
+
+# --------------------------------------------------------------------- #
+# 5. HTTP negotiation (415 + legacy latch)
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture()
+def http_server():
+    sched = HivedScheduler(
+        build_config(cubes=1, slices=1, solos=1),
+        kube_client=NullKubeClient(),
+        auto_admit=True,
+    )
+    for n in sorted(sched.core.configured_node_names()):
+        sched.add_node(Node(name=n))
+    ws = WebServer(sched, address="127.0.0.1:0")
+    ws.start()
+    yield ws
+    ws.stop()
+
+
+def _post_raw(port, body, content_type):
+    import http.client
+
+    conn = http.client.HTTPConnection("127.0.0.1", port)
+    try:
+        conn.request(
+            "POST", constants.FILTER_PATH, body,
+            {"Content-Type": content_type},
+        )
+        resp = conn.getresponse()
+        return resp.status, resp.read(), resp.getheader("Content-Type")
+    finally:
+        conn.close()
+
+
+def test_wire_filter_over_http_and_415_refusal(http_server):
+    sched = http_server.scheduler
+    nodes = sorted(sched.nodes)
+    pod = _gang(1)
+    sched.add_pod(pod)
+    args = ei.ExtenderArgs(pod=pod, node_names=nodes).to_dict()
+
+    # Legacy JSON and wire frames answer identically...
+    st_j, raw_j, ct_j = _post_raw(
+        http_server.port, json.dumps(args).encode(), "application/json"
+    )
+    frame = wire.dumps(args)
+    st_w, raw_w, ct_w = _post_raw(
+        http_server.port, frame, wire.CONTENT_TYPE
+    )
+    assert (st_j, ct_j) == (200, "application/json")
+    assert (st_w, ct_w) == (200, wire.CONTENT_TYPE)
+    assert wire.is_wire(raw_w) and not wire.is_wire(raw_j)
+    passthrough = wire.json_passthrough(raw_w)
+    assert passthrough is not None
+    assert json.loads(passthrough) == json.loads(raw_j)
+
+    # ...and a FOREIGN-version frame maps to HTTP 415, the signal the
+    # sim client's latch consumes (never a misdecode, never a 500).
+    foreign = bytes([frame[0], wire.VERSION + 1]) + frame[2:]
+    st_f, _raw_f, _ct = _post_raw(
+        http_server.port, foreign, wire.CONTENT_TYPE
+    )
+    assert st_f == 415
+
+
+def test_sim_client_latches_legacy_on_415(http_server):
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "hived_sim_server_for_wire_test",
+        pathlib.Path(__file__).resolve().parents[1]
+        / "hack" / "sim_server.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    sched = http_server.scheduler
+    nodes = sorted(sched.nodes)
+    pod = _gang(2)
+    sched.add_pod(pod)
+    args = ei.ExtenderArgs(pod=pod, node_names=nodes)
+
+    client = mod._WireExtender(sched, http_server.port)
+    assert client._wire
+    wire_result = client.filter_routine(args)
+
+    # Make this client's frames foreign: the server answers 415, the
+    # client re-sends legacy JSON and latches wire off — same outcome,
+    # no frames from then on.
+    class _ForeignWire:
+        def __getattr__(self, name):
+            return getattr(wire, name)
+
+        @staticmethod
+        def dumps(obj, kind=wire.KIND_OBJ):
+            buf = wire.dumps(obj, kind=kind)
+            return bytes([buf[0], wire.VERSION + 1]) + buf[2:]
+
+    client2 = mod._WireExtender(sched, http_server.port)
+    client2._wire_mod = _ForeignWire()
+    latched = client2.filter_routine(args)
+    assert not client2._wire, "415 must latch wire off"
+    assert latched.to_dict() == wire_result.to_dict()
+    # Latched client keeps working over legacy JSON.
+    again = client2.filter_routine(args)
+    assert again.to_dict() == wire_result.to_dict()
