@@ -217,6 +217,10 @@ def main():
             auto_admit=False,
         )
         s.mark_ready()
+        # Production (__main__.py) runs the supervision heartbeat so a
+        # SIGKILL'd/hung worker is detected and hot-resurrected without a
+        # caller; mirror that here so the sim serves the same fault arc.
+        s.supervisor.start(serve_config.shard_supervision_interval_seconds)
     else:
         s = HivedScheduler(serve_config, kube_client=NullKubeClient())
     if args.hosts:

@@ -37,6 +37,14 @@
 # hammers snapshot+delta recovery equivalence and the split-brain fence
 # specifically: hack/soak.sh --failover  (combines with --keep-decisions).
 #
+# Supervision focus: --supervise runs the kill/hang-weighted supervise
+# chaos sweep (tests/chaos.py step_supervise: worker SIGKILLs and hangs
+# against REAL worker processes, degraded-admission asserts after every
+# kill, hot resurrection + the resurrection differential vs a
+# never-crashed twin) at HIVED_CHAOS_ROUNDS scale, then the
+# HIVED_BENCH_SUPERVISE acceptance stage (surviving-shard p99 isolation,
+# never-500 degraded answers, zero placements lost/duplicated —
+# doc/fault-model.md "Shard supervision plane"): hack/soak.sh --supervise
 # Decision-journal artifacts: --keep-decisions [DIR] (first argument) keeps
 # the per-seed decision-journal dump a failing seed writes (the scheduler's
 # /v1/inspect/decisions ring + trace ring + metrics at the moment the
@@ -100,6 +108,18 @@ if [[ "${1:-}" == "--whatif" ]]; then
   export JAX_PLATFORMS=cpu
   echo "what-if plane: snapshot-forked queue forecast vs actual waits"
   exec env HIVED_BENCH_WHATIF=1 python bench.py "$@"
+fi
+
+if [[ "${1:-}" == "--supervise" ]]; then
+  shift
+  export JAX_PLATFORMS=cpu
+  rounds="${HIVED_CHAOS_ROUNDS:-200}"
+  echo "supervision soak: ${rounds} kill/hang-weighted supervise schedules"
+  HIVED_CHAOS_SUPERVISE_ROUNDS="${rounds}" python -m pytest \
+    "tests/test_chaos.py::test_chaos_procs_supervise_sweep" \
+    -q -p no:cacheprovider
+  echo "supervision bench: SIGKILL mid-load at the 432-host proc fleet"
+  exec env HIVED_BENCH_SUPERVISE=1 python bench.py "$@"
 fi
 
 if [[ "${1:-}" == "--audit" ]]; then

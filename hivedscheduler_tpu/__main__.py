@@ -107,6 +107,9 @@ def main(argv=None) -> int:
             len(scheduler.shards),
             {b.shard_id: list(b.owned_chains) for b in scheduler.shards},
         )
+        # Shard supervision plane (doc/fault-model.md): heartbeat
+        # liveness checks + hot resurrection of crashed/hung workers.
+        scheduler.supervisor.start()
     else:
         scheduler = HivedScheduler(config, auto_admit=args.standalone)
 

@@ -123,6 +123,17 @@ class Config:
     # in-process sharded scheduler exactly as before. The
     # HIVED_PROC_SHARDS env knob overrides at launch.
     proc_shards: int = 0
+    # Shard supervision plane (doc/fault-model.md "Shard supervision
+    # plane", proc shards only): the heartbeat cadence of the
+    # liveness/resurrection pass (0 disables the thread; detection via
+    # pipe EOF / verb deadlines still works), and the restart-storm
+    # bounds — resurrection attempt N backs off
+    # min(cap, base * 2^(N-1)) seconds, and the circuit breaker degrades
+    # the shard to "down" after max consecutive failures.
+    shard_supervision_interval_seconds: float = 5.0
+    shard_max_resurrection_failures: int = 3
+    shard_resurrection_backoff_seconds: float = 1.0
+    shard_resurrection_backoff_cap_seconds: float = 30.0
     physical_cluster: api.PhysicalClusterSpec = field(
         default_factory=api.PhysicalClusterSpec
     )
@@ -146,6 +157,10 @@ class Config:
         lease_d = d.get("leaseDurationSeconds")
         lease_r = d.get("leaseRenewSeconds")
         procs = d.get("procShards")
+        sup_s = d.get("shardSupervisionIntervalSeconds")
+        sup_f = d.get("shardMaxResurrectionFailures")
+        sup_b = d.get("shardResurrectionBackoffSeconds")
+        sup_c = d.get("shardResurrectionBackoffCapSeconds")
         defrag_t = d.get("defragIntervalTicks")
         defrag_m = d.get("defragMaxMigrationsPerCycle")
         audit_t = d.get("auditIntervalTicks")
@@ -191,6 +206,18 @@ class Config:
             ),
             lease_renew_seconds=5.0 if lease_r is None else float(lease_r),
             proc_shards=0 if procs is None else int(procs),
+            shard_supervision_interval_seconds=(
+                5.0 if sup_s is None else float(sup_s)
+            ),
+            shard_max_resurrection_failures=(
+                3 if sup_f is None else int(sup_f)
+            ),
+            shard_resurrection_backoff_seconds=(
+                1.0 if sup_b is None else float(sup_b)
+            ),
+            shard_resurrection_backoff_cap_seconds=(
+                30.0 if sup_c is None else float(sup_c)
+            ),
             physical_cluster=api.PhysicalClusterSpec.from_dict(
                 d.get("physicalCluster")
             ),

@@ -44,6 +44,10 @@ GATE_SUGGESTED = "suggestedNodes"  # K8s suggested-node set excluded the fit
 GATE_BUDDY_FIT = "buddyFit"        # virtual→physical buddy mapping failed
                                    # (fragmentation, doomed-bad bindings)
 GATE_CAPACITY = "capacity"         # plain insufficient physical capacity
+GATE_SHARD_DOWN = "shardDown"      # owning shard worker down/resurrecting
+                                   # (frontend-journaled degraded-mode WAIT;
+                                   # doc/fault-model.md "Shard supervision
+                                   # plane")
 # (Requests rejected before scheduling — unknown VC, SKU the VC lacks,
 # over-sized gang — surface as verdict "error", not a per-chain gate.)
 

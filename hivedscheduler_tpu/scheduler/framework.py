@@ -4257,6 +4257,12 @@ class HivedScheduler:
         # (shards.ShardedScheduler.get_metrics) overlays the real values.
         snap["wireBytesTotal"] = {"binary": 0, "pickle": 0, "json": 0}
         snap["deltaSuggestedResyncCount"] = 0
+        # Shard supervision plane (scheduler.supervisor): same pattern —
+        # a single process has no shard workers to supervise, so the
+        # counters are schema-stable zeros here and the sharded frontend
+        # overlays the live values (plus the per-shard shardUp gauge).
+        snap["shardRestartCount"] = 0
+        snap["shardDegradedWaitCount"] = 0
         # hived_build_info labels (rendered as a constant-1 gauge): the
         # deploy-identity facts an operator cross-checks first in any
         # incident — snapshot schema, config fingerprint prefix, shard

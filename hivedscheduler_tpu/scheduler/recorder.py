@@ -46,7 +46,7 @@ import os
 from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import common
-from ..api import constants
+from ..api import constants, extender as ei
 from . import snapshot as snapshot_mod, wire as wire_mod
 from .types import Node, Pod
 
@@ -426,8 +426,6 @@ class FlightRecorder:
         if memo is not None and memo[1].get("annotations") == ann:
             ref = memo[2]
         else:
-            from ..api import extender as ei
-
             ref = self._pod_ref(ei.pod_from_k8s(pod_d))
         ev: Dict = {
             "kind": "filter",

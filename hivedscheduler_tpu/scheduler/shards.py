@@ -83,7 +83,12 @@ from ..api import constants, extender as ei, types as api
 from ..api.config import Config
 from . import recorder as recorder_pkg
 from . import wire as wire_mod
-from .framework import HivedScheduler, KubeClient, NullKubeClient
+from .framework import (
+    HivedScheduler,
+    KubeClient,
+    NullKubeClient,
+    _decision_matches,
+)
 from .types import (
     Node,
     Pod,
@@ -498,8 +503,53 @@ def _exc_from_wire(w: Tuple) -> BaseException:
 
 
 class ShardWorkerError(RuntimeError):
-    """A shard worker died or broke protocol (distinct from an in-band
-    scheduling error, which re-raises as its original type)."""
+    """A shard worker died, hung, or is administratively unavailable
+    (distinct from an in-band scheduling error, which re-raises as its
+    original type). Carries the forensic context the supervision plane
+    journals: the worker's exitcode/signal (when a process actually
+    exited), the verb that was in flight, and a cause classification —
+    every instance is RETRIABLE by construction (the supervisor either
+    resurrects the shard or holds it down; the caller's request was
+    never half-applied because the worker executes strictly
+    sequentially and replies before the parent observes completion)."""
+
+    def __init__(
+        self,
+        message: str,
+        shard_id: Optional[int] = None,
+        method: str = "",
+        cause: str = "died",
+        exitcode: Optional[int] = None,
+        signal_name: str = "",
+    ):
+        super().__init__(message)
+        self.shard_id = shard_id
+        self.method = method
+        self.cause = cause  # died | hang | down | closed
+        self.exitcode = exitcode
+        self.signal_name = signal_name
+        self.retriable = True
+
+
+class ShardFrameError(RuntimeError):
+    """One pipe frame failed to decode (truncated/garbage bytes). Fails
+    only the affected call — the worker is alive and the byte stream is
+    length-delimited by the Connection framing, so the reader loop keeps
+    serving every other caller. Deliberately NOT a ShardWorkerError:
+    the supervision plane must not resurrect a healthy worker over one
+    corrupt frame."""
+
+
+def _exit_signal_name(exitcode: Optional[int]) -> str:
+    """Symbolic signal name for a negative Process.exitcode."""
+    if exitcode is None or exitcode >= 0:
+        return ""
+    try:
+        import signal as _signal
+
+        return _signal.Signals(-exitcode).name
+    except (ValueError, ImportError):
+        return f"SIG{-exitcode}"
 
 
 # --------------------------------------------------------------------- #
@@ -771,6 +821,14 @@ class ShardServer:
         self.scheduler.recover(nodes, pods, min_watermark=min_watermark)
         return self.list_state()
 
+    def replay_health_ticks(self, n: int) -> None:
+        """Resurrection replay (scheduler.supervisor): advance the
+        health clock by the supervisor journal's tick count so a
+        resurrected shard's damper/clock state matches its never-crashed
+        siblings — one RPC, worker-side loop."""
+        for _ in range(int(n)):
+            self.scheduler.health_tick()
+
     # -- positional inspect slices (merged by the parent) ----------- #
 
     def inspect_physical_positions(self) -> List[Tuple[int, Dict]]:
@@ -939,7 +997,15 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
         conn.send_bytes(buf)
 
     def recv():
-        return _unpack_frame(conn.recv_bytes())
+        # Decode failures are isolated from transport failures: a
+        # truncated/garbage frame must fail only the affected request,
+        # not kill the worker loop (pipe-protocol robustness; the
+        # parent side mirrors this in ProcShardBackend._recv_frame).
+        buf = conn.recv_bytes()
+        try:
+            return _unpack_frame(buf)
+        except Exception as e:  # noqa: BLE001 — decode-only failure
+            return ("__badframe__", f"{type(e).__name__}: {e}")
 
     def resolve(msg):
         # Ring frames MUST be consumed at pipe-arrival time (even when
@@ -968,6 +1034,14 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
                 "kube_ok", "kube_err"
             ):
                 return msg
+            if isinstance(msg, tuple) and msg and msg[0] == "__badframe__":
+                # The corrupt frame may have BEEN the awaited kube
+                # reply — notify the parent (it fails the oldest
+                # pending call) and keep waiting; if the reply is truly
+                # lost, the parent's verb deadline escalates this to
+                # the supervision plane.
+                send(("badframe", None, msg[1]))
+                continue
             pending.append(resolve(msg))
 
     kube = _ForwardingKubeClient(send, recv_kube_reply)
@@ -985,7 +1059,28 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
                 return
         if msg is None:
             return
+        if isinstance(msg, tuple) and msg and msg[0] == "__badframe__":
+            # A request frame that would not decode: report it (the
+            # parent fails the oldest pending call with a decode
+            # error) and keep serving — one corrupt frame must never
+            # take the worker down.
+            send(("badframe", None, msg[1]))
+            continue
         req_id, method, args = msg
+        if method == "__debug__":
+            # Test-only fault injection (supervision/robustness tests):
+            # "raw" writes arbitrary bytes straight onto the pipe
+            # (garbage-frame injection), "sleep" wedges the worker
+            # mid-verb (hang detection).
+            op = args[0]
+            if op == "raw":
+                conn.send_bytes(args[1])
+            elif op == "sleep":
+                import time as _time
+
+                _time.sleep(args[1])
+            send(("ok", req_id, True))
+            continue
         try:
             result = server.dispatch(method, args)
         except BaseException as e:  # noqa: BLE001
@@ -1035,6 +1130,35 @@ def _proc_worker_main(conn, config: Config, shard_id: int,
 # Parent-side backends
 # --------------------------------------------------------------------- #
 
+# Per-verb pipe deadline (supervision plane, doc/fault-model.md "Shard
+# supervision plane"): a worker that stops draining the pipe — wedged in
+# native code, deadlocked, livelocked — trips the SAME failure path as a
+# dead one: the waiting caller SIGKILLs the worker and fails all
+# in-flight calls retriably, and the supervisor resurrects the shard.
+# Verbs that legitimately run long (recovery replay, snapshot flush,
+# what-if horizon replay) get a 10x allowance. "0" disables deadlines
+# (the pre-supervision blocking behavior).
+SHARD_DEADLINE_ENV = "HIVED_SHARD_VERB_DEADLINE_S"
+_DEADLINE_DEFAULT_S = 60.0
+_SLOW_VERB_FACTOR = 10.0
+_SLOW_VERBS = frozenset({
+    "recover_slice", "prefetch_snapshot", "flush_snapshot",
+    "whatif_routine", "op_stage", "op_commit", "list_state",
+})
+
+
+def _verb_deadline_default() -> float:
+    try:
+        return float(
+            os.environ.get(SHARD_DEADLINE_ENV) or _DEADLINE_DEFAULT_S
+        )
+    except ValueError:
+        return _DEADLINE_DEFAULT_S
+
+
+class _VerbDeadline(Exception):
+    """Internal: a caller's per-verb pipe deadline expired."""
+
 
 class LocalShardBackend:
     """In-process shard: the identical ShardServer protocol without the
@@ -1046,13 +1170,45 @@ class LocalShardBackend:
         self.shard_id = server.shard_id
         self.owned_chains = server.owned_chains
         self._lock = threading.Lock()
+        self._dead = False
+        self.last_exit: Optional[Dict] = None
 
     @property
     def scheduler(self) -> HivedScheduler:
         return self.server.scheduler
 
-    def call(self, method: str, *args):
+    def is_alive(self) -> bool:
+        return not self._dead
+
+    def kill(self, cause: str = "kill") -> None:
+        """Death emulation for the supervision chaos events: subsequent
+        calls raise exactly the ShardWorkerError the proc transport
+        raises, so the frontend's degraded-mode and resurrection paths
+        run unchanged. cause="hang" emulates a wedged worker tripped by
+        the verb deadline (same terminal state: the supervisor kills a
+        hung worker before respawning it)."""
         with self._lock:
+            self._dead = True
+            self.last_exit = {
+                "cause": cause,
+                "exitcode": None if cause == "hang" else -9,
+                "signal": "" if cause == "hang" else "SIGKILL",
+                "method": "",
+                "methods": [],
+            }
+
+    def call(self, method: str, *args, timeout: Optional[float] = None):
+        with self._lock:
+            if self._dead:
+                cause = (self.last_exit or {}).get("cause", "died")
+                raise ShardWorkerError(
+                    f"shard {self.shard_id} worker {cause} ({method})",
+                    shard_id=self.shard_id,
+                    method=method,
+                    cause="died" if cause == "kill" else cause,
+                    exitcode=(self.last_exit or {}).get("exitcode"),
+                    signal_name=(self.last_exit or {}).get("signal", ""),
+                )
             return self.server.dispatch(method, args)
 
     def close(self) -> None:
@@ -1127,7 +1283,15 @@ class ProcShardBackend:
         self._reader_busy = False
         self._pending: Dict[int, List] = {}
         self._closing = False
+        self._closed = False
         self._dead = False
+        # Supervision plane: last_exit records WHY the worker stopped
+        # (cause, exitcode, symbolic signal, in-flight verbs) the first
+        # time the backend observes death — never overwritten, so the
+        # journaled record is the original cause even when multiple
+        # callers race the discovery.
+        self.last_exit: Optional[Dict] = None
+        self._deadline_s = _verb_deadline_default()
         self._conn, child = ctx.Pipe(duplex=True)
         ring_names = (
             (self._req_ring.name, self._resp_ring.name)
@@ -1167,14 +1331,31 @@ class ProcShardBackend:
 
     def _recv_frame(self):
         """Leader-side receive: one frame off the pipe, sniffed,
-        counted, decoded."""
+        counted, decoded. Transport failures (EOFError/OSError from the
+        pipe itself) mean the worker is gone; a DECODE failure of an
+        otherwise well-framed message raises ShardFrameError instead —
+        the worker is alive, only this one frame is garbage."""
         buf = self._conn.recv_bytes()
         self._note_frame(
             "binary" if wire_mod.is_wire(buf) else "pickle", len(buf)
         )
-        return _unpack_frame(buf)
+        try:
+            return _unpack_frame(buf)
+        except Exception as e:  # noqa: BLE001 — decode-only failure
+            raise ShardFrameError(
+                f"shard {self.shard_id}: undecodable pipe frame "
+                f"({len(buf)} bytes): {type(e).__name__}: {e}"
+            ) from e
 
     def _dispatch_msg(self, msg) -> None:
+        if msg[0] == "badframe":
+            # The worker could not decode one request frame: fail the
+            # oldest pending call (the worker serves strictly in
+            # arrival order, so the corrupt frame is at the head of its
+            # queue) and keep everything else in flight.
+            with self._io_lock:
+                self._fail_oldest_locked(msg[2])
+            return
         if msg[0] == "kube":
             _, kmethod, kargs = msg
             try:
@@ -1207,12 +1388,78 @@ class ProcShardBackend:
             slot[1] = (kind, payload)
             slot[0].set()
 
-    def _fail_all_locked(self) -> None:
+    def _fail_all_locked(self, cause: str = "died",
+                         method: str = "") -> None:
+        if self._dead:
+            return  # first observer wins: keep the original cause
         self._dead = True
+        exitcode = self._proc.exitcode
+        methods = sorted({
+            s[2] for s in self._pending.values() if len(s) > 2 and s[2]
+        })
+        self.last_exit = {
+            "cause": cause,
+            "exitcode": exitcode,
+            "signal": _exit_signal_name(exitcode),
+            "method": method or (methods[0] if methods else ""),
+            "methods": methods,
+        }
         pending, self._pending = dict(self._pending), {}
         for slot in pending.values():
             slot[1] = ("died", None)
             slot[0].set()
+
+    def _fail_oldest_locked(self, detail: str) -> None:
+        """One undecodable frame fails exactly one call: the oldest
+        pending request, because the worker executes (and therefore
+        replies) strictly in arrival order. Approximation caveat: with
+        concurrent senders arrival order can differ from request-id
+        order by in-flight races, but the affected window is the calls
+        racing the corruption — never a strand, never a poisoned loop."""
+        if not self._pending:
+            return
+        rid = min(self._pending)
+        slot = self._pending.pop(rid)
+        slot[1] = ("frame_err", detail)
+        slot[0].set()
+
+    def is_alive(self) -> bool:
+        if self._dead:
+            return False
+        if self._proc.is_alive():
+            return True
+        # Silent death discovered by the liveness probe (no caller has
+        # touched the pipe yet): latch the forensic record — exitcode,
+        # symbolic signal — and fail any in-flight stragglers through
+        # the same terminal path a caller's pipe error takes.
+        with self._io_lock:
+            self._fail_all_locked()
+        return False
+
+    def kill(self, cause: str = "kill") -> None:
+        """SIGKILL the worker and fail all in-flight calls retriably.
+        Used by the supervisor's hang trip and by fault-injection
+        tests; resurrection is the supervisor's job, not ours."""
+        try:
+            self._proc.kill()
+        except Exception:  # noqa: BLE001 — already gone
+            pass
+        self._proc.join(timeout=5)
+        with self._io_lock:
+            self._fail_all_locked(cause=cause)
+
+    def _trip_hang(self, method: str) -> None:
+        common.log.warning(
+            "shard %d worker hung in %r (deadline %.1fs): killing",
+            self.shard_id, method, self._deadline_s,
+        )
+        try:
+            self._proc.kill()
+        except Exception:  # noqa: BLE001
+            pass
+        self._proc.join(timeout=5)
+        with self._io_lock:
+            self._fail_all_locked(cause="hang", method=method)
 
     def _handoff_locked(self) -> None:
         """Wake exactly one reply-less waiter to take over reading (it
@@ -1222,13 +1469,30 @@ class ProcShardBackend:
                 slot[0].set()
                 return
 
-    def call(self, method: str, *args):
+    def call(self, method: str, *args, timeout: Optional[float] = None):
+        import time as _time
+
+        deadline_s = self._deadline_s if timeout is None else timeout
+        if deadline_s and method in _SLOW_VERBS and timeout is None:
+            deadline_s *= _SLOW_VERB_FACTOR
+        deadline_at = (
+            _time.monotonic() + deadline_s if deadline_s else None
+        )
         req_id = next(self._req_seq)
-        slot: List = [threading.Event(), None]
+        slot: List = [threading.Event(), None, method]
         with self._io_lock:
             if self._closing or self._dead:
+                exit_info = self.last_exit or {}
                 raise ShardWorkerError(
-                    f"shard {self.shard_id} backend is closed"
+                    f"shard {self.shard_id} backend is "
+                    f"{'dead' if self._dead else 'closed'} ({method})",
+                    shard_id=self.shard_id,
+                    method=method,
+                    cause=exit_info.get(
+                        "cause", "died" if self._dead else "closed"
+                    ),
+                    exitcode=exit_info.get("exitcode"),
+                    signal_name=exit_info.get("signal", ""),
                 )
             self._pending[req_id] = slot
         try:
@@ -1261,9 +1525,17 @@ class ProcShardBackend:
         except (OSError, ValueError) as e:
             with self._io_lock:
                 self._pending.pop(req_id, None)
+                if isinstance(e, OSError):
+                    # Broken pipe on send: the worker is gone. (A
+                    # ValueError is a frame-size problem, not death.)
+                    self._fail_all_locked(method=method)
             raise ShardWorkerError(
                 f"shard {self.shard_id} worker died mid-call "
-                f"({method}): {e}"
+                f"({method}): {e}",
+                shard_id=self.shard_id,
+                method=method,
+                exitcode=(self.last_exit or {}).get("exitcode"),
+                signal_name=(self.last_exit or {}).get("signal", ""),
             ) from e
         leading = False
         while slot[1] is None:
@@ -1278,16 +1550,42 @@ class ProcShardBackend:
                     # handed leadership (event set, result still None).
                     slot[0].wait(0.2)
                     slot[0].clear()
+                    if (
+                        slot[1] is None
+                        and deadline_at is not None
+                        and _time.monotonic() > deadline_at
+                    ):
+                        # My verb deadline expired while someone else
+                        # leads: the worker stopped draining the pipe.
+                        # Kill it; _fail_all_locked sets every slot
+                        # (including mine), and the leader EOFs out.
+                        self._trip_hang(method)
                     continue
             # Leader: read + dispatch one message, keep leading until my
             # own reply arrives, then hand off to one waiter.
             try:
+                while not self._conn.poll(0.2):
+                    if (
+                        deadline_at is not None
+                        and _time.monotonic() > deadline_at
+                    ):
+                        raise _VerbDeadline()
                 msg = self._recv_frame()
             except (EOFError, OSError):
                 with self._io_lock:
                     self._reader_busy = False
-                    self._fail_all_locked()
+                    self._fail_all_locked(method=method)
                 break
+            except _VerbDeadline:
+                self._trip_hang(method)
+                break
+            except ShardFrameError as e:
+                # Garbage frame: fail the oldest pending call only and
+                # keep leading — the stream is length-delimited, so the
+                # next frame decodes independently.
+                with self._io_lock:
+                    self._fail_oldest_locked(str(e))
+                continue
             self._dispatch_msg(msg)
         with self._io_lock:
             if leading:
@@ -1299,15 +1597,37 @@ class ProcShardBackend:
                 self._handoff_locked()
         kind, payload = slot[1]
         if kind == "died":
+            exit_info = self.last_exit or {}
+            cause = exit_info.get("cause", "died")
             raise ShardWorkerError(
-                f"shard {self.shard_id} worker died mid-call ({method})"
+                f"shard {self.shard_id} worker {cause} mid-call "
+                f"({method}; exitcode={exit_info.get('exitcode')}"
+                f"{' ' + exit_info['signal'] if exit_info.get('signal') else ''})",
+                shard_id=self.shard_id,
+                method=method,
+                cause=cause,
+                exitcode=exit_info.get("exitcode"),
+                signal_name=exit_info.get("signal", ""),
+            )
+        if kind == "frame_err":
+            raise ShardFrameError(
+                f"shard {self.shard_id} call ({method}) lost to an "
+                f"undecodable pipe frame: {payload}"
             )
         if kind == "err":
             raise _exc_from_wire(payload)
         return payload
 
     def close(self) -> None:
+        # Idempotent, and safe against a worker that is already dead
+        # (the close-races-death path): every step below tolerates a
+        # closed pipe / exited process, and the _closed latch makes a
+        # second close a no-op — including the supervisor closing a
+        # backend the frontend's own close() later sweeps again.
         with self._io_lock:
+            if self._closed:
+                return
+            self._closed = True
             self._closing = True
         try:
             with self._send_lock:
@@ -1317,6 +1637,14 @@ class ProcShardBackend:
         self._proc.join(timeout=5)
         if self._proc.is_alive():
             self._proc.terminate()
+            self._proc.join(timeout=5)
+        if self._proc.is_alive():
+            # A worker wedged past SIGTERM (the hang failure mode
+            # close can race): escalate so rings/pipes never leak.
+            try:
+                self._proc.kill()
+            except Exception:  # noqa: BLE001
+                pass
             self._proc.join(timeout=5)
         try:
             self._conn.close()
@@ -1518,23 +1846,10 @@ class ShardedScheduler:
             self.kube_client, self.routing.fingerprint(plan)
         )
         self.transport = transport
+        self._plan = plan
         self.shards: List = []
         for sid, owned in enumerate(plan):
-            if transport == "local":
-                server = ShardServer(
-                    config, sid, owned,
-                    _ShardScopedKubeClient(self, sid),
-                    auto_admit=auto_admit,
-                    plan=plan,
-                )
-                self.shards.append(LocalShardBackend(server))
-            else:
-                self.shards.append(ProcShardBackend(
-                    config, sid, owned,
-                    self._make_kube_handler(sid),
-                    auto_admit,
-                    plan,
-                ))
+            self.shards.append(self._spawn_backend(sid, owned))
         self._shard_of_chain: Dict[str, int] = {}
         for sid, backend in enumerate(self.shards):
             for c in backend.owned_chains:
@@ -1645,6 +1960,100 @@ class ShardedScheduler:
         # Nested-verb guard for the recorder (update_pod's delete+add
         # degrade must not double-record).
         self._rec_nested = threading.local()
+        # Frontend-owned decision journal: supervision lifecycle records
+        # (`_shard` source) and degraded-mode WAIT verdicts are journaled
+        # HERE — the shard that would normally journal them is the one
+        # that is down. Merged into /v1/inspect/decisions.
+        from . import decisions as decisions_mod
+
+        self.decisions = decisions_mod.DecisionJournal(
+            capacity=config.decision_journal_capacity
+        )
+        # The shard supervision plane (scheduler.supervisor,
+        # doc/fault-model.md "Shard supervision plane"): liveness,
+        # hot resurrection, degraded-mode bookkeeping.
+        from . import supervisor as supervisor_mod
+
+        self.supervisor = supervisor_mod.ShardSupervisor(self)
+
+    def _spawn_backend(self, sid: int, owned: Tuple[str, ...]):
+        """Build one shard backend (both transports) — used at boot and
+        by the supervisor's resurrection path, which must produce a
+        backend bit-identical in construction to the boot one."""
+        if self.transport == "local":
+            server = ShardServer(
+                self.config, sid, owned,
+                _ShardScopedKubeClient(self, sid),
+                auto_admit=self.auto_admit,
+                plan=self._plan,
+            )
+            return LocalShardBackend(server)
+        return ProcShardBackend(
+            self.config, sid, owned,
+            self._make_kube_handler(sid),
+            self.auto_admit,
+            self._plan,
+        )
+
+    # -- supervised backend access (degraded mode) -------------------- #
+
+    def _shard_call(self, sid: int, method: str, *args):
+        """Backend call through the supervision plane: a shard already
+        known to be down/resurrecting fails fast (no dead-pipe churn),
+        and a FRESH worker failure is reported to the supervisor before
+        the retriable ShardWorkerError propagates to the verb's
+        degraded-mode handler."""
+        if not self.supervisor.is_up(sid):
+            raise ShardWorkerError(
+                f"shard {sid} is {self.supervisor.status(sid)} "
+                f"({method})",
+                shard_id=sid, method=method, cause="down",
+            )
+        try:
+            return self.shards[sid].call(method, *args)
+        except ShardWorkerError as e:
+            self.supervisor.note_failure(sid, e, method)
+            raise
+
+    def _try_shard_call(self, sid: int, method: str, *args,
+                        default=None):
+        """Aggregation-path call: a failed shard contributes ``default``
+        instead of throwing — inspect/metrics reads must answer with
+        explicit attribution (``shardsDown``), never 500."""
+        try:
+            return self._shard_call(sid, method, *args)
+        except ShardWorkerError:
+            return default
+
+    def _degraded_wait(self, sid: int, pod_key: str,
+                       pod_uid: str) -> str:
+        """Account + journal one degraded-mode WAIT: the pod's owning
+        shard is under supervision, so the verdict is WAIT with a
+        ``shardDown`` rejection certificate (PR-12 shape: gate + the
+        version vector the verdict read — here the shard epoch, which
+        the resurrection bumps, so any cached certificate comparison
+        fails the moment the shard is back)."""
+        from . import decisions as decisions_mod
+
+        self.supervisor.note_degraded_wait(sid)
+        status = self.supervisor.status(sid)
+        reason = (
+            f"shard {sid} is {status} (worker under supervision; "
+            "retriable)"
+        )
+        try:
+            rec = self.decisions.begin(pod_key, pod_uid, "filter")
+            rec.verdict_wait(reason, certificate={
+                "gate": decisions_mod.GATE_SHARD_DOWN,
+                "vector": {
+                    "shard": sid,
+                    "shardEpoch": self.supervisor.epoch(sid),
+                },
+            })
+            self.decisions.commit(rec)
+        except Exception:  # noqa: BLE001 — diagnostics must never raise
+            common.log.exception("degraded-wait journaling failed")
+        return reason
 
     # -- kube brokering (parent side) -------------------------------- #
 
@@ -1796,9 +2205,18 @@ class ShardedScheduler:
         pod = args.pod
         sid = self._route(pod)
         if sid is not None:
-            with tr.span("shardCall", shard=sid):
-                result = self.shards[sid].call(
-                    "filter_routine", args, None, parent
+            try:
+                with tr.span("shardCall", shard=sid):
+                    result = self._shard_call(
+                        sid, "filter_routine", args, None, parent
+                    )
+            except ShardWorkerError:
+                # Degraded mode: the owning shard is under supervision —
+                # WAIT (with the shardDown certificate), never a 500.
+                reason = self._degraded_wait(sid, pod.key, pod.uid)
+                tr.finish(outcome="wait", shard=sid, degraded=True)
+                return ei.ExtenderFilterResult(
+                    failed_nodes={constants.COMPONENT_NAME: reason}
                 )
             self._note_routed(pod, sid)
             tr.finish(outcome=_frontend_outcome(result), shard=sid)
@@ -1809,11 +2227,21 @@ class ShardedScheduler:
         # leaf types, so the first non-wait outcome is the one the
         # single process's any-leaf-type scan finds (module docstring).
         result = None
+        skipped: Optional[int] = None
         for sid, leaf_types in self._sweep_chunks:
-            with tr.span("shardCall", shard=sid, sweep=True):
-                result = self.shards[sid].call(
-                    "filter_sweep", args, leaf_types, parent
-                )
+            try:
+                with tr.span("shardCall", shard=sid, sweep=True):
+                    result = self._shard_call(
+                        sid, "filter_sweep", args, leaf_types, parent
+                    )
+            except ShardWorkerError:
+                # A down chunk cannot veto the sweep: the other shards
+                # may still place the pod. If none does, the verdict
+                # degrades to the shardDown WAIT below (the skipped
+                # shard might have said yes).
+                skipped = sid
+                result = None
+                continue
             if result.node_names or (
                 result.failed_nodes
                 and set(result.failed_nodes) != {constants.COMPONENT_NAME}
@@ -1821,6 +2249,12 @@ class ShardedScheduler:
                 self._note_routed(pod, sid)
                 tr.finish(outcome=_frontend_outcome(result), shard=sid)
                 return result
+        if skipped is not None:
+            reason = self._degraded_wait(skipped, pod.key, pod.uid)
+            tr.finish(outcome="wait", sweep=True, degraded=True)
+            return ei.ExtenderFilterResult(
+                failed_nodes={constants.COMPONENT_NAME: reason}
+            )
         tr.finish(outcome="wait", sweep=True)
         return result if result is not None else ei.ExtenderFilterResult(
             failed_nodes={
@@ -1970,19 +2404,31 @@ class ShardedScheduler:
             # request body), so the wire codec may ship it as one
             # C-speed json blob instead of an element walk.
             pod_w = wire_mod.Json(pod_d) if self._wire_on else pod_d
-            with tr.span("shardCall", shard=sid):
-                out = self.shards[sid].call(
-                    "filter_fast", pod_w, nid, payload, parent,
-                )
-                if out.get("__needNodes"):
-                    if _is_delta_marker(payload):
-                        # Delta base miss/mismatch: the resync path —
-                        # counted, then the full list goes out.
-                        with self._maps_lock:
-                            self._delta_resyncs += 1
-                    out = self.shards[sid].call(
-                        "filter_fast", pod_w, nid, nodes, parent
+            try:
+                with tr.span("shardCall", shard=sid):
+                    out = self._shard_call(
+                        sid, "filter_fast", pod_w, nid, payload, parent,
                     )
+                    if out.get("__needNodes"):
+                        if _is_delta_marker(payload):
+                            # Delta base miss/mismatch: the resync path —
+                            # counted, then the full list goes out.
+                            with self._maps_lock:
+                                self._delta_resyncs += 1
+                        out = self._shard_call(
+                            sid, "filter_fast", pod_w, nid, nodes, parent
+                        )
+            except ShardWorkerError:
+                reason = self._degraded_wait(
+                    sid, f"{md.get('namespace', '')}/"
+                    f"{md.get('name', '')}", uid,
+                )
+                tr.finish(pod=uid, shard=sid, degraded=True)
+                return json.dumps(
+                    ei.ExtenderFilterResult(failed_nodes={
+                        constants.COMPONENT_NAME: reason
+                    }).to_dict()
+                ).encode(), "wait", ""
             with self._maps_lock:
                 self._nodes_sent[sid].add(nid)
                 self._nodes_acked[sid] = (nid, nodes_key)
@@ -2002,11 +2448,17 @@ class ShardedScheduler:
             # sweep workers decode JSON, so re-encode once. Rare path —
             # sweeps are cross-family untyped pods only.
             body = json.dumps(d).encode()
+        skipped: Optional[int] = None
         for sid, leaf_types in self._sweep_chunks:
-            with tr.span("shardCall", shard=sid, sweep=True):
-                out = self.shards[sid].call(
-                    "filter_sweep_raw", body, leaf_types, parent
-                )
+            try:
+                with tr.span("shardCall", shard=sid, sweep=True):
+                    out = self._shard_call(
+                        sid, "filter_sweep_raw", body, leaf_types, parent
+                    )
+            except ShardWorkerError:
+                skipped = sid
+                out = r = None
+                continue
             r = json.loads(out)
             if r.get("NodeNames") or r.get("Error") or (
                 r.get("FailedNodes")
@@ -2019,6 +2471,17 @@ class ShardedScheduler:
                 tr.finish(pod=uid, shard=sid, sweep=True)
                 outcome, bound = _raw_outcome(r)
                 return out, outcome, bound
+        if skipped is not None:
+            reason = self._degraded_wait(
+                skipped, f"{md.get('namespace', '')}/"
+                f"{md.get('name', '')}", uid,
+            )
+            tr.finish(pod=uid, sweep=True, degraded=True)
+            return json.dumps(
+                ei.ExtenderFilterResult(failed_nodes={
+                    constants.COMPONENT_NAME: reason
+                }).to_dict()
+            ).encode(), "wait", ""
         tr.finish(pod=uid, sweep=True)
         if out is not None:
             outcome, bound = _raw_outcome(r)
@@ -2039,16 +2502,32 @@ class ShardedScheduler:
         try:
             sid = self._route(pod)
             if sid is not None:
-                with tr.span("shardCall", shard=sid):
-                    result = self.shards[sid].call(
-                        "preempt_routine", args, parent
-                    )
+                try:
+                    with tr.span("shardCall", shard=sid):
+                        result = self._shard_call(
+                            sid, "preempt_routine", args, parent
+                        )
+                except ShardWorkerError:
+                    # Degraded: no victims named (an empty preemption
+                    # result means "cannot preempt right now" to the
+                    # default scheduler — retriable, never a 500).
+                    self.supervisor.note_degraded_wait(sid)
+                    tr.finish(shard=sid, degraded=True)
+                    result = ei.ExtenderPreemptionResult()
+                    return result
                 self._note_routed(pod, sid)
                 tr.finish(shard=sid)
                 return result
-            for sid, backend in enumerate(self.shards):
-                with tr.span("shardCall", shard=sid):
-                    result = backend.call("preempt_routine", args, parent)
+            for sid in range(len(self.shards)):
+                try:
+                    with tr.span("shardCall", shard=sid):
+                        result = self._shard_call(
+                            sid, "preempt_routine", args, parent
+                        )
+                except ShardWorkerError:
+                    self.supervisor.note_degraded_wait(sid)
+                    result = None
+                    continue
                 if result.node_name_to_meta_victims:
                     self._note_routed(pod, sid)
                     tr.finish(shard=sid)
@@ -2094,23 +2573,46 @@ class ShardedScheduler:
         with self._maps_lock:
             sid = self._uid_shard.get(args.pod_uid)
         if sid is not None:
-            with tr.span("shardCall", shard=sid):
-                result = self.shards[sid].call(
-                    "bind_routine", args, parent
+            try:
+                with tr.span("shardCall", shard=sid):
+                    result = self._shard_call(
+                        sid, "bind_routine", args, parent
+                    )
+            except ShardWorkerError:
+                # Degraded: refuse the bind RETRIABLY (503, the deposed-
+                # leader shape) — the default scheduler re-runs the
+                # cycle, and the resurrected shard recovers the pod's
+                # admission from its annotations. Never a 500.
+                self.supervisor.note_degraded_wait(sid)
+                tr.finish(shard=sid, outcome="error", degraded=True)
+                raise api.WebServerError(
+                    503,
+                    f"shard {sid} is {self.supervisor.status(sid)}: "
+                    "bind refused; the scheduler will retry once the "
+                    "shard is resurrected",
                 )
             tr.finish(shard=sid)
             return result
         # Unknown uid (e.g. a bind racing recovery): ask each shard; the
         # non-owners reject with the admission protocol error.
         last: Optional[api.WebServerError] = None
-        for backend in self.shards:
+        for s in range(len(self.shards)):
             try:
-                with tr.span("shardCall", shard=backend.shard_id):
-                    result = backend.call("bind_routine", args, parent)
-                tr.finish(shard=backend.shard_id)
+                with tr.span("shardCall", shard=s):
+                    result = self._shard_call(s, "bind_routine", args, parent)
+                tr.finish(shard=s)
                 return result
             except api.WebServerError as e:
                 last = e
+            except ShardWorkerError:
+                self.supervisor.note_degraded_wait(s)
+                if last is None:
+                    last = api.WebServerError(
+                        503,
+                        f"shard {s} is "
+                        f"{self.supervisor.status(s)}: bind refused; "
+                        "retry after resurrection",
+                    )
         tr.finish(outcome="error")
         raise last if last is not None else api.bad_request(
             "Pod does not exist, completed or has not been informed to "
@@ -2121,7 +2623,11 @@ class ShardedScheduler:
         sid = self._route(binding_pod)
         targets = [sid] if sid is not None else range(len(self.shards))
         for s in targets:
-            self.shards[s].call("handle_terminal_bind_failure", binding_pod)
+            # A down shard's recovery replays the pod's annotations and
+            # re-derives the failure handling; skipping is safe.
+            self._try_shard_call(
+                s, "handle_terminal_bind_failure", binding_pod
+            )
 
     # -- pod lifecycle events ----------------------------------------- #
 
@@ -2144,27 +2650,36 @@ class ShardedScheduler:
             # list carries this pod into the fan-out.
             return
         self._record("record_pod_event", "pod_add", pod)
+        self.supervisor.note_pod(pod)
         sid = self._route(pod)
         if sid is not None:
-            self.shards[sid].call("add_pod", pod)
+            # A down owner misses nothing: the supervisor mirror carries
+            # this pod into the resurrection's recovery slice.
+            self._try_shard_call(sid, "add_pod", pod)
             self._note_routed(pod, sid)
             return
         # Unroutable (untyped cross-family, or undecodable spec): every
         # shard admits it — the sweep's later filter finds it wherever it
         # runs, exactly as the single process's one status map would.
-        for backend in self.shards:
-            backend.call("add_pod", pod)
+        for s in range(len(self.shards)):
+            self._try_shard_call(s, "add_pod", pod)
 
     def update_pod(self, old: Pod, new: Pod) -> None:
         self._record("record_pod_update", old, new)
         sid_old, sid_new = self._route(old), self._route(new)
         if sid_old == sid_new and sid_new is not None:
-            self.shards[sid_new].call("update_pod", old, new)
+            if old.uid != new.uid:
+                self.supervisor.note_pod_delete(old.uid)
+            self.supervisor.note_pod(new)
+            self._try_shard_call(sid_new, "update_pod", old, new)
             self._note_routed(new, sid_new)
             return
         if sid_old is None and sid_new is None:
-            for backend in self.shards:
-                backend.call("update_pod", old, new)
+            if old.uid != new.uid:
+                self.supervisor.note_pod_delete(old.uid)
+            self.supervisor.note_pod(new)
+            for s in range(len(self.shards)):
+                self._try_shard_call(s, "update_pod", old, new)
             return
         # Routing moved (uid change across SKUs, or one side unroutable):
         # degrade to delete+add, the framework's own fallback shape (the
@@ -2178,16 +2693,21 @@ class ShardedScheduler:
 
     def delete_pod(self, pod: Pod) -> None:
         self._record("record_pod_event", "pod_delete", pod)
+        self.supervisor.note_pod_delete(pod.uid)
         sid = self._route(pod)
         if sid is not None:
-            meta = self.shards[sid].call("delete_pod_meta", pod)
+            # A down owner's delete is mirror-only: the resurrection's
+            # recovery slice simply no longer contains the pod.
+            meta = self._try_shard_call(sid, "delete_pod_meta", pod)
             self._forget_pod(pod, meta)
             return
         # Broadcast delete: the pin drops only when NO shard still holds
         # the group (same any()-liveness rule as delete_pods).
         metas = [
-            backend.call("delete_pod_meta", pod)
-            for backend in self.shards
+            m for m in (
+                self._try_shard_call(s, "delete_pod_meta", pod)
+                for s in range(len(self.shards))
+            ) if m is not None
         ]
         self._forget_pod(pod, {
             "group": metas[0].get("group") if metas else None,
@@ -2202,6 +2722,7 @@ class ShardedScheduler:
         that is still placed elsewhere)."""
         for pod in pods:
             self._record("record_pod_event", "pod_delete", pod)
+            self.supervisor.note_pod_delete(pod.uid)
         per_shard: Dict[Optional[int], List[Pod]] = {}
         for pod in pods:
             per_shard.setdefault(self._route(pod), []).append(pod)
@@ -2210,13 +2731,15 @@ class ShardedScheduler:
                 [sid] if sid is not None else range(len(self.shards))
             )
             all_metas = [
-                self.shards[s].call("delete_pods_meta", group)
-                for s in targets
+                m for m in (
+                    self._try_shard_call(s, "delete_pods_meta", group)
+                    for s in targets
+                ) if m is not None
             ]
             for i, pod in enumerate(group):
                 per_pod = [m[i] for m in all_metas]
                 self._forget_pod(pod, {
-                    "group": per_pod[0].get("group"),
+                    "group": per_pod[0].get("group") if per_pod else None,
                     "groupLive": any(
                         m.get("groupLive") for m in per_pod
                     ),
@@ -2259,7 +2782,15 @@ class ShardedScheduler:
                     f"whatif spec names leaf cell type {leaf!r} which "
                     "the cluster does not have"
                 )
-            return self.shards[sid].call("whatif_routine", payload)
+            try:
+                return self._shard_call(sid, "whatif_routine", payload)
+            except ShardWorkerError:
+                raise api.WebServerError(
+                    503,
+                    f"shard {sid} is {self.supervisor.status(sid)}: "
+                    "what-if forecast unavailable until it is "
+                    "resurrected",
+                )
         if payload.get("capacityTrace") is not None:
             return self._whatif_capacity(payload)
         # Queue mode: shards must NOT stamp their LOCAL verdicts — a
@@ -2271,6 +2802,13 @@ class ShardedScheduler:
         stamp = bool(fan_payload.get("stamp", True))
         fan_payload["stamp"] = False
         replies = self._whatif_fan_out("whatif_routine", fan_payload)
+        # Degraded mode: a down shard contributes no forecasts — its
+        # gangs are WAITing on shardDown anyway, and the merged answer
+        # attributes the gap instead of 500ing the whole forecast.
+        live = [r for r in replies if r is not None]
+        shards_down = [
+            sid for sid, r in enumerate(replies) if r is None
+        ]
         merged: Dict[str, Dict] = {}
         order: List[str] = []
 
@@ -2279,7 +2817,7 @@ class ShardedScheduler:
             kb = (b["predictedWaitS"] is None, b["predictedWaitS"] or 0.0)
             return ka < kb
 
-        for reply in replies:
+        for reply in live:
             for f in reply.get("forecasts") or []:
                 cur = merged.get(f["gang"])
                 if cur is None:
@@ -2294,21 +2832,26 @@ class ShardedScheduler:
             duration = next(
                 (
                     m["confidenceHorizonS"]
-                    for m in (r.get("meta") or {} for r in replies)
+                    for m in (r.get("meta") or {} for r in live)
                     if "confidenceHorizonS" in m
                 ),
                 0.0,
             )
             items = [(g, merged[g]["predictedWaitS"]) for g in order]
-            for backend in self.shards:
-                backend.call("whatif_stamp", items, duration)
+            for sid in range(len(self.shards)):
+                self._try_shard_call(sid, "whatif_stamp", items, duration)
+        meta: Dict = {
+            "shards": len(self.shards),
+            "perShard": [
+                r.get("meta") if r is not None else None for r in replies
+            ],
+        }
+        if shards_down:
+            meta["shardsDown"] = shards_down
         return {
             "mode": "queue",
             "forecasts": [merged[g] for g in order],
-            "meta": {
-                "shards": len(self.shards),
-                "perShard": [r.get("meta") for r in replies],
-            },
+            "meta": meta,
         }
 
     def _whatif_fan_out(
@@ -2318,7 +2861,8 @@ class ShardedScheduler:
         (each is a full fork build + horizon replay — wall time must be
         the max of the shards, not the sum; the recover() fan-out
         pattern). ``payloads`` is one shared payload dict, or a list
-        with one payload per shard."""
+        with one payload per shard. A down shard's slot stays None
+        (degraded mode — callers attribute the gap)."""
         per_shard = (
             payloads
             if isinstance(payloads, list)
@@ -2329,9 +2873,11 @@ class ShardedScheduler:
 
         def run(sid: int) -> None:
             try:
-                results[sid] = self.shards[sid].call(
-                    method, per_shard[sid]
+                results[sid] = self._shard_call(
+                    sid, method, per_shard[sid]
                 )
+            except ShardWorkerError:
+                pass  # degraded: slot stays None
             except BaseException as e:  # noqa: BLE001
                 errors.append(e)
 
@@ -2380,11 +2926,15 @@ class ShardedScheduler:
             sub["capacityTrace"] = dict(trace, events=slices[sid])
             per_shard.append(sub)
         replies = self._whatif_fan_out("whatif_routine", per_shard)
+        live = [r for r in replies if r is not None]
+        shards_down = [
+            sid for sid, r in enumerate(replies) if r is None
+        ]
         sub_g = sum(
-            r["counts"]["submittedGuaranteed"] for r in replies
+            r["counts"]["submittedGuaranteed"] for r in live
         )
-        bound_g = sum(r["counts"]["boundGuaranteed"] for r in replies)
-        return {
+        bound_g = sum(r["counts"]["boundGuaranteed"] for r in live)
+        result = {
             "mode": "capacity",
             "perShard": replies,
             "sloRisk": {
@@ -2393,13 +2943,18 @@ class ShardedScheduler:
                     round(bound_g / sub_g, 4) if sub_g else 1.0
                 ),
                 "waitingAtEnd": sum(
-                    r["sloRisk"]["waitingAtEnd"] for r in replies
+                    r["sloRisk"]["waitingAtEnd"] for r in live
                 ),
                 "p99OverSlo": any(
-                    r["sloRisk"]["p99OverSlo"] for r in replies
+                    r["sloRisk"]["p99OverSlo"] for r in live
                 ),
             },
         }
+        if shards_down:
+            # A down shard's submit slice went unforecast — say so
+            # rather than report a silently-partial plan.
+            result["shardsDown"] = shards_down
+        return result
 
     # -- node / health events (global mode) --------------------------- #
 
@@ -2424,18 +2979,34 @@ class ShardedScheduler:
                    targets: Optional[List[int]] = None) -> List:
         """Two-phase broadcast: stage everywhere, then commit in
         ascending shard order. A single-target broadcast degenerates to
-        a direct call (no second phase to tear)."""
+        a direct call (no second phase to tear).
+
+        Degraded mode: shards the supervisor holds non-up are skipped
+        up front, and a shard that DIES mid-broadcast is dropped from
+        the round instead of failing it — every verb broadcast here
+        (node events, health/clock ticks) is exactly what the
+        supervisor's mirror journal replays into the resurrected
+        worker, so the skipped shard converges on the same state."""
         ids = (
             list(range(len(self.shards))) if targets is None else targets
         )
+        ids = [sid for sid in ids if self.supervisor.is_up(sid)]
+        if not ids:
+            return []
         if len(ids) == 1:
-            return [self.shards[ids[0]].call(method, *args)]
+            try:
+                return [self._shard_call(ids[0], method, *args)]
+            except ShardWorkerError:
+                return [None]
         with self._op_lock:
             op_id = next(self._op_seq)
         staged: List[int] = []
         try:
             for sid in ids:
-                self.shards[sid].call("op_stage", op_id, method, args)
+                try:
+                    self._shard_call(sid, "op_stage", op_id, method, args)
+                except ShardWorkerError:
+                    continue  # died mid-round: journal replay covers it
                 staged.append(sid)
         except BaseException:
             for sid in staged:
@@ -2449,12 +3020,19 @@ class ShardedScheduler:
         # applying, so the failed shard itself holds nothing) — a
         # commit-phase error must not leave later shards staged-forever
         # while earlier shards already applied. The first error re-raises
-        # after the sweep.
+        # after the sweep; a worker DEATH does not (retriable — the
+        # resurrection replay re-delivers the event).
         results: List = []
         first_err: Optional[BaseException] = None
         for sid in sorted(ids):
+            if sid not in staged:
+                results.append(None)
+                continue
             try:
                 results.append(self._commit_phase(self.shards[sid], op_id))
+            except ShardWorkerError as e:
+                self.supervisor.note_failure(sid, e, method)
+                results.append(None)
             except BaseException as e:  # noqa: BLE001
                 if first_err is None:
                     first_err = e
@@ -2468,6 +3046,7 @@ class ShardedScheduler:
             self._informer_capture["nodes"].append(node)
             return
         self._record("record_node_event", "node_add", node)
+        self.supervisor.note_node(node)
         self._broadcast("add_node", (node,), self._node_targets(node.name))
 
     def add_nodes(self, nodes: List[Node]) -> None:
@@ -2479,6 +3058,7 @@ class ShardedScheduler:
             return
         for node in nodes:
             self._record("record_node_event", "node_add", node)
+            self.supervisor.note_node(node)
         per_targets: Dict[Tuple[int, ...], List[Node]] = {}
         for node in nodes:
             key = tuple(self._node_targets(node.name))
@@ -2491,18 +3071,21 @@ class ShardedScheduler:
             self._informer_capture["nodes"].append(new)
             return
         self._record("record_node_event", "node_state", new)
+        self.supervisor.note_node(new)
         self._broadcast(
             "update_node", (old, new), self._node_targets(new.name)
         )
 
     def delete_node(self, node: Node) -> None:
         self._record("record_node_event", "node_delete", node)
+        self.supervisor.note_node_delete(node.name)
         self._broadcast(
             "delete_node", (node,), self._node_targets(node.name)
         )
 
     def health_tick(self) -> None:
         self._record("record_marker", "health_tick")
+        self.supervisor.note_tick()
         self._broadcast("health_tick", ())
 
     def settle_health_now(self) -> None:
@@ -2514,7 +3097,10 @@ class ShardedScheduler:
         self._broadcast("settle_health_wall", ())
 
     def health_pending_count(self) -> int:
-        return sum(b.call("health_pending_count") for b in self.shards)
+        return sum(
+            self._try_shard_call(sid, "health_pending_count", default=0)
+            for sid in range(len(self.shards))
+        )
 
     # -- recovery (fan-out) ------------------------------------------- #
 
@@ -2527,6 +3113,10 @@ class ShardedScheduler:
         replay out: every shard restores its own ledger/snapshot slot
         and delta-replays its own chains — in parallel for process
         backends (the recovery-blackout win scales with shards)."""
+        # Full recovery supersedes per-shard supervision: authoritative
+        # state is about to replay into every backend, so force-respawn
+        # anything dead/down and reset the breakers first.
+        self.supervisor.ensure_all_up()
         node_list, pod_list = list(nodes), list(pods)
         node_slices: List[List[Node]] = [[] for _ in self.shards]
         for node in node_list:
@@ -2577,6 +3167,7 @@ class ShardedScheduler:
                     self._uid_shard[uid] = sid
                 for g in state["groups"]:
                     self._group_shard[g] = sid
+        self.supervisor.note_recovered(node_list, pod_list)
         self._ready.set()
 
     def _route_recovery_pod(self, pod: Pod) -> Optional[int]:
@@ -2607,8 +3198,8 @@ class ShardedScheduler:
         return None
 
     def discard_preapplied_state(self) -> None:
-        for backend in self.shards:
-            backend.call("discard_preapplied_state")
+        for sid in range(len(self.shards)):
+            self._try_shard_call(sid, "discard_preapplied_state")
 
     def begin_recovery(self, ledger_payload=None,
                        defer_doom_rebuild: bool = False) -> None:
@@ -2626,8 +3217,10 @@ class ShardedScheduler:
         )
 
     def mark_ready(self) -> None:
-        for backend in self.shards:
-            backend.call("mark_ready")
+        # A down shard is marked ready on resurrection instead
+        # (supervisor._recover_shard checks front.is_ready()).
+        for sid in range(len(self.shards)):
+            self._try_shard_call(sid, "mark_ready")
         self._ready.set()
 
     def is_ready(self) -> bool:
@@ -2651,9 +3244,10 @@ class ShardedScheduler:
 
     def prefetch_snapshot(self, min_watermark=None, apply: bool = False) -> bool:
         ok = True
-        for backend in self.shards:
-            ok = backend.call(
-                "prefetch_snapshot", min_watermark, apply
+        for sid in range(len(self.shards)):
+            ok = self._try_shard_call(
+                sid, "prefetch_snapshot", min_watermark, apply,
+                default=False,
             ) and ok
         return ok
 
@@ -2663,8 +3257,10 @@ class ShardedScheduler:
         if not self.is_leader():
             return False
         landed = False
-        for backend in self.shards:
-            landed = backend.call("flush_snapshot", self._watermark) or landed
+        for sid in range(len(self.shards)):
+            landed = self._try_shard_call(
+                sid, "flush_snapshot", self._watermark, default=False
+            ) or landed
         return landed
 
     def start_snapshot_flusher(
@@ -2706,8 +3302,15 @@ class ShardedScheduler:
     # -- inspect aggregation ------------------------------------------ #
 
     def get_metrics(self) -> Dict:
+        from . import supervisor as supervisor_mod
+
         merged: Dict = {}
-        per_shard = [b.call("get_metrics") for b in self.shards]
+        per_shard = [
+            p for p in (
+                self._try_shard_call(sid, "get_metrics")
+                for sid in range(len(self.shards))
+            ) if p is not None
+        ]
         merged = _merge_metrics(per_shard)
         merged["procShards"] = len(self.shards)
         merged["shardChains"] = {
@@ -2771,7 +3374,27 @@ class ShardedScheduler:
         )
         merged["leader"] = self.is_leader()
         merged["ready"] = self.is_ready()
-        merged["deposedBindRefusedCount"] += self._deposed_bind_refused
+        merged["deposedBindRefusedCount"] = (
+            merged.get("deposedBindRefusedCount", 0)
+            + self._deposed_bind_refused
+        )
+        # Supervision plane (doc/observability.md): per-shard liveness
+        # gauge + the restart / degraded-WAIT counters, plus explicit
+        # attribution of which shards the gather above skipped.
+        sup = self.supervisor.snapshot()
+        merged["shardUp"] = {
+            str(s["shard"]): 1 if s["status"] == supervisor_mod.STATUS_UP
+            else 0
+            for s in sup
+        }
+        merged["shardRestartCount"] = sum(s["restarts"] for s in sup)
+        merged["shardDegradedWaitCount"] = sum(
+            s["degradedWaits"] for s in sup
+        )
+        merged["shardsDown"] = [
+            s["shard"] for s in sup
+            if s["status"] != supervisor_mod.STATUS_UP
+        ]
         # Black-box plane: shard-side audit counters already summed by
         # _merge_metrics; the recorder captures at the FRONTEND (workers
         # run with theirs off), so its counters are the frontend's.
@@ -2787,16 +3410,24 @@ class ShardedScheduler:
 
     def get_physical_cluster_status(self) -> List[Dict]:
         merged: Dict[int, Dict] = {}
-        for backend in self.shards:
-            for i, st in backend.call("inspect_physical_positions"):
+        for sid in range(len(self.shards)):
+            reply = self._try_shard_call(
+                sid, "inspect_physical_positions"
+            )
+            for i, st in reply or []:
                 merged[i] = st
         return [merged[i] for i in sorted(merged)]
 
     def get_virtual_cluster_status(self, vcn: str) -> List[Dict]:
         merged: Dict[int, Dict] = {}
         tail: List[Dict] = []
-        for backend in self.shards:
-            indexed, appended = backend.call("inspect_vc_positions", vcn)
+        for sid in range(len(self.shards)):
+            reply = self._try_shard_call(
+                sid, "inspect_vc_positions", vcn
+            )
+            if reply is None:
+                continue
+            indexed, appended = reply
             for i, st in indexed:
                 merged[i] = st
             tail.extend(appended)
@@ -2819,10 +3450,9 @@ class ShardedScheduler:
 
     def get_all_affinity_groups(self) -> Dict:
         items: List[Dict] = []
-        for backend in self.shards:
-            items.extend(
-                backend.call("get_all_affinity_groups").get("items", [])
-            )
+        for sid in range(len(self.shards)):
+            reply = self._try_shard_call(sid, "get_all_affinity_groups")
+            items.extend((reply or {}).get("items", []))
         # The single-process list is insertion-ordered (allocation
         # history); the merged view normalizes to name order.
         items.sort(key=lambda d: (d.get("metadata") or {}).get("name", ""))
@@ -2832,11 +3462,21 @@ class ShardedScheduler:
         with self._maps_lock:
             sid = self._group_shard.get(name)
         if sid is not None:
-            return self.shards[sid].call("get_affinity_group", name)
-        last: Optional[api.WebServerError] = None
-        for backend in self.shards:
             try:
-                return backend.call("get_affinity_group", name)
+                return self._shard_call(sid, "get_affinity_group", name)
+            except ShardWorkerError:
+                raise api.WebServerError(
+                    503,
+                    f"shard {sid} owning affinity group {name} is "
+                    f"{self.supervisor.status(sid)}; retry after "
+                    "resurrection",
+                )
+        last: Optional[api.WebServerError] = None
+        for s in range(len(self.shards)):
+            try:
+                return self._shard_call(s, "get_affinity_group", name)
+            except ShardWorkerError:
+                continue
             except api.WebServerError as e:
                 last = e
         raise last if last is not None else api.not_found(
@@ -2844,20 +3484,32 @@ class ShardedScheduler:
         )
 
     def get_health(self) -> Dict:
-        payloads = [b.call("get_health_owned") for b in self.shards]
-        return _merge_health(payloads)
+        payloads = [
+            p for p in (
+                self._try_shard_call(sid, "get_health_owned")
+                for sid in range(len(self.shards))
+            ) if p is not None
+        ]
+        merged = _merge_health(payloads)
+        down = self.supervisor.down_shards()
+        if down:
+            merged["shardsDown"] = down
+        return merged
 
     def get_quarantine(self) -> Dict:
         items: List[Dict] = []
-        for backend in self.shards:
-            items.extend(backend.call("get_quarantine").get("items", []))
+        for sid in range(len(self.shards)):
+            reply = self._try_shard_call(sid, "get_quarantine")
+            items.extend((reply or {}).get("items", []))
         items.sort(key=lambda d: d.get("podUid", ""))
         return {"items": items}
 
     def get_doomed_ledger(self) -> Dict:
         merged: Dict = {"vcs": {}, "epoch": 0, "persistedEpoch": 0}
-        for backend in self.shards:
-            snap = backend.call("get_doomed_ledger_owned")
+        for sid in range(len(self.shards)):
+            snap = self._try_shard_call(sid, "get_doomed_ledger_owned")
+            if snap is None:
+                continue
             for vcn, entries in (snap.get("vcs") or {}).items():
                 merged["vcs"].setdefault(vcn, []).extend(entries)
             merged["epoch"] += snap.get("epoch", 0)
@@ -2876,12 +3528,18 @@ class ShardedScheduler:
         gate: Optional[str] = None,
     ) -> Dict:
         items: List[Dict] = []
-        for backend in self.shards:
-            items.extend(
-                backend.call(
-                    "get_decisions", n, verdict, gate
-                ).get("items", [])
+        for sid in range(len(self.shards)):
+            reply = self._try_shard_call(
+                sid, "get_decisions", n, verdict, gate
             )
+            items.extend((reply or {}).get("items", []))
+        # The frontend keeps its own journal for records no shard owns:
+        # `_shard` supervision lifecycle + degraded-mode WAIT verdicts.
+        # Same ?verdict=/?gate= slice the workers apply server-side.
+        items.extend(
+            d for d in self.decisions.snapshot()
+            if _decision_matches(d, verdict, gate)
+        )
         # Per-shard seq counters are independent; wall time is the only
         # cross-shard recency order. Without the sort, ?n= would keep the
         # highest-numbered shard's tail and drop newer decisions from
@@ -2901,11 +3559,18 @@ class ShardedScheduler:
 
     def get_decision(self, key: str) -> Dict:
         last: Optional[api.WebServerError] = None
-        for backend in self.shards:
+        for sid in range(len(self.shards)):
             try:
-                return backend.call("get_decision", key)
+                return self._shard_call(sid, "get_decision", key)
+            except ShardWorkerError:
+                continue
             except api.WebServerError as e:
                 last = e
+        # Frontend-journaled records (degraded-mode WAITs, `_shard`
+        # supervision lifecycle) live in no shard.
+        rec = self.decisions.lookup(key)
+        if rec is not None:
+            return rec
         raise last if last is not None else api.not_found(
             f"No decision recorded for pod {key}"
         )
@@ -2924,11 +3589,13 @@ class ShardedScheduler:
             for item in self.tracer.snapshot(n)
         ]
         shard_items: List[Dict] = []
-        for backend in self.shards:
-            p = backend.call("get_traces", n)
+        for sid in range(len(self.shards)):
+            p = self._try_shard_call(sid, "get_traces", n)
+            if p is None:
+                continue
             sample = p.get("sample") if sample is None else sample
             shard_items.extend(
-                {**item, "shard": backend.shard_id}
+                {**item, "shard": sid}
                 for item in p.get("items", [])
             )
         # Stitch: a worker trace with a parent nests under the frontend
@@ -2960,8 +3627,19 @@ class ShardedScheduler:
             "ready": self.is_ready(),
             "procShards": len(self.shards),
             "shards": [
-                backend.call("get_ha") for backend in self.shards
+                self._try_shard_call(
+                    sid, "get_ha",
+                    default={
+                        "shard": sid,
+                        "unavailable": True,
+                        "status": self.supervisor.status(sid),
+                    },
+                )
+                for sid in range(len(self.shards))
             ],
+            # Supervision plane: per-shard liveness, restart count, and
+            # last exit cause (ISSUE 17 observability satellite).
+            "supervision": self.supervisor.snapshot(),
         }
         if lead is not None:
             payload["identity"] = getattr(lead, "identity", "")
@@ -2993,11 +3671,11 @@ class ShardedScheduler:
         """(pod, state-string) for one scheduled pod, any transport."""
         with self._maps_lock:
             sid = self._uid_shard.get(uid)
-        backends = (
-            [self.shards[sid]] if sid is not None else self.shards
+        sids = (
+            [sid] if sid is not None else range(len(self.shards))
         )
-        for backend in backends:
-            found = backend.call("get_status_pod", uid)
+        for s in sids:
+            found = self._try_shard_call(s, "get_status_pod", uid)
             if found is not None:
                 return found
         return None
@@ -3012,10 +3690,11 @@ class ShardedScheduler:
         """Deterministically seed every shard's victim-pick rng (tests;
         the differential suites re-seed per call so the per-shard stream
         split cannot diverge from a single process's one stream)."""
-        for backend in self.shards:
-            backend.call("seed_preempt_rng", seed)
+        for sid in range(len(self.shards)):
+            self._try_shard_call(sid, "seed_preempt_rng", seed)
 
     def close(self) -> None:
+        self.supervisor.stop()
         self.stop_snapshot_flusher()
         for backend in self.shards:
             backend.close()

@@ -1,0 +1,497 @@
+"""The shard supervision plane: crash/hang detection, hot resurrection,
+degraded-mode bookkeeping.
+
+PR 8's multi-process frontend inherited HiveD's single-binary blind spot:
+a shard worker that dies (or wedges) marks its backend dead forever —
+one SIGKILL takes the shard's chain families offline until a full
+restart, despite the partitioned recovery machinery (PR 7's per-shard
+snapshot slots + annotation delta replay) being exactly what a per-shard
+resurrection needs. This module closes the loop:
+
+- **Liveness** — the backends themselves detect death (pipe EOF) and
+  hangs (per-verb deadlines, ``HIVED_SHARD_VERB_DEADLINE_S``); the
+  supervisor's heartbeat additionally catches a worker that died *idle*
+  (nobody reading the pipe) via ``Process.is_alive``. Every failure is
+  journaled as a ``_shard`` decision record carrying the exitcode /
+  signal / in-flight verb the backend captured.
+
+- **Hot resurrection** — respawn the worker through the frontend's own
+  backend factory, then drive the shard's recovery through the existing
+  PR-7 validation ladder against its own ``_PartitionStore`` slot (the
+  worker loads + validates its snapshot partition, falls back to
+  annotation delta replay of only its owned chains) fed from this
+  module's **mirror journal** of idempotent informer state: the
+  last-applied node set, the live pod set, and the health-clock tick
+  count since boot/recovery. All other shards keep serving throughout.
+  Restart storms are bounded by exponential backoff and a circuit
+  breaker that degrades the shard to ``down`` after N consecutive
+  failed resurrections.
+
+- **Degraded mode** — while a shard is not ``up``, the frontend answers
+  its routed filters with WAIT + a ``shardDown`` rejection certificate
+  (epoch-stamped, so a cached certificate is invalidated by the
+  resurrection's epoch bump), refuses its binds retriably (503), and
+  skips it in inspect/metrics aggregation with explicit attribution.
+  The counters here feed ``hived_shard_up{shard}`` /
+  ``hived_shard_restarts_total`` / ``hived_shard_degraded_waits_total``.
+
+The mirror journal is bounded by construction: nodes and pods are maps
+keyed by name/uid holding only the LATEST state (cluster-sized, not
+history-sized), and the tick count is one integer whose replay is capped
+at :data:`TICK_REPLAY_CAP` (past the health damper horizon, additional
+ticks only advance the clock). Why a mirror and not the kube informer:
+resurrection must not depend on an apiserver round-trip being possible
+at that moment — the inputs that built the live shards are replayed
+from memory, and the chaos differential (tests/chaos.py supervise mode)
+proves the mirror-recovered shard converges to the same chain-scoped
+fingerprint + probe outcomes as a never-crashed twin recovered from the
+harness's cluster truth.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import common
+from .types import Node, Pod
+
+# Resurrection replays at most this many health ticks (1 RPC, worker-side
+# loop). Past the damper horizon extra ticks are clock advancement only;
+# the cap keeps the journal's replay cost bounded on long-lived parents.
+TICK_REPLAY_CAP = 100_000
+
+STATUS_UP = "up"
+STATUS_RESURRECTING = "resurrecting"
+STATUS_DOWN = "down"
+
+
+class ShardJournal:
+    """Bounded mirror of the idempotent informer-state verbs, replayed
+    into a resurrected worker. Mutated only under the supervisor's lock
+    (the frontend verbs call through the supervisor's note_* hooks)."""
+
+    def __init__(self) -> None:
+        self.nodes: Dict[str, Node] = {}
+        self.pods: Dict[str, Pod] = {}
+        self.ticks = 0
+
+    def note_node(self, node: Node) -> None:
+        self.nodes[node.name] = node
+
+    def note_node_delete(self, name: str) -> None:
+        self.nodes.pop(name, None)
+
+    def note_pod(self, pod: Pod) -> None:
+        self.pods[pod.uid] = pod
+
+    def note_pod_delete(self, uid: str) -> None:
+        self.pods.pop(uid, None)
+
+    def note_tick(self) -> None:
+        self.ticks += 1
+
+    def reset(self, nodes, pods) -> None:
+        """A full recovery re-anchors the mirror on its authoritative
+        inputs (and zeroes the tick clock, like the recovery itself)."""
+        self.nodes = {n.name: n for n in nodes}
+        self.pods = {p.uid: p for p in pods}
+        self.ticks = 0
+
+
+class _ShardState:
+    __slots__ = (
+        "sid", "status", "restarts", "failures", "epoch", "last_exit",
+        "next_attempt_at", "degraded_waits",
+    )
+
+    def __init__(self, sid: int):
+        self.sid = sid
+        self.status = STATUS_UP
+        self.restarts = 0          # successful resurrections
+        self.failures = 0          # CONSECUTIVE failed resurrections
+        self.epoch = 0             # bumps on every resurrection
+        self.last_exit: Optional[Dict] = None
+        self.next_attempt_at = 0.0
+        self.degraded_waits = 0
+
+    def to_dict(self) -> Dict:
+        return {
+            "shard": self.sid,
+            "status": self.status,
+            "restarts": self.restarts,
+            "consecutiveFailures": self.failures,
+            "epoch": self.epoch,
+            "degradedWaits": self.degraded_waits,
+            "lastExit": self.last_exit,
+        }
+
+
+class ShardSupervisor:
+    """Per-shard liveness + resurrection driver for a ShardedScheduler
+    frontend. ``check_now()`` is the deterministic entry point (tests,
+    chaos); ``start()`` runs it on a heartbeat thread in production."""
+
+    def __init__(self, front, clock=time.monotonic):
+        self.front = front
+        cfg = front.config
+        self.max_failures = int(
+            getattr(cfg, "shard_max_resurrection_failures", 3)
+        )
+        self.backoff_base_s = float(
+            getattr(cfg, "shard_resurrection_backoff_seconds", 1.0)
+        )
+        self.backoff_cap_s = float(
+            getattr(cfg, "shard_resurrection_backoff_cap_seconds", 30.0)
+        )
+        self.clock = clock
+        self.journal = ShardJournal()
+        # RLock: the frontend's degraded-wait path runs under the
+        # supervisor lock and journals through front.decisions, whose
+        # commit path never re-enters here — but resurrection calls
+        # frontend verbs that call back into note_* hooks.
+        self._lock = threading.RLock()
+        self.states = [
+            _ShardState(sid) for sid in range(len(front.shards))
+        ]
+        self._stop: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- status reads (hot path: one dict lookup, no backend call) ----- #
+
+    def is_up(self, sid: int) -> bool:
+        return self.states[sid].status == STATUS_UP
+
+    def status(self, sid: int) -> str:
+        return self.states[sid].status
+
+    def epoch(self, sid: int) -> int:
+        return self.states[sid].epoch
+
+    def down_shards(self) -> List[int]:
+        return [
+            s.sid for s in self.states if s.status != STATUS_UP
+        ]
+
+    def snapshot(self) -> List[Dict]:
+        with self._lock:
+            return [s.to_dict() for s in self.states]
+
+    # -- journal feeding (called by the frontend's informer verbs) ----- #
+
+    def note_node(self, node: Node) -> None:
+        with self._lock:
+            self.journal.note_node(node)
+
+    def note_node_delete(self, name: str) -> None:
+        with self._lock:
+            self.journal.note_node_delete(name)
+
+    def note_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self.journal.note_pod(pod)
+
+    def note_pod_delete(self, uid: str) -> None:
+        with self._lock:
+            self.journal.note_pod_delete(uid)
+
+    def note_tick(self) -> None:
+        with self._lock:
+            self.journal.note_tick()
+
+    def note_recovered(self, nodes, pods) -> None:
+        with self._lock:
+            self.journal.reset(nodes, pods)
+
+    # -- failure intake ------------------------------------------------ #
+
+    def note_failure(self, sid: int, err: Optional[BaseException] = None,
+                     method: str = "") -> None:
+        """A ShardWorkerError surfaced (or the heartbeat found a dead
+        worker): transition the shard out of ``up`` exactly once and
+        journal the forensic record. Idempotent — every caller racing
+        the same death funnels here."""
+        with self._lock:
+            st = self.states[sid]
+            if st.status != STATUS_UP:
+                return
+            st.status = STATUS_RESURRECTING
+            st.failures = 0
+            st.next_attempt_at = self.clock()  # first attempt: immediate
+            backend = self.front.shards[sid]
+            exit_info = dict(getattr(backend, "last_exit", None) or {})
+            if not exit_info and err is not None:
+                exit_info = {
+                    "cause": getattr(err, "cause", "died"),
+                    "exitcode": getattr(err, "exitcode", None),
+                    "signal": getattr(err, "signal_name", ""),
+                    "method": getattr(err, "method", method),
+                }
+            st.last_exit = exit_info or None
+            self._journal_record(
+                sid,
+                "shard-failed",
+                "shard %d worker %s (exitcode=%s signal=%s method=%s)" % (
+                    sid,
+                    exit_info.get("cause", "died"),
+                    exit_info.get("exitcode"),
+                    exit_info.get("signal") or "-",
+                    exit_info.get("method") or "-",
+                ),
+            )
+            common.log.error(
+                "shard %d worker failed (%s); supervision engaged",
+                sid, exit_info.get("cause", "died"),
+            )
+
+    def note_degraded_wait(self, sid: int) -> None:
+        with self._lock:
+            self.states[sid].degraded_waits += 1
+
+    # -- liveness + resurrection driver -------------------------------- #
+
+    def check_now(self, resurrect: bool = True) -> Dict:
+        """One supervision pass: detect silently-dead workers, attempt
+        due resurrections. Deterministic (no sleeping) — the heartbeat
+        thread and the tests both drive exactly this."""
+        detected, resurrected, still_down = [], [], []
+        for st in self.states:
+            sid = st.sid
+            if st.status == STATUS_UP:
+                backend = self.front.shards[sid]
+                alive = True
+                try:
+                    alive = backend.is_alive()
+                except Exception:  # noqa: BLE001
+                    alive = False
+                if not alive:
+                    self.note_failure(sid)
+                    detected.append(sid)
+        if resurrect:
+            for st in self.states:
+                if st.status != STATUS_RESURRECTING:
+                    if st.status == STATUS_DOWN:
+                        still_down.append(st.sid)
+                    continue
+                if self.clock() < st.next_attempt_at:
+                    continue
+                if self._attempt(st.sid):
+                    resurrected.append(st.sid)
+                elif self.states[st.sid].status == STATUS_DOWN:
+                    still_down.append(st.sid)
+        return {
+            "detected": detected,
+            "resurrected": resurrected,
+            "down": still_down,
+        }
+
+    def _attempt(self, sid: int) -> bool:
+        with self._lock:
+            st = self.states[sid]
+            try:
+                self._resurrect(sid)
+            except Exception as e:  # noqa: BLE001
+                st.failures += 1
+                delay = min(
+                    self.backoff_cap_s,
+                    self.backoff_base_s * (2 ** (st.failures - 1)),
+                )
+                st.next_attempt_at = self.clock() + delay
+                if st.failures >= self.max_failures:
+                    st.status = STATUS_DOWN
+                    self._journal_record(
+                        sid,
+                        "shard-down",
+                        f"shard {sid} circuit breaker open after "
+                        f"{st.failures} failed resurrections: {e}",
+                    )
+                    common.log.error(
+                        "shard %d degraded to down after %d failed "
+                        "resurrections: %s", sid, st.failures, e,
+                    )
+                else:
+                    self._journal_record(
+                        sid,
+                        "shard-retry",
+                        f"shard {sid} resurrection failed "
+                        f"({st.failures}/{self.max_failures}), backoff "
+                        f"{delay:.1f}s: {e}",
+                    )
+                    common.log.warning(
+                        "shard %d resurrection failed (%d/%d): %s",
+                        sid, st.failures, self.max_failures, e,
+                    )
+                return False
+            st.status = STATUS_UP
+            st.restarts += 1
+            st.failures = 0
+            st.epoch += 1
+            self._journal_record(
+                sid,
+                "shard-resurrected",
+                f"shard {sid} resurrected (epoch {st.epoch}, "
+                f"restart {st.restarts})",
+            )
+            common.log.warning(
+                "shard %d resurrected (epoch %d)", sid, st.epoch
+            )
+            return True
+
+    def _resurrect(self, sid: int) -> None:
+        """Respawn + per-shard recovery. Any exception leaves the old
+        (dead) backend in place for the next attempt — the frontend's
+        degraded-mode path keeps answering for the shard meanwhile."""
+        front = self.front
+        old = front.shards[sid]
+        # Slice the mirror exactly the way recover() slices the cluster:
+        # nodes by chain targets, pods by recovery routing (an unroutable
+        # pod belongs to every slice, so it belongs to this one).
+        nodes = [
+            n for n in self.journal.nodes.values()
+            if sid in front._node_targets(n.name)
+        ]
+        pods = [
+            p for p in self.journal.pods.values()
+            if front._route_recovery_pod(p) in (sid, None)
+        ]
+        ticks = min(self.journal.ticks, TICK_REPLAY_CAP)
+        if self.journal.ticks > TICK_REPLAY_CAP:
+            common.log.warning(
+                "shard %d tick replay clamped: %d -> %d",
+                sid, self.journal.ticks, TICK_REPLAY_CAP,
+            )
+        try:
+            old.close()
+        except Exception:  # noqa: BLE001 — already-dead close must not
+            pass           # block the respawn
+        backend = front._spawn_backend(sid, old.owned_chains)
+        try:
+            self._recover_shard(backend, sid, nodes, pods, ticks)
+        except BaseException:
+            try:
+                backend.close()
+            except Exception:  # noqa: BLE001
+                pass
+            raise
+        # Swap in, then reset the frontend's per-shard transport memos
+        # (suggested-set sends, delta bases) and rebuild the routing maps
+        # for THIS shard from its recovered state.
+        front.shards[sid] = backend
+        state = backend.call("list_state")
+        with front._maps_lock:
+            front._nodes_sent[sid] = set()
+            front._nodes_acked[sid] = None
+            for uid in [
+                u for u, s in front._uid_shard.items() if s == sid
+            ]:
+                del front._uid_shard[uid]
+            for g in [
+                g for g, s in front._group_shard.items() if s == sid
+            ]:
+                del front._group_shard[g]
+            for uid in state["uids"]:
+                front._uid_shard[uid] = sid
+            for g in state["groups"]:
+                front._group_shard[g] = sid
+        # Post-resurrection flight-recorder windows must re-anchor on a
+        # fresh snapshot: the pre-crash anchor no longer matches the
+        # resurrected shard's projection lineage.
+        rec = front.recorder
+        if rec is not None:
+            rec.force_reanchor()
+
+    def _recover_shard(self, backend, sid: int, nodes, pods,
+                       ticks: int) -> None:
+        """Drive one respawned worker through the PR-7 recovery ladder
+        (snapshot slot validation, annotation delta replay of its owned
+        chains) and replay the mirror's idempotent clock. The chaos
+        sensitivity meta-test no-ops THIS seam to prove the supervise
+        differential has teeth."""
+        backend.call("recover_slice", nodes, pods, None)
+        if ticks:
+            backend.call("replay_health_ticks", ticks)
+        if self.front.is_ready():
+            backend.call("mark_ready")
+
+    def ensure_all_up(self) -> None:
+        """Force-respawn every non-up shard, resetting breakers — the
+        full-recovery path (frontend recover()) is about to replay
+        authoritative state into every backend, so per-shard recovery
+        and backoff bookkeeping are both moot."""
+        with self._lock:
+            for st in self.states:
+                sid = st.sid
+                backend = self.front.shards[sid]
+                dead = st.status != STATUS_UP
+                try:
+                    dead = dead or not backend.is_alive()
+                except Exception:  # noqa: BLE001
+                    dead = True
+                if dead:
+                    try:
+                        backend.close()
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self.front.shards[sid] = self.front._spawn_backend(
+                        sid, backend.owned_chains
+                    )
+                    if st.status != STATUS_UP:
+                        st.epoch += 1
+                        st.restarts += 1
+                st.status = STATUS_UP
+                st.failures = 0
+                st.next_attempt_at = 0.0
+
+    # -- heartbeat thread (production) --------------------------------- #
+
+    def start(self, interval_s: Optional[float] = None) -> bool:
+        interval = (
+            getattr(
+                self.front.config,
+                "shard_supervision_interval_seconds", 5.0,
+            )
+            if interval_s is None
+            else interval_s
+        )
+        if interval <= 0 or self._thread is not None:
+            return False
+        stop = threading.Event()
+
+        def loop() -> None:
+            while not stop.wait(interval):
+                try:
+                    self.check_now()
+                except Exception:  # noqa: BLE001
+                    common.log.exception("shard supervision pass failed")
+
+        t = threading.Thread(
+            target=loop, name="hived-shard-supervisor", daemon=True
+        )
+        self._stop, self._thread = stop, t
+        t.start()
+        return True
+
+    def stop(self) -> None:
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+        self._stop = self._thread = None
+
+    # -- journaling ---------------------------------------------------- #
+
+    def _journal_record(self, sid: int, verdict: str,
+                        detail: str) -> None:
+        """A `_shard` record in the FRONTEND decision journal (the
+        audit-plane `_audit` pattern): supervision lifecycle is part of
+        the explainability surface — `/v1/inspect/decisions` shows WHY
+        a family's pods started waiting."""
+        try:
+            journal = self.front.decisions
+            rec = journal.begin("_shard", f"_shard-{sid}", "supervise")
+            rec.verdict_error(detail)
+            rec.verdict = verdict
+            journal.commit(rec)
+        except Exception:  # noqa: BLE001 — diagnostics must never raise
+            common.log.exception("shard supervision journaling failed")

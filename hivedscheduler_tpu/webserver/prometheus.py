@@ -72,6 +72,8 @@ COUNTERS: Dict[str, tuple] = {
     "flightRecorderEventCount": ("hived_flightrecorder_events_total", "mutating verbs captured by the flight recorder since process start"),
     "flightRecorderReanchorCount": ("hived_flightrecorder_reanchors_total", "flight-recorder windows re-anchored on a fresh snapshot export (ring wrap or post-recovery)"),
     "deltaSuggestedResyncCount": ("hived_delta_suggested_resyncs_total", "delta-encoded suggested-set frames a worker refused (base mismatch or integrity check) and the frontend resynced with a full list (one wire plane; should stay near 0)"),
+    "shardRestartCount": ("hived_shard_restarts_total", "shard workers hot-resurrected by the supervision plane (crash/hang detected, worker respawned and recovered from its partition slot)"),
+    "shardDegradedWaitCount": ("hived_shard_degraded_waits_total", "filter requests answered WAIT with the shardDown gate because their owning shard was down or resurrecting"),
 }
 
 GAUGES: Dict[str, tuple] = {
@@ -107,6 +109,7 @@ LABELED: Dict[str, str] = {
     "hived_boot_phase_seconds": "boot wall seconds per phase (phase label: compile, healthInit, nodeAdd, fingerprint, recovery) — a gauge of the LAST boot, so standby cold-start is observable, not inferred",
     "hived_build_info": "constant-1 gauge whose labels identify the running deploy: snapshotSchema, configFingerprint (12-hex prefix), shards, and the hatch states (lazyVc, waitCache, nodeEventFastpath, liveAudit, flightRecorder)",
     "hived_wire_bytes_total": "per-codec internal-transport bytes (codec label: binary, pickle, json) — shard pipe/ring frames plus the frontend's HTTP filter envelope; zeros in a single-process deploy (one wire plane)",
+    "hived_shard_up": "per-shard liveness gauge (shard label): 1 while the worker is up, 0 while it is resurrecting or degraded to down (shard supervision plane; absent in a single-process deploy)",
 }
 
 # JSON-snapshot keys that are deliberately NOT exported to Prometheus:
@@ -125,6 +128,8 @@ EXCLUDED_KEYS = {
     "buildInfo",            # rendered as the hived_build_info labeled gauge
     "wireBytesTotal",       # rendered as the hived_wire_bytes_total labeled counter
     "shardWire",            # JSON-only transport detail (frame histogram)
+    "shardUp",              # rendered as the hived_shard_up labeled gauge
+    "shardsDown",           # JSON-only attribution list (non-numeric)
 }
 
 
@@ -228,6 +233,17 @@ def render(snapshot: Dict) -> str:
             f'{k}="{_escape_label(v)}"' for k, v in sorted(build.items())
         )
         lines.append("hived_build_info{%s} 1" % labels)
+
+    # Header always (family discoverability, like the lock series); rows
+    # only under proc shards — a single process has no shards to gauge.
+    header("hived_shard_up", "gauge", LABELED["hived_shard_up"])
+    for sid, up in sorted(
+        (snapshot.get("shardUp") or {}).items(), key=lambda kv: int(kv[0])
+    ):
+        lines.append(
+            'hived_shard_up{shard="%s"} %s'
+            % (_escape_label(sid), _fmt(int(up)))
+        )
 
     boot = snapshot.get("bootPhaseSeconds", {})
     header(

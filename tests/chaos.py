@@ -2403,7 +2403,8 @@ class ProcChaosHarness:
     LEASE_DURATION_S = 10.0
     LEASE_RENEW_S = 3.0
 
-    def __init__(self, seed: int, n_shards: int = 2):
+    def __init__(self, seed: int, n_shards: int = 2,
+                 supervise: bool = False):
         import bench as bench_mod
 
         from hivedscheduler_tpu.scheduler.shards import ShardedScheduler
@@ -2411,6 +2412,11 @@ class ProcChaosHarness:
         self.seed = seed
         self.rnd = random.Random(seed ^ 0x9C0C5)
         self.n_shards = n_shards
+        # Supervision chaos (scheduler.supervisor): schedules drawn from
+        # step_supervise — worker kills/hangs with in-place resurrection
+        # — instead of the default mix. A separate mode so the default
+        # schedules (and their pinned meta-test seeds) stay byte-stable.
+        self.supervise = supervise
         self.families = 2 + seed % 2
         self.hosts_per_family = 8
         self.kube = ScriptedKubeClient()
@@ -2433,6 +2439,10 @@ class ProcChaosHarness:
             "snapshot_fallbacks": 0, "node_flips": 0, "ticks": 0,
             "preempts": 0, "preempt_restarts": 0,
             "deposed_bind_refusals": 0, "broadcasts": 0,
+            # Supervision-plane events (zero outside supervise mode so
+            # the stats shape is schedule-independent).
+            "worker_kills": 0, "worker_hangs": 0, "resurrections": 0,
+            "degraded_waits": 0,
         }
         self.node_health: Dict[str, bool] = {}
         self.front = self._new_front()
@@ -2448,10 +2458,16 @@ class ProcChaosHarness:
     # ---------------- plumbing ---------------- #
 
     def _new_front(self):
-        return self._mk(
+        front = self._mk(
             self.config, kube_client=self.kube, n_shards=self.n_shards,
             transport="local",
         )
+        if self.supervise:
+            # Deterministic resurrection: no real-time backoff between
+            # attempts (the first attempt is immediate anyway; this
+            # keeps retry paths clock-free under test).
+            front.supervisor.backoff_base_s = 0.0
+        return front
 
     def _new_elector(self, identity: str) -> ha_mod.LeaderElector:
         return ha_mod.LeaderElector(
@@ -2626,6 +2642,163 @@ class ProcChaosHarness:
         chunks[idx] = chunks[idx][: max(1, len(chunks[idx]) // 2)] + "!"
         self.kube.snapshot = chunks
         self.stats["snapshot_corruptions"] += 1
+
+    # ---------------- supervision events ---------------- #
+
+    def _fams_of_shard(self, sid: int) -> List[int]:
+        """Hardware families whose chains the shard owns (each family is
+        one chain here, so exactly one shard serves it)."""
+        owned = set(self.front.shards[sid].owned_chains)
+        return [
+            fam for fam in range(self.families)
+            if any(
+                c in owned
+                for c in self.front.routing.leaf_chains.get(
+                    f"cc{fam}-chip", ()
+                )
+            )
+        ]
+
+    def _assert_degraded(self, sid: int) -> None:
+        """While the shard is dead and unresurrected: a routed filter
+        must answer WAIT with the shardDown rejection certificate (never
+        500), and the metrics surface must attribute the outage."""
+        fams = self._fams_of_shard(sid)
+        assert fams, (self.seed, sid, "shard owns no probed family")
+        fam = fams[0]
+        self.gang_seq += 1
+        tag = f"deg-{self.seed}-{self.gang_seq}"
+        pod = make_pod(
+            tag, f"u-{tag}", f"vc{fam}", 0, f"cc{fam}-chip", 1,
+            group={
+                "name": tag,
+                "members": [{"podNumber": 1, "leafCellNumber": 1}],
+            },
+        )
+        # add_pod itself is the failure detector here: the routed call
+        # hits the dead worker, the supervisor is notified, and the
+        # mirror still carries the pod for the resurrection slice.
+        self.front.add_pod(pod)
+        r = self.front.filter_routine(
+            ei.ExtenderArgs(pod=pod, node_names=self._nodes())
+        )
+        assert not r.node_names, (self.seed, sid, r.node_names)
+        assert set(r.failed_nodes) == {constants.COMPONENT_NAME}, (
+            self.seed, sid, r.failed_nodes,
+        )
+        rec = self.front.decisions.lookup(pod.uid)
+        assert rec is not None and rec["verdict"] == "wait", (
+            self.seed, sid, rec,
+        )
+        cert = rec.get("certificate") or {}
+        assert cert.get("gate") == "shardDown", (self.seed, sid, rec)
+        vector = cert.get("vector") or {}
+        assert vector.get("shard") == sid, (self.seed, sid, rec)
+        assert "shardEpoch" in vector, (self.seed, sid, rec)
+        m = self.front.get_metrics()
+        assert m["shardUp"][str(sid)] == 0, (self.seed, sid, m["shardUp"])
+        assert sid in m["shardsDown"], (self.seed, sid, m["shardsDown"])
+        assert m["shardDegradedWaitCount"] >= 1, (self.seed, sid)
+        self.front.delete_pod(pod)
+        self.stats["degraded_waits"] += 1
+
+    def worker_kill(self, hang: bool = False) -> None:
+        """Kill (or hang-trip) one shard worker in place, prove degraded
+        admission while it is down, resurrect it through the supervisor,
+        and prove the resurrected shard is equivalent to a never-crashed
+        twin (the supervise differential)."""
+        sid = self.rnd.randrange(self.n_shards)
+        self.front.shards[sid].kill(cause="hang" if hang else "kill")
+        self.stats["worker_hangs" if hang else "worker_kills"] += 1
+        self._assert_degraded(sid)
+        res = self.front.supervisor.check_now()
+        assert sid in res["resurrected"], (self.seed, sid, res)
+        sup = {
+            s["shard"]: s for s in self.front.supervisor.snapshot()
+        }[sid]
+        assert sup["status"] == "up" and sup["restarts"] >= 1, (
+            self.seed, sid, sup,
+        )
+        last_exit = sup.get("lastExit") or {}
+        assert last_exit.get("cause") == ("hang" if hang else "kill"), (
+            self.seed, sid, last_exit,
+        )
+        self.stats["resurrections"] += 1
+        self._assert_resurrection_differential(sid)
+        # Preemption reservations are checkpointed onto pods via kube
+        # annotation patches, which the supervisor mirror does not see:
+        # a resurrection legally forgets in-flight reservations (the
+        # documented fault-model contract), so drop the bookkeeping for
+        # groups the resurrected shard owned.
+        for name in list(self.preempting):
+            pods = [
+                self.cluster_pods[u]
+                for u in self.preempting[name]
+                if u in self.cluster_pods
+            ]
+            if not pods or self.front._route(pods[0]) == sid:
+                self.preempting.pop(name)
+
+    def _assert_resurrection_differential(self, sid: int) -> None:
+        """The resurrected shard must be indistinguishable from a shard
+        that never crashed: a SINGLE-PROCESS shadow recovered from the
+        supervisor mirror (nodes, pods, the partitioned ledger merged to
+        a one-process payload) with the mirror's health ticks replayed
+        must match the shard's chain-scoped fingerprint and its filter
+        probe outcomes. The sensitivity meta-test no-ops the supervisor's
+        recovery seam to prove this differential has teeth."""
+        from hivedscheduler_tpu.scheduler.supervisor import (
+            TICK_REPLAY_CAP,
+        )
+
+        journal = self.front.supervisor.journal
+        shadow_kube = ScriptedKubeClient()
+        shadow_kube.state = merged_shard_ledger_payload(
+            self.kube.state, self.front.routing.shard_plan(self.n_shards)
+        )
+        shadow = HivedScheduler(
+            self.config, force_bind_executor=lambda fn: fn()
+        )
+        shadow.kube_client = shadow_kube
+        shadow.core.preempt_rng = random.Random(self.seed ^ 0xF00D)
+        nodes = sorted(journal.nodes.values(), key=lambda n: n.name)
+        pods = [journal.pods[u] for u in sorted(journal.pods)]
+        shadow.recover(nodes, pods, min_watermark=None)
+        for _ in range(min(journal.ticks, TICK_REPLAY_CAP)):
+            shadow.health_tick()
+        backend = self.front.shards[sid]
+        owned = backend.owned_chains
+        node_chains = self.front.routing.node_chains
+
+        def owned_node(name, _o=set(owned)):
+            return bool(set(node_chains.get(name, ())) & _o)
+
+        fp_shard = chain_scoped_fingerprint(
+            backend.scheduler.core, owned, owned_node
+        )
+        fp_shadow = chain_scoped_fingerprint(
+            shadow.core, owned, owned_node
+        )
+        assert fp_shard == fp_shadow, (
+            self.seed, sid, "resurrection divergence",
+            {
+                k: "differs"
+                for k in fp_shard
+                if fp_shard[k] != fp_shadow[k]
+            },
+        )
+        # Probe outcomes, restricted to the resurrected shard's families:
+        # other shards may hold live preemption reservations the mirror
+        # (correctly) does not carry, so only the resurrected slice is
+        # comparable. Unique per-resurrection tag: the default per-restart
+        # tag would collide across multiple kills in one schedule.
+        tag = f"rz-{self.seed}-{self.stats['resurrections']}"
+        fams = self._fams_of_shard(sid)
+        assert self._probe_classes(
+            self.front, tag=tag, fams=fams
+        ) == self._probe_classes(shadow, tag=tag, fams=fams), (
+            self.seed, sid, "resurrection probe divergence",
+        )
 
     # ---------------- audits ---------------- #
 
@@ -2858,16 +3031,20 @@ class ProcChaosHarness:
             if name not in live_groups:
                 self.preempting.pop(name)
 
-    def _probe_classes(self, subject) -> List[tuple]:
+    def _probe_classes(self, subject, tag: Optional[str] = None,
+                       fams: Optional[List[int]] = None) -> List[tuple]:
         """Outcome classes of a fixed filter-probe battery, shape-agnostic
         (frontend and single scheduler both expose filter_routine). Probes
         are never-seen single-pod groups — read-only against the core —
         and uniquely named per restart so neither subject ever sees a
-        probe twice."""
+        probe twice. The resurrection differential narrows ``fams`` to the
+        resurrected shard's families and supplies a per-resurrection
+        ``tag`` (several kills can land between restarts)."""
         outs: List[tuple] = []
-        tag = f"{self.seed}-{self.stats['restarts']}"
+        if tag is None:
+            tag = f"{self.seed}-{self.stats['restarts']}"
         probe_i = 0
-        for fam in range(self.families):
+        for fam in (range(self.families) if fams is None else fams):
             for chips, prio in ((1, 0), (4, 0), (4, -1), (2, 5)):
                 probe_i += 1
                 pod = make_pod(
@@ -2972,12 +3149,48 @@ class ProcChaosHarness:
                 failover=True, mid_bind=self.rnd.random() < 0.5
             )
 
+    def step_supervise(self, i: int) -> None:
+        """Supervision-weighted event mix: the default churn plus worker
+        kills/hangs with in-place resurrection. A SEPARATE table — the
+        default step()'s thresholds are pinned by the meta-test seeds."""
+        self.event_i = i
+        self.stats["events"] += 1
+        roll = self.rnd.random()
+        if roll < 0.26:
+            self.gang_create()
+        elif roll < 0.36:
+            self.gang_delete()
+        elif roll < 0.44:
+            self.node_flip()
+        elif roll < 0.54:
+            self.health_tick()
+        elif roll < 0.60:
+            self.snapshot_flush()
+        elif roll < 0.68:
+            self.preempt_start()
+        elif roll < 0.72:
+            self.preempt_finish()
+        elif roll < 0.84:
+            self.worker_kill()
+        elif roll < 0.94:
+            self.worker_kill(hang=True)
+        else:
+            self.crash_restart()
+
     def run(self, n_events: Optional[int] = None) -> Dict[str, int]:
         n = n_events if n_events is not None else self.rnd.randint(10, 14)
+        step = self.step_supervise if self.supervise else self.step
         for i in range(n):
-            self.step(i)
+            step(i)
             self.audit(f"step={i}")
         self.event_i = n
+        if self.supervise:
+            # Every supervise schedule exercises at least one crash AND
+            # one hang resurrection, whatever the draw.
+            self.worker_kill()
+            self.audit("final-kill")
+            self.worker_kill(hang=True)
+            self.audit("final-hang")
         # Every schedule restarts through the multi-process path at least
         # once, alternating plain crash and lease failover.
         self.crash_restart(failover=self.seed % 2 == 1)
@@ -2987,8 +3200,14 @@ class ProcChaosHarness:
 
 
 def run_chaos_schedule_procs(
-    seed: int, n_events: Optional[int] = None, n_shards: int = 2
+    seed: int, n_events: Optional[int] = None, n_shards: int = 2,
+    supervise: bool = False,
 ) -> Dict[str, int]:
     """One seeded multi-process chaos schedule (the proc-mode analog of
-    run_chaos_schedule; hack/soak.sh --procs N drives soak-scale runs)."""
-    return ProcChaosHarness(seed, n_shards=n_shards).run(n_events)
+    run_chaos_schedule; hack/soak.sh --procs N drives soak-scale runs).
+    ``supervise=True`` draws from the supervision-weighted mix — worker
+    kills/hangs with degraded admission + in-place resurrection
+    (hack/soak.sh --supervise)."""
+    return ProcChaosHarness(
+        seed, n_shards=n_shards, supervise=supervise
+    ).run(n_events)

@@ -882,3 +882,65 @@ def test_nooped_broadcast_commit_is_caught(monkeypatch):
     for seed in PROC_BROADCAST_SEEDS:
         with pytest.raises(AssertionError):
             chaos.run_chaos_schedule_procs(seed)
+
+
+# Coverage floor for the supervision sweep (HIVED_CHAOS_SUPERVISE_ROUNDS
+# overrides for soaks — hack/soak.sh --supervise drives it). Every
+# supervise schedule forces at least one kill AND one hang resurrection,
+# so 3 of the 4 kill/hang checklist events are guaranteed per seed.
+SUPERVISE_CHAOS_ROUNDS = (
+    int(os.environ.get("HIVED_CHAOS_SUPERVISE_ROUNDS", "0")) or 20
+)
+
+# Seeds pinned for the no-op'd-recovery meta-test: ANY supervise seed
+# works (run() forces a kill + a hang per schedule, and a resurrected-
+# but-unrecovered shard always diverges from the mirror shadow on node
+# health alone), but these were verified against the current rng stream.
+SUPERVISE_NOOP_SEEDS = (0, 1, 2)
+
+
+def test_chaos_procs_supervise_sweep():
+    """The chaos acceptance for the shard supervision plane: seeded
+    schedules through the supervision-weighted mix — worker crashes and
+    hangs struck in place, each followed by the degraded-admission probe
+    (routed filter answers WAIT with the shardDown certificate, metrics
+    attribute the outage, never a 500), supervisor-driven resurrection,
+    and the resurrection differential (resurrected shard == a
+    single-process shadow recovered from the supervisor mirror, per
+    chain-scoped fingerprint and probe outcomes)."""
+    stats = {}
+    for seed in range(SUPERVISE_CHAOS_ROUNDS):
+        for k, v in chaos.run_chaos_schedule_procs(
+            seed, supervise=True
+        ).items():
+            stats[k] = stats.get(k, 0) + v
+    assert (
+        stats["worker_kills"] + stats["worker_hangs"]
+        >= 2 * SUPERVISE_CHAOS_ROUNDS
+    ), stats
+    for key in (
+        "worker_kills", "worker_hangs", "resurrections",
+        "degraded_waits", "binds", "restarts",
+    ):
+        assert stats[key] > 0, (key, stats)
+    assert stats["resurrections"] >= (
+        stats["worker_kills"] + stats["worker_hangs"]
+    ), stats
+
+
+def test_nooped_shard_recovery_is_caught(monkeypatch):
+    """Sensitivity meta-test for the supervise differential: with the
+    supervisor's per-shard recovery seam no-op'd — a fresh empty worker
+    swapped in as the "resurrected" shard — the pinned seeds' schedules
+    must fail the resurrection differential. If this passes while
+    recovery is dead, the supervise sweep is blind to resurrections that
+    lose state."""
+    from hivedscheduler_tpu.scheduler.supervisor import ShardSupervisor
+
+    monkeypatch.setattr(
+        ShardSupervisor, "_recover_shard",
+        lambda self, backend, sid, nodes, pods, ticks: None,
+    )
+    for seed in SUPERVISE_NOOP_SEEDS:
+        with pytest.raises(AssertionError):
+            chaos.run_chaos_schedule_procs(seed, supervise=True)

@@ -1,0 +1,420 @@
+"""Shard supervision plane (scheduler.supervisor + shards backends):
+crash/hang detection, hot resurrection, degraded-mode admission, the
+resurrection circuit breaker, and protocol robustness.
+
+Contracts under test (doc/fault-model.md "Shard supervision plane"):
+
+1. **Liveness** — a SIGKILL'd worker process is detected on the next
+   call (exitcode/signal captured) and by the heartbeat pass; a wedged
+   worker trips the per-verb pipe deadline and is killed + failed the
+   same way.
+2. **Hot resurrection** — the supervisor respawns the worker, drives the
+   per-shard recovery ladder from its mirror journal, and the shard
+   answers again with every placement preserved.
+3. **Degraded admission** — while a shard is down: routed filters WAIT
+   with the ``shardDown`` certificate, binds are refused retriably
+   (503), reads skip the shard with attribution. Never a 500.
+4. **Circuit breaker** — repeated resurrection failures degrade the
+   shard to ``down``; the full-recovery path (ensure_all_up / recover)
+   force-respawns and resets the breaker.
+5. **Protocol robustness** — one garbage pipe frame fails exactly one
+   call (ShardFrameError, no resurrection); close() is idempotent and
+   safe on an already-dead worker.
+"""
+
+import logging
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+import bench
+from hivedscheduler_tpu import common
+from hivedscheduler_tpu.api import extender as ei, types as api
+from hivedscheduler_tpu.scheduler import supervisor as supervisor_mod
+from hivedscheduler_tpu.scheduler.framework import NullKubeClient
+from hivedscheduler_tpu.scheduler.shards import (
+    ProcShardBackend,
+    ShardedScheduler,
+    ShardFrameError,
+    ShardWorkerError,
+)
+from hivedscheduler_tpu.scheduler.types import Node, Pod
+
+from .test_core import make_pod
+
+common.init_logging(logging.CRITICAL)
+
+
+def _front(transport="local", n_shards=2, hosts=8):
+    front = ShardedScheduler(
+        bench.build_concurrent_config(n_shards, hosts),
+        kube_client=NullKubeClient(),
+        n_shards=n_shards, transport=transport, auto_admit=True,
+    )
+    front.supervisor.backoff_base_s = 0.0
+    for n in front.configured_node_names():
+        front.add_node(Node(name=n))
+    return front
+
+
+def _bind_one(front, fam, tag):
+    """Place one single-pod gang on family ``fam`` and CONFIRM the bind
+    (the informer confirm in miniature) so the supervisor mirror carries
+    the bound pod; returns (confirmed_pod, node)."""
+    pod = make_pod(
+        f"{tag}", f"u-{tag}", f"vc{fam}", 0, f"cc{fam}-chip", 4,
+        group={
+            "name": f"{tag}",
+            "members": [{"podNumber": 1, "leafCellNumber": 4}],
+        },
+    )
+    front.add_pod(pod)
+    r = front.filter_routine(ei.ExtenderArgs(
+        pod=pod, node_names=front.configured_node_names(),
+    ))
+    assert r.node_names, (tag, r.failed_nodes)
+    bp, _state = front.get_status_pod(pod.uid)
+    confirmed = Pod(
+        name=bp.name, namespace=bp.namespace, uid=bp.uid,
+        annotations=dict(bp.annotations), node_name=bp.node_name,
+        phase="Running", resource_limits=dict(bp.resource_limits),
+    )
+    front.update_pod(pod, confirmed)
+    return confirmed, bp.node_name
+
+
+def _probe(front, fam, tag):
+    """One never-seen single-pod filter probe; the pod is deleted again
+    (mirror included) so probes don't accumulate capacity."""
+    pod = make_pod(
+        f"{tag}", f"u-{tag}", f"vc{fam}", 0, f"cc{fam}-chip", 1,
+        group={
+            "name": f"{tag}",
+            "members": [{"podNumber": 1, "leafCellNumber": 1}],
+        },
+    )
+    front.add_pod(pod)
+    r = front.filter_routine(ei.ExtenderArgs(
+        pod=pod, node_names=front.configured_node_names(),
+    ))
+    front.delete_pod(pod)
+    return pod, r
+
+
+# --------------------------------------------------------------------- #
+# 1+2+3. Real-process SIGKILL: detect, degrade, resurrect
+# --------------------------------------------------------------------- #
+
+
+@pytest.fixture(scope="module")
+def proc_front():
+    front = _front(transport="proc")
+    yield front
+    front.close()
+
+
+def test_sigkill_detect_degrade_resurrect(proc_front):
+    """The full supervision arc against a REAL worker process: SIGKILL
+    -> ShardWorkerError with exitcode/signal forensics -> degraded WAIT
+    with the shardDown certificate + metrics/HA attribution -> check_now
+    resurrection -> placements preserved, answers again."""
+    front = proc_front
+    placed, node = _bind_one(front, 0, "sk-keep")
+
+    os.kill(front.shards[0]._proc.pid, signal.SIGKILL)
+    front.shards[0]._proc.join(timeout=10)
+
+    # Heartbeat-path detection (no caller touched the dead pipe yet):
+    # the backend's liveness probe sees the dead process.
+    res = front.supervisor.check_now(resurrect=False)
+    assert res["detected"] == [0], res
+    assert front.supervisor.status(0) == supervisor_mod.STATUS_RESURRECTING
+
+    # Degraded admission: routed filter answers WAIT/shardDown.
+    waiting, r = _probe(front, 0, "sk-degraded")
+    assert not r.node_names
+    assert list(r.failed_nodes) == ["hivedscheduler-tpu"]
+    rec = front.decisions.lookup(waiting.uid)
+    assert rec["verdict"] == "wait"
+    assert rec["certificate"]["gate"] == "shardDown"
+    assert rec["certificate"]["vector"]["shard"] == 0
+
+    # A bind to the down shard is refused retriably (503), never a 500.
+    with pytest.raises(api.WebServerError) as exc:
+        front.bind_routine(ei.ExtenderBindingArgs(
+            pod_name=placed.name, pod_namespace=placed.namespace,
+            pod_uid=placed.uid, node=node,
+        ))
+    assert exc.value.code == 503
+
+    # The healthy shard still answers (surviving-shard availability).
+    _, r1 = _probe(front, 1, "sk-live")
+    assert r1.node_names
+
+    # Attribution on the merged surfaces.
+    m = front.get_metrics()
+    assert m["shardUp"] == {"0": 0, "1": 1}
+    assert 0 in m["shardsDown"]
+    assert m["shardDegradedWaitCount"] >= 1
+    ha = front.get_ha()
+    assert ha["shards"][0].get("unavailable") is True
+    sup = {s["shard"]: s for s in ha["supervision"]}
+    assert sup[0]["status"] == supervisor_mod.STATUS_RESURRECTING
+    assert sup[0]["lastExit"]["signal"] == "SIGKILL"
+    assert sup[0]["lastExit"]["exitcode"] == -signal.SIGKILL
+    # Inspect reads skip the down shard with attribution, never 500.
+    health = front.get_health()
+    assert health.get("shardsDown") == [0]
+
+    # Supervision lifecycle is journaled as `_shard` decision records.
+    verdicts = [
+        d["verdict"] for d in front.decisions.snapshot()
+        if d["pod"] == "_shard"
+    ]
+    assert "shard-failed" in verdicts
+
+    # Resurrection: respawn + mirror recovery; the shard answers again
+    # and the placement survived.
+    res = front.supervisor.check_now()
+    assert res["resurrected"] == [0], res
+    assert front.supervisor.status(0) == supervisor_mod.STATUS_UP
+    found = front.get_status_pod(placed.uid)
+    assert found is not None, "confirmed-bound pod lost in resurrection"
+    assert found[0].node_name == node
+    _, r2 = _probe(front, 0, "sk-after")
+    assert r2.node_names, r2.failed_nodes
+    m = front.get_metrics()
+    assert m["shardUp"] == {"0": 1, "1": 1}
+    assert m["shardRestartCount"] >= 1
+    assert "shardsDown" not in m or not m["shardsDown"]
+    verdicts = [
+        d["verdict"] for d in front.decisions.snapshot()
+        if d["pod"] == "_shard"
+    ]
+    assert "shard-resurrected" in verdicts
+    # Cleanup so later module tests see free capacity.
+    front.delete_pod(placed)
+
+
+def test_hang_trips_verb_deadline(proc_front):
+    """A wedged worker (parked in a debug sleep) trips the caller's
+    per-verb pipe deadline: the worker is SIGKILL'd, the call fails as
+    cause="hang", and the supervisor resurrects the shard."""
+    front = proc_front
+    backend = front.shards[1]
+    with pytest.raises(ShardWorkerError) as exc:
+        backend.call("__debug__", "sleep", 30, timeout=0.8)
+    assert exc.value.cause == "hang"
+    assert not backend.is_alive()
+    res = front.supervisor.check_now()
+    assert 1 in res["detected"] or 1 in res["resurrected"], res
+    assert front.supervisor.status(1) == supervisor_mod.STATUS_UP
+    _, r = _probe(front, 1, "hang-after")
+    assert r.node_names, r.failed_nodes
+
+
+def test_garbage_frame_fails_only_that_call(proc_front):
+    """Protocol robustness: a garbage reply frame fails exactly the
+    affected call with ShardFrameError — NOT a ShardWorkerError — the
+    worker stays alive, the next call answers, and the supervisor does
+    not resurrect over it."""
+    front = proc_front
+    backend = front.shards[0]
+    restarts_before = {
+        s["shard"]: s["restarts"] for s in front.supervisor.snapshot()
+    }
+    with pytest.raises(ShardFrameError):
+        backend.call("__debug__", "raw", b"\x93garbage-not-a-frame")
+    assert backend.is_alive()
+    # The stream is length-delimited: the next call is unaffected.
+    assert isinstance(backend.call("get_metrics"), dict)
+    assert front.supervisor.status(0) == supervisor_mod.STATUS_UP
+    assert {
+        s["shard"]: s["restarts"] for s in front.supervisor.snapshot()
+    } == restarts_before
+    # No stranded waiters: the pending table drained.
+    assert not backend._pending
+
+
+def test_close_idempotent_and_safe_after_death():
+    """close() contract: double close is a no-op; closing an already-
+    SIGKILL'd worker neither raises nor leaks the process; calls after
+    close fail as retriable ShardWorkerError (cause closed/died)."""
+    cfg = bench.build_concurrent_config(2, 4)
+    backend = ProcShardBackend(
+        cfg, 0, ("cc0-slice",), lambda m, a: None, True,
+        plan=[("cc0-slice",), ("cc1-slice",)],
+    )
+    assert backend.call("health_pending_count") == 0
+    backend.close()
+    backend.close()  # idempotent
+    assert not backend._proc.is_alive()
+    with pytest.raises(ShardWorkerError):
+        backend.call("get_metrics")
+
+    backend2 = ProcShardBackend(
+        cfg, 0, ("cc0-slice",), lambda m, a: None, True,
+        plan=[("cc0-slice",), ("cc1-slice",)],
+    )
+    assert backend2.call("health_pending_count") == 0
+    os.kill(backend2._proc.pid, signal.SIGKILL)
+    backend2._proc.join(timeout=10)
+    backend2.close()  # dead worker: still clean
+    backend2.close()
+    assert not backend2._proc.is_alive()
+    with pytest.raises(ShardWorkerError):
+        backend2.call("get_metrics")
+
+
+def test_pending_calls_fail_retriably_on_worker_death():
+    """In-flight semantics: a call parked inside the worker when it dies
+    fails with a RETRIABLE ShardWorkerError (never hangs, never a bare
+    pipe error)."""
+    cfg = bench.build_concurrent_config(2, 4)
+    backend = ProcShardBackend(
+        cfg, 0, ("cc0-slice",), lambda m, a: None, True,
+        plan=[("cc0-slice",), ("cc1-slice",)],
+    )
+    try:
+        errs = []
+
+        def parked():
+            try:
+                backend.call("__debug__", "sleep", 30, timeout=60)
+            except BaseException as e:  # noqa: BLE001
+                errs.append(e)
+
+        t = threading.Thread(target=parked)
+        t.start()
+        deadline = time.monotonic() + 5
+        while not backend._pending and time.monotonic() < deadline:
+            time.sleep(0.01)
+        os.kill(backend._proc.pid, signal.SIGKILL)
+        t.join(timeout=10)
+        assert not t.is_alive(), "in-flight call hung on worker death"
+        assert len(errs) == 1
+        assert isinstance(errs[0], ShardWorkerError)
+        assert errs[0].retriable
+        assert errs[0].method == "__debug__"
+    finally:
+        backend.close()
+
+
+# --------------------------------------------------------------------- #
+# 4. Circuit breaker + full recovery revival
+# --------------------------------------------------------------------- #
+
+
+def test_circuit_breaker_opens_then_full_recovery_revives():
+    front = _front(transport="local")
+    try:
+        sup = front.supervisor
+        orig_spawn = front._spawn_backend
+
+        def failing_spawn(sid, owned):
+            raise RuntimeError("spawn refused (test)")
+
+        front._spawn_backend = failing_spawn
+        front.shards[0].kill()
+        # Every attempt fails: after max_failures the breaker opens.
+        for _ in range(sup.max_failures + 1):
+            res = front.supervisor.check_now()
+        assert res["down"] == [0], res
+        assert sup.status(0) == supervisor_mod.STATUS_DOWN
+        verdicts = [
+            d["verdict"] for d in front.decisions.snapshot()
+            if d["pod"] == "_shard"
+        ]
+        assert "shard-retry" in verdicts and "shard-down" in verdicts
+
+        # Down shard: still degraded WAIT (fail-fast — no dead-pipe
+        # churn), never a 500; further passes stay down without churn.
+        pod, r = _probe(front, 0, "cb-down")
+        assert not r.node_names
+        rec = front.decisions.lookup(pod.uid)
+        assert rec["certificate"]["gate"] == "shardDown"
+        assert front.supervisor.check_now()["down"] == [0]
+        m = front.get_metrics()
+        assert m["shardUp"]["0"] == 0
+
+        # ensure_all_up (the recover() preamble) force-respawns and
+        # resets the breaker; full recovery replays the state back in.
+        front._spawn_backend = orig_spawn
+        nodes = [Node(name=n) for n in front.configured_node_names()]
+        front.recover(nodes, [], min_watermark=None)
+        assert sup.status(0) == supervisor_mod.STATUS_UP
+        _, r = _probe(front, 0, "cb-after")
+        assert r.node_names, r.failed_nodes
+    finally:
+        front.close()
+
+
+def test_resurrection_epoch_stamps_certificates():
+    """The degraded certificate's version vector carries the shard
+    EPOCH, which resurrection bumps — a cached certificate comparison
+    fails the moment the shard is back (PR-12 revalidation shape)."""
+    front = _front(transport="local")
+    try:
+        epoch0 = front.supervisor.epoch(0)
+        front.shards[0].kill()
+        pod, _ = _probe(front, 0, "ep-1")
+        rec = front.decisions.lookup(pod.uid)
+        assert rec["certificate"]["vector"]["shardEpoch"] == epoch0
+        assert front.supervisor.check_now()["resurrected"] == [0]
+        assert front.supervisor.epoch(0) == epoch0 + 1
+        snap = {s["shard"]: s for s in front.supervisor.snapshot()}
+        assert snap[0]["restarts"] == 1
+        assert snap[0]["lastExit"]["cause"] == "kill"
+    finally:
+        front.close()
+
+
+def test_heartbeat_thread_resurrects_without_a_caller():
+    """The production heartbeat (supervisor.start) detects and
+    resurrects a killed shard with NO caller touching the frontend —
+    liveness is not request-driven."""
+    front = _front(transport="local")
+    try:
+        assert front.supervisor.start(interval_s=0.05)
+        assert not front.supervisor.start(interval_s=0.05)  # one thread
+        front.shards[0].kill()
+        deadline = time.monotonic() + 5
+        while (
+            front.supervisor.status(0) != supervisor_mod.STATUS_UP
+            or front.supervisor.snapshot()[0]["restarts"] < 1
+        ) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        snap = front.supervisor.snapshot()[0]
+        assert snap["status"] == supervisor_mod.STATUS_UP, snap
+        assert snap["restarts"] >= 1, snap
+    finally:
+        front.close()
+        assert front.supervisor._thread is None  # close() stopped it
+
+
+def test_whatif_and_group_reads_degrade_with_attribution():
+    """Routed spec forecasts 503 retriably; aggregated reads skip the
+    down shard and say so (shardsDown) instead of failing."""
+    front = _front(transport="local")
+    try:
+        placed, _node = _bind_one(front, 0, "wd-keep")
+        front.shards[0].kill()
+        with pytest.raises(api.WebServerError) as exc:
+            front.whatif_routine({"spec": {
+                "name": "wf", "vc": "vc0", "leafType": "cc0-chip",
+                "pods": 1, "chips": 1, "priority": 0,
+            }})
+        assert exc.value.code == 503
+        # Routed group read on the down shard: 503, not 500.
+        with pytest.raises(api.WebServerError) as exc:
+            front.get_affinity_group("wd-keep")
+        assert exc.value.code == 503
+        # Aggregations answer with attribution.
+        assert front.get_health().get("shardsDown") == [0]
+        groups = front.get_all_affinity_groups()
+        assert isinstance(groups["items"], list)
+    finally:
+        front.close()
