@@ -37,6 +37,13 @@
 # hammers snapshot+delta recovery equivalence and the split-brain fence
 # specifically: hack/soak.sh --failover  (combines with --keep-decisions).
 #
+# Outage focus: --outage runs the weather-weighted chaos sweep (the
+# additive apiserver_weather event family: brownout/blackout windows,
+# write-behind journaling, post-heal drains + the convergence
+# differential vs a never-outage shadow) at HIVED_CHAOS_ROUNDS scale,
+# then the HIVED_BENCH_OUTAGE acceptance stage (432-host blackout
+# mid-load: zero 500s, degraded-filter p99 budget, measured drain —
+# doc/fault-model.md "Control-plane weather plane"): hack/soak.sh --outage
 # Supervision focus: --supervise runs the kill/hang-weighted supervise
 # chaos sweep (tests/chaos.py step_supervise: worker SIGKILLs and hangs
 # against REAL worker processes, degraded-admission asserts after every
@@ -120,6 +127,19 @@ if [[ "${1:-}" == "--supervise" ]]; then
     -q -p no:cacheprovider
   echo "supervision bench: SIGKILL mid-load at the 432-host proc fleet"
   exec env HIVED_BENCH_SUPERVISE=1 python bench.py "$@"
+fi
+
+if [[ "${1:-}" == "--outage" ]]; then
+  shift
+  export JAX_PLATFORMS=cpu
+  rounds="${HIVED_CHAOS_ROUNDS:-200}"
+  echo "weather soak: ${rounds} weather-weighted chaos schedules + differential"
+  HIVED_CHAOS_WEATHER_ROUNDS="${rounds}" python -m pytest \
+    "tests/test_chaos.py::test_chaos_weather_mix_sweep" \
+    "tests/test_chaos.py::test_weather_convergence_differential" \
+    -q -p no:cacheprovider
+  echo "outage bench: apiserver blackout mid-load at the 432-host fleet"
+  exec env HIVED_BENCH_OUTAGE=1 python bench.py "$@"
 fi
 
 if [[ "${1:-}" == "--audit" ]]; then

@@ -134,6 +134,17 @@ class Config:
     shard_max_resurrection_failures: int = 3
     shard_resurrection_backoff_seconds: float = 1.0
     shard_resurrection_backoff_cap_seconds: float = 30.0
+    # Control-plane weather plane (doc/fault-model.md "Control-plane
+    # weather plane"): the apiserver outage detector's sliding
+    # failure-rate window per verb class, the consecutive-failure count
+    # that escalates to blackout, the consecutive-success count that
+    # clears back, and the bound on the write-behind intent journal that
+    # absorbs durable writes during a blackout (overflow drops OLDEST,
+    # latest-wins per object key).
+    weather_window: int = 32
+    weather_blackout_after: int = 8
+    weather_clear_after: int = 3
+    intent_journal_capacity: int = 512
     physical_cluster: api.PhysicalClusterSpec = field(
         default_factory=api.PhysicalClusterSpec
     )
@@ -165,6 +176,10 @@ class Config:
         defrag_m = d.get("defragMaxMigrationsPerCycle")
         audit_t = d.get("auditIntervalTicks")
         fr_cap = d.get("flightRecorderCapacity")
+        wx_win = d.get("weatherWindow")
+        wx_black = d.get("weatherBlackoutAfter")
+        wx_clear = d.get("weatherClearAfter")
+        ij_cap = d.get("intentJournalCapacity")
         c = Config(
             kube_apiserver_address=d.get("kubeApiServerAddress"),
             kube_config_file_path=d.get("kubeConfigFilePath"),
@@ -217,6 +232,14 @@ class Config:
             ),
             shard_resurrection_backoff_cap_seconds=(
                 30.0 if sup_c is None else float(sup_c)
+            ),
+            weather_window=32 if wx_win is None else int(wx_win),
+            weather_blackout_after=(
+                8 if wx_black is None else int(wx_black)
+            ),
+            weather_clear_after=3 if wx_clear is None else int(wx_clear),
+            intent_journal_capacity=(
+                512 if ij_cap is None else int(ij_cap)
             ),
             physical_cluster=api.PhysicalClusterSpec.from_dict(
                 d.get("physicalCluster")

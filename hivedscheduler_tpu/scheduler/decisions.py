@@ -48,6 +48,10 @@ GATE_SHARD_DOWN = "shardDown"      # owning shard worker down/resurrecting
                                    # (frontend-journaled degraded-mode WAIT;
                                    # doc/fault-model.md "Shard supervision
                                    # plane")
+GATE_APISERVER_OUTAGE = "apiserverOutage"  # apiserver blackout: durable
+                                   # writes impossible, filter answers off
+                                   # the projection (doc/fault-model.md
+                                   # "Control-plane weather plane")
 # (Requests rejected before scheduling — unknown VC, SKU the VC lacks,
 # over-sized gang — surface as verdict "error", not a per-chain gate.)
 

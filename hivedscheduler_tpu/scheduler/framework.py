@@ -38,7 +38,8 @@ from . import health as health_mod
 from . import recorder as recorder_mod
 from . import snapshot as snapshot_mod
 from . import tracing
-from .decisions import DecisionJournal
+from . import weather as weather_mod
+from .decisions import GATE_APISERVER_OUTAGE, DecisionJournal
 from .defrag import DefragController
 from .locks import ChainShardedLock
 from .tracing import LatencyHistogram
@@ -204,6 +205,12 @@ class SchedulerMetrics:
         self.snapshot_persist_failure_count = 0
         self.snapshot_fallback_count = 0
         self.deposed_bind_refused_count = 0
+        # Control-plane weather plane (doc/fault-model.md): bind writes
+        # refused retriably because the apiserver is in blackout (the
+        # bind POST itself could not land), and filter verdicts answered
+        # as degraded WAITs off the projection during blackout.
+        self.outage_bind_refused_count = 0
+        self.outage_wait_count = 0
         # Framework-side phases (same accumulator/formatter as the core's
         # leaf-cell-search stats, so the merged "phases" payload is uniform).
         self.phase_stats = PhaseStats()
@@ -356,6 +363,14 @@ class SchedulerMetrics:
         with self._lock:
             self.deposed_bind_refused_count += 1
 
+    def observe_outage_bind_refused(self) -> None:
+        with self._lock:
+            self.outage_bind_refused_count += 1
+
+    def observe_outage_wait(self) -> None:
+        with self._lock:
+            self.outage_wait_count += 1
+
     def snapshot(self) -> Dict:
         with self._lock:
             lat = sorted(self.filter_latencies_s)
@@ -402,6 +417,8 @@ class SchedulerMetrics:
                 ),
                 "snapshotFallbackCount": self.snapshot_fallback_count,
                 "deposedBindRefusedCount": self.deposed_bind_refused_count,
+                "outageBindRefusedCount": self.outage_bind_refused_count,
+                "outageWaitCount": self.outage_wait_count,
                 "gangShrinkCount": self.gang_shrink_count,
                 "gangShrinkAbortCount": self.gang_shrink_abort_count,
                 "gangGrowCount": self.gang_grow_count,
@@ -711,6 +728,22 @@ class HivedScheduler:
         # the leader (single-scheduler deployments, tests, simulators).
         self.leadership = None
         self._deposed_flush_logged = False
+        # Control-plane weather plane (doc/fault-model.md "Control-plane
+        # weather plane"): the apiserver outage detector and the
+        # write-behind intent journal. RetryingKubeClient (scheduler.kube)
+        # wires itself to both when constructed with scheduler=self: it
+        # feeds every attempt outcome to the vane, journals durable writes
+        # that exhaust retries under blackout, and drains the journal from
+        # the mutator-exit flush once the weather clears and leadership is
+        # re-confirmed (_flush_side_effects).
+        self.weather_vane = weather_mod.WeatherVane(
+            window=getattr(config, "weather_window", 32),
+            blackout_after=getattr(config, "weather_blackout_after", 8),
+            clear_after=getattr(config, "weather_clear_after", 3),
+        )
+        self.intent_journal = weather_mod.IntentJournal(
+            capacity=getattr(config, "intent_journal_capacity", 512)
+        )
         # Shadow what-if plane (scheduler.whatif): constructed lazily by
         # the first whatif_routine call (or by the bench's sim sampler),
         # under _whatif_init_lock — two racing first POSTs on the
@@ -1056,6 +1089,16 @@ class HivedScheduler:
                     "deposed: dropping %d queued advisory kube writes (the "
                     "active leader owns the cluster state)", dropped,
                 )
+            # Intent-journal fence (doc/fault-model.md "Control-plane
+            # weather plane"): DISCARD journaled intents only on DEFINITE
+            # supersession — another holder observed on the lease. A
+            # leader merely unable to renew through a blackout keeps its
+            # journal: if its own identity is still on the lease when the
+            # weather clears, it resumes leadership warm and drains; if a
+            # standby took over meanwhile, the first post-heal election
+            # step observes the new holder and this branch discards.
+            if self._definitely_superseded():
+                self.intent_journal.discard_all()
             return
         self._deposed_flush_logged = False
         self._flush_annotation_clears()
@@ -1076,6 +1119,27 @@ class HivedScheduler:
             self._drain_resize_side_effects()
             self._flush_evictions()
         self._persist_doomed_ledger()
+        # Weather heal: replay journaled intents once the vane allows a
+        # drain (clear skies / read class proven clear) — leadership was
+        # just confirmed above. O(1) no-op while the journal is empty.
+        drain = getattr(self.kube_client, "maybe_drain", None)
+        if drain is not None and self.intent_journal.depth():
+            try:
+                drain()
+            except Exception as e:  # noqa: BLE001
+                common.log.warning("intent journal drain failed: %s", e)
+
+    def _definitely_superseded(self) -> bool:
+        """True only when the HA elector has OBSERVED another holder on
+        the lease — the discard-vs-keep pivot for the intent journal. A
+        lease merely unrenewable (apiserver unreachable) keeps the
+        journal for the own-lease warm-resumption path (scheduler.ha)."""
+        lead = self.leadership
+        if lead is None:
+            return False
+        holder = getattr(lead, "observed_holder", None)
+        identity = getattr(lead, "identity", None)
+        return bool(holder) and holder != identity
 
     def _flush_annotation_clears(self) -> None:
         with self._side_effect_lock:
@@ -3426,12 +3490,26 @@ class HivedScheduler:
             # thread). The status carries no pod_schedule_result —
             # nothing reads that field for WAITING pods.
             self._admit_unbound(pod)
-        if cert["suggested"] is not None and cert["suggested"] != (
-            self._suggested_token(args.node_names)
-        ):
+        if cert.get("gate") == GATE_APISERVER_OUTAGE:
+            # Weather certificate (gate + weather-epoch vector, no core
+            # version vector): servable while the sky is still black and
+            # the epoch unchanged — any transition (heal included) bumps
+            # the epoch, so the verdict self-invalidates.
+            if not self.weather_vane.certificate_current(cert):
+                self._wait_cache_drop(key)
+                return None
+        elif "suggested" not in cert:
+            # A vector-shaped certificate of an unknown gate (e.g. a
+            # shardDown cert that leaked across layers): never servable.
+            self._wait_cache_drop(key)
             return None
-        if not self.core.certificate_current(cert):
-            return None
+        else:
+            if cert["suggested"] is not None and cert["suggested"] != (
+                self._suggested_token(args.node_names)
+            ):
+                return None
+            if not self.core.certificate_current(cert):
+                return None
         wait_reason = entry["waitReason"]
         tr = self.tracer.trace("filter", pod=pod.key)
         rec = self.decisions.begin(
@@ -3456,6 +3534,60 @@ class HivedScheduler:
             time.sleep(self.config.waiting_pod_scheduling_block_ms / 1e3)
         return ei.ExtenderFilterResult(
             failed_nodes={constants.COMPONENT_NAME: wait_reason}
+        )
+
+    def _outage_wait(
+        self, args: ei.ExtenderArgs, leaf_types=None
+    ) -> Optional[ei.ExtenderFilterResult]:
+        """Blackout filter short-circuit: a pod that would need a NEW
+        placement waits with a weather-epoch certificate instead of
+        descending (no assume-bind whose bind write cannot land). The
+        certificate is stored in the negative-filter cache, so the
+        outage retry storm this verdict provokes costs one lock-free
+        vector compare per re-filter (_try_fast_wait). Returns None for
+        pods the full path must answer (BINDING/BOUND insists, unknown
+        pods under production admission)."""
+        pod = args.pod
+        status = self.pod_schedule_statuses.get(pod.uid)
+        if status is None:
+            if not self.auto_admit:
+                return None  # the admission check must reject it
+        elif status.pod_state != PodState.WAITING:
+            return None
+        if status is None:
+            self._admit_unbound(pod)
+        cert = self.weather_vane.certificate()
+        reason = (
+            "apiserver blackout (weather epoch "
+            f"{cert['vector']['weatherEpoch']}): new placements deferred "
+            "until the control plane heals"
+        )
+        key = self._spec_cache_key(
+            pod.annotations.get(
+                constants.ANNOTATION_POD_SCHEDULING_SPEC, ""
+            ),
+            leaf_types,
+        )
+        spec = None
+        if key and self.wait_cache_enabled:
+            try:
+                spec = extract_pod_scheduling_spec(pod)
+            except api.WebServerError:
+                spec = None
+            if spec is not None:
+                self._wait_cache_store(key, spec, cert, reason)
+        rec = self.decisions.begin(pod.key, pod.uid, "filter")
+        rec.lock_chains = "apiserverOutage"
+        if spec is not None:
+            rec.set_spec(spec)
+        rec.note("degraded WAIT: apiserver blackout")
+        rec.verdict_wait(reason, certificate=cert)
+        self.decisions.commit(rec)
+        self.metrics.observe_outage_wait()
+        if self.config.waiting_pod_scheduling_block_ms > 0:
+            time.sleep(self.config.waiting_pod_scheduling_block_ms / 1e3)
+        return ei.ExtenderFilterResult(
+            failed_nodes={constants.COMPONENT_NAME: reason}
         )
 
     # ------------------------------------------------------------------ #
@@ -3537,6 +3669,19 @@ class HivedScheduler:
                     time.monotonic() - start, "wait", 0.0, None
                 )
                 return fast
+        if self.weather_vane.state() == weather_mod.BLACKOUT:
+            # Degraded serving (doc/fault-model.md "Control-plane weather
+            # plane"): pods needing a NEW placement defer with a
+            # weather-epoch WAIT certificate — assume-binding cells whose
+            # bind write cannot land would churn allocations for nothing.
+            # BINDING/BOUND pods fall through: the insist path answers
+            # off the projection without a durable write.
+            degraded = self._outage_wait(args, leaf_types)
+            if degraded is not None:
+                self.metrics.observe_filter(
+                    time.monotonic() - start, "wait", 0.0, None
+                )
+                return degraded
         # Observability plane: a (sampled) span trace for the whole verb,
         # and an (always-on) decision record begun inside the section —
         # where the acquired lock scope is known (doc/observability.md).
@@ -3864,6 +4009,21 @@ class HivedScheduler:
                 503,
                 "not the leader: bind refused (lease lost or standby); "
                 "the active leader will re-schedule this pod",
+            )
+        # Weather fence (doc/fault-model.md "Control-plane weather
+        # plane"): during an apiserver blackout the Binding POST cannot
+        # land — refuse RETRIABLY (503, apiserverOutage) before spending
+        # the full retry budget per bind. The allocation is kept: the
+        # next filter round insists on the same placement, and the
+        # default scheduler retries the bind after the weather clears.
+        if self.weather_vane.state() == weather_mod.BLACKOUT:
+            self.metrics.observe_outage_bind_refused()
+            raise api.WebServerError(
+                503,
+                "apiserverOutage: bind refused retriably (apiserver "
+                "blackout, weather epoch "
+                f"{self.weather_vane.epoch}); the placement is kept and "
+                "the bind will be retried after the weather clears",
             )
         tr = self.tracer.trace(
             "bind", pod=binding_pod.key, parent=trace_parent
@@ -4263,6 +4423,24 @@ class HivedScheduler:
         # overlays the live values (plus the per-shard shardUp gauge).
         snap["shardRestartCount"] = 0
         snap["shardDegradedWaitCount"] = 0
+        # shardDown fast waits are served by the sharded frontend's
+        # lock-free certificate cache; schema-stable zero here.
+        snap["shardDownFastWaitCount"] = 0
+        # Control-plane weather plane (doc/fault-model.md): the vane's
+        # numeric state (0 clear / 1 brownout / 2 blackout) + monotone
+        # epoch, and the intent journal's accounting (invariant:
+        # journaled == drained + superseded + dropped + discarded +
+        # depth).
+        snap["apiserverWeather"] = self.weather_vane.state()
+        snap["apiserverWeatherEpoch"] = self.weather_vane.epoch
+        jc = self.intent_journal.counters()
+        snap["intentJournalDepth"] = jc["depth"]
+        snap["intentJournaledCount"] = jc["journaled"]
+        snap["intentSupersededCount"] = jc["superseded"]
+        snap["intentCoalescedCount"] = jc["coalesced"]
+        snap["intentDrainedCount"] = jc["drained"]
+        snap["intentDroppedCount"] = jc["dropped"]
+        snap["intentDiscardedCount"] = jc["discarded"]
         # hived_build_info labels (rendered as a constant-1 gauge): the
         # deploy-identity facts an operator cross-checks first in any
         # incident — snapshot schema, config fingerprint prefix, shard
@@ -4312,12 +4490,29 @@ class HivedScheduler:
                 "deltaPodCount": self._snapshot_delta_count,
                 "flusherRunning": self._flusher_thread is not None,
             },
+            # Control-plane weather plane: the vane's classification and
+            # the intent journal's live accounting.
+            "weather": self.weather_vane.snapshot(),
+            "intentJournal": self.intent_journal.counters(),
         }
         if lead is not None:
             payload["identity"] = getattr(lead, "identity", "")
             payload["observedHolder"] = getattr(lead, "observed_holder", "")
             payload["leaseTransitions"] = getattr(
                 lead, "transition_count", 0
+            )
+            # Lease weather semantics (scheduler.ha): cannot-renew
+            # (apiserver unreachable) vs superseded (another holder), and
+            # warm own-lease resumptions that skipped cold takeover.
+            payload["leaseWeather"] = getattr(lead, "lease_weather", "ok")
+            payload["cannotRenewCount"] = getattr(
+                lead, "cannot_renew_count", 0
+            )
+            payload["supersededCount"] = getattr(
+                lead, "superseded_count", 0
+            )
+            payload["ownReacquireCount"] = getattr(
+                lead, "own_reacquire_count", 0
             )
         return payload
 

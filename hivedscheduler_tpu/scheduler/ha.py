@@ -75,6 +75,21 @@ class LeaderElector:
         # Times leadership changed hands TO this elector (mirrors the
         # Lease's leaseTransitions for this participant's acquisitions).
         self.transition_count = 0
+        # Lease weather semantics (doc/fault-model.md "Control-plane
+        # weather plane"): the last step's verdict about WHY leadership
+        # is (or is not) progressing — "ok", "unreachable" (cannot renew:
+        # the apiserver did not answer; leadership decays by local expiry
+        # only), or "superseded" (another holder observed: definite
+        # deposition — the intent-journal discard fence keys on this
+        # distinction, framework._definitely_superseded).
+        self.lease_weather = "ok"
+        self.cannot_renew_count = 0
+        self.superseded_count = 0
+        # Warm resumptions: renew succeeded with OUR identity still on
+        # the lease after a local expiry — leadership resumes without the
+        # cold-takeover recovery (StandbyLoop consumes the flag).
+        self.own_reacquire_count = 0
+        self._own_resumption = False
 
     # ---------------- the protocol step ---------------- #
 
@@ -91,6 +106,8 @@ class LeaderElector:
         try:
             cur = self.client.read_lease()
         except Exception as e:  # noqa: BLE001
+            self.lease_weather = "unreachable"
+            self.cannot_renew_count += 1
             common.log.warning(
                 "leader lease read failed (leadership unchanged until "
                 "local expiry): %s", e,
@@ -115,10 +132,16 @@ class LeaderElector:
             # the leader, we have been superseded (e.g. clock trouble) —
             # depose immediately rather than waiting for local expiry.
             if self._held_until is not None:
+                # Definite supersession (vs a plain standby beat, which
+                # is healthy "ok" weather: the apiserver answered).
+                self.lease_weather = "superseded"
+                self.superseded_count += 1
                 common.log.warning(
                     "leader lease now held by %s; deposing", holder,
                 )
                 self._held_until = None
+            else:
+                self.lease_weather = "ok"
             return False
         transitions = int(spec.get("leaseTransitions") or 0)
         acquiring = holder != self.identity
@@ -131,6 +154,19 @@ class LeaderElector:
             "renewTime": now,
             "leaseTransitions": transitions + (1 if acquiring else 0),
         }
+        # Was our leadership LOCALLY expired going into this step? (A
+        # stale _held_until float, not None — None means never-held or
+        # definitively deposed.) If the write below lands while our own
+        # identity is still on the lease, this is a warm resumption: no
+        # standby can have acquired in between (the optimistic
+        # resourceVersion precondition would have failed us), so the
+        # in-memory projection is still the cluster truth and the
+        # cold-takeover recovery is unnecessary.
+        resuming_own = (
+            self._held_until is not None
+            and now >= self._held_until
+            and not acquiring
+        )
         try:
             self.client.write_lease(
                 new_spec, resource_version=resource_version
@@ -139,20 +175,40 @@ class LeaderElector:
             # Lost the optimistic write (another standby won) or transport
             # trouble: keep whatever leadership the last successful
             # renewal bought — it self-expires.
+            self.lease_weather = "unreachable"
+            self.cannot_renew_count += 1
             common.log.warning(
                 "leader lease write failed (leadership unchanged until "
                 "local expiry): %s", e,
             )
             return self.is_leader()
+        self.lease_weather = "ok"
         if self._held_until is None:
             self.transition_count += 1
             common.log.warning(
                 "acquired leader lease as %s (transitions=%d)",
                 self.identity, new_spec["leaseTransitions"],
             )
+        elif resuming_own:
+            self.own_reacquire_count += 1
+            self._own_resumption = True
+            common.log.warning(
+                "re-acquired own leader lease as %s after local expiry "
+                "(warm resumption, no cold takeover)", self.identity,
+            )
         self._held_until = now + self.duration_s
         self.observed_holder = self.identity
         return True
+
+    def consume_own_resumption(self) -> bool:
+        """Return-and-clear the warm-resumption flag. StandbyLoop calls
+        this on every not-leading→leading edge: True means the leadership
+        gap was OUR lease all along (local expiry, nobody else acquired),
+        so the cold-takeover recovery callback must be skipped — the
+        in-memory projection never stopped being the cluster truth."""
+        flag = self._own_resumption
+        self._own_resumption = False
+        return flag
 
     def step_down(self) -> None:
         """Voluntarily release leadership (graceful shutdown): zero the
@@ -227,7 +283,20 @@ class StandbyLoop:
         leading = self.elector.try_acquire_or_renew()
         if leading and not self.was_leading:
             self.was_leading = True
-            self.on_started_leading()
+            consume = getattr(
+                self.elector, "consume_own_resumption", None
+            )
+            if consume is not None and consume():
+                # Own-lease warm resumption: the apiserver blackout
+                # outlasted the lease locally, but our identity was still
+                # on the Lease when it healed — nobody else led in
+                # between, so the projection is intact and the cold
+                # recovery (snapshot + replay) is skipped.
+                common.log.warning(
+                    "resuming own leadership warm (no cold takeover)",
+                )
+            else:
+                self.on_started_leading()
         elif not leading:
             if self.was_leading:
                 self.was_leading = False

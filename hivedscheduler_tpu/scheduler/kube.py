@@ -26,6 +26,15 @@ from .. import common
 from ..api import constants, extender as ei
 from .framework import HivedScheduler, KubeClient, SchedulerMetrics
 from .types import Node, Pod, is_interested
+from .weather import (
+    BLACKOUT,
+    INTENT_EVICT,
+    INTENT_LEDGER,
+    INTENT_PATCH,
+    INTENT_SNAPSHOT,
+    IntentJournal,
+    WeatherVane,
+)
 
 SA_TOKEN_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/token"  # noqa: S105
 SA_CA_PATH = "/var/run/secrets/kubernetes.io/serviceaccount/ca.crt"
@@ -127,6 +136,19 @@ class RetryingKubeClient(KubeClient):
 
     ``sleep`` and ``jitter_rng`` are injectable so the chaos harness can run
     the real retry loop deterministically and without wall-clock delays.
+
+    Weather plane (doc/fault-model.md "Control-plane weather plane"): every
+    attempt outcome feeds the scheduler's :class:`~.weather.WeatherVane`
+    (reads and writes classified separately), and when a DURABLE write —
+    doomed ledger, snapshot family, preempt-checkpoint annotation patch,
+    eviction — exhausts its retry budget while the vane reads BLACKOUT,
+    the intent is coalesced into the :class:`~.weather.IntentJournal` and
+    the call *returns success*: the caller-side watermarks advance exactly
+    as under clear skies, and :meth:`maybe_drain` replays the journal
+    after the weather clears and leadership is re-confirmed. ``vane`` /
+    ``journal`` default to the scheduler's own (pass ``False`` to disable
+    explicitly — the chaos harness's non-weather schedules do, keeping
+    their pinned seeds byte-stable).
     """
 
     MAX_ATTEMPTS = 5
@@ -144,6 +166,8 @@ class RetryingKubeClient(KubeClient):
         backoff_max_s: float = BACKOFF_MAX_S,
         sleep: Callable[[float], None] = time.sleep,
         jitter_rng: Optional[random.Random] = None,
+        vane=None,
+        journal=None,
     ) -> None:
         self.inner = inner
         self.scheduler = scheduler
@@ -153,12 +177,25 @@ class RetryingKubeClient(KubeClient):
         self.backoff_max_s = backoff_max_s
         self._sleep = sleep
         self._rng = jitter_rng or random.Random()
+        self.vane: Optional[WeatherVane] = (
+            None if vane is False
+            else (vane or getattr(scheduler, "weather_vane", None))
+        )
+        self.journal: Optional[IntentJournal] = (
+            None if journal is False
+            else (journal or getattr(scheduler, "intent_journal", None))
+        )
+
+    def _note_weather(self, cls: str, ok: bool) -> None:
+        if self.vane is not None:
+            self.vane.record(cls, ok)
 
     def bind_pod(self, binding_pod: Pod) -> None:
         backoff = self.backoff_initial_s
         for attempt in range(1, self.max_attempts + 1):
             try:
                 self.inner.bind_pod(binding_pod)
+                self._note_weather("write", True)
                 if attempt > 1:
                     common.log.info(
                         "[%s]: bind succeeded on attempt %d",
@@ -166,6 +203,9 @@ class RetryingKubeClient(KubeClient):
                     )
                 return
             except Exception as e:  # noqa: BLE001
+                # Weather-wise a terminal verdict (404/409) is a SUCCESS:
+                # the apiserver answered and decided.
+                self._note_weather("write", not is_retryable_kube_error(e))
                 if is_already_bound_conflict(e, binding_pod.node_name):
                     # Duplicate bind of an already-bound pod (idempotent
                     # retry / force-bind race): the desired state holds.
@@ -237,18 +277,23 @@ class RetryingKubeClient(KubeClient):
             return None
         return delay
 
-    def _retrying_op(self, describe: str, attempt_fn: Callable):
+    def _retrying_op(self, describe: str, attempt_fn: Callable, cls="write"):
         """The bind retry policy for the auxiliary kube operations
         (annotation patches, scheduler-state ConfigMap reads/writes):
         transient errors back off and retry, terminal errors raise
         immediately, and an armed request deadline caps the total budget.
-        Returns attempt_fn()'s value."""
+        Returns attempt_fn()'s value. Every attempt outcome feeds the
+        weather vane under ``cls`` ("read" / "write")."""
         backoff = self.backoff_initial_s
         for attempt in range(1, self.max_attempts + 1):
             try:
-                return attempt_fn()
+                result = attempt_fn()
+                self._note_weather(cls, True)
+                return result
             except Exception as e:  # noqa: BLE001
-                if not is_retryable_kube_error(e) or attempt == self.max_attempts:
+                retryable = is_retryable_kube_error(e)
+                self._note_weather(cls, not retryable)
+                if not retryable or attempt == self.max_attempts:
                     raise
                 delay = self._next_retry_delay(backoff, describe, e)
                 if delay is None:
@@ -261,38 +306,72 @@ class RetryingKubeClient(KubeClient):
                 self._sleep(delay)
                 backoff = min(backoff * 2, self.backoff_max_s)
 
+    def _durable_op(
+        self, describe: str, attempt_fn: Callable, kind: str, key: str,
+        payload,
+    ) -> None:
+        """A durable write with the write-behind fallback: on an exhausted
+        RETRYABLE failure while the weather vane reads blackout, the
+        intent is journaled latest-wins and the call returns success —
+        the caller's watermarks advance as under clear skies, and the
+        journal drains after the weather heals (maybe_drain). Terminal
+        errors, and exhaustion outside a blackout, raise exactly as
+        before (PR 2 semantics)."""
+        try:
+            self._retrying_op(describe, attempt_fn)
+        except Exception as e:  # noqa: BLE001
+            if (
+                self.journal is None
+                or self.vane is None
+                or not is_retryable_kube_error(e)
+                or self.vane.state() != BLACKOUT
+            ):
+                raise
+            self.journal.put(kind, key, payload)
+            common.log.warning(
+                "%s: retry budget exhausted under apiserver blackout; "
+                "intent journaled as %r (depth %d): %s",
+                describe, key, self.journal.depth(), e,
+            )
+
     def patch_pod_annotations(self, pod, annotations) -> None:
-        self._retrying_op(
+        self._durable_op(
             f"[{pod.key}]: annotation patch",
             lambda: self.inner.patch_pod_annotations(pod, annotations),
+            INTENT_PATCH, f"patch:{pod.uid}", (pod, dict(annotations)),
         )
 
     def persist_scheduler_state(self, payload: str) -> None:
-        self._retrying_op(
+        self._durable_op(
             "scheduler-state ConfigMap write",
             lambda: self.inner.persist_scheduler_state(payload),
+            INTENT_LEDGER, "ledger", payload,
         )
 
     def load_scheduler_state(self) -> Optional[str]:
         # Reads share the retry policy; a missing ConfigMap is None, not an
         # error (first boot).
         return self._retrying_op(
-            "scheduler-state ConfigMap read", self.inner.load_scheduler_state
+            "scheduler-state ConfigMap read", self.inner.load_scheduler_state,
+            cls="read",
         )
 
     def persist_snapshot(self, chunks) -> None:
-        self._retrying_op(
+        self._durable_op(
             "snapshot ConfigMap write",
             lambda: self.inner.persist_snapshot(chunks),
+            INTENT_SNAPSHOT, "snapshot", list(chunks),
         )
 
     def load_snapshot(self):
         return self._retrying_op(
-            "snapshot ConfigMap read", self.inner.load_snapshot
+            "snapshot ConfigMap read", self.inner.load_snapshot, cls="read"
         )
 
     def read_lease(self):
-        return self._retrying_op("leader Lease read", self.inner.read_lease)
+        return self._retrying_op(
+            "leader Lease read", self.inner.read_lease, cls="read"
+        )
 
     def write_lease(self, spec, resource_version=None) -> None:
         # A 409 (another participant won the optimistic write) is
@@ -307,17 +386,99 @@ class RetryingKubeClient(KubeClient):
         )
 
     def evict_pod(self, pod: Pod) -> None:
+        def attempt() -> None:
+            try:
+                self.inner.evict_pod(pod)
+            except KubeAPIError as e:
+                if e.status == 404:
+                    # Already gone (deleted by a prior eviction round or
+                    # by its owner): the desired state holds — eviction
+                    # is idempotent.
+                    return
+                raise
+
+        self._durable_op(
+            f"[{pod.key}]: stranded-gang eviction", attempt,
+            INTENT_EVICT, f"evict:{pod.uid}", pod,
+        )
+
+    # ------------- weather plane: probe + journal drain ------------- #
+
+    def weather_probe(self) -> int:
+        """One explicit read probe (the leader Lease — tiny, always
+        present once HA is armed) feeding the vane's read class, so an
+        idle blackout still heals without waiting for organic traffic.
+        Returns the vane's overall state after the probe."""
         try:
-            self._retrying_op(
-                f"[{pod.key}]: stranded-gang eviction",
-                lambda: self.inner.evict_pod(pod),
+            self.read_lease()
+        except Exception:  # noqa: BLE001 — the probe IS the error feed
+            pass
+        return self.vane.state() if self.vane is not None else BLACKOUT
+
+    def maybe_drain(self) -> int:
+        """Drain the intent journal if (a) it has entries, (b) the vane
+        allows a drain attempt (clear skies, or the read class proven
+        clear — the first drained write is then the write-class probe),
+        and (c) the scheduler still holds leadership (a deposed leader
+        never drains; the superseded fence discards instead —
+        framework._flush_side_effects). Returns the number drained."""
+        journal = self.journal
+        if journal is None or journal.depth() == 0:
+            return 0
+        if self.vane is not None and not self.vane.drain_ok():
+            return 0
+        if self.scheduler is not None and not self.scheduler.is_leader():
+            return 0
+        drained = journal.drain(self._dispatch_intent)
+        if drained:
+            common.log.warning(
+                "intent journal drained %d intents (%d left)",
+                drained, journal.depth(),
             )
-        except KubeAPIError as e:
-            if e.status == 404:
-                # Already gone (deleted by a prior eviction round or by its
-                # owner): the desired state holds — eviction is idempotent.
-                return
-            raise
+        return drained
+
+    def _dispatch_intent(self, kind: str, payload) -> None:
+        """Replay one journaled intent against the live apiserver (full
+        retry policy, NO write-behind fallback: a failure here raises to
+        journal.drain, which restores the entry and stops)."""
+        if kind == INTENT_LEDGER:
+            self._retrying_op(
+                "intent drain: scheduler-state ConfigMap write",
+                lambda: self.inner.persist_scheduler_state(payload),
+            )
+        elif kind == INTENT_SNAPSHOT:
+            self._retrying_op(
+                "intent drain: snapshot ConfigMap write",
+                lambda: self.inner.persist_snapshot(payload),
+            )
+        elif kind == INTENT_PATCH:
+            pod, annotations = payload
+
+            def attempt_patch() -> None:
+                try:
+                    self.inner.patch_pod_annotations(pod, annotations)
+                except KubeAPIError as e:
+                    if e.status != 404:
+                        raise  # pod gone while journaled: patch is moot
+
+            self._retrying_op(
+                f"intent drain: [{pod.key}] annotation patch", attempt_patch
+            )
+        elif kind == INTENT_EVICT:
+            pod = payload
+
+            def attempt_evict() -> None:
+                try:
+                    self.inner.evict_pod(pod)
+                except KubeAPIError as e:
+                    if e.status != 404:
+                        raise
+
+            self._retrying_op(
+                f"intent drain: [{pod.key}] eviction", attempt_evict
+            )
+        else:
+            common.log.error("unknown journaled intent kind %r", kind)
 
 
 class KubeAPIClient(KubeClient):

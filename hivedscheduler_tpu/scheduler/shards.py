@@ -1975,6 +1975,32 @@ class ShardedScheduler:
         from . import supervisor as supervisor_mod
 
         self.supervisor = supervisor_mod.ShardSupervisor(self)
+        # shardDown fast-WAIT cache (ISSUE 18 satellite: PR-17 degraded
+        # verdicts fed through the PR-12 negative-cache idea): pod uid ->
+        # (shard, shardEpoch, reason). While the owning shard stays down
+        # at the same epoch, a pod's re-filter storm is answered by one
+        # lock-free dict probe + epoch compare instead of a decision-
+        # journal write per re-filter; resurrection's epoch bump
+        # self-invalidates every entry. Routed verdicts only — a sweep
+        # WAIT also depends on the OTHER shards' capacity, which the
+        # (shard, epoch) vector does not cover.
+        self._down_wait_cache: Dict[str, Tuple[int, int, str]] = {}
+        self._shard_down_fast_waits = 0
+        # Control-plane weather plane (doc/fault-model.md): every
+        # shard's durable write is brokered through the PARENT's kube
+        # client, so the outage detector and the write-behind intent
+        # journal live here — __main__'s RetryingKubeClient swap-in
+        # inherits both via its scheduler backref (kube.py).
+        from . import weather as weather_mod
+
+        self.weather_vane = weather_mod.WeatherVane(
+            window=getattr(config, "weather_window", 32),
+            blackout_after=getattr(config, "weather_blackout_after", 8),
+            clear_after=getattr(config, "weather_clear_after", 3),
+        )
+        self.intent_journal = weather_mod.IntentJournal(
+            capacity=getattr(config, "intent_journal_capacity", 512)
+        )
 
     def _spawn_backend(self, sid: int, owned: Tuple[str, ...]):
         """Build one shard backend (both transports) — used at boot and
@@ -2026,7 +2052,7 @@ class ShardedScheduler:
             return default
 
     def _degraded_wait(self, sid: int, pod_key: str,
-                       pod_uid: str) -> str:
+                       pod_uid: str, cacheable: bool = True) -> str:
         """Account + journal one degraded-mode WAIT: the pod's owning
         shard is under supervision, so the verdict is WAIT with a
         ``shardDown`` rejection certificate (PR-12 shape: gate + the
@@ -2041,6 +2067,12 @@ class ShardedScheduler:
             f"shard {sid} is {status} (worker under supervision; "
             "retriable)"
         )
+        if cacheable:
+            if len(self._down_wait_cache) > 16384:
+                self._down_wait_cache.clear()
+            self._down_wait_cache[pod_uid] = (
+                sid, self.supervisor.epoch(sid), reason
+            )
         try:
             rec = self.decisions.begin(pod_key, pod_uid, "filter")
             rec.verdict_wait(reason, certificate={
@@ -2075,6 +2107,13 @@ class ShardedScheduler:
         leadership fence (the shards themselves are always-leader; HA is
         a parent concern — one lease for the whole shard group)."""
         if not self.is_leader():
+            # A DEFINITELY superseded frontend (another holder observed
+            # on the Lease, not just a local-expiry blackout) must never
+            # drain its journaled intents — the new leader owns the
+            # durable state now (same fence as the in-process
+            # framework._flush_side_effects).
+            if self._definitely_superseded():
+                self.intent_journal.discard_all()
             if method == "bind_pod":
                 self._deposed_bind_refused += 1
                 raise api.WebServerError(
@@ -2094,7 +2133,17 @@ class ShardedScheduler:
                 )
             return None
         self._deposed_drop_logged = False
-        return getattr(self.kube_client, method)(*args)
+        result = getattr(self.kube_client, method)(*args)
+        # Weather plane: a successful leader-fenced write is the healed
+        # signal — give the intent journal a drain opportunity (no-op in
+        # one dict-len check when the journal is empty).
+        drain = getattr(self.kube_client, "maybe_drain", None)
+        if drain is not None:
+            try:
+                drain()
+            except Exception as e:  # noqa: BLE001
+                common.log.warning("intent journal drain failed: %s", e)
+        return result
 
     # -- routing ------------------------------------------------------ #
 
@@ -2203,6 +2252,23 @@ class ShardedScheduler:
         self, args: ei.ExtenderArgs, tr, parent
     ) -> ei.ExtenderFilterResult:
         pod = args.pod
+        hit = self._down_wait_cache.get(pod.uid)
+        if hit is not None:
+            dsid, depoch, dreason = hit
+            if (not self.supervisor.is_up(dsid)
+                    and self.supervisor.epoch(dsid) == depoch):
+                # Fast degraded WAIT: the owning shard is still down at
+                # the epoch the cached verdict read — same answer, no
+                # journal write, no supervisor accounting churn.
+                self._shard_down_fast_waits += 1
+                tr.finish(
+                    outcome="wait", shard=dsid, degraded=True,
+                    cached=True,
+                )
+                return ei.ExtenderFilterResult(
+                    failed_nodes={constants.COMPONENT_NAME: dreason}
+                )
+            self._down_wait_cache.pop(pod.uid, None)
         sid = self._route(pod)
         if sid is not None:
             try:
@@ -2250,7 +2316,9 @@ class ShardedScheduler:
                 tr.finish(outcome=_frontend_outcome(result), shard=sid)
                 return result
         if skipped is not None:
-            reason = self._degraded_wait(skipped, pod.key, pod.uid)
+            reason = self._degraded_wait(
+                skipped, pod.key, pod.uid, cacheable=False
+            )
             tr.finish(outcome="wait", sweep=True, degraded=True)
             return ei.ExtenderFilterResult(
                 failed_nodes={constants.COMPONENT_NAME: reason}
@@ -2338,6 +2406,21 @@ class ShardedScheduler:
         ))
         node = str((pod_d.get("spec") or {}).get("nodeName", "") or "")
         uid = str(md.get("uid", "") or "")
+        hit = self._down_wait_cache.get(uid)
+        if hit is not None:
+            dsid, depoch, dreason = hit
+            if (not self.supervisor.is_up(dsid)
+                    and self.supervisor.epoch(dsid) == depoch):
+                self._shard_down_fast_waits += 1
+                tr.finish(
+                    pod=uid, shard=dsid, degraded=True, cached=True
+                )
+                return json.dumps(
+                    ei.ExtenderFilterResult(failed_nodes={
+                        constants.COMPONENT_NAME: dreason
+                    }).to_dict()
+                ).encode(), "wait", ""
+            self._down_wait_cache.pop(uid, None)
         cached = self._route_cache.get((ann, node))
         if cached is not None:
             sid, gname = cached
@@ -2474,7 +2557,7 @@ class ShardedScheduler:
         if skipped is not None:
             reason = self._degraded_wait(
                 skipped, f"{md.get('namespace', '')}/"
-                f"{md.get('name', '')}", uid,
+                f"{md.get('name', '')}", uid, cacheable=False,
             )
             tr.finish(pod=uid, sweep=True, degraded=True)
             return json.dumps(
@@ -3230,6 +3313,19 @@ class ShardedScheduler:
         lead = self.leadership
         return lead is None or lead.is_leader()
 
+    def _definitely_superseded(self) -> bool:
+        """True only when another identity has been OBSERVED holding the
+        Lease — the discard fence for the intent journal. A leader that
+        merely cannot renew (apiserver unreachable, local expiry) keeps
+        its journal for the own-lease warm-resumption path."""
+        lead = self.leadership
+        if lead is None:
+            return False
+        holder = str(getattr(lead, "observed_holder", "") or "")
+        return bool(holder) and holder != str(
+            getattr(lead, "identity", "")
+        )
+
     @property
     def kube_client(self) -> KubeClient:
         return self._kube_client
@@ -3378,6 +3474,24 @@ class ShardedScheduler:
             merged.get("deposedBindRefusedCount", 0)
             + self._deposed_bind_refused
         )
+        merged["shardDownFastWaitCount"] = (
+            merged.get("shardDownFastWaitCount", 0)
+            + self._shard_down_fast_waits
+        )
+        # Control-plane weather plane: the vane and intent journal live
+        # on the FRONTEND (shard writes are brokered through the parent
+        # kube client) — overlay the (all-zero) summed shard-side
+        # values with the frontend truth.
+        merged["apiserverWeather"] = self.weather_vane.state()
+        merged["apiserverWeatherEpoch"] = self.weather_vane.epoch
+        jc = self.intent_journal.counters()
+        merged["intentJournalDepth"] = jc["depth"]
+        merged["intentJournaledCount"] = jc["journaled"]
+        merged["intentSupersededCount"] = jc["superseded"]
+        merged["intentCoalescedCount"] = jc["coalesced"]
+        merged["intentDrainedCount"] = jc["drained"]
+        merged["intentDroppedCount"] = jc["dropped"]
+        merged["intentDiscardedCount"] = jc["discarded"]
         # Supervision plane (doc/observability.md): per-shard liveness
         # gauge + the restart / degraded-WAIT counters, plus explicit
         # attribution of which shards the gather above skipped.
@@ -3641,11 +3755,23 @@ class ShardedScheduler:
             # last exit cause (ISSUE 17 observability satellite).
             "supervision": self.supervisor.snapshot(),
         }
+        payload["weather"] = self.weather_vane.snapshot()
+        payload["intentJournal"] = self.intent_journal.counters()
         if lead is not None:
             payload["identity"] = getattr(lead, "identity", "")
             payload["observedHolder"] = getattr(lead, "observed_holder", "")
             payload["leaseTransitions"] = getattr(
                 lead, "transition_count", 0
+            )
+            payload["leaseWeather"] = getattr(lead, "lease_weather", "ok")
+            payload["cannotRenewCount"] = getattr(
+                lead, "cannot_renew_count", 0
+            )
+            payload["supersededCount"] = getattr(
+                lead, "superseded_count", 0
+            )
+            payload["ownReacquireCount"] = getattr(
+                lead, "own_reacquire_count", 0
             )
         return payload
 

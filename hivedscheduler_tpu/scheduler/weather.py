@@ -1,0 +1,355 @@
+"""Control-plane weather: apiserver outage detection + write-behind intents.
+
+The reference HiveD assumes a healthy apiserver; our retry plane (PR 2)
+absorbs transient blips and the HA plane (PR 7) fences split-brain, but a
+*sustained* apiserver outage used to silently drop durable writes — the
+doomed-ledger flush, snapshot persists, preempt-checkpoint annotation
+patches, and evictions all counted a failure and moved on, so the next
+crash recovered from state the continuous timeline never had. This module
+is the weather plane (doc/fault-model.md "Control-plane weather plane"):
+
+- :class:`WeatherVane` classifies the KubeClient's per-attempt outcome
+  stream into ``clear`` / ``brownout`` / ``blackout`` with hysteresis.
+  Reads and writes are tracked separately (an apiserver can serve cached
+  reads while etcd rejects writes); the overall state is the worse of the
+  two, and every overall transition bumps a **monotone epoch** — the
+  version the weather WAIT certificates carry, so the PR 12 negative-
+  filter cache answers an outage retry storm with one vector compare.
+
+- :class:`IntentJournal` is the write-behind half: when a durable write
+  exhausts its retry budget under bad weather, RetryingKubeClient
+  (scheduler.kube) coalesces the *intent* — latest-wins per object key —
+  into this bounded journal instead of dropping it, and reports success
+  to the caller. The caller-visible world (persisted-epoch watermarks,
+  eviction records, shrink commits) therefore advances exactly as it
+  would under clear skies, which is what makes the post-drain durable
+  state provably byte-equal to a never-outage run (the chaos convergence
+  differential, tests/chaos.py). The journal drains in sequence order
+  once the weather clears AND leadership is re-confirmed; a *superseded*
+  leader discards — never drains — preserving the PR 7 fencing argument.
+
+Both classes are self-contained (no framework import) so kube.py, the
+chaos harness, and unit tests can use them without the scheduler.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .. import common
+from .decisions import GATE_APISERVER_OUTAGE
+
+# Weather states, ordered by severity — the numeric values are exported
+# as-is (hived_apiserver_weather), so they are part of the metric schema.
+CLEAR = 0
+BROWNOUT = 1
+BLACKOUT = 2
+
+STATE_NAMES = {CLEAR: "clear", BROWNOUT: "brownout", BLACKOUT: "blackout"}
+
+# Intent kinds (one per durable-write verb the journal covers).
+INTENT_LEDGER = "ledger"      # doomed-ledger ConfigMap payload
+INTENT_SNAPSHOT = "snapshot"  # snapshot ConfigMap chunk family
+INTENT_PATCH = "patch"        # pod annotation merge-patch (preempt ckpt)
+INTENT_EVICT = "evict"        # pod delete (stranded-gang eviction)
+
+
+class _ClassTrack:
+    """Failure tracking for one operation class ("read" / "write")."""
+
+    __slots__ = (
+        "window", "consecutive_failures", "consecutive_successes",
+        "severity",
+    )
+
+    def __init__(self, window: int) -> None:
+        self.window: deque = deque(maxlen=max(4, window))
+        self.consecutive_failures = 0
+        self.consecutive_successes = 0
+        self.severity = CLEAR
+
+
+class WeatherVane:
+    """Hysteretic outage detector over the kube attempt stream.
+
+    Per class, severity moves by these rules (evaluated per sample):
+
+    - → ``clear`` after ``clear_after`` consecutive successes. The class
+      window resets on this transition — hysteresis: a brownout's stale
+      failure history must not re-trip the rate gate after the apiserver
+      has demonstrably recovered.
+    - → ``blackout`` after ``blackout_after`` consecutive failures
+      (total unreachability, from any prior state).
+    - ``clear`` → ``brownout`` when the sliding window's failure rate
+      reaches ``brownout_rate`` with at least ``brownout_min_samples``
+      samples, or after ``brownout_after`` consecutive failures
+      (fast-path for a sudden storm on a quiet window).
+    - ``blackout`` never decays to ``brownout``: recovery is only ever
+      proven by the success streak, not by failures aging out.
+
+    Overall state = max(read severity, write severity); every overall
+    transition increments :attr:`epoch` (monotone — certificates compare
+    it for staleness). Thread-safe; every method is O(1).
+    """
+
+    def __init__(
+        self,
+        window: int = 32,
+        brownout_rate: float = 0.5,
+        brownout_min_samples: int = 4,
+        brownout_after: int = 3,
+        blackout_after: int = 8,
+        clear_after: int = 3,
+    ) -> None:
+        self.brownout_rate = float(brownout_rate)
+        self.brownout_min_samples = int(brownout_min_samples)
+        self.brownout_after = int(brownout_after)
+        self.blackout_after = int(blackout_after)
+        self.clear_after = int(clear_after)
+        self._lock = threading.Lock()
+        self._classes: Dict[str, _ClassTrack] = {
+            "read": _ClassTrack(window),
+            "write": _ClassTrack(window),
+        }
+        self._state = CLEAR
+        self._epoch = 0
+        self.transition_count = 0
+
+    # ---------------- feeding ---------------- #
+
+    def record(self, cls: str, ok: bool) -> None:
+        """One apiserver attempt outcome. ``cls`` is "read" or "write";
+        ``ok`` means the apiserver *answered* — a 4xx verdict is weather-
+        wise a success (the control plane is reachable and deciding)."""
+        with self._lock:
+            track = self._classes.get(cls)
+            if track is None:
+                return
+            track.window.append(0 if ok else 1)
+            if ok:
+                track.consecutive_successes += 1
+                track.consecutive_failures = 0
+            else:
+                track.consecutive_failures += 1
+                track.consecutive_successes = 0
+            self._reclassify(track)
+            overall = max(t.severity for t in self._classes.values())
+            if overall != self._state:
+                prev = self._state
+                self._state = overall
+                self._epoch += 1
+                self.transition_count += 1
+                common.log.warning(
+                    "apiserver weather %s -> %s (epoch %d; %s class %s)",
+                    STATE_NAMES[prev], STATE_NAMES[overall], self._epoch,
+                    cls, STATE_NAMES[track.severity],
+                )
+
+    def _reclassify(self, track: _ClassTrack) -> None:
+        if track.consecutive_successes >= self.clear_after:
+            if track.severity != CLEAR:
+                track.severity = CLEAR
+                track.window.clear()
+            return
+        if track.consecutive_failures >= self.blackout_after:
+            track.severity = BLACKOUT
+            return
+        if track.severity == CLEAR:
+            n = len(track.window)
+            rate = (sum(track.window) / n) if n else 0.0
+            if (
+                track.consecutive_failures >= self.brownout_after
+                or (n >= self.brownout_min_samples
+                    and rate >= self.brownout_rate)
+            ):
+                track.severity = BROWNOUT
+
+    # ---------------- reading ---------------- #
+
+    def state(self) -> int:
+        return self._state
+
+    def state_name(self) -> str:
+        return STATE_NAMES[self._state]
+
+    @property
+    def epoch(self) -> int:
+        return self._epoch
+
+    def class_state(self, cls: str) -> int:
+        track = self._classes.get(cls)
+        return track.severity if track is not None else CLEAR
+
+    def drain_ok(self) -> bool:
+        """May the intent journal attempt a drain? Clear skies, or at
+        least one class proven clear — the read class recovers first on a
+        healing apiserver (probes are reads), and the first drained write
+        is itself the probe that clears the write class. A failed
+        optimistic drain re-journals and costs one retry round."""
+        with self._lock:
+            return any(t.severity == CLEAR for t in self._classes.values())
+
+    def certificate(self) -> Dict:
+        """The weather WAIT certificate: gate + the version vector the
+        negative-filter cache revalidates against. Shaped like the
+        shardDown certificate (gate + vector), NOT like the core's
+        rejection certificate — framework._try_fast_wait branches on the
+        gate before touching core-vector keys."""
+        return {
+            "gate": GATE_APISERVER_OUTAGE,
+            "vector": {"weatherEpoch": self._epoch},
+        }
+
+    def certificate_current(self, cert: Dict) -> bool:
+        """A cached weather WAIT is servable iff the epoch is unchanged
+        AND the sky is still black — any transition (including heal)
+        bumps the epoch, so stale verdicts self-invalidate."""
+        vector = cert.get("vector") or {}
+        return (
+            self._state == BLACKOUT
+            and vector.get("weatherEpoch") == self._epoch
+        )
+
+    def snapshot(self) -> Dict:
+        """The /v1/inspect/ha weather block."""
+        with self._lock:
+            return {
+                "state": STATE_NAMES[self._state],
+                "epoch": self._epoch,
+                "read": STATE_NAMES[self._classes["read"].severity],
+                "write": STATE_NAMES[self._classes["write"].severity],
+                "transitions": self.transition_count,
+            }
+
+
+class IntentJournal:
+    """Bounded write-behind journal of durable-write intents.
+
+    One entry per object key, latest-wins: re-journaling a key counts the
+    displaced intent as *superseded* (its effect is contained in the
+    newer one — for annotation patches the dicts are merge-coalesced,
+    since applying P1 then P2 as JSON merge-patches equals applying
+    ``{**P1, **P2}``). Capacity overflow drops the OLDEST entry (counted
+    — the bench gate asserts zero drops at the sized capacity).
+
+    Accounting invariant (checked by tests and the drain gate)::
+
+        journaled == drained + superseded + dropped + discarded + depth
+
+    Draining is sequence-ordered and stops at the first failure (the
+    failed entry is restored under its original sequence number unless a
+    newer intent for the key arrived meanwhile, which supersedes it).
+    """
+
+    def __init__(self, capacity: int = 512) -> None:
+        self.capacity = max(1, int(capacity))
+        self._lock = threading.Lock()
+        self._entries: Dict[str, Tuple[int, str, Any]] = {}
+        self._seq = 0
+        self.journaled = 0
+        self.superseded = 0
+        self.coalesced = 0
+        self.drained = 0
+        self.dropped = 0
+        self.discarded = 0
+        self.last_drain_error: Optional[str] = None
+
+    # ---------------- writing ---------------- #
+
+    def put(self, kind: str, key: str, payload: Any) -> None:
+        with self._lock:
+            self._seq += 1
+            old = self._entries.get(key)
+            if old is not None:
+                _, old_kind, old_payload = old
+                if kind == INTENT_PATCH and old_kind == INTENT_PATCH:
+                    # Coalesce merge-patches: latest pod object, merged
+                    # annotation map (None values survive — they are the
+                    # RFC 7386 key deletions and must drain as such).
+                    pod, annotations = payload
+                    _, old_annotations = old_payload
+                    payload = (
+                        pod, {**dict(old_annotations), **dict(annotations)}
+                    )
+                    self.coalesced += 1
+                self.superseded += 1
+            elif len(self._entries) >= self.capacity:
+                victim = min(self._entries, key=lambda k: self._entries[k][0])
+                del self._entries[victim]
+                self.dropped += 1
+                common.log.error(
+                    "intent journal full (%d): dropped oldest intent %r",
+                    self.capacity, victim,
+                )
+            self._entries[key] = (self._seq, kind, payload)
+            self.journaled += 1
+
+    # ---------------- reading ---------------- #
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def counters(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "journaled": self.journaled,
+                "superseded": self.superseded,
+                "coalesced": self.coalesced,
+                "drained": self.drained,
+                "dropped": self.dropped,
+                "discarded": self.discarded,
+                "depth": len(self._entries),
+            }
+
+    # ---------------- resolution ---------------- #
+
+    def discard_all(self) -> int:
+        """A superseded leader's fence: the new leader owns the durable
+        truth now; draining stale intents over it would be the split-
+        brain write the HA plane exists to prevent."""
+        with self._lock:
+            n = len(self._entries)
+            if n:
+                self._entries.clear()
+                self.discarded += n
+                common.log.warning(
+                    "intent journal: discarded %d intents (superseded "
+                    "leader fence)", n,
+                )
+            return n
+
+    def drain(self, dispatch: Callable[[str, Any], None]) -> int:
+        """Dispatch every journaled intent in sequence order. Stops at
+        the first dispatch failure (entry restored; retried by the next
+        drain trigger). Returns the number drained this call."""
+        drained = 0
+        while True:
+            with self._lock:
+                if not self._entries:
+                    break
+                key = min(self._entries, key=lambda k: self._entries[k][0])
+                seq, kind, payload = self._entries.pop(key)
+            try:
+                dispatch(kind, payload)
+            except Exception as e:  # noqa: BLE001
+                with self._lock:
+                    if key in self._entries:
+                        # A newer intent for this key landed while the
+                        # drain attempt was in flight: it wins.
+                        self.superseded += 1
+                    else:
+                        self._entries[key] = (seq, kind, payload)
+                    self.last_drain_error = str(e)
+                common.log.warning(
+                    "intent drain stopped at %r (restored, will retry): %s",
+                    key, e,
+                )
+                break
+            with self._lock:
+                self.drained += 1
+                self.last_drain_error = None
+            drained += 1
+        return drained

@@ -74,6 +74,15 @@ COUNTERS: Dict[str, tuple] = {
     "deltaSuggestedResyncCount": ("hived_delta_suggested_resyncs_total", "delta-encoded suggested-set frames a worker refused (base mismatch or integrity check) and the frontend resynced with a full list (one wire plane; should stay near 0)"),
     "shardRestartCount": ("hived_shard_restarts_total", "shard workers hot-resurrected by the supervision plane (crash/hang detected, worker respawned and recovered from its partition slot)"),
     "shardDegradedWaitCount": ("hived_shard_degraded_waits_total", "filter requests answered WAIT with the shardDown gate because their owning shard was down or resurrecting"),
+    "shardDownFastWaitCount": ("hived_shard_down_fast_waits_total", "degraded shardDown WAITs answered from the frontend fast-WAIT cache with one epoch compare instead of a decision-journal write (self-invalidated by resurrection's epoch bump)"),
+    "intentJournaledCount": ("hived_intent_journaled_total", "durable writes absorbed into the write-behind intent journal because their retry budget exhausted during an apiserver blackout (control-plane weather plane)"),
+    "intentSupersededCount": ("hived_intent_superseded_total", "journaled intents replaced latest-wins by a newer intent for the same object before draining"),
+    "intentCoalescedCount": ("hived_intent_coalesced_total", "annotation-patch intents merge-coalesced into an already-journaled patch for the same pod"),
+    "intentDrainedCount": ("hived_intent_drained_total", "journaled intents successfully written through after the weather cleared and leadership was re-confirmed"),
+    "intentDroppedCount": ("hived_intent_dropped_total", "oldest journaled intents dropped because the bounded journal overflowed (should stay 0; raise intentJournalCapacity)"),
+    "intentDiscardedCount": ("hived_intent_discarded_total", "journaled intents discarded by the superseded-leader fence (another lease holder observed; the new leader owns the durable state)"),
+    "outageBindRefusedCount": ("hived_outage_bind_refusals_total", "bind writes refused retriably (503 apiserverOutage) because the apiserver weather was blackout"),
+    "outageWaitCount": ("hived_outage_waits_total", "filter requests answered WAIT with the apiserverOutage gate during an apiserver blackout (served off the in-memory projection)"),
 }
 
 GAUGES: Dict[str, tuple] = {
@@ -90,6 +99,9 @@ GAUGES: Dict[str, tuple] = {
     "whatifForkPodCount": ("hived_whatif_fork_pods", "pods restored into the most recent shadow fork"),
     "whatifForkAgeSeconds": ("hived_whatif_fork_age_seconds", "seconds since the most recent shadow fork was built (forecast staleness; -1 before the first fork)"),
     "whatifForecastSeconds": ("hived_whatif_forecast_seconds", "wall seconds of the most recent what-if forecast (fork + replay)"),
+    "apiserverWeather": ("hived_apiserver_weather", "apiserver weather verdict: 0 clear, 1 brownout (elevated failure rate), 2 blackout (durable writes journaled, binds refused retriably)"),
+    "apiserverWeatherEpoch": ("hived_apiserver_weather_epoch", "monotone weather-transition epoch (bumped on every overall state change; apiserverOutage WAIT certificates pin it)"),
+    "intentJournalDepth": ("hived_intent_journal_depth", "intents currently parked in the write-behind journal awaiting drain"),
 }
 
 # get_metrics keys -> histogram family names.

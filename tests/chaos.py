@@ -75,6 +75,7 @@ from hivedscheduler_tpu.algorithm.group import GroupState
 from hivedscheduler_tpu.api import constants, extender as ei, types as api
 from hivedscheduler_tpu.scheduler import ha as ha_mod
 from hivedscheduler_tpu.scheduler import snapshot as snapshot_mod
+from hivedscheduler_tpu.scheduler import weather as weather_mod
 from hivedscheduler_tpu.scheduler.framework import HivedScheduler, KubeClient
 from hivedscheduler_tpu.scheduler.kube import KubeAPIError, RetryingKubeClient
 from hivedscheduler_tpu.scheduler.types import (
@@ -153,6 +154,23 @@ _HA_FAMILY = (
 # gangs in the first place).
 _ELASTIC_FAMILY = ("gang_shrink", "gang_grow", "defrag_migrate")
 
+# Control-plane weather plane (doc/fault-model.md "Control-plane weather
+# plane"): apiserver brownout storms (exhausted writes must still RAISE),
+# blackout windows (durable writes journal-and-swallow, filters WAIT with
+# weather certificates, binds refuse retriably, the journal drains after
+# the heal), and flapping weather (epoch monotonicity / certificate
+# staleness). The "weather" alias of HIVED_CHAOS_MIX is ADDITIVE — the
+# family is deliberately absent from DEFAULT_EVENT_WEIGHTS (adding it
+# there would change total_weight and reshuffle every pinned seed's
+# schedule), so the alias APPENDS (event, base * factor) entries instead
+# of multiplying existing ones. hack/soak.sh --outage sweeps it.
+_WEATHER_FAMILY = (
+    ("apiserver_brownout", 3.0),
+    ("apiserver_blackout", 4.0),
+    ("weather_flap", 2.0),
+)
+WEATHER_EVENTS = tuple(name for name, _ in _WEATHER_FAMILY)
+
 
 def event_weights(mix_env: Optional[str] = None) -> List:
     """The (event, weight) table after applying the HIVED_CHAOS_MIX knob."""
@@ -160,6 +178,7 @@ def event_weights(mix_env: Optional[str] = None) -> List:
         "HIVED_CHAOS_MIX", ""
     )
     mult: Dict[str, float] = {}
+    weather_factor = 0.0
     for part in mix.split(","):
         part = part.strip()
         if not part or ":" not in part:
@@ -178,6 +197,8 @@ def event_weights(mix_env: Optional[str] = None) -> List:
         elif name.strip() == "elastic":
             for ev in _ELASTIC_FAMILY:
                 mult[ev] = mult.get(ev, 1.0) * factor
+        elif name.strip() == "weather":
+            weather_factor = factor
         else:
             mult[name.strip()] = factor
     weighted = [
@@ -185,6 +206,16 @@ def event_weights(mix_env: Optional[str] = None) -> List:
         for name, w in DEFAULT_EVENT_WEIGHTS
         if w * mult.get(name, 1.0) > 0
     ]
+    if weather_factor > 0:
+        # Additive: the default table above is untouched (same entries,
+        # same weights, same order), so every pinned non-weather seed's
+        # roll sequence is unchanged; weather schedules get the family
+        # appended with per-event fine-tuning still multiplicative.
+        weighted.extend(
+            (ev, base * weather_factor * mult.get(ev, 1.0))
+            for ev, base in _WEATHER_FAMILY
+            if base * weather_factor * mult.get(ev, 1.0) > 0
+        )
     # A mix that zeroes everything is a knob error; fall back to defaults
     # rather than dividing by an empty table.
     return weighted or list(DEFAULT_EVENT_WEIGHTS)
@@ -237,8 +268,20 @@ class ScriptedKubeClient(KubeClient):
         self.on_evict = None  # callable(pod) or None
         self.patches: List[tuple] = []
         self.evicted: List[str] = []
+        # Control-plane weather plane: while set, EVERY verb — reads and
+        # writes alike — fails 503. The fault queues model per-attempt
+        # blips; this models the sky going black (an apiserver outage
+        # window). Default off, so existing schedules are byte-identical.
+        self.outage = False
+
+    def _outage_check(self, method: str, path: str) -> None:
+        if self.outage:
+            raise KubeAPIError(
+                method, path, 503, "apiserver unreachable (outage window)"
+            )
 
     def bind_pod(self, binding_pod: Pod) -> None:
+        self._outage_check("POST", "/binding")
         if self.fault_queue:
             fault = self.fault_queue.popleft()
             if fault is not None:
@@ -246,6 +289,7 @@ class ScriptedKubeClient(KubeClient):
         self.bound[binding_pod.uid] = binding_pod
 
     def persist_scheduler_state(self, payload: str) -> None:
+        self._outage_check("PUT", "/configmaps/state")
         if self.state_fault_queue:
             fault = self.state_fault_queue.popleft()
             if fault is not None:
@@ -254,9 +298,11 @@ class ScriptedKubeClient(KubeClient):
         self.state_writes += 1
 
     def load_scheduler_state(self) -> Optional[str]:
+        self._outage_check("GET", "/configmaps/state")
         return self.state
 
     def persist_snapshot(self, chunks) -> None:
+        self._outage_check("PUT", "/configmaps/snapshot")
         if self.snapshot_fault_queue:
             fault = self.snapshot_fault_queue.popleft()
             if fault is not None:
@@ -265,9 +311,11 @@ class ScriptedKubeClient(KubeClient):
         self.snapshot_writes += 1
 
     def load_snapshot(self) -> Optional[List[str]]:
+        self._outage_check("GET", "/configmaps/snapshot")
         return list(self.snapshot) if self.snapshot is not None else None
 
     def read_lease(self) -> Optional[Dict]:
+        self._outage_check("GET", "/leases")
         if self.lease is None:
             return None
         return {
@@ -281,6 +329,7 @@ class ScriptedKubeClient(KubeClient):
         # an expired lease — only the first write wins), and a write
         # WITHOUT a resourceVersion is create-only (two standbys racing to
         # create the very first Lease — only the first POST wins).
+        self._outage_check("PUT", "/leases")
         if resource_version is None:
             if self.lease is not None:
                 raise KubeAPIError("POST", "/leases", 409, "already exists")
@@ -295,6 +344,7 @@ class ScriptedKubeClient(KubeClient):
         self.lease = {"spec": dict(spec), "resourceVersion": self.lease_rv}
 
     def patch_pod_annotations(self, pod, annotations) -> None:
+        self._outage_check("PATCH", "/pods")
         if self.patch_fault_queue:
             fault = self.patch_fault_queue.popleft()
             if fault is not None:
@@ -306,6 +356,7 @@ class ScriptedKubeClient(KubeClient):
     def evict_pod(self, pod: Pod) -> None:
         # Fault hook BEFORE recording: a failed delete must not appear in
         # the evicted log.
+        self._outage_check("DELETE", "/pods")
         if self.on_evict is not None:
             self.on_evict(pod)
         self.evicted.append(pod.uid)
@@ -552,9 +603,26 @@ class ChaosHarness:
             # each scheduler instance (agreement asserted — see
             # _accumulate_elastic_metrics).
             "live_audit_runs": 0,
+            # Control-plane weather plane (zero outside weather mode —
+            # the stats shape is schedule-independent).
+            "brownouts": 0,
+            "blackouts": 0,
+            "weather_flaps": 0,
+            "intents_journaled": 0,
+            "intents_coalesced": 0,
+            "intents_drained": 0,
+            "outage_waits": 0,
+            "outage_fast_waits": 0,
+            "outage_bind_refusals": 0,
         }
         self.weights = event_weights(mix)
         self.total_weight = sum(w for _, w in self.weights)
+        # Weather mode: the mix appended the weather family. Only then do
+        # the schedulers get a live vane + intent journal — see
+        # _new_scheduler for why the default mode must NOT have one.
+        self.weather_mode = any(
+            name in WEATHER_EVENTS for name, _ in self.weights
+        )
         # The HA plane's deterministic wall clock: leases are acquired and
         # expire only when a failover event advances it, so leadership is a
         # pure function of the event schedule.
@@ -616,6 +684,16 @@ class ChaosHarness:
             backoff_max_s=0.08,
             sleep=self.retry_sleeps.append,  # recorded, never slept
             jitter_rng=random.Random(self.seed ^ 0xBEEF),
+            # Outside weather mode the vane/journal are explicitly
+            # DISABLED (False, not the scheduler-inherit default): two
+            # back-to-back exhausted write bursts in a pinned schedule
+            # would otherwise accumulate to BLACKOUT and journal-and-
+            # swallow the second one — silently changing the behavior
+            # every pinned seed was derived against. Weather mode uses
+            # the production wiring and keeps its events self-contained
+            # (each one heals the sky and drains before returning).
+            vane=None if self.weather_mode else False,
+            journal=None if self.weather_mode else False,
         )
         # Victim-node picks are seeded so preemption schedules replay
         # exactly per seed.
@@ -1302,6 +1380,328 @@ class ChaosHarness:
         if not result.node_names:
             return None  # waiting: nothing assume-bound to fence
         return pod, result.node_names[0]
+
+    # ---------------- control-plane weather plane ---------------- #
+    #
+    # Weather events are SELF-CONTAINED: each one normalizes the sky,
+    # runs its storm, heals, drains, and asserts the journal is empty
+    # before returning — so any interleaving with the rest of the
+    # schedule (restarts, failovers, write-fault bursts) is safe, and
+    # the post-event audit/restart-equivalence machinery never sees a
+    # half-drained journal.
+
+    def _weather_client(self):
+        """The live RetryingKubeClient with its vane/journal, or None
+        outside weather mode (the events no-op so a stray direct call
+        can never skew a pinned default-mix schedule)."""
+        kc = self.scheduler.kube_client
+        vane = getattr(kc, "vane", None)
+        journal = getattr(kc, "journal", None)
+        if vane is None or journal is None:
+            return None
+        return kc, vane, journal
+
+    def _clear_sky(self, kc, vane) -> None:
+        """Normalize to CLEAR before a weather event asserts exact
+        transitions: end any outage window, purge leftover scripted
+        write faults (the general fault plane may have queued some), and
+        feed read+write successes until every class proves clear."""
+        self.kube.outage = False
+        self.kube.patch_fault_queue.clear()
+        self.kube.state_fault_queue.clear()
+        self.kube.snapshot_fault_queue.clear()
+        probe = Pod(name="wx-warm", uid=f"u-wx-warm-{self.seed}")
+        guard = 0
+        while vane.state() != weather_mod.CLEAR:
+            kc.weather_probe()
+            try:
+                kc.patch_pod_annotations(probe, {"wx-warm": None})
+            except KubeAPIError:
+                pass
+            guard += 1
+            assert guard < 64, (
+                self.seed, "sky would not clear", vane.snapshot(),
+            )
+
+    def apiserver_brownout(self) -> None:
+        """A brownout storm: one durable write exhausts its retry budget
+        while the sky is merely brown — PR 2 semantics must hold exactly
+        (the exhaustion RAISES; nothing is journaled or swallowed —
+        journal-and-swallow is a blackout-only behavior)."""
+        wc = self._weather_client()
+        if wc is None:
+            return
+        kc, vane, journal = wc
+        self._clear_sky(kc, vane)
+        before = journal.counters()
+        probe = Pod(
+            name=f"wx-brown-{self.event_i}",
+            uid=f"u-wx-brown-{self.seed}-{self.event_i}",
+        )
+        # One exhausted burst: every attempt fails, but the consecutive-
+        # failure streak (MAX_BIND_ATTEMPTS=4) stays below the blackout
+        # threshold (8) — the vane must read brownout, not blackout.
+        self.kube.patch_fault_queue.extend(
+            transient_fault() for _ in range(MAX_BIND_ATTEMPTS)
+        )
+        try:
+            kc.patch_pod_annotations(probe, {"wx-probe": "1"})
+            raise AssertionError(
+                (self.seed, "exhausted write under brownout did not raise")
+            )
+        except KubeAPIError:
+            pass
+        assert vane.state() == weather_mod.BROWNOUT, (
+            self.seed, "exhausted write burst did not trip brownout",
+            vane.snapshot(),
+        )
+        after = journal.counters()
+        assert after["journaled"] == before["journaled"], (
+            self.seed, "brownout journaled a write (blackout-only!)",
+            after,
+        )
+        self._clear_sky(kc, vane)
+        self.stats["brownouts"] += 1
+
+    def apiserver_blackout(self) -> None:
+        """A total outage window, end to end: the vane concedes BLACKOUT
+        off failed read probes BEFORE any durable write is risked; then
+        (a) durable writes journal-and-swallow latest-wins (a second
+        patch on the same pod coalesces), (b) a filter answers WAIT with
+        the weather-epoch certificate and the immediate re-filter is
+        served by the negative cache (one vector compare), (c) a parked
+        bind is refused 503/apiserverOutage retriably; then the sky
+        heals, the journal drains to empty with consistent accounting,
+        the coalesced patch lands as one merged write, and the parked
+        bind succeeds."""
+        wc = self._weather_client()
+        if wc is None:
+            return
+        kc, vane, journal = wc
+        self._clear_sky(kc, vane)
+        sched = self.scheduler
+        # Park a placement BEFORE the storm: filter succeeded, bind not
+        # yet issued — the state the weather fence must refuse.
+        parked = self._start_pending_bind()
+        m0 = sched.metrics.snapshot()
+        self.kube.outage = True
+        guard = 0
+        while vane.state() != weather_mod.BLACKOUT:
+            kc.weather_probe()
+            guard += 1
+            assert guard <= vane.blackout_after, (
+                self.seed, "read probes did not trip blackout",
+                vane.snapshot(),
+            )
+        epoch_black = vane.epoch
+        cert_black = vane.certificate()
+        assert vane.certificate_current(cert_black), (self.seed, cert_black)
+        before = journal.counters()
+        # (a) Durable writes journal-and-swallow; same-key patches
+        # coalesce latest-wins (merge semantics: None survives as the
+        # RFC 7386 deletion).
+        probe = Pod(
+            name=f"wx-black-{self.event_i}",
+            uid=f"u-wx-black-{self.seed}-{self.event_i}",
+        )
+        kc.patch_pod_annotations(probe, {"wx": "a", "wx-del": "1"})
+        kc.patch_pod_annotations(probe, {"wx": "b", "wx-del": None})
+        kc.evict_pod(probe)
+        mid = journal.counters()
+        assert mid["journaled"] == before["journaled"] + 3, (self.seed, mid)
+        assert mid["coalesced"] == before["coalesced"] + 1, (self.seed, mid)
+        assert mid["depth"] == before["depth"] + 2, (self.seed, mid)
+        assert not self.kube.patches or self.kube.patches[-1][0] != probe.uid
+        # (b) Degraded serving: WAIT with the weather certificate, then
+        # the one-compare fast path on the retry storm's re-filter.
+        fpod = self._weather_filter_probe(sched, vane, epoch_black, m0)
+        # (c) The parked bind is refused retriably — allocation kept.
+        if parked is not None:
+            ppod, pnode = parked
+            try:
+                sched.bind_routine(
+                    ei.ExtenderBindingArgs(
+                        pod_name=ppod.name,
+                        pod_namespace=ppod.namespace,
+                        pod_uid=ppod.uid,
+                        node=pnode,
+                    )
+                )
+                raise AssertionError(
+                    (self.seed, "blackout bind was not refused")
+                )
+            except api.WebServerError as e:
+                assert e.code == 503 and "apiserverOutage" in e.message, (
+                    self.seed, e.code, e.message,
+                )
+            self.stats["outage_bind_refusals"] += 1
+        # Heal: read probes clear the read class (drain_ok), the drain
+        # replays the journal in sequence order, and the drained writes
+        # are themselves the write-class recovery proof.
+        self.kube.outage = False
+        guard = 0
+        while not vane.drain_ok():
+            kc.weather_probe()
+            guard += 1
+            assert guard <= vane.clear_after + 1, (self.seed, vane.snapshot())
+        # The write class may still read blackout here — the read class
+        # alone opened the drain gate; the drained writes below are the
+        # write-class recovery proof.
+        drained = kc.maybe_drain()
+        assert drained == 2 and journal.depth() == 0, (
+            self.seed, drained, journal.counters(),
+        )
+        c = journal.counters()
+        assert c["journaled"] == (
+            c["drained"] + c["superseded"] + c["dropped"]
+            + c["discarded"] + c["depth"]
+        ), (self.seed, c)
+        assert c["dropped"] == 0, (self.seed, c)
+        # The coalesced patch landed as ONE merged write; the eviction
+        # drained too (the kubelet fold ignores the synthetic uid).
+        assert (probe.uid, {"wx": "b", "wx-del": None}) in self.kube.patches, (
+            self.seed, self.kube.patches[-3:],
+        )
+        assert probe.uid in self.kube.evicted, (self.seed,)
+        self._clear_sky(kc, vane)
+        # Fully healed now (every class clear): the heal transition
+        # bumped the monotone epoch, so the blackout-era certificate is
+        # stale — the negative cache self-invalidates.
+        assert vane.epoch > epoch_black, (self.seed, vane.snapshot())
+        assert not vane.certificate_current(cert_black), (
+            self.seed, "heal did not invalidate the blackout certificate",
+        )
+        # The parked bind goes through now that the sky is clear. The
+        # general fault plane may still fail it with a SCRIPTED bind
+        # fault (allowed — handled exactly like _filter_and_bind), but
+        # it must never be the weather fence again.
+        if parked is not None:
+            ppod, pnode = parked
+            try:
+                sched.bind_routine(
+                    ei.ExtenderBindingArgs(
+                        pod_name=ppod.name,
+                        pod_namespace=ppod.namespace,
+                        pod_uid=ppod.uid,
+                        node=pnode,
+                    )
+                )
+            except Exception as e:  # noqa: BLE001
+                assert "apiserverOutage" not in str(e), (
+                    self.seed, "post-heal bind still weather-fenced", e,
+                )
+            bound = self.kube.bound.get(ppod.uid)
+            if bound is not None:
+                bound.phase = "Running"
+                sched.update_pod(ppod, bound)
+                self.cluster_pods[ppod.uid] = bound
+                self.stats["binds"] += 1
+        if fpod is not None:
+            self.delete_pods([fpod.uid], missed=False)
+        self.stats["blackouts"] += 1
+        self.stats["intents_journaled"] += (
+            c["journaled"] - before["journaled"]
+        )
+        self.stats["intents_coalesced"] += (
+            c["coalesced"] - before["coalesced"]
+        )
+        self.stats["intents_drained"] += drained
+
+    def _weather_filter_probe(self, sched, vane, epoch_black, m0):
+        """During a blackout, drive one fresh pod through the production
+        filter twice: the first answer is a degraded WAIT carrying the
+        weather-epoch certificate; the second must be served by the
+        negative-filter cache (fastWaitCount, not a second walk).
+        Returns the probe pod (caller deletes it post-heal)."""
+        self.gang_seq += 1
+        name = f"wx{self.seed}-{self.gang_seq}"
+        fpod = make_pod(
+            f"{name}-0", f"u-{name}-0", self.rnd.choice(["A", "B"]), 0,
+            self.rnd.choice(["v5e-chip", "v5p-chip"]), 1,
+            group={
+                "name": name,
+                "members": [{"podNumber": 1, "leafCellNumber": 1}],
+            },
+        )
+        self.cluster_pods[fpod.uid] = fpod
+        sched.add_pod(fpod)
+        r1 = sched.filter_routine(
+            ei.ExtenderArgs(pod=fpod, node_names=self.live_nodes())
+        )
+        assert not r1.node_names and r1.failed_nodes, (
+            self.seed, "blackout filter did not WAIT", r1,
+        )
+        reason = r1.failed_nodes.get(constants.COMPONENT_NAME, "")
+        assert f"weather epoch {epoch_black}" in reason, (self.seed, reason)
+        m1 = sched.metrics.snapshot()
+        assert m1["outageWaitCount"] == m0["outageWaitCount"] + 1, (
+            self.seed, m0["outageWaitCount"], m1["outageWaitCount"],
+        )
+        # The decision record carries the certificate (observability
+        # contract: WAIT verdicts are explainable after the fact).
+        rec = sched.decisions.lookup(fpod.uid)
+        cert = (rec or {}).get("certificate")
+        assert cert is not None, (self.seed, rec)
+        assert cert.get("gate") == "apiserverOutage", (self.seed, cert)
+        assert (cert.get("vector") or {}).get("weatherEpoch") == epoch_black, (
+            self.seed, cert,
+        )
+        if getattr(sched, "wait_cache_enabled", False):
+            r2 = sched.filter_routine(
+                ei.ExtenderArgs(pod=fpod, node_names=self.live_nodes())
+            )
+            assert not r2.node_names and r2.failed_nodes, (self.seed, r2)
+            m2 = sched.metrics.snapshot()
+            assert m2["fastWaitCount"] == m1["fastWaitCount"] + 1, (
+                self.seed,
+                "outage re-filter was not served by the negative cache",
+            )
+            assert m2["outageWaitCount"] == m1["outageWaitCount"], (
+                self.seed, "fast path still walked the outage branch",
+            )
+            self.stats["outage_fast_waits"] += (
+                m2["fastWaitCount"] - m1["fastWaitCount"]
+            )
+        self.stats["outage_waits"] += (
+            m1["outageWaitCount"] - m0["outageWaitCount"]
+        )
+        return fpod
+
+    def weather_flap(self) -> None:
+        """Flapping weather: blackout → heal → blackout → heal. Epochs
+        are strictly monotone across the cycles, and a certificate
+        minted under one blackout is never current under a later sky —
+        the negative cache self-invalidates across heal cycles."""
+        wc = self._weather_client()
+        if wc is None:
+            return
+        kc, vane, journal = wc
+        self._clear_sky(kc, vane)
+        epochs = []
+        certs = []
+        for _cycle in range(2):
+            self.kube.outage = True
+            guard = 0
+            while vane.state() != weather_mod.BLACKOUT:
+                kc.weather_probe()
+                guard += 1
+                assert guard <= vane.blackout_after, (
+                    self.seed, vane.snapshot(),
+                )
+            certs.append(vane.certificate())
+            epochs.append(vane.epoch)
+            assert vane.certificate_current(certs[-1]), (self.seed,)
+            self._clear_sky(kc, vane)
+            epochs.append(vane.epoch)
+        assert epochs == sorted(epochs) and len(set(epochs)) == len(epochs), (
+            self.seed, "weather epochs not strictly monotone", epochs,
+        )
+        for cert in certs:
+            assert not vane.certificate_current(cert), (
+                self.seed, "stale blackout certificate still current", cert,
+            )
+        assert journal.depth() == 0, (self.seed, journal.counters())
+        self.stats["weather_flaps"] += 1
 
     def audit_desired_health(self) -> None:
         """Invariant 7 (health consistency, damping half): any target the
@@ -2246,6 +2646,172 @@ def _dump_decision_artifact(harness: "ChaosHarness", seed: int) -> str:
 
 
 ###############################################################################
+# Control-plane weather plane: the convergence differential (ISSUE 18
+# acceptance) — post-drain durable state byte-equal to a never-outage
+# shadow run fed the identical inputs
+###############################################################################
+
+
+def _weather_diff_client(seed: int, with_weather: bool):
+    """One side of the differential: a ScriptedKubeClient whose durable
+    effects are FOLDED (annotation merge semantics, eviction set) plus a
+    RetryingKubeClient over it — the live side carries a vane + intent
+    journal, the shadow side is the plain PR 2 retry plane."""
+    kube = ScriptedKubeClient()
+    anns: Dict[str, Dict] = {}
+    evicted: Set[str] = set()
+
+    def on_patch(pod, patch):
+        cur = dict(anns.get(pod.uid) or {})
+        for k, v in patch.items():
+            if v is None:
+                cur.pop(k, None)
+            else:
+                cur[k] = v
+        anns[pod.uid] = cur
+
+    def on_evict(pod):
+        evicted.add(pod.uid)
+
+    kube.on_patch = on_patch
+    kube.on_evict = on_evict
+    vane = weather_mod.WeatherVane() if with_weather else None
+    journal = weather_mod.IntentJournal() if with_weather else None
+    client = RetryingKubeClient(
+        kube,
+        max_attempts=MAX_BIND_ATTEMPTS,
+        backoff_initial_s=0.01,
+        backoff_max_s=0.08,
+        sleep=lambda s: None,
+        jitter_rng=random.Random(seed ^ 0xBEEF),
+        vane=vane if with_weather else False,
+        journal=journal if with_weather else False,
+    )
+    return kube, client, anns, evicted, vane, journal
+
+
+def run_weather_differential(
+    seed: int, n_ops: int = 48, noop_drain: bool = False
+) -> Dict[str, int]:
+    """The convergence differential: one seeded script of durable writes
+    (doomed-ledger blobs, snapshot families, annotation merge-patches
+    including RFC 7386 deletions, evictions) driven through TWO
+    RetryingKubeClients — the LIVE side weathers seeded outage windows
+    (the vane concedes blackout off read probes BEFORE the first durable
+    write is risked, writes journal-and-swallow, heals drain), the
+    SHADOW side enjoys permanently clear skies — then the durable state
+    each apiserver holds is compared byte-for-byte: the ledger blob, the
+    snapshot chunk family, the FOLDED annotation map per pod (the live
+    side issues fewer raw patches — coalescing — but sequential merge-
+    patches P1,P2 equal the single patch {**P1,**P2}, so the fold must
+    be identical), and the eviction set.
+
+    ``noop_drain=True`` severs the drain seam; the sensitivity meta-test
+    (tests/test_chaos.py) asserts the differential then FAILS on every
+    pinned seed — a silently no-op'd drain must never pass."""
+    rnd = random.Random(seed ^ 0x57EA7)
+    (live_kube, live, live_anns, live_evicted, vane, journal) = (
+        _weather_diff_client(seed, with_weather=True)
+    )
+    (shadow_kube, shadow, shadow_anns, shadow_evicted, _, _) = (
+        _weather_diff_client(seed, with_weather=False)
+    )
+    pods = [
+        Pod(name=f"wxd-{i}", uid=f"u-wxd-{seed}-{i}") for i in range(6)
+    ]
+    keys = ("alpha", "beta", "gamma")
+    outage = False
+    windows = 0
+
+    def _blackout():
+        live_kube.outage = True
+        guard = 0
+        while vane.state() != weather_mod.BLACKOUT:
+            live.weather_probe()
+            guard += 1
+            assert guard <= vane.blackout_after, (seed, vane.snapshot())
+
+    def _heal():
+        live_kube.outage = False
+        guard = 0
+        while not vane.drain_ok():
+            live.weather_probe()
+            guard += 1
+            assert guard <= vane.clear_after + 1, (seed, vane.snapshot())
+        if not noop_drain:
+            live.maybe_drain()
+
+    for i in range(n_ops):
+        r = rnd.random()
+        if not outage and r < 0.25:
+            outage = True
+            windows += 1
+            _blackout()
+        elif outage and r < 0.45:
+            outage = False
+            _heal()
+        kind = rnd.choice(
+            ["ledger", "snapshot", "patch", "patch", "evict"]
+        )
+        if kind == "ledger":
+            payload = f"ledger-{seed}-{i}"
+            live.persist_scheduler_state(payload)
+            shadow.persist_scheduler_state(payload)
+        elif kind == "snapshot":
+            chunks = [f"meta-{seed}-{i}", f"chunk-{i}-a", f"chunk-{i}-b"]
+            live.persist_snapshot(chunks)
+            shadow.persist_snapshot(chunks)
+        elif kind == "patch":
+            pod = rnd.choice(pods)
+            patch = {
+                rnd.choice(keys): (
+                    None if rnd.random() < 0.3 else f"v{i}"
+                )
+            }
+            live.patch_pod_annotations(pod, patch)
+            shadow.patch_pod_annotations(pod, patch)
+        else:
+            pod = rnd.choice(pods)
+            live.evict_pod(pod)
+            shadow.evict_pod(pod)
+    if outage:
+        _heal()
+    c = journal.counters()
+    # Accounting invariant holds drained or not; the byte comparisons
+    # below are what a no-op'd drain fails.
+    assert c["journaled"] == (
+        c["drained"] + c["superseded"] + c["dropped"]
+        + c["discarded"] + c["depth"]
+    ), (seed, c)
+    assert c["dropped"] == 0, (seed, c)
+    if not noop_drain:
+        assert journal.depth() == 0, (seed, c)
+    assert live_kube.state == shadow_kube.state, (
+        seed, "doomed ledger diverged from the never-outage shadow",
+        live_kube.state, shadow_kube.state,
+    )
+    assert live_kube.snapshot == shadow_kube.snapshot, (
+        seed, "snapshot family diverged from the never-outage shadow",
+    )
+    assert live_anns == shadow_anns, (
+        seed, "folded annotation state diverged from the shadow",
+        live_anns, shadow_anns,
+    )
+    assert live_evicted == shadow_evicted, (
+        seed, "eviction set diverged from the shadow",
+        sorted(live_evicted), sorted(shadow_evicted),
+    )
+    return {
+        "ops": n_ops,
+        "windows": windows,
+        "journaled": c["journaled"],
+        "drained": c["drained"],
+        "superseded": c["superseded"],
+        "coalesced": c["coalesced"],
+    }
+
+
+###############################################################################
 # Multi-process chaos (scheduler.shards): restarts/failovers through the
 # per-chain-family worker-shard frontend
 ###############################################################################
@@ -2442,7 +3008,7 @@ class ProcChaosHarness:
             # Supervision-plane events (zero outside supervise mode so
             # the stats shape is schedule-independent).
             "worker_kills": 0, "worker_hangs": 0, "resurrections": 0,
-            "degraded_waits": 0,
+            "degraded_waits": 0, "mid_broadcast_kills": 0,
         }
         self.node_health: Dict[str, bool] = {}
         self.front = self._new_front()
@@ -2725,6 +3291,9 @@ class ProcChaosHarness:
         )
         self.stats["resurrections"] += 1
         self._assert_resurrection_differential(sid)
+        self._drop_preempting_routed_to(sid)
+
+    def _drop_preempting_routed_to(self, sid: int) -> None:
         # Preemption reservations are checkpointed onto pods via kube
         # annotation patches, which the supervisor mirror does not see:
         # a resurrection legally forgets in-flight reservations (the
@@ -2738,6 +3307,79 @@ class ProcChaosHarness:
             ]
             if not pods or self.front._route(pods[0]) == sid:
                 self.preempting.pop(name)
+
+    def worker_kill_mid_broadcast(self) -> None:
+        """Targeted torn-broadcast chaos: pin a worker death to the
+        window BETWEEN ``op_stage`` and the victim's own ``op_commit``
+        of an in-flight two-phase broadcast (a health tick). The
+        contract under test (shards._broadcast phase 2): the round does
+        NOT raise — every other staged shard still gets its commit (the
+        commit-remaining sweep; their health clocks advance), the dead
+        shard is handed to the supervisor instead of failing the verb,
+        degraded admission answers WAIT while it is down, and the
+        resurrection replay re-delivers the missed tick so the
+        resurrected shard converges (the audit's broadcast-liveness
+        clock check passes for every shard afterwards)."""
+        ups = [
+            sid for sid in range(self.n_shards)
+            if self.front.supervisor.is_up(sid)
+        ]
+        if len(ups) < 2:
+            return  # a 1-shard round degenerates: no second phase to tear
+        victim = self.rnd.choice(ups)
+        orig = self.front._commit_phase
+        fired = {"killed": False}
+
+        def sabotage(backend, op_id):
+            if backend.shard_id == victim and not fired["killed"]:
+                # The stage RPC for this shard already succeeded (we are
+                # in phase 2), so this death tears the broadcast exactly
+                # between its stage and its commit.
+                fired["killed"] = True
+                backend.kill(cause="kill")
+            return orig(backend, op_id)
+
+        self.front._commit_phase = sabotage
+        try:
+            # Must not raise: a worker DEATH mid-commit is retriable
+            # (journal replay re-delivers), unlike a commit-phase error.
+            self.health_tick()
+        finally:
+            self.front._commit_phase = orig
+        assert fired["killed"], (self.seed, victim, "sabotage never fired")
+        self.stats["worker_kills"] += 1
+        self.stats["mid_broadcast_kills"] += 1
+        # Commit-remaining: every OTHER shard applied the tick even
+        # though an earlier/later sibling died mid-sweep.
+        for sid in ups:
+            if sid == victim:
+                continue
+            assert (
+                self.front.shards[sid].scheduler._health_clock
+                == self.tick_count
+            ), (
+                self.seed, victim, sid,
+                "surviving shard missed a commit in the torn round",
+            )
+        assert not self.front.supervisor.is_up(victim), (
+            self.seed, victim, "mid-commit death not handed to supervisor",
+        )
+        self._assert_degraded(victim)
+        res = self.front.supervisor.check_now()
+        assert victim in res["resurrected"], (self.seed, victim, res)
+        sup = {
+            s["shard"]: s for s in self.front.supervisor.snapshot()
+        }[victim]
+        assert sup["status"] == "up" and sup["restarts"] >= 1, (
+            self.seed, victim, sup,
+        )
+        self.stats["resurrections"] += 1
+        # Convergence: the resurrected shard (which missed its commit
+        # but got the mirror replay) equals a never-crashed twin —
+        # including the health clock the audit checks below.
+        self._assert_resurrection_differential(victim)
+        self._drop_preempting_routed_to(victim)
+        self.audit("mid-broadcast-kill")
 
     def _assert_resurrection_differential(self, sid: int) -> None:
         """The resurrected shard must be indistinguishable from a shard

@@ -434,6 +434,48 @@ def test_bench_supervise_smoke():
     json.dumps(result)
 
 
+def test_bench_outage_smoke():
+    """Smoke-sized variant of the HIVED_BENCH_OUTAGE stage (ISSUE 18):
+    full apiserver blackout struck mid-load. Zero 500s (every filter
+    answers WAIT with the weather-epoch certificate, every bind refuses
+    retriably with 503 apiserverOutage), write-behind accounting
+    (drained + superseded == journaled, zero drops, empty journal), and
+    post-drain convergence (final ledger/patch/eviction reach the
+    apiserver, parked binds land, fresh work schedules) are asserted
+    INSIDE the stage at every sizing; the degraded-filter p99 3% gate is
+    the driver stage's — CI boxes only check the delta is reported."""
+    result = bench.bench_outage(
+        cubes=2, slices=2, solos=2, n_gangs=40,
+        warm_calls=6, steady_calls=30, degraded_calls=30,
+        journal_writes=16, parked_binds=4,
+    )
+    assert_stage_meta(result)
+    assert result["http_500s"] == 0
+    assert result["bind_refusals_503"] == 4
+    assert result["outage_waits"] == 30
+    assert result["fast_waits"] > 0
+    assert result["steady_p99_ms"] > 0
+    assert result["degraded_p99_ms"] > 0
+    assert "degraded_p99_delta_pct" in result
+    assert result["p99_budget_pct"] == 3.0
+    jc = result["journal"]
+    assert jc["journaled"] == 16
+    assert jc["drained"] + jc["superseded"] == jc["journaled"]
+    assert jc["depth"] == 0 and jc["dropped"] == 0
+    assert jc["coalesced"] > 0
+    assert result["drained"] == 4
+    assert result["drain_ms"] >= 0
+    assert result["blackout_epoch"] >= 1
+    assert result["weather"]["state"] == "clear"
+    # Every second degraded call re-filters the same pod and is served
+    # from the negative cache: first-seen WAITs + fast-path replays
+    # together cover the whole window.
+    assert result["outage_wait_metric"] == 15
+    assert result["outage_wait_metric"] + result["fast_waits"] >= 30
+    assert result["outage_bind_refused_metric"] >= 4
+    json.dumps(result)
+
+
 def test_bench_whatif_smoke():
     """Smoke-sized variant of the HIVED_BENCH_WHATIF stage (ISSUE 14
     CI/tooling satellite): the mid-trace what-if sample must forecast

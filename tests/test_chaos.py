@@ -944,3 +944,156 @@ def test_nooped_shard_recovery_is_caught(monkeypatch):
     for seed in SUPERVISE_NOOP_SEEDS:
         with pytest.raises(AssertionError):
             chaos.run_chaos_schedule_procs(seed, supervise=True)
+
+
+# Seeds pinned for the targeted torn-broadcast event: every seed must
+# find >= 2 shards up so the sabotage seam (kill the victim between its
+# op_stage and its op_commit) actually fires. Verified against the
+# current supervise-harness rng stream.
+MID_BROADCAST_KILL_SEEDS = (0, 1, 2, 5)
+
+
+def test_worker_kill_mid_broadcast():
+    """Targeted chaos: a worker death pinned BETWEEN ``op_stage`` and the
+    victim's own ``op_commit`` of an in-flight two-phase health-tick
+    broadcast. The round must not raise, every surviving staged shard
+    still commits (commit-remaining), the victim is handed to the
+    supervisor, degraded admission answers WAIT while it is down, and
+    resurrection replay re-delivers the missed tick (the event runs the
+    resurrection differential internally)."""
+    for seed in MID_BROADCAST_KILL_SEEDS:
+        h = chaos.ProcChaosHarness(seed, supervise=True)
+        h.gang_create()
+        h.health_tick()
+        h.gang_create()
+        h.health_tick()
+        h.worker_kill_mid_broadcast()
+        assert h.stats["mid_broadcast_kills"] == 1, (seed, h.stats)
+        assert h.stats["resurrections"] >= 1, (seed, h.stats)
+        # The resurrected fleet keeps ticking in lock-step afterwards.
+        h.health_tick()
+        h.audit("post-mid-broadcast-kill")
+        h.teardown_and_assert_no_leaks()
+
+
+# --------------------------------------------------------------------- #
+# Control-plane weather plane (scheduler.weather; doc/fault-model.md
+# "Control-plane weather plane")
+# --------------------------------------------------------------------- #
+
+# Coverage floor for the weather-weighted sweep (HIVED_CHAOS_WEATHER_ROUNDS
+# overrides for soaks — hack/soak.sh --outage drives it). The weather
+# family is ADDITIVE (mix alias "weather:N" appends to the default event
+# table), so these schedules exercise the full fault plane UNDER weather.
+WEATHER_CHAOS_ROUNDS = (
+    int(os.environ.get("HIVED_CHAOS_WEATHER_ROUNDS", "0")) or 12
+)
+
+# Seeds whose weather-mix schedules run at least one full BLACKOUT arc
+# (journal-and-swallow, outage WAIT certificate, retriable bind refusal,
+# heal, drain) — the schedules that die if the intent drain is no-op'd
+# (see test_nooped_intent_drain_is_caught). Derived with mix
+# "weather:6" against the current rng stream; re-derive when the event
+# mix changes.
+WEATHER_BLACKOUT_SEEDS = (6, 7, 8, 10, 11)
+
+# Seeds pinned for the convergence differential + its sensitivity twin:
+# every seed must open at least one outage window WITH journaled durable
+# writes inside it (otherwise a no-op'd drain has nothing to lose).
+WEATHER_DIFF_SEEDS = (0, 1, 2, 3, 5, 7)
+
+
+def test_chaos_weather_mix_sweep():
+    """The chaos acceptance for the control-plane weather plane: seeded
+    schedules through the weather-weighted mix — apiserver brownouts
+    (exhausted retries still RAISE; nothing journaled), full blackouts
+    (durable writes journal-and-swallow with latest-wins coalescing,
+    filter answers WAIT with a weather-epoch certificate served from the
+    negative cache on repeat, binds are refused retriably with 503 —
+    never a 500 — and the heal drains the journal to empty), and
+    flap storms (epochs strictly monotone, stale certificates refused)."""
+    stats = {}
+    for seed in range(WEATHER_CHAOS_ROUNDS):
+        for k, v in chaos.run_chaos_schedule(
+            seed, mix="weather:6"
+        ).items():
+            stats[k] = stats.get(k, 0) + v
+    assert stats["restarts"] >= WEATHER_CHAOS_ROUNDS, stats
+    for key in (
+        "brownouts", "blackouts", "weather_flaps", "intents_journaled",
+        "intents_coalesced", "intents_drained", "outage_waits",
+        "outage_fast_waits", "outage_bind_refusals",
+    ):
+        assert stats[key] > 0, (key, stats)
+    # Every blackout arc drains what it journaled minus coalescing;
+    # nothing is ever dropped (the events assert depth()==0 per arc).
+    assert stats["intents_drained"] > 0, stats
+
+
+def test_default_mix_stays_weather_free():
+    """Pinned-seed safety: the weather family is additive-only — the
+    DEFAULT event table must stay byte-identical (same names, same
+    weights, same order) so every pinned seed set in this file keeps its
+    rng stream. A weather event leaking into the default mix silently
+    re-derives all of them."""
+    default_names = [name for name, _ in chaos.event_weights(None)]
+    assert not set(default_names) & set(chaos.WEATHER_EVENTS), (
+        default_names,
+    )
+    weather_names = [
+        name for name, _ in chaos.event_weights("weather:6")
+    ]
+    # The alias APPENDS: the default prefix is untouched.
+    assert weather_names[: len(default_names)] == default_names
+    assert set(weather_names[len(default_names):]) == set(
+        chaos.WEATHER_EVENTS
+    )
+
+
+def test_weather_convergence_differential():
+    """ISSUE 18 acceptance: after the final heal + drain, the durable
+    state behind the weathered client (ledger blob, snapshot chunk
+    family, folded annotation maps including RFC 7386 deletions, the
+    eviction set) is byte-equal to a never-outage shadow driven with the
+    identical op script — coalescing may issue fewer raw patches, but
+    the fold must converge."""
+    totals = {
+        "windows": 0, "journaled": 0, "drained": 0,
+        "superseded": 0, "coalesced": 0,
+    }
+    for seed in WEATHER_DIFF_SEEDS:
+        r = chaos.run_weather_differential(seed)
+        # Full accounting per seed: everything journaled was either
+        # drained or superseded by a later same-key write — never
+        # dropped, never left behind.
+        assert r["journaled"] == r["drained"] + r["superseded"], (seed, r)
+        for k in totals:
+            totals[k] += r[k]
+    assert totals["windows"] > 0, totals
+    assert totals["journaled"] > 0 and totals["drained"] > 0, totals
+    assert totals["coalesced"] > 0, totals
+
+
+def test_nooped_intent_drain_is_caught(monkeypatch):
+    """Sensitivity meta-test: with the write-behind drain no-op'd —
+    blackout intents journaled but never replayed after the heal — every
+    pinned blackout seed's schedule must fail its post-heal asserts
+    (drained count, journal depth, the replayed patch/evict reaching the
+    apiserver). If this passes while the drain is dead, the weather
+    sweep is blind to silently lost durable writes."""
+    monkeypatch.setattr(
+        RetryingKubeClient, "maybe_drain", lambda self: 0,
+    )
+    for seed in WEATHER_BLACKOUT_SEEDS:
+        with pytest.raises(AssertionError):
+            chaos.run_chaos_schedule(seed, mix="weather:6")
+
+
+def test_nooped_differential_drain_is_caught():
+    """The differential's own sensitivity twin: severing the drain seam
+    (noop_drain=True) must break byte-equality with the never-outage
+    shadow on every pinned seed — otherwise the convergence check proves
+    nothing."""
+    for seed in WEATHER_DIFF_SEEDS:
+        with pytest.raises(AssertionError):
+            chaos.run_weather_differential(seed, noop_drain=True)

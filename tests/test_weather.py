@@ -1,0 +1,587 @@
+"""Control-plane weather plane unit tests (doc/fault-model.md
+"Control-plane weather plane").
+
+Covers the plane seam by seam, below the chaos sweeps (tests/test_chaos.py
+runs the weather-weighted schedules and the convergence differential):
+
+- :class:`WeatherVane` hysteresis — consecutive-failure and window-rate
+  brownout gates, blackout that never decays back to brownout, the
+  window reset on clear, per-class (read/write) independence, and the
+  monotone epoch the WAIT certificates version themselves with;
+- :class:`IntentJournal` — latest-wins coalescing (merge-patch folding
+  with RFC 7386 ``None`` deletions surviving), the accounting invariant
+  ``journaled == drained + superseded + dropped + discarded + depth``,
+  sequence-ordered drain with restore-on-failure and
+  supersede-during-drain, capacity overflow dropping the OLDEST entry,
+  and the superseded-leader ``discard_all`` fence;
+- :class:`RetryingKubeClient` write-behind — journal-and-swallow ONLY on
+  exhausted retryable failure under blackout (brownout exhaustion and
+  terminal verdicts raise exactly as before), probe/heal/drain ordering,
+  and the leadership gate on ``maybe_drain``;
+- :class:`~.ha.LeaderElector` lease weather — cannot-renew (apiserver
+  unreachable: leadership decays by local expiry only) vs superseded
+  (another holder observed: definite deposition), and the own-lease warm
+  re-acquire that skips the cold-takeover recovery;
+- framework degraded serving — blackout filters WAIT with a
+  weather-epoch certificate served from the negative cache on repeat,
+  binds refuse retriably with 503 ``apiserverOutage``, and the deposed
+  discard fence drops the journal only on DEFINITE supersession.
+"""
+
+import random
+
+import pytest
+
+from hivedscheduler_tpu.api import extender as ei, types as api
+from hivedscheduler_tpu.scheduler import ha as ha_mod
+from hivedscheduler_tpu.scheduler import weather as wx
+from hivedscheduler_tpu.scheduler.framework import (
+    HivedScheduler,
+    NullKubeClient,
+)
+from hivedscheduler_tpu.scheduler.kube import KubeAPIError, RetryingKubeClient
+from hivedscheduler_tpu.scheduler.types import Node, Pod
+
+from . import chaos
+from .test_core import make_pod
+from .test_wait_cache import filter_pod, four_host_config, gang
+
+
+# --------------------------------------------------------------------- #
+# WeatherVane
+# --------------------------------------------------------------------- #
+
+
+def test_vane_consecutive_failure_brownout_then_clear_resets_window():
+    v = wx.WeatherVane()
+    for _ in range(v.brownout_after):
+        v.record("write", False)
+    assert v.state() == wx.BROWNOUT
+    assert v.class_state("write") == wx.BROWNOUT
+    assert v.class_state("read") == wx.CLEAR
+    for _ in range(v.clear_after):
+        v.record("write", True)
+    assert v.state() == wx.CLEAR
+    # Hysteresis: the clear transition wiped the window, so the stale
+    # failure history must NOT re-trip the rate gate on the next blip.
+    v.record("write", False)
+    assert v.state() == wx.CLEAR
+
+
+def test_vane_window_rate_brownout_without_consecutive_failures():
+    v = wx.WeatherVane()
+    # fail/ok alternation never reaches brownout_after consecutive
+    # failures, but the window rate hits brownout_rate with
+    # brownout_min_samples samples.
+    v.record("write", False)
+    v.record("write", True)
+    v.record("write", False)
+    assert v.state() == wx.CLEAR  # 2/3 failing but only 3 samples
+    v.record("write", True)  # 4 samples at rate 0.5
+    assert v.state() == wx.BROWNOUT
+
+
+def test_vane_blackout_never_decays_to_brownout():
+    v = wx.WeatherVane()
+    for _ in range(v.blackout_after):
+        v.record("write", False)
+    assert v.state() == wx.BLACKOUT
+    # Sub-threshold success bursts (with failures interleaved) must not
+    # soften blackout: recovery is only ever the full success streak.
+    for _ in range(v.clear_after - 1):
+        v.record("write", True)
+    v.record("write", False)
+    assert v.state() == wx.BLACKOUT
+    for _ in range(v.clear_after):
+        v.record("write", True)
+    assert v.state() == wx.CLEAR
+
+
+def test_vane_overall_is_max_of_classes_and_snapshot_names():
+    v = wx.WeatherVane()
+    for _ in range(v.blackout_after):
+        v.record("read", False)
+    for _ in range(v.brownout_after):
+        v.record("write", False)
+    snap = v.snapshot()
+    assert snap["read"] == "blackout" and snap["write"] == "brownout"
+    assert snap["state"] == "blackout"
+    assert v.state() == wx.BLACKOUT
+    # Healing just the read class lowers overall to the write class's
+    # brownout — and drain_ok turns True off the one clear class.
+    assert not v.drain_ok()
+    for _ in range(v.clear_after):
+        v.record("read", True)
+    assert v.class_state("read") == wx.CLEAR
+    assert v.state() == wx.BROWNOUT
+    assert v.drain_ok()
+
+
+def test_vane_epoch_monotone_and_certificate_staleness():
+    v = wx.WeatherVane()
+    epochs = [v.epoch]
+    for _ in range(v.blackout_after):
+        v.record("write", False)
+    epochs.append(v.epoch)
+    cert_black = v.certificate()
+    assert cert_black["gate"] == "apiserverOutage"
+    assert cert_black["vector"]["weatherEpoch"] == v.epoch
+    assert v.certificate_current(cert_black)
+    for _ in range(v.clear_after):
+        v.record("write", True)
+    epochs.append(v.epoch)
+    # Heal bumps the epoch, so the blackout certificate self-invalidates.
+    assert not v.certificate_current(cert_black)
+    for _ in range(v.blackout_after):
+        v.record("write", False)
+    epochs.append(v.epoch)
+    # A NEW blackout is a new epoch: the old certificate stays stale.
+    assert not v.certificate_current(cert_black)
+    assert v.certificate_current(v.certificate())
+    assert epochs == sorted(set(epochs)), epochs  # strictly monotone
+    # Every overall transition bumps the epoch by exactly one, so the
+    # two counters track in lockstep (sampled epochs just skip the
+    # intermediate brownout steps).
+    assert v.transition_count == v.epoch
+
+
+def test_vane_certificate_requires_blackout():
+    v = wx.WeatherVane()
+    for _ in range(v.brownout_after):
+        v.record("write", False)
+    # Brownout degrades nothing: certificates only gate under blackout.
+    assert not v.certificate_current(v.certificate())
+
+
+# --------------------------------------------------------------------- #
+# IntentJournal
+# --------------------------------------------------------------------- #
+
+
+def _invariant(j: wx.IntentJournal) -> None:
+    c = j.counters()
+    assert c["journaled"] == (
+        c["drained"] + c["superseded"] + c["dropped"]
+        + c["discarded"] + c["depth"]
+    ), c
+
+
+def test_journal_latest_wins_and_patch_coalescing():
+    j = wx.IntentJournal()
+    pod = Pod(name="p", uid="u-p")
+    j.put(wx.INTENT_LEDGER, "ledger", "v1")
+    j.put(wx.INTENT_LEDGER, "ledger", "v2")
+    j.put(wx.INTENT_PATCH, "patch:u-p", (pod, {"a": "1", "kill": "x"}))
+    j.put(wx.INTENT_PATCH, "patch:u-p", (pod, {"b": "2", "kill": None}))
+    c = j.counters()
+    assert c["depth"] == 2 and c["superseded"] == 2 and c["coalesced"] == 1
+    _invariant(j)
+    got = {}
+    j.drain(lambda kind, payload: got.__setitem__(kind, payload))
+    # The merged patch folds sequentially: later keys win, and the None
+    # deletion SURVIVES the merge (it must drain as an RFC 7386 delete).
+    assert got[wx.INTENT_LEDGER] == "v2"
+    assert got[wx.INTENT_PATCH] == (pod, {"a": "1", "b": "2", "kill": None})
+    _invariant(j)
+
+
+def test_journal_drain_order_restore_on_failure():
+    j = wx.IntentJournal()
+    for i in range(3):
+        j.put(wx.INTENT_LEDGER, f"k{i}", f"v{i}")
+    seen = []
+
+    def flaky(kind, payload):
+        if payload == "v1":
+            raise chaos.transient_fault()
+        seen.append(payload)
+
+    # Drain stops at the first failure; k1 is restored under its ORIGINAL
+    # sequence number, so the retry replays in the original order.
+    assert j.drain(flaky) == 1
+    assert seen == ["v0"] and j.depth() == 2
+    assert j.last_drain_error is not None
+    _invariant(j)
+    assert j.drain(lambda kind, payload: seen.append(payload)) == 2
+    assert seen == ["v0", "v1", "v2"]
+    assert j.last_drain_error is None
+    _invariant(j)
+
+
+def test_journal_supersede_during_drain():
+    j = wx.IntentJournal()
+    j.put(wx.INTENT_LEDGER, "ledger", "stale")
+
+    def race(kind, payload):
+        # A newer same-key intent lands while the dispatch is in flight,
+        # then the dispatch fails: the newer entry must win (the failed
+        # one is superseded, not restored over it).
+        j.put(wx.INTENT_LEDGER, "ledger", "fresh")
+        raise chaos.transient_fault()
+
+    assert j.drain(race) == 0
+    assert j.depth() == 1
+    got = []
+    assert j.drain(lambda kind, payload: got.append(payload)) == 1
+    assert got == ["fresh"]
+    _invariant(j)
+
+
+def test_journal_overflow_drops_oldest():
+    j = wx.IntentJournal(capacity=2)
+    j.put(wx.INTENT_LEDGER, "k0", "v0")
+    j.put(wx.INTENT_LEDGER, "k1", "v1")
+    j.put(wx.INTENT_LEDGER, "k2", "v2")
+    assert j.counters()["dropped"] == 1 and j.depth() == 2
+    got = []
+    j.drain(lambda kind, payload: got.append(payload))
+    assert got == ["v1", "v2"]  # the OLDEST (k0) was the victim
+    _invariant(j)
+
+
+def test_journal_discard_all_fence():
+    j = wx.IntentJournal()
+    j.put(wx.INTENT_LEDGER, "ledger", "v0")
+    j.put(wx.INTENT_SNAPSHOT, "snapshot", ["m", "c"])
+    assert j.discard_all() == 2
+    assert j.depth() == 0 and j.counters()["discarded"] == 2
+    assert j.discard_all() == 0  # idempotent
+    _invariant(j)
+
+
+# --------------------------------------------------------------------- #
+# RetryingKubeClient write-behind
+# --------------------------------------------------------------------- #
+
+
+def _weathered_client(scheduler=None):
+    kube = chaos.ScriptedKubeClient()
+    vane = wx.WeatherVane()
+    journal = wx.IntentJournal()
+    client = RetryingKubeClient(
+        kube, scheduler=scheduler, max_attempts=3,
+        backoff_initial_s=0.01, backoff_max_s=0.02,
+        sleep=lambda s: None, jitter_rng=random.Random(7),
+        vane=vane, journal=journal,
+    )
+    return kube, client, vane, journal
+
+
+def _blacken(kube, client, vane):
+    kube.outage = True
+    guard = 0
+    while vane.state() != wx.BLACKOUT:
+        client.weather_probe()
+        guard += 1
+        assert guard <= vane.blackout_after
+    return vane.epoch
+
+
+def _heal(kube, client, vane):
+    kube.outage = False
+    guard = 0
+    while not vane.drain_ok():
+        client.weather_probe()
+        guard += 1
+        assert guard <= vane.clear_after + 1
+
+
+def test_durable_write_journals_only_under_blackout():
+    kube, client, vane, journal = _weathered_client()
+    _blacken(kube, client, vane)
+    # Under blackout the exhausted durable write SWALLOWS and journals —
+    # the caller's watermarks advance as under clear skies.
+    client.persist_scheduler_state("ledger-v1")
+    pod = Pod(name="p", uid="u-p")
+    client.patch_pod_annotations(pod, {"a": "1"})
+    client.evict_pod(pod)
+    assert journal.depth() == 3
+    assert kube.state is None and not kube.patches and not kube.evicted
+    _heal(kube, client, vane)
+    assert client.maybe_drain() == 3
+    assert kube.state == "ledger-v1"
+    assert (pod.uid, {"a": "1"}) in kube.patches
+    assert pod.uid in kube.evicted
+    assert journal.depth() == 0
+
+
+def test_brownout_exhaustion_still_raises():
+    kube, client, vane, journal = _weathered_client()
+    # Exactly brownout_after exhausted attempts: the vane reads BROWNOUT,
+    # not blackout — PR 2 semantics must hold (the failure raises, and
+    # nothing is journaled).
+    kube.patch_fault_queue.extend(
+        chaos.transient_fault() for _ in range(3)
+    )
+    with pytest.raises(KubeAPIError):
+        client.patch_pod_annotations(Pod(name="p", uid="u-p"), {"a": "1"})
+    assert vane.state() == wx.BROWNOUT
+    assert journal.depth() == 0
+
+
+def test_terminal_verdict_is_weather_success_and_never_journaled():
+    kube, client, vane, journal = _weathered_client()
+    # A 4xx is the apiserver ANSWERING: weather-wise a success even
+    # though the call fails — and terminal errors never journal.
+    kube.state_fault_queue.append(
+        KubeAPIError("PUT", "/configmaps/state", 422, "invalid")
+    )
+    with pytest.raises(KubeAPIError):
+        client.persist_scheduler_state("v1")
+    assert vane.state() == wx.CLEAR
+    assert vane.class_state("write") == wx.CLEAR
+    assert journal.depth() == 0
+
+
+def test_drained_patch_404_is_moot():
+    kube, client, vane, journal = _weathered_client()
+    _blacken(kube, client, vane)
+    client.patch_pod_annotations(Pod(name="gone", uid="u-gone"), {"a": "1"})
+    _heal(kube, client, vane)
+    # The pod vanished while journaled: the drained patch hits 404 and
+    # the intent is moot — drained, not restored (a dead entry would
+    # wedge the sequence-ordered drain forever).
+    kube.patch_fault_queue.append(
+        KubeAPIError("PATCH", "/pods", 404, "pod gone")
+    )
+    assert client.maybe_drain() == 1
+    assert journal.depth() == 0
+
+
+def test_maybe_drain_gates_on_drain_ok_and_leadership():
+    class FakeSched:
+        metrics = None
+
+        def __init__(self):
+            self.leader = True
+
+        def is_leader(self):
+            return self.leader
+
+    sched = FakeSched()
+    kube, client, vane, journal = _weathered_client(scheduler=sched)
+    _blacken(kube, client, vane)
+    client.persist_scheduler_state("v1")
+    assert journal.depth() == 1
+    # Still black: no drain attempt.
+    assert client.maybe_drain() == 0
+    kube.outage = False
+    _heal(kube, client, vane)
+    # Healed but NOT the leader: a deposed client never drains (the
+    # superseded fence discards via the framework instead).
+    sched.leader = False
+    assert client.maybe_drain() == 0
+    assert journal.depth() == 1
+    sched.leader = True
+    assert client.maybe_drain() == 1
+    assert kube.state == "v1"
+
+
+# --------------------------------------------------------------------- #
+# Lease weather (scheduler.ha)
+# --------------------------------------------------------------------- #
+
+
+def _elector(kube, identity, clock, duration=10.0):
+    return ha_mod.LeaderElector(
+        kube, identity, duration_s=duration, renew_s=3.0,
+        clock=lambda: clock[0],
+    )
+
+
+def test_elector_unreachable_vs_superseded():
+    kube = chaos.ScriptedKubeClient()
+    clock = [100.0]
+    a = _elector(kube, "a", clock)
+    b = _elector(kube, "b", clock)
+    assert a.try_acquire_or_renew()
+    assert a.lease_weather == "ok"
+    # Apiserver unreachable: cannot-renew — leadership holds until the
+    # LOCAL expiry, and the verdict is "unreachable", not deposition.
+    kube.outage = True
+    clock[0] += 5.0
+    assert a.try_acquire_or_renew() and a.is_leader()
+    assert a.lease_weather == "unreachable"
+    assert a.cannot_renew_count == 1 and a.superseded_count == 0
+    clock[0] += 5.5  # past local expiry: self-deposal, still unreachable
+    assert not a.try_acquire_or_renew()
+    assert a.cannot_renew_count == 2
+    # The outage ends and a standby takes the expired lease; the old
+    # leader's next step OBSERVES the new holder: definite supersession.
+    kube.outage = False
+    assert b.try_acquire_or_renew() and b.is_leader()
+    a._held_until = clock[0] + 1.0  # simulate a stale local hold
+    assert not a.try_acquire_or_renew()
+    assert a.lease_weather == "superseded"
+    assert a.superseded_count == 1
+    assert a.observed_holder == "b"
+
+
+def test_standby_loop_own_lease_warm_resumption_skips_cold_takeover():
+    kube = chaos.ScriptedKubeClient()
+    clock = [100.0]
+    events = []
+    a = _elector(kube, "a", clock)
+    loop = ha_mod.StandbyLoop(
+        a,
+        on_started_leading=lambda: events.append("lead"),
+        on_stopped_leading=lambda: events.append("stop"),
+    )
+    assert loop.step() is True
+    assert events == ["lead"]
+    # A blackout outlasts the lease: leadership decays locally...
+    kube.outage = True
+    clock[0] += 10.5
+    assert loop.step() is False
+    assert events == ["lead", "stop"]
+    # ...but when the weather heals, OUR identity is still on the Lease
+    # (nobody else could acquire through the outage), so the re-acquire
+    # is a WARM resumption: the cold-takeover recovery must be skipped.
+    kube.outage = False
+    assert loop.step() is True
+    assert events == ["lead", "stop"]  # no second "lead"
+    assert a.own_reacquire_count == 1
+    assert a.lease_weather == "ok"
+    # A standby winning the lease after a later expiry is observed as
+    # DEFINITE supersession, not unreachable weather.
+    clock[0] += 10.5
+    b = _elector(kube, "b", clock)
+    assert b.try_acquire_or_renew()
+    assert loop.step() is False  # observes b's unexpired lease
+    assert a.lease_weather == "superseded"
+    assert events == ["lead", "stop", "stop"]
+
+
+# --------------------------------------------------------------------- #
+# Framework degraded serving + the discard fence
+# --------------------------------------------------------------------- #
+
+
+def _sched(**kw):
+    sched = HivedScheduler(
+        four_host_config(),
+        kube_client=NullKubeClient(),
+        force_bind_executor=lambda fn: fn(),
+        trace_sample=0.0,
+        auto_admit=True,
+        **kw,
+    )
+    for name in sched.core.configured_node_names():
+        sched.add_node(Node(name=name))
+    sched.mark_ready()
+    return sched
+
+
+def _blacken_sched(sched) -> int:
+    for _ in range(sched.weather_vane.blackout_after):
+        sched.weather_vane.record("write", False)
+    assert sched.weather_vane.state() == wx.BLACKOUT
+    return sched.weather_vane.epoch
+
+
+def _heal_sched(sched) -> None:
+    for _ in range(sched.weather_vane.clear_after):
+        sched.weather_vane.record("write", True)
+    assert sched.weather_vane.state() == wx.CLEAR
+
+
+def test_blackout_filter_waits_with_certificate_and_fast_path():
+    sched = _sched()
+    epoch = _blacken_sched(sched)
+    pod = make_pod(
+        "wx-0", "u-wx0", "A", 0, "v5e-chip", 4, group=gang("gwx", 1, 4)
+    )
+    r1 = filter_pod(sched, pod)
+    assert not r1.node_names
+    reason = list(r1.failed_nodes.values())[0]
+    assert f"apiserver blackout (weather epoch {epoch})" in reason
+    m1 = sched.get_metrics()
+    assert m1["outageWaitCount"] == 1 and m1["fastWaitCount"] == 0
+    rec = sched.get_decision("u-wx0")
+    cert = rec["certificate"]
+    assert cert["gate"] == "apiserverOutage"
+    assert cert["vector"] == {"weatherEpoch": epoch}
+    # The retry storm the WAIT provokes is answered from the negative
+    # cache: one weather-epoch compare, no second journal write.
+    r2 = filter_pod(sched, pod)
+    assert not r2.node_names
+    m2 = sched.get_metrics()
+    assert m2["fastWaitCount"] == 1 and m2["outageWaitCount"] == 1
+    # Heal bumps the epoch: the cached verdict self-invalidates and the
+    # pod places normally (capacity was there all along).
+    _heal_sched(sched)
+    r3 = filter_pod(sched, pod)
+    assert r3.node_names, r3.failed_nodes
+    m3 = sched.get_metrics()
+    assert m3["fastWaitCount"] == 1
+
+
+def test_blackout_bind_refused_retriably_then_heals():
+    sched = _sched()
+    pod = make_pod(
+        "wb-0", "u-wb0", "A", 0, "v5e-chip", 4, group=gang("gwb", 1, 4)
+    )
+    r = filter_pod(sched, pod)
+    assert r.node_names
+    epoch = _blacken_sched(sched)
+    bind_args = ei.ExtenderBindingArgs(
+        pod_name=pod.name, pod_namespace=pod.namespace,
+        pod_uid=pod.uid, node=r.node_names[0],
+    )
+    with pytest.raises(api.WebServerError) as exc:
+        sched.bind_routine(bind_args)
+    assert exc.value.code == 503
+    assert "apiserverOutage" in exc.value.message
+    assert f"weather epoch {epoch}" in exc.value.message
+    assert sched.get_metrics()["outageBindRefusedCount"] == 1
+    # The placement was KEPT: after the heal the default scheduler's
+    # bind retry lands on the same node without a fresh filter round.
+    _heal_sched(sched)
+    sched.bind_routine(bind_args)
+    assert [p.uid for p in sched.kube_client.bound_pods] == ["u-wb0"]
+
+
+def test_deposed_discard_fence_only_on_definite_supersession():
+    sched = _sched()
+
+    class StubElector:
+        identity = "me"
+        observed_holder = ""
+        lease_weather = "unreachable"
+
+        def is_leader(self):
+            return False
+
+    sched.leadership = StubElector()
+    sched.intent_journal.put(wx.INTENT_LEDGER, "ledger", "v1")
+    # Merely unable to renew (no other holder observed): the journal is
+    # KEPT for the own-lease warm-resumption path.
+    sched._flush_side_effects()
+    assert sched.intent_journal.depth() == 1
+    assert sched.intent_journal.counters()["discarded"] == 0
+    # Another holder observed on the lease: DEFINITE supersession — the
+    # new leader owns the durable truth, so the journal discards.
+    sched.leadership.observed_holder = "other"
+    sched.leadership.lease_weather = "superseded"
+    sched._flush_side_effects()
+    assert sched.intent_journal.depth() == 0
+    assert sched.intent_journal.counters()["discarded"] == 1
+
+
+def test_metrics_and_inspect_ha_carry_the_weather_block():
+    sched = _sched()
+    epoch = _blacken_sched(sched)
+    m = sched.get_metrics()
+    assert m["apiserverWeather"] == wx.BLACKOUT
+    assert m["apiserverWeatherEpoch"] == epoch
+    for key in (
+        "intentJournalDepth", "intentJournaledCount",
+        "intentSupersededCount", "intentCoalescedCount",
+        "intentDrainedCount", "intentDroppedCount",
+        "intentDiscardedCount",
+    ):
+        assert m[key] == 0, key
+    ha = sched.get_ha()
+    assert ha["weather"]["state"] == "blackout"
+    assert ha["weather"]["epoch"] == epoch
+    assert ha["intentJournal"]["depth"] == 0
