@@ -2883,6 +2883,238 @@ def bench_recovery_blackout(
     }, 16 * cubes + 4 * slices + solos, t0_stage)
 
 
+def bench_store(
+    cubes: int = 26,
+    slices: int = 2,
+    solos: int = 8,
+    n_gangs: int = 1200,
+    reps: int = 3,
+    store_reps: int = 5,
+) -> dict:
+    """Durable-state plane v2 acceptance stage (HIVED_BENCH_STORE=1;
+    hack/soak.sh --store): the partial-fallback recovery A/B at the
+    432-host fleet, plus the object-store backend's persist/load wall.
+
+    The A/B runs BOTH arms behind a hot standby (prefetch + pre-apply on
+    an idle beat, OUTSIDE the timed blackout window — the same warm
+    headline bench_recovery_blackout reports): flush the sectioned v3
+    envelope, corrupt EXACTLY ONE chain-family section (a bit flip at
+    the manifest-computed byte offset — the same arithmetic decode
+    runs), then take over. v2's all-or-nothing envelope would throw the
+    whole snapshot away and replay every annotation; v3 pre-applies the
+    healthy families on the standby beat and the takeover replays only
+    the corrupt family's chains — asserted in-stage to land in
+    ``snapshot+partial`` with a placement fingerprint identical to BOTH
+    a full annotation replay and a never-corrupted snapshot+delta
+    shadow. The corrupt section is the family with the FEWEST bound
+    pods: the localized fault the sectioned schema exists for (one
+    rotted object out of many), on the asymmetric fleet shape where
+    blast radius actually is proportional — the default 432 hosts put
+    416 under the v5p family and 16 under v5e. Acceptance: partial
+    fallback >= 3x faster than the full replay (``speedup_gate``;
+    medians of ``reps``; recorded as ``gate_passed``). Honest nulls
+    live in doc/hot-path.md: a COLD partial restore is decode-dominated
+    and can lose to the full replay outright at MB-scale envelopes, and
+    corrupting the LARGEST family degrades toward full-replay cost by
+    design.
+
+    The store side times :class:`FileSnapshotStore` persist (chunk
+    writes + fsync + atomic manifest flip + generation GC) and load for
+    the same envelope, and checks GC holds exactly the configured
+    generation count — the cost of taking snapshots off the apiserver.
+    """
+    import shutil
+    import tempfile
+
+    from hivedscheduler_tpu.algorithm.cell import LOWEST_LEVEL
+    from hivedscheduler_tpu.scheduler import snapshot as snapshot_mod
+    from hivedscheduler_tpu.scheduler.store import FileSnapshotStore
+
+    def physical_fingerprint(s) -> str:
+        """Placement-equivalence fingerprint over the PHYSICAL side: leaf
+        cell states/owners, the free set, and per-pod placements. Virtual
+        cell identity within a level is interchangeable (the chaos
+        plane's equivalence relation), so the snapshot's virtual-binding
+        labels vs a replay's fresh labels must not count as divergence."""
+        leaves = {
+            leaf.address: (
+                leaf.state.value, leaf.priority, leaf.healthy,
+                leaf.draining,
+                leaf.using_group.name if leaf.using_group else None,
+            )
+            for ccl in s.core.full_cell_list.values()
+            for leaf in ccl[LOWEST_LEVEL]
+        }
+        free = {
+            str(chain): {
+                lvl: sorted(c.address for c in cl)
+                for lvl, cl in ccl.levels.items() if len(cl)
+            }
+            for chain, ccl in sorted(s.core.free_cell_list.items())
+        }
+        pods = sorted(
+            (uid, st.pod.node_name)
+            for uid, st in s.pod_schedule_statuses.items()
+            if st.pod is not None
+        )
+        return json.dumps(
+            {"leaves": leaves, "free": free, "pods": pods},
+            sort_keys=True, default=str,
+        )
+
+    t0_stage = time.perf_counter()
+    config_args = dict(cubes=cubes, slices=slices, solos=solos)
+    client = _SnapshotKubeClient()
+    sched = HivedScheduler(build_config(**config_args), kube_client=client)
+    nodes = sched.core.configured_node_names()
+    for n in nodes:
+        sched.add_node(Node(name=n))
+    sched.mark_ready()
+    _drive_and_confirm(sched, nodes, n_gangs, shapes=DENSE_GANG_SHAPES)
+    sched.note_watermark(1)
+    assert sched.flush_snapshot_now(), "snapshot flush failed"
+    clean_chunks = list(client.snapshot)
+
+    # Corrupt the chain family with the FEWEST bound pods, located by
+    # manifest byte offsets: recovery cost is proportional to the
+    # damaged family's pod share, so this is the scenario the sectioned
+    # schema buys the most on — and corrupting the LARGEST family
+    # degrades toward full-replay cost by design (doc/hot-path.md
+    # records that honest null).
+    snap, _reason = snapshot_mod.decode(
+        clean_chunks, sched._config_fingerprint, None
+    )
+    assert snap is not None, _reason
+    fam_pods = {
+        f["name"]: len(f.get("pods") or ())
+        for f in snap.get("_families") or ()
+    }
+    assert fam_pods, "no chain-family sections in the envelope"
+    target_name = min(fam_pods, key=fam_pods.get)
+    manifest = json.loads(clean_chunks[0])
+    offset = 0
+    target = None
+    for entry in manifest["sections"]:
+        if entry.get("name") == target_name:
+            target = (entry, offset)
+        offset += entry["bytes"]
+    assert target is not None, (target_name, manifest["sections"])
+    entry, start = target
+    body = "".join(clean_chunks[1:])
+    pos = start + entry["bytes"] // 2
+    corrupt_body = (
+        body[:pos] + ("X" if body[pos] != "X" else "Y") + body[pos + 1:]
+    )
+    corrupt_chunks = [clean_chunks[0], corrupt_body]
+
+    bound = [
+        st.pod
+        for st in sched.pod_schedule_statuses.values()
+        if st.pod is not None and st.pod.node_name
+    ]
+    node_objs = [Node(name=n) for n in nodes]
+
+    def recover_once(chunks):
+        # Every arm gets the same hot-standby treatment: the prefetch
+        # beat (decode + pre-apply; scoped to the healthy families when
+        # the envelope is corrupt) runs BEFORE the timed window, like a
+        # standby that was idling when the leader died. The full-replay
+        # arm's standby finds nothing to warm — a lost envelope leaves
+        # nothing to pre-apply — so its blackout carries the whole
+        # annotation replay.
+        kube = _SnapshotKubeClient()
+        if chunks is not None:
+            kube.snapshot = list(chunks)
+        fresh = HivedScheduler(build_config(**config_args), kube_client=kube)
+        fresh.prefetch_snapshot(min_watermark=0, apply=True)
+        t0 = time.perf_counter()
+        fresh.recover(node_objs, bound, min_watermark=0)
+        return (time.perf_counter() - t0) * 1e3, fresh
+
+    full_ms, partial_ms = [], []
+    replayed_sections = 0
+    shadow = partial = clean = None
+    for _ in range(reps):
+        ms, shadow = recover_once(None)
+        assert shadow._recovery_mode == "full"
+        full_ms.append(ms)
+        ms, partial = recover_once(corrupt_chunks)
+        assert partial._recovery_mode == "snapshot+partial", (
+            partial._recovery_mode
+        )
+        m = partial.get_metrics()
+        assert m["snapshotSectionFallbackCount"] >= 1
+        replayed_sections = m["snapshotSectionFallbackCount"]
+        partial_ms.append(ms)
+    _, clean = recover_once(clean_chunks)
+    assert clean._recovery_mode == "snapshot+delta", clean._recovery_mode
+
+    # The differential: partial fallback must be INVISIBLE in the landed
+    # state — identical to the full replay AND the never-corrupted
+    # snapshot shadow, pod set included.
+    fp_partial = physical_fingerprint(partial)
+    assert fp_partial == physical_fingerprint(shadow), (
+        "partial fallback diverged from full replay"
+    )
+    assert fp_partial == physical_fingerprint(clean), (
+        "partial fallback diverged from the never-corrupted shadow"
+    )
+    assert (
+        set(partial.pod_schedule_statuses)
+        == set(shadow.pod_schedule_statuses)
+        == set(clean.pod_schedule_statuses)
+    )
+
+    # Object-store wall: persist (chunk writes + fsync + atomic flip +
+    # GC) and load of the same envelope, with GC holding exactly N.
+    keep = 3
+    store_dir = tempfile.mkdtemp(prefix="hived-bench-store-")
+    try:
+        store = FileSnapshotStore(store_dir, keep_generations=keep)
+        persist_ms, load_ms = [], []
+        for _ in range(max(store_reps, keep + 1)):
+            t0 = time.perf_counter()
+            store.persist(clean_chunks)
+            persist_ms.append((time.perf_counter() - t0) * 1e3)
+            t0 = time.perf_counter()
+            loaded = store.load()
+            load_ms.append((time.perf_counter() - t0) * 1e3)
+        assert loaded == clean_chunks, "store round-trip mismatch"
+        on_disk = [
+            n for n in os.listdir(store_dir) if n.startswith("gen-")
+        ]
+        assert len(on_disk) == keep, on_disk
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+    full_med = statistics.median(full_ms)
+    partial_med = statistics.median(partial_ms)
+    return _stage_meta({
+        "fleet_hosts": 16 * cubes + 4 * slices + solos,
+        "pods_recovered": len(bound),
+        "snapshot_bytes": sum(len(c) for c in clean_chunks),
+        "family_sections": sum(
+            1 for s in manifest["sections"] if s.get("chains")
+        ),
+        "corrupt_section_bytes": entry["bytes"],
+        "corrupt_family_pods": fam_pods[target_name],
+        "replayed_sections": replayed_sections,
+        "warm_standby": True,  # prefetch+pre-apply outside the window
+        "full_replay_ms": round(full_med, 2),
+        "partial_fallback_ms": round(partial_med, 2),
+        "partial_speedup": (
+            round(full_med / partial_med, 2) if partial_med else 0.0
+        ),
+        "speedup_gate": 3.0,  # acceptance: partial >= 3x full replay
+        "gate_passed": bool(
+            partial_med and full_med / partial_med >= 3.0
+        ),
+        "store_persist_ms": round(statistics.median(persist_ms), 3),
+        "store_load_ms": round(statistics.median(load_ms), 3),
+        "store_gc_kept": keep,
+    }, 16 * cubes + 4 * slices + solos, t0_stage)
+
+
 def bench_recovery(sched) -> dict:
     """Full restart recovery: rebuild a fresh scheduler purely from the
     bound pods' annotations (the informer replay path), timed end-to-end —
@@ -3083,6 +3315,33 @@ if __name__ == "__main__":
                     "unit": "x",
                     "vs_baseline": round(
                         result["speedup_10k"] / result["speedup_gate"], 3
+                    ),
+                    "extra": result,
+                }
+            )
+        )
+        sys.exit(0)
+    if os.environ.get("HIVED_BENCH_STORE") == "1":
+        # Durable-state plane v2 (doc/fault-model.md): partial-fallback
+        # recovery A/B + object-store wall (hack/soak.sh --store).
+        # Smoke sizing for CI: HIVED_BENCH_STORE_SMOKE=1 (tiny fleet;
+        # wiring, not the perf gate).
+        if os.environ.get("HIVED_BENCH_STORE_SMOKE") == "1":
+            result = bench_store(
+                cubes=2, slices=4, solos=2, n_gangs=60,
+                reps=1, store_reps=2,
+            )
+        else:
+            result = bench_store()
+        print(
+            json.dumps(
+                {
+                    "metric": "partial_fallback_speedup",
+                    "value": result["partial_speedup"],
+                    "unit": "x",
+                    "vs_baseline": round(
+                        result["partial_speedup"]
+                        / result["speedup_gate"], 3
                     ),
                     "extra": result,
                 }

@@ -44,6 +44,13 @@
 # then the HIVED_BENCH_OUTAGE acceptance stage (432-host blackout
 # mid-load: zero 500s, degraded-filter p99 budget, measured drain —
 # doc/fault-model.md "Control-plane weather plane"): hack/soak.sh --outage
+# Durable-store focus: --store runs the store-fault-weighted chaos sweep
+# (the additive store event family: torn chunk writes, missing sections,
+# bit flips, stale manifests, slow stores) plus the section-validation
+# sensitivity meta-test, then the HIVED_BENCH_STORE acceptance stage
+# (432-host partial-fallback recovery A/B behind a hot standby + the
+# object-store persist/load wall — doc/fault-model.md "Durable-state
+# plane v2"): hack/soak.sh --store
 # Supervision focus: --supervise runs the kill/hang-weighted supervise
 # chaos sweep (tests/chaos.py step_supervise: worker SIGKILLs and hangs
 # against REAL worker processes, degraded-admission asserts after every
@@ -140,6 +147,19 @@ if [[ "${1:-}" == "--outage" ]]; then
     -q -p no:cacheprovider
   echo "outage bench: apiserver blackout mid-load at the 432-host fleet"
   exec env HIVED_BENCH_OUTAGE=1 python bench.py "$@"
+fi
+
+if [[ "${1:-}" == "--store" ]]; then
+  shift
+  export JAX_PLATFORMS=cpu
+  rounds="${HIVED_CHAOS_ROUNDS:-200}"
+  echo "store soak: ${rounds} store-fault-weighted chaos schedules + sensitivity"
+  HIVED_CHAOS_STORE_ROUNDS="${rounds}" python -m pytest \
+    "tests/test_chaos.py::test_chaos_store_mix_sweep" \
+    "tests/test_chaos.py::test_nooped_section_validation_is_caught" \
+    -q -p no:cacheprovider
+  echo "store bench: partial-fallback A/B + object-store wall at 432 hosts"
+  exec env HIVED_BENCH_STORE=1 python bench.py "$@"
 fi
 
 if [[ "${1:-}" == "--audit" ]]; then

@@ -130,10 +130,34 @@ def main(argv=None) -> int:
             "KUBE_APISERVER_ADDRESS", "https://kubernetes.default.svc"
         )
         client = KubeAPIClient(apiserver)
+        # Durable-state plane v2: an optional object-store backend for the
+        # snapshot envelope (snapshotStoreBackend: file). None keeps the
+        # ConfigMap chunk family default.
+        from .scheduler.scrub import SnapshotScrubber
+        from .scheduler.store import make_snapshot_store
+
+        snapshot_store = make_snapshot_store(config)
+        if snapshot_store is not None:
+            common.log.info(
+                "snapshot store backend: %s (GC keeps last %d generations)",
+                snapshot_store.name, config.snapshot_store_gc_generations,
+            )
         # Write path goes through the fault absorber: transient apiserver
         # errors are retried with backoff; terminal 404/409 failures release
         # the assume-bind allocation (doc/fault-model.md).
-        scheduler.kube_client = RetryingKubeClient(client, scheduler=scheduler)
+        scheduler.kube_client = RetryingKubeClient(
+            client, scheduler=scheduler, snapshot_store=snapshot_store
+        )
+        # Continuous integrity scrubber: rides the flusher beats on the
+        # leader and the standby beats on a hot standby;
+        # HIVED_SNAPSHOT_SCRUB=0 is the emergency hatch. Single-process
+        # only — the sharded frontend's per-shard partition slots carry
+        # their own per-slot checksums (scheduler.shards).
+        if isinstance(scheduler, HivedScheduler):
+            scheduler.scrubber = SnapshotScrubber(
+                scheduler,
+                interval_beats=config.snapshot_scrub_interval_beats,
+            )
         informer = InformerLoop(scheduler, client)
         if args.ha:
             from .scheduler.ha import LeaderElector, StandbyLoop
@@ -172,6 +196,12 @@ def main(argv=None) -> int:
                 # both the JSON decode and the projection restore — the
                 # failover blackout is just the delta replay.
                 scheduler.prefetch_snapshot(apply=True)
+                # Anti-entropy: fingerprint the pre-applied projection
+                # against the durable envelope every few beats; rot is
+                # discarded and re-prefetched (scheduler.scrub).
+                scrub = getattr(scheduler, "scrubber", None)
+                if scrub is not None:
+                    scrub.tick()
 
             StandbyLoop(
                 elector,

@@ -1580,7 +1580,9 @@ class HivedCore:
         self.preferred_doomed_virtual = {}
         self.doomed_ledger_mode = False
 
-    def rebuild_doomed_from_ledger(self) -> None:
+    def rebuild_doomed_from_ledger(
+        self, chains: Optional[Set[str]] = None
+    ) -> None:
         """Make the advisory doomed set exactly the persisted ledger's:
         retire the organic dooms the constructor's all-nodes-bad bootstrap
         bound (they predate the ledger and sit on arbitrary cells), then
@@ -1588,11 +1590,20 @@ class HivedCore:
         Called by recover() before the node-health replay, while every
         cell is still marked bad — the ledger cells (bad on the pre-crash
         side, or they would not be listed) are guaranteed bindable. No-op
-        outside ledger mode (first boot: organic dooming stands)."""
+        outside ledger mode (first boot: organic dooming stands).
+
+        ``chains`` scopes both the retire and the bind to those chains —
+        the PARTIAL snapshot import's doom gate: corrupt-section chains
+        still sit in the constructor's bootstrap state (bad cells,
+        possibly organically doomed by the non-fold boot path) and need
+        the ledger rebuild, while healthy-section chains already restored
+        their doomed bindings verbatim and must not be touched."""
         if not self.doomed_ledger_mode:
             return
         for vcn, per_chain in self.vc_doomed_bad_cells.items():
             for chain, ccl in per_chain.items():
+                if chains is not None and str(chain) not in chains:
+                    continue
                 for level in list(ccl.levels):
                     for c in list(ccl.levels[level]):
                         if c.priority < MIN_GUARANTEED_PRIORITY:
@@ -1601,6 +1612,8 @@ class HivedCore:
         for (vcn, chain, level), addresses in sorted(
             self.preferred_doomed.items()
         ):
+            if chains is not None and str(chain) not in chains:
+                continue
             doomed = self.vc_doomed_bad_cells.get(vcn, {}).get(chain)
             preassigned = self.vc_schedulers[vcn].non_pinned_preassigned
             if doomed is None or chain not in preassigned:
@@ -1697,15 +1710,34 @@ class HivedCore:
         cleared wholesale by restore_projection (direct field writes
         bypass the mutator hooks). tests/test_snapshot_ha.py proves the
         memoized assembly identical to a cold rebuild differentially."""
-        sections: List[Dict] = []
-        for chain in self.full_cell_list:
-            epoch = self.chain_epoch(chain)
-            cached = self._export_chain_memo.get(chain)
-            if cached is None or cached[0] != epoch:
-                cached = self._export_chain_memo[chain] = (
-                    epoch, self._export_chain_section(chain)
-                )
-            sections.append(cached[1])
+        sections = [
+            self._chain_section_cached(chain) for chain in self.full_cell_list
+        ]
+        merged = self._merge_projection_sections(sections)
+        # Groups without a placement chain (none in a normalized export;
+        # defensive) are attributed fresh each walk.
+        groups = merged["groups"]
+        for name, g in self.affinity_groups.items():
+            if name not in groups and group_chain(g) is None:
+                groups[name] = self._export_group_record(g)
+        return merged
+
+    def _chain_section_cached(self, chain: CellChain) -> Dict:
+        epoch = self.chain_epoch(chain)
+        cached = self._export_chain_memo.get(chain)
+        if cached is None or cached[0] != epoch:
+            cached = self._export_chain_memo[chain] = (
+                epoch, self._export_chain_section(chain)
+            )
+        return cached[1]
+
+    @staticmethod
+    def _merge_projection_sections(sections: List[Dict]) -> Dict:
+        """Merge per-chain (or per-family) export sections into one core
+        body — mirrored byte-for-byte by scheduler.snapshot's
+        merge_core_slices (which reassembles a sectioned snapshot's
+        healthy families without importing this module); the snapshot
+        differential tests pin the two equivalent."""
         phys: Dict[str, List] = {}
         virt: Dict[str, List] = {}
         free_lists: Dict[str, Dict] = {}
@@ -1732,11 +1764,6 @@ class HivedCore:
             total_left.update(sec["totalLeft"])
             all_vc_doomed.update(sec["allVCDoomed"])
             groups.update(sec["groups"])
-        # Groups without a placement chain (none in a normalized export;
-        # defensive) are attributed fresh each walk.
-        for name, g in self.affinity_groups.items():
-            if name not in groups and group_chain(g) is None:
-                groups[name] = self._export_group_record(g)
         return {
             "phys": phys,
             "virt": virt,
@@ -1752,6 +1779,58 @@ class HivedCore:
             },
             "groups": groups,
         }
+
+    def export_projection_sections(self) -> Tuple[List[Dict], Dict]:
+        """The durable projection sliced per CHAIN FAMILY (the compiled
+        shares-a-leaf-SKU partition, compiler.chain_families) — the unit
+        of the sectioned snapshot (schema v3): each family's slice is the
+        merge of its chains' memoized export sections, so a family whose
+        chains were quiet since the last flush costs dict lookups, not a
+        cell walk. Returns ``(families, chainless_groups)``: families is
+        ``[{"chains": [...], "core": {...}}]`` in compiled-family order;
+        chainless_groups are the no-placement groups export_projection
+        attributes fresh each walk (they belong to no family and ride the
+        snapshot's meta section). Same normalization contract as
+        export_projection."""
+        families: List[Dict] = []
+        for chains in self.compiled.families:
+            secs = [
+                self._chain_section_cached(c)
+                for c in chains
+                if c in self.full_cell_list
+            ]
+            families.append({
+                "chains": [str(c) for c in chains],
+                "core": self._merge_projection_sections(secs),
+            })
+        chainless = {
+            name: self._export_group_record(g)
+            for name, g in self.affinity_groups.items()
+            if group_chain(g) is None
+        }
+        return families, chainless
+
+    def family_node_names(self) -> List[Set[str]]:
+        """Per chain-family node-name sets (config-static, cached on
+        first use): which hosts carry each family's cells. The partial
+        snapshot import uses this for the demotion closure — a node that
+        hosts BOTH a corrupt and a healthy family forces the healthy one
+        down to annotation replay too, because node-level health records
+        cannot be split between a restored and a replayed family."""
+        cached = getattr(self, "_family_nodes_cache", None)
+        if cached is None:
+            cached = []
+            for chains in self.compiled.families:
+                nodes: Set[str] = set()
+                for chain in chains:
+                    ccl = self.full_cell_list.get(chain)
+                    if ccl is None:
+                        continue
+                    for c in ccl[ccl.top_level]:
+                        nodes.update(c.nodes)
+                cached.append(nodes)
+            self._family_nodes_cache = cached
+        return cached
 
     def _export_cell_groups(self) -> Dict:
         """chain -> (physical cells, virtual cells): static post-compile,
@@ -1913,6 +1992,7 @@ class HivedCore:
         core_body: Dict,
         health: Optional[Dict] = None,
         live_node_names: Optional[Set[str]] = None,
+        chains: Optional[Set[str]] = None,
     ) -> None:
         """Reinstate an exported projection by direct field assignment —
         the O(delta) recovery fast path. Every mutable field of every cell
@@ -1925,6 +2005,17 @@ class HivedCore:
         configured node absent from the live list is marked bad, exactly
         the state full replay leaves it in (the constructor's bootstrap
         badness never healed by a node event).
+
+        ``chains`` scopes the restore to those chains for the PARTIAL
+        snapshot import (sectioned snapshots, doc/fault-model.md
+        "Durable-state plane v2"): cells, listings, and counters of
+        chains OUTSIDE the set are left completely untouched — on the
+        VIRGIN core the partial import runs against, that is exactly the
+        constructor's all-bad bootstrap state full annotation replay
+        starts from, so the excluded (corrupt-section) chains replay from
+        annotations while the scoped ones restore wholesale. Scoped
+        restore is only meaningful on a virgin core; the unscoped default
+        keeps the historical does-not-depend-on-prior-state contract.
 
         The caller (framework.import_snapshot) wraps any failure here in a
         wholesale reset + full annotation replay — a half-restored core is
@@ -1943,6 +2034,8 @@ class HivedCore:
         for addr, c in self._phys_cell_index.items():
             if addr in phys_recs:
                 continue  # every field overwritten by its record below
+            if chains is not None and str(c.chain) not in chains:
+                continue  # out-of-scope chain: untouched (partial import)
             c.state = free
             c.priority = FREE_PRIORITY
             c.healthy = True
@@ -1957,6 +2050,8 @@ class HivedCore:
         for addr, v in self._virt_cell_index.items():
             if addr in virt_recs:
                 continue
+            if chains is not None and str(v.chain) not in chains:
+                continue
             v.state = free
             v.priority = FREE_PRIORITY
             v.healthy = True
@@ -1964,11 +2059,27 @@ class HivedCore:
             v.unusable_leaf_num = 0
             if v.used_leaf_cells_at_priority:
                 v.used_leaf_cells_at_priority.clear()
-        self.bound_physical.clear()
+        if chains is None:
+            self.bound_physical.clear()
+        else:
+            for addr in [
+                a for a, c in self.bound_physical.items()
+                if str(c.chain) in chains
+            ]:
+                del self.bound_physical[addr]
 
         # Groups first (no cell pointers yet) so the physical records can
-        # resolve using-group names.
-        self.affinity_groups = {}
+        # resolve using-group names. A scoped restore keeps the groups of
+        # out-of-scope chains (none on the virgin core it targets;
+        # defensive) — a group record only ever references cells of its
+        # own chain, so cross-family pointers cannot dangle.
+        if chains is None:
+            self.affinity_groups = {}
+        else:
+            self.affinity_groups = {
+                n: g for n, g in self.affinity_groups.items()
+                if (gc := group_chain(g)) is not None and str(gc) not in chains
+            }
         groups = self.affinity_groups
         for name, rec in (core_body.get("groups") or {}).items():
             g = AffinityGroup(
@@ -2060,22 +2171,39 @@ class HivedCore:
                 for c in cells:
                     ccl[int(l)].append(c)
 
+        def in_scope(chain) -> bool:
+            return chains is None or str(chain) in chains
+
         free_dump = core_body.get("freeLists") or {}
         for chain, ccl in self.free_cell_list.items():
-            fill_ccl(ccl, free_dump.get(str(chain)))
+            if in_scope(chain):
+                fill_ccl(ccl, free_dump.get(str(chain)))
         bad_free_dump = core_body.get("badFree") or {}
         for chain, ccl in self.bad_free_cells.items():
-            fill_ccl(ccl, bad_free_dump.get(str(chain)))
+            if in_scope(chain):
+                fill_ccl(ccl, bad_free_dump.get(str(chain)))
         doomed_dump = core_body.get("vcDoomed") or {}
         for vcn, per_chain in self.vc_doomed_bad_cells.items():
             vc_dump = doomed_dump.get(str(vcn)) or {}
             for chain, ccl in per_chain.items():
-                fill_ccl(ccl, vc_dump.get(str(chain)))
-        self._ot_cells = {}
+                if in_scope(chain):
+                    fill_ccl(ccl, vc_dump.get(str(chain)))
+        if chains is None:
+            self._ot_cells = {}
+        else:
+            for vcn in list(self._ot_cells):
+                kept = {
+                    a: c for a, c in self._ot_cells[vcn].items()
+                    if str(c.chain) not in chains
+                }
+                if kept:
+                    self._ot_cells[vcn] = kept
+                else:
+                    del self._ot_cells[vcn]
         for vcn, addrs in (core_body.get("otCells") or {}).items():
-            self._ot_cells[vcn] = {
+            self._ot_cells.setdefault(vcn, {}).update({
                 a: self._phys_cell_index[a] for a in addrs
-            }
+            })
 
         counters = core_body.get("counters") or {}
 
@@ -2083,6 +2211,8 @@ class HivedCore:
             target: Dict[CellChain, Dict[CellLevel, int]], dumped: Dict
         ) -> None:
             for chain in list(target):
+                if not in_scope(chain):
+                    continue
                 per = (dumped or {}).get(str(chain)) or {}
                 target[chain] = {int(l): n for l, n in per.items()}
 

@@ -115,6 +115,22 @@ class Config:
     # lease_renew_seconds and is deposed lease_duration_seconds after its
     # last successful renewal.
     snapshot_interval_seconds: float = 0.0
+    # Durable-state plane v2 (doc/fault-model.md). The flusher's export
+    # gate skips while preempt churn is live; past
+    # snapshot_max_staleness_seconds a refused flush arms a forced retry
+    # at the next quiet point (0 disables the override). The store knobs
+    # select where chunks persist: "configmap" (default, the PR 7 chunk
+    # family) or "file" (the object-store backend, scheduler.store —
+    # write-new-then-flip manifest pointer under snapshot_store_path, no
+    # 1MiB cap, generation GC keeping the last
+    # snapshot_store_gc_generations). snapshot_scrub_interval_beats > 0
+    # arms the continuous integrity scrubber (scheduler.scrub) every that
+    # many flusher beats; HIVED_SNAPSHOT_SCRUB=0 is the no-rollout hatch.
+    snapshot_max_staleness_seconds: float = 0.0
+    snapshot_store_backend: str = "configmap"
+    snapshot_store_path: str = ""
+    snapshot_store_gc_generations: int = 3
+    snapshot_scrub_interval_beats: int = 4
     lease_duration_seconds: float = 15.0
     lease_renew_seconds: float = 5.0
     # Multi-process scheduling core (doc/hot-path.md "The multi-process
@@ -165,6 +181,11 @@ class Config:
         tr_cap = d.get("traceRingCapacity")
         wc_cap = d.get("waitCacheCapacity")
         snap_s = d.get("snapshotIntervalSeconds")
+        snap_stale = d.get("snapshotMaxStalenessSeconds")
+        store_be = d.get("snapshotStoreBackend")
+        store_path = d.get("snapshotStorePath")
+        store_gc = d.get("snapshotStoreGcGenerations")
+        scrub_b = d.get("snapshotScrubIntervalBeats")
         lease_d = d.get("leaseDurationSeconds")
         lease_r = d.get("leaseRenewSeconds")
         procs = d.get("procShards")
@@ -215,6 +236,21 @@ class Config:
             ),
             snapshot_interval_seconds=(
                 0.0 if snap_s is None else float(snap_s)
+            ),
+            snapshot_max_staleness_seconds=(
+                0.0 if snap_stale is None else float(snap_stale)
+            ),
+            snapshot_store_backend=(
+                "configmap" if store_be is None else str(store_be)
+            ),
+            snapshot_store_path=(
+                "" if store_path is None else str(store_path)
+            ),
+            snapshot_store_gc_generations=(
+                3 if store_gc is None else int(store_gc)
+            ),
+            snapshot_scrub_interval_beats=(
+                4 if scrub_b is None else int(scrub_b)
             ),
             lease_duration_seconds=(
                 15.0 if lease_d is None else float(lease_d)

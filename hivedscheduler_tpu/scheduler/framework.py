@@ -204,6 +204,11 @@ class SchedulerMetrics:
         self.snapshot_persist_count = 0
         self.snapshot_persist_failure_count = 0
         self.snapshot_fallback_count = 0
+        # Durable-state plane v2 (doc/fault-model.md): chain-family
+        # sections demoted to the scoped annotation replay (corrupt or
+        # doom-diverged) while the rest of the snapshot restored — the
+        # partial fallback that replaced the all-or-nothing cliff.
+        self.snapshot_section_fallback_count = 0
         self.deposed_bind_refused_count = 0
         # Control-plane weather plane (doc/fault-model.md): bind writes
         # refused retriably because the apiserver is in blackout (the
@@ -359,6 +364,10 @@ class SchedulerMetrics:
         with self._lock:
             self.snapshot_fallback_count += 1
 
+    def observe_snapshot_section_fallback(self, sections: int = 1) -> None:
+        with self._lock:
+            self.snapshot_section_fallback_count += sections
+
     def observe_deposed_bind_refused(self) -> None:
         with self._lock:
             self.deposed_bind_refused_count += 1
@@ -416,6 +425,9 @@ class SchedulerMetrics:
                     self.snapshot_persist_failure_count
                 ),
                 "snapshotFallbackCount": self.snapshot_fallback_count,
+                "snapshotSectionFallbackCount": (
+                    self.snapshot_section_fallback_count
+                ),
                 "deposedBindRefusedCount": self.deposed_bind_refused_count,
                 "outageBindRefusedCount": self.outage_bind_refused_count,
                 "outageWaitCount": self.outage_wait_count,
@@ -473,6 +485,15 @@ BLACKBOX_EMPTY_METRICS = {
     "auditViolationCount": 0,
     "flightRecorderEventCount": 0,
     "flightRecorderReanchorCount": 0,
+}
+
+# Durable-state plane v2 scrubber keys (doc/observability.md): always
+# present so the golden metrics schema holds with the scrubber disabled
+# (HIVED_SNAPSHOT_SCRUB=0 or no operator wiring).
+SCRUB_EMPTY_METRICS = {
+    "scrubRunCount": 0,
+    "scrubDivergenceCount": 0,
+    "scrubRepairCount": 0,
 }
 
 
@@ -690,6 +711,12 @@ class HivedScheduler:
         # only the delta replay.
         self._prefetched_snapshot: Optional[Tuple[List[str], Dict]] = None
         self._preapplied_chunks: Optional[List[str]] = None
+        # Non-None when the pre-apply was PARTIAL (corrupt sections in
+        # the prefetched envelope): the chain set the standby left in
+        # bootstrap state for the takeover's scoped annotation replay.
+        # Takeover trusts the pre-apply only if its own gate (run against
+        # the real crash ledger) demotes exactly the same chains.
+        self._preapplied_replay: Optional[frozenset] = None
         self._last_snapshot_chunks: Optional[List[str]] = None
         # Imported pods released mid-replay by a claim conflict: their live
         # events may already have been visited, so finish_recovery re-adds
@@ -723,6 +750,25 @@ class HivedScheduler:
         self._snapshot_write_lock = threading.Lock()
         self._flusher_stop: Optional[threading.Event] = None
         self._flusher_thread: Optional[threading.Thread] = None
+        # Max-staleness override (doc/fault-model.md "Durable-state plane
+        # v2"): the flusher's export gate refuses while a PREEMPTING
+        # group is live, so sustained preempt churn could starve
+        # snapshots forever. When a refused flush finds the snapshot past
+        # its staleness budget it raises the wanted flag; the next
+        # mutation-bracket exit (a quiet point by construction) pokes the
+        # flusher's wake event for an immediate retry instead of waiting
+        # out the interval. _last_flush_monotonic feeds the
+        # snapshotAgeSeconds gauge (-1 until the first flush); the age
+        # anchor also arms at mark_ready so a leader that never managed a
+        # single flush still trips the override.
+        self._flusher_wake: Optional[threading.Event] = None
+        self._snapshot_flush_wanted = False
+        self._last_flush_monotonic: Optional[float] = None
+        self._snapshot_age_anchor: Optional[float] = None
+        # Continuous integrity scrubber (scheduler.scrub): constructed by
+        # the operator wiring (__main__/ha), rides the flusher's beats.
+        # None = scrubbing disabled (tests, simulators, the env hatch).
+        self.scrubber = None
         # Leader-election gate (scheduler.ha.LeaderElector, or anything
         # with is_leader()). None = HA disabled: this process is always
         # the leader (single-scheduler deployments, tests, simulators).
@@ -1041,6 +1087,13 @@ class HivedScheduler:
         self._mutation_depth.d -= 1
         if self._mutation_depth.d == 0:
             self._flush_side_effects()
+            if self._snapshot_flush_wanted:
+                # Staleness override: a refused flush found the snapshot
+                # past its budget; this quiet point is the flusher's
+                # earliest legal retry (the export gate re-checks).
+                wake = self._flusher_wake
+                if wake is not None:
+                    wake.set()
 
     def _on_preemption_event(self, group, event: str) -> None:
         """Core observer (called under the acting thread's chain section):
@@ -1446,6 +1499,8 @@ class HivedScheduler:
 
     def mark_ready(self) -> None:
         """Recovery (initial list replay) complete: /readyz turns 200."""
+        if self._snapshot_age_anchor is None:
+            self._snapshot_age_anchor = time.monotonic()
         self._ready.set()
 
     def is_ready(self) -> bool:
@@ -1475,13 +1530,20 @@ class HivedScheduler:
         with self._lock:
             if not self._ready.is_set():
                 return None
-            exported = self._export_body_locked()
-            if exported is None:
+            raw = self._export_sections_locked()
+            if raw is None:
                 return None
-            body, pods_json = exported
             watermark = self._watermark
-        return snapshot_mod.encode(
-            body, self._config_fingerprint, watermark, pods_json=pods_json
+        # Render + checksum outside the lock: section payloads reference
+        # the core's memoized per-chain dumps, which are rebuilt (never
+        # mutated) on epoch bumps — the same property the monolithic
+        # encoder relied on.
+        sections = [
+            (name, chains, snapshot_mod.section_text(payload, texts))
+            for name, chains, payload, texts in raw
+        ]
+        return snapshot_mod.encode_sections(
+            sections, self._config_fingerprint, watermark
         )
 
     def export_fork_body(self) -> Optional[Dict]:
@@ -1503,17 +1565,14 @@ class HivedScheduler:
             body, _pods_json = exported
         return body
 
-    def _export_body_locked(
+    def _export_pods_locked(
         self,
         for_fork: bool = False,
-    ) -> Optional[Tuple[Dict, List[str]]]:
-        """The durable projection, exactly the state the chaos harness
-        proves restart-equivalent: the core's verbatim cell-level
-        projection (free/bad-free/doomed listings, sparse cell records,
-        quota counters, allocated groups) plus the confirmed-BOUND pods
-        with their decoded spec/bind-info and slot index (so import can
-        slot them without decoding), the applied health records, and the
-        doomed-ledger epoch.
+    ) -> Optional[Tuple[List[Dict], List[str]]]:
+        """The pod half of the durable projection — the confirmed-BOUND
+        pods with their decoded spec/bind-info and slot index (so import
+        can slot them without decoding) — as parallel record/serialized
+        lists, plus the export GATE both snapshot layouts share.
 
         Returns None — skip this flush — while the projection carries
         transient overlays a real crash would forget: a PREEMPTING group
@@ -1620,6 +1679,24 @@ class HivedScheduler:
         # groups always replay from live preempt-info annotations — they
         # are deltas by nature), and the ALLOCATED-only gate above means
         # a flush can never coexist with a PREEMPTING group anyway.
+        return pods_out, pods_json
+
+    def _export_body_locked(
+        self,
+        for_fork: bool = False,
+    ) -> Optional[Tuple[Dict, List[str]]]:
+        """The durable projection as ONE MERGED body, exactly the state
+        the chaos harness proves restart-equivalent: the core's verbatim
+        cell-level projection (free/bad-free/doomed listings, sparse cell
+        records, quota counters, allocated groups) plus the bound pods,
+        the applied health records, and the doomed-ledger epoch. Used by
+        fork exports (scheduler.whatif) and anywhere a monolithic body is
+        still the right shape; the flusher exports per-family SECTIONS
+        instead (_export_sections_locked)."""
+        exported = self._export_pods_locked(for_fork)
+        if exported is None:
+            return None
+        pods_out, pods_json = exported
         body = {
             "doomedEpoch": self.core.doomed_epoch,
             "health": self.core.health_snapshot(),
@@ -1627,6 +1704,71 @@ class HivedScheduler:
             "pods": pods_out,
         }
         return body, pods_json
+
+    def _export_sections_locked(
+        self,
+    ) -> Optional[List[Tuple[str, Optional[List[str]], Dict, Optional[List[str]]]]]:
+        """The durable projection as PER-CHAIN-FAMILY sections (schema
+        v3, doc/fault-model.md "Durable-state plane v2"): one section per
+        compiled chain family — its merged projection slice plus the
+        bound pods whose bind chain belongs to it — alongside the
+        load-bearing ``meta`` (doomed epoch, chain-less groups, orphan
+        pods) and ``health`` sections. Returns raw ``(name, chains,
+        payload, pods_json)`` tuples; the caller renders and checksums
+        OUTSIDE the lock. None = the export gate refused (see
+        _export_pods_locked)."""
+        exported = self._export_pods_locked(False)
+        if exported is None:
+            return None
+        pods_out, pods_json = exported
+        fams, chainless = self.core.export_projection_sections()
+        fam_of_chain: Dict[str, int] = {}
+        for i, fam in enumerate(fams):
+            for c in fam["chains"]:
+                fam_of_chain[str(c)] = i
+        fam_recs: List[List[Dict]] = [[] for _ in fams]
+        fam_texts: List[List[str]] = [[] for _ in fams]
+        orphan_recs: List[Dict] = []
+        orphan_texts: List[str] = []
+        for rec, text in zip(pods_out, pods_json):
+            i = fam_of_chain.get(str(rec["bindInfo"]["cellChain"]))
+            if i is None:
+                # A bind chain no compiled family covers (unreachable in
+                # steady state — bind infos validate against the config):
+                # rides the meta section, replayed like chain-less state.
+                orphan_recs.append(rec)
+                orphan_texts.append(text)
+            else:
+                fam_recs[i].append(rec)
+                fam_texts[i].append(text)
+        sections: List[
+            Tuple[str, Optional[List[str]], Dict, Optional[List[str]]]
+        ] = [
+            (
+                snapshot_mod.SECTION_META,
+                None,
+                {
+                    "doomedEpoch": self.core.doomed_epoch,
+                    "groups": chainless,
+                    "pods": orphan_recs,
+                },
+                orphan_texts,
+            ),
+            (
+                snapshot_mod.SECTION_HEALTH,
+                None,
+                self.core.health_snapshot(),
+                None,
+            ),
+        ]
+        for i, fam in enumerate(fams):
+            sections.append((
+                f"family:{i}",
+                list(fam["chains"]),
+                {"core": fam["core"], "pods": fam_recs[i]},
+                fam_texts[i],
+            ))
+        return sections
 
     def flush_snapshot_now(self) -> bool:
         """One flusher step: export under the guard, write outside it.
@@ -1636,6 +1778,21 @@ class HivedScheduler:
             return False
         chunks = self.export_snapshot()
         if chunks is None:
+            # Staleness override (doc/fault-model.md "Durable-state plane
+            # v2"): the export gate refuses while preempt churn is live,
+            # which under sustained churn would starve snapshots forever.
+            # Past the staleness budget, arm the wanted flag — the next
+            # mutation-bracket exit wakes the flusher for an immediate
+            # retry at that quiet point instead of the next interval beat.
+            max_stale = self.config.snapshot_max_staleness_seconds
+            anchor = self._snapshot_age_anchor
+            if (
+                max_stale > 0
+                and self._ready.is_set()
+                and anchor is not None
+                and time.monotonic() - anchor > max_stale
+            ):
+                self._snapshot_flush_wanted = True
             return False
         # _snapshot_write_lock serializes concurrent flushes so chunk
         # families cannot interleave; never held while holding chain locks.
@@ -1645,11 +1802,15 @@ class HivedScheduler:
             except Exception as e:  # noqa: BLE001
                 self.metrics.observe_snapshot_persist(False)
                 common.log.warning(
-                    "snapshot ConfigMap write failed (recovery falls back "
+                    "snapshot write failed (recovery falls back "
                     "to the previous snapshot or full replay): %s", e,
                 )
                 return False
         self.metrics.observe_snapshot_persist(True)
+        now = time.monotonic()
+        self._last_flush_monotonic = now
+        self._snapshot_age_anchor = now
+        self._snapshot_flush_wanted = False
         return True
 
     def start_snapshot_flusher(
@@ -1669,12 +1830,25 @@ class HivedScheduler:
         if interval <= 0 or self._flusher_thread is not None:
             return False
         stop = threading.Event()
+        wake = threading.Event()
 
         def loop() -> None:
-            while not stop.wait(interval):
+            # wake is the staleness-override doorbell: _exit_mutation
+            # sets it at a quiet point when a refused flush left the
+            # snapshot past its budget, turning the interval sleep into
+            # an immediate retry. The scrubber (scheduler.scrub) also
+            # rides these beats — event-clocked, never its own thread.
+            while not stop.is_set():
+                wake.wait(interval)
+                wake.clear()
+                if stop.is_set():
+                    break
                 try:
                     self.settle_health_wall()
                     self.flush_snapshot_now()
+                    scrub = self.scrubber
+                    if scrub is not None:
+                        scrub.tick()
                 except Exception:  # noqa: BLE001
                     common.log.exception("snapshot flusher step failed")
 
@@ -1682,6 +1856,7 @@ class HivedScheduler:
             target=loop, name="hived-snapshot-flusher", daemon=True
         )
         self._flusher_stop = stop
+        self._flusher_wake = wake
         self._flusher_thread = t
         t.start()
         return True
@@ -1689,9 +1864,12 @@ class HivedScheduler:
     def stop_snapshot_flusher(self) -> None:
         if self._flusher_stop is not None:
             self._flusher_stop.set()
+        if self._flusher_wake is not None:
+            self._flusher_wake.set()
         if self._flusher_thread is not None:
             self._flusher_thread.join(timeout=2.0)
         self._flusher_stop = None
+        self._flusher_wake = None
         self._flusher_thread = None
 
     def prefetch_snapshot(self, min_watermark=None, apply: bool = False) -> bool:
@@ -1734,7 +1912,51 @@ class HivedScheduler:
                 )
                 return False
             self._prefetched_snapshot = (chunks, snap)
+        corrupt = snap.get("_corrupt") or {}
+        partial = bool(corrupt.get("sections") or corrupt.get("chains"))
         if apply and not self._ready.is_set():
+            if partial:
+                # PARTIAL pre-apply: restore the healthy chain-family
+                # sections scoped on a fresh core NOW (the expensive
+                # restore runs on an idle standby beat, off the failover
+                # blackout path) and remember the demoted chain set. The
+                # gate here runs against whatever ledger the standby has
+                # (usually none); takeover re-gates against the real
+                # crash ledger and only trusts this pre-apply when both
+                # demote exactly the same chains — else it discards and
+                # restores scoped in-window, the plain partial path. The
+                # gate mutates ok flags, so it runs on copies to keep the
+                # cached decode pristine for the takeover's own gate.
+                families = snap.get("_families")
+                if not families:
+                    return True  # monolithic corruption never decodes
+                fams = [dict(f) for f in families]
+                usable, replay_chains, _n = (
+                    self._gate_sectioned_snapshot(fams)
+                )
+                if not usable:
+                    return True  # keep the decode warm, nothing to apply
+                scope = frozenset(str(c) for c in replay_chains)
+                if (
+                    self._preapplied_chunks == chunks
+                    and self._preapplied_replay == scope
+                ):
+                    return True  # idle beat, unchanged family: no-op
+                try:
+                    self._clear_imported_state()
+                    self._swap_fresh_core()
+                    self._import_snapshot_partial(
+                        snap, fams, replay_chains, live_names=None
+                    )
+                    self._preapplied_chunks = list(chunks)
+                    self._preapplied_replay = scope
+                except Exception:  # noqa: BLE001
+                    common.log.exception(
+                        "hot-standby partial pre-apply failed; takeover "
+                        "will restore from the decoded snapshot instead",
+                    )
+                    self._clear_imported_state()
+                return True
             try:
                 self._clear_imported_state()
                 self._import_snapshot_state(snap, live_names=None)
@@ -1784,6 +2006,7 @@ class HivedScheduler:
             self._chip_targets.clear()
             self._damper.reset()
             self._preapplied_chunks = None
+            self._preapplied_replay = None
         self._wait_cache_clear()
 
     def load_valid_snapshot(self, min_watermark=None) -> Optional[Dict]:
@@ -1847,16 +2070,34 @@ class HivedScheduler:
         bootstrap state, the proven PR-3 path). The window is one doom
         change between the last flush and the crash — rare at production
         cadence, and the fallback is the deterministic degraded mode the
-        fault model already guarantees."""
+        fault model already guarantees.
+
+        At schema v3 both the gate and the fallback are SECTION-GRANULAR
+        (doc/fault-model.md "Durable-state plane v2"): each chain-family
+        section is doom-gated against the ledger's entries for its own
+        chains, and a corrupt or diverged family demotes to the scoped
+        annotation replay (mode "snapshot+partial") while every healthy
+        section restores wholesale. Monolithic layouts (v2 read-compat,
+        single-body v3) keep the historical all-or-nothing behavior."""
         chunks = self._last_snapshot_chunks
         preapplied = (
             self._preapplied_chunks is not None
             and chunks == self._preapplied_chunks
         )
-        if not self._snapshot_dooms_match_ledger(snap):
+        families = snap.get("_families") or snapshot_mod._single_family(snap)
+        sectioned = any(f.get("chains") is not None for f in families)
+        if sectioned:
+            usable, replay_chains, n_fallback = (
+                self._gate_sectioned_snapshot(families)
+            )
+        else:
+            usable = self._snapshot_dooms_match_ledger(snap)
+            replay_chains, n_fallback = set(), 0
+        if not usable:
             common.log.warning(
                 "persisted snapshot's doomed bindings diverge from the "
-                "crash ledger; recovering by full annotation replay",
+                "crash ledger (or no chain-family section survived); "
+                "recovering by full annotation replay",
             )
             self.metrics.observe_snapshot_fallback()
             if preapplied or self._preapplied_chunks is not None:
@@ -1867,6 +2108,68 @@ class HivedScheduler:
                 self.core.rebuild_doomed_from_ledger()
             return False
         live_names = {n.name for n in nodes}
+        if replay_chains:
+            if (
+                preapplied
+                and self._preapplied_replay is not None
+                and self._preapplied_replay
+                == {str(c) for c in replay_chains}
+            ):
+                # Hot-standby PARTIAL fast path: the healthy families are
+                # already restored in this process (pre-applied on a
+                # standby beat with the SAME replay scope this gate just
+                # computed), so the blackout shrinks to the demoted
+                # chains' annotation replay plus the node delta. The
+                # scoped doom rebuild re-runs here because the standby
+                # gated against its own (possibly absent) ledger copy
+                # while begin_recovery just installed the real one.
+                with self._lock:
+                    for name in self.core.configured_node_names():
+                        if name not in live_names:
+                            self.core.set_bad_node(name)
+                    for n, chips in self.core.bad_chips.items():
+                        self._chip_targets[n] = set(chips)
+                    self.core.rebuild_doomed_from_ledger(
+                        chains={str(c) for c in replay_chains}
+                    )
+                self.metrics.observe_snapshot_section_fallback(n_fallback)
+                common.log.warning(
+                    "partial snapshot fallback (hot standby): %d "
+                    "section(s) covering chain(s) %s replay from "
+                    "annotations; every other section was pre-applied",
+                    n_fallback, sorted(replay_chains),
+                )
+                self._recovery_mode = "snapshot+partial"
+                return True
+            # PARTIAL fallback: the demoted families' chains replay from
+            # annotations (the existing delta path) while the rest of the
+            # snapshot restores wholesale — the plane degrades in
+            # proportion to the damage, not in one cliff.
+            try:
+                if self._preapplied_chunks is not None:
+                    # A scoped restore is only meaningful on a virgin
+                    # core; discard any pre-applied projection wholesale.
+                    self._clear_imported_state()
+                    self._swap_fresh_core()
+                self._import_snapshot_partial(
+                    snap, families, replay_chains, live_names
+                )
+            except Exception:  # noqa: BLE001
+                common.log.exception(
+                    "partial snapshot import failed mid-way; resetting "
+                    "for full annotation replay",
+                )
+                self.metrics.observe_snapshot_fallback()
+                self._reset_for_full_replay(nodes)
+                return False
+            self.metrics.observe_snapshot_section_fallback(n_fallback)
+            common.log.warning(
+                "partial snapshot fallback: %d section(s) covering "
+                "chain(s) %s replay from annotations; every other "
+                "section restored", n_fallback, sorted(replay_chains),
+            )
+            self._recovery_mode = "snapshot+partial"
+            return True
         try:
             if preapplied:
                 # Hot standby: the projection is already live in this
@@ -1897,29 +2200,206 @@ class HivedScheduler:
         self._recovery_mode = "snapshot+delta"
         return True
 
-    def _snapshot_dooms_match_ledger(self, snap: Dict) -> bool:
+    def _ledger_dooms(self) -> Set[Tuple[str, str, int, str]]:
         ledger = self._recovery_ledger
         if not isinstance(ledger, dict):
             # No authoritative ledger (first boot or failed read): organic
             # dooming is live during recovery, which a verbatim restore
             # cannot reproduce — unless neither side has any doom at all.
             ledger = {}
-        ledger_dooms = {
+        return {
             (str(vcn), str(e.get("chain")), int(e.get("level", -1)),
              str(e.get("address")))
             for vcn, entries in (ledger.get("vcs") or {}).items()
             for e in entries
         }
-        snap_dooms = {
+
+    @staticmethod
+    def _core_dooms(core_body: Dict) -> Set[Tuple[str, str, int, str]]:
+        return {
             (str(vcn), str(chain), int(level), str(addr))
             for vcn, per_chain in (
-                (snap.get("core") or {}).get("vcDoomed") or {}
+                core_body.get("vcDoomed") or {}
             ).items()
             for chain, levels in per_chain.items()
             for level, addrs in levels.items()
             for addr in addrs
         }
-        return snap_dooms == ledger_dooms
+
+    def _snapshot_dooms_match_ledger(self, snap: Dict) -> bool:
+        return self._core_dooms(snap.get("core") or {}) == (
+            self._ledger_dooms()
+        )
+
+    def _chain_node_map(self) -> Dict[str, Set[str]]:
+        """chain name -> the node-name set of its chain FAMILY (config
+        static; family_node_names caches the underlying walk)."""
+        out: Dict[str, Set[str]] = {}
+        for chains, node_set in zip(
+            self.core.compiled.families, self.core.family_node_names()
+        ):
+            for c in chains:
+                out[str(c)] = node_set
+        return out
+
+    def _gate_sectioned_snapshot(
+        self, families: List[Dict]
+    ) -> Tuple[bool, Set[str], int]:
+        """Per-family doom gate + spanning-node demotion closure for a
+        SECTIONED snapshot (schema v3). Mutates the ``ok`` flags in
+        place; returns ``(usable, replay_chains, n_fallback)`` where
+        replay_chains is every configured chain that must replay from
+        annotations (corrupt sections, doom-diverged families, and any
+        chain no healthy section covers) and n_fallback the count of
+        family sections that fell back. usable=False means no healthy
+        family survived — the snapshot is as good as absent.
+
+        The doom gate is the PR-7 ledger gate, SCOPED: a family whose
+        restored dooms diverge from the crash ledger's entries for its
+        own chains is stale for the doom subsystem and demotes to the
+        annotation replay (which rebinds the ledger's dooms on bootstrap
+        state), without dragging healthy families down with it.
+
+        The closure exists because node-level health is not splittable:
+        a host carrying BOTH a replaying and a restoring family would
+        need its health record half-applied. Families are leaf-SKU
+        connected components, so the closure only fires on heterogeneous
+        hosts — and it runs to a fixpoint because each round only ever
+        demotes."""
+        ledger_dooms = self._ledger_dooms()
+        for fam in families:
+            if not fam["ok"]:
+                continue
+            fam_chains = {str(c) for c in fam["chains"] or ()}
+            want = {d for d in ledger_dooms if d[1] in fam_chains}
+            if self._core_dooms(fam.get("core") or {}) != want:
+                fam["ok"] = False
+                common.log.warning(
+                    "snapshot section %r: doomed bindings diverge from "
+                    "the crash ledger; demoting its chains to annotation "
+                    "replay", fam.get("name"),
+                )
+        chain_nodes = self._chain_node_map()
+        all_chains = {str(c) for c in self.core.full_cell_list}
+
+        def nodes_of(chains) -> Set[str]:
+            out: Set[str] = set()
+            for c in chains:
+                out |= chain_nodes.get(str(c), set())
+            return out
+
+        while True:
+            replay_chains = all_chains - {
+                str(c)
+                for f in families
+                if f["ok"]
+                for c in f["chains"] or ()
+            }
+            replay_nodes = nodes_of(replay_chains)
+            spanned = [
+                f for f in families
+                if f["ok"] and nodes_of(f["chains"] or ()) & replay_nodes
+            ]
+            if not spanned:
+                break
+            for f in spanned:
+                f["ok"] = False
+                common.log.warning(
+                    "snapshot section %r: shares host(s) with a replaying "
+                    "family; demoting to annotation replay too",
+                    f.get("name"),
+                )
+        n_fallback = sum(1 for f in families if not f["ok"])
+        if not any(f["ok"] for f in families):
+            return False, all_chains, n_fallback
+        return True, replay_chains, n_fallback
+
+    def _swap_fresh_core(self) -> None:
+        """A SCOPED restore (partial fallback) is only meaningful on a
+        VIRGIN core: out-of-scope chains must sit in the constructor
+        bootstrap state (all nodes bad, bad-free lists full) — exactly
+        where the full annotation replay starts — not in whatever a
+        hot-standby pre-apply left behind. Discards the core wholesale
+        and re-installs the decoded ledger preferences."""
+        old = self.core
+        core = HivedCore(self.config)
+        core.decisions = self.decisions
+        core.lock_validator = self._locks.require_global
+        core.preemption_observer = self._on_preemption_event
+        core.preempt_rng = old.preempt_rng
+        self.core = core
+        self._wait_cache_clear()
+        core.set_preferred_doomed(self._recovery_ledger)
+        if self.recorder is not None:
+            self.recorder.force_reanchor()
+
+    def _import_snapshot_partial(
+        self,
+        snap: Dict,
+        families: List[Dict],
+        replay_chains: Set[str],
+        live_names: Optional[Set[str]],
+    ) -> None:
+        """Restore the healthy chain-family sections wholesale and leave
+        the replay chains in bootstrap state for the annotation replay —
+        the projection-side half of the partial fallback. Health is
+        COMPOSED: the snapshot's record minus the replaying hosts (their
+        chip badness re-derives from live node annotations exactly as a
+        full replay would), with every replaying host forced bad so the
+        node replay's heal transition fires on it (set_bad_node no-ops on
+        the bootstrap state, so the forcing is idempotent)."""
+        ok_fams = [f for f in families if f["ok"]]
+        healthy_chains = {
+            str(c) for f in ok_fams for c in f["chains"] or ()
+        }
+        chainless = snap.get("_chainless") or {"groups": {}, "pods": []}
+        core_body = snapshot_mod.merge_core_slices(
+            [f["core"] for f in ok_fams]
+        )
+        core_body.setdefault("groups", {}).update(
+            chainless.get("groups") or {}
+        )
+        pod_recs: List[Dict] = []
+        for f in ok_fams:
+            pod_recs.extend(f["pods"])
+        pod_recs.extend(chainless.get("pods") or [])
+        chain_nodes = self._chain_node_map()
+        replay_nodes: Set[str] = set()
+        for c in replay_chains:
+            replay_nodes |= chain_nodes.get(str(c), set())
+        health = dict(snap.get("health") or {})
+        health["badNodes"] = sorted(
+            set(health.get("badNodes") or ()) | replay_nodes
+        )
+        health["badChips"] = {
+            n: v
+            for n, v in (health.get("badChips") or {}).items()
+            if n not in replay_nodes
+        }
+        health["drainingChips"] = {
+            n: v
+            for n, v in (health.get("drainingChips") or {}).items()
+            if n not in replay_nodes
+        }
+        with self._lock:
+            self.core.restore_projection(
+                core_body, health, live_names, chains=healthy_chains
+            )
+            self._damper.reset()
+            for n, chips in self.core.bad_chips.items():
+                self._chip_targets[n] = set(chips)
+            imported = self._attach_snapshot_pods_locked(pod_recs)
+            # The replay chains' advisory dooms rebuild from the crash
+            # ledger on their bootstrap cells — the proven PR-3 full
+            # replay path, scoped; the restored chains carry the ledger's
+            # dooms verbatim (the gate enforced exact equality).
+            self.core.rebuild_doomed_from_ledger(
+                chains={str(c) for c in replay_chains}
+            )
+        self._snapshot_imported_count = imported
+        self._snapshot_delta_count = 0
+        if self.recorder is not None:
+            self.recorder.force_reanchor()
 
     def _import_snapshot_state(
         self, snap: Dict, live_names: Optional[Set[str]]
@@ -1928,7 +2408,6 @@ class HivedScheduler:
         live node list for absent-node normalization; None during a
         hot-standby pre-apply (the takeover normalizes against the real
         list)."""
-        imported = 0
         with self._lock:
             # The restored doomed bindings ARE the ledger's (the gate in
             # import_snapshot verified exact equality), carried with the
@@ -1948,47 +2427,55 @@ class HivedScheduler:
             # the live device-health annotation plus these targets.
             for n, chips in self.core.bad_chips.items():
                 self._chip_targets[n] = set(chips)
-            for rec in snap.get("pods") or []:
-                pod = Pod(
-                    name=rec["name"],
-                    namespace=rec["namespace"],
-                    uid=rec["uid"],
-                    annotations=dict(rec["annotations"]),
-                    node_name=rec["node"],
-                    phase=rec.get("phase", "Running"),
-                    resource_limits={
-                        str(k): int(v)
-                        for k, v in (rec.get("resourceLimits") or {}).items()
-                    },
-                )
-                # Decode-free slotting: the cell state is already restored
-                # verbatim; each pod record only names its group slot. The
-                # delta replay re-checks every pod against its live
-                # annotations before trusting the import.
-                self.core.attach_restored_pod(
-                    rec["spec"]["affinityGroup"]["name"],
-                    int(rec["spec"]["leafCellNumber"]),
-                    int(rec["podIndex"]),
-                    pod,
-                )
-                self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
-                    pod=pod, pod_state=PodState.BOUND
-                )
-                self._snapshot_pending[pod.uid] = (
-                    self._snapshot_pod_fingerprint(pod)
-                )
-                info = rec["bindInfo"]
-                for idx in info["leafCellIsolation"]:
-                    self._snapshot_claims[
-                        (info["cellChain"], info["node"], idx)
-                    ] = pod.uid
-                imported += 1
+            imported = self._attach_snapshot_pods_locked(
+                snap.get("pods") or []
+            )
         self._snapshot_imported_count = imported
         self._snapshot_delta_count = 0
         if self.recorder is not None:
             # restore_projection writes cell fields directly: the current
             # recording window's anchor no longer describes this state.
             self.recorder.force_reanchor()
+
+    def _attach_snapshot_pods_locked(self, pod_recs: List[Dict]) -> int:
+        """Decode-free pod slotting shared by the wholesale and partial
+        imports (caller holds the guard): the cell state is already
+        restored verbatim, so each record only names its group slot. The
+        delta replay re-checks every pod against its live annotations
+        before trusting the import. Returns the count imported."""
+        imported = 0
+        for rec in pod_recs:
+            pod = Pod(
+                name=rec["name"],
+                namespace=rec["namespace"],
+                uid=rec["uid"],
+                annotations=dict(rec["annotations"]),
+                node_name=rec["node"],
+                phase=rec.get("phase", "Running"),
+                resource_limits={
+                    str(k): int(v)
+                    for k, v in (rec.get("resourceLimits") or {}).items()
+                },
+            )
+            self.core.attach_restored_pod(
+                rec["spec"]["affinityGroup"]["name"],
+                int(rec["spec"]["leafCellNumber"]),
+                int(rec["podIndex"]),
+                pod,
+            )
+            self.pod_schedule_statuses[pod.uid] = PodScheduleStatus(
+                pod=pod, pod_state=PodState.BOUND
+            )
+            self._snapshot_pending[pod.uid] = (
+                self._snapshot_pod_fingerprint(pod)
+            )
+            info = rec["bindInfo"]
+            for idx in info["leafCellIsolation"]:
+                self._snapshot_claims[
+                    (info["cellChain"], info["node"], idx)
+                ] = pod.uid
+            imported += 1
+        return imported
 
     @staticmethod
     def _snapshot_pod_fingerprint(pod: Pod) -> Tuple:
@@ -4368,6 +4855,14 @@ class HivedScheduler:
         # mode flag, and the leadership gauge.
         snap["snapshotImportedPodCount"] = self._snapshot_imported_count
         snap["snapshotDeltaPodCount"] = self._snapshot_delta_count
+        # Seconds since the last snapshot landed (-1 until the first
+        # flush): the starvation gauge behind the max-staleness override.
+        last_flush = self._last_flush_monotonic
+        snap["snapshotAgeSeconds"] = (
+            -1.0
+            if last_flush is None
+            else round(time.monotonic() - last_flush, 3)
+        )
         snap["recoveryMode"] = self._recovery_mode
         snap["leader"] = self.is_leader()
         snap["quarantinedPodCount"] = len(self.quarantined_pods)
@@ -4410,6 +4905,13 @@ class HivedScheduler:
         recd = self.recorder
         if recd is not None:
             snap.update(recd.metrics_snapshot())
+        # Durable-state plane v2 (doc/observability.md): integrity-scrub
+        # runs, divergences, and repairs. Keys always present; zeros
+        # while the scrubber is disabled.
+        snap.update(dict(SCRUB_EMPTY_METRICS))
+        scrub = self.scrubber
+        if scrub is not None:
+            snap.update(scrub.metrics_snapshot())
         # One wire (scheduler.wire): per-codec transport bytes and
         # delta-suggested-set resyncs are TRANSPORT-plane counters — the
         # single-process core has no internal transport, so the keys are

@@ -116,7 +116,14 @@ def is_retryable_kube_error(e: Exception) -> bool:
     reset, timeout, TLS), apiserver 5xx, and 429 throttling. Terminal: other
     HTTP statuses — notably 404 (pod deleted before the bind landed) and 409
     (UID precondition: the pod was deleted and recreated, so the decision
-    belongs to a dead incarnation)."""
+    belongs to a dead incarnation).
+
+    Non-kube backends opt in by stamping ``kube_retryable = True`` on the
+    exception class (store.StoreUnavailableError): a snapshot-store outage
+    is then classified exactly like an apiserver 5xx — retried, weather-
+    counted, and journalable under blackout."""
+    if getattr(e, "kube_retryable", False):
+        return True
     if isinstance(e, KubeAPIError):
         return e.status >= 500 or e.status == 429
     if isinstance(e, urllib.error.HTTPError):  # not wrapped by _request
@@ -168,8 +175,15 @@ class RetryingKubeClient(KubeClient):
         jitter_rng: Optional[random.Random] = None,
         vane=None,
         journal=None,
+        snapshot_store=None,
     ) -> None:
         self.inner = inner
+        # Durable-state plane v2: when a SnapshotStore is configured the
+        # snapshot envelope bypasses the ConfigMap chunk family entirely —
+        # persist/load (and snapshot intent drains) route to the store,
+        # under the SAME retry/vane/journal policy (StoreUnavailableError
+        # is retryable by the shared classifier).
+        self.snapshot_store = snapshot_store
         self.scheduler = scheduler
         self.metrics = metrics or (scheduler.metrics if scheduler else None)
         self.max_attempts = max_attempts
@@ -357,6 +371,15 @@ class RetryingKubeClient(KubeClient):
         )
 
     def persist_snapshot(self, chunks) -> None:
+        store = self.snapshot_store
+        if store is not None:
+            chunk_list = list(chunks)
+            self._durable_op(
+                f"snapshot store ({store.name}) write",
+                lambda: store.persist(chunk_list),
+                INTENT_SNAPSHOT, "snapshot", chunk_list,
+            )
+            return
         self._durable_op(
             "snapshot ConfigMap write",
             lambda: self.inner.persist_snapshot(chunks),
@@ -364,6 +387,11 @@ class RetryingKubeClient(KubeClient):
         )
 
     def load_snapshot(self):
+        store = self.snapshot_store
+        if store is not None:
+            return self._retrying_op(
+                f"snapshot store ({store.name}) read", store.load, cls="read"
+            )
         return self._retrying_op(
             "snapshot ConfigMap read", self.inner.load_snapshot, cls="read"
         )
@@ -447,10 +475,17 @@ class RetryingKubeClient(KubeClient):
                 lambda: self.inner.persist_scheduler_state(payload),
             )
         elif kind == INTENT_SNAPSHOT:
-            self._retrying_op(
-                "intent drain: snapshot ConfigMap write",
-                lambda: self.inner.persist_snapshot(payload),
-            )
+            store = self.snapshot_store
+            if store is not None:
+                self._retrying_op(
+                    f"intent drain: snapshot store ({store.name}) write",
+                    lambda: store.persist(payload),
+                )
+            else:
+                self._retrying_op(
+                    "intent drain: snapshot ConfigMap write",
+                    lambda: self.inner.persist_snapshot(payload),
+                )
         elif kind == INTENT_PATCH:
             pod, annotations = payload
 

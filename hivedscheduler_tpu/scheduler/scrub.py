@@ -1,0 +1,282 @@
+"""Continuous durable-state integrity scrubber (doc/fault-model.md
+"Durable-state plane v2").
+
+PR 7's validation ladder runs at RECOVERY — a corrupted snapshot is only
+discovered at the worst possible moment, mid-failover, when the fallback
+(full annotation replay) is most expensive. The scrubber moves that
+discovery to steady state, in the :class:`~.audit.LiveAuditor` mold:
+event-clocked (it rides the snapshot flusher's beats — never its own
+thread or wall clock), always-on in production, and degrading gracefully
+on divergence (count + journal + black-box artifact + repair — NEVER an
+assert into the serving path).
+
+Leader beats re-read the durable envelope end to end and re-run the
+validation ladder against LIVE state: per-section sha256 checksums, the
+config fingerprint rung, and the doomed-cell gate vs the in-memory ledger
+(decode carries the first two; the scrubber adds the third). A divergence
+means the durable copy would degrade — or doom — the next failover, so
+the repair is simply a rewrite from the live projection
+(``flush_snapshot_now``), which is always authoritative on the leader.
+
+Standby beats are the anti-entropy half: a HOT standby pre-applies the
+projection into its own core (``prefetch_snapshot(apply=True)``), and a
+bit of rot there would silently ship into the next takeover. The scrubber
+fingerprints the pre-applied projection against the durable envelope's
+core sections; on mismatch it discards the pre-apply wholesale and
+re-prefetches from durable state (durable wins — the standby's copy is
+the derived one).
+
+``HIVED_SNAPSHOT_SCRUB=0`` is the emergency hatch: it disables scrubbing
+at construction without touching config. Cadence comes from
+``snapshotScrubIntervalBeats`` (every Nth flusher beat).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Dict, Optional
+
+from .. import common
+from . import snapshot as snapshot_mod
+from .audit import AUDIT_ARTIFACT_DIR_ENV
+
+SCRUB_ENABLE_ENV = "HIVED_SNAPSHOT_SCRUB"
+
+
+def projection_fingerprint(core_body: Dict) -> str:
+    """Order-insensitive fingerprint of a core projection body. Used for
+    the standby anti-entropy compare: the durable envelope's merged core
+    sections vs the standby's own ``export_projection()`` must hash
+    identically or the pre-apply has rotted."""
+    return hashlib.sha256(
+        json.dumps(core_body, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()
+
+
+class SnapshotScrubber:
+    """Event-clocked integrity scrubber over the durable snapshot plane.
+
+    Thread-safety: ``tick`` is called from the flusher thread (leader)
+    or the standby beat loop (standby) — one caller at a time by
+    construction; counters ride the GIL."""
+
+    def __init__(self, sched, interval_beats: int = 4):
+        self.sched = sched
+        self.interval_beats = max(1, int(interval_beats))
+        self.enabled = os.environ.get(SCRUB_ENABLE_ENV, "").strip() != "0"
+        self.beats = 0
+        self.scrub_runs = 0
+        self.divergence_count = 0
+        self.repair_count = 0
+        self.last_divergence: str = ""
+        self.last_artifact: str = ""
+
+    # -- the event clock ------------------------------------------------ #
+
+    def tick(self) -> None:
+        """One flusher/standby beat completed."""
+        if not self.enabled:
+            return
+        self.beats += 1
+        if self.beats % self.interval_beats == 0:
+            self.scrub_now(f"cadence beat={self.beats}")
+
+    def scrub_now(self, ctx: str = "manual") -> bool:
+        """One scrub pass. Returns True when durable state verified clean
+        (or there was nothing to verify). A divergence is counted,
+        journaled, dumped, and REPAIRED — never raised; any crash of the
+        scrub itself logs and counts as a run, never a divergence (the
+        scrubber must not invent corruption)."""
+        sched = self.sched
+        if getattr(sched, "_in_recovery", False):
+            return True  # a half-replayed view has no authoritative side
+        self.scrub_runs += 1
+        try:
+            if sched.is_leader():
+                return self._scrub_leader(ctx)
+            return self._scrub_standby(ctx)
+        except Exception as e:  # noqa: BLE001
+            common.log.warning(
+                "snapshot scrub pass crashed (not counted as a "
+                "divergence): %s", e,
+            )
+            return True
+
+    # -- leader: durable envelope vs live ledger ------------------------ #
+
+    def _scrub_leader(self, ctx: str) -> bool:
+        sched = self.sched
+        try:
+            chunks = sched.kube_client.load_snapshot()
+        except Exception as e:  # noqa: BLE001
+            # A store/apiserver outage is the weather plane's problem
+            # (vane + journal), not corruption.
+            common.log.debug("scrub read failed (weather, not rot): %s", e)
+            return True
+        if not chunks:
+            return True  # nothing persisted yet — first boot
+        snap, reason = snapshot_mod.decode(
+            chunks, sched._config_fingerprint, None
+        )
+        if snap is None:
+            return self._diverged(
+                ctx, f"durable envelope unusable: {reason}", repair=True
+            )
+        corrupt = snap.get("_corrupt") or {}
+        if corrupt.get("sections") or corrupt.get("chains"):
+            return self._diverged(
+                ctx,
+                "corrupt sections in durable envelope: "
+                f"sections={sorted(corrupt.get('sections') or [])} "
+                f"chains={sorted(corrupt.get('chains') or [])}",
+                repair=True,
+            )
+        # The doom gate, scrubbed ahead of failover: durable dooms must
+        # match the live ledger. A mismatch here can be flush lag (a doom
+        # landed after the last flush) — still worth repairing NOW rather
+        # than at takeover, where it would force a fallback.
+        snap_dooms = sched._core_dooms(snap.get("core") or {})
+        live_dooms = sched._ledger_dooms()
+        if snap_dooms != live_dooms:
+            return self._diverged(
+                ctx,
+                "durable doomed set diverges from live ledger: "
+                f"snapshot-only={sorted(snap_dooms - live_dooms)[:8]} "
+                f"ledger-only={sorted(live_dooms - snap_dooms)[:8]}",
+                repair=True,
+            )
+        return True
+
+    # -- standby: pre-applied projection vs durable (anti-entropy) ------ #
+
+    def _scrub_standby(self, ctx: str) -> bool:
+        sched = self.sched
+        if sched._preapplied_chunks is None:
+            return True  # cold/warm standby — nothing pre-applied to rot
+        try:
+            chunks = sched.kube_client.load_snapshot()
+        except Exception as e:  # noqa: BLE001
+            common.log.debug("standby scrub read failed: %s", e)
+            return True
+        if not chunks or chunks != sched._preapplied_chunks:
+            # The pre-apply lags the durable stream; the next prefetch
+            # beat reconciles. Only a SAME-family mismatch is rot.
+            return True
+        snap, reason = snapshot_mod.decode(
+            chunks, sched._config_fingerprint, None
+        )
+        if snap is None:
+            return True  # prefetch/recovery ladders own this case
+        if sched._preapplied_replay is not None:
+            # PARTIAL pre-apply: the live core deliberately holds only
+            # the healthy families (demoted chains sit in bootstrap
+            # state, their hosts forced bad), so the wholesale
+            # projection compare below would read the scoping itself as
+            # rot. The takeover gate re-validates the scope against the
+            # real ledger; the leader-side section scrub owns the
+            # durable bytes.
+            return True
+        durable_fp = projection_fingerprint(snap.get("core") or {})
+        with sched._lock:
+            live_fp = projection_fingerprint(sched.core.export_projection())
+        if durable_fp == live_fp:
+            return True
+        diverged = self._diverged(
+            ctx,
+            "hot-standby pre-applied projection diverges from durable "
+            f"envelope (durable {durable_fp[:12]} vs pre-applied "
+            f"{live_fp[:12]}); discarding pre-apply and re-prefetching",
+            repair=False,
+        )
+        # Durable wins: drop the rotted pre-apply and rebuild it from the
+        # envelope we just verified section-clean.
+        try:
+            sched.discard_preapplied_state()
+            sched._prefetched_snapshot = None
+            sched.prefetch_snapshot(apply=True)
+            self.repair_count += 1
+        except Exception:  # noqa: BLE001 — repair is best-effort
+            common.log.exception("standby scrub re-prefetch failed")
+        return diverged
+
+    # -- divergence plumbing -------------------------------------------- #
+
+    def _diverged(self, ctx: str, detail: str, repair: bool) -> bool:
+        self.divergence_count += 1
+        self.last_divergence = detail[:2000]
+        common.log.error(
+            "SNAPSHOT SCRUB DIVERGENCE #%d (%s): %s — scheduler keeps "
+            "serving; black-box bundle dumping",
+            self.divergence_count, ctx, self.last_divergence,
+        )
+        self._journal(ctx, detail)
+        try:
+            self.last_artifact = self.dump_artifact(ctx, detail)
+        except Exception:  # noqa: BLE001 — the dump must never raise
+            common.log.exception("scrub artifact dump failed")
+        if repair:
+            try:
+                if self.sched.flush_snapshot_now():
+                    self.repair_count += 1
+                    common.log.warning(
+                        "scrub repaired durable snapshot by rewriting from "
+                        "the live projection"
+                    )
+            except Exception:  # noqa: BLE001 — repair is best-effort
+                common.log.exception("scrub repair flush failed")
+        return False
+
+    def _journal(self, ctx: str, detail: str) -> None:
+        """A divergence is a decision too: one journal record under the
+        synthetic pod key ``_scrub`` so ``/v1/inspect/decisions`` shows
+        it inline with the scheduling stream."""
+        try:
+            rec = self.sched.decisions.begin("_scrub", "_scrub", "scrub")
+            rec.verdict_error(f"durable-state divergence ({ctx}): "
+                              f"{detail[:500]}")
+            self.sched.decisions.commit(rec)
+        except Exception:  # noqa: BLE001 — diagnostics must never raise
+            pass
+
+    def dump_artifact(self, ctx: str, detail: str) -> str:
+        """The black-box bundle, co-located with the audit bundles under
+        HIVED_AUDIT_ARTIFACT_DIR (default $TMPDIR/hived-audit)."""
+        import tempfile
+
+        out_dir = os.environ.get(AUDIT_ARTIFACT_DIR_ENV) or os.path.join(
+            tempfile.gettempdir(), "hived-audit"
+        )
+        os.makedirs(out_dir, exist_ok=True)
+        sched = self.sched
+        recorder = getattr(sched, "recorder", None)
+        payload = {
+            "context": ctx,
+            "divergence": detail,
+            "divergenceCount": self.divergence_count,
+            "scrubRuns": self.scrub_runs,
+            "wallTime": time.time(),
+            "decisions": sched.decisions.snapshot(),
+            "traces": sched.tracer.snapshot(),
+            "metrics": sched.get_metrics(),
+            "flightRecording": (
+                recorder.recording() if recorder is not None else None
+            ),
+        }
+        path = os.path.join(
+            out_dir,
+            f"scrub-divergence-{self.divergence_count}-{os.getpid()}.json",
+        )
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1, default=str)
+        common.log.error("black-box bundle dumped to %s", path)
+        return path
+
+    def metrics_snapshot(self) -> Dict:
+        return {
+            "scrubRunCount": self.scrub_runs,
+            "scrubDivergenceCount": self.divergence_count,
+            "scrubRepairCount": self.repair_count,
+        }

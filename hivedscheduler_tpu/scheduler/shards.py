@@ -70,6 +70,7 @@ today's path.
 
 from __future__ import annotations
 
+import hashlib
 import itertools
 import json
 import os
@@ -1747,12 +1748,21 @@ def _merge_snapshot(
     snapshots: Dict[str, List[str]], fingerprint: str
 ) -> List[str]:
     """One chunk list for the underlying store: a directory chunk naming
-    each shard's chunk count, then the shard chunk groups in shard-id
-    order. Splittable without decoding any shard's own chunks."""
+    each shard's chunk count AND slot checksum, then the shard chunk
+    groups in shard-id order. Splittable without decoding any shard's own
+    chunks. The per-slot sha256 makes each slot a SECTION in the
+    durable-state-plane-v2 sense: corruption localizes to the slot it
+    hit, and only that shard falls back to replaying its chains."""
     order = sorted(snapshots, key=int)
     directory = json.dumps({
         _ENVELOPE_KEY: fingerprint,
         "shards": {k: len(snapshots[k]) for k in order},
+        "sha256": {
+            k: hashlib.sha256(
+                "".join(snapshots[k]).encode()
+            ).hexdigest()
+            for k in order
+        },
     })
     merged = [directory]
     for k in order:
@@ -1761,23 +1771,56 @@ def _merge_snapshot(
 
 
 def _split_snapshot(chunks, fingerprint: str) -> Dict[str, List[str]]:
+    """Split the merged blob back into per-shard slots. A slot that
+    fails its directory checksum is kept but flagged in the log: the
+    slot's OWN sectioned envelope (manifest + per-family checksums) is
+    the authority on what inside it is salvageable, so passing it
+    through lets the shard recover partially instead of replaying
+    wholesale. Only a short slice drops the slot — past a truncation the
+    boundary is unknowable. An unusable DIRECTORY (unparseable, wrong
+    partition fingerprint) still invalidates everything. Directories
+    from before the per-slot checksum (one schema back) split by counts
+    alone."""
     if not chunks:
         return {}
     try:
         directory = json.loads(chunks[0])
-        assert directory.get(_ENVELOPE_KEY) == fingerprint
+        if (
+            not isinstance(directory, dict)
+            or directory.get(_ENVELOPE_KEY) != fingerprint
+        ):
+            return {}
         counts = directory["shards"]
-        out: Dict[str, List[str]] = {}
-        i = 1
-        for k in sorted(counts, key=int):
-            n = int(counts[k])
-            out[k] = list(chunks[i:i + n])
-            if len(out[k]) != n:
-                return {}
-            i += n
-        return out
-    except Exception:  # noqa: BLE001 — any malformation: no partitions
+        shas = directory.get("sha256") or {}
+    except Exception:  # noqa: BLE001 — no directory: no partitions
         return {}
+    out: Dict[str, List[str]] = {}
+    i = 1
+    for k in sorted(counts, key=int):
+        try:
+            n = int(counts[k])
+        except (TypeError, ValueError):
+            return {}  # boundary unknowable past this point
+        slot = list(chunks[i:i + n])
+        i += n
+        if len(slot) != n:
+            common.log.warning(
+                "partition snapshot slot %s truncated (%d/%d chunks); "
+                "dropping the slot — shard falls back to replay", k,
+                len(slot), n,
+            )
+            continue
+        want = shas.get(k)
+        if want is not None and hashlib.sha256(
+            "".join(slot).encode()
+        ).hexdigest() != want:
+            common.log.warning(
+                "partition snapshot slot %s failed its checksum; passing "
+                "it through — the slot's own section ladder localizes "
+                "the damage", k,
+            )
+        out[k] = slot
+    return out
 
 
 class _ShardScopedKubeClient(KubeClient):

@@ -6,27 +6,36 @@ fleet. This module serializes the scheduler's DURABLE PROJECTION — exactly
 the state the chaos harness proves restart-equivalent (confirmed-bound
 pods with their decoded placements, the preemption checkpoints, applied
 health records, the doomed-ledger epoch, and the informer resourceVersion
-watermark) — into a chunked, checksummed payload a scheduler-owned
-ConfigMap family carries, so recovery becomes snapshot-import plus a
-delta replay of only what changed since the watermark
-(doc/fault-model.md "HA and snapshot recovery plane").
+watermark) — into a chunked, checksummed payload a pluggable
+``SnapshotStore`` carries (ConfigMap chunk family by default,
+filesystem/S3-shaped object store for projections that outgrow it), so
+recovery becomes snapshot-import plus a delta replay of only what changed
+since the watermark (doc/fault-model.md "Durable-state plane v2").
 
-Format: ``encode`` returns a chunk list whose FIRST element is a small
-JSON meta header (schema version, SHA-256 checksum and byte length of the
-body, chunk count, compiled-config fingerprint, watermark) and whose
-remaining elements are the JSON body split at ``CHUNK_BYTES`` boundaries
-(a ConfigMap tops out at 1 MiB; chunks leave headroom for the object
-envelope). ``decode`` is the validation ladder — every rung falls back to
-full annotation replay rather than guessing:
+Format (schema v3, SECTIONED): ``encode_sections`` returns a chunk list
+whose FIRST element is a small JSON manifest (schema version, config
+fingerprint, watermark, chunk count, whole-body byte length + SHA-256,
+and a ``sections`` table: name, covered chains, byte length, SHA-256 per
+section) and whose remaining elements are the CONCATENATED section texts
+split at ``CHUNK_BYTES`` boundaries. Sections are one per chain family
+(riding the per-chain ``export_projection`` memo) plus ``meta``
+(doomed-ledger epoch, chainless groups, orphan pods) and ``health`` (the
+applied hardware-health records), so the validation ladder is
+SECTION-GRANULAR — a corrupt section invalidates only its chains:
 
-  1. meta header decodes and carries the expected schema version;
-  2. chunk count and reassembled byte length match the header;
-  3. SHA-256 of the reassembled body matches;
-  4. the config fingerprint matches the running config (a reconfiguration
+  1. manifest decodes, carries a readable schema version (v3, or v2
+     read-only for the rolling upgrade), and a well-formed section table;
+  2. the config fingerprint matches the running config (a reconfiguration
      between snapshot and recovery invalidates every cell address);
-  5. the watermark is not older than ``min_watermark`` (the informer's
+  3. the watermark is not older than ``min_watermark`` (the informer's
      delta floor — a snapshot from before the watch window is stale);
-  6. the body decodes and is schema-shaped.
+  4. per SECTION: the manifest's byte range slices out of the reassembled
+     body, its SHA-256 matches, and the payload decodes — a failed
+     section marks only its chains for annotation replay
+     (``_corrupt``), while every healthy section restores wholesale;
+  5. the ``meta`` and ``health`` sections are load-bearing for every
+     chain, so their corruption (or every family section failing) still
+     fails the WHOLE snapshot — the caller falls back to full replay.
 
 Everything here is pure data transformation — no locks, no I/O — so the
 framework can serialize under its lock and write outside it (the PR-3
@@ -42,16 +51,33 @@ from typing import Dict, List, Optional, Tuple
 from ..api.config import Config
 from . import wire
 
-# Bump when the body schema changes shape; decode refuses other versions
-# (rung 1 of the fallback ladder). The golden schema test pins the
-# serialized form in both directions. v2: the body gained the "core"
+# Bump when the body schema changes shape; decode refuses versions it
+# cannot read (rung 1 of the fallback ladder). The golden schema test pins
+# the serialized form in both directions. v2: the body gained the "core"
 # section (verbatim cell-level projection) and import switched from
-# per-pod re-admission to direct state restore.
-SCHEMA_VERSION = 2
+# per-pod re-admission to direct state restore. v3: the body split into
+# independently checksummed SECTIONS (one per chain family + meta +
+# health) listed in the manifest, making corruption section-granular.
+SCHEMA_VERSION = 3
+
+# One schema back stays readable (read-only: restored, then re-persisted
+# at SCHEMA_VERSION by the next flush) so a v2->v3 rolling upgrade does
+# not cost every replica a full annotation replay.
+COMPAT_READ_VERSIONS = (2, SCHEMA_VERSION)
 
 # Body bytes per chunk. A ConfigMap caps at 1 MiB total; 900 KB leaves
 # headroom for the object envelope and the apiserver's own accounting.
+# (The object-store backend has no such cap but keeps the same chunking —
+# one format, two stores.)
 CHUNK_BYTES = 900_000
+
+# Reserved section names (everything else is a chain-family section,
+# conventionally "family:<i>"). SECTION_BODY is the degenerate monolithic
+# layout ``encode`` emits for hand-built bodies: one all-or-nothing
+# section covering every chain, exactly v2's blast radius.
+SECTION_META = "meta"
+SECTION_HEALTH = "health"
+SECTION_BODY = "body"
 
 
 def config_fingerprint(config: Config) -> str:
@@ -120,6 +146,120 @@ def config_fingerprint(config: Config) -> str:
     return h.hexdigest()
 
 
+def merge_core_slices(slices: List[Dict]) -> Dict:
+    """Merge per-family (or per-chain) core projection slices back into
+    the single core body ``restore_projection`` consumes — the same
+    merge ``HivedCore.export_projection`` performs over its per-chain
+    memo sections, so a sectioned snapshot's healthy families reassemble
+    byte-equivalently to the monolithic export."""
+    phys: Dict[str, List] = {}
+    virt: Dict[str, List] = {}
+    free_lists: Dict[str, Dict] = {}
+    bad_free: Dict[str, Dict] = {}
+    vc_doomed: Dict[str, Dict] = {}
+    ot_cells: Dict[str, List[str]] = {}
+    vc_free: Dict[str, Dict] = {}
+    all_vc_free: Dict[str, Dict] = {}
+    total_left: Dict[str, Dict] = {}
+    all_vc_doomed: Dict[str, Dict] = {}
+    groups: Dict[str, Dict] = {}
+    for sec in slices:
+        phys.update(sec.get("phys") or {})
+        virt.update(sec.get("virt") or {})
+        free_lists.update(sec.get("freeLists") or {})
+        bad_free.update(sec.get("badFree") or {})
+        for vcn, per_chain in (sec.get("vcDoomed") or {}).items():
+            vc_doomed.setdefault(vcn, {}).update(per_chain)
+        for vcn, addrs in (sec.get("otCells") or {}).items():
+            ot_cells.setdefault(vcn, []).extend(addrs)
+        counters = sec.get("counters") or {}
+        for vcn, per_chain in (counters.get("vcFree") or {}).items():
+            vc_free.setdefault(vcn, {}).update(per_chain)
+        all_vc_free.update(counters.get("allVCFree") or {})
+        total_left.update(counters.get("totalLeft") or {})
+        all_vc_doomed.update(counters.get("allVCDoomed") or {})
+        groups.update(sec.get("groups") or {})
+    return {
+        "phys": phys,
+        "virt": virt,
+        "freeLists": free_lists,
+        "badFree": bad_free,
+        "vcDoomed": vc_doomed,
+        "otCells": ot_cells,
+        "counters": {
+            "vcFree": vc_free,
+            "allVCFree": all_vc_free,
+            "totalLeft": total_left,
+            "allVCDoomed": all_vc_doomed,
+        },
+        "groups": groups,
+    }
+
+
+def section_text(payload: Dict, pods_json: Optional[List[str]] = None) -> str:
+    """Serialize one section payload, splicing the flusher's memoized
+    per-pod JSON texts into the ``pods`` entry when provided — the PR-7
+    fast path (a bound pod's record never changes, so re-dumping the pods
+    bulk every flush was pure GC churn). Byte-identical to the plain
+    ``json.dumps(payload)`` because dicts preserve insertion order and the
+    same separators are used throughout."""
+    if pods_json is None:
+        return json.dumps(payload, separators=(",", ":"))
+    parts = []
+    for k, v in payload.items():
+        if k == "pods":
+            parts.append('"pods":[' + ",".join(pods_json) + "]")
+        else:
+            parts.append(
+                json.dumps(k) + ":" + json.dumps(v, separators=(",", ":"))
+            )
+    return "{" + ",".join(parts) + "}"
+
+
+def encode_sections(
+    sections: List[Tuple[str, Optional[List[str]], str]],
+    fingerprint: str,
+    watermark,
+    chunk_bytes: int = CHUNK_BYTES,
+) -> List[str]:
+    """Serialize pre-rendered sections into the v3 chunk list the
+    SnapshotStore persists: ``[manifest-json, body-part-0, ...]``.
+
+    ``sections`` is an ordered list of ``(name, chains, text)`` — chains
+    is the list of chain names the section covers (None for the reserved
+    meta/health/body sections). The body is the concatenation of the
+    section texts; the manifest records each section's byte range (by
+    order) and SHA-256 so decode can validate and fall back per section.
+    """
+    manifest_sections = []
+    for name, chains, text in sections:
+        data = text.encode()
+        entry = {
+            "name": name,
+            "bytes": len(data),
+            "sha256": hashlib.sha256(data).hexdigest(),
+        }
+        if chains is not None:
+            entry["chains"] = [str(c) for c in chains]
+        manifest_sections.append(entry)
+    body_text = "".join(text for _, _, text in sections)
+    data = body_text.encode()
+    chunks = [
+        body_text[i: i + chunk_bytes]
+        for i in range(0, len(body_text), chunk_bytes)
+    ] or [""]
+    manifest = {
+        "schemaVersion": SCHEMA_VERSION,
+        "checksum": hashlib.sha256(data).hexdigest(),
+        "bytes": len(data),
+        "chunks": len(chunks),
+        "configFingerprint": fingerprint,
+        "watermark": watermark,
+        "sections": manifest_sections,
+    }
+    return [json.dumps(manifest, separators=(",", ":"))] + chunks
+
+
 def encode(
     body: Dict,
     fingerprint: str,
@@ -128,44 +268,34 @@ def encode(
     chunk_bytes: int = CHUNK_BYTES,
     pods_json: Optional[List[str]] = None,
 ) -> List[str]:
-    """Serialize a snapshot body into the chunk list the KubeClient
-    persists: ``[meta-json, body-part-0, body-part-1, ...]``.
+    """Serialize a MERGED snapshot body into a persistable chunk list.
 
-    ``pods_json`` is the flusher's fast path: pre-serialized JSON texts
-    for the entries of ``body["pods"]``, memoized per bound pod across
-    flushes (a bound pod's record never changes, so re-dumping the pods
-    section — the bulk of the body at fleet scale — every flush was pure
-    GC churn). The section-wise assembly below is byte-identical to the
-    plain ``json.dumps(body)`` because dicts preserve insertion order
-    and the same separators are used throughout."""
-    if pods_json is None:
-        body_text = json.dumps(body, separators=(",", ":"))
-    else:
-        parts = []
-        for k, v in body.items():
-            if k == "pods":
-                parts.append('"pods":[' + ",".join(pods_json) + "]")
-            else:
-                parts.append(
-                    json.dumps(k)
-                    + ":"
-                    + json.dumps(v, separators=(",", ":"))
-                )
-        body_text = "{" + ",".join(parts) + "}"
-    data = body_text.encode()
-    chunks = [
-        body_text[i: i + chunk_bytes]
-        for i in range(0, len(body_text), chunk_bytes)
-    ] or [""]
-    meta = {
-        "schemaVersion": schema_version,
-        "checksum": hashlib.sha256(data).hexdigest(),
-        "bytes": len(data),
-        "chunks": len(chunks),
-        "configFingerprint": fingerprint,
-        "watermark": watermark,
-    }
-    return [json.dumps(meta, separators=(",", ":"))] + chunks
+    At ``SCHEMA_VERSION`` this emits the degenerate single-``body``-section
+    v3 envelope (all-or-nothing, v2's blast radius) — the sectioned fast
+    path lives in the framework flusher, which renders per-family sections
+    and calls ``encode_sections`` directly. Passing ``schema_version=2``
+    emits the legacy v2 envelope verbatim (the rolling-upgrade read-compat
+    tests exercise decode against it)."""
+    if schema_version == 2:
+        body_text = section_text(body, pods_json)
+        data = body_text.encode()
+        chunks = [
+            body_text[i: i + chunk_bytes]
+            for i in range(0, len(body_text), chunk_bytes)
+        ] or [""]
+        meta = {
+            "schemaVersion": 2,
+            "checksum": hashlib.sha256(data).hexdigest(),
+            "bytes": len(data),
+            "chunks": len(chunks),
+            "configFingerprint": fingerprint,
+            "watermark": watermark,
+        }
+        return [json.dumps(meta, separators=(",", ":"))] + chunks
+    text = section_text(body, pods_json)
+    return encode_sections(
+        [(SECTION_BODY, None, text)], fingerprint, watermark, chunk_bytes
+    )
 
 
 def encode_body_wire(
@@ -176,11 +306,12 @@ def encode_body_wire(
 ) -> bytes:
     """Pack a snapshot body into one binary KIND_SNAPSHOT frame for the
     hops that never touch the apiserver (HA pre-apply, what-if fork,
-    flight-recorder anchor). The durable ConfigMap format stays the JSON
-    chunk envelope of ``encode`` — this frame is an IN-PROCESS transport:
-    no chunking, no SHA-256 (the wire header's magic/version/length
-    framing plus the fingerprint rung below carry the same refusals), and
-    the body rides as one C-speed JSON blob inside the frame."""
+    flight-recorder anchor). The durable format stays the sectioned chunk
+    envelope — this frame is an IN-PROCESS transport: no chunking, no
+    SHA-256 (the wire header's magic/version/length framing plus the
+    fingerprint rung below carry the same refusals), and the MERGED body
+    rides as one C-speed JSON blob inside the frame (both ends are always
+    the same build, so no sectioning and no one-back compat here)."""
     return wire.dumps(
         (int(schema_version), str(fingerprint), watermark, wire.Json(body)),
         kind=wire.KIND_SNAPSHOT,
@@ -239,28 +370,35 @@ def _watermark_older(watermark, floor) -> bool:
         return True
 
 
-def decode(
-    chunks: Optional[List[str]],
-    expected_fingerprint: str,
-    min_watermark=None,
+def _single_family(body: Dict) -> List[Dict]:
+    """The ``_families`` view of a monolithic body (v2 envelope or the
+    single-``body``-section v3 layout): one healthy pseudo-family covering
+    every chain (``chains=None``), so the import path's per-family doom
+    gate degenerates to the historical global gate."""
+    return [{
+        "name": SECTION_BODY,
+        "chains": None,
+        "ok": True,
+        "core": body.get("core") or {},
+        "pods": body.get("pods") or [],
+    }]
+
+
+def _shape_error(body) -> str:
+    if not isinstance(body, dict) or not isinstance(body.get("pods"), list):
+        return "body is not snapshot-shaped (missing pods list)"
+    if not isinstance(body.get("core"), dict):
+        return "body is not snapshot-shaped (missing core projection)"
+    return ""
+
+
+def _decode_v2(
+    meta: Dict, chunks: List[str]
 ) -> Tuple[Optional[Dict], str]:
-    """Validate + reassemble a persisted chunk list. Returns
-    ``(body, "")`` on success or ``(None, reason)`` naming the first rung
-    of the fallback ladder that failed — the caller counts it
-    (snapshotFallbackCount) and runs the full annotation replay."""
-    if not chunks:
-        return None, "empty chunk list"
-    try:
-        meta = json.loads(chunks[0])
-    except (TypeError, ValueError) as e:
-        return None, f"meta header undecodable: {e}"
-    if not isinstance(meta, dict):
-        return None, "meta header is not an object"
-    if meta.get("schemaVersion") != SCHEMA_VERSION:
-        return None, (
-            f"schema version mismatch: snapshot {meta.get('schemaVersion')}, "
-            f"running {SCHEMA_VERSION}"
-        )
+    """The legacy v2 whole-body ladder (read-only compat: rungs 2-6 of the
+    historical six-rung ladder, all-or-nothing). A v2 body that passes is
+    returned in the merged shape with an all-healthy single family; the
+    next flush re-persists it at v3."""
     if meta.get("chunks") != len(chunks) - 1:
         return None, (
             f"chunk count mismatch: header says {meta.get('chunks')}, "
@@ -276,6 +414,70 @@ def decode(
     checksum = hashlib.sha256(data).hexdigest()
     if meta.get("checksum") != checksum:
         return None, "checksum mismatch (corrupt snapshot)"
+    try:
+        body = json.loads(body_text)
+    except ValueError as e:
+        return None, f"body undecodable: {e}"
+    err = _shape_error(body)
+    if err:
+        return None, err
+    body["_meta"] = meta
+    body["_families"] = _single_family(body)
+    body["_corrupt"] = {"sections": [], "chains": []}
+    body["_chainless"] = {"groups": {}, "pods": []}
+    return body, ""
+
+
+def _section_valid(text: str, entry: Dict) -> bool:
+    """The per-section integrity rung: exact byte length + sha256. A
+    separate function so the chaos sensitivity meta-test can no-op it and
+    prove the pinned store-fault seeds then FAIL (the validation is
+    load-bearing, not decorative)."""
+    data = text.encode()
+    return len(data) == entry["bytes"] and (
+        hashlib.sha256(data).hexdigest() == entry["sha256"]
+    )
+
+
+def decode(
+    chunks: Optional[List[str]],
+    expected_fingerprint: str,
+    min_watermark=None,
+) -> Tuple[Optional[Dict], str]:
+    """Validate + reassemble a persisted chunk list. Returns
+    ``(snap, "")`` on success or ``(None, reason)`` naming the first rung
+    of the fallback ladder that failed — the caller counts it
+    (snapshotFallbackCount) and runs the full annotation replay.
+
+    On success ``snap`` is the MERGED body (healthy sections only) plus
+    bookkeeping the import path consumes:
+
+    - ``snap["_meta"]``: the validated manifest;
+    - ``snap["_families"]``: per chain-family records ``{name, chains,
+      ok, core, pods}`` (one pseudo-family with ``chains=None`` for
+      monolithic layouts) — the import path's unit of doom-gating and
+      demotion;
+    - ``snap["_corrupt"]``: ``{"sections": [...], "chains": [...]}`` for
+      the family sections that failed their rung — those chains replay
+      from annotations (partial fallback) while the rest restore.
+
+    Global refusals (whole snapshot unusable → ``None``): unreadable or
+    unknown-schema manifest, config fingerprint mismatch, stale
+    watermark, corrupt ``meta``/``health``/``body`` section, or every
+    chain-family section corrupt."""
+    if not chunks:
+        return None, "empty chunk list"
+    try:
+        meta = json.loads(chunks[0])
+    except (TypeError, ValueError) as e:
+        return None, f"meta header undecodable: {e}"
+    if not isinstance(meta, dict):
+        return None, "meta header is not an object"
+    if meta.get("schemaVersion") not in COMPAT_READ_VERSIONS:
+        return None, (
+            f"schema version mismatch: snapshot {meta.get('schemaVersion')}, "
+            f"running {SCHEMA_VERSION} (reads {COMPAT_READ_VERSIONS})"
+        )
     if meta.get("configFingerprint") != expected_fingerprint:
         return None, (
             "config fingerprint mismatch (reconfigured since the snapshot)"
@@ -287,13 +489,130 @@ def decode(
             f"stale watermark: snapshot at {meta.get('watermark')!r}, delta "
             f"floor {min_watermark!r}"
         )
-    try:
-        body = json.loads(body_text)
-    except ValueError as e:
-        return None, f"body undecodable: {e}"
-    if not isinstance(body, dict) or not isinstance(body.get("pods"), list):
-        return None, "body is not snapshot-shaped (missing pods list)"
-    if not isinstance(body.get("core"), dict):
-        return None, "body is not snapshot-shaped (missing core projection)"
-    body["_meta"] = meta
+    if meta.get("schemaVersion") == 2:
+        return _decode_v2(meta, chunks)
+
+    manifest_sections = meta.get("sections")
+    if not (
+        isinstance(manifest_sections, list)
+        and manifest_sections
+        and all(
+            isinstance(s, dict)
+            and isinstance(s.get("name"), str)
+            and isinstance(s.get("bytes"), int)
+            and s["bytes"] >= 0
+            and isinstance(s.get("sha256"), str)
+            for s in manifest_sections
+        )
+    ):
+        return None, "manifest section table malformed"
+
+    # NOTE deliberately absent global rungs: chunk count, whole-body byte
+    # length, and whole-body checksum are recorded in the manifest (the
+    # scrubber and ops tooling read them) but are NOT refusal rungs at v3
+    # — a dropped or truncated chunk shifts every later section's byte
+    # range so those sections fail their OWN sha rung, while sections
+    # before the damage stay restorable. Failing globally here would
+    # reintroduce exactly the all-or-nothing cliff this schema removes.
+    body_text = "".join(chunks[1:])
+
+    payloads: Dict[str, Dict] = {}
+    corrupt_sections: List[str] = []
+    corrupt_chains: List[str] = []
+    offset = 0
+    for entry in manifest_sections:
+        name = entry["name"]
+        text = body_text[offset: offset + entry["bytes"]]
+        offset += entry["bytes"]
+        ok = _section_valid(text, entry)
+        payload = None
+        if ok:
+            try:
+                payload = json.loads(text)
+            except ValueError:
+                payload = None
+            if not isinstance(payload, dict):
+                ok = False
+        if ok:
+            payloads[name] = payload
+        else:
+            corrupt_sections.append(name)
+            corrupt_chains.extend(str(c) for c in entry.get("chains") or ())
+
+    if SECTION_BODY in (e["name"] for e in manifest_sections):
+        # Monolithic layout: one section, v2 semantics.
+        if SECTION_BODY in corrupt_sections:
+            return None, "body section corrupt"
+        body = payloads[SECTION_BODY]
+        err = _shape_error(body)
+        if err:
+            return None, err
+        body["_meta"] = meta
+        body["_families"] = _single_family(body)
+        body["_corrupt"] = {"sections": [], "chains": []}
+        body["_chainless"] = {"groups": {}, "pods": []}
+        return body, ""
+
+    # Sectioned layout: meta + health are load-bearing for every chain.
+    if SECTION_META in corrupt_sections:
+        return None, "meta section corrupt"
+    if SECTION_HEALTH in corrupt_sections:
+        return None, "health section corrupt"
+    meta_payload = payloads.get(SECTION_META)
+    health_payload = payloads.get(SECTION_HEALTH)
+    if meta_payload is None or health_payload is None:
+        return None, "manifest missing meta/health sections"
+
+    families: List[Dict] = []
+    any_ok = False
+    for entry in manifest_sections:
+        name = entry["name"]
+        if name in (SECTION_META, SECTION_HEALTH):
+            continue
+        chains = [str(c) for c in entry.get("chains") or ()]
+        fam = {"name": name, "chains": chains, "ok": name in payloads}
+        if fam["ok"]:
+            payload = payloads[name]
+            fam["core"] = payload.get("core") or {}
+            fam["pods"] = payload.get("pods") or []
+            if not isinstance(fam["pods"], list) or not isinstance(
+                fam["core"], dict
+            ):
+                fam["ok"] = False
+                fam["core"], fam["pods"] = {}, []
+                corrupt_sections.append(name)
+                corrupt_chains.extend(chains)
+        else:
+            fam["core"], fam["pods"] = {}, []
+        any_ok = any_ok or fam["ok"]
+        families.append(fam)
+    if not any_ok:
+        return None, "every chain-family section corrupt"
+
+    core = merge_core_slices([f["core"] for f in families if f["ok"]])
+    core["groups"].update(meta_payload.get("groups") or {})
+    pods: List = []
+    for f in families:
+        if f["ok"]:
+            pods.extend(f["pods"])
+    pods.extend(meta_payload.get("pods") or [])
+    body = {
+        "doomedEpoch": meta_payload.get("doomedEpoch"),
+        "health": health_payload,
+        "core": core,
+        "pods": pods,
+        "_meta": meta,
+        "_families": families,
+        "_corrupt": {
+            "sections": corrupt_sections,
+            "chains": sorted(set(corrupt_chains)),
+        },
+        # The chain-less remainder (groups with no chain yet + orphan
+        # pods) lives in the meta section; the partial-import path
+        # re-merges it after demoting doom-diverged families.
+        "_chainless": {
+            "groups": meta_payload.get("groups") or {},
+            "pods": meta_payload.get("pods") or [],
+        },
+    }
     return body, ""

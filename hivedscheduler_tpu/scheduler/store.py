@@ -1,0 +1,243 @@
+"""Pluggable snapshot stores (doc/fault-model.md "Durable-state plane v2").
+
+PR 7's durable envelope lived in a ConfigMap chunk family — ~1 MiB per
+object, apiserver-coupled, and etcd-priced per flush. At the 50k-host
+north star the projection outgrows that: this module extracts the
+persistence seam as a :class:`SnapshotStore` interface (``persist`` a
+chunk list / ``load`` it back) with two implementations:
+
+* the ConfigMap chunk family stays the DEFAULT and needs no store object
+  at all — ``RetryingKubeClient`` keeps routing to the apiserver when its
+  ``snapshot_store`` is None (the zero-regression path);
+* :class:`FileSnapshotStore` is the object-store backend: a
+  filesystem/S3-shaped layout (a POSIX directory stands in for a bucket —
+  an NFS/GCS-fuse mount in production, a tmpdir in tests) with
+  write-new-then-flip atomicity and generation GC.
+
+Atomicity contract (the part the chaos ``torn_chunk`` events attack): a
+``persist`` writes every chunk of a NEW generation directory first, fsyncs
+them, and only then flips the single ``MANIFEST`` pointer via the POSIX
+``os.replace`` rename — readers follow the pointer, so they observe either
+the previous complete generation or the new complete generation, never a
+mix. A crash or torn write before the flip leaves orphan files the next
+GC sweeps; a torn MANIFEST write is impossible by the rename's atomicity.
+GC keeps the last ``keep_generations`` generations (point-in-time rollback
+for operators) and never touches the current one.
+
+Failure model: every OSError is wrapped in :class:`StoreUnavailableError`,
+which carries ``kube_retryable = True`` so ``is_retryable_kube_error``
+classifies a store outage exactly like an apiserver 5xx — capped retries
+feeding the weather vane, and once the vane reads blackout the manifest
+write parks in the PR 18 intent journal instead of raising (zero errors
+surfaced to the flusher; the journal drains when the store heals).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import List, Optional
+
+from .. import common
+
+MANIFEST_NAME = "MANIFEST"
+GENERATION_PREFIX = "gen-"
+CHUNK_PREFIX = "chunk-"
+
+
+class StoreUnavailableError(OSError):
+    """The backing store is unreachable (mount gone, bucket 5xx, disk
+    full). ``kube_retryable`` makes the shared classifier treat it as a
+    transient control-plane failure: retries with backoff, then the
+    write-behind intent journal under blackout — never a raised error on
+    the flusher path."""
+
+    kube_retryable = True
+
+
+class SnapshotStore:
+    """Where the durable snapshot envelope lives. Implementations must be
+    atomic at the chunk-list granularity: ``load`` returns either a
+    complete previously-persisted list or None (nothing persisted yet) —
+    torn writes must be invisible (the PR 7 validation ladder is the
+    second line of defense, not the first)."""
+
+    name = "abstract"
+
+    def persist(self, chunks: List[str]) -> None:
+        raise NotImplementedError
+
+    def load(self) -> Optional[List[str]]:
+        raise NotImplementedError
+
+
+class FileSnapshotStore(SnapshotStore):
+    """Filesystem/S3-shaped object store::
+
+        root/
+          MANIFEST              # {"generation": N, "chunks": k} — the pointer
+          gen-00000042/chunk-0000 ... chunk-<k-1>
+
+    No 1 MiB cap (chunking is kept only so the envelope format is
+    identical across backends), no apiserver round-trips, and the flip is
+    one ``os.replace``."""
+
+    name = "file"
+
+    def __init__(self, root: str, keep_generations: int = 3) -> None:
+        if not root:
+            raise ValueError("FileSnapshotStore requires a root path")
+        self.root = root
+        self.keep_generations = max(1, int(keep_generations))
+        # Test/ops visibility, not golden metrics.
+        self.persist_count = 0
+        self.gc_removed_count = 0
+
+    # ------------------------------------------------------------------ #
+    # helpers
+    # ------------------------------------------------------------------ #
+
+    def _manifest_path(self) -> str:
+        return os.path.join(self.root, MANIFEST_NAME)
+
+    def _gen_dir(self, gen: int) -> str:
+        return os.path.join(self.root, f"{GENERATION_PREFIX}{gen:08d}")
+
+    def _read_manifest(self) -> Optional[dict]:
+        try:
+            with open(self._manifest_path(), "r", encoding="utf-8") as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            return None
+        except ValueError:
+            # A corrupt pointer is indistinguishable from no pointer:
+            # the next persist writes a fresh generation and flips over
+            # it; load treats the store as empty (recovery falls back).
+            common.log.warning(
+                "snapshot store manifest unreadable at %s; treating the "
+                "store as empty", self._manifest_path(),
+            )
+            return None
+        if not (
+            isinstance(manifest, dict)
+            and isinstance(manifest.get("generation"), int)
+            and isinstance(manifest.get("chunks"), int)
+        ):
+            return None
+        return manifest
+
+    def _generations_on_disk(self) -> List[int]:
+        try:
+            names = os.listdir(self.root)
+        except FileNotFoundError:
+            return []
+        gens = []
+        for n in names:
+            if n.startswith(GENERATION_PREFIX):
+                try:
+                    gens.append(int(n[len(GENERATION_PREFIX):]))
+                except ValueError:
+                    continue
+        return sorted(gens)
+
+    # ------------------------------------------------------------------ #
+    # SnapshotStore
+    # ------------------------------------------------------------------ #
+
+    def persist(self, chunks: List[str]) -> None:
+        try:
+            os.makedirs(self.root, exist_ok=True)
+            manifest = self._read_manifest()
+            on_disk = self._generations_on_disk()
+            current = max(
+                [manifest["generation"]] if manifest else [0] + on_disk
+            )
+            gen = current + 1
+            gen_dir = self._gen_dir(gen)
+            os.makedirs(gen_dir, exist_ok=True)
+            for i, chunk in enumerate(chunks):
+                path = os.path.join(gen_dir, f"{CHUNK_PREFIX}{i:04d}")
+                with open(path, "w", encoding="utf-8") as f:
+                    f.write(chunk)
+                    f.flush()
+                    os.fsync(f.fileno())
+            # The commit point: write the new pointer beside the old one,
+            # fsync it, then atomically rename over MANIFEST. Readers see
+            # the old complete generation until this instant.
+            tmp = self._manifest_path() + f".tmp-{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"generation": gen, "chunks": len(chunks)}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._manifest_path())
+            self.persist_count += 1
+            self._gc(gen)
+        except OSError as e:
+            if isinstance(e, StoreUnavailableError):
+                raise
+            raise StoreUnavailableError(
+                f"snapshot store write failed under {self.root}: {e}"
+            ) from e
+
+    def load(self) -> Optional[List[str]]:
+        try:
+            manifest = self._read_manifest()
+            if manifest is None:
+                return None
+            gen_dir = self._gen_dir(manifest["generation"])
+            chunks: List[str] = []
+            for i in range(manifest["chunks"]):
+                path = os.path.join(gen_dir, f"{CHUNK_PREFIX}{i:04d}")
+                try:
+                    with open(path, "r", encoding="utf-8") as f:
+                        chunks.append(f.read())
+                except FileNotFoundError:
+                    # Torn family (GC raced a reader, or bit-level loss):
+                    # return what exists — the validation ladder demotes
+                    # the missing sections and recovery degrades in
+                    # proportion, exactly the ConfigMap-backend contract.
+                    break
+            return chunks or None
+        except OSError as e:
+            if isinstance(e, StoreUnavailableError):
+                raise
+            raise StoreUnavailableError(
+                f"snapshot store read failed under {self.root}: {e}"
+            ) from e
+
+    # ------------------------------------------------------------------ #
+    # GC
+    # ------------------------------------------------------------------ #
+
+    def _gc(self, current: int) -> None:
+        """Keep the last ``keep_generations`` generations (the current one
+        always included); best-effort — a GC failure never fails the
+        persist that triggered it (the flip already landed)."""
+        floor = current - self.keep_generations + 1
+        for gen in self._generations_on_disk():
+            if gen >= floor:
+                continue
+            try:
+                shutil.rmtree(self._gen_dir(gen))
+                self.gc_removed_count += 1
+            except OSError as e:
+                common.log.warning(
+                    "snapshot store GC could not remove generation %d: %s",
+                    gen, e,
+                )
+
+
+def make_snapshot_store(config) -> Optional[SnapshotStore]:
+    """Operator wiring (``__main__``): the configured backend, or None for
+    the default ConfigMap chunk family (RetryingKubeClient then routes
+    snapshot persistence to the apiserver exactly as before)."""
+    backend = getattr(config, "snapshot_store_backend", "configmap")
+    if backend in ("", "configmap"):
+        return None
+    if backend == "file":
+        return FileSnapshotStore(
+            config.snapshot_store_path,
+            keep_generations=config.snapshot_store_gc_generations,
+        )
+    raise ValueError(f"unknown snapshotStoreBackend {backend!r}")

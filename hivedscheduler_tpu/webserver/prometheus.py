@@ -56,6 +56,10 @@ COUNTERS: Dict[str, tuple] = {
     "snapshotPersistCount": ("hived_snapshot_persists_total", "successful snapshot ConfigMap writes"),
     "snapshotPersistFailureCount": ("hived_snapshot_persist_failures_total", "failed snapshot ConfigMap writes"),
     "snapshotFallbackCount": ("hived_snapshot_fallbacks_total", "recoveries that fell back from an unusable snapshot to full annotation replay"),
+    "snapshotSectionFallbackCount": ("hived_snapshot_section_fallbacks_total", "chain-family sections refused at recovery (checksum or doom-gate) whose chains replayed from annotations while healthy sections restored wholesale (durable-state plane v2)"),
+    "scrubRunCount": ("hived_scrub_runs_total", "integrity-scrub passes over the durable snapshot (event-clocked on flusher/standby beats at snapshotScrubIntervalBeats)"),
+    "scrubDivergenceCount": ("hived_scrub_divergences_total", "scrub passes that found the durable envelope diverged from live state (unusable, corrupt sections, or doomed-set drift; counted + journaled under _scrub + black-box bundle dumped — should stay 0)"),
+    "scrubRepairCount": ("hived_scrub_repairs_total", "scrub divergences repaired (leader: durable snapshot rewritten from the live projection; standby: pre-applied projection discarded and re-prefetched)"),
     "deposedBindRefusedCount": ("hived_deposed_bind_refusals_total", "bind writes refused because this process no longer holds the leader lease"),
     "gangShrinkCount": ("hived_gang_shrinks_total", "stranded gangs shrunk in place instead of evicted (elastic gang plane)"),
     "gangShrinkAbortCount": ("hived_gang_shrink_aborts_total", "shrinks aborted and rolled back (survivor annotation patch failed or the gang changed mid-flight)"),
@@ -96,6 +100,7 @@ GAUGES: Dict[str, tuple] = {
     "leader": ("hived_leader", "1 while this process holds (or needs no) leader lease, else 0"),
     "snapshotImportedPodCount": ("hived_snapshot_imported_pods", "bound pods bulk-imported from the snapshot at the last recovery"),
     "snapshotDeltaPodCount": ("hived_snapshot_delta_pods", "pods replayed or released as deltas past the snapshot at the last recovery"),
+    "snapshotAgeSeconds": ("hived_snapshot_age_seconds", "seconds since the last durable snapshot flush landed (-1 before the first flush; alert on this against snapshotMaxStalenessSeconds)"),
     "whatifForkPodCount": ("hived_whatif_fork_pods", "pods restored into the most recent shadow fork"),
     "whatifForkAgeSeconds": ("hived_whatif_fork_age_seconds", "seconds since the most recent shadow fork was built (forecast staleness; -1 before the first fork)"),
     "whatifForecastSeconds": ("hived_whatif_forecast_seconds", "wall seconds of the most recent what-if forecast (fork + replay)"),
@@ -135,7 +140,7 @@ EXCLUDED_KEYS = {
     "lockWaitByChain",      # rendered as hived_lock_* labeled series
     "latencyHistograms",    # rendered as hived_*_latency_seconds
     "lockSharding",         # string mode flag ("chains"/"global")
-    "recoveryMode",         # string mode flag ("none"/"full"/"snapshot+delta")
+    "recoveryMode",         # string mode flag ("none"/"full"/"snapshot+delta"/"snapshot+partial")
     "bootPhaseSeconds",     # rendered as the hived_boot_phase_seconds gauge
     "buildInfo",            # rendered as the hived_build_info labeled gauge
     "wireBytesTotal",       # rendered as the hived_wire_bytes_total labeled counter

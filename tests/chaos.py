@@ -74,6 +74,7 @@ from hivedscheduler_tpu.algorithm.core import (
 from hivedscheduler_tpu.algorithm.group import GroupState
 from hivedscheduler_tpu.api import constants, extender as ei, types as api
 from hivedscheduler_tpu.scheduler import ha as ha_mod
+from hivedscheduler_tpu.scheduler import scrub as scrub_mod
 from hivedscheduler_tpu.scheduler import snapshot as snapshot_mod
 from hivedscheduler_tpu.scheduler import weather as weather_mod
 from hivedscheduler_tpu.scheduler.framework import HivedScheduler, KubeClient
@@ -171,6 +172,26 @@ _WEATHER_FAMILY = (
 )
 WEATHER_EVENTS = tuple(name for name, _ in _WEATHER_FAMILY)
 
+# Durable-state plane v2 (doc/fault-model.md): store-fault vocabulary —
+# torn writes, lost section objects, silent bit rot, a manifest gone
+# stale relative to its body, and a slow-but-honest store. Each event
+# corrupts (or delays) the persisted envelope and asserts the integrity
+# SCRUBBER detects it within one cadence (counter + _scrub journal +
+# black-box artifact) and repairs by rewriting from the live projection;
+# the next crash_restart then exercises partial-fallback recovery against
+# whatever the scrubber did not get to repair. Like "weather", the
+# "store" alias of HIVED_CHAOS_MIX is ADDITIVE — appended after the
+# default table so every pinned non-store seed's roll sequence is
+# byte-identical. hack/soak.sh --store sweeps it.
+_STORE_FAMILY = (
+    ("torn_chunk", 3.0),
+    ("missing_section", 3.0),
+    ("bit_flip", 3.0),
+    ("stale_manifest", 2.0),
+    ("slow_store", 2.0),
+)
+STORE_EVENTS = tuple(name for name, _ in _STORE_FAMILY)
+
 
 def event_weights(mix_env: Optional[str] = None) -> List:
     """The (event, weight) table after applying the HIVED_CHAOS_MIX knob."""
@@ -179,6 +200,7 @@ def event_weights(mix_env: Optional[str] = None) -> List:
     )
     mult: Dict[str, float] = {}
     weather_factor = 0.0
+    store_factor = 0.0
     for part in mix.split(","):
         part = part.strip()
         if not part or ":" not in part:
@@ -199,6 +221,8 @@ def event_weights(mix_env: Optional[str] = None) -> List:
                 mult[ev] = mult.get(ev, 1.0) * factor
         elif name.strip() == "weather":
             weather_factor = factor
+        elif name.strip() == "store":
+            store_factor = factor
         else:
             mult[name.strip()] = factor
     weighted = [
@@ -215,6 +239,15 @@ def event_weights(mix_env: Optional[str] = None) -> List:
             (ev, base * weather_factor * mult.get(ev, 1.0))
             for ev, base in _WEATHER_FAMILY
             if base * weather_factor * mult.get(ev, 1.0) > 0
+        )
+    if store_factor > 0:
+        # Additive for the same reason as the weather family: the default
+        # table (and a weather-extended table) keeps its entries, weights,
+        # and order, so pinned non-store seeds replay byte-identically.
+        weighted.extend(
+            (ev, base * store_factor * mult.get(ev, 1.0))
+            for ev, base in _STORE_FAMILY
+            if base * store_factor * mult.get(ev, 1.0) > 0
         )
     # A mix that zeroes everything is a knob error; fall back to defaults
     # rather than dividing by an empty table.
@@ -580,8 +613,16 @@ class ChaosHarness:
             "snapshot_recoveries": 0,
             "snapshot_fallbacks": 0,
             "snapshot_doom_fallbacks": 0,
+            "snapshot_partial_recoveries": 0,
             "snapshot_corruptions": 0,
             "stale_snapshots": 0,
+            # Durable-state plane v2: store-fault events injected and the
+            # scrub detections/repairs they provoked (zero outside store
+            # mode — the stats shape is schedule-independent).
+            "store_faults": 0,
+            "scrub_divergences": 0,
+            "scrub_repairs": 0,
+            "slow_store_flushes": 0,
             "failovers": 0,
             "hot_takeovers": 0,
             "deposed_bind_refusals": 0,
@@ -1348,6 +1389,176 @@ class ChaosHarness:
 
     def failover_mid_bind(self) -> None:
         self.crash_restart(failover=True, mid_bind=True)
+
+    # ------------- durable-state plane v2: store faults ------------- #
+    #
+    # Each fault event: flush a fresh envelope, corrupt the durable copy
+    # the way the named store failure would, then run one scrub cadence
+    # and assert the scrubber DETECTS it (divergence counter + _scrub
+    # journal record + black-box artifact) and — when the export gate
+    # allows a rewrite — REPAIRS it back to a decode-clean envelope. The
+    # live projection is never touched, so the scheduler keeps serving
+    # throughout; whatever repair could not land is exercised by the next
+    # crash_restart's partial-fallback contract instead.
+
+    def _store_scrubber(self) -> scrub_mod.SnapshotScrubber:
+        scrub = self.scheduler.scrubber
+        if scrub is None:
+            scrub = scrub_mod.SnapshotScrubber(
+                self.scheduler, interval_beats=1
+            )
+            self.scheduler.scrubber = scrub
+        return scrub
+
+    def _store_flush_fresh(self) -> bool:
+        """A fresh envelope matching live state — the precondition every
+        corruption event needs (otherwise there is nothing to rot)."""
+        self.scheduler.note_watermark(self.event_i)
+        if self.scheduler.flush_snapshot_now():
+            self.stats["snapshot_flushes"] += 1
+        return bool(self.kube.snapshot)
+
+    def _store_family_sections(self):
+        """(manifest, body_text, [(entry, start, end)] for the chain-family
+        sections of the persisted envelope)."""
+        import json as _json
+
+        snap = self.kube.snapshot
+        manifest = _json.loads(snap[0])
+        body = "".join(snap[1:])
+        fams = []
+        off = 0
+        for entry in manifest.get("sections") or []:
+            start, end = off, off + entry["bytes"]
+            off = end
+            if entry.get("chains"):
+                fams.append((entry, start, end))
+        return manifest, body, fams
+
+    def _store_write_body(self, body: str) -> None:
+        """Re-persist a corrupted body under the UNTOUCHED manifest chunk
+        (chunk sizes are irrelevant at decode: sections are byte ranges of
+        the joined body)."""
+        head = self.kube.snapshot[0]
+        chunks = [body[i:i + 4096] for i in range(0, len(body), 4096)]
+        self.kube.snapshot = [head] + (chunks or [""])
+
+    def _assert_scrub_detects(self, what: str) -> None:
+        scrub = self._store_scrubber()
+        sched = self.scheduler
+        d0, r0 = scrub.divergence_count, scrub.repair_count
+        j0 = sum(
+            1 for d in sched.decisions.snapshot() if d.get("pod") == "_scrub"
+        )
+        scrub.tick()  # one cadence (interval_beats=1)
+        assert scrub.divergence_count == d0 + 1, (
+            self.seed, what, "scrubber missed injected store corruption",
+        )
+        assert sum(
+            1 for d in sched.decisions.snapshot() if d.get("pod") == "_scrub"
+        ) == j0 + 1, (self.seed, what, "scrub divergence not journaled")
+        assert scrub.last_artifact and os.path.exists(scrub.last_artifact), (
+            self.seed, what, "scrub divergence dumped no black-box bundle",
+        )
+        self.stats["store_faults"] += 1
+        self.stats["scrub_divergences"] += 1
+        if scrub.repair_count > r0:
+            self.stats["scrub_repairs"] += scrub.repair_count - r0
+            repaired, reason = snapshot_mod.decode(
+                self.kube.snapshot, sched._config_fingerprint, 0
+            )
+            corrupt = (repaired or {}).get("_corrupt") or {}
+            assert repaired is not None and not (
+                corrupt.get("sections") or corrupt.get("chains")
+            ), (
+                self.seed, what, "scrub repair left a corrupt envelope",
+                reason,
+            )
+
+    def torn_chunk(self) -> None:
+        """A torn store write: the tail of the envelope never made it.
+        Later sections shift past their byte ranges and fail their own
+        sha rungs; sections before the tear stay restorable."""
+        if not self._store_flush_fresh():
+            return
+        snap = self.kube.snapshot
+        if len(snap) < 2 or not snap[-1]:
+            return
+        snap[-1] = snap[-1][: len(snap[-1]) // 2]
+        self._assert_scrub_detects("torn_chunk")
+
+    def missing_section(self) -> None:
+        """A lost section object: one chain-family section's bytes vanish
+        from the body while the manifest still lists it."""
+        if not self._store_flush_fresh():
+            return
+        manifest, body, fams = self._store_family_sections()
+        if not fams:
+            return
+        # The LAST family section keeps the fault localized (no byte
+        # shift for earlier sections) — the minimal partial-fallback
+        # shape; torn_chunk covers the cascading variant.
+        entry, start, end = fams[-1]
+        self._store_write_body(body[:start] + body[end:])
+        self._assert_scrub_detects("missing_section")
+
+    def bit_flip(self) -> None:
+        """Silent bit rot inside one chain-family section's byte range:
+        only that section's sha rung fails; every other section restores
+        wholesale."""
+        if not self._store_flush_fresh():
+            return
+        manifest, body, fams = self._store_family_sections()
+        if not fams:
+            return
+        entry, start, end = fams[self.rnd.randrange(len(fams))]
+        if end <= start:
+            return
+        pos = start + self.rnd.randrange(end - start)
+        flipped = "X" if body[pos] != "X" else "Y"
+        self._store_write_body(body[:pos] + flipped + body[pos + 1:])
+        self._assert_scrub_detects("bit_flip")
+
+    def stale_manifest(self) -> None:
+        """The manifest went stale relative to its body (a generation
+        flip raced a body rewrite): one family entry's recorded sha no
+        longer matches the — intact — section bytes."""
+        if not self._store_flush_fresh():
+            return
+        import json as _json
+
+        manifest, body, fams = self._store_family_sections()
+        if not fams:
+            return
+        entry, _start, _end = fams[self.rnd.randrange(len(fams))]
+        for s in manifest["sections"]:
+            if s["name"] == entry["name"]:
+                s["sha256"] = "0" * 64
+        self.kube.snapshot[0] = _json.dumps(
+            manifest, separators=(",", ":")
+        )
+        self._assert_scrub_detects("stale_manifest")
+
+    def slow_store(self) -> None:
+        """A slow-but-honest store: transient write failures that clear
+        within the retry budget. The flush must land (retries absorb the
+        slowness) and the scrubber must find NOTHING — slowness is
+        weather, never rot."""
+        scrub = self._store_scrubber()
+        d0 = scrub.divergence_count
+        self.kube.snapshot_fault_queue.extend(
+            transient_fault() for _ in range(self.rnd.randint(1, 2))
+        )
+        self.scheduler.note_watermark(self.event_i)
+        if self.scheduler.flush_snapshot_now():
+            self.stats["snapshot_flushes"] += 1
+            self.stats["slow_store_flushes"] += 1
+        self.kube.snapshot_fault_queue.clear()
+        scrub.tick()
+        assert scrub.divergence_count == d0, (
+            self.seed, "slow store misread as corruption",
+        )
+        self.stats["store_faults"] += 1
 
     def _start_pending_bind(self):
         """Create a fresh 1-pod gang and run it through filter ONLY: an
@@ -2374,48 +2585,7 @@ class ChaosHarness:
         expected, _reason = snapshot_mod.decode(
             snapshot_at_crash, new._config_fingerprint, 0
         )
-        if expected is not None and not self._snapshot_dooms_match_ledger(
-            expected, state_at_crash
-        ):
-            # The documented doom-staleness gate (framework.import_snapshot):
-            # advisory doomed bindings are history-dependent and organic
-            # doom churn is suspended during recovery, so a snapshot whose
-            # doomed set diverged from the crash ledger cannot be
-            # delta-converged — it must fall back to the full replay, which
-            # is the proven PR-3 path.
-            assert new._recovery_mode == "full", (
-                self.seed, "doom-diverged snapshot was not refused",
-                new._recovery_mode,
-            )
-            assert (
-                new.metrics.snapshot()["snapshotFallbackCount"] >= 1
-            ), (self.seed, "doom-divergence fallback not counted")
-            self.stats["snapshot_doom_fallbacks"] += 1
-            return
-        if expected is not None:
-            assert new._recovery_mode == "snapshot+delta", (
-                self.seed, "valid snapshot not used for recovery",
-                new._recovery_mode,
-            )
-            self.stats["snapshot_recoveries"] += 1
-            full = self._recover_shadow(
-                nodes_at_crash, pods_at_crash, state_at_crash, None
-            )
-            assert full._recovery_mode == "full"
-            assert core_fingerprint(full.core) == core_fingerprint(
-                new.core
-            ), (
-                self.seed,
-                "snapshot+delta recovery diverges from full replay",
-            )
-            nodes = self.live_nodes()
-            assert probe_outcomes(
-                full.core, nodes, self.seed
-            ) == probe_outcomes(new.core, nodes, self.seed), (
-                self.seed,
-                "probe outcomes diverge: snapshot+delta vs full replay",
-            )
-        else:
+        if expected is None:
             assert new._recovery_mode == "full", (
                 self.seed, "unusable snapshot did not fall back",
                 new._recovery_mode,
@@ -2424,6 +2594,80 @@ class ChaosHarness:
                 new.metrics.snapshot()["snapshotFallbackCount"] >= 1
             ), (self.seed, "fallback not counted")
             self.stats["snapshot_fallbacks"] += 1
+            return
+        corrupt = expected.get("_corrupt") or {}
+        dooms_ok = self._snapshot_dooms_match_ledger(
+            expected, state_at_crash
+        )
+        if corrupt.get("chains") or not dooms_ok:
+            # Durable-state plane v2: a snapshot with corrupt chain-family
+            # sections — or one whose doomed set diverged from the crash
+            # ledger (v3 gates dooms PER FAMILY, so confined divergence
+            # demotes only the families it touches) — recovers PARTIALLY
+            # when at least one family survives the gate + spanning-node
+            # closure, and falls back to the full replay otherwise. Either
+            # way the landed state must be BIT-EQUAL to the full annotation
+            # replay: strict core fingerprints plus probe outcomes —
+            # partial fallback is an optimization, never a different
+            # answer.
+            assert new._recovery_mode in ("snapshot+partial", "full"), (
+                self.seed, "degraded snapshot neither partial nor full",
+                new._recovery_mode,
+            )
+            m = new.metrics.snapshot()
+            if new._recovery_mode == "snapshot+partial":
+                assert m["snapshotSectionFallbackCount"] >= 1, (
+                    self.seed, "partial fallback not counted per section",
+                )
+                self.stats["snapshot_partial_recoveries"] += 1
+                full = self._recover_shadow(
+                    nodes_at_crash, pods_at_crash, state_at_crash, None
+                )
+                assert full._recovery_mode == "full"
+                assert core_fingerprint(full.core) == core_fingerprint(
+                    new.core
+                ), (
+                    self.seed,
+                    "snapshot+partial recovery diverges from full replay",
+                )
+                nodes = self.live_nodes()
+                assert probe_outcomes(
+                    full.core, nodes, self.seed
+                ) == probe_outcomes(new.core, nodes, self.seed), (
+                    self.seed,
+                    "probe outcomes diverge: snapshot+partial vs full",
+                )
+            else:
+                assert m["snapshotFallbackCount"] >= 1, (
+                    self.seed, "degraded-snapshot fallback not counted",
+                )
+                self.stats[
+                    "snapshot_fallbacks" if corrupt.get("chains")
+                    else "snapshot_doom_fallbacks"
+                ] += 1
+            return
+        assert new._recovery_mode == "snapshot+delta", (
+            self.seed, "valid snapshot not used for recovery",
+            new._recovery_mode,
+        )
+        self.stats["snapshot_recoveries"] += 1
+        full = self._recover_shadow(
+            nodes_at_crash, pods_at_crash, state_at_crash, None
+        )
+        assert full._recovery_mode == "full"
+        assert core_fingerprint(full.core) == core_fingerprint(
+            new.core
+        ), (
+            self.seed,
+            "snapshot+delta recovery diverges from full replay",
+        )
+        nodes = self.live_nodes()
+        assert probe_outcomes(
+            full.core, nodes, self.seed
+        ) == probe_outcomes(new.core, nodes, self.seed), (
+            self.seed,
+            "probe outcomes diverge: snapshot+delta vs full replay",
+        )
 
     def _assert_degraded_recovery(
         self,
@@ -3002,7 +3246,8 @@ class ProcChaosHarness:
             "events": 0, "binds": 0, "restarts": 0, "failovers": 0,
             "hot_takeovers": 0, "snapshot_flushes": 0,
             "snapshot_corruptions": 0, "snapshot_recoveries": 0,
-            "snapshot_fallbacks": 0, "node_flips": 0, "ticks": 0,
+            "snapshot_fallbacks": 0, "snapshot_partial_recoveries": 0,
+            "node_flips": 0, "ticks": 0,
             "preempts": 0, "preempt_restarts": 0,
             "deposed_bind_refusals": 0, "broadcasts": 0,
             # Supervision-plane events (zero outside supervise mode so
@@ -3497,12 +3742,18 @@ class ProcChaosHarness:
             if snap is None:
                 out.append("fallback")
                 continue
-            if ChaosHarness._snapshot_dooms_match_ledger(
-                snap, ledgers.get(str(sid))
+            corrupt = snap.get("_corrupt") or {}
+            if corrupt.get("chains") or not (
+                ChaosHarness._snapshot_dooms_match_ledger(
+                    snap, ledgers.get(str(sid))
+                )
             ):
-                out.append("snapshot+delta")
+                # Durable-state plane v2: corrupt chain-family sections
+                # (or per-family doom divergence) recover partially when
+                # any family survives the gate, full otherwise.
+                out.append("degraded")
             else:
-                out.append("fallback")
+                out.append("snapshot+delta")
         return out
 
     def crash_restart(self, failover: bool = False, mid_bind: bool = False) -> None:
@@ -3597,6 +3848,21 @@ class ProcChaosHarness:
                 )
                 assert m["snapshotFallbackCount"] >= 1, (self.seed, sid)
                 self.stats["snapshot_fallbacks"] += 1
+            elif expected == "degraded":
+                # Corrupt chain sections / doom divergence: the shard
+                # replays the affected families (partial) or, when no
+                # family survives the gate, falls back wholesale.
+                assert mode in ("snapshot+partial", "full"), (
+                    self.seed, sid, mode, "degraded snapshot misused",
+                )
+                if mode == "snapshot+partial":
+                    assert m["snapshotSectionFallbackCount"] >= 1, (
+                        self.seed, sid,
+                    )
+                    self.stats["snapshot_partial_recoveries"] += 1
+                else:
+                    assert m["snapshotFallbackCount"] >= 1, (self.seed, sid)
+                    self.stats["snapshot_fallbacks"] += 1
             else:
                 assert mode == "full", (self.seed, sid, mode)
 

@@ -476,6 +476,38 @@ def test_bench_outage_smoke():
     json.dumps(result)
 
 
+def test_bench_store_smoke():
+    """Smoke-sized variant of the HIVED_BENCH_STORE stage (ISSUE 19):
+    the partial-fallback recovery A/B plus the object-store wall, tiny
+    fleet. Landed-state equivalence (partial == full replay == clean
+    snapshot+delta, by physical placement fingerprint and pod set),
+    recovery modes, and store GC holding exactly N generations are
+    asserted INSIDE the stage at every sizing; the >=3x speedup gate is
+    the 432-host driver stage's (hack/soak.sh --store) — a tiny fleet's
+    corrupt family holds half the pods, so CI boxes only guard wiring
+    and key presence."""
+    result = bench.bench_store(
+        cubes=2, slices=4, solos=2, n_gangs=60, reps=1, store_reps=2,
+    )
+    assert_stage_meta(result)
+    assert result["pods_recovered"] > 0
+    assert result["snapshot_bytes"] > 0
+    assert result["family_sections"] >= 2
+    assert result["corrupt_section_bytes"] > 0
+    assert result["corrupt_family_pods"] > 0
+    assert result["replayed_sections"] >= 1
+    assert result["warm_standby"] is True
+    assert result["full_replay_ms"] > 0
+    assert result["partial_fallback_ms"] > 0
+    assert result["partial_speedup"] > 0
+    assert result["speedup_gate"] == 3.0
+    assert "gate_passed" in result
+    assert result["store_persist_ms"] > 0
+    assert result["store_load_ms"] > 0
+    assert result["store_gc_kept"] == 3
+    json.dumps(result)
+
+
 def test_bench_whatif_smoke():
     """Smoke-sized variant of the HIVED_BENCH_WHATIF stage (ISSUE 14
     CI/tooling satellite): the mid-trace what-if sample must forecast

@@ -1097,3 +1097,88 @@ def test_nooped_differential_drain_is_caught():
     for seed in WEATHER_DIFF_SEEDS:
         with pytest.raises(AssertionError):
             chaos.run_weather_differential(seed, noop_drain=True)
+
+
+# --------------------------------------------------------------------- #
+# Durable-state plane v2 (scheduler.store + scheduler.scrub;
+# doc/fault-model.md "Durable-state plane v2")
+# --------------------------------------------------------------------- #
+
+# Coverage floor for the store-fault sweep (HIVED_CHAOS_STORE_ROUNDS
+# overrides for soaks — hack/soak.sh --store drives it). The store family
+# is ADDITIVE (mix alias "store:N" appends to the default event table),
+# so these schedules exercise the full fault plane UNDER storage rot.
+STORE_CHAOS_ROUNDS = (
+    int(os.environ.get("HIVED_CHAOS_STORE_ROUNDS", "0")) or 12
+)
+
+# Seeds whose store-mix schedules die if per-section validation is
+# no-op'd (see test_nooped_section_validation_is_caught): each lands at
+# least one corruption that keeps the envelope JSON-parseable
+# (stale_manifest, string-interior bit_flip), so only the checksum —
+# not the JSON decoder — can catch it. Derived with mix "store:6"
+# against the current rng stream; re-derive when the event mix changes.
+STORE_SENSITIVE_SEEDS = (0, 1, 2, 3, 5, 6)
+
+
+def test_chaos_store_mix_sweep():
+    """The chaos acceptance for the durable-state plane v2: seeded
+    schedules through the store-weighted mix — torn chunk writes,
+    spliced-out sections, in-band bit flips, stale manifest checksums,
+    and slow (but honest) stores. The integrity scrubber must detect
+    every injected corruption within one cadence (divergence counter +
+    ``_scrub`` journal record + black-box bundle) while the scheduler
+    keeps serving, repair by rewriting from the live projection, and
+    never misread store slowness as rot."""
+    stats = {}
+    for seed in range(STORE_CHAOS_ROUNDS):
+        for k, v in chaos.run_chaos_schedule(
+            seed, mix="store:6"
+        ).items():
+            stats[k] = stats.get(k, 0) + v
+    assert stats["restarts"] >= STORE_CHAOS_ROUNDS, stats
+    for key in (
+        "store_faults", "scrub_divergences", "scrub_repairs",
+        "slow_store_flushes", "snapshot_flushes",
+    ):
+        assert stats[key] > 0, (key, stats)
+    # Detection is not allowed to outpace injection: every divergence the
+    # scrubber counted traces back to an injected fault (slow_store
+    # asserts NO divergence inline, so the residue is corruption-only).
+    assert stats["scrub_divergences"] <= stats["store_faults"], stats
+
+
+def test_default_mix_stays_store_free():
+    """Pinned-seed safety: the store family is additive-only — the
+    DEFAULT event table must stay byte-identical (same names, same
+    weights, same order) so every pinned seed set in this file keeps its
+    rng stream. A store event leaking into the default mix silently
+    re-derives all of them."""
+    default_names = [name for name, _ in chaos.event_weights(None)]
+    assert not set(default_names) & set(chaos.STORE_EVENTS), (
+        default_names,
+    )
+    store_names = [name for name, _ in chaos.event_weights("store:6")]
+    # The alias APPENDS: the default prefix is untouched.
+    assert store_names[: len(default_names)] == default_names
+    assert set(store_names[len(default_names):]) == set(
+        chaos.STORE_EVENTS
+    )
+
+
+def test_nooped_section_validation_is_caught(monkeypatch):
+    """Sensitivity meta-test: with per-section validation no-op'd (every
+    section reported healthy regardless of bytes), the scrubber goes
+    blind to checksum-only corruption — stale manifests and bit flips
+    that keep the JSON parseable — so every pinned store seed's schedule
+    must fail its detect-within-one-cadence assert. If this passes while
+    ``_section_valid`` is dead, the store sweep proves nothing about the
+    validation ladder."""
+    from hivedscheduler_tpu.scheduler import snapshot as snapshot_mod
+
+    monkeypatch.setattr(
+        snapshot_mod, "_section_valid", lambda *a, **k: True,
+    )
+    for seed in STORE_SENSITIVE_SEEDS:
+        with pytest.raises(AssertionError):
+            chaos.run_chaos_schedule(seed, mix="store:6")

@@ -21,7 +21,9 @@ optimistic-write race between two standbys, and the deposed leader's
 bind fence + readiness gate.
 """
 
+import hashlib
 import json
+import os
 import random
 
 import pytest
@@ -43,8 +45,11 @@ from .test_placement_equivalence import random_config
 # new shape) — this test is the reminder.
 GOLDEN_META_KEYS = {
     "schemaVersion", "checksum", "bytes", "chunks", "configFingerprint",
-    "watermark",
+    "watermark", "sections",
 }
+# Per-section manifest entries (schema v3): name + byte range + SHA-256,
+# plus the covered chain list on chain-family sections.
+GOLDEN_SECTION_KEYS = {"name", "bytes", "sha256"}
 GOLDEN_BODY_KEYS = {"doomedEpoch", "health", "core", "pods"}
 GOLDEN_POD_KEYS = {
     "name", "namespace", "uid", "node", "phase", "resourceLimits",
@@ -124,12 +129,37 @@ def test_golden_snapshot_schema_export():
 
     meta = json.loads(chunks[0])
     assert set(meta) == GOLDEN_META_KEYS, set(meta) ^ GOLDEN_META_KEYS
-    assert meta["schemaVersion"] == snapshot_mod.SCHEMA_VERSION == 2
+    assert meta["schemaVersion"] == snapshot_mod.SCHEMA_VERSION == 3
     assert meta["watermark"] == 41
     assert meta["configFingerprint"] == sched._config_fingerprint
     assert meta["chunks"] == len(chunks) - 1
 
-    body = json.loads("".join(chunks[1:]))
+    # Section table: meta + health first, then one section per chain
+    # family (each naming its chains), every byte range sha-verified.
+    body_text = "".join(chunks[1:])
+    names = [e["name"] for e in meta["sections"]]
+    assert names[:2] == [
+        snapshot_mod.SECTION_META, snapshot_mod.SECTION_HEALTH,
+    ]
+    assert len(names) >= 3
+    assert all(n.startswith("family:") for n in names[2:])
+    offset = 0
+    for entry in meta["sections"]:
+        assert set(entry) - {"chains"} == GOLDEN_SECTION_KEYS
+        text = body_text[offset: offset + entry["bytes"]]
+        offset += entry["bytes"]
+        assert hashlib.sha256(text.encode()).hexdigest() == entry["sha256"]
+        assert isinstance(json.loads(text), dict)
+        if entry["name"].startswith("family:"):
+            assert entry["chains"], "family sections must name their chains"
+    assert offset == meta["bytes"] == len(body_text.encode())
+
+    # The MERGED view (what import consumes) carries the pinned body keys.
+    decoded, reason = snapshot_mod.decode(
+        chunks, sched._config_fingerprint, min_watermark=0
+    )
+    assert decoded is not None, reason
+    body = {k: v for k, v in decoded.items() if not k.startswith("_")}
     assert set(body) == GOLDEN_BODY_KEYS, set(body) ^ GOLDEN_BODY_KEYS
     assert len(body["pods"]) == 1
     pod_rec = body["pods"][0]
@@ -773,3 +803,289 @@ def test_incremental_export_matches_cold_rebuild():
         core.export_projection()
         for chain, (epoch, section) in core._export_chain_memo.items():
             assert before[chain][1] is section, chain
+
+
+# --------------------------------------------------------------------- #
+# Durable-state plane v2: sectioned partial fallback, one-schema-back
+# read compat, the staleness override, and the integrity scrubber
+# --------------------------------------------------------------------- #
+
+
+def _family_section_range(chunks, index=0):
+    """Byte range of the index-th chain-family section inside the joined
+    body (manifest offsets — the same arithmetic decode runs)."""
+    manifest = json.loads(chunks[0])
+    offset = 0
+    families = []
+    for entry in manifest["sections"]:
+        if entry.get("chains"):
+            families.append((entry, offset, offset + entry["bytes"]))
+        offset += entry["bytes"]
+    return manifest, families[index]
+
+
+def test_partial_fallback_restores_healthy_families_and_matches_replay():
+    """The tentpole differential: corrupt EXACTLY one chain-family
+    section — recovery restores every healthy family wholesale, replays
+    only the corrupt family's chains from annotations, reports
+    ``snapshot+partial``, and lands bit-equal to a full annotation
+    replay that never had a snapshot."""
+    s1, inner = _booted()
+    b1 = _bind_one(s1, inner, "pf-0", "u-pf-0", vc="A")
+    b2 = _bind_one(s1, inner, "pf-1", "u-pf-1", vc="B")
+    s1.note_watermark(5)
+    assert s1.flush_snapshot_now()
+
+    manifest, (entry, start, _end) = _family_section_range(inner.snapshot)
+    assert entry["chains"], entry  # a chain family, not meta/health
+    body = "".join(inner.snapshot[1:])
+    pos = start + entry["bytes"] // 2
+    body = body[:pos] + ("X" if body[pos] != "X" else "Y") + body[pos + 1:]
+    inner.snapshot = [inner.snapshot[0], body]  # chunking is cosmetic
+
+    live_nodes = [Node(name=n) for n in sorted(s1.nodes)]
+    s2, _ = _booted(kube=inner)
+    s2._ready.clear()
+    s2.recover(live_nodes, [b1, b2], min_watermark=0)
+    assert s2._recovery_mode == "snapshot+partial"
+    m = s2.get_metrics()
+    assert m["snapshotSectionFallbackCount"] >= 1
+    assert m["snapshotFallbackCount"] == 0
+
+    plain, _ = _booted(kube=chaos.ScriptedKubeClient())
+    plain._ready.clear()
+    plain.recover(live_nodes, [b1, b2], min_watermark=0)
+    assert plain._recovery_mode == "full"
+    assert chaos.core_fingerprint(s2.core) == chaos.core_fingerprint(
+        plain.core
+    )
+    assert set(s2.pod_schedule_statuses) == set(plain.pod_schedule_statuses)
+    chaos.audit_invariants(s2, "partial-fallback")
+
+
+def test_hot_standby_partial_preapply_takeover_matches_cold_partial():
+    """Hot-standby × partial fallback: a standby beat that prefetches a
+    corrupt-section envelope pre-applies the HEALTHY families scoped
+    (the expensive restore runs off the blackout path) and records the
+    demoted chain set; the takeover re-gates against the real ledger,
+    sees the same scope, and shrinks the blackout to the scoped replay —
+    landing bit-equal to the cold partial restore AND to a full replay
+    that never had a snapshot."""
+    s1, inner = _booted()
+    b1 = _bind_one(s1, inner, "hp-0", "u-hp-0", vc="A")
+    b2 = _bind_one(s1, inner, "hp-1", "u-hp-1", vc="B")
+    s1.note_watermark(5)
+    assert s1.flush_snapshot_now()
+
+    manifest, (entry, start, _end) = _family_section_range(inner.snapshot)
+    body = "".join(inner.snapshot[1:])
+    pos = start + entry["bytes"] // 2
+    body = body[:pos] + ("X" if body[pos] != "X" else "Y") + body[pos + 1:]
+    inner.snapshot = [inner.snapshot[0], body]
+
+    live_nodes = [Node(name=n) for n in sorted(s1.nodes)]
+    hot, _ = _booted(kube=inner)
+    hot._ready.clear()
+    assert hot.prefetch_snapshot(min_watermark=0, apply=True)
+    assert hot._preapplied_chunks == inner.snapshot
+    assert hot._preapplied_replay == set(entry["chains"])
+    # An idle beat with the unchanged family is a no-op.
+    assert hot.prefetch_snapshot(min_watermark=0, apply=True)
+    hot.recover(live_nodes, [b1, b2], min_watermark=0)
+    assert hot._recovery_mode == "snapshot+partial"
+    m = hot.get_metrics()
+    assert m["snapshotSectionFallbackCount"] >= 1
+    assert m["snapshotFallbackCount"] == 0
+
+    cold, _ = _booted(kube=inner)
+    cold._ready.clear()
+    cold.recover(live_nodes, [b1, b2], min_watermark=0)
+    assert cold._recovery_mode == "snapshot+partial"
+
+    plain, _ = _booted(kube=chaos.ScriptedKubeClient())
+    plain._ready.clear()
+    plain.recover(live_nodes, [b1, b2], min_watermark=0)
+    assert plain._recovery_mode == "full"
+
+    for other in (cold, plain):
+        assert chaos.core_fingerprint(hot.core) == chaos.core_fingerprint(
+            other.core
+        )
+        assert set(hot.pod_schedule_statuses) == set(
+            other.pod_schedule_statuses
+        )
+    chaos.audit_invariants(hot, "hot-partial-takeover")
+
+
+def test_one_schema_back_v2_snapshot_restores_then_repersists_as_v3():
+    """Rolling-upgrade contract: a v2 (monolithic) envelope written by
+    the previous release restores on the v3 reader (``snapshot+delta``,
+    zero fallbacks), and the first flush after the upgrade re-persists
+    the sectioned v3 form."""
+    s1, inner = _booted()
+    b1 = _bind_one(s1, inner, "v2-0", "u-v2-0", vc="A")
+    s1.note_watermark(5)
+    assert s1.flush_snapshot_now()
+    snap, reason = snapshot_mod.decode(
+        inner.snapshot, s1._config_fingerprint, None
+    )
+    assert snap is not None, reason
+    body = {k: v for k, v in snap.items() if not k.startswith("_")}
+    inner.snapshot = snapshot_mod.encode(
+        body, s1._config_fingerprint, watermark=5, schema_version=2
+    )
+    assert json.loads(inner.snapshot[0])["schemaVersion"] == 2
+
+    s2, _ = _booted(kube=inner)
+    s2._ready.clear()
+    s2.recover(
+        [Node(name=n) for n in sorted(s1.nodes)], [b1], min_watermark=0
+    )
+    assert s2._recovery_mode == "snapshot+delta"
+    m = s2.get_metrics()
+    assert m["snapshotFallbackCount"] == 0
+    assert m["snapshotImportedPodCount"] == 1
+    assert chaos.leaf_fingerprint(s2.core) == chaos.leaf_fingerprint(s1.core)
+
+    # The first post-upgrade flush re-persists at the CURRENT schema.
+    assert s2.flush_snapshot_now()
+    manifest = json.loads(inner.snapshot[0])
+    assert manifest["schemaVersion"] == snapshot_mod.SCHEMA_VERSION
+    assert any(s.get("chains") for s in manifest["sections"])
+
+
+def test_snapshot_age_gauge_and_staleness_override(monkeypatch):
+    """``snapshotAgeSeconds`` is -1 until the first flush, then seconds
+    since the last one; once the age outruns
+    ``snapshotMaxStalenessSeconds`` while the export gate refuses, the
+    wanted flag arms so the next quiet point flushes immediately."""
+    s1, inner = _booted()
+    assert s1.get_metrics()["snapshotAgeSeconds"] == -1.0
+    _bind_one(s1, inner, "ag-0", "u-ag-0")
+    s1.note_watermark(1)
+    assert s1.flush_snapshot_now()
+    assert 0.0 <= s1.get_metrics()["snapshotAgeSeconds"] < 60.0
+
+    # Default (0 = disabled): a refused export never arms the flag.
+    monkeypatch.setattr(s1, "export_snapshot", lambda: None)
+    s1._snapshot_age_anchor -= 3600.0
+    assert s1.config.snapshot_max_staleness_seconds == 0.0
+    assert not s1.flush_snapshot_now()
+    assert not s1._snapshot_flush_wanted
+
+    # Armed: the same refusal past the budget requests the quiet-point
+    # retry.
+    s1.config.snapshot_max_staleness_seconds = 30.0
+    assert not s1.flush_snapshot_now()
+    assert s1._snapshot_flush_wanted
+    monkeypatch.undo()
+    assert s1.flush_snapshot_now()
+    assert not s1._snapshot_flush_wanted
+    assert s1.get_metrics()["snapshotAgeSeconds"] < 30.0
+
+
+def test_scrubber_leader_detects_and_repairs_section_rot(
+    tmp_path, monkeypatch
+):
+    """Leader cadence: a bit flip inside a chain-family section is
+    detected within ONE cadence (divergence counter + ``_scrub`` journal
+    record + black-box bundle) and repaired by rewriting the envelope
+    from the live projection — the scheduler keeps serving throughout."""
+    from hivedscheduler_tpu.scheduler.scrub import SnapshotScrubber
+
+    monkeypatch.setenv("HIVED_AUDIT_ARTIFACT_DIR", str(tmp_path))
+    s1, inner = _booted()
+    _bind_one(s1, inner, "sc-0", "u-sc-0")
+    s1.note_watermark(2)
+    assert s1.flush_snapshot_now()
+    scrub = SnapshotScrubber(s1, interval_beats=1)
+    s1.scrubber = scrub
+
+    assert scrub.scrub_now("clean pass")  # verified clean: no divergence
+    assert scrub.divergence_count == 0
+
+    _manifest, (entry, start, _end) = _family_section_range(inner.snapshot)
+    body = "".join(inner.snapshot[1:])
+    pos = start + entry["bytes"] // 2
+    body = body[:pos] + ("X" if body[pos] != "X" else "Y") + body[pos + 1:]
+    inner.snapshot = [inner.snapshot[0], body]
+
+    scrub.tick()  # one cadence beat
+    assert scrub.divergence_count == 1
+    assert scrub.repair_count == 1
+    assert os.path.exists(scrub.last_artifact)
+    assert any(
+        d.get("pod") == "_scrub" for d in s1.decisions.snapshot()
+    )
+    # The repair rewrote from the live projection: the envelope decodes
+    # clean again and the next pass verifies it.
+    snap, reason = snapshot_mod.decode(
+        inner.snapshot, s1._config_fingerprint, None
+    )
+    assert snap is not None and not (
+        snap["_corrupt"]["sections"] or snap["_corrupt"]["chains"]
+    ), reason
+    assert scrub.scrub_now("post-repair")
+    assert scrub.divergence_count == 1
+    # Metrics plumbing: the golden keys ride get_metrics.
+    m = s1.get_metrics()
+    assert m["scrubDivergenceCount"] == 1
+    assert m["scrubRepairCount"] == 1
+    assert m["scrubRunCount"] == scrub.scrub_runs
+
+
+def test_scrubber_standby_anti_entropy_discards_rotted_preapply():
+    """Standby cadence: rot in the PRE-APPLIED projection (fingerprint
+    mismatch vs the durable envelope it was built from) is a divergence;
+    the repair discards the pre-apply wholesale and re-prefetches from
+    durable state — the next takeover ships the durable truth."""
+    from hivedscheduler_tpu.scheduler.scrub import SnapshotScrubber
+
+    s1, inner = _booted()
+    b1 = _bind_one(s1, inner, "ae-0", "u-ae-0")
+    s1.note_watermark(3)
+    assert s1.flush_snapshot_now()
+
+    hot, _ = _booted(kube=inner)
+    hot._ready.clear()
+    hot.leadership = type(
+        "StubLease", (), {"is_leader": staticmethod(lambda: False)}
+    )()
+    assert hot.prefetch_snapshot(min_watermark=0, apply=True)
+    scrub = SnapshotScrubber(hot, interval_beats=1)
+
+    scrub.tick()  # clean: pre-apply matches durable
+    assert scrub.divergence_count == 0
+
+    # Rot the pre-applied side only (the durable envelope is untouched).
+    hot.core.export_projection = lambda: {"rotted": True}
+    scrub.tick()
+    assert scrub.divergence_count == 1
+    assert scrub.repair_count == 1  # discard + re-prefetch landed
+    assert hot._preapplied_chunks == inner.snapshot
+    # The fresh core's projection matches durable again.
+    assert scrub.scrub_now("post-repair")
+    assert scrub.divergence_count == 1
+
+    hot.recover(
+        [Node(name=n) for n in sorted(s1.nodes)], [b1], min_watermark=0
+    )
+    assert hot._recovery_mode == "snapshot+delta"
+    assert chaos.leaf_fingerprint(hot.core) == chaos.leaf_fingerprint(
+        s1.core
+    )
+
+
+def test_scrubber_env_hatch_disables_at_construction(monkeypatch):
+    from hivedscheduler_tpu.scheduler.scrub import SnapshotScrubber
+
+    monkeypatch.setenv("HIVED_SNAPSHOT_SCRUB", "0")
+    s1, inner = _booted()
+    _bind_one(s1, inner, "eh-0", "u-eh-0")
+    assert s1.flush_snapshot_now()
+    scrub = SnapshotScrubber(s1, interval_beats=1)
+    assert not scrub.enabled
+    inner.snapshot = [inner.snapshot[0], "garbage"]
+    for _ in range(4):
+        scrub.tick()
+    assert scrub.scrub_runs == 0 and scrub.divergence_count == 0

@@ -1,0 +1,259 @@
+"""Pluggable snapshot-store unit tests (scheduler.store;
+doc/fault-model.md "Durable-state plane v2").
+
+Covers the object-store backend seam by seam, below the chaos store-mix
+sweeps (tests/test_chaos.py runs the store-weighted schedules):
+
+- :class:`FileSnapshotStore` — write-new-then-flip atomicity (a torn
+  write before the manifest flip is invisible to readers; the orphan
+  generation is swept later), generation GC keeping exactly the last N,
+  corrupt-manifest-as-empty, and the missing-chunk partial read that
+  hands degraded families to the validation ladder instead of erroring;
+- :class:`StoreUnavailableError` — ``kube_retryable`` classification, so
+  a store outage rides the PR 2 retry plane and the PR 18 weather vane
+  without store-specific casing;
+- :class:`RetryingKubeClient` routing — with ``snapshot_store`` set the
+  snapshot family bypasses the apiserver entirely, and an exhausted
+  store outage under blackout parks the manifest write in the intent
+  journal (zero raised errors) and drains back to the STORE after the
+  heal;
+- :func:`make_snapshot_store` operator wiring.
+"""
+
+import os
+import random
+
+import pytest
+
+from hivedscheduler_tpu.api.config import Config
+from hivedscheduler_tpu.scheduler import weather as wx
+from hivedscheduler_tpu.scheduler.kube import (
+    RetryingKubeClient,
+    is_retryable_kube_error,
+)
+from hivedscheduler_tpu.scheduler.store import (
+    CHUNK_PREFIX,
+    GENERATION_PREFIX,
+    MANIFEST_NAME,
+    FileSnapshotStore,
+    SnapshotStore,
+    StoreUnavailableError,
+    make_snapshot_store,
+)
+
+from . import chaos
+
+
+def _gens(root):
+    return sorted(
+        int(n[len(GENERATION_PREFIX):])
+        for n in os.listdir(root)
+        if n.startswith(GENERATION_PREFIX)
+    )
+
+
+# --------------------------------------------------------------------- #
+# FileSnapshotStore
+# --------------------------------------------------------------------- #
+
+
+def test_round_trip_returns_newest_generation(tmp_path):
+    store = FileSnapshotStore(str(tmp_path / "snap"))
+    assert store.load() is None  # first boot: empty store, no error
+    store.persist(["m1", "a", "b"])
+    store.persist(["m2", "c"])
+    assert store.load() == ["m2", "c"]
+    assert store.persist_count == 2
+
+
+def test_torn_write_before_flip_is_invisible(tmp_path, monkeypatch):
+    """The atomicity contract the chaos ``torn_chunk`` events attack: a
+    crash after the new generation's chunks land but BEFORE the manifest
+    flip must leave readers on the previous complete generation."""
+    root = str(tmp_path / "snap")
+    store = FileSnapshotStore(root)
+    store.persist(["m1", "old"])
+
+    real_replace = os.replace
+
+    def torn_replace(src, dst):
+        if os.path.basename(dst) == MANIFEST_NAME:
+            raise OSError("simulated crash at the commit point")
+        return real_replace(src, dst)
+
+    monkeypatch.setattr(os, "replace", torn_replace)
+    with pytest.raises(StoreUnavailableError):
+        store.persist(["m2", "new"])
+    monkeypatch.setattr(os, "replace", real_replace)
+
+    # The orphan generation is on disk, but the pointer never moved:
+    # readers still see the old complete family.
+    assert store.load() == ["m1", "old"]
+    assert 2 in _gens(root)
+    # The next successful persist flips past the orphan and GC
+    # eventually sweeps it like any expired generation.
+    store.persist(["m3", "newer"])
+    assert store.load() == ["m3", "newer"]
+
+
+def test_gc_keeps_exactly_last_n(tmp_path):
+    root = str(tmp_path / "snap")
+    store = FileSnapshotStore(root, keep_generations=3)
+    for i in range(6):
+        store.persist([f"m{i}", f"body{i}"])
+    assert _gens(root) == [4, 5, 6]  # exactly the last N, current included
+    assert store.gc_removed_count == 3
+    assert store.load() == ["m5", "body5"]
+
+
+def test_corrupt_manifest_reads_as_empty_and_self_heals(tmp_path):
+    root = str(tmp_path / "snap")
+    store = FileSnapshotStore(root)
+    store.persist(["m1", "a"])
+    with open(os.path.join(root, MANIFEST_NAME), "w") as f:
+        f.write("{not json")
+    # A corrupt pointer is indistinguishable from no pointer — the
+    # validation ladder's full-replay rung handles it, never a raise.
+    assert store.load() is None
+    store.persist(["m2", "b"])
+    assert store.load() == ["m2", "b"]
+
+
+def test_missing_chunk_degrades_proportionally(tmp_path):
+    """A chunk lost after the flip (bit-level loss, GC racing a reader)
+    returns the surviving prefix: the sectioned envelope demotes exactly
+    the families whose bytes are gone, same as the ConfigMap backend."""
+    root = str(tmp_path / "snap")
+    store = FileSnapshotStore(root)
+    store.persist(["m1", "a", "b", "c"])
+    gen_dir = os.path.join(root, f"{GENERATION_PREFIX}{1:08d}")
+    os.remove(os.path.join(gen_dir, f"{CHUNK_PREFIX}{2:04d}"))
+    assert store.load() == ["m1", "a"]
+
+
+def test_oserror_wraps_as_retryable_store_outage(tmp_path):
+    # Root path occupied by a FILE: every write under it is an OSError —
+    # the wrapper must classify it as a transient control-plane failure.
+    blocker = tmp_path / "blocked"
+    blocker.write_text("not a directory")
+    store = FileSnapshotStore(str(blocker))
+    with pytest.raises(StoreUnavailableError) as ei:
+        store.persist(["m1", "a"])
+    assert is_retryable_kube_error(ei.value)
+    assert isinstance(ei.value, OSError)
+
+
+def test_make_snapshot_store_wiring():
+    cfg = Config()
+    assert cfg.snapshot_store_backend == "configmap"
+    assert make_snapshot_store(cfg) is None  # default: apiserver family
+    cfg.snapshot_store_backend = ""
+    assert make_snapshot_store(cfg) is None
+    cfg.snapshot_store_backend = "file"
+    cfg.snapshot_store_path = "/var/lib/hived/snapshots"
+    cfg.snapshot_store_gc_generations = 5
+    store = make_snapshot_store(cfg)
+    assert isinstance(store, FileSnapshotStore)
+    assert store.root == "/var/lib/hived/snapshots"
+    assert store.keep_generations == 5
+    cfg.snapshot_store_backend = "s3"
+    with pytest.raises(ValueError):
+        make_snapshot_store(cfg)
+
+
+# --------------------------------------------------------------------- #
+# RetryingKubeClient routing + blackout write-behind
+# --------------------------------------------------------------------- #
+
+
+class _FlakyStore(SnapshotStore):
+    """A store with a switchable outage, for the weather plumbing."""
+
+    name = "flaky"
+
+    def __init__(self):
+        self.chunks = None
+        self.down = False
+        self.persist_calls = 0
+
+    def persist(self, chunks):
+        self.persist_calls += 1
+        if self.down:
+            raise StoreUnavailableError("bucket unreachable")
+        self.chunks = list(chunks)
+
+    def load(self):
+        if self.down:
+            raise StoreUnavailableError("bucket unreachable")
+        return list(self.chunks) if self.chunks is not None else None
+
+
+def _weathered_store_client(store):
+    kube = chaos.ScriptedKubeClient()
+    vane = wx.WeatherVane()
+    journal = wx.IntentJournal()
+    client = RetryingKubeClient(
+        kube, max_attempts=3,
+        backoff_initial_s=0.01, backoff_max_s=0.02,
+        sleep=lambda s: None, jitter_rng=random.Random(7),
+        vane=vane, journal=journal, snapshot_store=store,
+    )
+    return kube, client, vane, journal
+
+
+def test_client_routes_snapshot_family_to_store(tmp_path):
+    store = FileSnapshotStore(str(tmp_path / "snap"))
+    kube, client, _vane, _journal = _weathered_store_client(store)
+    client.persist_snapshot(["m1", "a"])
+    # The apiserver chunk family is never touched: the store owns the
+    # envelope end to end.
+    assert kube.snapshot is None
+    assert client.load_snapshot() == ["m1", "a"]
+
+
+def test_store_outage_journals_under_blackout_and_drains_to_store():
+    store = _FlakyStore()
+    kube, client, vane, journal = _weathered_store_client(store)
+
+    # Blacken the skies (apiserver probes fail), then take the store
+    # down too: the exhausted snapshot write must SWALLOW and journal —
+    # the flusher's watermarks advance as under clear skies.
+    kube.outage = True
+    guard = 0
+    while vane.state() != wx.BLACKOUT:
+        client.weather_probe()
+        guard += 1
+        assert guard <= vane.blackout_after
+    store.down = True
+    client.persist_snapshot(["m1", "v1"])  # zero raised errors
+    client.persist_snapshot(["m2", "v2"])  # latest-wins coalescing
+    assert journal.depth() == 1
+    assert store.chunks is None
+
+    # Heal both planes: the drain replays the LATEST manifest write to
+    # the STORE (not the apiserver chunk family).
+    kube.outage = False
+    store.down = False
+    guard = 0
+    while not vane.drain_ok():
+        client.weather_probe()
+        guard += 1
+        assert guard <= vane.clear_after + 1
+    assert client.maybe_drain() == 1
+    assert store.chunks == ["m2", "v2"]
+    assert kube.snapshot is None
+    assert journal.depth() == 0
+
+
+def test_store_outage_outside_blackout_still_raises():
+    # PR 2 semantics hold outside blackout: a store outage with clear
+    # apiserver weather exhausts its retries and raises (the vane reads
+    # the failures, but nothing journals).
+    store = _FlakyStore()
+    _kube, client, vane, journal = _weathered_store_client(store)
+    store.down = True
+    with pytest.raises(StoreUnavailableError):
+        client.persist_snapshot(["m1", "v1"])
+    assert store.persist_calls == 3  # full retry budget spent
+    assert journal.depth() == 0
+    assert vane.state() != wx.CLEAR  # the outage fed the vane
